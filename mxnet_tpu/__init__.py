@@ -1,0 +1,62 @@
+"""mxnet_tpu — a TPU-native deep-learning framework with MXNet's capabilities.
+
+A brand-new framework (not a port): the reference's C++ dependency engine,
+CUDA/mshadow kernels, NNVM memory planning and NCCL/ps-lite communication are
+replaced by XLA async dispatch, jax/Pallas compute, whole-graph XLA lowering
+and ICI/DCN collectives. See SURVEY.md at the repo root for the blueprint and
+per-module docstrings for reference file:line parity citations.
+
+Public surface (mirrors ``python/mxnet``):
+    mx.nd        imperative arrays       mx.sym      symbolic graphs
+    mx.autograd  tape autograd           mx.gluon    imperative models + JIT
+    mx.mod       Module API              mx.kv       KVStore (XLA collectives)
+    mx.io        data iterators          mx.optimizer / mx.metric / mx.init
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from . import base
+from .base import MXNetError
+from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, num_gpus, num_tpus
+from . import ops
+from . import ndarray
+from . import ndarray as nd
+from . import autograd
+from . import random
+from .ndarray import NDArray
+
+# Submodules imported lazily to keep import light and avoid cycles.
+import importlib as _importlib
+
+_lazy = {
+    "symbol": ".symbol", "sym": ".symbol",
+    "gluon": ".gluon",
+    "optimizer": ".optimizer",
+    "metric": ".metric",
+    "initializer": ".initializer", "init": ".initializer",
+    "lr_scheduler": ".lr_scheduler",
+    "io": ".io",
+    "recordio": ".recordio",
+    "image": ".image",
+    "kvstore": ".kvstore", "kv": ".kvstore",
+    "module": ".module", "mod": ".module",
+    "model": ".model",
+    "callback": ".callback",
+    "monitor": ".monitor",
+    "profiler": ".profiler",
+    "parallel": ".parallel",
+    "engine": ".engine",
+    "executor": ".executor",
+    "test_utils": ".test_utils",
+    "util": ".util",
+    "contrib": ".contrib",
+}
+
+
+def __getattr__(name):
+    if name in _lazy:
+        mod = _importlib.import_module(_lazy[name], __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'mxnet_tpu' has no attribute {name!r}")

@@ -1,0 +1,404 @@
+"""Autograd: tape-based reverse-mode differentiation at op granularity.
+
+Reference parity: ``python/mxnet/autograd.py`` (record/pause/train_mode/
+predict_mode/backward/grad/Function) over ``src/imperative/imperative.cc``
+(``RecordOp`` :191, ``Backward`` :278, AGInfo tagging).
+
+TPU-first: instead of building an NNVM gradient graph and scheduling it on a
+C++ engine, each recorded op captures its ``jax.vjp`` closure (forward runs
+exactly once; the closure holds XLA-resident residuals). ``backward()`` walks
+the tape in reverse creation order accumulating cotangents — every vjp call
+is itself a cached XLA executable, so the backward pass is a sequence of
+async device dispatches just like forward.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from . import random as _random
+
+__all__ = ["record", "pause", "train_mode", "predict_mode", "is_recording",
+           "is_training", "set_recording", "set_training", "backward", "grad",
+           "mark_variables", "get_symbol", "Function"]
+
+_state = threading.local()
+
+
+def _st():
+    if not hasattr(_state, "recording"):
+        _state.recording = False
+        _state.training = False
+        _state.tape = []
+        _state.counter = 0
+        _state.pending_nodes = None
+    return _state
+
+
+def is_recording() -> bool:
+    return _st().recording
+
+
+def is_training() -> bool:
+    return _st().training
+
+
+def set_recording(flag: bool) -> bool:
+    st = _st()
+    old, st.recording = st.recording, flag
+    return old
+
+
+def set_training(flag: bool) -> bool:
+    st = _st()
+    old, st.training = st.training, flag
+    return old
+
+
+class _Scope:
+    def __init__(self, recording: Optional[bool], training: Optional[bool]):
+        self._rec, self._train = recording, training
+
+    def __enter__(self):
+        st = _st()
+        self._old = (st.recording, st.training)
+        if self._rec is not None:
+            st.recording = self._rec
+        if self._train is not None:
+            st.training = self._train
+        return self
+
+    def __exit__(self, *exc):
+        st = _st()
+        st.recording, st.training = self._old
+        return False
+
+
+def record(train_mode: bool = True) -> _Scope:
+    return _Scope(True, train_mode)
+
+
+def pause(train_mode: bool = False) -> _Scope:
+    return _Scope(False, train_mode)
+
+
+def train_mode() -> _Scope:
+    return _Scope(None, True)
+
+
+def predict_mode() -> _Scope:
+    return _Scope(None, False)
+
+
+class _Node:
+    """One recorded op application (the AGInfo equivalent)."""
+
+    __slots__ = ("vjp_fn", "parents", "parent_slots", "n_outputs", "order",
+                 "op_name", "saved_outputs")
+
+    def __init__(self, vjp_fn, parents, parent_slots, n_outputs, order, op_name):
+        self.vjp_fn = vjp_fn
+        self.parents = parents          # list of (_Node | _Leaf | None)
+        self.parent_slots = parent_slots  # output index within parent
+        self.n_outputs = n_outputs
+        self.order = order
+        self.op_name = op_name
+        self.saved_outputs = None
+
+
+class _Leaf:
+    """A variable with an attached gradient buffer."""
+
+    __slots__ = ("array_ref", "grad_req", "order")
+
+    def __init__(self, array_ref, grad_req="write"):
+        self.array_ref = array_ref
+        self.grad_req = grad_req
+        self.order = -1
+
+
+def _float_ok(x) -> bool:
+    return jnp.issubdtype(x.dtype, jnp.floating) or jnp.issubdtype(x.dtype, jnp.complexfloating)
+
+
+def _record_invoke(opdef, inputs, in_datas, attrs):
+    """Run ``opdef`` under jax.vjp, record a tape node. Called from
+    _imperative.invoke while recording."""
+    st = _st()
+    from ._imperative import _op_signature_flags
+    accepts_train, accepts_rng = _op_signature_flags(opdef)
+    if accepts_train and "is_train" not in attrs:
+        attrs["is_train"] = st.training
+    if accepts_rng and attrs.get("rng") is None:
+        attrs["rng"] = _random.next_key()
+    rng = attrs.pop("rng", None)
+
+    diff_idx = [i for i, d in enumerate(in_datas)
+                if hasattr(d, "dtype") and _float_ok(d)]
+    nondiff = {i: d for i, d in enumerate(in_datas) if i not in diff_idx}
+
+    def closed(*diff_args):
+        full = list(in_datas)
+        for j, i in enumerate(diff_idx):
+            full[i] = diff_args[j]
+        kw = dict(attrs)
+        if rng is not None:
+            kw["rng"] = rng
+        return opdef.fn(*full, **kw)
+
+    diff_args = [in_datas[i] for i in diff_idx]
+    if not diff_args:
+        out = closed()
+        st.pending_nodes = None
+        return out
+    out, vjp_fn = jax.vjp(closed, *diff_args)
+
+    parents, slots = [], []
+    for i in diff_idx:
+        node = getattr(inputs[i], "_ag_node", None)
+        slot = getattr(inputs[i], "_ag_slot", 0)
+        parents.append(node)
+        slots.append(slot)
+
+    n_out = len(out) if isinstance(out, tuple) else 1
+    node = _Node(vjp_fn, parents, slots, n_out, st.counter, opdef.name)
+    if n_out > 1:
+        node.saved_outputs = list(out)
+    st.counter += 1
+    st.tape.append(node)
+    st.pending_nodes = node
+    return out
+
+
+def _attach_outputs(outs):
+    st = _st()
+    node = st.pending_nodes
+    st.pending_nodes = None
+    if node is None:
+        return
+    for i, o in enumerate(outs):
+        o._ag_node = node
+        o._ag_slot = i
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for v, g, req in zip(variables, gradients, grad_reqs):
+        v._grad = g
+        v._ag_node = _Leaf(v, req)
+        v._ag_slot = 0
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """Accumulate gradients of ``heads`` into attached leaf grads
+    (reference Imperative::Backward, imperative.cc:278)."""
+    from .ndarray.ndarray import NDArray
+    if isinstance(heads, NDArray):
+        heads = [heads]
+        if head_grads is not None and not isinstance(head_grads, (list, tuple)):
+            head_grads = [head_grads]
+    _backward_impl(heads, head_grads, retain_graph, accumulate_to_leaves=True)
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,
+         train_mode=True):
+    """Return grads of heads wrt variables without touching .grad buffers."""
+    from .ndarray.ndarray import NDArray, _wrap
+    if isinstance(heads, NDArray):
+        heads = [heads]
+    if isinstance(variables, NDArray):
+        variables = [variables]
+    if create_graph:
+        raise MXNetError("create_graph=True (higher-order autograd) is not yet "
+                         "supported by the tape; use mxnet_tpu.functional.grad")
+    grads = _backward_impl(heads, head_grads, retain_graph or create_graph,
+                           accumulate_to_leaves=False, wrt=variables)
+    return [_wrap(g) for g in grads]
+
+
+def _backward_impl(heads, head_grads, retain_graph, accumulate_to_leaves=True,
+                   wrt=None):
+    st = _st()
+    # cotangent accumulator keyed by (id(node), slot)
+    cotangents: Dict[Any, Any] = {}
+    roots: List[_Node] = []
+    for i, h in enumerate(heads):
+        node = getattr(h, "_ag_node", None)
+        if node is None:
+            raise MXNetError("head array is not part of a recorded graph "
+                             "(did you compute it under autograd.record()?)")
+        hg = None
+        if head_grads is not None and head_grads[i] is not None:
+            hg = head_grads[i]._data if hasattr(head_grads[i], "_data") else head_grads[i]
+        else:
+            hg = jnp.ones_like(h._data)
+        slot = getattr(h, "_ag_slot", 0)
+        key = (id(node), slot)
+        cotangents[key] = cotangents.get(key, 0) + hg
+        if isinstance(node, _Node):
+            roots.append(node)
+
+    # collect reachable subgraph
+    seen = {}
+    stack = list(roots)
+    while stack:
+        n = stack.pop()
+        if id(n) in seen or not isinstance(n, _Node):
+            continue
+        seen[id(n)] = n
+        for p in n.parents:
+            if isinstance(p, _Node) and id(p) not in seen:
+                stack.append(p)
+
+    order = sorted(seen.values(), key=lambda n: n.order, reverse=True)
+
+    leaf_grads: Dict[int, Any] = {}
+    for n in order:
+        outs = []
+        missing = True
+        for s in range(n.n_outputs):
+            ct = cotangents.get((id(n), s))
+            if ct is not None:
+                missing = False
+        if missing:
+            continue
+        # build full cotangent tuple for the vjp
+        if n.n_outputs == 1:
+            ct0 = cotangents.get((id(n), 0))
+            in_cts = n.vjp_fn(ct0)
+        else:
+            cts = tuple(
+                cotangents.get((id(n), s)) if cotangents.get((id(n), s)) is not None
+                else jnp.zeros(sh.shape, sh.dtype)
+                for s, sh in enumerate(_vjp_out_avals(n)))
+            in_cts = n.vjp_fn(cts)
+        for p, slot, ict in zip(n.parents, n.parent_slots, in_cts):
+            if p is None or ict is None:
+                continue
+            if isinstance(p, _Leaf):
+                key = id(p.array_ref)
+                leaf_grads[key] = (leaf_grads.get(key, 0) + ict)
+            else:
+                k = (id(p), slot)
+                cotangents[k] = cotangents.get(k, 0) + ict
+        if not retain_graph:
+            n.vjp_fn = None  # free residuals eagerly
+
+    # head that IS a leaf (x.backward() on a var directly)
+    for i, h in enumerate(heads):
+        node = getattr(h, "_ag_node", None)
+        if isinstance(node, _Leaf):
+            key = id(node.array_ref)
+            hg = cotangents[(id(node), getattr(h, "_ag_slot", 0))]
+            leaf_grads[key] = leaf_grads.get(key, 0) + hg
+
+    if accumulate_to_leaves:
+        _deliver_leaf_grads(leaf_grads)
+        if not retain_graph:
+            st.tape.clear()
+        return None
+    else:
+        out = []
+        for v in wrt:
+            g = leaf_grads.get(id(v))
+            if g is None:
+                g = jnp.zeros_like(v._data)
+            out.append(g)
+        if not retain_graph:
+            st.tape.clear()
+        return out
+
+
+_all_leaves: Dict[int, Any] = {}
+
+
+def _register_leaf(arr):
+    _all_leaves[id(arr)] = arr
+
+
+def _deliver_leaf_grads(leaf_grads):
+    for key, g in leaf_grads.items():
+        arr = _all_leaves.get(key)
+        if arr is None:
+            continue
+        node = getattr(arr, "_ag_node", None)
+        req = node.grad_req if isinstance(node, _Leaf) else "write"
+        if req == "null":
+            continue
+        if req == "add" and arr._grad is not None:
+            arr._grad._set_data(arr._grad._data + g)
+        else:
+            arr._grad._set_data(g)
+
+
+def _vjp_out_avals(node):
+    # saved output avals for zero-filling missing cotangents
+    if node.saved_outputs is not None:
+        return node.saved_outputs
+    raise MXNetError(f"internal: missing output avals for {node.op_name}")
+
+
+def get_symbol(x):
+    raise MXNetError("get_symbol: the TPU runtime records jax vjp closures, "
+                     "not NNVM nodes; use CachedOp/hybridize to obtain a graph")
+
+
+class Function:
+    """Custom differentiable function (reference autograd.Function,
+    python/mxnet/autograd.py:Function). Subclass and implement
+    ``forward(self, *inputs)`` and ``backward(self, *output_grads)`` with
+    NDArray in/out."""
+
+    def __init__(self):
+        self._saved = None
+
+    def save_for_backward(self, *arrays):
+        self._saved = arrays
+
+    @property
+    def saved_tensors(self):
+        return self._saved
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        from .ndarray.ndarray import NDArray, _wrap
+        st = _st()
+        with pause():
+            outputs = self.forward(*inputs)
+        single = not isinstance(outputs, (list, tuple))
+        outs = [outputs] if single else list(outputs)
+        if is_recording():
+            func = self
+
+            def vjp_fn(cts):
+                cts = (cts,) if not isinstance(cts, tuple) else cts
+                with pause():
+                    gs = func.backward(*[_wrap(c) for c in cts])
+                if not isinstance(gs, (list, tuple)):
+                    gs = [gs]
+                return tuple(g._data if hasattr(g, "_data") else g for g in gs)
+
+            parents, slots = [], []
+            for x in inputs:
+                parents.append(getattr(x, "_ag_node", None))
+                slots.append(getattr(x, "_ag_slot", 0))
+            node = _Node(vjp_fn if len(outs) > 1 else (lambda ct: vjp_fn((ct,))),
+                         parents, slots, len(outs), st.counter,
+                         type(self).__name__)
+            node.saved_outputs = [o._data for o in outs]
+            st.counter += 1
+            st.tape.append(node)
+            for i, o in enumerate(outs):
+                o._ag_node = node
+                o._ag_slot = i
+        return outs[0] if single else outs

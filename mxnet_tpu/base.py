@@ -1,0 +1,146 @@
+"""Substrate: typed config registry, logging, errors, small utilities.
+
+TPU-native replacement for the reference's dmlc-core slice: the ~60 `MXNET_*`
+environment variables read via ``dmlc::GetEnv`` at point of use (reference
+``docs/faq/env_var.md``) and the ``dmlc::Parameter`` declarative structs
+(reference ``include/dmlc/parameter.h`` usage, e.g. ``src/imperative/cached_op.h:32``)
+collapse here into one typed, env-overridable config registry (SURVEY.md 5.6).
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional, Type
+
+__all__ = [
+    "MXNetError",
+    "config",
+    "register_config",
+    "get_env",
+    "string_types",
+    "numeric_types",
+    "integer_types",
+    "logger",
+]
+
+logger = logging.getLogger("mxnet_tpu")
+
+string_types = (str,)
+numeric_types = (float, int)
+integer_types = (int,)
+
+
+class MXNetError(RuntimeError):
+    """Framework error type (mirrors the reference's ``MXNetError`` raised
+    through the C-API thread-local error string, ``src/c_api/c_api_error.cc``)."""
+
+
+@dataclass
+class _ConfigEntry:
+    name: str
+    default: Any
+    typ: Type
+    doc: str = ""
+    validator: Optional[Callable[[Any], bool]] = None
+
+
+class _ConfigRegistry:
+    """Typed config registry, env-overridable.
+
+    Every knob is registered once with a type, default and docstring; reads
+    check ``os.environ`` first (so ``MXNET_ENGINE_TYPE=...`` style overrides
+    keep working) and fall back to programmatic ``set()`` values, then the
+    default.
+    """
+
+    def __init__(self) -> None:
+        self._entries: Dict[str, _ConfigEntry] = {}
+        self._values: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    def register(self, name: str, default: Any, typ: Type = None, doc: str = "",
+                 validator: Optional[Callable[[Any], bool]] = None) -> None:
+        typ = typ or type(default)
+        with self._lock:
+            self._entries[name] = _ConfigEntry(name, default, typ, doc, validator)
+
+    def _coerce(self, entry: _ConfigEntry, raw: str) -> Any:
+        if entry.typ is bool:
+            return raw.lower() not in ("0", "false", "off", "")
+        return entry.typ(raw)
+
+    def get(self, name: str, default: Any = None) -> Any:
+        env = os.environ.get(name)
+        entry = self._entries.get(name)
+        if env is not None:
+            if entry is not None:
+                return self._coerce(entry, env)
+            return env
+        if name in self._values:
+            return self._values[name]
+        if entry is not None:
+            return entry.default
+        return default
+
+    def set(self, name: str, value: Any) -> None:
+        entry = self._entries.get(name)
+        if entry is not None and entry.validator is not None and not entry.validator(value):
+            raise MXNetError(f"invalid value {value!r} for config {name}")
+        with self._lock:
+            self._values[name] = value
+
+    def describe(self) -> str:
+        lines = []
+        for e in sorted(self._entries.values(), key=lambda e: e.name):
+            lines.append(f"{e.name} (default={e.default!r}, type={e.typ.__name__}): {e.doc}")
+        return "\n".join(lines)
+
+    def entries(self) -> Dict[str, _ConfigEntry]:
+        return dict(self._entries)
+
+
+config = _ConfigRegistry()
+
+
+def register_config(name: str, default: Any, typ: Type = None, doc: str = "",
+                    validator=None) -> None:
+    config.register(name, default, typ, doc, validator)
+
+
+def get_env(name: str, default: Any = None) -> Any:
+    return config.get(name, default)
+
+
+# Core knobs (parity with reference docs/faq/env_var.md where meaningful on TPU).
+register_config("MXNET_ENGINE_TYPE", "XLAAsync", str,
+                "Scheduler flavor. XLAAsync rides XLA's async dispatch; "
+                "Naive forces synchronous execution after every op (debug).")
+register_config("MXNET_EXEC_BULK_EXEC_TRAIN", True, bool,
+                "Fuse op segments into one compiled XLA program during training.")
+register_config("MXNET_EXEC_BULK_EXEC_INFERENCE", True, bool,
+                "Fuse op segments into one compiled XLA program during inference.")
+register_config("MXNET_BACKWARD_DO_MIRROR", False, bool,
+                "Trade FLOPs for memory via rematerialization (jax.checkpoint).")
+register_config("MXNET_KVSTORE_BIGARRAY_BOUND", 1 << 20, int,
+                "Size above which a gradient is sharded across the reduce axis.")
+register_config("MXNET_UPDATE_AGGREGATION_SIZE", 4, int,
+                "Number of gradient tensors aggregated per fused allreduce bucket.")
+register_config("MXNET_ENFORCE_DETERMINISM", False, bool,
+                "Disallow non-deterministic reductions.")
+register_config("MXNET_PROFILER_AUTOSTART", False, bool,
+                "Start the chrome-trace profiler at import time.")
+register_config("MXNET_DEFAULT_DTYPE", "float32", str,
+                "Default dtype for created arrays.")
+register_config("MXNET_TPU_MATMUL_PRECISION", "default", str,
+                "jax matmul precision: default|high|highest.")
+register_config("MXNET_SEED", -1, int, "Global PRNG seed; -1 = nondeterministic.")
+
+
+class classproperty:  # noqa: N801  (descriptor, lowercase by convention)
+    def __init__(self, fget):
+        self.fget = fget
+
+    def __get__(self, obj, owner):
+        return self.fget(owner)

@@ -1,0 +1,82 @@
+"""``mx.nd`` — imperative array namespace.
+
+Every registered operator is exposed here as a function (generated lazily via
+module ``__getattr__``, the analogue of the reference's import-time codegen in
+``python/mxnet/ndarray/register.py``). Convention: NDArray positional args are
+op inputs; keyword args are attrs; ``out=`` writes into an existing array.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .ndarray import NDArray, array, _wrap, _unwrap
+from .utils import (zeros, ones, full, empty, arange, save, load, concat,
+                    stack, split, one_hot, concatenate, moveaxis)
+from .. import random as _random
+from .._imperative import invoke
+from ..context import Context, current_context
+from ..ops.registry import get_op, list_ops, _REGISTRY
+
+__all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
+           "save", "load", "concat", "stack", "split", "one_hot", "waitall"]
+
+
+def waitall() -> None:
+    """Block until all launched work completes (reference Engine::WaitForAll)."""
+    try:
+        for a in jax.live_arrays():
+            a.block_until_ready()
+    except Exception:
+        pass
+
+
+def _make_op_func(name: str):
+    opdef = get_op(name)
+
+    def fn(*args, out=None, **kwargs):
+        inputs = []
+        for a in args:
+            if isinstance(a, NDArray):
+                inputs.append(a)
+            elif isinstance(a, (np.ndarray, jax.Array)):
+                inputs.append(array(a))
+            else:
+                # positional scalar attr (rare; ops like clip(x, a, b))
+                inputs.append(a)
+        nds = [x for x in inputs if isinstance(x, NDArray)]
+        pos_scalars = [x for x in inputs if not isinstance(x, NDArray)]
+        if pos_scalars:
+            kwargs.setdefault("_pos", tuple(pos_scalars))
+            # clip is the only common positional-scalar op
+            if name == "clip" and len(pos_scalars) == 2:
+                kwargs.pop("_pos")
+                kwargs.setdefault("a_min", pos_scalars[0])
+                kwargs.setdefault("a_max", pos_scalars[1])
+            else:
+                kwargs.pop("_pos")
+        kwargs.pop("name", None)
+        kwargs.pop("ctx", None)
+        return invoke(name, nds, kwargs, out=out)
+
+    fn.__name__ = name
+    fn.__doc__ = opdef.doc
+    return fn
+
+
+_func_cache = {}
+
+
+def __getattr__(name: str):
+    if name in _REGISTRY:
+        if name not in _func_cache:
+            _func_cache[name] = _make_op_func(name)
+        return _func_cache[name]
+    raise AttributeError(f"module 'mxnet_tpu.ndarray' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(list(globals()) + list_ops()))

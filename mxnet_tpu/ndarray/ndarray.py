@@ -1,0 +1,336 @@
+"""NDArray — the imperative array with dataflow semantics.
+
+Reference parity: ``include/mxnet/ndarray.h:82`` / ``src/ndarray/ndarray.cc``
+and the Python frontend ``python/mxnet/ndarray/ndarray.py``.
+
+TPU-first design: the reference's Chunk = {storage handle + engine variable}
+becomes simply a ``jax.Array`` — XLA's async dispatch provides the same
+observable semantics the C++ dependency engine provides (ops return
+immediately; ``wait_to_read`` blocks on the underlying buffer future;
+asynchronous errors surface at the next sync point). Mutation (`a[:] = x`,
+`a += b`) rebinds the underlying buffer and bumps a version counter, which is
+exactly the ThreadedVar version story (threaded_engine.h:115-220) minus the
+need for any locks: the old buffer stays alive for whoever recorded it.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import autograd
+from .._imperative import invoke, invoke_raw
+from ..base import MXNetError
+from ..context import Context, current_context
+
+__all__ = ["NDArray", "array", "_wrap", "_unwrap"]
+
+
+def _unwrap(x):
+    if isinstance(x, NDArray):
+        return x._data
+    return x
+
+
+def _wrap(data) -> "NDArray":
+    return NDArray(data)
+
+
+def _to_jax(source_array, ctx: Optional[Context], dtype) -> jax.Array:
+    if isinstance(source_array, NDArray):
+        data = source_array._data
+    elif isinstance(source_array, jax.Array):
+        data = source_array
+    else:
+        data = np.asarray(source_array, dtype=dtype if dtype else None)
+        if data.dtype == np.float64 and dtype is None:
+            data = data.astype(np.float32)  # MXNet default dtype
+    dev = (ctx or current_context()).jax_device()
+    out = jax.device_put(data, dev)
+    if dtype is not None and out.dtype != jnp.dtype(dtype):
+        out = out.astype(jnp.dtype(dtype))
+    return out
+
+
+class NDArray:
+    """An n-dimensional array on a device, with async execution semantics."""
+
+    __slots__ = ("_data", "_grad", "_ag_node", "_ag_slot", "_version", "__weakref__")
+
+    # make numpy defer to our reflected operators (np_array + NDArray etc.)
+    __array_priority__ = 100.0
+
+    def __init__(self, data):
+        if isinstance(data, NDArray):
+            data = data._data
+        self._data = data
+        self._grad: Optional[NDArray] = None
+        self._ag_node = None
+        self._ag_slot = 0
+        self._version = 0
+
+    # ------------------------------------------------------------- properties
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        return np.dtype(self._data.dtype)
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self.shape)) if self.shape else 1
+
+    @property
+    def ndim(self) -> int:
+        return self._data.ndim
+
+    @property
+    def stype(self) -> str:
+        return "default"
+
+    @property
+    def context(self) -> Context:
+        dev = list(self._data.devices())[0]
+        if dev.platform == "cpu":
+            return Context("cpu", dev.id)
+        return Context("tpu", dev.id)
+
+    ctx = context
+
+    @property
+    def T(self) -> "NDArray":
+        return self.transpose()
+
+    @property
+    def grad(self) -> Optional["NDArray"]:
+        return self._grad
+
+    # ------------------------------------------------------------- sync / host
+    def wait_to_read(self) -> None:
+        """Block until all pending writes finish (reference
+        NDArray::WaitToRead). Async errors raise here."""
+        try:
+            self._data.block_until_ready()
+        except Exception as e:  # surface XLA async errors as MXNetError
+            raise MXNetError(str(e)) from e
+
+    wait_to_write = wait_to_read
+
+    def asnumpy(self) -> np.ndarray:
+        self.wait_to_read()
+        return np.asarray(self._data)
+
+    def asscalar(self):
+        if self.size != 1:
+            raise MXNetError("the array is not scalar")
+        return self.asnumpy().reshape(())[()]
+
+    def item(self):
+        return self.asscalar()
+
+    def tolist(self):
+        return self.asnumpy().tolist()
+
+    def __array__(self, dtype=None):
+        a = self.asnumpy()
+        return a.astype(dtype) if dtype else a
+
+    def __float__(self):
+        return float(self.asscalar())
+
+    def __int__(self):
+        return int(self.asscalar())
+
+    def __bool__(self):
+        if self.size != 1:
+            raise MXNetError("ambiguous truth value of multi-element NDArray")
+        return bool(self.asscalar())
+
+    def __len__(self):
+        if not self.shape:
+            raise TypeError("len() of 0-d array")
+        return self.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __repr__(self):
+        return f"\n{self.asnumpy()}\n<NDArray {'x'.join(map(str, self.shape))} " \
+               f"@{self.context}>"
+
+    # ------------------------------------------------------------- mutation
+    def _set_data(self, data) -> None:
+        self._data = data
+        self._version += 1
+
+    def copyto(self, other: Union["NDArray", Context]) -> "NDArray":
+        if isinstance(other, Context):
+            return NDArray(jax.device_put(self._data, other.jax_device()))
+        other._set_data(jax.device_put(self._data, list(other._data.devices())[0]))
+        return other
+
+    def as_in_context(self, ctx: Context) -> "NDArray":
+        if ctx == self.context:
+            return self
+        return NDArray(jax.device_put(self._data, ctx.jax_device()))
+
+    as_in_ctx = as_in_context
+
+    def copy(self) -> "NDArray":
+        return NDArray(jnp.copy(self._data))
+
+    def astype(self, dtype, copy=True) -> "NDArray":
+        if not copy and self.dtype == np.dtype(dtype):
+            return self
+        return NDArray(self._data.astype(jnp.dtype(dtype)))
+
+    def detach(self) -> "NDArray":
+        out = NDArray(self._data)
+        return out
+
+    def attach_grad(self, grad_req: str = "write", stype=None) -> None:
+        """Allocate a gradient buffer and mark this array as a tape leaf
+        (reference MXAutogradMarkVariables)."""
+        self._grad = NDArray(jnp.zeros_like(self._data))
+        self._ag_node = autograd._Leaf(self, grad_req)
+        self._ag_slot = 0
+        autograd._register_leaf(self)
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True) -> None:
+        autograd.backward([self], [out_grad] if out_grad is not None else None,
+                          retain_graph=retain_graph, train_mode=train_mode)
+
+    # ------------------------------------------------------------- indexing
+    def __getitem__(self, key) -> "NDArray":
+        if isinstance(key, NDArray):
+            key = key._data
+            if jnp.issubdtype(key.dtype, jnp.floating):
+                key = key.astype(jnp.int32)
+        return NDArray(self._data[key])
+
+    def __setitem__(self, key, value) -> None:
+        if isinstance(key, NDArray):
+            key = key._data.astype(jnp.int32)
+        if isinstance(value, NDArray):
+            value = value._data
+        if isinstance(key, slice) and key == slice(None) and not np.isscalar(value):
+            value = jnp.asarray(value, dtype=self._data.dtype)
+            self._set_data(jnp.broadcast_to(value, self.shape).astype(self._data.dtype))
+            return
+        self._set_data(self._data.at[key].set(jnp.asarray(value)))
+
+    def slice_assign(self, rhs, begin, end, step=None):
+        from ..ops.matrix import _canon_slice
+        sl = _canon_slice(self.shape, begin, end, step)
+        self._set_data(self._data.at[sl].set(_unwrap(rhs)))
+        return self
+
+    # ------------------------------------------------------------- arithmetic
+    def _binop(self, op, other, scalar_op=None, reverse=False):
+        if isinstance(other, NDArray):
+            a, b = (other, self) if reverse else (self, other)
+            return invoke(op, [a, b], {})
+        if np.isscalar(other):
+            return invoke(scalar_op, [self], {"scalar": float(other)})
+        other = NDArray(_to_jax(other, self.context, None))
+        a, b = (other, self) if reverse else (self, other)
+        return invoke(op, [a, b], {})
+
+    def __add__(self, o): return self._binop("broadcast_add", o, "_plus_scalar")
+    def __radd__(self, o): return self._binop("broadcast_add", o, "_plus_scalar")
+    def __sub__(self, o): return self._binop("broadcast_sub", o, "_minus_scalar")
+    def __rsub__(self, o): return self._binop("broadcast_sub", o, "_rminus_scalar", reverse=True)
+    def __mul__(self, o): return self._binop("broadcast_mul", o, "_mul_scalar")
+    def __rmul__(self, o): return self._binop("broadcast_mul", o, "_mul_scalar")
+    def __truediv__(self, o): return self._binop("broadcast_div", o, "_div_scalar")
+    def __rtruediv__(self, o): return self._binop("broadcast_div", o, "_rdiv_scalar", reverse=True)
+    def __mod__(self, o): return self._binop("broadcast_mod", o, "_mod_scalar")
+    def __rmod__(self, o): return self._binop("broadcast_mod", o, "_rmod_scalar", reverse=True)
+    def __pow__(self, o): return self._binop("broadcast_power", o, "_power_scalar")
+    def __rpow__(self, o): return self._binop("broadcast_power", o, "_rpower_scalar", reverse=True)
+    def __neg__(self): return invoke("negative", [self], {})
+    def __abs__(self): return invoke("abs", [self], {})
+    def __matmul__(self, o): return invoke("dot", [self, o], {})
+
+    def __eq__(self, o): return self._binop("broadcast_equal", o, "_equal_scalar")
+    def __ne__(self, o): return self._binop("broadcast_not_equal", o, "_not_equal_scalar")
+    def __gt__(self, o): return self._binop("broadcast_greater", o, "_greater_scalar")
+    def __ge__(self, o): return self._binop("broadcast_greater_equal", o, "_greater_equal_scalar")
+    def __lt__(self, o): return self._binop("broadcast_lesser", o, "_lesser_scalar")
+    def __le__(self, o): return self._binop("broadcast_lesser_equal", o, "_lesser_equal_scalar")
+
+    def __hash__(self):
+        return id(self)
+
+    def _inplace(self, op, other, scalar_op):
+        res = self._binop(op, other, scalar_op)
+        self._set_data(res._data)
+        return self
+
+    def __iadd__(self, o): return self._inplace("broadcast_add", o, "_plus_scalar")
+    def __isub__(self, o): return self._inplace("broadcast_sub", o, "_minus_scalar")
+    def __imul__(self, o): return self._inplace("broadcast_mul", o, "_mul_scalar")
+    def __itruediv__(self, o): return self._inplace("broadcast_div", o, "_div_scalar")
+
+    # ------------------------------------------------------------- op methods
+    def reshape(self, *shape, **kwargs) -> "NDArray":
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        shape = kwargs.pop("shape", shape)
+        reverse = kwargs.pop("reverse", False)
+        return invoke("Reshape", [self], {"shape": tuple(shape), "reverse": reverse})
+
+    def reshape_like(self, other):
+        return invoke("reshape_like", [self, other], {})
+
+    def broadcast_to(self, shape):
+        return invoke("broadcast_to", [self], {"shape": tuple(shape)})
+
+    def broadcast_like(self, other):
+        return invoke("broadcast_like", [self, other], {})
+
+    def expand_dims(self, axis):
+        return invoke("expand_dims", [self], {"axis": axis})
+
+    def transpose(self, *axes):
+        if len(axes) == 1 and isinstance(axes[0], (tuple, list)):
+            axes = tuple(axes[0])
+        return invoke("transpose", [self], {"axes": axes or None})
+
+    def flatten(self):
+        return invoke("Flatten", [self], {})
+
+    def squeeze(self, axis=None):
+        return invoke("squeeze", [self], {"axis": axis})
+
+    def __getattr__(self, name):
+        # dynamic method fallback: any registered op becomes a method taking
+        # self as first input — mirrors the reference's generated methods.
+        from ..ops.registry import _REGISTRY
+        if name.startswith("_") or name not in _REGISTRY:
+            raise AttributeError(f"NDArray has no attribute {name!r}")
+        me = self
+
+        def method(*args, **kwargs):
+            ins = [me] + [a for a in args if isinstance(a, NDArray)]
+            attrs = {k: v for k, v in kwargs.items()}
+            scalars = [a for a in args if not isinstance(a, NDArray)]
+            if scalars:
+                # positional non-array args are op-specific; only axis-like
+                # single values are supported positionally
+                if len(scalars) == 1 and "axis" not in attrs:
+                    attrs["axis"] = scalars[0]
+            out = attrs.pop("out", None)
+            return invoke(name, ins, attrs, out=out)
+
+        return method
+
+
+def array(source_array, ctx: Optional[Context] = None, dtype=None) -> NDArray:
+    """Create an NDArray from any array-like (reference mx.nd.array)."""
+    return NDArray(_to_jax(source_array, ctx, dtype))

@@ -1,0 +1,149 @@
+"""Array creation helpers and serialization.
+
+Reference parity: ``python/mxnet/ndarray/utils.py`` (zeros/ones/save/load) and
+the binary list format of ``NDArray::Save/Load``
+(``src/ndarray/ndarray.cc:1562-1769``). The on-disk format here is a
+self-describing container (magic + dtype/shape header + raw little-endian
+buffers); ``mxnet_tpu.util.load_reference_params`` handles the reference's
+format for zoo interop.
+"""
+from __future__ import annotations
+
+import json
+import struct
+from typing import Dict, List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .ndarray import NDArray, array, _unwrap
+from ..context import Context, current_context
+from ..base import MXNetError
+
+__all__ = ["zeros", "ones", "full", "empty", "arange", "save", "load",
+           "concat", "stack", "split", "one_hot", "concatenate", "moveaxis"]
+
+_MAGIC = b"MXTPU001"
+
+
+def _shape(shape):
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(shape)
+
+
+def zeros(shape, ctx: Optional[Context] = None, dtype=None, **kwargs) -> NDArray:
+    dtype = dtype or "float32"
+    return array(np.zeros(_shape(shape), dtype=dtype), ctx=ctx)
+
+
+def ones(shape, ctx: Optional[Context] = None, dtype=None, **kwargs) -> NDArray:
+    dtype = dtype or "float32"
+    return array(np.ones(_shape(shape), dtype=dtype), ctx=ctx)
+
+
+def full(shape, val, ctx: Optional[Context] = None, dtype=None, **kwargs) -> NDArray:
+    dtype = dtype or "float32"
+    return array(np.full(_shape(shape), val, dtype=dtype), ctx=ctx)
+
+
+def empty(shape, ctx: Optional[Context] = None, dtype=None) -> NDArray:
+    return zeros(shape, ctx=ctx, dtype=dtype)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=None) -> NDArray:
+    dtype = dtype or "float32"
+    out = np.arange(start, stop, step, dtype=dtype)
+    if repeat > 1:
+        out = np.repeat(out, repeat)
+    return array(out, ctx=ctx)
+
+
+def concat(*arrays, dim=1):
+    from .._imperative import invoke
+    if len(arrays) == 1 and isinstance(arrays[0], (list, tuple)):
+        arrays = tuple(arrays[0])
+    return invoke("Concat", list(arrays), {"dim": dim})
+
+
+def concatenate(arrays, axis=0):
+    return concat(*arrays, dim=axis)
+
+
+def stack(*arrays, axis=0):
+    from .._imperative import invoke
+    if len(arrays) == 1 and isinstance(arrays[0], (list, tuple)):
+        arrays = tuple(arrays[0])
+    return invoke("stack", list(arrays), {"axis": axis})
+
+
+def split(ary, indices_or_sections, axis=0):
+    from .._imperative import invoke
+    if isinstance(indices_or_sections, int):
+        return invoke("SliceChannel", [ary],
+                      {"num_outputs": indices_or_sections, "axis": axis})
+    return invoke("split_v2", [ary],
+                  {"indices": tuple(indices_or_sections), "axis": axis})
+
+
+def one_hot(indices, depth, on_value=1.0, off_value=0.0, dtype="float32"):
+    from .._imperative import invoke
+    return invoke("one_hot", [indices],
+                  {"depth": depth, "on_value": on_value, "off_value": off_value,
+                   "dtype": dtype})
+
+
+def moveaxis(tensor, source, destination):
+    ax = list(range(tensor.ndim))
+    ax.remove(source % tensor.ndim)
+    ax.insert(destination % tensor.ndim, source % tensor.ndim)
+    return tensor.transpose(*ax)
+
+
+# ---------------------------------------------------------------- save / load
+def save(fname: str, data) -> None:
+    """Save a list or str-keyed dict of NDArrays (reference mx.nd.save)."""
+    if isinstance(data, NDArray):
+        data = [data]
+    if isinstance(data, dict):
+        keys = list(data.keys())
+        arrays = [data[k] for k in keys]
+    else:
+        keys = None
+        arrays = list(data)
+    metas = []
+    blobs = []
+    for a in arrays:
+        np_a = a.asnumpy() if isinstance(a, NDArray) else np.asarray(a)
+        blobs.append(np_a.tobytes())
+        metas.append({"shape": list(np_a.shape), "dtype": str(np_a.dtype)})
+    header = json.dumps({"keys": keys, "metas": metas}).encode()
+    with open(fname, "wb") as f:
+        f.write(_MAGIC)
+        f.write(struct.pack("<Q", len(header)))
+        f.write(header)
+        for b in blobs:
+            f.write(struct.pack("<Q", len(b)))
+            f.write(b)
+
+
+def load(fname: str):
+    """Load NDArrays saved by :func:`save`; returns list or dict."""
+    with open(fname, "rb") as f:
+        magic = f.read(8)
+        if magic != _MAGIC:
+            raise MXNetError(f"{fname}: not a mxnet_tpu NDArray file "
+                             f"(bad magic {magic!r}); for reference-format "
+                             f".params files use mxnet_tpu.util.load_reference_params")
+        (hlen,) = struct.unpack("<Q", f.read(8))
+        header = json.loads(f.read(hlen).decode())
+        arrays = []
+        for meta in header["metas"]:
+            (blen,) = struct.unpack("<Q", f.read(8))
+            buf = f.read(blen)
+            np_a = np.frombuffer(buf, dtype=meta["dtype"]).reshape(meta["shape"])
+            arrays.append(array(np_a))
+    if header["keys"] is None:
+        return arrays
+    return dict(zip(header["keys"], arrays))

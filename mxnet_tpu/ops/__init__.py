@@ -1,0 +1,16 @@
+"""Operator library (the ``src/operator`` equivalent, as XLA emitters).
+
+Importing this package registers every op module with the registry. The
+priority order follows SURVEY.md stage 2: tensor → nn → random → sequence →
+long tail.
+"""
+from .registry import OpDef, register, get_op, list_ops, alias, jitted_op
+
+from . import elemwise       # noqa: F401
+from . import broadcast_reduce  # noqa: F401
+from . import matrix         # noqa: F401
+from . import index          # noqa: F401
+from . import init_ops       # noqa: F401
+from . import order          # noqa: F401
+from . import nn             # noqa: F401
+from . import random_ops     # noqa: F401

@@ -1,0 +1,147 @@
+"""Broadcast binary ops and reductions.
+
+Reference parity: ``src/operator/tensor/broadcast_reduce_op*.{cc,h}`` and
+``elemwise_binary_broadcast_op*.cc``. MXNet distinguishes elemwise (same
+shape) from broadcast_* ops; XLA implements both with the same HLO, so the
+broadcast family simply maps to numpy-style broadcasting.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _bcast(name, fn, differentiable=True):
+    register(name, differentiable=differentiable)(fn)
+
+
+_bcast("broadcast_add", jnp.add)
+_bcast("broadcast_plus", jnp.add)
+_bcast("broadcast_sub", jnp.subtract)
+_bcast("broadcast_minus", jnp.subtract)
+_bcast("broadcast_mul", jnp.multiply)
+_bcast("broadcast_div", jnp.divide)
+_bcast("broadcast_mod", jnp.mod)
+_bcast("broadcast_power", jnp.power)
+_bcast("broadcast_maximum", jnp.maximum)
+_bcast("broadcast_minimum", jnp.minimum)
+_bcast("broadcast_hypot", jnp.hypot)
+
+
+def _bcast_cmp(name, fn):
+    register(name, differentiable=False)(lambda l, r: fn(l, r).astype(l.dtype))
+
+
+_bcast_cmp("broadcast_equal", jnp.equal)
+_bcast_cmp("broadcast_not_equal", jnp.not_equal)
+_bcast_cmp("broadcast_greater", jnp.greater)
+_bcast_cmp("broadcast_greater_equal", jnp.greater_equal)
+_bcast_cmp("broadcast_lesser", jnp.less)
+_bcast_cmp("broadcast_lesser_equal", jnp.less_equal)
+_bcast_cmp("broadcast_logical_and", lambda l, r: jnp.logical_and(l != 0, r != 0))
+_bcast_cmp("broadcast_logical_or", lambda l, r: jnp.logical_or(l != 0, r != 0))
+_bcast_cmp("broadcast_logical_xor", lambda l, r: jnp.logical_xor(l != 0, r != 0))
+
+
+@register("broadcast_to")
+def _broadcast_to(x, shape=None):
+    # MXNet semantics: 0 in target shape means "keep source dim".
+    tgt = tuple(s if t == 0 else t for s, t in zip(x.shape, shape))
+    return jnp.broadcast_to(x, tgt)
+
+
+@register("broadcast_like")
+def _broadcast_like(x, like, lhs_axes=None, rhs_axes=None):
+    if lhs_axes is None:
+        return jnp.broadcast_to(x, like.shape)
+    tgt = list(x.shape)
+    for la, ra in zip(lhs_axes, rhs_axes):
+        tgt[la] = like.shape[ra]
+    return jnp.broadcast_to(x, tuple(tgt))
+
+
+@register("broadcast_axis", aliases=["broadcast_axes"])
+def _broadcast_axis(x, axis=(), size=()):
+    axes = (axis,) if isinstance(axis, int) else tuple(axis)
+    sizes = (size,) if isinstance(size, int) else tuple(size)
+    tgt = list(x.shape)
+    for a, s in zip(axes, sizes):
+        tgt[a] = s
+    return jnp.broadcast_to(x, tuple(tgt))
+
+
+# ---- reductions ------------------------------------------------------------
+def _norm_axis(axis, ndim, exclude=False):
+    if axis is None or axis == ():
+        ax = tuple(range(ndim)) if not exclude else ()
+        return ax if ax else None if not exclude else tuple(range(ndim))
+    if isinstance(axis, int):
+        axis = (axis,)
+    axis = tuple(a % ndim for a in axis)
+    if exclude:
+        axis = tuple(a for a in range(ndim) if a not in axis)
+    return axis
+
+
+def _reduce(name, fn, differentiable=True, int_out=False):
+    def op(x, axis=None, keepdims=False, exclude=False):
+        ax = _norm_axis(axis, x.ndim, exclude)
+        return fn(x, axis=ax, keepdims=bool(keepdims))
+
+    register(name, differentiable=differentiable)(op)
+
+
+_reduce("sum", jnp.sum)
+_reduce("mean", jnp.mean)
+_reduce("prod", jnp.prod)
+_reduce("max", jnp.max)
+_reduce("min", jnp.min)
+_reduce("nansum", jnp.nansum)
+_reduce("nanprod", jnp.nanprod)
+
+
+@register("norm")
+def _norm(x, ord=2, axis=None, keepdims=False, out_dtype=None):
+    ax = _norm_axis(axis, x.ndim) if axis is not None else None
+    if ord == 1:
+        r = jnp.sum(jnp.abs(x), axis=ax, keepdims=bool(keepdims))
+    else:
+        r = jnp.sqrt(jnp.sum(jnp.square(x), axis=ax, keepdims=bool(keepdims)))
+    return r.astype(jnp.dtype(out_dtype)) if out_dtype else r
+
+
+def _arg_reduce(name, fn):
+    def op(x, axis=None, keepdims=False):
+        if axis is None:
+            return fn(x.reshape(-1), axis=0).astype(jnp.float32)
+        out = fn(x, axis=int(axis))
+        if keepdims:
+            out = jnp.expand_dims(out, int(axis))
+        return out.astype(jnp.float32)  # MXNet returns float indices
+
+    register(name, differentiable=False)(op)
+
+
+_arg_reduce("argmax", jnp.argmax)
+_arg_reduce("argmin", jnp.argmin)
+
+
+@register("argmax_channel", differentiable=False)
+def _argmax_channel(x):
+    return jnp.argmax(x, axis=1).astype(jnp.float32)
+
+
+@register("L2Normalization")
+def _l2_normalization(x, eps=1e-10, mode="instance"):
+    # reference: src/operator/l2_normalization.cc
+    if mode == "instance":
+        ax = tuple(range(1, x.ndim))
+    elif mode == "channel":
+        ax = (1,)
+    elif mode == "spatial":
+        ax = tuple(range(2, x.ndim))
+    else:
+        raise ValueError(f"bad L2Normalization mode {mode}")
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=ax, keepdims=True) + eps)
+    return x / norm

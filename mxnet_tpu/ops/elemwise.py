@@ -1,0 +1,184 @@
+"""Element-wise unary / binary / scalar operators.
+
+Reference parity: the mshadow_op functor zoo + elemwise registrations in
+``src/operator/tensor/elemwise_unary_op_basic.cc``, ``elemwise_binary_op*.cc``,
+``elemwise_binary_scalar_op*.cc`` and ``src/operator/mshadow_op.h``.
+On TPU all of these are single XLA HLO instructions that fuse into neighboring
+ops; there is nothing to hand-schedule.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register, alias
+
+_f32 = jnp.float32
+
+
+def _unary(name, fn, differentiable=True, aliases=()):
+    register(name, differentiable=differentiable, aliases=aliases)(fn)
+
+
+# ---- unary math ------------------------------------------------------------
+_unary("abs", jnp.abs)
+_unary("sign", jnp.sign)
+_unary("negative", jnp.negative)
+_unary("reciprocal", lambda x: 1.0 / x)
+_unary("square", jnp.square)
+_unary("sqrt", jnp.sqrt)
+_unary("rsqrt", lambda x: jax.lax.rsqrt(x))
+_unary("cbrt", jnp.cbrt)
+_unary("rcbrt", lambda x: 1.0 / jnp.cbrt(x))
+_unary("exp", jnp.exp)
+_unary("expm1", jnp.expm1)
+_unary("log", jnp.log)
+_unary("log2", jnp.log2)
+_unary("log10", jnp.log10)
+_unary("log1p", jnp.log1p)
+_unary("sin", jnp.sin)
+_unary("cos", jnp.cos)
+_unary("tan", jnp.tan)
+_unary("arcsin", jnp.arcsin)
+_unary("arccos", jnp.arccos)
+_unary("arctan", jnp.arctan)
+_unary("sinh", jnp.sinh)
+_unary("cosh", jnp.cosh)
+_unary("tanh", jnp.tanh)
+_unary("arcsinh", jnp.arcsinh)
+_unary("arccosh", jnp.arccosh)
+_unary("arctanh", jnp.arctanh)
+_unary("degrees", jnp.degrees)
+_unary("radians", jnp.radians)
+_unary("floor", jnp.floor, differentiable=False)
+_unary("ceil", jnp.ceil, differentiable=False)
+_unary("round", jnp.round, differentiable=False)
+_unary("rint", jnp.rint, differentiable=False)
+_unary("trunc", jnp.trunc, differentiable=False)
+_unary("fix", jnp.trunc, differentiable=False)
+_unary("erf", jax.lax.erf)
+_unary("erfinv", jax.lax.erf_inv)
+_unary("gamma", lambda x: jnp.exp(jax.lax.lgamma(x)))
+_unary("gammaln", jax.lax.lgamma)
+_unary("sigmoid", jax.nn.sigmoid)
+_unary("softsign", lambda x: x / (1.0 + jnp.abs(x)))
+_unary("relu", jax.nn.relu)
+_unary("logical_not", lambda x: (x == 0).astype(x.dtype), differentiable=False)
+
+
+@register("hard_sigmoid")
+def _hard_sigmoid(x, alpha=0.2, beta=0.5):
+    return jnp.clip(alpha * x + beta, 0.0, 1.0)
+
+
+@register("identity", aliases=["_copy"])
+def _identity(x):
+    return x
+
+
+@register("BlockGrad", aliases=["stop_gradient"])
+def _block_grad(x):
+    return jax.lax.stop_gradient(x)
+
+
+@register("make_loss")
+def _make_loss(x):
+    # reference: src/operator/make_loss.cc — marks an output as a loss head;
+    # the graph layer treats it as an output whose gradient seed is ones.
+    return x
+
+
+@register("Cast", aliases=["cast"])
+def _cast(x, dtype="float32"):
+    return x.astype(jnp.dtype(dtype))
+
+
+@register("amp_cast")
+def _amp_cast(x, dtype="float32"):
+    return x.astype(jnp.dtype(dtype))
+
+
+@register("amp_multicast", num_outputs=lambda attrs: int(attrs.get("num_outputs", 1)))
+def _amp_multicast(*xs, num_outputs=1):
+    wide = jnp.result_type(*[x.dtype for x in xs])
+    return tuple(x.astype(wide) for x in xs)
+
+
+@register("clip")
+def _clip(x, a_min=None, a_max=None):
+    return jnp.clip(x, a_min, a_max)
+
+
+# ---- binary (same-shape elementwise; XLA broadcasts anyway, MXNet requires
+# identical shapes for elemwise_* but numpy-broadcast here is a superset) ----
+_unary("elemwise_add", jnp.add, aliases=["_plus", "_add"])
+_unary("elemwise_sub", jnp.subtract, aliases=["_minus", "_sub"])
+_unary("elemwise_mul", jnp.multiply, aliases=["_mul"])
+_unary("elemwise_div", jnp.divide, aliases=["_div"])
+_unary("_power", jnp.power, aliases=["pow"])
+_unary("_maximum", jnp.maximum)
+_unary("_minimum", jnp.minimum)
+_unary("_hypot", jnp.hypot)
+_unary("_mod", jnp.mod, aliases=["mod"])
+
+
+@register("add_n", aliases=["ElementWiseSum", "_sum"])
+def _add_n(*xs):
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return out
+
+
+def _cmp(name, fn):
+    register(name, differentiable=False)(lambda l, r: fn(l, r).astype(l.dtype))
+
+
+_cmp("_equal", jnp.equal)
+_cmp("_not_equal", jnp.not_equal)
+_cmp("_greater", jnp.greater)
+_cmp("_greater_equal", jnp.greater_equal)
+_cmp("_lesser", jnp.less)
+_cmp("_lesser_equal", jnp.less_equal)
+_cmp("_logical_and", lambda l, r: jnp.logical_and(l != 0, r != 0))
+_cmp("_logical_or", lambda l, r: jnp.logical_or(l != 0, r != 0))
+_cmp("_logical_xor", lambda l, r: jnp.logical_xor(l != 0, r != 0))
+
+
+# ---- scalar ops (attr `scalar`) -------------------------------------------
+def _scalar_op(name, fn, differentiable=True, aliases=()):
+    register(name, differentiable=differentiable, aliases=aliases)(
+        lambda x, scalar=0.0: fn(x, scalar))
+
+
+_scalar_op("_plus_scalar", lambda x, s: x + s)
+_scalar_op("_minus_scalar", lambda x, s: x - s)
+_scalar_op("_rminus_scalar", lambda x, s: s - x)
+_scalar_op("_mul_scalar", lambda x, s: x * s)
+_scalar_op("_div_scalar", lambda x, s: x / s)
+_scalar_op("_rdiv_scalar", lambda x, s: s / x)
+_scalar_op("_power_scalar", lambda x, s: jnp.power(x, s))
+_scalar_op("_rpower_scalar", lambda x, s: jnp.power(s, x))
+_scalar_op("_maximum_scalar", lambda x, s: jnp.maximum(x, s))
+_scalar_op("_minimum_scalar", lambda x, s: jnp.minimum(x, s))
+_scalar_op("_mod_scalar", lambda x, s: jnp.mod(x, s))
+_scalar_op("_rmod_scalar", lambda x, s: jnp.mod(s, x))
+_scalar_op("_hypot_scalar", lambda x, s: jnp.hypot(x, s))
+_scalar_op("_equal_scalar", lambda x, s: (x == s).astype(x.dtype), differentiable=False)
+_scalar_op("_not_equal_scalar", lambda x, s: (x != s).astype(x.dtype), differentiable=False)
+_scalar_op("_greater_scalar", lambda x, s: (x > s).astype(x.dtype), differentiable=False)
+_scalar_op("_greater_equal_scalar", lambda x, s: (x >= s).astype(x.dtype), differentiable=False)
+_scalar_op("_lesser_scalar", lambda x, s: (x < s).astype(x.dtype), differentiable=False)
+_scalar_op("_lesser_equal_scalar", lambda x, s: (x <= s).astype(x.dtype), differentiable=False)
+_scalar_op("_logical_and_scalar", lambda x, s: jnp.logical_and(x != 0, s != 0).astype(x.dtype),
+           differentiable=False)
+_scalar_op("_logical_or_scalar", lambda x, s: jnp.logical_or(x != 0, s != 0).astype(x.dtype),
+           differentiable=False)
+_scalar_op("_logical_xor_scalar", lambda x, s: jnp.logical_xor(x != 0, s != 0).astype(x.dtype),
+           differentiable=False)
+
+
+@register("smooth_l1")
+def _smooth_l1(x, scalar=1.0):
+    s2 = scalar * scalar
+    return jnp.where(jnp.abs(x) < 1.0 / s2, 0.5 * s2 * x * x, jnp.abs(x) - 0.5 / s2)

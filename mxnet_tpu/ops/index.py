@@ -1,0 +1,131 @@
+"""Indexing / gather / scatter ops.
+
+Reference parity: ``src/operator/tensor/indexing_op.cc`` (take, Embedding,
+pick, one_hot, gather_nd, scatter_nd), ``where``, boolean masking. Sparse
+gradients (row_sparse take grads) are represented densely; see
+``mxnet_tpu.ndarray.sparse`` for the sparse surface.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+@register("take")
+def _take(a, indices, axis=0, mode="clip"):
+    idx = indices.astype(jnp.int32)
+    return jnp.take(a, idx, axis=int(axis), mode=mode if mode != "raise" else "clip")
+
+
+@register("batch_take")
+def _batch_take(a, indices):
+    idx = indices.astype(jnp.int32).reshape(-1)
+    flat = a.reshape(-1)
+    offs = jnp.arange(a.shape[0]) * a.shape[1]
+    return flat[offs + idx]
+
+
+@register("Embedding", arg_names=("data", "weight"))
+def _embedding(data, weight, input_dim=None, output_dim=None, dtype="float32",
+               sparse_grad=False):
+    idx = data.astype(jnp.int32)
+    return jnp.take(weight, idx, axis=0, mode="clip")
+
+
+@register("pick")
+def _pick(data, index, axis=-1, keepdims=False, mode="clip"):
+    idx = index.astype(jnp.int32)
+    ax = int(axis) % data.ndim
+    idxe = jnp.expand_dims(idx, ax) if idx.ndim < data.ndim else idx
+    out = jnp.take_along_axis(data, jnp.clip(idxe, 0, data.shape[ax] - 1), axis=ax)
+    if not keepdims:
+        out = jnp.squeeze(out, axis=ax)
+    return out
+
+
+@register("one_hot", differentiable=False)
+def _one_hot(indices, depth=1, on_value=1.0, off_value=0.0, dtype="float32"):
+    idx = indices.astype(jnp.int32)
+    oh = jax.nn.one_hot(idx, int(depth), dtype=jnp.dtype(dtype))
+    return oh * (on_value - off_value) + off_value
+
+
+@register("gather_nd")
+def _gather_nd(data, indices):
+    idx = indices.astype(jnp.int32)
+    m = idx.shape[0]
+    return data[tuple(idx[i] for i in range(m))]
+
+
+@register("scatter_nd")
+def _scatter_nd(data, indices, shape=None):
+    idx = indices.astype(jnp.int32)
+    m = idx.shape[0]
+    out = jnp.zeros(shape, dtype=data.dtype)
+    return out.at[tuple(idx[i] for i in range(m))].set(data)
+
+
+@register("_scatter_set_nd")
+def _scatter_set_nd(lhs, rhs, indices, shape=None):
+    idx = indices.astype(jnp.int32)
+    m = idx.shape[0]
+    return lhs.at[tuple(idx[i] for i in range(m))].set(rhs)
+
+
+@register("where")
+def _where(condition, x, y):
+    return jnp.where(condition != 0, x, y)
+
+
+@register("boolean_mask")
+def _boolean_mask(data, index, axis=0):
+    # Dynamic-size output: XLA needs static shapes, so this op is only legal
+    # imperatively (outside jit), like the reference's dynamic-shape contrib ops.
+    import numpy as np
+    mask = np.asarray(index) != 0
+    return jnp.compress(mask, data, axis=int(axis))
+
+
+@register("SequenceMask", aliases=["sequence_mask"])
+def _sequence_mask(data, sequence_length=None, use_sequence_length=False, value=0.0, axis=0):
+    # reference: src/operator/sequence_mask.cc — data layout (seq, batch, ...)
+    # for axis=0 or (batch, seq, ...) for axis=1.
+    if not use_sequence_length or sequence_length is None:
+        return data
+    ax = int(axis)
+    seq_len = data.shape[ax]
+    pos = jnp.arange(seq_len)
+    lens = sequence_length.astype(pos.dtype)
+    mask = pos[:, None] < lens[None, :]  # (seq, batch)
+    if ax == 1:
+        mask = mask.T  # (batch, seq)
+    mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    return jnp.where(mask, data, jnp.asarray(value, dtype=data.dtype))
+
+
+@register("SequenceLast", aliases=["sequence_last"])
+def _sequence_last(data, sequence_length=None, use_sequence_length=False, axis=0):
+    ax = int(axis)
+    if not use_sequence_length or sequence_length is None:
+        idx = [slice(None)] * data.ndim
+        idx[ax] = -1
+        return data[tuple(idx)]
+    last = (sequence_length.astype(jnp.int32) - 1)
+    moved = jnp.moveaxis(data, ax, 0)  # (seq, batch, ...)
+    batch = jnp.arange(moved.shape[1])
+    return moved[last, batch]
+
+
+@register("SequenceReverse", aliases=["sequence_reverse"])
+def _sequence_reverse(data, sequence_length=None, use_sequence_length=False, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return jnp.flip(data, axis=0)
+    seq_len = data.shape[0]
+    pos = jnp.arange(seq_len)[:, None]
+    lens = sequence_length.astype(jnp.int32)[None, :]
+    src = jnp.where(pos < lens, lens - 1 - pos, pos)  # (seq, batch)
+    moved = data  # (seq, batch, ...)
+    batch = jnp.arange(data.shape[1])[None, :]
+    return moved[src, batch]

@@ -1,0 +1,57 @@
+"""Creation ops (no array inputs).
+
+Reference parity: ``src/operator/tensor/init_op.cc`` — zeros/ones/full/
+arange/eye/linspace and the *_like family.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _dt(dtype):
+    return jnp.dtype(dtype or "float32")
+
+
+@register("_zeros", aliases=["zeros"], differentiable=False)
+def _zeros(shape=(), dtype="float32"):
+    return jnp.zeros(shape, dtype=_dt(dtype))
+
+
+@register("_ones", aliases=["ones"], differentiable=False)
+def _ones(shape=(), dtype="float32"):
+    return jnp.ones(shape, dtype=_dt(dtype))
+
+
+@register("_full", aliases=["full"], differentiable=False)
+def _full(shape=(), value=0.0, dtype="float32"):
+    return jnp.full(shape, value, dtype=_dt(dtype))
+
+
+@register("_arange", aliases=["arange"], differentiable=False)
+def _arange(start=0.0, stop=None, step=1.0, repeat=1, infer_range=False, dtype="float32"):
+    out = jnp.arange(start, stop, step, dtype=_dt(dtype))
+    if int(repeat) > 1:
+        out = jnp.repeat(out, int(repeat))
+    return out
+
+
+@register("_linspace", aliases=["linspace"], differentiable=False)
+def _linspace(start=0.0, stop=1.0, num=50, endpoint=True, dtype="float32"):
+    return jnp.linspace(start, stop, int(num), endpoint=bool(endpoint), dtype=_dt(dtype))
+
+
+@register("_eye", aliases=["eye"], differentiable=False)
+def _eye(N=0, M=0, k=0, dtype="float32"):
+    return jnp.eye(int(N), int(M) or None, k=int(k), dtype=_dt(dtype))
+
+
+@register("zeros_like", differentiable=False)
+def _zeros_like(x):
+    return jnp.zeros_like(x)
+
+
+@register("ones_like", differentiable=False)
+def _ones_like(x):
+    return jnp.ones_like(x)

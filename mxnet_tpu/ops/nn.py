@@ -1,0 +1,461 @@
+"""Neural-network ops: the MXU path.
+
+Reference parity: ``src/operator/nn/`` — FullyConnected
+(``fully_connected.cc:239-279``), Convolution/Deconvolution (cuDNN backends
+``nn/cudnn/`` replaced by XLA's convolution HLO), Pooling, BatchNorm,
+LayerNorm, LRN, Activation/LeakyReLU, softmax family, Dropout, UpSampling.
+
+TPU-first notes: convs/matmuls go through ``lax.conv_general_dilated`` /
+``jnp.dot`` so XLA tiles them onto the MXU; elementwise pre/post ops fuse into
+the same HLO computation. The cuDNN algo-selection registry
+(``cudnn_algoreg-inl.h``) has no equivalent here — XLA autotunes.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+from ..base import MXNetError
+
+
+def _pair(v, n=2):
+    if v is None or v == ():
+        return (1,) * n
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(int(x) for x in v)
+
+
+# ---------------------------------------------------------------- FullyConnected
+@register("FullyConnected", arg_names=("data", "weight", "bias"))
+def _fully_connected(data, weight, bias=None, num_hidden=None, no_bias=False,
+                     flatten=True):
+    """out = X·Wᵀ + b. Weight layout (num_hidden, input_dim), matching the
+    reference (fully_connected.cc:47-93 shape function)."""
+    if flatten and data.ndim > 2:
+        data = data.reshape(data.shape[0], -1)
+    out = jnp.dot(data, weight.T)
+    if not no_bias and bias is not None:
+        out = out + bias
+    return out
+
+
+# ---------------------------------------------------------------- Convolution
+_CONV_DIMS = {1: ("NCW", "OIW", "NCW"), 2: ("NCHW", "OIHW", "NCHW"),
+              3: ("NCDHW", "OIDHW", "NCDHW")}
+
+
+@register("Convolution", arg_names=("data", "weight", "bias"))
+def _convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(), pad=(),
+                 num_filter=1, num_group=1, no_bias=False, workspace=1024,
+                 cudnn_tune=None, cudnn_off=False, layout=None):
+    nd = len(kernel)
+    stride = _pair(stride, nd)
+    dilate = _pair(dilate, nd)
+    pad = _pair(pad, nd) if pad else (0,) * nd
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape, _CONV_DIMS[nd])
+    out = lax.conv_general_dilated(
+        data, weight, window_strides=stride,
+        padding=[(p, p) for p in pad],
+        rhs_dilation=dilate, dimension_numbers=dn,
+        feature_group_count=int(num_group))
+    if not no_bias and bias is not None:
+        out = out + bias.reshape((1, -1) + (1,) * nd)
+    return out
+
+
+@register("Deconvolution", arg_names=("data", "weight", "bias"))
+def _deconvolution(data, weight, bias=None, kernel=(), stride=(), dilate=(), pad=(),
+                   adj=(), target_shape=(), num_filter=1, num_group=1, no_bias=True,
+                   workspace=512, cudnn_tune=None, cudnn_off=False, layout=None):
+    """Transposed convolution (reference src/operator/nn/deconvolution.cc):
+    the gradient of Convolution wrt its input, expressed directly with
+    input dilation so XLA sees one conv HLO."""
+    nd = len(kernel)
+    stride = _pair(stride, nd)
+    dilate = _pair(dilate, nd)
+    pad = _pair(pad, nd) if pad else (0,) * nd
+    adj = _pair(adj, nd) if adj else (0,) * nd
+    # weight layout: (in_channels, num_filter//group, *kernel)
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape, _CONV_DIMS[nd])
+    k_eff = [(int(kernel[i]) - 1) * dilate[i] + 1 for i in range(nd)]
+    padding = [(k_eff[i] - 1 - pad[i], k_eff[i] - 1 - pad[i] + adj[i]) for i in range(nd)]
+    g = int(num_group)
+    # flip spatial dims and swap in/out channels per group
+    w = jnp.flip(weight, axis=tuple(range(2, 2 + nd)))
+    ci, co_g = w.shape[0], w.shape[1]
+    w = w.reshape((g, ci // g, co_g) + w.shape[2:])
+    w = jnp.swapaxes(w, 1, 2).reshape((co_g * g, ci // g) + tuple(w.shape[3:]))
+    out = lax.conv_general_dilated(
+        data, w, window_strides=(1,) * nd, padding=padding,
+        lhs_dilation=stride, rhs_dilation=dilate, dimension_numbers=dn,
+        feature_group_count=g)
+    if not no_bias and bias is not None:
+        out = out + bias.reshape((1, -1) + (1,) * nd)
+    return out
+
+
+# ---------------------------------------------------------------- Pooling
+@register("Pooling", arg_names=("data",))
+def _pooling(data, kernel=(), pool_type="max", global_pool=False, stride=(), pad=(),
+             pooling_convention="valid", cudnn_off=False, p_value=2,
+             count_include_pad=True, layout=None):
+    nd = data.ndim - 2
+    if global_pool:
+        kernel = data.shape[2:]
+        stride = (1,) * nd
+        pad = (0,) * nd
+    kernel = _pair(kernel, nd)
+    stride = _pair(stride, nd) if stride else kernel if global_pool else (1,) * nd
+    pad = _pair(pad, nd) if pad else (0,) * nd
+    window = (1, 1) + kernel
+    strides = (1, 1) + stride
+    padcfg = []
+    for i in range(nd):
+        lo = hi = pad[i]
+        if pooling_convention == "full":
+            # ceil output size (reference pooling-inl.h kFull)
+            size = data.shape[2 + i]
+            out_sz = -(-(size + 2 * pad[i] - kernel[i]) // stride[i]) + 1
+            need = (out_sz - 1) * stride[i] + kernel[i] - size - pad[i]
+            hi = max(need, pad[i])
+        padcfg.append((lo, hi))
+    padding = ((0, 0), (0, 0)) + tuple(padcfg)
+    if pool_type == "max":
+        init = -jnp.inf
+        out = lax.reduce_window(data, init, lax.max, window, strides, padding)
+    elif pool_type in ("avg", "sum"):
+        out = lax.reduce_window(data, 0.0, lax.add, window, strides, padding)
+        if pool_type == "avg":
+            if count_include_pad:
+                out = out / float(jnp.prod(jnp.asarray(kernel)))
+            else:
+                ones = jnp.ones_like(data)
+                cnt = lax.reduce_window(ones, 0.0, lax.add, window, strides, padding)
+                out = out / cnt
+    elif pool_type == "lp":
+        p = float(p_value)
+        out = lax.reduce_window(jnp.abs(data) ** p, 0.0, lax.add, window, strides,
+                                padding) ** (1.0 / p)
+    else:
+        raise MXNetError(f"bad pool_type {pool_type}")
+    return out
+
+
+# ---------------------------------------------------------------- Norms
+@register("BatchNorm", num_outputs=3,
+          arg_names=("data", "gamma", "beta", "moving_mean", "moving_var"),
+          aux_args=("moving_mean", "moving_var"))
+def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3, momentum=0.9,
+                fix_gamma=True, use_global_stats=False, output_mean_var=False,
+                axis=1, cudnn_off=False, is_train=True):
+    ax = int(axis) % data.ndim
+    red = tuple(i for i in range(data.ndim) if i != ax)
+    bshape = tuple(data.shape[ax] if i == ax else 1 for i in range(data.ndim))
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    if is_train and not use_global_stats:
+        mean = jnp.mean(data, axis=red)
+        var = jnp.mean(jnp.square(data - mean.reshape(bshape)), axis=red)
+    else:
+        mean, var = moving_mean, moving_var
+    inv = lax.rsqrt(var.reshape(bshape) + eps)
+    out = (data - mean.reshape(bshape)) * inv * g.reshape(bshape) + beta.reshape(bshape)
+    return out, mean, var
+
+
+@register("LayerNorm", num_outputs=3, arg_names=("data", "gamma", "beta"))
+def _layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False):
+    ax = int(axis) % data.ndim
+    mean = jnp.mean(data, axis=ax, keepdims=True)
+    var = jnp.mean(jnp.square(data - mean), axis=ax, keepdims=True)
+    inv = lax.rsqrt(var + eps)
+    shape = tuple(data.shape[ax] if i == ax else 1 for i in range(data.ndim))
+    out = (data - mean) * inv * gamma.reshape(shape) + beta.reshape(shape)
+    return out, jnp.squeeze(mean, ax), jnp.squeeze(var, ax)
+
+
+@register("InstanceNorm", arg_names=("data", "gamma", "beta"))
+def _instance_norm(data, gamma, beta, eps=1e-3):
+    red = tuple(range(2, data.ndim))
+    mean = jnp.mean(data, axis=red, keepdims=True)
+    var = jnp.mean(jnp.square(data - mean), axis=red, keepdims=True)
+    shape = (1, -1) + (1,) * (data.ndim - 2)
+    return (data - mean) * lax.rsqrt(var + eps) * gamma.reshape(shape) + beta.reshape(shape)
+
+
+@register("LRN", num_outputs=2, arg_names=("data",))
+def _lrn(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5):
+    """Local response norm across channels (reference src/operator/nn/lrn.cc)."""
+    half = int(nsize) // 2
+    sq = jnp.square(data)
+    padded = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+    windows = sum(padded[:, i:i + data.shape[1]] for i in range(int(nsize)))
+    norm = (knorm + (alpha / nsize) * windows) ** beta
+    return data / norm, norm
+
+
+# ---------------------------------------------------------------- Activations
+@register("Activation", arg_names=("data",))
+def _activation(data, act_type="relu"):
+    if act_type == "relu":
+        return jax.nn.relu(data)
+    if act_type == "sigmoid":
+        return jax.nn.sigmoid(data)
+    if act_type == "tanh":
+        return jnp.tanh(data)
+    if act_type == "softrelu":
+        return jax.nn.softplus(data)
+    if act_type == "softsign":
+        return data / (1.0 + jnp.abs(data))
+    raise MXNetError(f"bad act_type {act_type}")
+
+
+@register("LeakyReLU", needs_rng=True, arg_names=("data", "gamma"))
+def _leaky_relu(data, gamma=None, act_type="leaky", slope=0.25, lower_bound=0.125,
+                upper_bound=0.334, rng=None, is_train=True):
+    if act_type == "leaky":
+        return jnp.where(data >= 0, data, slope * data)
+    if act_type == "prelu":
+        shape = (1, -1) + (1,) * (data.ndim - 2) if data.ndim > 1 else (-1,)
+        return jnp.where(data >= 0, data, gamma.reshape(shape) * data)
+    if act_type == "elu":
+        return jnp.where(data >= 0, data, slope * jnp.expm1(data))
+    if act_type == "selu":
+        a, s = 1.6732632423543772, 1.0507009873554805
+        return s * jnp.where(data >= 0, data, a * jnp.expm1(data))
+    if act_type == "gelu":
+        return jax.nn.gelu(data, approximate=False)
+    if act_type == "rrelu":
+        if is_train and rng is not None:
+            sl = jax.random.uniform(rng, data.shape, minval=lower_bound,
+                                    maxval=upper_bound, dtype=data.dtype)
+        else:
+            sl = (lower_bound + upper_bound) / 2.0
+        return jnp.where(data >= 0, data, sl * data)
+    raise MXNetError(f"bad act_type {act_type}")
+
+
+# ---------------------------------------------------------------- Softmax family
+@register("softmax", arg_names=("data",))
+def _softmax(data, axis=-1, temperature=None, length=None, use_length=False,
+             dtype=None):
+    x = data / temperature if temperature else data
+    if use_length and length is not None:
+        ax = int(axis) % data.ndim
+        pos = jnp.arange(data.shape[ax])
+        shape = tuple(data.shape[ax] if i == ax else 1 for i in range(data.ndim))
+        lens = length.reshape(tuple(-1 if i == 0 else 1 for i in range(data.ndim)))
+        mask = pos.reshape(shape) < lens
+        x = jnp.where(mask, x, -jnp.inf)
+        out = jax.nn.softmax(x, axis=int(axis))
+        return jnp.where(mask, out, 0.0)
+    out = jax.nn.softmax(x, axis=int(axis))
+    return out.astype(jnp.dtype(dtype)) if dtype else out
+
+
+@register("log_softmax", arg_names=("data",))
+def _log_softmax(data, axis=-1, temperature=None, dtype=None):
+    x = data / temperature if temperature else data
+    out = jax.nn.log_softmax(x, axis=int(axis))
+    return out.astype(jnp.dtype(dtype)) if dtype else out
+
+
+@register("softmin", arg_names=("data",))
+def _softmin(data, axis=-1, temperature=None, dtype=None):
+    return _softmax(-data, axis=axis, temperature=temperature, dtype=dtype)
+
+
+@register("SoftmaxActivation")
+def _softmax_activation(data, mode="instance"):
+    if mode == "channel":
+        return jax.nn.softmax(data, axis=1)
+    return jax.nn.softmax(data.reshape(data.shape[0], -1), axis=-1).reshape(data.shape)
+
+
+def _softmax_output_fwd(data, label, grad_scale, ignore_label, use_ignore,
+                        multi_output, normalization, smooth_alpha):
+    if multi_output:
+        out = jax.nn.softmax(data, axis=1)
+    else:
+        out = jax.nn.softmax(data.reshape(data.shape[0], -1), axis=-1).reshape(data.shape)
+    return out
+
+
+@register("SoftmaxOutput", aliases=["Softmax"], arg_names=("data", "label"))
+def _softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0, multi_output=False,
+                    use_ignore=False, preserve_shape=False, normalization="null",
+                    out_grad=False, smooth_alpha=0.0):
+    """Softmax forward with implicit cross-entropy gradient (reference
+    src/operator/softmax_output.cc): backward is (p - onehot(label)) * scale,
+    expressed via jax.custom_vjp so autograd and the graph compiler both see it.
+    """
+
+    @jax.custom_vjp
+    def _so(d, l):
+        return _softmax_output_fwd(d, l, grad_scale, ignore_label, use_ignore,
+                                   multi_output, normalization, smooth_alpha)
+
+    def _fwd(d, l):
+        out = _so(d, l)
+        return out, (out, l)
+
+    def _bwd(res, g):
+        out, l = res
+        if multi_output:
+            # data (N, C, ...); label (N, ...)
+            lab = l.astype(jnp.int32)
+            oh = jax.nn.one_hot(lab, out.shape[1], dtype=out.dtype, axis=1)
+        else:
+            flat = out.reshape(out.shape[0], -1)
+            lab = l.reshape(-1).astype(jnp.int32)
+            oh = jax.nn.one_hot(lab, flat.shape[-1], dtype=out.dtype).reshape(out.shape)
+        if smooth_alpha:
+            k = oh.shape[1] if multi_output else oh.reshape(oh.shape[0], -1).shape[-1]
+            oh = oh * (1.0 - smooth_alpha) + smooth_alpha / (k - 1) * (1.0 - oh)
+        grad = out - oh
+        if use_ignore:
+            if multi_output:
+                mask = (l != ignore_label).astype(out.dtype)
+                mask = jnp.expand_dims(mask, 1)
+            else:
+                mask = (l.reshape(-1) != ignore_label).astype(out.dtype)
+                mask = mask.reshape((-1,) + (1,) * (out.ndim - 1))
+            grad = grad * mask
+        scale = grad_scale
+        if normalization == "batch":
+            scale = scale / out.shape[0]
+        elif normalization == "valid" and use_ignore:
+            valid = jnp.maximum(jnp.sum((l != ignore_label).astype(out.dtype)), 1.0)
+            grad = grad / valid
+        grad = grad * scale
+        return grad, jnp.zeros_like(l)
+
+    _so.defvjp(_fwd, _bwd)
+    return _so(data, label)
+
+
+@register("LinearRegressionOutput", arg_names=("data", "label"))
+def _linear_regression_output(data, label, grad_scale=1.0):
+    @jax.custom_vjp
+    def _lr(d, l):
+        return d
+
+    def _fwd(d, l):
+        return d, (d, l)
+
+    def _bwd(res, g):
+        d, l = res
+        return ((d - l.reshape(d.shape)) * grad_scale, jnp.zeros_like(l))
+
+    _lr.defvjp(_fwd, _bwd)
+    return _lr(data, label)
+
+
+@register("LogisticRegressionOutput", arg_names=("data", "label"))
+def _logistic_regression_output(data, label, grad_scale=1.0):
+    @jax.custom_vjp
+    def _lr(d, l):
+        return jax.nn.sigmoid(d)
+
+    def _fwd(d, l):
+        out = jax.nn.sigmoid(d)
+        return out, (out, l)
+
+    def _bwd(res, g):
+        out, l = res
+        return ((out - l.reshape(out.shape)) * grad_scale, jnp.zeros_like(l))
+
+    _lr.defvjp(_fwd, _bwd)
+    return _lr(data, label)
+
+
+@register("MAERegressionOutput", arg_names=("data", "label"))
+def _mae_regression_output(data, label, grad_scale=1.0):
+    @jax.custom_vjp
+    def _lr(d, l):
+        return d
+
+    def _fwd(d, l):
+        return d, (d, l)
+
+    def _bwd(res, g):
+        d, l = res
+        return (jnp.sign(d - l.reshape(d.shape)) * grad_scale, jnp.zeros_like(l))
+
+    _lr.defvjp(_fwd, _bwd)
+    return _lr(data, label)
+
+
+# ---------------------------------------------------------------- Dropout
+@register("Dropout", needs_rng=True, arg_names=("data",))
+def _dropout(data, p=0.5, mode="training", axes=(), cudnn_off=False, rng=None,
+             is_train=True):
+    if (not is_train and mode != "always") or p <= 0.0 or rng is None:
+        return data
+    shape = list(data.shape)
+    for ax in (axes or ()):
+        shape[ax] = 1
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(rng, keep, tuple(shape)).astype(data.dtype) / keep
+    return data * mask
+
+
+# ---------------------------------------------------------------- Misc nn
+@register("UpSampling")
+def _upsampling(*args, scale=1, sample_type="nearest", num_filter=0, num_args=1,
+                multi_input_mode="concat", workspace=512):
+    data = args[0]
+    s = int(scale)
+    if sample_type == "nearest":
+        out = jnp.repeat(jnp.repeat(data, s, axis=2), s, axis=3)
+    else:  # bilinear — args[1] is the (unused) learned weight in inference mode
+        n, c, h, w = data.shape
+        out = jax.image.resize(data, (n, c, h * s, w * s), method="bilinear")
+    return out
+
+
+@register("GridGenerator")
+def _grid_generator(data, transform_type="affine", target_shape=(0, 0)):
+    h, w = int(target_shape[0]), int(target_shape[1])
+    if transform_type == "affine":
+        ys = jnp.linspace(-1.0, 1.0, h)
+        xs = jnp.linspace(-1.0, 1.0, w)
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        base = jnp.stack([gx.reshape(-1), gy.reshape(-1), jnp.ones(h * w)], axis=0)
+        theta = data.reshape(-1, 2, 3)
+        grid = jnp.einsum("nij,jk->nik", theta, base)
+        return grid.reshape(-1, 2, h, w)
+    return data  # warp type: data is already the flow grid
+
+
+@register("BilinearSampler")
+def _bilinear_sampler(data, grid, cudnn_off=False):
+    n, c, h, w = data.shape
+    gx = (grid[:, 0] + 1.0) * (w - 1) / 2.0
+    gy = (grid[:, 1] + 1.0) * (h - 1) / 2.0
+    x0 = jnp.floor(gx); x1 = x0 + 1
+    y0 = jnp.floor(gy); y1 = y0 + 1
+    wa = (x1 - gx) * (y1 - gy)
+    wb = (x1 - gx) * (gy - y0)
+    wc = (gx - x0) * (y1 - gy)
+    wd = (gx - x0) * (gy - y0)
+
+    def gather(yi, xi):
+        yi = jnp.clip(yi.astype(jnp.int32), 0, h - 1)
+        xi = jnp.clip(xi.astype(jnp.int32), 0, w - 1)
+        bidx = jnp.arange(n).reshape(n, 1, 1)
+        return data[bidx, :, yi, xi].transpose(0, 3, 1, 2)
+
+    out = (wa[:, None] * gather(y0, x0) + wb[:, None] * gather(y1, x0)
+           + wc[:, None] * gather(y0, x1) + wd[:, None] * gather(y1, x1))
+    inb = ((gx >= 0) & (gx <= w - 1) & (gy >= 0) & (gy <= h - 1)).astype(data.dtype)
+    return out * inb[:, None]
+
+
+@register("SpatialTransformer")
+def _spatial_transformer(data, loc, target_shape=(0, 0), transform_type="affine",
+                         sampler_type="bilinear", cudnn_off=False):
+    grid = _grid_generator(loc, transform_type="affine", target_shape=target_shape)
+    return _bilinear_sampler(data, grid)

@@ -1,0 +1,65 @@
+"""Ordering ops: sort / argsort / topk.
+
+Reference parity: ``src/operator/tensor/ordering_op.cc``. XLA lowers these to
+its own sort HLO; no hand-rolled bitonic kernels needed.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+
+
+@register("sort")
+def _sort(x, axis=-1, is_ascend=True):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    out = jnp.sort(x, axis=int(axis))
+    if not is_ascend:
+        out = jnp.flip(out, axis=int(axis))
+    return out
+
+
+@register("argsort", differentiable=False)
+def _argsort(x, axis=-1, is_ascend=True, dtype="float32"):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    idx = jnp.argsort(x, axis=int(axis))
+    if not is_ascend:
+        idx = jnp.flip(idx, axis=int(axis))
+    return idx.astype(jnp.dtype(dtype))
+
+
+def _topk_nout(attrs):
+    rt = attrs.get("ret_typ", "indices")
+    return 2 if rt == "both" else 1
+
+
+@register("topk", num_outputs=_topk_nout, differentiable=False)
+def _topk(x, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
+    ax = int(axis) if axis is not None else 0
+    if axis is None:
+        x = x.reshape(-1)
+    k = int(k) if int(k) > 0 else x.shape[ax]
+    sign = 1.0 if is_ascend else -1.0
+    idx = jnp.argsort(sign * x, axis=ax)
+    idx = jnp.take(idx, jnp.arange(k), axis=ax)
+    vals = jnp.take_along_axis(x, idx, axis=ax)
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "indices":
+        return idx.astype(jnp.dtype(dtype))
+    if ret_typ == "both":
+        return vals, idx.astype(jnp.dtype(dtype))
+    if ret_typ == "mask":
+        mask = jnp.zeros_like(x)
+        return mask.at[idx].set(1.0) if x.ndim == 1 else _mask_along(x, idx, ax)
+    raise ValueError(f"bad ret_typ {ret_typ}")
+
+
+def _mask_along(x, idx, ax):
+    onehot = jnp.sum(
+        jnp.eye(x.shape[ax], dtype=x.dtype)[idx], axis=ax, keepdims=False)
+    return jnp.moveaxis(jnp.moveaxis(jnp.zeros_like(x), ax, -1) + onehot, -1, ax)

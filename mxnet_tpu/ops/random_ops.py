@@ -1,0 +1,127 @@
+"""Random sampling ops.
+
+Reference parity: ``src/operator/random/`` (sample_op.cc: uniform/normal/
+gamma/exponential/poisson/negative_binomial/generalized_negative_binomial,
+randint, multinomial, shuffle; random_generator.h parallel PRNG).
+
+TPU-first: counter-based stateless PRNG (jax threefry). Imperative calls draw
+keys from the global seed stream (``mxnet_tpu.random``); inside captured
+graphs the key is a traced input so compiled executables stay functional.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _dt(dtype):
+    return jnp.dtype(dtype or "float32")
+
+
+@register("_random_uniform", aliases=["random_uniform", "uniform"], needs_rng=True,
+          differentiable=False)
+def _uniform(low=0.0, high=1.0, shape=(), dtype="float32", rng=None, ctx=None):
+    return jax.random.uniform(rng, shape, minval=low, maxval=high, dtype=_dt(dtype))
+
+
+@register("_random_normal", aliases=["random_normal", "normal"], needs_rng=True,
+          differentiable=False)
+def _normal(loc=0.0, scale=1.0, shape=(), dtype="float32", rng=None, ctx=None):
+    return jax.random.normal(rng, shape, dtype=_dt(dtype)) * scale + loc
+
+
+@register("_random_gamma", aliases=["random_gamma"], needs_rng=True, differentiable=False)
+def _gamma(alpha=1.0, beta=1.0, shape=(), dtype="float32", rng=None, ctx=None):
+    return jax.random.gamma(rng, alpha, shape, dtype=_dt(dtype)) * beta
+
+
+@register("_random_exponential", aliases=["random_exponential"], needs_rng=True,
+          differentiable=False)
+def _exponential(lam=1.0, shape=(), dtype="float32", rng=None, ctx=None):
+    return jax.random.exponential(rng, shape, dtype=_dt(dtype)) / lam
+
+
+@register("_random_poisson", aliases=["random_poisson"], needs_rng=True,
+          differentiable=False)
+def _poisson(lam=1.0, shape=(), dtype="float32", rng=None, ctx=None):
+    return jax.random.poisson(rng, lam, shape).astype(_dt(dtype))
+
+
+@register("_random_negative_binomial", aliases=["random_negative_binomial"],
+          needs_rng=True, differentiable=False)
+def _neg_binomial(k=1, p=1.0, shape=(), dtype="float32", rng=None, ctx=None):
+    k1, k2 = jax.random.split(rng)
+    lam = jax.random.gamma(k1, k, shape) * ((1 - p) / p)
+    return jax.random.poisson(k2, lam, shape).astype(_dt(dtype))
+
+
+@register("_random_generalized_negative_binomial",
+          aliases=["random_generalized_negative_binomial"], needs_rng=True,
+          differentiable=False)
+def _gen_neg_binomial(mu=1.0, alpha=1.0, shape=(), dtype="float32", rng=None, ctx=None):
+    k1, k2 = jax.random.split(rng)
+    r = 1.0 / alpha
+    lam = jax.random.gamma(k1, r, shape) * (mu * alpha)
+    return jax.random.poisson(k2, lam, shape).astype(_dt(dtype))
+
+
+@register("_random_randint", aliases=["random_randint", "randint"], needs_rng=True,
+          differentiable=False)
+def _randint(low=0, high=1, shape=(), dtype="int32", rng=None, ctx=None):
+    return jax.random.randint(rng, shape, int(low), int(high), dtype=_dt(dtype))
+
+
+# sample_* ops: per-element distribution parameters given as input arrays.
+@register("_sample_uniform", aliases=["sample_uniform"], needs_rng=True,
+          differentiable=False)
+def _sample_uniform(low, high, shape=(), dtype="float32", rng=None):
+    s = tuple(shape) if shape else ()
+    u = jax.random.uniform(rng, low.shape + s, dtype=_dt(dtype))
+    return low.reshape(low.shape + (1,) * len(s)) + u * (high - low).reshape(
+        low.shape + (1,) * len(s))
+
+
+@register("_sample_normal", aliases=["sample_normal"], needs_rng=True,
+          differentiable=False)
+def _sample_normal(mu, sigma, shape=(), dtype="float32", rng=None):
+    s = tuple(shape) if shape else ()
+    z = jax.random.normal(rng, mu.shape + s, dtype=_dt(dtype))
+    return mu.reshape(mu.shape + (1,) * len(s)) + z * sigma.reshape(sigma.shape + (1,) * len(s))
+
+
+@register("_sample_gamma", aliases=["sample_gamma"], needs_rng=True, differentiable=False)
+def _sample_gamma(alpha, beta, shape=(), dtype="float32", rng=None):
+    s = tuple(shape) if shape else ()
+    a = alpha.reshape(alpha.shape + (1,) * len(s))
+    g = jax.random.gamma(rng, jnp.broadcast_to(a, alpha.shape + s), dtype=_dt(dtype))
+    return g * beta.reshape(beta.shape + (1,) * len(s))
+
+
+@register("_sample_multinomial", aliases=["sample_multinomial"], needs_rng=True,
+          differentiable=False, num_outputs=lambda a: 2 if a.get("get_prob") else 1)
+def _sample_multinomial(data, shape=(), get_prob=False, dtype="int32", rng=None):
+    s = (int(shape),) if isinstance(shape, int) else tuple(shape)
+    n = 1
+    for d in s:
+        n *= d
+    n = max(n, 1)
+    logits = jnp.log(jnp.maximum(data, 1e-30))
+    samp = jax.random.categorical(rng, logits, axis=-1, shape=(max(n, 1),) + logits.shape[:-1])
+    samp = jnp.moveaxis(samp, 0, -1)
+    out_shape = data.shape[:-1] + s if s else data.shape[:-1]
+    samp = samp.reshape(out_shape) if s else samp.reshape(data.shape[:-1])
+    samp = samp.astype(_dt(dtype))
+    if get_prob:
+        logp = jnp.take_along_axis(
+            jax.nn.log_softmax(logits, axis=-1),
+            samp.astype(jnp.int32).reshape(data.shape[:-1] + (-1,)), axis=-1
+        ).reshape(samp.shape)
+        return samp, logp
+    return samp
+
+
+@register("_shuffle", aliases=["shuffle"], needs_rng=True, differentiable=False)
+def _shuffle(data, rng=None):
+    return jax.random.permutation(rng, data, axis=0)

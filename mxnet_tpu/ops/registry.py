@@ -1,0 +1,155 @@
+"""Operator registry — the TPU-native analogue of the NNVM op registry.
+
+Reference parity: ``NNVM_REGISTER_OP`` + per-op attrs ``FCompute``,
+``FInferShape``, ``FInferType``, ``FGradient`` (``include/mxnet/op_attr_types.h:66-313``,
+registration style ``src/operator/nn/fully_connected.cc:239-279``).
+
+TPU-first design: an op is a *pure jax function* ``fn(*arrays, **attrs)``.
+That single artifact subsumes the reference's per-op attribute zoo:
+
+* ``FCompute<cpu/gpu>``  → the jax function itself (XLA compiles per backend);
+* ``FInferShape/FInferType`` → ``jax.eval_shape`` over the same function;
+* ``FGradient``          → ``jax.vjp`` over the same function (with optional
+  per-op override for custom gradients like ``SoftmaxOutput``);
+* kernel autotuning (``operator_tune.h``) → XLA's cost model; nothing to do.
+
+Both frontend namespaces (``mxnet_tpu.ndarray`` — imperative, and
+``mxnet_tpu.symbol`` — graph-building) are generated from this registry at
+import, mirroring the reference's codegen from the C registry
+(``python/mxnet/ndarray/register.py``).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+
+from ..base import MXNetError
+
+__all__ = ["OpDef", "register", "get_op", "list_ops", "alias", "jitted_op"]
+
+_REGISTRY: Dict[str, "OpDef"] = {}
+
+
+class OpDef:
+    """One registered operator.
+
+    Parameters
+    ----------
+    name : canonical op name (MXNet-compatible, e.g. ``FullyConnected``).
+    fn : pure function ``fn(*arrays, **attrs) -> array | tuple``. Arrays are
+        jax arrays; attrs are hashable python values (the registry coerces
+        lists to tuples at call sites).
+    num_outputs : static output count, or a callable ``attrs -> int`` for ops
+        like ``split`` whose arity depends on attrs.
+    needs_rng : op consumes a PRNG key; the runtime threads one in as the
+        ``rng`` keyword (imperative: from the global seed stream; symbolic:
+        as a traced input so jitted graphs stay functional).
+    grad : optional custom gradient: ``grad(attrs) -> fn`` returning a
+        function with a ``jax.custom_vjp`` already applied, or None to use
+        plain ``jax.vjp`` over ``fn``.
+    differentiable : False marks ops with no gradient (integer ops etc.).
+    """
+
+    def __init__(self, name: str, fn: Callable, num_outputs=1, needs_rng: bool = False,
+                 differentiable: bool = True, doc: str = "", arg_names=None,
+                 aux_args=()):
+        self.name = name
+        self.fn = fn
+        self.num_outputs = num_outputs
+        self.needs_rng = needs_rng
+        self.differentiable = differentiable
+        self.doc = doc or (fn.__doc__ or "")
+        self._arg_names = arg_names  # explicit array-input names, else derived
+        self.aux_args = tuple(aux_args)  # names that are auxiliary states (BN stats)
+
+    def arg_names(self):
+        """Array-input parameter names, for symbolic auto-variable creation
+        (the reference derives these from the C op signature the same way)."""
+        if self._arg_names is None:
+            import inspect
+            names = []
+            try:
+                for p in inspect.signature(self.fn).parameters.values():
+                    if p.kind == p.VAR_POSITIONAL:
+                        names = None  # variadic: caller must pass arrays
+                        break
+                    if p.default is p.empty or p.default is None:
+                        if p.name not in ("rng",):
+                            names.append(p.name)
+                    else:
+                        break
+            except (TypeError, ValueError):
+                names = None
+            self._arg_names = names
+        return self._arg_names
+
+    def out_count(self, attrs: Dict[str, Any]) -> int:
+        if callable(self.num_outputs):
+            return self.num_outputs(attrs)
+        return self.num_outputs
+
+    def __repr__(self):
+        return f"OpDef({self.name})"
+
+
+def _hashable(v):
+    if isinstance(v, list):
+        return tuple(_hashable(x) for x in v)
+    if isinstance(v, tuple):
+        return tuple(_hashable(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _hashable(x)) for k, x in v.items()))
+    return v
+
+
+def normalize_attrs(attrs: Dict[str, Any]) -> Tuple[Tuple[str, Any], ...]:
+    return tuple(sorted((k, _hashable(v)) for k, v in attrs.items() if v is not None))
+
+
+def register(name: str, num_outputs=1, needs_rng: bool = False,
+             differentiable: bool = True, aliases: Sequence[str] = (),
+             arg_names=None, aux_args=()):
+    """Decorator: register ``fn`` as operator ``name`` (plus aliases)."""
+
+    def deco(fn: Callable):
+        opdef = OpDef(name, fn, num_outputs=num_outputs, needs_rng=needs_rng,
+                      differentiable=differentiable, arg_names=arg_names,
+                      aux_args=aux_args)
+        _REGISTRY[name] = opdef
+        for a in aliases:
+            _REGISTRY[a] = opdef
+        return fn
+
+    return deco
+
+
+def alias(existing: str, *names: str) -> None:
+    opdef = _REGISTRY[existing]
+    for n in names:
+        _REGISTRY[n] = opdef
+
+
+def get_op(name: str) -> OpDef:
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise MXNetError(f"operator {name!r} is not registered") from None
+
+
+def list_ops():
+    return sorted(_REGISTRY)
+
+
+@functools.lru_cache(maxsize=16384)
+def jitted_op(name: str, attr_items: Tuple[Tuple[str, Any], ...]):
+    """Per-op compiled-executable cache, keyed by (op, attrs); XLA adds the
+    (shapes, dtypes) key underneath. This is the imperative fast path the
+    reference gets from its async C++ engine (SURVEY.md stage 3): each
+    distinct (op, attrs, shapes) pair compiles once, then dispatches async.
+    """
+    opdef = get_op(name)
+    attrs = dict(attr_items)
+    fn = functools.partial(opdef.fn, **attrs)
+    return jax.jit(fn)

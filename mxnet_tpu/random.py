@@ -1,0 +1,55 @@
+"""Global PRNG seed stream.
+
+Reference parity: ``mx.random.seed`` (``python/mxnet/random.py``) and the
+per-device parallel PRNG resource (``include/mxnet/random_generator.h``,
+``src/resource.cc:87-162`` global seeding).
+
+TPU-first: a counter-based stateless threefry stream. ``seed(n)`` resets the
+root key; every imperative random op folds in a fresh counter value, so ops
+stay pure functions of (key, attrs) and remain jit-compatible. Inside captured
+graphs the key is threaded as a real input by the tracer instead.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+import jax
+
+from .base import get_env
+
+__all__ = ["seed", "next_key", "current_seed"]
+
+_state = threading.local()
+_global = {"seed": None}
+_lock = threading.Lock()
+
+
+def _root():
+    if _global["seed"] is None:
+        env = int(get_env("MXNET_SEED", -1))
+        _global["seed"] = env if env >= 0 else (time.time_ns() & 0x7FFFFFFF)
+        _global["counter"] = 0
+    return _global["seed"]
+
+
+def seed(seed_state: int, ctx="all") -> None:
+    """Reset the global stream (ctx arg kept for API parity; the stream is
+    device-independent because keys are data, not device state)."""
+    with _lock:
+        _global["seed"] = int(seed_state)
+        _global["counter"] = 0
+
+
+def current_seed() -> int:
+    with _lock:
+        return _root()
+
+
+def next_key():
+    """Draw the next key from the global stream."""
+    with _lock:
+        root = _root()
+        c = _global["counter"]
+        _global["counter"] += 1
+    return jax.random.fold_in(jax.random.PRNGKey(root), c)

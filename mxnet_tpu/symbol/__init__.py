@@ -1,0 +1,100 @@
+"""``mx.sym`` — symbolic namespace.
+
+Like ``mx.nd``, every registered operator is exposed lazily as a graph-node
+constructor (reference codegen: ``python/mxnet/symbol/register.py``). Calling
+``sym.FullyConnected(data, num_hidden=10, name="fc1")`` creates a node and
+auto-creates weight/bias Variables named ``fc1_weight``/``fc1_bias`` when not
+supplied — same behavior as the reference's symbol composition.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from .symbol import (Symbol, Variable, var, Group, load, load_json, _Node,
+                     _auto_name)
+from ..ops.registry import get_op, list_ops, _REGISTRY
+from ..base import MXNetError
+
+__all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json", "zeros",
+           "ones"]
+
+
+def _invoke_sym(op_name: str, sym_inputs: List[Symbol], kwargs: Dict[str, Any]) -> Symbol:
+    opdef = get_op(op_name)
+    name = kwargs.pop("name", None) or _auto_name(op_name)
+    kwargs.pop("ctx", None)
+
+    # expand multi-output symbols for variadic ops; take output 0 otherwise
+    entries = []
+    for s in sym_inputs:
+        if not isinstance(s, Symbol):
+            raise MXNetError(f"{op_name}: expected Symbol input, got {type(s)}")
+        if len(s._outputs) > 1:
+            entries.extend(s._outputs)
+        else:
+            entries.append(s._outputs[0])
+
+    # split keyword Symbol args (e.g. weight=..., bias=...) from attrs
+    arg_names = opdef.arg_names() or []
+    kw_syms: Dict[str, Symbol] = {k: v for k, v in kwargs.items()
+                                  if isinstance(v, Symbol)}
+    attrs = {k: v for k, v in kwargs.items() if not isinstance(v, Symbol)}
+
+    if arg_names:
+        # build the input list in signature order, auto-creating variables
+        final: List = []
+        pos = 0
+        for i, an in enumerate(arg_names):
+            if an in kw_syms:
+                final.append(kw_syms[an]._outputs[0])
+            elif pos < len(entries):
+                final.append(entries[pos])
+                pos += 1
+            else:
+                # auto-create variable (params like weight/bias/gamma/beta)
+                if op_name == "FullyConnected" and an == "bias" and attrs.get("no_bias"):
+                    continue
+                if op_name in ("Convolution", "Deconvolution") and an == "bias" \
+                        and attrs.get("no_bias", op_name == "Deconvolution"):
+                    continue
+                if op_name == "LeakyReLU" and an == "gamma" \
+                        and attrs.get("act_type", "leaky") != "prelu":
+                    continue
+                vnode = _Node(None, f"{name}_{an}", {}, [])
+                final.append((vnode, 0))
+        entries = final
+    node = _Node(op_name, name, attrs, entries)
+    return Symbol([(node, i) for i in range(node.num_outputs)])
+
+
+def _make_sym_func(op_name: str):
+    def fn(*args, **kwargs):
+        syms = [a for a in args if isinstance(a, Symbol)]
+        return _invoke_sym(op_name, syms, dict(kwargs))
+
+    fn.__name__ = op_name
+    fn.__doc__ = get_op(op_name).doc
+    return fn
+
+
+_func_cache: Dict[str, Any] = {}
+
+
+def __getattr__(name: str):
+    if name in _REGISTRY:
+        if name not in _func_cache:
+            _func_cache[name] = _make_sym_func(name)
+        return _func_cache[name]
+    raise AttributeError(f"module 'mxnet_tpu.symbol' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(list(globals()) + list_ops()))
+
+
+def zeros(shape, dtype="float32", **kw):
+    return _invoke_sym("_zeros", [], {"shape": tuple(shape) if not isinstance(shape, int) else (shape,), "dtype": dtype})
+
+
+def ones(shape, dtype="float32", **kw):
+    return _invoke_sym("_ones", [], {"shape": tuple(shape) if not isinstance(shape, int) else (shape,), "dtype": dtype})

@@ -1,0 +1,354 @@
+"""Symbol — the symbolic graph IR.
+
+Reference parity: ``nnvm::Symbol/Graph`` + ``python/mxnet/symbol/symbol.py``
+(composition, ``infer_shape`` :1080+, ``bind``/``simple_bind`` :1290,1554,
+JSON save/load). The NNVM pass pipeline (Gradient, PlanMemory, AttachOpExecs,
+InitOpSegs — ``src/executor/graph_executor.cc:232,637,647,1186``) collapses
+into "lower the whole graph to ONE jitted XLA computation": XLA's fusion and
+buffer assignment replace the reference's memory planner and bulking.
+"""
+from __future__ import annotations
+
+import json
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from ..base import MXNetError
+from ..ops.registry import get_op, OpDef
+
+__all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json"]
+
+_name_counters: Dict[str, int] = {}
+
+
+def _auto_name(op_name: str) -> str:
+    base = op_name.lower().lstrip("_")
+    i = _name_counters.get(base, 0)
+    _name_counters[base] = i + 1
+    return f"{base}{i}"
+
+
+class _Node:
+    """One graph node: an op application or a variable (op=None)."""
+
+    __slots__ = ("op", "name", "attrs", "inputs", "num_outputs", "_attr_dict")
+
+    def __init__(self, op: Optional[str], name: str, attrs: Dict[str, Any],
+                 inputs: List[Tuple["_Node", int]]):
+        self.op = op
+        self.name = name
+        self.attrs = attrs
+        self.inputs = inputs
+        if op is None:
+            self.num_outputs = 1
+        else:
+            self.num_outputs = get_op(op).out_count(attrs)
+        self._attr_dict: Dict[str, str] = {}
+
+    @property
+    def is_var(self) -> bool:
+        return self.op is None
+
+
+class Symbol:
+    """A list of output entries over a shared DAG (matches nnvm::Symbol)."""
+
+    def __init__(self, outputs: List[Tuple[_Node, int]]):
+        self._outputs = outputs
+
+    # ---------------------------------------------------------------- info
+    @property
+    def name(self) -> str:
+        if len(self._outputs) == 1:
+            return self._outputs[0][0].name
+        return "group"
+
+    def __len__(self):
+        return len(self._outputs)
+
+    def __iter__(self):
+        for i in range(len(self._outputs)):
+            yield Symbol([self._outputs[i]])
+
+    def __getitem__(self, idx):
+        if isinstance(idx, str):
+            names = self.list_outputs()
+            idx = names.index(idx)
+        return Symbol([self._outputs[idx]])
+
+    def __repr__(self):
+        return f"<Symbol {self.name}>"
+
+    def topo_nodes(self) -> List[_Node]:
+        """Post-order DFS over the DAG (reference IndexedGraph topo order)."""
+        seen = set()
+        order: List[_Node] = []
+
+        def visit(node: _Node):
+            if id(node) in seen:
+                return
+            seen.add(id(node))
+            for (src, _) in node.inputs:
+                visit(src)
+            order.append(node)
+
+        for (node, _) in self._outputs:
+            visit(node)
+        return order
+
+    def list_arguments(self) -> List[str]:
+        out = []
+        for n in self.topo_nodes():
+            if n.is_var and n.name not in out and not self._is_aux(n):
+                out.append(n.name)
+        return out
+
+    def list_auxiliary_states(self) -> List[str]:
+        out = []
+        for n in self.topo_nodes():
+            if n.is_var and self._is_aux(n) and n.name not in out:
+                out.append(n.name)
+        return out
+
+    def _aux_names(self) -> set:
+        aux = set()
+        for n in self.topo_nodes():
+            if n.op is None:
+                continue
+            opdef = get_op(n.op)
+            if opdef.aux_args:
+                arg_names = opdef.arg_names() or []
+                for i, (src, _) in enumerate(n.inputs):
+                    if src.is_var and i < len(arg_names) and arg_names[i] in opdef.aux_args:
+                        aux.add(src.name)
+        return aux
+
+    def _is_aux(self, node: _Node) -> bool:
+        if not hasattr(self, "_aux_cache"):
+            self._aux_cache = self._aux_names()
+        return node.name in self._aux_cache
+
+    def list_outputs(self) -> List[str]:
+        names = []
+        for (node, idx) in self._outputs:
+            if node.num_outputs == 1:
+                names.append(f"{node.name}_output")
+            else:
+                names.append(f"{node.name}_output{idx}")
+        return names
+
+    def list_inputs(self) -> List[str]:
+        return self.list_arguments() + self.list_auxiliary_states()
+
+    def get_internals(self) -> "Symbol":
+        outs = []
+        for n in self.topo_nodes():
+            for i in range(n.num_outputs):
+                outs.append((n, i))
+        return Symbol(outs)
+
+    def get_children(self) -> Optional["Symbol"]:
+        node = self._outputs[0][0]
+        if not node.inputs:
+            return None
+        return Symbol(list(node.inputs))
+
+    # ---------------------------------------------------------------- attrs
+    def attr(self, key: str) -> Optional[str]:
+        return self._outputs[0][0]._attr_dict.get(key)
+
+    def _set_attr(self, **kwargs):
+        self._outputs[0][0]._attr_dict.update(kwargs)
+
+    def list_attr(self) -> Dict[str, str]:
+        return dict(self._outputs[0][0]._attr_dict)
+
+    def attr_dict(self) -> Dict[str, Dict[str, str]]:
+        out = {}
+        for n in self.topo_nodes():
+            d = dict(n._attr_dict)
+            if n.op is not None:
+                d.update({k: str(v) for k, v in n.attrs.items()})
+            if d:
+                out[n.name] = d
+        return out
+
+    # ---------------------------------------------------------------- compose
+    def _entry(self) -> Tuple[_Node, int]:
+        if len(self._outputs) != 1:
+            raise MXNetError("operation requires a single-output symbol")
+        return self._outputs[0]
+
+    # arithmetic sugar (same set as NDArray)
+    def _binop(self, op, other, scalar_op, reverse=False):
+        from . import _invoke_sym
+        if isinstance(other, Symbol):
+            a, b = (other, self) if reverse else (self, other)
+            return _invoke_sym(op, [a, b], {})
+        return _invoke_sym(scalar_op, [self], {"scalar": float(other)})
+
+    def __add__(self, o): return self._binop("broadcast_add", o, "_plus_scalar")
+    def __radd__(self, o): return self._binop("broadcast_add", o, "_plus_scalar")
+    def __sub__(self, o): return self._binop("broadcast_sub", o, "_minus_scalar")
+    def __rsub__(self, o): return self._binop("broadcast_sub", o, "_rminus_scalar", True)
+    def __mul__(self, o): return self._binop("broadcast_mul", o, "_mul_scalar")
+    def __rmul__(self, o): return self._binop("broadcast_mul", o, "_mul_scalar")
+    def __truediv__(self, o): return self._binop("broadcast_div", o, "_div_scalar")
+    def __rtruediv__(self, o): return self._binop("broadcast_div", o, "_rdiv_scalar", True)
+    def __pow__(self, o): return self._binop("broadcast_power", o, "_power_scalar")
+    def __neg__(self):
+        from . import _invoke_sym
+        return _invoke_sym("negative", [self], {})
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        from ..ops.registry import _REGISTRY
+        if name not in _REGISTRY:
+            raise AttributeError(f"Symbol has no attribute {name!r}")
+        from . import _invoke_sym
+        me = self
+
+        def method(*args, **kwargs):
+            syms = [me] + [a for a in args if isinstance(a, Symbol)]
+            return _invoke_sym(name, syms, kwargs)
+
+        return method
+
+    # ---------------------------------------------------------------- shape/type
+    def infer_shape(self, *args, **kwargs):
+        """Returns (arg_shapes, out_shapes, aux_shapes) — via jax.eval_shape
+        over the lowered graph (replaces infer_graph_attr_pass.cc:325)."""
+        try:
+            return self._infer_shape_impl(False, *args, **kwargs)
+        except MXNetError:
+            raise
+
+    def infer_shape_partial(self, *args, **kwargs):
+        return self._infer_shape_impl(True, *args, **kwargs)
+
+    def _infer_shape_impl(self, partial, *args, **kwargs):
+        from ..executor import _GraphLowering
+        arg_names = self.list_arguments()
+        aux_names = self.list_auxiliary_states()
+        known: Dict[str, Tuple[int, ...]] = {}
+        if args:
+            for n, s in zip(arg_names, args):
+                if s is not None:
+                    known[n] = tuple(s)
+        known.update({k: tuple(v) for k, v in kwargs.items() if v is not None})
+        lowering = _GraphLowering(self)
+        try:
+            shapes = lowering.infer_shapes(known)
+        except Exception as e:
+            if partial:
+                return None, None, None
+            raise MXNetError(f"infer_shape failed: {e}") from e
+        arg_shapes = [shapes.get(n) for n in arg_names]
+        aux_shapes = [shapes.get(n) for n in aux_names]
+        out_shapes = shapes["__outputs__"]
+        return arg_shapes, out_shapes, aux_shapes
+
+    def infer_type(self, *args, **kwargs):
+        arg_names = self.list_arguments()
+        dtypes = [np.float32] * len(arg_names)
+        return dtypes, [np.float32] * len(self._outputs), \
+            [np.float32] * len(self.list_auxiliary_states())
+
+    # ---------------------------------------------------------------- binding
+    def bind(self, ctx, args, args_grad=None, grad_req="write", aux_states=None,
+             group2ctx=None, shared_exec=None):
+        from ..executor import Executor
+        return Executor(self, ctx, args, args_grad, grad_req, aux_states)
+
+    def simple_bind(self, ctx, grad_req="write", type_dict=None, shared_arg_names=None,
+                    shared_exec=None, shared_buffer=None, **kwargs):
+        from .. import ndarray as nd
+        from ..executor import Executor
+        arg_shapes, _, aux_shapes = self.infer_shape(**kwargs)
+        arg_names = self.list_arguments()
+        aux_names = self.list_auxiliary_states()
+        if any(s is None for s in arg_shapes):
+            missing = [n for n, s in zip(arg_names, arg_shapes) if s is None]
+            raise MXNetError(f"simple_bind: cannot infer shapes for {missing}")
+        args = {n: nd.zeros(s, ctx=ctx) for n, s in zip(arg_names, arg_shapes)}
+        args_grad = {n: nd.zeros(s, ctx=ctx) for n, s in zip(arg_names, arg_shapes)
+                     if grad_req != "null"}
+        aux = {n: nd.zeros(s, ctx=ctx) for n, s in zip(aux_names, aux_shapes)}
+        return Executor(self, ctx, args, args_grad, grad_req, aux)
+
+    # eval sugar: run imperatively
+    def eval(self, ctx=None, **kwargs):
+        ex = self.bind(ctx, kwargs)
+        return ex.forward()
+
+    # ---------------------------------------------------------------- serialization
+    def tojson(self) -> str:
+        nodes = self.topo_nodes()
+        nid = {id(n): i for i, n in enumerate(nodes)}
+        jnodes = []
+        for n in nodes:
+            jnodes.append({
+                "op": n.op or "null",
+                "name": n.name,
+                "attrs": {k: json.dumps(v) for k, v in (n.attrs or {}).items()},
+                "inputs": [[nid[id(src)], idx, 0] for (src, idx) in n.inputs],
+            })
+        heads = [[nid[id(node)], idx, 0] for (node, idx) in self._outputs]
+        return json.dumps({"nodes": jnodes, "heads": heads,
+                           "mxnet_tpu_version": 1}, indent=2)
+
+    def save(self, fname: str) -> None:
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+
+def Variable(name: str, attr=None, shape=None, lr_mult=None, wd_mult=None,
+             dtype=None, init=None, stype=None, **kwargs) -> Symbol:
+    node = _Node(None, name, {}, [])
+    sym = Symbol([(node, 0)])
+    meta = {}
+    if shape is not None:
+        meta["__shape__"] = str(tuple(shape))
+    if dtype is not None:
+        meta["__dtype__"] = str(dtype)
+    if lr_mult is not None:
+        meta["__lr_mult__"] = str(lr_mult)
+    if wd_mult is not None:
+        meta["__wd_mult__"] = str(wd_mult)
+    if attr:
+        meta.update(attr)
+    meta.update({k: str(v) for k, v in kwargs.items()})
+    if meta:
+        sym._set_attr(**meta)
+    return sym
+
+
+var = Variable
+
+
+def Group(symbols: Sequence[Symbol]) -> Symbol:
+    outs = []
+    for s in symbols:
+        outs.extend(s._outputs)
+    return Symbol(outs)
+
+
+def load_json(json_str: str) -> Symbol:
+    data = json.loads(json_str)
+    nodes: List[_Node] = []
+    for jn in data["nodes"]:
+        op = None if jn["op"] == "null" else jn["op"]
+        attrs = {k: json.loads(v) for k, v in jn.get("attrs", {}).items()}
+        inputs = [(nodes[i], idx) for (i, idx, _) in jn.get("inputs", [])]
+        nodes.append(_Node(op, jn["name"], attrs, inputs))
+    heads = [(nodes[i], idx) for (i, idx, _) in data["heads"]]
+    return Symbol(heads)
+
+
+def load(fname: str) -> Symbol:
+    with open(fname) as f:
+        return load_json(f.read())

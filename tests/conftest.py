@@ -1,0 +1,24 @@
+"""Test harness: run everything on an 8-device virtual CPU mesh.
+
+Mirrors the reference's CI strategy of simulating multi-device on one box
+(SURVEY.md §4.5: tools/launch.py local launcher → here
+xla_force_host_platform_device_count). The real-TPU bench path is exercised
+by bench.py, not the unit suite.
+"""
+import os
+
+os.environ["XLA_FLAGS"] = ("--xla_force_host_platform_device_count=8 "
+                           + os.environ.get("XLA_FLAGS", ""))
+os.environ.setdefault("MXNET_SEED", "17")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(170)
