@@ -16,6 +16,12 @@ from __future__ import annotations
 
 __version__ = "0.1.0"
 
+import jax as _jax
+
+# MXNet exposes float64/int64 dtypes on request; jax hides them by default.
+# Default creation paths still produce float32 (MXNET_DEFAULT_DTYPE).
+_jax.config.update("jax_enable_x64", True)
+
 from . import base
 from .base import MXNetError
 from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, num_gpus, num_tpus

@@ -88,7 +88,8 @@ def _boolean_mask(data, index, axis=0):
     return jnp.compress(mask, data, axis=int(axis))
 
 
-@register("SequenceMask", aliases=["sequence_mask"])
+@register("SequenceMask", aliases=["sequence_mask"],
+          arg_names=("data", "sequence_length"))
 def _sequence_mask(data, sequence_length=None, use_sequence_length=False, value=0.0, axis=0):
     # reference: src/operator/sequence_mask.cc — data layout (seq, batch, ...)
     # for axis=0 or (batch, seq, ...) for axis=1.
@@ -105,7 +106,8 @@ def _sequence_mask(data, sequence_length=None, use_sequence_length=False, value=
     return jnp.where(mask, data, jnp.asarray(value, dtype=data.dtype))
 
 
-@register("SequenceLast", aliases=["sequence_last"])
+@register("SequenceLast", aliases=["sequence_last"],
+          arg_names=("data", "sequence_length"))
 def _sequence_last(data, sequence_length=None, use_sequence_length=False, axis=0):
     ax = int(axis)
     if not use_sequence_length or sequence_length is None:
@@ -118,7 +120,8 @@ def _sequence_last(data, sequence_length=None, use_sequence_length=False, axis=0
     return moved[last, batch]
 
 
-@register("SequenceReverse", aliases=["sequence_reverse"])
+@register("SequenceReverse", aliases=["sequence_reverse"],
+          arg_names=("data", "sequence_length"))
 def _sequence_reverse(data, sequence_length=None, use_sequence_length=False, axis=0):
     if not use_sequence_length or sequence_length is None:
         return jnp.flip(data, axis=0)

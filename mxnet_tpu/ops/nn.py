@@ -403,7 +403,7 @@ def _dropout(data, p=0.5, mode="training", axes=(), cudnn_off=False, rng=None,
 
 
 # ---------------------------------------------------------------- Misc nn
-@register("UpSampling")
+@register("UpSampling", arg_names=("data",))
 def _upsampling(*args, scale=1, sample_type="nearest", num_filter=0, num_args=1,
                 multi_input_mode="concat", workspace=512):
     data = args[0]

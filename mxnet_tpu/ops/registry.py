@@ -75,11 +75,11 @@ class OpDef:
                     if p.kind == p.VAR_POSITIONAL:
                         names = None  # variadic: caller must pass arrays
                         break
-                    if p.default is p.empty or p.default is None:
-                        if p.name not in ("rng",):
-                            names.append(p.name)
+                    if p.default is p.empty:
+                        names.append(p.name)
                     else:
-                        break
+                        break  # optional arrays (bias=None etc.) need explicit
+                               # arg_names= annotation at registration
             except (TypeError, ValueError):
                 names = None
             self._arg_names = names

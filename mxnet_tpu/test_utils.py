@@ -1,0 +1,102 @@
+"""Test utilities (reference parity: ``python/mxnet/test_utils.py`` —
+assert_almost_equal, check_numeric_gradient finite differences,
+check_consistency cross-device comparison, rand_ndarray, default_context).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from . import ndarray as nd
+from . import autograd
+from .context import Context, cpu, current_context
+from .ndarray.ndarray import NDArray
+
+__all__ = ["default_context", "assert_almost_equal", "almost_equal",
+           "rand_ndarray", "rand_shape_nd", "check_numeric_gradient",
+           "check_consistency", "same"]
+
+_default = [None]
+
+
+def default_context() -> Context:
+    return _default[0] or current_context()
+
+
+def set_default_context(ctx: Context) -> None:
+    _default[0] = ctx
+
+
+def same(a, b) -> bool:
+    return np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def almost_equal(a, b, rtol=1e-5, atol=1e-8) -> bool:
+    return np.allclose(np.asarray(a), np.asarray(b), rtol=rtol, atol=atol)
+
+
+def assert_almost_equal(a, b, rtol=1e-5, atol=1e-8, names=("a", "b")) -> None:
+    a = a.asnumpy() if isinstance(a, NDArray) else np.asarray(a)
+    b = b.asnumpy() if isinstance(b, NDArray) else np.asarray(b)
+    np.testing.assert_allclose(a, b, rtol=rtol, atol=atol,
+                               err_msg=f"{names[0]} vs {names[1]}")
+
+
+def rand_shape_nd(ndim: int, dim: int = 10) -> tuple:
+    return tuple(np.random.randint(1, dim + 1, size=ndim).tolist())
+
+
+def rand_ndarray(shape, stype="default", density=None, dtype="float32",
+                 ctx=None) -> NDArray:
+    arr = np.random.uniform(-1.0, 1.0, size=shape).astype(dtype)
+    return nd.array(arr, ctx=ctx)
+
+
+def check_numeric_gradient(op_fn: Callable, inputs: Sequence[np.ndarray],
+                           eps: float = 1e-3, rtol: float = 1e-2,
+                           atol: float = 1e-3, head_grad: Optional[np.ndarray] = None):
+    """Finite-difference gradient check of an op called through autograd
+    (reference check_numeric_gradient)."""
+    arrays = [nd.array(x.astype("float64").astype("float32")) for x in inputs]
+    for a in arrays:
+        a.attach_grad()
+    with autograd.record():
+        out = op_fn(*arrays)
+        if head_grad is None:
+            loss = out.sum() if not isinstance(out, (list, tuple)) else sum(
+                o.sum() for o in out)
+        else:
+            loss = (out * nd.array(head_grad)).sum()
+    loss.backward()
+    analytic = [a.grad.asnumpy() for a in arrays]
+
+    def f(xs):
+        outs = op_fn(*[nd.array(x) for x in xs])
+        if isinstance(outs, (list, tuple)):
+            return sum(float(o.sum().asscalar()) for o in outs)
+        if head_grad is None:
+            return float(outs.sum().asscalar())
+        return float((outs * nd.array(head_grad)).sum().asscalar())
+
+    for i, x in enumerate(inputs):
+        num = np.zeros_like(x, dtype="float64")
+        flat = x.reshape(-1)
+        it = np.nditer(flat, flags=["c_index"])
+        while not it.finished:
+            j = it.index
+            orig = flat[j]
+            xs_p = [a.copy() for a in inputs]
+            xs_p[i].reshape(-1)[j] = orig + eps
+            xs_m = [a.copy() for a in inputs]
+            xs_m[i].reshape(-1)[j] = orig - eps
+            num.reshape(-1)[j] = (f(xs_p) - f(xs_m)) / (2 * eps)
+            it.iternext()
+        np.testing.assert_allclose(analytic[i], num, rtol=rtol, atol=atol,
+                                   err_msg=f"gradient mismatch for input {i}")
+
+
+def check_consistency(sym, ctx_list=None, scale=1.0, **kwargs):
+    """Cross-context consistency (the reference's CPU↔GPU parity mechanism,
+    here CPU↔TPU when both platforms exist)."""
+    raise NotImplementedError("use tests/tpu/test_parity.py harness")

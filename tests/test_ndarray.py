@@ -1,0 +1,134 @@
+"""NDArray semantics tests (reference: tests/python/unittest/test_ndarray.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_create_and_basic_math(rng):
+    a = nd.array(rng.randn(3, 4))
+    b = nd.array(rng.randn(3, 4))
+    assert a.shape == (3, 4)
+    assert a.dtype == np.float32
+    assert_almost_equal(a + b, a.asnumpy() + b.asnumpy(), rtol=1e-5)
+    assert_almost_equal(a - b, a.asnumpy() - b.asnumpy(), rtol=1e-5)
+    assert_almost_equal(a * b, a.asnumpy() * b.asnumpy(), rtol=1e-5)
+    assert_almost_equal(a / (b + 10.0), a.asnumpy() / (b.asnumpy() + 10.0), rtol=1e-5)
+    assert_almost_equal(2.0 * a + 1.0, 2.0 * a.asnumpy() + 1.0, rtol=1e-5)
+    assert_almost_equal(-a, -a.asnumpy())
+    assert_almost_equal(abs(a), np.abs(a.asnumpy()))
+
+
+def test_creation_helpers():
+    assert nd.zeros((2, 3)).asnumpy().sum() == 0
+    assert nd.ones((2, 3)).asnumpy().sum() == 6
+    assert_almost_equal(nd.full((2, 2), 3.5), np.full((2, 2), 3.5))
+    assert_almost_equal(nd.arange(5), np.arange(5, dtype="float32"))
+    assert nd.zeros((2,), dtype="int32").dtype == np.int32
+
+
+def test_mutation_and_version(rng):
+    a = nd.zeros((4,))
+    a[:] = 7.0
+    assert (a.asnumpy() == 7).all()
+    a[1:3] = 0.0
+    assert a.asnumpy().tolist() == [7, 0, 0, 7]
+    a += 1
+    assert a.asnumpy().tolist() == [8, 1, 1, 8]
+    b = nd.array(rng.randn(2, 2))
+    old = b.asnumpy()
+    b *= 2
+    assert_almost_equal(b, old * 2)
+
+
+def test_indexing(rng):
+    x = nd.array(rng.randn(4, 5))
+    xn = x.asnumpy()
+    assert_almost_equal(x[1], xn[1])
+    assert_almost_equal(x[1:3], xn[1:3])
+    assert_almost_equal(x[:, 2], xn[:, 2])
+    assert_almost_equal(x[1, 2], xn[1, 2])
+    idx = nd.array([0, 2], dtype="int32")
+    assert_almost_equal(x[idx], xn[[0, 2]])
+
+
+def test_reshape_special_codes(rng):
+    x = nd.array(rng.randn(2, 3, 4))
+    assert x.reshape(-1).shape == (24,)
+    assert x.reshape(0, -1).shape == (2, 12)
+    assert x.reshape((-2,)).shape == (2, 3, 4)
+    assert x.reshape(-3, 0).shape == (6, 4)
+    y = nd.array(rng.randn(2, 4, 4))
+    assert y.reshape(0, -4, 2, 2, 0).shape == (2, 2, 2, 4)
+    assert x.reshape(6, 4).shape == (6, 4)
+
+
+def test_copy_context():
+    a = nd.array([1.0, 2.0])
+    b = a.copy()
+    b += 1
+    assert a.asnumpy().tolist() == [1.0, 2.0]
+    c = a.as_in_context(mx.cpu())
+    assert c.context.device_type == "cpu"
+    assert a.astype("float64").dtype == np.float64
+
+
+def test_scalar_conversions():
+    a = nd.array([3.5])
+    assert float(a) == 3.5
+    assert a.asscalar() == np.float32(3.5)
+    assert int(nd.array([2])) == 2
+    with pytest.raises(Exception):
+        nd.array([1.0, 2.0]).asscalar()
+
+
+def test_comparisons(rng):
+    a = nd.array([1.0, 2.0, 3.0])
+    b = nd.array([2.0, 2.0, 2.0])
+    assert (a < b).asnumpy().tolist() == [1.0, 0.0, 0.0]
+    assert (a == b).asnumpy().tolist() == [0.0, 1.0, 0.0]
+    assert (a >= b).asnumpy().tolist() == [0.0, 1.0, 1.0]
+
+
+def test_save_load_roundtrip(tmp_path, rng):
+    a = nd.array(rng.randn(3, 3))
+    b = nd.array(rng.randn(2,))
+    path = str(tmp_path / "arrays.bin")
+    nd.save(path, [a, b])
+    loaded = nd.load(path)
+    assert_almost_equal(loaded[0], a)
+    assert_almost_equal(loaded[1], b)
+    nd.save(path, {"w": a, "b": b})
+    d = nd.load(path)
+    assert set(d) == {"w", "b"}
+    assert_almost_equal(d["w"], a)
+
+
+def test_wait_to_read_and_waitall(rng):
+    a = nd.array(rng.randn(64, 64))
+    b = nd.dot(a, a)
+    b.wait_to_read()
+    nd.waitall()
+    assert np.isfinite(b.asnumpy()).all()
+
+
+def test_concat_stack_split(rng):
+    a = nd.array(rng.randn(2, 3))
+    b = nd.array(rng.randn(2, 3))
+    c = nd.concat(a, b, dim=0)
+    assert c.shape == (4, 3)
+    s = nd.stack(a, b, axis=0)
+    assert s.shape == (2, 2, 3)
+    parts = nd.split(c, 2, axis=0)
+    assert_almost_equal(parts[0], a)
+    assert_almost_equal(parts[1], b)
+
+
+def test_dynamic_method_dispatch(rng):
+    x = nd.array(rng.rand(3, 4) + 0.5)
+    assert_almost_equal(x.log(), np.log(x.asnumpy()), rtol=1e-5)
+    assert_almost_equal(x.sqrt(), np.sqrt(x.asnumpy()), rtol=1e-5)
+    assert x.sum(axis=0).shape == (4,)
+    assert x.mean().shape == ()

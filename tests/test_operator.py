@@ -1,0 +1,252 @@
+"""Operator numeric tests vs numpy + finite-difference gradient checks
+(reference: tests/python/unittest/test_operator.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+from mxnet_tpu.test_utils import assert_almost_equal, check_numeric_gradient
+
+
+def test_unary_math(rng):
+    x = rng.rand(3, 4).astype("float32") + 0.5
+    cases = {
+        "exp": np.exp, "log": np.log, "sqrt": np.sqrt, "square": np.square,
+        "sigmoid": lambda v: 1 / (1 + np.exp(-v)), "tanh": np.tanh,
+        "sin": np.sin, "cos": np.cos, "abs": np.abs, "floor": np.floor,
+        "ceil": np.ceil, "log1p": np.log1p, "expm1": np.expm1,
+        "rsqrt": lambda v: 1 / np.sqrt(v),
+    }
+    for name, ref in cases.items():
+        got = getattr(nd, name)(nd.array(x)).asnumpy()
+        np.testing.assert_allclose(got, ref(x), rtol=1e-5, atol=1e-6, err_msg=name)
+
+
+def test_broadcast_binary(rng):
+    a = rng.randn(3, 1, 4).astype("float32")
+    b = rng.randn(1, 5, 4).astype("float32")
+    assert_almost_equal(nd.broadcast_add(nd.array(a), nd.array(b)), a + b, rtol=1e-6)
+    assert_almost_equal(nd.broadcast_mul(nd.array(a), nd.array(b)), a * b, rtol=1e-6)
+    assert_almost_equal(nd.broadcast_maximum(nd.array(a), nd.array(b)),
+                        np.maximum(a, b), rtol=1e-6)
+
+
+def test_reductions(rng):
+    x = rng.randn(2, 3, 4).astype("float32")
+    a = nd.array(x)
+    assert_almost_equal(nd.sum(a), x.sum(), rtol=1e-5)
+    assert_almost_equal(nd.sum(a, axis=1), x.sum(axis=1), rtol=1e-5)
+    assert_almost_equal(nd.sum(a, axis=(0, 2), keepdims=True),
+                        x.sum(axis=(0, 2), keepdims=True), rtol=1e-5)
+    assert_almost_equal(nd.sum(a, axis=1, exclude=True), x.sum(axis=(0, 2)), rtol=1e-5)
+    assert_almost_equal(nd.mean(a, axis=0), x.mean(axis=0), rtol=1e-5)
+    assert_almost_equal(nd.max(a, axis=2), x.max(axis=2))
+    assert_almost_equal(nd.argmax(a, axis=1), x.argmax(axis=1).astype("float32"))
+    assert_almost_equal(nd.norm(a), np.linalg.norm(x.reshape(-1)), rtol=1e-5)
+
+
+def test_dot(rng):
+    a = rng.randn(3, 4).astype("float32")
+    b = rng.randn(4, 5).astype("float32")
+    assert_almost_equal(nd.dot(nd.array(a), nd.array(b)), a @ b, rtol=1e-5)
+    assert_almost_equal(nd.dot(nd.array(a), nd.array(b.T), transpose_b=True),
+                        a @ b, rtol=1e-5)
+    assert_almost_equal(nd.dot(nd.array(a.T), nd.array(b), transpose_a=True),
+                        a @ b, rtol=1e-5)
+    x = rng.randn(2, 3, 4).astype("float32")
+    y = rng.randn(2, 4, 5).astype("float32")
+    assert_almost_equal(nd.batch_dot(nd.array(x), nd.array(y)), x @ y, rtol=1e-5)
+
+
+def test_fully_connected(rng):
+    x = rng.randn(2, 3, 4).astype("float32")
+    w = rng.randn(8, 12).astype("float32")
+    b = rng.randn(8).astype("float32")
+    out = nd.FullyConnected(nd.array(x), nd.array(w), nd.array(b), num_hidden=8)
+    ref = x.reshape(2, -1) @ w.T + b
+    assert_almost_equal(out, ref, rtol=1e-4, atol=1e-5)
+    out2 = nd.FullyConnected(nd.array(x.reshape(2, 12)), nd.array(w), None,
+                             num_hidden=8, no_bias=True)
+    assert_almost_equal(out2, x.reshape(2, -1) @ w.T, rtol=1e-4, atol=1e-5)
+
+
+def test_convolution_vs_naive(rng):
+    x = rng.randn(1, 2, 5, 5).astype("float32")
+    w = rng.randn(3, 2, 3, 3).astype("float32")
+    out = nd.Convolution(nd.array(x), nd.array(w), None, kernel=(3, 3),
+                         num_filter=3, no_bias=True).asnumpy()
+    # naive correlation
+    ref = np.zeros((1, 3, 3, 3), dtype="float32")
+    for f in range(3):
+        for i in range(3):
+            for j in range(3):
+                ref[0, f, i, j] = (x[0, :, i:i+3, j:j+3] * w[f]).sum()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_pooling(rng):
+    x = rng.randn(1, 1, 4, 4).astype("float32")
+    out = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2), pool_type="max")
+    ref = x.reshape(1, 1, 2, 2, 2, 2).max(axis=(3, 5))
+    assert_almost_equal(out, ref)
+    avg = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2), pool_type="avg")
+    ref_avg = x.reshape(1, 1, 2, 2, 2, 2).mean(axis=(3, 5))
+    assert_almost_equal(avg, ref_avg, rtol=1e-5)
+    gp = nd.Pooling(nd.array(x), global_pool=True, pool_type="avg", kernel=(1, 1))
+    assert_almost_equal(gp, x.mean(axis=(2, 3), keepdims=True), rtol=1e-5)
+
+
+def test_batchnorm_train_inference(rng):
+    x = rng.randn(4, 3, 2, 2).astype("float32")
+    gamma = np.ones(3, dtype="float32")
+    beta = np.zeros(3, dtype="float32")
+    mm = np.zeros(3, dtype="float32")
+    mv = np.ones(3, dtype="float32")
+    outs = nd.BatchNorm(nd.array(x), nd.array(gamma), nd.array(beta),
+                        nd.array(mm), nd.array(mv), fix_gamma=False, is_train=True)
+    out = outs[0].asnumpy()
+    mean = x.mean(axis=(0, 2, 3))
+    var = x.var(axis=(0, 2, 3))
+    ref = (x - mean[None, :, None, None]) / np.sqrt(var[None, :, None, None] + 1e-3)
+    np.testing.assert_allclose(out, ref, rtol=1e-3, atol=1e-3)
+    # inference path uses moving stats
+    outs_i = nd.BatchNorm(nd.array(x), nd.array(gamma), nd.array(beta),
+                          nd.array(mm), nd.array(mv), fix_gamma=False, is_train=False)
+    ref_i = x / np.sqrt(1.0 + 1e-3)
+    np.testing.assert_allclose(outs_i[0].asnumpy(), ref_i, rtol=1e-3, atol=1e-3)
+
+
+def test_softmax_family(rng):
+    x = rng.randn(3, 5).astype("float32")
+    sm = nd.softmax(nd.array(x)).asnumpy()
+    ref = np.exp(x) / np.exp(x).sum(axis=1, keepdims=True)
+    np.testing.assert_allclose(sm, ref, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(nd.log_softmax(nd.array(x)).asnumpy(),
+                               np.log(ref), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(sm.sum(axis=1), np.ones(3), rtol=1e-5)
+
+
+def test_activation_types(rng):
+    x = rng.randn(4, 4).astype("float32")
+    a = nd.array(x)
+    assert_almost_equal(nd.Activation(a, act_type="relu"), np.maximum(x, 0))
+    assert_almost_equal(nd.LeakyReLU(a, act_type="leaky", slope=0.1),
+                        np.where(x >= 0, x, 0.1 * x), rtol=1e-6)
+    assert_almost_equal(nd.LeakyReLU(a, act_type="elu", slope=1.0),
+                        np.where(x >= 0, x, np.expm1(x)), rtol=1e-5, atol=1e-6)
+
+
+def test_take_embedding_pick(rng):
+    w = rng.randn(10, 4).astype("float32")
+    idx = np.array([1, 3, 5], dtype="float32")
+    emb = nd.Embedding(nd.array(idx), nd.array(w), input_dim=10, output_dim=4)
+    assert_almost_equal(emb, w[[1, 3, 5]])
+    x = rng.randn(3, 5).astype("float32")
+    p = nd.pick(nd.array(x), nd.array([0, 2, 4], dtype="float32"), axis=1)
+    assert_almost_equal(p, x[np.arange(3), [0, 2, 4]])
+    t = nd.take(nd.array(x), nd.array([0, 2], dtype="float32"), axis=1)
+    assert_almost_equal(t, x[:, [0, 2]])
+
+
+def test_transpose_slice_tile(rng):
+    x = rng.randn(2, 3, 4).astype("float32")
+    a = nd.array(x)
+    assert_almost_equal(nd.transpose(a), x.T)
+    assert_almost_equal(nd.transpose(a, axes=(1, 0, 2)), x.transpose(1, 0, 2))
+    assert_almost_equal(nd.slice(a, begin=(0, 1), end=(2, 3)), x[0:2, 1:3])
+    assert_almost_equal(nd.slice_axis(a, axis=2, begin=1, end=3), x[:, :, 1:3])
+    assert_almost_equal(nd.tile(a, reps=(2, 1, 1)), np.tile(x, (2, 1, 1)))
+    assert_almost_equal(nd.flip(a, axis=1), x[:, ::-1])
+    assert_almost_equal(nd.expand_dims(a, axis=1), x[:, None])
+
+
+def test_sort_topk(rng):
+    x = rng.randn(3, 6).astype("float32")
+    a = nd.array(x)
+    assert_almost_equal(nd.sort(a, axis=1), np.sort(x, axis=1))
+    assert_almost_equal(nd.sort(a, axis=1, is_ascend=False), -np.sort(-x, axis=1))
+    vals = nd.topk(a, k=2, axis=1, ret_typ="value")
+    ref = -np.sort(-x, axis=1)[:, :2]
+    assert_almost_equal(vals, ref)
+
+
+def test_where_onehot_clip(rng):
+    cond = nd.array([1.0, 0.0, 1.0])
+    x = nd.array([1.0, 2.0, 3.0])
+    y = nd.array([-1.0, -2.0, -3.0])
+    assert nd.where(cond, x, y).asnumpy().tolist() == [1.0, -2.0, 3.0]
+    oh = nd.one_hot(nd.array([0, 2], dtype="float32"), 3)
+    assert oh.asnumpy().tolist() == [[1, 0, 0], [0, 0, 1]]
+    assert nd.clip(nd.array([-2.0, 0.5, 9.0]), 0.0, 1.0).asnumpy().tolist() == [0.0, 0.5, 1.0]
+
+
+def test_sequence_ops(rng):
+    x = rng.randn(4, 2, 3).astype("float32")  # (seq, batch, feat)
+    lens = np.array([2, 3], dtype="float32")
+    masked = nd.SequenceMask(nd.array(x), nd.array(lens), use_sequence_length=True).asnumpy()
+    assert (masked[2:, 0] == 0).all()
+    assert (masked[3:, 1] == 0).all()
+    assert_almost_equal(masked[:2, 0], x[:2, 0])
+    last = nd.SequenceLast(nd.array(x), nd.array(lens), use_sequence_length=True).asnumpy()
+    assert_almost_equal(last[0], x[1, 0])
+    assert_almost_equal(last[1], x[2, 1])
+    rev = nd.SequenceReverse(nd.array(x), nd.array(lens), use_sequence_length=True).asnumpy()
+    assert_almost_equal(rev[0, 0], x[1, 0])
+    assert_almost_equal(rev[1, 0], x[0, 0])
+    assert_almost_equal(rev[3, 0], x[3, 0])  # beyond length: untouched
+
+
+def test_gradients_numeric(rng):
+    check_numeric_gradient(lambda x: nd.sum(x * x), [rng.randn(3, 3).astype("float32")])
+    check_numeric_gradient(lambda x: nd.sigmoid(x).sum(), [rng.randn(2, 4).astype("float32")])
+    check_numeric_gradient(
+        lambda a, b: nd.dot(a, b).sum(),
+        [rng.randn(3, 4).astype("float32"), rng.randn(4, 2).astype("float32")],
+        rtol=3e-2, atol=3e-3)
+    check_numeric_gradient(
+        lambda x, w: nd.FullyConnected(x, w, None, num_hidden=4, no_bias=True).sum(),
+        [rng.randn(2, 5).astype("float32"), rng.randn(4, 5).astype("float32")],
+        rtol=3e-2, atol=3e-3)
+
+
+def test_random_ops_statistics():
+    mx.random.seed(7)
+    u = nd.random_uniform(low=0.0, high=1.0, shape=(10000,)).asnumpy()
+    assert 0.45 < u.mean() < 0.55
+    assert u.min() >= 0.0 and u.max() <= 1.0
+    n = nd.random_normal(loc=2.0, scale=0.5, shape=(10000,)).asnumpy()
+    assert 1.9 < n.mean() < 2.1
+    assert 0.4 < n.std() < 0.6
+    r = nd.random_randint(low=0, high=5, shape=(1000,)).asnumpy()
+    assert set(np.unique(r)).issubset({0, 1, 2, 3, 4})
+
+
+def test_dropout_modes(rng):
+    x = nd.ones((100, 100))
+    with autograd.record():  # training mode
+        y = nd.Dropout(x, p=0.5)
+    kept = (y.asnumpy() != 0).mean()
+    assert 0.4 < kept < 0.6
+    assert np.allclose(np.unique(y.asnumpy()), [0.0, 2.0])
+    y_inf = nd.Dropout(x, p=0.5)  # not training → identity
+    assert_almost_equal(y_inf, x)
+
+
+def test_cast_and_scalar_ops(rng):
+    x = nd.array([1.5, 2.5])
+    assert nd.Cast(x, dtype="int32").dtype == np.int32
+    assert_almost_equal(x + 1, np.array([2.5, 3.5]))
+    assert_almost_equal(1 - x, np.array([-0.5, -1.5]))
+    assert_almost_equal(2 / x, np.array([4 / 3, 0.8]), rtol=1e-6)
+    assert_almost_equal(x ** 2, np.array([2.25, 6.25]))
+
+
+def test_layernorm(rng):
+    x = rng.randn(4, 10).astype("float32")
+    g = np.ones(10, dtype="float32")
+    b = np.zeros(10, dtype="float32")
+    out = nd.LayerNorm(nd.array(x), nd.array(g), nd.array(b))[0].asnumpy()
+    mean = x.mean(axis=1, keepdims=True)
+    std = x.std(axis=1, keepdims=True)
+    np.testing.assert_allclose(out, (x - mean) / np.sqrt(std**2 + 1e-5),
+                               rtol=1e-4, atol=1e-4)

@@ -1,0 +1,142 @@
+"""Symbol + Executor tests (reference: tests/python/unittest/test_symbol.py,
+test_executor.py, test_infer_shape.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _mlp():
+    data = sym.Variable("data")
+    fc1 = sym.FullyConnected(data, num_hidden=16, name="fc1")
+    act = sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = sym.FullyConnected(act, num_hidden=10, name="fc2")
+    return sym.SoftmaxOutput(fc2, sym.Variable("softmax_label"), name="softmax")
+
+
+def test_compose_and_list_arguments():
+    out = _mlp()
+    assert out.list_arguments() == ["data", "fc1_weight", "fc1_bias",
+                                    "fc2_weight", "fc2_bias", "softmax_label"]
+    assert out.list_outputs() == ["softmax_output"]
+
+
+def test_infer_shape():
+    out = _mlp()
+    arg_shapes, out_shapes, aux_shapes = out.infer_shape(data=(32, 100),
+                                                         softmax_label=(32,))
+    d = dict(zip(out.list_arguments(), arg_shapes))
+    assert d["fc1_weight"] == (16, 100)
+    assert d["fc1_bias"] == (16,)
+    assert d["fc2_weight"] == (10, 16)
+    assert out_shapes == [(32, 10)]
+    assert aux_shapes == []
+
+
+def test_infer_shape_conv():
+    data = sym.Variable("data")
+    conv = sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1), name="c1")
+    bn = sym.BatchNorm(conv, name="bn1")
+    arg_shapes, out_shapes, aux_shapes = bn.infer_shape(data=(2, 3, 8, 8))
+    d = dict(zip(bn.list_arguments(), arg_shapes))
+    assert d["c1_weight"] == (8, 3, 3, 3)
+    assert d["bn1_gamma"] == (8,)
+    assert out_shapes[0] == (2, 8, 8, 8)
+    assert dict(zip(bn.list_auxiliary_states(), aux_shapes))["bn1_moving_mean"] == (8,)
+
+
+def test_executor_forward_backward(rng):
+    out = _mlp()
+    ex = out.simple_bind(mx.cpu(), data=(8, 20), softmax_label=(8,))
+    for name, arr in ex.arg_dict.items():
+        if name.endswith("weight"):
+            arr._set_data(nd.array(rng.randn(*arr.shape).astype("float32") * 0.1)._data)
+    x = rng.randn(8, 20).astype("float32")
+    y = rng.randint(0, 10, size=(8,)).astype("float32")
+    outs = ex.forward(is_train=True, data=nd.array(x), softmax_label=nd.array(y))
+    probs = outs[0].asnumpy()
+    assert probs.shape == (8, 10)
+    np.testing.assert_allclose(probs.sum(axis=1), np.ones(8), rtol=1e-5)
+    ex.backward()
+    for name in ("fc1_weight", "fc2_weight", "fc1_bias"):
+        assert abs(ex.grad_dict[name].asnumpy()).sum() > 0, name
+
+
+def test_executor_grad_req_null_and_add(rng):
+    x = sym.Variable("x")
+    y = (x * x).sum()
+    xs = nd.array(rng.randn(3).astype("float32"))
+    gx = nd.zeros((3,))
+    ex = y.bind(mx.cpu(), {"x": xs}, {"x": gx}, grad_req="add")
+    ex.forward(is_train=True)
+    ex.backward()
+    ex.forward(is_train=True)
+    ex.backward()
+    assert_almost_equal(gx, 2 * 2 * xs.asnumpy(), rtol=1e-5)
+
+
+def test_symbol_arithmetic(rng):
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = 2.0 * a + b / 4.0 - 1.0
+    an = rng.randn(3, 3).astype("float32")
+    bn_ = rng.randn(3, 3).astype("float32")
+    ex = c.bind(mx.cpu(), {"a": nd.array(an), "b": nd.array(bn_)})
+    out = ex.forward()[0]
+    assert_almost_equal(out, 2 * an + bn_ / 4 - 1, rtol=1e-5)
+
+
+def test_group_and_getitem():
+    a = sym.Variable("a")
+    s1 = a * 2
+    s2 = a + 1
+    g = sym.Group([s1, s2])
+    assert len(g.list_outputs()) == 2
+    ex = g.bind(mx.cpu(), {"a": nd.ones((2,))})
+    o = ex.forward()
+    assert o[0].asnumpy().tolist() == [2.0, 2.0]
+    assert o[1].asnumpy().tolist() == [2.0, 2.0]
+
+
+def test_json_roundtrip(tmp_path):
+    out = _mlp()
+    js = out.tojson()
+    s2 = sym.load_json(js)
+    assert s2.list_arguments() == out.list_arguments()
+    assert s2.list_outputs() == out.list_outputs()
+    p = str(tmp_path / "sym.json")
+    out.save(p)
+    s3 = sym.load(p)
+    assert s3.list_arguments() == out.list_arguments()
+    # loaded symbol still executable
+    ex = s3.simple_bind(mx.cpu(), data=(2, 10), softmax_label=(2,))
+    assert ex.forward()[0].shape == (2, 10)
+
+
+def test_get_internals():
+    out = _mlp()
+    internals = out.get_internals()
+    names = internals.list_outputs()
+    assert any("fc1" in n for n in names)
+    fc1_out = internals["fc1_output"]
+    ex = fc1_out.simple_bind(mx.cpu(), data=(2, 10))
+    assert ex.forward()[0].shape == (2, 16)
+
+
+def test_executor_reshape(rng):
+    out = _mlp()
+    ex = out.simple_bind(mx.cpu(), data=(8, 20), softmax_label=(8,))
+    ex2 = ex.reshape(data=(4, 20), softmax_label=(4,))
+    o = ex2.forward(is_train=False, data=nd.array(rng.randn(4, 20).astype("float32")),
+                    softmax_label=nd.zeros((4,)))
+    assert o[0].shape == (4, 10)
+    # weights shared with original executor
+    assert ex2.arg_dict["fc1_weight"] is ex.arg_dict["fc1_weight"]
+
+
+def test_variable_attrs():
+    v = sym.Variable("w", shape=(3, 4), lr_mult=2.0)
+    assert v.attr("__shape__") == str((3, 4))
+    assert v.attr("__lr_mult__") == "2.0"
