@@ -1,0 +1,14 @@
+"""Gluon — imperative model API with graph-capture JIT
+(reference: ``python/mxnet/gluon``)."""
+from .parameter import Parameter, Constant, ParameterDict, DeferredInitializationError
+from .block import Block, HybridBlock, SymbolBlock, CachedOp
+from .trainer import Trainer
+from . import nn
+from . import loss
+from . import utils
+from .utils import split_and_load, split_data, clip_global_norm
+
+from . import rnn
+from . import data
+from . import model_zoo
+from . import contrib

@@ -1,0 +1,541 @@
+"""Gluon Block / HybridBlock / SymbolBlock + CachedOp.
+
+Reference parity: ``python/mxnet/gluon/block.py`` (Block :127, HybridBlock
+:671 with ``_build_cache`` → CachedOp :748-795, SymbolBlock :952, export
+:868) and ``src/imperative/cached_op.{h,cc}``.
+
+TPU-first: hybridize() is the JIT hook (SURVEY.md §2.1 CachedOp: "where TPU
+JIT-compiles hybridized blocks to an XLA executable"). The first call traces
+``hybrid_forward`` with Symbol placeholders; the captured graph lowers to ONE
+jitted XLA computation (static_alloc/static_shape/bulking flags are
+meaningless here — XLA owns buffers and fusion). Training integrates with the
+autograd tape by recording the whole cached graph as a single vjp node.
+"""
+from __future__ import annotations
+
+import re
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import autograd
+from .. import random as _random
+from ..base import MXNetError
+from ..context import current_context
+from ..executor import _GraphLowering
+from ..ndarray import NDArray
+from ..ndarray.ndarray import _wrap, _unwrap
+from .parameter import Parameter, ParameterDict, DeferredInitializationError
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock", "CachedOp"]
+
+
+class _BlockScope:
+    """Hierarchical name manager (reference block.py:_BlockScope)."""
+
+    _current = threading.local()
+
+    def __init__(self, block):
+        self._block = block
+        self._counter: Dict[str, int] = {}
+        self._old = None
+
+    @staticmethod
+    def create(prefix, params, hint):
+        current = getattr(_BlockScope._current, "value", None)
+        if current is None:
+            if prefix is None:
+                prefix = _static_name(hint) + "_"
+            return prefix, ParameterDict(prefix, params)
+        if prefix is None:
+            count = current._counter.get(hint, 0)
+            current._counter[hint] = count + 1
+            prefix = f"{hint}{count}_"
+        prefix = current._block.prefix + prefix
+        parent_params = current._block._params
+        return prefix, ParameterDict(prefix, params if params is not None
+                                     else parent_params._shared)
+
+    def __enter__(self):
+        # a block constructed with prefix="" is transparent: its children name
+        # themselves in the parent's scope (reference block.py _empty_prefix)
+        if getattr(self._block, "_empty_prefix", False):
+            return self
+        self._old = getattr(_BlockScope._current, "value", None)
+        _BlockScope._current.value = self
+        return self
+
+    def __exit__(self, *exc):
+        if getattr(self._block, "_empty_prefix", False):
+            return False
+        _BlockScope._current.value = self._old
+        return False
+
+
+_global_counter: Dict[str, int] = {}
+
+
+def _static_name(hint: str) -> str:
+    i = _global_counter.get(hint, 0)
+    _global_counter[hint] = i + 1
+    return f"{hint}{i}"
+
+
+def _flatten_arrays(args):
+    flat = []
+    fmt = []
+    for a in args:
+        if isinstance(a, NDArray):
+            flat.append(a)
+            fmt.append(0)
+        elif isinstance(a, (list, tuple)):
+            sub_flat, sub_fmt = _flatten_arrays(a)
+            flat.extend(sub_flat)
+            fmt.append(sub_fmt)
+        else:
+            flat.append(a)
+            fmt.append(0)
+    return flat, fmt
+
+
+class Block:
+    """Base class for all layers/models (reference gluon/block.py:127)."""
+
+    def __init__(self, prefix: Optional[str] = None, params: Optional[ParameterDict] = None):
+        hint = type(self).__name__.lower()
+        self._empty_prefix = prefix == ""
+        self._prefix, self._params = _BlockScope.create(prefix, params, hint)
+        self._name = self._prefix[:-1] if self._prefix.endswith("_") else self._prefix
+        self._scope = _BlockScope(self)
+        self._children: "OrderedDict[str, Block]" = OrderedDict()
+        self._reg_params: Dict[str, Parameter] = {}
+        self._forward_hooks: List = []
+        self._forward_pre_hooks: List = []
+
+    # ------------------------------------------------------------- naming
+    @property
+    def prefix(self) -> str:
+        return self._prefix
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    def name_scope(self) -> _BlockScope:
+        return self._scope
+
+    @property
+    def params(self) -> ParameterDict:
+        return self._params
+
+    # ------------------------------------------------------------- children
+    def __setattr__(self, name, value):
+        if isinstance(value, Block):
+            self.__dict__.setdefault("_children", OrderedDict())[name] = value
+        elif isinstance(value, Parameter):
+            self.__dict__.setdefault("_reg_params", {})[name] = value
+        super().__setattr__(name, value)
+
+    def register_child(self, block: "Block", name: Optional[str] = None) -> None:
+        self._children[name or str(len(self._children))] = block
+
+    def register_forward_hook(self, hook):
+        self._forward_hooks.append(hook)
+
+    def register_forward_pre_hook(self, hook):
+        self._forward_pre_hooks.append(hook)
+
+    def collect_params(self, select: Optional[str] = None) -> ParameterDict:
+        ret = ParameterDict(self._params.prefix)
+        params = ParameterDict(self._params.prefix)
+        params.update(self._own_params())
+        for child in self._children.values():
+            params.update(child.collect_params())
+        if select is None:
+            ret.update(params)
+        else:
+            pat = re.compile(select)
+            for name, p in params.items():
+                if pat.match(name):
+                    ret._params[name] = p
+        return ret
+
+    def _own_params(self) -> ParameterDict:
+        d = ParameterDict(self._params.prefix)
+        for p in self._reg_params.values():
+            d._params[p.name] = p
+        return d
+
+    def initialize(self, init=None, ctx=None, verbose=False, force_reinit=False):
+        from .. import initializer as _init
+        self.collect_params().initialize(init or _init.Uniform(), ctx,
+                                         verbose, force_reinit)
+
+    def cast(self, dtype) -> None:
+        for child in self._children.values():
+            child.cast(dtype)
+        for p in self.collect_params().values():
+            p.cast(dtype)
+
+    def apply(self, fn):
+        for child in self._children.values():
+            child.apply(fn)
+        fn(self)
+        return self
+
+    # ------------------------------------------------------------- persistence
+    def _collect_params_with_prefix(self, prefix: str = "") -> Dict[str, Parameter]:
+        """Structural names ('0.weight', 'body.1.bias') independent of name
+        scopes — the reference's save_parameters keying (block.py:315-356),
+        which makes checkpoints portable across differently-prefixed models."""
+        if prefix:
+            prefix += "."
+        ret = {prefix + k: v for k, v in self._reg_params.items()}
+        for name, child in self._children.items():
+            ret.update(child._collect_params_with_prefix(prefix + name))
+        return ret
+
+    def save_parameters(self, filename: str, deduplicate: bool = False) -> None:
+        from ..ndarray import save as nd_save
+        params = self._collect_params_with_prefix()
+        nd_save(filename, {k: p.data() for k, p in params.items()})
+
+    def load_parameters(self, filename: str, ctx=None, allow_missing=False,
+                        ignore_extra=False, cast_dtype=False, dtype_source="current"):
+        from ..ndarray import load as nd_load
+        loaded = nd_load(filename)
+        params = self._collect_params_with_prefix()
+        for name, p in params.items():
+            if name in loaded:
+                if p._data is None:
+                    p.shape = tuple(loaded[name].shape)
+                    if p._deferred_init is not None:
+                        p._finish_deferred_init(p.shape)
+                    else:
+                        p.initialize(ctx=ctx)
+                p.set_data(loaded[name])
+            elif not allow_missing:
+                raise MXNetError(f"parameter {name!r} missing in file {filename}")
+        if not ignore_extra:
+            extra = set(loaded) - set(params)
+            if extra:
+                raise MXNetError(f"file {filename} has extra parameters "
+                                 f"{sorted(extra)}; set ignore_extra=True")
+
+    # legacy aliases (reference keeps both spellings)
+    save_params = save_parameters
+
+    def load_params(self, filename, ctx=None, allow_missing=False, ignore_extra=False):
+        self.load_parameters(filename, ctx, allow_missing, ignore_extra)
+
+    # ------------------------------------------------------------- exec
+    def __call__(self, *args):
+        for hook in self._forward_pre_hooks:
+            hook(self, args)
+        out = self.forward(*args)
+        for hook in self._forward_hooks:
+            hook(self, args, out)
+        return out
+
+    def forward(self, *args):
+        raise NotImplementedError
+
+    def hybridize(self, active: bool = True, **kwargs) -> None:
+        for child in self._children.values():
+            child.hybridize(active, **kwargs)
+
+    def summary(self, *inputs):
+        out = self(*inputs)
+        n_params = sum(int(np.prod(p.shape)) for p in self.collect_params().values()
+                       if p.shape)
+        print(f"{type(self).__name__}: {n_params} parameters")
+        return out
+
+    def __repr__(self):
+        lines = [f"{type(self).__name__}("]
+        for name, child in self._children.items():
+            lines.append(f"  ({name}): {type(child).__name__}")
+        lines.append(")")
+        return "\n".join(lines)
+
+
+class CachedOp:
+    """A captured graph compiled to one XLA executable
+    (reference src/imperative/cached_op.cc; here StaticForward/DynamicForward
+    collapse into jax.jit's shape-keyed executable cache)."""
+
+    def __init__(self, sym, param_map: Dict[str, Parameter], flags=None,
+                 data_names=None):
+        self._sym = sym
+        self._param_map = dict(param_map)
+        self._lowering = _GraphLowering(sym)
+        self._input_names = [n.name for n in sym.topo_nodes() if n.is_var]
+        if data_names is not None:
+            self._data_names = list(data_names)
+        else:
+            self._data_names = [n for n in self._input_names
+                                if n not in self._param_map]
+        self._jit_cache: Dict[bool, Any] = {}
+        self._n_outputs = len(sym._outputs)
+
+    def _compiled(self, is_train: bool):
+        if is_train not in self._jit_cache:
+            self._jit_cache[is_train] = jax.jit(self._lowering.lower(is_train))
+        return self._jit_cache[is_train]
+
+    def __call__(self, *args):
+        """args: data arrays in _data_names order."""
+        if len(args) != len(self._data_names):
+            raise MXNetError(f"CachedOp expects {len(self._data_names)} inputs "
+                             f"({self._data_names}), got {len(args)}")
+        is_train = autograd.is_training()
+        recording = autograd.is_recording()
+        fn = self._compiled(is_train)
+
+        inputs: Dict[str, Any] = {}
+        holders: Dict[str, NDArray] = {}
+        for name, arr in zip(self._data_names, args):
+            inputs[name] = _unwrap(arr)
+            holders[name] = arr
+        for name, p in self._param_map.items():
+            nd_p = p.data()
+            inputs[name] = nd_p._data
+            holders[name] = nd_p
+
+        rng = _random.next_key() if self._lowering.has_rng else jax.random.PRNGKey(0)
+        for v in inputs.values():
+            if hasattr(v, "devices"):
+                rng = jax.device_put(rng, list(v.devices())[0])
+                break
+
+        if recording:
+            diff_names = [n for n in self._input_names
+                          if jnp.issubdtype(jnp.asarray(inputs[n]).dtype, jnp.floating)]
+            nondiff = {n: v for n, v in inputs.items() if n not in diff_names}
+            diff = {n: inputs[n] for n in diff_names}
+
+            def closed(d):
+                return fn({**d, **nondiff}, rng)
+
+            (outs, aux_updates), vjp_fn = jax.vjp(closed, diff)
+
+            st = autograd._st()
+            aux_zeros = {k: jnp.zeros_like(v) for k, v in aux_updates.items()}
+
+            def node_vjp(cts):
+                if not isinstance(cts, tuple):
+                    cts = (cts,)
+                (gdict,) = vjp_fn((list(cts), aux_zeros))
+                return tuple(gdict[n] for n in diff_names)
+
+            parents = [getattr(holders[n], "_ag_node", None) for n in diff_names]
+            slots = [getattr(holders[n], "_ag_slot", 0) for n in diff_names]
+            node = autograd._Node(node_vjp, parents, slots, len(outs),
+                                  st.counter, "CachedOp")
+            node.saved_outputs = list(outs)
+            st.counter += 1
+            st.tape.append(node)
+            wrapped = []
+            for i, o in enumerate(outs):
+                w = _wrap(o)
+                w._ag_node = node
+                w._ag_slot = i
+                wrapped.append(w)
+        else:
+            outs, aux_updates = fn(inputs, rng)
+            wrapped = [_wrap(o) for o in outs]
+
+        # apply BN-style aux updates to the backing parameters
+        for name, val in aux_updates.items():
+            p = self._param_map.get(name)
+            if p is not None:
+                p.data()._set_data(val)
+        if len(wrapped) == 1:
+            return wrapped[0]
+        return wrapped
+
+
+class HybridBlock(Block):
+    """A Block that can be captured into a single XLA program
+    (reference gluon/block.py:671)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._active = False
+        self._cached_op: Optional[CachedOp] = None
+        self._flags: Dict[str, Any] = {}
+        self._in_format = None
+
+    def hybridize(self, active: bool = True, static_alloc=False, static_shape=False,
+                  inline_limit=2, forward_bulk_size=None, backward_bulk_size=None):
+        self._active = active
+        self._cached_op = None
+        self._flags = {"static_alloc": static_alloc, "static_shape": static_shape}
+        super().hybridize(active)
+
+    def cast(self, dtype):
+        self._cached_op = None
+        super().cast(dtype)
+
+    # ------------------------------------------------------------- tracing
+    def _trace_symbol(self, n_inputs: int):
+        from .. import symbol as sym
+        data_syms = [sym.Variable(f"data{i}" if n_inputs > 1 else "data")
+                     for i in range(n_inputs)]
+        params = {n: p.var() for n, p in self._reg_params.items()}
+        with autograd.pause():
+            out = self._call_hybrid(sym, data_syms, params)
+        if isinstance(out, (list, tuple)):
+            out = sym.Group(list(out))
+        return out, data_syms
+
+    def _call_hybrid(self, F, data_list, params):
+        return self.hybrid_forward(F, *data_list, **params)
+
+    def _collect_param_map(self) -> Dict[str, Parameter]:
+        pmap = {}
+        for p in self.collect_params().values():
+            pmap[p.name] = p
+        return pmap
+
+    def _build_cache(self, flat_args):
+        out_sym, data_syms = self._trace_symbol(len(flat_args))
+        pmap = self._collect_param_map()
+        used = {n.name for n in out_sym.topo_nodes() if n.is_var}
+        pmap = {k: v for k, v in pmap.items() if k in used}
+        self._cached_op = CachedOp(out_sym, pmap, self._flags,
+                                   data_names=[s.name for s in data_syms])
+
+    def _deferred_infer_shape(self, flat_args):
+        """Infer unknown parameter shapes from a symbolic trace + input shapes
+        (reference HybridBlock._deferred_infer_shape)."""
+        out_sym, data_syms = self._trace_symbol(len(flat_args))
+        known = {}
+        for s, a in zip(
+                [f"data{i}" if len(flat_args) > 1 else "data"
+                 for i in range(len(flat_args))], flat_args):
+            known[s] = tuple(a.shape)
+        pmap = self._collect_param_map()
+        for name, p in pmap.items():
+            if p._shape_known():
+                known[name] = p.shape
+        lowering = _GraphLowering(out_sym)
+        shapes = lowering.infer_shapes(known)
+        for name, p in pmap.items():
+            if not p._shape_known() and name in shapes:
+                p._finish_deferred_init(shapes[name])
+            elif p._deferred_init is not None and name in shapes:
+                p._finish_deferred_init(shapes[name])
+
+    # ------------------------------------------------------------- forward
+    def forward(self, x, *args):
+        if isinstance(x, NDArray):
+            flat = [x] + [a for a in args if isinstance(a, NDArray)]
+            try:
+                return self._forward_nd(x, *args)
+            except DeferredInitializationError:
+                self._deferred_infer_shape(flat)
+                return self._forward_nd(x, *args)
+        # symbolic composition: net(sym.Variable('data'))
+        from .. import symbol as sym_mod
+        params = {n: p.var() for n, p in self._reg_params.items()}
+        return self._call_hybrid(sym_mod, [x] + list(args), params)
+
+    def _forward_nd(self, x, *args):
+        if self._active:
+            if self._cached_op is None:
+                flat = [x] + [a for a in args if isinstance(a, NDArray)]
+                # make sure params are initialized before capture
+                for p in self._collect_param_map().values():
+                    p.data()
+                self._build_cache(flat)
+            return self._cached_op(x, *args)
+        from .. import ndarray as nd_mod
+        params = {n: p.data() for n, p in self._reg_params.items()}
+        return self.hybrid_forward(nd_mod, x, *args, **params)
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError
+
+    # ------------------------------------------------------------- export
+    def export(self, path: str, epoch: int = 0):
+        """Write ``path-symbol.json`` + ``path-%04d.params`` (reference
+        block.py:868) for SymbolBlock/Module serving."""
+        if not self._active or self._cached_op is None:
+            raise MXNetError("export requires hybridize() and at least one "
+                             "forward call")
+        sym_file = f"{path}-symbol.json"
+        self._cached_op._sym.save(sym_file)
+        from ..ndarray import save as nd_save
+        params = {}
+        for name, p in self._cached_op._param_map.items():
+            params[("aux:" if p.grad_req == "null" else "arg:") + name] = p.data()
+        param_file = f"{path}-{epoch:04d}.params"
+        nd_save(param_file, params)
+        return sym_file, param_file
+
+
+class SymbolBlock(HybridBlock):
+    """Wrap an arbitrary Symbol as a Block (reference block.py:952)."""
+
+    def __init__(self, outputs, inputs, params=None):
+        super().__init__(prefix="", params=None)
+        from .. import symbol as sym_mod
+        if isinstance(outputs, (list, tuple)):
+            outputs = sym_mod.Group(list(outputs))
+        if not isinstance(inputs, (list, tuple)):
+            inputs = [inputs]
+        self._sym_outputs = outputs
+        input_names = {s.name for s in inputs}
+        self._input_names_ordered = [s.name for s in inputs]
+        pdict = params or {}
+        for name in outputs.list_inputs():
+            if name in input_names:
+                continue
+            p = self.params.get(name, allow_deferred_init=True)
+            if name in pdict:
+                arr = pdict[name]
+                p.shape = tuple(arr.shape)
+                p.initialize()
+                p.set_data(arr)
+            self._reg_params[name] = p
+
+    @staticmethod
+    def imports(symbol_file: str, input_names, param_file: Optional[str] = None,
+                ctx=None):
+        from .. import symbol as sym_mod
+        from ..ndarray import load as nd_load
+        sym = sym_mod.load(symbol_file)
+        if isinstance(input_names, str):
+            input_names = [input_names]
+        inputs = [sym_mod.Variable(n) for n in input_names]
+        params = {}
+        if param_file:
+            for k, v in nd_load(param_file).items():
+                params[k.split(":", 1)[-1]] = v
+        return SymbolBlock(sym, inputs, params)
+
+    def _trace_symbol(self, n_inputs):
+        # inputs keep their original names
+        from .. import symbol as sym_mod
+        return self._sym_outputs, [sym_mod.Variable(n)
+                                   for n in self._input_names_ordered]
+
+    def forward(self, x, *args):
+        if not isinstance(x, NDArray):
+            return self._sym_outputs
+        if self._cached_op is None:
+            for p in self._reg_params.values():
+                try:
+                    p.data()
+                except DeferredInitializationError:
+                    self._deferred_infer_shape([x] + list(args))
+                    break
+            pmap = {p.name: p for p in self._reg_params.values()}
+            self._cached_op = CachedOp(self._sym_outputs, pmap, {},
+                                       data_names=self._input_names_ordered)
+        return self._cached_op(x, *args)

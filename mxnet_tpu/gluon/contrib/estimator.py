@@ -1,0 +1,32 @@
+"""Minimal training-loop estimator (reference: gluon/contrib/estimator)."""
+from __future__ import annotations
+
+from ... import autograd
+from ...base import MXNetError
+
+__all__ = ["Estimator"]
+
+
+class Estimator:
+    def __init__(self, net, loss, metrics=None, trainer=None, context=None):
+        self.net = net
+        self.loss = loss
+        self.metrics = metrics or []
+        self.trainer = trainer
+        self.context = context
+
+    def fit(self, train_data, val_data=None, epochs=1):
+        if self.trainer is None:
+            raise MXNetError("Estimator needs a trainer")
+        for epoch in range(epochs):
+            for m in self.metrics:
+                m.reset()
+            for batch in train_data:
+                data, label = batch[0], batch[1]
+                with autograd.record():
+                    out = self.net(data)
+                    loss = self.loss(out, label)
+                loss.backward()
+                self.trainer.step(data.shape[0])
+                for m in self.metrics:
+                    m.update(label, out)

@@ -1,0 +1,332 @@
+"""Recurrent cells (reference: ``python/mxnet/gluon/rnn/rnn_cell.py``).
+
+Cells unroll explicitly (BucketingModule-style variable-length handling,
+SURVEY.md §5.7); the fused layers in rnn_layer.py are the fast path.
+"""
+from __future__ import annotations
+
+from ... import ndarray as nd
+from ...base import MXNetError
+from ..block import HybridBlock
+
+__all__ = ["RecurrentCell", "RNNCell", "LSTMCell", "GRUCell",
+           "SequentialRNNCell", "DropoutCell", "ZoneoutCell", "ResidualCell",
+           "BidirectionalCell"]
+
+
+class RecurrentCell(HybridBlock):
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._modified = False
+        self.reset()
+
+    def reset(self):
+        self._init_counter = -1
+        self._counter = -1
+        for cell in self._children.values():
+            if isinstance(cell, RecurrentCell):
+                cell.reset()
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        func = func or (lambda shape=None, **kw: nd.zeros(shape, **kw))
+        states = []
+        for info in self.state_info(batch_size):
+            self._init_counter += 1
+            states.append(func(shape=info["shape"], **kwargs))
+        return states
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        """Unroll the cell for ``length`` steps (reference rnn_cell.py:unroll)."""
+        self.reset()
+        axis = layout.find("T")
+        batch_axis = layout.find("N")
+        if isinstance(inputs, (list, tuple)):
+            seq = list(inputs)
+            batch = seq[0].shape[0]
+        else:
+            batch = inputs.shape[batch_axis]
+            seq = [nd.squeeze(s, axis=axis) for s in
+                   nd.split(inputs, length, axis=axis)] if length > 1 else \
+                  [nd.squeeze(inputs, axis=axis)]
+        states = begin_state if begin_state is not None else self.begin_state(batch)
+        outputs = []
+        for t in range(length):
+            out, states = self(seq[t], states)
+            outputs.append(out)
+        if valid_length is not None:
+            stacked = nd.stack(*outputs, axis=axis)
+            stacked = nd.SequenceMask(stacked, valid_length,
+                                      use_sequence_length=True, axis=axis)
+            outputs = stacked
+            merge_outputs = True
+        if merge_outputs:
+            if not isinstance(outputs, nd.NDArray):
+                outputs = nd.stack(*outputs, axis=axis)
+        return outputs, states
+
+    def forward(self, x, states):
+        self._counter += 1
+        for p in self._reg_params.values():
+            if p._deferred_init is not None:
+                shape = tuple(x.shape[-1] if s == 0 else s for s in p.shape)
+                p._finish_deferred_init(shape)
+        return self._cell_forward(x, states)
+
+    def _cell_forward(self, x, states):
+        from ... import ndarray as nd_mod
+        params = {n: p.data() for n, p in self._reg_params.items()}
+        return self.hybrid_forward(nd_mod, x, states, **params)
+
+
+class RNNCell(RecurrentCell):
+    def __init__(self, hidden_size, activation="tanh", i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", input_size=0, prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._hidden_size = hidden_size
+        self._activation = activation
+        with self.name_scope():
+            self.i2h_weight = self.params.get("i2h_weight",
+                                              shape=(hidden_size, input_size),
+                                              init=i2h_weight_initializer,
+                                              allow_deferred_init=True)
+            self.h2h_weight = self.params.get("h2h_weight",
+                                              shape=(hidden_size, hidden_size),
+                                              init=h2h_weight_initializer,
+                                              allow_deferred_init=True)
+            self.i2h_bias = self.params.get("i2h_bias", shape=(hidden_size,),
+                                            init=i2h_bias_initializer,
+                                            allow_deferred_init=True)
+            self.h2h_bias = self.params.get("h2h_bias", shape=(hidden_size,),
+                                            init=h2h_bias_initializer,
+                                            allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def hybrid_forward(self, F, x, states, i2h_weight, h2h_weight, i2h_bias,
+                       h2h_bias):
+        i2h = F.FullyConnected(x, i2h_weight, i2h_bias,
+                               num_hidden=self._hidden_size)
+        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
+                               num_hidden=self._hidden_size)
+        out = F.Activation(i2h + h2h, act_type=self._activation)
+        return out, [out]
+
+
+class LSTMCell(RecurrentCell):
+    """Gate order [i, f, g, o] (reference rnn_cell.py:LSTMCell)."""
+
+    def __init__(self, hidden_size, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", input_size=0, prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._hidden_size = hidden_size
+        with self.name_scope():
+            self.i2h_weight = self.params.get("i2h_weight",
+                                              shape=(4 * hidden_size, input_size),
+                                              init=i2h_weight_initializer,
+                                              allow_deferred_init=True)
+            self.h2h_weight = self.params.get("h2h_weight",
+                                              shape=(4 * hidden_size, hidden_size),
+                                              init=h2h_weight_initializer,
+                                              allow_deferred_init=True)
+            self.i2h_bias = self.params.get("i2h_bias", shape=(4 * hidden_size,),
+                                            init=i2h_bias_initializer,
+                                            allow_deferred_init=True)
+            self.h2h_bias = self.params.get("h2h_bias", shape=(4 * hidden_size,),
+                                            init=h2h_bias_initializer,
+                                            allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def hybrid_forward(self, F, x, states, i2h_weight, h2h_weight, i2h_bias,
+                       h2h_bias):
+        h = self._hidden_size
+        gates = F.FullyConnected(x, i2h_weight, i2h_bias, num_hidden=4 * h) + \
+            F.FullyConnected(states[0], h2h_weight, h2h_bias, num_hidden=4 * h)
+        parts = F.SliceChannel(gates, num_outputs=4, axis=1)
+        i = F.sigmoid(parts[0])
+        f = F.sigmoid(parts[1])
+        g = F.Activation(parts[2], act_type="tanh")
+        o = F.sigmoid(parts[3])
+        c = f * states[1] + i * g
+        out = o * F.Activation(c, act_type="tanh")
+        return out, [out, c]
+
+
+class GRUCell(RecurrentCell):
+    """Gate order [r, z, n] (reference rnn_cell.py:GRUCell)."""
+
+    def __init__(self, hidden_size, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", input_size=0, prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._hidden_size = hidden_size
+        with self.name_scope():
+            self.i2h_weight = self.params.get("i2h_weight",
+                                              shape=(3 * hidden_size, input_size),
+                                              init=i2h_weight_initializer,
+                                              allow_deferred_init=True)
+            self.h2h_weight = self.params.get("h2h_weight",
+                                              shape=(3 * hidden_size, hidden_size),
+                                              init=h2h_weight_initializer,
+                                              allow_deferred_init=True)
+            self.i2h_bias = self.params.get("i2h_bias", shape=(3 * hidden_size,),
+                                            init=i2h_bias_initializer,
+                                            allow_deferred_init=True)
+            self.h2h_bias = self.params.get("h2h_bias", shape=(3 * hidden_size,),
+                                            init=h2h_bias_initializer,
+                                            allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def hybrid_forward(self, F, x, states, i2h_weight, h2h_weight, i2h_bias,
+                       h2h_bias):
+        h = self._hidden_size
+        i2h = F.FullyConnected(x, i2h_weight, i2h_bias, num_hidden=3 * h)
+        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias, num_hidden=3 * h)
+        i2h_parts = F.SliceChannel(i2h, num_outputs=3, axis=1)
+        h2h_parts = F.SliceChannel(h2h, num_outputs=3, axis=1)
+        r = F.sigmoid(i2h_parts[0] + h2h_parts[0])
+        z = F.sigmoid(i2h_parts[1] + h2h_parts[1])
+        n = F.Activation(i2h_parts[2] + r * h2h_parts[2], act_type="tanh")
+        out = (1.0 - z) * n + z * states[0]
+        return out, [out]
+
+
+class SequentialRNNCell(RecurrentCell):
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix, params)
+
+    def add(self, cell):
+        self.register_child(cell)
+
+    def state_info(self, batch_size=0):
+        infos = []
+        for cell in self._children.values():
+            infos.extend(cell.state_info(batch_size))
+        return infos
+
+    def _cell_forward(self, x, states):
+        next_states = []
+        p = 0
+        for cell in self._children.values():
+            n = len(cell.state_info())
+            x, sub = cell(x, states[p:p + n])
+            next_states.extend(sub)
+            p += n
+        return x, next_states
+
+    def __len__(self):
+        return len(self._children)
+
+    def __getitem__(self, i):
+        return list(self._children.values())[i]
+
+
+class DropoutCell(RecurrentCell):
+    def __init__(self, rate, axes=(), prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._rate = rate
+        self._axes = tuple(axes)
+
+    def state_info(self, batch_size=0):
+        return []
+
+    def _cell_forward(self, x, states):
+        if self._rate > 0:
+            x = nd.Dropout(x, p=self._rate, axes=self._axes)
+        return x, states
+
+
+class ZoneoutCell(RecurrentCell):
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0,
+                 prefix=None, params=None):
+        super().__init__(prefix, params)
+        self.base_cell = base_cell
+        self._zoneout_outputs = zoneout_outputs
+        self._zoneout_states = zoneout_states
+        self._prev_output = None
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def reset(self):
+        super().reset()
+        self._prev_output = None
+
+    def _cell_forward(self, x, states):
+        out, next_states = self.base_cell(x, states)
+        from ... import autograd
+        if autograd.is_training():
+            if self._zoneout_outputs > 0:
+                mask = nd.Dropout(nd.ones_like(out), p=self._zoneout_outputs)
+                prev = self._prev_output if self._prev_output is not None \
+                    else nd.zeros_like(out)
+                out = nd.where(mask, out, prev)
+            if self._zoneout_states > 0:
+                zs = []
+                for new_s, old_s in zip(next_states, states):
+                    mask = nd.Dropout(nd.ones_like(new_s), p=self._zoneout_states)
+                    zs.append(nd.where(mask, new_s, old_s))
+                next_states = zs
+        self._prev_output = out
+        return out, next_states
+
+
+class ResidualCell(RecurrentCell):
+    def __init__(self, base_cell, prefix=None, params=None):
+        super().__init__(prefix, params)
+        self.base_cell = base_cell
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def _cell_forward(self, x, states):
+        out, next_states = self.base_cell(x, states)
+        return out + x, next_states
+
+
+class BidirectionalCell(RecurrentCell):
+    def __init__(self, l_cell, r_cell, prefix=None, params=None):
+        super().__init__(prefix, params)
+        self.l_cell = l_cell
+        self.r_cell = r_cell
+
+    def state_info(self, batch_size=0):
+        return self.l_cell.state_info(batch_size) + self.r_cell.state_info(batch_size)
+
+    def __call__(self, x, states):
+        raise MXNetError("BidirectionalCell supports unroll() only")
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        self.reset()
+        axis = layout.find("T")
+        if not isinstance(inputs, (list, tuple)):
+            seq = [nd.squeeze(s, axis=axis) for s in
+                   nd.split(inputs, length, axis=axis)]
+            batch = inputs.shape[layout.find("N")]
+        else:
+            seq = list(inputs)
+            batch = seq[0].shape[0]
+        states = begin_state if begin_state is not None else self.begin_state(batch)
+        nl = len(self.l_cell.state_info())
+        l_out, l_states = self.l_cell.unroll(length, seq, states[:nl],
+                                             layout="NTC", merge_outputs=False)
+        r_out, r_states = self.r_cell.unroll(length, list(reversed(seq)),
+                                             states[nl:], layout="NTC",
+                                             merge_outputs=False)
+        outs = [nd.concat(lo, ro, dim=1)
+                for lo, ro in zip(l_out, reversed(r_out))]
+        if merge_outputs:
+            outs = nd.stack(*outs, axis=axis)
+        return outs, l_states + r_states

@@ -1,0 +1,159 @@
+"""Fused recurrent layers (reference: ``python/mxnet/gluon/rnn/rnn_layer.py``).
+
+Parameters are registered per layer/direction (``l0_i2h_weight`` …) exactly as
+the reference does, and packed at forward time into the RNN op's flat vector
+(a few concats that XLA folds away), so checkpoints keep the same names.
+"""
+from __future__ import annotations
+
+from ... import ndarray as nd
+from ...base import MXNetError
+from ..block import HybridBlock
+
+__all__ = ["RNN", "LSTM", "GRU"]
+
+_GATES = {"lstm": 4, "gru": 3, "rnn_relu": 1, "rnn_tanh": 1}
+
+
+class _RNNLayer(HybridBlock):
+    def __init__(self, hidden_size, num_layers, layout, dropout, bidirectional,
+                 input_size, mode, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", prefix=None, params=None):
+        super().__init__(prefix, params)
+        if layout not in ("TNC", "NTC"):
+            raise MXNetError(f"bad layout {layout}")
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._mode = mode
+        gates = _GATES[mode]
+        ng = gates * hidden_size
+        with self.name_scope():
+            for layer in range(num_layers):
+                for d in ["l", "r"][:self._dir]:
+                    in_sz = input_size if layer == 0 else hidden_size * self._dir
+                    setattr(self, f"{d}{layer}_i2h_weight", self.params.get(
+                        f"{d}{layer}_i2h_weight", shape=(ng, in_sz),
+                        init=i2h_weight_initializer, allow_deferred_init=True))
+                    setattr(self, f"{d}{layer}_h2h_weight", self.params.get(
+                        f"{d}{layer}_h2h_weight", shape=(ng, hidden_size),
+                        init=h2h_weight_initializer, allow_deferred_init=True))
+                    setattr(self, f"{d}{layer}_i2h_bias", self.params.get(
+                        f"{d}{layer}_i2h_bias", shape=(ng,),
+                        init=i2h_bias_initializer, allow_deferred_init=True))
+                    setattr(self, f"{d}{layer}_h2h_bias", self.params.get(
+                        f"{d}{layer}_h2h_bias", shape=(ng,),
+                        init=h2h_bias_initializer, allow_deferred_init=True))
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        """Initial states as NDArrays (func defaults to nd.zeros)."""
+        func = func or (lambda shape=None, **kw: nd.zeros(shape, **kw))
+        states = []
+        for info in self.state_info(batch_size):
+            states.append(func(shape=info["shape"], **kwargs))
+        return states
+
+    def _pack_params(self, F, kwargs):
+        parts = []
+        for layer in range(self._num_layers):
+            for d in ["l", "r"][:self._dir]:
+                parts.append(F.Reshape(kwargs[f"{d}{layer}_i2h_weight"], shape=(-1,)))
+                parts.append(F.Reshape(kwargs[f"{d}{layer}_h2h_weight"], shape=(-1,)))
+        for layer in range(self._num_layers):
+            for d in ["l", "r"][:self._dir]:
+                parts.append(kwargs[f"{d}{layer}_i2h_bias"])
+                parts.append(kwargs[f"{d}{layer}_h2h_bias"])
+        return F.Concat(*parts, dim=0) if len(parts) > 1 else parts[0]
+
+    def hybrid_forward(self, F, x, *states_args, **params):
+        states = list(states_args)
+        if not states:
+            raise MXNetError("RNN layer needs states: call with begin_state() "
+                             "output, or call imperatively for auto zero-states")
+        if self._layout == "NTC":
+            x = F.SwapAxis(x, dim1=0, dim2=1)
+        flat = self._pack_params(F, params)
+        ret = self._forward_kernel(F, x, flat, states)
+        out = ret[0] if isinstance(ret, (list, tuple)) else ret
+        rest = list(ret[1:]) if isinstance(ret, (list, tuple)) else []
+        if self._layout == "NTC":
+            out = F.SwapAxis(out, dim1=0, dim2=1)
+        return [out] + rest if rest else out
+
+    def _forward_kernel(self, F, x, flat, states):
+        kw = dict(state_size=self._hidden_size, num_layers=self._num_layers,
+                  bidirectional=self._dir == 2, p=self._dropout,
+                  mode=self._mode, state_outputs=True)
+        if self._mode == "lstm":
+            return F.RNN(x, flat, states[0], states[1], **kw)
+        return F.RNN(x, flat, states[0], **kw)
+
+    def __call__(self, x, *states):
+        """Returns ``output`` if called without states (auto zero-state), else
+        ``(output, [new_states...])`` — reference _RNNLayer.forward contract."""
+        from ...ndarray import NDArray
+        explicit = bool(states)
+        if len(states) == 1 and isinstance(states[0], (list, tuple)):
+            states = tuple(states[0])
+        if isinstance(x, NDArray):
+            # finish deferred init: layer-0 input size comes from the data
+            for p in self._reg_params.values():
+                if p._deferred_init is not None:
+                    shape = tuple(x.shape[-1] if s == 0 else s for s in p.shape)
+                    p._finish_deferred_init(shape)
+        if isinstance(x, NDArray) and not states:
+            batch = x.shape[self._layout.find("N")]
+            states = tuple(self.begin_state(batch))
+        ret = super().__call__(x, *states)
+        if isinstance(ret, (list, tuple)):
+            out, rest = ret[0], list(ret[1:])
+        else:
+            out, rest = ret, []
+        if explicit:
+            return out, rest
+        return out
+
+
+class RNN(_RNNLayer):
+    """Vanilla multi-layer RNN (relu/tanh)."""
+
+    def __init__(self, hidden_size, num_layers=1, activation="relu", layout="TNC",
+                 dropout=0, bidirectional=False, input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout, bidirectional,
+                         input_size, "rnn_" + activation, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]
+
+
+class LSTM(_RNNLayer):
+    """Fused multi-layer LSTM (north-star config #3 workhorse)."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout, bidirectional,
+                         input_size, "lstm", **kwargs)
+
+    def state_info(self, batch_size=0):
+        shape = (self._num_layers * self._dir, batch_size, self._hidden_size)
+        return [{"shape": shape, "__layout__": "LNC"},
+                {"shape": shape, "__layout__": "LNC"}]
+
+
+class GRU(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout, bidirectional,
+                         input_size, "gru", **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]

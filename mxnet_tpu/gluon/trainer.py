@@ -1,0 +1,140 @@
+"""Gluon Trainer.
+
+Reference parity: ``python/mxnet/gluon/trainer.py`` (``_init_kvstore`` :168,
+``step`` :301, ``allreduce_grads`` :330, ``update`` :362).
+
+TPU-first: with a single-process SPMD runtime there is one logical copy of
+each parameter, so "allreduce across devices then update per device" becomes
+"(optionally) psum sharded grads via the KVStore facade, then one fused
+update". Priority-ordered comm (reference pushes with priority=-index so early
+layers' reduces land first) is preserved by the kvstore's bucketed allreduce.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from .. import optimizer as opt_mod
+from ..base import MXNetError
+from .parameter import Parameter, ParameterDict
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
+                 compression_params=None, update_on_kvstore=None):
+        if isinstance(params, (dict, ParameterDict)):
+            params = list(params.values())
+        if not isinstance(params, (list, tuple)):
+            raise MXNetError("params must be a ParameterDict or list of Parameters")
+        self._params: List[Parameter] = []
+        self._param2idx = {}
+        for i, p in enumerate(params):
+            if not isinstance(p, Parameter):
+                raise MXNetError(f"expected Parameter, got {type(p)}")
+            self._param2idx[p.name] = i
+            self._params.append(p)
+        self._scale = 1.0
+        optimizer_params = optimizer_params or {}
+        self._init_optimizer(optimizer, optimizer_params)
+        self._kvstore_type = kvstore
+        self._kvstore = None
+        self._kv_initialized = False
+        self._update_on_kvstore = update_on_kvstore
+        self._params_to_init = list(self._params)
+
+    # ------------------------------------------------------------- setup
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: p for i, p in enumerate(self._params)}
+        if isinstance(optimizer, opt_mod.Optimizer):
+            if optimizer_params:
+                raise MXNetError("optimizer_params must be None when optimizer "
+                                 "is an Optimizer instance")
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            self._optimizer = opt_mod.create(optimizer, param_dict=param_dict,
+                                             **optimizer_params)
+        self._updaters = [opt_mod.get_updater(self._optimizer)]
+
+    def _init_kvstore(self):
+        if self._kvstore_type and str(self._kvstore_type) not in ("None",):
+            from .. import kvstore as kv_mod
+            if isinstance(self._kvstore_type, str):
+                self._kvstore = kv_mod.create(self._kvstore_type)
+            else:
+                self._kvstore = self._kvstore_type
+            if self._update_on_kvstore is None:
+                self._update_on_kvstore = False
+            if self._update_on_kvstore:
+                self._kvstore.set_optimizer(self._optimizer)
+            for i, p in enumerate(self._params):
+                if p.grad_req != "null":
+                    self._kvstore.init(i, p.data())
+        self._kv_initialized = True
+
+    @property
+    def learning_rate(self) -> float:
+        return self._optimizer.learning_rate
+
+    def set_learning_rate(self, lr: float) -> None:
+        self._optimizer.set_learning_rate(lr)
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    # ------------------------------------------------------------- stepping
+    def step(self, batch_size: int, ignore_stale_grad: bool = False) -> None:
+        """allreduce (if distributed) + optimizer update; grads are rescaled
+        by 1/batch_size like the reference."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def allreduce_grads(self) -> None:
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._allreduce_grads()
+
+    def _allreduce_grads(self) -> None:
+        if self._kvstore is None:
+            return
+        for i, p in enumerate(self._params):
+            if p.grad_req != "null":
+                # priority=-i preserves the reference's overlap ordering
+                self._kvstore.push(i, p.list_grad(), priority=-i)
+                if not self._update_on_kvstore:
+                    self._kvstore.pull(i, p.list_grad(), priority=-i,
+                                       ignore_sparse=False)
+
+    def update(self, batch_size: int, ignore_stale_grad: bool = False) -> None:
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    def _update(self, ignore_stale_grad: bool = False) -> None:
+        if self._update_on_kvstore and self._kvstore is not None:
+            for i, p in enumerate(self._params):
+                if p.grad_req != "null":
+                    self._kvstore.pull(i, p.list_data(), priority=-i)
+            return
+        updater = self._updaters[0]
+        for i, p in enumerate(self._params):
+            if p.grad_req == "null":
+                continue
+            updater(i, p.grad, p.data())
+
+    # ------------------------------------------------------------- states
+    def save_states(self, fname: str) -> None:
+        with open(fname, "wb") as f:
+            f.write(self._updaters[0].get_states(dump_optimizer=False))
+
+    def load_states(self, fname: str) -> None:
+        if not self._kv_initialized:
+            self._init_kvstore()
+        with open(fname, "rb") as f:
+            self._updaters[0].set_states(f.read())

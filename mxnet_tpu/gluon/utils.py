@@ -1,0 +1,81 @@
+"""Gluon utilities (reference: ``python/mxnet/gluon/utils.py``)."""
+from __future__ import annotations
+
+import math
+from typing import List
+
+import numpy as np
+
+from .. import ndarray as nd
+from ..base import MXNetError
+from ..ndarray import NDArray
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm", "check_sha1",
+           "download"]
+
+
+def split_data(data: NDArray, num_slice: int, batch_axis: int = 0,
+               even_split: bool = True) -> List[NDArray]:
+    """Slice a batch along ``batch_axis`` into ``num_slice`` pieces
+    (reference DataParallelExecutorGroup.decide_slices / gluon split_data)."""
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise MXNetError(
+            f"batch size {size} must be divisible by number of slices "
+            f"{num_slice}; set even_split=False")
+    step = size // num_slice
+    slices = []
+    for i in range(num_slice):
+        begin = i * step
+        end = (i + 1) * step if i < num_slice - 1 else size
+        slices.append(nd.slice_axis(data, axis=batch_axis, begin=begin, end=end))
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Split a batch and load each slice onto a context. On the SPMD runtime
+    one logical array can also be sharded across a mesh axis instead — see
+    mxnet_tpu.parallel — but the per-context list API is preserved."""
+    if not isinstance(data, NDArray):
+        data = nd.array(data)
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays: List[NDArray], max_norm: float,
+                     check_isfinite: bool = True) -> float:
+    """Rescale arrays so the joint L2 norm ≤ max_norm (reference
+    gluon/utils.py:clip_global_norm)."""
+    if not arrays:
+        return 0.0
+    total = 0.0
+    norms = [nd.sum(a * a) for a in arrays]  # async dispatches
+    total = float(sum(n.asscalar() for n in norms))
+    norm = math.sqrt(total)
+    if check_isfinite and not math.isfinite(norm):
+        raise MXNetError(f"global norm is {norm}: gradients exploded/NaN")
+    scale = max_norm / (norm + 1e-8)
+    if scale < 1.0:
+        for a in arrays:
+            a *= scale
+    return norm
+
+
+def check_sha1(filename: str, sha1_hash: str) -> bool:
+    import hashlib
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None, retries=5,
+             verify_ssl=True):
+    raise MXNetError("download: this environment has no network egress; "
+                     "place files locally and pass their path instead")

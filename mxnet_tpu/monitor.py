@@ -1,0 +1,65 @@
+"""Monitor — per-layer output/statistic tap (reference:
+``python/mxnet/monitor.py`` over ``GraphExecutor::SetMonitorCallback``,
+``src/executor/graph_executor.cc:104``)."""
+from __future__ import annotations
+
+import logging
+import re
+from typing import Callable, List, Optional, Tuple
+
+from .ndarray import NDArray
+
+__all__ = ["Monitor"]
+
+
+class Monitor:
+    def __init__(self, interval: int, stat_func: Optional[Callable] = None,
+                 pattern: str = ".*", sort: bool = False):
+        if stat_func is None:
+            def stat_func(x):
+                return abs(x).mean()
+        self.stat_func = stat_func
+        self.interval = interval
+        self.activated = False
+        self.queue: List[Tuple[int, str, NDArray]] = []
+        self.step = 0
+        self.exes = []
+        self.re_prog = re.compile(pattern)
+        self.sort = sort
+
+        def stat_helper(name, array):
+            if not self.activated or not self.re_prog.match(name):
+                return
+            self.queue.append((self.step, name, self.stat_func(array)))
+
+        self.stat_helper = stat_helper
+
+    def install(self, exe, monitor_all: bool = False) -> None:
+        exe.set_monitor_callback(self.stat_helper, monitor_all)
+        self.exes.append(exe)
+
+    def tic(self) -> None:
+        if self.step % self.interval == 0:
+            self.queue = []
+            self.activated = True
+        self.step += 1
+
+    def toc(self) -> List:
+        if not self.activated:
+            return []
+        self.activated = False
+        res = []
+        queue = sorted(self.queue, key=lambda x: x[1]) if self.sort else self.queue
+        for n, k, v_list in queue:
+            if isinstance(v_list, NDArray):
+                v_list = [v_list]
+            v = ", ".join(f"{float(v.asnumpy().reshape(-1)[0]):.5f}"
+                          if isinstance(v, NDArray) else str(v) for v in
+                          ([v_list] if not isinstance(v_list, list) else v_list))
+            res.append((n, k, v))
+        self.queue = []
+        return res
+
+    def toc_print(self) -> None:
+        for n, k, v in self.toc():
+            logging.info("Batch: %7d %30s %s", n, k, v)

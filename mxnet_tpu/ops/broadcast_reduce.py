@@ -13,7 +13,8 @@ from .registry import register
 
 
 def _bcast(name, fn, differentiable=True):
-    register(name, differentiable=differentiable)(fn)
+    register(name, differentiable=differentiable, arg_names=("lhs", "rhs"))(
+        lambda lhs, rhs, _fn=fn: _fn(lhs, rhs))
 
 
 _bcast("broadcast_add", jnp.add)
@@ -30,7 +31,8 @@ _bcast("broadcast_hypot", jnp.hypot)
 
 
 def _bcast_cmp(name, fn):
-    register(name, differentiable=False)(lambda l, r: fn(l, r).astype(l.dtype))
+    register(name, differentiable=False, arg_names=("lhs", "rhs"))(
+        lambda lhs, rhs, _fn=fn: _fn(lhs, rhs).astype(lhs.dtype))
 
 
 _bcast_cmp("broadcast_equal", jnp.equal)

@@ -17,7 +17,14 @@ _f32 = jnp.float32
 
 
 def _unary(name, fn, differentiable=True, aliases=()):
-    register(name, differentiable=differentiable, aliases=aliases)(fn)
+    # explicit 1-arg wrapper: keeps arg_names() well-defined even for ufuncs
+    register(name, differentiable=differentiable, aliases=aliases,
+             arg_names=("data",))(lambda data, _fn=fn: _fn(data))
+
+
+def _binary(name, fn, differentiable=True, aliases=()):
+    register(name, differentiable=differentiable, aliases=aliases,
+             arg_names=("lhs", "rhs"))(lambda lhs, rhs, _fn=fn: _fn(lhs, rhs))
 
 
 # ---- unary math ------------------------------------------------------------
@@ -111,15 +118,15 @@ def _clip(x, a_min=None, a_max=None):
 
 # ---- binary (same-shape elementwise; XLA broadcasts anyway, MXNet requires
 # identical shapes for elemwise_* but numpy-broadcast here is a superset) ----
-_unary("elemwise_add", jnp.add, aliases=["_plus", "_add"])
-_unary("elemwise_sub", jnp.subtract, aliases=["_minus", "_sub"])
-_unary("elemwise_mul", jnp.multiply, aliases=["_mul"])
-_unary("elemwise_div", jnp.divide, aliases=["_div"])
-_unary("_power", jnp.power, aliases=["pow"])
-_unary("_maximum", jnp.maximum)
-_unary("_minimum", jnp.minimum)
-_unary("_hypot", jnp.hypot)
-_unary("_mod", jnp.mod, aliases=["mod"])
+_binary("elemwise_add", jnp.add, aliases=["_plus", "_add"])
+_binary("elemwise_sub", jnp.subtract, aliases=["_minus", "_sub"])
+_binary("elemwise_mul", jnp.multiply, aliases=["_mul"])
+_binary("elemwise_div", jnp.divide, aliases=["_div"])
+_binary("_power", jnp.power, aliases=["pow"])
+_binary("_maximum", jnp.maximum)
+_binary("_minimum", jnp.minimum)
+_binary("_hypot", jnp.hypot)
+_binary("_mod", jnp.mod, aliases=["mod"])
 
 
 @register("add_n", aliases=["ElementWiseSum", "_sum"])

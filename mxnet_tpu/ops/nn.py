@@ -130,7 +130,10 @@ def _pooling(data, kernel=(), pool_type="max", global_pool=False, stride=(), pad
         out = lax.reduce_window(data, 0.0, lax.add, window, strides, padding)
         if pool_type == "avg":
             if count_include_pad:
-                out = out / float(jnp.prod(jnp.asarray(kernel)))
+                denom = 1.0
+                for k in kernel:
+                    denom *= float(k)
+                out = out / denom
             else:
                 ones = jnp.ones_like(data)
                 cnt = lax.reduce_window(ones, 0.0, lax.add, window, strides, padding)

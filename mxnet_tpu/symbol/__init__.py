@@ -24,12 +24,14 @@ def _invoke_sym(op_name: str, sym_inputs: List[Symbol], kwargs: Dict[str, Any]) 
     name = kwargs.pop("name", None) or _auto_name(op_name)
     kwargs.pop("ctx", None)
 
-    # expand multi-output symbols for variadic ops; take output 0 otherwise
+    # variadic ops (Concat/add_n/stack: arg_names() None) consume every output
+    # of a multi-output input; fixed-arity ops take output 0 (NNVM behavior)
+    variadic = opdef.arg_names() is None
     entries = []
     for s in sym_inputs:
         if not isinstance(s, Symbol):
             raise MXNetError(f"{op_name}: expected Symbol input, got {type(s)}")
-        if len(s._outputs) > 1:
+        if len(s._outputs) > 1 and variadic:
             entries.extend(s._outputs)
         else:
             entries.append(s._outputs[0])
