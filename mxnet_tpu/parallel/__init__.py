@@ -1,0 +1,25 @@
+"""mxnet_tpu.parallel — SPMD parallelism over device meshes.
+
+The TPU-native expression of SURVEY.md §2.3's strategy inventory:
+
+=====================  ==============================================
+reference mechanism     here
+=====================  ==============================================
+KVStore local/device    DataParallelTrainer / bucketed_allreduce (psum on 'dp')
+KVStore dist_sync       same + jax.distributed multi-host mesh
+group2ctx model par.    shard_gluon_params / NamedSharding placement
+(absent) tensor par.    tensor_parallel.* (Megatron col/row split on 'tp')
+(absent) pipeline       pipeline.pipeline_apply (GPipe over 'pp')
+(absent) seq/context    ring_attention / ulysses_attention on 'sp'
+=====================  ==============================================
+"""
+from .mesh import (make_mesh, auto_mesh, local_mesh, replicated, shard_spec,
+                   Mesh, NamedSharding, PartitionSpec)
+from . import collectives
+from .collectives import psum_arrays, bucketed_allreduce
+from .data_parallel import DataParallelTrainer
+from .ring_attention import ring_attention, local_attention
+from .ulysses import ulysses_attention
+from . import tensor_parallel
+from .tensor_parallel import shard_gluon_params
+from .pipeline import pipeline_apply

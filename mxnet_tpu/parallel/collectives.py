@@ -1,0 +1,103 @@
+"""Collective primitives over ICI/DCN.
+
+TPU-native replacement for the reference's comm stack (SURVEY.md §5.8):
+ncclAllReduce/Bcast (kvstore_nccl.h:402,482), the CommDeviceTree spanning
+trees (comm_tree.h, gpu_topology.h), and ps-lite ZPush/ZPull all become XLA
+collectives on a named mesh axis. The topology-aware tree construction the
+reference builds by parsing PCIe/NVLink link matrices is XLA's job here —
+collectives ride the ICI torus with compiler-chosen algorithms.
+
+These wrappers are meant for use inside ``shard_map``-ed functions; outside,
+use ``psum_arrays`` which wraps its own shard_map.
+"""
+from __future__ import annotations
+
+import functools
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["allreduce", "allgather", "reduce_scatter", "broadcast", "ppermute",
+           "all_to_all", "psum_arrays", "cross_process_allreduce",
+           "bucketed_allreduce"]
+
+
+# ---- inside-shard_map primitives (thin, named-axis) -----------------------
+def allreduce(x, axis_name: str):
+    return lax.psum(x, axis_name)
+
+
+def allgather(x, axis_name: str, axis: int = 0, tiled: bool = True):
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis_name: str, axis: int = 0):
+    return lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=True)
+
+
+def broadcast(x, axis_name: str, src: int = 0):
+    idx = lax.axis_index(axis_name)
+    return jnp.where(idx == src, x, x)  # value already replicated post-psum
+
+
+def ppermute(x, axis_name: str, perm):
+    return lax.ppermute(x, axis_name, perm)
+
+
+def all_to_all(x, axis_name: str, split_axis: int, concat_axis: int):
+    return lax.all_to_all(x, axis_name, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=True)
+
+
+# ---- host-level helpers ----------------------------------------------------
+@functools.lru_cache(maxsize=64)
+def _psum_fn(mesh: Mesh, axis: str, n: int):
+    @functools.partial(shard_map, mesh=mesh,
+                       in_specs=tuple(P(axis) for _ in range(n)),
+                       out_specs=tuple(P(axis) for _ in range(n)))
+    def f(*xs):
+        return tuple(lax.psum(x, axis) for x in xs)
+
+    return jax.jit(f)
+
+
+def psum_arrays(arrays: Sequence, mesh: Mesh, axis: str = "dp") -> List:
+    """Allreduce a list of arrays sharded on ``axis`` (leading dim)."""
+    fn = _psum_fn(mesh, axis, len(arrays))
+    return list(fn(*arrays))
+
+
+def cross_process_allreduce(x):
+    """Sum an identical-shaped host-local array across processes (the
+    dist_sync push path). Uses a global 1-axis mesh over all devices."""
+    if jax.process_count() == 1:
+        return x
+    from jax.experimental import multihost_utils
+    return multihost_utils.process_allgather(x).sum(axis=0)
+
+
+def bucketed_allreduce(grads: List, mesh: Mesh, axis: str = "dp",
+                       bucket_bytes: int = 4 << 20) -> List:
+    """Bucket small gradients into fused allreduce dispatches, preserving
+    order so early (high-priority) buckets land first — the reference's
+    priority=-index comm overlap (model.py:150-160) and
+    MXNET_UPDATE_AGGREGATION_SIZE batching (kvstore_nccl.h)."""
+    out: List = [None] * len(grads)
+    bucket: List[int] = []
+    size = 0
+    for i, g in enumerate(grads):
+        bucket.append(i)
+        size += g.size * g.dtype.itemsize
+        if size >= bucket_bytes or i == len(grads) - 1:
+            reduced = psum_arrays([grads[j] for j in bucket], mesh, axis)
+            for j, r in zip(bucket, reduced):
+                out[j] = r
+            bucket, size = [], 0
+    return out

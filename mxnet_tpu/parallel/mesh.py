@@ -1,0 +1,66 @@
+"""Device mesh construction.
+
+The TPU-native substrate for every parallelism strategy in SURVEY.md §2.3:
+data parallel (the reference's KVStore tiers), tensor parallel (absent in the
+reference — first-class here), pipeline, and sequence/context parallel.
+Axis convention: ('dp', 'fsdp', 'tp', 'pp', 'sp', 'ep') — any subset.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = ["make_mesh", "auto_mesh", "local_mesh", "replicated", "shard_spec",
+           "Mesh", "NamedSharding", "PartitionSpec"]
+
+
+def make_mesh(axes: Dict[str, int], devices=None) -> Mesh:
+    """Build a Mesh from {axis_name: size}. Sizes must multiply to the device
+    count (use -1 once for 'the rest'). Axis order follows insertion order —
+    put the fastest-varying (highest-bandwidth, usually 'tp') LAST so it maps
+    to the innermost ICI ring."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    sizes = list(axes.values())
+    if sizes.count(-1) > 1:
+        raise ValueError("only one axis may be -1")
+    known = int(np.prod([s for s in sizes if s != -1]))
+    if -1 in sizes:
+        sizes[sizes.index(-1)] = n // known
+    if int(np.prod(sizes)) != n:
+        raise ValueError(f"mesh {dict(zip(axes, sizes))} does not cover "
+                         f"{n} devices")
+    arr = np.asarray(devices).reshape(sizes)
+    return Mesh(arr, tuple(axes.keys()))
+
+
+def auto_mesh(dp: int = -1, tp: int = 1, pp: int = 1, sp: int = 1,
+              devices=None) -> Mesh:
+    """Common 4-axis mesh with dp inferred."""
+    axes = {}
+    if pp != 1:
+        axes["pp"] = pp
+    axes["dp"] = dp
+    if sp != 1:
+        axes["sp"] = sp
+    if tp != 1:
+        axes["tp"] = tp
+    if "pp" not in axes:
+        axes.setdefault("dp", -1)
+    return make_mesh(axes, devices)
+
+
+def local_mesh(axis: str = "dp", devices=None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    return Mesh(np.asarray(devices), (axis,))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def shard_spec(mesh: Mesh, *axes: Optional[str]) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec(*axes))

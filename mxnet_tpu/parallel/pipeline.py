@@ -1,0 +1,76 @@
+"""Pipeline parallelism over the 'pp' mesh axis.
+
+Absent from the reference (SURVEY.md §2.3: "nearest: DAG-level
+auto-parallelism"); built first-class here. GPipe-style schedule expressed
+the SPMD way: every device holds ONE stage's parameters (stacked arrays
+sharded on their leading 'stage' dim); a ``lax.fori_loop`` runs
+n_micro + n_stages - 1 ticks in which each device applies its stage to the
+activation it holds and ``ppermute``s the result to the next device.
+Bubble fraction = (n-1)/(m+n-1), as usual — choose n_micro accordingly.
+
+Constraint (same as scan-based pipelining generally): all stages share one
+activation shape, e.g. a stack of identical transformer/MLP blocks.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+__all__ = ["pipeline_apply"]
+
+
+def pipeline_apply(stage_fn: Callable, stacked_params, x_microbatches,
+                   mesh: Mesh, axis: str = "pp"):
+    """Run ``stage_fn(params_i, x) -> x`` over n_stages = mesh[axis] stages.
+
+    stacked_params: pytree whose leaves have leading dim n_stages (sharded on
+    ``axis``). x_microbatches: (n_micro, *batch_shape) replicated input; the
+    return is (n_micro, *batch_shape) of the final stage's outputs.
+    """
+    n_stages = mesh.shape[axis]
+    n_micro = x_microbatches.shape[0]
+    total = n_micro + n_stages - 1
+
+    def local(params_stacked, xs):
+        # params_stacked leaves: (1, ...) local slice -> squeeze stage dim
+        params = jax.tree_util.tree_map(lambda a: a[0], params_stacked)
+        rank = lax.axis_index(axis)
+        from .ring_attention import _pvary
+        state = _pvary(jnp.zeros_like(xs[0]), axis)  # activation currently held
+        outs = _pvary(jnp.zeros_like(xs), axis)
+        fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+        def tick(t, carry):
+            state, outs = carry
+            # stage 0 ingests microbatch t (if any remain)
+            feed = xs[jnp.minimum(t, n_micro - 1)]
+            state = jnp.where(rank == 0, feed, state)
+            new_state = stage_fn(params, state)
+            # last stage emits result of microbatch t - (n_stages - 1)
+            out_idx = t - (n_stages - 1)
+            emit = jnp.logical_and(rank == n_stages - 1, out_idx >= 0)
+            slot = jnp.maximum(out_idx, 0)
+            outs = outs.at[slot].set(jnp.where(emit, new_state, outs[slot]))
+            state = lax.ppermute(new_state, axis, fwd_perm)
+            return state, outs
+
+        state, outs = lax.fori_loop(0, total, tick, (state, outs))
+        # only the last rank's outs are real; broadcast them
+        outs = lax.psum(jnp.where(rank == n_stages - 1, outs, 0.0), axis)
+        return outs
+
+    fn = shard_map(local, mesh=mesh,
+                   in_specs=(jax.tree_util.tree_map(lambda _: P(axis), stacked_params,
+                                                    is_leaf=lambda l: hasattr(l, "shape")),
+                             P()),
+                   out_specs=P())
+    return fn(stacked_params, x_microbatches)

@@ -1,0 +1,110 @@
+"""Ring attention — sequence/context parallelism over the 'sp' mesh axis.
+
+The reference predates attention entirely (SURVEY.md §5.7: its long-sequence
+story is bucketing + fused cuDNN RNN); this module is the long-context
+capability the north star requires as first-class. Design follows the
+blockwise/ring formulation (Liu et al.; see PAPERS.md): each device holds a
+sequence shard of Q, K, V; K/V blocks rotate around the ICI ring via
+``ppermute`` while each device accumulates its Q-shard's attention with an
+online (log-sum-exp) softmax — memory O(T/n · T/n), full overlap of compute
+with neighbor transfers.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["ring_attention", "local_attention", "ring_attention_sharded"]
+
+
+def _pvary(x, axis_name):
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, (axis_name,), to="varying")
+    return lax.pvary(x, (axis_name,))
+
+
+def local_attention(q, k, v, causal: bool = False, scale: Optional[float] = None,
+                    q_offset: int = 0, k_offset: int = 0):
+    """Plain single-device attention; q,k,v: (B, H, T, D)."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        qpos = jnp.arange(q.shape[2]) + q_offset
+        kpos = jnp.arange(k.shape[2]) + k_offset
+        mask = qpos[:, None] >= kpos[None, :]
+        scores = jnp.where(mask, scores, -jnp.inf)
+    return jax.nn.softmax(scores, axis=-1) @ v
+
+
+def _ring_attention_local(q, k, v, axis_name: str, causal: bool,
+                          scale: Optional[float]):
+    """Runs inside shard_map. q,k,v: (B, H, Tq_local, D) on each device."""
+    n = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    sc = scale if scale is not None else 1.0 / (D ** 0.5)
+    perm = [(i, (i + 1) % n) for i in range(n)]  # pass kv to the next rank
+
+    acc0 = jnp.zeros((B, H, Tq, D), jnp.float32)
+    m0 = jnp.full((B, H, Tq), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((B, H, Tq), jnp.float32)
+    # constants start 'unvarying' over the manual axis; the loop carry becomes
+    # varying after the first iteration — pre-cast so types line up (jax vma)
+    acc0, m0, l0 = (_pvary(x, axis_name) for x in (acc0, m0, l0))
+
+    def body(i, carry):
+        acc, m, l, k_blk, v_blk = carry
+        src = (my - i) % n  # whose kv shard we hold this tick
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk).astype(jnp.float32) * sc
+        if causal:
+            qpos = jnp.arange(Tq) + my * Tq
+            kpos = jnp.arange(Tk) + src * Tk
+            mask = qpos[:, None] >= kpos[None, :]
+            scores = jnp.where(mask, scores, -jnp.inf)
+        blk_max = jnp.max(scores, axis=-1)
+        m_new = jnp.maximum(m, blk_max)
+        # guard fully-masked blocks (exp(-inf - -inf))
+        safe_m = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+        p = jnp.exp(scores - safe_m[..., None])
+        p = jnp.where(jnp.isneginf(scores), 0.0, p)
+        corr = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - safe_m))
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        acc_new = acc * corr[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_blk.astype(jnp.float32))
+        k_next = lax.ppermute(k_blk, axis_name, perm)
+        v_next = lax.ppermute(v_blk, axis_name, perm)
+        return acc_new, m_new, l_new, k_next, v_next
+
+    acc, m, l, _, _ = lax.fori_loop(0, n, body, (acc0, m0, l0, k, v))
+    out = acc / jnp.maximum(l[..., None], 1e-30)
+    return out.astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp", causal: bool = False,
+                   scale: Optional[float] = None):
+    """Global-array entry: q,k,v (B, H, T, D) with T sharded over ``axis``."""
+    fn = shard_map(
+        functools.partial(_ring_attention_local, axis_name=axis, causal=causal,
+                          scale=scale),
+        mesh=mesh,
+        in_specs=(P(None, None, axis, None),) * 3,
+        out_specs=P(None, None, axis, None))
+    return fn(q, k, v)
+
+
+def ring_attention_sharded(axis: str = "sp", causal: bool = False,
+                           scale: Optional[float] = None):
+    """For composition inside an existing shard_map region."""
+    return functools.partial(_ring_attention_local, axis_name=axis,
+                             causal=causal, scale=scale)
