@@ -1,0 +1,7 @@
+"""``mx.io`` — data iterators (reference: ``python/mxnet/io/io.py`` + the C++
+iterators in ``src/io/``)."""
+from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter,
+                 PrefetchingIter, CSVIter, ImageRecordIter, MNISTIter)
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
+           "PrefetchingIter", "CSVIter", "ImageRecordIter", "MNISTIter"]

@@ -1,0 +1,504 @@
+"""Data iterators.
+
+Reference parity: ``python/mxnet/io/io.py`` (DataIter/DataBatch/DataDesc,
+NDArrayIter :580+, ResizeIter, PrefetchingIter) and the registered C++
+iterators of ``src/io/`` (ImageRecordIter — iter_image_recordio_2.cc —, CSV,
+MNIST). The decode pipeline (RecordIO chunk read → parallel JPEG decode →
+augment → batch → prefetch) runs on host threads feeding device uploads; the
+C++ fast reader in mxnet_tpu/native accelerates the chunk/parse stage.
+"""
+from __future__ import annotations
+
+import os
+import queue
+import struct
+import threading
+from collections import namedtuple
+from typing import Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .. import ndarray as nd
+from ..base import MXNetError
+from ..ndarray import NDArray
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
+           "PrefetchingIter", "CSVIter", "ImageRecordIter", "MNISTIter"]
+
+
+class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
+    def __new__(cls, name, shape, dtype=np.float32, layout="NCHW"):
+        ret = super().__new__(cls, name, shape)
+        ret.dtype = dtype
+        ret.layout = layout
+        return ret
+
+    @staticmethod
+    def get_batch_axis(layout):
+        if layout is None:
+            return 0
+        return layout.find("N")
+
+
+class DataBatch:
+    def __init__(self, data, label=None, pad=None, index=None,
+                 bucket_key=None, provide_data=None, provide_label=None):
+        if data is not None and not isinstance(data, (list, tuple)):
+            data = [data]
+        if label is not None and not isinstance(label, (list, tuple)):
+            label = [label]
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.bucket_key = bucket_key
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+
+class DataIter:
+    """Iterator base (reference io.py:DataIter)."""
+
+    def __init__(self, batch_size: int = 0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self) -> DataBatch:
+        if self.iter_next():
+            return DataBatch(data=self.getdata(), label=self.getlabel(),
+                             pad=self.getpad(), index=self.getindex())
+        raise StopIteration
+
+    def __next__(self):
+        return self.next()
+
+    def iter_next(self) -> bool:
+        raise NotImplementedError
+
+    def getdata(self):
+        raise NotImplementedError
+
+    def getlabel(self):
+        raise NotImplementedError
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        return 0
+
+
+def _init_data(data, allow_empty, default_name):
+    if data is None:
+        if not allow_empty:
+            raise MXNetError("data cannot be None")
+        return []
+    if isinstance(data, (np.ndarray, NDArray)):
+        data = [data]
+    if isinstance(data, (list, tuple)):
+        if not allow_empty and len(data) == 0:
+            raise MXNetError("data cannot be empty")
+        if len(data) == 1:
+            data = {default_name: data[0]}
+        else:
+            data = {f"_{i}_{default_name}": d for i, d in enumerate(data)}
+    if not isinstance(data, dict):
+        raise MXNetError("data must be NDArray, numpy array, list or dict")
+    out = []
+    for k, v in data.items():
+        if not isinstance(v, NDArray):
+            v = nd.array(np.asarray(v))
+        out.append((k, v))
+    return out
+
+
+class NDArrayIter(DataIter):
+    """In-memory iterator with pad/discard/roll_over last-batch handling
+    (reference io.py:NDArrayIter)."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data", label_name="softmax_label"):
+        super().__init__(batch_size)
+        self.data = _init_data(data, False, data_name)
+        self.label = _init_data(label, True, label_name)
+        self.num_data = self.data[0][1].shape[0]
+        self.cursor = -batch_size
+        self.shuffle = shuffle
+        self.last_batch_handle = last_batch_handle
+        self.idx = np.arange(self.num_data)
+        self._cache_data = None
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(k, (self.batch_size,) + tuple(v.shape[1:]), v.dtype)
+                for k, v in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(k, (self.batch_size,) + tuple(v.shape[1:]), v.dtype)
+                for k, v in self.label]
+
+    def reset(self):
+        if self.shuffle:
+            np.random.shuffle(self.idx)
+        if self.last_batch_handle == "roll_over" and \
+                -self.batch_size < self.cursor < self.num_data:
+            self.cursor = -self.batch_size + (self.cursor % self.num_data) % \
+                self.batch_size
+        else:
+            self.cursor = -self.batch_size
+
+    def iter_next(self) -> bool:
+        self.cursor += self.batch_size
+        if self.last_batch_handle == "discard":
+            return self.cursor + self.batch_size <= self.num_data
+        return self.cursor < self.num_data
+
+    def _slice(self, arrays):
+        out = []
+        for k, v in arrays:
+            take = self.idx[max(self.cursor, 0):self.cursor + self.batch_size]
+            chunk = v.asnumpy()[take]
+            if chunk.shape[0] < self.batch_size:
+                if self.last_batch_handle == "pad":
+                    extra = self.idx[:self.batch_size - chunk.shape[0]]
+                    chunk = np.concatenate([chunk, v.asnumpy()[extra]], axis=0)
+            out.append(nd.array(chunk, dtype=str(v.dtype)))
+        return out
+
+    def getdata(self):
+        return self._slice(self.data)
+
+    def getlabel(self):
+        return self._slice(self.label)
+
+    def getpad(self) -> int:
+        if self.last_batch_handle == "pad" and \
+                self.cursor + self.batch_size > self.num_data:
+            return self.cursor + self.batch_size - self.num_data
+        return 0
+
+
+class ResizeIter(DataIter):
+    """Fix the epoch size of an underlying iterator (reference ResizeIter)."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__(data_iter.batch_size)
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+        self.current_batch = None
+        for attr in ("provide_data", "provide_label", "default_bucket_key"):
+            if hasattr(data_iter, attr):
+                setattr(self, attr, getattr(data_iter, attr))
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def iter_next(self):
+        if self.cur == self.size:
+            return False
+        try:
+            self.current_batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            self.current_batch = self.data_iter.next()
+        self.cur += 1
+        return True
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getindex(self):
+        return self.current_batch.index
+
+    def getpad(self):
+        return self.current_batch.pad
+
+
+class PrefetchingIter(DataIter):
+    """Thread-prefetched composition of iterators (reference PrefetchingIter;
+    the dmlc ThreadedIter equivalent)."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None):
+        if not isinstance(iters, list):
+            iters = [iters]
+        super().__init__(iters[0].batch_size)
+        self.iters = iters
+        self.rename_data = rename_data
+        self.rename_label = rename_label
+        self._queue: "queue.Queue" = queue.Queue(maxsize=4)
+        self._stop = threading.Event()
+        self._thread = None
+        self._start()
+
+    @property
+    def provide_data(self):
+        out = []
+        for i, it in enumerate(self.iters):
+            for d in it.provide_data:
+                name = (self.rename_data[i][d.name]
+                        if self.rename_data else d.name)
+                out.append(DataDesc(name, d.shape, d.dtype))
+        return out
+
+    @property
+    def provide_label(self):
+        out = []
+        for i, it in enumerate(self.iters):
+            for d in it.provide_label:
+                name = (self.rename_label[i][d.name]
+                        if self.rename_label else d.name)
+                out.append(DataDesc(name, d.shape, d.dtype))
+        return out
+
+    def _producer(self):
+        try:
+            while not self._stop.is_set():
+                try:
+                    batches = [it.next() for it in self.iters]
+                except StopIteration:
+                    self._queue.put(None)
+                    return
+                self._queue.put(batches)
+        except Exception as e:  # surface errors at the consumer
+            self._queue.put(e)
+
+    def _start(self):
+        self._thread = threading.Thread(target=self._producer, daemon=True)
+        self._thread.start()
+
+    def reset(self):
+        self._stop.set()
+        try:
+            while True:
+                self._queue.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=5)
+        for it in self.iters:
+            it.reset()
+        self._stop = threading.Event()
+        self._queue = queue.Queue(maxsize=4)
+        self._start()
+
+    def next(self):
+        item = self._queue.get()
+        if item is None:
+            raise StopIteration
+        if isinstance(item, Exception):
+            raise item
+        batches = item
+        data = [d for b in batches for d in b.data]
+        label = [l for b in batches for l in (b.label or [])]
+        return DataBatch(data=data, label=label, pad=batches[0].pad,
+                         index=batches[0].index)
+
+    def iter_next(self):
+        raise MXNetError("use next() on PrefetchingIter")
+
+
+class CSVIter(DataIter):
+    """CSV reader (reference src/io/iter_csv.cc)."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
+                 batch_size=1, round_batch=True, dtype="float32", **kwargs):
+        super().__init__(batch_size)
+        data = np.loadtxt(data_csv, delimiter=",", dtype=dtype, ndmin=2)
+        data = data.reshape((-1,) + tuple(data_shape))
+        label = None
+        if label_csv is not None:
+            label = np.loadtxt(label_csv, delimiter=",", dtype=dtype, ndmin=2)
+            label = label.reshape((-1,) + tuple(label_shape))
+            if label.shape[-1] == 1:
+                label = label.reshape(label.shape[:-1])
+        self._inner = NDArrayIter(data, label, batch_size,
+                                  last_batch_handle="pad" if round_batch else
+                                  "discard")
+        self.provide_data = self._inner.provide_data
+        self.provide_label = self._inner.provide_label
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+    def iter_next(self):
+        return self._inner.iter_next()
+
+
+class MNISTIter(DataIter):
+    """MNIST idx-format iterator (reference src/io/iter_mnist.cc)."""
+
+    def __init__(self, image, label, batch_size=128, shuffle=True, flat=False,
+                 silent=False, seed=None, **kwargs):
+        super().__init__(batch_size)
+        import gzip
+
+        def _read(path, is_img):
+            opener = gzip.open if path.endswith(".gz") else open
+            with opener(path, "rb") as f:
+                if is_img:
+                    _, num, rows, cols = struct.unpack(">IIII", f.read(16))
+                    arr = np.frombuffer(f.read(), dtype=np.uint8)
+                    return arr.reshape(num, 1, rows, cols).astype("float32") / 255.0
+                struct.unpack(">II", f.read(8))
+                return np.frombuffer(f.read(), dtype=np.uint8).astype("float32")
+
+        data = _read(image, True)
+        lbl = _read(label, False)
+        if flat:
+            data = data.reshape(data.shape[0], -1)
+        self._inner = NDArrayIter(data, lbl, batch_size, shuffle=shuffle,
+                                  last_batch_handle="discard")
+        self.provide_data = self._inner.provide_data
+        self.provide_label = self._inner.provide_label
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+    def iter_next(self):
+        return self._inner.iter_next()
+
+
+class ImageRecordIter(DataIter):
+    """RecordIO image iterator with augmentation + threaded decode
+    (reference src/io/iter_image_recordio_2.cc: chunk read → OMP JPEG decode
+    → augment → batch → prefetch; here a thread pool decodes with
+    PIL/libjpeg-turbo which releases the GIL)."""
+
+    def __init__(self, path_imgrec, data_shape, batch_size, path_imgidx=None,
+                 label_width=1, shuffle=False, mean_r=0.0, mean_g=0.0, mean_b=0.0,
+                 std_r=1.0, std_g=1.0, std_b=1.0, scale=1.0, rand_crop=False,
+                 rand_mirror=False, resize=-1, data_name="data",
+                 label_name="softmax_label", preprocess_threads=4,
+                 round_batch=True, seed=None, **kwargs):
+        super().__init__(batch_size)
+        from .. import recordio as rio
+        self._rio = rio
+        self.path_imgrec = path_imgrec
+        idx_path = path_imgidx or os.path.splitext(path_imgrec)[0] + ".idx"
+        if os.path.isfile(idx_path):
+            self._rec = rio.MXIndexedRecordIO(idx_path, path_imgrec, "r")
+            self._keys = list(self._rec.keys)
+        else:
+            self._rec = rio.MXRecordIO(path_imgrec, "r")
+            self._keys = None
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.shuffle = shuffle
+        self.rand_crop = rand_crop
+        self.rand_mirror = rand_mirror
+        self.resize = resize
+        self.scale = scale
+        self.mean = np.array([mean_r, mean_g, mean_b], dtype="float32")
+        self.std = np.array([std_r, std_g, std_b], dtype="float32")
+        self._threads = max(1, preprocess_threads)
+        self.data_name = data_name
+        self.label_name = label_name
+        self._order = None
+        self._pos = 0
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(self.data_name, (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        shape = (self.batch_size,) if self.label_width == 1 else \
+            (self.batch_size, self.label_width)
+        return [DataDesc(self.label_name, shape)]
+
+    def reset(self):
+        self._pos = 0
+        if self._keys is not None:
+            self._order = list(self._keys)
+            if self.shuffle:
+                np.random.shuffle(self._order)
+        else:
+            self._rec.reset()
+
+    def _decode_one(self, raw):
+        header, img = self._rio.unpack_img(raw, iscolor=1)
+        if self.resize > 0:
+            from PIL import Image
+            import io as _io
+            h, w = img.shape[:2]
+            short = min(h, w)
+            ratio = self.resize / short
+            img = np.asarray(Image.fromarray(img).resize(
+                (int(w * ratio), int(h * ratio))))
+        c, th, tw = self.data_shape
+        h, w = img.shape[:2]
+        if h < th or w < tw:
+            from PIL import Image
+            img = np.asarray(Image.fromarray(img).resize((max(tw, w), max(th, h))))
+            h, w = img.shape[:2]
+        if self.rand_crop:
+            y0 = np.random.randint(0, h - th + 1)
+            x0 = np.random.randint(0, w - tw + 1)
+        else:
+            y0 = (h - th) // 2
+            x0 = (w - tw) // 2
+        img = img[y0:y0 + th, x0:x0 + tw]
+        if self.rand_mirror and np.random.rand() < 0.5:
+            img = img[:, ::-1]
+        chw = img.astype("float32").transpose(2, 0, 1)
+        chw = (chw * self.scale - self.mean[:, None, None]) / self.std[:, None, None]
+        label = header.label
+        if isinstance(label, np.ndarray) and self.label_width == 1:
+            label = float(label[0])
+        return chw, label
+
+    def _read_raw(self):
+        if self._keys is not None:
+            if self._pos >= len(self._order):
+                return None
+            raw = self._rec.read_idx(self._order[self._pos])
+        else:
+            raw = self._rec.read()
+        self._pos += 1
+        return raw
+
+    def next(self) -> DataBatch:
+        from concurrent.futures import ThreadPoolExecutor
+        raws = []
+        for _ in range(self.batch_size):
+            raw = self._read_raw()
+            if raw is None:
+                break
+            raws.append(raw)
+        if not raws:
+            raise StopIteration
+        pad = self.batch_size - len(raws)
+        if self._threads > 1 and len(raws) > 1:
+            with ThreadPoolExecutor(max_workers=self._threads) as pool:
+                decoded = list(pool.map(self._decode_one, raws))
+        else:
+            decoded = [self._decode_one(r) for r in raws]
+        data = np.stack([d for d, _ in decoded])
+        labels = np.asarray([l for _, l in decoded], dtype="float32")
+        if pad:
+            data = np.concatenate([data, np.repeat(data[:1], pad, axis=0)])
+            labels = np.concatenate([labels, np.repeat(labels[:1], pad, axis=0)])
+        return DataBatch(data=[nd.array(data)], label=[nd.array(labels)], pad=pad)
+
+    def iter_next(self):
+        raise MXNetError("use next()")

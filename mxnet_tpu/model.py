@@ -1,0 +1,44 @@
+"""Checkpoint helpers (reference: ``python/mxnet/model.py`` —
+save_checkpoint/load_checkpoint :388-418; the FeedForward legacy class is
+superseded by Module/Gluon and intentionally not reproduced).
+"""
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+from . import ndarray as nd
+from . import symbol as sym_mod
+from .ndarray import NDArray
+
+__all__ = ["save_checkpoint", "load_checkpoint", "load_params", "BatchEndParam"]
+
+from .module.base_module import BatchEndParam  # re-export for parity
+
+
+def save_checkpoint(prefix: str, epoch: int, symbol, arg_params: Dict[str, NDArray],
+                    aux_params: Dict[str, NDArray], remove_amp_cast: bool = True):
+    """Write ``prefix-symbol.json`` + ``prefix-%04d.params``
+    (two-artifact format, reference model.py:388)."""
+    if symbol is not None:
+        symbol.save(f"{prefix}-symbol.json")
+    save_dict = {f"arg:{k}": v for k, v in arg_params.items()}
+    save_dict.update({f"aux:{k}": v for k, v in aux_params.items()})
+    nd.save(f"{prefix}-{epoch:04d}.params", save_dict)
+
+
+def load_params(prefix: str, epoch: int):
+    save_dict = nd.load(f"{prefix}-{epoch:04d}.params")
+    arg_params, aux_params = {}, {}
+    for k, v in save_dict.items():
+        tp, name = k.split(":", 1)
+        if tp == "arg":
+            arg_params[name] = v
+        elif tp == "aux":
+            aux_params[name] = v
+    return arg_params, aux_params
+
+
+def load_checkpoint(prefix: str, epoch: int):
+    symbol = sym_mod.load(f"{prefix}-symbol.json")
+    arg_params, aux_params = load_params(prefix, epoch)
+    return symbol, arg_params, aux_params

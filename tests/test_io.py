@@ -1,0 +1,135 @@
+"""IO tests (reference: tests/python/unittest/test_io.py, test_recordio)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, recordio
+from mxnet_tpu.io import (NDArrayIter, DataBatch, ResizeIter, PrefetchingIter,
+                          CSVIter, ImageRecordIter)
+
+
+def test_ndarray_iter_basic(rng):
+    data = rng.randn(29, 3).astype("float32")
+    label = rng.randint(0, 5, 29).astype("float32")
+    it = NDArrayIter(data, label, batch_size=8, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 4
+    assert batches[0].data[0].shape == (8, 3)
+    assert batches[-1].pad == 3
+    # discard mode drops the last partial batch
+    it2 = NDArrayIter(data, label, batch_size=8, last_batch_handle="discard")
+    assert len(list(it2)) == 3
+    # reset reuses the iterator
+    it.reset()
+    assert len(list(it)) == 4
+
+
+def test_ndarray_iter_shuffle_and_dict(rng):
+    data = {"a": rng.randn(10, 2).astype("float32"),
+            "b": rng.randn(10, 4).astype("float32")}
+    it = NDArrayIter(data, None, batch_size=5, shuffle=True)
+    batch = next(it)
+    assert len(batch.data) == 2
+    names = [d.name for d in it.provide_data]
+    assert set(names) == {"a", "b"}
+
+
+def test_resize_iter(rng):
+    data = rng.randn(8, 2).astype("float32")
+    base = NDArrayIter(data, None, batch_size=4)
+    it = ResizeIter(base, size=5)
+    assert len(list(it)) == 5
+
+
+def test_prefetching_iter(rng):
+    data = rng.randn(16, 2).astype("float32")
+    base = NDArrayIter(data, None, batch_size=4)
+    it = PrefetchingIter(base)
+    n = 0
+    for batch in it:
+        n += 1
+        assert batch.data[0].shape == (4, 2)
+    assert n == 4
+    it.reset()
+    assert sum(1 for _ in it) == 4
+
+
+def test_recordio_roundtrip(tmp_path):
+    path = str(tmp_path / "test.rec")
+    writer = recordio.MXRecordIO(path, "w")
+    for i in range(5):
+        writer.write(f"record-{i}".encode() * (i + 1))
+    writer.close()
+    reader = recordio.MXRecordIO(path, "r")
+    for i in range(5):
+        assert reader.read() == f"record-{i}".encode() * (i + 1)
+    assert reader.read() is None
+    reader.close()
+
+
+def test_indexed_recordio(tmp_path):
+    rec_path = str(tmp_path / "t.rec")
+    idx_path = str(tmp_path / "t.idx")
+    w = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    for i in range(10):
+        w.write_idx(i, f"payload{i}".encode())
+    w.close()
+    r = recordio.MXIndexedRecordIO(idx_path, rec_path, "r")
+    assert r.keys == list(range(10))
+    assert r.read_idx(7) == b"payload7"
+    assert r.read_idx(2) == b"payload2"
+    r.close()
+
+
+def test_pack_unpack_header():
+    h = recordio.IRHeader(0, 3.0, 42, 0)
+    s = recordio.pack(h, b"data!")
+    h2, payload = recordio.unpack(s)
+    assert payload == b"data!"
+    assert h2.label == 3.0
+    assert h2.id == 42
+    # multi-label
+    h3 = recordio.IRHeader(0, np.array([1.0, 2.0, 3.0], dtype="float32"), 7, 0)
+    s3 = recordio.pack(h3, b"x")
+    h4, p4 = recordio.unpack(s3)
+    assert h4.flag == 3
+    np.testing.assert_allclose(np.asarray(h4.label), [1, 2, 3])
+
+
+def test_pack_img_and_image_record_iter(tmp_path, rng):
+    rec_path = str(tmp_path / "img.rec")
+    idx_path = str(tmp_path / "img.idx")
+    w = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    for i in range(12):
+        img = (rng.rand(24, 24, 3) * 255).astype("uint8")
+        header = recordio.IRHeader(0, float(i % 3), i, 0)
+        w.write_idx(i, recordio.pack_img(header, img, img_fmt=".png"))
+    w.close()
+
+    it = ImageRecordIter(path_imgrec=rec_path, path_imgidx=idx_path,
+                         data_shape=(3, 16, 16), batch_size=4,
+                         preprocess_threads=2)
+    n = 0
+    for batch in it:
+        assert batch.data[0].shape == (4, 3, 16, 16)
+        assert batch.label[0].shape == (4,)
+        n += 1
+    assert n == 3
+    it.reset()
+    assert sum(1 for _ in it) == 3
+
+
+def test_csv_iter(tmp_path, rng):
+    data = rng.randn(10, 4).astype("float32")
+    labels = rng.randint(0, 2, 10).astype("float32")
+    dpath = str(tmp_path / "d.csv")
+    lpath = str(tmp_path / "l.csv")
+    np.savetxt(dpath, data, delimiter=",")
+    np.savetxt(lpath, labels, delimiter=",")
+    it = CSVIter(data_csv=dpath, data_shape=(4,), label_csv=lpath,
+                 batch_size=5)
+    batches = list(it)
+    assert len(batches) == 2
+    np.testing.assert_allclose(batches[0].data[0].asnumpy(), data[:5], rtol=1e-5)

@@ -1,0 +1,117 @@
+"""Module API tests (reference: tests/python/unittest/test_module.py +
+tests/python/train convergence checks)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.io import NDArrayIter, DataBatch
+from mxnet_tpu.module import Module, BucketingModule
+
+
+def _mlp_sym(num_hidden=16, classes=4):
+    data = sym.Variable("data")
+    fc1 = sym.FullyConnected(data, num_hidden=num_hidden, name="fc1")
+    act = sym.Activation(fc1, act_type="relu")
+    fc2 = sym.FullyConnected(act, num_hidden=classes, name="fc2")
+    return sym.SoftmaxOutput(fc2, sym.Variable("softmax_label"), name="softmax")
+
+
+def _toy_data(rng, n=64, d=10, classes=4):
+    x = rng.randn(n, d).astype("float32")
+    w = rng.randn(d, classes).astype("float32")
+    y = (x @ w).argmax(axis=1).astype("float32")
+    return x, y
+
+
+def test_module_fit_converges(rng):
+    x, y = _toy_data(rng)
+    train = NDArrayIter(x, y, batch_size=16, shuffle=True)
+    mod = Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(train, num_epoch=12, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5},
+            initializer=mx.init.Xavier(), kvstore=None)
+    score = mod.score(NDArrayIter(x, y, batch_size=16), "acc")
+    assert dict(score)["accuracy"] > 0.8
+
+
+def test_module_forward_backward_api(rng):
+    mod = Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (8, 10))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params(initializer=mx.init.Xavier())
+    mod.init_optimizer(kvstore="local", optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    x, y = _toy_data(rng, n=8)
+    batch = DataBatch(data=[nd.array(x)], label=[nd.array(y)])
+    mod.forward_backward(batch)
+    before = mod.get_params()[0]["fc1_weight"].asnumpy().copy()
+    mod.update()
+    after = mod.get_params()[0]["fc1_weight"].asnumpy()
+    assert not np.allclose(before, after)
+    outs = mod.get_outputs()
+    assert outs[0].shape == (8, 4)
+
+
+def test_module_predict(rng):
+    x, y = _toy_data(rng, n=32)
+    mod = Module(_mlp_sym(), context=mx.cpu())
+    it = NDArrayIter(x, y, batch_size=8)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    pred = mod.predict(it)
+    assert pred.shape == (32, 4)
+
+
+def test_module_checkpoint(tmp_path, rng):
+    x, y = _toy_data(rng)
+    mod = Module(_mlp_sym(), context=mx.cpu())
+    it = NDArrayIter(x, y, batch_size=16)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(initializer=mx.init.Xavier())
+    mod.init_optimizer(kvstore=None)
+    prefix = str(tmp_path / "model")
+    mod.save_checkpoint(prefix, 3)
+
+    mod2 = Module.load(prefix, 3, context=mx.cpu())
+    mod2.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod2.init_params()
+    p1 = mod.get_params()[0]["fc1_weight"].asnumpy()
+    p2 = mod2.get_params()[0]["fc1_weight"].asnumpy()
+    np.testing.assert_allclose(p1, p2, rtol=1e-6)
+
+
+def test_bucketing_module(rng):
+    """Variable-length bucketing (reference test_bucketing.py): one executable
+    per bucket, parameters shared."""
+
+    def sym_gen(seq_len):
+        data = sym.Variable("data")  # (batch, seq_len, feat)
+        pooled = sym.mean(data, axis=1)  # length-invariant -> shared weights
+        fc = sym.FullyConnected(pooled, num_hidden=8, name="fc_shared")
+        out = sym.SoftmaxOutput(fc, sym.Variable("softmax_label"), name="softmax")
+        return out, ("data",), ("softmax_label",)
+
+    bm = BucketingModule(sym_gen, default_bucket_key=20, context=mx.cpu())
+    from mxnet_tpu.io.io import DataDesc
+    bm.bind(data_shapes=[DataDesc("data", (4, 20, 6))],
+            label_shapes=[DataDesc("softmax_label", (4,))])
+    bm.init_params(initializer=mx.init.Xavier())
+    bm.init_optimizer(kvstore=None, optimizer="sgd",
+                      optimizer_params={"learning_rate": 0.1})
+
+    for seq_len in (20, 10, 20, 10):
+        x = rng.randn(4, seq_len, 6).astype("float32")
+        y = rng.randint(0, 8, 4).astype("float32")
+        batch = DataBatch(data=[nd.array(x)], label=[nd.array(y)],
+                          bucket_key=seq_len,
+                          provide_data=[DataDesc("data", (4, seq_len, 6))],
+                          provide_label=[DataDesc("softmax_label", (4,))])
+        bm.forward(batch, is_train=True)
+        bm.backward()
+        bm.update()
+        assert bm.get_outputs()[0].shape == (4, 8)
+    # parameter arrays shared across buckets
+    m20 = bm._buckets[20]._exec_group.execs[0]
+    m10 = bm._buckets[10]._exec_group.execs[0]
+    assert m20.arg_dict["fc_shared_bias"] is m10.arg_dict["fc_shared_bias"]
