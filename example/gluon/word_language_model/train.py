@@ -1,0 +1,141 @@
+#!/usr/bin/env python
+"""LSTM word language model (reference: example/gluon/word_language_model).
+North-star config #3: the imperative NDArray/hybrid LSTM path on PTB-style
+data. Loads a text file if given, else generates a synthetic corpus.
+"""
+import argparse
+import math
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..", ".."))
+
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, autograd
+from mxnet_tpu.gluon import nn, rnn
+
+
+class RNNModel(gluon.Block):
+    """Embedding → LSTM → Dense decoder (reference model.py:RNNModel)."""
+
+    def __init__(self, mode, vocab_size, num_embed, num_hidden, num_layers,
+                 dropout=0.5, tie_weights=False, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.drop = nn.Dropout(dropout)
+            self.encoder = nn.Embedding(vocab_size, num_embed,
+                                        weight_initializer=mx.init.Uniform(0.1))
+            if mode == "lstm":
+                self.rnn = rnn.LSTM(num_hidden, num_layers, dropout=dropout,
+                                    input_size=num_embed)
+            elif mode == "gru":
+                self.rnn = rnn.GRU(num_hidden, num_layers, dropout=dropout,
+                                   input_size=num_embed)
+            else:
+                self.rnn = rnn.RNN(num_hidden, num_layers, dropout=dropout,
+                                   input_size=num_embed)
+            self.decoder = nn.Dense(vocab_size, in_units=num_hidden)
+            self.num_hidden = num_hidden
+
+    def forward(self, inputs, hidden):
+        emb = self.drop(self.encoder(inputs))
+        output, hidden = self.rnn(emb, hidden)
+        output = self.drop(output)
+        decoded = self.decoder(output.reshape((-1, self.num_hidden)))
+        return decoded, hidden
+
+    def begin_state(self, *args, **kwargs):
+        return self.rnn.begin_state(*args, **kwargs)
+
+
+def batchify(data, batch_size):
+    nbatch = len(data) // batch_size
+    data = data[:nbatch * batch_size]
+    return mx.nd.array(data.reshape(batch_size, nbatch).T)
+
+
+def get_batch(source, i, bptt):
+    seq_len = min(bptt, source.shape[0] - 1 - i)
+    data = source[i:i + seq_len]
+    target = source[i + 1:i + 1 + seq_len]
+    return data, target.reshape((-1,))
+
+
+def detach(hidden):
+    if isinstance(hidden, (list, tuple)):
+        return [detach(h) for h in hidden]
+    return hidden.detach()
+
+
+def main():
+    parser = argparse.ArgumentParser(description="word language model")
+    parser.add_argument("--data", type=str, default=None,
+                        help="path to a tokenized text file")
+    parser.add_argument("--model", type=str, default="lstm")
+    parser.add_argument("--emsize", type=int, default=200)
+    parser.add_argument("--nhid", type=int, default=200)
+    parser.add_argument("--nlayers", type=int, default=2)
+    parser.add_argument("--lr", type=float, default=1.0)
+    parser.add_argument("--clip", type=float, default=0.2)
+    parser.add_argument("--epochs", type=int, default=1)
+    parser.add_argument("--batch_size", type=int, default=32)
+    parser.add_argument("--bptt", type=int, default=35)
+    parser.add_argument("--dropout", type=float, default=0.2)
+    parser.add_argument("--log-interval", type=int, default=20)
+    parser.add_argument("--max-batches", type=int, default=None)
+    args = parser.parse_args()
+
+    if args.data and os.path.exists(args.data):
+        with open(args.data) as f:
+            words = f.read().split()
+        vocab = {w: i for i, w in enumerate(sorted(set(words)))}
+        corpus = np.array([vocab[w] for w in words], dtype="float32")
+        ntokens = len(vocab)
+    else:
+        print("no --data given; using synthetic corpus")
+        ntokens = 1000
+        rs = np.random.RandomState(1)
+        corpus = rs.randint(0, ntokens, 40000).astype("float32")
+
+    train_data = batchify(corpus, args.batch_size)
+    model = RNNModel(args.model, ntokens, args.emsize, args.nhid, args.nlayers,
+                     args.dropout)
+    model.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(model.collect_params(), "sgd",
+                            {"learning_rate": args.lr, "momentum": 0, "wd": 0},
+                            kvstore=None)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    for epoch in range(args.epochs):
+        total_L = 0.0
+        hidden = model.begin_state(batch_size=args.batch_size)
+        tic = time.time()
+        nbatches = 0
+        for ibatch, i in enumerate(range(0, train_data.shape[0] - 1, args.bptt)):
+            data, target = get_batch(train_data, i, args.bptt)
+            hidden = detach(hidden)
+            with autograd.record():
+                output, hidden = model(data, hidden)
+                L = loss_fn(output, target)
+            L.backward()
+            grads = [p.grad for p in model.collect_params().values()
+                     if p.grad_req != "null"]
+            gluon.utils.clip_global_norm(grads, args.clip * args.bptt *
+                                         args.batch_size)
+            trainer.step(args.bptt * args.batch_size)
+            total_L += float(L.mean().asscalar())
+            nbatches += 1
+            if ibatch % args.log_interval == 0 and ibatch > 0:
+                cur_L = total_L / nbatches
+                wps = nbatches * args.bptt * args.batch_size / (time.time() - tic)
+                print(f"[epoch {epoch} batch {ibatch}] loss {cur_L:.2f}, "
+                      f"ppl {math.exp(min(cur_L, 20)):.2f}, {wps:.0f} wps")
+            if args.max_batches and ibatch >= args.max_batches:
+                break
+        print(f"epoch {epoch} done: avg loss {total_L / max(nbatches,1):.3f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -69,15 +69,25 @@ def invoke(op_name: str, inputs, attrs, out=None):
     """Imperative entry used by the generated ``mx.nd.*`` wrappers: unwraps
     NDArrays, records on the autograd tape when active, rewraps outputs."""
     from .ndarray.ndarray import NDArray, _wrap, _unwrap
-    from . import autograd
+    from . import autograd, profiler, engine
 
     opdef = get_op(op_name)
     in_datas = [_unwrap(x) for x in inputs]
+
+    profiling = profiler.is_active("imperative")
+    t0 = profiler._prof.us() if profiling else 0.0
 
     if autograd.is_recording() and opdef.differentiable:
         out_data = autograd._record_invoke(opdef, inputs, in_datas, dict(attrs))
     else:
         out_data = invoke_raw(op_name, in_datas, attrs)
+
+    if engine.is_naive():
+        for o in (out_data if isinstance(out_data, tuple) else (out_data,)):
+            if hasattr(o, "block_until_ready"):
+                o.block_until_ready()
+    if profiling:
+        profiler.record_event(op_name, "operator", t0, profiler._prof.us() - t0)
 
     n_out = opdef.out_count(dict(attrs))
     if isinstance(out_data, tuple):

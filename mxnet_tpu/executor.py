@@ -253,8 +253,8 @@ class Executor:
             self.grad_req = dict(grad_req)
 
         self._lowering = _GraphLowering(symbol)
-        self._jit_cache: Dict[bool, Callable] = {}
-        self._vjp_fn = None
+        self._jit_cache: Dict[Any, Callable] = {}
+        self._pending = None
         self._outputs: List[NDArray] = []
         self.monitor_callback = None
 
@@ -281,6 +281,39 @@ class Executor:
             self._jit_cache[is_train] = jax.jit(raw)
         return self._jit_cache[is_train]
 
+    def _diff_names(self):
+        return tuple(n for n in self._symbol.list_arguments()
+                     if self.grad_req.get(n, "null") != "null"
+                     and n in self.arg_dict)
+
+    def _compiled_train_step(self) -> Callable:
+        """ONE jitted XLA computation for forward + default-cotangent backward
+        — the whole-graph lowering of SURVEY.md stage 4 (the reference's
+        InitCachedOps + bulked segments collapse into this single program).
+        Used by forward(is_train=True); backward() then just delivers the
+        precomputed grads, so a Module training step is exactly one async
+        device dispatch."""
+        if "train_step" not in self._jit_cache:
+            raw = self._lowering.lower(True)
+            diff_names = self._diff_names()
+
+            def step(inputs, rng):
+                diff = {n: inputs[n] for n in diff_names}
+                nondiff = {n: v for n, v in inputs.items()
+                           if n not in diff_names}
+
+                def f(d):
+                    return raw({**d, **nondiff}, rng)
+
+                (outs, aux), vjp_fn = jax.vjp(f, diff)
+                cts = [jnp.ones_like(o) for o in outs]
+                aux_ct = jax.tree_util.tree_map(jnp.zeros_like, aux)
+                (grads,) = vjp_fn((cts, aux_ct))
+                return outs, aux, grads
+
+            self._jit_cache["train_step"] = jax.jit(step)
+        return self._jit_cache["train_step"]
+
     def set_monitor_callback(self, callback, monitor_all=False):
         self.monitor_callback = callback
 
@@ -303,45 +336,55 @@ class Executor:
                 break
 
         if is_train:
-            diff_names = [n for n in self._symbol.list_arguments()
-                          if self.grad_req.get(n, "null") != "null"
-                          and n in self.arg_dict]
-            nondiff = {n: v for n, v in inputs.items() if n not in diff_names}
-            diff = {n: inputs[n] for n in diff_names}
-            fn = self._compiled(True)
-
-            def f(d):
-                return fn({**d, **nondiff}, rng)
-
-            (outs, aux_updates), vjp_fn = jax.vjp(f, diff)
-            self._vjp_fn = (vjp_fn, outs, aux_updates)
+            outs, aux_updates, grads = self._compiled_train_step()(inputs, rng)
+            self._pending = (inputs, rng, outs, grads)
             for name, val in aux_updates.items():
                 if name in self.aux_dict:
                     self.aux_dict[name]._set_data(val)
         else:
             outs, _ = self._compiled(False)(inputs, rng)
-            self._vjp_fn = None
+            self._pending = None
         self._outputs = [_wrap(o) for o in outs]
         if self.monitor_callback is not None:
             for name, o in zip(self._symbol.list_outputs(), self._outputs):
                 self.monitor_callback(name, o)
         return self._outputs
 
+    def _compiled_custom_bwd(self) -> Callable:
+        """Jitted fwd+bwd with explicit head cotangents (the rare
+        backward(out_grads=...) path; recomputes forward inside one program)."""
+        if "custom_bwd" not in self._jit_cache:
+            raw = self._lowering.lower(True)
+            diff_names = self._diff_names()
+
+            def step(inputs, rng, cts):
+                diff = {n: inputs[n] for n in diff_names}
+                nondiff = {n: v for n, v in inputs.items()
+                           if n not in diff_names}
+
+                def f(d):
+                    return raw({**d, **nondiff}, rng)
+
+                (outs, aux), vjp_fn = jax.vjp(f, diff)
+                aux_ct = jax.tree_util.tree_map(jnp.zeros_like, aux)
+                (grads,) = vjp_fn((list(cts), aux_ct))
+                return grads
+
+            self._jit_cache["custom_bwd"] = jax.jit(step)
+        return self._jit_cache["custom_bwd"]
+
     # ------------------------------------------------------------- backward
     def backward(self, out_grads=None):
         from .ndarray.ndarray import NDArray
-        if self._vjp_fn is None:
+        if self._pending is None:
             raise MXNetError("backward called without forward(is_train=True)")
-        vjp_fn, outs, aux_updates = self._vjp_fn
-        if out_grads is None:
-            cts = [jnp.ones_like(o) for o in outs]
-        else:
+        inputs, rng, outs, grads = self._pending
+        if out_grads is not None:
             if isinstance(out_grads, NDArray):
                 out_grads = [out_grads]
-            cts = [g._data if isinstance(g, NDArray) else jnp.asarray(g)
-                   for g in out_grads]
-        aux_cts = {k: jnp.zeros_like(v) for k, v in aux_updates.items()}
-        (grads,) = vjp_fn((cts, aux_cts))
+            cts = tuple(g._data if isinstance(g, NDArray) else jnp.asarray(g)
+                        for g in out_grads)
+            grads = self._compiled_custom_bwd()(inputs, rng, cts)
         for name, g in grads.items():
             req = self.grad_req.get(name, "null")
             if req == "null" or name not in self.grad_dict:

@@ -54,8 +54,12 @@ class Parameter:
     @grad_req.setter
     def grad_req(self, req: str) -> None:
         self._grad_req = req
-        if self._data is not None and req != "null":
-            self._data.attach_grad(req)
+        if self._data is not None:
+            if req == "null":
+                self._data._grad = None
+                self._data._ag_node = None
+            else:
+                self._data.attach_grad(req)
 
     def _shape_known(self) -> bool:
         return self.shape is not None and all(s > 0 for s in self.shape)

@@ -1,0 +1,135 @@
+"""Linear-algebra operators.
+
+Reference parity: ``src/operator/tensor/la_op.cc`` (_linalg_gemm/gemm2/potrf/
+potri/trsm/trmm/syrk/sumlogdiag/extractdiag/makediag/extracttrian/maketrian/
+inverse/det/slogdet/gelqf/syevd). XLA has native triangular-solve/cholesky/
+eigh HLOs; everything maps 1:1.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+@register("_linalg_gemm", aliases=["linalg_gemm"])
+def _gemm(A, B, C, transpose_a=False, transpose_b=False, alpha=1.0, beta=1.0,
+          axis=-2):
+    a = jnp.swapaxes(A, -1, -2) if transpose_a else A
+    b = jnp.swapaxes(B, -1, -2) if transpose_b else B
+    return alpha * jnp.matmul(a, b) + beta * C
+
+
+@register("_linalg_gemm2", aliases=["linalg_gemm2"])
+def _gemm2(A, B, transpose_a=False, transpose_b=False, alpha=1.0, axis=-2):
+    a = jnp.swapaxes(A, -1, -2) if transpose_a else A
+    b = jnp.swapaxes(B, -1, -2) if transpose_b else B
+    return alpha * jnp.matmul(a, b)
+
+
+@register("_linalg_potrf", aliases=["linalg_potrf"])
+def _potrf(A, lower=True):
+    L = jnp.linalg.cholesky(A)
+    return L if lower else jnp.swapaxes(L, -1, -2)
+
+
+@register("_linalg_potri", aliases=["linalg_potri"])
+def _potri(A, lower=True):
+    # inverse of a matrix given its Cholesky factor A (reference la_op potri)
+    eye = jnp.broadcast_to(jnp.eye(A.shape[-1], dtype=A.dtype), A.shape)
+    inv_l = lax.linalg.triangular_solve(A, eye, lower=lower, left_side=True)
+    return jnp.matmul(jnp.swapaxes(inv_l, -1, -2), inv_l) if lower else \
+        jnp.matmul(inv_l, jnp.swapaxes(inv_l, -1, -2))
+
+
+@register("_linalg_trsm", aliases=["linalg_trsm"])
+def _trsm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0):
+    return lax.linalg.triangular_solve(
+        A, alpha * B, left_side=not rightside, lower=lower,
+        transpose_a=transpose)
+
+
+@register("_linalg_trmm", aliases=["linalg_trmm"])
+def _trmm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0):
+    tri = jnp.tril(A) if lower else jnp.triu(A)
+    if transpose:
+        tri = jnp.swapaxes(tri, -1, -2)
+    return alpha * (jnp.matmul(B, tri) if rightside else jnp.matmul(tri, B))
+
+
+@register("_linalg_syrk", aliases=["linalg_syrk"])
+def _syrk(A, transpose=False, alpha=1.0):
+    at = jnp.swapaxes(A, -1, -2)
+    return alpha * (jnp.matmul(at, A) if transpose else jnp.matmul(A, at))
+
+
+@register("_linalg_sumlogdiag", aliases=["linalg_sumlogdiag"])
+def _sumlogdiag(A):
+    diag = jnp.diagonal(A, axis1=-2, axis2=-1)
+    return jnp.sum(jnp.log(diag), axis=-1)
+
+
+@register("_linalg_extractdiag", aliases=["linalg_extractdiag"])
+def _extractdiag(A, offset=0):
+    return jnp.diagonal(A, offset=int(offset), axis1=-2, axis2=-1)
+
+
+@register("_linalg_makediag", aliases=["linalg_makediag"])
+def _makediag(A, offset=0):
+    n = A.shape[-1] + abs(int(offset))
+    base = jnp.zeros(A.shape[:-1] + (n, n), dtype=A.dtype)
+    idx = jnp.arange(A.shape[-1])
+    if offset >= 0:
+        return base.at[..., idx, idx + offset].set(A)
+    return base.at[..., idx - offset, idx].set(A)
+
+
+@register("_linalg_extracttrian", aliases=["linalg_extracttrian"])
+def _extracttrian(A, offset=0, lower=True):
+    n = A.shape[-1]
+    rows, cols = jnp.tril_indices(n, k=int(offset)) if lower else \
+        jnp.triu_indices(n, k=int(offset))
+    return A[..., rows, cols]
+
+
+@register("_linalg_maketrian", aliases=["linalg_maketrian"])
+def _maketrian(A, offset=0, lower=True):
+    # infer n from len = n*(n+1)/2 (offset 0 case)
+    import math
+    ln = A.shape[-1]
+    n = int((math.isqrt(8 * ln + 1) - 1) // 2) + abs(int(offset))
+    rows, cols = jnp.tril_indices(n, k=int(offset)) if lower else \
+        jnp.triu_indices(n, k=int(offset))
+    base = jnp.zeros(A.shape[:-1] + (n, n), dtype=A.dtype)
+    return base.at[..., rows, cols].set(A)
+
+
+@register("_linalg_inverse", aliases=["linalg_inverse"])
+def _inverse(A):
+    return jnp.linalg.inv(A)
+
+
+@register("_linalg_det", aliases=["linalg_det"])
+def _det(A):
+    return jnp.linalg.det(A)
+
+
+@register("_linalg_slogdet", aliases=["linalg_slogdet"], num_outputs=2)
+def _slogdet(A):
+    sign, logdet = jnp.linalg.slogdet(A)
+    return sign, logdet
+
+
+@register("_linalg_gelqf", aliases=["linalg_gelqf"], num_outputs=2)
+def _gelqf(A):
+    # LQ factorization = transpose of QR of Aᵀ
+    q, r = jnp.linalg.qr(jnp.swapaxes(A, -1, -2))
+    return jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2)
+
+
+@register("_linalg_syevd", aliases=["linalg_syevd"], num_outputs=2)
+def _syevd(A):
+    w, v = jnp.linalg.eigh(A)
+    return jnp.swapaxes(v, -1, -2), w

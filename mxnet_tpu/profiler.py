@@ -1,0 +1,231 @@
+"""Profiler — chrome-trace JSON + XLA/TPU trace sessions.
+
+Reference parity: ``src/profiler/profiler.{h,cc}`` + ``python/mxnet/profiler.py``
+(set_config/start/stop/dump, mode bitmask {symbolic, imperative, api, memory}
+profiler.h:256-262, ProfileDomain/Task/Event/Counter/Marker objects
+profiler.h:556+, aggregate summary aggregate_stats.cc, env autostart
+MXNET_PROFILER_AUTOSTART).
+
+TPU-first: host-side events (op dispatches, graph executions, API calls) are
+recorded directly in chrome-trace format; device-side timing comes from an
+XLA profiler session (``jax.profiler``) whose TensorBoard trace dir sits next
+to the JSON file — the split mirrors the reference's CPU-op vs GPU-kernel
+event streams.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+from .base import get_env
+
+__all__ = ["set_config", "start", "stop", "pause", "resume", "dump", "dumps",
+           "Domain", "Task", "Event", "Counter", "Marker", "profiler_set_state"]
+
+_lock = threading.Lock()
+
+
+class _ProfilerState:
+    def __init__(self):
+        self.running = False
+        self.paused = False
+        self.events: List[dict] = []
+        self.filename = "profile.json"
+        self.modes = {"symbolic": True, "imperative": True, "api": False,
+                      "memory": False}
+        self.aggregate = False
+        self.xla_trace_dir: Optional[str] = None
+        self.t0 = time.perf_counter()
+
+    def us(self):
+        return (time.perf_counter() - self.t0) * 1e6
+
+
+_prof = _ProfilerState()
+
+
+def set_config(profile_all=False, profile_symbolic=False, profile_imperative=False,
+               profile_memory=False, profile_api=False, filename="profile.json",
+               aggregate_stats=False, profile_process="worker",
+               xla_trace_dir=None, **kwargs):
+    with _lock:
+        _prof.filename = filename
+        _prof.aggregate = aggregate_stats
+        _prof.xla_trace_dir = xla_trace_dir
+        if profile_all:
+            for k in _prof.modes:
+                _prof.modes[k] = True
+        else:
+            _prof.modes.update(symbolic=profile_symbolic,
+                               imperative=profile_imperative,
+                               memory=profile_memory, api=profile_api)
+
+
+def start():
+    with _lock:
+        _prof.running = True
+        _prof.paused = False
+        _prof.t0 = time.perf_counter()
+        _prof.events = []
+    if _prof.xla_trace_dir:
+        import jax
+        jax.profiler.start_trace(_prof.xla_trace_dir)
+
+
+def stop():
+    with _lock:
+        _prof.running = False
+    if _prof.xla_trace_dir:
+        import jax
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+
+
+def pause(profile_process="worker"):
+    _prof.paused = True
+
+
+def resume(profile_process="worker"):
+    _prof.paused = False
+
+
+def profiler_set_state(state="stop"):
+    if state == "run":
+        start()
+    else:
+        stop()
+
+
+def is_active(kind: str = "imperative") -> bool:
+    return _prof.running and not _prof.paused and _prof.modes.get(kind, False)
+
+
+def record_event(name: str, category: str, t_start_us: float, dur_us: float,
+                 args: Optional[dict] = None):
+    with _lock:
+        _prof.events.append({
+            "name": name, "cat": category, "ph": "X",
+            "ts": t_start_us, "dur": dur_us,
+            "pid": os.getpid(), "tid": threading.get_ident() % (1 << 31),
+            "args": args or {}})
+
+
+class _Scope:
+    def __init__(self, name, category):
+        self.name = name
+        self.category = category
+
+    def __enter__(self):
+        self.start = _prof.us()
+        return self
+
+    def __exit__(self, *exc):
+        record_event(self.name, self.category, self.start,
+                     _prof.us() - self.start)
+        return False
+
+
+def scope(name: str, category: str = "operator") -> _Scope:
+    return _Scope(name, category)
+
+
+def dumps(reset=False) -> str:
+    """Aggregate text summary (reference aggregate_stats.cc table)."""
+    agg: Dict[str, List[float]] = defaultdict(list)
+    with _lock:
+        for e in _prof.events:
+            agg[e["name"]].append(e["dur"])
+        if reset:
+            _prof.events = []
+    lines = [f"{'Name':<40}{'Calls':>8}{'Total(us)':>14}{'Mean(us)':>12}"]
+    for name, durs in sorted(agg.items(), key=lambda kv: -sum(kv[1])):
+        lines.append(f"{name:<40}{len(durs):>8}{sum(durs):>14.1f}"
+                     f"{sum(durs)/len(durs):>12.1f}")
+    return "\n".join(lines)
+
+
+def dump(finished=True, profile_process="worker"):
+    """Write the chrome trace JSON (load in chrome://tracing / Perfetto)."""
+    with _lock:
+        trace = {"traceEvents": list(_prof.events), "displayTimeUnit": "ms"}
+        with open(_prof.filename, "w") as f:
+            json.dump(trace, f)
+        if finished:
+            _prof.events = []
+
+
+# ---- user-facing objects (reference profiler.py:Domain/Task/Event/...) ----
+class Domain:
+    def __init__(self, name):
+        self.name = name
+
+
+class Task:
+    def __init__(self, domain, name):
+        self.domain = domain
+        self.name = name
+        self._start = None
+
+    def start(self):
+        self._start = _prof.us()
+
+    def stop(self):
+        if self._start is not None:
+            record_event(self.name, self.domain.name, self._start,
+                         _prof.us() - self._start)
+            self._start = None
+
+
+class Event(Task):
+    pass
+
+
+class Counter:
+    def __init__(self, domain, name, value=0):
+        self.domain = domain
+        self.name = name
+        self.value = value
+        self._emit()
+
+    def _emit(self):
+        with _lock:
+            _prof.events.append({"name": self.name, "cat": self.domain.name,
+                                 "ph": "C", "ts": _prof.us(),
+                                 "pid": os.getpid(),
+                                 "args": {"value": self.value}})
+
+    def set_value(self, value):
+        self.value = value
+        self._emit()
+
+    def increment(self, delta=1):
+        self.set_value(self.value + delta)
+
+    def decrement(self, delta=1):
+        self.set_value(self.value - delta)
+
+    __iadd__ = increment
+    __isub__ = decrement
+
+
+class Marker:
+    def __init__(self, domain, name):
+        self.domain = domain
+        self.name = name
+
+    def mark(self, scope_="process"):
+        with _lock:
+            _prof.events.append({"name": self.name, "cat": self.domain.name,
+                                 "ph": "i", "ts": _prof.us(), "s": "p",
+                                 "pid": os.getpid()})
+
+
+if get_env("MXNET_PROFILER_AUTOSTART", False):
+    set_config(profile_all=True)
+    start()

@@ -1,0 +1,175 @@
+"""contrib tests: control flow, quantization, linalg, RNN op
+(reference: tests/python/unittest/test_contrib_control_flow.py,
+test_operator.py linalg sections, quantization tests)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+from mxnet_tpu.contrib import foreach, while_loop, cond
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_foreach_cumsum(rng):
+    data = nd.array(rng.randn(6, 3).astype("float32"))
+
+    def body(x, state):
+        new = state + x
+        return new, new
+
+    outs, final = foreach(body, data, nd.zeros((3,)))
+    ref = np.cumsum(data.asnumpy(), axis=0)
+    assert_almost_equal(outs, ref, rtol=1e-5)
+    assert_almost_equal(final, ref[-1], rtol=1e-5)
+
+
+def test_foreach_gradient(rng):
+    data = nd.array(rng.randn(5, 4).astype("float32"))
+    data.attach_grad()
+
+    def body(x, state):
+        new = state + x * x
+        return new, new
+
+    with autograd.record():
+        outs, final = foreach(body, data, nd.zeros((4,)))
+        loss = final.sum()
+    loss.backward()
+    assert_almost_equal(data.grad, 2 * data.asnumpy(), rtol=1e-4)
+
+
+def test_while_loop(rng):
+    def cond_fn(v):
+        return (v.sum() < 100.0)
+
+    def body_fn(v):
+        return None, v * 2
+
+    steps, out = while_loop(cond_fn, body_fn, nd.ones((2,)), max_iterations=50)
+    assert float(out.sum().asscalar()) >= 100.0
+    assert int(steps.asscalar()) == 6  # 2^6 * 2 = 128 >= 100
+
+
+def test_cond(rng):
+    x = nd.array([3.0])
+    out = cond(lambda a: a.sum() > 1.0,
+               lambda a: a * 10, lambda a: a - 10, [x])
+    assert out.asnumpy().tolist() == [30.0]
+    out2 = cond(lambda a: a.sum() > 100.0,
+                lambda a: a * 10, lambda a: a - 10, [x])
+    assert out2.asnumpy().tolist() == [-7.0]
+
+
+def test_linalg_ops(rng):
+    a = rng.randn(3, 4).astype("float32")
+    b = rng.randn(4, 5).astype("float32")
+    c = rng.randn(3, 5).astype("float32")
+    out = nd.linalg_gemm(nd.array(a), nd.array(b), nd.array(c), alpha=2.0,
+                         beta=0.5)
+    assert_almost_equal(out, 2 * (a @ b) + 0.5 * c, rtol=1e-4)
+    out2 = nd.linalg_gemm2(nd.array(a), nd.array(b))
+    assert_almost_equal(out2, a @ b, rtol=1e-4)
+
+    spd = rng.randn(4, 4).astype("float32")
+    spd = spd @ spd.T + 4 * np.eye(4, dtype="float32")
+    L = nd.linalg_potrf(nd.array(spd))
+    assert_almost_equal(L.asnumpy() @ L.asnumpy().T, spd, rtol=1e-3, atol=1e-3)
+    inv = nd.linalg_potri(L)
+    assert_almost_equal(inv.asnumpy() @ spd, np.eye(4), rtol=1e-2, atol=1e-2)
+    sld = nd.linalg_sumlogdiag(nd.array(np.abs(spd) + np.eye(4, dtype="float32")))
+    assert np.isfinite(sld.asnumpy()).all()
+    d = nd.linalg_det(nd.array(spd))
+    assert_almost_equal(d, np.linalg.det(spd), rtol=1e-3)
+    iv = nd.linalg_inverse(nd.array(spd))
+    assert_almost_equal(iv.asnumpy() @ spd, np.eye(4), rtol=1e-3, atol=1e-3)
+
+
+def test_rnn_op_direct(rng):
+    """Packed-parameter fused RNN op vs manual LSTM recurrence."""
+    from mxnet_tpu.ops.rnn import rnn_packed_param_size
+    T, B, I, H = 4, 2, 3, 5
+    n = rnn_packed_param_size("lstm", 1, False, I, H)
+    params = rng.randn(n).astype("float32") * 0.1
+    x = rng.randn(T, B, I).astype("float32")
+    h0 = np.zeros((1, B, H), dtype="float32")
+    c0 = np.zeros((1, B, H), dtype="float32")
+    outs = nd.RNN(nd.array(x), nd.array(params), nd.array(h0), nd.array(c0),
+                  state_size=H, num_layers=1, mode="lstm", state_outputs=True)
+    out, hn, cn = outs
+    assert out.shape == (T, B, H)
+    assert hn.shape == (1, B, H)
+    # manual recurrence with the same packed params
+    wih = params[:4 * H * I].reshape(4 * H, I)
+    whh = params[4 * H * I:4 * H * I + 4 * H * H].reshape(4 * H, H)
+    bih = params[4 * H * I + 4 * H * H:4 * H * I + 4 * H * H + 4 * H]
+    bhh = params[4 * H * I + 4 * H * H + 4 * H:]
+    h = np.zeros((B, H), dtype="float32")
+    c = np.zeros((B, H), dtype="float32")
+
+    def sig(v):
+        return 1 / (1 + np.exp(-v))
+
+    ref = []
+    for t in range(T):
+        g = x[t] @ wih.T + bih + h @ whh.T + bhh
+        i_, f, gg, o = np.split(g, 4, axis=1)
+        c = sig(f) * c + sig(i_) * np.tanh(gg)
+        h = sig(o) * np.tanh(c)
+        ref.append(h)
+    np.testing.assert_allclose(out.asnumpy(), np.stack(ref), rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(hn.asnumpy()[0], h, rtol=1e-4, atol=1e-5)
+
+
+def test_quantize_dequantize(rng):
+    x = rng.randn(4, 8).astype("float32")
+    q, mn, mx_ = nd.contrib_quantize(nd.array(x), nd.array(x.min()),
+                                     nd.array(x.max()))
+    assert q.dtype == np.int8
+    back = nd.contrib_dequantize(q, mn, mx_)
+    np.testing.assert_allclose(back.asnumpy(), x, atol=float(np.abs(x).max()) / 60)
+
+
+def test_quantized_fc(rng):
+    x = rng.randn(2, 6).astype("float32")
+    w = rng.randn(4, 6).astype("float32")
+    qx, mnx, mxx = [a for a in nd.contrib_quantize(
+        nd.array(x), nd.array(x.min()), nd.array(x.max()))]
+    qw, mnw, mxw = [a for a in nd.contrib_quantize(
+        nd.array(w), nd.array(w.min()), nd.array(w.max()))]
+    from mxnet_tpu._imperative import invoke
+    acc, mn, mx_ = invoke("_contrib_quantized_fully_connected",
+                          [qx, qw, None, mnx, mxx, mnw, mxw],
+                          {"num_hidden": 4, "no_bias": True})
+    scale = (float(mx_.asnumpy().ravel()[0]) / (1 << 30))
+    approx = acc.asnumpy().astype("float64") * scale
+    np.testing.assert_allclose(approx, x @ w.T, atol=0.2, rtol=0.1)
+
+
+def test_profiler_chrome_trace(tmp_path, rng):
+    from mxnet_tpu import profiler
+    f = str(tmp_path / "trace.json")
+    profiler.set_config(profile_all=True, filename=f)
+    profiler.start()
+    a = nd.array(rng.randn(16, 16).astype("float32"))
+    b = nd.dot(a, a)
+    b.wait_to_read()
+    profiler.stop()
+    profiler.dump()
+    import json
+    trace = json.load(open(f))
+    names = [e["name"] for e in trace["traceEvents"]]
+    assert "dot" in names
+    summary = profiler.dumps()
+    assert "Name" in summary
+
+
+def test_naive_engine_mode(rng):
+    from mxnet_tpu import engine
+    assert not engine.is_naive()
+    with engine.naive_mode():
+        assert engine.is_naive()
+        x = nd.array(rng.randn(4, 4).astype("float32"))
+        y = nd.dot(x, x)  # blocks internally
+    assert not engine.is_naive()
+    engine.wait_all()

@@ -1,0 +1,216 @@
+"""Gluon tests (reference: tests/python/unittest/test_gluon.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd, gluon
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_dense_deferred_init(rng):
+    layer = nn.Dense(8)
+    layer.initialize()
+    x = nd.array(rng.randn(4, 6).astype("float32"))
+    out = layer(x)
+    assert out.shape == (4, 8)
+    assert layer.weight.shape == (8, 6)
+
+
+def test_parameter_api(rng):
+    p = gluon.Parameter("w", shape=(3, 4))
+    p.initialize(init=mx.init.One())
+    assert (p.data().asnumpy() == 1).all()
+    p.set_data(nd.zeros((3, 4)))
+    assert (p.data().asnumpy() == 0).all()
+    assert p.grad is not None
+    p.zero_grad()
+    assert p.grad.asnumpy().sum() == 0
+    p.grad_req = "null"
+    with pytest.raises(Exception):
+        _ = p.grad
+
+
+def test_block_naming_and_collect():
+    net = nn.HybridSequential(prefix="model_")
+    with net.name_scope():
+        net.add(nn.Dense(4, prefix="fc1_"))
+        net.add(nn.Dense(2))
+    names = list(net.collect_params().keys())
+    assert "model_fc1_weight" in names
+    sel = net.collect_params(".*weight")
+    assert all(k.endswith("weight") for k in sel.keys())
+
+
+def test_hybridize_consistency(rng):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    x = nd.array(rng.randn(5, 7).astype("float32"))
+    imp = net(x).asnumpy()
+    net.hybridize()
+    hyb = net(x).asnumpy()
+    np.testing.assert_allclose(imp, hyb, rtol=1e-5, atol=1e-6)
+
+
+def test_conv_blocks(rng):
+    x = nd.array(rng.randn(2, 3, 12, 12).astype("float32"))
+    for blk, shape in [
+        (nn.Conv2D(8, 3, padding=1), (2, 8, 12, 12)),
+        (nn.Conv2D(8, 3, strides=2, padding=1), (2, 8, 6, 6)),
+        (nn.Conv2DTranspose(4, 2, strides=2), (2, 4, 24, 24)),
+        (nn.MaxPool2D(), (2, 3, 6, 6)),
+        (nn.GlobalAvgPool2D(), (2, 3, 1, 1)),
+    ]:
+        blk.initialize()
+        assert blk(x).shape == shape, type(blk).__name__
+
+
+def test_losses(rng):
+    pred = nd.array(rng.randn(8, 5).astype("float32"))
+    label = nd.array(rng.randint(0, 5, 8).astype("float32"))
+    l = gluon.loss.SoftmaxCrossEntropyLoss()(pred, label)
+    assert l.shape == (8,)
+    ref = -np.log(np.exp(pred.asnumpy())
+                  / np.exp(pred.asnumpy()).sum(1, keepdims=True))
+    ref = ref[np.arange(8), label.asnumpy().astype(int)]
+    np.testing.assert_allclose(l.asnumpy(), ref, rtol=1e-4, atol=1e-5)
+
+    l2 = gluon.loss.L2Loss()(pred, nd.array(rng.randn(8, 5).astype("float32")))
+    assert l2.shape == (8,)
+    l1 = gluon.loss.L1Loss()(pred, pred)
+    assert np.allclose(l1.asnumpy(), 0)
+    h = gluon.loss.HuberLoss()(pred, pred)
+    assert np.allclose(h.asnumpy(), 0)
+
+
+def test_trainer_learning_rate():
+    net = nn.Dense(2)
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.5},
+                       kvstore=None)
+    assert tr.learning_rate == 0.5
+    tr.set_learning_rate(0.1)
+    assert tr.learning_rate == 0.1
+
+
+def test_trainer_states_roundtrip(tmp_path, rng):
+    net = nn.Dense(4)
+    net.initialize()
+    x = nd.array(rng.randn(8, 3).astype("float32"))
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1, "momentum": 0.9}, kvstore=None)
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    tr.step(8)
+    f = str(tmp_path / "trainer.states")
+    tr.save_states(f)
+    tr.load_states(f)
+
+
+def test_dataloader_and_dataset(rng):
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+    x = rng.randn(20, 3).astype("float32")
+    y = rng.randint(0, 2, 20).astype("float32")
+    ds = ArrayDataset(x, y)
+    assert len(ds) == 20
+    loader = DataLoader(ds, batch_size=6, shuffle=True, last_batch="discard")
+    batches = list(loader)
+    assert len(batches) == 3
+    assert batches[0][0].shape == (6, 3)
+    loader2 = DataLoader(ds, batch_size=6, num_workers=2)
+    assert len(list(loader2)) == 4
+    # transform
+    ds2 = ds.transform_first(lambda a: a * 2)
+    item = ds2[0]
+    np.testing.assert_allclose(item[0].asnumpy(), x[0] * 2, rtol=1e-6)
+
+
+def test_vision_dataset_synthetic():
+    from mxnet_tpu.gluon.data.vision import MNIST
+    ds = MNIST(root="/tmp/nonexistent_mnist_dir", train=True,
+               synthetic_size=64)
+    assert len(ds) == 64
+    img, label = ds[0]
+    assert img.shape == (28, 28, 1)
+    assert 0 <= int(label) < 10
+
+
+def test_vision_transforms(rng):
+    from mxnet_tpu.gluon.data.vision import transforms as T
+    img = nd.array((rng.rand(28, 30, 3) * 255).astype("uint8"), dtype="uint8")
+    t = T.ToTensor()(img)
+    assert t.shape == (3, 28, 30)
+    assert float(t.max().asscalar()) <= 1.0
+    c = T.CenterCrop(20)(img)
+    assert c.shape == (20, 20, 3)
+    r = T.Resize(14)(img)
+    assert r.shape == (14, 14, 3)
+    comp = T.Compose([T.ToTensor(), T.Normalize([0.5, 0.5, 0.5], [0.5, 0.5, 0.5])])
+    n = comp(img)
+    assert n.shape == (3, 28, 30)
+
+
+def test_export_and_symbolblock(tmp_path, rng):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(3))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    x = nd.array(rng.randn(2, 5).astype("float32"))
+    ref = net(x).asnumpy()
+    prefix = str(tmp_path / "exported")
+    sym_file, param_file = net.export(prefix, epoch=7)
+    net2 = gluon.SymbolBlock.imports(sym_file, ["data"], param_file)
+    got = net2(x).asnumpy()
+    np.testing.assert_allclose(ref, got, rtol=1e-5, atol=1e-6)
+
+
+def test_rnn_layers_shapes(rng):
+    for layer, state_mult in [(gluon.rnn.LSTM(8, 2), 2),
+                              (gluon.rnn.GRU(8, 2), 1),
+                              (gluon.rnn.RNN(8, 1), 1)]:
+        layer.initialize()
+        x = nd.array(rng.randn(6, 3, 4).astype("float32"))
+        out = layer(x)
+        assert out.shape == (6, 3, 8)
+
+    bi = gluon.rnn.LSTM(8, 1, bidirectional=True)
+    bi.initialize()
+    out = bi(nd.array(rng.randn(6, 3, 4).astype("float32")))
+    assert out.shape == (6, 3, 16)
+
+
+def test_rnn_cells(rng):
+    for cell_cls, n_states in [(gluon.rnn.LSTMCell, 2), (gluon.rnn.GRUCell, 1),
+                               (gluon.rnn.RNNCell, 1)]:
+        cell = cell_cls(10)
+        cell.initialize()
+        x = nd.array(rng.randn(4, 6).astype("float32"))
+        states = cell.begin_state(4)
+        assert len(states) == n_states
+        out, new_states = cell(x, states)
+        assert out.shape == (4, 10)
+        assert len(new_states) == n_states
+
+    seq = gluon.rnn.SequentialRNNCell()
+    seq.add(gluon.rnn.LSTMCell(8))
+    seq.add(gluon.rnn.LSTMCell(8))
+    seq.initialize()
+    outs, states = seq.unroll(5, nd.array(rng.randn(2, 5, 4).astype("float32")),
+                              layout="NTC")
+    assert len(outs) == 5 and outs[0].shape == (2, 8)
+    assert len(states) == 4
+
+
+def test_rnn_layer_grad_flows(rng):
+    lstm = gluon.rnn.LSTM(8, 1, input_size=4)
+    lstm.initialize()
+    x = nd.array(rng.randn(5, 2, 4).astype("float32"))
+    params = lstm.collect_params()
+    with autograd.record():
+        out = lstm(x)
+        loss = (out * out).sum()
+    loss.backward()
+    g = params[lstm.prefix + "l0_i2h_weight"].grad.asnumpy()
+    assert np.abs(g).sum() > 0
