@@ -394,12 +394,18 @@ class ImageRecordIter(DataIter):
         self._rio = rio
         self.path_imgrec = path_imgrec
         idx_path = path_imgidx or os.path.splitext(path_imgrec)[0] + ".idx"
-        if os.path.isfile(idx_path):
-            self._rec = rio.MXIndexedRecordIO(idx_path, path_imgrec, "r")
-            self._keys = list(self._rec.keys)
-        else:
-            self._rec = rio.MXRecordIO(path_imgrec, "r")
-            self._keys = None
+        self._native = None
+        try:  # native C++ scanner/prefetcher: index from framing, no .idx needed
+            from ..native import NativeRecordReader
+            self._native = NativeRecordReader(path_imgrec)
+            self._keys = list(range(len(self._native)))
+        except Exception:
+            if os.path.isfile(idx_path):
+                self._rec = rio.MXIndexedRecordIO(idx_path, path_imgrec, "r")
+                self._keys = list(self._rec.keys)
+            else:
+                self._rec = rio.MXRecordIO(path_imgrec, "r")
+                self._keys = None
         self.data_shape = tuple(data_shape)
         self.label_width = label_width
         self.shuffle = shuffle
@@ -434,6 +440,11 @@ class ImageRecordIter(DataIter):
                 np.random.shuffle(self._order)
         else:
             self._rec.reset()
+
+    def _read_record(self, key):
+        if self._native is not None:
+            return self._native.read(key)
+        return self._rec.read_idx(key)
 
     def _decode_one(self, raw):
         header, img = self._rio.unpack_img(raw, iscolor=1)
@@ -471,7 +482,7 @@ class ImageRecordIter(DataIter):
         if self._keys is not None:
             if self._pos >= len(self._order):
                 return None
-            raw = self._rec.read_idx(self._order[self._pos])
+            raw = self._read_record(self._order[self._pos])
         else:
             raw = self._rec.read()
         self._pos += 1

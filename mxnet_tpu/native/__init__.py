@@ -1,0 +1,124 @@
+"""Native (C++) runtime components, ctypes-bound.
+
+Reference parity: the native data-pipeline slice (dmlc RecordIO reader +
+ThreadedIter prefetch, SURVEY.md §2.1 Data IO). Built lazily with g++ on
+first use; every consumer has a pure-python fallback so the package works
+without a toolchain.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_HERE, "libmxtpu.so")
+_lock = threading.Lock()
+_lib = None
+_build_failed = False
+
+
+def _build() -> bool:
+    src = os.path.join(_HERE, "recordio.cc")
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           src, "-o", _LIB_PATH]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except Exception:
+        return False
+
+
+def get_lib():
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib, _build_failed
+    with _lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        if not os.path.exists(_LIB_PATH) or \
+                os.path.getmtime(_LIB_PATH) < os.path.getmtime(
+                    os.path.join(_HERE, "recordio.cc")):
+            if not _build():
+                _build_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            _build_failed = True
+            return None
+        lib.rio_open.restype = ctypes.c_void_p
+        lib.rio_open.argtypes = [ctypes.c_char_p]
+        lib.rio_close.argtypes = [ctypes.c_void_p]
+        lib.rio_num_records.restype = ctypes.c_int64
+        lib.rio_num_records.argtypes = [ctypes.c_void_p]
+        lib.rio_record_size.restype = ctypes.c_int64
+        lib.rio_record_size.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.rio_read.restype = ctypes.c_int64
+        lib.rio_read.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                 ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64]
+        lib.rio_start_prefetch.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                           ctypes.c_int64]
+        lib.rio_next_prefetched.restype = ctypes.c_int64
+        lib.rio_next_prefetched.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64)]
+        _lib = lib
+        return _lib
+
+
+class NativeRecordReader:
+    """Random-access + prefetching reader over a .rec file (no .idx needed —
+    the index is rebuilt from framing in one native scan)."""
+
+    def __init__(self, path: str):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._h = lib.rio_open(path.encode())
+        if not self._h:
+            raise IOError(f"cannot open/scan RecordIO file {path}")
+
+    def __len__(self) -> int:
+        return int(self._lib.rio_num_records(self._h))
+
+    def read(self, idx: int) -> bytes:
+        size = int(self._lib.rio_record_size(self._h, idx))
+        if size < 0:
+            raise IndexError(idx)
+        buf = np.empty(size, dtype=np.uint8)
+        n = self._lib.rio_read(self._h, idx,
+                               buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                               size)
+        if n < 0:
+            raise IOError(f"read failed for record {idx}")
+        return buf.tobytes()
+
+    def start_prefetch(self, start: int = 0, depth: int = 16) -> None:
+        self._lib.rio_start_prefetch(self._h, start, depth)
+
+    def next_prefetched(self, max_size: int = 64 << 20):
+        buf = np.empty(max_size, dtype=np.uint8)
+        size = ctypes.c_int64(0)
+        idx = self._lib.rio_next_prefetched(
+            self._h, buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            max_size, ctypes.byref(size))
+        if idx == -1:
+            return None, None
+        if idx == -2:
+            raise IOError("prefetch buffer too small")
+        return int(idx), buf[:size.value].tobytes()
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.rio_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

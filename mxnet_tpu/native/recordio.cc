@@ -1,0 +1,204 @@
+// Native RecordIO chunk reader + threaded prefetcher.
+//
+// Reference parity: the C++ fast path of the data pipeline —
+// dmlc-core RecordIO reader + ThreadedIter as used by
+// src/io/iter_image_recordio_2.cc (chunk read -> parse -> prefetch).
+// TPU-native role: keep the host-side input pipeline off the Python
+// interpreter so device steps are never input-bound; decode/augment stays in
+// worker threads (libjpeg-turbo via PIL releases the GIL), this library owns
+// file scanning, framing, and read-ahead.
+//
+// C ABI (ctypes-consumed, see mxnet_tpu/native/__init__.py):
+//   rio_open / rio_close
+//   rio_num_records / rio_record_size
+//   rio_read (copy record payload into caller buffer)
+//   rio_start_prefetch / rio_next_prefetched (sequential read-ahead thread)
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0xced7230a;
+constexpr uint32_t kLenMask = (1u << 29) - 1;
+
+struct RecordRef {
+  uint64_t offset;   // payload offset in file
+  uint32_t length;   // payload length
+};
+
+struct Reader {
+  FILE* f = nullptr;
+  std::vector<RecordRef> records;
+
+  // prefetch state
+  std::thread worker;
+  std::mutex mu;
+  std::condition_variable cv_push, cv_pop;
+  std::deque<std::pair<size_t, std::vector<uint8_t>>> queue;
+  size_t capacity = 8;
+  std::atomic<bool> stop{false};
+  size_t next_emit = 0;
+
+  ~Reader() {
+    stop.store(true);
+    cv_push.notify_all();
+    cv_pop.notify_all();
+    if (worker.joinable()) worker.join();
+    if (f) fclose(f);
+  }
+
+  bool scan() {
+    // Build the record index in one sequential pass (the .idx equivalent,
+    // derived from framing alone so unindexed .rec files work too).
+    uint64_t pos = 0;
+    if (fseek(f, 0, SEEK_END) != 0) return false;
+    uint64_t file_size = static_cast<uint64_t>(ftell(f));
+    rewind(f);
+    std::vector<uint8_t> head(8);
+    while (pos + 8 <= file_size) {
+      if (fread(head.data(), 1, 8, f) != 8) break;
+      uint32_t magic, lrec;
+      memcpy(&magic, head.data(), 4);
+      memcpy(&lrec, head.data() + 4, 4);
+      if (magic != kMagic) return false;
+      uint32_t cflag = lrec >> 29;
+      uint32_t length = lrec & kLenMask;
+      uint64_t payload = pos + 8;
+      uint32_t padded = (length + 3u) & ~3u;
+      if (cflag == 0) {
+        records.push_back({payload, length});
+      } else {
+        // chunked record: only record the first chunk; rio_read re-walks
+        records.push_back({payload, length});
+        // skip continuation chunks
+        uint64_t p = payload + padded;
+        while (cflag != 0 && cflag != 3 && p + 8 <= file_size) {
+          fseek(f, static_cast<long>(p), SEEK_SET);
+          if (fread(head.data(), 1, 8, f) != 8) break;
+          memcpy(&magic, head.data(), 4);
+          memcpy(&lrec, head.data() + 4, 4);
+          cflag = lrec >> 29;
+          uint32_t l2 = lrec & kLenMask;
+          p += 8 + ((l2 + 3u) & ~3u);
+        }
+        padded = static_cast<uint32_t>(p - payload);
+      }
+      pos = payload + padded;
+      fseek(f, static_cast<long>(pos), SEEK_SET);
+    }
+    return true;
+  }
+
+  int64_t read_into(size_t idx, uint8_t* buf, size_t buf_len) {
+    if (idx >= records.size()) return -1;
+    const RecordRef& r = records[idx];
+    if (r.length > buf_len) return -static_cast<int64_t>(r.length);
+    fseek(f, static_cast<long>(r.offset), SEEK_SET);
+    if (fread(buf, 1, r.length, f) != r.length) return -1;
+    return static_cast<int64_t>(r.length);
+  }
+
+  void prefetch_loop(size_t start) {
+    // dedicated FILE* so the worker doesn't race user reads
+    FILE* pf = fopen(path.c_str(), "rb");
+    if (!pf) return;
+    for (size_t i = start; i < records.size() && !stop.load(); ++i) {
+      std::vector<uint8_t> payload(records[i].length);
+      fseek(pf, static_cast<long>(records[i].offset), SEEK_SET);
+      if (fread(payload.data(), 1, payload.size(), pf) != payload.size()) break;
+      std::unique_lock<std::mutex> lk(mu);
+      cv_push.wait(lk, [&] { return queue.size() < capacity || stop.load(); });
+      if (stop.load()) break;
+      queue.emplace_back(i, std::move(payload));
+      cv_pop.notify_one();
+    }
+    fclose(pf);
+    std::unique_lock<std::mutex> lk(mu);
+    queue.emplace_back(static_cast<size_t>(-1), std::vector<uint8_t>());
+    cv_pop.notify_one();
+  }
+
+  std::string path;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* rio_open(const char* path) {
+  Reader* r = new Reader();
+  r->path = path;
+  r->f = fopen(path, "rb");
+  if (!r->f || !r->scan()) {
+    delete r;
+    return nullptr;
+  }
+  return r;
+}
+
+void rio_close(void* handle) {
+  delete static_cast<Reader*>(handle);
+}
+
+int64_t rio_num_records(void* handle) {
+  return static_cast<int64_t>(static_cast<Reader*>(handle)->records.size());
+}
+
+int64_t rio_record_size(void* handle, int64_t idx) {
+  Reader* r = static_cast<Reader*>(handle);
+  if (idx < 0 || static_cast<size_t>(idx) >= r->records.size()) return -1;
+  return r->records[static_cast<size_t>(idx)].length;
+}
+
+// Copy record `idx` into buf. Returns bytes written, or negative required
+// size if buf is too small.
+int64_t rio_read(void* handle, int64_t idx, uint8_t* buf, int64_t buf_len) {
+  return static_cast<Reader*>(handle)->read_into(
+      static_cast<size_t>(idx), buf, static_cast<size_t>(buf_len));
+}
+
+// Start sequential read-ahead from record `start` with `depth` buffers.
+void rio_start_prefetch(void* handle, int64_t start, int64_t depth) {
+  Reader* r = static_cast<Reader*>(handle);
+  r->stop.store(true);
+  r->cv_push.notify_all();
+  r->cv_pop.notify_all();
+  if (r->worker.joinable()) r->worker.join();
+  {
+    std::lock_guard<std::mutex> lk(r->mu);
+    r->queue.clear();
+  }
+  r->stop.store(false);
+  r->capacity = depth > 0 ? static_cast<size_t>(depth) : 8;
+  r->worker = std::thread(&Reader::prefetch_loop, r, static_cast<size_t>(start));
+}
+
+// Pop the next prefetched record. Returns record index (or -1 at end /
+// -2 if buffer too small; required size written to *size_out).
+int64_t rio_next_prefetched(void* handle, uint8_t* buf, int64_t buf_len,
+                            int64_t* size_out) {
+  Reader* r = static_cast<Reader*>(handle);
+  std::unique_lock<std::mutex> lk(r->mu);
+  r->cv_pop.wait(lk, [&] { return !r->queue.empty() || r->stop.load(); });
+  if (r->queue.empty()) return -1;
+  auto& front = r->queue.front();
+  if (front.first == static_cast<size_t>(-1)) return -1;  // end marker
+  *size_out = static_cast<int64_t>(front.second.size());
+  if (static_cast<int64_t>(front.second.size()) > buf_len) return -2;
+  memcpy(buf, front.second.data(), front.second.size());
+  int64_t idx = static_cast<int64_t>(front.first);
+  r->queue.pop_front();
+  r->cv_push.notify_one();
+  return idx;
+}
+
+}  // extern "C"

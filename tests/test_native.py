@@ -1,0 +1,61 @@
+"""Native C++ RecordIO reader tests (reference: dmlc-core recordio tests)."""
+import numpy as np
+import pytest
+
+from mxnet_tpu import recordio
+from mxnet_tpu.native import get_lib, NativeRecordReader
+
+
+@pytest.fixture(scope="module")
+def lib():
+    lib = get_lib()
+    if lib is None:
+        pytest.skip("native toolchain unavailable")
+    return lib
+
+
+def _write_rec(path, n=20):
+    w = recordio.MXRecordIO(str(path), "w")
+    payloads = []
+    for i in range(n):
+        p = bytes([i % 251]) * (10 + 13 * i)
+        payloads.append(p)
+        w.write(p)
+    w.close()
+    return payloads
+
+
+def test_native_scan_and_read(tmp_path, lib):
+    path = tmp_path / "x.rec"
+    payloads = _write_rec(path)
+    r = NativeRecordReader(str(path))
+    assert len(r) == len(payloads)
+    for i in (0, 3, 19, 7):
+        assert r.read(i) == payloads[i]
+    r.close()
+
+
+def test_native_prefetch_stream(tmp_path, lib):
+    path = tmp_path / "y.rec"
+    payloads = _write_rec(path, n=50)
+    r = NativeRecordReader(str(path))
+    r.start_prefetch(0, depth=4)
+    seen = {}
+    while True:
+        idx, data = r.next_prefetched()
+        if idx is None:
+            break
+        seen[idx] = data
+    assert len(seen) == 50
+    for i, p in enumerate(payloads):
+        assert seen[i] == p
+    r.close()
+
+
+def test_native_matches_python_reader(tmp_path, lib):
+    path = tmp_path / "z.rec"
+    payloads = _write_rec(path, n=10)
+    py = recordio.MXRecordIO(str(path), "r")
+    native = NativeRecordReader(str(path))
+    for i in range(10):
+        assert py.read() == native.read(i)
