@@ -1,0 +1,349 @@
+"""``mx.image`` — image decode/augment utilities and ImageIter.
+
+Reference parity: ``python/mxnet/image/image.py`` (imdecode/imread/imresize/
+fixed_crop/random_crop/center_crop/color_normalize, Augmenter zoo,
+CreateAugmenter, ImageIter). Decode runs through PIL (libjpeg-turbo) on host
+threads; resize on device uses jax.image when arrays are already device-side.
+"""
+from __future__ import annotations
+
+import io as _io
+import os
+import random
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from . import ndarray as nd
+from .base import MXNetError
+from .ndarray import NDArray
+
+__all__ = ["imread", "imdecode", "imresize", "resize_short", "fixed_crop",
+           "random_crop", "center_crop", "color_normalize", "Augmenter",
+           "ResizeAug", "ForceResizeAug", "RandomCropAug", "CenterCropAug",
+           "HorizontalFlipAug", "BrightnessJitterAug", "ContrastJitterAug",
+           "SaturationJitterAug", "ColorJitterAug", "LightingAug", "CastAug",
+           "CreateAugmenter", "ImageIter"]
+
+
+def imdecode(buf, flag=1, to_rgb=True, **kwargs) -> NDArray:
+    from PIL import Image
+    img = Image.open(_io.BytesIO(buf if isinstance(buf, bytes) else bytes(buf)))
+    if flag == 0:
+        img = img.convert("L")
+        arr = np.asarray(img)[:, :, None]
+    else:
+        img = img.convert("RGB")
+        arr = np.asarray(img)
+        if not to_rgb:
+            arr = arr[:, :, ::-1]
+    return nd.array(arr, dtype="uint8")
+
+
+def imread(filename, flag=1, to_rgb=True) -> NDArray:
+    with open(filename, "rb") as f:
+        return imdecode(f.read(), flag, to_rgb)
+
+
+def imresize(src: NDArray, w: int, h: int, interp=1) -> NDArray:
+    from PIL import Image
+    arr = src.asnumpy()
+    pil = Image.fromarray(arr.squeeze() if arr.shape[-1] == 1 else arr)
+    out = np.asarray(pil.resize((w, h),
+                                Image.NEAREST if interp == 0 else Image.BILINEAR))
+    if out.ndim == 2:
+        out = out[:, :, None]
+    return nd.array(out, dtype=str(src.dtype))
+
+
+def resize_short(src: NDArray, size: int, interp=2) -> NDArray:
+    h, w = src.shape[0], src.shape[1]
+    if h > w:
+        new_w, new_h = size, int(h * size / w)
+    else:
+        new_w, new_h = int(w * size / h), size
+    return imresize(src, new_w, new_h, interp)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2) -> NDArray:
+    out = src[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        out = imresize(out, size[0], size[1], interp)
+    return out
+
+
+def random_crop(src, size, interp=2):
+    h, w = src.shape[0], src.shape[1]
+    new_w, new_h = min(size[0], w), min(size[1], h)
+    x0 = random.randint(0, w - new_w)
+    y0 = random.randint(0, h - new_h)
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def center_crop(src, size, interp=2):
+    h, w = src.shape[0], src.shape[1]
+    new_w, new_h = min(size[0], w), min(size[1], h)
+    x0 = (w - new_w) // 2
+    y0 = (h - new_h) // 2
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def color_normalize(src, mean, std=None) -> NDArray:
+    src = src.astype("float32", copy=False)
+    out = src - (mean if isinstance(mean, NDArray) else nd.array(np.asarray(mean)))
+    if std is not None:
+        out = out / (std if isinstance(std, NDArray) else nd.array(np.asarray(std)))
+    return out
+
+
+# ---------------------------------------------------------------- augmenters
+class Augmenter:
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def __call__(self, src: NDArray) -> NDArray:
+        raise NotImplementedError
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return imresize(src, self.size[0], self.size[1], self.interp)
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p=0.5):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if random.random() < self.p:
+            return nd.flip(src, axis=1)
+        return src
+
+
+class BrightnessJitterAug(Augmenter):
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + random.uniform(-self.brightness, self.brightness)
+        return src.astype("float32", copy=False) * alpha
+
+
+class ContrastJitterAug(Augmenter):
+    def __init__(self, contrast):
+        super().__init__(contrast=contrast)
+        self.contrast = contrast
+
+    def __call__(self, src):
+        alpha = 1.0 + random.uniform(-self.contrast, self.contrast)
+        src = src.astype("float32", copy=False)
+        gray = float(nd.mean(src).asscalar())
+        return src * alpha + gray * (1 - alpha)
+
+
+class SaturationJitterAug(Augmenter):
+    def __init__(self, saturation):
+        super().__init__(saturation=saturation)
+        self.saturation = saturation
+
+    def __call__(self, src):
+        alpha = 1.0 + random.uniform(-self.saturation, self.saturation)
+        src = src.astype("float32", copy=False)
+        coef = nd.array(np.array([0.299, 0.587, 0.114], dtype="float32")
+                        .reshape(1, 1, 3))
+        gray = nd.sum(src * coef, axis=2, keepdims=True)
+        return src * alpha + gray * (1 - alpha)
+
+
+class ColorJitterAug(Augmenter):
+    def __init__(self, brightness=0, contrast=0, saturation=0):
+        super().__init__(brightness=brightness, contrast=contrast,
+                         saturation=saturation)
+        self.augs = []
+        if brightness:
+            self.augs.append(BrightnessJitterAug(brightness))
+        if contrast:
+            self.augs.append(ContrastJitterAug(contrast))
+        if saturation:
+            self.augs.append(SaturationJitterAug(saturation))
+
+    def __call__(self, src):
+        augs = list(self.augs)
+        random.shuffle(augs)
+        for a in augs:
+            src = a(src)
+        return src
+
+
+class LightingAug(Augmenter):
+    """PCA-based lighting noise (AlexNet-style)."""
+
+    def __init__(self, alphastd, eigval, eigvec):
+        super().__init__(alphastd=alphastd)
+        self.alphastd = alphastd
+        self.eigval = np.asarray(eigval, dtype="float32")
+        self.eigvec = np.asarray(eigvec, dtype="float32")
+
+    def __call__(self, src):
+        alpha = np.random.normal(0, self.alphastd, size=(3,)).astype("float32")
+        rgb = (self.eigvec * alpha * self.eigval).sum(axis=1)
+        return src.astype("float32", copy=False) + nd.array(rgb.reshape(1, 1, 3))
+
+
+class CastAug(Augmenter):
+    def __init__(self, typ="float32"):
+        super().__init__(typ=typ)
+        self.typ = typ
+
+    def __call__(self, src):
+        return src.astype(self.typ, copy=False)
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, pca_noise=0, inter_method=2,
+                    **kwargs) -> List[Augmenter]:
+    """Standard augmentation list builder (reference image.py:CreateAugmenter)."""
+    auglist: List[Augmenter] = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if pca_noise > 0:
+        eigval = [55.46, 4.794, 1.148]
+        eigvec = [[-0.5675, 0.7192, 0.4009],
+                  [-0.5808, -0.0045, -0.8140],
+                  [-0.5836, -0.6948, 0.4203]]
+        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53], dtype="float32")
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375], dtype="float32")
+    if mean is not None and np.asarray(mean).any():
+        class _Norm(Augmenter):
+            def __call__(self, src):
+                return color_normalize(src, nd.array(np.asarray(mean, dtype="float32")),
+                                       nd.array(np.asarray(std, dtype="float32"))
+                                       if std is not None else None)
+        auglist.append(_Norm())
+    return auglist
+
+
+class ImageIter:
+    """Image iterator over .rec or .lst+raw files with augmenters
+    (reference image.py:ImageIter)."""
+
+    def __init__(self, batch_size, data_shape, label_width=1, path_imgrec=None,
+                 path_imglist=None, path_root="", shuffle=False, aug_list=None,
+                 imglist=None, data_name="data", label_name="softmax_label",
+                 **kwargs):
+        self.batch_size = batch_size
+        self.data_shape = tuple(data_shape)
+        self.auglist = aug_list if aug_list is not None else \
+            CreateAugmenter((0,) + self.data_shape, **kwargs)
+        self._entries: List = []
+        if path_imgrec:
+            from .io.io import ImageRecordIter
+            self._rec_iter = ImageRecordIter(
+                path_imgrec=path_imgrec, data_shape=self.data_shape,
+                batch_size=batch_size, shuffle=shuffle, **kwargs)
+        else:
+            self._rec_iter = None
+            entries = []
+            if imglist is not None:
+                entries = [(float(l[0]), os.path.join(path_root, l[1]))
+                           for l in imglist]
+            elif path_imglist:
+                with open(path_imglist) as f:
+                    for line in f:
+                        parts = line.strip().split("\t")
+                        entries.append((float(parts[1]),
+                                        os.path.join(path_root, parts[-1])))
+            self._entries = entries
+            self._order = list(range(len(entries)))
+            self._shuffle = shuffle
+            self._pos = 0
+
+    def reset(self):
+        if self._rec_iter is not None:
+            self._rec_iter.reset()
+        else:
+            self._pos = 0
+            if self._shuffle:
+                random.shuffle(self._order)
+
+    def __iter__(self):
+        return self
+
+    def next(self):
+        from .io.io import DataBatch
+        if self._rec_iter is not None:
+            return self._rec_iter.next()
+        if self._pos >= len(self._entries):
+            raise StopIteration
+        datas, labels = [], []
+        while len(datas) < self.batch_size and self._pos < len(self._entries):
+            label, path = self._entries[self._order[self._pos]]
+            img = imread(path)
+            for aug in self.auglist:
+                img = aug(img)
+            datas.append(nd.transpose(img.astype("float32", copy=False),
+                                      axes=(2, 0, 1)))
+            labels.append(label)
+            self._pos += 1
+        pad = self.batch_size - len(datas)
+        while len(datas) < self.batch_size:
+            datas.append(datas[-1])
+            labels.append(labels[-1])
+        return DataBatch(data=[nd.stack(*datas, axis=0)],
+                         label=[nd.array(np.asarray(labels, dtype="float32"))],
+                         pad=pad)
+
+    __next__ = next

@@ -16,6 +16,7 @@ import numpy as np
 from .ndarray import NDArray, array, _wrap, _unwrap
 from .utils import (zeros, ones, full, empty, arange, save, load, concat,
                     stack, split, one_hot, concatenate, moveaxis)
+from . import sparse
 from .. import random as _random
 from .._imperative import invoke
 from ..context import Context, current_context

@@ -1,0 +1,225 @@
+"""Sparse NDArray: row_sparse and CSR storage.
+
+Reference parity: ``include/mxnet/ndarray.h:61-66`` storage types +
+``python/mxnet/ndarray/sparse.py`` (RowSparseNDArray, CSRNDArray,
+row_sparse_array/csr_matrix constructors, retain, sparse dot).
+
+TPU-first (SURVEY.md hard part #3): XLA has no sparse HLOs, so
+- storage is faithful (values+indices / data+indices+indptr on device),
+- CSR matmul lowers through ``jax.experimental.sparse.BCOO`` (XLA
+  gather/scatter + segment-sum emulation — the documented strategy),
+- row_sparse exists chiefly for the KVStore ``row_sparse_pull`` /
+  sparse-gradient pattern: ops that need dense math densify explicitly
+  (``tostype('default')``), never silently.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError
+from ..context import Context
+from .ndarray import NDArray, array as nd_array, _unwrap, _wrap
+
+__all__ = ["RowSparseNDArray", "CSRNDArray", "row_sparse_array", "csr_matrix",
+           "zeros", "empty", "retain", "dot"]
+
+
+class BaseSparseNDArray:
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def dtype(self):
+        return np.dtype(self._values.dtype)
+
+    @property
+    def context(self):
+        return _wrap(self._values).context
+
+    def asnumpy(self):
+        return np.asarray(self.todense()._data)
+
+    def __repr__(self):
+        return f"<{type(self).__name__} {'x'.join(map(str, self.shape))}>"
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """Rows `indices` hold `values`; all other rows are zero
+    (reference ndarray.h kRowSparseStorage)."""
+
+    stype = "row_sparse"
+
+    def __init__(self, values, indices, shape):
+        self._values = _unwrap(values) if not isinstance(values, np.ndarray) \
+            else jnp.asarray(values)
+        self._indices = jnp.asarray(_unwrap(indices)).astype(jnp.int64)
+        self._shape = tuple(shape)
+
+    @property
+    def data(self) -> NDArray:
+        return _wrap(self._values)
+
+    @property
+    def indices(self) -> NDArray:
+        return _wrap(self._indices)
+
+    def copy(self):
+        return RowSparseNDArray(jnp.copy(self._values),
+                                jnp.copy(self._indices), self._shape)
+
+    def todense(self) -> NDArray:
+        out = jnp.zeros(self._shape, dtype=self._values.dtype)
+        return _wrap(out.at[self._indices].add(self._values))
+
+    def tostype(self, stype: str):
+        if stype == "row_sparse":
+            return self
+        if stype == "default":
+            return self.todense()
+        raise MXNetError(f"cannot convert row_sparse to {stype}")
+
+    def retain(self, row_ids) -> "RowSparseNDArray":
+        """Keep only rows in row_ids (reference sparse_retain op)."""
+        rid = jnp.asarray(_unwrap(row_ids)).astype(jnp.int64)
+        dense = _unwrap(self.todense())
+        vals = jnp.take(dense, rid, axis=0)
+        return RowSparseNDArray(vals, rid, self._shape)
+
+    def __add__(self, other):
+        if isinstance(other, RowSparseNDArray):
+            return self.todense() + other.todense()
+        return self.todense() + other
+
+    def wait_to_read(self):
+        self._values.block_until_ready()
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """Compressed sparse row matrix (reference ndarray.h kCSRStorage)."""
+
+    stype = "csr"
+
+    def __init__(self, data, indices, indptr, shape):
+        self._values = jnp.asarray(_unwrap(data))
+        self._indices = jnp.asarray(_unwrap(indices)).astype(jnp.int32)
+        self._indptr = jnp.asarray(_unwrap(indptr)).astype(jnp.int32)
+        self._shape = tuple(shape)
+
+    @property
+    def data(self) -> NDArray:
+        return _wrap(self._values)
+
+    @property
+    def indices(self) -> NDArray:
+        return _wrap(self._indices)
+
+    @property
+    def indptr(self) -> NDArray:
+        return _wrap(self._indptr)
+
+    def _row_ids(self):
+        counts = self._indptr[1:] - self._indptr[:-1]
+        return jnp.repeat(jnp.arange(self._shape[0]), counts,
+                          total_repeat_length=self._values.shape[0])
+
+    def _bcoo(self):
+        from jax.experimental import sparse as jsparse
+        rows = self._row_ids()
+        idx = jnp.stack([rows, self._indices.astype(jnp.int64)], axis=1)
+        return jsparse.BCOO((self._values, idx), shape=self._shape)
+
+    def todense(self) -> NDArray:
+        rows = self._row_ids()
+        out = jnp.zeros(self._shape, dtype=self._values.dtype)
+        return _wrap(out.at[rows, self._indices].add(self._values))
+
+    def tostype(self, stype: str):
+        if stype == "csr":
+            return self
+        if stype == "default":
+            return self.todense()
+        raise MXNetError(f"cannot convert csr to {stype}")
+
+    def dot(self, rhs, transpose_a=False) -> NDArray:
+        """CSR × dense via BCOO matmul (XLA gather/segment-sum lowering)."""
+        b = self._bcoo()
+        if transpose_a:
+            b = b.T
+        return _wrap(b @ _unwrap(rhs))
+
+    def wait_to_read(self):
+        self._values.block_until_ready()
+
+    def __getitem__(self, i):
+        return self.todense()[i]
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None) -> RowSparseNDArray:
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        values, indices = arg1
+        values = np.asarray(values, dtype=dtype or "float32")
+        if shape is None:
+            raise MXNetError("row_sparse_array((data, indices)) needs shape")
+        return RowSparseNDArray(jnp.asarray(values), jnp.asarray(indices), shape)
+    dense = np.asarray(arg1, dtype=dtype or "float32")
+    nz_rows = np.where(np.abs(dense).sum(axis=tuple(range(1, dense.ndim))) > 0)[0]
+    return RowSparseNDArray(jnp.asarray(dense[nz_rows]), jnp.asarray(nz_rows),
+                            dense.shape)
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None) -> CSRNDArray:
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        if shape is None:
+            raise MXNetError("csr_matrix((data, indices, indptr)) needs shape")
+        return CSRNDArray(np.asarray(data, dtype=dtype or "float32"),
+                          np.asarray(indices), np.asarray(indptr), shape)
+    dense = np.asarray(arg1, dtype=dtype or "float32")
+    try:
+        import scipy.sparse as sp
+        m = sp.csr_matrix(dense)
+        return CSRNDArray(m.data.astype(dense.dtype), m.indices, m.indptr,
+                          dense.shape)
+    except ImportError:
+        indptr = [0]
+        data, indices = [], []
+        for row in dense:
+            nz = np.nonzero(row)[0]
+            indices.extend(nz.tolist())
+            data.extend(row[nz].tolist())
+            indptr.append(len(indices))
+        return CSRNDArray(np.asarray(data, dtype=dense.dtype),
+                          np.asarray(indices), np.asarray(indptr), dense.shape)
+
+
+def zeros(stype, shape, ctx=None, dtype="float32"):
+    if stype == "row_sparse":
+        return RowSparseNDArray(jnp.zeros((0,) + tuple(shape[1:]), jnp.dtype(dtype)),
+                                jnp.zeros((0,), jnp.int64), shape)
+    if stype == "csr":
+        return CSRNDArray(np.zeros(0, dtype), np.zeros(0, "int32"),
+                          np.zeros(shape[0] + 1, "int32"), shape)
+    from .utils import zeros as dense_zeros
+    return dense_zeros(shape, ctx=ctx, dtype=dtype)
+
+
+empty = zeros
+
+
+def retain(data: RowSparseNDArray, indices) -> RowSparseNDArray:
+    return data.retain(indices)
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False) -> NDArray:
+    if isinstance(lhs, CSRNDArray):
+        if transpose_b:
+            rhs = rhs.T
+        return lhs.dot(rhs, transpose_a=transpose_a)
+    from .._imperative import invoke
+    return invoke("dot", [lhs, rhs], {"transpose_a": transpose_a,
+                                      "transpose_b": transpose_b})

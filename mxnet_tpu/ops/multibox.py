@@ -1,0 +1,229 @@
+"""SSD detection ops: MultiBoxPrior / MultiBoxTarget / MultiBoxDetection,
+box_nms and box utilities.
+
+Reference parity: ``src/operator/contrib/`` multibox_prior.cc,
+multibox_target.cc, multibox_detection.cc, bounding_box.cc — the op set
+behind example/ssd (north-star config #4).
+
+TPU-first: everything is expressed with static shapes; NMS is the classic
+O(k²) masked suppression over the top-k candidates (XLA sort + matrix IoU),
+no dynamic output sizes — detections are fixed-size with -1 padding exactly
+like the reference's output convention.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+def _corner_iou(a, b):
+    """IoU between two corner-format box sets: a (N,4), b (M,4) → (N,M)."""
+    ax1, ay1, ax2, ay2 = a[:, 0:1], a[:, 1:2], a[:, 2:3], a[:, 3:4]
+    bx1, by1, bx2, by2 = b[None, :, 0], b[None, :, 1], b[None, :, 2], b[None, :, 3]
+    ix1 = jnp.maximum(ax1, bx1)
+    iy1 = jnp.maximum(ay1, by1)
+    ix2 = jnp.minimum(ax2, bx2)
+    iy2 = jnp.minimum(ay2, by2)
+    iw = jnp.maximum(ix2 - ix1, 0.0)
+    ih = jnp.maximum(iy2 - iy1, 0.0)
+    inter = iw * ih
+    area_a = jnp.maximum((ax2 - ax1) * (ay2 - ay1), 0.0)
+    area_b = jnp.maximum((bx2 - bx1) * (by2 - by1), 0.0)
+    return inter / jnp.maximum(area_a + area_b - inter, 1e-12)
+
+
+@register("_contrib_MultiBoxPrior", aliases=["contrib_MultiBoxPrior"],
+          differentiable=False)
+def _multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False, steps=(-1.0, -1.0),
+                    offsets=(0.5, 0.5)):
+    """Anchor generation (reference multibox_prior.cc): per feature-map cell,
+    len(sizes)+len(ratios)-1 anchors in corner format, normalized [0,1]."""
+    h, w = data.shape[2], data.shape[3]
+    step_y = steps[0] if steps[0] > 0 else 1.0 / h
+    step_x = steps[1] if steps[1] > 0 else 1.0 / w
+    cy = (jnp.arange(h) + offsets[0]) * step_y
+    cx = (jnp.arange(w) + offsets[1]) * step_x
+    cyx = jnp.stack(jnp.meshgrid(cy, cx, indexing="ij"), axis=-1)  # (h,w,2)
+
+    whs = []
+    s0 = sizes[0]
+    for s in sizes:
+        whs.append((s, s))
+    for r in ratios[1:]:
+        sr = jnp.sqrt(r) if not isinstance(r, (int, float)) else float(r) ** 0.5
+        whs.append((s0 * sr, s0 / sr))
+    anchors = []
+    for (aw, ah) in whs:
+        half_w, half_h = aw / 2.0, ah / 2.0
+        boxes = jnp.concatenate([
+            (cyx[..., 1] - half_w)[..., None], (cyx[..., 0] - half_h)[..., None],
+            (cyx[..., 1] + half_w)[..., None], (cyx[..., 0] + half_h)[..., None],
+        ], axis=-1)
+        anchors.append(boxes)
+    out = jnp.stack(anchors, axis=2).reshape(h * w * len(whs), 4)
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    return out[None]  # (1, num_anchors, 4)
+
+
+@register("_contrib_MultiBoxTarget", aliases=["contrib_MultiBoxTarget"],
+          num_outputs=3, differentiable=False)
+def _multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                     ignore_label=-1.0, negative_mining_ratio=-1.0,
+                     negative_mining_thresh=0.5, minimum_negative_samples=0,
+                     variances=(0.1, 0.1, 0.2, 0.2)):
+    """Anchor→GT matching + box-regression targets (reference
+    multibox_target.cc). label: (B, M, 5) [cls, x1, y1, x2, y2], -1 pad."""
+    anchors = anchor.reshape(-1, 4)  # (N, 4)
+    N = anchors.shape[0]
+    B = label.shape[0]
+
+    acx = (anchors[:, 0] + anchors[:, 2]) / 2
+    acy = (anchors[:, 1] + anchors[:, 3]) / 2
+    aw = jnp.maximum(anchors[:, 2] - anchors[:, 0], 1e-12)
+    ah = jnp.maximum(anchors[:, 3] - anchors[:, 1], 1e-12)
+
+    def one_sample(lab):
+        valid = lab[:, 0] >= 0  # (M,)
+        gt = lab[:, 1:5]
+        iou = _corner_iou(anchors, gt)  # (N, M)
+        iou = jnp.where(valid[None, :], iou, -1.0)
+        best_gt = jnp.argmax(iou, axis=1)          # per anchor
+        best_iou = jnp.max(iou, axis=1)
+        matched = best_iou >= overlap_threshold
+        # force-match: each valid gt claims its best anchor
+        best_anchor = jnp.argmax(iou, axis=0)      # (M,)
+        force = jnp.zeros(N, bool).at[best_anchor].set(valid)
+        force_gt = jnp.zeros(N, jnp.int32).at[best_anchor].set(
+            jnp.arange(gt.shape[0], dtype=jnp.int32))
+        matched = matched | force
+        gt_idx = jnp.where(force, force_gt, best_gt)
+
+        g = gt[gt_idx]
+        gcx = (g[:, 0] + g[:, 2]) / 2
+        gcy = (g[:, 1] + g[:, 3]) / 2
+        gw = jnp.maximum(g[:, 2] - g[:, 0], 1e-12)
+        gh = jnp.maximum(g[:, 3] - g[:, 1], 1e-12)
+        tx = (gcx - acx) / aw / variances[0]
+        ty = (gcy - acy) / ah / variances[1]
+        tw = jnp.log(gw / aw) / variances[2]
+        th = jnp.log(gh / ah) / variances[3]
+        loc_t = jnp.stack([tx, ty, tw, th], axis=1)
+        loc_t = jnp.where(matched[:, None], loc_t, 0.0).reshape(-1)
+        loc_mask = jnp.where(matched[:, None],
+                             jnp.ones((N, 4)), jnp.zeros((N, 4))).reshape(-1)
+        cls_t = jnp.where(matched, lab[gt_idx, 0] + 1.0, 0.0)
+        return loc_t, loc_mask, cls_t
+
+    loc_t, loc_mask, cls_t = jax.vmap(one_sample)(label)
+    return loc_t, loc_mask, cls_t
+
+
+@register("_contrib_MultiBoxDetection", aliases=["contrib_MultiBoxDetection"],
+          differentiable=False)
+def _multibox_detection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
+                        background_id=0, nms_threshold=0.5, force_suppress=False,
+                        variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1):
+    """Decode + per-class NMS (reference multibox_detection.cc). Output
+    (B, N, 6) rows [cls_id, score, x1, y1, x2, y2]; suppressed rows cls=-1."""
+    anchors = anchor.reshape(-1, 4)
+    N = anchors.shape[0]
+    acx = (anchors[:, 0] + anchors[:, 2]) / 2
+    acy = (anchors[:, 1] + anchors[:, 3]) / 2
+    aw = jnp.maximum(anchors[:, 2] - anchors[:, 0], 1e-12)
+    ah = jnp.maximum(anchors[:, 3] - anchors[:, 1], 1e-12)
+
+    def one_sample(probs, locs):
+        l = locs.reshape(-1, 4)
+        cx = l[:, 0] * variances[0] * aw + acx
+        cy = l[:, 1] * variances[1] * ah + acy
+        w = jnp.exp(l[:, 2] * variances[2]) * aw
+        h = jnp.exp(l[:, 3] * variances[3]) * ah
+        boxes = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], axis=1)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        # best non-background class per anchor (reference keeps argmax class)
+        fg = jnp.concatenate([probs[:background_id],
+                              probs[background_id + 1:]], axis=0)
+        cls_id = jnp.argmax(fg, axis=0)
+        cls_id = jnp.where(cls_id >= background_id, cls_id + 1, cls_id) \
+            if background_id == 0 else cls_id
+        score = jnp.max(fg, axis=0)
+        keep = score > threshold
+        cls_of = jnp.where(keep, (cls_id - 1).astype(jnp.float32), -1.0)
+        order = jnp.argsort(-score)
+        boxes_s = boxes[order]
+        score_s = score[order]
+        cls_s = cls_of[order]
+        iou = _corner_iou(boxes_s, boxes_s)
+        same_cls = (cls_s[:, None] == cls_s[None, :]) | force_suppress
+        sup_candidate = (iou > nms_threshold) & same_cls
+        tri = jnp.tril(jnp.ones((N, N), bool), k=-1)  # j suppressed by earlier i
+
+        def body(i, alive):
+            row = sup_candidate[i] & tri.T[i]  # boxes after i overlapping i
+            return jnp.where(alive[i], alive & ~row, alive)
+
+        alive = lax.fori_loop(0, N, body, cls_s >= 0)
+        cls_final = jnp.where(alive, cls_s, -1.0)
+        return jnp.concatenate([cls_final[:, None], score_s[:, None], boxes_s],
+                               axis=1)
+
+    return jax.vmap(one_sample)(cls_prob, loc_pred)
+
+
+@register("_contrib_box_nms", aliases=["contrib_box_nms", "box_nms"],
+          differentiable=False)
+def _box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1, coord_start=2,
+             score_index=1, id_index=0, background_id=-1, force_suppress=False,
+             in_format="corner", out_format="corner"):
+    """Generic NMS (reference bounding_box.cc box_nms). data (..., N, K)."""
+    def one(arr):
+        N = arr.shape[0]
+        score = arr[:, score_index]
+        boxes = lax.dynamic_slice_in_dim(arr, coord_start, 4, axis=1)
+        if in_format == "center":
+            cx, cy, w, h = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+            boxes = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], 1)
+        ids = arr[:, id_index] if id_index >= 0 else jnp.zeros(N)
+        valid = score > valid_thresh
+        if background_id >= 0 and id_index >= 0:
+            valid = valid & (ids != background_id)
+        order = jnp.argsort(-jnp.where(valid, score, -jnp.inf))
+        arr_s = arr[order]
+        boxes_s = boxes[order]
+        ids_s = ids[order]
+        valid_s = valid[order]
+        if topk > 0:
+            valid_s = valid_s & (jnp.arange(N) < topk)
+        iou = _corner_iou(boxes_s, boxes_s)
+        same = (ids_s[:, None] == ids_s[None, :]) | force_suppress
+
+        def body(i, alive):
+            row = (iou[i] > overlap_thresh) & same[i] & (jnp.arange(N) > i)
+            return jnp.where(alive[i], alive & ~row, alive)
+
+        alive = lax.fori_loop(0, N, body, valid_s)
+        out = jnp.where(alive[:, None], arr_s,
+                        jnp.full_like(arr_s, -1.0))
+        return out
+
+    flat = data.reshape((-1,) + data.shape[-2:])
+    out = jax.vmap(one)(flat)
+    return out.reshape(data.shape)
+
+
+@register("_contrib_box_iou", aliases=["contrib_box_iou"], differentiable=False)
+def _box_iou(lhs, rhs, format="corner"):
+    a = lhs.reshape(-1, 4)
+    b = rhs.reshape(-1, 4)
+    if format == "center":
+        def c2c(x):
+            return jnp.stack([x[:, 0] - x[:, 2] / 2, x[:, 1] - x[:, 3] / 2,
+                              x[:, 0] + x[:, 2] / 2, x[:, 1] + x[:, 3] / 2], 1)
+        a, b = c2c(a), c2c(b)
+    iou = _corner_iou(a, b)
+    return iou.reshape(lhs.shape[:-1] + rhs.shape[:-1])

@@ -1,0 +1,70 @@
+"""SSD op tests (reference: tests/python/unittest/test_operator.py multibox
+sections + test_contrib_bounding_box)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_multibox_prior_shapes():
+    data = nd.zeros((1, 8, 4, 4))
+    anchors = nd.contrib_MultiBoxPrior(data, sizes=(0.5, 0.25), ratios=(1, 2))
+    # per cell: len(sizes)+len(ratios)-1 = 3 anchors
+    assert anchors.shape == (1, 4 * 4 * 3, 4)
+    a = anchors.asnumpy()[0]
+    assert (a[:, 2] >= a[:, 0]).all() and (a[:, 3] >= a[:, 1]).all()
+    # first anchor of the first cell centered at (0.5/4, 0.5/4)
+    cx = (a[0, 0] + a[0, 2]) / 2
+    np.testing.assert_allclose(cx, 0.125, atol=1e-6)
+
+
+def test_multibox_target_matching():
+    anchors = nd.array([[[0.0, 0.0, 0.5, 0.5],
+                         [0.5, 0.5, 1.0, 1.0],
+                         [0.0, 0.5, 0.5, 1.0]]])
+    # one gt box matching anchor 0 (cls 2)
+    label = nd.array([[[2.0, 0.05, 0.05, 0.45, 0.45],
+                       [-1.0, 0.0, 0.0, 0.0, 0.0]]])
+    cls_pred = nd.zeros((1, 3, 3))
+    loc_t, loc_mask, cls_t = nd.contrib_MultiBoxTarget(anchors, label, cls_pred)
+    ct = cls_t.asnumpy()[0]
+    assert ct[0] == 3.0  # cls + 1
+    assert ct[1] == 0.0 and ct[2] == 0.0
+    lm = loc_mask.asnumpy()[0].reshape(3, 4)
+    assert lm[0].sum() == 4 and lm[1].sum() == 0
+
+
+def test_multibox_detection_and_nms():
+    anchors = nd.array([[[0.0, 0.0, 0.5, 0.5],
+                         [0.01, 0.01, 0.52, 0.52],
+                         [0.5, 0.5, 1.0, 1.0]]])
+    # class probs: (B, num_cls+1, N) — background + 1 class
+    cls_prob = nd.array([[[0.1, 0.2, 0.9],
+                          [0.9, 0.8, 0.1]]])
+    loc_pred = nd.zeros((1, 12))
+    out = nd.contrib_MultiBoxDetection(cls_prob, loc_pred, anchors,
+                                       nms_threshold=0.5,
+                                       threshold=0.2).asnumpy()[0]
+    kept = out[out[:, 0] >= 0]
+    # anchors 0/1 overlap heavily -> one suppressed; anchor 2 is background
+    assert len(kept) == 1
+    assert kept[0][1] == pytest.approx(0.9, abs=1e-5)
+
+
+def test_box_nms():
+    data = nd.array([[0.0, 0.9, 0.0, 0.0, 0.5, 0.5],
+                     [0.0, 0.8, 0.01, 0.01, 0.51, 0.51],
+                     [0.0, 0.7, 0.6, 0.6, 1.0, 1.0]])
+    out = nd.contrib_box_nms(data, overlap_thresh=0.5).asnumpy()
+    assert out[0, 0] == 0.0        # best box kept
+    assert out[1, 0] == -1.0       # overlapping suppressed
+    assert out[2, 0] == 0.0        # distant kept
+
+
+def test_box_iou():
+    a = nd.array([[0.0, 0.0, 1.0, 1.0]])
+    b = nd.array([[0.5, 0.5, 1.5, 1.5], [0.0, 0.0, 1.0, 1.0]])
+    iou = nd.contrib_box_iou(a, b).asnumpy()
+    np.testing.assert_allclose(iou[0, 0], 0.25 / 1.75, rtol=1e-5)
+    np.testing.assert_allclose(iou[0, 1], 1.0, rtol=1e-5)

@@ -1,0 +1,64 @@
+"""Sparse NDArray tests (reference: tests/python/unittest/test_sparse_ndarray.py,
+test_sparse_operator.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.ndarray import sparse
+
+
+def test_row_sparse_roundtrip(rng):
+    dense = np.zeros((6, 3), dtype="float32")
+    dense[1] = rng.randn(3)
+    dense[4] = rng.randn(3)
+    rs = sparse.row_sparse_array(dense)
+    assert rs.stype == "row_sparse"
+    assert rs.indices.asnumpy().tolist() == [1, 4]
+    np.testing.assert_allclose(rs.asnumpy(), dense, rtol=1e-6)
+    back = rs.tostype("default")
+    np.testing.assert_allclose(back.asnumpy(), dense, rtol=1e-6)
+
+
+def test_row_sparse_from_tuple():
+    rs = sparse.row_sparse_array((np.ones((2, 4)), [0, 3]), shape=(5, 4))
+    d = rs.asnumpy()
+    assert d[0].sum() == 4 and d[3].sum() == 4
+    assert d[[1, 2, 4]].sum() == 0
+
+
+def test_row_sparse_retain(rng):
+    rs = sparse.row_sparse_array((rng.randn(3, 2).astype("float32"), [1, 2, 4]),
+                                 shape=(6, 2))
+    kept = sparse.retain(rs, nd.array([2, 4], dtype="int64"))
+    d = kept.asnumpy()
+    assert np.abs(d[[0, 1, 3, 5]]).sum() == 0
+    np.testing.assert_allclose(d[2], rs.asnumpy()[2], rtol=1e-6)
+
+
+def test_csr_roundtrip_and_dot(rng):
+    dense = (rng.rand(5, 7) > 0.6).astype("float32") * rng.randn(5, 7).astype("float32")
+    csr = sparse.csr_matrix(dense)
+    assert csr.stype == "csr"
+    np.testing.assert_allclose(csr.asnumpy(), dense, rtol=1e-6)
+    w = rng.randn(7, 3).astype("float32")
+    out = sparse.dot(csr, nd.array(w))
+    np.testing.assert_allclose(out.asnumpy(), dense @ w, rtol=1e-4, atol=1e-5)
+    outT = sparse.dot(csr, nd.array(rng.randn(5, 2).astype("float32")),
+                      transpose_a=True)
+    assert outT.shape == (7, 2)
+
+
+def test_sparse_zeros():
+    rs = sparse.zeros("row_sparse", (4, 3))
+    assert rs.asnumpy().sum() == 0
+    csr = sparse.zeros("csr", (4, 3))
+    assert csr.asnumpy().sum() == 0
+
+
+def test_kvstore_row_sparse_interop(rng):
+    kv = mx.kv.create("local")
+    kv.init("w", nd.array(rng.randn(8, 2).astype("float32")))
+    out = nd.zeros((8, 2))
+    kv.row_sparse_pull("w", out=out, row_ids=nd.array([0, 5], dtype="int64"))
+    assert np.abs(out.asnumpy()[[1, 2, 3, 4, 6, 7]]).sum() == 0
