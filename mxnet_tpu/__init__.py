@@ -31,6 +31,9 @@ from . import ndarray as nd
 from . import autograd
 from . import random
 from .ndarray import NDArray
+from .attribute import AttrScope
+from . import name
+from . import attribute
 
 # Submodules imported lazily to keep import light and avoid cycles.
 import importlib as _importlib

@@ -1,0 +1,58 @@
+"""Symbol attribute scoping.
+
+Reference parity: ``python/mxnet/attribute.py`` — ``AttrScope`` is a
+thread-local stack of attribute dicts applied to every symbol created inside
+the ``with`` block (used for ``ctx_group`` model-parallel placement,
+``lr_mult``/``wd_mult`` etc. — see SURVEY.md §2.3 model parallelism and
+``symbol.py:1290`` group2ctx).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+from .base import MXNetError
+
+__all__ = ["AttrScope"]
+
+
+class AttrScope:
+    """Attribute manager appending scope attrs to each created symbol."""
+
+    _state = threading.local()
+
+    def __init__(self, **kwargs):
+        for v in kwargs.values():
+            if not isinstance(v, str):
+                raise MXNetError("AttrScope values must be strings")
+        self._attr: Dict[str, str] = kwargs
+        self._old_scope: Optional["AttrScope"] = None
+
+    def get(self, attr: Optional[Dict[str, str]]) -> Dict[str, str]:
+        """Merge scope attrs with per-symbol ``attr`` (symbol wins)."""
+        if self._attr:
+            ret = self._attr.copy()
+            if attr:
+                ret.update(attr)
+            return ret
+        return attr if attr else {}
+
+    def __enter__(self):
+        if not hasattr(AttrScope._state, "current"):
+            AttrScope._state.current = AttrScope()
+        self._old_scope = AttrScope._state.current
+        attr = AttrScope._state.current._attr.copy()
+        attr.update(self._attr)
+        self._attr = attr
+        AttrScope._state.current = self
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        assert self._old_scope is not None
+        AttrScope._state.current = self._old_scope
+
+    @staticmethod
+    def current() -> "AttrScope":
+        if not hasattr(AttrScope._state, "current"):
+            AttrScope._state.current = AttrScope()
+        return AttrScope._state.current

@@ -287,7 +287,7 @@ class ImageIter:
         self.batch_size = batch_size
         self.data_shape = tuple(data_shape)
         self.auglist = aug_list if aug_list is not None else \
-            CreateAugmenter((0,) + self.data_shape, **kwargs)
+            CreateAugmenter(self.data_shape, **kwargs)
         self._entries: List = []
         if path_imgrec:
             from .io.io import ImageRecordIter

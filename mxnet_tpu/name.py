@@ -1,0 +1,62 @@
+"""Automatic naming of symbol nodes.
+
+Reference parity: ``python/mxnet/name.py`` — ``NameManager`` thread-local
+scope stack assigning unique names to anonymous ops, and ``Prefix`` which
+prepends a fixed prefix (used by Gluon name scopes). The reference keeps the
+current manager in a class attribute with ``__enter__/__exit__`` push/pop;
+we mirror that contract exactly.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+__all__ = ["NameManager", "Prefix"]
+
+
+class NameManager:
+    """Assigns ``{op}{counter}`` names to anonymous symbols (name.py:28)."""
+
+    _state = threading.local()
+
+    def __init__(self):
+        self._counter: Dict[str, int] = {}
+        self._old_manager: Optional["NameManager"] = None
+
+    def get(self, name: Optional[str], hint: str) -> str:
+        if name:
+            return name
+        if hint not in self._counter:
+            self._counter[hint] = 0
+        name = f"{hint}{self._counter[hint]}"
+        self._counter[hint] += 1
+        return name
+
+    def __enter__(self):
+        if not hasattr(NameManager._state, "current"):
+            NameManager._state.current = NameManager()
+        self._old_manager = NameManager._state.current
+        NameManager._state.current = self
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        assert self._old_manager is not None
+        NameManager._state.current = self._old_manager
+
+    @staticmethod
+    def current() -> "NameManager":
+        if not hasattr(NameManager._state, "current"):
+            NameManager._state.current = NameManager()
+        return NameManager._state.current
+
+
+class Prefix(NameManager):
+    """NameManager that prepends ``prefix`` to every name (name.py:74)."""
+
+    def __init__(self, prefix: str):
+        super().__init__()
+        self._prefix = prefix
+
+    def get(self, name: Optional[str], hint: str) -> str:
+        name = super().get(name, hint)
+        return self._prefix + name

@@ -86,7 +86,7 @@ def _multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
     aw = jnp.maximum(anchors[:, 2] - anchors[:, 0], 1e-12)
     ah = jnp.maximum(anchors[:, 3] - anchors[:, 1], 1e-12)
 
-    def one_sample(lab):
+    def one_sample(lab, pred):
         valid = lab[:, 0] >= 0  # (M,)
         gt = lab[:, 1:5]
         iou = _corner_iou(anchors, gt)  # (N, M)
@@ -94,11 +94,14 @@ def _multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
         best_gt = jnp.argmax(iou, axis=1)          # per anchor
         best_iou = jnp.max(iou, axis=1)
         matched = best_iou >= overlap_threshold
-        # force-match: each valid gt claims its best anchor
+        # force-match: each valid gt claims its best anchor; padded (invalid)
+        # gt rows scatter out of bounds and are dropped so they cannot
+        # clobber a valid gt's claim on anchor 0
         best_anchor = jnp.argmax(iou, axis=0)      # (M,)
-        force = jnp.zeros(N, bool).at[best_anchor].set(valid)
-        force_gt = jnp.zeros(N, jnp.int32).at[best_anchor].set(
-            jnp.arange(gt.shape[0], dtype=jnp.int32))
+        tgt = jnp.where(valid, best_anchor, N)
+        force = jnp.zeros(N, bool).at[tgt].set(True, mode="drop")
+        force_gt = jnp.zeros(N, jnp.int32).at[tgt].set(
+            jnp.arange(gt.shape[0], dtype=jnp.int32), mode="drop")
         matched = matched | force
         gt_idx = jnp.where(force, force_gt, best_gt)
 
@@ -116,9 +119,29 @@ def _multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
         loc_mask = jnp.where(matched[:, None],
                              jnp.ones((N, 4)), jnp.zeros((N, 4))).reshape(-1)
         cls_t = jnp.where(matched, lab[gt_idx, 0] + 1.0, 0.0)
+
+        if negative_mining_ratio > 0:
+            # Hard-negative mining (reference multibox_target.cc): keep the
+            # hardest unmatched anchors as background up to
+            # ratio*num_positives (min minimum_negative_samples); the rest
+            # get ignore_label. Hardness = 1 - p(background) from cls_pred
+            # (B, num_classes, N) softmax.
+            probs = jax.nn.softmax(pred, axis=0)
+            hardness = 1.0 - probs[0]
+            eligible = (~matched) & (best_iou < negative_mining_thresh)
+            num_pos = jnp.sum(matched)
+            num_neg = jnp.maximum(
+                (negative_mining_ratio * num_pos).astype(jnp.int32),
+                jnp.int32(minimum_negative_samples))
+            score = jnp.where(eligible, hardness, -jnp.inf)
+            order = jnp.argsort(-score)
+            rank = jnp.zeros(N, jnp.int32).at[order].set(jnp.arange(N, dtype=jnp.int32))
+            selected = eligible & (rank < num_neg)
+            cls_t = jnp.where(matched, cls_t,
+                              jnp.where(selected, 0.0, float(ignore_label)))
         return loc_t, loc_mask, cls_t
 
-    loc_t, loc_mask, cls_t = jax.vmap(one_sample)(label)
+    loc_t, loc_mask, cls_t = jax.vmap(one_sample)(label, cls_pred)
     return loc_t, loc_mask, cls_t
 
 
@@ -145,19 +168,24 @@ def _multibox_detection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
         boxes = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], axis=1)
         if clip:
             boxes = jnp.clip(boxes, 0.0, 1.0)
-        # best non-background class per anchor (reference keeps argmax class)
+        # best non-background class per anchor. The emitted class id is the
+        # index over non-background classes (reference convention: with
+        # background_id=0, original class k is emitted as k-1) — which is
+        # exactly the fg row index for any background_id.
         fg = jnp.concatenate([probs[:background_id],
                               probs[background_id + 1:]], axis=0)
         cls_id = jnp.argmax(fg, axis=0)
-        cls_id = jnp.where(cls_id >= background_id, cls_id + 1, cls_id) \
-            if background_id == 0 else cls_id
         score = jnp.max(fg, axis=0)
         keep = score > threshold
-        cls_of = jnp.where(keep, (cls_id - 1).astype(jnp.float32), -1.0)
+        cls_of = jnp.where(keep, cls_id.astype(jnp.float32), -1.0)
         order = jnp.argsort(-score)
         boxes_s = boxes[order]
         score_s = score[order]
         cls_s = cls_of[order]
+        alive0 = cls_s >= 0
+        if nms_topk > 0:
+            # only the top-k scoring candidates enter NMS (reference nms_topk)
+            alive0 = alive0 & (jnp.arange(N) < nms_topk)
         iou = _corner_iou(boxes_s, boxes_s)
         same_cls = (cls_s[:, None] == cls_s[None, :]) | force_suppress
         sup_candidate = (iou > nms_threshold) & same_cls
@@ -167,7 +195,7 @@ def _multibox_detection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
             row = sup_candidate[i] & tri.T[i]  # boxes after i overlapping i
             return jnp.where(alive[i], alive & ~row, alive)
 
-        alive = lax.fori_loop(0, N, body, cls_s >= 0)
+        alive = lax.fori_loop(0, N, body, alive0)
         cls_final = jnp.where(alive, cls_s, -1.0)
         return jnp.concatenate([cls_final[:, None], score_s[:, None], boxes_s],
                                axis=1)
@@ -207,6 +235,14 @@ def _box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1, coord_start=2,
             return jnp.where(alive[i], alive & ~row, alive)
 
         alive = lax.fori_loop(0, N, body, valid_s)
+        if out_format != in_format:
+            # rewrite the coordinate slice in the requested format
+            if out_format == "center":
+                x1, y1, x2, y2 = boxes_s[:, 0], boxes_s[:, 1], boxes_s[:, 2], boxes_s[:, 3]
+                conv = jnp.stack([(x1 + x2) / 2, (y1 + y2) / 2, x2 - x1, y2 - y1], 1)
+            else:  # center → corner (boxes_s already converted to corner above)
+                conv = boxes_s
+            arr_s = lax.dynamic_update_slice_in_dim(arr_s, conv, coord_start, axis=1)
         out = jnp.where(alive[:, None], arr_s,
                         jnp.full_like(arr_s, -1.0))
         return out

@@ -10,8 +10,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, List
 
-from .symbol import (Symbol, Variable, var, Group, load, load_json, _Node,
-                     _auto_name)
+from .symbol import Symbol, Variable, var, Group, load, load_json, _Node
 from ..ops.registry import get_op, list_ops, _REGISTRY
 from ..base import MXNetError
 
@@ -20,8 +19,12 @@ __all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json", "zeros",
 
 
 def _invoke_sym(op_name: str, sym_inputs: List[Symbol], kwargs: Dict[str, Any]) -> Symbol:
+    from ..name import NameManager
+    from ..attribute import AttrScope
     opdef = get_op(op_name)
-    name = kwargs.pop("name", None) or _auto_name(op_name)
+    hint = op_name.lower().lstrip("_")
+    name = NameManager.current().get(kwargs.pop("name", None), hint)
+    scope_attr = AttrScope.current().get(kwargs.pop("attr", None))
     kwargs.pop("ctx", None)
 
     # variadic ops (Concat/add_n/stack: arg_names() None) consume every output
@@ -66,6 +69,8 @@ def _invoke_sym(op_name: str, sym_inputs: List[Symbol], kwargs: Dict[str, Any]) 
                 final.append((vnode, 0))
         entries = final
     node = _Node(op_name, name, attrs, entries)
+    if scope_attr:
+        node._attr_dict.update(scope_attr)
     return Symbol([(node, i) for i in range(node.num_outputs)])
 
 

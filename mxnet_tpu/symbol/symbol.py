@@ -21,16 +21,6 @@ from ..ops.registry import get_op, OpDef
 
 __all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json"]
 
-_name_counters: Dict[str, int] = {}
-
-
-def _auto_name(op_name: str) -> str:
-    base = op_name.lower().lstrip("_")
-    i = _name_counters.get(base, 0)
-    _name_counters[base] = i + 1
-    return f"{base}{i}"
-
-
 class _Node:
     """One graph node: an op application or a variable (op=None)."""
 
@@ -308,8 +298,10 @@ class Symbol:
 
 def Variable(name: str, attr=None, shape=None, lr_mult=None, wd_mult=None,
              dtype=None, init=None, stype=None, **kwargs) -> Symbol:
+    from ..attribute import AttrScope
     node = _Node(None, name, {}, [])
     sym = Symbol([(node, 0)])
+    attr = AttrScope.current().get(attr)
     meta = {}
     if shape is not None:
         meta["__shape__"] = str(tuple(shape))

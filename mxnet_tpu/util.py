@@ -1,0 +1,93 @@
+"""General utilities.
+
+Reference parity: ``python/mxnet/util.py`` (np-shape toggles, feature
+helpers). On TPU the numpy-semantics toggles are accepted for source
+compatibility; zero-size shape handling is native to jax so ``np_shape``
+is effectively always-on and the setters simply record the flag.
+"""
+from __future__ import annotations
+
+import functools
+import inspect
+import os
+import threading
+from typing import Callable
+
+__all__ = ["is_np_shape", "set_np_shape", "np_shape", "use_np_shape",
+           "makedirs", "getenv", "setenv", "get_gpu_count", "get_gpu_memory"]
+
+_state = threading.local()
+
+
+def is_np_shape() -> bool:
+    """Whether numpy-compatible shape semantics are active (util.py:37).
+
+    jax handles zero-dim/zero-size arrays natively, so this only tracks the
+    user-visible flag for API compatibility."""
+    return getattr(_state, "np_shape", False)
+
+
+def set_np_shape(active: bool) -> bool:
+    prev = is_np_shape()
+    _state.np_shape = bool(active)
+    return prev
+
+
+class np_shape:
+    """Context manager / decorator toggling np-shape semantics (util.py:82)."""
+
+    def __init__(self, active: bool = True):
+        self._active = active
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = set_np_shape(self._active)
+        return self
+
+    def __exit__(self, *exc):
+        set_np_shape(self._prev)
+
+    def __call__(self, fn: Callable):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with np_shape(self._active):
+                return fn(*args, **kwargs)
+        return wrapper
+
+
+def use_np_shape(fn: Callable) -> Callable:
+    """Decorator form (util.py:170)."""
+    if inspect.isclass(fn):
+        return fn
+    return np_shape(True)(fn)
+
+
+def makedirs(d: str) -> None:
+    """``os.makedirs(exist_ok=True)`` shim (util.py:30)."""
+    os.makedirs(os.path.expanduser(d), exist_ok=True)
+
+
+def getenv(name: str):
+    return os.environ.get(name)
+
+
+def setenv(name: str, value) -> None:
+    os.environ[name] = str(value)
+
+
+def get_gpu_count() -> int:
+    """Accelerator count — TPU chips visible to jax (c_api MXGetGPUCount)."""
+    import jax
+    return len([d for d in jax.devices() if d.platform != "cpu"])
+
+
+def get_gpu_memory(dev_id: int = 0):
+    """(free, total) bytes on the accelerator if the backend reports it."""
+    import jax
+    devs = [d for d in jax.devices() if d.platform != "cpu"]
+    if dev_id >= len(devs):
+        raise ValueError(f"no accelerator {dev_id}")
+    stats = devs[dev_id].memory_stats() or {}
+    total = stats.get("bytes_limit", 0)
+    used = stats.get("bytes_in_use", 0)
+    return total - used, total

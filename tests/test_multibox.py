@@ -68,3 +68,71 @@ def test_box_iou():
     iou = nd.contrib_box_iou(a, b).asnumpy()
     np.testing.assert_allclose(iou[0, 0], 0.25 / 1.75, rtol=1e-5)
     np.testing.assert_allclose(iou[0, 1], 1.0, rtol=1e-5)
+
+
+def test_multibox_target_padded_gt_no_clobber():
+    """A padded (-1) gt row must not steal anchor 0's force-match from a
+    valid gt whose best anchor is 0 (regression: argmax over an all -1 IoU
+    column is 0, and a duplicate-index scatter used to overwrite)."""
+    anchors = nd.array([[[0.0, 0.0, 0.4, 0.4],
+                         [0.6, 0.6, 1.0, 1.0]]])
+    # gt overlaps anchor 0 only weakly (below threshold) -> only the
+    # force-match path can claim it; a padded row follows
+    label = nd.array([[[1.0, 0.0, 0.0, 0.2, 0.2],
+                       [-1.0, 0.0, 0.0, 0.0, 0.0]]])
+    cls_pred = nd.zeros((1, 2, 2))
+    _, loc_mask, cls_t = nd.contrib_MultiBoxTarget(
+        anchors, label, cls_pred, overlap_threshold=0.5)
+    cls_t = cls_t.asnumpy()[0]
+    assert cls_t[0] == 2.0          # cls 1 + 1, force-matched to anchor 0
+    assert cls_t[1] == 0.0          # background
+    assert loc_mask.asnumpy()[0][:4].sum() == 4.0
+
+
+def test_multibox_target_negative_mining():
+    anchors = nd.array([[[0.0, 0.0, 0.5, 0.5],
+                         [0.5, 0.5, 1.0, 1.0],
+                         [0.0, 0.5, 0.5, 1.0],
+                         [0.5, 0.0, 1.0, 0.5]]])
+    label = nd.array([[[0.0, 0.0, 0.0, 0.5, 0.5]]])
+    # cls_pred (B, num_classes, N): anchor 1 is the hardest negative
+    cls_pred = nd.array([[[0.0, 0.0, 0.0, 0.0],
+                          [0.0, 5.0, 0.0, 0.0]]])
+    _, _, cls_t = nd.contrib_MultiBoxTarget(
+        anchors, label, cls_pred, overlap_threshold=0.5,
+        negative_mining_ratio=1.0, negative_mining_thresh=0.5)
+    cls_t = cls_t.asnumpy()[0]
+    assert cls_t[0] == 1.0                      # matched, cls 0 -> target 1
+    assert cls_t[1] == 0.0                      # hard negative kept
+    assert cls_t[2] == -1.0 and cls_t[3] == -1.0  # ignored negatives
+
+
+def test_multibox_detection_background_id():
+    """Emitted class id is the fg row index for any background_id."""
+    anchors = nd.array([[[0.1, 0.1, 0.4, 0.4]]])
+    loc = nd.zeros((1, 4))
+    # 3 classes, background_id=1; anchor predicts original class 2
+    probs = nd.array([[[0.1], [0.2], [0.7]]])
+    out = nd.contrib_MultiBoxDetection(
+        probs, loc, anchors, background_id=1, threshold=0.05).asnumpy()[0]
+    # fg rows = [class0, class2]; argmax -> fg row 1
+    assert out[0, 0] == 1.0
+    np.testing.assert_allclose(out[0, 1], 0.7, atol=1e-6)
+
+
+def test_multibox_detection_nms_topk():
+    anchors = nd.array([[[0.0, 0.0, 0.5, 0.5],
+                         [0.4, 0.4, 0.9, 0.9],
+                         [0.5, 0.5, 1.0, 1.0]]])
+    loc = nd.zeros((1, 12))
+    probs = nd.array([[[0.1, 0.2, 0.3], [0.9, 0.8, 0.7]]])
+    out = nd.contrib_MultiBoxDetection(probs, loc, anchors, nms_topk=1,
+                                       nms_threshold=0.99).asnumpy()[0]
+    assert (out[:, 0] >= 0).sum() == 1  # only top-1 candidate survives
+
+
+def test_box_nms_out_format_center():
+    data = nd.array([[1.0, 0.9, 0.2, 0.2, 0.6, 0.6]])
+    out = nd.contrib_box_nms(data, overlap_thresh=0.5,
+                             out_format="center").asnumpy()
+    np.testing.assert_allclose(out[0, 2:6], [0.4, 0.4, 0.4, 0.4], atol=1e-6)

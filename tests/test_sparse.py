@@ -62,3 +62,31 @@ def test_kvstore_row_sparse_interop(rng):
     out = nd.zeros((8, 2))
     kv.row_sparse_pull("w", out=out, row_ids=nd.array([0, 5], dtype="int64"))
     assert np.abs(out.asnumpy()[[1, 2, 3, 4, 6, 7]]).sum() == 0
+
+
+def test_row_sparse_retain_no_densify():
+    """retain gathers against stored indices (no todense); absent rows zero."""
+    vals = nd.array(np.arange(6, dtype=np.float32).reshape(3, 2))
+    rs = mx.nd.sparse.row_sparse_array((vals, [1, 4, 7]), shape=(10, 2))
+    out = rs.retain(nd.array([0, 4, 7, 9]))
+    assert out.indices.asnumpy().tolist() == [0, 4, 7, 9]
+    np.testing.assert_allclose(
+        out.data.asnumpy(),
+        [[0, 0], [2, 3], [4, 5], [0, 0]])
+    # empty source
+    empty = mx.nd.sparse.row_sparse_array(
+        (nd.zeros((0, 2)), nd.zeros((0,))), shape=(10, 2))
+    out2 = empty.retain(nd.array([3]))
+    np.testing.assert_allclose(out2.data.asnumpy(), [[0, 0]])
+
+
+def test_image_iter_default_aug_crop_size():
+    """ImageIter default augmenters crop to (W, H) of data_shape (regression:
+    a (0,)+shape prepend shifted indexing so crops came out (H, C))."""
+    from mxnet_tpu import image as img
+    auglist = img.CreateAugmenter((3, 224, 200))
+    crops = [a for a in auglist if hasattr(a, "size")]
+    assert crops and crops[-1].size == (200, 224)
+    x = mx.nd.array(np.zeros((300, 260, 3), dtype=np.float32))
+    y = crops[-1](x)
+    assert y.shape == (224, 200, 3)

@@ -140,3 +140,40 @@ def test_variable_attrs():
     v = sym.Variable("w", shape=(3, 4), lr_mult=2.0)
     assert v.attr("__shape__") == str((3, 4))
     assert v.attr("__lr_mult__") == "2.0"
+
+
+def test_name_manager_prefix():
+    """mx.name.Prefix / NameManager scope naming (reference python/mxnet/name.py)."""
+    with mx.name.Prefix("enc_"):
+        d = sym.Variable("data")
+        fc = sym.FullyConnected(d, num_hidden=4)
+    assert fc.name.startswith("enc_fullyconnected")
+    assert "enc_" + fc.name.split("enc_")[1] + "_weight" in fc.list_arguments()
+    # nested managers restore on exit
+    with mx.name.NameManager():
+        a = sym.FullyConnected(sym.Variable("x"), num_hidden=2)
+        b = sym.FullyConnected(sym.Variable("y"), num_hidden=2)
+    assert a.name != b.name
+
+
+def test_attr_scope():
+    """mx.AttrScope applies attrs to symbols created in scope."""
+    with mx.AttrScope(ctx_group="stage1", __lr_mult__="0.5"):
+        v = sym.Variable("w")
+        fc = sym.FullyConnected(v, num_hidden=2, name="fca")
+        with mx.AttrScope(ctx_group="stage2"):
+            inner = sym.Variable("w2")
+    assert v.attr("ctx_group") == "stage1"
+    assert fc.attr("ctx_group") == "stage1"
+    assert fc.attr("__lr_mult__") == "0.5"
+    assert inner.attr("ctx_group") == "stage2"
+    # out of scope: no attr
+    v2 = sym.Variable("w3")
+    assert v2.attr("ctx_group") is None
+
+
+def test_util_np_shape():
+    assert mx.util.is_np_shape() is False
+    with mx.util.np_shape(True):
+        assert mx.util.is_np_shape() is True
+    assert mx.util.is_np_shape() is False
