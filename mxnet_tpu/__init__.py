@@ -55,6 +55,12 @@ _lazy = {
     "monitor": ".monitor",
     "profiler": ".profiler",
     "parallel": ".parallel",
+    "rnn": ".rnn",
+    "visualization": ".visualization", "viz": ".visualization",
+    "rtc": ".rtc",
+    "operator": ".operator",
+    "registry": ".registry",
+    "kvstore_server": ".kvstore_server",
     "engine": ".engine",
     "executor": ".executor",
     "test_utils": ".test_utils",

@@ -86,6 +86,20 @@ def _prelu_param_shapes(attrs, ds):
     return {}
 
 
+def _rnn_param_shapes(attrs, ds):
+    # ds is (T, B, I); packed parameter layout per ops/rnn.py (reference
+    # rnn-inl.h); state vars are (L*dirs, B, H)
+    from .ops.rnn import rnn_packed_param_size
+    mode = attrs.get("mode", "lstm")
+    H = int(attrs["state_size"])
+    L = int(attrs.get("num_layers", 1))
+    bi = str(attrs.get("bidirectional", False)) in ("True", "true", "1")
+    dirs = 2 if bi else 1
+    n = rnn_packed_param_size(mode, L, bi, int(ds[2]), H)
+    state = (L * dirs, int(ds[1]), H)
+    return {"parameters": (n,), "state": state, "state_cell": state}
+
+
 _PARAM_SHAPE_RULES: Dict[str, Callable] = {
     "FullyConnected": _fc_param_shapes,
     "Convolution": _conv_param_shapes,
@@ -95,6 +109,7 @@ _PARAM_SHAPE_RULES: Dict[str, Callable] = {
     "InstanceNorm": _in_param_shapes,
     "Embedding": _emb_param_shapes,
     "LeakyReLU": _prelu_param_shapes,
+    "RNN": _rnn_param_shapes,
 }
 
 # Ops whose extra outputs update auxiliary state during training:

@@ -1,0 +1,62 @@
+"""KVStore server process entry (``mx.kvstore_server``).
+
+Reference parity: ``python/mxnet/kvstore_server.py`` — in the reference's
+parameter-server deployment, processes launched with ``DMLC_ROLE=server``
+enter a blocking serve loop that applies optimizer updates pushed by workers
+(``src/kvstore/kvstore_dist_server.h:155``).
+
+TPU-native design (SURVEY.md §5.8): there are no parameter servers — gradient
+aggregation is an XLA AllReduce over ICI/DCN and the optimizer runs
+replicated, so a "server" role has nothing to do. This module keeps the entry
+point so reference launch scripts run unchanged: a server-role process simply
+waits on the coordinator barrier (joining the jax.distributed cluster keeps
+rank assignment identical to the reference's tracker) and exits with the job.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+from .kvstore import KVStore
+
+
+class KVStoreServer(object):
+    """Server-role shim; ``run()`` blocks until the job's workers finish."""
+
+    def __init__(self, kvstore: KVStore):
+        self.kvstore = kvstore
+        self.init_logging = False
+
+    def _controller(self, cmd_id, cmd_body):
+        """Command handler (reference: sync-mode switch, optimizer blob).
+        Optimizer commands are accepted and ignored — updates run on
+        workers (update_on_kvstore is effectively always False on TPU)."""
+        if not self.init_logging:
+            head = '%(asctime)-15s Server ' + str(self.kvstore.rank)
+            logging.basicConfig(level=logging.DEBUG, format=head)
+            self.init_logging = True
+        logging.debug("server command %s ignored (TPU collectives have no "
+                      "server-side optimizer)", cmd_id)
+
+    def run(self):
+        """Block for the duration of the job (reference: ps serve loop)."""
+        logging.info("TPU kvstore server shim: no parameter-server role; "
+                     "waiting for workers")
+        # nothing to serve: the process simply stays alive so reference
+        # launchers that expect S server processes keep working
+        try:
+            self.kvstore.barrier()
+        except Exception:
+            pass
+
+
+def _init_kvstore_server_module():
+    """Called at import in reference server processes (kvstore_server.py:89)."""
+    is_worker = int(os.environ.get("DMLC_ROLE", "worker") == "worker")
+    if not is_worker:
+        from . import kvstore as kv_mod
+        kvstore = kv_mod.create('dist')
+        server = KVStoreServer(kvstore)
+        server.run()
+        sys.exit()

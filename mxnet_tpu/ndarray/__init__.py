@@ -72,6 +72,10 @@ _func_cache = {}
 
 
 def __getattr__(name: str):
+    if name == "Custom":
+        # frontend-defined op: eager python callback path (mx.operator)
+        from ..operator import Custom
+        return Custom
     if name in _REGISTRY:
         if name not in _func_cache:
             _func_cache[name] = _make_op_func(name)

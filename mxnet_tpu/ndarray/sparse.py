@@ -86,21 +86,26 @@ class RowSparseNDArray(BaseSparseNDArray):
     def retain(self, row_ids) -> "RowSparseNDArray":
         """Keep only rows in row_ids (reference sparse_retain op).
 
-        O(|row_ids| log nnz) gather against the stored indices — never
-        densifies (a (10M, 512) embedding gradient with a few thousand
+        Sorts the stored index vector then gathers only the requested rows —
+        never densifies (a (10M, 512) embedding gradient with a few thousand
         nnz rows stays a few MB)."""
         rid = jnp.asarray(_unwrap(row_ids)).astype(jnp.int64)
         tail = self._values.shape[1:]
         if self._indices.shape[0] == 0:
             vals = jnp.zeros((rid.shape[0],) + tail, dtype=self._values.dtype)
             return RowSparseNDArray(vals, rid, self._shape)
-        # row_sparse indices are ascending (reference ndarray.h invariant);
-        # find each requested row among stored rows, zero-fill absent ones
-        pos = jnp.searchsorted(self._indices, rid)
-        pos = jnp.clip(pos, 0, self._indices.shape[0] - 1)
-        present = self._indices[pos] == rid
+        # stored indices may arrive unsorted from the (values, indices)
+        # constructor — sort them (with values) so the searchsorted gather
+        # below is valid, then zero-fill requested rows that are absent
+        order = jnp.argsort(self._indices)
+        sorted_idx = self._indices[order]
+        pos = jnp.searchsorted(sorted_idx, rid)
+        pos = jnp.clip(pos, 0, sorted_idx.shape[0] - 1)
+        present = sorted_idx[pos] == rid
         mask = present.reshape((-1,) + (1,) * len(tail))
-        vals = jnp.where(mask, self._values[pos], 0.0)
+        # gather only the |row_ids| requested rows, never a sorted full copy
+        vals = jnp.where(mask, self._values[order[pos]],
+                         jnp.zeros((), dtype=self._values.dtype))
         return RowSparseNDArray(vals, rid, self._shape)
 
     def __add__(self, other):
@@ -175,7 +180,11 @@ class CSRNDArray(BaseSparseNDArray):
 def row_sparse_array(arg1, shape=None, ctx=None, dtype=None) -> RowSparseNDArray:
     if isinstance(arg1, tuple) and len(arg1) == 2:
         values, indices = arg1
-        values = np.asarray(values, dtype=dtype or "float32")
+        if dtype is None:
+            # preserve the source dtype (reference: default_dtype = source);
+            # bare python lists still default to float32
+            dtype = getattr(values, "dtype", "float32")
+        values = np.asarray(values, dtype=dtype)
         if shape is None:
             raise MXNetError("row_sparse_array((data, indices)) needs shape")
         return RowSparseNDArray(jnp.asarray(values), jnp.asarray(indices), shape)

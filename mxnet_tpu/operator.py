@@ -1,0 +1,226 @@
+"""Frontend-defined custom operators (``mx.operator``).
+
+Reference parity: ``python/mxnet/operator.py`` (CustomOp/CustomOpProp/register)
+backed by ``src/operator/custom/custom-inl.h:50-170`` — the reference runs
+Python callbacks on a dedicated thread pool so they can't deadlock the engine.
+
+TPU-first: the imperative path runs the callback eagerly and records a tape
+node whose vjp calls ``CustomOp.backward`` (same plumbing as
+``autograd.Function``). The symbolic path registers a ``Custom`` op whose
+compute is a ``jax.pure_callback`` — a host-callback sync region inside the
+otherwise fused XLA program, exactly the "explicit sync region" noted in
+SURVEY.md hard part #5. Gradients through the symbolic path are supported
+only imperatively (hybridize falls back to the recorded graph).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Sequence
+
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_prop_cls", "Custom"]
+
+_CUSTOM_OPS: Dict[str, type] = {}
+
+
+class CustomOp(object):
+    """Base class for custom operator implementations."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        """Compute outputs; write them via ``self.assign``."""
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        """Compute input gradients; write them via ``self.assign``."""
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        """Assign ``src`` to ``dst`` honoring the write request."""
+        if req in ("null", None):
+            return
+        if req in ("write", "inplace"):
+            dst[:] = src
+        elif req == "add":
+            dst[:] = dst + src
+        else:
+            raise MXNetError("unknown req %s" % req)
+
+
+class CustomOpProp(object):
+    """Describes a custom op: its arguments, outputs, shapes and types."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+        self.kwargs: Dict[str, str] = {}
+
+    def list_arguments(self) -> List[str]:
+        return ["data"]
+
+    def list_outputs(self) -> List[str]:
+        return ["output"]
+
+    def list_auxiliary_states(self) -> List[str]:
+        return []
+
+    def infer_shape(self, in_shape):
+        """Default: all outputs/aux take the first input's shape."""
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), \
+            [in_shape[0]] * len(self.list_auxiliary_states())
+
+    def infer_type(self, in_type):
+        return in_type, [in_type[0]] * len(self.list_outputs()), \
+            [in_type[0]] * len(self.list_auxiliary_states())
+
+    def need_top_grad(self) -> bool:
+        return self.need_top_grad_
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad():
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes) -> CustomOp:
+        raise NotImplementedError
+
+
+def register(reg_name: str):
+    """Decorator registering a CustomOpProp subclass under ``reg_name``."""
+    def do_register(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise MXNetError("can only register subclasses of CustomOpProp")
+        _CUSTOM_OPS[reg_name] = prop_cls
+        return prop_cls
+    return do_register
+
+
+def get_prop_cls(op_type: str) -> type:
+    if op_type not in _CUSTOM_OPS:
+        raise MXNetError("custom op %r is not registered" % op_type)
+    return _CUSTOM_OPS[op_type]
+
+
+def _make_prop(op_type: str, kwargs: Dict[str, Any]) -> CustomOpProp:
+    prop_cls = get_prop_cls(op_type)
+    # reference passes user kwargs as strings to the prop constructor
+    prop = prop_cls(**{k: str(v) for k, v in kwargs.items()})
+    prop.kwargs = {k: str(v) for k, v in kwargs.items()}
+    return prop
+
+
+def Custom(*inputs, **kwargs):
+    """Imperative custom-op call: ``mx.nd.Custom(x, ..., op_type=name)``.
+
+    Positional inputs are the op's arguments followed by its auxiliary
+    states. Runs eagerly; records an autograd node when recording is on.
+    """
+    from .ndarray import ndarray as _ndmod
+    from .ndarray.ndarray import NDArray, _wrap
+    from .ndarray.utils import zeros as nd_zeros
+    from . import autograd
+    from .context import current_context
+
+    op_type = kwargs.pop("op_type", None)
+    name = kwargs.pop("name", None)  # cosmetic
+    if op_type is None:
+        raise MXNetError("Custom requires op_type=")
+    prop = _make_prop(op_type, kwargs)
+
+    args = prop.list_arguments()
+    n_args = len(args)
+    in_data = [x if isinstance(x, NDArray) else _ndmod.array(x)
+               for x in inputs[:n_args]]
+    aux = [x if isinstance(x, NDArray) else _ndmod.array(x)
+           for x in inputs[n_args:]]
+    if len(in_data) != n_args:
+        raise MXNetError("custom op %s expects %d inputs (%s), got %d"
+                         % (op_type, n_args, args, len(in_data)))
+
+    in_shapes = [tuple(x.shape) for x in in_data]
+    _, out_shapes, _aux_shapes = prop.infer_shape(in_shapes)
+    in_types = [x.dtype for x in in_data]
+    _, out_types, _ = prop.infer_type(in_types)
+
+    op = prop.create_operator(current_context(), in_shapes, in_types)
+
+    out_data = [nd_zeros(tuple(s), dtype=t)
+                for s, t in zip(out_shapes, out_types)]
+    with autograd.pause():
+        op.forward(is_train=autograd.is_training(),
+                   req=["write"] * len(out_data),
+                   in_data=in_data, out_data=out_data, aux=aux)
+
+    if autograd.is_recording():
+        st = autograd._st()
+
+        def vjp_fn(cts):
+            cts = (cts,) if not isinstance(cts, tuple) else cts
+            with autograd.pause():
+                out_grad = [_wrap(c) for c in cts]
+                in_grad = [nd_zeros(tuple(x.shape), dtype=x.dtype)
+                           for x in in_data]
+                op.backward(req=["write"] * len(in_grad), out_grad=out_grad,
+                            in_data=in_data, out_data=out_data,
+                            in_grad=in_grad, aux=aux)
+            return tuple(g._data for g in in_grad)
+
+        parents = [getattr(x, "_ag_node", None) for x in in_data]
+        slots = [getattr(x, "_ag_slot", 0) for x in in_data]
+        node = autograd._Node(
+            vjp_fn if len(out_data) > 1 else (lambda ct: vjp_fn((ct,))),
+            parents, slots, len(out_data), st.counter, "Custom:" + op_type)
+        node.saved_outputs = [o._data for o in out_data]
+        st.counter += 1
+        st.tape.append(node)
+        for i, o in enumerate(out_data):
+            o._ag_node = node
+            o._ag_slot = i
+
+    return out_data[0] if len(out_data) == 1 else out_data
+
+
+def _register_symbolic_custom():
+    """Register the graph-mode ``Custom`` op: a jax.pure_callback island."""
+    import jax
+    import jax.numpy as jnp
+    from .ops.registry import register as op_register
+
+    def _n_out(attrs):
+        prop = _make_prop(attrs["op_type"],
+                          {k: v for k, v in attrs.items() if k != "op_type"})
+        return len(prop.list_outputs())
+
+    @op_register("Custom", num_outputs=_n_out, differentiable=False)
+    def _custom(*inputs, op_type=None, **kw):
+        prop = _make_prop(op_type, kw)
+        n_args = len(prop.list_arguments())
+        in_shapes = [tuple(x.shape) for x in inputs[:n_args]]
+        _, out_shapes, _ = prop.infer_shape(in_shapes)
+        in_types = [x.dtype for x in inputs[:n_args]]
+        _, out_types, _ = prop.infer_type(in_types)
+        result_shapes = [jax.ShapeDtypeStruct(tuple(s), jnp.dtype(t))
+                         for s, t in zip(out_shapes, out_types)]
+
+        def cb(*arrs):
+            from .ndarray import ndarray as _ndmod
+            from .ndarray.utils import zeros as nd_zeros
+            from .context import current_context
+            in_data = [_ndmod.array(np.asarray(a)) for a in arrs[:n_args]]
+            aux = [_ndmod.array(np.asarray(a)) for a in arrs[n_args:]]
+            op = prop.create_operator(current_context(), in_shapes, in_types)
+            out_data = [nd_zeros(tuple(s), dtype=t)
+                        for s, t in zip(out_shapes, out_types)]
+            op.forward(is_train=False, req=["write"] * len(out_data),
+                       in_data=in_data, out_data=out_data, aux=aux)
+            return tuple(np.asarray(o.asnumpy(), dtype=t)
+                         for o, t in zip(out_data, out_types))
+
+        out = jax.pure_callback(cb, tuple(result_shapes), *inputs)
+        return out[0] if len(result_shapes) == 1 else tuple(out)
+
+
+_register_symbolic_custom()

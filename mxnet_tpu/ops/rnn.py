@@ -103,6 +103,12 @@ def _run_layer(x, mode, wih, whh, bih, bhh, h0, c0, reverse=False):
     if reverse:
         x = jnp.flip(x, axis=0)
     T, B = x.shape[0], x.shape[1]
+    # a (1, H) initial state stands for "unknown batch" (legacy begin_state);
+    # broadcast it up front so the scan carry has a fixed (B, H) shape
+    if h0.shape[0] == 1 and B != 1:
+        h0 = jnp.broadcast_to(h0, (B, h0.shape[1]))
+    if c0 is not None and c0.shape[0] == 1 and B != 1:
+        c0 = jnp.broadcast_to(c0, (B, c0.shape[1]))
     xp = jnp.dot(x.reshape(T * B, -1), wih.T).reshape(T, B, -1) + bih
     if mode == "lstm":
         out, hn, cn = _lstm_scan(xp, h0, c0, whh, bhh)

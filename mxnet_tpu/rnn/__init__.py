@@ -1,0 +1,11 @@
+"""``mx.rnn`` — legacy symbolic RNN cell API.
+
+Reference parity: ``python/mxnet/rnn/`` (rnn_cell.py symbolic cells,
+io.py BucketSentenceIter, rnn.py checkpoint helpers). The Gluon cell API
+lives separately in ``mxnet_tpu.gluon.rnn``.
+"""
+from .rnn_cell import (RNNParams, BaseRNNCell, RNNCell, LSTMCell, GRUCell,
+                       FusedRNNCell, SequentialRNNCell, BidirectionalCell,
+                       DropoutCell, ModifierCell, ZoneoutCell, ResidualCell)
+from .io import encode_sentences, BucketSentenceIter
+from .rnn import (save_rnn_checkpoint, load_rnn_checkpoint, do_rnn_checkpoint)

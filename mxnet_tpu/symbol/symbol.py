@@ -302,7 +302,8 @@ def Variable(name: str, attr=None, shape=None, lr_mult=None, wd_mult=None,
     node = _Node(None, name, {}, [])
     sym = Symbol([(node, 0)])
     attr = AttrScope.current().get(attr)
-    meta = {}
+    # scope/user attr dict first, explicit kwargs last so they win
+    meta = dict(attr) if attr else {}
     if shape is not None:
         meta["__shape__"] = str(tuple(shape))
     if dtype is not None:
@@ -311,8 +312,6 @@ def Variable(name: str, attr=None, shape=None, lr_mult=None, wd_mult=None,
         meta["__lr_mult__"] = str(lr_mult)
     if wd_mult is not None:
         meta["__wd_mult__"] = str(wd_mult)
-    if attr:
-        meta.update(attr)
     meta.update({k: str(v) for k, v in kwargs.items()})
     if meta:
         sym._set_attr(**meta)

@@ -1,0 +1,146 @@
+"""Legacy mx.rnn cell API tests (reference: tests/python/unittest/test_rnn.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import rnn
+
+
+def test_rnn_cell_unroll_shapes():
+    cell = rnn.RNNCell(num_hidden=16, prefix='rnn_')
+    outputs, states = cell.unroll(3, mx.sym.Variable('data'), layout='NTC',
+                                  merge_outputs=True)
+    assert sorted(outputs.list_arguments()) == [
+        'data', 'rnn_h2h_bias', 'rnn_h2h_weight', 'rnn_i2h_bias', 'rnn_i2h_weight']
+    _, out_shapes, _ = outputs.infer_shape(data=(2, 3, 8))
+    assert out_shapes == [(2, 3, 16)]
+
+
+def test_lstm_cell_unroll():
+    cell = rnn.LSTMCell(num_hidden=10, prefix='lstm_')
+    outputs, states = cell.unroll(4, mx.sym.Variable('data'), merge_outputs=True)
+    assert len(states) == 2
+    _, out_shapes, _ = outputs.infer_shape(data=(2, 4, 6))
+    assert out_shapes == [(2, 4, 10)]
+
+
+def test_gru_cell_unroll():
+    cell = rnn.GRUCell(num_hidden=12, prefix='gru_')
+    outputs, _ = cell.unroll(3, mx.sym.Variable('data'), merge_outputs=True)
+    _, out_shapes, _ = outputs.infer_shape(data=(5, 3, 7))
+    assert out_shapes == [(5, 3, 12)]
+
+
+def test_sequential_stack():
+    stack = rnn.SequentialRNNCell()
+    for i in range(2):
+        stack.add(rnn.LSTMCell(num_hidden=8, prefix='lstm_l%d_' % i))
+    outputs, states = stack.unroll(3, mx.sym.Variable('data'), merge_outputs=True)
+    assert len(states) == 4
+    _, out_shapes, _ = outputs.infer_shape(data=(2, 3, 5))
+    assert out_shapes == [(2, 3, 8)]
+
+
+def test_bidirectional_cell():
+    cell = rnn.BidirectionalCell(rnn.LSTMCell(num_hidden=6, prefix='l_'),
+                                 rnn.LSTMCell(num_hidden=6, prefix='r_'))
+    outputs, states = cell.unroll(3, mx.sym.Variable('data'), merge_outputs=True)
+    _, out_shapes, _ = outputs.infer_shape(data=(2, 3, 4))
+    assert out_shapes == [(2, 3, 12)]
+
+
+def test_residual_cell():
+    cell = rnn.ResidualCell(rnn.GRUCell(num_hidden=4, prefix='gru_'))
+    outputs, _ = cell.unroll(2, mx.sym.Variable('data'), merge_outputs=True)
+    _, out_shapes, _ = outputs.infer_shape(data=(3, 2, 4))
+    assert out_shapes == [(3, 2, 4)]
+
+
+def test_zoneout_cell():
+    cell = rnn.ZoneoutCell(rnn.RNNCell(num_hidden=4, prefix='rnn_'),
+                           zoneout_outputs=0.3, zoneout_states=0.3)
+    outputs, _ = cell.unroll(2, mx.sym.Variable('data'), merge_outputs=True)
+    _, out_shapes, _ = outputs.infer_shape(data=(3, 2, 4))
+    assert out_shapes == [(3, 2, 4)]
+
+
+def test_fused_rnn_shapes():
+    cell = rnn.FusedRNNCell(32, num_layers=2, mode='lstm', bidirectional=True,
+                            get_next_state=True)
+    outputs, states = cell.unroll(7, mx.sym.Variable('data'), layout='NTC',
+                                  merge_outputs=True)
+    assert outputs.list_arguments() == ['data', 'lstm_parameters']
+    _, out_shapes, _ = outputs.infer_shape(data=(4, 7, 10))
+    assert out_shapes == [(4, 7, 64)]
+    assert len(states) == 2
+
+
+def test_fused_pack_unpack_roundtrip():
+    from mxnet_tpu.ops.rnn import rnn_packed_param_size
+    cell = rnn.FusedRNNCell(8, num_layers=2, mode='lstm')
+    n = rnn_packed_param_size('lstm', 2, False, 5, 8)
+    packed = mx.nd.array(np.random.rand(n).astype('float32'))
+    unpacked = cell.unpack_weights({'lstm_parameters': packed})
+    assert 'lstm_parameters' not in unpacked
+    assert len(unpacked) == 32  # 2 layers x (2 groups x 4 gates) x (w + b)
+    repacked = cell.pack_weights(unpacked)
+    np.testing.assert_allclose(repacked['lstm_parameters'].asnumpy(),
+                               packed.asnumpy(), rtol=1e-6)
+
+
+def test_fused_matches_unfused():
+    """Fused RNN op and the stepped LSTMCell graph must agree numerically."""
+    from mxnet_tpu.ops.rnn import rnn_packed_param_size
+    T, B, I, H = 3, 2, 4, 5
+    fused = rnn.FusedRNNCell(H, num_layers=1, mode='lstm', prefix='lstm_')
+    n = rnn_packed_param_size('lstm', 1, False, I, H)
+    rs = np.random.RandomState(0)
+    packed = mx.nd.array(rs.uniform(-0.5, 0.5, (n,)).astype('float32'))
+
+    data = mx.sym.Variable('data')
+    fout, _ = fused.unroll(T, data, layout='NTC', merge_outputs=True)
+    x = rs.uniform(-1, 1, (B, T, I)).astype('float32')
+    ex = fout.bind(mx.cpu(), {'data': mx.nd.array(x), 'lstm_parameters': packed})
+    fused_y = ex.forward(is_train=False)[0].asnumpy()
+
+    unfused = fused.unfuse()
+    uout, _ = unfused.unroll(T, mx.sym.Variable('data'), merge_outputs=True)
+    args = fused.unpack_weights({'lstm_parameters': packed})
+    # unfuse() names cells lstm_l0_; map per-gate weights to stacked i2h/h2h
+    bind_args = {'data': mx.nd.array(x)}
+    for group in ('i2h', 'h2h'):
+        w = np.concatenate([args['lstm_l0_%s%s_weight' % (group, g)].asnumpy()
+                            for g in ('_i', '_f', '_c', '_o')], axis=0)
+        b = np.concatenate([args['lstm_l0_%s%s_bias' % (group, g)].asnumpy()
+                            for g in ('_i', '_f', '_c', '_o')], axis=0)
+        bind_args['lstm_l0_%s_weight' % group] = mx.nd.array(w)
+        bind_args['lstm_l0_%s_bias' % group] = mx.nd.array(b)
+    ex2 = uout.bind(mx.cpu(), bind_args)
+    unfused_y = ex2.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(fused_y, unfused_y, rtol=1e-4, atol=1e-5)
+
+
+def test_encode_sentences():
+    sents = [['the', 'cat'], ['the', 'dog', 'barks']]
+    coded, vocab = rnn.encode_sentences(sents)
+    assert len(coded) == 2
+    assert coded[0][0] == coded[1][0]  # 'the' same id
+    assert len(vocab) == 5  # 4 words + invalid key
+
+
+def test_bucket_sentence_iter():
+    sents = [[1, 2, 3], [2, 3], [1, 2, 3, 4, 5], [3, 4], [1, 2], [2, 2, 2]]
+    it = rnn.BucketSentenceIter(sents, batch_size=2, buckets=[3, 5],
+                                invalid_label=0)
+    assert it.default_bucket_key == 5
+    batches = list(it)
+    assert all(b.data[0].shape[0] == 2 for b in batches)
+    for b in batches:
+        assert b.bucket_key in (3, 5)
+        assert b.data[0].shape[1] == b.bucket_key
+    # labels are data shifted left by one
+    it.reset()
+    b = next(it)
+    d = b.data[0].asnumpy()
+    l = b.label[0].asnumpy()
+    np.testing.assert_allclose(l[:, :-1], d[:, 1:])
