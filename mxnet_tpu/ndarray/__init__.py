@@ -72,6 +72,9 @@ _func_cache = {}
 
 
 def __getattr__(name: str):
+    if name == "contrib":
+        import importlib
+        return importlib.import_module(__name__ + ".contrib")
     if name == "Custom":
         # frontend-defined op: eager python callback path (mx.operator)
         from ..operator import Custom

@@ -1,0 +1,24 @@
+"""``mx.nd.contrib`` — experimental-op namespace.
+
+Mirrors the reference's generated ``mxnet.ndarray.contrib`` module
+(``python/mxnet/ndarray/register.py`` puts every ``_contrib_*`` registration
+under the ``contrib`` namespace): ``mx.nd.contrib.MultiBoxPrior(...)`` calls
+the op registered as ``_contrib_MultiBoxPrior``.
+"""
+from __future__ import annotations
+
+from ..ops.registry import _REGISTRY
+
+
+def __getattr__(name: str):
+    from . import __getattr__ as _nd_getattr
+    for cand in (f"_contrib_{name}", f"contrib_{name}"):
+        if cand in _REGISTRY:
+            return _nd_getattr(cand)
+    raise AttributeError(
+        f"module 'mxnet_tpu.ndarray.contrib' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(n[len("_contrib_"):] for n in _REGISTRY
+                  if n.startswith("_contrib_"))

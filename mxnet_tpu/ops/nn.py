@@ -462,3 +462,32 @@ def _spatial_transformer(data, loc, target_shape=(0, 0), transform_type="affine"
                          sampler_type="bilinear", cudnn_off=False):
     grid = _grid_generator(loc, transform_type="affine", target_shape=target_shape)
     return _bilinear_sampler(data, grid)
+
+
+@register("softmax_cross_entropy", arg_names=("data", "label"))
+def _softmax_cross_entropy(data, label):
+    """Total softmax CE over the batch, shape (1,).
+
+    Reference: ``src/operator/loss_binary_op.cc`` (out = Σ_i CE(row_i)).
+    On TPU the per-row CE is the fused Pallas kernel (no materialized
+    softmax); gradient is the fused softmax−onehot custom VJP.
+    """
+    from .pallas_kernels import softmax_cross_entropy as _ce
+    per_row = _ce(data, label.astype(jnp.int32).reshape(-1))
+    return jnp.sum(per_row).reshape(1)
+
+
+@register("_contrib_flash_attention", aliases=["contrib_flash_attention"],
+          arg_names=("query", "key", "value"))
+def _flash_attention_op(query, key, value, causal=False, scale=None,
+                        q_offset=0, k_offset=0):
+    """Blockwise (flash) attention, (B, H, T, D) layout; Pallas kernel on TPU.
+
+    The reference has no attention op (SURVEY.md §5.7) — this is the
+    long-context extension the TPU build makes first-class; the same kernel
+    is the ring-attention per-step partial (``parallel.ring_attention``).
+    """
+    from .pallas_kernels import flash_attention
+    return flash_attention(query, key, value, causal=bool(causal),
+                           scale=None if scale is None else float(scale),
+                           q_offset=int(q_offset), k_offset=int(k_offset))

@@ -1,0 +1,20 @@
+"""``mx.sym.contrib`` — symbolic experimental-op namespace (see
+``mxnet_tpu.ndarray.contrib``; reference ``python/mxnet/symbol/register.py``).
+"""
+from __future__ import annotations
+
+from ..ops.registry import _REGISTRY
+
+
+def __getattr__(name: str):
+    from . import __getattr__ as _sym_getattr
+    for cand in (f"_contrib_{name}", f"contrib_{name}"):
+        if cand in _REGISTRY:
+            return _sym_getattr(cand)
+    raise AttributeError(
+        f"module 'mxnet_tpu.symbol.contrib' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(n[len("_contrib_"):] for n in _REGISTRY
+                  if n.startswith("_contrib_"))
