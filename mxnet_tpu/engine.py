@@ -58,12 +58,15 @@ def naive_mode():
 
 
 def wait_all() -> None:
-    """Engine::WaitForAll — block until all dispatched work completes."""
+    """Engine::WaitForAll — block until all dispatched work completes
+    (device XLA queues + the host task engine, if one was started)."""
     try:
         for a in jax.live_arrays():
             a.block_until_ready()
     except Exception:
         pass
+    if _host_engine is not None:
+        _host_engine.wait_all()
 
 
 _bulk_size = [0]
@@ -85,3 +88,109 @@ def bulk(size: int):
         yield
     finally:
         set_bulk_size(old)
+
+
+# ---------------------------------------------------------------------------
+# host-task dependency engine (native C++ backend)
+# ---------------------------------------------------------------------------
+# The reference exposes its scheduler to frontends via MXEnginePushAsync /
+# MXEnginePushSync (src/c_api/c_api.cc) with const/mutable var lists; here
+# the same contract orders host-side work (IO, decode, checkpoint shards,
+# custom callbacks) while XLA orders device work. Backed by the C++ engine in
+# native/engine_storage.cc (ThreadedVar queues, priority pool, deferred
+# exceptions); a pure-python serial fallback keeps the API alive without a
+# toolchain.
+
+_host_engine = None
+_host_engine_lock = threading.Lock()
+
+
+class _SerialEngine:
+    """Fallback: immediate execution with reference error-deferral semantics."""
+
+    def __init__(self):
+        self._versions = {}
+        self._errors = {}
+        self._next = [1]
+
+    def new_var(self):
+        v = self._next[0]
+        self._next[0] += 1
+        self._versions[v] = 0
+        return v
+
+    def var_version(self, var):
+        return self._versions.get(var, 0)
+
+    def free_var(self, var):
+        self._versions.pop(var, None)
+        self._errors.pop(var, None)
+
+    def push(self, fn, const_vars=(), mutable_vars=(), priority=0):
+        try:
+            fn()
+        except BaseException as e:
+            for v in list(const_vars) + list(mutable_vars):
+                self._errors.setdefault(v, f"{type(e).__name__}: {e}")
+        for v in mutable_vars:
+            self._versions[v] = self._versions.get(v, 0) + 1
+
+    def _raise_if(self, var):
+        msg = self._errors.pop(var, None)
+        if msg:
+            raise RuntimeError(f"deferred engine error: {msg}")
+
+    def wait_var(self, var):
+        self._raise_if(var)
+
+    def wait_all(self):
+        for v in list(self._errors):
+            self._raise_if(v)
+
+
+def _get_host_engine():
+    global _host_engine
+    with _host_engine_lock:
+        if _host_engine is None:
+            nworkers = int(get_env("MXNET_CPU_WORKER_NTHREADS", 4))
+            try:
+                from .native import NativeEngine
+                _host_engine = NativeEngine(nworkers)
+            except Exception:
+                _host_engine = _SerialEngine()
+        return _host_engine
+
+
+def new_var() -> int:
+    """Allocate a dependency variable (Engine::NewVariable)."""
+    return _get_host_engine().new_var()
+
+
+def var_version(var: int) -> int:
+    """Write-version counter of a var (ThreadedVar::version_)."""
+    return _get_host_engine().var_version(var)
+
+
+def free_var(var: int) -> None:
+    """Engine::DeleteVariable — waits for the var's pending ops, then
+    reclaims its bookkeeping (pair every new_var with this in long loops)."""
+    _get_host_engine().free_var(var)
+
+
+def push(fn, const_vars=(), mutable_vars=(), priority: int = 0) -> None:
+    """Run ``fn()`` on the host pool once its var deps are satisfied
+    (MXEnginePushAsync). Errors surface at wait_var/wait_all."""
+    _get_host_engine().push(fn, const_vars, mutable_vars, priority)
+
+
+def wait_var(var: int) -> None:
+    """Engine::WaitForVar — block + re-raise deferred errors on this var."""
+    _get_host_engine().wait_var(var)
+
+
+def wait_all_host() -> None:
+    """Block until all host-engine tasks finish (re-raises deferred errors)."""
+    _get_host_engine().wait_all()
+
+
+__all__ += ["new_var", "var_version", "free_var", "push", "wait_var", "wait_all_host"]

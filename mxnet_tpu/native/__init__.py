@@ -21,10 +21,13 @@ _lib = None
 _build_failed = False
 
 
+_SOURCES = ("recordio.cc", "engine_storage.cc")
+
+
 def _build() -> bool:
-    src = os.path.join(_HERE, "recordio.cc")
+    srcs = [os.path.join(_HERE, s) for s in _SOURCES]
     cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
-           src, "-o", _LIB_PATH]
+           *srcs, "-o", _LIB_PATH]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         return True
@@ -39,8 +42,9 @@ def get_lib():
         if _lib is not None or _build_failed:
             return _lib
         if not os.path.exists(_LIB_PATH) or \
-                os.path.getmtime(_LIB_PATH) < os.path.getmtime(
-                    os.path.join(_HERE, "recordio.cc")):
+                os.path.getmtime(_LIB_PATH) < max(
+                    os.path.getmtime(os.path.join(_HERE, s))
+                    for s in _SOURCES):
             if not _build():
                 _build_failed = True
                 return None
@@ -65,8 +69,211 @@ def get_lib():
         lib.rio_next_prefetched.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
             ctypes.POINTER(ctypes.c_int64)]
+        # dependency engine (engine_storage.cc)
+        lib.eng_create.restype = ctypes.c_void_p
+        lib.eng_create.argtypes = [ctypes.c_int]
+        lib.eng_destroy.argtypes = [ctypes.c_void_p]
+        lib.eng_new_var.restype = ctypes.c_uint64
+        lib.eng_new_var.argtypes = [ctypes.c_void_p]
+        lib.eng_var_version.restype = ctypes.c_uint64
+        lib.eng_var_version.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.eng_del_var.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.eng_push.argtypes = [
+            ctypes.c_void_p, TASK_FN, ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_int,
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_int, ctypes.c_int]
+        lib.eng_wait_var.restype = ctypes.c_void_p  # char* (freed via eng_free_str)
+        lib.eng_wait_var.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.eng_wait_all.restype = ctypes.c_void_p
+        lib.eng_wait_all.argtypes = [ctypes.c_void_p]
+        lib.eng_free_str.argtypes = [ctypes.c_void_p]
+        # storage pool
+        lib.sto_create.restype = ctypes.c_void_p
+        lib.sto_create.argtypes = [ctypes.c_int, ctypes.c_uint64,
+                                   ctypes.c_uint64]
+        lib.sto_destroy.argtypes = [ctypes.c_void_p]
+        lib.sto_alloc.restype = ctypes.c_void_p
+        lib.sto_alloc.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.sto_free.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+        lib.sto_release_all.argtypes = [ctypes.c_void_p]
+        lib.sto_stats.argtypes = [ctypes.c_void_p,
+                                  ctypes.POINTER(ctypes.c_uint64)]
         _lib = lib
         return _lib
+
+
+TASK_FN = ctypes.CFUNCTYPE(None, ctypes.c_void_p,
+                           ctypes.POINTER(ctypes.c_char_p))
+
+
+class NativeEngine:
+    """Threaded dependency engine (reference ThreadedEnginePerDevice
+    semantics — src/engine/threaded_engine.h): vars with read/write queues
+    and version counters; ops with wait counts dispatched to a priority
+    worker pool; exceptions captured per-var and re-raised at wait points.
+
+    Python callbacks hold the GIL while running, so this engine's win is
+    ordering + overlap of host-side work whose heavy lifting releases the
+    GIL (file IO, numpy, jax dispatch) — the same division of labor as the
+    reference's custom-op thread pool (src/operator/custom/custom-inl.h).
+    """
+
+    def __init__(self, num_workers: int = 4):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._h = lib.eng_create(num_workers)
+        self._callbacks = {}      # keep CFUNCTYPE objects alive until done
+        self._done = []           # ids safe to drop (drained outside callbacks)
+        self._cb_id = [0]
+        self._cb_lock = threading.Lock()
+
+    def _drain_done(self):
+        # ONLY call from points where the C engine guarantees every recorded
+        # callback's thunk has fully returned (after eng_wait_all /
+        # eng_destroy). Draining from push() would race: _done is appended
+        # inside the Python body, before the worker thread finishes walking
+        # back through the ffi closure's return path.
+        with self._cb_lock:
+            for cb_id in self._done:
+                self._callbacks.pop(cb_id, None)
+            self._done.clear()
+
+    def new_var(self) -> int:
+        return int(self._lib.eng_new_var(self._h))
+
+    def var_version(self, var: int) -> int:
+        return int(self._lib.eng_var_version(self._h, var))
+
+    def free_var(self, var: int) -> None:
+        """Engine::DeleteVariable — waits for pending ops, then reclaims."""
+        self._lib.eng_del_var(self._h, var)
+
+    def push(self, fn, const_vars=(), mutable_vars=(), priority: int = 0):
+        """Schedule ``fn()`` after all deps; reads const_vars, writes
+        mutable_vars (MXEnginePushAsync semantics). Exceptions raised by
+        ``fn`` surface at wait_var/wait_all on any touched var."""
+        with self._cb_lock:
+            cb_id = self._cb_id[0]
+            self._cb_id[0] += 1
+
+        def trampoline(_ctx, err_out):
+            try:
+                fn()
+            except BaseException as e:  # captured, surfaced at sync point
+                msg = f"{type(e).__name__}: {e}".encode()
+                buf = ctypes.create_string_buffer(msg)  # NUL-terminated
+                # engine frees with free(); allocate with C malloc via strdup
+                libc = ctypes.CDLL(None)
+                libc.strdup.restype = ctypes.c_void_p
+                err_out[0] = ctypes.cast(libc.strdup(buf), ctypes.c_char_p)
+            finally:
+                # NOT popped here: freeing a CFUNCTYPE from inside its own
+                # invocation would release the thunk while it is executing
+                with self._cb_lock:
+                    self._done.append(cb_id)
+
+        cfn = TASK_FN(trampoline)
+        with self._cb_lock:
+            self._callbacks[cb_id] = cfn
+        nc, nm = len(const_vars), len(mutable_vars)
+        cv = (ctypes.c_uint64 * max(nc, 1))(*const_vars)
+        mv = (ctypes.c_uint64 * max(nm, 1))(*mutable_vars)
+        self._lib.eng_push(self._h, cfn, None, cv, nc, mv, nm, priority)
+
+    def _raise_if(self, err_ptr):
+        if err_ptr:
+            msg = ctypes.cast(err_ptr, ctypes.c_char_p).value.decode()
+            self._lib.eng_free_str(err_ptr)
+            raise RuntimeError(f"deferred engine error: {msg}")
+
+    def wait_var(self, var: int) -> None:
+        self._raise_if(self._lib.eng_wait_var(self._h, var))
+
+    def wait_all(self) -> None:
+        self._raise_if(self._lib.eng_wait_all(self._h))
+        self._drain_done()
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.eng_destroy(self._h)  # joins workers: thunks returned
+            self._h = None
+            self._drain_done()
+            self._callbacks.clear()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class StoragePool:
+    """Pooled host allocator (reference pooled_storage_manager.h).
+
+    pool_type: 'naive' (no reuse), 'pooled' (page-rounded best-fit,
+    GPUPooledStorageManager), 'rounded' (power-of-2,
+    GPUPooledRoundedStorageManager). Returns numpy views over pool memory
+    for zero-copy staging buffers.
+    """
+
+    _TYPES = {"naive": 0, "pooled": 1, "rounded": 2}
+
+    def __init__(self, pool_type: str = "pooled", page_size: int = 4096,
+                 cap_bytes: int = 0):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._h = lib.sto_create(self._TYPES[pool_type], page_size, cap_bytes)
+        self._finalizers = {}  # ptr -> weakref.finalize (auto-free on GC)
+
+    def alloc(self, nbytes: int) -> np.ndarray:
+        import weakref
+        ptr = self._lib.sto_alloc(self._h, nbytes)
+        if not ptr:
+            raise MemoryError(nbytes)
+        buf = (ctypes.c_uint8 * nbytes).from_address(ptr)
+        arr = np.frombuffer(buf, dtype=np.uint8)
+        # keyed by the native pointer (not id(arr): ids recycle); a dropped
+        # array returns its block to the pool automatically
+        self._finalizers[ptr] = weakref.finalize(arr, self._return_block, ptr)
+        return arr
+
+    def _return_block(self, ptr) -> None:
+        if self._h and self._finalizers.pop(ptr, None) is not None:
+            self._lib.sto_free(self._h, ptr)
+
+    def free(self, arr: np.ndarray) -> None:
+        ptr = arr.ctypes.data
+        fin = self._finalizers.get(ptr)
+        if fin is not None:
+            fin.detach()
+            self._return_block(ptr)
+
+    def stats(self) -> dict:
+        out = (ctypes.c_uint64 * 4)()
+        self._lib.sto_stats(self._h, out)
+        return {"live_bytes": out[0], "pooled_bytes": out[1],
+                "allocs": out[2], "pool_hits": out[3]}
+
+    def release_all(self) -> None:
+        self._lib.sto_release_all(self._h)
+
+    def close(self) -> None:
+        if self._h:
+            h, self._h = self._h, None  # _return_block guards on _h
+            for fin in self._finalizers.values():
+                fin.detach()
+            self._finalizers.clear()
+            self._lib.sto_destroy(h)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
 
 class NativeRecordReader:
