@@ -4,3 +4,4 @@ from . import control_flow
 from .control_flow import foreach, while_loop, cond
 from . import quantization
 from . import amp
+from . import onnx

@@ -1,0 +1,9 @@
+"""``mx.contrib.onnx`` — ONNX interop (reference
+``python/mxnet/contrib/onnx``: ``import_model``/``export_model`` over the
+mx2onnx + onnx2mx translator registries). Self-contained: serialization uses
+the in-repo protobuf wire codec (proto.py), no ``onnx`` package required.
+"""
+from .mx2onnx import export_model
+from .onnx2mx import import_model
+
+__all__ = ["export_model", "import_model"]

@@ -1,0 +1,393 @@
+"""Symbol → ONNX exporter.
+
+Reference parity: ``python/mxnet/contrib/onnx/mx2onnx/export_onnx.py`` +
+``_op_translations.py`` (MXNetGraph.create_onnx_graph_proto walks the graph
+in topo order, one translator per op). Same structure here, but emitting via
+the in-repo proto codec (no onnx dependency) and reading this framework's
+Symbol IR directly instead of the JSON round-trip.
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+from ...base import MXNetError
+from .proto import (GraphProto, ModelProto, NodeProto, TensorProto,
+                    ValueInfoProto, DTYPE_TO_ONNX)
+
+__all__ = ["export_model", "MX2ONNX_TRANSLATORS"]
+
+MX2ONNX_TRANSLATORS = {}
+
+
+def register(op_name):
+    def deco(fn):
+        MX2ONNX_TRANSLATORS[op_name] = fn
+        return fn
+    return deco
+
+
+def _pair(v, nd=2):
+    v = tuple(v) if v else (1,) * nd
+    return [int(x) for x in v]
+
+
+class _Ctx:
+    """Per-export state handed to translators."""
+
+    def __init__(self, graph: GraphProto):
+        self.graph = graph
+        self._uid = [0]
+
+    def add_node(self, op_type, inputs, outputs, name=None, **attrs):
+        node = NodeProto(op_type=op_type, name=name or outputs[0],
+                         inputs=list(inputs), outputs=list(outputs),
+                         attrs=attrs)
+        self.graph.nodes.append(node)
+        return node
+
+    def add_initializer(self, name, arr):
+        arr = np.asarray(arr)
+        self.graph.initializers.append(TensorProto.from_array(arr, name))
+        self.graph.inputs.append(ValueInfoProto(
+            name, DTYPE_TO_ONNX[arr.dtype], arr.shape))
+
+    def fresh(self, hint):
+        self._uid[0] += 1
+        return f"{hint}_{self._uid[0]}"
+
+
+# ---------------------------------------------------------------------------
+# translators: (ctx, node_name, input_names, attrs) -> output name(s)
+# ---------------------------------------------------------------------------
+
+@register("Convolution")
+def _conv(ctx, name, ins, attrs):
+    kernel = _pair(attrs.get("kernel"))
+    nd = len(kernel)
+    pads = _pair(attrs.get("pad", (0,) * nd), nd)
+    ctx.add_node("Conv", ins, [name],
+                 kernel_shape=kernel,
+                 strides=_pair(attrs.get("stride", (1,) * nd), nd),
+                 dilations=_pair(attrs.get("dilate", (1,) * nd), nd),
+                 pads=pads + pads,
+                 group=int(attrs.get("num_group", 1)))
+    return name
+
+
+@register("Deconvolution")
+def _deconv(ctx, name, ins, attrs):
+    kernel = _pair(attrs.get("kernel"))
+    nd = len(kernel)
+    pads = _pair(attrs.get("pad", (0,) * nd), nd)
+    ctx.add_node("ConvTranspose", ins, [name],
+                 kernel_shape=kernel,
+                 strides=_pair(attrs.get("stride", (1,) * nd), nd),
+                 pads=pads + pads,
+                 group=int(attrs.get("num_group", 1)))
+    return name
+
+
+@register("FullyConnected")
+def _fc(ctx, name, ins, attrs):
+    data = ins[0]
+    if attrs.get("flatten", True):
+        flat = ctx.fresh(name + "_flat")
+        ctx.add_node("Flatten", [data], [flat], axis=1)
+        data = flat
+    if attrs.get("no_bias", False):
+        # Gemm requires C; emit MatMul against the transposed weight
+        wt = ctx.fresh(name + "_wT")
+        ctx.add_node("Transpose", [ins[1]], [wt], perm=[1, 0])
+        ctx.add_node("MatMul", [data, wt], [name])
+    else:
+        ctx.add_node("Gemm", [data, ins[1], ins[2]], [name],
+                     alpha=1.0, beta=1.0, transA=0, transB=1)
+    return name
+
+
+@register("Activation")
+def _act(ctx, name, ins, attrs):
+    op = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+          "softrelu": "Softplus", "softsign": "Softsign"}[
+              attrs.get("act_type", "relu")]
+    ctx.add_node(op, ins, [name])
+    return name
+
+
+@register("LeakyReLU")
+def _leaky(ctx, name, ins, attrs):
+    act = attrs.get("act_type", "leaky")
+    if act == "leaky":
+        ctx.add_node("LeakyRelu", ins[:1], [name],
+                     alpha=float(attrs.get("slope", 0.25)))
+    elif act == "elu":
+        ctx.add_node("Elu", ins[:1], [name],
+                     alpha=float(attrs.get("slope", 0.25)))
+    elif act == "prelu":
+        ctx.add_node("PRelu", ins, [name])
+    else:
+        raise MXNetError(f"ONNX export: unsupported LeakyReLU {act}")
+    return name
+
+
+@register("BatchNorm")
+def _bn(ctx, name, ins, attrs):
+    # mx order: data gamma beta moving_mean moving_var == onnx order
+    ctx.add_node("BatchNormalization", ins, [name],
+                 epsilon=float(attrs.get("eps", 1e-3)),
+                 momentum=float(attrs.get("momentum", 0.9)))
+    return name
+
+
+@register("Pooling")
+def _pool(ctx, name, ins, attrs):
+    ptype = attrs.get("pool_type", "max")
+    if attrs.get("global_pool", False):
+        ctx.add_node({"max": "GlobalMaxPool", "avg": "GlobalAveragePool"}[
+            ptype], ins, [name])
+        return name
+    kernel = _pair(attrs.get("kernel"))
+    nd = len(kernel)
+    pads = _pair(attrs.get("pad", (0,) * nd), nd)
+    kw = dict(kernel_shape=kernel,
+              strides=_pair(attrs.get("stride", (1,) * nd), nd),
+              pads=pads + pads)
+    if ptype == "avg":
+        kw["count_include_pad"] = 1 if attrs.get("count_include_pad", True) \
+            else 0
+    ctx.add_node({"max": "MaxPool", "avg": "AveragePool"}[ptype], ins,
+                 [name], **kw)
+    return name
+
+
+@register("softmax")
+@register("Softmax")
+def _softmax(ctx, name, ins, attrs):
+    ctx.add_node("Softmax", ins[:1], [name], axis=int(attrs.get("axis", -1)))
+    return name
+
+
+@register("SoftmaxOutput")
+def _softmax_out(ctx, name, ins, attrs):
+    ctx.add_node("Softmax", ins[:1], [name], axis=1)
+    return name
+
+
+@register("Flatten")
+def _flatten(ctx, name, ins, attrs):
+    ctx.add_node("Flatten", ins, [name], axis=1)
+    return name
+
+
+@register("Concat")
+def _concat(ctx, name, ins, attrs):
+    ctx.add_node("Concat", ins, [name], axis=int(attrs.get("dim", 1)))
+    return name
+
+
+@register("Dropout")
+def _dropout(ctx, name, ins, attrs):
+    ctx.add_node("Dropout", ins, [name], ratio=float(attrs.get("p", 0.5)))
+    return name
+
+
+@register("Reshape")
+def _reshape(ctx, name, ins, attrs):
+    shape_name = ctx.fresh(name + "_shape")
+    ctx.add_initializer(shape_name,
+                        np.asarray(attrs.get("shape", ()), np.int64))
+    ctx.add_node("Reshape", [ins[0], shape_name], [name])
+    return name
+
+
+@register("transpose")
+def _transpose(ctx, name, ins, attrs):
+    axes = attrs.get("axes", ())
+    kw = {"perm": [int(a) for a in axes]} if axes else {}
+    ctx.add_node("Transpose", ins, [name], **kw)
+    return name
+
+
+@register("dot")
+def _dot(ctx, name, ins, attrs):
+    ctx.add_node("MatMul", ins, [name])
+    return name
+
+
+@register("add_n")
+@register("ElementWiseSum")
+def _add_n(ctx, name, ins, attrs):
+    ctx.add_node("Sum", ins, [name])
+    return name
+
+
+@register("clip")
+def _clip(ctx, name, ins, attrs):
+    ctx.add_node("Clip", ins, [name], min=float(attrs.get("a_min", 0.0)),
+                 max=float(attrs.get("a_max", 1.0)))
+    return name
+
+
+@register("mean")
+def _mean(ctx, name, ins, attrs):
+    axis = attrs.get("axis", None)
+    kw = {"keepdims": 1 if attrs.get("keepdims", False) else 0}
+    if axis is not None:
+        axes = axis if isinstance(axis, (list, tuple)) else (axis,)
+        kw["axes"] = [int(a) for a in axes]
+    ctx.add_node("ReduceMean", ins, [name], **kw)
+    return name
+
+
+@register("Embedding")
+def _embedding(ctx, name, ins, attrs):
+    # onnx Gather(weight, indices); mx order is (data=indices, weight)
+    ctx.add_node("Gather", [ins[1], ins[0]], [name], axis=0)
+    return name
+
+
+@register("Cast")
+def _cast(ctx, name, ins, attrs):
+    dt = DTYPE_TO_ONNX[np.dtype(attrs.get("dtype", "float32"))]
+    ctx.add_node("Cast", ins, [name], to=int(dt))
+    return name
+
+
+def _binary(onnx_op):
+    def fn(ctx, name, ins, attrs):
+        ctx.add_node(onnx_op, ins, [name])
+        return name
+    return fn
+
+
+def _unary(onnx_op):
+    def fn(ctx, name, ins, attrs):
+        ctx.add_node(onnx_op, ins[:1], [name])
+        return name
+    return fn
+
+
+for _mx, _onnx in [("elemwise_add", "Add"), ("broadcast_add", "Add"),
+                   ("_plus", "Add"), ("elemwise_sub", "Sub"),
+                   ("broadcast_sub", "Sub"), ("elemwise_mul", "Mul"),
+                   ("broadcast_mul", "Mul"), ("elemwise_div", "Div"),
+                   ("broadcast_div", "Div"), ("broadcast_maximum", "Max"),
+                   ("broadcast_minimum", "Min"), ("broadcast_power", "Pow")]:
+    register(_mx)(_binary(_onnx))
+
+for _mx, _onnx in [("relu", "Relu"), ("sigmoid", "Sigmoid"), ("tanh", "Tanh"),
+                   ("exp", "Exp"), ("log", "Log"), ("sqrt", "Sqrt"),
+                   ("abs", "Abs"), ("negative", "Neg"), ("floor", "Floor"),
+                   ("ceil", "Ceil"), ("identity", "Identity"),
+                   ("_copy", "Identity")]:
+    register(_mx)(_unary(_onnx))
+
+
+def _scalar_op(onnx_op, attr_key="scalar"):
+    def fn(ctx, name, ins, attrs):
+        sc = ctx.fresh(name + "_scalar")
+        ctx.add_initializer(sc, np.asarray(float(attrs.get(attr_key, 0.0)),
+                                           np.float32))
+        ctx.add_node(onnx_op, [ins[0], sc], [name])
+        return name
+    return fn
+
+
+for _mx, _onnx in [("_plus_scalar", "Add"), ("_minus_scalar", "Sub"),
+                   ("_mul_scalar", "Mul"), ("_div_scalar", "Div"),
+                   ("_power_scalar", "Pow")]:
+    register(_mx)(_scalar_op(_onnx))
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+def export_model(sym, params, input_shape, input_dtype=np.float32,
+                 onnx_file_path="model.onnx", verbose=False):
+    """Export (Symbol, params) to an ONNX file.
+
+    Matches the reference entry ``onnx_mxnet.export_model(sym, params,
+    [in_shape], in_dtype, path)`` (mx2onnx/export_model.py). ``params`` maps
+    arg/aux names to NDArray (or numpy). Returns the file path.
+    """
+    from ... import ndarray as nd_mod
+
+    if hasattr(sym, "_outputs") is False:
+        raise MXNetError("export_model expects a Symbol")
+    params = {k.split(":", 1)[-1]: (v.asnumpy() if hasattr(v, "asnumpy")
+                                    else np.asarray(v))
+              for k, v in params.items()}
+
+    graph = GraphProto(name=sym.name or "mxnet_tpu")
+    ctx = _Ctx(graph)
+
+    shapes = input_shape if isinstance(input_shape[0], (list, tuple)) \
+        else [input_shape]
+    dtypes = input_dtype if isinstance(input_dtype, (list, tuple)) \
+        else [input_dtype] * len(shapes)
+
+    # topo order over the node graph
+    order = []
+    seen = set()
+
+    def visit(node):
+        if id(node) in seen:
+            return
+        seen.add(id(node))
+        for (inp, _) in node.inputs:
+            visit(inp)
+        order.append(node)
+
+    for (out_node, _) in sym._outputs:
+        visit(out_node)
+
+    # graph inputs: variables not provided by params
+    var_inputs = [n.name for n in order
+                  if n.is_var and n.name not in params]
+    label_like = [v for v in var_inputs if v.endswith(("label", "_weight_"))]
+    data_inputs = [v for v in var_inputs if v not in label_like]
+    if len(data_inputs) != len(shapes):
+        raise MXNetError(
+            f"input_shape count {len(shapes)} != graph data inputs "
+            f"{data_inputs}")
+
+    outputs_of: Dict[int, List[str]] = {}
+    for node in order:
+        if node.is_var:
+            if node.name in params:
+                ctx.add_initializer(node.name, params[node.name])
+            elif node.name in data_inputs:
+                i = data_inputs.index(node.name)
+                graph.inputs.append(ValueInfoProto(
+                    node.name, DTYPE_TO_ONNX[np.dtype(dtypes[i])],
+                    shapes[i]))
+            else:
+                continue  # label var unused at inference
+            outputs_of[id(node)] = [node.name]
+            continue
+        fn = MX2ONNX_TRANSLATORS.get(node.op)
+        if fn is None:
+            raise MXNetError(f"ONNX export: op {node.op} not supported "
+                             f"(node {node.name})")
+        ins = []
+        for (inp, idx) in node.inputs:
+            names = outputs_of.get(id(inp))
+            if names is None:
+                continue  # dropped label path
+            ins.append(names[min(idx, len(names) - 1)])
+        out = fn(ctx, node.name, ins, node.attrs or {})
+        outputs_of[id(node)] = [out] if isinstance(out, str) else list(out)
+
+    for (out_node, idx) in sym._outputs:
+        names = outputs_of[id(out_node)]
+        graph.outputs.append(ValueInfoProto(
+            names[min(idx, len(names) - 1)], 1, ()))
+
+    model = ModelProto(graph=graph)
+    model.save(onnx_file_path)
+    if verbose:
+        print(f"exported {len(graph.nodes)} nodes -> {onnx_file_path}")
+    return onnx_file_path

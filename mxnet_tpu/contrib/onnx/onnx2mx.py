@@ -1,0 +1,307 @@
+"""ONNX → Symbol importer.
+
+Reference parity: ``python/mxnet/contrib/onnx/onnx2mx/import_onnx.py``
+(GraphProto walk building mx symbols + arg/aux param dicts, one translator
+per ONNX op — ``_op_translations.py``). Returns ``(sym, arg_params,
+aux_params)`` exactly like ``onnx_mxnet.import_model``.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from ...base import MXNetError
+from .proto import ModelProto, ONNX_TO_DTYPE
+
+__all__ = ["import_model", "ONNX2MX_TRANSLATORS"]
+
+ONNX2MX_TRANSLATORS = {}
+
+
+def register(op_type):
+    def deco(fn):
+        ONNX2MX_TRANSLATORS[op_type] = fn
+        return fn
+    return deco
+
+
+def _sym():
+    from ... import symbol
+    return symbol
+
+
+@register("Conv")
+def _conv(name, ins, attrs, st):
+    kw = dict(kernel=tuple(attrs["kernel_shape"]),
+              stride=tuple(attrs.get("strides", ())) or None,
+              pad=tuple(attrs.get("pads", ())[:len(attrs["kernel_shape"])]),
+              dilate=tuple(attrs.get("dilations", ())) or None,
+              num_group=int(attrs.get("group", 1)),
+              num_filter=st["shapes"][ins[1].name][0],
+              no_bias=len(ins) == 2)
+    kw = {k: v for k, v in kw.items() if v is not None}
+    return _sym().Convolution(*ins, name=name, **kw)
+
+
+@register("ConvTranspose")
+def _deconv(name, ins, attrs, st):
+    kw = dict(kernel=tuple(attrs["kernel_shape"]),
+              stride=tuple(attrs.get("strides", ())) or None,
+              pad=tuple(attrs.get("pads", ())[:len(attrs["kernel_shape"])]),
+              num_group=int(attrs.get("group", 1)),
+              num_filter=st["shapes"][ins[1].name][1],
+              no_bias=len(ins) == 2)
+    kw = {k: v for k, v in kw.items() if v is not None}
+    return _sym().Deconvolution(*ins, name=name, **kw)
+
+
+@register("Gemm")
+def _gemm(name, ins, attrs, st):
+    if int(attrs.get("transB", 0)) != 1 or int(attrs.get("transA", 0)) != 0:
+        raise MXNetError("ONNX import: only Gemm(transA=0, transB=1)")
+    num_hidden = st["shapes"][ins[1].name][0]
+    return _sym().FullyConnected(ins[0], ins[1], ins[2], name=name,
+                                 num_hidden=num_hidden, flatten=False)
+
+
+@register("MatMul")
+def _matmul(name, ins, attrs, st):
+    return _sym().dot(ins[0], ins[1], name=name)
+
+
+@register("BatchNormalization")
+def _bn(name, ins, attrs, st):
+    return _sym().BatchNorm(*ins, name=name,
+                            eps=float(attrs.get("epsilon", 1e-5)),
+                            momentum=float(attrs.get("momentum", 0.9)),
+                            fix_gamma=False, use_global_stats=False)
+
+
+def _pool_kw(attrs):
+    kernel = tuple(attrs["kernel_shape"])
+    return dict(kernel=kernel,
+                stride=tuple(attrs.get("strides", ())) or (1,) * len(kernel),
+                pad=tuple(attrs.get("pads", ())[:len(kernel)]))
+
+
+@register("MaxPool")
+def _maxpool(name, ins, attrs, st):
+    return _sym().Pooling(ins[0], name=name, pool_type="max", **_pool_kw(attrs))
+
+
+@register("AveragePool")
+def _avgpool(name, ins, attrs, st):
+    return _sym().Pooling(
+        ins[0], name=name, pool_type="avg",
+        count_include_pad=bool(attrs.get("count_include_pad", 1)),
+        **_pool_kw(attrs))
+
+
+@register("GlobalMaxPool")
+def _gmaxpool(name, ins, attrs, st):
+    return _sym().Pooling(ins[0], name=name, pool_type="max", global_pool=True)
+
+
+@register("GlobalAveragePool")
+def _gavgpool(name, ins, attrs, st):
+    return _sym().Pooling(ins[0], name=name, pool_type="avg", global_pool=True)
+
+
+@register("Softmax")
+def _softmax(name, ins, attrs, st):
+    return _sym().softmax(ins[0], name=name, axis=int(attrs.get("axis", -1)))
+
+
+@register("Flatten")
+def _flatten(name, ins, attrs, st):
+    return _sym().Flatten(ins[0], name=name)
+
+
+@register("Concat")
+def _concat(name, ins, attrs, st):
+    return _sym().Concat(*ins, name=name, dim=int(attrs.get("axis", 1)))
+
+
+@register("Dropout")
+def _dropout(name, ins, attrs, st):
+    return _sym().Dropout(ins[0], name=name,
+                          p=float(attrs.get("ratio", 0.5)))
+
+
+@register("Reshape")
+def _reshape(name, ins, attrs, st):
+    if "shape" in attrs:                     # opset-1 attr form
+        shape = tuple(int(x) for x in attrs["shape"])
+    else:                                    # opset-5 tensor input form
+        shp_name = st["raw_inputs"][name][1]
+        if shp_name not in st["consts"]:
+            raise MXNetError("ONNX import: dynamic Reshape shape")
+        shape = tuple(int(x) for x in st["consts"][shp_name])
+    return _sym().Reshape(ins[0], name=name, shape=shape)
+
+
+@register("Transpose")
+def _transpose(name, ins, attrs, st):
+    if "perm" in attrs:
+        return _sym().transpose(ins[0], name=name,
+                                axes=tuple(int(x) for x in attrs["perm"]))
+    return _sym().transpose(ins[0], name=name)
+
+
+@register("Gather")
+def _gather(name, ins, attrs, st):
+    if int(attrs.get("axis", 0)) != 0:
+        raise MXNetError("ONNX import: Gather only with axis=0")
+    # Gather(weight, indices) -> take(weight, indices)
+    return _sym().take(ins[0], ins[1], name=name)
+
+
+@register("Clip")
+def _clip(name, ins, attrs, st):
+    return _sym().clip(ins[0], name=name,
+                       a_min=float(attrs.get("min", -3.4e38)),
+                       a_max=float(attrs.get("max", 3.4e38)))
+
+
+@register("ReduceMean")
+def _reduce_mean(name, ins, attrs, st):
+    kw = dict(keepdims=bool(attrs.get("keepdims", 1)))
+    if "axes" in attrs:
+        kw["axis"] = tuple(int(a) for a in attrs["axes"])
+    return _sym().mean(ins[0], name=name, **kw)
+
+
+@register("Cast")
+def _cast(name, ins, attrs, st):
+    dtype = ONNX_TO_DTYPE[int(attrs["to"])]
+    return _sym().Cast(ins[0], name=name, dtype=str(dtype))
+
+
+@register("LeakyRelu")
+def _leaky(name, ins, attrs, st):
+    return _sym().LeakyReLU(ins[0], name=name, act_type="leaky",
+                            slope=float(attrs.get("alpha", 0.01)))
+
+
+@register("Elu")
+def _elu(name, ins, attrs, st):
+    return _sym().LeakyReLU(ins[0], name=name, act_type="elu",
+                            slope=float(attrs.get("alpha", 1.0)))
+
+
+@register("PRelu")
+def _prelu(name, ins, attrs, st):
+    return _sym().LeakyReLU(ins[0], ins[1], name=name, act_type="prelu")
+
+
+@register("Sum")
+def _sum(name, ins, attrs, st):
+    return _sym().add_n(*ins, name=name)
+
+
+@register("Pad")
+def _pad(name, ins, attrs, st):
+    pads = [int(x) for x in attrs.get("pads", ())]
+    nd = len(pads) // 2
+    width = []
+    for i in range(nd):
+        width += [pads[i], pads[nd + i]]
+    return _sym().pad(ins[0], name=name,
+                      mode=attrs.get("mode", "constant"),
+                      pad_width=tuple(width),
+                      constant_value=float(attrs.get("value", 0.0)))
+
+
+def _binary(mx_op):
+    def fn(name, ins, attrs, st):
+        return getattr(_sym(), mx_op)(ins[0], ins[1], name=name)
+    return fn
+
+
+def _unary(mx_op):
+    def fn(name, ins, attrs, st):
+        return getattr(_sym(), mx_op)(ins[0], name=name)
+    return fn
+
+
+for _onnx, _mx in [("Add", "broadcast_add"), ("Sub", "broadcast_sub"),
+                   ("Mul", "broadcast_mul"), ("Div", "broadcast_div"),
+                   ("Max", "broadcast_maximum"), ("Min", "broadcast_minimum"),
+                   ("Pow", "broadcast_power")]:
+    register(_onnx)(_binary(_mx))
+
+for _onnx, _mx in [("Relu", "relu"), ("Sigmoid", "sigmoid"), ("Tanh", "tanh"),
+                   ("Exp", "exp"), ("Log", "log"), ("Sqrt", "sqrt"),
+                   ("Abs", "abs"), ("Neg", "negative"), ("Floor", "floor"),
+                   ("Ceil", "ceil"), ("Identity", "identity")]:
+    register(_onnx)(_unary(_mx))
+
+
+@register("Softplus")
+def _softplus(name, ins, attrs, st):
+    return _sym().Activation(ins[0], name=name, act_type="softrelu")
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+def import_model(model_file: str):
+    """Load an ONNX file → ``(sym, arg_params, aux_params)``.
+
+    Matches the reference entry ``onnx_mxnet.import_model`` — aux params are
+    the BatchNorm running stats (inputs 3/4 of BatchNormalization); all other
+    initializers are args.
+    """
+    from ... import ndarray as nd_mod
+    from ... import symbol as sym_mod
+
+    model = ModelProto.load(model_file)
+    g = model.graph
+
+    consts: Dict[str, np.ndarray] = {
+        t.name: t.to_array() for t in g.initializers}
+    shapes = {n: a.shape for n, a in consts.items()}
+
+    aux_names = set()
+    for node in g.nodes:
+        if node.op_type == "BatchNormalization":
+            aux_names.update(node.inputs[3:5])
+
+    st = {"consts": consts, "shapes": shapes,
+          "raw_inputs": {n.name or (n.outputs[0] if n.outputs else ""): n.inputs
+                         for n in g.nodes}}
+
+    env: Dict[str, "object"] = {}
+    for vi in g.inputs:
+        if vi.name not in consts:
+            env[vi.name] = sym_mod.Variable(vi.name)
+    for name in consts:
+        env[name] = sym_mod.Variable(name)
+
+    for node in g.nodes:
+        fn = ONNX2MX_TRANSLATORS.get(node.op_type)
+        if fn is None:
+            raise MXNetError(
+                f"ONNX import: op {node.op_type} not supported")
+        name = node.name or node.outputs[0]
+        st["raw_inputs"][name] = node.inputs
+        ins = [env[i] for i in node.inputs if i in env]
+        if node.op_type == "Reshape" and len(ins) == 2:
+            ins = ins[:1]  # shape tensor consumed via st["consts"] instead
+        out = fn(name, ins, node.attrs, st)
+        outs = [out[j] for j in range(len(out))] if len(out) > 1 else [out]
+        for out_name, s in zip(node.outputs, outs):
+            env[out_name] = s
+
+    out_syms = [env[o.name] for o in g.outputs]
+    sym = out_syms[0] if len(out_syms) == 1 else sym_mod.Group(out_syms)
+
+    # remap initializer names onto the composed graph's arg names: our symbol
+    # ops auto-bind inputs by position, so Variables carry the onnx names
+    arg_params = {k: nd_mod.array(v) for k, v in consts.items()
+                  if k not in aux_names}
+    aux_params = {k: nd_mod.array(v) for k, v in consts.items()
+                  if k in aux_names}
+    return sym, arg_params, aux_params

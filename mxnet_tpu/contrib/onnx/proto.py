@@ -1,0 +1,481 @@
+"""Minimal self-contained ONNX protobuf codec (no ``onnx``/``protobuf`` dep).
+
+The reference's converters (``python/mxnet/contrib/onnx/mx2onnx/export_onnx.py``,
+``onnx2mx/import_onnx.py``) lean on the installed ``onnx`` package; this
+environment has none, so the subset of ``onnx.proto3`` the converters need —
+Model/Graph/Node/Attribute/Tensor/ValueInfo — is implemented directly against
+the protobuf wire format (varint + length-delimited fields). Field numbers
+and enums follow the public ONNX spec, so files written here load in stock
+``onnx``/onnxruntime and vice versa.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["TensorProto", "ValueInfoProto", "AttributeProto", "NodeProto",
+           "GraphProto", "ModelProto", "OperatorSetIdProto",
+           "DTYPE_TO_ONNX", "ONNX_TO_DTYPE"]
+
+# onnx TensorProto.DataType
+DTYPE_TO_ONNX = {
+    np.dtype("float32"): 1, np.dtype("uint8"): 2, np.dtype("int8"): 3,
+    np.dtype("uint16"): 4, np.dtype("int16"): 5, np.dtype("int32"): 6,
+    np.dtype("int64"): 7, np.dtype("bool"): 9, np.dtype("float16"): 10,
+    np.dtype("float64"): 11, np.dtype("uint32"): 12, np.dtype("uint64"): 13,
+}
+ONNX_TO_DTYPE = {v: k for k, v in DTYPE_TO_ONNX.items()}
+
+
+# ---------------------------------------------------------------------------
+# wire primitives
+# ---------------------------------------------------------------------------
+
+def _enc_varint(x: int) -> bytes:
+    if x < 0:
+        x += 1 << 64  # two's complement, 64-bit
+    out = bytearray()
+    while True:
+        b = x & 0x7F
+        x >>= 7
+        if x:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _dec_varint(buf: bytes, pos: int):
+    x = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        x |= (b & 0x7F) << shift
+        if not b & 0x80:
+            break
+        shift += 7
+    return x, pos
+
+
+def _sint(x: int) -> int:
+    """Interpret a decoded varint as a signed 64-bit int."""
+    return x - (1 << 64) if x >= (1 << 63) else x
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _enc_varint((field << 3) | wire)
+
+
+def _enc_len(field: int, payload: bytes) -> bytes:
+    return _tag(field, 2) + _enc_varint(len(payload)) + payload
+
+
+def _enc_int(field: int, value: int) -> bytes:
+    return _tag(field, 0) + _enc_varint(int(value))
+
+
+def _enc_float(field: int, value: float) -> bytes:
+    return _tag(field, 5) + struct.pack("<f", float(value))
+
+
+def _enc_str(field: int, value) -> bytes:
+    if isinstance(value, str):
+        value = value.encode()
+    return _enc_len(field, value)
+
+
+def _iter_fields(buf: bytes):
+    """Yield (field_number, wire_type, value, next_pos) over a message."""
+    pos = 0
+    n = len(buf)
+    while pos < n:
+        key, pos = _dec_varint(buf, pos)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            val, pos = _dec_varint(buf, pos)
+        elif wire == 1:
+            val = buf[pos:pos + 8]
+            pos += 8
+        elif wire == 2:
+            ln, pos = _dec_varint(buf, pos)
+            val = buf[pos:pos + ln]
+            pos += ln
+        elif wire == 5:
+            val = buf[pos:pos + 4]
+            pos += 4
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        yield field, wire, val
+
+
+def _dec_packed_varints(val, wire) -> List[int]:
+    if wire == 0:
+        return [_sint(val)]
+    out = []
+    pos = 0
+    while pos < len(val):
+        x, pos = _dec_varint(val, pos)
+        out.append(_sint(x))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# messages
+# ---------------------------------------------------------------------------
+
+class TensorProto:
+    """onnx.TensorProto: dims=1, data_type=2, float_data=4, int32_data=5,
+    string_data=6, int64_data=7, name=8, raw_data=9."""
+
+    def __init__(self, name="", dims=(), data_type=1, raw_data=b""):
+        self.name = name
+        self.dims = list(dims)
+        self.data_type = data_type
+        self.raw_data = raw_data
+        self._typed_data: List = []
+
+    @classmethod
+    def from_array(cls, arr: np.ndarray, name: str) -> "TensorProto":
+        arr = np.ascontiguousarray(arr)
+        dt = DTYPE_TO_ONNX[arr.dtype]
+        return cls(name=name, dims=arr.shape, data_type=dt,
+                   raw_data=arr.tobytes())
+
+    def to_array(self) -> np.ndarray:
+        dtype = ONNX_TO_DTYPE[self.data_type]
+        if self.raw_data:
+            arr = np.frombuffer(self.raw_data, dtype=dtype)
+        else:
+            arr = np.asarray(self._typed_data, dtype=dtype)
+        return arr.reshape(self.dims)
+
+    def encode(self) -> bytes:
+        out = bytearray()
+        for d in self.dims:
+            out += _enc_int(1, d)
+        out += _enc_int(2, self.data_type)
+        if self.name:
+            out += _enc_str(8, self.name)
+        out += _enc_len(9, self.raw_data)
+        return bytes(out)
+
+    @classmethod
+    def decode(cls, buf: bytes) -> "TensorProto":
+        t = cls()
+        t._typed_data = []
+        for field, wire, val in _iter_fields(buf):
+            if field == 1:
+                t.dims.extend(_dec_packed_varints(val, wire))
+            elif field == 2:
+                t.data_type = val
+            elif field == 4 and wire == 2:   # packed floats
+                t._typed_data.extend(
+                    struct.unpack(f"<{len(val)//4}f", val))
+            elif field == 4 and wire == 5:
+                t._typed_data.append(struct.unpack("<f", val)[0])
+            elif field in (5, 7):
+                t._typed_data.extend(_dec_packed_varints(val, wire))
+            elif field == 8:
+                t.name = val.decode()
+            elif field == 9:
+                t.raw_data = val
+        return t
+
+
+class ValueInfoProto:
+    """onnx.ValueInfoProto: name=1, type=2 {tensor_type=1 {elem_type=1,
+    shape=2 {dim=1 {dim_value=1 | dim_param=2}}}}."""
+
+    def __init__(self, name="", elem_type=1, shape=()):
+        self.name = name
+        self.elem_type = elem_type
+        self.shape = list(shape)   # ints or strings (symbolic dims)
+
+    def encode(self) -> bytes:
+        dims = bytearray()
+        for d in self.shape:
+            if isinstance(d, str):
+                dims += _enc_len(1, _enc_str(2, d))
+            else:
+                dims += _enc_len(1, _enc_int(1, d))
+        shape_msg = bytes(dims)
+        tensor_type = _enc_int(1, self.elem_type) + _enc_len(2, shape_msg)
+        type_msg = _enc_len(1, tensor_type)
+        return _enc_str(1, self.name) + _enc_len(2, type_msg)
+
+    @classmethod
+    def decode(cls, buf: bytes) -> "ValueInfoProto":
+        v = cls()
+        for field, wire, val in _iter_fields(buf):
+            if field == 1:
+                v.name = val.decode()
+            elif field == 2:
+                for f2, w2, v2 in _iter_fields(val):
+                    if f2 != 1:
+                        continue
+                    for f3, w3, v3 in _iter_fields(v2):
+                        if f3 == 1:
+                            v.elem_type = v3
+                        elif f3 == 2:
+                            for f4, w4, v4 in _iter_fields(v3):
+                                if f4 != 1:
+                                    continue
+                                dim_val = 0
+                                for f5, w5, v5 in _iter_fields(v4):
+                                    if f5 == 1:
+                                        dim_val = _sint(v5)
+                                    elif f5 == 2:
+                                        dim_val = v5.decode()
+                                v.shape.append(dim_val)
+        return v
+
+
+class AttributeProto:
+    """onnx.AttributeProto: name=1, f=2, i=3, s=4, t=5, floats=7, ints=8,
+    strings=9, type=20 (FLOAT=1 INT=2 STRING=3 TENSOR=4 FLOATS=6 INTS=7
+    STRINGS=8)."""
+
+    def __init__(self, name="", value=None, attr_type=None):
+        self.name = name
+        self.value = value
+        self.attr_type = attr_type
+
+    @classmethod
+    def make(cls, name: str, value) -> "AttributeProto":
+        if isinstance(value, bool):
+            return cls(name, int(value), 2)
+        if isinstance(value, (int, np.integer)):
+            return cls(name, int(value), 2)
+        if isinstance(value, (float, np.floating)):
+            return cls(name, float(value), 1)
+        if isinstance(value, (str, bytes)):
+            return cls(name, value, 3)
+        if isinstance(value, TensorProto):
+            return cls(name, value, 4)
+        if isinstance(value, (list, tuple)):
+            if all(isinstance(x, (int, np.integer)) for x in value):
+                return cls(name, [int(x) for x in value], 7)
+            if all(isinstance(x, (str, bytes)) for x in value):
+                return cls(name, list(value), 8)
+            return cls(name, [float(x) for x in value], 6)
+        raise TypeError(f"unsupported attribute {name}={value!r}")
+
+    def encode(self) -> bytes:
+        out = bytearray(_enc_str(1, self.name))
+        t = self.attr_type
+        if t == 1:
+            out += _enc_float(2, self.value)
+        elif t == 2:
+            out += _enc_int(3, self.value)
+        elif t == 3:
+            out += _enc_str(4, self.value)
+        elif t == 4:
+            out += _enc_len(5, self.value.encode())
+        elif t == 6:
+            for x in self.value:
+                out += _enc_float(7, x)
+        elif t == 7:
+            for x in self.value:
+                out += _enc_int(8, x)
+        elif t == 8:
+            for x in self.value:
+                out += _enc_str(9, x)
+        else:
+            raise TypeError(f"unsupported attr type {t}")
+        out += _enc_int(20, t)
+        return bytes(out)
+
+    @classmethod
+    def decode(cls, buf: bytes) -> "AttributeProto":
+        a = cls()
+        floats: List[float] = []
+        ints: List[int] = []
+        strings: List[bytes] = []
+        for field, wire, val in _iter_fields(buf):
+            if field == 1:
+                a.name = val.decode()
+            elif field == 2:
+                a.value = struct.unpack("<f", val)[0]
+                a.attr_type = a.attr_type or 1
+            elif field == 3:
+                a.value = _sint(val)
+                a.attr_type = a.attr_type or 2
+            elif field == 4:
+                a.value = val.decode()
+                a.attr_type = a.attr_type or 3
+            elif field == 5:
+                a.value = TensorProto.decode(val)
+                a.attr_type = a.attr_type or 4
+            elif field == 7:
+                floats.append(struct.unpack("<f", val)[0] if wire == 5 else
+                              float(val))
+                a.attr_type = 6
+            elif field == 8:
+                ints.extend(_dec_packed_varints(val, wire))
+                a.attr_type = 7
+            elif field == 9:
+                strings.append(val.decode())
+                a.attr_type = 8
+            elif field == 20:
+                a.attr_type = val
+        if a.attr_type == 6:
+            a.value = floats
+        elif a.attr_type == 7:
+            a.value = ints
+        elif a.attr_type == 8:
+            a.value = strings
+        return a
+
+
+class NodeProto:
+    """onnx.NodeProto: input=1, output=2, name=3, op_type=4, attribute=5,
+    domain=7."""
+
+    def __init__(self, op_type="", name="", inputs=(), outputs=(), attrs=None):
+        self.op_type = op_type
+        self.name = name
+        self.inputs = list(inputs)
+        self.outputs = list(outputs)
+        self.attrs: Dict[str, Any] = dict(attrs or {})
+
+    def encode(self) -> bytes:
+        out = bytearray()
+        for s in self.inputs:
+            out += _enc_str(1, s)
+        for s in self.outputs:
+            out += _enc_str(2, s)
+        out += _enc_str(3, self.name)
+        out += _enc_str(4, self.op_type)
+        for k in sorted(self.attrs):
+            out += _enc_len(5, AttributeProto.make(k, self.attrs[k]).encode())
+        return bytes(out)
+
+    @classmethod
+    def decode(cls, buf: bytes) -> "NodeProto":
+        n = cls()
+        for field, wire, val in _iter_fields(buf):
+            if field == 1:
+                n.inputs.append(val.decode())
+            elif field == 2:
+                n.outputs.append(val.decode())
+            elif field == 3:
+                n.name = val.decode()
+            elif field == 4:
+                n.op_type = val.decode()
+            elif field == 5:
+                a = AttributeProto.decode(val)
+                n.attrs[a.name] = a.value
+        return n
+
+
+class GraphProto:
+    """onnx.GraphProto: node=1, name=2, initializer=5, input=11, output=12."""
+
+    def __init__(self, name="graph"):
+        self.name = name
+        self.nodes: List[NodeProto] = []
+        self.initializers: List[TensorProto] = []
+        self.inputs: List[ValueInfoProto] = []
+        self.outputs: List[ValueInfoProto] = []
+
+    def encode(self) -> bytes:
+        out = bytearray()
+        for n in self.nodes:
+            out += _enc_len(1, n.encode())
+        out += _enc_str(2, self.name)
+        for t in self.initializers:
+            out += _enc_len(5, t.encode())
+        for v in self.inputs:
+            out += _enc_len(11, v.encode())
+        for v in self.outputs:
+            out += _enc_len(12, v.encode())
+        return bytes(out)
+
+    @classmethod
+    def decode(cls, buf: bytes) -> "GraphProto":
+        g = cls()
+        for field, wire, val in _iter_fields(buf):
+            if field == 1:
+                g.nodes.append(NodeProto.decode(val))
+            elif field == 2:
+                g.name = val.decode()
+            elif field == 5:
+                g.initializers.append(TensorProto.decode(val))
+            elif field == 11:
+                g.inputs.append(ValueInfoProto.decode(val))
+            elif field == 12:
+                g.outputs.append(ValueInfoProto.decode(val))
+        return g
+
+
+class OperatorSetIdProto:
+    """onnx.OperatorSetIdProto: domain=1, version=2."""
+
+    def __init__(self, domain="", version=9):
+        self.domain = domain
+        self.version = version
+
+    def encode(self) -> bytes:
+        return _enc_str(1, self.domain) + _enc_int(2, self.version)
+
+    @classmethod
+    def decode(cls, buf: bytes) -> "OperatorSetIdProto":
+        o = cls()
+        for field, wire, val in _iter_fields(buf):
+            if field == 1:
+                o.domain = val.decode()
+            elif field == 2:
+                o.version = val
+        return o
+
+
+class ModelProto:
+    """onnx.ModelProto: ir_version=1, producer_name=2, producer_version=3,
+    model_version=5, graph=7, opset_import=8."""
+
+    def __init__(self, graph: Optional[GraphProto] = None, ir_version=4,
+                 producer_name="mxnet_tpu", producer_version="0.1",
+                 opset_version=9):
+        self.ir_version = ir_version
+        self.producer_name = producer_name
+        self.producer_version = producer_version
+        self.graph = graph or GraphProto()
+        self.opset_imports = [OperatorSetIdProto(version=opset_version)]
+
+    def encode(self) -> bytes:
+        out = bytearray(_enc_int(1, self.ir_version))
+        out += _enc_str(2, self.producer_name)
+        out += _enc_str(3, self.producer_version)
+        out += _enc_len(7, self.graph.encode())
+        for o in self.opset_imports:
+            out += _enc_len(8, o.encode())
+        return bytes(out)
+
+    @classmethod
+    def decode(cls, buf: bytes) -> "ModelProto":
+        m = cls(graph=None)
+        m.opset_imports = []
+        for field, wire, val in _iter_fields(buf):
+            if field == 1:
+                m.ir_version = val
+            elif field == 2:
+                m.producer_name = val.decode()
+            elif field == 3:
+                m.producer_version = val.decode()
+            elif field == 7:
+                m.graph = GraphProto.decode(val)
+            elif field == 8:
+                m.opset_imports.append(OperatorSetIdProto.decode(val))
+        return m
+
+    def save(self, path: str) -> None:
+        with open(path, "wb") as f:
+            f.write(self.encode())
+
+    @classmethod
+    def load(cls, path: str) -> "ModelProto":
+        with open(path, "rb") as f:
+            return cls.decode(f.read())

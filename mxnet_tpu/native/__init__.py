@@ -105,6 +105,10 @@ def get_lib():
 TASK_FN = ctypes.CFUNCTYPE(None, ctypes.c_void_p,
                            ctypes.POINTER(ctypes.c_char_p))
 
+_libc = ctypes.CDLL(None)
+_libc.strdup.restype = ctypes.c_void_p
+_libc.strdup.argtypes = [ctypes.c_char_p]
+
 
 class NativeEngine:
     """Threaded dependency engine (reference ThreadedEnginePerDevice
@@ -125,35 +129,49 @@ class NativeEngine:
         self._lib = lib
         self._h = lib.eng_create(num_workers)
         self._callbacks = {}      # keep CFUNCTYPE objects alive until done
-        self._done = []           # ids safe to drop (drained outside callbacks)
+        self._cb_vars = {}        # cb_id -> vars the op touches
+        self._done = set()        # ids whose PYTHON body finished
         self._cb_id = [0]
         self._cb_lock = threading.Lock()
 
-    def _drain_done(self):
-        # ONLY call from points where the C engine guarantees every recorded
-        # callback's thunk has fully returned (after eng_wait_all /
-        # eng_destroy). Draining from push() would race: _done is appended
-        # inside the Python body, before the worker thread finishes walking
-        # back through the ffi closure's return path.
+    def _check(self):
+        if not self._h:
+            raise RuntimeError("engine is closed")
+
+    def _drain_done(self, var=None):
+        # A CFUNCTYPE may only be dropped once its thunk has FULLY returned
+        # (the worker is past the ffi closure's return path). The C engine
+        # proves that per-op at Finish time: eng_wait_all ⇒ all ops finished;
+        # eng_wait_var(v) ⇒ every op touching v finished. _done alone is not
+        # proof (appended inside the Python body), so it is intersected with
+        # that guarantee: var=None drains everything, else only ops on `var`.
         with self._cb_lock:
-            for cb_id in self._done:
+            for cb_id in list(self._done):
+                if var is not None and var not in self._cb_vars.get(cb_id, ()):
+                    continue
+                self._done.discard(cb_id)
                 self._callbacks.pop(cb_id, None)
-            self._done.clear()
+                self._cb_vars.pop(cb_id, None)
 
     def new_var(self) -> int:
+        self._check()
         return int(self._lib.eng_new_var(self._h))
 
     def var_version(self, var: int) -> int:
+        self._check()
         return int(self._lib.eng_var_version(self._h, var))
 
     def free_var(self, var: int) -> None:
         """Engine::DeleteVariable — waits for pending ops, then reclaims."""
+        self._check()
         self._lib.eng_del_var(self._h, var)
+        self._drain_done(var)
 
     def push(self, fn, const_vars=(), mutable_vars=(), priority: int = 0):
         """Schedule ``fn()`` after all deps; reads const_vars, writes
         mutable_vars (MXEnginePushAsync semantics). Exceptions raised by
         ``fn`` surface at wait_var/wait_all on any touched var."""
+        self._check()
         with self._cb_lock:
             cb_id = self._cb_id[0]
             self._cb_id[0] += 1
@@ -163,20 +181,19 @@ class NativeEngine:
                 fn()
             except BaseException as e:  # captured, surfaced at sync point
                 msg = f"{type(e).__name__}: {e}".encode()
-                buf = ctypes.create_string_buffer(msg)  # NUL-terminated
                 # engine frees with free(); allocate with C malloc via strdup
-                libc = ctypes.CDLL(None)
-                libc.strdup.restype = ctypes.c_void_p
-                err_out[0] = ctypes.cast(libc.strdup(buf), ctypes.c_char_p)
+                err_out[0] = ctypes.cast(_libc.strdup(msg), ctypes.c_char_p)
             finally:
                 # NOT popped here: freeing a CFUNCTYPE from inside its own
                 # invocation would release the thunk while it is executing
                 with self._cb_lock:
-                    self._done.append(cb_id)
+                    self._done.add(cb_id)
 
         cfn = TASK_FN(trampoline)
         with self._cb_lock:
             self._callbacks[cb_id] = cfn
+            self._cb_vars[cb_id] = frozenset(const_vars) | frozenset(
+                mutable_vars)
         nc, nm = len(const_vars), len(mutable_vars)
         cv = (ctypes.c_uint64 * max(nc, 1))(*const_vars)
         mv = (ctypes.c_uint64 * max(nm, 1))(*mutable_vars)
@@ -189,11 +206,16 @@ class NativeEngine:
             raise RuntimeError(f"deferred engine error: {msg}")
 
     def wait_var(self, var: int) -> None:
-        self._raise_if(self._lib.eng_wait_var(self._h, var))
+        self._check()
+        err = self._lib.eng_wait_var(self._h, var)
+        self._drain_done(var)  # ops touching `var` have finished
+        self._raise_if(err)
 
     def wait_all(self) -> None:
-        self._raise_if(self._lib.eng_wait_all(self._h))
+        self._check()
+        err = self._lib.eng_wait_all(self._h)
         self._drain_done()
+        self._raise_if(err)
 
     def close(self) -> None:
         if self._h:
@@ -201,6 +223,7 @@ class NativeEngine:
             self._h = None
             self._drain_done()
             self._callbacks.clear()
+            self._cb_vars.clear()
 
     def __del__(self):
         try:
@@ -231,6 +254,8 @@ class StoragePool:
 
     def alloc(self, nbytes: int) -> np.ndarray:
         import weakref
+        if not self._h:
+            raise RuntimeError("storage pool is closed")
         ptr = self._lib.sto_alloc(self._h, nbytes)
         if not ptr:
             raise MemoryError(nbytes)
@@ -253,12 +278,16 @@ class StoragePool:
             self._return_block(ptr)
 
     def stats(self) -> dict:
+        if not self._h:
+            raise RuntimeError("storage pool is closed")
         out = (ctypes.c_uint64 * 4)()
         self._lib.sto_stats(self._h, out)
         return {"live_bytes": out[0], "pooled_bytes": out[1],
                 "allocs": out[2], "pool_hits": out[3]}
 
     def release_all(self) -> None:
+        if not self._h:
+            raise RuntimeError("storage pool is closed")
         self._lib.sto_release_all(self._h)
 
     def close(self) -> None:

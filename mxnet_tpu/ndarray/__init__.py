@@ -27,12 +27,10 @@ __all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
 
 
 def waitall() -> None:
-    """Block until all launched work completes (reference Engine::WaitForAll)."""
-    try:
-        for a in jax.live_arrays():
-            a.block_until_ready()
-    except Exception:
-        pass
+    """Block until all launched work completes (reference Engine::WaitForAll:
+    device XLA queues + host task engine, surfacing deferred errors)."""
+    from ..engine import wait_all
+    wait_all()
 
 
 def _make_op_func(name: str):

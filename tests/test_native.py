@@ -104,18 +104,29 @@ def test_engine_read_write_deps(lib):
 
 
 def test_engine_parallel_reads(lib):
-    """Independent readers overlap on the pool (no false serialization)."""
+    """Independent readers overlap on the pool (no false serialization):
+    assert observed concurrency structurally, not by wall clock."""
+    import threading
     import time
     from mxnet_tpu.native import NativeEngine
     eng = NativeEngine(8)
     v = eng.new_var()
     eng.push(lambda: None, mutable_vars=[v])
-    t0 = time.monotonic()
+    lock = threading.Lock()
+    state = {"cur": 0, "peak": 0}
+
+    def reader():
+        with lock:
+            state["cur"] += 1
+            state["peak"] = max(state["peak"], state["cur"])
+        time.sleep(0.05)          # GIL released: readers can overlap
+        with lock:
+            state["cur"] -= 1
+
     for _ in range(8):
-        eng.push(lambda: time.sleep(0.1), const_vars=[v])
+        eng.push(reader, const_vars=[v])
     eng.wait_var(v)
-    # 8 x 0.1s sleeps (GIL released) on 8 workers ≈ 0.1s, not 0.8s
-    assert time.monotonic() - t0 < 0.5
+    assert state["peak"] >= 2     # serialized readers would peak at 1
     eng.close()
 
 
@@ -211,3 +222,26 @@ def test_storage_gc_returns_block(lib):
     st = pool.stats()
     assert st["live_bytes"] == 0 and st["pooled_bytes"] == 4096
     pool.close()
+
+
+def test_engine_closed_guard(lib):
+    from mxnet_tpu.native import NativeEngine, StoragePool
+    eng = NativeEngine(2)
+    eng.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        eng.new_var()
+    pool = StoragePool("pooled")
+    pool.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        pool.alloc(10)
+
+
+def test_nd_waitall_surfaces_host_errors():
+    import mxnet_tpu as mx
+    from mxnet_tpu import engine
+    v = engine.new_var()
+    engine.push(lambda: (_ for _ in ()).throw(ValueError("boom")),
+                mutable_vars=[v])
+    with pytest.raises(RuntimeError, match="boom"):
+        mx.nd.waitall()
+    engine.free_var(v)

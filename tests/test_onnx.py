@@ -1,0 +1,160 @@
+"""ONNX export/import round-trip tests (reference test strategy:
+tests/python-pytest/onnx/ — export a model, re-import, compare forward)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.contrib.onnx import export_model, import_model
+from mxnet_tpu.contrib.onnx.proto import (ModelProto, GraphProto, NodeProto,
+                                          TensorProto, AttributeProto,
+                                          ValueInfoProto)
+
+
+def _lenet():
+    data = mx.sym.Variable("data")
+    c1 = mx.sym.Convolution(data, kernel=(5, 5), num_filter=8, name="c1")
+    a1 = mx.sym.Activation(c1, act_type="tanh", name="a1")
+    p1 = mx.sym.Pooling(a1, pool_type="max", kernel=(2, 2), stride=(2, 2),
+                        name="p1")
+    f = mx.sym.Flatten(p1, name="flat")
+    fc1 = mx.sym.FullyConnected(f, num_hidden=32, name="fc1")
+    a2 = mx.sym.Activation(fc1, act_type="relu", name="a2")
+    fc2 = mx.sym.FullyConnected(a2, num_hidden=10, name="fc2")
+    return mx.sym.softmax(fc2, axis=-1, name="out")
+
+
+def _init_params(sym, data_shape):
+    shapes, _, _ = sym.infer_shape(data=data_shape)
+    rng = np.random.RandomState(7)
+    params = {}
+    for name, shp in zip(sym.list_arguments(), shapes):
+        if name == "data":
+            continue
+        params[name] = mx.nd.array(rng.uniform(-0.1, 0.1, shp)
+                                   .astype("float32"))
+    return params
+
+
+def _forward(sym, params, x):
+    ex = sym.bind(mx.cpu(), dict(params, data=mx.nd.array(x)))
+    return ex.forward(is_train=False)[0].asnumpy()
+
+
+def test_proto_roundtrip():
+    g = GraphProto(name="g")
+    g.nodes.append(NodeProto("Conv", "n0", ["x", "w"], ["y"],
+                             {"kernel_shape": [3, 3], "alpha": 0.5,
+                              "mode": "constant"}))
+    g.initializers.append(TensorProto.from_array(
+        np.arange(6, dtype=np.float32).reshape(2, 3), "w"))
+    g.inputs.append(ValueInfoProto("x", 1, (1, 3, "N", 8)))
+    g.outputs.append(ValueInfoProto("y", 1, ()))
+    m = ModelProto(graph=g, opset_version=11)
+    buf = m.encode()
+    m2 = ModelProto.decode(buf)
+    assert m2.producer_name == "mxnet_tpu"
+    assert m2.opset_imports[0].version == 11
+    n = m2.graph.nodes[0]
+    assert n.op_type == "Conv" and n.inputs == ["x", "w"]
+    assert n.attrs["kernel_shape"] == [3, 3]
+    assert abs(n.attrs["alpha"] - 0.5) < 1e-7
+    assert n.attrs["mode"] == "constant"
+    w = m2.graph.initializers[0].to_array()
+    np.testing.assert_array_equal(w, np.arange(6).reshape(2, 3))
+    vi = m2.graph.inputs[0]
+    assert vi.shape == [1, 3, "N", 8]
+
+
+def test_export_import_lenet_roundtrip(tmp_path):
+    sym = _lenet()
+    shape = (2, 1, 16, 16)
+    params = _init_params(sym, shape)
+    x = np.random.RandomState(3).randn(*shape).astype("float32")
+    ref = _forward(sym, params, x)
+
+    path = str(tmp_path / "lenet.onnx")
+    export_model(sym, params, shape, np.float32, path)
+
+    sym2, args2, aux2 = import_model(path)
+    out = _forward(sym2, {**args2, **aux2}, x)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_export_import_batchnorm_concat(tmp_path):
+    data = mx.sym.Variable("data")
+    bn = mx.sym.BatchNorm(data, name="bn", fix_gamma=False,
+                          use_global_stats=True)
+    br1 = mx.sym.Convolution(bn, kernel=(1, 1), num_filter=4, name="br1")
+    br2 = mx.sym.Convolution(bn, kernel=(1, 1), num_filter=4, name="br2")
+    cat = mx.sym.Concat(br1, br2, dim=1, name="cat")
+    pool = mx.sym.Pooling(cat, global_pool=True, pool_type="avg", name="gap")
+    out = mx.sym.Flatten(pool, name="flatout")
+
+    shape = (2, 3, 8, 8)
+    shapes, _, _ = out.infer_shape(data=shape)
+    rng = np.random.RandomState(11)
+    params = {}
+    for name, shp in zip(out.list_arguments(), shapes):
+        if name == "data":
+            continue
+        if "moving_var" in name or "var" in name:
+            params[name] = mx.nd.array(
+                rng.uniform(0.5, 1.5, shp).astype("float32"))
+        else:
+            params[name] = mx.nd.array(
+                rng.uniform(-0.5, 0.5, shp).astype("float32"))
+    for name, shp in zip(out.list_auxiliary_states(),
+                         out.infer_shape(data=shape)[2]):
+        if "var" in name:
+            params[name] = mx.nd.array(
+                rng.uniform(0.5, 1.5, shp).astype("float32"))
+        else:
+            params[name] = mx.nd.array(rng.randn(*shp).astype("float32"))
+
+    x = rng.randn(*shape).astype("float32")
+    ex = out.bind(mx.cpu(), dict(params, data=mx.nd.array(x)))
+    ref = ex.forward(is_train=False)[0].asnumpy()
+
+    path = str(tmp_path / "bn.onnx")
+    export_model(out, params, shape, np.float32, path)
+    sym2, args2, aux2 = import_model(path)
+    ex2 = sym2.bind(mx.cpu(), {**args2, **aux2, "data": mx.nd.array(x)})
+    got = ex2.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_export_elemwise_scalar(tmp_path):
+    a = mx.sym.Variable("a")
+    out = (a * 2.0 + 1.5)
+    out = mx.sym.relu(out, name="r")
+    path = str(tmp_path / "ew.onnx")
+    x = np.random.RandomState(0).randn(3, 4).astype("float32")
+    export_model(out, {}, (3, 4), np.float32, path)
+    sym2, args2, aux2 = import_model(path)
+    ex = sym2.bind(mx.cpu(), {**args2, "a": mx.nd.array(x)})
+    got = ex.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(got, np.maximum(x * 2 + 1.5, 0), rtol=1e-6)
+
+
+def test_export_resnet_zoo(tmp_path):
+    """The model-zoo export path the reference advertises (mx2onnx on
+    resnet): hybridized gluon net -> Symbol -> onnx file, then re-import
+    and numerically compare."""
+    from mxnet_tpu.gluon.model_zoo import vision
+    net = vision.squeezenet1_0(classes=10)
+    net.initialize(mx.init.Xavier())
+    x = np.random.RandomState(5).randn(1, 3, 64, 64).astype("float32")
+    ref = net(mx.nd.array(x)).asnumpy()
+
+    data = mx.sym.Variable("data")
+    sym = net(data)
+    params = {p.name: p.data() for p in net.collect_params().values()}
+    path = str(tmp_path / "squeezenet.onnx")
+    export_model(sym, params, x.shape, np.float32, path)
+
+    sym2, args2, aux2 = import_model(path)
+    ex = sym2.bind(mx.cpu(), {**args2, **aux2, "data": mx.nd.array(x)})
+    got = ex.forward(is_train=False)[0].asnumpy()
+    # different op spellings → different XLA fusion → fp32 reassociation
+    # noise across 26 conv layers; compare with an absolute tolerance
+    np.testing.assert_allclose(got, ref, rtol=2e-2, atol=2e-3)
