@@ -5,3 +5,11 @@ from .control_flow import foreach, while_loop, cond
 from . import quantization
 from . import amp
 from . import onnx
+from . import text
+from . import svrg_optimization
+from . import tensorboard
+from . import tensorrt
+from . import autograd
+from . import io
+from . import ndarray
+from . import symbol

@@ -1,0 +1,67 @@
+"""Legacy experimental autograd API (reference
+``python/mxnet/contrib/autograd.py`` — the pre-``mx.autograd`` surface:
+set_is_training, train_section, backward, grad/grad_and_loss decorators).
+Thin adapters over the first-class ``mxnet_tpu.autograd``."""
+from __future__ import annotations
+
+import functools
+
+from .. import autograd as _ag
+from .. import ndarray as nd
+
+__all__ = ["set_is_training", "train_section", "test_section", "backward",
+           "compute_gradient", "grad_and_loss", "grad"]
+
+
+def set_is_training(is_train: bool):
+    """Reference contrib/autograd.py:set_is_training; returns previous."""
+    prev = _ag.is_training()
+    _ag.set_training(is_train)
+    _ag.set_recording(is_train)
+    return prev
+
+
+train_section = _ag.record
+test_section = _ag.pause
+
+
+def backward(outputs, out_grads=None, retain_graph=False):
+    head = outputs if not isinstance(outputs, (list, tuple)) else None
+    if head is not None:
+        return _ag.backward([head], out_grads and [out_grads],
+                            retain_graph=retain_graph)
+    return _ag.backward(list(outputs), out_grads, retain_graph=retain_graph)
+
+
+def compute_gradient(outputs):
+    """Deprecated alias (reference :89)."""
+    return backward(outputs)
+
+
+def grad_and_loss(func, argnum=None):
+    """Decorator: returns (gradients, loss) (reference :120)."""
+    @functools.wraps(func)
+    def wrapped(*args):
+        variables = list(args)
+        if argnum is not None:
+            idx = argnum if isinstance(argnum, (list, tuple)) else [argnum]
+            variables = [args[i] for i in idx]
+        for x in variables:
+            x.attach_grad()
+        with _ag.record():
+            outputs = func(*args)
+        backward([outputs] if not isinstance(outputs, (list, tuple))
+                 else list(outputs))
+        grads = [x.grad for x in variables]
+        return grads, outputs
+    return wrapped
+
+
+def grad(func, argnum=None):
+    """Decorator: returns gradients only (reference :149)."""
+    g_l = grad_and_loss(func, argnum)
+
+    @functools.wraps(func)
+    def wrapped(*args):
+        return g_l(*args)[0]
+    return wrapped

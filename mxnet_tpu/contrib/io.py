@@ -1,0 +1,49 @@
+"""Contrib IO (reference ``python/mxnet/contrib/io.py``:
+DataLoaderIter — wraps a gluon DataLoader in the DataIter interface so
+Module-based training loops can consume Dataset/DataLoader pipelines)."""
+from __future__ import annotations
+
+from ..io.io import DataIter, DataBatch, DataDesc
+
+__all__ = ["DataLoaderIter"]
+
+
+class DataLoaderIter(DataIter):
+    """DataIter view over a ``gluon.data.DataLoader`` (reference io.py:30)."""
+
+    def __init__(self, loader, data_name="data", label_name="softmax_label"):
+        super().__init__(batch_size=getattr(loader, "_batch_size", 0))
+        self._loader = loader
+        self._iter = iter(loader)
+        self._data_name = data_name
+        self._label_name = label_name
+        self._first = None
+        try:
+            self._first = next(self._iter)
+        except StopIteration:
+            raise ValueError("empty DataLoader")
+
+    def _descs(self, sample, name):
+        return [DataDesc(name, tuple(sample.shape))]
+
+    @property
+    def provide_data(self):
+        return self._descs(self._first[0], self._data_name)
+
+    @property
+    def provide_label(self):
+        return self._descs(self._first[1], self._label_name)
+
+    def reset(self):
+        self._iter = iter(self._loader)
+
+    def next(self):
+        if self._first is not None:
+            data, label = self._first
+            self._first = None
+        else:
+            try:
+                data, label = next(self._iter)
+            except StopIteration:
+                raise StopIteration
+        return DataBatch(data=[data], label=[label], pad=0)

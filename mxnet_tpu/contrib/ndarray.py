@@ -1,0 +1,4 @@
+"""``mx.contrib.ndarray`` — contrib op namespace alias (reference generates
+``mxnet.contrib.ndarray`` from the ``_contrib_*`` registrations; here it is
+the same lazy module as ``mx.nd.contrib``)."""
+from ..ndarray.contrib import __getattr__, __dir__  # noqa: F401

@@ -329,20 +329,7 @@ def export_model(sym, params, input_shape, input_dtype=np.float32,
     dtypes = input_dtype if isinstance(input_dtype, (list, tuple)) \
         else [input_dtype] * len(shapes)
 
-    # topo order over the node graph
-    order = []
-    seen = set()
-
-    def visit(node):
-        if id(node) in seen:
-            return
-        seen.add(id(node))
-        for (inp, _) in node.inputs:
-            visit(inp)
-        order.append(node)
-
-    for (out_node, _) in sym._outputs:
-        visit(out_node)
+    order = sym.topo_nodes()
 
     # graph inputs: variables not provided by params
     var_inputs = [n.name for n in order

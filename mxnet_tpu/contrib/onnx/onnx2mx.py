@@ -94,7 +94,7 @@ def _maxpool(name, ins, attrs, st):
 def _avgpool(name, ins, attrs, st):
     return _sym().Pooling(
         ins[0], name=name, pool_type="avg",
-        count_include_pad=bool(attrs.get("count_include_pad", 1)),
+        count_include_pad=bool(attrs.get("count_include_pad", 0)),  # spec default 0
         **_pool_kw(attrs))
 
 
@@ -274,6 +274,7 @@ def import_model(model_file: str):
                          for n in g.nodes}}
 
     env: Dict[str, "object"] = {}
+    consumed_consts = set()  # attr-like tensors (e.g. Reshape shapes)
     for vi in g.inputs:
         if vi.name not in consts:
             env[vi.name] = sym_mod.Variable(vi.name)
@@ -290,6 +291,7 @@ def import_model(model_file: str):
         ins = [env[i] for i in node.inputs if i in env]
         if node.op_type == "Reshape" and len(ins) == 2:
             ins = ins[:1]  # shape tensor consumed via st["consts"] instead
+            consumed_consts.add(node.inputs[1])
         out = fn(name, ins, node.attrs, st)
         outs = [out[j] for j in range(len(out))] if len(out) > 1 else [out]
         for out_name, s in zip(node.outputs, outs):
@@ -301,7 +303,7 @@ def import_model(model_file: str):
     # remap initializer names onto the composed graph's arg names: our symbol
     # ops auto-bind inputs by position, so Variables carry the onnx names
     arg_params = {k: nd_mod.array(v) for k, v in consts.items()
-                  if k not in aux_names}
+                  if k not in aux_names and k not in consumed_consts}
     aux_params = {k: nd_mod.array(v) for k, v in consts.items()
                   if k in aux_names}
     return sym, arg_params, aux_params

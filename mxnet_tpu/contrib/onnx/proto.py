@@ -159,7 +159,12 @@ class TensorProto:
         out += _enc_int(2, self.data_type)
         if self.name:
             out += _enc_str(8, self.name)
-        out += _enc_len(9, self.raw_data)
+        raw = self.raw_data
+        if not raw and self._typed_data:
+            # decoded from typed fields (float_data/int64_data…): re-encode
+            # canonically as raw bytes so save→load round-trips the data
+            raw = self.to_array().tobytes()
+        out += _enc_len(9, raw)
         return bytes(out)
 
     @classmethod
@@ -310,8 +315,10 @@ class AttributeProto:
                 a.value = TensorProto.decode(val)
                 a.attr_type = a.attr_type or 4
             elif field == 7:
-                floats.append(struct.unpack("<f", val)[0] if wire == 5 else
-                              float(val))
+                if wire == 5:
+                    floats.append(struct.unpack("<f", val)[0])
+                else:  # packed (proto3 default for repeated floats)
+                    floats.extend(struct.unpack(f"<{len(val)//4}f", val))
                 a.attr_type = 6
             elif field == 8:
                 ints.extend(_dec_packed_varints(val, wire))

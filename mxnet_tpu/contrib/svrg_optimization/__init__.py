@@ -1,0 +1,9 @@
+"""SVRG optimization (reference ``python/mxnet/contrib/svrg_optimization``).
+
+Stochastic Variance-Reduced Gradient (Johnson & Zhang 2013): periodically
+snapshot the weights, compute the full-dataset gradient at the snapshot, and
+correct each minibatch gradient by ``g(w) − g(w_snap) + full_grad(w_snap)``.
+"""
+from .svrg_module import SVRGModule
+
+__all__ = ["SVRGModule"]

@@ -1,0 +1,128 @@
+"""SVRGModule (reference ``contrib/svrg_optimization/svrg_module.py:30``).
+
+Holds the training module plus a frozen-snapshot module over the same
+symbol; ``update_full_grads`` sweeps the dataset to build the snapshot's
+full gradient, and every minibatch gradient is corrected with the SVRG rule
+before the optimizer step (reference ``_svrg_grads_update_rule`` :360).
+"""
+from __future__ import annotations
+
+import logging
+
+from ... import ndarray as nd
+from ...module.module import Module
+
+__all__ = ["SVRGModule"]
+
+
+class SVRGModule(Module):
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), update_freq=2, **kwargs):
+        super().__init__(symbol, data_names=data_names,
+                         label_names=label_names, **kwargs)
+        if int(update_freq) < 1:
+            raise ValueError("update_freq must be >= 1 (epochs between "
+                             "full-gradient snapshots)")
+        self.update_freq = int(update_freq)
+        # frozen-weight twin over the same symbol (reference _mod_aux)
+        self._mod_aux = Module(symbol, data_names=data_names,
+                               label_names=label_names, **kwargs)
+        self._param_dict = None   # full grads at the snapshot weights
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             **kwargs):
+        super().bind(data_shapes, label_shapes, for_training, **kwargs)
+        self._mod_aux.bind(data_shapes, label_shapes, for_training, **kwargs)
+
+    def init_params(self, *args, **kwargs):
+        super().init_params(*args, **kwargs)
+        arg, aux = self.get_params()
+        self._mod_aux.init_params(arg_params=arg, aux_params=aux,
+                                  allow_missing=False, force_init=True)
+
+    def forward(self, data_batch, is_train=None):
+        super().forward(data_batch, is_train)
+        if is_train or (is_train is None and self.for_training):
+            self._mod_aux.forward(data_batch, is_train=True)
+
+    def backward(self, out_grads=None):
+        super().backward(out_grads)
+        if self._mod_aux.binded:
+            self._mod_aux.backward(out_grads)
+        if self._param_dict is not None:
+            self._update_svrg_gradients()
+
+    def update_full_grads(self, train_data):
+        """Snapshot current weights into the aux module and accumulate the
+        full-dataset gradient there (reference svrg_module.py:292)."""
+        arg, aux = self.get_params()
+        self._mod_aux.set_params(arg_params=arg, aux_params=aux)
+        train_data.reset()
+        accum = {}
+        nbatch = 0
+        for batch in train_data:
+            self._mod_aux.forward(batch, is_train=True)
+            self._mod_aux.backward()
+            ex = self._mod_aux._exec_group.execs[0]
+            for name, grad in ex.grad_dict.items():
+                if grad is None:
+                    continue
+                if name not in accum:
+                    accum[name] = grad.copy()
+                else:
+                    accum[name] += grad
+            nbatch += 1
+        if nbatch == 0:
+            raise ValueError("empty train_data in update_full_grads")
+        self._param_dict = {k: v / nbatch for k, v in accum.items()}
+
+    def _update_svrg_gradients(self):
+        """g ← g(w) − g(w_snap) + full_grad(w_snap) (reference :360-393)."""
+        ex = self._exec_group.execs[0]
+        ex_aux = self._mod_aux._exec_group.execs[0]
+        for name, grad in ex.grad_dict.items():
+            if grad is None or name not in self._param_dict:
+                continue
+            g_aux = ex_aux.grad_dict.get(name)
+            if g_aux is None:
+                continue
+            corrected = grad - g_aux + self._param_dict[name]
+            grad[:] = corrected
+
+    def fit(self, train_data, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None,
+            kvstore="local", optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.01),),
+            initializer=None, num_epoch=1, **kwargs):
+        """Training loop with periodic full-gradient snapshots (reference
+        svrg_module.py:395). Accepts the core BaseModule.fit options."""
+        from ...initializer import Uniform
+        from ... import metric as metric_mod
+
+        self.bind(data_shapes=train_data.provide_data,
+                  label_shapes=train_data.provide_label, for_training=True)
+        self.init_params(initializer=initializer or Uniform(0.01))
+        self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                            optimizer_params=optimizer_params)
+        if not isinstance(eval_metric, metric_mod.EvalMetric):
+            eval_metric = metric_mod.create(eval_metric)
+
+        for epoch in range(num_epoch):
+            if epoch % self.update_freq == 0:
+                self.update_full_grads(train_data)
+            train_data.reset()
+            eval_metric.reset()
+            for nbatch, batch in enumerate(train_data):
+                self.forward(batch, is_train=True)
+                self.backward()
+                self.update()
+                self.update_metric(eval_metric, batch.label)
+                if batch_end_callback is not None:
+                    batch_end_callback(type("P", (), {
+                        "epoch": epoch, "nbatch": nbatch,
+                        "eval_metric": eval_metric, "locals": None})())
+            name, val = eval_metric.get()
+            logging.info("Epoch[%d] Train-%s=%s", epoch, name, val)
+            if epoch_end_callback is not None:
+                arg, aux = self.get_params()
+                epoch_end_callback(epoch, self._symbol, arg, aux)

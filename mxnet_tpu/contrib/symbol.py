@@ -1,0 +1,3 @@
+"""``mx.contrib.symbol`` — contrib symbolic namespace alias (see
+``mx.sym.contrib``)."""
+from ..symbol.contrib import __getattr__, __dir__  # noqa: F401

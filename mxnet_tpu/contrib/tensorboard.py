@@ -1,0 +1,48 @@
+"""TensorBoard logging callback (reference
+``python/mxnet/contrib/tensorboard.py``: LogMetricsCallback writing scalar
+summaries per batch). Gated on an installed summary writer
+(``tensorboardX``/``torch.utils.tensorboard``) — absent here, the callback
+degrades to logging so training scripts keep running unchanged."""
+from __future__ import annotations
+
+import logging
+
+__all__ = ["LogMetricsCallback"]
+
+
+def _find_writer(logging_dir):
+    try:
+        from tensorboardX import SummaryWriter  # type: ignore
+        return SummaryWriter(logging_dir)
+    except ImportError:
+        pass
+    try:
+        from torch.utils.tensorboard import SummaryWriter  # type: ignore
+        return SummaryWriter(logging_dir)
+    except Exception:
+        return None
+
+
+class LogMetricsCallback:
+    """Per-batch metric scalars → TensorBoard event file (reference
+    tensorboard.py:25). Use as a ``batch_end_callback``."""
+
+    def __init__(self, logging_dir, prefix=None):
+        self.prefix = prefix
+        self.step = 0
+        self.summary_writer = _find_writer(logging_dir)
+        if self.summary_writer is None:
+            logging.warning("no tensorboard writer available; "
+                            "LogMetricsCallback falls back to logging")
+
+    def __call__(self, param):
+        self.step += 1
+        if param.eval_metric is None:
+            return
+        for name, value in param.eval_metric.get_name_value():
+            if self.prefix is not None:
+                name = f"{self.prefix}-{name}"
+            if self.summary_writer is not None:
+                self.summary_writer.add_scalar(name, value, self.step)
+            else:
+                logging.info("tb[%d] %s=%s", self.step, name, value)

@@ -1,0 +1,27 @@
+"""Accelerated-inference toggle (reference
+``python/mxnet/contrib/tensorrt.py``: get/set_use_tensorrt +
+init_tensorrt_params gate the TensorRT graph pass). TPU-native equivalent:
+the flag gates ahead-of-time XLA compilation of bound inference executors —
+there is no external engine to hand subgraphs to, XLA *is* the engine — so
+the API is preserved and `init_tensorrt_params` simply returns the params
+it was given (the XLA path needs no engine-side weight copy)."""
+from __future__ import annotations
+
+_USE_RT = False
+
+__all__ = ["set_use_tensorrt", "get_use_tensorrt", "init_tensorrt_params"]
+
+
+def set_use_tensorrt(status: bool) -> None:
+    global _USE_RT
+    _USE_RT = bool(status)
+
+
+def get_use_tensorrt() -> bool:
+    return _USE_RT
+
+
+def init_tensorrt_params(sym, arg_params, aux_params):
+    """Reference signature parity (tensorrt.py:init_tensorrt_params); the
+    XLA inference path consumes params directly."""
+    return arg_params, aux_params

@@ -1,0 +1,11 @@
+"""``mx.contrib.text`` — vocabulary + token embeddings (reference
+``python/mxnet/contrib/text``: ``vocab.Vocabulary``,
+``embedding.CustomEmbedding`` et al., ``utils.count_tokens_from_str``)."""
+from . import utils
+from . import vocab
+from . import embedding
+from .vocab import Vocabulary
+from .embedding import CustomEmbedding, get_pretrained_file_names
+
+__all__ = ["utils", "vocab", "embedding", "Vocabulary", "CustomEmbedding",
+           "get_pretrained_file_names"]

@@ -1,0 +1,157 @@
+"""Token embeddings (reference ``contrib/text/embedding.py``).
+
+The reference downloads GloVe/fastText files; this environment has zero
+egress, so the download registry returns the known file names for API parity
+while ``CustomEmbedding`` loads any local pretrained file in the same
+``token v1 v2 ...`` format. Lookup/update semantics (``get_vecs_by_tokens``,
+``update_token_vectors``, unknown-token handling) follow the reference.
+"""
+from __future__ import annotations
+
+import io
+import logging
+from typing import List, Optional
+
+import numpy as np
+
+from ... import ndarray as nd
+
+__all__ = ["TokenEmbeddingBase", "CustomEmbedding",
+           "get_pretrained_file_names"]
+
+_KNOWN_PRETRAINED = {
+    "glove": ["glove.42B.300d.txt", "glove.6B.50d.txt", "glove.6B.100d.txt",
+              "glove.6B.200d.txt", "glove.6B.300d.txt",
+              "glove.840B.300d.txt", "glove.twitter.27B.25d.txt",
+              "glove.twitter.27B.50d.txt", "glove.twitter.27B.100d.txt",
+              "glove.twitter.27B.200d.txt"],
+    "fasttext": ["wiki.en.vec", "wiki.simple.vec"],
+}
+
+
+def get_pretrained_file_names(embedding_name=None):
+    """Known pretrained-file registry (reference
+    embedding.py:get_pretrained_file_names). Files must be supplied locally
+    (no network egress on this platform)."""
+    if embedding_name is None:
+        return dict(_KNOWN_PRETRAINED)
+    if embedding_name not in _KNOWN_PRETRAINED:
+        raise KeyError(f"unknown embedding {embedding_name}")
+    return list(_KNOWN_PRETRAINED[embedding_name])
+
+
+class TokenEmbeddingBase:
+    """Shared indexing + lookup (reference ``_TokenEmbedding``)."""
+
+    def __init__(self, unknown_token="<unk>"):
+        self._unknown_token = unknown_token
+        self._idx_to_token = [unknown_token]
+        self._token_to_idx = {unknown_token: 0}
+        self._idx_to_vec = None  # NDArray (V, D)
+
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    @property
+    def vec_len(self):
+        return int(self._idx_to_vec.shape[1])
+
+    @property
+    def idx_to_vec(self):
+        return self._idx_to_vec
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self):
+        return self._idx_to_token
+
+    @property
+    def unknown_token(self):
+        return self._unknown_token
+
+    def _load_embedding_txt(self, file_path, elem_delim=" ",
+                            encoding="utf8"):
+        tokens, vecs = [], []
+        vec_len = None
+        with io.open(file_path, "r", encoding=encoding) as f:
+            for line_num, line in enumerate(f):
+                parts = line.rstrip().split(elem_delim)
+                if len(parts) <= 2:   # header line (fasttext) or junk
+                    continue
+                token, elems = parts[0], parts[1:]
+                if vec_len is None:
+                    vec_len = len(elems)
+                elif len(elems) != vec_len:
+                    logging.warning("line %d has %d elems, expected %d — "
+                                    "skipped", line_num, len(elems), vec_len)
+                    continue
+                if token in self._token_to_idx:
+                    continue
+                try:
+                    vec = [float(x) for x in elems]
+                except ValueError:
+                    continue
+                self._token_to_idx[token] = len(self._idx_to_token)
+                self._idx_to_token.append(token)
+                tokens.append(token)
+                vecs.append(vec)
+        if vec_len is None:
+            raise ValueError(f"no vectors parsed from {file_path}")
+        mat = np.zeros((len(self._idx_to_token), vec_len), np.float32)
+        mat[1:len(vecs) + 1] = np.asarray(vecs, np.float32)
+        self._idx_to_vec = nd.array(mat)
+
+    def get_vecs_by_tokens(self, tokens, lower_case_backup=False):
+        """Vectors for token(s); unknown → the unknown vector (index 0)."""
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        idx = []
+        for t in toks:
+            if t in self._token_to_idx:
+                idx.append(self._token_to_idx[t])
+            elif lower_case_backup and t.lower() in self._token_to_idx:
+                idx.append(self._token_to_idx[t.lower()])
+            else:
+                idx.append(0)
+        vecs = self._idx_to_vec[np.asarray(idx)]
+        return vecs[0] if single else vecs
+
+    def update_token_vectors(self, tokens, new_vectors):
+        """Overwrite vectors of existing tokens (reference
+        embedding.py:update_token_vectors)."""
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else list(tokens)
+        arr = new_vectors.asnumpy() if hasattr(new_vectors, "asnumpy") \
+            else np.asarray(new_vectors)
+        if arr.ndim == 1:
+            arr = arr[None]
+        mat = np.array(self._idx_to_vec.asnumpy())  # writable copy
+        for t, vec in zip(toks, arr):
+            if t not in self._token_to_idx:
+                raise ValueError(f"token {t!r} is unknown; only existing "
+                                 "tokens can be updated")
+            mat[self._token_to_idx[t]] = vec
+        self._idx_to_vec = nd.array(mat)
+
+
+class CustomEmbedding(TokenEmbeddingBase):
+    """Embedding loaded from a local ``token v1 v2 ...`` text file
+    (reference embedding.py:CustomEmbedding)."""
+
+    def __init__(self, pretrained_file_path, elem_delim=" ", encoding="utf8",
+                 vocabulary=None, unknown_token="<unk>"):
+        super().__init__(unknown_token=unknown_token)
+        self._load_embedding_txt(pretrained_file_path, elem_delim, encoding)
+        if vocabulary is not None:
+            self._restrict_to_vocab(vocabulary)
+
+    def _restrict_to_vocab(self, vocabulary):
+        old_vec = self._idx_to_vec
+        old_map = self._token_to_idx
+        self._idx_to_token = list(vocabulary.idx_to_token)
+        self._token_to_idx = dict(vocabulary.token_to_idx)
+        rows = [old_map.get(t, 0) for t in self._idx_to_token]
+        self._idx_to_vec = old_vec[np.asarray(rows)]

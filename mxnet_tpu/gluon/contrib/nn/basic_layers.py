@@ -1,0 +1,85 @@
+"""Contrib layers (reference ``gluon/contrib/nn/basic_layers.py``)."""
+from __future__ import annotations
+
+from .... import ndarray as nd
+from ...nn.basic_layers import Sequential, HybridSequential, BatchNorm, \
+    Embedding
+from ...block import HybridBlock, Block
+
+__all__ = ["Concurrent", "HybridConcurrent", "Identity", "SparseEmbedding",
+           "SyncBatchNorm"]
+
+
+class Concurrent(Sequential):
+    """Lays children side by side and concatenates their outputs along
+    ``axis`` (reference basic_layers.py:29)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def forward(self, x):
+        out = [block(x) for block in self._children.values()]
+        return nd.concat(*out, dim=self.axis)
+
+
+class HybridConcurrent(HybridSequential):
+    """Hybridizable Concurrent (reference basic_layers.py:62)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def hybrid_forward(self, F, x):
+        out = [block(x) for block in self._children.values()]
+        return F.concat(*out, dim=self.axis)
+
+
+class Identity(HybridBlock):
+    """Identity passthrough, useful inside Concurrent branches
+    (reference basic_layers.py:95)."""
+
+    def hybrid_forward(self, F, x):
+        return x
+
+
+class SparseEmbedding(Block):
+    """Embedding with row-sparse gradient semantics (reference
+    basic_layers.py:116 uses ``sparse_grad=True``). On TPU the gradient of a
+    gather is a scatter-add which XLA fuses; dense storage is used (sparse
+    HBM tensors are emulated — SURVEY.md §7 hard-part 3), so this is
+    functionally Embedding while keeping the reference's class surface."""
+
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, **kwargs):
+        super().__init__(**kwargs)
+        self._kwargs = {"input_dim": input_dim, "output_dim": output_dim,
+                        "dtype": dtype, "sparse_grad": True}
+        with self.name_scope():
+            self.weight = self.params.get(
+                "weight", shape=(input_dim, output_dim),
+                init=weight_initializer, dtype=dtype, grad_stype="row_sparse")
+
+    def forward(self, x):
+        return nd.Embedding(x, self.weight.data(),
+                            input_dim=self._kwargs["input_dim"],
+                            output_dim=self._kwargs["output_dim"])
+
+    def __repr__(self):
+        s = "{name}({input_dim} -> {output_dim}, {dtype})"
+        return s.format(name=self.__class__.__name__, **self._kwargs)
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-device synchronized BatchNorm (reference basic_layers.py:163,
+    backed by a CUDA allreduce kernel). TPU-native: under ``pjit`` with the
+    batch axis sharded, the mean/variance reductions are GLOBAL reductions —
+    XLA inserts the cross-replica collectives automatically, so plain
+    BatchNorm already IS SyncBatchNorm in the SPMD programming model. The
+    class is kept for API parity; ``num_devices`` is accepted and ignored."""
+
+    def __init__(self, in_channels=0, num_devices=None, momentum=0.9,
+                 epsilon=1e-5, **kwargs):
+        super().__init__(momentum=momentum, epsilon=epsilon,
+                         in_channels=in_channels, **kwargs)
+        self._num_devices = num_devices

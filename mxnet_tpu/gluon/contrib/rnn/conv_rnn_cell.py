@@ -1,0 +1,169 @@
+"""Convolutional recurrent cells (reference
+``gluon/contrib/rnn/conv_rnn_cell.py``: Conv{1,2,3}D{RNN,LSTM,GRU}Cell —
+i2h/h2h are convolutions instead of dense projections; Shi et al. 2015
+ConvLSTM). States carry the spatial dims: (batch, channels, *spatial).
+
+TPU note: the gate convolutions are emitted as one fused Convolution with
+4×/3× hidden channels (one conv HLO per i2h/h2h), so XLA tiles a single
+large conv onto the MXU per step instead of per-gate kernels.
+"""
+from __future__ import annotations
+
+from .... import ndarray as nd
+from ...rnn.rnn_cell import RecurrentCell
+
+__all__ = ["Conv1DRNNCell", "Conv2DRNNCell", "Conv3DRNNCell",
+           "Conv1DLSTMCell", "Conv2DLSTMCell", "Conv3DLSTMCell",
+           "Conv1DGRUCell", "Conv2DGRUCell", "Conv3DGRUCell"]
+
+
+def _tuplize(v, nd_):
+    if isinstance(v, int):
+        return (v,) * nd_
+    return tuple(v)
+
+
+class _BaseConvRNNCell(RecurrentCell):
+    """Shared machinery (reference conv_rnn_cell.py:37)."""
+
+    def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                 i2h_pad, activation, num_gates, dims,
+                 conv_layout="NCHW", prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._input_shape = tuple(input_shape)  # (C_in, *spatial)
+        self._hidden_channels = hidden_channels
+        self._dims = dims
+        self._num_gates = num_gates
+        self._activation = activation
+        self._i2h_kernel = _tuplize(i2h_kernel, dims)
+        self._h2h_kernel = _tuplize(h2h_kernel, dims)
+        for k in self._h2h_kernel:
+            if k % 2 == 0:
+                raise ValueError(
+                    f"h2h_kernel must be odd to preserve spatial dims, got "
+                    f"{self._h2h_kernel}")
+        self._i2h_pad = _tuplize(i2h_pad, dims)
+        self._h2h_pad = tuple(k // 2 for k in self._h2h_kernel)
+        out_ch = num_gates * hidden_channels
+        in_ch = self._input_shape[0]
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight", shape=(out_ch, in_ch, *self._i2h_kernel),
+                allow_deferred_init=True)
+            self.h2h_weight = self.params.get(
+                "h2h_weight",
+                shape=(out_ch, hidden_channels, *self._h2h_kernel),
+                allow_deferred_init=True)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(out_ch,), init="zeros",
+                allow_deferred_init=True)
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(out_ch,), init="zeros",
+                allow_deferred_init=True)
+
+    def _spatial_out(self):
+        # i2h conv output spatial size (stride 1, dilation 1)
+        return tuple(s + 2 * p - k + 1 for s, k, p in
+                     zip(self._input_shape[1:], self._i2h_kernel,
+                         self._i2h_pad))
+
+    def state_info(self, batch_size=0):
+        shape = (batch_size, self._hidden_channels, *self._spatial_out())
+        return [{"shape": shape, "__layout__": "NC" + "DHW"[-self._dims:]}
+                ] * self._num_states
+
+    def _conv_gates(self, x, h):
+        out_ch = self._num_gates * self._hidden_channels
+        i2h = nd.Convolution(x, self.i2h_weight.data(), self.i2h_bias.data(),
+                             kernel=self._i2h_kernel, pad=self._i2h_pad,
+                             num_filter=out_ch)
+        h2h = nd.Convolution(h, self.h2h_weight.data(), self.h2h_bias.data(),
+                             kernel=self._h2h_kernel, pad=self._h2h_pad,
+                             num_filter=out_ch)
+        return i2h, h2h
+
+    def _act(self, x):
+        return nd.Activation(x, act_type=self._activation)
+
+
+class _ConvRNNCell(_BaseConvRNNCell):
+    _num_states = 1
+
+    def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                 i2h_pad, activation, dims, **kwargs):
+        super().__init__(input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                         i2h_pad, activation, num_gates=1, dims=dims, **kwargs)
+
+    def _cell_forward(self, x, states):
+        i2h, h2h = self._conv_gates(x, states[0])
+        out = self._act(i2h + h2h)
+        return out, [out]
+
+
+class _ConvLSTMCell(_BaseConvRNNCell):
+    _num_states = 2
+
+    def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                 i2h_pad, activation, dims, **kwargs):
+        super().__init__(input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                         i2h_pad, activation, num_gates=4, dims=dims, **kwargs)
+
+    def _cell_forward(self, x, states):
+        i2h, h2h = self._conv_gates(x, states[0])
+        gates = i2h + h2h
+        i, f, g, o = nd.split(gates, 4, axis=1)
+        i = nd.sigmoid(i)
+        f = nd.sigmoid(f)
+        g = self._act(g)
+        o = nd.sigmoid(o)
+        c = f * states[1] + i * g
+        h = o * self._act(c)
+        return h, [h, c]
+
+
+class _ConvGRUCell(_BaseConvRNNCell):
+    _num_states = 1
+
+    def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                 i2h_pad, activation, dims, **kwargs):
+        super().__init__(input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                         i2h_pad, activation, num_gates=3, dims=dims, **kwargs)
+
+    def _cell_forward(self, x, states):
+        i2h, h2h = self._conv_gates(x, states[0])
+        i2h_r, i2h_z, i2h_n = nd.split(i2h, 3, axis=1)
+        h2h_r, h2h_z, h2h_n = nd.split(h2h, 3, axis=1)
+        r = nd.sigmoid(i2h_r + h2h_r)
+        z = nd.sigmoid(i2h_z + h2h_z)
+        n = self._act(i2h_n + r * h2h_n)
+        out = (1 - z) * n + z * states[0]
+        return out, [out]
+
+
+def _make(dims, base, act_default):
+    class Cell(base):
+        def __init__(self, input_shape, hidden_channels, i2h_kernel,
+                     h2h_kernel, i2h_pad=0, activation=act_default,
+                     prefix=None, params=None):
+            super().__init__(input_shape, hidden_channels, i2h_kernel,
+                             h2h_kernel, i2h_pad, activation, dims=dims,
+                             prefix=prefix, params=params)
+    return Cell
+
+
+Conv1DRNNCell = _make(1, _ConvRNNCell, "tanh")
+Conv2DRNNCell = _make(2, _ConvRNNCell, "tanh")
+Conv3DRNNCell = _make(3, _ConvRNNCell, "tanh")
+Conv1DLSTMCell = _make(1, _ConvLSTMCell, "tanh")
+Conv2DLSTMCell = _make(2, _ConvLSTMCell, "tanh")
+Conv3DLSTMCell = _make(3, _ConvLSTMCell, "tanh")
+Conv1DGRUCell = _make(1, _ConvGRUCell, "tanh")
+Conv2DGRUCell = _make(2, _ConvGRUCell, "tanh")
+Conv3DGRUCell = _make(3, _ConvGRUCell, "tanh")
+for _n, _c in [("Conv1DRNNCell", Conv1DRNNCell), ("Conv2DRNNCell", Conv2DRNNCell),
+               ("Conv3DRNNCell", Conv3DRNNCell), ("Conv1DLSTMCell", Conv1DLSTMCell),
+               ("Conv2DLSTMCell", Conv2DLSTMCell), ("Conv3DLSTMCell", Conv3DLSTMCell),
+               ("Conv1DGRUCell", Conv1DGRUCell), ("Conv2DGRUCell", Conv2DGRUCell),
+               ("Conv3DGRUCell", Conv3DGRUCell)]:
+    _c.__name__ = _n
+    _c.__qualname__ = _n

@@ -1,0 +1,228 @@
+"""gluon.contrib layers/cells, contrib.text, SVRG, tensorboard/tensorrt
+shims (reference tests: tests/python/unittest/test_gluon_contrib.py,
+test_contrib_text.py, test_contrib_svrg_module.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import contrib as gcontrib
+
+
+def test_concurrent_and_identity(rng):
+    layer = gcontrib.nn.HybridConcurrent(axis=1)
+    layer.add(gluon.nn.Dense(4))
+    layer.add(gluon.nn.Dense(6))
+    layer.add(gcontrib.nn.Identity())
+    layer.initialize()
+    x = mx.nd.array(rng.randn(2, 3).astype("float32"))
+    out = layer(x)
+    assert out.shape == (2, 4 + 6 + 3)
+
+    c = gcontrib.nn.Concurrent(axis=-1)
+    c.add(gcontrib.nn.Identity())
+    c.add(gcontrib.nn.Identity())
+    c.initialize()
+    out2 = c(x)
+    np.testing.assert_allclose(out2.asnumpy(),
+                               np.concatenate([x.asnumpy()] * 2, -1))
+
+
+def test_sparse_embedding(rng):
+    emb = gcontrib.nn.SparseEmbedding(10, 5)
+    emb.initialize()
+    idx = mx.nd.array(np.array([1, 3, 1], "float32"))
+    out = emb(idx)
+    assert out.shape == (3, 5)
+    w = emb.weight.data().asnumpy()
+    np.testing.assert_allclose(out.asnumpy(), w[[1, 3, 1]])
+
+
+def test_sync_batchnorm_alias(rng):
+    bn = gcontrib.nn.SyncBatchNorm(in_channels=4, num_devices=8)
+    bn.initialize()
+    x = mx.nd.array(rng.randn(2, 4, 3, 3).astype("float32"))
+    out = bn(x)
+    assert out.shape == x.shape
+
+
+def test_variational_dropout_cell_mask_reuse(rng):
+    from mxnet_tpu import autograd
+    base = gluon.rnn.RNNCell(6)
+    cell = gcontrib.rnn.VariationalDropoutCell(base, drop_inputs=0.5,
+                                               drop_outputs=0.5)
+    cell.initialize()
+    x = mx.nd.ones((3, 4))
+    states = cell.begin_state(3)
+    with autograd.record():
+        cell.reset()
+        _, states = cell(x, states)
+        mask1 = cell._input_mask.asnumpy()
+        cell(x, states)
+        mask2 = cell._input_mask.asnumpy()
+    np.testing.assert_array_equal(mask1, mask2)  # same mask across steps
+    cell.reset()
+    assert cell._input_mask is None
+
+
+def test_lstmp_cell_shapes(rng):
+    cell = gcontrib.rnn.LSTMPCell(hidden_size=8, projection_size=3)
+    cell.initialize()
+    x = mx.nd.array(rng.randn(2, 5).astype("float32"))
+    states = cell.begin_state(2)
+    assert [s.shape for s in states] == [(2, 3), (2, 8)]
+    out, new_states = cell(x, states)
+    assert out.shape == (2, 3)                # projected
+    assert new_states[1].shape == (2, 8)      # cell state full-size
+    outs, _ = cell.unroll(3, mx.nd.array(rng.randn(2, 3, 5).astype("f")),
+                          merge_outputs=True)
+    assert outs.shape == (2, 3, 3)
+
+
+@pytest.mark.parametrize("cls,states", [
+    ("Conv1DRNNCell", 1), ("Conv1DLSTMCell", 2), ("Conv1DGRUCell", 1)])
+def test_conv_rnn_cells_1d(rng, cls, states):
+    cell = getattr(gcontrib.rnn, cls)((4, 10), hidden_channels=6,
+                                      i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    cell.initialize()
+    x = mx.nd.array(rng.randn(2, 4, 10).astype("float32"))
+    st = cell.begin_state(2)
+    assert len(st) == states
+    out, new_st = cell(x, st)
+    assert out.shape == (2, 6, 10)
+    assert all(s.shape == (2, 6, 10) for s in new_st)
+
+
+def test_conv2d_lstm_cell_unroll(rng):
+    cell = gcontrib.rnn.Conv2DLSTMCell((3, 8, 8), hidden_channels=5,
+                                       i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    cell.initialize()
+    seq = mx.nd.array(rng.randn(2, 4, 3, 8, 8).astype("float32"))
+    outs, states = cell.unroll(4, seq, merge_outputs=False)
+    assert len(outs) == 4 and outs[0].shape == (2, 5, 8, 8)
+    assert states[1].shape == (2, 5, 8, 8)
+
+
+def test_interval_sampler():
+    s = gcontrib.data.IntervalSampler(10, 3)
+    idx = list(s)
+    assert sorted(idx) == list(range(10))
+    assert idx[:4] == [0, 3, 6, 9]
+    s2 = gcontrib.data.IntervalSampler(10, 3, rollover=False)
+    assert list(s2) == [0, 3, 6, 9] and len(s2) == 4
+
+
+def test_text_vocabulary():
+    from mxnet_tpu.contrib import text
+    counter = text.utils.count_tokens_from_str(
+        "a b b c c c\nd d d d", to_lower=False)
+    assert counter["c"] == 3 and counter["d"] == 4
+    vocab = text.Vocabulary(counter, most_freq_count=3, min_freq=2,
+                            reserved_tokens=["<pad>"])
+    # <unk>, <pad>, then d(4) c(3) b(2)
+    assert vocab.idx_to_token == ["<unk>", "<pad>", "d", "c", "b"]
+    assert vocab.to_indices(["d", "zzz"]) == [2, 0]
+    assert vocab.to_tokens([3, 4]) == ["c", "b"]
+    assert len(vocab) == 5
+
+
+def test_text_custom_embedding(tmp_path):
+    from mxnet_tpu.contrib import text
+    p = tmp_path / "emb.txt"
+    p.write_text("hello 0.1 0.2 0.3\nworld 0.4 0.5 0.6\n")
+    emb = text.CustomEmbedding(str(p))
+    assert emb.vec_len == 3 and len(emb) == 3
+    v = emb.get_vecs_by_tokens(["hello", "nope"])
+    np.testing.assert_allclose(v.asnumpy()[0], [0.1, 0.2, 0.3], rtol=1e-6)
+    np.testing.assert_allclose(v.asnumpy()[1], [0, 0, 0], atol=1e-8)
+    emb.update_token_vectors("world", mx.nd.array([[1., 1., 1.]]))
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("world").asnumpy(), [1, 1, 1])
+
+    vocab = text.Vocabulary(
+        text.utils.count_tokens_from_str("world world"))
+    emb2 = text.CustomEmbedding(str(p), vocabulary=vocab)
+    assert emb2.idx_to_token == ["<unk>", "world"]
+    np.testing.assert_allclose(
+        emb2.get_vecs_by_tokens("world").asnumpy(), [1, 1, 1] if False
+        else [0.4, 0.5, 0.6], rtol=1e-6)
+
+
+def test_svrg_module_convergence(rng):
+    """SVRG on least squares: loss decreases and SVRG correction applies
+    (reference test_contrib_svrg_module.py)."""
+    from mxnet_tpu.contrib.svrg_optimization import SVRGModule
+    from mxnet_tpu.io import NDArrayIter
+
+    n, d = 64, 5
+    w_true = rng.randn(d, 1).astype("float32")
+    X = rng.randn(n, d).astype("float32")
+    y = (X @ w_true).astype("float32")
+    it = NDArrayIter(X, y, batch_size=16, shuffle=False,
+                     label_name="lin_reg_label")
+
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=1, no_bias=True, name="fc")
+    out = mx.sym.LinearRegressionOutput(fc, mx.sym.Variable("lin_reg_label"),
+                                        name="lro")
+    mod = SVRGModule(out, data_names=("data",),
+                     label_names=("lin_reg_label",), update_freq=2)
+    mod.fit(it, eval_metric="mse", optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.2),), num_epoch=25)
+    arg, _ = mod.get_params()
+    w = arg["fc_weight"].asnumpy().reshape(-1, 1)
+    assert np.mean((w - w_true) ** 2) < 0.05
+
+
+def test_tensorboard_callback_fallback():
+    from mxnet_tpu.contrib.tensorboard import LogMetricsCallback
+    from mxnet_tpu import metric as metric_mod
+    cb = LogMetricsCallback("/tmp/tb-logs")
+    m = metric_mod.create("acc")
+    m.update([mx.nd.array([1, 0])], [mx.nd.array([[0.1, 0.9], [0.8, 0.2]])])
+    cb(type("P", (), {"eval_metric": m})())   # no writer -> logs, no crash
+
+
+def test_tensorrt_toggle():
+    from mxnet_tpu.contrib import tensorrt
+    assert tensorrt.get_use_tensorrt() is False
+    tensorrt.set_use_tensorrt(True)
+    assert tensorrt.get_use_tensorrt() is True
+    tensorrt.set_use_tensorrt(False)
+    a, b = tensorrt.init_tensorrt_params(None, {"w": 1}, {})
+    assert a == {"w": 1}
+
+
+def test_contrib_autograd_legacy(rng):
+    from mxnet_tpu.contrib import autograd as cag
+    x = mx.nd.array(rng.randn(3).astype("float32"))
+
+    @cag.grad_and_loss
+    def loss_fn(a):
+        return (a * a).sum()
+
+    grads, loss = loss_fn(x)
+    np.testing.assert_allclose(grads[0].asnumpy(), 2 * x.asnumpy(),
+                               rtol=1e-5)
+
+    @cag.grad
+    def g_fn(a):
+        return (a * a * a).sum()
+
+    g = g_fn(x)
+    np.testing.assert_allclose(g[0].asnumpy(), 3 * x.asnumpy() ** 2,
+                               rtol=1e-5)
+
+
+def test_contrib_dataloader_iter(rng):
+    from mxnet_tpu.contrib.io import DataLoaderIter
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+    X = rng.randn(10, 4).astype("float32")
+    y = np.arange(10).astype("float32")
+    loader = DataLoader(ArrayDataset(X, y), batch_size=5)
+    it = DataLoaderIter(loader)
+    assert it.provide_data[0].shape == (5, 4)
+    batches = list(it)
+    assert len(batches) == 2
+    it.reset()
+    assert len(list(it)) == 2
