@@ -26,11 +26,13 @@ test_section = _ag.pause
 
 
 def backward(outputs, out_grads=None, retain_graph=False):
-    head = outputs if not isinstance(outputs, (list, tuple)) else None
-    if head is not None:
-        return _ag.backward([head], out_grads and [out_grads],
-                            retain_graph=retain_graph)
-    return _ag.backward(list(outputs), out_grads, retain_graph=retain_graph)
+    outs = list(outputs) if isinstance(outputs, (list, tuple)) else [outputs]
+    grads = None
+    if out_grads is not None:
+        # no truthiness on NDArray (multi-element __bool__ is ambiguous)
+        grads = list(out_grads) if isinstance(out_grads, (list, tuple)) \
+            else [out_grads]
+    return _ag.backward(outs, grads, retain_graph=retain_graph)
 
 
 def compute_gradient(outputs):

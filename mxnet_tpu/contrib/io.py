@@ -12,38 +12,32 @@ class DataLoaderIter(DataIter):
     """DataIter view over a ``gluon.data.DataLoader`` (reference io.py:30)."""
 
     def __init__(self, loader, data_name="data", label_name="softmax_label"):
-        super().__init__(batch_size=getattr(loader, "_batch_size", 0))
-        self._loader = loader
-        self._iter = iter(loader)
-        self._data_name = data_name
-        self._label_name = label_name
-        self._first = None
+        # peek one batch ONLY for shape metadata; iteration always restarts
+        # from a fresh loader iterator, so nothing is duplicated or skipped
         try:
-            self._first = next(self._iter)
+            first = next(iter(loader))
         except StopIteration:
             raise ValueError("empty DataLoader")
-
-    def _descs(self, sample, name):
-        return [DataDesc(name, tuple(sample.shape))]
+        super().__init__(batch_size=int(first[0].shape[0]))
+        self._loader = loader
+        self._data_descs = [DataDesc(data_name, tuple(first[0].shape))]
+        self._label_descs = [DataDesc(label_name, tuple(first[1].shape))]
+        self._iter = iter(loader)
 
     @property
     def provide_data(self):
-        return self._descs(self._first[0], self._data_name)
+        return self._data_descs
 
     @property
     def provide_label(self):
-        return self._descs(self._first[1], self._label_name)
+        return self._label_descs
 
     def reset(self):
         self._iter = iter(self._loader)
 
     def next(self):
-        if self._first is not None:
-            data, label = self._first
-            self._first = None
-        else:
-            try:
-                data, label = next(self._iter)
-            except StopIteration:
-                raise StopIteration
+        try:
+            data, label = next(self._iter)
+        except StopIteration:
+            raise StopIteration
         return DataBatch(data=[data], label=[label], pad=0)

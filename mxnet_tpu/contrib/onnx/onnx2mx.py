@@ -291,7 +291,15 @@ def import_model(model_file: str):
         ins = [env[i] for i in node.inputs if i in env]
         if node.op_type == "Reshape" and len(ins) == 2:
             ins = ins[:1]  # shape tensor consumed via st["consts"] instead
-            consumed_consts.add(node.inputs[1])
+            shp = node.inputs[1]
+            # drop from params only if no OTHER node reads it as data
+            used_elsewhere = any(
+                inp == shp
+                for other in g.nodes
+                for k, inp in enumerate(other.inputs)
+                if not (other is node and k == 1))
+            if not used_elsewhere:
+                consumed_consts.add(shp)
         out = fn(name, ins, node.attrs, st)
         outs = [out[j] for j in range(len(out))] if len(out) > 1 else [out]
         for out_name, s in zip(node.outputs, outs):

@@ -123,6 +123,14 @@ class SVRGModule(Module):
                         "eval_metric": eval_metric, "locals": None})())
             name, val = eval_metric.get()
             logging.info("Epoch[%d] Train-%s=%s", epoch, name, val)
+            if eval_data is not None:
+                eval_metric.reset()
+                eval_data.reset()
+                for batch in eval_data:
+                    self.forward(batch, is_train=False)
+                    self.update_metric(eval_metric, batch.label)
+                vname, vval = eval_metric.get()
+                logging.info("Epoch[%d] Validation-%s=%s", epoch, vname, vval)
             if epoch_end_callback is not None:
                 arg, aux = self.get_params()
                 epoch_end_callback(epoch, self._symbol, arg, aux)

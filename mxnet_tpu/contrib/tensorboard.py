@@ -14,7 +14,7 @@ def _find_writer(logging_dir):
     try:
         from tensorboardX import SummaryWriter  # type: ignore
         return SummaryWriter(logging_dir)
-    except ImportError:
+    except Exception:   # missing package OR failing constructor — fall back
         pass
     try:
         from torch.utils.tensorboard import SummaryWriter  # type: ignore

@@ -98,14 +98,6 @@ class LSTMPCell(RecurrentCell):
                 {"shape": (batch_size, self._hidden_size),
                  "__layout__": "NC"}]
 
-    def forward(self, x, states):
-        self._counter += 1
-        for p in self._reg_params.values():
-            if p._deferred_init is not None:
-                shape = tuple(x.shape[-1] if s == 0 else s for s in p.shape)
-                p._finish_deferred_init(shape)
-        return self._cell_forward(x, states)
-
     def _cell_forward(self, x, states):
         h = self._hidden_size
         i2h = nd.FullyConnected(x, self.i2h_weight.data(),

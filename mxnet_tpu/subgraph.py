@@ -1,0 +1,320 @@
+"""Subgraph partitioning framework.
+
+Reference parity: ``src/operator/subgraph/subgraph_property.h:54-155``
+(SubgraphSelector / SubgraphProperty / property registry) and the NNVM
+"PartitionGraph" pass (``src/operator/subgraph/partition_graph.cc:157-317``):
+select seed nodes, grow regions along input/output edges, enforce convexity
+(no external path from a region output back into a region input), then
+replace each region with a single subgraph node that owns the inner Symbol.
+
+TPU-native role: the reference partitions to hand subgraphs to MKLDNN or
+TensorRT engines; here the "engine" is XLA itself — a partitioned region is
+lowered once via the graph executor and runs as ONE jitted XLA computation,
+so partitioning is the graph-level fusion/offload hook (used by the int8
+quantization flow and available to users via ``build_subgraph``).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .base import MXNetError
+from .ops.registry import register as _register_op
+from .symbol.symbol import Symbol, _Node
+
+__all__ = ["SubgraphSelector", "ContainOpSelector", "SubgraphProperty",
+           "register_subgraph_property", "get_subgraph_property",
+           "partition_graph", "build_subgraph"]
+
+
+class SubgraphSelector:
+    """Decides how a region grows (reference subgraph_property.h:54)."""
+
+    def select(self, node: _Node) -> bool:
+        """Whether ``node`` can seed a new subgraph."""
+        raise NotImplementedError
+
+    def select_input(self, cur: _Node, input_node: _Node) -> bool:
+        """Whether to grow across the edge cur ← input_node."""
+        return self.select(input_node)
+
+    def select_output(self, cur: _Node, output_node: _Node) -> bool:
+        """Whether to grow across the edge cur → output_node."""
+        return self.select(output_node)
+
+    def filter(self, candidates: List[_Node]) -> List[_Node]:
+        """Last-chance veto over a grown region (reference :81)."""
+        return candidates
+
+
+class ContainOpSelector(SubgraphSelector):
+    """Selects any node whose op is in ``op_names`` — the common fusion
+    selector (reference subgraph_property.h / default_subgraph_property)."""
+
+    def __init__(self, op_names: Sequence[str]):
+        self.op_names = frozenset(op_names)
+
+    def select(self, node: _Node) -> bool:
+        return node.op in self.op_names
+
+
+class SubgraphProperty:
+    """Bundles a selector with subgraph-node creation (reference :93)."""
+
+    def __init__(self, op_names: Optional[Sequence[str]] = None):
+        self._op_names = tuple(op_names or ())
+
+    def create_subgraph_selector(self) -> SubgraphSelector:
+        return ContainOpSelector(self._op_names)
+
+    def create_subgraph_node(self, sym: Symbol, subgraph_id: int) -> _Node:
+        """Default: a ``_subgraph`` node executing the inner symbol as one
+        lowered XLA computation (reference CreateSubgraphNode :105)."""
+        sg_id = _store_subgraph(sym)
+        input_names = tuple(sym.list_arguments())
+        node = _Node("_subgraph", f"subgraph{subgraph_id}",
+                     {"subgraph_id": sg_id, "num_out": len(sym.list_outputs()),
+                      "input_names": input_names}, [])
+        return node
+
+
+_PROPERTIES: Dict[str, SubgraphProperty] = {}
+
+
+def register_subgraph_property(name: str, prop: SubgraphProperty) -> None:
+    """Property registry (reference SubgraphPropertyRegistry :155; selected
+    at bind time by MXNET_SUBGRAPH_BACKEND)."""
+    _PROPERTIES[name] = prop
+
+
+def get_subgraph_property(name: str) -> SubgraphProperty:
+    if name not in _PROPERTIES:
+        raise MXNetError(f"no subgraph property {name!r} registered "
+                         f"(have {sorted(_PROPERTIES)})")
+    return _PROPERTIES[name]
+
+
+# inner symbols owned by _subgraph nodes (the reference stashes them on the
+# node's attrs; kept here so op attrs stay hashable for the XLA jit cache)
+_SUBGRAPH_STORE: List[Symbol] = []
+
+
+def _store_subgraph(sym: Symbol) -> int:
+    _SUBGRAPH_STORE.append(sym)
+    return len(_SUBGRAPH_STORE) - 1
+
+
+def get_stored_subgraph(idx: int) -> Symbol:
+    return _SUBGRAPH_STORE[idx]
+
+
+@_register_op("_subgraph", num_outputs=lambda attrs: int(attrs.get("num_out", 1)))
+def _subgraph_exec(*inputs, subgraph_id=0, num_out=1, input_names=(),
+                   is_train=False):
+    """Execute a partitioned region as one lowered XLA computation."""
+    from .executor import _GraphLowering
+    import jax
+
+    sym = get_stored_subgraph(int(subgraph_id))
+    fn = _GraphLowering(sym).lower(is_train=bool(is_train))
+    feed = dict(zip(input_names, inputs))
+    outs, _ = fn(feed, jax.random.PRNGKey(0))
+    return tuple(outs) if len(outs) > 1 else outs[0]
+
+
+# ---------------------------------------------------------------------------
+# the partition pass
+# ---------------------------------------------------------------------------
+
+def _ancestors(node: _Node, stop: frozenset) -> set:
+    out = set()
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        for (src, _) in n.inputs:
+            if id(src) not in out:
+                out.add(id(src))
+                if id(src) not in stop:
+                    stack.append(src)
+    return out
+
+
+def _grow_region(seed: _Node, selector: SubgraphSelector, order: List[_Node],
+                 consumers: Dict[int, List[_Node]], taken: set) -> List[_Node]:
+    region = {id(seed): seed}
+    stack = [seed]
+    while stack:
+        cur = stack.pop()
+        for (src, _) in cur.inputs:
+            if (not src.is_var and id(src) not in region
+                    and id(src) not in taken
+                    and selector.select_input(cur, src)):
+                region[id(src)] = src
+                stack.append(src)
+        for cons in consumers.get(id(cur), ()):
+            if (id(cons) not in region and id(cons) not in taken
+                    and selector.select_output(cur, cons)):
+                region[id(cons)] = cons
+                stack.append(cons)
+    nodes = [n for n in order if id(n) in region]
+    nodes = selector.filter(nodes)
+    return nodes
+
+
+def _enforce_convexity(region: List[_Node], order: List[_Node]) -> List[_Node]:
+    """Drop nodes until no external node sits on a path region→x→region
+    (reference partition_graph.cc cycle exclusion)."""
+    region_ids = set(id(n) for n in region)
+    changed = True
+    while changed and region_ids:
+        changed = False
+        for x in order:
+            if id(x) in region_ids or x.is_var:
+                continue
+            anc = _ancestors(x, frozenset())
+            if not (anc & region_ids):
+                continue  # x has no region ancestor: fine
+            # x depends on the region; if anything in the region depends on
+            # x, the region is non-convex -> drop x's region ancestors
+            for r in list(region_ids):
+                node_r = next(n for n in region if id(n) == r)
+                if id(x) in _ancestors(node_r, frozenset()):
+                    region_ids -= (anc & region_ids)
+                    changed = True
+                    break
+            if changed:
+                break
+    return [n for n in region if id(n) in region_ids]
+
+
+def partition_graph(sym: Symbol, prop: SubgraphProperty) -> Symbol:
+    """Replace selected regions with ``_subgraph`` nodes (reference
+    "PartitionGraph" NNVM pass, invoked from bind when
+    MXNET_SUBGRAPH_BACKEND is set — graph_executor.cc:1492)."""
+    order = sym.topo_nodes()
+    consumers: Dict[int, List[_Node]] = {}
+    for n in order:
+        for (src, _) in n.inputs:
+            consumers.setdefault(id(src), []).append(n)
+
+    taken: set = set()
+    regions: List[List[_Node]] = []
+    selector_factory = prop.create_subgraph_selector
+    for node in order:
+        if node.is_var or id(node) in taken:
+            continue
+        selector = selector_factory()
+        if not selector.select(node):
+            continue
+        region = _grow_region(node, selector, order, consumers, taken)
+        region = _enforce_convexity(region, order)
+        if not region:
+            continue
+        for n in region:
+            taken.add(id(n))
+        regions.append(region)
+
+    if not regions:
+        return sym
+
+    # map region-internal entries; build one _subgraph node per region
+    node_region = {}
+    for i, region in enumerate(regions):
+        for n in region:
+            node_region[id(n)] = i
+
+    remap: Dict[Tuple[int, int], Tuple[_Node, int]] = {}
+    region_nodes: List[Optional[_Node]] = [None] * len(regions)
+
+    def map_entry(entry):
+        src, idx = entry
+        if (id(src), idx) in remap:
+            return remap[(id(src), idx)]
+        if id(src) in node_region:
+            build_region_node(node_region[id(src)])
+            return remap[(id(src), idx)]
+        if src.is_var:
+            return (src, idx)
+        # plain node: rebuild with remapped inputs (memoized via remap)
+        new_inputs = [map_entry(e) for e in src.inputs]
+        nn = _Node(src.op, src.name, src.attrs, new_inputs)
+        nn._attr_dict = dict(src._attr_dict)
+        for k in range(src.num_outputs):
+            remap[(id(src), k)] = (nn, k)
+        return remap[(id(src), idx)]
+
+    def build_region_node(ri: int):
+        if region_nodes[ri] is not None:
+            return region_nodes[ri]
+        region = regions[ri]
+        rset = set(id(n) for n in region)
+        # external entries consumed by the region, in first-use order
+        ext_entries: List[Tuple[_Node, int]] = []
+        seen_ext = set()
+        for n in region:
+            for (src, idx) in n.inputs:
+                if id(src) not in rset and (id(src), idx) not in seen_ext:
+                    seen_ext.add((id(src), idx))
+                    ext_entries.append((src, idx))
+        # region outputs: entries consumed outside or graph heads
+        out_entries: List[Tuple[_Node, int]] = []
+        head_ids = {(id(s), i) for (s, i) in sym._outputs}
+        for n in region:
+            for k in range(n.num_outputs):
+                used_outside = any(id(c) not in rset
+                                   for c in consumers.get(id(n), ())
+                                   if any(id(s) == id(n) and i == k
+                                          for (s, i) in c.inputs)) \
+                    or (id(n), k) in head_ids
+                if used_outside:
+                    out_entries.append((n, k))
+        # build the inner symbol: clone region with vars for ext entries
+        from .symbol.symbol import Variable
+        inner_map: Dict[Tuple[int, int], Tuple[_Node, int]] = {}
+        ext_names: List[str] = []
+        for j, (src, idx) in enumerate(ext_entries):
+            base = src.name if src.num_outputs == 1 or src.is_var \
+                else f"{src.name}{idx}"
+            while base in ext_names:
+                base = f"{base}_{j}"
+            ext_names.append(base)
+            var = Variable(base)
+            inner_map[(id(src), idx)] = (var._outputs[0][0], 0)
+
+        def clone_inner(entry):
+            src, idx = entry
+            if (id(src), idx) in inner_map:
+                return inner_map[(id(src), idx)]
+            new_inputs = [clone_inner(e) for e in src.inputs]
+            nn = _Node(src.op, src.name, src.attrs, new_inputs)
+            for k in range(src.num_outputs):
+                inner_map[(id(src), k)] = (nn, k)
+            return inner_map[(id(src), idx)]
+
+        inner_outputs = [clone_inner(e) for e in out_entries]
+        inner_sym = Symbol(inner_outputs)
+        sg_node = prop.create_subgraph_node(inner_sym, ri)
+        # wire the subgraph node's inputs to the REMAPPED outer entries;
+        # feed order must be ext-entry order, not list_arguments order
+        sg_node.attrs = dict(sg_node.attrs,
+                             input_names=tuple(ext_names),
+                             num_out=len(out_entries))
+        sg_node.inputs = [map_entry(e) for e in ext_entries]
+        sg_node.num_outputs = len(out_entries)
+        region_nodes[ri] = sg_node
+        for k, (src, idx) in enumerate(out_entries):
+            remap[(id(src), idx)] = (sg_node, k)
+        return sg_node
+
+    # remap heads (regions materialize lazily through remap/build)
+    new_heads = []
+    for (src, idx) in sym._outputs:
+        if id(src) in node_region:
+            build_region_node(node_region[id(src)])
+        new_heads.append(map_entry((src, idx)))
+    return Symbol(new_heads)
+
+
+def build_subgraph(sym: Symbol, op_names: Sequence[str]) -> Symbol:
+    """Convenience: partition ``sym`` grouping runs of ``op_names``
+    (reference default_subgraph_property usage in quantization/TensorRT)."""
+    return partition_graph(sym, SubgraphProperty(op_names))

@@ -1,0 +1,118 @@
+"""Subgraph partitioning framework tests (reference
+tests/python/unittest/test_subgraph_op.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import subgraph as sg
+
+
+def _bind_forward(sym, feeds):
+    ex = sym.bind(mx.cpu(), {k: mx.nd.array(v) for k, v in feeds.items()})
+    return [o.asnumpy() for o in ex.forward(is_train=False)]
+
+
+def _count_ops(sym):
+    from collections import Counter
+    return Counter(n.op for n in sym.topo_nodes() if n.op)
+
+
+def test_partition_simple_chain(rng):
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    out = mx.sym.relu((a + b) * a, name="r")
+    part = sg.build_subgraph(out, ["elemwise_add", "elemwise_mul", "relu",
+                                   "broadcast_add", "broadcast_mul", "_plus",
+                                   "_mul"])
+    ops = _count_ops(part)
+    assert ops.get("_subgraph", 0) == 1
+    assert sum(v for k, v in ops.items() if k != "_subgraph") == 0
+
+    av = rng.randn(3, 4).astype("float32")
+    bv = rng.randn(3, 4).astype("float32")
+    ref = np.maximum((av + bv) * av, 0)
+    got = _bind_forward(part, {"a": av, "b": bv})[0]
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+
+def test_partition_partial_selection(rng):
+    """Only FC ops grouped; activation stays outside."""
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu", name="act")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=3, name="fc2")
+
+    part = sg.build_subgraph(fc2, ["FullyConnected"])
+    ops = _count_ops(part)
+    assert ops["_subgraph"] == 2            # two disjoint FC regions
+    assert ops["Activation"] == 1
+    assert "FullyConnected" not in ops
+
+    shapes, _, _ = fc2.infer_shape(data=(2, 5))
+    feeds = {"data": rng.randn(2, 5).astype("float32")}
+    for name, shp in zip(fc2.list_arguments(), shapes):
+        if name != "data":
+            feeds[name] = rng.randn(*shp).astype("float32") * 0.1
+    ref = _bind_forward(fc2, feeds)[0]
+    got = _bind_forward(part, feeds)[0]
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_partition_convexity(rng):
+    """Diamond where one branch is unselectable: region must not swallow
+    both ends (would create a cycle through the external branch)."""
+    a = mx.sym.Variable("a")
+    left = a * 2.0                      # selectable (_mul_scalar)
+    right = mx.sym.sigmoid(a)           # NOT selectable
+    out = left + right                  # selectable add consumes both
+
+    part = sg.build_subgraph(out, ["_mul_scalar", "_plus_scalar",
+                                   "broadcast_add", "elemwise_add"])
+    av = rng.randn(4).astype("float32")
+    ref = av * 2.0 + 1 / (1 + np.exp(-av))
+    got = _bind_forward(part, {"a": av})[0]
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
+    ops = _count_ops(part)
+    assert ops.get("sigmoid", 0) == 1   # external op survived
+
+
+def test_partition_multi_output_region(rng):
+    """A region output consumed both inside and outside the region."""
+    a = mx.sym.Variable("a")
+    h = mx.sym.relu(a, name="h")
+    o1 = h * 3.0
+    out = mx.sym.Group([h, o1])
+    part = sg.build_subgraph(out, ["relu", "_mul_scalar"])
+    av = rng.randn(5).astype("float32")
+    got = _bind_forward(part, {"a": av})
+    np.testing.assert_allclose(got[0], np.maximum(av, 0), rtol=1e-6)
+    np.testing.assert_allclose(got[1], np.maximum(av, 0) * 3, rtol=1e-6)
+
+
+def test_property_registry():
+    prop = sg.SubgraphProperty(["relu"])
+    sg.register_subgraph_property("test_backend", prop)
+    assert sg.get_subgraph_property("test_backend") is prop
+    with pytest.raises(mx.MXNetError):
+        sg.get_subgraph_property("nope")
+
+
+def test_custom_selector(rng):
+    """Selector veto via filter(): regions smaller than 2 nodes dropped."""
+
+    class MinSizeSelector(sg.ContainOpSelector):
+        def filter(self, candidates):
+            return candidates if len(candidates) >= 2 else []
+
+    class Prop(sg.SubgraphProperty):
+        def create_subgraph_selector(self):
+            return MinSizeSelector(["relu", "tanh"])
+
+    a = mx.sym.Variable("a")
+    lone = mx.sym.relu(a)               # single-node region -> vetoed
+    part1 = sg.partition_graph(lone, Prop())
+    assert "_subgraph" not in _count_ops(part1)
+
+    pair = mx.sym.tanh(mx.sym.relu(a))  # two-node region -> kept
+    part2 = sg.partition_graph(pair, Prop())
+    assert _count_ops(part2)["_subgraph"] == 1

@@ -1,0 +1,84 @@
+"""tools/ tests: im2rec list+pack round-trip, launch env contract, diagnose
+(reference: tools are exercised by example scripts + nightly jobs)."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import im2rec  # noqa: E402
+import launch  # noqa: E402
+
+
+def _make_images(root, classes=("cat", "dog"), per_class=3):
+    from PIL import Image
+    rng = np.random.RandomState(0)
+    for ci, cls in enumerate(classes):
+        d = os.path.join(root, cls)
+        os.makedirs(d, exist_ok=True)
+        for i in range(per_class):
+            arr = rng.randint(0, 255, (16, 20, 3), dtype=np.uint8)
+            Image.fromarray(arr).save(os.path.join(d, f"{cls}{i}.jpg"))
+
+
+def test_im2rec_list_and_pack(tmp_path):
+    pytest.importorskip("PIL")
+    root = str(tmp_path / "imgs")
+    _make_images(root)
+    prefix = str(tmp_path / "data")
+    im2rec.main([prefix, root, "--list", "--recursive"])
+    lst = prefix + ".lst"
+    assert os.path.exists(lst)
+    rows = list(im2rec.read_list(lst))
+    assert len(rows) == 6
+    assert {int(l) for _, _, l in rows} == {0, 1}   # two class labels
+
+    im2rec.main([prefix, root, "--resize", "16"])
+    assert os.path.exists(prefix + ".rec") and os.path.exists(prefix + ".idx")
+
+    from mxnet_tpu import recordio
+    r = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "r")
+    seen = 0
+    for idx, _, label in rows:
+        header, img = recordio.unpack_img(r.read_idx(idx))
+        assert header.label == label
+        assert img.shape[2] == 3 and min(img.shape[:2]) == 16
+        seen += 1
+    assert seen == 6
+
+
+def test_launch_worker_env():
+    env = launch.worker_env(2, 4, "10.0.0.1:9870", base={})
+    assert env["DMLC_WORKER_ID"] == "2"
+    assert env["DMLC_NUM_WORKER"] == "4"
+    assert env["JAX_PROCESS_ID"] == "2"
+    assert env["JAX_COORDINATOR_ADDRESS"] == "10.0.0.1:9870"
+
+
+def test_launch_local_runs_n_processes(tmp_path):
+    out = tmp_path / "ranks"
+    out.mkdir()
+    script = tmp_path / "w.py"
+    script.write_text(
+        "import os\n"
+        f"open(os.path.join({str(out)!r}, os.environ['DMLC_WORKER_ID']), 'w')"
+        ".write(os.environ['DMLC_NUM_WORKER'])\n")
+    rc = launch.launch_local(3, [sys.executable, str(script)])
+    assert rc == 0
+    assert sorted(os.listdir(out)) == ["0", "1", "2"]
+    assert (out / "1").read_text() == "3"
+
+
+def test_diagnose_runs():
+    p = subprocess.run([sys.executable, os.path.join(REPO, "tools",
+                                                     "diagnose.py")],
+                       capture_output=True, text=True, timeout=120,
+                       env={**os.environ, "JAX_PLATFORMS": "cpu",
+                            "PYTHONPATH": ""})
+    assert p.returncode == 0, p.stderr
+    assert "Framework Info" in p.stdout
+    assert "native lib   : ok" in p.stdout

@@ -1,0 +1,65 @@
+#!/usr/bin/env python
+"""diagnose — print platform/framework info for bug reports (reference
+``tools/diagnose.py``: python/pip/mxnet/os/hardware/network checks; network
+checks dropped — this platform has no egress)."""
+from __future__ import annotations
+
+import os
+import platform
+import sys
+import time
+
+
+def check_python():
+    print("----------Python Info----------")
+    print("Version      :", platform.python_version())
+    print("Compiler     :", platform.python_compiler())
+    print("Build        :", platform.python_build())
+
+
+def check_os():
+    print("----------System Info----------")
+    print("Platform     :", platform.platform())
+    print("system       :", platform.system())
+    print("node         :", platform.node())
+    print("release      :", platform.release())
+    print("version      :", platform.version())
+
+
+def check_hardware():
+    print("----------Hardware Info----------")
+    print("machine      :", platform.machine())
+    print("processor    :", platform.processor())
+    try:
+        with open("/proc/cpuinfo") as f:
+            cores = sum(1 for line in f if line.startswith("processor"))
+        print("cpu cores    :", cores)
+    except OSError:
+        pass
+
+
+def check_framework():
+    print("----------Framework Info----------")
+    t0 = time.time()
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+    import mxnet_tpu as mx
+    print("import time  : %.3fs" % (time.time() - t0))
+    print("version      :", getattr(mx, "__version__", "dev"))
+    import jax
+    print("jax          :", jax.__version__)
+    print("backend      :", jax.default_backend())
+    print("devices      :", jax.devices())
+    from mxnet_tpu.native import get_lib
+    print("native lib   :", "ok" if get_lib() is not None else "UNAVAILABLE")
+
+
+def main():
+    check_python()
+    check_os()
+    check_hardware()
+    check_framework()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,148 @@
+#!/usr/bin/env python
+"""im2rec — build .lst/.rec image datasets (reference ``tools/im2rec.py``:
+list_image/make_list + multiprocess pack to RecordIO).
+
+Usage (same CLI shape as the reference):
+  python tools/im2rec.py PREFIX ROOT --list --recursive   # write PREFIX.lst
+  python tools/im2rec.py PREFIX ROOT [--resize N]         # write PREFIX.rec/.idx
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mxnet_tpu import recordio  # noqa: E402
+
+EXTS = (".jpg", ".jpeg", ".png", ".bmp")
+
+
+def list_image(root, recursive, exts=EXTS):
+    """Yield (index, relpath, label) walking ``root`` (reference
+    im2rec.py:38 — label = directory index when recursive)."""
+    i = 0
+    if recursive:
+        cat = {}
+        for path, dirs, files in sorted(os.walk(root, followlinks=True)):
+            dirs.sort()
+            for fname in sorted(files):
+                if os.path.splitext(fname)[1].lower() not in exts:
+                    continue
+                fpath = os.path.join(path, fname)
+                if path not in cat:
+                    cat[path] = len(cat)
+                yield (i, os.path.relpath(fpath, root), cat[path])
+                i += 1
+    else:
+        for fname in sorted(os.listdir(root)):
+            fpath = os.path.join(root, fname)
+            if os.path.isfile(fpath) and \
+                    os.path.splitext(fname)[1].lower() in exts:
+                yield (i, os.path.relpath(fpath, root), 0)
+                i += 1
+
+
+def write_list(path_out, image_list):
+    with open(path_out, "w") as f:
+        for idx, relpath, label in image_list:
+            f.write(f"{idx}\t{label}\t{relpath}\n")
+
+
+def read_list(path_in):
+    with open(path_in) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            yield (int(parts[0]), parts[2], float(parts[1]))
+
+
+def make_list(args):
+    image_list = list(list_image(args.root, args.recursive))
+    if args.shuffle:
+        random.seed(100)
+        random.shuffle(image_list)
+        image_list = [(i, p, l) for i, (_, p, l) in enumerate(image_list)]
+    n_test = int(len(image_list) * args.test_ratio)
+    n_train = int(len(image_list) * args.train_ratio)
+    chunks = {"_test": image_list[:n_test],
+              "_train": image_list[n_test:n_test + n_train]} \
+        if args.test_ratio + args.train_ratio < 1.0 or args.test_ratio > 0 \
+        else {"": image_list}
+    if args.test_ratio == 0 and args.train_ratio == 1.0:
+        chunks = {"": image_list}
+    for suffix, chunk in chunks.items():
+        if chunk:
+            write_list(f"{args.prefix}{suffix}.lst", chunk)
+
+
+def image_encode(args, relpath):
+    from PIL import Image
+    import io as _io
+    img = Image.open(os.path.join(args.root, relpath)).convert("RGB")
+    if args.resize:
+        w, h = img.size
+        scale = args.resize / min(w, h)
+        img = img.resize((max(1, int(w * scale)), max(1, int(h * scale))))
+    buf = _io.BytesIO()
+    img.save(buf, format="JPEG", quality=args.quality)
+    return buf.getvalue()
+
+
+def make_record(args, lst_path):
+    prefix = os.path.splitext(lst_path)[0]
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    cnt = 0
+    for idx, relpath, label in read_list(lst_path):
+        try:
+            payload = image_encode(args, relpath)
+        except Exception as e:  # unreadable image: skip, like the reference
+            print(f"imread error {relpath}: {e}", file=sys.stderr)
+            continue
+        header = recordio.IRHeader(0, label, idx, 0)
+        rec.write_idx(idx, recordio.pack(header, payload))
+        cnt += 1
+        if cnt % 1000 == 0:
+            print(f"packed {cnt} images")
+    rec.close()
+    print(f"{prefix}.rec: {cnt} records")
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        description="Create an image list or RecordIO file")
+    p.add_argument("prefix", help="prefix of .lst/.rec files")
+    p.add_argument("root", help="image root dir")
+    p.add_argument("--list", action="store_true",
+                   help="create list instead of record")
+    p.add_argument("--recursive", action="store_true")
+    p.add_argument("--shuffle", type=bool, default=True)
+    p.add_argument("--test-ratio", type=float, default=0.0)
+    p.add_argument("--train-ratio", type=float, default=1.0)
+    p.add_argument("--resize", type=int, default=0)
+    p.add_argument("--quality", type=int, default=95)
+    return p.parse_args(argv)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    if args.list:
+        make_list(args)
+        return
+    # pack every matching .lst with this prefix (reference behavior)
+    d = os.path.dirname(os.path.abspath(args.prefix)) or "."
+    base = os.path.basename(args.prefix)
+    lsts = [os.path.join(d, f) for f in os.listdir(d)
+            if f.startswith(base) and f.endswith(".lst")]
+    if not lsts:
+        print(f"no .lst file matching prefix {args.prefix}", file=sys.stderr)
+        sys.exit(1)
+    for lst in sorted(lsts):
+        make_record(args, lst)
+
+
+if __name__ == "__main__":
+    main()
