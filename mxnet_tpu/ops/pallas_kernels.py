@@ -70,11 +70,14 @@ def _fa_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
     row log-sum-exp are written out.
     """
     ik = pl.program_id(2)
+    # Mosaic can't legalize f64 constants: pin every python-float scalar to f32
+    scale = jnp.float32(scale)
+    neg_inf = jnp.float32(_NEG_INF)
 
     @pl.when(ik == 0)
     def _init():
         acc_ref[...] = jnp.zeros_like(acc_ref)
-        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        m_ref[...] = jnp.full_like(m_ref, neg_inf)
         l_ref[...] = jnp.zeros_like(l_ref)
 
     q = q_ref[0].astype(jnp.float32)                     # (bq, D)
@@ -88,7 +91,7 @@ def _fa_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     # mask ragged tail of the key axis (grid pads the last block)
     k_idx = lax.broadcasted_iota(jnp.int32, s.shape, 1) + ik * block_k
-    s = jnp.where(k_idx < tk_total, s, _NEG_INF)
+    s = jnp.where(k_idx < tk_total, s, neg_inf)
 
     if causal:
         # global positions: q_offset/k_offset arrive via SMEM (they are
@@ -99,13 +102,13 @@ def _fa_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
             + offs_ref[0]
         kpos = lax.broadcasted_iota(jnp.int32, s.shape, 1) + ik * block_k \
             + offs_ref[1]
-        s = jnp.where(qpos >= kpos, s, _NEG_INF)
+        s = jnp.where(qpos >= kpos, s, neg_inf)
 
     m_prev = m_ref[...]                                  # (bq, 128)
     blk_max = jnp.max(s, axis=1)[:, None]                # (bq, 1)
     m_new = jnp.maximum(m_prev, jnp.broadcast_to(blk_max, m_prev.shape))
     p = jnp.exp(s - m_new[:, :1])                        # (bq, bk)
-    p = jnp.where(s <= _NEG_INF / 2, 0.0, p)
+    p = jnp.where(s <= neg_inf / 2, jnp.float32(0.0), p)
     corr = jnp.exp(m_prev[:, :1] - m_new[:, :1])         # (bq, 1)
     l_ref[...] = l_ref[...] * jnp.broadcast_to(corr, l_ref.shape) \
         + jnp.broadcast_to(jnp.sum(p, axis=1)[:, None], l_ref.shape)
@@ -116,10 +119,10 @@ def _fa_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
     @pl.when(ik == nk_total - 1)
     def _finalize():
         l = l_ref[...][:, :1]                            # (bq, 1)
-        safe_l = jnp.maximum(l, 1e-30)
+        safe_l = jnp.maximum(l, jnp.float32(1e-30))
         o_ref[0] = (acc_ref[...] / safe_l).astype(o_ref.dtype)
         m = m_ref[...][:, :1]
-        lse = jnp.where(l <= 0.0, _NEG_INF, m + jnp.log(safe_l))
+        lse = jnp.where(l <= jnp.float32(0.0), neg_inf, m + jnp.log(safe_l))
         lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
 
 
@@ -142,30 +145,33 @@ def _fa_pallas(q, k, v, scale, causal, q_offset, k_offset,
     nq, nk = pl.cdiv(Tq, block_q), pl.cdiv(Tk, block_k)
     offs = jnp.asarray([q_offset, k_offset], jnp.int32)
 
-    grid = (BH, nq, nk)
-    out, lse = pl.pallas_call(
-        functools.partial(_fa_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, nk_total=nk,
-                          tk_total=Tk),
-        grid=grid,
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,   # offs (q/k global offsets) land in SMEM
+        grid=(BH, nq, nk),
         in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, block_q, D), lambda b, iq, ik: (b, iq, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, iq, ik: (b, ik, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, iq, ik: (b, ik, 0)),
+            pl.BlockSpec((1, block_q, D), lambda b, iq, ik, offs: (b, iq, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, iq, ik, offs: (b, ik, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, iq, ik, offs: (b, ik, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, iq, ik: (b, iq, 0)),
-            pl.BlockSpec((1, block_q, 128), lambda b, iq, ik: (b, iq, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((BH, Tq, D), q.dtype, **_vma_kw(q)),
-            jax.ShapeDtypeStruct((BH, Tq, 128), jnp.float32, **_vma_kw(q)),
+            pl.BlockSpec((1, block_q, D), lambda b, iq, ik, offs: (b, iq, 0)),
+            pl.BlockSpec((1, block_q, 128),
+                         lambda b, iq, ik, offs: (b, iq, 0)),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, D), jnp.float32),
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+    )
+    out, lse = pl.pallas_call(
+        functools.partial(_fa_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, nk_total=nk,
+                          tk_total=Tk),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Tq, D), q.dtype, **_vma_kw(q)),
+            jax.ShapeDtypeStruct((BH, Tq, 128), jnp.float32, **_vma_kw(q)),
         ],
         interpret=_interpret(),
     )(offs, q, k, v)
