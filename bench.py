@@ -1,10 +1,21 @@
 #!/usr/bin/env python
 """Benchmark driver: ResNet-50 training throughput on the available device.
 
-Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+plus diagnostic fields (mfu, flops_per_step, device_kind, overlapped_img_s,
+and "degraded" when a fallback path was taken).
+
 Baseline: the reference's headline ResNet-50 ImageNet training number —
 109 img/s on 1x K80 at batch 32 (reference example/image-classification/
 README.md:149-156, recorded in BASELINE.md).
+
+Robustness contract (the round-1 failure mode): the parent process NEVER
+imports jax. The actual benchmark runs in a child process; if the TPU backend
+fails to initialize (transient "UNAVAILABLE: TPU backend setup/compile error"
+from the axon tunnel) the parent retries once, then falls back to a CPU child,
+and in the worst case still emits a well-formed JSON line with a "degraded"
+field. A wall-clock budget is split across attempts so the driver's own
+timeout is never hit with nothing printed.
 
 The training step is the fused SPMD path (parallel.DataParallelTrainer):
 forward+backward+update in one jitted XLA computation, bfloat16 compute with
@@ -12,24 +23,62 @@ float32 params/accumulation on TPU.
 """
 import json
 import os
+import subprocess
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, HERE)
 
-import jax
-import numpy as np
+BASELINE_IMG_S = 109.0  # reference ResNet-50, 1x K80, batch 32
+
+# bf16 peak FLOP/s per chip by device_kind substring (public TPU specs).
+_PEAK_FLOPS = [
+    ("v6", 918e12), ("v5p", 459e12), ("v5e", 197e12), ("v5 lite", 197e12),
+    ("v5", 459e12), ("v4", 275e12), ("v3", 123e12), ("v2", 45e12),
+]
 
 
-def main():
+def _peak_flops(device_kind: str):
+    kind = (device_kind or "").lower()
+    for sub, peak in _PEAK_FLOPS:
+        if sub in kind:
+            return peak
+    return None
+
+
+# --------------------------------------------------------------------------
+# Child: the actual benchmark. Exits 3 quickly if no backend comes up so the
+# parent can retry / fall back without burning its budget.
+# --------------------------------------------------------------------------
+def run_bench():
+    import jax
+
+    if os.environ.get("BENCH_FORCE_CPU") == "1":
+        jax.config.update("jax_platforms", "cpu")
+
+    devices = None
+    err = None
+    for attempt in range(2):
+        try:
+            devices = jax.devices()
+            break
+        except Exception as e:  # backend init failure — retry once in-process
+            err = e
+            time.sleep(3)
+    if devices is None:
+        print("BENCH_CHILD_BACKEND_FAIL: %s" % err, file=sys.stderr)
+        sys.exit(3)
+
+    import numpy as np
     import mxnet_tpu as mx
     from mxnet_tpu import gluon, parallel
     from mxnet_tpu.gluon.model_zoo import vision
 
-    on_accel = any(d.platform != "cpu" for d in jax.devices())
+    on_accel = any(d.platform != "cpu" for d in devices)
     batch = int(os.environ.get("BENCH_BATCH", 32 if on_accel else 8))
     image = int(os.environ.get("BENCH_IMAGE", 224 if on_accel else 64))
-    steps = int(os.environ.get("BENCH_STEPS", 20 if on_accel else 3))
+    steps = int(os.environ.get("BENCH_STEPS", 30 if on_accel else 3))
     warmup = int(os.environ.get("BENCH_WARMUP", 5 if on_accel else 1))
 
     np.random.seed(0)
@@ -59,17 +108,164 @@ def main():
         loss = trainer.step(xd, yd)
     float(loss)  # sync
     dt = time.perf_counter() - t0
-
     img_per_sec = steps * batch / dt
-    baseline = 109.0  # img/s, reference 1xK80 batch 32
-    n_chips = max(1, len([d for d in jax.devices() if d.platform != "cpu"]))
+
+    n_chips = max(1, len([d for d in devices if d.platform != "cpu"]))
+    per_chip = img_per_sec / n_chips
+    device_kind = devices[0].device_kind
+
+    core = {
+        "metric": "resnet50_train_throughput_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "img/s/chip",
+        "vs_baseline": round(per_chip / BASELINE_IMG_S, 3),
+        "batch": batch, "image": image, "steps": steps,
+        "n_chips": n_chips, "device_kind": device_kind,
+        "platform": devices[0].platform,
+    }
+    if not on_accel:
+        core["degraded"] = "cpu-only-backend"
+    # Emit the measured number NOW — the diagnostics below (cost analysis,
+    # overlapped variant) must not be able to cost us the result if they
+    # hang; the parent takes the LAST metric line, so the enriched line
+    # below supersedes this one when everything goes well.
+    print(json.dumps(core), flush=True)
+
+    # ---- MFU from the lowered step's own cost analysis --------------------
+    flops_per_step = None
+    mfu = None
+    try:
+        lowered = trainer._step_fn.lower(
+            trainer._params, trainer._aux, trainer._opt_state,
+            jax.random.PRNGKey(0), xd, yd)
+        try:
+            ca = lowered.cost_analysis()  # compile-free when supported
+        except Exception:
+            ca = lowered.compile().cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        flops_per_step = float(ca.get("flops", 0.0)) or None
+    except Exception as e:
+        print("cost_analysis unavailable: %s" % e, file=sys.stderr)
+    peak = _peak_flops(device_kind) if on_accel else None
+    if flops_per_step and peak:
+        achieved = flops_per_step * (steps / dt)
+        mfu = achieved / (peak * n_chips)
+
+    # ---- input-pipeline-overlapped variant: host batches, async dispatch --
+    overlapped = None
+    try:
+        host_batches = [
+            (np.random.uniform(-1, 1, x.shape).astype("float32"), y)
+            for _ in range(3)]
+        trainer.step(*host_batches[0])  # warm transfer path
+        t0 = time.perf_counter()
+        for i in range(steps):
+            hx, hy = host_batches[i % len(host_batches)]
+            loss = trainer.step(hx, hy)  # async: upload i+1 overlaps step i
+        float(loss)
+        overlapped = round(steps * batch / (time.perf_counter() - t0) /
+                           n_chips, 2)
+    except Exception as e:
+        print("overlapped variant failed: %s" % e, file=sys.stderr)
+
+    out = dict(core)
+    if flops_per_step:
+        out["flops_per_step"] = flops_per_step
+    if mfu is not None:
+        out["mfu"] = round(mfu, 4)
+        out["peak_flops_assumed"] = peak
+    if overlapped is not None:
+        out["overlapped_img_s_per_chip"] = overlapped
+    print(json.dumps(out), flush=True)
+
+
+# --------------------------------------------------------------------------
+# Parent: orchestrates child attempts under a wall-clock budget. No jax here.
+# --------------------------------------------------------------------------
+def _attempt(env_extra, timeout):
+    env = dict(os.environ, **env_extra)
+    def last_metric_line(stdout):
+        line = None
+        for ln in (stdout or "").splitlines():
+            ln = ln.strip()
+            if ln.startswith("{") and '"metric"' in ln:
+                line = ln
+        return line
+
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--run"],
+            env=env, capture_output=True, text=True, timeout=timeout)
+    except subprocess.TimeoutExpired as exc:
+        # the child may have printed a valid measurement before hanging in
+        # post-measurement diagnostics — salvage it.
+        stdout = exc.stdout.decode(errors="replace") if isinstance(
+            exc.stdout, bytes) else (exc.stdout or "")
+        stderr = exc.stderr.decode(errors="replace") if isinstance(
+            exc.stderr, bytes) else (exc.stderr or "")
+        line = last_metric_line(stdout)
+        if line:
+            try:
+                return json.loads(line), None
+            except ValueError:
+                pass
+        return None, "timeout after %ds %s" % (
+            timeout, stderr[-400:].replace("\n", " | "))
+    line = last_metric_line(proc.stdout)
+    if proc.returncode == 0 and line:
+        try:
+            return json.loads(line), None
+        except ValueError:
+            pass
+    tail = ((proc.stderr or "") + (proc.stdout or ""))[-800:]
+    return None, "rc=%d %s" % (proc.returncode, tail.replace("\n", " | "))
+
+
+def main():
+    budget = float(os.environ.get("BENCH_TOTAL_BUDGET", 1500))
+    deadline = time.time() + budget
+    errors = []
+
+    # attempt 1 + one retry on the default (TPU) backend; reserve time for
+    # the CPU fallback child.
+    reserve = 420.0
+    for i in range(2):
+        remaining = deadline - time.time() - reserve
+        if remaining < 60:
+            errors.append("no budget left for TPU attempt %d" % (i + 1))
+            break
+        result, err = _attempt({}, timeout=min(720.0, remaining))
+        if result is not None:
+            print(json.dumps(result))
+            return
+        errors.append("tpu attempt %d: %s" % (i + 1, err))
+        time.sleep(5)
+
+    # CPU fallback — hardcoded small shapes so it ALWAYS finishes fast,
+    # regardless of any BENCH_* tuning aimed at the TPU attempt.
+    remaining = max(60.0, deadline - time.time())
+    result, err = _attempt(
+        {"BENCH_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu",
+         "BENCH_BATCH": "8", "BENCH_IMAGE": "64", "BENCH_STEPS": "3",
+         "BENCH_WARMUP": "1"},
+        timeout=min(remaining, reserve))
+    if result is not None:
+        result["degraded"] = "cpu-fallback: " + "; ".join(errors)[:400]
+        print(json.dumps(result))
+        return
+    errors.append("cpu fallback: %s" % err)
+
+    # worst case: still emit a well-formed line.
     print(json.dumps({
         "metric": "resnet50_train_throughput_per_chip",
-        "value": round(img_per_sec / n_chips, 2),
-        "unit": "img/s/chip",
-        "vs_baseline": round(img_per_sec / n_chips / baseline, 3),
+        "value": 0.0, "unit": "img/s/chip", "vs_baseline": 0.0,
+        "degraded": "all attempts failed: " + "; ".join(errors)[:800],
     }))
 
 
 if __name__ == "__main__":
-    main()
+    if "--run" in sys.argv:
+        run_bench()
+    else:
+        main()
