@@ -56,6 +56,16 @@ def run_bench():
 
     if os.environ.get("BENCH_FORCE_CPU") == "1":
         jax.config.update("jax_platforms", "cpu")
+    # persistent compile cache: the fused ResNet-50 train step takes minutes
+    # to compile over the axon tunnel; cache it so retries (and the driver's
+    # own bench run on this machine) skip the compile entirely.
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.environ.get("BENCH_CACHE_DIR",
+                                         "/tmp/mxtpu_jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception as e:
+        print("compile cache unavailable: %s" % e, file=sys.stderr)
 
     devices = None
     err = None
@@ -92,16 +102,22 @@ def run_bench():
     x = np.random.uniform(-1, 1, (batch, 3, image, image)).astype("float32")
     y = np.random.randint(0, 1000, (batch,)).astype("float32")
 
-    # pre-stage the synthetic batch on device (reference benchmark_score.py
-    # measures with synthetic device-resident data too); the axon tunnel makes
-    # host->device uploads artificially slow and is not what we measure.
+    # pre-stage the synthetic batch on device BEFORE warmup (reference
+    # benchmark_score.py measures with synthetic device-resident data too);
+    # the axon tunnel makes host->device uploads artificially slow and is
+    # not what we measure — transfer exactly once.
     from jax.sharding import NamedSharding, PartitionSpec as P
-    for _ in range(warmup):
-        loss = trainer.step(x, y)
-    float(loss)  # sync
     spec = NamedSharding(trainer.mesh, P("dp"))
+    t_compile = time.perf_counter()
+    loss = trainer.step(x, y)  # capture + lower + compile (first call)
+    float(loss)
+    print("first step (compile) took %.1fs" % (time.perf_counter() - t_compile),
+          file=sys.stderr, flush=True)
     xd = jax.device_put(x, spec)
     yd = jax.device_put(y, spec)
+    for _ in range(warmup):
+        loss = trainer.step(xd, yd)
+    float(loss)  # sync
 
     t0 = time.perf_counter()
     for _ in range(steps):
@@ -223,19 +239,20 @@ def _attempt(env_extra, timeout):
 
 
 def main():
-    budget = float(os.environ.get("BENCH_TOTAL_BUDGET", 1500))
+    budget = float(os.environ.get("BENCH_TOTAL_BUDGET", 2400))
     deadline = time.time() + budget
     errors = []
 
     # attempt 1 + one retry on the default (TPU) backend; reserve time for
-    # the CPU fallback child.
+    # the CPU fallback child. The retry hits the persistent compile cache,
+    # so it needs far less time than attempt 1.
     reserve = 420.0
     for i in range(2):
         remaining = deadline - time.time() - reserve
         if remaining < 60:
             errors.append("no budget left for TPU attempt %d" % (i + 1))
             break
-        result, err = _attempt({}, timeout=min(720.0, remaining))
+        result, err = _attempt({}, timeout=min(1500.0, remaining))
         if result is not None:
             print(json.dumps(result))
             return

@@ -65,6 +65,7 @@ _lazy = {
     "executor": ".executor",
     "test_utils": ".test_utils",
     "util": ".util",
+    "interop": ".interop",
     "contrib": ".contrib",
 }
 

@@ -1,0 +1,355 @@
+"""Reference-format interop: binary ``.params`` files and legacy symbol JSON.
+
+The reference serializes NDArray maps as a dmlc stream container
+(``src/ndarray/ndarray.cc:1767-1795``): a ``uint64`` list magic ``0x112``, a
+``uint64`` reserved word, a ``uint64``-counted vector of NDArray records, then
+a ``uint64``-counted vector of names (each ``uint64`` length + bytes). Each
+record (``NDArray::Save``, ``src/ndarray/ndarray.cc:1567-1633``) is:
+
+- ``uint32`` magic: ``0xF993fac9`` (V2, with storage type), ``0xF993fac8``
+  (V1, int64 shape, dense only), or — for pre-V1 legacy — the raw ``ndim``
+  with ``uint32`` dims following (``LegacyTShapeLoad``, ndarray.cc:1636-1650).
+- V2 only: ``int32`` storage type (0 dense / 1 row_sparse / 2 csr) and, for
+  sparse, the storage shape.
+- shape (``uint32`` ndim + ``int64`` dims), empty shape = none;
+- context (``int32`` dev_type, ``int32`` dev_id — ``include/mxnet/base.h:188``);
+- ``int32`` mshadow type flag; sparse aux types/shapes; raw little-endian
+  buffer(s).
+
+Symbol JSON import handles the nnvm graph format plus the legacy upgrades of
+``src/nnvm/legacy_json_util.cc``: per-node attrs under ``attrs``/``attr``/
+``param``, 2- or 3-element input/head entries, hidden ``lr_mult``-style keys
+rehomed onto variables (``UpgradeJSON_FixParsing``), pre-0.9 missing aux
+inputs re-created (``UpgradeJSON_000800_000900``), and the argmin/argmax
+``axis=-1`` drop (``UpgradeJSON_000904_000905``).
+"""
+from __future__ import annotations
+
+import ast
+import io
+import json
+import logging
+import struct
+from typing import Dict, List, Optional, Tuple, Union
+
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = ["load_reference_params", "save_reference_params",
+           "load_reference_ndarrays", "save_reference_ndarrays",
+           "symbol_from_reference_json", "load_reference_checkpoint",
+           "is_reference_params_file", "is_reference_symbol_json"]
+
+_LIST_MAGIC = 0x112
+_V2_MAGIC = 0xF993FAC9
+_V1_MAGIC = 0xF993FAC8
+
+# mshadow type flags (reference 3rdparty/mshadow/mshadow/base.h TypeFlag)
+_FLAG_TO_DTYPE = {0: "float32", 1: "float64", 2: "float16", 3: "uint8",
+                  4: "int32", 5: "int8", 6: "int64"}
+_DTYPE_TO_FLAG = {v: k for k, v in _FLAG_TO_DTYPE.items()}
+
+_STYPE_DEFAULT, _STYPE_ROW_SPARSE, _STYPE_CSR = 0, 1, 2
+
+
+class _Reader:
+    def __init__(self, data: bytes):
+        self._b = memoryview(data)
+        self._pos = 0
+
+    def read(self, n: int) -> memoryview:
+        if self._pos + n > len(self._b):
+            raise MXNetError("reference .params file truncated at byte "
+                             f"{self._pos} (wanted {n} more)")
+        out = self._b[self._pos:self._pos + n]
+        self._pos += n
+        return out
+
+    def u32(self) -> int:
+        return struct.unpack("<I", self.read(4))[0]
+
+    def i32(self) -> int:
+        return struct.unpack("<i", self.read(4))[0]
+
+    def u64(self) -> int:
+        return struct.unpack("<Q", self.read(8))[0]
+
+
+def _read_shape_v2(r: _Reader) -> Tuple[int, ...]:
+    ndim = r.u32()
+    return tuple(struct.unpack(f"<{ndim}q", r.read(8 * ndim)))
+
+
+def _read_shape_legacy(r: _Reader, ndim: int) -> Tuple[int, ...]:
+    return tuple(struct.unpack(f"<{ndim}I", r.read(4 * ndim)))
+
+
+def _read_buffer(r: _Reader, shape, dtype) -> np.ndarray:
+    count = int(np.prod(shape)) if shape else 1
+    nbytes = count * np.dtype(dtype).itemsize
+    return np.frombuffer(r.read(nbytes), dtype=dtype).reshape(shape).copy()
+
+
+def _read_record(r: _Reader):
+    """One NDArray record → numpy array | (stype, fields) | None."""
+    magic = r.u32()
+    if magic == _V2_MAGIC:
+        stype = r.i32()
+        nad = {_STYPE_DEFAULT: 0, _STYPE_ROW_SPARSE: 1, _STYPE_CSR: 2}.get(stype)
+        if nad is None:
+            raise MXNetError(f"reference .params: unknown storage type {stype}")
+        sshape = _read_shape_v2(r) if nad else None
+        shape = _read_shape_v2(r)
+        if len(shape) == 0:
+            return None
+        r.i32(); r.i32()  # context (dev_type, dev_id) — irrelevant here
+        dtype = _FLAG_TO_DTYPE[r.i32()]
+        aux = []
+        for _ in range(nad):
+            aux_dtype = _FLAG_TO_DTYPE[r.i32()]
+            aux.append((aux_dtype, _read_shape_v2(r)))
+        data = _read_buffer(r, sshape if nad else shape, dtype)
+        aux_data = [_read_buffer(r, s, dt) for dt, s in aux]
+        if stype == _STYPE_DEFAULT:
+            return data
+        return (stype, shape, data, aux_data)
+    if magic == _V1_MAGIC:
+        shape = _read_shape_v2(r)
+    else:
+        # pre-V1: the "magic" is the ndim, uint32 dims follow
+        shape = _read_shape_legacy(r, magic)
+    if len(shape) == 0:
+        return None
+    r.i32(); r.i32()  # context
+    dtype = _FLAG_TO_DTYPE[r.i32()]
+    return _read_buffer(r, shape, dtype)
+
+
+def is_reference_params_file(fname: str) -> bool:
+    try:
+        with open(fname, "rb") as f:
+            head = f.read(8)
+    except OSError:
+        return False
+    return len(head) == 8 and struct.unpack("<Q", head)[0] == _LIST_MAGIC
+
+
+def load_reference_ndarrays(fname: str):
+    """Load a reference NDArray list file → (list_of_arrays, names).
+
+    Arrays come back as mxnet_tpu NDArrays (dense) or sparse NDArrays;
+    ``names`` is ``[]`` when the file stored an unnamed list.
+    """
+    from .ndarray import array
+    from .ndarray import sparse as _sparse
+
+    with open(fname, "rb") as f:
+        r = _Reader(f.read())
+    if r.u64() != _LIST_MAGIC:
+        raise MXNetError(f"{fname}: not a reference NDArray file")
+    r.u64()  # reserved
+    n = r.u64()
+    raw = [_read_record(r) for _ in range(n)]
+    n_names = r.u64()
+    names = [bytes(r.read(r.u64())).decode() for _ in range(n_names)]
+    if names and len(names) != len(raw):
+        raise MXNetError(f"{fname}: {len(names)} names for {len(raw)} arrays")
+
+    out = []
+    for rec in raw:
+        if rec is None:
+            out.append(None)
+        elif isinstance(rec, tuple):
+            stype, shape, data, aux = rec
+            if stype == _STYPE_ROW_SPARSE:
+                out.append(_sparse.row_sparse_array(
+                    (data, aux[0]), shape=shape))
+            else:  # CSR: aux = [indptr, indices]
+                out.append(_sparse.csr_matrix(
+                    (data, aux[1], aux[0]), shape=shape))
+        else:
+            # explicit dtype: array() defaults to float32 like the reference
+            # frontend, but a loader must preserve what is on disk
+            out.append(array(rec, dtype=rec.dtype))
+    return out, names
+
+
+def load_reference_params(fname: str) -> Dict[str, "object"]:
+    """Load a reference ``.params`` file as a name→NDArray dict.
+
+    Keys keep their ``arg:``/``aux:`` prefixes when present (the format the
+    reference's ``save_checkpoint`` writes, ``python/mxnet/model.py:388``).
+    Unnamed list files get positional ``ndarray_{i}`` keys.
+    """
+    arrays, names = load_reference_ndarrays(fname)
+    if not names:
+        names = [f"ndarray_{i}" for i in range(len(arrays))]
+    return dict(zip(names, arrays))
+
+
+def _write_shape(out: io.BytesIO, shape) -> None:
+    out.write(struct.pack("<I", len(shape)))
+    out.write(struct.pack(f"<{len(shape)}q", *shape))
+
+
+def _write_record(out: io.BytesIO, arr) -> None:
+    np_a = np.ascontiguousarray(
+        arr.asnumpy() if hasattr(arr, "asnumpy") else np.asarray(arr))
+    dt = str(np_a.dtype)
+    if dt not in _DTYPE_TO_FLAG:
+        raise MXNetError(f"dtype {dt} has no reference type flag; cast first")
+    out.write(struct.pack("<I", _V2_MAGIC))
+    out.write(struct.pack("<i", _STYPE_DEFAULT))
+    _write_shape(out, np_a.shape)
+    out.write(struct.pack("<ii", 1, 0))  # Context{cpu, 0}
+    out.write(struct.pack("<i", _DTYPE_TO_FLAG[dt]))
+    out.write(np_a.tobytes())
+
+
+def save_reference_ndarrays(fname: str, arrays: List, names: List[str]) -> None:
+    """Write a reference-wire-format NDArray list file (dense V2 records)."""
+    out = io.BytesIO()
+    out.write(struct.pack("<QQ", _LIST_MAGIC, 0))
+    out.write(struct.pack("<Q", len(arrays)))
+    for a in arrays:
+        _write_record(out, a)
+    out.write(struct.pack("<Q", len(names)))
+    for nm in names:
+        b = nm.encode()
+        out.write(struct.pack("<Q", len(b)))
+        out.write(b)
+    with open(fname, "wb") as f:
+        f.write(out.getvalue())
+
+
+def save_reference_params(fname: str, params: Dict[str, "object"]) -> None:
+    """Write a dict of NDArrays in the reference ``.params`` wire format, so
+    checkpoints trained here can be consumed by reference tooling."""
+    names = list(params.keys())
+    save_reference_ndarrays(fname, [params[k] for k in names], names)
+
+
+# --------------------------------------------------------------------------
+# Symbol JSON import with legacy upgrade
+# --------------------------------------------------------------------------
+# attrs the reference parks on nodes but which belong to variables / schedule
+# metadata, not op params (kHiddenKeys, src/c_api/c_api_common.h)
+_HIDDEN_KEYS = ("ctx_group", "lr_mult", "wd_mult", "force_mirroring")
+
+# pre-0.9 JSON dropped aux-state inputs; re-create them per op, in the
+# reference's input order (UpgradeJSON_000800_000900 + FListInputNames)
+_AUX_INPUT_NAMES = {
+    "BatchNorm": ("moving_mean", "moving_var"),
+    "CuDNNBatchNorm": ("moving_mean", "moving_var"),
+}
+
+
+def _parse_attr_value(s):
+    """Reference attr values are strings ('(3, 3)', '64', 'True', 'relu')."""
+    if not isinstance(s, str):
+        return s
+    txt = s.strip()
+    try:
+        return ast.literal_eval(txt)
+    except (ValueError, SyntaxError):
+        return s
+
+
+def is_reference_symbol_json(data: dict) -> bool:
+    return "mxnet_tpu_version" not in data and "nodes" in data
+
+
+def symbol_from_reference_json(json_str_or_dict: Union[str, dict]):
+    """Build a Symbol from reference/nnvm graph JSON, applying the legacy
+    upgrade chain so 0.8-era files load too."""
+    from .symbol.symbol import Symbol, _Node
+
+    data = (json.loads(json_str_or_dict)
+            if isinstance(json_str_or_dict, str) else json_str_or_dict)
+    jnodes = data.get("nodes")
+    if jnodes is None:
+        raise MXNetError("symbol JSON has no 'nodes' list")
+
+    version = 0
+    gattrs = data.get("attrs", {})
+    if isinstance(gattrs, dict) and "mxnet_version" in gattrs:
+        v = gattrs["mxnet_version"]
+        version = v[1] if isinstance(v, (list, tuple)) else v
+
+    nodes: List = []
+    for jn in jnodes:
+        op = None if jn.get("op", "null") in (None, "null") else jn["op"]
+        # attrs key varies by era: attrs (>=1.0) / attr (0.9.x) / param (0.8)
+        raw_attrs = dict(jn.get("attrs") or jn.get("attr")
+                         or jn.get("param") or {})
+        attrs, hidden = {}, {}
+        for k, v in raw_attrs.items():
+            base = k[2:-2] if k.startswith("__") and k.endswith("__") else k
+            if base in _HIDDEN_KEYS or any(
+                    k.endswith("_" + h) for h in _HIDDEN_KEYS):
+                hidden[k] = v
+            elif op is None:
+                attrs[k if k.startswith("__") else f"__{k}__"] = v
+            else:
+                attrs[k] = _parse_attr_value(v)
+        inputs = [(nodes[e[0]], e[1]) for e in jn.get("inputs", [])]
+        node = _Node(op, jn.get("name", ""), attrs, inputs)
+        nodes.append(node)
+        # rehome hidden keys: bare key on a variable stays; 'argname_lr_mult'
+        # on an op node moves onto the matching variable input when findable
+        for k, v in hidden.items():
+            if op is None:
+                node.attrs[f"__{k.strip('_')}__"] = v
+                continue
+            for h in _HIDDEN_KEYS:
+                if not k.endswith("_" + h):
+                    continue
+                arg = k[:-(len(h) + 1)]
+                for src, _idx in node.inputs:
+                    if src.op is None and (src.name == arg
+                                           or src.name.endswith("_" + arg)):
+                        src.attrs[f"__{h}__"] = v
+                        break
+                break
+
+        # UpgradeJSON_000800_000900: re-create dropped aux inputs
+        if op in _AUX_INPUT_NAMES and version < 900:
+            want = _AUX_INPUT_NAMES[op]
+            missing = [n for n in want
+                       if not any(s.name.endswith(n) for s, _ in node.inputs)]
+            for aux_name in missing:
+                var = _Node(None, f"{node.name}_{aux_name}", {}, [])
+                nodes.append(var)
+                node.inputs.append((var, 0))
+
+        # UpgradeJSON_000904_000905: optionalized argmin/argmax axis
+        if op in ("argmin", "argmax") and version < 905 \
+                and attrs.get("axis") == -1:
+            attrs.pop("axis")
+
+    heads_raw = data.get("heads") or [[len(nodes) - 1, 0]]
+    heads = [(nodes[h[0]], h[1] if len(h) > 1 else 0) for h in heads_raw]
+    if version and version < 10000:
+        logging.getLogger(__name__).info(
+            "loaded symbol saved by reference v%d.%d.%d (upgraded)",
+            version // 10000, (version // 100) % 100, version % 100)
+    return Symbol(heads)
+
+
+def load_reference_checkpoint(prefix: str, epoch: int):
+    """Reference-checkpoint pair → (symbol, arg_params, aux_params)."""
+    from .symbol import load as sym_load
+
+    symbol = sym_load(f"{prefix}-symbol.json")
+    params = load_reference_params(f"{prefix}-{epoch:04d}.params")
+    arg_params, aux_params = {}, {}
+    for k, v in params.items():
+        tp, _, name = k.partition(":")
+        if tp == "arg":
+            arg_params[name] = v
+        elif tp == "aux":
+            aux_params[name] = v
+        else:
+            arg_params[k] = v
+    return symbol, arg_params, aux_params

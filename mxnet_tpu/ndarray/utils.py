@@ -129,13 +129,19 @@ def save(fname: str, data) -> None:
 
 
 def load(fname: str):
-    """Load NDArrays saved by :func:`save`; returns list or dict."""
+    """Load NDArrays saved by :func:`save` — or by the reference's
+    ``mx.nd.save`` (the dmlc ``0x112`` list container, auto-detected and
+    routed through :mod:`mxnet_tpu.interop`); returns list or dict."""
     with open(fname, "rb") as f:
         magic = f.read(8)
         if magic != _MAGIC:
+            from .. import interop
+            if interop.is_reference_params_file(fname):
+                arrays, names = interop.load_reference_ndarrays(fname)
+                return dict(zip(names, arrays)) if names else arrays
             raise MXNetError(f"{fname}: not a mxnet_tpu NDArray file "
-                             f"(bad magic {magic!r}); for reference-format "
-                             f".params files use mxnet_tpu.util.load_reference_params")
+                             f"(bad magic {magic!r}) and not a reference "
+                             f".params file either")
         (hlen,) = struct.unpack("<Q", f.read(8))
         header = json.loads(f.read(hlen).decode())
         arrays = []

@@ -1,17 +1,21 @@
 """Symbolic RNN cells (legacy ``mx.rnn`` API).
 
-Reference parity: ``python/mxnet/rnn/rnn_cell.py`` — cells compose ``Symbol``
-graphs step by step (``__call__``) or over a whole sequence (``unroll``), with
-weight sharing through :class:`RNNParams` and pack/unpack helpers that convert
-between per-gate weights and the fused op's single packed parameter vector.
+Reference parity: ``python/mxnet/rnn/rnn_cell.py`` — the same cell classes,
+unroll semantics, parameter names, and packed-weight layout, so checkpoints
+keyed by ``{prefix}i2h_weight`` / ``{prefix}{dir}{layer}_i2h{gate}_weight``
+interchange with reference-trained models.
 
-TPU-first notes: ``FusedRNNCell`` maps onto the ``RNN`` op, whose packed-vector
-layout matches ``src/operator/rnn-inl.h`` and which lowers to one big input
-projection matmul + a ``lax.scan`` hidden recurrence (see ``ops/rnn.py``) —
-there is no cuDNN descriptor machinery to mirror. ``begin_state`` emits
-zeros with a leading 1 ("unknown batch") that broadcasts against the first
-timestep, since XLA graphs have static shapes and cannot carry the
-reference's 0-meaning-unknown batch dimension.
+The implementation is organized differently from the reference: all gated
+recurrences (vanilla/LSTM/GRU) share ONE recipe — project input and hidden
+state through two stacked FullyConnected ops, split per gate, combine — in
+:func:`_gate_step`, and the fused packed-vector layout is described once by
+:func:`_packed_layout` and walked by both pack and unpack. ``FusedRNNCell``
+maps onto the ``RNN`` op, which lowers to a single big input-projection
+matmul + a ``lax.scan`` hidden recurrence (see ``ops/rnn.py``) — there is no
+cuDNN descriptor machinery to mirror. ``begin_state`` emits zeros with a
+leading 1 ("unknown batch") that broadcasts against the first timestep,
+since XLA graphs have static shapes and cannot carry the reference's
+0-meaning-unknown batch dimension.
 """
 from __future__ import annotations
 
@@ -20,81 +24,131 @@ from ..symbol import Symbol
 from ..base import MXNetError
 from ..ops.rnn import rnn_packed_param_size
 
+__all__ = ["RNNParams", "BaseRNNCell", "RNNCell", "LSTMCell", "GRUCell",
+           "FusedRNNCell", "SequentialRNNCell", "DropoutCell", "ModifierCell",
+           "ZoneoutCell", "ResidualCell", "BidirectionalCell"]
 
-def _cells_state_info(cells):
-    return sum([c.state_info for c in cells], [])
-
-
-def _cells_begin_state(cells, **kwargs):
-    return sum([c.begin_state(**kwargs) for c in cells], [])
-
-
-def _cells_unpack_weights(cells, args):
-    for cell in cells:
-        args = cell.unpack_weights(args)
-    return args
+# gate suffixes per mode, in the packed layout's order
+_GATES = {"rnn_relu": ("",), "rnn_tanh": ("",),
+          "lstm": ("_i", "_f", "_c", "_o"), "gru": ("_r", "_z", "_o")}
 
 
-def _cells_pack_weights(cells, args):
-    for cell in cells:
-        args = cell.pack_weights(args)
-    return args
+# ------------------------------------------------------------ sequence forms
+def _time_axis(layout):
+    ax = layout.find("T")
+    if ax < 0:
+        raise MXNetError(f"layout {layout!r} has no time axis")
+    return ax
 
 
-def _normalize_sequence(length, inputs, layout, merge, in_layout=None):
-    """Convert between a single (N,T,C)/(T,N,C) Symbol and a length-T list."""
-    assert inputs is not None
-    axis = layout.find('T')
-    in_axis = in_layout.find('T') if in_layout is not None else axis
+def _to_steps(inputs, length, layout):
+    """Whatever form ``inputs`` is in → a length-T list of (N, C) Symbols."""
     if isinstance(inputs, Symbol):
-        if merge is False:
-            if len(inputs.list_outputs()) != 1:
-                raise MXNetError("unroll doesn't allow grouped symbol as input")
-            inputs = list(symbol.SliceChannel(inputs, axis=in_axis,
-                                              num_outputs=length,
-                                              squeeze_axis=1))
-    else:
-        assert length is None or len(inputs) == length
-        if merge is True:
-            inputs = [symbol.expand_dims(i, axis=axis) for i in inputs]
-            inputs = symbol.Concat(*inputs, dim=axis)
-            in_axis = axis
-    if isinstance(inputs, Symbol) and axis != in_axis:
-        inputs = symbol.swapaxes(inputs, dim1=axis, dim2=in_axis)
-    return inputs, axis
+        if len(inputs.list_outputs()) != 1:
+            raise MXNetError("unroll needs a single-output symbol as input")
+        return list(symbol.SliceChannel(
+            inputs, axis=_time_axis(layout), num_outputs=length,
+            squeeze_axis=1))
+    steps = list(inputs)
+    if length is not None and len(steps) != length:
+        raise MXNetError(f"got {len(steps)} step inputs, expected {length}")
+    return steps
+
+
+def _to_merged(inputs, length, layout):
+    """Whatever form ``inputs`` is in → one (N,T,C)/(T,N,C) Symbol."""
+    if isinstance(inputs, Symbol):
+        return inputs
+    steps = list(inputs)
+    if length is not None and len(steps) != length:
+        raise MXNetError(f"got {len(steps)} step inputs, expected {length}")
+    ax = _time_axis(layout)
+    expanded = [symbol.expand_dims(s, axis=ax) for s in steps]
+    return symbol.Concat(*expanded, dim=ax)
+
+
+def _shape_outputs(outputs, length, layout, merge):
+    """Present per-step outputs in the caller-requested form: True → merged
+    Symbol, False → step list, None → leave as produced."""
+    if merge is True:
+        return _to_merged(outputs, length, layout)
+    if merge is False:
+        return _to_steps(outputs, length, layout)
+    return outputs
+
+
+# -------------------------------------------------------------- shared math
+def _gate_step(mode, num_hidden, proj_i, proj_h, states, name,
+               activation="tanh", get_act=None):
+    """One recurrence step given the two stacked projections.
+
+    ``proj_i``/``proj_h`` are the FullyConnected outputs of shape
+    (N, num_gates*H) in the gate order of ``_GATES[mode]``.
+    Returns (output, new_states).
+    """
+    if mode in ("rnn_relu", "rnn_tanh"):
+        act = activation if get_act else mode.split("_")[1]
+        out = get_act(proj_i + proj_h, act, name=name + "out") if get_act \
+            else symbol.Activation(proj_i + proj_h, act_type=act)
+        return out, [out]
+
+    if mode == "lstm":
+        parts = list(symbol.SliceChannel(proj_i + proj_h, num_outputs=4,
+                                         name=name + "slice"))
+        sig = lambda s, g: symbol.Activation(s, act_type="sigmoid",
+                                             name=name + g)
+        write = sig(parts[0], "i") * symbol.Activation(
+            parts[2], act_type="tanh", name=name + "c")
+        c_next = sig(parts[1], "f") * states[1] + write
+        h_next = sig(parts[3], "o") * symbol.Activation(c_next,
+                                                        act_type="tanh")
+        return h_next, [h_next, c_next]
+
+    if mode == "gru":
+        gi = list(symbol.SliceChannel(proj_i, num_outputs=3,
+                                      name=name + "i2h_slice"))
+        gh = list(symbol.SliceChannel(proj_h, num_outputs=3,
+                                      name=name + "h2h_slice"))
+        reset = symbol.Activation(gi[0] + gh[0], act_type="sigmoid",
+                                  name=name + "r_act")
+        update = symbol.Activation(gi[1] + gh[1], act_type="sigmoid",
+                                   name=name + "z_act")
+        cand = symbol.Activation(gi[2] + reset * gh[2], act_type="tanh",
+                                 name=name + "h_act")
+        h_next = update * states[0] + (1.0 - update) * cand
+        return h_next, [h_next]
+
+    raise MXNetError(f"unknown cell mode {mode!r}")
 
 
 class RNNParams(object):
-    """Container for holding variables; get() caches by full name so cells
-    sharing an RNNParams share weights (reference rnn_cell.py:78)."""
+    """Shared variable pool: ``get`` returns the same Variable for the same
+    full name, so cells constructed on one RNNParams share weights
+    (reference rnn_cell.py:78)."""
 
-    def __init__(self, prefix=''):
+    def __init__(self, prefix=""):
         self._prefix = prefix
         self._params = {}
 
     def get(self, name, **kwargs):
-        name = self._prefix + name
-        if name not in self._params:
-            self._params[name] = symbol.Variable(name, **kwargs)
-        return self._params[name]
+        full = self._prefix + name
+        if full not in self._params:
+            self._params[full] = symbol.Variable(full, **kwargs)
+        return self._params[full]
 
 
 class BaseRNNCell(object):
-    """Abstract base class for symbolic RNN cells."""
+    """Abstract symbolic cell: step with ``__call__``, loop with ``unroll``."""
 
-    def __init__(self, prefix='', params=None):
-        if params is None:
-            params = RNNParams(prefix)
-            self._own_params = True
-        else:
-            self._own_params = False
+    def __init__(self, prefix="", params=None):
+        self._own_params = params is None
         self._prefix = prefix
-        self._params = params
+        self._params = RNNParams(prefix) if params is None else params
         self._modified = False
         self.reset()
 
     def reset(self):
-        """Reset the per-unroll step counter."""
+        """Reset the per-unroll step counters."""
         self._init_counter = -1
         self._counter = -1
 
@@ -114,78 +168,75 @@ class BaseRNNCell(object):
 
     @property
     def state_shape(self):
-        return [ele['shape'] for ele in self.state_info]
+        return [info["shape"] for info in self.state_info]
 
     @property
     def _gate_names(self):
         return ()
 
     def begin_state(self, func=symbol.zeros, **kwargs):
-        """Initial states for this cell. With the default func the batch dim
-        is emitted as 1 and broadcasts against the data (XLA static shapes
-        cannot express the reference's 0 == unknown batch)."""
-        assert not self._modified, \
-            "After applying modifier cells the base cell cannot be called directly."
+        """Initial states. With the default func the batch dim is emitted as
+        1 and broadcasts against the data (XLA static shapes cannot express
+        the reference's 0 == unknown batch)."""
+        if self._modified:
+            raise MXNetError("cell is wrapped by a modifier; ask the "
+                             "modifier for begin_state instead")
+        kwargs = {k: v for k, v in kwargs.items() if k != "name"}
         states = []
         for info in self.state_info:
             self._init_counter += 1
-            shape = tuple(1 if d == 0 else d for d in info['shape'])
-            state = func(name='%sbegin_state_%d' % (self._prefix, self._init_counter),
-                         shape=shape, **{k: v for k, v in kwargs.items()
-                                         if k not in ('name',)})
-            states.append(state)
+            states.append(func(
+                name=f"{self._prefix}begin_state_{self._init_counter}",
+                shape=tuple(d or 1 for d in info["shape"]), **kwargs))
         return states
 
+    # ---- packed (stacked-gate) <-> per-gate weight dict conversion -------
+    def _gate_slices(self, group):
+        """(full_param_name per gate) for the stacked i2h/h2h weight+bias."""
+        return [(f"{self._prefix}{group}{g}_weight",
+                 f"{self._prefix}{group}{g}_bias")
+                for g in self._gate_names]
+
     def unpack_weights(self, args):
-        """Split packed fused weights into per-gate i2h/h2h arrays."""
-        args = args.copy()
+        """Split stacked i2h/h2h weights into per-gate arrays."""
         if not self._gate_names:
-            return args
+            return args.copy()
+        out = args.copy()
         h = self._num_hidden
-        for group_name in ['i2h', 'h2h']:
-            weight = args.pop('%s%s_weight' % (self._prefix, group_name))
-            bias = args.pop('%s%s_bias' % (self._prefix, group_name))
-            for j, gate in enumerate(self._gate_names):
-                wname = '%s%s%s_weight' % (self._prefix, group_name, gate)
-                args[wname] = weight[j * h:(j + 1) * h].copy()
-                bname = '%s%s%s_bias' % (self._prefix, group_name, gate)
-                args[bname] = bias[j * h:(j + 1) * h].copy()
-        return args
+        for group in ("i2h", "h2h"):
+            w = out.pop(f"{self._prefix}{group}_weight")
+            b = out.pop(f"{self._prefix}{group}_bias")
+            for j, (wname, bname) in enumerate(self._gate_slices(group)):
+                out[wname] = w[j * h:(j + 1) * h].copy()
+                out[bname] = b[j * h:(j + 1) * h].copy()
+        return out
 
     def pack_weights(self, args):
-        """Inverse of unpack_weights."""
-        args = args.copy()
+        """Inverse of :meth:`unpack_weights`."""
         if not self._gate_names:
-            return args
-        for group_name in ['i2h', 'h2h']:
-            weight = []
-            bias = []
-            for gate in self._gate_names:
-                wname = '%s%s%s_weight' % (self._prefix, group_name, gate)
-                weight.append(args.pop(wname))
-                bname = '%s%s%s_bias' % (self._prefix, group_name, gate)
-                bias.append(args.pop(bname))
-            from ..ndarray import concat
-            args['%s%s_weight' % (self._prefix, group_name)] = concat(*weight, dim=0)
-            args['%s%s_bias' % (self._prefix, group_name)] = concat(*bias, dim=0)
-        return args
+            return args.copy()
+        from ..ndarray import concat
+        out = args.copy()
+        for group in ("i2h", "h2h"):
+            ws, bs = [], []
+            for wname, bname in self._gate_slices(group):
+                ws.append(out.pop(wname))
+                bs.append(out.pop(bname))
+            out[f"{self._prefix}{group}_weight"] = concat(*ws, dim=0)
+            out[f"{self._prefix}{group}_bias"] = concat(*bs, dim=0)
+        return out
 
-    def unroll(self, length, inputs, begin_state=None, layout='NTC',
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
                merge_outputs=None):
-        """Unroll the cell for ``length`` steps; returns (outputs, states)."""
+        """Unroll ``length`` steps; returns (outputs, final_states)."""
         self.reset()
-        inputs, _ = _normalize_sequence(length, inputs, layout, False)
-        if begin_state is None:
-            begin_state = self.begin_state()
-
-        states = begin_state
+        steps = _to_steps(inputs, length, layout)
+        states = begin_state if begin_state is not None else self.begin_state()
         outputs = []
-        for i in range(length):
-            output, states = self(inputs[i], states)
-            outputs.append(output)
-
-        outputs, _ = _normalize_sequence(length, outputs, layout, merge_outputs)
-        return outputs, states
+        for x in steps:
+            y, states = self(x, states)
+            outputs.append(y)
+        return _shape_outputs(outputs, length, layout, merge_outputs), states
 
     def _get_activation(self, inputs, activation, **kwargs):
         if isinstance(activation, str):
@@ -193,377 +244,329 @@ class BaseRNNCell(object):
         return activation(inputs, **kwargs)
 
 
-class RNNCell(BaseRNNCell):
-    """Vanilla RNN cell: h' = act(W_x x + W_h h + b)."""
+class _GateCell(BaseRNNCell):
+    """Shared implementation of the three stepped gated cells: two stacked
+    FullyConnected projections + the :func:`_gate_step` recipe."""
 
-    def __init__(self, num_hidden, activation='tanh', prefix='rnn_', params=None):
-        super(RNNCell, self).__init__(prefix=prefix, params=params)
+    _mode = None  # set by subclasses
+
+    def __init__(self, num_hidden, prefix, params, i2h_bias_init=None):
+        super().__init__(prefix=prefix, params=params)
         self._num_hidden = num_hidden
+        get = self.params.get
+        self._weights = {
+            "i2h": (get("i2h_weight"),
+                    get("i2h_bias", **({"init": i2h_bias_init}
+                                       if i2h_bias_init else {}))),
+            "h2h": (get("h2h_weight"), get("h2h_bias")),
+        }
+
+    @property
+    def _gate_names(self):
+        return tuple(_GATES[self._mode])
+
+    @property
+    def state_info(self):
+        slots = 2 if self._mode == "lstm" else 1
+        return [{"shape": (0, self._num_hidden), "__layout__": "NC"}
+                for _ in range(slots)]
+
+    def _project(self, data, group, name):
+        w, b = self._weights[group]
+        return symbol.FullyConnected(
+            data=data, weight=w, bias=b,
+            num_hidden=self._num_hidden * len(self._gate_names),
+            name=name + group)
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = f"{self._prefix}t{self._counter}_"
+        proj_i = self._project(inputs, "i2h", name)
+        proj_h = self._project(states[0], "h2h", name)
+        return _gate_step(self._mode, self._num_hidden, proj_i, proj_h,
+                          states, name,
+                          activation=getattr(self, "_activation", None),
+                          get_act=(self._get_activation
+                                   if self._mode.startswith("rnn") else None))
+
+
+class RNNCell(_GateCell):
+    """Vanilla RNN: h' = act(W_x x + W_h h + b)."""
+
+    _mode = "rnn_tanh"
+
+    def __init__(self, num_hidden, activation="tanh", prefix="rnn_",
+                 params=None):
+        super().__init__(num_hidden, prefix, params)
         self._activation = activation
-        self._iW = self.params.get('i2h_weight')
-        self._iB = self.params.get('i2h_bias')
-        self._hW = self.params.get('h2h_weight')
-        self._hB = self.params.get('h2h_bias')
-
-    @property
-    def state_info(self):
-        return [{'shape': (0, self._num_hidden), '__layout__': 'NC'}]
-
-    @property
-    def _gate_names(self):
-        return ('',)
-
-    def __call__(self, inputs, states):
-        self._counter += 1
-        name = '%st%d_' % (self._prefix, self._counter)
-        i2h = symbol.FullyConnected(data=inputs, weight=self._iW, bias=self._iB,
-                                    num_hidden=self._num_hidden,
-                                    name='%si2h' % name)
-        h2h = symbol.FullyConnected(data=states[0], weight=self._hW, bias=self._hB,
-                                    num_hidden=self._num_hidden,
-                                    name='%sh2h' % name)
-        output = self._get_activation(i2h + h2h, self._activation,
-                                      name='%sout' % name)
-        return output, [output]
 
 
-class LSTMCell(BaseRNNCell):
-    """LSTM cell; gate order [i, f, g, o] as the reference (rnn_cell.py:408)."""
+class LSTMCell(_GateCell):
+    """LSTM; gate order [i, f, c, o] matches the reference packed layout
+    (reference rnn_cell.py:408)."""
 
-    def __init__(self, num_hidden, prefix='lstm_', params=None, forget_bias=1.0):
-        super(LSTMCell, self).__init__(prefix=prefix, params=params)
-        self._num_hidden = num_hidden
-        self._iW = self.params.get('i2h_weight')
-        self._hW = self.params.get('h2h_weight')
-        self._iB = self.params.get(
-            'i2h_bias', init=LSTMBiasInit(forget_bias))
-        self._hB = self.params.get('h2h_bias')
+    _mode = "lstm"
 
-    @property
-    def state_info(self):
-        return [{'shape': (0, self._num_hidden), '__layout__': 'NC'},
-                {'shape': (0, self._num_hidden), '__layout__': 'NC'}]
-
-    @property
-    def _gate_names(self):
-        return ['_i', '_f', '_c', '_o']
-
-    def __call__(self, inputs, states):
-        self._counter += 1
-        name = '%st%d_' % (self._prefix, self._counter)
-        i2h = symbol.FullyConnected(data=inputs, weight=self._iW, bias=self._iB,
-                                    num_hidden=self._num_hidden * 4,
-                                    name='%si2h' % name)
-        h2h = symbol.FullyConnected(data=states[0], weight=self._hW, bias=self._hB,
-                                    num_hidden=self._num_hidden * 4,
-                                    name='%sh2h' % name)
-        gates = i2h + h2h
-        slice_gates = symbol.SliceChannel(gates, num_outputs=4,
-                                          name="%sslice" % name)
-        in_gate = symbol.Activation(slice_gates[0], act_type="sigmoid",
-                                    name='%si' % name)
-        forget_gate = symbol.Activation(slice_gates[1], act_type="sigmoid",
-                                        name='%sf' % name)
-        in_transform = symbol.Activation(slice_gates[2], act_type="tanh",
-                                         name='%sc' % name)
-        out_gate = symbol.Activation(slice_gates[3], act_type="sigmoid",
-                                     name='%so' % name)
-        next_c = forget_gate * states[1] + in_gate * in_transform
-        next_h = out_gate * symbol.Activation(next_c, act_type="tanh")
-        return next_h, [next_h, next_c]
+    def __init__(self, num_hidden, prefix="lstm_", params=None,
+                 forget_bias=1.0):
+        super().__init__(num_hidden, prefix, params,
+                         i2h_bias_init=LSTMBiasInit(forget_bias))
 
 
-class GRUCell(BaseRNNCell):
-    """GRU cell; gate order [r, z, n] (reference rnn_cell.py:469)."""
+class GRUCell(_GateCell):
+    """GRU; gate order [r, z, o] (reference rnn_cell.py:469)."""
 
-    def __init__(self, num_hidden, prefix='gru_', params=None):
-        super(GRUCell, self).__init__(prefix=prefix, params=params)
-        self._num_hidden = num_hidden
-        self._iW = self.params.get('i2h_weight')
-        self._iB = self.params.get('i2h_bias')
-        self._hW = self.params.get('h2h_weight')
-        self._hB = self.params.get('h2h_bias')
+    _mode = "gru"
 
-    @property
-    def state_info(self):
-        return [{'shape': (0, self._num_hidden), '__layout__': 'NC'}]
+    def __init__(self, num_hidden, prefix="gru_", params=None):
+        super().__init__(num_hidden, prefix, params)
 
-    @property
-    def _gate_names(self):
-        return ['_r', '_z', '_o']
 
-    def __call__(self, inputs, states):
-        self._counter += 1
-        name = '%st%d_' % (self._prefix, self._counter)
-        prev_state_h = states[0]
-        i2h = symbol.FullyConnected(data=inputs, weight=self._iW, bias=self._iB,
-                                    num_hidden=self._num_hidden * 3,
-                                    name="%si2h" % name)
-        h2h = symbol.FullyConnected(data=prev_state_h, weight=self._hW,
-                                    bias=self._hB,
-                                    num_hidden=self._num_hidden * 3,
-                                    name="%sh2h" % name)
-        i2h_r, i2h_z, i2h = symbol.SliceChannel(i2h, num_outputs=3,
-                                                name="%si2h_slice" % name)
-        h2h_r, h2h_z, h2h = symbol.SliceChannel(h2h, num_outputs=3,
-                                                name="%sh2h_slice" % name)
-        reset_gate = symbol.Activation(i2h_r + h2h_r, act_type="sigmoid",
-                                       name="%sr_act" % name)
-        update_gate = symbol.Activation(i2h_z + h2h_z, act_type="sigmoid",
-                                        name="%sz_act" % name)
-        next_h_tmp = symbol.Activation(i2h + reset_gate * h2h, act_type="tanh",
-                                       name="%sh_act" % name)
-        next_h = (1. - update_gate) * next_h_tmp + update_gate * prev_state_h
-        return next_h, [next_h]
+# ------------------------------------------------------------------- fused
+def _packed_layout(mode, num_layers, directions, input_size, hidden):
+    """Yield (param_name_parts, shape) over the fused packed vector, in wire
+    order: all weights layer-major (i2h gates then h2h gates per direction),
+    then all biases in the same order (reference rnn-inl.h packed layout)."""
+    gates = _GATES[mode]
+    b = len(directions)
+    for kind in ("weight", "bias"):
+        for layer in range(num_layers):
+            for d in directions:
+                for group in ("i2h", "h2h"):
+                    if kind == "bias":
+                        shape = (hidden,)
+                    elif group == "h2h":
+                        shape = (hidden, hidden)
+                    else:
+                        in_dim = input_size if layer == 0 else hidden * b
+                        shape = (hidden, in_dim)
+                    for g in gates:
+                        yield (d, layer, group, g, kind), shape
 
 
 class FusedRNNCell(BaseRNNCell):
-    """Fused multi-layer RNN backed by the ``RNN`` op (one packed parameter
-    vector; lowers to matmul + lax.scan, see ops/rnn.py)."""
+    """Fused multi-layer RNN backed by the ``RNN`` op: one packed parameter
+    vector, lowered to a big matmul + lax.scan (ops/rnn.py)."""
 
-    def __init__(self, num_hidden, num_layers=1, mode='lstm', bidirectional=False,
-                 dropout=0., get_next_state=False, forget_bias=1.0,
-                 prefix=None, params=None):
-        if prefix is None:
-            prefix = '%s_' % mode
-        super(FusedRNNCell, self).__init__(prefix=prefix, params=params)
-        self._num_hidden = num_hidden
-        self._num_layers = num_layers
-        self._mode = mode
-        self._bidirectional = bidirectional
-        self._dropout = dropout
-        self._get_next_state = get_next_state
-        self._forget_bias = forget_bias
-        self._directions = ['l', 'r'] if bidirectional else ['l']
-        self._parameter = self.params.get('parameters')
+    def __init__(self, num_hidden, num_layers=1, mode="lstm",
+                 bidirectional=False, dropout=0., get_next_state=False,
+                 forget_bias=1.0, prefix=None, params=None):
+        super().__init__(prefix=f"{mode}_" if prefix is None else prefix,
+                         params=params)
+        self._num_hidden, self._num_layers, self._mode = \
+            num_hidden, num_layers, mode
+        self._bidirectional, self._dropout = bidirectional, dropout
+        self._get_next_state, self._forget_bias = get_next_state, forget_bias
+        self._directions = ["l", "r"] if bidirectional else ["l"]
+        self._parameter = self.params.get("parameters")
 
     @property
     def state_info(self):
-        b = self._bidirectional + 1
-        n = (self._mode == 'lstm') + 1
-        return [{'shape': (b * self._num_layers, 0, self._num_hidden),
-                 '__layout__': 'LNC'} for _ in range(n)]
+        layers = len(self._directions) * self._num_layers
+        n_states = 2 if self._mode == "lstm" else 1
+        return [{"shape": (layers, 0, self._num_hidden), "__layout__": "LNC"}
+                for _ in range(n_states)]
 
     @property
     def _gate_names(self):
-        return {'rnn_relu': [''],
-                'rnn_tanh': [''],
-                'lstm': ['_i', '_f', '_c', '_o'],
-                'gru': ['_r', '_z', '_o']}[self._mode]
+        return _GATES[self._mode]
 
     @property
     def _num_gates(self):
         return len(self._gate_names)
 
-    def _slice_weights(self, arr, li, lh):
-        """Slice a packed parameter ndarray into a per-layer/gate dict."""
-        args = {}
-        gate_names = self._gate_names
-        directions = self._directions
-        b = len(directions)
-        p = 0
-        for layer in range(self._num_layers):
-            for direction in directions:
-                for gate in gate_names:
-                    name = '%s%s%d_i2h%s_weight' % (self._prefix, direction, layer, gate)
-                    size = (li if layer == 0 else lh * b) * lh
-                    args[name] = arr[p:p + size].reshape(
-                        (lh, li if layer == 0 else lh * b))
-                    p += size
-                for gate in gate_names:
-                    name = '%s%s%d_h2h%s_weight' % (self._prefix, direction, layer, gate)
-                    size = lh ** 2
-                    args[name] = arr[p:p + size].reshape((lh, lh))
-                    p += size
-        for layer in range(self._num_layers):
-            for direction in directions:
-                for gate in gate_names:
-                    name = '%s%s%d_i2h%s_bias' % (self._prefix, direction, layer, gate)
-                    args[name] = arr[p:p + lh]
-                    p += lh
-                for gate in gate_names:
-                    name = '%s%s%d_h2h%s_bias' % (self._prefix, direction, layer, gate)
-                    args[name] = arr[p:p + lh]
-                    p += lh
-        assert p == arr.size, "Invalid parameters size for FusedRNNCell"
-        return args
+    def _infer_input_size(self, total):
+        """Invert rnn_packed_param_size for the input dim (monotone in li)."""
+        size_of = lambda li: rnn_packed_param_size(
+            self._mode, self._num_layers, self._bidirectional, li,
+            self._num_hidden)
+        li = 0
+        while size_of(li) < total:
+            li += 1
+        if size_of(li) != total:
+            raise MXNetError(
+                f"packed vector of {total} elements matches no input size "
+                f"for mode={self._mode} layers={self._num_layers}")
+        return li
+
+    def _param_name(self, key):
+        d, layer, group, gate, kind = key
+        return f"{self._prefix}{d}{layer}_{group}{gate}_{kind}"
 
     def unpack_weights(self, args):
-        args = args.copy()
-        arr = args.pop(self._parameter.name)
-        h = self._num_hidden
-        # solve for the input size from the packed total size
-        calc = lambda li: rnn_packed_param_size(
-            self._mode, self._num_layers, self._bidirectional, li, h)
-        li = 0
-        while calc(li) < arr.size:
-            li += 1
-        assert calc(li) == arr.size, "cannot infer input size from packed weights"
-        nargs = self._slice_weights(arr, li, self._num_hidden)
-        args.update({name: nd.copy() for name, nd in nargs.items()})
-        return args
+        out = args.copy()
+        packed = out.pop(self._parameter.name)
+        li = self._infer_input_size(packed.size)
+        pos = 0
+        for key, shape in _packed_layout(self._mode, self._num_layers,
+                                         self._directions, li,
+                                         self._num_hidden):
+            n = 1
+            for d in shape:
+                n *= d
+            out[self._param_name(key)] = packed[pos:pos + n].reshape(shape).copy()
+            pos += n
+        if pos != packed.size:
+            raise MXNetError("packed parameter vector has trailing elements")
+        return out
 
     def pack_weights(self, args):
-        # build the packed vector by concatenation in the fused op's layout
-        # (arrays are immutable JAX values, so no write-into-slice path)
-        args = args.copy()
         from ..ndarray import concat
-        pieces = []
-        for layer in range(self._num_layers):
-            for direction in self._directions:
-                for group in ('i2h', 'h2h'):
-                    for gate in self._gate_names:
-                        name = '%s%s%d_%s%s_weight' % (
-                            self._prefix, direction, layer, group, gate)
-                        pieces.append(args.pop(name).reshape((-1,)))
-        for layer in range(self._num_layers):
-            for direction in self._directions:
-                for group in ('i2h', 'h2h'):
-                    for gate in self._gate_names:
-                        name = '%s%s%d_%s%s_bias' % (
-                            self._prefix, direction, layer, group, gate)
-                        pieces.append(args.pop(name).reshape((-1,)))
-        args[self._parameter.name] = concat(*pieces, dim=0)
-        return args
+        out = args.copy()
+        pieces = [out.pop(self._param_name(key)).reshape((-1,))
+                  for key, _ in _packed_layout(
+                      self._mode, self._num_layers, self._directions,
+                      None, self._num_hidden)
+                  ]
+        out[self._parameter.name] = concat(*pieces, dim=0)
+        return out
 
     def __call__(self, inputs, states):
-        raise NotImplementedError("FusedRNNCell cannot be stepped. Please use unroll")
+        raise NotImplementedError(
+            "FusedRNNCell cannot be stepped; use unroll")
 
-    def unroll(self, length, inputs, begin_state=None, layout='NTC',
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
                merge_outputs=None):
         self.reset()
-        inputs, axis = _normalize_sequence(length, inputs, layout, True)
-        if axis == 1:
-            # fused op wants TNC
-            inputs = symbol.swapaxes(inputs, dim1=0, dim2=1)
-        if begin_state is None:
-            begin_state = self.begin_state()
+        seq = _to_merged(inputs, length, layout)
+        if _time_axis(layout) != 0:
+            seq = symbol.swapaxes(seq, dim1=0, dim2=1)  # RNN op wants TNC
 
-        states = begin_state
-        if self._mode == 'lstm':
-            states = {'state': states[0], 'state_cell': states[1]}
-        else:
-            states = {'state': states[0]}
+        init = begin_state if begin_state is not None else self.begin_state()
+        state_kwargs = {"state": init[0]}
+        if self._mode == "lstm":
+            state_kwargs["state_cell"] = init[1]
 
-        rnn = symbol.RNN(data=inputs, parameters=self._parameter,
+        rnn = symbol.RNN(data=seq, parameters=self._parameter,
                          state_size=self._num_hidden,
                          num_layers=self._num_layers,
                          bidirectional=self._bidirectional,
                          p=self._dropout,
                          state_outputs=self._get_next_state,
                          mode=self._mode,
-                         name=self._prefix + 'rnn',
-                         **states)
+                         name=self._prefix + "rnn", **state_kwargs)
 
-        if not self._get_next_state:
-            outputs, states = rnn, []
-        elif self._mode == 'lstm':
-            outputs, states = rnn[0], [rnn[1], rnn[2]]
+        if self._get_next_state:
+            outputs = rnn[0]
+            states = [rnn[1], rnn[2]] if self._mode == "lstm" else [rnn[1]]
         else:
-            outputs, states = rnn[0], [rnn[1]]
+            outputs, states = rnn, []
 
-        if axis == 1:
+        if _time_axis(layout) != 0:
             outputs = symbol.swapaxes(outputs, dim1=0, dim2=1)
-
-        outputs, _ = _normalize_sequence(length, outputs, layout, merge_outputs)
-        return outputs, states
+        return _shape_outputs(outputs, length, layout, merge_outputs), states
 
     def unfuse(self):
         """Equivalent unfused SequentialRNNCell (reference rnn_cell.py:714)."""
+        def make(cell_prefix):
+            if self._mode == "lstm":
+                return LSTMCell(self._num_hidden, prefix=cell_prefix,
+                                forget_bias=self._forget_bias)
+            if self._mode == "gru":
+                return GRUCell(self._num_hidden, prefix=cell_prefix)
+            return RNNCell(self._num_hidden,
+                           activation=self._mode.split("_")[1],
+                           prefix=cell_prefix)
+
         stack = SequentialRNNCell()
-        get_cell = {'rnn_relu': lambda cell_prefix: RNNCell(self._num_hidden,
-                                                            activation='relu',
-                                                            prefix=cell_prefix),
-                    'rnn_tanh': lambda cell_prefix: RNNCell(self._num_hidden,
-                                                            activation='tanh',
-                                                            prefix=cell_prefix),
-                    'lstm': lambda cell_prefix: LSTMCell(self._num_hidden,
-                                                         prefix=cell_prefix),
-                    'gru': lambda cell_prefix: GRUCell(self._num_hidden,
-                                                       prefix=cell_prefix)}[self._mode]
         for i in range(self._num_layers):
             if self._bidirectional:
                 stack.add(BidirectionalCell(
-                    get_cell('%sl%d_' % (self._prefix, i)),
-                    get_cell('%sr%d_' % (self._prefix, i)),
-                    output_prefix='%sbi_l%d_' % (self._prefix, i)))
+                    make(f"{self._prefix}l{i}_"),
+                    make(f"{self._prefix}r{i}_"),
+                    output_prefix=f"{self._prefix}bi_l{i}_"))
             else:
-                stack.add(get_cell('%sl%d_' % (self._prefix, i)))
+                stack.add(make(f"{self._prefix}l{i}_"))
             if self._dropout > 0 and i != self._num_layers - 1:
                 stack.add(DropoutCell(self._dropout,
-                                      prefix='%s_dropout%d_' % (self._prefix, i)))
+                                      prefix=f"{self._prefix}_dropout{i}_"))
         return stack
+
+
+# -------------------------------------------------------------- containers
+def _adopt_params(parent, *cells):
+    """Merge child cells' variable pools into the parent's shared pool."""
+    for cell in cells:
+        parent.params._params.update(cell.params._params)
+
+
+def _split_states(states, cells):
+    """Partition a flat state list back into per-cell chunks."""
+    chunks, pos = [], 0
+    for cell in cells:
+        n = len(cell.state_info)
+        chunks.append(states[pos:pos + n])
+        pos += n
+    return chunks
 
 
 class SequentialRNNCell(BaseRNNCell):
     """Stack of cells applied in order each step."""
 
     def __init__(self, params=None):
-        super(SequentialRNNCell, self).__init__(prefix='', params=params)
+        super().__init__(prefix="", params=params)
         self._override_cell_params = params is not None
         self._cells = []
 
     def add(self, cell):
         self._cells.append(cell)
         if self._override_cell_params:
-            assert cell._own_params, \
-                "Either specify params for SequentialRNNCell or child cells, not both."
+            if not cell._own_params:
+                raise MXNetError("specify params on SequentialRNNCell or on "
+                                 "child cells, not both")
             cell.params._params.update(self.params._params)
-        self.params._params.update(cell.params._params)
+        _adopt_params(self, cell)
 
     @property
     def state_info(self):
-        return _cells_state_info(self._cells)
+        return [info for c in self._cells for info in c.state_info]
 
     def begin_state(self, **kwargs):
         assert not self._modified
-        return _cells_begin_state(self._cells, **kwargs)
+        return [s for c in self._cells for s in c.begin_state(**kwargs)]
 
     def unpack_weights(self, args):
-        return _cells_unpack_weights(self._cells, args)
+        for cell in self._cells:
+            args = cell.unpack_weights(args)
+        return args
 
     def pack_weights(self, args):
-        return _cells_pack_weights(self._cells, args)
+        for cell in self._cells:
+            args = cell.pack_weights(args)
+        return args
 
     def __call__(self, inputs, states):
         self._counter += 1
         next_states = []
-        p = 0
-        for cell in self._cells:
-            assert not isinstance(cell, BidirectionalCell)
-            n = len(cell.state_info)
-            state = states[p:p + n]
-            p += n
-            inputs, state = cell(inputs, state)
-            next_states.append(state)
-        return inputs, sum(next_states, [])
+        for cell, chunk in zip(self._cells, _split_states(states, self._cells)):
+            if isinstance(cell, BidirectionalCell):
+                raise MXNetError("BidirectionalCell cannot be stepped "
+                                 "inside a SequentialRNNCell")
+            inputs, new = cell(inputs, chunk)
+            next_states.extend(new)
+        return inputs, next_states
 
-    def unroll(self, length, inputs, begin_state=None, layout='NTC',
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
                merge_outputs=None):
         self.reset()
-        num_cells = len(self._cells)
-        if begin_state is None:
-            begin_state = self.begin_state()
-        p = 0
-        next_states = []
-        for i, cell in enumerate(self._cells):
-            n = len(cell.state_info)
-            states = begin_state[p:p + n]
-            p += n
+        init = self.begin_state() if begin_state is None else begin_state
+        chunks = _split_states(init, self._cells)
+        final_states = []
+        last = len(self._cells) - 1
+        for i, (cell, chunk) in enumerate(zip(self._cells, chunks)):
             inputs, states = cell.unroll(
-                length, inputs=inputs, begin_state=states, layout=layout,
-                merge_outputs=None if i < num_cells - 1 else merge_outputs)
-            next_states.extend(states)
-        return inputs, next_states
+                length, inputs=inputs, begin_state=chunk, layout=layout,
+                merge_outputs=merge_outputs if i == last else None)
+            final_states.extend(states)
+        return inputs, final_states
 
 
 class DropoutCell(BaseRNNCell):
-    """Applies dropout on the input (no state)."""
+    """Applies dropout to the input; stateless."""
 
-    def __init__(self, dropout, prefix='dropout_', params=None):
-        super(DropoutCell, self).__init__(prefix, params)
-        assert isinstance(dropout, float)
-        self.dropout = dropout
+    def __init__(self, dropout, prefix="dropout_", params=None):
+        super().__init__(prefix, params)
+        self.dropout = float(dropout)
 
     @property
     def state_info(self):
@@ -574,23 +577,24 @@ class DropoutCell(BaseRNNCell):
             inputs = symbol.Dropout(data=inputs, p=self.dropout)
         return inputs, states
 
-    def unroll(self, length, inputs, begin_state=None, layout='NTC',
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
                merge_outputs=None):
         self.reset()
-        inputs, _ = _normalize_sequence(length, inputs, layout, merge_outputs)
-        if isinstance(inputs, Symbol):
-            return self(inputs, []), []
-        return super(DropoutCell, self).unroll(length, inputs,
-                                               begin_state=begin_state,
-                                               layout=layout,
-                                               merge_outputs=merge_outputs)
+        if merge_outputs is True or (merge_outputs is None
+                                     and isinstance(inputs, Symbol)):
+            # dropout is elementwise: one Dropout node on the merged sequence
+            out, _ = self(_to_merged(inputs, length, layout), [])
+            return out, []
+        return super().unroll(length, inputs, begin_state=begin_state,
+                              layout=layout, merge_outputs=merge_outputs)
 
 
 class ModifierCell(BaseRNNCell):
-    """Base for cells that wrap another cell (Zoneout/Residual)."""
+    """Base for cells that decorate another cell (Zoneout/Residual); params
+    belong to the wrapped cell."""
 
     def __init__(self, base_cell):
-        super(ModifierCell, self).__init__()
+        super().__init__()
         base_cell._modified = True
         self.base_cell = base_cell
 
@@ -606,9 +610,10 @@ class ModifierCell(BaseRNNCell):
     def begin_state(self, func=symbol.zeros, **kwargs):
         assert not self._modified
         self.base_cell._modified = False
-        begin = self.base_cell.begin_state(func=func, **kwargs)
-        self.base_cell._modified = True
-        return begin
+        try:
+            return self.base_cell.begin_state(func=func, **kwargs)
+        finally:
+            self.base_cell._modified = True
 
     def unpack_weights(self, args):
         return self.base_cell.unpack_weights(args)
@@ -621,145 +626,158 @@ class ModifierCell(BaseRNNCell):
 
 
 class ZoneoutCell(ModifierCell):
-    """Zoneout regularization: keep previous state with given probability."""
+    """Zoneout: randomly hold the previous output/state instead of the new
+    one (Krueger et al. 2017)."""
 
     def __init__(self, base_cell, zoneout_outputs=0., zoneout_states=0.):
-        assert not isinstance(base_cell, FusedRNNCell), \
-            "FusedRNNCell doesn't support zoneout. Please unfuse first."
-        assert not isinstance(base_cell, BidirectionalCell), \
-            "BidirectionalCell doesn't support zoneout since it doesn't support step."
-        super(ZoneoutCell, self).__init__(base_cell)
-        self.zoneout_outputs = zoneout_outputs
-        self.zoneout_states = zoneout_states
+        if isinstance(base_cell, FusedRNNCell):
+            raise MXNetError("unfuse() the cell before applying zoneout")
+        if isinstance(base_cell, BidirectionalCell):
+            raise MXNetError("BidirectionalCell cannot be zoned out "
+                             "(it cannot be stepped)")
+        super().__init__(base_cell)
+        self.zoneout_outputs, self.zoneout_states = \
+            zoneout_outputs, zoneout_states
         self.prev_output = None
 
     def reset(self):
-        super(ZoneoutCell, self).reset()
+        super().reset()
         self.prev_output = None
 
+    @staticmethod
+    def _keep_mask(p, like):
+        # Dropout of ones == a 0/1 keep mask scaled by 1/(1-p); where() only
+        # cares about zero vs nonzero, so the scale is harmless
+        return symbol.Dropout(symbol.ones_like(like), p=p)
+
     def __call__(self, inputs, states):
-        cell, p_outputs, p_states = self.base_cell, self.zoneout_outputs, self.zoneout_states
-        next_output, next_states = cell(inputs, states)
-        mask = lambda p, like: symbol.Dropout(symbol.ones_like(like), p=p)
-        prev_output = self.prev_output if self.prev_output is not None \
-            else symbol.zeros_like(next_output)
-        output = symbol.where(mask(p_outputs, next_output), next_output,
-                              prev_output) if p_outputs != 0. else next_output
-        new_states = ([symbol.where(mask(p_states, new_s), new_s, old_s)
-                       for new_s, old_s in zip(next_states, states)]
-                      if p_states != 0. else next_states)
-        self.prev_output = output
-        return output, new_states
+        new_out, new_states = self.base_cell(inputs, states)
+        if self.zoneout_outputs > 0.:
+            held = self.prev_output if self.prev_output is not None \
+                else symbol.zeros_like(new_out)
+            new_out = symbol.where(
+                self._keep_mask(self.zoneout_outputs, new_out), new_out, held)
+        if self.zoneout_states > 0.:
+            new_states = [
+                symbol.where(self._keep_mask(self.zoneout_states, ns), ns, os)
+                for ns, os in zip(new_states, states)]
+        self.prev_output = new_out
+        return new_out, new_states
 
 
 class ResidualCell(ModifierCell):
-    """Adds the input to the wrapped cell's output (residual connection)."""
-
-    def __init__(self, base_cell):
-        super(ResidualCell, self).__init__(base_cell)
+    """Adds the step input to the wrapped cell's output."""
 
     def __call__(self, inputs, states):
-        output, states = self.base_cell(inputs, states)
-        output = output + inputs
-        return output, states
+        out, states = self.base_cell(inputs, states)
+        return out + inputs, states
 
-    def unroll(self, length, inputs, begin_state=None, layout='NTC',
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
                merge_outputs=None):
         self.reset()
         self.base_cell._modified = False
-        outputs, states = self.base_cell.unroll(length, inputs=inputs,
-                                                begin_state=begin_state,
-                                                layout=layout,
-                                                merge_outputs=merge_outputs)
-        self.base_cell._modified = True
+        try:
+            outputs, states = self.base_cell.unroll(
+                length, inputs=inputs, begin_state=begin_state, layout=layout,
+                merge_outputs=merge_outputs)
+        finally:
+            self.base_cell._modified = True
         if merge_outputs is None:
             merge_outputs = isinstance(outputs, Symbol)
-        inputs, _ = _normalize_sequence(length, inputs, layout, merge_outputs)
         if merge_outputs:
-            outputs = outputs + inputs
+            outputs = outputs + _to_merged(inputs, length, layout)
         else:
-            outputs = [o + i for o, i in zip(outputs, inputs)]
+            outputs = [o + x for o, x in
+                       zip(outputs, _to_steps(inputs, length, layout))]
         return outputs, states
 
 
 class BidirectionalCell(BaseRNNCell):
     """Runs one cell forward and one backward over the sequence and
-    concatenates the per-step outputs."""
+    concatenates per-step outputs on the feature axis."""
 
-    def __init__(self, l_cell, r_cell, params=None, output_prefix='bi_'):
-        super(BidirectionalCell, self).__init__('', params=params)
+    def __init__(self, l_cell, r_cell, params=None, output_prefix="bi_"):
+        super().__init__("", params=params)
         self._output_prefix = output_prefix
         self._override_cell_params = params is not None
         if self._override_cell_params:
-            assert l_cell._own_params and r_cell._own_params, \
-                "Either specify params for BidirectionalCell or child cells, not both."
+            if not (l_cell._own_params and r_cell._own_params):
+                raise MXNetError("specify params on BidirectionalCell or on "
+                                 "child cells, not both")
             l_cell.params._params.update(self.params._params)
             r_cell.params._params.update(self.params._params)
-        self.params._params.update(l_cell.params._params)
-        self.params._params.update(r_cell.params._params)
+        _adopt_params(self, l_cell, r_cell)
         self._cells = [l_cell, r_cell]
 
     def unpack_weights(self, args):
-        return _cells_unpack_weights(self._cells, args)
+        for cell in self._cells:
+            args = cell.unpack_weights(args)
+        return args
 
     def pack_weights(self, args):
-        return _cells_pack_weights(self._cells, args)
+        for cell in self._cells:
+            args = cell.pack_weights(args)
+        return args
 
     def __call__(self, inputs, states):
-        raise NotImplementedError("Bidirectional cannot be stepped. Please use unroll")
+        raise NotImplementedError(
+            "BidirectionalCell cannot be stepped; use unroll")
 
     @property
     def state_info(self):
-        return _cells_state_info(self._cells)
+        return [info for c in self._cells for info in c.state_info]
 
     def begin_state(self, **kwargs):
         assert not self._modified
-        return _cells_begin_state(self._cells, **kwargs)
+        return [s for c in self._cells for s in c.begin_state(**kwargs)]
 
-    def unroll(self, length, inputs, begin_state=None, layout='NTC',
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
                merge_outputs=None):
         self.reset()
-        inputs, axis = _normalize_sequence(length, inputs, layout, False)
-        if begin_state is None:
-            begin_state = self.begin_state()
+        init = self.begin_state() if begin_state is None else begin_state
+        fwd, bwd = self._cells
+        fwd_chunk, bwd_chunk = _split_states(init, self._cells)
+        t_ax = _time_axis(layout)
 
-        states = begin_state
-        l_cell, r_cell = self._cells
-        l_outputs, l_states = l_cell.unroll(length, inputs=inputs,
-                                            begin_state=states[:len(l_cell.state_info)],
-                                            layout=layout, merge_outputs=merge_outputs)
-        r_outputs, r_states = r_cell.unroll(length,
-                                            inputs=list(reversed(inputs)),
-                                            begin_state=states[len(l_cell.state_info):],
-                                            layout=layout, merge_outputs=merge_outputs)
-
-        if merge_outputs is None:
-            merge_outputs = (isinstance(l_outputs, Symbol)
-                             and isinstance(r_outputs, Symbol))
-            if not merge_outputs:
-                if isinstance(l_outputs, Symbol):
-                    l_outputs = list(symbol.SliceChannel(
-                        l_outputs, axis=1, num_outputs=length, squeeze_axis=1))
-                if isinstance(r_outputs, Symbol):
-                    r_outputs = list(symbol.SliceChannel(
-                        r_outputs, axis=1, num_outputs=length, squeeze_axis=1))
-
-        if merge_outputs:
-            r_outputs = symbol.reverse(r_outputs, axis=1)
-            outputs = symbol.Concat(l_outputs, r_outputs, dim=2,
-                                    name='%sout' % self._output_prefix)
+        # keep the input in its native form: a merged Symbol reverses with
+        # ONE reverse node (fused children then stay O(1) in graph size),
+        # a step list reverses as a list
+        if isinstance(inputs, Symbol):
+            bwd_in = symbol.reverse(inputs, axis=t_ax)
         else:
-            outputs = [symbol.Concat(l_o, r_o, dim=1,
-                                     name='%st%d' % (self._output_prefix, i))
-                       for i, (l_o, r_o) in enumerate(
-                           zip(l_outputs, reversed(r_outputs)))]
+            bwd_in = list(reversed(list(inputs)))
 
-        states = l_states + r_states
-        return outputs, states
+        # each direction unrolls in its natural/requested form; with
+        # merge_outputs=None the children's output form decides the result
+        # form (stepped cells yield lists, fused cells yield one Symbol)
+        fwd_out, fwd_states = fwd.unroll(length, inputs,
+                                         begin_state=fwd_chunk, layout=layout,
+                                         merge_outputs=merge_outputs)
+        bwd_out, bwd_states = bwd.unroll(length, bwd_in,
+                                         begin_state=bwd_chunk, layout=layout,
+                                         merge_outputs=merge_outputs)
+        if merge_outputs is None:
+            merge_outputs = (isinstance(fwd_out, Symbol)
+                             and isinstance(bwd_out, Symbol))
+        if merge_outputs:
+            # O(1) graph nodes: reverse the backward stream on the time axis
+            # and join the feature axes
+            bwd_rev = symbol.reverse(_to_merged(bwd_out, length, layout),
+                                     axis=t_ax)
+            outputs = symbol.Concat(_to_merged(fwd_out, length, layout),
+                                    bwd_rev, dim=2,
+                                    name=f"{self._output_prefix}out")
+        else:
+            outputs = [
+                symbol.Concat(f, b, dim=1, name=f"{self._output_prefix}t{t}")
+                for t, (f, b) in enumerate(
+                    zip(_to_steps(fwd_out, length, layout),
+                        reversed(_to_steps(bwd_out, length, layout))))]
+        return outputs, fwd_states + bwd_states
 
 
 def LSTMBiasInit(forget_bias):
-    """Initializer spec string for LSTM bias (forget gate set to forget_bias);
-    resolved lazily to avoid an import cycle with initializer.py."""
+    """Initializer spec for the stacked LSTM i2h bias (forget gate filled
+    with ``forget_bias``); resolved lazily to avoid an import cycle."""
     from ..initializer import LSTMBias
     return LSTMBias(forget_bias=forget_bias)

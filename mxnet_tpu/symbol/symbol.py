@@ -330,6 +330,9 @@ def Group(symbols: Sequence[Symbol]) -> Symbol:
 
 def load_json(json_str: str) -> Symbol:
     data = json.loads(json_str)
+    from ..interop import is_reference_symbol_json, symbol_from_reference_json
+    if is_reference_symbol_json(data):
+        return symbol_from_reference_json(data)
     nodes: List[_Node] = []
     for jn in data["nodes"]:
         op = None if jn["op"] == "null" else jn["op"]

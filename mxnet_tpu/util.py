@@ -14,7 +14,29 @@ import threading
 from typing import Callable
 
 __all__ = ["is_np_shape", "set_np_shape", "np_shape", "use_np_shape",
-           "makedirs", "getenv", "setenv", "get_gpu_count", "get_gpu_memory"]
+           "makedirs", "getenv", "setenv", "get_gpu_count", "get_gpu_memory",
+           "load_reference_params", "save_reference_params",
+           "load_reference_checkpoint"]
+
+
+def load_reference_params(fname: str):
+    """Load a reference-format binary ``.params`` file (name→NDArray dict,
+    ``arg:``/``aux:`` prefixes preserved). See :mod:`mxnet_tpu.interop`."""
+    from .interop import load_reference_params as _impl
+    return _impl(fname)
+
+
+def save_reference_params(fname: str, params) -> None:
+    """Write params in the reference's binary wire format."""
+    from .interop import save_reference_params as _impl
+    return _impl(fname, params)
+
+
+def load_reference_checkpoint(prefix: str, epoch: int):
+    """Reference ``prefix-symbol.json`` + ``prefix-NNNN.params`` →
+    (symbol, arg_params, aux_params)."""
+    from .interop import load_reference_checkpoint as _impl
+    return _impl(prefix, epoch)
 
 _state = threading.local()
 

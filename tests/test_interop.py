@@ -1,0 +1,286 @@
+"""Reference-format interop: binary .params wire format + legacy symbol JSON.
+
+The wire layouts asserted here are transcribed from the reference sources:
+NDArray records src/ndarray/ndarray.cc:1567-1765, list container :1767-1795,
+context include/mxnet/base.h:188-201, legacy symbol upgrades
+src/nnvm/legacy_json_util.cc. The exact-bytes test pins the format
+independently of our own writer so reader and writer can't drift together.
+"""
+import json
+import os
+import struct
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import interop
+from mxnet_tpu.base import MXNetError
+
+FIXDIR = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+# ------------------------------------------------------------------ helpers
+def v2_dense_record(arr, dev_type=1, dev_id=0):
+    """Hand-assemble one NDARRAY_V2 dense record, byte by byte."""
+    arr = np.ascontiguousarray(arr)
+    flag = {"float32": 0, "float64": 1, "float16": 2, "uint8": 3,
+            "int32": 4, "int8": 5, "int64": 6}[str(arr.dtype)]
+    out = struct.pack("<I", 0xF993FAC9)          # V2 magic
+    out += struct.pack("<i", 0)                  # stype dense
+    out += struct.pack("<I", arr.ndim)           # shape: uint32 ndim
+    out += struct.pack(f"<{arr.ndim}q", *arr.shape)  # int64 dims
+    out += struct.pack("<ii", dev_type, dev_id)  # Context
+    out += struct.pack("<i", flag)               # type flag
+    out += arr.tobytes()
+    return out
+
+
+def list_container(records, names):
+    out = struct.pack("<QQQ", 0x112, 0, len(records))
+    out += b"".join(records)
+    out += struct.pack("<Q", len(names))
+    for nm in names:
+        out += struct.pack("<Q", len(nm)) + nm.encode()
+    return out
+
+
+# ------------------------------------------------------------- wire format
+def test_writer_matches_hand_assembled_bytes(tmp_path):
+    w = np.asarray([[1.0, 2.0], [3.0, 4.0]], dtype="float32")
+    b = np.asarray([5.0, 6.0], dtype="float32")
+    fname = str(tmp_path / "two.params")
+    interop.save_reference_params(
+        fname, {"arg:w": mx.nd.array(w), "arg:b": mx.nd.array(b)})
+    expected = list_container(
+        [v2_dense_record(w), v2_dense_record(b)], ["arg:w", "arg:b"])
+    with open(fname, "rb") as f:
+        assert f.read() == expected
+
+
+def test_roundtrip_dtypes(tmp_path):
+    rng = np.random.RandomState(3)
+    params = {
+        "arg:f32": rng.randn(3, 4).astype("float32"),
+        "arg:f64": rng.randn(2).astype("float64"),
+        "arg:f16": rng.randn(5).astype("float16"),
+        "arg:u8": rng.randint(0, 255, (4,)).astype("uint8"),
+        "arg:i32": rng.randint(-9, 9, (2, 2)).astype("int32"),
+        "arg:i64": rng.randint(-9, 9, (3,)).astype("int64"),
+        "aux:i8": rng.randint(-9, 9, (3,)).astype("int8"),
+    }
+    fname = str(tmp_path / "rt.params")
+    # explicit dtype: mx.nd.array deliberately mirrors the reference's
+    # float32-default coercion, which would mask dtype fidelity here
+    interop.save_reference_params(
+        fname, {k: mx.nd.array(v, dtype=v.dtype) for k, v in params.items()})
+    out = interop.load_reference_params(fname)
+    assert set(out) == set(params)
+    for k, v in params.items():
+        got = out[k].asnumpy()
+        assert got.dtype == v.dtype and got.shape == v.shape
+        np.testing.assert_array_equal(got, v)
+
+
+def test_nd_load_autodetects_reference_format(tmp_path):
+    fname = str(tmp_path / "auto.params")
+    a = np.arange(6, dtype="float32").reshape(2, 3)
+    interop.save_reference_params(fname, {"x": mx.nd.array(a)})
+    loaded = mx.nd.load(fname)
+    assert isinstance(loaded, dict)
+    np.testing.assert_array_equal(loaded["x"].asnumpy(), a)
+
+
+def test_unnamed_list_and_gpu_context(tmp_path):
+    # names vector may be empty; context may be gpu(3) — both must load
+    a = np.asarray([7.0], dtype="float32")
+    raw = list_container([v2_dense_record(a, dev_type=2, dev_id=3)], [])
+    fname = str(tmp_path / "anon.params")
+    with open(fname, "wb") as f:
+        f.write(raw)
+    arrays, names = interop.load_reference_ndarrays(fname)
+    assert names == [] and len(arrays) == 1
+    np.testing.assert_array_equal(arrays[0].asnumpy(), a)
+
+
+def test_legacy_v1_and_prev1_records(tmp_path):
+    # V1: magic 0xF993fac8, no stype, int64 shape
+    a = np.asarray([1.5, -2.5], dtype="float32")
+    v1 = struct.pack("<I", 0xF993FAC8) + struct.pack("<I", 1)
+    v1 += struct.pack("<q", 2) + struct.pack("<ii", 1, 0)
+    v1 += struct.pack("<i", 0) + a.tobytes()
+    # pre-V1: leading uint32 IS the ndim, dims are uint32
+    b = np.arange(6, dtype="float32").reshape(2, 3)
+    pre = struct.pack("<I", 2) + struct.pack("<II", 2, 3)
+    pre += struct.pack("<ii", 1, 0) + struct.pack("<i", 0) + b.tobytes()
+    fname = str(tmp_path / "legacy.params")
+    with open(fname, "wb") as f:
+        f.write(list_container([v1, pre], ["v1", "pre"]))
+    out = interop.load_reference_params(fname)
+    np.testing.assert_array_equal(out["v1"].asnumpy(), a)
+    np.testing.assert_array_equal(out["pre"].asnumpy(), b)
+
+
+def test_sparse_records(tmp_path):
+    # row_sparse: aux = [indices]; storage shape = data shape
+    vals = np.asarray([[1.0, 2.0], [3.0, 4.0]], dtype="float32")
+    idx = np.asarray([0, 2], dtype="int64")
+    rs = struct.pack("<Ii", 0xF993FAC9, 1)               # magic, row_sparse
+    rs += struct.pack("<I2q", 2, 2, 2)                   # storage shape (2,2)
+    rs += struct.pack("<I2q", 2, 4, 2)                   # logical shape (4,2)
+    rs += struct.pack("<ii", 1, 0)                       # ctx
+    rs += struct.pack("<i", 0)                           # data float32
+    rs += struct.pack("<i", 6) + struct.pack("<I1q", 1, 2)  # aux int64,(2,)
+    rs += vals.tobytes() + idx.tobytes()
+
+    # csr: aux = [indptr, indices]
+    data = np.asarray([5.0, 7.0, 9.0], dtype="float32")
+    indptr = np.asarray([0, 1, 1, 3], dtype="int64")
+    indices = np.asarray([1, 0, 2], dtype="int64")
+    cs = struct.pack("<Ii", 0xF993FAC9, 2)
+    cs += struct.pack("<I1q", 1, 3)                      # storage shape (3,)
+    cs += struct.pack("<I2q", 2, 3, 3)                   # logical shape (3,3)
+    cs += struct.pack("<ii", 1, 0) + struct.pack("<i", 0)
+    cs += struct.pack("<i", 6) + struct.pack("<I1q", 1, 4)  # indptr
+    cs += struct.pack("<i", 6) + struct.pack("<I1q", 1, 3)  # indices
+    cs += data.tobytes() + indptr.tobytes() + indices.tobytes()
+
+    fname = str(tmp_path / "sparse.params")
+    with open(fname, "wb") as f:
+        f.write(list_container([rs, cs], ["rs", "cs"]))
+    out = interop.load_reference_params(fname)
+    dense_rs = np.zeros((4, 2), dtype="float32")
+    dense_rs[[0, 2]] = vals
+    np.testing.assert_array_equal(out["rs"].asnumpy(), dense_rs)
+    dense_cs = np.asarray([[0, 5, 0], [0, 0, 0], [7, 0, 9]], dtype="float32")
+    np.testing.assert_array_equal(out["cs"].asnumpy(), dense_cs)
+
+
+def test_truncated_file_raises(tmp_path):
+    a = np.ones((3,), dtype="float32")
+    raw = list_container([v2_dense_record(a)], ["x"])
+    fname = str(tmp_path / "trunc.params")
+    with open(fname, "wb") as f:
+        f.write(raw[: len(raw) // 2])
+    with pytest.raises(MXNetError):
+        interop.load_reference_params(fname)
+
+
+# ----------------------------------------------------- committed fixtures
+def test_committed_fixture_checkpoint_predicts():
+    """The committed reference-wire-format MLP checkpoint loads through the
+    public checkpoint API and predicts the pinned logits."""
+    prefix = os.path.join(FIXDIR, "refmlp")
+    sym, arg_params, aux_params = mx.util.load_reference_checkpoint(prefix, 0)
+    assert sorted(arg_params) == ["fc1_bias", "fc1_weight",
+                                  "fc2_bias", "fc2_weight"]
+    x = np.load(os.path.join(FIXDIR, "refmlp_input.npy"))
+    expected = np.load(os.path.join(FIXDIR, "refmlp_output.npy"))
+    ex = sym.bind(mx.cpu(), dict(arg_params, data=mx.nd.array(x)))
+    out = ex.forward()[0].asnumpy()
+    np.testing.assert_allclose(out, expected, rtol=1e-5, atol=1e-5)
+
+
+def test_committed_fixture_via_module():
+    prefix = os.path.join(FIXDIR, "refmlp")
+    sym, arg_params, aux_params = mx.model.load_checkpoint(prefix, 0)
+    mod = mx.mod.Module(sym, data_names=["data"], label_names=None)
+    x = np.load(os.path.join(FIXDIR, "refmlp_input.npy"))
+    mod.bind(data_shapes=[("data", x.shape)], for_training=False)
+    mod.set_params(arg_params, aux_params)
+    mod.forward(mx.io.DataBatch(data=[mx.nd.array(x)]), is_train=False)
+    out = mod.get_outputs()[0].asnumpy()
+    expected = np.load(os.path.join(FIXDIR, "refmlp_output.npy"))
+    np.testing.assert_allclose(out, expected, rtol=1e-5, atol=1e-5)
+
+
+# ------------------------------------------------------ legacy symbol JSON
+def _ref_mlp_json(era="1.0"):
+    """Reference-style graph JSON for data→FC(4)→relu→FC(3); attrs are
+    strings, key name varies by era, heads/inputs are [id, idx, version]."""
+    attr_key = {"1.0": "attrs", "0.9": "attr", "0.8": "param"}[era]
+    nodes = [
+        {"op": "null", "name": "data", "inputs": []},
+        {"op": "null", "name": "fc1_weight", "inputs": []},
+        {"op": "null", "name": "fc1_bias", "inputs": []},
+        {"op": "FullyConnected", "name": "fc1",
+         attr_key: {"num_hidden": "4"},
+         "inputs": [[0, 0, 0], [1, 0, 0], [2, 0, 0]]},
+        {"op": "Activation", "name": "relu1",
+         attr_key: {"act_type": "relu"}, "inputs": [[3, 0, 0]]},
+        {"op": "null", "name": "fc2_weight", "inputs": []},
+        {"op": "null", "name": "fc2_bias", "inputs": []},
+        {"op": "FullyConnected", "name": "fc2",
+         attr_key: {"num_hidden": "3"},
+         "inputs": [[4, 0, 0], [5, 0, 0], [6, 0, 0]]},
+    ]
+    doc = {"nodes": nodes, "arg_nodes": [0, 1, 2, 5, 6],
+           "node_row_ptr": list(range(len(nodes) + 1)),
+           "heads": [[7, 0, 0]],
+           "attrs": {"mxnet_version": ["int", 10400 if era == "1.0" else 900]}}
+    if era == "0.8":
+        doc.pop("attrs")
+        doc["heads"] = [[7, 0]]   # old 2-element heads
+        for n in doc["nodes"]:
+            n["inputs"] = [e[:2] for e in n["inputs"]]
+    return json.dumps(doc)
+
+
+@pytest.mark.parametrize("era", ["1.0", "0.9", "0.8"])
+def test_reference_symbol_json_eras(era):
+    sym = mx.sym.load_json(_ref_mlp_json(era))
+    args = sym.list_arguments()
+    assert args == ["data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias"]
+    rng = np.random.RandomState(0)
+    params = {
+        "fc1_weight": mx.nd.array(rng.randn(4, 5).astype("float32")),
+        "fc1_bias": mx.nd.zeros((4,)),
+        "fc2_weight": mx.nd.array(rng.randn(3, 4).astype("float32")),
+        "fc2_bias": mx.nd.zeros((3,)),
+    }
+    x = rng.randn(2, 5).astype("float32")
+    ex = sym.bind(mx.cpu(), dict(params, data=mx.nd.array(x)))
+    out = ex.forward()[0].asnumpy()
+    h = np.maximum(x @ params["fc1_weight"].asnumpy().T, 0)
+    expected = h @ params["fc2_weight"].asnumpy().T
+    np.testing.assert_allclose(out, expected, rtol=1e-5, atol=1e-5)
+
+
+def test_legacy_batchnorm_aux_inputs_recreated():
+    """Pre-0.9 JSON stored no aux-state inputs for BatchNorm
+    (UpgradeJSON_000800_000900) — they must be re-created on load."""
+    nodes = [
+        {"op": "null", "name": "data", "inputs": []},
+        {"op": "null", "name": "bn_gamma", "inputs": []},
+        {"op": "null", "name": "bn_beta", "inputs": []},
+        {"op": "BatchNorm", "name": "bn", "param": {},
+         "inputs": [[0, 0], [1, 0], [2, 0]]},
+    ]
+    sym = mx.sym.load_json(json.dumps(
+        {"nodes": nodes, "arg_nodes": [0, 1, 2], "heads": [[3, 0]]}))
+    assert "bn_moving_mean" in (sym.list_arguments()
+                                + sym.list_auxiliary_states())
+    assert "bn_moving_var" in (sym.list_arguments()
+                               + sym.list_auxiliary_states())
+
+
+def test_hidden_lr_mult_keys_rehomed():
+    """'weight_lr_mult'-style keys on an op node must move to the matching
+    variable (UpgradeJSON_FixParsing) instead of reaching the op."""
+    nodes = [
+        {"op": "null", "name": "data", "inputs": []},
+        {"op": "null", "name": "fc_weight", "inputs": []},
+        {"op": "null", "name": "fc_bias", "inputs": []},
+        {"op": "FullyConnected", "name": "fc",
+         "attr": {"num_hidden": "2", "weight_lr_mult": "0.5"},
+         "inputs": [[0, 0, 0], [1, 0, 0], [2, 0, 0]]},
+    ]
+    sym = mx.sym.load_json(json.dumps(
+        {"nodes": nodes, "arg_nodes": [0, 1, 2], "heads": [[3, 0, 0]]}))
+    x = mx.nd.ones((1, 3))
+    ex = sym.bind(mx.cpu(), {"data": x, "fc_weight": mx.nd.ones((2, 3)),
+                             "fc_bias": mx.nd.zeros((2,))})
+    out = ex.forward()[0].asnumpy()   # op must not choke on the hidden key
+    np.testing.assert_allclose(out, [[3.0, 3.0]], rtol=1e-6)
+    weight_node = [n for n in sym.topo_nodes() if n.name == "fc_weight"][0]
+    assert weight_node.attrs.get("__lr_mult__") == "0.5"
