@@ -313,14 +313,15 @@ def symbol_from_reference_json(json_str_or_dict: Union[str, dict]):
                         break
                 break
 
-        # UpgradeJSON_000800_000900: re-create dropped aux inputs
+        # UpgradeJSON_000800_000900: re-create dropped aux inputs. The new
+        # variables are wired in as inputs only — they must NOT be appended
+        # to `nodes`, which mirrors the JSON's id->node indexing
         if op in _AUX_INPUT_NAMES and version < 900:
             want = _AUX_INPUT_NAMES[op]
             missing = [n for n in want
                        if not any(s.name.endswith(n) for s, _ in node.inputs)]
             for aux_name in missing:
                 var = _Node(None, f"{node.name}_{aux_name}", {}, [])
-                nodes.append(var)
                 node.inputs.append((var, 0))
 
         # UpgradeJSON_000904_000905: optionalized argmin/argmax axis

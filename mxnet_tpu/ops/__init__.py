@@ -17,3 +17,5 @@ from . import random_ops     # noqa: F401
 from . import rnn            # noqa: F401
 from . import linalg         # noqa: F401
 from . import multibox       # noqa: F401
+from . import contrib_ops    # noqa: F401
+from . import ctc            # noqa: F401

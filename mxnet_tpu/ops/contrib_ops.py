@@ -1,0 +1,505 @@
+"""Detection / signal / sketch contrib operators.
+
+Reference parity (semantics, not structure):
+- ROIPooling          src/operator/roi_pooling.cc (rounded coords, max pool)
+- ROIAlign            src/operator/contrib/roi_align.cc (bilinear, avg pool)
+- Proposal/MultiProposal  src/operator/contrib/proposal.cc (anchors + NMS)
+- DeformableConvolution   src/operator/contrib/deformable_convolution.cc
+- Correlation         src/operator/correlation.cc (FlowNet cost volume)
+- fft / ifft          src/operator/contrib/fft.cc (interleaved re/im layout,
+                      unnormalized inverse — out/d equals numpy ifft)
+- count_sketch        src/operator/contrib/count_sketch.cc
+- AdaptiveAvgPooling2D    src/operator/contrib/adaptive_avg_pooling.cc
+
+TPU-first notes: everything here is static-shaped and vectorized — bin
+reductions become masked max/mean or small matmuls (MXU-friendly), deformable
+sampling becomes four gathers + interpolation weights (differentiable w.r.t.
+data and offsets), NMS is a fixed-trip-count lax.fori_loop, and the
+displacement grid of Correlation unrolls into static shifted products.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+from ..base import MXNetError
+
+
+# ---------------------------------------------------------------- ROIPooling
+@register("ROIPooling", arg_names=("data", "rois"))
+def _roi_pooling(data, rois, pooled_size=(1, 1), spatial_scale=1.0):
+    """Max-pool each ROI into a fixed (ph, pw) grid with the reference's
+    rounded-coordinate bins (roi_pooling.cc:54-106)."""
+    ph, pw = int(pooled_size[0]), int(pooled_size[1])
+    n, c, height, width = data.shape
+    batch_idx = rois[:, 0].astype(jnp.int32)
+    # reference rounds the scaled corners and uses inclusive extents
+    x1 = jnp.round(rois[:, 1] * spatial_scale).astype(jnp.int32)
+    y1 = jnp.round(rois[:, 2] * spatial_scale).astype(jnp.int32)
+    x2 = jnp.round(rois[:, 3] * spatial_scale).astype(jnp.int32)
+    y2 = jnp.round(rois[:, 4] * spatial_scale).astype(jnp.int32)
+    roi_h = jnp.maximum(y2 - y1 + 1, 1)
+    roi_w = jnp.maximum(x2 - x1 + 1, 1)
+
+    def bounds(start, roi_sz, count, limit):
+        # exact integer floor/ceil of i*roi_sz/count: immune to the float32
+        # boundary rounding that XLA fusion can flip (the C++ float path is
+        # itself inconsistent between eager/fused evaluation there)
+        i = jnp.arange(count, dtype=jnp.int32)           # (P,)
+        lo = (i[None, :] * roi_sz[:, None]) // count + start[:, None]
+        hi = (((i[None, :] + 1) * roi_sz[:, None] + count - 1) // count
+              + start[:, None])
+        return (jnp.clip(lo, 0, limit), jnp.clip(hi, 0, limit))
+
+    h_lo, h_hi = bounds(y1, roi_h, ph, height)           # (R, ph)
+    w_lo, w_hi = bounds(x1, roi_w, pw, width)            # (R, pw)
+    hs = jnp.arange(height, dtype=jnp.int32)
+    ws = jnp.arange(width, dtype=jnp.int32)
+    mask_h = ((hs[None, None, :] >= h_lo[:, :, None])
+              & (hs[None, None, :] < h_hi[:, :, None]))  # (R, ph, H)
+    mask_w = ((ws[None, None, :] >= w_lo[:, :, None])
+              & (ws[None, None, :] < w_hi[:, :, None]))  # (R, pw, W)
+
+    per_roi = jnp.take(data, batch_idx, axis=0)          # (R, C, H, W)
+    neg = jnp.finfo(data.dtype).min
+    # two-stage masked max keeps peak memory at O(R*C*H*pw), not O(...*W)
+    tmp = jnp.where(mask_w[:, None, None, :, :], per_roi[:, :, :, None, :],
+                    neg).max(axis=-1)                    # (R, C, H, pw)
+    out = jnp.where(mask_h[:, None, :, None, :],         # (R, 1, ph, 1, H)
+                    tmp.swapaxes(2, 3)[:, :, None, :, :],  # (R, C, 1, pw, H)
+                    neg).max(axis=-1)                    # (R, C, ph, pw)
+    # empty bins (all-false mask) produce -inf -> reference writes 0
+    empty = ((~mask_h.any(-1))[:, None, :, None]
+             | (~mask_w.any(-1))[:, None, None, :])
+    return jnp.where(empty, jnp.zeros((), data.dtype), out)
+
+
+# ----------------------------------------------------------------- ROIAlign
+def _bilinear_gather(img, y, x):
+    """Sample img (C, H, W) at float coords y/x (...,) with bilinear weights
+    and zero padding outside; differentiable in img AND coords."""
+    c, h, w = img.shape
+    valid = (y > -1.0) & (y < h) & (x > -1.0) & (x < w)
+    y = jnp.clip(y, 0.0, h - 1.0)
+    x = jnp.clip(x, 0.0, w - 1.0)
+    y0 = jnp.floor(y)
+    x0 = jnp.floor(x)
+    y1 = jnp.minimum(y0 + 1, h - 1.0)
+    x1 = jnp.minimum(x0 + 1, w - 1.0)
+    wy1 = y - y0
+    wx1 = x - x0
+    flat = img.reshape(c, -1)
+
+    def at(yy, xx):
+        idx = (yy * w + xx).astype(jnp.int32).reshape(-1)
+        return jnp.take(flat, idx, axis=1).reshape((c,) + y.shape)
+
+    val = ((1 - wy1) * (1 - wx1) * at(y0, x0) + (1 - wy1) * wx1 * at(y0, x1)
+           + wy1 * (1 - wx1) * at(y1, x0) + wy1 * wx1 * at(y1, x1))
+    return jnp.where(valid, val, 0.0)
+
+
+@register("_contrib_ROIAlign", aliases=["ROIAlign"],
+          arg_names=("data", "rois"))
+def _roi_align(data, rois, pooled_size=(1, 1), spatial_scale=1.0,
+               sample_ratio=-1, position_sensitive=False, aligned=False):
+    """Average-pooled bilinear ROI sampling (roi_align.cc). With
+    ``sample_ratio <= 0`` the reference picks an adaptive per-roi grid; XLA
+    needs a static count, so we use 2 samples per bin axis (the detectron
+    default) in that case."""
+    if position_sensitive:
+        raise MXNetError("position_sensitive ROIAlign not supported yet")
+    ph, pw = int(pooled_size[0]), int(pooled_size[1])
+    grid = int(sample_ratio) if int(sample_ratio) > 0 else 2
+    offset = 0.5 if aligned else 0.0
+
+    batch_idx = rois[:, 0].astype(jnp.int32)
+    x1 = rois[:, 1] * spatial_scale - offset
+    y1 = rois[:, 2] * spatial_scale - offset
+    x2 = rois[:, 3] * spatial_scale - offset
+    y2 = rois[:, 4] * spatial_scale - offset
+    roi_w = x2 - x1
+    roi_h = y2 - y1
+    if not aligned:
+        roi_w = jnp.maximum(roi_w, 1.0)
+        roi_h = jnp.maximum(roi_h, 1.0)
+    bin_h = roi_h / ph
+    bin_w = roi_w / pw
+
+    iy = (jnp.arange(grid) + 0.5) / grid                 # (g,)
+    py = jnp.arange(ph)
+    px = jnp.arange(pw)
+    # sample coords: (R, ph, g)
+    ys = (y1[:, None, None] + (py[None, :, None] + iy[None, None, :])
+          * bin_h[:, None, None])
+    xs = (x1[:, None, None] + (px[None, :, None] + iy[None, None, :])
+          * bin_w[:, None, None])
+
+    def one_roi(img, ys_r, xs_r):
+        yy = ys_r[:, :, None, None]                      # (ph, g, 1, 1)
+        xx = xs_r[None, None, :, :]                      # (1, 1, pw, g)
+        vals = _bilinear_gather(img, jnp.broadcast_to(
+            yy, (ph, grid, pw, grid)), jnp.broadcast_to(
+            xx, (ph, grid, pw, grid)))                   # (C, ph, g, pw, g)
+        return vals.mean(axis=(2, 4))                    # (C, ph, pw)
+
+    per_roi = jnp.take(data, batch_idx, axis=0)          # (R, C, H, W)
+    return jax.vmap(one_roi)(per_roi, ys, xs)
+
+
+# ----------------------------------------------------------------- Proposal
+def _make_anchors(feature_stride, scales, ratios):
+    """Reference anchor enumeration (rcnn/proposal generate_anchors): start
+    from the stride-sized box, enumerate ratios with rounded w/h, then
+    scales."""
+    base = jnp.asarray([0.0, 0.0, feature_stride - 1.0, feature_stride - 1.0])
+    w = base[2] - base[0] + 1
+    h = base[3] - base[1] + 1
+    cx = base[0] + 0.5 * (w - 1)
+    cy = base[1] + 0.5 * (h - 1)
+    anchors = []
+    for r in ratios:
+        ws = jnp.round(jnp.sqrt(w * h / r))
+        hs = jnp.round(ws * r)
+        for s in scales:
+            ws_s, hs_s = ws * s, hs * s
+            anchors.append(jnp.stack([cx - 0.5 * (ws_s - 1),
+                                      cy - 0.5 * (hs_s - 1),
+                                      cx + 0.5 * (ws_s - 1),
+                                      cy + 0.5 * (hs_s - 1)]))
+    return jnp.stack(anchors)                            # (A, 4)
+
+
+def _decode_bbox(anchors, deltas):
+    """Apply (dx, dy, dw, dh) regression deltas (bbox_transform_inv)."""
+    w = anchors[:, 2] - anchors[:, 0] + 1.0
+    h = anchors[:, 3] - anchors[:, 1] + 1.0
+    cx = anchors[:, 0] + 0.5 * (w - 1.0)
+    cy = anchors[:, 1] + 0.5 * (h - 1.0)
+    ncx = deltas[:, 0] * w + cx
+    ncy = deltas[:, 1] * h + cy
+    nw = jnp.exp(deltas[:, 2]) * w
+    nh = jnp.exp(deltas[:, 3]) * h
+    return jnp.stack([ncx - 0.5 * (nw - 1), ncy - 0.5 * (nh - 1),
+                      ncx + 0.5 * (nw - 1), ncy + 0.5 * (nh - 1)], axis=1)
+
+
+def _iou_matrix(boxes):
+    area = ((boxes[:, 2] - boxes[:, 0] + 1.0)
+            * (boxes[:, 3] - boxes[:, 1] + 1.0))
+    lt = jnp.maximum(boxes[:, None, :2], boxes[None, :, :2])
+    rb = jnp.minimum(boxes[:, None, 2:], boxes[None, :, 2:])
+    wh = jnp.maximum(rb - lt + 1.0, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    return inter / (area[:, None] + area[None, :] - inter)
+
+
+def _greedy_nms(boxes, scores, iou_threshold, keep_n):
+    """Fixed-trip-count greedy NMS: returns indices of kept boxes (padded by
+    repeating the last kept index) — XLA-friendly, no dynamic shapes."""
+    order = jnp.argsort(-scores)
+    boxes = boxes[order]
+    iou = _iou_matrix(boxes)
+    n = boxes.shape[0]
+
+    def body(i, alive):
+        # if box i still alive, suppress everything it overlaps
+        suppress = (iou[i] > iou_threshold) & (jnp.arange(n) > i)
+        return jnp.where(alive[i], alive & ~suppress, alive)
+
+    alive = lax.fori_loop(0, n, body, jnp.ones((n,), bool))
+    # stable-select up to keep_n alive indices
+    rank = jnp.cumsum(alive) - 1                          # position if alive
+    slots = jnp.where(alive, rank, n)
+    picked = jnp.full((keep_n,), n, dtype=jnp.int32)
+    picked = picked.at[jnp.clip(slots, 0, keep_n - 1)].min(
+        jnp.arange(n, dtype=jnp.int32), mode="drop")
+    # pad empty slots with the best box
+    picked = jnp.where(picked == n, picked[0], picked)
+    return order[picked], alive.sum()
+
+
+def _proposal_single(cls_prob, bbox_pred, im_info, anchors, feature_stride,
+                     pre_nms, post_nms, threshold, min_size):
+    a = anchors.shape[0]
+    height, width = cls_prob.shape[-2:]
+    # shift anchors over the feature grid
+    sx = jnp.arange(width) * feature_stride
+    sy = jnp.arange(height) * feature_stride
+    shifts = jnp.stack(jnp.meshgrid(sx, sy), axis=-1).reshape(-1, 2)
+    shifts = jnp.tile(shifts, (1, 2)).astype(cls_prob.dtype)  # (HW, 4)
+    all_anchors = (anchors[None, :, :] + shifts[:, None, :]).reshape(-1, 4)
+
+    scores = cls_prob[a:].reshape(a, -1).T.reshape(-1)    # fg scores, (HW*A,)
+    # deltas come as (4A, H, W) -> (HW*A, 4)
+    deltas = bbox_pred.reshape(a, 4, height * width).transpose(2, 0, 1)
+    deltas = deltas.reshape(-1, 4)
+    props = _decode_bbox(all_anchors, deltas)
+    # clip to image
+    props = jnp.stack([jnp.clip(props[:, 0], 0, im_info[1] - 1.0),
+                       jnp.clip(props[:, 1], 0, im_info[0] - 1.0),
+                       jnp.clip(props[:, 2], 0, im_info[1] - 1.0),
+                       jnp.clip(props[:, 3], 0, im_info[0] - 1.0)], axis=1)
+    ws = props[:, 2] - props[:, 0] + 1.0
+    hs = props[:, 3] - props[:, 1] + 1.0
+    min_sz = min_size * im_info[2]
+    small = (ws < min_sz) | (hs < min_sz)
+    # FilterBox (proposal.cc:145-158): grow too-small boxes by min_size/2 on
+    # every side AND sink their score — the grown extents still take part in
+    # NMS suppression
+    grow = jnp.where(small, min_sz / 2.0, 0.0)[:, None] * \
+        jnp.asarray([-1.0, -1.0, 1.0, 1.0], props.dtype)[None, :]
+    props = props + grow
+    scores = jnp.where(small, -1.0, scores)
+
+    k = min(pre_nms, scores.shape[0])
+    top_scores, top_idx = lax.top_k(scores, k)
+    keep, _ = _greedy_nms(props[top_idx], top_scores, threshold, post_nms)
+    rois = props[top_idx][keep]
+    return rois, top_scores[keep]
+
+
+def _as_floats(v):
+    if isinstance(v, (int, float)):
+        return (float(v),)
+    return tuple(float(x) for x in v)
+
+
+@register("_contrib_Proposal", aliases=["Proposal"], differentiable=False,
+          num_outputs=1, arg_names=("cls_prob", "bbox_pred", "im_info"))
+def _proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
+              rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+              scales=(4, 8, 16, 32), ratios=(0.5, 1, 2), feature_stride=16,
+              output_score=False, iou_loss=False):
+    """RPN proposal layer (proposal.cc): decode anchors, clip, filter small,
+    greedy NMS, emit (post_nms_top_n, 5) rois with batch index 0."""
+    anchors = _make_anchors(feature_stride, _as_floats(scales),
+                            _as_floats(ratios)).astype(cls_prob.dtype)
+    rois, scores = _proposal_single(
+        cls_prob[0], bbox_pred[0], im_info[0], anchors, feature_stride,
+        int(rpn_pre_nms_top_n), int(rpn_post_nms_top_n), float(threshold),
+        float(rpn_min_size))
+    rois = jnp.concatenate(
+        [jnp.zeros((rois.shape[0], 1), rois.dtype), rois], axis=1)
+    if output_score:
+        return rois, scores[:, None]
+    return rois
+
+
+@register("_contrib_MultiProposal", aliases=["MultiProposal"],
+          differentiable=False,
+          arg_names=("cls_prob", "bbox_pred", "im_info"))
+def _multi_proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
+                    rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+                    scales=(4, 8, 16, 32), ratios=(0.5, 1, 2),
+                    feature_stride=16, output_score=False, iou_loss=False):
+    """Batched Proposal (multi_proposal.cc) via vmap over images."""
+    anchors = _make_anchors(feature_stride, _as_floats(scales),
+                            _as_floats(ratios)).astype(cls_prob.dtype)
+    fn = functools.partial(
+        _proposal_single, anchors=anchors, feature_stride=feature_stride,
+        pre_nms=int(rpn_pre_nms_top_n), post_nms=int(rpn_post_nms_top_n),
+        threshold=float(threshold), min_size=float(rpn_min_size))
+    rois, scores = jax.vmap(fn)(cls_prob, bbox_pred, im_info)  # (N, P, 4)
+    n, p, _ = rois.shape
+    batch = jnp.broadcast_to(
+        jnp.arange(n, dtype=rois.dtype)[:, None, None], (n, p, 1))
+    rois = jnp.concatenate([batch, rois], axis=2).reshape(n * p, 5)
+    if output_score:
+        return rois, scores.reshape(n * p, 1)
+    return rois
+
+
+# ------------------------------------------------- DeformableConvolution
+@register("_contrib_DeformableConvolution",
+          aliases=["DeformableConvolution"],
+          arg_names=("data", "offset", "weight", "bias"))
+def _deformable_convolution(data, offset, weight, bias=None, kernel=(1, 1),
+                            stride=(1, 1), dilate=(1, 1), pad=(0, 0),
+                            num_filter=1, num_group=1,
+                            num_deformable_group=1, no_bias=False,
+                            workspace=1024, layout=None):
+    """Deformable conv v1 (deformable_convolution.cc): each kernel tap reads
+    the input at a learned fractional offset. Lowered as kh*kw bilinear
+    gathers building an im2col tensor, then one big matmul (MXU)."""
+    kh, kw = int(kernel[0]), int(kernel[1])
+    sh, sw = int(stride[0]), int(stride[1])
+    dh, dw = int(dilate[0]), int(dilate[1])
+    ph, pw = int(pad[0]), int(pad[1])
+    n, c, h, w = data.shape
+    oh = (h + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    ow = (w + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+    dg = int(num_deformable_group)
+    if c % dg or offset.shape[1] != 2 * kh * kw * dg:
+        raise MXNetError("offset channels must be 2*kh*kw*num_deformable_group")
+
+    base_y = (jnp.arange(oh) * sh - ph).astype(data.dtype)  # (oh,)
+    base_x = (jnp.arange(ow) * sw - pw).astype(data.dtype)
+    off = offset.reshape(n, dg, kh * kw, 2, oh, ow)
+
+    cols = []
+    for t in range(kh * kw):
+        u, v = divmod(t, kw)
+        # sampling coords per deform group: (N, dg, oh, ow)
+        yy = base_y[None, None, :, None] + u * dh + off[:, :, t, 0]
+        xx = base_x[None, None, None, :] + v * dw + off[:, :, t, 1]
+        img = data.reshape(n, dg, c // dg, h, w)
+
+        def sample(img_g, y_g, x_g):                     # over (N, dg)
+            return _bilinear_gather(img_g, y_g, x_g)     # (c/dg, oh, ow)
+
+        tap = jax.vmap(jax.vmap(sample))(img, yy, xx)    # (N, dg, c/dg, oh, ow)
+        cols.append(tap.reshape(n, c, oh, ow))
+    col = jnp.stack(cols, axis=2)                        # (N, C, kh*kw, oh, ow)
+
+    f = int(num_filter)
+    g = int(num_group)
+    wmat = weight.reshape(g, f // g, (c // g) * kh * kw)
+    col_g = col.reshape(n, g, (c // g) * kh * kw, oh * ow)
+    out = jnp.einsum("gfk,ngko->ngfo", wmat, col_g,
+                     preferred_element_type=jnp.float32)
+    out = out.reshape(n, f, oh, ow).astype(data.dtype)
+    if not no_bias and bias is not None:
+        out = out + bias[None, :, None, None]
+    return out
+
+
+# -------------------------------------------------------------- Correlation
+@register("Correlation", num_outputs=1, arg_names=("data1", "data2"))
+def _correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
+                 stride2=1, pad_size=0, is_multiply=True):
+    """FlowNet cost volume (correlation.cc): for every displacement in the
+    stride2 grid, a channel-summed (product|abs-diff) map, box-filtered by
+    kernel_size and sampled on the stride1 grid; normalized by
+    kernel_size^2 * channels."""
+    k = int(kernel_size)
+    md = int(max_displacement)
+    s1, s2 = int(stride1), int(stride2)
+    p = int(pad_size)
+    kr = (k - 1) // 2
+    border = md + kr
+    n, c, h, w = data1.shape
+    hp, wp = h + 2 * p, w + 2 * p
+    top_h = int(math.ceil((hp - border * 2) / s1))
+    top_w = int(math.ceil((wp - border * 2) / s1))
+    if top_h < 1 or top_w < 1:
+        raise MXNetError("Correlation: displacement/kernel larger than input")
+    grid_r = md // s2
+    grid = 2 * grid_r + 1
+    sumelems = k * k * c
+
+    f1 = jnp.pad(data1, ((0, 0), (0, 0), (p, p), (p, p)))
+    f2 = jnp.pad(data2, ((0, 0), (0, 0), (p, p), (p, p)))
+
+    maps = []
+    for dy in range(-grid_r, grid_r + 1):
+        for dx in range(-grid_r, grid_r + 1):
+            oy, ox = dy * s2, dx * s2
+            # shift f2 by (oy, ox) with zero fill
+            shifted = jnp.roll(f2, (-oy, -ox), axis=(2, 3))
+            ys = jnp.arange(hp) + oy
+            xs = jnp.arange(wp) + ox
+            valid = ((ys >= 0) & (ys < hp))[None, None, :, None] & \
+                    ((xs >= 0) & (xs < wp))[None, None, None, :]
+            shifted = jnp.where(valid, shifted, 0.0)
+            prod = (f1 * shifted if is_multiply
+                    else jnp.abs(f1 - shifted)).sum(axis=1)   # (N, Hp, Wp)
+            # box-filter around each stride1 center inside the border
+            lo = border - kr
+            span_h = (top_h - 1) * s1 + k
+            span_w = (top_w - 1) * s1 + k
+            region = lax.dynamic_slice(
+                prod, (0, lo, lo), (n, span_h, span_w))
+            summed = lax.reduce_window(
+                region, 0.0, lax.add, (1, k, k), (1, s1, s1), "VALID")
+            maps.append(summed / sumelems)
+    return jnp.stack(maps, axis=1)                       # (N, grid^2, th, tw)
+
+
+# ------------------------------------------------------------------ fft/ifft
+@register("_contrib_fft", aliases=["fft"])
+def _fft(data, compute_size=128):
+    """FFT along the last axis; output interleaves re/im so the last dim
+    doubles (fft.cc output layout)."""
+    z = jnp.fft.fft(data.astype(jnp.complex64), axis=-1)
+    out = jnp.stack([jnp.real(z), jnp.imag(z)], axis=-1)
+    return out.reshape(data.shape[:-1] + (2 * data.shape[-1],)).astype(
+        data.dtype)
+
+
+@register("_contrib_ifft", aliases=["ifft"])
+def _ifft(data, compute_size=128):
+    """Unnormalized inverse FFT of interleaved re/im input: the last dim
+    halves and out/d equals numpy's normalized ifft (reference test
+    tests/python/gpu/test_operator_gpu.py:96-140)."""
+    d = data.shape[-1] // 2
+    pairs = data.reshape(data.shape[:-1] + (d, 2))
+    z = lax.complex(pairs[..., 0], pairs[..., 1])
+    return (jnp.real(jnp.fft.ifft(z, axis=-1)) * d).astype(data.dtype)
+
+
+# -------------------------------------------------------------- count_sketch
+@register("_contrib_count_sketch", aliases=["count_sketch"],
+          arg_names=("data", "h", "s"))
+def _count_sketch(data, h, s, out_dim=None, processing_batch_size=32):
+    """Count-sketch projection (count_sketch.cc): out[n, h[i]] += s[i]*x[n,i].
+    One scatter-add — differentiable w.r.t. data through the scatter."""
+    if out_dim is None:
+        raise MXNetError("count_sketch requires out_dim")
+    hv = h.reshape(-1).astype(jnp.int32)
+    sv = s.reshape(-1).astype(data.dtype)
+    signed = data * sv[None, :]
+    out = jnp.zeros((data.shape[0], int(out_dim)), data.dtype)
+    return out.at[:, hv].add(signed, mode="drop")
+
+
+# ---------------------------------------------------- AdaptiveAvgPooling2D
+def _adaptive_matrix(in_sz, out_sz, dtype):
+    """(out, in) averaging matrix: row i covers [floor(i*I/O), ceil((i+1)I/O))."""
+    i = jnp.arange(out_sz)
+    lo = jnp.floor(i * in_sz / out_sz)
+    hi = jnp.ceil((i + 1) * in_sz / out_sz)
+    pos = jnp.arange(in_sz)
+    mask = ((pos[None, :] >= lo[:, None])
+            & (pos[None, :] < hi[:, None])).astype(dtype)
+    return mask / mask.sum(axis=1, keepdims=True)
+
+
+@register("_contrib_AdaptiveAvgPooling2D", aliases=["AdaptiveAvgPooling2D"])
+def _adaptive_avg_pooling(data, output_size=None):
+    """Adaptive average pooling to a fixed output grid, expressed as two
+    small matmuls (adaptive_avg_pooling.cc; MXU-friendly form)."""
+    if output_size is None or output_size == ():
+        oh = ow = 1
+    elif isinstance(output_size, int):
+        oh = ow = int(output_size)
+    else:
+        pair = tuple(output_size)
+        oh, ow = (int(pair[0]), int(pair[-1]))
+    ah = _adaptive_matrix(data.shape[2], oh, data.dtype)
+    aw = _adaptive_matrix(data.shape[3], ow, data.dtype)
+    return jnp.einsum("ih,nchw,jw->ncij", ah, data, aw)
+
+
+# ----------------------------------------------------------- BilinearResize2D
+@register("_contrib_BilinearResize2D", aliases=["BilinearResize2D"])
+def _bilinear_resize(data, height=None, width=None, scale_height=None,
+                     scale_width=None, mode="size"):
+    """Bilinear resize with ALIGN-CORNERS sampling like the reference
+    (bilinear_resize.cc:67: rscale = (in-1)/(out-1), output corners land on
+    input corners) — jax.image.resize's half-pixel convention differs."""
+    oh = int(height) if height else int(data.shape[2] * float(scale_height))
+    ow = int(width) if width else int(data.shape[3] * float(scale_width))
+    h, w = data.shape[2], data.shape[3]
+    ry = (h - 1.0) / (oh - 1.0) if oh > 1 else 0.0
+    rx = (w - 1.0) / (ow - 1.0) if ow > 1 else 0.0
+    yy = jnp.arange(oh, dtype=data.dtype) * ry            # (oh,)
+    xx = jnp.arange(ow, dtype=data.dtype) * rx
+    grid_y = jnp.broadcast_to(yy[:, None], (oh, ow))
+    grid_x = jnp.broadcast_to(xx[None, :], (oh, ow))
+    return jax.vmap(lambda img: _bilinear_gather(img, grid_y, grid_x))(data)

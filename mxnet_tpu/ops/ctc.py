@@ -1,0 +1,66 @@
+"""Connectionist temporal classification loss operator.
+
+Reference parity: ``src/operator/nn/ctc_loss.cc`` / ``ctc_loss-inl.h`` —
+input layout (T, N, C), optional ``data_lengths``/``label_lengths`` inputs,
+``blank_label`` first (0, labels 1..C-1, 0-padding) or last (C-1, labels
+0..C-2, -1 padding). Output is the per-sequence negative log likelihood
+(N,).
+
+TPU-first: the log-domain forward recursion is optax.ctc_loss — a
+lax.scan the XLA compiler pipelines; the gradient comes from jax autodiff
+of the same recursion (the reference's warp-ctc/baidu kernels have no
+equivalent here and need none).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+from ..base import MXNetError
+
+
+def _ctc_nll(logits_tnc, labels, data_lengths, label_lengths, blank_first):
+    """(T,N,C) logits → (N,) negative log likelihood."""
+    import optax  # optional dep: only needed when CTC actually runs
+    t, n, c = logits_tnc.shape
+    logits = jnp.swapaxes(logits_tnc, 0, 1)              # optax wants (N,T,C)
+    labels = labels.astype(jnp.int32)
+    if labels.ndim != 2 or labels.shape[0] != n:
+        raise MXNetError(f"CTC label shape {labels.shape} != (batch, max_len)")
+
+    if data_lengths is None:
+        logit_pad = jnp.zeros((n, t), logits.dtype)
+    else:
+        steps = jnp.arange(t)[None, :]
+        logit_pad = (steps >= data_lengths.reshape(n, 1)).astype(logits.dtype)
+
+    if label_lengths is None:
+        # implicit padding marker: 0 when blank is first, <0 when last
+        pad_mask = (labels <= 0) if blank_first else (labels < 0)
+    else:
+        pos = jnp.arange(labels.shape[1])[None, :]
+        pad_mask = pos >= label_lengths.reshape(n, 1)
+    label_pad = pad_mask.astype(logits.dtype)
+
+    if blank_first:
+        blank_id = 0
+        labels = jnp.where(pad_mask, 0, labels)
+    else:
+        blank_id = c - 1
+        labels = jnp.where(pad_mask, 0, labels)
+    return optax.ctc_loss(logits, logit_pad, labels, label_pad,
+                          blank_id=blank_id)
+
+
+@register("CTCLoss", aliases=["ctc_loss", "_contrib_CTCLoss",
+                              "_contrib_ctc_loss"],
+          arg_names=("data", "label", "data_lengths", "label_lengths"))
+def _ctc_loss(data, label, data_lengths=None, label_lengths=None,
+              use_data_lengths=False, use_label_lengths=False,
+              blank_label="first"):
+    if not use_data_lengths:
+        data_lengths = None
+    if not use_label_lengths:
+        label_lengths = None
+    return _ctc_nll(data, label, data_lengths, label_lengths,
+                    blank_first=(blank_label == "first"))

@@ -67,6 +67,7 @@ _unary("erf", jax.lax.erf)
 _unary("erfinv", jax.lax.erf_inv)
 _unary("gamma", lambda x: jnp.exp(jax.lax.lgamma(x)))
 _unary("gammaln", jax.lax.lgamma)
+_unary("digamma", jax.lax.digamma)
 _unary("sigmoid", jax.nn.sigmoid)
 _unary("softsign", lambda x: x / (1.0 + jnp.abs(x)))
 _unary("relu", jax.nn.relu)

@@ -132,3 +132,20 @@ def _sequence_reverse(data, sequence_length=None, use_sequence_length=False, axi
     moved = data  # (seq, batch, ...)
     batch = jnp.arange(data.shape[1])[None, :]
     return moved[src, batch]
+
+
+@register("_unravel_index", aliases=["unravel_index"], differentiable=False)
+def _unravel_index_op(data, shape=None):
+    """Flat indices -> coordinate rows: output (ndim,) + data.shape
+    (reference src/operator/tensor/ravel.cc)."""
+    coords = jnp.unravel_index(data.astype(jnp.int64), tuple(shape))
+    return jnp.stack(coords).astype(data.dtype)
+
+
+@register("_ravel_multi_index", aliases=["ravel_multi_index"],
+          differentiable=False)
+def _ravel_multi_index_op(data, shape=None):
+    """Coordinate rows (ndim, n) -> flat indices (n,) (ravel.cc)."""
+    coords = tuple(data[i].astype(jnp.int64) for i in range(data.shape[0]))
+    return jnp.ravel_multi_index(coords, tuple(shape), mode="clip").astype(
+        data.dtype)

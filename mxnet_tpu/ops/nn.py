@@ -491,3 +491,38 @@ def _flash_attention_op(query, key, value, causal=False, scale=None,
     return flash_attention(query, key, value, causal=bool(causal),
                            scale=None if scale is None else float(scale),
                            q_offset=int(q_offset), k_offset=int(k_offset))
+
+
+@register("SVMOutput", arg_names=("data", "label"))
+def _svm_output(data, label, margin=1.0, regularization_coefficient=1.0,
+                use_linear=False):
+    """Hinge-loss output layer (reference src/operator/svm_output.cc):
+    forward is identity on the scores; backward writes the L1 (use_linear)
+    or squared hinge gradient directly, via jax.custom_vjp like
+    SoftmaxOutput."""
+
+    @jax.custom_vjp
+    def _svm(d, l):
+        return d
+
+    def _fwd(d, l):
+        return d, (d, l)
+
+    def _bwd(res, g):
+        d, l = res
+        k = l.reshape(-1).astype(jnp.int32)
+        is_true = jax.nn.one_hot(k, d.shape[1], dtype=bool, axis=-1)
+        reg = regularization_coefficient
+        if use_linear:
+            # L1_SVM (svm_output.cc:31-47)
+            g_true = -(margin > d).astype(d.dtype) * reg
+            g_other = (margin > -d).astype(d.dtype) * reg
+        else:
+            # L2_SVM (svm_output.cc:50-66)
+            g_true = -2.0 * jnp.maximum(margin - d, 0.0) * reg
+            g_other = 2.0 * jnp.maximum(margin + d, 0.0) * reg
+        grad = jnp.where(is_true, g_true, g_other)
+        return grad, jnp.zeros_like(l)
+
+    _svm.defvjp(_fwd, _bwd)
+    return _svm(data, label)

@@ -246,6 +246,38 @@ def test_reference_symbol_json_eras(era):
     np.testing.assert_allclose(out, expected, rtol=1e-5, atol=1e-5)
 
 
+def test_legacy_batchnorm_upgrade_keeps_node_ids_intact():
+    """Nodes AFTER an upgraded BatchNorm must still resolve their input ids
+    against the JSON's indexing (regression: aux vars must not be appended
+    to the id-indexed node list)."""
+    nodes = [
+        {"op": "null", "name": "data", "inputs": []},
+        {"op": "null", "name": "bn_gamma", "inputs": []},
+        {"op": "null", "name": "bn_beta", "inputs": []},
+        {"op": "BatchNorm", "name": "bn", "param": {},
+         "inputs": [[0, 0], [1, 0], [2, 0]]},
+        {"op": "null", "name": "fc_weight", "inputs": []},
+        {"op": "null", "name": "fc_bias", "inputs": []},
+        {"op": "FullyConnected", "name": "fc", "param": {"num_hidden": "2"},
+         "inputs": [[3, 0], [4, 0], [5, 0]]},
+    ]
+    sym = mx.sym.load_json(json.dumps(
+        {"nodes": nodes, "arg_nodes": [0, 1, 2, 4, 5], "heads": [[6, 0]]}))
+    args = sym.list_arguments()
+    # fc must be wired to fc_weight/fc_bias, and the head must be fc itself
+    assert "fc_weight" in args and "fc_bias" in args
+    head_names = [n.name for n, _ in sym._outputs]
+    assert head_names == ["fc"]
+    x = mx.nd.array(np.ones((2, 3), "float32"))
+    ex = sym.bind(mx.cpu(), {
+        "data": x, "bn_gamma": mx.nd.ones((3,)), "bn_beta": mx.nd.zeros((3,)),
+        "bn_moving_mean": mx.nd.zeros((3,)), "bn_moving_var": mx.nd.ones((3,)),
+        "fc_weight": mx.nd.ones((2, 3)), "fc_bias": mx.nd.zeros((2,))},
+        aux_states=None)
+    out = ex.forward(is_train=False)[0]
+    assert out.shape == (2, 2)
+
+
 def test_legacy_batchnorm_aux_inputs_recreated():
     """Pre-0.9 JSON stored no aux-state inputs for BatchNorm
     (UpgradeJSON_000800_000900) — they must be re-created on load."""
