@@ -31,11 +31,26 @@ def _sym():
     return symbol
 
 
+def _sym_pads(attrs, nd):
+    """ONNX pads are [begin_0..begin_nd, end_0..end_nd]; our ops take one
+    symmetric pad per spatial dim, so asymmetric padding must be rejected,
+    not silently truncated."""
+    pads = tuple(int(p) for p in attrs.get("pads", ()))
+    if not pads:
+        return ()
+    begin, end = pads[:nd], pads[nd:]
+    if tuple(begin) != tuple(end):
+        raise MXNetError(
+            f"ONNX import: asymmetric padding {pads} is not supported; "
+            "only symmetric begin/end pads map onto the pad= attribute")
+    return begin
+
+
 @register("Conv")
 def _conv(name, ins, attrs, st):
     kw = dict(kernel=tuple(attrs["kernel_shape"]),
               stride=tuple(attrs.get("strides", ())) or None,
-              pad=tuple(attrs.get("pads", ())[:len(attrs["kernel_shape"])]),
+              pad=_sym_pads(attrs, len(attrs["kernel_shape"])),
               dilate=tuple(attrs.get("dilations", ())) or None,
               num_group=int(attrs.get("group", 1)),
               num_filter=st["shapes"][ins[1].name][0],
@@ -48,7 +63,7 @@ def _conv(name, ins, attrs, st):
 def _deconv(name, ins, attrs, st):
     kw = dict(kernel=tuple(attrs["kernel_shape"]),
               stride=tuple(attrs.get("strides", ())) or None,
-              pad=tuple(attrs.get("pads", ())[:len(attrs["kernel_shape"])]),
+              pad=_sym_pads(attrs, len(attrs["kernel_shape"])),
               num_group=int(attrs.get("group", 1)),
               num_filter=st["shapes"][ins[1].name][1],
               no_bias=len(ins) == 2)
@@ -82,7 +97,7 @@ def _pool_kw(attrs):
     kernel = tuple(attrs["kernel_shape"])
     return dict(kernel=kernel,
                 stride=tuple(attrs.get("strides", ())) or (1,) * len(kernel),
-                pad=tuple(attrs.get("pads", ())[:len(kernel)]))
+                pad=_sym_pads(attrs, len(kernel)))
 
 
 @register("MaxPool")

@@ -148,6 +148,10 @@ class TensorProto:
         dtype = ONNX_TO_DTYPE[self.data_type]
         if self.raw_data:
             arr = np.frombuffer(self.raw_data, dtype=dtype)
+        elif self.data_type == 10:
+            # float16 typed storage holds raw uint16 bit patterns in
+            # int32_data, not numeric values
+            arr = np.asarray(self._typed_data, dtype=np.uint16).view(np.float16)
         else:
             arr = np.asarray(self._typed_data, dtype=dtype)
         return arr.reshape(self.dims)

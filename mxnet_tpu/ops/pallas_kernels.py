@@ -322,14 +322,15 @@ def flash_attention_with_lse(q, k, v, causal: bool = False,
 # fused softmax cross-entropy
 # ---------------------------------------------------------------------------
 
-def _ce_kernel(logits_ref, labels_ref, loss_ref):
+def _ce_kernel(logits_ref, lse_ref):
+    # labels stay OUTSIDE the kernel: a (bn, 1) int32 tile is a shape Mosaic
+    # may refuse to legalize, and the label gather is a cheap XLA gather the
+    # compiler fuses with the subtraction anyway. Only the reduction that
+    # would otherwise materialize softmax lives here.
     x = logits_ref[...].astype(jnp.float32)              # (bn, C)
     m = jnp.max(x, axis=1, keepdims=True)
     lse = jnp.log(jnp.sum(jnp.exp(x - m), axis=1, keepdims=True)) + m
-    cls = lax.broadcasted_iota(jnp.int32, x.shape, 1)
-    lbl = labels_ref[...]                                # (bn, 1) int32
-    picked = jnp.sum(jnp.where(cls == lbl, x, 0.0), axis=1, keepdims=True)
-    loss_ref[...] = jnp.broadcast_to(lse - picked, loss_ref.shape)
+    lse_ref[...] = jnp.broadcast_to(lse, lse_ref.shape)
 
 
 @jax.custom_vjp
@@ -347,15 +348,17 @@ def _ce_fwd(logits, labels):
     labels = labels.astype(jnp.int32)
     if use_pallas() and C % 128 == 0 and N % 8 == 0:
         bn = min(256, N)
-        loss = pl.pallas_call(
+        lse = pl.pallas_call(
             _ce_kernel,
             grid=(pl.cdiv(N, bn),),
-            in_specs=[pl.BlockSpec((bn, C), lambda i: (i, 0)),
-                      pl.BlockSpec((bn, 1), lambda i: (i, 0))],
+            in_specs=[pl.BlockSpec((bn, C), lambda i: (i, 0))],
             out_specs=pl.BlockSpec((bn, 128), lambda i: (i, 0)),
             out_shape=jax.ShapeDtypeStruct((N, 128), jnp.float32),
             interpret=_interpret(),
-        )(logits, labels[:, None])[:, 0]
+        )(logits)[:, 0]
+        picked = jnp.take_along_axis(
+            logits.astype(jnp.float32), labels[:, None], axis=1)[:, 0]
+        loss = lse - picked
     else:
         x = logits.astype(jnp.float32)
         lse = jax.nn.logsumexp(x, axis=1)

@@ -107,17 +107,27 @@ def get_stored_subgraph(idx: int) -> Symbol:
     return _SUBGRAPH_STORE[idx]
 
 
-@_register_op("_subgraph", num_outputs=lambda attrs: int(attrs.get("num_out", 1)))
+_LOWERED_SUBGRAPHS: Dict[tuple, object] = {}
+
+
+@_register_op("_subgraph", needs_rng=True,
+              num_outputs=lambda attrs: int(attrs.get("num_out", 1)))
 def _subgraph_exec(*inputs, subgraph_id=0, num_out=1, input_names=(),
-                   is_train=False):
+                   is_train=False, rng=None):
     """Execute a partitioned region as one lowered XLA computation."""
     from .executor import _GraphLowering
     import jax
 
-    sym = get_stored_subgraph(int(subgraph_id))
-    fn = _GraphLowering(sym).lower(is_train=bool(is_train))
+    cache_key = (int(subgraph_id), bool(is_train))
+    fn = _LOWERED_SUBGRAPHS.get(cache_key)
+    if fn is None:
+        sym = get_stored_subgraph(int(subgraph_id))
+        fn = _GraphLowering(sym).lower(is_train=bool(is_train))
+        _LOWERED_SUBGRAPHS[cache_key] = fn
     feed = dict(zip(input_names, inputs))
-    outs, _ = fn(feed, jax.random.PRNGKey(0))
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    outs, _ = fn(feed, rng)
     return tuple(outs) if len(outs) > 1 else outs[0]
 
 

@@ -111,6 +111,14 @@ def make_record(args, lst_path):
     print(f"{prefix}.rec: {cnt} records")
 
 
+def _str2bool(v):
+    if v.lower() in ("1", "true", "yes", "y"):
+        return True
+    if v.lower() in ("0", "false", "no", "n"):
+        return False
+    raise argparse.ArgumentTypeError(f"boolean value expected, got {v!r}")
+
+
 def parse_args(argv=None):
     p = argparse.ArgumentParser(
         description="Create an image list or RecordIO file")
@@ -119,7 +127,9 @@ def parse_args(argv=None):
     p.add_argument("--list", action="store_true",
                    help="create list instead of record")
     p.add_argument("--recursive", action="store_true")
-    p.add_argument("--shuffle", type=bool, default=True)
+    p.add_argument("--shuffle", type=_str2bool, nargs="?", const=True,
+                   default=True,
+                   help="shuffle the list (--shuffle False to disable)")
     p.add_argument("--test-ratio", type=float, default=0.0)
     p.add_argument("--train-ratio", type=float, default=1.0)
     p.add_argument("--resize", type=int, default=0)
