@@ -44,7 +44,7 @@ class Context:
         if kind in ("cpu", "cpu_pinned", "cpu_shared"):
             devs = _devices_of("cpu")
             if not devs:  # cpu backend always exists in practice
-                devs = jax.devices()
+                devs = jax.local_devices()
             return devs[min(self.device_id, len(devs) - 1)]
         # gpu is an alias for "the accelerator" so reference scripts with
         # ctx=mx.gpu() run unchanged on TPU hosts.
@@ -89,14 +89,17 @@ class Context:
 
 
 def _devices_of(platform: str):
+    """PROCESS-LOCAL devices: like the reference, a worker's Context
+    addresses its own devices — under jax.distributed the global list
+    contains other hosts' devices, which are not addressable here."""
     try:
-        return jax.devices(platform)
+        return jax.local_devices(backend=platform)
     except RuntimeError:
         return []
 
 
 def _accelerator_devices():
-    devs = [d for d in jax.devices() if d.platform != "cpu"]
+    devs = [d for d in jax.local_devices() if d.platform != "cpu"]
     return devs or _devices_of("cpu")
 
 
@@ -119,7 +122,7 @@ def cpu_pinned(device_id: int = 0) -> Context:
 
 
 def num_gpus() -> int:
-    return len([d for d in jax.devices() if d.platform != "cpu"])
+    return len([d for d in jax.local_devices() if d.platform != "cpu"])
 
 
 def num_tpus() -> int:
@@ -132,7 +135,7 @@ def current_context() -> Context:
         # default to the accelerator if one exists, else cpu — unlike the
         # reference (default cpu), because on a TPU host that is always what
         # the user means; tests pin JAX_PLATFORMS=cpu so this stays cpu there.
-        accel = [d for d in jax.devices() if d.platform != "cpu"]
+        accel = [d for d in jax.local_devices() if d.platform != "cpu"]
         ctx = Context("tpu", 0) if accel else Context("cpu", 0)
         Context._default_ctx.value = ctx
     return ctx

@@ -71,6 +71,13 @@ class Trainer:
             for i, p in enumerate(self._params):
                 if p.grad_req != "null":
                     self._kvstore.init(i, p.data())
+            # pull initial weights back so every worker starts from the
+            # store's (rank 0's) values — reference trainer does the same
+            # after init (trainer.py:168+)
+            if self._kvstore.num_workers > 1:
+                for i, p in enumerate(self._params):
+                    if p.grad_req != "null":
+                        self._kvstore.pull(i, p.list_data(), priority=-i)
         self._kv_initialized = True
 
     @property
@@ -102,13 +109,18 @@ class Trainer:
     def _allreduce_grads(self) -> None:
         if self._kvstore is None:
             return
-        for i, p in enumerate(self._params):
-            if p.grad_req != "null":
-                # priority=-i preserves the reference's overlap ordering
-                self._kvstore.push(i, p.list_grad(), priority=-i)
-                if not self._update_on_kvstore:
-                    self._kvstore.pull(i, p.list_grad(), priority=-i,
-                                       ignore_sparse=False)
+        # two-phase like the reference's aggregated NCCL path
+        # (model.py:130-148): queue every push first so the store can bucket
+        # them (MXNET_UPDATE_AGGREGATION_SIZE), then pull.
+        live = [(i, p) for i, p in enumerate(self._params)
+                if p.grad_req != "null"]
+        for i, p in live:
+            # priority=-i preserves the reference's overlap ordering
+            self._kvstore.push(i, p.list_grad(), priority=-i)
+        if not self._update_on_kvstore:
+            for i, p in live:
+                self._kvstore.pull(i, p.list_grad(), priority=-i,
+                                   ignore_sparse=False)
 
     def update(self, batch_size: int, ignore_stale_grad: bool = False) -> None:
         if not self._kv_initialized:

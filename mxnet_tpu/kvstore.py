@@ -55,6 +55,8 @@ class KVStore:
         self._optimizer = None
         self._store: Dict[Any, NDArray] = {}
         self._compression_params = None
+        # (priority, seq, key, [per-device arrays]) awaiting dispatch
+        self._pending: List[tuple] = []
 
     # ------------------------------------------------------------- data plane
     def init(self, key, value) -> None:
@@ -66,19 +68,52 @@ class KVStore:
                                                      else v[0])))
 
     def push(self, key, value, priority: int = 0) -> None:
+        """Enqueue a push. Like the reference (which schedules pushes on the
+        async engine with a priority hint, model.py:150-160), push returns
+        immediately; the reduce is dispatched at the next flush point (pull/
+        barrier/state IO) in priority order, aggregated into buckets of
+        MXNET_UPDATE_AGGREGATION_SIZE tensors fused into one XLA computation
+        each."""
         keys, values = _key_value(key, value)
         for k, vlist in zip(keys, values):
             if not isinstance(vlist, list):
                 vlist = [vlist]
-            merged = self._reduce([_unwrap(v) for v in vlist])
-            merged = self._global_reduce(merged, k)
-            if self._updater is not None:
-                # server-side optimizer semantics (update_on_kvstore=True)
-                self._updater(k, _wrap(merged), self._store[k])
-            else:
-                self._store[k]._set_data(merged)
+            if k not in self._store:
+                raise MXNetError(f"key {k} was not init'd")
+            self._pending.append((priority, len(self._pending), k,
+                                  [_unwrap(v) for v in vlist]))
+
+    def _flush(self) -> None:
+        """Dispatch pending pushes: highest priority first (ties keep push
+        order), in fused buckets (reference MXNET_UPDATE_AGGREGATION_SIZE,
+        model.py:130-148)."""
+        if not self._pending:
+            return
+        pending, self._pending = self._pending, []
+        # priority orders DISTINCT keys; same-key pushes must keep issue
+        # order (the reference serializes them through the key's engine
+        # write var regardless of priority hint) — so every entry of a key
+        # sorts with the key's first-seen priority, and the stable sort
+        # preserves seq order within the key
+        key_prio: Dict[Any, int] = {}
+        for prio, _, k, _ in pending:
+            key_prio.setdefault(k, prio)
+        pending.sort(key=lambda t: (-key_prio[t[2]], t[1]))
+        agg = max(1, int(get_env("MXNET_UPDATE_AGGREGATION_SIZE", 4)))
+        for start in range(0, len(pending), agg):
+            bucket = pending[start:start + agg]
+            merged_list = _fused_bucket_sum(tuple(tuple(v) for _, _, _, v
+                                                  in bucket))
+            for (prio, _, k, _), merged in zip(bucket, merged_list):
+                merged = self._global_reduce(merged, k)
+                if self._updater is not None:
+                    # server-side optimizer semantics (update_on_kvstore=True)
+                    self._updater(k, _wrap(merged), self._store[k])
+                else:
+                    self._store[k]._set_data(merged)
 
     def pull(self, key, out=None, priority: int = 0, ignore_sparse: bool = True):
+        self._flush()
         keys, outs = _key_value(key, out)
         for k, olist in zip(keys, outs):
             if k not in self._store:
@@ -98,6 +133,7 @@ class KVStore:
         Dense emulation: gather(rows) of the stored value."""
         if row_ids is None:
             raise MXNetError("row_sparse_pull requires row_ids")
+        self._flush()
         keys, outs = _key_value(key, out)
         rid_list = row_ids if isinstance(row_ids, list) else [row_ids]
         for k, olist in zip(keys, outs):
@@ -111,13 +147,6 @@ class KVStore:
                 o._set_data(full)
 
     # ------------------------------------------------------------- reduction
-    def _reduce(self, arrays: List) -> Any:
-        """Fused multi-array sum — one XLA computation regardless of arity
-        (replaces CommCPU's OMP tree / CommDevice P2P ring, comm.h:103,451)."""
-        if len(arrays) == 1:
-            return arrays[0]
-        return _fused_sum(tuple(arrays))
-
     def _global_reduce(self, merged, key):
         return merged  # single-host: nothing to do
 
@@ -141,7 +170,13 @@ class KVStore:
 
     def set_gradient_compression(self, compression_params: Dict) -> None:
         # ICI bandwidth makes 2-bit compression unnecessary (SURVEY.md §2.3);
-        # accepted for API parity, stored for introspection.
+        # accepted for API parity but pushes stay dense — warn rather than
+        # silently dropping the request.
+        import warnings
+        warnings.warn(
+            "gradient compression is a no-op on this backend: pushes ride "
+            "ICI/DCN collectives at full precision (see README de-scopes)",
+            stacklevel=2)
         self._compression_params = dict(compression_params)
 
     # ------------------------------------------------------------- topology
@@ -154,15 +189,17 @@ class KVStore:
         return 1
 
     def barrier(self) -> None:
-        pass
+        self._flush()
 
     def save_optimizer_states(self, fname: str, dump_optimizer: bool = False) -> None:
+        self._flush()
         if getattr(self, "_raw_updater", None) is None:
             raise MXNetError("no optimizer set on kvstore")
         with open(fname, "wb") as f:
             f.write(self._raw_updater.get_states(dump_optimizer))
 
     def load_optimizer_states(self, fname: str) -> None:
+        self._flush()   # pending grads must consume the OLD state
         if getattr(self, "_raw_updater", None) is None:
             raise MXNetError("no optimizer set on kvstore")
         with open(fname, "rb") as f:
@@ -180,6 +217,7 @@ class KVStoreDist(KVStore):
 
     def __init__(self, name: str):
         super().__init__(name)
+        _maybe_join_cluster()
         self._nprocs = jax.process_count()
         self._rank = jax.process_index()
 
@@ -191,6 +229,21 @@ class KVStoreDist(KVStore):
     def num_workers(self) -> int:
         return self._nprocs
 
+    def init(self, key, value) -> None:
+        """Init + broadcast: rank 0's value wins everywhere, so workers with
+        independently-initialized params start in lockstep (the reference's
+        workers pull server-held initial weights after init,
+        kvstore_dist.h:217-246)."""
+        super().init(key, value)
+        if self._nprocs == 1:
+            return
+        keys, _ = _key_value(key, value)
+        from jax.experimental import multihost_utils
+        for k in keys:
+            v = self._store[k]._data
+            self._store[k]._set_data(
+                jnp.asarray(multihost_utils.broadcast_one_to_all(v)))
+
     def _global_reduce(self, merged, key):
         if self._nprocs == 1:
             return merged
@@ -198,6 +251,7 @@ class KVStoreDist(KVStore):
         return collectives.cross_process_allreduce(merged)
 
     def barrier(self) -> None:
+        self._flush()
         if self._nprocs > 1:
             from jax.experimental import multihost_utils
             multihost_utils.sync_global_devices("mxnet_tpu_kvstore_barrier")
@@ -205,22 +259,63 @@ class KVStoreDist(KVStore):
 
 # ----------------------------------------------------------------- helpers
 import functools
+import os
+
+_cluster_joined = False
 
 
-@functools.lru_cache(maxsize=512)
-def _fused_sum_compiled(n: int, shape, dtype):
-    def f(*xs):
-        out = xs[0]
-        for x in xs[1:]:
-            out = out + x
-        return out
+def _maybe_join_cluster() -> None:
+    """Join the jax.distributed cluster from the env set by tools/launch.py
+    (reference: the dmlc tracker exports DMLC_* and every worker's kvstore
+    ctor calls ps::StartAsync, kvstore_dist.h:47-67). Makes
+    ``create('dist_sync')`` work unchanged under ``launch.py -n N``."""
+    global _cluster_joined
+    coord = os.environ.get("JAX_COORDINATOR_ADDRESS") \
+        or os.environ.get("MXNET_COORDINATOR_ADDRESS")
+    nprocs = os.environ.get("JAX_NUM_PROCESSES")
+    pid = os.environ.get("JAX_PROCESS_ID")
+    if _cluster_joined or not (coord and nprocs and pid):
+        return
+    # must not touch the backend (process_count()/devices() would initialize
+    # it and make initialize() below illegal) — probe the distributed client
+    # state directly
+    from jax._src import distributed as _jdist
+    if getattr(_jdist.global_state, "client", None) is not None:
+        _cluster_joined = True
+        return
+    jax.distributed.initialize(coordinator_address=coord,
+                               num_processes=int(nprocs),
+                               process_id=int(pid))
+    _cluster_joined = True
+
+
+@functools.lru_cache(maxsize=256)
+def _bucket_sum_compiled(sig):
+    """One jitted computation summing every key's device list in a bucket —
+    replaces CommCPU's OMP tree / CommDevice P2P ring (comm.h:103,451) and
+    the aggregated dispatch the reference gets from batching engine pushes
+    (kvstore_nccl.h MXNET_UPDATE_AGGREGATION_SIZE)."""
+    arities = tuple(n for n, _, _ in sig)
+
+    def f(*flat):
+        out, i = [], 0
+        for n in arities:
+            group = flat[i:i + n]
+            i += n
+            acc = group[0]
+            for x in group[1:]:
+                acc = acc + x
+            out.append(acc)
+        return tuple(out)
+
     return jax.jit(f)
 
 
-def _fused_sum(arrays):
-    fn = _fused_sum_compiled(len(arrays), tuple(arrays[0].shape),
-                             str(arrays[0].dtype))
-    return fn(*arrays)
+def _fused_bucket_sum(groups):
+    """groups: tuple of per-key tuples of arrays → list of merged arrays."""
+    sig = tuple((len(g), tuple(g[0].shape), str(g[0].dtype)) for g in groups)
+    flat = [x for g in groups for x in g]
+    return list(_bucket_sum_compiled(sig)(*flat))
 
 
 def _key_value(keys, values):

@@ -184,6 +184,12 @@ class Module(BaseModule):
                 kv.set_optimizer(self._optimizer)
             for i, name in enumerate(self._param_names):
                 kv.init(i, ex.arg_dict[name])
+            # pull initial weights back so every dist worker starts from
+            # the store's (rank 0's) values — reference _initialize_kvstore
+            # pulls right after init (model.py:100-128)
+            if kv.num_workers > 1:
+                for i, name in enumerate(self._param_names):
+                    kv.pull(i, ex.arg_dict[name], priority=-i)
         self.optimizer_initialized = True
 
     # ------------------------------------------------------------- exec
@@ -202,20 +208,22 @@ class Module(BaseModule):
         _update_params_on_kvstore: push grads, pull weights)."""
         assert self.binded and self.params_initialized and self.optimizer_initialized
         ex = self._exec_group.execs[0]
+        # two-phase push-then-pull so the kvstore aggregates dispatches
+        # (reference _update_params_on_kvstore_nccl, model.py:130-148)
+        live = [(i, name, ex.grad_dict[name])
+                for i, name in enumerate(self._param_names)
+                if ex.grad_dict.get(name) is not None]
         if self._kvstore is not None and self._update_on_kvstore:
-            for i, name in enumerate(self._param_names):
-                grad = ex.grad_dict.get(name)
-                if grad is None:
-                    continue
+            for i, name, grad in live:
                 self._kvstore.push(i, grad, priority=-i)
+            for i, name, grad in live:
                 self._kvstore.pull(i, ex.arg_dict[name], priority=-i)
         else:
             if self._kvstore is not None:
-                for i, name in enumerate(self._param_names):
-                    grad = ex.grad_dict.get(name)
-                    if grad is not None:
-                        self._kvstore.push(i, grad, priority=-i)
-                        self._kvstore.pull(i, grad, priority=-i)
+                for i, name, grad in live:
+                    self._kvstore.push(i, grad, priority=-i)
+                for i, name, grad in live:
+                    self._kvstore.pull(i, grad, priority=-i)
             for i, name in enumerate(self._param_names):
                 grad = ex.grad_dict.get(name)
                 if grad is not None:

@@ -76,11 +76,13 @@ def psum_arrays(arrays: Sequence, mesh: Mesh, axis: str = "dp") -> List:
 
 def cross_process_allreduce(x):
     """Sum an identical-shaped host-local array across processes (the
-    dist_sync push path). Uses a global 1-axis mesh over all devices."""
+    dist_sync push path). Gathers on a new leading axis (tiled concat — the
+    stacking path rejects multi-host arrays) and reduces it."""
     if jax.process_count() == 1:
         return x
     from jax.experimental import multihost_utils
-    return multihost_utils.process_allgather(x).sum(axis=0)
+    gathered = multihost_utils.process_allgather(x[None], tiled=True)
+    return jnp.asarray(gathered).sum(axis=0)
 
 
 def bucketed_allreduce(grads: List, mesh: Mesh, axis: str = "dp",

@@ -197,3 +197,35 @@ def test_shard_gluon_params(rng):
     assert len(shardings) == 4
     for p in net.collect_params().values():
         assert p.sharding is not None
+
+
+def test_kvstore_aggregated_priority_dispatch(rng, monkeypatch):
+    """Pushes queue until a flush point, then dispatch highest-priority
+    first in buckets of MXNET_UPDATE_AGGREGATION_SIZE (reference
+    model.py:130-160 aggregated NCCL path)."""
+    import mxnet_tpu.kvstore as kv_mod
+
+    buckets = []
+    real = kv_mod._fused_bucket_sum
+
+    def spy(groups):
+        buckets.append(len(groups))
+        return real(groups)
+
+    monkeypatch.setattr(kv_mod, "_fused_bucket_sum", spy)
+    monkeypatch.setenv("MXNET_UPDATE_AGGREGATION_SIZE", "4")
+
+    kv = mx.kv.create("local")
+    for i in range(10):
+        kv.init(i, nd.zeros((2, 2)))
+    for i in range(10):
+        kv.push(i, nd.ones((2, 2)) * (i + 1), priority=-i)
+    assert buckets == []          # nothing dispatched yet
+    out = nd.zeros((2, 2))
+    kv.pull(0, out=out)           # flush point
+    assert buckets == [4, 4, 2]   # 10 keys in aggregation-size buckets
+    np.testing.assert_allclose(out.asnumpy(), np.ones((2, 2)))
+    for i in range(1, 10):
+        kv.pull(i, out=out)
+        np.testing.assert_allclose(out.asnumpy(),
+                                   np.full((2, 2), float(i + 1)))
