@@ -35,6 +35,17 @@ from .attribute import AttrScope
 from . import name
 from . import attribute
 
+# Under tools/launch.py the coordinator env trio is set: join the
+# jax.distributed cluster NOW, before anything can initialize the XLA
+# backend (the reference's ps-lite StartAsync happens equally early via the
+# tracker env). No-op outside a launched job.
+import os as _os
+if _os.environ.get("JAX_COORDINATOR_ADDRESS") \
+        or _os.environ.get("MXNET_COORDINATOR_ADDRESS"):
+    from .kvstore import _maybe_join_cluster as _join
+    _join()
+    del _join
+
 # Submodules imported lazily to keep import light and avoid cycles.
 import importlib as _importlib
 

@@ -104,8 +104,11 @@ class KVStore:
             bucket = pending[start:start + agg]
             merged_list = _fused_bucket_sum(tuple(tuple(v) for _, _, _, v
                                                   in bucket))
+            # ONE cross-process collective per bucket, not per key — this is
+            # where the aggregation actually reaches the network
+            merged_list = self._global_reduce_bucket(
+                merged_list, [k for _, _, k, _ in bucket])
             for (prio, _, k, _), merged in zip(bucket, merged_list):
-                merged = self._global_reduce(merged, k)
                 if self._updater is not None:
                     # server-side optimizer semantics (update_on_kvstore=True)
                     self._updater(k, _wrap(merged), self._store[k])
@@ -147,17 +150,19 @@ class KVStore:
                 o._set_data(full)
 
     # ------------------------------------------------------------- reduction
-    def _global_reduce(self, merged, key):
-        return merged  # single-host: nothing to do
+    def _global_reduce_bucket(self, merged_list, keys):
+        return merged_list  # single-host: nothing to do
 
     # ------------------------------------------------------------- control
     def set_updater(self, updater: Callable) -> None:
+        self._flush()   # earlier pushes keep their pre-updater semantics
         self._updater = updater
 
     def set_optimizer(self, optimizer) -> None:
         """Run the optimizer inside the store (reference ships a pickled
         optimizer to servers via the 'optimizer' control command,
         kvstore_dist_server.h:206-227)."""
+        self._flush()   # earlier pushes keep their pre-updater semantics
         from . import optimizer as opt_mod
         self._optimizer = optimizer
         updater = opt_mod.get_updater(optimizer)
@@ -244,11 +249,11 @@ class KVStoreDist(KVStore):
             self._store[k]._set_data(
                 jnp.asarray(multihost_utils.broadcast_one_to_all(v)))
 
-    def _global_reduce(self, merged, key):
+    def _global_reduce_bucket(self, merged_list, keys):
         if self._nprocs == 1:
-            return merged
+            return merged_list
         from .parallel import collectives
-        return collectives.cross_process_allreduce(merged)
+        return collectives.cross_process_allreduce_many(merged_list)
 
     def barrier(self) -> None:
         self._flush()
@@ -283,9 +288,16 @@ def _maybe_join_cluster() -> None:
     if getattr(_jdist.global_state, "client", None) is not None:
         _cluster_joined = True
         return
-    jax.distributed.initialize(coordinator_address=coord,
-                               num_processes=int(nprocs),
-                               process_id=int(pid))
+    try:
+        jax.distributed.initialize(coordinator_address=coord,
+                                   num_processes=int(nprocs),
+                                   process_id=int(pid))
+    except RuntimeError as e:
+        raise MXNetError(
+            "cannot join the distributed cluster: the XLA backend was "
+            "already initialized by earlier array work. Create the dist "
+            "kvstore (or import mxnet_tpu under tools/launch.py, which "
+            "joins at import) before any computation.") from e
     _cluster_joined = True
 
 

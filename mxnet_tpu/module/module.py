@@ -224,10 +224,8 @@ class Module(BaseModule):
                     self._kvstore.push(i, grad, priority=-i)
                 for i, name, grad in live:
                     self._kvstore.pull(i, grad, priority=-i)
-            for i, name in enumerate(self._param_names):
-                grad = ex.grad_dict.get(name)
-                if grad is not None:
-                    self._updater(i, grad, ex.arg_dict[name])
+            for i, name, grad in live:
+                self._updater(i, grad, ex.arg_dict[name])
 
     def get_outputs(self, merge_multi_context=True):
         return self._exec_group.get_outputs(merge_multi_context)

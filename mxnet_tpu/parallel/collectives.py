@@ -85,6 +85,30 @@ def cross_process_allreduce(x):
     return jnp.asarray(gathered).sum(axis=0)
 
 
+def cross_process_allreduce_many(arrays: Sequence) -> List:
+    """Allreduce a whole bucket of host-local arrays with ONE collective:
+    flatten+concat per dtype, gather once, sum, split back. This is the
+    network-level half of the reference's MXNET_UPDATE_AGGREGATION_SIZE
+    batching (kvstore_nccl.h aggregates push/pull pairs the same way)."""
+    arrays = list(arrays)
+    if jax.process_count() == 1 or len(arrays) <= 1:
+        return [cross_process_allreduce(a) for a in arrays]
+    out: List = [None] * len(arrays)
+    by_dtype: dict = {}
+    for i, a in enumerate(arrays):
+        by_dtype.setdefault(jnp.asarray(a).dtype, []).append(i)
+    for dt, idxs in by_dtype.items():
+        flat = jnp.concatenate([jnp.ravel(jnp.asarray(arrays[i]))
+                                for i in idxs])
+        red = cross_process_allreduce(flat)
+        off = 0
+        for i in idxs:
+            n = arrays[i].size
+            out[i] = red[off:off + n].reshape(arrays[i].shape)
+            off += n
+    return out
+
+
 def bucketed_allreduce(grads: List, mesh: Mesh, axis: str = "dp",
                        bucket_bytes: int = 4 << 20) -> List:
     """Bucket small gradients into fused allreduce dispatches, preserving

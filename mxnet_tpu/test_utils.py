@@ -72,12 +72,15 @@ def check_numeric_gradient(op_fn: Callable, inputs: Sequence[np.ndarray],
     analytic = [a.grad.asnumpy() for a in arrays]
 
     def f(xs):
-        outs = op_fn(*[nd.array(x) for x in xs])
-        if isinstance(outs, (list, tuple)):
-            return sum(float(o.sum().asscalar()) for o in outs)
-        if head_grad is None:
-            return float(outs.sum().asscalar())
-        return float((outs * nd.array(head_grad)).sum().asscalar())
+        # evaluate in train mode so mode-dependent ops (BatchNorm batch
+        # stats, Dropout) differentiate the same function autograd saw
+        with autograd.train_mode():
+            outs = op_fn(*[nd.array(x) for x in xs])
+            if isinstance(outs, (list, tuple)):
+                return sum(float(o.sum().asscalar()) for o in outs)
+            if head_grad is None:
+                return float(outs.sum().asscalar())
+            return float((outs * nd.array(head_grad)).sum().asscalar())
 
     for i, x in enumerate(inputs):
         num = np.zeros_like(x, dtype="float64")
