@@ -293,6 +293,8 @@ def _maybe_join_cluster() -> None:
                                    num_processes=int(nprocs),
                                    process_id=int(pid))
     except RuntimeError as e:
+        if "must be called before" not in str(e):
+            raise   # real failure (unreachable coordinator etc.) — keep it
         raise MXNetError(
             "cannot join the distributed cluster: the XLA backend was "
             "already initialized by earlier array work. Create the dist "

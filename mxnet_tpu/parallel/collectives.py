@@ -26,7 +26,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["allreduce", "allgather", "reduce_scatter", "broadcast", "ppermute",
            "all_to_all", "psum_arrays", "cross_process_allreduce",
-           "bucketed_allreduce"]
+           "cross_process_allreduce_many", "bucketed_allreduce"]
 
 
 # ---- inside-shard_map primitives (thin, named-axis) -----------------------

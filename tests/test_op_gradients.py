@@ -16,8 +16,6 @@ define backward as the LOSS gradient while forward emits predictions, so
 finite differences of the forward cannot match by design — they get
 closed-form analytic checks at the bottom instead of the sweep.
 """
-import functools
-
 import numpy as np
 import pytest
 
@@ -180,6 +178,18 @@ SPEC = {
         fixed={1: const(np.array([[0, 0.5, 0.5, 4.5, 4.5]], "float32"))},
         attrs={"pooled_size": (2, 2), "spatial_scale": 1.0},
         tol=dict(rtol=3e-2, atol=3e-3)),
+    "_contrib_PSROIPooling": dict(
+        inputs=[u(1, 4, 6, 6)],
+        fixed={1: const(np.array([[0, 1, 1, 5, 5]], "float32"))},
+        attrs={"spatial_scale": 1.0, "output_dim": 1, "pooled_size": 2,
+               "group_size": 2},
+        tol=dict(rtol=3e-2, atol=3e-3)),
+    "_contrib_DeformablePSROIPooling": dict(
+        inputs=[u(1, 4, 6, 6), u(1, 2, 2, 2, low=-0.2, high=0.2)],
+        fixed={1: const(np.array([[0, 1, 1, 5, 5]], "float32"))},
+        attrs={"spatial_scale": 1.0, "output_dim": 1, "pooled_size": 2,
+               "group_size": 2, "trans_std": 0.1},
+        tol=dict(rtol=3e-2, atol=3e-3)),
     "RNN": dict(
         inputs=[u(3, 2, 4), u(33), u(1, 2, 3)],
         attrs={"mode": "rnn_tanh", "state_size": 3, "num_layers": 1},
@@ -316,7 +326,8 @@ SPEC = {
     "space_to_depth": dict(inputs=[u(1, 2, 4, 4)], attrs={"block_size": 2}),
     "Cast": dict(attrs={"dtype": "float32"}),
     "amp_cast": dict(attrs={"dtype": "float32"}),
-    "Crop": dict(skip="alias of slice; covered there"),
+    "Crop": dict(inputs=[u(1, 2, 5, 6)],
+                 attrs={"offset": (1, 2), "h_w": (3, 3)}),
 
     # ---- indexing with pinned integer inputs
     "take": dict(inputs=[u(5, 3)], fixed={1: const(np.array([0, 2, 4], "int32"))}),

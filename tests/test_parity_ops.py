@@ -1,0 +1,283 @@
+"""Tests for the reference op-name parity layer (ops/parity_ops.py):
+fused optimizer updates vs numpy reference math, legacy layers, graph
+utilities, contrib long tail, int8 quantized ops."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def A(x):
+    return nd.array(np.asarray(x, "float32"))
+
+
+# ------------------------------------------------------------ optimizer ops
+def test_sgd_update(rng):
+    w = rng.randn(4, 3).astype("float32")
+    g = rng.randn(4, 3).astype("float32")
+    out = nd.sgd_update(A(w), A(g), lr=0.1, wd=0.01, rescale_grad=0.5)
+    ref = w - 0.1 * (0.5 * g + 0.01 * w)
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-6)
+
+
+def test_sgd_mom_update_in_place(rng):
+    w, g = A(rng.randn(4)), A(rng.randn(4))
+    mom = nd.zeros((4,))
+    w0, g0 = w.asnumpy(), g.asnumpy()
+    nd.sgd_mom_update(w, g, mom, lr=0.1, momentum=0.9, out=[w, mom])
+    ref_mom = -0.1 * g0
+    np.testing.assert_allclose(mom.asnumpy(), ref_mom, rtol=1e-6)
+    np.testing.assert_allclose(w.asnumpy(), w0 + ref_mom, rtol=1e-6)
+    # second step exercises the momentum term
+    nd.sgd_mom_update(w, g, mom, lr=0.1, momentum=0.9, out=[w, mom])
+    ref_mom2 = 0.9 * ref_mom - 0.1 * g0
+    np.testing.assert_allclose(mom.asnumpy(), ref_mom2, rtol=1e-6)
+
+
+def test_adam_update_matches_optimizer(rng):
+    """adam_update must agree with the Adam in mx.optimizer step-for-step."""
+    w0 = rng.randn(6).astype("float32")
+    g0 = rng.randn(6).astype("float32")
+    w, mean, var = A(w0), nd.zeros((6,)), nd.zeros((6,))
+    lr, b1, b2, eps = 0.01, 0.9, 0.999, 1e-8
+    # op path (no bias correction, reference adam_update semantics)
+    nd.adam_update(w, A(g0), mean, var, lr=lr, beta1=b1, beta2=b2,
+                   epsilon=eps, out=[w, mean, var])
+    m = (1 - b1) * g0
+    v = (1 - b2) * g0 * g0
+    ref = w0 - lr * m / (np.sqrt(v) + eps)
+    np.testing.assert_allclose(w.asnumpy(), ref, rtol=1e-5)
+
+
+def test_mp_sgd_update_keeps_fp32_master(rng):
+    w32_0 = rng.randn(5).astype("float32")
+    w16 = nd.array(w32_0.astype("float32"))  # low-precision working copy
+    w32 = A(w32_0)
+    g = A(rng.randn(5))
+    nd.mp_sgd_update(w16, g, w32, lr=0.1, out=[w16, w32])
+    np.testing.assert_allclose(w32.asnumpy(), w32_0 - 0.1 * g.asnumpy(),
+                               rtol=1e-6)
+    np.testing.assert_allclose(w16.asnumpy(), w32.asnumpy(), rtol=1e-6)
+
+
+def test_ftrl_signum_rmsprop_shapes(rng):
+    w = A(rng.randn(3, 2))
+    g = A(rng.randn(3, 2))
+    z, n = nd.zeros((3, 2)), nd.zeros((3, 2))
+    outs = nd.ftrl_update(w, g, z, n, lr=0.1)
+    assert [o.shape for o in outs] == [(3, 2)] * 3
+    mom = nd.zeros((3, 2))
+    outs = nd.signum_update(w, g, mom, lr=0.1, momentum=0.9)
+    assert [o.shape for o in outs] == [(3, 2)] * 2
+    outs = nd.rmsprop_update(w, g, nd.zeros((3, 2)), lr=0.1)
+    assert [o.shape for o in outs] == [(3, 2)] * 2
+    outs = nd.ftml_update(w, g, nd.zeros((3, 2)), nd.zeros((3, 2)),
+                          nd.zeros((3, 2)), lr=0.1, t=1)
+    assert [o.shape for o in outs] == [(3, 2)] * 4
+    outs = nd.rmspropalex_update(w, g, nd.zeros((3, 2)), nd.zeros((3, 2)),
+                                 nd.zeros((3, 2)), lr=0.1)
+    assert [o.shape for o in outs] == [(3, 2)] * 4
+
+
+def test_adamw_tensor_rescale(rng):
+    w0 = rng.randn(4).astype("float32")
+    g0 = rng.randn(4).astype("float32")
+    w, mean, var = A(w0), nd.zeros((4,)), nd.zeros((4,))
+    nd._contrib_adamw_update(w, A(g0), mean, var, A(np.float32(0.5)),
+                             lr=0.01, wd=0.1, eta=1.0, out=[w, mean, var])
+    gs = 0.5 * g0
+    m = 0.1 * gs
+    v = 0.001 * gs * gs
+    ref = w0 - (0.01 * m / (np.sqrt(v) + 1e-8) + 0.1 * w0)
+    np.testing.assert_allclose(w.asnumpy(), ref, rtol=1e-5)
+
+
+def test_multi_sum_sq(rng):
+    a = rng.randn(3, 4).astype("float32")
+    b = rng.randn(5).astype("float32")
+    outs = nd.multi_sum_sq(A(a), A(b), num_arrays=2)
+    np.testing.assert_allclose(outs[0].asnumpy(), (a ** 2).sum(), rtol=1e-5)
+    np.testing.assert_allclose(outs[1].asnumpy(), (b ** 2).sum(), rtol=1e-5)
+
+
+# ------------------------------------------------------------ legacy layers
+def test_legacy_crop_offset_and_center(rng):
+    x = rng.randn(1, 2, 6, 8).astype("float32")
+    out = nd.Crop(A(x), offset=(1, 2), h_w=(3, 4))
+    np.testing.assert_allclose(out.asnumpy(), x[:, :, 1:4, 2:6])
+    out = nd.Crop(A(x), h_w=(4, 4), center_crop=True)
+    np.testing.assert_allclose(out.asnumpy(), x[:, :, 1:5, 2:6])
+
+
+def test_make_loss_grad_scale(rng):
+    from mxnet_tpu import autograd
+    x = A(rng.rand(3, 4) + 0.1)
+    x.attach_grad()
+    with autograd.record():
+        out = nd.MakeLoss(x * 2, grad_scale=3.0)
+    out.backward()
+    # backward ignores the chain: d(loss)/dx = grad_scale * d(2x)/dx
+    np.testing.assert_allclose(x.grad.asnumpy(), np.full((3, 4), 6.0),
+                               rtol=1e-6)
+
+
+def test_identity_kl_sparse_reg_adds_grad(rng):
+    from mxnet_tpu import autograd
+    x = A(rng.randn(4, 3))
+    x.attach_grad()
+    with autograd.record():
+        out = nd.IdentityAttachKLSparseReg(x, sparseness_target=0.2,
+                                           penalty=0.01)
+    out.backward()
+    assert not np.allclose(x.grad.asnumpy(), np.ones((4, 3)))  # reg added
+    np.testing.assert_allclose(out.asnumpy(), x.asnumpy())     # fwd identity
+
+
+# ------------------------------------------------------------ utilities
+def test_histogram(rng):
+    x = rng.rand(100).astype("float32")
+    hist, edges = nd._histogram(A(x), bin_cnt=10, range=(0.0, 1.0))
+    ref_hist, ref_edges = np.histogram(x, bins=10, range=(0, 1))
+    np.testing.assert_allclose(hist.asnumpy(), ref_hist)
+    np.testing.assert_allclose(edges.asnumpy(), ref_edges, rtol=1e-6)
+
+
+def test_khatri_rao():
+    a = np.array([[1., -1.], [2., -3.]], "float32")
+    b = np.array([[1., 4.]], "float32")
+    out = nd.khatri_rao(A(a), A(b))
+    ref = np.vstack([np.kron(a[:, k], b[:, k]) for k in range(2)]).T
+    np.testing.assert_allclose(out.asnumpy(), ref)
+
+
+def test_slice_assign(rng):
+    x = np.zeros((4, 5), "float32")
+    v = rng.randn(2, 3).astype("float32")
+    out = nd._slice_assign(A(x), A(v), begin=(1, 1), end=(3, 4))
+    ref = x.copy()
+    ref[1:3, 1:4] = v
+    np.testing.assert_allclose(out.asnumpy(), ref)
+    out = nd._slice_assign_scalar(A(x), scalar=7.0, begin=(0, 0), end=(2, 2))
+    ref = x.copy()
+    ref[:2, :2] = 7
+    np.testing.assert_allclose(out.asnumpy(), ref)
+
+
+def test_sparse_retain_dense(rng):
+    x = rng.randn(5, 3).astype("float32")
+    out = nd._sparse_retain(A(x), A([0, 3]))
+    assert (out.asnumpy()[[1, 2, 4]] == 0).all()
+    np.testing.assert_allclose(out.asnumpy()[[0, 3]], x[[0, 3]])
+
+
+# ------------------------------------------------------------ contrib tail
+def test_quadratic_grad(rng):
+    from mxnet_tpu.test_utils import check_numeric_gradient
+    check_numeric_gradient(
+        lambda x: nd._contrib_quadratic(x, a=2.0, b=-1.0, c=3.0),
+        [rng.randn(3, 4).astype("float32")])
+
+
+def test_index_copy():
+    old = np.zeros((5, 2), "float32")
+    new = np.ones((2, 2), "float32") * 7
+    out = nd._contrib_index_copy(A(old), A([1, 3]), A(new))
+    assert (out.asnumpy()[[1, 3]] == 7).all()
+    assert (out.asnumpy()[[0, 2, 4]] == 0).all()
+
+
+def test_edge_id_getnnz():
+    adj = np.array([[0, 2, 0], [1, 0, 0]], "float32")
+    out = nd._contrib_edge_id(A(adj), A([0, 1, 0]), A([1, 0, 0]))
+    np.testing.assert_allclose(out.asnumpy(), [2, 1, -1])
+    assert int(nd._contrib_getnnz(A(adj)).asnumpy()) == 2
+    np.testing.assert_allclose(nd._contrib_getnnz(A(adj), axis=0).asnumpy(),
+                               [1, 1, 0])
+
+
+def test_bipartite_matching():
+    score = np.array([[0.5, 0.6, 0.9],
+                      [0.8, 0.2, 0.3]], "float32")
+    rmatch, cmatch = nd._contrib_bipartite_matching(A(score), threshold=0.1)
+    # greedy: (0,2)=0.9 first, then (1,0)=0.8
+    np.testing.assert_allclose(rmatch.asnumpy(), [2, 0])
+    np.testing.assert_allclose(cmatch.asnumpy(), [1, -1, 0])
+
+
+def test_psroi_pooling_shape_and_uniform(rng):
+    ps, gs, od = 2, 2, 3
+    C = od * gs * gs
+    # constant per-channel input: each output bin must equal its mapped
+    # channel's constant
+    x = np.tile(np.arange(C, dtype="float32").reshape(1, C, 1, 1), (1, 1, 8, 8))
+    rois = np.array([[0, 0, 0, 7, 7]], "float32")
+    out = nd._contrib_PSROIPooling(A(x), A(rois), spatial_scale=1.0,
+                                   output_dim=od, pooled_size=ps,
+                                   group_size=gs)
+    assert out.shape == (1, od, ps, ps)
+    got = out.asnumpy()[0]
+    for c in range(od):
+        for i in range(ps):
+            for j in range(ps):
+                assert got[c, i, j] == (c * gs + i) * gs + j
+
+
+def test_deformable_psroi_pooling_no_trans_matches_psroi(rng):
+    x = rng.randn(1, 4, 8, 8).astype("float32")
+    rois = np.array([[0, 1, 1, 6, 6]], "float32")
+    a = nd._contrib_PSROIPooling(A(x), A(rois), spatial_scale=1.0,
+                                 output_dim=1, pooled_size=2, group_size=2)
+    b = nd._contrib_DeformablePSROIPooling(
+        A(x), A(rois), nd.zeros((1, 2, 2, 2)), spatial_scale=1.0,
+        output_dim=1, pooled_size=2, group_size=2, no_trans=True)
+    np.testing.assert_allclose(a.asnumpy(), b.asnumpy(), rtol=1e-6)
+
+
+# ------------------------------------------------------------ quantized
+def test_quantized_conv_matches_float(rng):
+    x = rng.uniform(-1, 1, (1, 2, 5, 5)).astype("float32")
+    w = rng.uniform(-1, 1, (3, 2, 3, 3)).astype("float32")
+    qx = np.clip(np.round(x * 127), -127, 127).astype(np.int8)
+    qw = np.clip(np.round(w * 127), -127, 127).astype(np.int8)
+    acc, mn, mx = nd._contrib_quantized_conv(
+        nd.array(qx, dtype="int8"), nd.array(qw, dtype="int8"),
+        nd.zeros((3,)), A(-1.0), A(1.0), A(-1.0), A(1.0),
+        kernel=(3, 3), num_filter=3, no_bias=True)
+    scale = float(mx.asnumpy()) / (1 << 30)
+    deq = acc.asnumpy().astype(np.float64) * scale
+    import jax.numpy as jnp
+    from jax import lax
+    ref = np.asarray(lax.conv_general_dilated(
+        jnp.asarray(x), jnp.asarray(w), (1, 1), [(0, 0), (0, 0)]))
+    np.testing.assert_allclose(deq, ref, atol=0.15)
+
+
+def test_quantized_concat_common_scale():
+    a = np.array([[100, -100]], np.int8)       # range ±1  -> values ±0.787
+    b = np.array([[50, -50]], np.int8)         # range ±2  -> values ±0.787
+    out, mn, mx = nd._contrib_quantized_concat(
+        nd.array(a, dtype="int8"), nd.array(b, dtype="int8"),
+        A(-1.0), A(1.0), A(-2.0), A(2.0), dim=1)
+    amax = float(mx.asnumpy())
+    assert amax == 2.0
+    deq = out.asnumpy().astype(np.float64) * amax / 127.0
+    np.testing.assert_allclose(deq, [[100 / 127, -100 / 127,
+                                      50 * 2 / 127, -50 * 2 / 127]],
+                               atol=0.02)
+
+
+# ------------------------------------------------------------ aliases
+def test_spmd_and_legacy_aliases(rng):
+    from mxnet_tpu.ops.registry import get_op
+    assert get_op("_contrib_SyncBatchNorm") is get_op("BatchNorm")
+    assert get_op("BatchNorm_v1") is get_op("BatchNorm")
+    assert get_op("Convolution_v1") is get_op("Convolution")
+    assert get_op("Pooling_v1") is get_op("Pooling")
+    assert get_op("_contrib_SparseEmbedding") is get_op("Embedding")
+    assert get_op("_contrib_boolean_mask") is get_op("boolean_mask")
+    assert get_op("_CrossDeviceCopy") is not None
+    x = rng.randn(2, 3).astype("float32")
+    np.testing.assert_allclose(nd._CrossDeviceCopy(A(x)).asnumpy(), x)
+    np.testing.assert_allclose(nd.cast_storage(A(x), stype="default")
+                               .asnumpy(), x)
