@@ -49,7 +49,7 @@ def _dequantize(data, min_range, max_range, out_type="float32"):
 def _requantize(data, min_range, max_range, min_calib_range=None,
                 max_calib_range=None, out_type="int8"):
     f = data.astype(jnp.float32) * (jnp.maximum(jnp.abs(min_range),
-                                                jnp.abs(max_range)) / (1 << 30))
+                                                jnp.abs(max_range)) / 0x7FFFFFFF)
     if min_calib_range is not None:
         mn, mx = min_calib_range, max_calib_range
     else:
@@ -80,7 +80,7 @@ def _quantized_fc(data, weight, bias, min_data, max_data, min_weight,
         scale_b = jnp.maximum(jnp.abs(min_bias), jnp.abs(max_bias)) / 127.0
         acc = acc + jnp.round(bias.astype(jnp.float32) * (scale_b / out_scale)
                               ).astype(jnp.int32)
-    rng = out_scale * (1 << 30)
+    rng = out_scale * 0x7FFFFFFF
     return acc, -rng, rng
 
 

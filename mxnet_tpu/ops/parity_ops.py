@@ -189,10 +189,15 @@ def _group_adagrad_update(weight, grad, history, lr, rescale_grad=1.0,
     g = grad * rescale_grad
     if clip_gradient is not None and clip_gradient > 0:
         g = jnp.clip(g, -clip_gradient, clip_gradient)
-    reduce_axes = tuple(range(1, g.ndim))
-    h_new = history + jnp.mean(g * g, axis=reduce_axes) if g.ndim > 1 \
-        else history + g * g
-    scale = h_new.reshape((-1,) + (1,) * (g.ndim - 1)) if g.ndim > 1 else h_new
+    if g.ndim > 1:
+        # per-row mean; history arrives as (rows,) or the reference's
+        # (rows, 1) state shape — compute in the history's own shape
+        mean_sq = jnp.mean(g * g, axis=tuple(range(1, g.ndim)))
+        h_new = history + mean_sq.reshape(history.shape)
+        scale = h_new.reshape((-1,) + (1,) * (g.ndim - 1))
+    else:
+        h_new = history + g * g
+        scale = h_new
     w = weight - lr * g / (jnp.sqrt(scale) + epsilon)
     return w, h_new
 
@@ -286,7 +291,7 @@ def _identity_kl_sparse_reg(data, sparseness_target=0.1, penalty=0.001,
 # graph-builder / tensor utilities
 # ---------------------------------------------------------------------------
 
-@register("cast_storage", differentiable=False)
+@register("cast_storage")
 def _cast_storage(data, stype="default"):
     """Storage-type cast. Dense tensors are the universal storage here
     (sparse is BCOO at the NDArray layer); numerically the identity."""
@@ -322,14 +327,14 @@ def _khatri_rao(*mats):
     return out
 
 
-@register("_slice_assign", differentiable=False)
+@register("_slice_assign")
 def _slice_assign(lhs, rhs, begin=(), end=(), step=()):
     idx = tuple(slice(b, e, s or None) for b, e, s in
                 zip(begin, end, step or (None,) * len(begin)))
     return lhs.at[idx].set(rhs)
 
 
-@register("_slice_assign_scalar", differentiable=False)
+@register("_slice_assign_scalar")
 def _slice_assign_scalar(data, scalar=0.0, begin=(), end=(), step=()):
     idx = tuple(slice(b, e, s or None) for b, e, s in
                 zip(begin, end, step or (None,) * len(begin)))
@@ -465,8 +470,10 @@ def _psroi_sample(data, rois, spatial_scale, output_dim, pooled_size,
         # deformable: per-(class-agnostic-part, bin) learned offsets
         pt = int(part_size) if part_size else ps
         t = trans.reshape(trans.shape[0], -1, 2, pt, pt)  # (R, cls, 2, pt, pt)
-        ty = t[:, 0, 0]                                   # (R, pt, pt)
-        tx = t[:, 0, 1]
+        # reference channel order (deformable_psroi_pooling.cc): plane 2k
+        # is the x offset, plane 2k+1 the y offset
+        tx = t[:, 0, 0]                                   # (R, pt, pt)
+        ty = t[:, 0, 1]
         # nearest part bin per pooled bin (pt == ps in practice)
         sel = (jnp.arange(ps) * pt // ps)
         dy = ty[:, sel][:, :, sel] * trans_std            # (R, ps, ps)
@@ -561,7 +568,7 @@ def _quantized_conv(data, weight, bias, min_data, max_data, min_weight,
         q_bias = jnp.round(bias.astype(jnp.float32)
                            * (scale_b / out_scale)).astype(jnp.int32)
         acc = acc + q_bias.reshape(1, -1, *([1] * nd))
-    rng = out_scale * (1 << 30)
+    rng = out_scale * 0x7FFFFFFF
     return acc, -rng, rng
 
 

@@ -99,7 +99,88 @@ def check_numeric_gradient(op_fn: Callable, inputs: Sequence[np.ndarray],
                                    err_msg=f"gradient mismatch for input {i}")
 
 
-def check_consistency(sym, ctx_list=None, scale=1.0, **kwargs):
-    """Cross-context consistency (the reference's CPU↔GPU parity mechanism,
-    here CPU↔TPU when both platforms exist)."""
-    raise NotImplementedError("use tests/tpu/test_parity.py harness")
+# per-dtype comparison tolerance (reference test_utils.check_consistency's
+# dtype ladder, with bfloat16 standing in for float16 on TPU)
+_CONSISTENCY_TOL = {
+    "float16": 1e-1,
+    "bfloat16": 5e-2,
+    "float32": 1e-3,
+    "float64": 1e-5,
+}
+
+
+def check_consistency(sym, ctx_list, scale=1.0, grad_req="write",
+                      arg_params=None, tol=None, rng=None):
+    """Cross-context consistency — the reference's CPU↔GPU parity mechanism
+    (``tests/python/gpu/test_operator_gpu.py`` + ``test_utils.py``
+    check_consistency), here CPU↔TPU.
+
+    ``ctx_list`` entries are dicts like
+    ``{"ctx": mx.tpu(), "data": (2, 3), "type_dict": {"data": "float32"}}``.
+    The same random inputs (and head gradients) feed every context; each
+    context's outputs and input gradients must match the highest-precision
+    context's within its dtype tolerance. Returns the per-context outputs.
+    """
+    import numpy as _np
+    rng = rng or _np.random.RandomState(17)
+
+    shapes = {k: v for k, v in ctx_list[0].items()
+              if k not in ("ctx", "type_dict")}
+    arg_names = sym.list_arguments()
+    sym_shapes, out_shapes, _ = sym.infer_shape(**shapes)
+
+    base_args = arg_params or {}
+    shared = {}
+    for name, shp in zip(arg_names, sym_shapes):
+        if name in base_args:
+            shared[name] = _np.asarray(base_args[name], "float64")
+        else:
+            shared[name] = rng.uniform(-1, 1, size=shp) * scale
+    head_grads = [rng.uniform(-1, 1, size=s) for s in out_shapes]
+
+    import jax as _jax
+    results = []
+    for spec in ctx_list:
+        ctx = spec["ctx"]
+        type_dict = spec.get("type_dict", {})
+        default_dt = type_dict.get("__default__", "float32")
+        # pin matmul precision: the TPU default is bf16-pass matmuls (a
+        # deliberate speed feature), which makes "fp32" diverge from CPU
+        # fp32 by ~1e-2 — for a PARITY check fp32 must mean fp32
+        with _jax.default_matmul_precision("highest"):
+            exe = sym.simple_bind(ctx, grad_req=grad_req, **shapes)
+            for name in arg_names:
+                dt = type_dict.get(name, default_dt)
+                exe.arg_dict[name]._set_data(
+                    nd.array(shared[name].astype(dt), ctx=ctx)._data)
+            exe.forward(is_train=grad_req != "null")
+            outs = [o.asnumpy().astype("float64") for o in exe.outputs]
+            grads = {}
+            if grad_req != "null":
+                exe.backward([nd.array(h.astype(default_dt), ctx=ctx)
+                              for h in head_grads])
+                grads = {n: g.asnumpy().astype("float64")
+                         for n, g in exe.grad_dict.items() if g is not None}
+        dt_rank = max((_np.dtype(type_dict.get(n, default_dt)).itemsize
+                       for n in arg_names), default=4)
+        results.append(dict(ctx=ctx, outs=outs, grads=grads,
+                            dtype=default_dt, rank=dt_rank))
+
+    # most precise context is ground truth
+    truth = max(results, key=lambda r: r["rank"])
+    for r in results:
+        if r is truth:
+            continue
+        t = tol if tol is not None else max(
+            _CONSISTENCY_TOL.get(str(r["dtype"]), 1e-3),
+            _CONSISTENCY_TOL.get(str(truth["dtype"]), 1e-3))
+        for i, (a, b) in enumerate(zip(r["outs"], truth["outs"])):
+            _np.testing.assert_allclose(
+                a, b, rtol=t, atol=t,
+                err_msg=f"output {i}: {r['ctx']} vs {truth['ctx']}")
+        for n in r["grads"]:
+            if n in truth["grads"]:
+                _np.testing.assert_allclose(
+                    r["grads"][n], truth["grads"][n], rtol=t, atol=t,
+                    err_msg=f"grad {n}: {r['ctx']} vs {truth['ctx']}")
+    return [r["outs"] for r in results]

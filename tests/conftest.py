@@ -7,13 +7,19 @@ by bench.py, not the unit suite.
 """
 import os
 
-os.environ["XLA_FLAGS"] = ("--xla_force_host_platform_device_count=8 "
-                           + os.environ.get("XLA_FLAGS", ""))
+# MXTPU_REAL_TPU=1 keeps the real accelerator visible (used by
+# tests/tpu/test_parity.py on the bench machine); default CI forces the
+# virtual CPU mesh.
+_REAL = os.environ.get("MXTPU_REAL_TPU") == "1"
+if not _REAL:
+    os.environ["XLA_FLAGS"] = ("--xla_force_host_platform_device_count=8 "
+                               + os.environ.get("XLA_FLAGS", ""))
 os.environ.setdefault("MXNET_SEED", "17")
 
 import jax
 
-jax.config.update("jax_platforms", "cpu")
+if not _REAL:
+    jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest

@@ -141,7 +141,7 @@ def test_quantized_fc(rng):
     acc, mn, mx_ = invoke("_contrib_quantized_fully_connected",
                           [qx, qw, None, mnx, mxx, mnw, mxw],
                           {"num_hidden": 4, "no_bias": True})
-    scale = (float(mx_.asnumpy().ravel()[0]) / (1 << 30))
+    scale = (float(mx_.asnumpy().ravel()[0]) / 0x7FFFFFFF)
     approx = acc.asnumpy().astype("float64") * scale
     np.testing.assert_allclose(approx, x @ w.T, atol=0.2, rtol=0.1)
 

@@ -138,3 +138,21 @@ def test_sparse_retain_preserves_dtype():
     out = arr.retain(mx.nd.array([0, 1]))
     assert out.data.asnumpy().dtype == np.int32
     np.testing.assert_array_equal(out.data.asnumpy(), [[1, 2], [0, 0]])
+
+
+def test_check_consistency_machinery(rng):
+    """check_consistency compares contexts/dtypes (here cpu fp32 vs cpu
+    bf16 — the dtype ladder) and raises on real divergence."""
+    import pytest
+    from mxnet_tpu.test_utils import check_consistency
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=8,
+                                name="fc")
+    ctx_list = [dict(ctx=mx.cpu(), data=(4, 16)),
+                dict(ctx=mx.cpu(), data=(4, 16),
+                     type_dict={"__default__": "bfloat16"})]
+    outs = check_consistency(net, ctx_list)
+    assert len(outs) == 2 and outs[0][0].shape == (4, 8)
+
+    # a genuinely divergent "context" must be caught: scale one input set
+    with pytest.raises(AssertionError):
+        check_consistency(net, ctx_list, tol=1e-12)

@@ -324,6 +324,12 @@ SPEC = {
     "diag": dict(),
     "depth_to_space": dict(inputs=[u(1, 4, 2, 2)], attrs={"block_size": 2}),
     "space_to_depth": dict(inputs=[u(1, 2, 4, 4)], attrs={"block_size": 2}),
+    "cast_storage": dict(attrs={"stype": "default"}),
+    "_slice_assign": dict(inputs=[u(4, 5), u(2, 3)],
+                          attrs={"begin": (1, 1), "end": (3, 4)}),
+    "_slice_assign_scalar": dict(inputs=[u(4, 5)],
+                                 attrs={"scalar": 2.0, "begin": (0, 0),
+                                        "end": (2, 2)}),
     "Cast": dict(attrs={"dtype": "float32"}),
     "amp_cast": dict(attrs={"dtype": "float32"}),
     "Crop": dict(inputs=[u(1, 2, 5, 6)],

@@ -93,6 +93,44 @@ def test_adamw_tensor_rescale(rng):
     np.testing.assert_allclose(w.asnumpy(), ref, rtol=1e-5)
 
 
+def test_group_adagrad_reference_state_shape(rng):
+    """GroupAdaGrad state is (rows, 1) in the reference optimizer."""
+    w = A(rng.randn(4, 3))
+    g = A(rng.randn(4, 3))
+    hist = nd.zeros((4, 1))
+    w_new, h_new = nd._contrib_group_adagrad_update(w, g, hist, lr=0.1)
+    assert w_new.shape == (4, 3) and h_new.shape == (4, 1)
+    gn = g.asnumpy()
+    ref_h = (gn ** 2).mean(axis=1, keepdims=True)
+    np.testing.assert_allclose(h_new.asnumpy(), ref_h, rtol=1e-5)
+    np.testing.assert_allclose(
+        w_new.asnumpy(), w.asnumpy() - 0.1 * gn / (np.sqrt(ref_h) + 1e-5),
+        rtol=1e-5)
+
+
+def test_deformable_psroi_trans_channel_order():
+    """Plane 0 of trans shifts x, plane 1 shifts y (reference order)."""
+    x = np.zeros((1, 1, 8, 8), "float32")
+    x[0, 0, :, 6] = 1.0          # bright COLUMN at x=6
+    rois = np.array([[0, 1, 1, 4, 4]], "float32")
+    base = nd._contrib_DeformablePSROIPooling(
+        A(x), A(rois), nd.zeros((1, 2, 1, 1)), spatial_scale=1.0,
+        output_dim=1, pooled_size=1, group_size=1, trans_std=1.0,
+        no_trans=True).asnumpy()
+    # plane 0 = x offset: shifting x toward the bright column raises output
+    tr_x = np.zeros((1, 2, 1, 1), "float32"); tr_x[0, 0] = 1.0
+    got_x = nd._contrib_DeformablePSROIPooling(
+        A(x), A(rois), A(tr_x), spatial_scale=1.0, output_dim=1,
+        pooled_size=1, group_size=1, trans_std=1.0).asnumpy()
+    # plane 1 = y offset: shifting y along the column changes nothing
+    tr_y = np.zeros((1, 2, 1, 1), "float32"); tr_y[0, 1] = 1.0
+    got_y = nd._contrib_DeformablePSROIPooling(
+        A(x), A(rois), A(tr_y), spatial_scale=1.0, output_dim=1,
+        pooled_size=1, group_size=1, trans_std=1.0).asnumpy()
+    assert got_x.sum() > base.sum() + 0.01
+    np.testing.assert_allclose(got_y, base, atol=1e-6)
+
+
 def test_multi_sum_sq(rng):
     a = rng.randn(3, 4).astype("float32")
     b = rng.randn(5).astype("float32")
@@ -244,7 +282,7 @@ def test_quantized_conv_matches_float(rng):
         nd.array(qx, dtype="int8"), nd.array(qw, dtype="int8"),
         nd.zeros((3,)), A(-1.0), A(1.0), A(-1.0), A(1.0),
         kernel=(3, 3), num_filter=3, no_bias=True)
-    scale = float(mx.asnumpy()) / (1 << 30)
+    scale = float(mx.asnumpy()) / 0x7FFFFFFF
     deq = acc.asnumpy().astype(np.float64) * scale
     import jax.numpy as jnp
     from jax import lax

@@ -1,0 +1,107 @@
+"""CPU ↔ TPU operator parity (reference
+``tests/python/gpu/test_operator_gpu.py``: rerun the CPU op suite on the
+accelerator and ``check_consistency`` the results).
+
+On a machine WITHOUT a TPU (the CI mesh forces the CPU platform) every test
+skips cleanly. On the bench machine run:
+
+    MXTPU_REAL_TPU=1 python -m pytest tests/tpu/ -q
+
+which keeps the axon TPU visible (tests/conftest.py honors the flag) and
+compares every symbol below on cpu vs tpu, fp32 and bf16.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import check_consistency
+
+sym = mx.sym
+
+
+def _has_tpu():
+    try:
+        return mx.num_tpus() > 0
+    except Exception:
+        return False
+
+
+pytestmark = pytest.mark.skipif(not _has_tpu(),
+                                reason="no TPU present; parity runs on the "
+                                       "bench machine via MXTPU_REAL_TPU=1")
+
+
+def _ctx_list(**shapes):
+    return [dict(ctx=mx.cpu(), **shapes),
+            dict(ctx=mx.tpu(), **shapes)]
+
+
+def _ctx_list_bf16(**shapes):
+    cl = _ctx_list(**shapes)
+    cl.append(dict(ctx=mx.tpu(),
+                   type_dict={"__default__": "bfloat16"}, **shapes))
+    return cl
+
+
+def test_fully_connected_parity():
+    net = sym.FullyConnected(sym.Variable("data"), num_hidden=16, name="fc")
+    check_consistency(net, _ctx_list_bf16(data=(8, 32)))
+
+
+def test_convolution_parity():
+    net = sym.Convolution(sym.Variable("data"), kernel=(3, 3), num_filter=8,
+                          pad=(1, 1), name="conv")
+    check_consistency(net, _ctx_list_bf16(data=(2, 4, 16, 16)))
+
+
+def test_batchnorm_relu_pool_parity():
+    d = sym.Variable("data")
+    net = sym.Convolution(d, kernel=(3, 3), num_filter=4, name="c")
+    net = sym.BatchNorm(net, fix_gamma=False, name="bn")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.Pooling(net, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    check_consistency(net, _ctx_list(data=(2, 3, 8, 8)))
+
+
+def test_softmax_ce_parity():
+    net = sym.SoftmaxOutput(
+        sym.FullyConnected(sym.Variable("data"), num_hidden=10),
+        sym.Variable("sm_label"), name="sm")
+    check_consistency(net, _ctx_list(data=(16, 32), sm_label=(16,)))
+
+
+def test_elemwise_chain_parity():
+    a, b = sym.Variable("a"), sym.Variable("b")
+    net = sym.tanh(a * b + sym.exp(a) - sym.sqrt(sym.abs(b) + 1.0))
+    check_consistency(net, _ctx_list(a=(4, 64), b=(4, 64)))
+
+
+def test_dot_transpose_parity():
+    a, b = sym.Variable("a"), sym.Variable("b")
+    net = sym.dot(a, sym.transpose(b))
+    check_consistency(net, _ctx_list_bf16(a=(8, 32), b=(16, 32)))
+
+
+def test_reduction_broadcast_parity():
+    a = sym.Variable("a")
+    net = sym.broadcast_mul(a, sym.sum(a, axis=0, keepdims=True))
+    check_consistency(net, _ctx_list(a=(8, 16)))
+
+
+def test_rnn_fused_parity():
+    data = sym.Variable("data")
+    params = sym.Variable("params")
+    state = sym.Variable("state")
+    net = sym.RNN(data, params, state, mode="rnn_tanh", state_size=8,
+                  num_layers=1, name="rnn")
+    from mxnet_tpu.ops.rnn import rnn_packed_param_size
+    n = rnn_packed_param_size("rnn_tanh", 1, False, 4, 8)
+    check_consistency(net, _ctx_list(data=(5, 2, 4), params=(n,),
+                                     state=(1, 2, 8)))
+
+
+def test_layernorm_softmax_parity():
+    d = sym.Variable("data")
+    net = sym.softmax(sym.LayerNorm(d, sym.Variable("g"), sym.Variable("b"),
+                                    name="ln"))
+    check_consistency(net, _ctx_list(data=(4, 32), g=(32,), b=(32,)))
