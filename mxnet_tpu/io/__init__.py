@@ -1,7 +1,8 @@
 """``mx.io`` — data iterators (reference: ``python/mxnet/io/io.py`` + the C++
 iterators in ``src/io/``)."""
 from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter,
-                 PrefetchingIter, CSVIter, ImageRecordIter, MNISTIter)
+                 PrefetchingIter, CSVIter, ImageRecordIter,
+                 ImageDetRecordIter, MNISTIter)
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
-           "PrefetchingIter", "CSVIter", "ImageRecordIter", "MNISTIter"]
+           "PrefetchingIter", "CSVIter", "ImageRecordIter", "ImageDetRecordIter", "MNISTIter"]

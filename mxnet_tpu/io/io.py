@@ -23,7 +23,7 @@ from ..base import MXNetError
 from ..ndarray import NDArray
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
-           "PrefetchingIter", "CSVIter", "ImageRecordIter", "MNISTIter"]
+           "PrefetchingIter", "CSVIter", "ImageRecordIter", "ImageDetRecordIter", "MNISTIter"]
 
 
 class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
@@ -513,3 +513,65 @@ class ImageRecordIter(DataIter):
 
     def iter_next(self):
         raise MXNetError("use next()")
+
+
+class ImageDetRecordIter(ImageRecordIter):
+    """Detection RecordIO iterator (reference src/io/iter_image_det_recordio.cc).
+
+    Record label layout (the reference's detection list format,
+    tools/im2rec detection lists): ``[header_width, obj_width,
+    <extra header...>, obj0..., obj1...]`` where each object is
+    ``obj_width`` floats starting with ``[class, xmin, ymin, xmax, ymax]``
+    normalized to [0, 1]. Batches labels as (B, max_objs, 5) padded with
+    -1 — exactly what _contrib_MultiBoxTarget consumes.
+
+    The whole image is resized to data_shape (no random crop: crops would
+    invalidate the normalized box coordinates).
+    """
+
+    def __init__(self, path_imgrec, data_shape, batch_size, max_objs=8,
+                 **kwargs):
+        self.max_objs = int(max_objs)
+        kwargs.setdefault("label_name", "label")
+        for dead in ("rand_crop", "resize"):
+            if kwargs.pop(dead, None):
+                raise MXNetError(
+                    f"ImageDetRecordIter does not support {dead}: boxes are "
+                    "normalized to the full image, which is resized straight "
+                    "to data_shape")
+        super().__init__(path_imgrec, data_shape, batch_size,
+                         rand_crop=False, **kwargs)
+
+    @property
+    def provide_label(self):
+        return [DataDesc(self.label_name,
+                         (self.batch_size, self.max_objs, 5))]
+
+    def _decode_one(self, raw):
+        from PIL import Image
+        header, img = self._rio.unpack_img(raw, iscolor=1)
+        c, th, tw = self.data_shape
+        if img.shape[:2] != (th, tw):
+            img = np.asarray(Image.fromarray(img).resize((tw, th)))
+        if self.rand_mirror and np.random.rand() < 0.5:
+            img = img[:, ::-1]
+            mirrored = True
+        else:
+            mirrored = False
+        chw = img.astype("float32").transpose(2, 0, 1)
+        chw = (chw * self.scale - self.mean[:, None, None]) \
+            / self.std[:, None, None]
+
+        lab = np.asarray(header.label, dtype="float32").ravel()
+        hw = int(lab[0]) if lab.size else 2
+        ow = int(lab[1]) if lab.size > 1 else 5
+        objs = lab[hw:]
+        n = objs.size // ow if ow else 0
+        out = np.full((self.max_objs, 5), -1.0, dtype="float32")
+        for i in range(min(n, self.max_objs)):
+            o = objs[i * ow:(i + 1) * ow]
+            cls, x1, y1, x2, y2 = o[0], o[1], o[2], o[3], o[4]
+            if mirrored:
+                x1, x2 = 1.0 - x2, 1.0 - x1
+            out[i] = (cls, x1, y1, x2, y2)
+        return chw, out

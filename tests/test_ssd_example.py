@@ -1,0 +1,34 @@
+"""SSD end-to-end example must train (loss decreases) and detect
+(north-star config #4; reference example/ssd)."""
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(ROOT, "example", "ssd"))
+
+
+def test_ssd_records_roundtrip():
+    from dataset import write_records
+    import mxnet_tpu as mx
+    with tempfile.TemporaryDirectory() as d:
+        rec = write_records(os.path.join(d, "t"), num_images=8, size=64)
+        it = mx.io.ImageDetRecordIter(rec, data_shape=(3, 64, 64),
+                                      batch_size=4, max_objs=4,
+                                      scale=1.0 / 255)
+        batch = it.next()
+        assert batch.data[0].shape == (4, 3, 64, 64)
+        lab = batch.label[0].asnumpy()
+        assert lab.shape == (4, 4, 5)
+        valid = lab[lab[:, :, 0] >= 0]
+        assert len(valid) >= 4                      # >=1 object per image
+        assert (valid[:, 1:] >= 0).all() and (valid[:, 1:] <= 1).all()
+
+
+def test_ssd_trains_and_detects():
+    from train import main
+    rc = main(["--epochs", "5", "--num-images", "64", "--batch-size", "16",
+               "--lr", "0.05"])
+    assert rc == 0
