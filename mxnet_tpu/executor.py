@@ -350,14 +350,24 @@ class Executor:
                 rng = jax.device_put(rng, list(v.devices())[0])
                 break
 
+        try:
+            if is_train:
+                outs, aux_updates, grads = self._compiled_train_step()(inputs,
+                                                                       rng)
+            else:
+                outs, _ = self._compiled(False)(inputs, rng)
+        except MXNetError:
+            raise
+        except (TypeError, ValueError) as e:
+            # graph trace/compile failures (shape mismatches etc.) surface
+            # as MXNetError like the reference's bind-time CHECK failures
+            raise MXNetError(f"graph execution failed: {e}") from e
         if is_train:
-            outs, aux_updates, grads = self._compiled_train_step()(inputs, rng)
             self._pending = (inputs, rng, outs, grads)
             for name, val in aux_updates.items():
                 if name in self.aux_dict:
                     self.aux_dict[name]._set_data(val)
         else:
-            outs, _ = self._compiled(False)(inputs, rng)
             self._pending = None
         self._outputs = [_wrap(o) for o in outs]
         if self.monitor_callback is not None:

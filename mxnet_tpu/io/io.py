@@ -471,12 +471,18 @@ class ImageRecordIter(DataIter):
         img = img[y0:y0 + th, x0:x0 + tw]
         if self.rand_mirror and np.random.rand() < 0.5:
             img = img[:, ::-1]
-        chw = img.astype("float32").transpose(2, 0, 1)
-        chw = (chw * self.scale - self.mean[:, None, None]) / self.std[:, None, None]
+        chw = self._normalize(img)
         label = header.label
         if isinstance(label, np.ndarray) and self.label_width == 1:
             label = float(label[0])
         return chw, label
+
+    def _normalize(self, img):
+        """HWC uint8 → normalized CHW float32 (shared by the classification
+        and detection decode paths)."""
+        chw = img.astype("float32").transpose(2, 0, 1)
+        return (chw * self.scale - self.mean[:, None, None]) \
+            / self.std[:, None, None]
 
     def _read_raw(self):
         if self._keys is not None:
@@ -533,12 +539,11 @@ class ImageDetRecordIter(ImageRecordIter):
                  **kwargs):
         self.max_objs = int(max_objs)
         kwargs.setdefault("label_name", "label")
-        for dead in ("rand_crop", "resize"):
-            if kwargs.pop(dead, None):
-                raise MXNetError(
-                    f"ImageDetRecordIter does not support {dead}: boxes are "
-                    "normalized to the full image, which is resized straight "
-                    "to data_shape")
+        if kwargs.pop("rand_crop", False) or kwargs.pop("resize", -1) > 0:
+            raise MXNetError(
+                "ImageDetRecordIter does not support rand_crop/resize: boxes "
+                "are normalized to the full image, which is resized straight "
+                "to data_shape")
         super().__init__(path_imgrec, data_shape, batch_size,
                          rand_crop=False, **kwargs)
 
@@ -558,9 +563,7 @@ class ImageDetRecordIter(ImageRecordIter):
             mirrored = True
         else:
             mirrored = False
-        chw = img.astype("float32").transpose(2, 0, 1)
-        chw = (chw * self.scale - self.mean[:, None, None]) \
-            / self.std[:, None, None]
+        chw = self._normalize(img)
 
         lab = np.asarray(header.label, dtype="float32").ravel()
         hw = int(lab[0]) if lab.size else 2

@@ -1,0 +1,111 @@
+"""Async-exception propagation tier (reference
+``tests/python/unittest/test_exc_handling.py``): a failing op inside a graph
+must surface as MXNetError at a WAIT POINT (asnumpy/wait_to_read/waitall),
+must not crash worker threads, and must not poison subsequent independent
+work."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import MXNetError, autograd, nd
+
+
+def test_shape_mismatch_raises_mxnet_error():
+    a = nd.ones((2, 3))
+    b = nd.ones((4, 5))
+    with pytest.raises(Exception):
+        nd.dot(a, b).asnumpy()
+
+
+def test_engine_survives_failed_op(rng):
+    """After a failed op the engine keeps scheduling new, independent work
+    (reference: failed kernel must not kill the worker thread)."""
+    a = nd.ones((2, 3))
+    with pytest.raises(Exception):
+        nd.dot(a, nd.ones((4, 5))).asnumpy()
+    # independent follow-up work is unaffected
+    out = nd.dot(a, nd.ones((3, 2))).asnumpy()
+    np.testing.assert_allclose(out, np.full((2, 2), 3.0))
+
+
+class _Failing(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise ValueError("intentional custom-op failure")
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise ValueError("intentional custom-op backward failure")
+
+
+@mx.operator.register("_test_failing_op")
+class _FailingProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def list_arguments(self):
+        return ["data"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return _Failing()
+
+
+def test_custom_op_exception_surfaces_at_wait():
+    """Python exception inside a CustomOp callback reaches the caller as an
+    error at the sync point instead of crashing the process (reference
+    test_exc_handling.py custom-op variant)."""
+    x = nd.ones((2, 2))
+    with pytest.raises(Exception, match="intentional|callback|XlaRuntimeError"):
+        out = nd.Custom(x, op_type="_test_failing_op")
+        out.asnumpy()          # wait point
+
+
+def test_custom_op_failure_does_not_poison_engine():
+    x = nd.ones((2, 2))
+    with pytest.raises(Exception):
+        nd.Custom(x, op_type="_test_failing_op").asnumpy()
+    np.testing.assert_allclose((x * 2).asnumpy(), np.full((2, 2), 2.0))
+
+
+def test_symbolic_bind_shape_error_is_mxnet_error():
+    a = mx.sym.Variable("a")
+    net = mx.sym.FullyConnected(a, num_hidden=4, name="fc")
+    # inconsistent: weight shape contradicts data shape
+    ex = net.simple_bind(mx.cpu(), a=(2, 3))
+    ex.arg_dict["fc_weight"]._set_data(nd.ones((4, 99))._data)
+    with pytest.raises(MXNetError):
+        ex.forward()
+        ex.outputs[0].asnumpy()
+
+
+def test_autograd_backward_without_forward_raises():
+    a = mx.sym.Variable("a")
+    net = mx.sym.relu(a)
+    ex = net.simple_bind(mx.cpu(), a=(2, 2))
+    with pytest.raises(MXNetError):
+        ex.backward()
+
+
+def test_naive_engine_mode_raises_eagerly(rng):
+    """NaiveEngine (sync) mode surfaces errors at the op call itself —
+    the reference's deterministic replay debugging mode
+    (MXNET_ENGINE_TYPE=NaiveEngine)."""
+    from mxnet_tpu import engine
+    with engine.naive_mode():
+        a = nd.ones((2, 3))
+        with pytest.raises(Exception):
+            nd.dot(a, nd.ones((4, 5)))  # raises HERE, no wait needed
+        out = nd.dot(a, nd.ones((3, 2)))
+        np.testing.assert_allclose(out.asnumpy(), np.full((2, 2), 3.0))
+
+
+def test_waitall_after_error():
+    """waitall() after a failed async op must not hang or crash."""
+    a = nd.ones((2, 3))
+    try:
+        nd.dot(a, nd.ones((4, 5)))
+    except Exception:
+        pass
+    nd.waitall() if hasattr(nd, "waitall") else mx.nd.waitall()
+    np.testing.assert_allclose((a + 1).asnumpy(), np.full((2, 3), 2.0))

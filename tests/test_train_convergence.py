@@ -1,0 +1,125 @@
+"""Trainer-level convergence tier (reference ``tests/python/train/``:
+test_mlp.py, test_conv.py — small REAL trainings asserting accuracy
+thresholds, not just loss movement)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+
+
+def _two_moons(rng, n=512):
+    """Separable-but-not-linear binary data."""
+    t = rng.rand(n) * np.pi
+    cls = (rng.rand(n) > 0.5).astype("float32")
+    x = np.stack([np.cos(t) + cls * 1.0 - 0.5,
+                  np.sin(t) * (1 - 2 * cls) + cls * 0.3], 1)
+    x += rng.randn(n, 2) * 0.08
+    return x.astype("float32"), cls
+
+
+def _shapes_dataset(rng, n=256, size=16):
+    """3-class images: horizontal bar / vertical bar / centered square."""
+    X = np.zeros((n, 1, size, size), "float32")
+    y = rng.randint(0, 3, size=n).astype("float32")
+    for i, c in enumerate(y.astype(int)):
+        p = rng.randint(3, size - 5)
+        if c == 0:
+            X[i, 0, p:p + 2, 2:size - 2] = 1.0
+        elif c == 1:
+            X[i, 0, 2:size - 2, p:p + 2] = 1.0
+        else:
+            X[i, 0, p:p + 4, p:p + 4] = 1.0
+    X += rng.randn(*X.shape).astype("float32") * 0.05
+    return X, y
+
+
+def test_mlp_convergence_module(rng):
+    """Module.fit on an MLP must reach >= 95% train accuracy (reference
+    tests/python/train/test_mlp.py pattern)."""
+    X, y = _two_moons(rng)
+    it = mx.io.NDArrayIter(X, y, batch_size=32, shuffle=True,
+                           label_name="sm_label")
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=32,
+                                name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=2, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="sm")
+    mod = mx.mod.Module(net, context=mx.cpu(), label_names=["sm_label"])
+    mod.fit(it, num_epoch=15, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5, "momentum": 0.9},
+            kvstore="local", initializer=mx.init.Xavier())
+    acc = dict(mod.score(it, "acc"))["accuracy"]
+    assert acc >= 0.95, f"MLP failed to converge: acc={acc}"
+
+
+def test_conv_convergence_gluon(rng):
+    """Gluon CNN must reach >= 90% train accuracy (reference
+    tests/python/train/test_conv.py pattern)."""
+    X, y = _shapes_dataset(rng)
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Conv2D(8, kernel_size=3, padding=1, activation="relu"))
+    net.add(gluon.nn.MaxPool2D(2))
+    net.add(gluon.nn.Conv2D(16, kernel_size=3, padding=1, activation="relu"))
+    net.add(gluon.nn.GlobalAvgPool2D())
+    net.add(gluon.nn.Dense(3))
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.01}, kvstore=None)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    xs, ys = nd.array(X), nd.array(y)
+    bs = 32
+    for epoch in range(12):
+        for i in range(0, len(X), bs):
+            xb, yb = xs[i:i + bs], ys[i:i + bs]
+            with autograd.record():
+                loss = loss_fn(net(xb), yb)
+            loss.backward()
+            trainer.step(bs)
+    pred = net(xs).asnumpy().argmax(1)
+    acc = (pred == y.astype(int)).mean()
+    assert acc >= 0.9, f"CNN failed to converge: acc={acc}"
+
+
+def test_lstm_convergence_sequence_task(rng):
+    """Fused-RNN LSTM learns a sequence task: predict whether the sum of a
+    +-1 sequence is positive (long-context tier smoke)."""
+    T, N = 12, 256
+    seq = rng.choice([-1.0, 1.0], size=(T, N, 1)).astype("float32")
+    lab = (seq.sum(axis=0)[:, 0] > 0).astype("float32")
+    from mxnet_tpu.ops.rnn import rnn_packed_param_size
+    npar = rnn_packed_param_size("lstm", 1, False, 1, 16)
+
+    it = mx.io.NDArrayIter({"data": seq.transpose(1, 0, 2)}, lab,
+                           batch_size=64, label_name="sm_label")
+
+    # NDArrayIter batches on axis 0; RNN wants (T, N, I): transpose inside
+    params = mx.sym.Variable("rnn_params")
+    state = mx.sym.Variable("state")
+    cell = mx.sym.Variable("state_cell")
+    data_tnc = mx.sym.transpose(mx.sym.Variable("data"), axes=(1, 0, 2))
+    rnn = mx.sym.RNN(data_tnc, params, state, cell, mode="lstm",
+                     state_size=16, num_layers=1, name="lstm")
+    last = mx.sym.slice_axis(rnn, axis=0, begin=T - 1, end=T)
+    last = mx.sym.Reshape(last, shape=(-1, 16))
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(last, num_hidden=2, name="out"),
+        mx.sym.Variable("sm_label"), name="sm")
+
+    mod = mx.mod.Module(net, context=mx.cpu(),
+                        data_names=["data"], label_names=["sm_label"])
+    mod.bind(data_shapes=[("data", (64, T, 1))],
+             label_shapes=[("sm_label", (64,))])
+    mod.init_params(mx.init.Xavier())
+    # zero initial states, fixed
+    mod._exec_group.execs[0].arg_dict["rnn_params"]._set_data(
+        nd.array(rng.randn(npar).astype("float32") * 0.1)._data)
+    mod.init_optimizer(kvstore=None, optimizer="adam",
+                       optimizer_params={"learning_rate": 0.01})
+    for epoch in range(10):
+        it.reset()
+        for batch in it:
+            mod.forward_backward(batch)
+            mod.update()
+    it.reset()
+    acc = dict(mod.score(it, "acc"))["accuracy"]
+    assert acc >= 0.9, f"LSTM failed to converge: acc={acc}"
