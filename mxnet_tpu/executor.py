@@ -356,11 +356,12 @@ class Executor:
                                                                        rng)
             else:
                 outs, _ = self._compiled(False)(inputs, rng)
-        except MXNetError:
-            raise
         except (TypeError, ValueError) as e:
             # graph trace/compile failures (shape mismatches etc.) surface
-            # as MXNetError like the reference's bind-time CHECK failures
+            # as MXNetError like the reference's bind-time CHECK failures;
+            # stale state from a previous successful step must not survive
+            # into a later backward()
+            self._pending = None
             raise MXNetError(f"graph execution failed: {e}") from e
         if is_train:
             self._pending = (inputs, rng, outs, grads)

@@ -539,7 +539,7 @@ class ImageDetRecordIter(ImageRecordIter):
                  **kwargs):
         self.max_objs = int(max_objs)
         kwargs.setdefault("label_name", "label")
-        if kwargs.pop("rand_crop", False) or kwargs.pop("resize", -1) > 0:
+        if kwargs.pop("rand_crop", False) or float(kwargs.pop("resize", -1)) > 0:
             raise MXNetError(
                 "ImageDetRecordIter does not support rand_crop/resize: boxes "
                 "are normalized to the full image, which is resized straight "

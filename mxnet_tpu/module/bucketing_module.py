@@ -25,6 +25,16 @@ class BucketingModule(BaseModule):
                  state_names=None, group2ctxs=None, compression_params=None):
         super().__init__(logger)
         assert default_bucket_key is not None
+        if group2ctxs:
+            from ..symbol.symbol import _check_group2ctx
+            from ..context import current_context
+            base_ctx = context if context is not None else current_context()
+            base_ctx = base_ctx[0] if isinstance(base_ctx, (list, tuple)) \
+                else base_ctx
+            specs = group2ctxs if isinstance(group2ctxs, (list, tuple)) \
+                else [group2ctxs]
+            for spec in specs:
+                _check_group2ctx(base_ctx, spec)
         self._sym_gen = sym_gen
         self._default_bucket_key = default_bucket_key
         self._context = context

@@ -33,6 +33,13 @@ class Module(BaseModule):
         if isinstance(context, Context):
             context = [context]
         self._context = context
+        if group2ctxs:
+            # honor-or-raise like Symbol.bind (README de-scope #4)
+            from ..symbol.symbol import _check_group2ctx
+            specs = group2ctxs if isinstance(group2ctxs, (list, tuple)) \
+                else [group2ctxs]
+            for spec in specs:
+                _check_group2ctx(context[0], spec)
         self._symbol = symbol
         self._data_names = list(data_names or [])
         self._label_names = list(label_names or [])

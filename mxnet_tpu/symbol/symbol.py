@@ -252,12 +252,15 @@ class Symbol:
     def bind(self, ctx, args, args_grad=None, grad_req="write", aux_states=None,
              group2ctx=None, shared_exec=None):
         from ..executor import Executor
+        _check_group2ctx(ctx, group2ctx)
         return Executor(self, ctx, args, args_grad, grad_req, aux_states)
 
     def simple_bind(self, ctx, grad_req="write", type_dict=None, shared_arg_names=None,
-                    shared_exec=None, shared_buffer=None, **kwargs):
+                    shared_exec=None, shared_buffer=None, group2ctx=None,
+                    **kwargs):
         from .. import ndarray as nd
         from ..executor import Executor
+        _check_group2ctx(ctx, group2ctx)
         arg_shapes, _, aux_shapes = self.infer_shape(**kwargs)
         arg_names = self.list_arguments()
         aux_names = self.list_auxiliary_states()
@@ -294,6 +297,36 @@ class Symbol:
     def save(self, fname: str) -> None:
         with open(fname, "w") as f:
             f.write(self.tojson())
+
+
+def _check_group2ctx(ctx, group2ctx) -> None:
+    """Honor-or-raise for the reference's ctx_group placement spec
+    (symbol.py:1290 group2ctx → AssignContext, exec_utils.h:500).
+
+    On TPU, inter-layer model parallelism is expressed through mesh
+    sharding, not per-group device contexts: a group2ctx that maps every
+    group to the bind context is honored trivially; one that asks for
+    placement across DISTINCT devices raises with a pointer to the
+    sharding APIs instead of being silently dropped."""
+    if not group2ctx:
+        return
+    from ..base import MXNetError
+    from ..context import Context
+
+    def key(c):
+        c = Context(c) if not isinstance(c, Context) else c
+        return (c.device_type, c.device_id)
+
+    distinct = {key(c) for c in group2ctx.values()}
+    if ctx is not None:
+        distinct.add(key(ctx))
+    if len(distinct) > 1:
+        raise MXNetError(
+            "group2ctx placement across distinct devices is expressed via "
+            "mesh sharding on TPU: use mxnet_tpu.parallel.shard_gluon_params "
+            "(tensor/model parallel) or mxnet_tpu.parallel.pipeline "
+            "(inter-layer stages) instead of per-group contexts. See "
+            "README 'Design decisions & de-scopes'.")
 
 
 def Variable(name: str, attr=None, shape=None, lr_mult=None, wd_mult=None,

@@ -107,5 +107,5 @@ def test_waitall_after_error():
         nd.dot(a, nd.ones((4, 5)))
     except Exception:
         pass
-    nd.waitall() if hasattr(nd, "waitall") else mx.nd.waitall()
+    nd.waitall()
     np.testing.assert_allclose((a + 1).asnumpy(), np.full((2, 3), 2.0))

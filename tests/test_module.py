@@ -115,3 +115,15 @@ def test_bucketing_module(rng):
     m20 = bm._buckets[20]._exec_group.execs[0]
     m10 = bm._buckets[10]._exec_group.execs[0]
     assert m20.arg_dict["fc_shared_bias"] is m10.arg_dict["fc_shared_bias"]
+
+
+def test_module_group2ctxs_honor_or_raise():
+    import pytest
+    from mxnet_tpu.base import MXNetError
+    net = mx.sym.relu(mx.sym.Variable("data"))
+    # trivial spec accepted
+    mx.mod.Module(net, label_names=None, context=mx.cpu(),
+                  group2ctxs={"g": mx.cpu()})
+    with pytest.raises(MXNetError, match="sharding"):
+        mx.mod.Module(net, label_names=None, context=mx.cpu(),
+                      group2ctxs=[{"g": mx.cpu(1)}])

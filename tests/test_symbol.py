@@ -177,3 +177,19 @@ def test_util_np_shape():
     with mx.util.np_shape(True):
         assert mx.util.is_np_shape() is True
     assert mx.util.is_np_shape() is False
+
+
+def test_group2ctx_honor_or_raise():
+    """group2ctx: trivial spec honored, cross-device placement raises with
+    sharding guidance (README de-scope #4)."""
+    import pytest
+    from mxnet_tpu.base import MXNetError
+    a = mx.sym.Variable("a")
+    net = mx.sym.relu(a)
+    # trivial: all groups on the bind context -> honored
+    ex = net.simple_bind(mx.cpu(), a=(2, 2), group2ctx={"g0": mx.cpu()})
+    assert ex is not None
+    # distinct devices -> explicit error, not a silent drop
+    with pytest.raises(MXNetError, match="sharding"):
+        net.simple_bind(mx.cpu(), a=(2, 2),
+                        group2ctx={"g0": mx.cpu(1)})
