@@ -1,12 +1,24 @@
 """Automatic mixed precision (reference: the amp_cast/amp_multicast ops in
 ``src/operator/tensor/amp_cast.cc`` + python/mxnet/contrib/amp of later
-branches). On TPU the low-precision type is bfloat16 (MXU-native), not fp16.
+branches).
+
+TPU-first: the low-precision type is bfloat16 (MXU-native). bf16's exponent
+range matches fp32, so loss scaling is rarely REQUIRED — but the reference
+AMP API ships a dynamic loss scaler and some models still want one (tiny
+gradients underflow bf16's short-mantissa paths), so ``init_trainer`` +
+``scale_loss`` implement the real thing: scale the loss up, unscale inside
+``Trainer.step``, skip the update and halve the scale on overflow, double it
+after ``growth_interval`` clean steps.
 """
 from __future__ import annotations
 
+from contextlib import contextmanager
 from typing import Optional
 
 from ..base import MXNetError
+
+__all__ = ["init", "is_enabled", "convert_hybrid_block", "init_trainer",
+           "scale_loss", "LossScaler"]
 
 _state = {"enabled": False, "dtype": "bfloat16"}
 
@@ -20,6 +32,77 @@ def init(target_dtype: str = "bfloat16") -> None:
 
 def is_enabled() -> bool:
     return _state["enabled"]
+
+
+class LossScaler:
+    """Dynamic loss scaling (reference amp/loss_scaler.py): multiply the
+    loss by ``loss_scale``; on non-finite grads skip the step and halve,
+    after ``growth_interval`` good steps double (capped at 2**24)."""
+
+    def __init__(self, init_scale: float = 2.0 ** 10,
+                 growth_interval: int = 200):
+        self.loss_scale = float(init_scale)
+        self.growth_interval = growth_interval
+        self._good_steps = 0
+
+    def has_overflow(self, params) -> bool:
+        """Device-side finiteness check: one reduced scalar crosses to the
+        host (the reference's multi_all_finite), never the gradients."""
+        import jax.numpy as jnp
+        from ..ndarray.ndarray import _unwrap
+        bad = None
+        for p in params:
+            if p.grad_req == "null":
+                continue
+            g = p.grad
+            if g is None:
+                continue
+            cnt = jnp.sum(~jnp.isfinite(_unwrap(g)))
+            bad = cnt if bad is None else bad + cnt
+        return bool(bad) if bad is not None else False
+
+    def update(self, overflow: bool) -> None:
+        if overflow:
+            self.loss_scale = max(self.loss_scale / 2.0, 1.0)
+            self._good_steps = 0
+        else:
+            self._good_steps += 1
+            if self._good_steps >= self.growth_interval:
+                self.loss_scale = min(self.loss_scale * 2.0, 2.0 ** 24)
+                self._good_steps = 0
+
+
+def init_trainer(trainer, loss_scaler: Optional[LossScaler] = None) -> None:
+    """Attach a dynamic loss scaler to a gluon Trainer and wrap its step:
+    grads are unscaled via the trainer's rescale machinery; overflowed steps
+    are SKIPPED (the reference amp trainer hook)."""
+    scaler = loss_scaler or LossScaler()
+    trainer._amp_loss_scaler = scaler
+    orig_step = trainer.step
+
+    def step(batch_size, ignore_stale_grad=False):
+        overflow = scaler.has_overflow(trainer._params)
+        if not overflow:
+            # fold the unscale into the optimizer's rescale_grad
+            orig_step(batch_size * scaler.loss_scale,
+                      ignore_stale_grad=ignore_stale_grad)
+        scaler.update(overflow)
+
+    trainer.step = step
+
+
+@contextmanager
+def scale_loss(loss, trainer):
+    """``with amp.scale_loss(loss, trainer) as scaled: scaled.backward()`` —
+    multiplies the loss by the current dynamic scale; the wrapped
+    trainer.step unscales and handles overflow."""
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        raise MXNetError("call amp.init_trainer(trainer) before scale_loss")
+    if isinstance(loss, (list, tuple)):
+        yield [l * scaler.loss_scale for l in loss]
+    else:
+        yield loss * scaler.loss_scale
 
 
 def convert_hybrid_block(net, target_dtype: Optional[str] = None):

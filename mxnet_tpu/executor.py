@@ -363,6 +363,9 @@ class Executor:
             # into a later backward()
             self._pending = None
             raise MXNetError(f"graph execution failed: {e}") from e
+        except Exception:
+            self._pending = None
+            raise
         if is_train:
             self._pending = (inputs, rng, outs, grads)
             for name, val in aux_updates.items():

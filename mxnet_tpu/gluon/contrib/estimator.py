@@ -1,32 +1,228 @@
-"""Minimal training-loop estimator (reference: gluon/contrib/estimator)."""
+"""Gluon Estimator with event handlers (reference
+``python/mxnet/gluon/contrib/estimator``: estimator.py + event_handler.py).
+
+Training facade over net/loss/trainer with a handler pipeline: handlers
+receive train_begin/epoch_begin/batch_begin/batch_end/epoch_end/train_end
+events and can read/write the shared ``est`` state (metrics, stop flag).
+LoggingHandler, CheckpointHandler, EarlyStoppingHandler and
+ValidationHandler mirror the reference set.
+"""
 from __future__ import annotations
+
+import logging
+import time
+from typing import List, Optional, Sequence
 
 from ... import autograd
 from ...base import MXNetError
 
-__all__ = ["Estimator"]
+__all__ = ["Estimator", "EventHandler", "LoggingHandler", "CheckpointHandler",
+           "EarlyStoppingHandler", "ValidationHandler"]
+
+
+class EventHandler:
+    def train_begin(self, est):
+        pass
+
+    def train_end(self, est):
+        pass
+
+    def epoch_begin(self, est):
+        pass
+
+    def epoch_end(self, est):
+        pass
+
+    def batch_begin(self, est):
+        pass
+
+    def batch_end(self, est):
+        pass
+
+
+class LoggingHandler(EventHandler):
+    """Per-epoch (and optionally per-N-batch) metric logging (reference
+    event_handler.py:LoggingHandler)."""
+
+    def __init__(self, log_interval: Optional[int] = None, logger=None):
+        self.log_interval = log_interval
+        self.logger = logger or logging.getLogger("estimator")
+
+    def epoch_begin(self, est):
+        self._tic = time.time()
+
+    def batch_end(self, est):
+        if self.log_interval and est.batch_idx % self.log_interval == 0:
+            msg = ", ".join(f"{m.get()[0]}={m.get()[1]:.4f}"
+                            for m in est.train_metrics)
+            self.logger.info("epoch %d batch %d: %s", est.epoch,
+                             est.batch_idx, msg)
+
+    def epoch_end(self, est):
+        msg = ", ".join(f"{m.get()[0]}={m.get()[1]:.4f}"
+                        for m in est.train_metrics + est.val_metrics)
+        self.logger.info("epoch %d done in %.1fs: %s", est.epoch,
+                         time.time() - self._tic, msg)
+
+
+class CheckpointHandler(EventHandler):
+    """Save parameters every epoch; keep the best by a monitored metric
+    (reference event_handler.py:CheckpointHandler)."""
+
+    def __init__(self, model_dir: str, model_prefix: str = "model",
+                 monitor=None, save_best: bool = False, mode: str = "min"):
+        import os
+        os.makedirs(model_dir, exist_ok=True)
+        self.model_dir = model_dir
+        self.model_prefix = model_prefix
+        self.monitor = monitor
+        self.save_best = save_best
+        self.mode = mode
+        self.best = float("inf") if mode == "min" else -float("inf")
+
+    def epoch_end(self, est):
+        import os
+        path = os.path.join(self.model_dir,
+                            f"{self.model_prefix}-{est.epoch:04d}.params")
+        est.net.save_parameters(path)
+        if self.save_best and self.monitor is not None:
+            val = self.monitor.get()[1]
+            better = val < self.best if self.mode == "min" else val > self.best
+            if better:
+                self.best = val
+                est.net.save_parameters(os.path.join(
+                    self.model_dir, f"{self.model_prefix}-best.params"))
+
+
+class EarlyStoppingHandler(EventHandler):
+    """Stop when a monitored metric stops improving (reference
+    event_handler.py:EarlyStoppingHandler)."""
+
+    def __init__(self, monitor, patience: int = 2, mode: str = "min",
+                 min_delta: float = 0.0):
+        self.monitor = monitor
+        self.patience = patience
+        self.mode = mode
+        self.min_delta = min_delta
+        self.best = float("inf") if mode == "min" else -float("inf")
+        self.waited = 0
+
+    def epoch_end(self, est):
+        val = self.monitor.get()[1]
+        improved = (val < self.best - self.min_delta if self.mode == "min"
+                    else val > self.best + self.min_delta)
+        if improved:
+            self.best = val
+            self.waited = 0
+        else:
+            self.waited += 1
+            if self.waited >= self.patience:
+                est.stop_training = True
+
+
+class ValidationHandler(EventHandler):
+    """Run validation each epoch (reference event_handler.py:
+    ValidationHandler)."""
+
+    def __init__(self, val_data, eval_fn):
+        self.val_data = val_data
+        self.eval_fn = eval_fn
+
+    def epoch_end(self, est):
+        self.eval_fn(self.val_data)
 
 
 class Estimator:
-    def __init__(self, net, loss, metrics=None, trainer=None, context=None):
+    """Train/validate a gluon net with a handler pipeline (reference
+    estimator.py:Estimator.fit)."""
+
+    def __init__(self, net, loss, train_metrics=None, trainer=None,
+                 context=None):
         self.net = net
         self.loss = loss
-        self.metrics = metrics or []
+        self.train_metrics = list(train_metrics or [])
+        self.val_metrics: List = []
         self.trainer = trainer
         self.context = context
+        self.epoch = 0
+        self.batch_idx = 0
+        self.stop_training = False
 
-    def fit(self, train_data, val_data=None, epochs=1):
+    # ------------------------------------------------------------- loops
+    def evaluate(self, val_data, metrics: Optional[Sequence] = None):
+        metrics = list(metrics if metrics is not None else self.val_metrics
+                       or self.train_metrics)
+        for m in metrics:
+            m.reset()
+        if hasattr(val_data, "reset"):
+            val_data.reset()
+        for batch in val_data:
+            data, label = _split(batch)
+            out = self.net(data)
+            for m in metrics:
+                m.update(label, out)
+        return [(m.get()) for m in metrics]
+
+    def fit(self, train_data, val_data=None, epochs: int = 1,
+            event_handlers: Optional[List[EventHandler]] = None,
+            batches: Optional[int] = None):
         if self.trainer is None:
             raise MXNetError("Estimator needs a trainer")
+        handlers = list(event_handlers or [])
+        if not any(isinstance(h, LoggingHandler) for h in handlers):
+            handlers.append(LoggingHandler())
+        if val_data is not None:
+            if not self.val_metrics:
+                # independent metric instances so validation never clobbers
+                # the training numbers
+                import copy
+                self.val_metrics = [copy.deepcopy(m)
+                                    for m in self.train_metrics]
+                for m in self.val_metrics:
+                    m.name = f"val_{m.name}"
+                    m.reset()
+            if not any(isinstance(h, ValidationHandler) for h in handlers):
+                handlers.append(ValidationHandler(
+                    val_data,
+                    lambda vd: self.evaluate(vd, self.val_metrics)))
+        self.stop_training = False
+        for h in handlers:
+            h.train_begin(self)
         for epoch in range(epochs):
-            for m in self.metrics:
+            self.epoch = epoch
+            for m in self.train_metrics:
                 m.reset()
-            for batch in train_data:
-                data, label = batch[0], batch[1]
+            for h in handlers:
+                h.epoch_begin(self)
+            if hasattr(train_data, "reset"):
+                train_data.reset()
+            for i, batch in enumerate(train_data):
+                if batches is not None and i >= batches:
+                    break
+                self.batch_idx = i
+                for h in handlers:
+                    h.batch_begin(self)
+                data, label = _split(batch)
                 with autograd.record():
                     out = self.net(data)
                     loss = self.loss(out, label)
                 loss.backward()
                 self.trainer.step(data.shape[0])
-                for m in self.metrics:
+                for m in self.train_metrics:
                     m.update(label, out)
+                for h in handlers:
+                    h.batch_end(self)
+            for h in handlers:
+                h.epoch_end(self)
+            if self.stop_training:
+                break
+        for h in handlers:
+            h.train_end(self)
+        return self
+
+
+def _split(batch):
+    """Accept (data, label) tuples and DataBatch objects."""
+    if hasattr(batch, "data"):
+        return batch.data[0], batch.label[0]
+    return batch[0], batch[1]

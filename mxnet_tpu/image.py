@@ -23,7 +23,10 @@ __all__ = ["imread", "imdecode", "imresize", "resize_short", "fixed_crop",
            "ResizeAug", "ForceResizeAug", "RandomCropAug", "CenterCropAug",
            "HorizontalFlipAug", "BrightnessJitterAug", "ContrastJitterAug",
            "SaturationJitterAug", "ColorJitterAug", "LightingAug", "CastAug",
-           "CreateAugmenter", "ImageIter"]
+           "HueJitterAug", "RandomGrayAug", "RandomOrderAug",
+           "CreateAugmenter", "ImageIter",
+           "DetAugmenter", "DetBorrowAug", "DetHorizontalFlipAug",
+           "DetRandomCropAug", "DetRandomPadAug", "CreateDetAugmenter"]
 
 
 def imdecode(buf, flag=1, to_rgb=True, **kwargs) -> NDArray:
@@ -46,8 +49,16 @@ def imread(filename, flag=1, to_rgb=True) -> NDArray:
 
 
 def imresize(src: NDArray, w: int, h: int, interp=1) -> NDArray:
-    from PIL import Image
     arr = src.asnumpy()
+    if arr.dtype != np.uint8:
+        # PIL only takes uint8 HWC; float images (mid-pipeline after jitter
+        # or padding) resize through jax.image instead
+        import jax
+        out = np.asarray(jax.image.resize(
+            arr, (h, w) + arr.shape[2:],
+            method="nearest" if interp == 0 else "bilinear"))
+        return nd.array(out, dtype=str(src.dtype))
+    from PIL import Image
     pil = Image.fromarray(arr.squeeze() if arr.shape[-1] == 1 else arr)
     out = np.asarray(pil.resize((w, h),
                                 Image.NEAREST if interp == 0 else Image.BILINEAR))
@@ -229,6 +240,61 @@ class LightingAug(Augmenter):
         return src.astype("float32", copy=False) + nd.array(rgb.reshape(1, 1, 3))
 
 
+class HueJitterAug(Augmenter):
+    """Random hue rotation in YIQ space (reference image.py:HueJitterAug)."""
+
+    def __init__(self, hue):
+        super().__init__(hue=hue)
+        self.hue = hue
+        self.tyiq = np.array([[0.299, 0.587, 0.114],
+                              [0.596, -0.274, -0.321],
+                              [0.211, -0.523, 0.311]], "float32")
+        self.ityiq = np.array([[1.0, 0.956, 0.621],
+                               [1.0, -0.272, -0.647],
+                               [1.0, -1.107, 1.705]], "float32")
+
+    def __call__(self, src):
+        alpha = random.uniform(-self.hue, self.hue)
+        u, w = np.cos(alpha * np.pi), np.sin(alpha * np.pi)
+        bt = np.array([[1.0, 0.0, 0.0],
+                       [0.0, u, -w],
+                       [0.0, w, u]], "float32")
+        t = np.dot(np.dot(self.ityiq, bt), self.tyiq).T
+        return nd.dot(src.astype("float32", copy=False), nd.array(t))
+
+
+class RandomGrayAug(Augmenter):
+    """Randomly convert to 3-channel grayscale (reference RandomGrayAug)."""
+
+    def __init__(self, p=0.5):
+        super().__init__(p=p)
+        self.p = p
+        self.mat = np.array([[0.21, 0.21, 0.21],
+                             [0.72, 0.72, 0.72],
+                             [0.07, 0.07, 0.07]], "float32")
+
+    def __call__(self, src):
+        if random.random() < self.p:
+            return nd.dot(src.astype("float32", copy=False),
+                          nd.array(self.mat))
+        return src
+
+
+class RandomOrderAug(Augmenter):
+    """Apply child augmenters in random order (reference RandomOrderAug)."""
+
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = list(ts)
+
+    def __call__(self, src):
+        ts = list(self.ts)
+        random.shuffle(ts)
+        for t in ts:
+            src = t(src)
+        return src
+
+
 class CastAug(Augmenter):
     def __init__(self, typ="float32"):
         super().__init__(typ=typ)
@@ -256,6 +322,10 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
     auglist.append(CastAug())
     if brightness or contrast or saturation:
         auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if kwargs.get("hue"):
+        auglist.append(HueJitterAug(kwargs["hue"]))
+    if kwargs.get("rand_gray"):
+        auglist.append(RandomGrayAug(kwargs["rand_gray"]))
     if pca_noise > 0:
         eigval = [55.46, 4.794, 1.148]
         eigvec = [[-0.5675, 0.7192, 0.4009],
@@ -347,3 +417,10 @@ class ImageIter:
                          pad=pad)
 
     __next__ = next
+
+
+# detection augmenters live in their own module but are exposed here like
+# the reference's mxnet.image namespace (python/mxnet/image/detection.py)
+from .image_detection import (DetAugmenter, DetBorrowAug,            # noqa: E402,F401
+                              DetHorizontalFlipAug, DetRandomCropAug,
+                              DetRandomPadAug, CreateDetAugmenter)

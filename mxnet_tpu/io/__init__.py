@@ -2,7 +2,7 @@
 iterators in ``src/io/``)."""
 from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter,
                  PrefetchingIter, CSVIter, ImageRecordIter,
-                 ImageDetRecordIter, MNISTIter)
+                 ImageDetRecordIter, MNISTIter, LibSVMIter)
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
-           "PrefetchingIter", "CSVIter", "ImageRecordIter", "ImageDetRecordIter", "MNISTIter"]
+           "PrefetchingIter", "CSVIter", "ImageRecordIter", "ImageDetRecordIter", "MNISTIter", "LibSVMIter"]

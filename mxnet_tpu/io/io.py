@@ -23,7 +23,7 @@ from ..base import MXNetError
 from ..ndarray import NDArray
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
-           "PrefetchingIter", "CSVIter", "ImageRecordIter", "ImageDetRecordIter", "MNISTIter"]
+           "PrefetchingIter", "CSVIter", "ImageRecordIter", "ImageDetRecordIter", "MNISTIter", "LibSVMIter"]
 
 
 class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
@@ -578,3 +578,108 @@ class ImageDetRecordIter(ImageRecordIter):
                 x1, x2 = 1.0 - x2, 1.0 - x1
             out[i] = (cls, x1, y1, x2, y2)
         return chw, out
+
+
+class LibSVMIter(DataIter):
+    """LibSVM text-format iterator (reference src/io/iter_libsvm.cc):
+    ``label idx:val idx:val ...`` per line, 0- or 1-based indices. Batches
+    come out as CSRNDArray so sparse pipelines (linear models, sparse dot)
+    keep compact storage end to end."""
+
+    def __init__(self, data_libsvm, data_shape, label_libsvm=None,
+                 label_shape=(1,), batch_size=1, round_batch=True,
+                 data_name="data", label_name="softmax_label",
+                 indexing_mode="auto", **kwargs):
+        """``indexing_mode``: 0 (features numbered 0..ncol-1), 1 (the
+        canonical 1..ncol libsvm numbering), or "auto" — 1-based iff the
+        maximum observed index equals ncol. Auto cannot distinguish a
+        1-based file that never uses feature ncol; pass the mode explicitly
+        when that matters. Out-of-range indices after decoding raise."""
+        super().__init__(batch_size)
+        self.data_name, self.label_name = data_name, label_name
+        self.data_shape = tuple(data_shape)
+        ncol = int(np.prod(self.data_shape))
+        labels, indptr, indices, values = [], [0], [], []
+        with open(data_libsvm) as f:
+            for line in f:
+                parts = line.split()
+                if not parts:
+                    continue
+                labels.append(float(parts[0]))
+                for tok in parts[1:]:
+                    i, v = tok.split(":")
+                    indices.append(int(i))
+                    values.append(float(v))
+                indptr.append(len(indices))
+        indices = np.asarray(indices, np.int64)
+        if indexing_mode == "auto":
+            indexing_mode = 1 if indices.size and indices.max() >= ncol else 0
+        if int(indexing_mode) == 1:
+            indices = indices - 1
+        if indices.size and (indices.min() < 0 or indices.max() >= ncol):
+            raise MXNetError(
+                f"libsvm feature index out of range for data_shape "
+                f"{self.data_shape} with indexing_mode={indexing_mode}: "
+                f"[{indices.min()}, {indices.max()}]")
+        self._values = np.asarray(values, "float32")
+        self._indices = indices
+        self._indptr = np.asarray(indptr, np.int64)
+        self._labels = np.asarray(labels, "float32")
+        if label_libsvm is not None:
+            ext_labels = []
+            with open(label_libsvm) as f:
+                for line in f:
+                    if line.split():
+                        ext_labels.append(
+                            [float(t) for t in line.split()[:1 if
+                             label_shape == (1,) else None]])
+            self._labels = np.asarray(ext_labels, "float32").reshape(
+                (-1,) + tuple(label_shape))
+            if self._labels.shape[-1] == 1:
+                self._labels = self._labels.reshape(self._labels.shape[:-1])
+        self._nrows = len(self._indptr) - 1
+        self._round = round_batch
+        self._pos = 0
+
+    @property
+    def provide_data(self):
+        return [DataDesc(self.data_name,
+                         (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        shape = (self.batch_size,) if self._labels.ndim == 1 else \
+            (self.batch_size,) + self._labels.shape[1:]
+        return [DataDesc(self.label_name, shape)]
+
+    def reset(self):
+        self._pos = 0
+
+    def next(self) -> DataBatch:
+        from ..ndarray import sparse as sp
+        if self._pos >= self._nrows:
+            raise StopIteration
+        end = min(self._pos + self.batch_size, self._nrows)
+        rows = list(range(self._pos, end))
+        pad = self.batch_size - len(rows)
+        if pad and self._round:
+            rows += [self._pos] * pad                 # wrap-pad like the ref
+        else:
+            pad = 0                                   # short final batch
+        ptr = [0]
+        idx, val = [], []
+        lab = []
+        for r in rows:
+            s, e = self._indptr[r], self._indptr[r + 1]
+            idx.extend(self._indices[s:e])
+            val.extend(self._values[s:e])
+            ptr.append(len(idx))
+            lab.append(self._labels[r])
+        self._pos = end
+        ncol = int(np.prod(self.data_shape))
+        data = sp.csr_matrix(
+            (np.asarray(val, "float32"), np.asarray(idx, np.int64),
+             np.asarray(ptr, np.int64)),
+            shape=(len(rows), ncol))
+        return DataBatch(data=[data], label=[nd.array(np.asarray(lab))],
+                         pad=pad)

@@ -1,16 +1,21 @@
-"""Checkpoint helpers (reference: ``python/mxnet/model.py`` —
-save_checkpoint/load_checkpoint :388-418; the FeedForward legacy class is
-superseded by Module/Gluon and intentionally not reproduced).
+"""Checkpoint helpers + the legacy FeedForward facade (reference:
+``python/mxnet/model.py`` — save_checkpoint/load_checkpoint :388-418,
+FeedForward :419+). FeedForward here is a thin adapter over Module, which is
+how the reference itself implements it post-Module.
 """
 from __future__ import annotations
+
+import numpy as np
 
 from typing import Dict, Tuple
 
 from . import ndarray as nd
 from . import symbol as sym_mod
+from .base import MXNetError
 from .ndarray import NDArray
 
-__all__ = ["save_checkpoint", "load_checkpoint", "load_params", "BatchEndParam"]
+__all__ = ["save_checkpoint", "load_checkpoint", "load_params",
+           "BatchEndParam", "FeedForward"]
 
 from .module.base_module import BatchEndParam  # re-export for parity
 
@@ -42,3 +47,110 @@ def load_checkpoint(prefix: str, epoch: int):
     symbol = sym_mod.load(f"{prefix}-symbol.json")
     arg_params, aux_params = load_params(prefix, epoch)
     return symbol, arg_params, aux_params
+
+
+class FeedForward:
+    """Legacy training facade (reference model.py:FeedForward) — fit/predict/
+    score/save/load over a Module. Kept so pre-Module reference scripts run;
+    new code should use Module or Gluon directly."""
+
+    def __init__(self, symbol, ctx=None, num_epoch=None, optimizer="sgd",
+                 initializer=None, arg_params=None, aux_params=None,
+                 begin_epoch=0, **kwargs):
+        from . import context as ctx_mod
+        from . import initializer as init_mod
+        self.symbol = symbol
+        self.ctx = ctx if ctx is not None else ctx_mod.current_context()
+        self.num_epoch = num_epoch
+        self.optimizer = optimizer
+        self.initializer = initializer or init_mod.Uniform(0.01)
+        self.arg_params = arg_params
+        self.aux_params = aux_params
+        self.begin_epoch = begin_epoch
+        # every remaining kwarg goes straight to the optimizer, like the
+        # reference FeedForward's **kwargs passthrough
+        self._opt_kwargs = dict(kwargs)
+        self._module = None
+
+    def _label_name(self):
+        outs = self.symbol.list_outputs()
+        name = outs[0]
+        base = name[:-len("_output")] if name.endswith("_output") else name
+        return f"{base}_label"
+
+    def fit(self, X, y=None, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None,
+            kvstore="local", logger=None):
+        from . import io as io_mod
+        from . import module as mod_mod
+        data = X if isinstance(X, io_mod.DataIter) else io_mod.NDArrayIter(
+            np.asarray(X), np.asarray(y), batch_size=min(128, len(X)),
+            label_name=self._label_name())
+        label_names = [d.name for d in (data.provide_label or [])] or None
+        self._module = mod_mod.Module(self.symbol, context=self.ctx,
+                                      data_names=[d.name for d in
+                                                  data.provide_data],
+                                      label_names=label_names)
+        if self.num_epoch is None:
+            raise MXNetError("FeedForward.fit requires num_epoch")
+        self._module.fit(
+            data, eval_data=eval_data, eval_metric=eval_metric,
+            epoch_end_callback=epoch_end_callback,
+            batch_end_callback=batch_end_callback, kvstore=kvstore,
+            optimizer=self.optimizer, optimizer_params=self._opt_kwargs or
+            (("learning_rate", 0.01),), initializer=self.initializer,
+            arg_params=self.arg_params, aux_params=self.aux_params,
+            allow_missing=self.arg_params is not None,
+            begin_epoch=self.begin_epoch, num_epoch=self.num_epoch)
+        self.arg_params, self.aux_params = self._module.get_params()
+        return self
+
+    def predict(self, X, num_batch=None):
+        from . import io as io_mod
+        from . import module as mod_mod
+        data = X if isinstance(X, io_mod.DataIter) else io_mod.NDArrayIter(
+            np.asarray(X), batch_size=min(128, len(X)))
+        if self._module is None:
+            # output-layer labels (softmax_label etc.) are unused at
+            # inference but still listed as graph arguments; bind them with
+            # (batch,) placeholders so shape inference closes
+            label_args = [n for n in self.symbol.list_arguments()
+                          if n.endswith("_label")]
+            batch = data.provide_data[0].shape[0]
+            mod = mod_mod.Module(self.symbol, context=self.ctx,
+                                 data_names=[d.name for d in
+                                             data.provide_data],
+                                 label_names=label_args or None)
+            mod.bind(data_shapes=data.provide_data,
+                     label_shapes=[(n, (batch,)) for n in label_args]
+                     or None, for_training=False)
+            mod.set_params(self.arg_params, self.aux_params or {})
+            self._module = mod
+        outs = self._module.predict(data, num_batch=num_batch)
+        first = outs[0] if isinstance(outs, list) else outs
+        return first.asnumpy()
+
+    def score(self, X, eval_metric="acc", num_batch=None):
+        res = self._module.score(X, eval_metric, num_batch=num_batch)
+        return dict(res).popitem()[1]
+
+    def save(self, prefix, epoch=None):
+        epoch = epoch if epoch is not None else (self.num_epoch or 0)
+        save_checkpoint(prefix, epoch, self.symbol, self.arg_params or {},
+                        self.aux_params or {})
+
+    @staticmethod
+    def load(prefix, epoch, ctx=None, **kwargs):
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        return FeedForward(symbol, ctx=ctx, arg_params=arg_params,
+                           aux_params=aux_params, begin_epoch=epoch, **kwargs)
+
+
+def create(symbol, X, y=None, ctx=None, num_epoch=None, **kwargs):
+    """Functional alias (reference model.py FeedForward.create)."""
+    model = FeedForward(symbol, ctx=ctx, num_epoch=num_epoch, **kwargs)
+    model.fit(X, y)
+    return model
+
+
+FeedForward.create = staticmethod(create)

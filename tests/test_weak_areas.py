@@ -1,0 +1,219 @@
+"""Tests for the deepened subsystems: LibSVMIter, det/hue/gray augmenters,
+Estimator event handlers, FeedForward facade, AMP dynamic loss scaling."""
+import os
+import random
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+
+
+# ------------------------------------------------------------ LibSVMIter
+def test_libsvm_iter_sparse_batches():
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "t.libsvm")
+        with open(p, "w") as f:
+            f.write("1 0:1.5 3:2.0\n0 1:0.5\n1 2:3.0 3:1.0\n")
+        it = mx.io.LibSVMIter(p, data_shape=(4,), batch_size=2)
+        b = it.next()
+        from mxnet_tpu.ndarray.sparse import CSRNDArray
+        assert isinstance(b.data[0], CSRNDArray)
+        np.testing.assert_allclose(
+            b.data[0].asnumpy(),
+            [[1.5, 0, 0, 2.0], [0, 0.5, 0, 0]])
+        np.testing.assert_allclose(b.label[0].asnumpy(), [1, 0])
+        b2 = it.next()                     # padded final batch
+        assert b2.pad == 1
+        with pytest.raises(StopIteration):
+            it.next()
+        it.reset()
+        assert it.next().pad == 0
+
+
+# ------------------------------------------------------------ augmenters
+def test_hue_and_gray_augmenters(rng):
+    random.seed(11)
+    src = mx.nd.array((rng.rand(8, 8, 3) * 255).astype("float32"))
+    out = mx.image.HueJitterAug(0.3)(src)
+    assert out.shape == src.shape
+    gray = mx.image.RandomGrayAug(1.0)(src).asnumpy()
+    np.testing.assert_allclose(gray[..., 0], gray[..., 1], rtol=1e-5)
+
+
+def test_det_flip_adjusts_boxes(rng):
+    random.seed(1)
+    src = mx.nd.array((rng.rand(8, 8, 3) * 255).astype("float32"))
+    label = np.array([[0, 0.1, 0.2, 0.5, 0.7]], "float32")
+    aug = mx.image.DetHorizontalFlipAug(p=1.0)
+    _, out = aug(src, label)
+    np.testing.assert_allclose(out[0], [0, 0.5, 0.2, 0.9, 0.7], rtol=1e-6)
+
+
+def test_det_random_crop_keeps_box_validity(rng):
+    random.seed(5)
+    src = mx.nd.array((rng.rand(32, 32, 3) * 255).astype("float32"))
+    label = np.array([[2, 0.3, 0.3, 0.7, 0.7]], "float32")
+    aug = mx.image.DetRandomCropAug(min_object_covered=0.5)
+    out_img, out_label = aug(src, label)
+    valid = out_label[out_label[:, 0] >= 0]
+    assert len(valid) >= 1
+    assert (valid[:, 1:] >= -1e-6).all() and (valid[:, 1:] <= 1 + 1e-6).all()
+
+
+# ------------------------------------------------------------ Estimator
+def _toy_net_and_data(rng):
+    X = rng.randn(64, 4).astype("float32")
+    y = (X.sum(1) > 0).astype("float32")
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(8, activation="relu"))
+    net.add(gluon.nn.Dense(2))
+    net.initialize(mx.init.Xavier())
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.3}, kvstore=None)
+    data = mx.io.NDArrayIter(X, y, batch_size=16)
+    return net, tr, data
+
+
+def test_estimator_with_handlers(rng, tmp_path):
+    from mxnet_tpu.gluon.contrib.estimator import (
+        CheckpointHandler, EarlyStoppingHandler, Estimator, LoggingHandler)
+    net, tr, data = _toy_net_and_data(rng)
+    acc = mx.metric.Accuracy()
+    est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                    train_metrics=[acc], trainer=tr)
+    ckpt = CheckpointHandler(str(tmp_path), monitor=acc, save_best=True,
+                             mode="max")
+    stop = EarlyStoppingHandler(monitor=acc, patience=100, mode="max")
+    est.fit(data, epochs=6, event_handlers=[LoggingHandler(), ckpt, stop])
+    assert acc.get()[1] > 0.8
+    assert os.path.exists(os.path.join(str(tmp_path), "model-0005.params"))
+    assert os.path.exists(os.path.join(str(tmp_path), "model-best.params"))
+
+    # early stopping actually stops: patience 0 on a flat metric
+    class _Flat:
+        def get(self):
+            return ("flat", 0.0)
+    est2 = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                     train_metrics=[acc], trainer=tr)
+    est2.fit(data, epochs=50,
+             event_handlers=[EarlyStoppingHandler(monitor=_Flat(),
+                                                  patience=2)])
+    assert est2.epoch < 49                      # stopped early
+
+
+def test_estimator_evaluate(rng):
+    from mxnet_tpu.gluon.contrib.estimator import Estimator
+    net, tr, data = _toy_net_and_data(rng)
+    est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                    train_metrics=[mx.metric.Accuracy()], trainer=tr)
+    est.fit(data, epochs=4)
+    data.reset()
+    res = est.evaluate(data)
+    assert res[0][1] > 0.7
+
+
+# ------------------------------------------------------------ FeedForward
+def test_feedforward_fit_predict_save_load(rng, tmp_path):
+    X = rng.randn(64, 5).astype("float32")
+    y = (X.sum(1) > 0).astype("float32")
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=8)
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=2)
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    model = mx.model.FeedForward(net, ctx=mx.cpu(), num_epoch=30,
+                                 learning_rate=0.3)
+    model.fit(X, y)
+    pred = model.predict(X)
+    assert pred.shape == (64, 2)
+    acc = (pred.argmax(1) == y.astype(int)).mean()
+    assert acc > 0.85, acc
+
+    prefix = os.path.join(str(tmp_path), "ff")
+    model.save(prefix)
+    loaded = mx.model.FeedForward.load(prefix, 30, ctx=mx.cpu())
+    pred2 = loaded.predict(X)
+    np.testing.assert_allclose(pred2, pred, rtol=1e-5, atol=1e-6)
+
+
+# ------------------------------------------------------------ AMP
+def test_amp_loss_scaling_trains_and_skips_overflow(rng):
+    from mxnet_tpu.contrib import amp
+    X = rng.randn(32, 4).astype("float32")
+    y = (X.sum(1) > 0).astype("float32")
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(8, activation="relu"), gluon.nn.Dense(2))
+    net.initialize(mx.init.Xavier())
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.2}, kvstore=None)
+    amp.init_trainer(tr)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    xs, ys = nd.array(X), nd.array(y)
+    net(xs)                    # materialize deferred-init params
+    before = [p.data().asnumpy().copy()
+              for p in net.collect_params().values()]
+    for _ in range(20):
+        with autograd.record():
+            loss = loss_fn(net(xs), ys)
+            with amp.scale_loss(loss, tr) as scaled:
+                pass           # the scaling multiply must be recorded
+        scaled.backward()
+        tr.step(32)
+    after = [p.data().asnumpy() for p in net.collect_params().values()]
+    assert any(not np.allclose(b, a) for b, a in zip(before, after))
+    pred = net(xs).asnumpy().argmax(1)
+    assert (pred == y.astype(int)).mean() > 0.8
+
+    # overflow: poison a grad with inf -> step skipped, scale halves
+    scaler = tr._amp_loss_scaler
+    old_scale = scaler.loss_scale
+    p0 = list(net.collect_params().values())[0]
+    snapshot = p0.data().asnumpy().copy()
+    p0.grad[:] = np.inf
+    tr.step(32)
+    assert scaler.loss_scale == max(old_scale / 2, 1.0)
+    np.testing.assert_allclose(p0.data().asnumpy(), snapshot)
+
+
+def test_libsvm_indexing_modes_and_round_batch():
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "one_based.libsvm")
+        with open(p, "w") as f:
+            f.write("1 1:5.0 4:2.0\n0 2:1.0\n")        # canonical 1-based
+        it = mx.io.LibSVMIter(p, data_shape=(4,), batch_size=2,
+                              indexing_mode=1)
+        np.testing.assert_allclose(it.next().data[0].asnumpy(),
+                                   [[5, 0, 0, 2], [0, 1, 0, 0]])
+        # explicit 0-based on a file with index 4 must raise, not shift
+        with pytest.raises(mx.MXNetError, match="out of range"):
+            mx.io.LibSVMIter(p, data_shape=(4,), batch_size=2,
+                             indexing_mode=0)
+        # round_batch=False yields the short final batch
+        it = mx.io.LibSVMIter(p, data_shape=(4,), batch_size=2,
+                              indexing_mode=1)
+        it.next()
+        p2 = os.path.join(d, "three.libsvm")
+        with open(p2, "w") as f:
+            f.write("1 0:1.0\n0 1:1.0\n1 2:1.0\n")
+        it = mx.io.LibSVMIter(p2, data_shape=(4,), batch_size=2,
+                              round_batch=False)
+        it.next()
+        short = it.next()
+        assert short.data[0].shape == (1, 4) and short.pad == 0
+
+
+def test_estimator_validation_metrics_separate(rng):
+    from mxnet_tpu.gluon.contrib.estimator import Estimator
+    net, tr, data = _toy_net_and_data(rng)
+    Xv = np.asarray(rng.randn(32, 4), "float32")
+    yv = (Xv.sum(1) > 0).astype("float32")
+    val = mx.io.NDArrayIter(Xv, yv, batch_size=16)
+    est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                    train_metrics=[mx.metric.Accuracy()], trainer=tr)
+    est.fit(data, val_data=val, epochs=4)
+    assert est.val_metrics and est.val_metrics[0].name.startswith("val_")
+    # validation ran every epoch (iterator reset works) and has instances
+    assert est.val_metrics[0].num_inst > 0
+    assert est.val_metrics[0].get()[1] > 0.6
