@@ -87,6 +87,35 @@ class Context:
         except Exception:
             pass
 
+    def memory_info(self) -> dict:
+        """HBM pool observability (reference GPUPooledStorageManager stats,
+        pooled_storage_manager.h:58-66 / MXGetGPUMemoryInformation): bytes
+        in use / limit / peak from the device allocator, plus the count and
+        bytes of live arrays this process holds on the device."""
+        dev = self.jax_device()
+        info = {"device": str(dev)}
+        try:
+            stats = dev.memory_stats() or {}
+            info.update({
+                "bytes_in_use": stats.get("bytes_in_use"),
+                "bytes_limit": stats.get("bytes_limit"),
+                "peak_bytes_in_use": stats.get("peak_bytes_in_use"),
+                "largest_alloc_size": stats.get("largest_alloc_size"),
+            })
+        except Exception:
+            info["bytes_in_use"] = None   # backend exposes no allocator stats
+        live_n = live_b = 0
+        try:
+            for a in jax.live_arrays():
+                if dev in getattr(a, "devices", lambda: set())():
+                    live_n += 1
+                    live_b += a.size * a.dtype.itemsize
+        except Exception:
+            pass
+        info["live_arrays"] = live_n
+        info["live_array_bytes"] = live_b
+        return info
+
 
 def _devices_of(platform: str):
     """PROCESS-LOCAL devices: like the reference, a worker's Context

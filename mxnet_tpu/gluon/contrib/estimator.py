@@ -185,6 +185,11 @@ class Estimator:
                 handlers.append(ValidationHandler(
                     val_data,
                     lambda vd: self.evaluate(vd, self.val_metrics)))
+        # validation runs FIRST at epoch_end so logging/checkpoint/early-stop
+        # handlers see THIS epoch's validation numbers (the reference gives
+        # ValidationHandler top priority)
+        handlers.sort(key=lambda h: 0 if isinstance(h, ValidationHandler)
+                      else 1)
         self.stop_training = False
         for h in handlers:
             h.train_begin(self)

@@ -332,18 +332,32 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
                   [-0.5808, -0.0045, -0.8140],
                   [-0.5836, -0.6948, 0.4203]]
         auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    norm = make_norm_aug(mean, std)
+    if norm is not None:
+        auglist.append(norm)
+    return auglist
+
+
+def make_norm_aug(mean, std) -> Optional[Augmenter]:
+    """mean/std normalization augmenter; True selects the ImageNet defaults
+    (shared by CreateAugmenter and CreateDetAugmenter). None if neither
+    given."""
     if mean is True:
         mean = np.array([123.68, 116.28, 103.53], dtype="float32")
     if std is True:
         std = np.array([58.395, 57.12, 57.375], dtype="float32")
-    if mean is not None and np.asarray(mean).any():
-        class _Norm(Augmenter):
-            def __call__(self, src):
-                return color_normalize(src, nd.array(np.asarray(mean, dtype="float32")),
-                                       nd.array(np.asarray(std, dtype="float32"))
-                                       if std is not None else None)
-        auglist.append(_Norm())
-    return auglist
+    if mean is None and std is None:
+        return None
+
+    class _Norm(Augmenter):
+        def __call__(self, src):
+            m = nd.array(np.asarray(mean, dtype="float32")) \
+                if mean is not None else nd.zeros((3,))
+            s = nd.array(np.asarray(std, dtype="float32")) \
+                if std is not None else None
+            return color_normalize(src, m, s)
+
+    return _Norm()
 
 
 class ImageIter:

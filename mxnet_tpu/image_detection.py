@@ -179,18 +179,7 @@ def CreateDetAugmenter(data_shape, resize=0, rand_crop=0.0, rand_pad=0.0,
                   [-0.5836, -0.6948, 0.4203]]
         auglist.append(DetBorrowAug(
             img_mod.LightingAug(pca_noise, eigval, eigvec)))
-    if mean is not None or std is not None:
-        if mean is True:
-            mean = np.array([123.68, 116.28, 103.53], "float32")
-        if std is True:
-            std = np.array([58.395, 57.12, 57.375], "float32")
-
-        class _Norm(img_mod.Augmenter):
-            def __call__(self, s):
-                return img_mod.color_normalize(
-                    s, nd.array(np.asarray(mean, "float32")),
-                    nd.array(np.asarray(std, "float32"))
-                    if std is not None else None)
-
-        auglist.append(DetBorrowAug(_Norm()))
+    norm = img_mod.make_norm_aug(mean, std)
+    if norm is not None:
+        auglist.append(DetBorrowAug(norm))
     return auglist

@@ -2,3 +2,4 @@
 from .base_module import BaseModule, BatchEndParam
 from .module import Module
 from .bucketing_module import BucketingModule
+from .sequential_module import SequentialModule, PythonModule

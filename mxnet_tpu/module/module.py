@@ -92,6 +92,12 @@ class Module(BaseModule):
     def output_shapes(self):
         outs = [tuple(o.shape) for o in self._exec_group.execs[0].outputs] \
             if self._exec_group.execs[0].outputs else None
+        if outs is None and self._data_shapes is not None:
+            # before the first forward: infer from the bound input shapes
+            feed = {d.name: d.shape for d in self._data_shapes}
+            for l in (self._label_shapes or []):
+                feed[l.name] = l.shape
+            _, outs, _ = self._symbol.infer_shape_partial(**feed)
         return list(zip(self.output_names, outs or []))
 
     def bind(self, data_shapes, label_shapes=None, for_training=True,

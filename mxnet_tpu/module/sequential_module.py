@@ -1,0 +1,207 @@
+"""SequentialModule — chain modules so one's outputs feed the next
+(reference ``python/mxnet/module/sequential_module.py``).
+
+Each sub-module binds against the previous one's output shapes; only
+modules flagged ``take_labels`` receive the batch labels (the reference's
+META_TAKE_LABELS); backward propagates input gradients right-to-left via
+``inputs_need_grad`` on every interior module.
+"""
+from __future__ import annotations
+
+import logging
+from typing import List, Optional
+
+from .base_module import BaseModule
+from ..base import MXNetError
+from ..io.io import DataBatch
+
+
+class SequentialModule(BaseModule):
+    META_TAKE_LABELS = "take_labels"
+    META_AUTO_WIRING = "auto_wiring"
+
+    def __init__(self, logger=logging):
+        super().__init__(logger)
+        self._modules: List[BaseModule] = []
+        self._metas: List[dict] = []
+        self._label_shapes = None
+        self.binded = False
+        self.params_initialized = False
+        self.optimizer_initialized = False
+
+    def add(self, module: BaseModule, **kwargs) -> "SequentialModule":
+        self._modules.append(module)
+        self._metas.append(kwargs)
+        self.binded = False
+        self.params_initialized = False
+        return self
+
+    # ------------------------------------------------------------- interface
+    @property
+    def data_names(self):
+        return self._modules[0].data_names if self._modules else []
+
+    @property
+    def output_names(self):
+        return self._modules[-1].output_names if self._modules else []
+
+    def get_params(self):
+        assert self.params_initialized
+        arg_params, aux_params = {}, {}
+        for m in self._modules:
+            a, x = m.get_params()
+            arg_params.update(a)
+            aux_params.update(x)
+        return arg_params, aux_params
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        if self.binded and not force_rebind:
+            return
+        if not self._modules:
+            raise MXNetError("SequentialModule has no modules; call add()")
+        self._label_shapes = label_shapes
+        cur_shapes = data_shapes
+        for i, (module, meta) in enumerate(zip(self._modules, self._metas)):
+            take_labels = meta.get(self.META_TAKE_LABELS, False)
+            # interior modules need input grads to keep the chain flowing
+            need_grad = inputs_need_grad if i == 0 else True
+            module.bind(cur_shapes,
+                        label_shapes if take_labels else None,
+                        for_training=for_training,
+                        inputs_need_grad=need_grad,
+                        force_rebind=force_rebind, grad_req=grad_req)
+            out_names = module.output_names
+            outs = module.output_shapes if hasattr(module, "output_shapes") \
+                else None
+            if outs is None:
+                raise MXNetError("sub-module must expose output_shapes")
+            # next module's data = this one's outputs, renamed positionally
+            nxt = self._modules[i + 1] if i + 1 < len(self._modules) else None
+            if nxt is not None:
+                data_names = nxt.data_names
+                if len(data_names) != len(outs):
+                    raise MXNetError(
+                        f"module {i} emits {len(outs)} outputs but module "
+                        f"{i+1} consumes {len(data_names)} inputs")
+                cur_shapes = [(n, s) for n, (_, s) in zip(data_names, outs)]
+        self.binded = True
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False, allow_extra=False):
+        assert self.binded
+        for m in self._modules:
+            m.init_params(initializer=initializer, arg_params=arg_params,
+                          aux_params=aux_params, allow_missing=True,
+                          force_init=force_init)
+        self.params_initialized = True
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        assert self.binded and self.params_initialized
+        for m in self._modules:
+            m.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                             optimizer_params=optimizer_params,
+                             force_init=force_init)
+        self.optimizer_initialized = True
+
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        batch = data_batch
+        for i, (module, meta) in enumerate(zip(self._modules, self._metas)):
+            module.forward(batch, is_train=is_train)
+            if i + 1 == len(self._modules):
+                break
+            outs = module.get_outputs()
+            batch = DataBatch(data=outs,
+                              label=data_batch.label
+                              if self._metas[i + 1].get(
+                                  self.META_TAKE_LABELS) else None,
+                              pad=data_batch.pad)
+
+    def backward(self, out_grads=None):
+        assert self.binded and self.params_initialized
+        grads = out_grads
+        for i, module in reversed(list(enumerate(self._modules))):
+            module.backward(out_grads=grads)
+            if i > 0:
+                grads = module.get_input_grads()
+
+    def update(self):
+        assert self.optimizer_initialized
+        for m in self._modules:
+            m.update()
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._modules[-1].get_outputs(merge_multi_context)
+
+    def get_input_grads(self, merge_multi_context=True):
+        return self._modules[0].get_input_grads(merge_multi_context)
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        for module, meta in zip(self._modules, self._metas):
+            if meta.get(self.META_TAKE_LABELS, False):
+                module.update_metric(eval_metric, labels, pre_sliced)
+
+
+class PythonModule(BaseModule):
+    """A module whose compute is arbitrary Python (reference
+    python_module.py): subclasses implement forward/backward; useful for
+    loss layers and glue stages inside a SequentialModule."""
+
+    def __init__(self, data_names, label_names, output_names, logger=logging):
+        super().__init__(logger)
+        self._data_names = list(data_names)
+        self._label_names = list(label_names or [])
+        self._output_names = list(output_names)
+        self._data_shapes = None
+        self._label_shapes = None
+        self._output_shapes = None
+        self.binded = False
+        self.params_initialized = False
+        self.optimizer_initialized = False
+
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def output_names(self):
+        return self._output_names
+
+    @property
+    def output_shapes(self):
+        return self._output_shapes
+
+    def get_params(self):
+        return {}, {}
+
+    def init_params(self, *a, **kw):
+        self.params_initialized = True
+
+    def init_optimizer(self, *a, **kw):
+        self.optimizer_initialized = True
+
+    def update(self):
+        pass
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        pass
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        self._data_shapes = data_shapes
+        self._label_shapes = label_shapes
+        self._output_shapes = self._compute_output_shapes(data_shapes,
+                                                          label_shapes)
+        self.binded = True
+        self.params_initialized = True
+
+    def _compute_output_shapes(self, data_shapes, label_shapes):
+        """Default: outputs mirror the data shapes 1:1."""
+        return [(n, s) for n, (_, s) in zip(self._output_names,
+                                            [(d[0], d[1]) for d in
+                                             data_shapes])]

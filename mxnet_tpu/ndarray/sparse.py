@@ -33,6 +33,12 @@ class BaseSparseNDArray:
     def shape(self):
         return self._shape
 
+    def norm(self) -> NDArray:
+        """Frobenius norm over stored values (valid because indices are
+        duplicate-free by construction)."""
+        return _wrap(jnp.sqrt(jnp.sum(self._values.astype(jnp.float32)
+                                      ** 2)))
+
     @property
     def dtype(self):
         return np.dtype(self._values.dtype)
@@ -110,8 +116,60 @@ class RowSparseNDArray(BaseSparseNDArray):
 
     def __add__(self, other):
         if isinstance(other, RowSparseNDArray):
-            return self.todense() + other.todense()
+            # sparse + sparse stays sparse. Rows present in BOTH operands
+            # must be summed into one stored row — a raw concat would leave
+            # duplicate indices that break every non-linear consumer
+            # (square/norm/retain) even though todense() would still be
+            # right (reference FComputeEx elemwise_add kRowSparseStorage).
+            if other._shape != self._shape:
+                raise MXNetError(f"shape mismatch {self._shape} vs "
+                                 f"{other._shape}")
+            idx = jnp.concatenate([self._indices, other._indices])
+            vals = jnp.concatenate([self._values, other._values], axis=0)
+            uniq, inv = jnp.unique(idx, return_inverse=True)
+            tail = vals.shape[1:]
+            merged = jnp.zeros((uniq.shape[0],) + tail,
+                               dtype=vals.dtype).at[inv].add(vals)
+            return RowSparseNDArray(merged, uniq, self._shape)
         return self.todense() + other
+
+    def __mul__(self, other):
+        if np.isscalar(other):
+            return RowSparseNDArray(self._values * other, self._indices,
+                                    self._shape)
+        return self.todense() * other
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        if np.isscalar(other):
+            return RowSparseNDArray(self._values / other, self._indices,
+                                    self._shape)
+        return self.todense() / other
+
+    def _unary(self, fn) -> "RowSparseNDArray":
+        """Apply a zero-preserving elementwise fn to stored values only
+        (reference FComputeEx unary kRowSparseStorage dispatch)."""
+        return RowSparseNDArray(fn(self._values), self._indices, self._shape)
+
+    def square(self):
+        return self._unary(jnp.square)
+
+    def sqrt(self):
+        return self._unary(jnp.sqrt)
+
+    def abs(self):
+        return self._unary(jnp.abs)
+
+    def sign(self):
+        return self._unary(jnp.sign)
+
+    def clip(self, a_min, a_max):
+        if a_min > 0 or a_max < 0:
+            raise MXNetError("clip range excluding 0 would densify a "
+                             "row_sparse array; convert with "
+                             "tostype('default') first")
+        return self._unary(lambda v: jnp.clip(v, a_min, a_max))
 
     def wait_to_read(self):
         self._values.block_until_ready()
@@ -174,6 +232,16 @@ class CSRNDArray(BaseSparseNDArray):
         self._values.block_until_ready()
 
     def __getitem__(self, i):
+        if isinstance(i, slice):
+            # row-range slice stays CSR without densifying (reference CSR
+            # slice op, matrix_op FComputeEx kCSRStorage)
+            start, stop, step = i.indices(self._shape[0])
+            if step != 1:
+                raise MXNetError("CSR slicing supports step 1 only")
+            ptr = self._indptr[start:stop + 1]
+            lo, hi = int(ptr[0]), int(ptr[-1])
+            return CSRNDArray(self._values[lo:hi], self._indices[lo:hi],
+                              ptr - lo, (stop - start, self._shape[1]))
         return self.todense()[i]
 
 

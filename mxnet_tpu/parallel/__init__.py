@@ -11,6 +11,7 @@ group2ctx model par.    shard_gluon_params / NamedSharding placement
 (absent) tensor par.    tensor_parallel.* (Megatron col/row split on 'tp')
 (absent) pipeline       pipeline.pipeline_apply (GPipe over 'pp')
 (absent) seq/context    ring_attention / ulysses_attention on 'sp'
+(absent) expert par.    expert_parallel.ep_moe_ffn (MoE all_to_all on 'ep')
 =====================  ==============================================
 """
 from .mesh import (make_mesh, auto_mesh, local_mesh, replicated, shard_spec,
@@ -23,3 +24,5 @@ from .ulysses import ulysses_attention
 from . import tensor_parallel
 from .tensor_parallel import shard_gluon_params
 from .pipeline import pipeline_apply
+from . import expert_parallel
+from .expert_parallel import ep_moe_ffn, moe_ffn_reference, MoEParams

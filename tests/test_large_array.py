@@ -1,0 +1,48 @@
+"""Large-array tier (reference ``tests/nightly/test_large_array.py``):
+operations must stay correct when a dimension or total size crosses the
+int32-index comfort zone. Kept memory-sane for CI (hundreds of MB, not the
+reference's 2^32-element giants) while still exercising >2^27-element
+buffers and large reductions."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+LARGE = 1 << 27          # 134M elements float32 = 512 MB
+
+
+def test_large_elementwise_and_reduce():
+    x = nd.ones((LARGE,))
+    assert float(x.sum().asnumpy()) == LARGE
+    y = x * 2 + 1
+    np.testing.assert_allclose(y[:3].asnumpy(), [3, 3, 3])
+    np.testing.assert_allclose(y[-3:].asnumpy(), [3, 3, 3])
+
+
+def test_large_matmul_row_count():
+    n = 1 << 20          # 1M rows
+    a = nd.ones((n, 16))
+    b = nd.ones((16, 8))
+    out = nd.dot(a, b)
+    assert out.shape == (n, 8)
+    np.testing.assert_allclose(out[0].asnumpy(), np.full(8, 16.0))
+    np.testing.assert_allclose(out[n - 1].asnumpy(), np.full(8, 16.0))
+
+
+def test_large_argmax_indexing():
+    n = (1 << 24) + 7
+    x = nd.zeros((n,))
+    x[n - 2] = 5.0
+    idx = int(nd.max(x).asnumpy())
+    assert idx == 5
+    am = int(x.asnumpy().argmax())
+    assert am == n - 2
+
+
+def test_large_take():
+    n = 1 << 22
+    x = nd.array(np.arange(n, dtype="float32"))
+    idx = nd.array(np.array([0, n // 2, n - 1], "int32"))
+    out = nd.take(x, idx)
+    np.testing.assert_allclose(out.asnumpy(), [0, n // 2, n - 1])

@@ -156,3 +156,13 @@ def test_check_consistency_machinery(rng):
     # a genuinely divergent "context" must be caught: scale one input set
     with pytest.raises(AssertionError):
         check_consistency(net, ctx_list, tol=1e-12)
+
+
+def test_context_memory_info():
+    """HBM/host allocator observability (reference MXGetGPUMemoryInformation
+    / pooled storage manager counters)."""
+    x = mx.nd.ones((256, 256))
+    x.wait_to_read()
+    info = mx.cpu().memory_info()
+    assert "device" in info and info["live_arrays"] >= 1
+    assert info["live_array_bytes"] >= 256 * 256 * 4

@@ -127,3 +127,39 @@ def test_module_group2ctxs_honor_or_raise():
     with pytest.raises(MXNetError, match="sharding"):
         mx.mod.Module(net, label_names=None, context=mx.cpu(),
                       group2ctxs=[{"g": mx.cpu(1)}])
+
+
+def test_sequential_module_chains(rng):
+    """SequentialModule: stage-1 features -> stage-2 classifier with labels
+    (reference module/sequential_module.py)."""
+    feat = mx.sym.Activation(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=16,
+                              name="s1fc"), act_type="relu")
+    head = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=2,
+                              name="s2fc"), name="sm")
+
+    seq = mx.mod.SequentialModule()
+    seq.add(mx.mod.Module(feat, label_names=None, context=mx.cpu()))
+    seq.add(mx.mod.Module(head, label_names=["sm_label"], context=mx.cpu()),
+            take_labels=True)
+
+    X = rng.randn(64, 6).astype("float32")
+    y = (X.sum(1) > 0).astype("float32")
+    it = mx.io.NDArrayIter(X, y, batch_size=16, label_name="sm_label")
+    seq.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    seq.init_params(mx.init.Xavier())
+    seq.init_optimizer(kvstore=None, optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5})
+    metric = mx.metric.Accuracy()
+    for epoch in range(12):
+        it.reset()
+        metric.reset()
+        for batch in it:
+            seq.forward(batch, is_train=True)
+            seq.backward()
+            seq.update()
+            seq.update_metric(metric, batch.label)
+    assert metric.get()[1] > 0.9, metric.get()
+    arg_params, _ = seq.get_params()
+    assert "s1fc_weight" in arg_params and "s2fc_weight" in arg_params

@@ -90,3 +90,43 @@ def test_image_iter_default_aug_crop_size():
     x = mx.nd.array(np.zeros((300, 260, 3), dtype=np.float32))
     y = crops[-1](x)
     assert y.shape == (224, 200, 3)
+
+
+def test_row_sparse_arithmetic_stays_sparse(rng):
+    from mxnet_tpu.ndarray import sparse as sp
+    a = sp.row_sparse_array((rng.randn(2, 3).astype("float32"), [1, 4]),
+                            shape=(6, 3))
+    b = sp.row_sparse_array((rng.randn(2, 3).astype("float32"), [1, 2]),
+                            shape=(6, 3))
+    s = a + b
+    assert isinstance(s, sp.RowSparseNDArray)
+    np.testing.assert_allclose(s.asnumpy(), a.asnumpy() + b.asnumpy(),
+                               rtol=1e-6)
+    m = a * 2.5
+    assert isinstance(m, sp.RowSparseNDArray)
+    np.testing.assert_allclose(m.asnumpy(), a.asnumpy() * 2.5, rtol=1e-6)
+    sq = a.square()
+    assert isinstance(sq, sp.RowSparseNDArray)
+    np.testing.assert_allclose(sq.asnumpy(), a.asnumpy() ** 2, rtol=1e-6)
+    np.testing.assert_allclose(float(a.norm().asnumpy()),
+                               np.linalg.norm(a.asnumpy()), rtol=1e-5)
+    import pytest
+    from mxnet_tpu.base import MXNetError
+    with pytest.raises(MXNetError, match="densify"):
+        a.clip(0.5, 1.0)
+
+
+def test_csr_row_slice_stays_csr(rng):
+    from mxnet_tpu.ndarray import sparse as sp
+    dense = np.zeros((5, 4), "float32")
+    dense[0, 1] = 1; dense[2, 3] = 2; dense[3, 0] = 3; dense[4, 2] = 4
+    # build CSR by hand
+    data = np.array([1, 2, 3, 4], "float32")
+    indices = np.array([1, 3, 0, 2], np.int64)
+    indptr = np.array([0, 1, 1, 2, 3, 4], np.int64)
+    c = sp.csr_matrix((data, indices, indptr), shape=(5, 4))
+    s = c[1:4]
+    assert isinstance(s, sp.CSRNDArray)
+    np.testing.assert_allclose(s.asnumpy(), dense[1:4])
+    np.testing.assert_allclose(float(c.norm().asnumpy()),
+                               np.linalg.norm(dense), rtol=1e-5)

@@ -1,0 +1,103 @@
+"""Threaded-frontend race tier (reference
+``tests/nightly/test_tlocal_racecondition.py``): concurrent Python threads
+driving imperative ops, autograd tapes, and executors must produce correct
+independent results — autograd state is thread-local like the reference's
+(imperative.cc:26-30 thread-local recording flags)."""
+import threading
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+
+
+def test_concurrent_imperative_ops(rng):
+    """Many threads hammer the imperative op cache simultaneously."""
+    errs = []
+
+    def work(seed):
+        try:
+            r = np.random.RandomState(seed)
+            a = r.randn(16, 16).astype("float32")
+            b = r.randn(16, 16).astype("float32")
+            for _ in range(20):
+                out = nd.dot(nd.array(a), nd.array(b))
+                out = nd.relu(out) + 1.0
+            np.testing.assert_allclose(
+                out.asnumpy(), np.maximum(a @ b, 0) + 1.0, rtol=1e-4,
+                atol=1e-5)
+        except Exception as e:   # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs, errs
+
+
+def test_autograd_recording_is_thread_local(rng):
+    """One thread records a tape while another runs un-recorded ops; the
+    recording thread's gradients must be unaffected."""
+    errs = []
+    barrier = threading.Barrier(2)
+
+    def recorder():
+        try:
+            x = nd.array(rng.randn(8, 8).astype("float32"))
+            x.attach_grad()
+            barrier.wait()
+            for _ in range(10):
+                with autograd.record():
+                    y = (x * x).sum()
+                y.backward()
+                np.testing.assert_allclose(x.grad.asnumpy(),
+                                           2 * x.asnumpy(), rtol=1e-5)
+        except Exception as e:
+            errs.append(e)
+
+    def bystander():
+        try:
+            barrier.wait()
+            for _ in range(50):
+                a = nd.ones((32, 32))
+                assert not autograd.is_recording()
+                (a * 3).asnumpy()
+        except Exception as e:
+            errs.append(e)
+
+    t1 = threading.Thread(target=recorder)
+    t2 = threading.Thread(target=bystander)
+    t1.start(); t2.start()
+    t1.join(); t2.join()
+    assert not errs, errs
+
+
+def test_concurrent_executors(rng):
+    """Independent bound executors step concurrently without crosstalk."""
+    errs = []
+
+    def work(seed):
+        try:
+            r = np.random.RandomState(seed)
+            net = mx.sym.FullyConnected(mx.sym.Variable("data"),
+                                        num_hidden=4, name="fc")
+            ex = net.simple_bind(mx.cpu(), data=(2, 3))
+            w = r.randn(4, 3).astype("float32")
+            ex.arg_dict["fc_weight"]._set_data(nd.array(w)._data)
+            ex.arg_dict["fc_bias"]._set_data(nd.zeros((4,))._data)
+            x = r.randn(2, 3).astype("float32")
+            for _ in range(5):
+                out = ex.forward(data=nd.array(x))[0]
+            np.testing.assert_allclose(out.asnumpy(), x @ w.T, rtol=1e-4,
+                                       atol=1e-5)
+        except Exception as e:
+            errs.append(e)
+
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs, errs

@@ -217,3 +217,27 @@ def test_estimator_validation_metrics_separate(rng):
     # validation ran every epoch (iterator reset works) and has instances
     assert est.val_metrics[0].num_inst > 0
     assert est.val_metrics[0].get()[1] > 0.6
+
+
+def test_row_sparse_add_merges_duplicate_rows(rng):
+    from mxnet_tpu.ndarray import sparse as sp
+    a = sp.row_sparse_array((np.ones((2, 3), "float32"), [1, 4]), shape=(6, 3))
+    b = sp.row_sparse_array((np.ones((2, 3), "float32") * 2, [1, 2]),
+                            shape=(6, 3))
+    s = a + b
+    assert len(np.unique(s.indices.asnumpy())) == s.indices.shape[0]
+    # non-linear consumer of the merged result is correct: (1+2)^2 = 9
+    np.testing.assert_allclose(s.square().asnumpy()[1], np.full(3, 9.0))
+    # retain sees the full merged row
+    np.testing.assert_allclose(s.retain([1]).asnumpy()[1], np.full(3, 3.0))
+
+
+def test_det_augmenter_std_only_and_norm_sharing(rng):
+    import random as _r
+    _r.seed(2)
+    augs = mx.image.CreateDetAugmenter((3, 16, 16), std=(58.4, 57.1, 57.4))
+    img = mx.nd.array((rng.rand(16, 16, 3) * 255).astype("float32"))
+    label = np.array([[0, 0.1, 0.1, 0.5, 0.5]], "float32")
+    for a in augs:
+        img, label = a(img, label)
+    assert img.shape == (16, 16, 3)          # std-only must not crash
