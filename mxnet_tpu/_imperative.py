@@ -53,6 +53,11 @@ def invoke_raw(op_name: str, inputs: Sequence[Any], attrs: Dict[str, Any],
                 rng = jax.device_put(rng, list(v.devices())[0])
                 break
     key = normalize_attrs(attrs)
+    if opdef.host:
+        # host op: no fixed-shape XLA lowering exists; run eagerly
+        if rng is not None:
+            return opdef.fn(*inputs, rng=rng, **dict(key))
+        return opdef.fn(*inputs, **dict(key))
     fn = jitted_op(opdef.name, key)
     try:
         if rng is not None:

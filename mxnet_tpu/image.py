@@ -352,7 +352,7 @@ def make_norm_aug(mean, std) -> Optional[Augmenter]:
     class _Norm(Augmenter):
         def __call__(self, src):
             m = nd.array(np.asarray(mean, dtype="float32")) \
-                if mean is not None else nd.zeros((3,))
+                if mean is not None else nd.zeros(np.shape(std))
             s = nd.array(np.asarray(std, dtype="float32")) \
                 if std is not None else None
             return color_normalize(src, m, s)

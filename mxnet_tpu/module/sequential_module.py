@@ -65,8 +65,9 @@ class SequentialModule(BaseModule):
         cur_shapes = data_shapes
         for i, (module, meta) in enumerate(zip(self._modules, self._metas)):
             take_labels = meta.get(self.META_TAKE_LABELS, False)
-            # interior modules need input grads to keep the chain flowing
-            need_grad = inputs_need_grad if i == 0 else True
+            # interior modules need input grads to keep the chain flowing,
+            # but only when a backward pass can happen at all
+            need_grad = inputs_need_grad if i == 0 else for_training
             module.bind(cur_shapes,
                         label_shapes if take_labels else None,
                         for_training=for_training,

@@ -77,6 +77,15 @@ def __getattr__(name: str):
         # frontend-defined op: eager python callback path (mx.operator)
         from ..operator import Custom
         return Custom
+    if name not in _REGISTRY and not name.startswith("__"):
+        # ops registered by modules outside ops/ resolve lazily (registry
+        # _LAZY_PROVIDERS) — mirror the reference where every op name is
+        # importable the moment the package loads
+        try:
+            from ..ops.registry import get_op
+            get_op(name)
+        except Exception:
+            pass
     if name in _REGISTRY:
         if name not in _func_cache:
             _func_cache[name] = _make_op_func(name)

@@ -238,6 +238,10 @@ class CSRNDArray(BaseSparseNDArray):
             start, stop, step = i.indices(self._shape[0])
             if step != 1:
                 raise MXNetError("CSR slicing supports step 1 only")
+            if stop <= start:  # empty (or inverted) row range
+                return CSRNDArray(self._values[:0], self._indices[:0],
+                                  jnp.zeros((1,), self._indptr.dtype),
+                                  (0, self._shape[1]))
             ptr = self._indptr[start:stop + 1]
             lo, hi = int(ptr[0]), int(ptr[-1])
             return CSRNDArray(self._values[lo:hi], self._indices[lo:hi],

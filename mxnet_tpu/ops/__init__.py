@@ -20,3 +20,4 @@ from . import multibox       # noqa: F401
 from . import contrib_ops    # noqa: F401
 from . import ctc            # noqa: F401
 from . import parity_ops     # noqa: F401
+from . import tail_ops       # noqa: F401

@@ -21,6 +21,7 @@ import, mirroring the reference's codegen from the C registry
 from __future__ import annotations
 
 import functools
+import importlib
 from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
 import jax
@@ -54,12 +55,16 @@ class OpDef:
 
     def __init__(self, name: str, fn: Callable, num_outputs=1, needs_rng: bool = False,
                  differentiable: bool = True, doc: str = "", arg_names=None,
-                 aux_args=()):
+                 aux_args=(), host: bool = False):
         self.name = name
         self.fn = fn
         self.num_outputs = num_outputs
         self.needs_rng = needs_rng
         self.differentiable = differentiable
+        # host=True: data-dependent shapes/rejection loops with no fixed-shape
+        # XLA lowering; imperative path runs fn eagerly (no jit) so it may do
+        # numpy work on host, like the reference's CPU-only op kernels.
+        self.host = host
         self.doc = doc or (fn.__doc__ or "")
         self._arg_names = arg_names  # explicit array-input names, else derived
         self.aux_args = tuple(aux_args)  # names that are auxiliary states (BN stats)
@@ -110,13 +115,13 @@ def normalize_attrs(attrs: Dict[str, Any]) -> Tuple[Tuple[str, Any], ...]:
 
 def register(name: str, num_outputs=1, needs_rng: bool = False,
              differentiable: bool = True, aliases: Sequence[str] = (),
-             arg_names=None, aux_args=()):
+             arg_names=None, aux_args=(), host: bool = False):
     """Decorator: register ``fn`` as operator ``name`` (plus aliases)."""
 
     def deco(fn: Callable):
         opdef = OpDef(name, fn, num_outputs=num_outputs, needs_rng=needs_rng,
                       differentiable=differentiable, arg_names=arg_names,
-                      aux_args=aux_args)
+                      aux_args=aux_args, host=host)
         _REGISTRY[name] = opdef
         for a in aliases:
             _REGISTRY[a] = opdef
@@ -131,11 +136,27 @@ def alias(existing: str, *names: str) -> None:
         _REGISTRY[n] = opdef
 
 
+#: modules outside ``ops/`` that register operators on import; tried once on
+#: a registry miss so symbolic graphs referencing them resolve without the
+#: user importing the submodule (the reference registers everything at load).
+_LAZY_PROVIDERS = ["mxnet_tpu.contrib.quantization", "mxnet_tpu.operator"]
+
+
 def get_op(name: str) -> OpDef:
     try:
         return _REGISTRY[name]
     except KeyError:
-        raise MXNetError(f"operator {name!r} is not registered") from None
+        pass
+    for mod in list(_LAZY_PROVIDERS):
+        try:
+            importlib.import_module(mod)
+        except Exception:
+            continue  # leave in the list: a later lookup may retry (e.g.
+                      # circular import during package init resolves itself)
+        _LAZY_PROVIDERS.remove(mod)
+        if name in _REGISTRY:
+            return _REGISTRY[name]
+    raise MXNetError(f"operator {name!r} is not registered")
 
 
 def list_ops():

@@ -91,6 +91,11 @@ def __getattr__(name: str):
     if name == "contrib":
         import importlib
         return importlib.import_module(__name__ + ".contrib")
+    if name not in _REGISTRY and not name.startswith("__"):
+        try:  # lazy-provider ops (registry._LAZY_PROVIDERS) resolve on access
+            get_op(name)
+        except Exception:
+            pass
     if name in _REGISTRY:
         if name not in _func_cache:
             _func_cache[name] = _make_sym_func(name)

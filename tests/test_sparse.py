@@ -130,3 +130,16 @@ def test_csr_row_slice_stays_csr(rng):
     np.testing.assert_allclose(s.asnumpy(), dense[1:4])
     np.testing.assert_allclose(float(c.norm().asnumpy()),
                                np.linalg.norm(dense), rtol=1e-5)
+
+
+def test_csr_empty_and_inverted_slice():
+    from mxnet_tpu.ndarray import sparse as sp
+    data = np.array([1, 2], "float32")
+    indices = np.array([1, 3], np.int64)
+    indptr = np.array([0, 1, 1, 2, 2, 2], np.int64)
+    c = sp.csr_matrix((data, indices, indptr), shape=(5, 4))
+    for sl in (slice(4, 1), slice(2, 2), slice(7, 9)):
+        s = c[sl]
+        assert isinstance(s, sp.CSRNDArray)
+        assert s.shape == (0, 4)
+        assert s.asnumpy().shape == (0, 4)

@@ -1,0 +1,103 @@
+"""Tests for ops/tail_ops.py: grad accumulation, scatter arithmetic,
+``*_like`` samplers, unique-zipfian candidate sampling, and image ops —
+numeric checks vs numpy, distribution moment checks for the samplers."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def A(x):
+    return nd.array(np.asarray(x, "float32"))
+
+
+def test_grad_add(rng):
+    a, b = rng.randn(3, 4), rng.randn(3, 4)
+    np.testing.assert_allclose(nd._grad_add(A(a), A(b)).asnumpy(),
+                               (a + b).astype("float32"), rtol=1e-6)
+
+
+def test_square_sum_axes(rng):
+    x = rng.randn(4, 5).astype("float32")
+    np.testing.assert_allclose(nd._square_sum(A(x)).asnumpy(),
+                               (x ** 2).sum(), rtol=1e-5)
+    np.testing.assert_allclose(nd._square_sum(A(x), axis=1).asnumpy(),
+                               (x ** 2).sum(1), rtol=1e-5)
+    out = nd._square_sum(A(x), axis=0, keepdims=True)
+    assert out.shape == (1, 5)
+
+
+def test_scatter_arith(rng):
+    a = rng.rand(3, 4).astype("float32") + 1.0
+    b = rng.rand(3, 4).astype("float32") + 1.0
+    np.testing.assert_allclose(
+        nd._scatter_elemwise_div(A(a), A(b)).asnumpy(), a / b, rtol=1e-6)
+    np.testing.assert_allclose(
+        nd._scatter_plus_scalar(A(a), scalar=2.5).asnumpy(), a + 2.5, rtol=1e-6)
+    np.testing.assert_allclose(
+        nd._scatter_minus_scalar(A(a), scalar=2.5).asnumpy(), a - 2.5, rtol=1e-6)
+
+
+@pytest.mark.parametrize("op,mean_ok", [
+    ("_random_uniform_like", lambda m: 0.3 < m < 0.7),
+    ("_random_normal_like", lambda m: abs(m) < 0.3),
+    ("_random_exponential_like", lambda m: 0.5 < m < 1.6),
+    ("_random_poisson_like", lambda m: 0.5 < m < 1.6),
+    ("_random_gamma_like", lambda m: 0.5 < m < 1.6),
+    ("_random_negative_binomial_like", lambda m: m >= 0),
+    ("_random_generalized_negative_binomial_like", lambda m: m >= 0),
+])
+def test_random_like_family(op, mean_ok):
+    data = nd.zeros((32, 32))
+    out = getattr(nd, op)(data)
+    assert out.shape == data.shape
+    assert mean_ok(float(out.asnumpy().mean())), (op, out.asnumpy().mean())
+
+
+def test_sample_unique_zipfian_unique_and_skewed():
+    mx.random.seed(7)
+    s, tries = nd._sample_unique_zipfian(range_max=5000, shape=(4, 64))
+    sn = s.asnumpy()
+    assert sn.shape == (4, 64) and tries.shape == (4,)
+    for row, t in zip(sn, tries.asnumpy()):
+        assert len(set(row.tolist())) == 64          # unique per row
+        assert 0 <= row.min() and row.max() < 5000   # in range
+        assert t >= 64                               # tries counts raw draws
+    # log-uniform: small ids must dominate large ids
+    assert (sn < 500).sum() > (sn >= 4500).sum()
+
+
+def test_div_sqrt_dim():
+    x = np.ones((2, 3, 16), "float32")
+    np.testing.assert_allclose(
+        nd._contrib_div_sqrt_dim(A(x)).asnumpy(), x / 4.0, rtol=1e-6)
+
+
+def test_image_to_tensor_and_normalize(rng):
+    img = (rng.rand(6, 5, 3) * 255).astype("uint8")
+    t = nd._image_to_tensor(nd.array(img))
+    assert t.shape == (3, 6, 5)
+    np.testing.assert_allclose(
+        t.asnumpy(), img.transpose(2, 0, 1).astype("float32") / 255.0,
+        rtol=1e-6)
+    out = nd._image_normalize(t, mean=(0.1, 0.2, 0.3), std=(0.5, 0.5, 0.5))
+    ref = (t.asnumpy() - np.array([0.1, 0.2, 0.3]).reshape(3, 1, 1)) / 0.5
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-5)
+    # batched NHWC -> NCHW
+    batch = nd.array(np.stack([img, img]))
+    tb = nd._image_to_tensor(batch)
+    assert tb.shape == (2, 3, 6, 5)
+
+
+def test_lazy_provider_resolves_via_namespaces():
+    """Quantization ops registered outside ops/ resolve through nd and sym
+    attribute access without importing contrib.quantization first."""
+    q, mn, mxv = nd._contrib_quantize(
+        A(np.random.randn(4, 4)), A([-3.0]), A([3.0]))
+    assert q.asnumpy().dtype.name == "int8"
+    import mxnet_tpu.symbol as sym
+    x = sym.Variable("x")
+    y = sym._contrib_div_sqrt_dim(x)
+    e = y.bind(mx.cpu(), {"x": nd.ones((2, 16))})
+    np.testing.assert_allclose(e.forward()[0].asnumpy(), 0.25)
