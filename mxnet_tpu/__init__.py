@@ -78,6 +78,8 @@ _lazy = {
     "util": ".util",
     "interop": ".interop",
     "contrib": ".contrib",
+    "checkpoint": ".checkpoint",
+    "gradient_compression": ".gradient_compression",
 }
 
 

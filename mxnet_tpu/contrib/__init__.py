@@ -10,6 +10,7 @@ from . import svrg_optimization
 from . import tensorboard
 from . import tensorrt
 from . import autograd
+from . import dgl
 from . import io
 from . import ndarray
 from . import symbol

@@ -1,0 +1,259 @@
+"""DGL graph-sampling operators over CSR adjacency matrices.
+
+Reference parity: ``src/operator/contrib/dgl_graph.cc`` —
+``_contrib_dgl_csr_neighbor_uniform_sample`` (SampleSubgraph :544-727),
+``_contrib_dgl_csr_neighbor_non_uniform_sample`` (ArrayHeap weighted
+sampling :495-542), ``_contrib_dgl_subgraph`` (:1129), ``_contrib_dgl_adjacency``
+(:1390), ``_contrib_dgl_graph_compact`` (:1565).
+
+These are host operators in the reference too (CPU-only FComputeEx — graph
+traversal with hash sets has no fixed-shape device lowering), so the
+TPU-native design keeps them on host: numpy BFS/sampling over the CSR
+buffers, fixed-size padded outputs exactly like the reference so downstream
+device code sees static shapes. Exposed through ``mx.nd.contrib.*`` like
+every other ``_contrib_`` op.
+
+Output contract of the neighbor samplers (per seed array):
+1. ``sampled_vertices`` int64[max_num_vertices+1] — sorted unique vertex
+   ids, padded; LAST element = actual count.
+2. ``sub_csr`` CSR (max_num_vertices, graph_cols) — row i = i-th sampled
+   vertex's sampled edges; values are the ORIGINAL edge ids.
+3. (non-uniform only) ``sub_prob`` float32[max_num_vertices] — each sampled
+   vertex's probability.
+4. ``sub_layer`` int64[max_num_vertices] — BFS layer per sampled vertex
+   (0 = seed), padded with -1.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..base import MXNetError
+from ..ndarray import NDArray, array as nd_array
+from ..ndarray.sparse import CSRNDArray, csr_matrix
+
+__all__ = ["dgl_csr_neighbor_uniform_sample",
+           "dgl_csr_neighbor_non_uniform_sample",
+           "dgl_subgraph", "dgl_adjacency", "dgl_graph_compact"]
+
+
+def _csr_parts(csr: CSRNDArray):
+    if not isinstance(csr, CSRNDArray):
+        raise MXNetError("graph must be a CSRNDArray (stype 'csr')")
+    data = np.asarray(csr.data.asnumpy()).astype(np.int64)
+    indices = np.asarray(csr.indices.asnumpy()).astype(np.int64)
+    indptr = np.asarray(csr.indptr.asnumpy()).astype(np.int64)
+    return data, indices, indptr
+
+
+def _as_np_ids(x) -> np.ndarray:
+    if isinstance(x, NDArray):
+        x = x.asnumpy()
+    return np.asarray(x).astype(np.int64).ravel()
+
+
+def _sample_row(vals, cols, num_neighbor, rs, prob=None):
+    """Sample up to num_neighbor of a vertex's edges (GetUniformSample /
+    GetNonUniformSample: degree <= k keeps everything, in order)."""
+    deg = len(cols)
+    if deg <= num_neighbor:
+        return cols, vals
+    if prob is None:
+        idx = np.sort(rs.choice(deg, size=num_neighbor, replace=False))
+        return cols[idx], vals[idx]
+    p = prob[cols]
+    s = p.sum()
+    if s <= 0:
+        raise MXNetError("non-uniform sample: zero total probability")
+    idx = rs.choice(deg, size=num_neighbor, replace=False, p=p / s)
+    # reference sorts sampled vertex and edge lists (GetNonUniformSample)
+    return np.sort(cols[idx]), np.sort(vals[idx])
+
+
+def _sample_subgraph(csr, seeds, num_hops, num_neighbor, max_num_vertices,
+                     prob=None, rs=None):
+    """SampleSubgraph (dgl_graph.cc:544): BFS from the seeds, sampling
+    ``num_neighbor`` edges per expanded vertex, capped at
+    ``max_num_vertices`` vertices."""
+    data, indices, indptr = _csr_parts(csr)
+    seeds = _as_np_ids(seeds)
+    if max_num_vertices < len(seeds):
+        raise MXNetError("max_num_vertices must cover the seeds")
+    rs = rs or np.random.RandomState()
+
+    layer_of = {}
+    order: List[Tuple[int, int]] = []   # (vertex, layer) in discovery order
+    for s in seeds:
+        if int(s) not in layer_of:
+            layer_of[int(s)] = 0
+            order.append((int(s), 0))
+    edges = {}                          # expanded vertex -> (cols, vals)
+    idx = 0
+    while idx < len(order) and len(layer_of) < max_num_vertices:
+        v, lvl = order[idx]
+        idx += 1
+        if lvl >= num_hops:
+            continue
+        lo, hi = indptr[v], indptr[v + 1]
+        cols, vals = _sample_row(data[lo:hi], indices[lo:hi], num_neighbor,
+                                 rs, prob)
+        # keep deterministic (col, val) pairing: _sample_row may have sorted
+        edges[v] = (cols, vals)
+        for u in cols:
+            if len(layer_of) >= max_num_vertices:
+                break
+            if int(u) not in layer_of:
+                layer_of[int(u)] = lvl + 1
+                order.append((int(u), lvl + 1))
+
+    verts = np.sort(np.fromiter(layer_of, np.int64, len(layer_of)))
+    n = len(verts)
+
+    sampled = np.zeros((max_num_vertices + 1,), np.int64)
+    sampled[:n] = verts
+    sampled[max_num_vertices] = n       # last element = actual count
+    layers = np.full((max_num_vertices,), -1, np.int64)
+    layers[:n] = [layer_of[int(v)] for v in verts]
+
+    # sub-CSR: row i = i-th sampled vertex, columns keep ORIGINAL ids
+    out_data, out_cols, out_ptr = [], [], [0]
+    for v in verts:
+        cols, vals = edges.get(int(v), (np.empty(0, np.int64),) * 2)
+        out_cols.extend(int(c) for c in cols)
+        out_data.extend(int(x) for x in vals)
+        out_ptr.append(len(out_cols))
+    while len(out_ptr) < max_num_vertices + 1:
+        out_ptr.append(out_ptr[-1])
+    sub = csr_matrix((np.asarray(out_data, np.int64),
+                      np.asarray(out_cols, np.int64),
+                      np.asarray(out_ptr, np.int64)),
+                     shape=(max_num_vertices, csr.shape[1]))
+    if prob is not None:
+        sub_prob = np.zeros((max_num_vertices,), np.float32)
+        sub_prob[:n] = prob[verts]
+        return nd_array(sampled), sub, nd_array(sub_prob), nd_array(layers)
+    return nd_array(sampled), sub, nd_array(layers)
+
+
+def dgl_csr_neighbor_uniform_sample(csr, *seeds, num_args=None, num_hops=1,
+                                    num_neighbor=2, max_num_vertices=100,
+                                    seed=None):
+    """Uniform neighbor sampling; returns the 3 output sets flattened in
+    reference order: all sampled_vertices, then all sub_csrs, then all
+    layers (one of each per seed array)."""
+    rs = np.random.RandomState(seed)
+    results = [_sample_subgraph(csr, s, num_hops, num_neighbor,
+                                max_num_vertices, rs=rs) for s in seeds]
+    return [r[i] for i in range(3) for r in results] if len(results) > 1 \
+        else list(results[0])
+
+
+def dgl_csr_neighbor_non_uniform_sample(csr, probability, *seeds,
+                                        num_args=None, num_hops=1,
+                                        num_neighbor=2, max_num_vertices=100,
+                                        seed=None):
+    """Probability-weighted sampling; outputs gain a per-vertex probability
+    set (4 sets total, dgl_graph.cc:852+)."""
+    prob = np.asarray(probability.asnumpy() if isinstance(probability, NDArray)
+                      else probability, np.float32).ravel()
+    rs = np.random.RandomState(seed)
+    results = [_sample_subgraph(csr, s, num_hops, num_neighbor,
+                                max_num_vertices, prob=prob, rs=rs)
+               for s in seeds]
+    return [r[i] for i in range(4) for r in results] if len(results) > 1 \
+        else list(results[0])
+
+
+def dgl_subgraph(graph, *vertex_sets, return_mapping=False, num_args=None):
+    """Induced subgraph per (sorted) vertex set: rows/cols restricted and
+    relabelled to the set's order. The first output's edge values are NEW
+    edge ids — 0-based row-major positions, exactly the reference kernel
+    (GetSubgraph ``sub_eids[i] = i``; its docstring example shows 1-based
+    but the implementation is 0-based). The mapping output (if requested)
+    carries the original edge ids."""
+    data, indices, indptr = _csr_parts(graph)
+    news, olds = [], []
+    for vset in vertex_sets:
+        v = _as_np_ids(vset)
+        if not np.all(v[:-1] <= v[1:]):
+            raise MXNetError("the input vertex list has to be sorted")
+        pos = {int(x): i for i, x in enumerate(v)}
+        n = len(v)
+        nd_, nc, np_ = [], [], [0]
+        od = []
+        for dst in v:
+            lo, hi = indptr[dst], indptr[dst + 1]
+            for c, val in zip(indices[lo:hi], data[lo:hi]):
+                j = pos.get(int(c))
+                if j is None:
+                    continue
+                nc.append(j)
+                nd_.append(len(nd_))
+                od.append(int(val))
+            np_.append(len(nc))
+        mk = lambda vals: csr_matrix((np.asarray(vals, np.int64),
+                                      np.asarray(nc, np.int64),
+                                      np.asarray(np_, np.int64)),
+                                     shape=(n, n))
+        news.append(mk(nd_))
+        olds.append(mk(od))
+    out = news + olds if return_mapping else news
+    return out if len(out) > 1 else out[0]
+
+
+def dgl_adjacency(graph):
+    """Edge-id CSR -> float32 adjacency-of-ones CSR (dgl_graph.cc:1390)."""
+    data, indices, indptr = _csr_parts(graph)
+    return csr_matrix((np.ones(len(data), np.float32), indices, indptr),
+                      shape=tuple(graph.shape))
+
+
+def dgl_graph_compact(*args, graph_sizes=None, return_mapping=False,
+                      num_args=None):
+    """Strip the padding the neighbor samplers add: keep the first
+    ``graph_size`` rows, relabel columns to subgraph-local ids, emit a
+    (size, size) CSR whose values are new 0-based sequential edge ids
+    (CompactSubgraph ``sub_eids[i] = i``). The mapping output carries the
+    original edge ids. Inputs alternate: N sub_csrs then N vertex-id arrays
+    (reference SubgraphCompactParam layout); a trailing count element on
+    the vertex array (as the samplers emit) is ignored via ``graph_sizes``.
+    Edges to vertices outside the kept set are dropped (the reference hard-
+    CHECK-fails there; that only happens on truncated samples)."""
+    n_graphs = len(args) // 2
+    if len(args) != 2 * n_graphs or n_graphs == 0:
+        raise MXNetError("expected csr1..csrN, vertices1..vertexN")
+    if graph_sizes is None:
+        raise MXNetError(
+            "dgl_graph_compact requires graph_sizes (the actual vertex "
+            "count per subgraph — the samplers report it in the last "
+            "element of their sampled_vertices output)")
+    sizes = ([int(graph_sizes)] * n_graphs if np.isscalar(graph_sizes)
+             else [int(s) for s in graph_sizes])
+    news, olds = [], []
+    for g in range(n_graphs):
+        sub, vids = args[g], args[n_graphs + g]
+        size = sizes[g]
+        data, indices, indptr = _csr_parts(sub)
+        v = _as_np_ids(vids)[:size]
+        pos = {int(x): i for i, x in enumerate(v)}
+        nd_, nc, np_ = [], [], [0]
+        od = []
+        for r in range(size):
+            lo, hi = indptr[r], indptr[r + 1]
+            for c, val in zip(indices[lo:hi], data[lo:hi]):
+                j = pos.get(int(c))
+                if j is None:
+                    continue
+                nc.append(j)
+                od.append(int(val))
+                nd_.append(len(nd_))
+            np_.append(len(nc))
+        mk = lambda vals: csr_matrix((np.asarray(vals, np.int64),
+                                      np.asarray(nc, np.int64),
+                                      np.asarray(np_, np.int64)),
+                                     shape=(size, size))
+        news.append(mk(nd_))
+        olds.append(mk(od))
+    out = news + olds if return_mapping else news
+    return out if len(out) > 1 else out[0]

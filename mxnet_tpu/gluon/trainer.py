@@ -39,6 +39,7 @@ class Trainer:
         self._init_optimizer(optimizer, optimizer_params)
         self._kvstore_type = kvstore
         self._kvstore = None
+        self._compression_params = compression_params
         self._kv_initialized = False
         self._update_on_kvstore = update_on_kvstore
         self._params_to_init = list(self._params)
@@ -64,6 +65,9 @@ class Trainer:
                 self._kvstore = kv_mod.create(self._kvstore_type)
             else:
                 self._kvstore = self._kvstore_type
+            if self._compression_params:
+                self._kvstore.set_gradient_compression(
+                    self._compression_params)
             if self._update_on_kvstore is None:
                 self._update_on_kvstore = False
             if self._update_on_kvstore:

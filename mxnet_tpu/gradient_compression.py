@@ -1,0 +1,100 @@
+"""2-bit gradient compression with error-feedback residual.
+
+Reference parity: ``src/kvstore/gradient_compression.cc:44-108`` and the
+bit-packing kernels in ``gradient_compression-inl.h`` (quantize_2bit /
+dequantize_2bit structs). Semantics reproduced exactly:
+
+- ``residual += grad``
+- ``residual >=  threshold`` -> emit ``+threshold`` (code 0b11), subtract it
+- ``residual <= -threshold`` -> emit ``-threshold`` (code 0b10), add it back
+- otherwise                  -> emit ``0``         (code 0b00)
+- four 2-bit codes per byte, first element in the two MOST significant bits
+  (reference posbits {0xc0, 0x30, 0x0c, 0x03}) — wire format matches, so a
+  payload produced here decodes with the reference kernels and vice versa.
+
+TPU-first: the reference hand-writes CPU/GPU kernels; here quantize and
+dequantize are single fused XLA computations (compare/select + shift/or
+reductions), jitted once per gradient shape. Compression factor 16 vs fp32
+(``GetCompressionFactor``, gradient_compression.cc:86-91).
+
+The wire payload is ``uint8[ceil(n/4)]`` + the float threshold carried in
+band by the kvstore, exactly the reference server protocol.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+
+__all__ = ["GradientCompression"]
+
+
+@functools.partial(jax.jit, static_argnames=("threshold",))
+def _quantize_2bit(grad, residual, *, threshold: float):
+    res = residual + grad
+    pos = res >= threshold
+    neg = res <= -threshold
+    codes = jnp.where(pos, jnp.uint8(3), jnp.where(neg, jnp.uint8(2),
+                                                   jnp.uint8(0)))
+    new_res = res - jnp.where(pos, threshold, 0.0) + jnp.where(neg, threshold,
+                                                               0.0)
+    n = codes.size
+    pad = (-n) % 4
+    codes = jnp.concatenate([codes.ravel(),
+                             jnp.zeros((pad,), jnp.uint8)]).reshape(-1, 4)
+    packed = ((codes[:, 0] << 6) | (codes[:, 1] << 4) |
+              (codes[:, 2] << 2) | codes[:, 3]).astype(jnp.uint8)
+    return packed, new_res
+
+
+@functools.partial(jax.jit, static_argnames=("threshold", "size"))
+def _dequantize_2bit(packed, *, threshold: float, size: int):
+    # expand each byte into its four 2-bit fields, MSB-first
+    fields = jnp.stack([(packed >> 6) & 3, (packed >> 4) & 3,
+                        (packed >> 2) & 3, packed & 3], axis=1).ravel()[:size]
+    return jnp.where(fields == 3, threshold,
+                     jnp.where(fields == 2, -threshold, 0.0)
+                     ).astype(jnp.float32)
+
+
+class GradientCompression:
+    """Stateless codec; the kvstore owns per-key residuals."""
+
+    def __init__(self, compression_params):
+        params = dict(compression_params or {})
+        ctype = params.pop("type", "2bit")
+        if ctype != "2bit":
+            raise MXNetError(
+                f"unknown gradient compression type {ctype!r} (only '2bit', "
+                "gradient_compression.cc:45-49)")
+        self.type = ctype
+        self.threshold = float(params.pop("threshold", 0.5))
+        if self.threshold <= 0:
+            raise MXNetError("threshold must be greater than 0")
+        if params:
+            raise MXNetError(f"unknown compression params: {sorted(params)}")
+
+    # ----------------------------------------------------------------- codec
+    def quantize(self, grad, residual):
+        """-> (packed uint8[ceil(n/4)], updated residual). Shapes of grad
+        and residual must match; residual starts at zeros."""
+        return _quantize_2bit(jnp.asarray(grad, jnp.float32),
+                              jnp.asarray(residual, jnp.float32),
+                              threshold=self.threshold)
+
+    def dequantize(self, packed, shape):
+        size = int(math.prod(shape)) if not isinstance(shape, int) else shape
+        out = _dequantize_2bit(packed, threshold=self.threshold, size=size)
+        return out if isinstance(shape, int) else out.reshape(shape)
+
+    def compressed_size(self, original_size: int) -> int:
+        """Bytes on the wire for ``original_size`` float32 elements
+        (GetCompressedSize, gradient_compression.cc:93-98)."""
+        return (original_size + 3) // 4
+
+    def get_compression_factor(self) -> int:
+        return 16

@@ -37,6 +37,7 @@ class BucketingModule(BaseModule):
                 _check_group2ctx(base_ctx, spec)
         self._sym_gen = sym_gen
         self._default_bucket_key = default_bucket_key
+        self._compression_params = compression_params
         self._context = context
         self._fixed_param_names = fixed_param_names
         self._buckets: Dict = {}
@@ -55,7 +56,8 @@ class BucketingModule(BaseModule):
         sym, data_names, label_names = self._sym_gen(bucket_key)
         return Module(sym, data_names, label_names, logger=self.logger,
                       context=self._context,
-                      fixed_param_names=self._fixed_param_names)
+                      fixed_param_names=self._fixed_param_names,
+                      compression_params=self._compression_params)
 
     def bind(self, data_shapes, label_shapes=None, for_training=True,
              inputs_need_grad=False, force_rebind=False, shared_module=None,

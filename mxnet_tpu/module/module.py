@@ -54,6 +54,7 @@ class Module(BaseModule):
         self._optimizer = None
         self._updater = None
         self._kvstore = None
+        self._compression_params = compression_params
         self._update_on_kvstore = False
 
     @staticmethod
@@ -188,6 +189,8 @@ class Module(BaseModule):
         if kvstore:
             from .. import kvstore as kv_mod
             kv = kv_mod.create(kvstore) if isinstance(kvstore, str) else kvstore
+            if self._compression_params:
+                kv.set_gradient_compression(self._compression_params)
             self._kvstore = kv
             # reference default: optimizer runs on the store when one exists
             # (model.py _create_kvstore update_on_kvstore=True path)

@@ -11,6 +11,12 @@ from ..ops.registry import _REGISTRY
 
 
 def __getattr__(name: str):
+    if name.startswith("dgl_"):
+        # graph-sampling ops take/return CSRNDArrays — host functions, not
+        # registry ops (reference: CPU-only FComputeEx, dgl_graph.cc)
+        from ..contrib import dgl as _dgl
+        if hasattr(_dgl, name):
+            return getattr(_dgl, name)
     from . import __getattr__ as _nd_getattr
     for cand in (f"_contrib_{name}", f"contrib_{name}"):
         if cand in _REGISTRY:

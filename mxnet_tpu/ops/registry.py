@@ -147,16 +147,24 @@ def get_op(name: str) -> OpDef:
         return _REGISTRY[name]
     except KeyError:
         pass
+    provider_errs = []
     for mod in list(_LAZY_PROVIDERS):
         try:
             importlib.import_module(mod)
-        except Exception:
-            continue  # leave in the list: a later lookup may retry (e.g.
-                      # circular import during package init resolves itself)
+        except Exception as e:
+            # leave in the list: a circular import during package init
+            # resolves itself on a later lookup — but surface the error so
+            # a genuinely broken provider isn't silently invisible
+            provider_errs.append(f"{mod}: {e!r}")
+            continue
         _LAZY_PROVIDERS.remove(mod)
         if name in _REGISTRY:
             return _REGISTRY[name]
-    raise MXNetError(f"operator {name!r} is not registered")
+    msg = f"operator {name!r} is not registered"
+    if provider_errs:
+        msg += " (lazy op providers failed to import: " \
+               + "; ".join(provider_errs) + ")"
+    raise MXNetError(msg)
 
 
 def list_ops():

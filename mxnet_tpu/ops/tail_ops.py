@@ -140,12 +140,18 @@ def _sample_unique_zipfian(range_max=1, shape=(1,), rng=None):
     this is a host op (``host=True``) like the reference's CPU-only kernel
     (``unique_sample_op.cc`` is FCompute<cpu> only).
     """
+    from ..base import MXNetError
     if isinstance(shape, int):
         shape = (shape,)
     shape = tuple(int(s) for s in shape)
     n_rows = int(np.prod(shape[:-1], dtype=np.int64)) if len(shape) > 1 else 1
     n_col = shape[-1]
     range_max = int(range_max)
+    if range_max < n_col:
+        raise MXNetError(
+            f"_sample_unique_zipfian: cannot draw {n_col} unique ids from "
+            f"range_max={range_max} (reference unique_sample_op.cc CHECKs "
+            "the same precondition)")
 
     def host_sample(seed):
         rs = np.random.RandomState(int(np.asarray(seed).ravel()[0]) & 0x7FFFFFFF)

@@ -94,6 +94,19 @@ def main():
     assert got[0].sum() == shape[1] and got[2].sum() == shape[1]
     assert got[1].sum() == 0 and got[3].sum() == 0
 
+    # --- 2-bit compressed wire path: every worker pushes 0.6 with
+    # threshold 0.5 -> each contributes exactly +0.5, residual 0.1; a second
+    # push of 0.45 fires again off the residual (0.55 >= 0.5)
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv.init("gc", mx.nd.zeros(shape))
+    kv.push("gc", mx.nd.ones(shape) * 0.6)
+    out = mx.nd.zeros(shape)
+    kv.pull("gc", out=out)
+    check_diff(out, 0.5 * nw)
+    kv.push("gc", mx.nd.ones(shape) * 0.45)
+    kv.pull("gc", out=out)
+    check_diff(out, 0.5 * nw)
+
     # --- barrier flushes and synchronizes
     kv.barrier()
     print(f"worker {rank}/{nw}: dist_sync kvstore OK", flush=True)

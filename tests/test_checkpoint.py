@@ -1,0 +1,82 @@
+"""Sharded/async checkpointing: save sharded over one mesh layout, restore
+onto a different one; async save overlaps and joins; gluon params round-trip."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import mxnet_tpu as mx
+from mxnet_tpu.checkpoint import ShardedCheckpointer, save_sharded, load_sharded
+
+
+def _mesh(shape, names):
+    devs = np.array(jax.devices()[: int(np.prod(shape))]).reshape(shape)
+    return Mesh(devs, names)
+
+
+def test_save_restore_resharded(tmp_path):
+    mesh8 = _mesh((8,), ("dp",))
+    mesh24 = _mesh((2, 4), ("dp", "tp"))
+    w = jax.device_put(np.arange(64, dtype="float32").reshape(8, 8),
+                       NamedSharding(mesh8, P("dp", None)))
+    b = jax.device_put(np.ones((8,), "float32"),
+                       NamedSharding(mesh8, P()))
+    ckpt = ShardedCheckpointer(str(tmp_path / "run"))
+    ckpt.save(0, {"w": w, "b": b})
+
+    # restore onto a DIFFERENT mesh/sharding
+    like = {
+        "w": jax.device_put(jnp.zeros((8, 8), jnp.float32),
+                            NamedSharding(mesh24, P("dp", "tp"))),
+        "b": jax.device_put(jnp.zeros((8,), jnp.float32),
+                            NamedSharding(mesh24, P("tp"))),
+    }
+    out = ckpt.restore(0, like=like)
+    np.testing.assert_allclose(np.asarray(out["w"]), np.asarray(w))
+    np.testing.assert_allclose(np.asarray(out["b"]), 1.0)
+    assert out["w"].sharding.is_equivalent_to(like["w"].sharding, 2)
+
+
+def test_async_save_overlaps_and_joins(tmp_path):
+    ckpt = ShardedCheckpointer(str(tmp_path / "run"))
+    params = {f"p{i}": jnp.full((32, 32), float(i)) for i in range(4)}
+    ckpt.save(5, params, async_save=True)   # returns immediately
+    # training continues while serialization is in flight
+    x = jnp.ones((8, 8)) @ jnp.ones((8, 8))
+    x.block_until_ready()
+    ckpt.wait_until_finished()
+    assert ckpt.steps() == [5]
+    out = ckpt.restore(5)
+    for i in range(4):
+        np.testing.assert_allclose(np.asarray(out[f"p{i}"]), float(i))
+
+
+def test_aux_and_steps_listing(tmp_path):
+    ckpt = ShardedCheckpointer(str(tmp_path / "run"))
+    for step in (0, 10, 2):
+        ckpt.save(step, {"w": jnp.ones((4,)) * step},
+                  aux={"ema": jnp.zeros((4,))})
+    assert ckpt.steps() == [0, 2, 10]
+    out = ckpt.restore(10)
+    np.testing.assert_allclose(np.asarray(out["w"]), 10.0)
+    np.testing.assert_allclose(np.asarray(out["__aux__ema"]), 0.0)
+
+
+def test_gluon_params_roundtrip(tmp_path):
+    from mxnet_tpu import gluon
+    net = gluon.nn.Dense(4, in_units=3)
+    net.initialize(mx.init.Xavier())
+    net(mx.nd.ones((1, 3)))
+    save_sharded(str(tmp_path / "g"), 0, net.collect_params())
+    out = load_sharded(str(tmp_path / "g"), 0)
+    for p in net.collect_params().values():
+        np.testing.assert_allclose(np.asarray(out[p.name]),
+                                   p.data().asnumpy())
+
+
+def test_missing_step_raises(tmp_path):
+    ckpt = ShardedCheckpointer(str(tmp_path / "run"))
+    with pytest.raises(mx.MXNetError, match="no checkpoint"):
+        ckpt.restore(99)

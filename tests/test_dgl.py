@@ -1,0 +1,130 @@
+"""DGL graph-sampling ops (contrib/dgl.py) vs the reference kernel contract
+(dgl_graph.cc): neighbor sampling invariants, induced subgraph known values,
+adjacency conversion, compaction relabelling."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.ndarray import sparse as sp
+
+
+def full_graph():
+    """The 5-vertex complete-minus-diagonal graph from the reference op
+    docstring (dgl_graph.cc:758+): edge ids 1..20."""
+    data = np.arange(1, 21, dtype=np.int64)
+    indices = np.array([1, 2, 3, 4, 0, 2, 3, 4, 0, 1, 3, 4,
+                        0, 1, 2, 4, 0, 1, 2, 3], np.int64)
+    indptr = np.array([0, 4, 8, 12, 16, 20], np.int64)
+    return sp.csr_matrix((data, indices, indptr), shape=(5, 5))
+
+
+def test_uniform_sample_all_seeds():
+    g = full_graph()
+    seed = nd.array(np.arange(5), dtype="int64")
+    # headroom above the seed count is required for expansion (the reference
+    # BFS stops once the vertex map reaches max_num_vertices, :593)
+    verts, sub, layer = nd.contrib.dgl_csr_neighbor_uniform_sample(
+        g, seed, num_args=2, num_hops=1, num_neighbor=2, max_num_vertices=6,
+        seed=3)
+    v = verts.asnumpy()
+    assert v.shape == (7,)
+    assert v[-1] == 5                      # count in last slot
+    np.testing.assert_array_equal(np.sort(v[:5]), np.arange(5))
+    assert layer.asnumpy().tolist() == [0] * 5 + [-1]   # all are seeds
+    dense = sub.asnumpy()
+    assert dense.shape == (6, 5)
+    # each expanded row sampled exactly 2 edges whose values are original ids
+    for r in range(5):
+        nz = dense[r][dense[r] != 0]
+        assert len(nz) == 2
+        assert set(nz).issubset(set(range(1, 21)))
+
+
+def test_uniform_sample_hops_and_cap():
+    g = full_graph()
+    seed = nd.array([0], dtype="int64")
+    verts, sub, layer = nd.contrib.dgl_csr_neighbor_uniform_sample(
+        g, seed, num_hops=1, num_neighbor=2, max_num_vertices=4, seed=0)
+    v, l = verts.asnumpy(), layer.asnumpy()
+    n = v[-1]
+    assert 1 <= n <= 4
+    assert l[0] >= 0 and (l[:n] >= 0).all() and (l[n:] == -1).all()
+    assert 0 in v[:n]                       # the seed is in the sample
+    # seed is layer 0, neighbors layer 1
+    lid = {int(vi): int(li) for vi, li in zip(v[:n], l[:n])}
+    assert lid[0] == 0
+    assert all(li == 1 for vi, li in lid.items() if vi != 0)
+
+
+def test_non_uniform_sample_respects_zero_prob():
+    g = full_graph()
+    # vertex 3 has probability 0: it can never be SAMPLED as a neighbor
+    prob = nd.array(np.array([1, 1, 1, 0, 1], "float32"))
+    seed = nd.array([0], dtype="int64")
+    verts, sub, sprob, layer = nd.contrib.dgl_csr_neighbor_non_uniform_sample(
+        g, prob, seed, num_hops=1, num_neighbor=2, max_num_vertices=5, seed=1)
+    v = verts.asnumpy()
+    n = v[-1]
+    assert 3 not in v[:n]
+    # probability output aligns with the sampled vertices
+    pv = sprob.asnumpy()
+    expect = np.array([1, 1, 1, 0, 1], "float32")
+    for vi, pi in zip(v[:n], pv[:n]):
+        assert pi == expect[int(vi)]
+
+
+def test_subgraph_known_values():
+    # the docstring example graph (dgl_graph.cc:1129): 4x4, edge ids 1..7
+    dense = np.array([[1, 0, 0, 2],
+                      [3, 0, 4, 0],
+                      [0, 5, 0, 0],
+                      [0, 6, 7, 0]], np.int64)
+    data = np.array([1, 2, 3, 4, 5, 6, 7], np.int64)
+    indices = np.array([0, 3, 0, 2, 1, 1, 2], np.int64)
+    indptr = np.array([0, 2, 4, 5, 7], np.int64)
+    g = sp.csr_matrix((data, indices, indptr), shape=(4, 4))
+    new, old = nd.contrib.dgl_subgraph(
+        g, nd.array([0, 1, 2], dtype="int64"), return_mapping=True)
+    # original edge ids of the induced subgraph
+    np.testing.assert_array_equal(old.asnumpy(), [[1, 0, 0],
+                                                  [3, 0, 4],
+                                                  [0, 5, 0]])
+    # new ids are 0-based sequential positions (GetSubgraph sub_eids[i]=i)
+    assert new.asnumpy()[1, 0] == 1 and new.asnumpy()[1, 2] == 2
+    assert new.asnumpy()[2, 1] == 3
+    with pytest.raises(MXNetError, match="sorted"):
+        nd.contrib.dgl_subgraph(g, nd.array([2, 0], dtype="int64"))
+
+
+def test_adjacency():
+    g = full_graph()
+    adj = nd.contrib.dgl_adjacency(g)
+    assert isinstance(adj, sp.CSRNDArray)
+    a = adj.asnumpy()
+    assert a.dtype == np.float32
+    np.testing.assert_array_equal(a, (full_graph().asnumpy() != 0))
+
+
+def test_graph_compact_relabels():
+    g = full_graph()
+    seed = nd.array(np.arange(5), dtype="int64")
+    verts, sub, layer = nd.contrib.dgl_csr_neighbor_uniform_sample(
+        g, seed, num_hops=1, num_neighbor=2, max_num_vertices=6, seed=5)
+    n = int(verts.asnumpy()[-1])
+    compact = nd.contrib.dgl_graph_compact(
+        sub, verts, graph_sizes=n, return_mapping=False)
+    assert compact.shape == (n, n)
+    # same number of edges, values now 0..E-1 (CompactSubgraph sub_eids[i]=i)
+    sub_n = sub.asnumpy()
+    n_edges = int((sub_n != 0).sum())  # ids 1..20, so every edge is nonzero
+    c = compact.asnumpy()
+    got = sorted(c[c != 0].tolist() + [0.0] * (n_edges - (c != 0).sum()))
+    assert got == list(range(n_edges))
+
+
+def test_graph_compact_requires_sizes():
+    g = full_graph()
+    with pytest.raises(MXNetError, match="graph_sizes"):
+        nd.contrib.dgl_graph_compact(g, nd.array([0, 1], dtype="int64"))

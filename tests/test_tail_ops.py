@@ -101,3 +101,8 @@ def test_lazy_provider_resolves_via_namespaces():
     y = sym._contrib_div_sqrt_dim(x)
     e = y.bind(mx.cpu(), {"x": nd.ones((2, 16))})
     np.testing.assert_allclose(e.forward()[0].asnumpy(), 0.25)
+
+
+def test_sample_unique_zipfian_range_too_small_raises():
+    with pytest.raises(mx.MXNetError, match="unique"):
+        nd._sample_unique_zipfian(range_max=4, shape=(8,))
