@@ -1,0 +1,108 @@
+/*!
+ * C prediction ABI for the TPU-native framework.
+ *
+ * Drop-in signature parity with the reference's standalone inference ABI
+ * (reference include/mxnet/c_predict_api.h): MXPredCreate /
+ * MXPredCreatePartialOut / MXPredReshape / MXPredSetInput / MXPredForward /
+ * MXPredGetOutputShape / MXPredGetOutput / MXPredFree and the MXNDList
+ * trio, plus MXGetLastError. Any language that can call C (Rust, Go, Java,
+ * C#, Julia...) binds this one shared object — the same role the reference's
+ * flat C ABI plays for its Scala/R/Perl bindings.
+ *
+ * Implementation: libmxtpu_predict.so embeds (or, when loaded into a Python
+ * process, joins) a CPython interpreter and drives the framework's XLA
+ * executor; dev_type selects cpu (1) or the accelerator (2).
+ *
+ * Build (see native/c_predict_api.cc header comment for the exact line):
+ *   g++ -O2 -shared -fPIC native/c_predict_api.cc \
+ *       $(python3-config --includes) -lpython3.12 \
+ *       -o native/libmxtpu_predict.so
+ */
+#ifndef MXTPU_C_PREDICT_API_H_
+#define MXTPU_C_PREDICT_API_H_
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef unsigned int mx_uint;
+typedef float mx_float;
+typedef void *PredictorHandle;
+typedef void *NDListHandle;
+
+/*! \brief Last error message on this thread ("" if none). */
+const char *MXGetLastError();
+
+/*!
+ * \brief Create a predictor from a symbol JSON + parameter file bytes.
+ * Parameter bytes may be in the reference NDARRAY_V2 .params format or this
+ * framework's own ndarray-map format.
+ * \param dev_type 1 = cpu, 2 = accelerator (TPU)
+ * \param input_shape_indptr length num_input_nodes+1, CSR-style offsets
+ *        into input_shape_data
+ * \return 0 on success, -1 on failure (see MXGetLastError)
+ */
+int MXPredCreate(const char *symbol_json_str, const void *param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 mx_uint num_input_nodes, const char **input_keys,
+                 const mx_uint *input_shape_indptr,
+                 const mx_uint *input_shape_data, PredictorHandle *out);
+
+/*! \brief Same, keeping only the named internal outputs. */
+int MXPredCreatePartialOut(const char *symbol_json_str,
+                           const void *param_bytes, int param_size,
+                           int dev_type, int dev_id, mx_uint num_input_nodes,
+                           const char **input_keys,
+                           const mx_uint *input_shape_indptr,
+                           const mx_uint *input_shape_data,
+                           mx_uint num_output_nodes, const char **output_keys,
+                           PredictorHandle *out);
+
+/*! \brief Rebind with new input shapes; returns a NEW handle sharing
+ *         parameters (the old handle stays valid). */
+int MXPredReshape(mx_uint num_input_nodes, const char **input_keys,
+                  const mx_uint *input_shape_indptr,
+                  const mx_uint *input_shape_data, PredictorHandle handle,
+                  PredictorHandle *out);
+
+/*! \brief Shape of output `index`; pointers are owned by the handle and
+ *         valid until the next call on it. */
+int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
+                         mx_uint **shape_data, mx_uint *shape_ndim);
+
+/*! \brief Copy float32 input data into input `key`. */
+int MXPredSetInput(PredictorHandle handle, const char *key,
+                   const mx_float *data, mx_uint size);
+
+/*! \brief Run the forward graph (one XLA program). */
+int MXPredForward(PredictorHandle handle);
+
+/*! \brief Stepped forward for parity; this executor runs whole-graph, so
+ *         one step completes everything (*step_left = 0). */
+int MXPredPartialForward(PredictorHandle handle, int step, int *step_left);
+
+/*! \brief Copy output `index` into the caller's float32 buffer. */
+int MXPredGetOutput(PredictorHandle handle, mx_uint index, mx_float *data,
+                    mx_uint size);
+
+/*! \brief Free the predictor. */
+int MXPredFree(PredictorHandle handle);
+
+/*! \brief Load an ndarray file's contents (either supported format). */
+int MXNDListCreate(const char *nd_file_bytes, int nd_file_size,
+                   NDListHandle *out, mx_uint *out_length);
+
+/*! \brief Borrow entry `index`: name, float32 data, shape (owned by the
+ *         handle, valid until the next call on it). */
+int MXNDListGet(NDListHandle handle, mx_uint index, const char **out_key,
+                const mx_float **out_data, const mx_uint **out_shape,
+                mx_uint *out_ndim);
+
+/*! \brief Free the list. */
+int MXNDListFree(NDListHandle handle);
+
+#ifdef __cplusplus
+}  // extern "C"
+#endif
+
+#endif  // MXTPU_C_PREDICT_API_H_

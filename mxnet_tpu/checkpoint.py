@@ -71,8 +71,9 @@ class ShardedCheckpointer:
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
         ocp = _ocp()
-        self._async_ckpt = ocp.AsyncCheckpointer(
-            ocp.StandardCheckpointHandler())
+        # async checkpointer owns a background thread — create it only when
+        # an async save actually happens, and close both in close()
+        self._async_ckpt = None
         self._sync_ckpt = ocp.Checkpointer(ocp.StandardCheckpointHandler())
 
     def _step_dir(self, step: int) -> str:
@@ -85,13 +86,18 @@ class ShardedCheckpointer:
         if aux:
             tree = dict(tree, **{f"__aux__{k}": v
                                  for k, v in _to_tree(aux).items()})
+        if async_save and self._async_ckpt is None:
+            ocp = _ocp()
+            self._async_ckpt = ocp.AsyncCheckpointer(
+                ocp.StandardCheckpointHandler())
         ckpt = self._async_ckpt if async_save else self._sync_ckpt
         ckpt.save(self._step_dir(step), tree, force=overwrite)
 
     def wait_until_finished(self) -> None:
         """Join any in-flight async save (call before exiting or before
         deleting the checkpoint)."""
-        self._async_ckpt.wait_until_finished()
+        if self._async_ckpt is not None:
+            self._async_ckpt.wait_until_finished()
 
     # --------------------------------------------------------------- restore
     def restore(self, step: int, like=None, shardings=None) -> Dict[str, Any]:
@@ -109,6 +115,18 @@ class ShardedCheckpointer:
             target = {k: jax.ShapeDtypeStruct(v.shape, v.dtype,
                                               sharding=_sharding_of(v))
                       for k, v in tree.items()}
+            # the restore target must match the SAVED tree structure — fill
+            # keys the caller didn't provide (e.g. __aux__* state) from the
+            # checkpoint's own metadata, restored replicated
+            try:
+                meta = self._sync_ckpt.metadata(path)
+                saved = dict(meta.item_metadata.tree)
+            except Exception:
+                saved = {}
+            for k, m in saved.items():
+                if k not in target and hasattr(m, "shape"):
+                    target[k] = jax.ShapeDtypeStruct(
+                        tuple(m.shape), np.dtype(str(m.dtype)))
         elif shardings is not None:
             raise MXNetError("pass `like=` example arrays (shardings are "
                              "derived from them)")
@@ -132,6 +150,10 @@ class ShardedCheckpointer:
 
     def close(self) -> None:
         self.wait_until_finished()
+        if self._async_ckpt is not None:
+            self._async_ckpt.close()
+            self._async_ckpt = None
+        self._sync_ckpt.close()
 
 
 def _sharding_of(v):
@@ -150,4 +172,8 @@ def save_sharded(directory: str, step: int, params, aux=None,
 
 
 def load_sharded(directory: str, step: int, like=None) -> Dict[str, Any]:
-    return ShardedCheckpointer(directory).restore(step, like=like)
+    ckpt = ShardedCheckpointer(directory)
+    try:
+        return ckpt.restore(step, like=like)
+    finally:
+        ckpt.close()

@@ -67,8 +67,13 @@ def _sample_row(vals, cols, num_neighbor, rs, prob=None):
     if s <= 0:
         raise MXNetError("non-uniform sample: zero total probability")
     idx = rs.choice(deg, size=num_neighbor, replace=False, p=p / s)
-    # reference sorts sampled vertex and edge lists (GetNonUniformSample)
-    return np.sort(cols[idx]), np.sort(vals[idx])
+    # the reference sorts the sampled vertex and edge lists INDEPENDENTLY
+    # (GetNonUniformSample, dgl_graph.cc:533-534), which scrambles the
+    # (neighbor, edge-id) pairing; we sort by column carrying the edge id
+    # along so edge-feature lookups stay correct — deliberate fix, not a
+    # transcription
+    order = np.argsort(cols[idx], kind="stable")
+    return cols[idx][order], vals[idx][order]
 
 
 def _sample_subgraph(csr, seeds, num_hops, num_neighbor, max_num_vertices,
@@ -80,6 +85,11 @@ def _sample_subgraph(csr, seeds, num_hops, num_neighbor, max_num_vertices,
     seeds = _as_np_ids(seeds)
     if max_num_vertices < len(seeds):
         raise MXNetError("max_num_vertices must cover the seeds")
+    n_rows = csr.shape[0]
+    if len(seeds) and (seeds.min() < 0 or seeds.max() >= n_rows):
+        raise MXNetError(
+            f"seed vertex ids must be in [0, {n_rows}); got "
+            f"[{seeds.min()}, {seeds.max()}]")
     rs = rs or np.random.RandomState()
 
     layer_of = {}
@@ -178,6 +188,10 @@ def dgl_subgraph(graph, *vertex_sets, return_mapping=False, num_args=None):
         v = _as_np_ids(vset)
         if not np.all(v[:-1] <= v[1:]):
             raise MXNetError("the input vertex list has to be sorted")
+        if len(v) and (v.min() < 0 or v.max() >= graph.shape[0]):
+            raise MXNetError(
+                f"vertex ids must be in [0, {graph.shape[0]}); got "
+                f"[{v.min()}, {v.max()}]")
         pos = {int(x): i for i, x in enumerate(v)}
         n = len(v)
         nd_, nc, np_ = [], [], [0]

@@ -1,0 +1,404 @@
+/*!
+ * C prediction ABI — implementation.
+ *
+ * Reference parity: src/c_api/c_predict_api.cc (Predictor struct, thread-
+ * local error string, API_BEGIN/API_END macros). TPU-native twist: the
+ * predictor executes the symbol as ONE jitted XLA program via the Python
+ * runtime; this file embeds (or joins) CPython and marshals buffers. All
+ * framework logic lives in mxnet_tpu/native/predict_bridge.py — this layer
+ * owns handles, the GIL, and error strings only.
+ *
+ * Build:
+ *   g++ -O2 -shared -fPIC -o mxnet_tpu/native/libmxtpu_predict.so \
+ *       mxnet_tpu/native/c_predict_api.cc \
+ *       $(python3-config --includes) -L/usr/local/lib -lpython3.12
+ *
+ * Standalone (non-Python) hosts: set MXTPU_ROOT to the repo/install root if
+ * the library is moved out of its build tree.
+ */
+#include <Python.h>
+#include <dlfcn.h>
+
+#include <cstring>
+#include <string>
+#include <vector>
+
+extern "C" {
+typedef unsigned int mx_uint;
+typedef float mx_float;
+typedef void *PredictorHandle;
+typedef void *NDListHandle;
+}
+
+namespace {
+
+thread_local std::string g_last_error;
+
+void set_error(const std::string &msg) { g_last_error = msg; }
+
+// Fetch a pending Python exception into the error string.
+void set_error_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  std::string msg = "python error";
+  if (value) {
+    PyObject *s = PyObject_Str(value);
+    if (s) {
+      const char *c = PyUnicode_AsUTF8(s);
+      if (c) msg = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  set_error(msg);
+}
+
+// Start the interpreter if this library is the host (standalone C program).
+// When loaded into an existing Python process, join it instead. Must run
+// BEFORE any PyGILState_Ensure: after Py_InitializeEx this thread holds the
+// GIL, so release it once to put the interpreter in the "callable from any
+// thread via PyGILState" state.
+void ensure_interpreter() {
+  static bool done = false;
+  if (done) return;
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    PyEval_SaveThread();
+  }
+  done = true;
+}
+
+// Import the bridge module once (call with the GIL held).
+PyObject *bridge_module() {
+  static PyObject *mod = nullptr;
+  if (mod) return mod;
+  // Make the package importable from a standalone host: MXTPU_ROOT env
+  // wins; otherwise derive the package root from this library's own path
+  // (native/ -> mxnet_tpu/ -> root); compile-time default as last resort.
+  std::string root_storage;
+  const char *root = getenv("MXTPU_ROOT");
+  if (!root) {
+    Dl_info info;
+    if (dladdr(reinterpret_cast<void *>(&bridge_module), &info) &&
+        info.dli_fname) {
+      root_storage = info.dli_fname;
+      for (int up = 0; up < 3; ++up) {
+        size_t pos = root_storage.find_last_of('/');
+        if (pos == std::string::npos) break;
+        root_storage.erase(pos);
+      }
+      if (!root_storage.empty()) root = root_storage.c_str();
+    }
+  }
+#ifdef MXTPU_DEFAULT_ROOT
+  if (!root) root = MXTPU_DEFAULT_ROOT;
+#endif
+  if (root) {
+    PyObject *sys_path = PySys_GetObject("path");  // borrowed
+    if (sys_path) {
+      PyObject *p = PyUnicode_FromString(root);
+      if (p) {
+        if (!PySequence_Contains(sys_path, p)) PyList_Append(sys_path, p);
+        Py_DECREF(p);
+      }
+    }
+  }
+  mod = PyImport_ImportModule("mxnet_tpu.native.predict_bridge");
+  if (!mod) set_error_from_python();
+  return mod;
+}
+
+// A handle: the bridge Predictor/NDList object plus scratch buffers that
+// back the pointers we hand to the caller.
+struct PredHandle {
+  PyObject *obj;
+  std::vector<mx_uint> shape_buf;
+};
+
+struct ListHandle {
+  PyObject *obj;
+  std::string key_buf;
+  std::vector<mx_float> data_buf;
+  std::vector<mx_uint> shape_buf;
+};
+
+class GIL {
+ public:
+  GIL() {
+    ensure_interpreter();
+    state_ = PyGILState_Ensure();
+  }
+  ~GIL() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+// dict {name: (d0, d1, ...)} from the CSR-style shape arrays.
+PyObject *shapes_dict(mx_uint num, const char **keys, const mx_uint *indptr,
+                      const mx_uint *data) {
+  PyObject *d = PyDict_New();
+  for (mx_uint i = 0; i < num; ++i) {
+    mx_uint lo = indptr[i], hi = indptr[i + 1];
+    PyObject *t = PyTuple_New(hi - lo);
+    for (mx_uint j = lo; j < hi; ++j)
+      PyTuple_SET_ITEM(t, j - lo, PyLong_FromUnsignedLong(data[j]));
+    PyDict_SetItemString(d, keys[i], t);
+    Py_DECREF(t);
+  }
+  return d;
+}
+
+int create_predictor(const char *symbol_json_str, const void *param_bytes,
+                     int param_size, int dev_type, int dev_id,
+                     mx_uint num_input_nodes, const char **input_keys,
+                     const mx_uint *input_shape_indptr,
+                     const mx_uint *input_shape_data,
+                     mx_uint num_output_nodes, const char **output_keys,
+                     PredictorHandle *out) {
+  GIL gil;
+  PyObject *mod = bridge_module();
+  if (!mod) return -1;
+  PyObject *cls = PyObject_GetAttrString(mod, "Predictor");
+  if (!cls) {
+    set_error_from_python();
+    return -1;
+  }
+  PyObject *shapes = shapes_dict(num_input_nodes, input_keys,
+                                 input_shape_indptr, input_shape_data);
+  PyObject *params = PyBytes_FromStringAndSize(
+      static_cast<const char *>(param_bytes), param_size);
+  PyObject *outs = Py_None;
+  Py_INCREF(Py_None);
+  if (num_output_nodes > 0) {
+    Py_DECREF(Py_None);
+    outs = PyList_New(num_output_nodes);
+    for (mx_uint i = 0; i < num_output_nodes; ++i)
+      PyList_SET_ITEM(outs, i, PyUnicode_FromString(output_keys[i]));
+  }
+  PyObject *obj = PyObject_CallFunction(cls, "sOiiOO", symbol_json_str,
+                                        params, dev_type, dev_id, shapes,
+                                        outs);
+  Py_DECREF(cls);
+  Py_DECREF(shapes);
+  Py_DECREF(params);
+  Py_DECREF(outs);
+  if (!obj) {
+    set_error_from_python();
+    return -1;
+  }
+  auto *h = new PredHandle{obj, {}};
+  *out = h;
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char *MXGetLastError() { return g_last_error.c_str(); }
+
+int MXPredCreate(const char *symbol_json_str, const void *param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 mx_uint num_input_nodes, const char **input_keys,
+                 const mx_uint *input_shape_indptr,
+                 const mx_uint *input_shape_data, PredictorHandle *out) {
+  return create_predictor(symbol_json_str, param_bytes, param_size, dev_type,
+                          dev_id, num_input_nodes, input_keys,
+                          input_shape_indptr, input_shape_data, 0, nullptr,
+                          out);
+}
+
+int MXPredCreatePartialOut(const char *symbol_json_str,
+                           const void *param_bytes, int param_size,
+                           int dev_type, int dev_id, mx_uint num_input_nodes,
+                           const char **input_keys,
+                           const mx_uint *input_shape_indptr,
+                           const mx_uint *input_shape_data,
+                           mx_uint num_output_nodes, const char **output_keys,
+                           PredictorHandle *out) {
+  return create_predictor(symbol_json_str, param_bytes, param_size, dev_type,
+                          dev_id, num_input_nodes, input_keys,
+                          input_shape_indptr, input_shape_data,
+                          num_output_nodes, output_keys, out);
+}
+
+int MXPredReshape(mx_uint num_input_nodes, const char **input_keys,
+                  const mx_uint *input_shape_indptr,
+                  const mx_uint *input_shape_data, PredictorHandle handle,
+                  PredictorHandle *out) {
+  GIL gil;
+  auto *h = static_cast<PredHandle *>(handle);
+  PyObject *shapes = shapes_dict(num_input_nodes, input_keys,
+                                 input_shape_indptr, input_shape_data);
+  PyObject *obj = PyObject_CallMethod(h->obj, "reshape", "O", shapes);
+  Py_DECREF(shapes);
+  if (!obj) {
+    set_error_from_python();
+    return -1;
+  }
+  *out = new PredHandle{obj, {}};
+  return 0;
+}
+
+int MXPredSetInput(PredictorHandle handle, const char *key,
+                   const mx_float *data, mx_uint size) {
+  GIL gil;
+  auto *h = static_cast<PredHandle *>(handle);
+  // shape is the bound input's shape; bridge validates the element count
+  PyObject *bytes = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char *>(data), sizeof(mx_float) * size);
+  PyObject *r = PyObject_CallMethod(h->obj, "set_input_flat", "sOI", key,
+                                    bytes, size);
+  Py_DECREF(bytes);
+  if (!r) {
+    set_error_from_python();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXPredForward(PredictorHandle handle) {
+  GIL gil;
+  auto *h = static_cast<PredHandle *>(handle);
+  PyObject *r = PyObject_CallMethod(h->obj, "forward", nullptr);
+  if (!r) {
+    set_error_from_python();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXPredPartialForward(PredictorHandle handle, int step, int *step_left) {
+  if (step_left) *step_left = 0;  // whole-graph XLA execution: one step
+  return MXPredForward(handle);
+}
+
+int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
+                         mx_uint **shape_data, mx_uint *shape_ndim) {
+  GIL gil;
+  auto *h = static_cast<PredHandle *>(handle);
+  PyObject *shape = PyObject_CallMethod(h->obj, "get_output_shape", "I",
+                                        index);
+  if (!shape) {
+    set_error_from_python();
+    return -1;
+  }
+  Py_ssize_t n = PyTuple_Size(shape);
+  h->shape_buf.resize(n);
+  for (Py_ssize_t i = 0; i < n; ++i)
+    h->shape_buf[i] =
+        static_cast<mx_uint>(PyLong_AsLong(PyTuple_GET_ITEM(shape, i)));
+  Py_DECREF(shape);
+  *shape_data = h->shape_buf.data();
+  *shape_ndim = static_cast<mx_uint>(n);
+  return 0;
+}
+
+int MXPredGetOutput(PredictorHandle handle, mx_uint index, mx_float *data,
+                    mx_uint size) {
+  GIL gil;
+  auto *h = static_cast<PredHandle *>(handle);
+  PyObject *bytes = PyObject_CallMethod(h->obj, "get_output", "I", index);
+  if (!bytes) {
+    set_error_from_python();
+    return -1;
+  }
+  char *buf = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(bytes, &buf, &len) != 0) {
+    Py_DECREF(bytes);
+    set_error_from_python();
+    return -1;
+  }
+  if (static_cast<size_t>(len) != sizeof(mx_float) * size) {
+    Py_DECREF(bytes);
+    set_error("MXPredGetOutput: size mismatch (got " +
+              std::to_string(len / sizeof(mx_float)) + " floats, caller asked "
+              + std::to_string(size) + ")");
+    return -1;
+  }
+  std::memcpy(data, buf, len);
+  Py_DECREF(bytes);
+  return 0;
+}
+
+int MXPredFree(PredictorHandle handle) {
+  GIL gil;
+  auto *h = static_cast<PredHandle *>(handle);
+  Py_XDECREF(h->obj);
+  delete h;
+  return 0;
+}
+
+int MXNDListCreate(const char *nd_file_bytes, int nd_file_size,
+                   NDListHandle *out, mx_uint *out_length) {
+  GIL gil;
+  PyObject *mod = bridge_module();
+  if (!mod) return -1;
+  PyObject *cls = PyObject_GetAttrString(mod, "NDList");
+  if (!cls) {
+    set_error_from_python();
+    return -1;
+  }
+  PyObject *bytes = PyBytes_FromStringAndSize(nd_file_bytes, nd_file_size);
+  PyObject *obj = PyObject_CallFunction(cls, "O", bytes);
+  Py_DECREF(cls);
+  Py_DECREF(bytes);
+  if (!obj) {
+    set_error_from_python();
+    return -1;
+  }
+  Py_ssize_t n = PyObject_Length(obj);
+  auto *h = new ListHandle{obj, {}, {}, {}};
+  *out = h;
+  *out_length = static_cast<mx_uint>(n);
+  return 0;
+}
+
+int MXNDListGet(NDListHandle handle, mx_uint index, const char **out_key,
+                const mx_float **out_data, const mx_uint **out_shape,
+                mx_uint *out_ndim) {
+  GIL gil;
+  auto *h = static_cast<ListHandle *>(handle);
+  PyObject *t = PyObject_CallMethod(h->obj, "get", "I", index);
+  if (!t) {
+    set_error_from_python();
+    return -1;
+  }
+  PyObject *name = PyTuple_GET_ITEM(t, 0);
+  PyObject *bytes = PyTuple_GET_ITEM(t, 1);
+  PyObject *shape = PyTuple_GET_ITEM(t, 2);
+  h->key_buf = PyUnicode_AsUTF8(name);
+  char *buf = nullptr;
+  Py_ssize_t len = 0;
+  PyBytes_AsStringAndSize(bytes, &buf, &len);
+  h->data_buf.resize(len / sizeof(mx_float));
+  std::memcpy(h->data_buf.data(), buf, len);
+  Py_ssize_t nd = PyTuple_Size(shape);
+  h->shape_buf.resize(nd);
+  for (Py_ssize_t i = 0; i < nd; ++i)
+    h->shape_buf[i] =
+        static_cast<mx_uint>(PyLong_AsLong(PyTuple_GET_ITEM(shape, i)));
+  Py_DECREF(t);
+  *out_key = h->key_buf.c_str();
+  *out_data = h->data_buf.data();
+  *out_shape = h->shape_buf.data();
+  *out_ndim = static_cast<mx_uint>(nd);
+  return 0;
+}
+
+int MXNDListFree(NDListHandle handle) {
+  GIL gil;
+  auto *h = static_cast<ListHandle *>(handle);
+  Py_XDECREF(h->obj);
+  delete h;
+  return 0;
+}
+
+}  // extern "C"

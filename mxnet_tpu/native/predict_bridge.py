@@ -1,0 +1,171 @@
+"""Python side of the C prediction ABI (``c_predict_api.cc``).
+
+The C library embeds (or joins) a CPython interpreter and drives this module
+through simple PyObject calls; everything framework-specific lives here so
+the C++ layer stays a thin handle/GIL/error-marshalling shim.
+
+Reference parity: the Predictor semantics of ``src/c_api/c_predict_api.cc``
+(graph load -> bind with static input shapes -> set input / forward / get
+output) — but the executor under the hood is one jitted XLA program, so a C
+caller gets the same compiled-graph performance as the Python frontend.
+
+Accepts BOTH parameter formats: the reference's NDARRAY_V2 ``.params`` bytes
+(``interop.load_reference_params``) and this framework's own format
+(``ndarray.utils.save``), with ``arg:``/``aux:`` prefixes or bare names.
+"""
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+def _load_param_bytes(param_bytes: bytes):
+    """-> (arg_params, aux_params) from raw file bytes, either format."""
+    from .. import interop
+    from ..ndarray import utils as nd_utils
+    arg, aux = {}, {}
+    if not param_bytes:
+        return arg, aux
+    with tempfile.NamedTemporaryFile(suffix=".params", delete=False) as f:
+        f.write(param_bytes)
+        path = f.name
+    try:
+        try:
+            loaded = interop.load_reference_params(path)
+        except Exception:
+            loaded = nd_utils.load(path)
+    finally:
+        os.unlink(path)
+    for k, v in loaded.items():
+        if k.startswith("arg:"):
+            arg[k[4:]] = v
+        elif k.startswith("aux:"):
+            aux[k[4:]] = v
+        else:
+            arg[k] = v
+    return arg, aux
+
+
+class Predictor:
+    """One bound inference executor with fixed input shapes."""
+
+    def __init__(self, symbol_json: str, param_bytes: bytes,
+                 dev_type: int, dev_id: int,
+                 input_shapes: Dict[str, Sequence[int]],
+                 output_keys: Optional[List[str]] = None):
+        import mxnet_tpu as mx
+        from .. import symbol as sym_mod
+
+        sym = sym_mod.load_json(symbol_json)
+        if output_keys:
+            internals = sym.get_internals()
+            avail = internals.list_outputs()
+            chosen = []
+            for key in output_keys:
+                name = key if key in avail else key + "_output"
+                if name not in avail:
+                    raise ValueError(f"output {key!r} not found in graph")
+                chosen.append(internals[name])
+            sym = sym_mod.Group(chosen) if len(chosen) > 1 else chosen[0]
+        self._sym = sym
+        # dev_type 1=cpu (reference c_predict_api.h:66); anything else =
+        # the accelerator (TPU here, GPU there)
+        ctx = mx.cpu(dev_id) if dev_type == 1 else mx.context.tpu(dev_id)
+        self._ctx = ctx
+        arg_params, aux_params = _load_param_bytes(param_bytes)
+
+        self._input_names = list(input_shapes)
+        args = {}
+        for name in sym.list_arguments():
+            if name in input_shapes:
+                args[name] = mx.nd.zeros(tuple(int(x) for x in
+                                               input_shapes[name]))
+            elif name in arg_params:
+                args[name] = arg_params[name]
+        missing = [n for n in sym.list_arguments()
+                   if n not in args]
+        if missing:
+            raise ValueError(f"missing parameters for arguments: {missing}")
+        aux = {n: aux_params[n] for n in sym.list_auxiliary_states()
+               if n in aux_params}
+        self._aux = aux
+        self._exec = sym.bind(ctx, args, aux_states=aux if aux else None)
+        self._args = args
+        self._outputs = None
+
+    # ------------------------------------------------------------------ API
+    def set_input(self, name: str, data: bytes, shape: Sequence[int]):
+        arr = np.frombuffer(data, dtype=np.float32).reshape(
+            tuple(int(x) for x in shape)).copy()
+        if name not in self._args:
+            raise ValueError(f"unknown input {name!r}")
+        self._args[name]._set_data(arr)
+        self._outputs = None
+
+    def set_input_flat(self, name: str, data: bytes, size: int):
+        """C ABI entry: flat float32 buffer reshaped to the bound shape."""
+        if name not in self._args:
+            raise ValueError(f"unknown input {name!r}")
+        shape = tuple(self._args[name].shape)
+        n = int(np.prod(shape)) if shape else 1
+        if int(size) != n:
+            raise ValueError(
+                f"input {name!r} expects {n} floats (shape {shape}), "
+                f"got {size}")
+        self.set_input(name, data, shape)
+
+    def forward(self):
+        self._outputs = self._exec.forward(is_train=False)
+
+    def num_outputs(self) -> int:
+        return len(self._sym.list_outputs())
+
+    def get_output_shape(self, index: int):
+        if self._outputs is None:
+            self.forward()
+        return tuple(int(x) for x in self._outputs[index].shape)
+
+    def get_output(self, index: int) -> bytes:
+        if self._outputs is None:
+            self.forward()
+        return np.ascontiguousarray(
+            self._outputs[index].asnumpy().astype(np.float32)).tobytes()
+
+    def reshape(self, new_shapes: Dict[str, Sequence[int]]) -> "Predictor":
+        shapes = {n: tuple(self._args[n].shape) for n in self._input_names}
+        shapes.update({k: tuple(int(x) for x in v)
+                       for k, v in new_shapes.items()})
+        clone = object.__new__(Predictor)
+        clone.__dict__.update(self.__dict__)
+        import mxnet_tpu as mx
+        args = dict(self._args)
+        for n, s in shapes.items():
+            args[n] = mx.nd.zeros(s)
+        clone._args = args
+        clone._exec = self._sym.bind(
+            self._ctx, args, aux_states=self._aux if self._aux else None)
+        clone._input_names = list(self._input_names)
+        clone._outputs = None
+        return clone
+
+
+class NDList:
+    """MXNDListCreate / MXNDListGet: read an ndarray file's contents."""
+
+    def __init__(self, nd_bytes: bytes):
+        arg, aux = _load_param_bytes(nd_bytes)
+        merged = dict(arg)
+        merged.update({f"aux:{k}": v for k, v in aux.items()})
+        self._names = list(merged)
+        self._arrays = [np.asarray(merged[n].asnumpy(), np.float32)
+                        for n in self._names]
+
+    def __len__(self):
+        return len(self._names)
+
+    def get(self, index: int):
+        a = self._arrays[index]
+        return self._names[index], a.tobytes(), tuple(a.shape)

@@ -1,0 +1,204 @@
+"""C prediction ABI (include/mxtpu/c_predict_api.h + native/c_predict_api.cc):
+exercised two ways — in-process via ctypes (the library joins this
+interpreter) and from a standalone C program that embeds the interpreter,
+proving the other-language-binding story end-to-end."""
+import ctypes
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SO = os.path.join(ROOT, "mxnet_tpu", "native", "libmxtpu_predict.so")
+SRC = os.path.join(ROOT, "mxnet_tpu", "native", "c_predict_api.cc")
+
+
+def _build_so():
+    if (os.path.exists(SO)
+            and os.path.getmtime(SO) >= os.path.getmtime(SRC)):
+        return True
+    inc = subprocess.run(["python3-config", "--includes"],
+                         capture_output=True, text=True).stdout.split()
+    r = subprocess.run(
+        ["g++", "-O2", "-shared", "-fPIC", "-o", SO, SRC, *inc,
+         f'-DMXTPU_DEFAULT_ROOT="{ROOT}"',
+         "-L/usr/local/lib", f"-lpython3.{sys.version_info[1]}", "-ldl"],
+        capture_output=True, text=True)
+    return r.returncode == 0
+
+
+def _export_model(tmp_path):
+    """A tiny known-weight MLP exported as (symbol json, reference .params
+    bytes) + the expected forward output."""
+    import mxnet_tpu.symbol as sym
+    from mxnet_tpu import interop
+    x = sym.Variable("data")
+    h = sym.FullyConnected(x, num_hidden=3, name="fc1")
+    out = sym.Activation(h, act_type="relu", name="relu1")
+
+    rng = np.random.RandomState(0)
+    w = rng.randn(3, 4).astype("float32")
+    b = rng.randn(3).astype("float32")
+    params = {"arg:fc1_weight": nd.array(w), "arg:fc1_bias": nd.array(b)}
+    pfile = str(tmp_path / "model.params")
+    interop.save_reference_params(pfile, params)
+
+    data = rng.randn(2, 4).astype("float32")
+    expect = np.maximum(data @ w.T + b, 0.0)
+    return out.tojson(), open(pfile, "rb").read(), data, expect
+
+
+@pytest.fixture(scope="module")
+def lib():
+    if not _build_so():
+        pytest.skip("toolchain cannot build libmxtpu_predict.so")
+    return ctypes.CDLL(SO)
+
+
+def test_ctypes_roundtrip(lib, tmp_path):
+    js, pbytes, data, expect = _export_model(tmp_path)
+    handle = ctypes.c_void_p()
+    keys = (ctypes.c_char_p * 1)(b"data")
+    indptr = (ctypes.c_uint * 2)(0, 2)
+    shp = (ctypes.c_uint * 2)(2, 4)
+    rc = lib.MXPredCreate(js.encode(), pbytes, len(pbytes), 1, 0, 1, keys,
+                          indptr, shp, ctypes.byref(handle))
+    lib.MXGetLastError.restype = ctypes.c_char_p
+    assert rc == 0, lib.MXGetLastError()
+
+    flat = np.ascontiguousarray(data.ravel())
+    rc = lib.MXPredSetInput(handle, b"data",
+                            flat.ctypes.data_as(
+                                ctypes.POINTER(ctypes.c_float)), flat.size)
+    assert rc == 0, lib.MXGetLastError()
+    assert lib.MXPredForward(handle) == 0, lib.MXGetLastError()
+
+    sdata = ctypes.POINTER(ctypes.c_uint)()
+    ndim = ctypes.c_uint()
+    rc = lib.MXPredGetOutputShape(handle, 0, ctypes.byref(sdata),
+                                  ctypes.byref(ndim))
+    assert rc == 0, lib.MXGetLastError()
+    shape = tuple(sdata[i] for i in range(ndim.value))
+    assert shape == (2, 3)
+
+    out = np.zeros(6, "float32")
+    rc = lib.MXPredGetOutput(handle, 0,
+                             out.ctypes.data_as(
+                                 ctypes.POINTER(ctypes.c_float)), out.size)
+    assert rc == 0, lib.MXGetLastError()
+    np.testing.assert_allclose(out.reshape(2, 3), expect, rtol=1e-5)
+
+    # size mismatch is a clean error, not a crash
+    bad = np.zeros(5, "float32")
+    rc = lib.MXPredGetOutput(handle, 0,
+                             bad.ctypes.data_as(
+                                 ctypes.POINTER(ctypes.c_float)), bad.size)
+    assert rc == -1 and b"size mismatch" in lib.MXGetLastError()
+    assert lib.MXPredFree(handle) == 0
+
+
+def test_ndlist(lib, tmp_path):
+    _, pbytes, _, _ = _export_model(tmp_path)
+    handle = ctypes.c_void_p()
+    length = ctypes.c_uint()
+    rc = lib.MXNDListCreate(pbytes, len(pbytes), ctypes.byref(handle),
+                            ctypes.byref(length))
+    lib.MXGetLastError.restype = ctypes.c_char_p
+    assert rc == 0, lib.MXGetLastError()
+    assert length.value == 2
+    key = ctypes.c_char_p()
+    dptr = ctypes.POINTER(ctypes.c_float)()
+    sptr = ctypes.POINTER(ctypes.c_uint)()
+    ndim = ctypes.c_uint()
+    rc = lib.MXNDListGet(handle, 0, ctypes.byref(key), ctypes.byref(dptr),
+                         ctypes.byref(sptr), ctypes.byref(ndim))
+    assert rc == 0
+    assert key.value.decode() in ("fc1_weight", "fc1_bias")
+    assert lib.MXNDListFree(handle) == 0
+
+
+C_DRIVER = textwrap.dedent(r"""
+    #include <stdio.h>
+    #include <stdlib.h>
+    #include <string.h>
+    #include "mxtpu/c_predict_api.h"
+
+    static char* slurp(const char* path, long* size) {
+      FILE* f = fopen(path, "rb");
+      if (!f) { fprintf(stderr, "open %s failed\n", path); exit(2); }
+      fseek(f, 0, SEEK_END); *size = ftell(f); fseek(f, 0, SEEK_SET);
+      char* buf = (char*)malloc(*size + 1);
+      if (fread(buf, 1, *size, f) != (size_t)*size) exit(2);
+      buf[*size] = 0; fclose(f);
+      return buf;
+    }
+
+    int main(int argc, char** argv) {
+      long jsz, psz;
+      char* json = slurp(argv[1], &jsz);
+      char* params = slurp(argv[2], &psz);
+      const char* keys[] = {"data"};
+      mx_uint indptr[] = {0, 2};
+      mx_uint shape[] = {2, 4};
+      PredictorHandle h = NULL;
+      if (MXPredCreate(json, params, (int)psz, 1, 0, 1, keys, indptr,
+                       shape, &h) != 0) {
+        fprintf(stderr, "create: %s\n", MXGetLastError()); return 1;
+      }
+      float in[8];
+      long isz; char* ibytes = slurp(argv[3], &isz);
+      memcpy(in, ibytes, sizeof(in));
+      if (MXPredSetInput(h, "data", in, 8) != 0) {
+        fprintf(stderr, "set: %s\n", MXGetLastError()); return 1;
+      }
+      if (MXPredForward(h) != 0) {
+        fprintf(stderr, "fwd: %s\n", MXGetLastError()); return 1;
+      }
+      mx_uint *oshape, ondim;
+      if (MXPredGetOutputShape(h, 0, &oshape, &ondim) != 0) return 1;
+      mx_uint n = 1;
+      for (mx_uint i = 0; i < ondim; ++i) n *= oshape[i];
+      float* out = (float*)malloc(sizeof(float) * n);
+      if (MXPredGetOutput(h, 0, out, n) != 0) return 1;
+      for (mx_uint i = 0; i < n; ++i) printf("%.6f\n", out[i]);
+      MXPredFree(h);
+      return 0;
+    }
+""")
+
+
+def test_standalone_c_program(lib, tmp_path):
+    """Compile a pure-C driver against the public header and run it in a
+    process with NO Python on the command line — the library must bring up
+    the interpreter itself."""
+    js, pbytes, data, expect = _export_model(tmp_path)
+    (tmp_path / "model.json").write_text(js)
+    (tmp_path / "model.params").write_bytes(pbytes)
+    (tmp_path / "input.bin").write_bytes(
+        np.ascontiguousarray(data).tobytes())
+    csrc = tmp_path / "driver.c"
+    csrc.write_text(C_DRIVER)
+    exe = tmp_path / "driver"
+    r = subprocess.run(
+        ["gcc", "-O1", str(csrc), "-I", os.path.join(ROOT, "include"),
+         "-L", os.path.dirname(SO), "-lmxtpu_predict",
+         f"-Wl,-rpath,{os.path.dirname(SO)}", "-o", str(exe)],
+        capture_output=True, text=True)
+    if r.returncode != 0:
+        pytest.skip(f"cannot link C driver: {r.stderr[:400]}")
+    env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["MXTPU_ROOT"] = ROOT
+    r = subprocess.run(
+        [str(exe), str(tmp_path / "model.json"),
+         str(tmp_path / "model.params"), str(tmp_path / "input.bin")],
+        capture_output=True, text=True, env=env, timeout=300)
+    assert r.returncode == 0, f"stdout:{r.stdout}\nstderr:{r.stderr}"
+    got = np.array([float(x) for x in r.stdout.split()], "float32")
+    np.testing.assert_allclose(got.reshape(2, 3), expect, rtol=1e-5)

@@ -80,3 +80,15 @@ def test_missing_step_raises(tmp_path):
     ckpt = ShardedCheckpointer(str(tmp_path / "run"))
     with pytest.raises(mx.MXNetError, match="no checkpoint"):
         ckpt.restore(99)
+
+
+def test_restore_like_with_aux(tmp_path):
+    """Resharded restore must work on checkpoints that carry aux state —
+    missing target keys are filled from the checkpoint's own metadata."""
+    ckpt = ShardedCheckpointer(str(tmp_path / "run"))
+    params = {"w": jnp.ones((4, 4)) * 2}
+    ckpt.save(0, params, aux={"ema": jnp.ones((4,)) * 3})
+    out = ckpt.restore(0, like=params)
+    np.testing.assert_allclose(np.asarray(out["w"]), 2.0)
+    np.testing.assert_allclose(np.asarray(out["__aux__ema"]), 3.0)
+    ckpt.close()

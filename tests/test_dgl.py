@@ -128,3 +128,34 @@ def test_graph_compact_requires_sizes():
     g = full_graph()
     with pytest.raises(MXNetError, match="graph_sizes"):
         nd.contrib.dgl_graph_compact(g, nd.array([0, 1], dtype="int64"))
+
+
+def test_seed_validation():
+    g = full_graph()
+    with pytest.raises(MXNetError, match=r"\[0, 5\)"):
+        nd.contrib.dgl_csr_neighbor_uniform_sample(
+            g, nd.array([7], dtype="int64"), max_num_vertices=3)
+    with pytest.raises(MXNetError, match=r"\[0, 5\)"):
+        nd.contrib.dgl_subgraph(g, nd.array([0, 9], dtype="int64"))
+
+
+def test_non_uniform_preserves_edge_pairing():
+    """Edge ids must stay paired with their neighbor column even when ids
+    do not ascend with column order (fixes the reference's independent-sort
+    quirk, GetNonUniformSample dgl_graph.cc:533)."""
+    # row 0 has neighbors 1..4 with DESCENDING edge ids 40,30,20,10
+    data = np.array([40, 30, 20, 10], np.int64)
+    indices = np.array([1, 2, 3, 4], np.int64)
+    indptr = np.array([0, 4, 4, 4, 4, 4], np.int64)
+    g = sp.csr_matrix((data, indices, indptr), shape=(5, 5))
+    prob = nd.array(np.ones(5, "float32"))
+    verts, sub, sprob, layer = nd.contrib.dgl_csr_neighbor_non_uniform_sample(
+        g, prob, nd.array([0], dtype="int64"), num_hops=1, num_neighbor=3,
+        max_num_vertices=5, seed=2)
+    dense = sub.asnumpy()
+    expect = {1: 40, 2: 30, 3: 20, 4: 10}
+    row0 = dense[0]
+    picked = {c: int(row0[c]) for c in np.nonzero(row0)[0]}
+    assert len(picked) == 3
+    for c, eid in picked.items():
+        assert eid == expect[c], (c, eid)
