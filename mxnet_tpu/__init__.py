@@ -24,7 +24,7 @@ _jax.config.update("jax_enable_x64", True)
 
 from . import base
 from .base import MXNetError
-from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, num_gpus, num_tpus
+from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, num_gpus, num_tpus, gpu_memory_info
 from . import ops
 from . import ndarray
 from . import ndarray as nd

@@ -12,7 +12,7 @@ from typing import Optional
 
 import jax
 
-__all__ = ["Context", "cpu", "gpu", "tpu", "current_context", "num_gpus", "num_tpus"]
+__all__ = ["Context", "cpu", "gpu", "tpu", "current_context", "num_gpus", "num_tpus", "gpu_memory_info"]
 
 
 class Context:
@@ -156,6 +156,17 @@ def num_gpus() -> int:
 
 def num_tpus() -> int:
     return num_gpus()
+
+
+def gpu_memory_info(device_id: int = 0):
+    """(free_bytes, total_bytes) of the accelerator's HBM — reference
+    ``mx.context.gpu_memory_info`` / ``MXGetGPUMemoryInformation64``. Total
+    is the allocator's byte limit; on backends that expose no allocator
+    stats (some PJRT plugins) both values are 0."""
+    info = gpu(device_id).memory_info()
+    total = info.get("bytes_limit") or 0
+    used = info.get("bytes_in_use") or 0
+    return (max(total - used, 0), total)
 
 
 def current_context() -> Context:

@@ -381,7 +381,16 @@ class Executor:
 
     def _compiled_custom_bwd(self) -> Callable:
         """Jitted fwd+bwd with explicit head cotangents (the rare
-        backward(out_grads=...) path; recomputes forward inside one program)."""
+        backward(out_grads=...) path; recomputes forward inside one program).
+
+        Deliberate cost tradeoff: XLA cannot export a vjp closure across
+        program boundaries, so reusing forward's residuals would require
+        splitting the default train path into two programs (fwd, then
+        fwd+bwd) — slowing the common case ~1.3x to speed this rare one.
+        Instead the custom-cotangent path recomputes the forward inside one
+        fused program (compiled once, cached); callers looping over custom
+        cotangents should pass them via autograd.grad with create_graph
+        instead."""
         if "custom_bwd" not in self._jit_cache:
             raw = self._lowering.lower(True)
             diff_names = self._diff_names()

@@ -20,6 +20,7 @@
 #include <dlfcn.h>
 
 #include <cstring>
+#include <mutex>
 #include <string>
 #include <vector>
 
@@ -62,13 +63,13 @@ void set_error_from_python() {
 // GIL, so release it once to put the interpreter in the "callable from any
 // thread via PyGILState" state.
 void ensure_interpreter() {
-  static bool done = false;
-  if (done) return;
-  if (!Py_IsInitialized()) {
-    Py_InitializeEx(0);
-    PyEval_SaveThread();
-  }
-  done = true;
+  static std::once_flag flag;
+  std::call_once(flag, [] {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      PyEval_SaveThread();
+    }
+  });
 }
 
 // Import the bridge module once (call with the GIL held).

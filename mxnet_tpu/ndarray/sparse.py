@@ -133,6 +133,12 @@ class RowSparseNDArray(BaseSparseNDArray):
             return RowSparseNDArray(merged, uniq, self._shape)
         return self.todense() + other
 
+    def __sub__(self, other):
+        if isinstance(other, RowSparseNDArray):
+            return self + RowSparseNDArray(-other._values, other._indices,
+                                           other._shape)
+        return self.todense() - other
+
     def __mul__(self, other):
         if np.isscalar(other):
             return RowSparseNDArray(self._values * other, self._indices,
@@ -247,6 +253,126 @@ class CSRNDArray(BaseSparseNDArray):
             return CSRNDArray(self._values[lo:hi], self._indices[lo:hi],
                               ptr - lo, (stop - start, self._shape[1]))
         return self.todense()[i]
+
+    # -------------------------------------------------- sparse arithmetic
+    def _coo(self):
+        """Host (rows, cols, vals) view — CSR structure manipulation is
+        metadata work the reference also runs on CPU kernels."""
+        indptr = np.asarray(self._indptr)
+        rows = np.repeat(np.arange(self._shape[0], dtype=np.int64),
+                         np.diff(indptr))
+        return rows, np.asarray(self._indices, np.int64), \
+            np.asarray(self._values)
+
+    @staticmethod
+    def _from_coo(rows, cols, vals, shape, prune_zeros=True):
+        order = np.lexsort((cols, rows))
+        rows, cols, vals = rows[order], cols[order], vals[order]
+        if len(rows):
+            # merge duplicate (row, col) entries
+            boundary = np.ones(len(rows), bool)
+            boundary[1:] = (rows[1:] != rows[:-1]) | (cols[1:] != cols[:-1])
+            starts = np.flatnonzero(boundary)
+            vals = np.add.reduceat(vals, starts)
+            rows, cols = rows[starts], cols[starts]
+        if prune_zeros and len(rows):
+            keep = vals != 0
+            rows, cols, vals = rows[keep], cols[keep], vals[keep]
+        indptr = np.zeros(shape[0] + 1, np.int64)
+        np.add.at(indptr, rows + 1, 1)
+        return CSRNDArray(vals, cols, np.cumsum(indptr), shape)
+
+    def __add__(self, other):
+        """csr + csr stays csr (reference ElemwiseBinaryOp csr,csr->csr
+        FComputeEx); anything else densifies."""
+        if isinstance(other, CSRNDArray):
+            if other._shape != self._shape:
+                raise MXNetError(f"shape mismatch {self._shape} vs "
+                                 f"{other._shape}")
+            r1, c1, v1 = self._coo()
+            r2, c2, v2 = other._coo()
+            return self._from_coo(np.concatenate([r1, r2]),
+                                  np.concatenate([c1, c2]),
+                                  np.concatenate([v1, v2]), self._shape)
+        return self.todense() + other
+
+    def __sub__(self, other):
+        if isinstance(other, CSRNDArray):
+            return self + CSRNDArray(-other._values, other._indices,
+                                     other._indptr, other._shape)
+        return self.todense() - other
+
+    def __mul__(self, other):
+        """Scalar scaling and csr*csr intersection stay csr; csr * dense
+        keeps the sparsity pattern, scaling each stored value by the dense
+        element at its position (reference elemwise_mul csr,dense->csr)."""
+        if np.isscalar(other):
+            return CSRNDArray(self._values * other, self._indices,
+                              self._indptr, self._shape)
+        if isinstance(other, CSRNDArray):
+            if other._shape != self._shape:
+                raise MXNetError(f"shape mismatch {self._shape} vs "
+                                 f"{other._shape}")
+            # sparse intersection on linearized keys — never densifies
+            r1, c1, v1 = self._coo()
+            r2, c2, v2 = other._coo()
+            ncols = self._shape[1]
+            k1 = r1 * ncols + c1
+            k2 = r2 * ncols + c2
+            common, i1, i2 = np.intersect1d(k1, k2, assume_unique=True,
+                                            return_indices=True)
+            return self._from_coo(common // ncols, common % ncols,
+                                  v1[i1] * v2[i2], self._shape)
+        dense = np.asarray(other.asnumpy() if hasattr(other, "asnumpy")
+                           else other)
+        rows, cols, vals = self._coo()
+        return self._from_coo(rows, cols, vals * dense[rows, cols],
+                              self._shape, prune_zeros=False)
+
+    __rmul__ = __mul__
+
+    def sum(self, axis=None):
+        """Reductions without densifying (reference sum FComputeEx csr)."""
+        from .ndarray import _wrap
+        rows, cols, vals = self._coo()
+        if axis is None:
+            return _wrap(jnp.asarray(np.asarray(vals).sum()))
+        if axis in (0, -2):
+            out = np.zeros(self._shape[1], vals.dtype)
+            np.add.at(out, cols, vals)
+            return _wrap(jnp.asarray(out))
+        if axis in (1, -1):
+            out = np.zeros(self._shape[0], vals.dtype)
+            np.add.at(out, rows, vals)
+            return _wrap(jnp.asarray(out))
+        raise MXNetError(f"bad axis {axis} for 2-D CSR")
+
+    def mean(self, axis=None):
+        n = (np.prod(self._shape) if axis is None
+             else self._shape[0] if axis in (0, -2) else self._shape[1])
+        return self.sum(axis=axis) / float(n)
+
+    @property
+    def nnz(self) -> int:
+        return int(self._values.shape[0])
+
+
+def add_n(*arrays):
+    """ElementwiseSum over a mixed sparse/dense list (reference
+    ElementwiseSum FComputeEx: all-row_sparse stays row_sparse, all-csr
+    stays csr, any dense densifies)."""
+    if not arrays:
+        raise MXNetError("add_n needs at least one array")
+    if len(arrays) == 1 and isinstance(arrays[0], (list, tuple)):
+        arrays = tuple(arrays[0])
+    out = arrays[0]
+    for a in arrays[1:]:
+        if isinstance(a, BaseSparseNDArray) \
+                and not isinstance(out, BaseSparseNDArray):
+            a = a.todense()   # dense accumulator: dense NDArray ops can't
+                              # consume a sparse rhs
+        out = out + a
+    return out
 
 
 def row_sparse_array(arg1, shape=None, ctx=None, dtype=None) -> RowSparseNDArray:

@@ -537,11 +537,43 @@ class Updater:
         self.states_synced: Dict[Any, bool] = {}
 
     def __call__(self, index, grad, weight):
+        from .ndarray.sparse import RowSparseNDArray
+        if isinstance(grad, RowSparseNDArray):
+            if self._lazy_row_sparse_update(index, grad, weight):
+                return
+            grad = grad.todense()   # stateful optimizers: standard update
         if index not in self.states:
             self.states[index] = self.optimizer.create_state_multi_precision(
                 index, weight)
         self.optimizer.update_multi_precision(index, weight, grad,
                                               self.states[index])
+
+    def _lazy_row_sparse_update(self, index, grad, weight) -> bool:
+        """Row-sparse lazy update: touch ONLY the rows present in the
+        gradient (reference sparse SGD kernel, optimizer_op.cc SGDUpdateEx
+        row_sparse path / optimizer.py lazy_update=True). Supported for
+        momentum-free SGD, where untouched rows are genuinely unchanged;
+        stateful optimizers fall back to a dense update because their
+        per-row state must decay every step."""
+        opt = self.optimizer
+        # plain SGD only: momentum/delay-compensation/master-copy state must
+        # evolve every step, which a touched-rows-only update cannot honor
+        if not (type(opt).__name__ == "SGD"
+                and getattr(opt, "momentum", 0) == 0
+                and not getattr(opt, "multi_precision", False)):
+            return False
+        import jax.numpy as jnp
+        opt._update_count(index)
+        lr = opt._get_lr(index)
+        wd = opt._get_wd(index)
+        idx = jnp.asarray(grad._indices).astype(jnp.int32)
+        g = jnp.asarray(grad._values) * opt.rescale_grad
+        if getattr(opt, "clip_gradient", None):
+            g = jnp.clip(g, -opt.clip_gradient, opt.clip_gradient)
+        w = weight._data
+        rows = w[idx]
+        weight._set_data(w.at[idx].set(rows - lr * (g + wd * rows)))
+        return True
 
     def get_states(self, dump_optimizer=False):
         import pickle

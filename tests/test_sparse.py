@@ -143,3 +143,136 @@ def test_csr_empty_and_inverted_slice():
         assert isinstance(s, sp.CSRNDArray)
         assert s.shape == (0, 4)
         assert s.asnumpy().shape == (0, 4)
+
+
+def _mk_csr(dense):
+    dense = np.asarray(dense, "float32")
+    from mxnet_tpu.ndarray import sparse as sp
+    rows, cols = np.nonzero(dense)
+    indptr = np.zeros(dense.shape[0] + 1, np.int64)
+    np.add.at(indptr, rows + 1, 1)
+    return sp.csr_matrix((dense[rows, cols], cols.astype(np.int64),
+                          np.cumsum(indptr)), shape=dense.shape)
+
+
+def test_csr_add_sub_stays_csr(rng):
+    from mxnet_tpu.ndarray import sparse as sp
+    a = np.where(rng.rand(5, 6) < 0.3, rng.randn(5, 6), 0).astype("float32")
+    b = np.where(rng.rand(5, 6) < 0.3, rng.randn(5, 6), 0).astype("float32")
+    ca, cb = _mk_csr(a), _mk_csr(b)
+    s = ca + cb
+    assert isinstance(s, sp.CSRNDArray)
+    np.testing.assert_allclose(s.asnumpy(), a + b, rtol=1e-6)
+    d = ca - cb
+    assert isinstance(d, sp.CSRNDArray)
+    np.testing.assert_allclose(d.asnumpy(), a - b, rtol=1e-6)
+    # exact cancellation prunes entries rather than storing zeros
+    z = ca - ca
+    assert z.nnz == 0 and not z.asnumpy().any()
+
+
+def test_csr_mul_and_reductions(rng):
+    from mxnet_tpu.ndarray import sparse as sp
+    a = np.where(rng.rand(4, 5) < 0.4, rng.randn(4, 5), 0).astype("float32")
+    b = np.where(rng.rand(4, 5) < 0.4, rng.randn(4, 5), 0).astype("float32")
+    ca, cb = _mk_csr(a), _mk_csr(b)
+    np.testing.assert_allclose((ca * 2.5).asnumpy(), a * 2.5, rtol=1e-6)
+    m = ca * cb                         # intersection product stays csr
+    assert isinstance(m, sp.CSRNDArray)
+    np.testing.assert_allclose(m.asnumpy(), a * b, rtol=1e-6)
+    dense = rng.randn(4, 5).astype("float32")
+    md = ca * mx.nd.array(dense)        # pattern-preserving scale
+    assert isinstance(md, sp.CSRNDArray)
+    np.testing.assert_allclose(md.asnumpy(), a * dense, rtol=1e-6)
+    np.testing.assert_allclose(float(ca.sum().asnumpy()), a.sum(), rtol=1e-5)
+    np.testing.assert_allclose(ca.sum(axis=0).asnumpy(), a.sum(0), rtol=1e-5)
+    np.testing.assert_allclose(ca.sum(axis=1).asnumpy(), a.sum(1), rtol=1e-5)
+    np.testing.assert_allclose(ca.mean(axis=1).asnumpy(), a.mean(1), rtol=1e-5)
+
+
+def test_sparse_add_n(rng):
+    from mxnet_tpu.ndarray import sparse as sp
+    dense = [np.where(rng.rand(3, 4) < 0.5, rng.randn(3, 4), 0).astype("f4")
+             for _ in range(3)]
+    out = sp.add_n(*[_mk_csr(d) for d in dense])
+    assert isinstance(out, sp.CSRNDArray)
+    np.testing.assert_allclose(out.asnumpy(), sum(dense), rtol=1e-5)
+    # row_sparse flavor
+    rs = [sp.row_sparse_array((rng.randn(2, 4).astype("f4"),
+                               np.array([0, 2])), shape=(5, 4))
+          for _ in range(2)]
+    out = sp.add_n(rs[0], rs[1])
+    assert isinstance(out, sp.RowSparseNDArray)
+    np.testing.assert_allclose(out.asnumpy(), rs[0].asnumpy() + rs[1].asnumpy(),
+                               rtol=1e-5)
+
+
+def test_row_sparse_sub(rng):
+    from mxnet_tpu.ndarray import sparse as sp
+    a = sp.row_sparse_array((rng.randn(2, 3).astype("f4"), np.array([1, 3])),
+                            shape=(5, 3))
+    b = sp.row_sparse_array((rng.randn(2, 3).astype("f4"), np.array([0, 3])),
+                            shape=(5, 3))
+    d = a - b
+    assert isinstance(d, sp.RowSparseNDArray)
+    np.testing.assert_allclose(d.asnumpy(), a.asnumpy() - b.asnumpy(),
+                               rtol=1e-6)
+
+
+def test_lazy_row_sparse_sgd_update(rng):
+    """SGD with a row_sparse gradient must update ONLY the touched rows
+    (reference lazy_update=True sparse SGD kernel)."""
+    from mxnet_tpu.ndarray import sparse as sp
+    from mxnet_tpu import optimizer as opt_mod
+    w0 = rng.randn(6, 3).astype("float32")
+    w = mx.nd.array(w0.copy())
+    g = sp.row_sparse_array((np.ones((2, 3), "f4"), np.array([1, 4])),
+                            shape=(6, 3))
+    upd = opt_mod.get_updater(opt_mod.SGD(learning_rate=0.5, wd=0.0,
+                                          rescale_grad=1.0))
+    upd(0, g, w)
+    got = w.asnumpy()
+    np.testing.assert_allclose(got[[1, 4]], w0[[1, 4]] - 0.5, rtol=1e-6)
+    np.testing.assert_allclose(got[[0, 2, 3, 5]], w0[[0, 2, 3, 5]])
+    # stateful optimizer (momentum) falls back to an equivalent dense update
+    upd2 = opt_mod.get_updater(opt_mod.SGD(learning_rate=0.5, momentum=0.9))
+    w2 = mx.nd.array(w0.copy())
+    upd2(0, g, w2)
+    assert not np.allclose(w2.asnumpy()[[1, 4]], w0[[1, 4]])
+
+
+def test_gpu_memory_info_api():
+    if mx.num_gpus():
+        free, total = mx.gpu_memory_info(0)
+        assert free >= 0 and total >= free
+    # the Context.memory_info dict must answer for the cpu device too
+    info = mx.cpu().memory_info()
+    assert "live_arrays" in info and "bytes_in_use" in info
+
+
+def test_add_n_dense_first(rng):
+    from mxnet_tpu.ndarray import sparse as sp
+    d = rng.randn(3, 4).astype("f4")
+    s = np.where(rng.rand(3, 4) < 0.5, rng.randn(3, 4), 0).astype("f4")
+    out = sp.add_n(mx.nd.array(d), _mk_csr(s))
+    np.testing.assert_allclose(out.asnumpy(), d + s, rtol=1e-6)
+
+
+def test_lazy_sparse_update_advances_lr_schedule(rng):
+    """The lazy path must advance num_update so lr schedules decay."""
+    from mxnet_tpu.ndarray import sparse as sp
+    from mxnet_tpu import optimizer as opt_mod
+    sched = mx.lr_scheduler.FactorScheduler(step=1, factor=0.5)
+    opt = opt_mod.SGD(learning_rate=1.0, lr_scheduler=sched)
+    upd = opt_mod.get_updater(opt)
+    w = mx.nd.array(np.zeros((4, 2), "f4"))
+    g = sp.row_sparse_array((np.ones((1, 2), "f4"), np.array([0])),
+                            shape=(4, 2))
+    for _ in range(3):
+        upd(0, g, w)
+    assert opt.num_update == 3
+    # DCASGD and multi-precision SGD must NOT take the lazy path
+    for o in (opt_mod.DCASGD(learning_rate=0.1),
+              opt_mod.SGD(learning_rate=0.1, multi_precision=True)):
+        u = opt_mod.Updater(o)
+        assert not u._lazy_row_sparse_update(0, g, w)
