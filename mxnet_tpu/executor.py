@@ -311,6 +311,11 @@ class Executor:
         if "train_step" not in self._jit_cache:
             raw = self._lowering.lower(True)
             diff_names = self._diff_names()
+            # MXNET_BACKWARD_DO_MIRROR (graph_executor.cc:232 mirroring):
+            # rematerialize the forward during backward instead of keeping
+            # every activation — jax.checkpoint is the XLA-native form
+            from .base import get_env
+            mirror = bool(get_env("MXNET_BACKWARD_DO_MIRROR", False))
 
             def step(inputs, rng):
                 diff = {n: inputs[n] for n in diff_names}
@@ -320,6 +325,8 @@ class Executor:
                 def f(d):
                     return raw({**d, **nondiff}, rng)
 
+                if mirror:
+                    f = jax.checkpoint(f)
                 (outs, aux), vjp_fn = jax.vjp(f, diff)
                 cts = [jnp.ones_like(o) for o in outs]
                 aux_ct = jax.tree_util.tree_map(jnp.zeros_like, aux)

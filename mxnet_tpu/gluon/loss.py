@@ -146,13 +146,15 @@ class CTCLoss(Loss):
                            _unwrap(label_lengths)[:, None]).astype(jnp.float32)
             else:
                 lab_pad = (labels < 0).astype(jnp.float32)
-            # optax expects blank_id default 0; MXNet uses blank = 0 in
-            # 'first' mode — labels already 1-based for classes.
-            fn = lambda lg, lb: optax.ctc_loss(lg, logit_pad, lb, lab_pad)
+            # gluon convention: index alphabet_size-1 is the blank
+            # (reference gluon/loss.py:475 blank_label='last'), labels are
+            # 0-based and must never equal the blank id
+            blank = c - 1
             if ag.is_recording():
                 import jax as _jax
                 out, vjp = _jax.vjp(lambda lg: optax.ctc_loss(
-                    lg, logit_pad, jnp.maximum(labels, 0), lab_pad), logits)
+                    lg, logit_pad, jnp.maximum(labels, 0), lab_pad,
+                    blank_id=blank), logits)
                 st = ag._st()
                 node = ag._Node(lambda ct: vjp(ct), [getattr(pred, "_ag_node", None)],
                                 [getattr(pred, "_ag_slot", 0)], 1, st.counter, "CTCLoss")
@@ -163,7 +165,8 @@ class CTCLoss(Loss):
                 w._ag_slot = 0
                 return w
             return _wrap(optax.ctc_loss(logits, logit_pad,
-                                        jnp.maximum(labels, 0), lab_pad))
+                                        jnp.maximum(labels, 0), lab_pad,
+                                        blank_id=blank))
         raise NotImplementedError("symbolic CTCLoss: call imperatively or use "
                                   "F.CTCLoss op once registered")
 

@@ -186,6 +186,9 @@ class LayerNorm(HybridBlock):
         out = F.LayerNorm(x, gamma, beta, axis=self._axis, eps=self._eps)
         if isinstance(out, (list, tuple)):
             out = out[0]
+        elif hasattr(out, "list_outputs") and len(out.list_outputs()) > 1:
+            out = out[0]   # symbolic: keep only the normalized output, not
+                           # the (mean, std) side outputs
         return out
 
 

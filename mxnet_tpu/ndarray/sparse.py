@@ -265,16 +265,22 @@ class CSRNDArray(BaseSparseNDArray):
             np.asarray(self._values)
 
     @staticmethod
-    def _from_coo(rows, cols, vals, shape, prune_zeros=True):
+    def _merge_coo(rows, cols, vals):
+        """Canonicalize: sort by (row, col) and sum duplicate entries (the
+        raw csr_matrix ctor performs no canonicalization)."""
         order = np.lexsort((cols, rows))
         rows, cols, vals = rows[order], cols[order], vals[order]
         if len(rows):
-            # merge duplicate (row, col) entries
             boundary = np.ones(len(rows), bool)
             boundary[1:] = (rows[1:] != rows[:-1]) | (cols[1:] != cols[:-1])
             starts = np.flatnonzero(boundary)
             vals = np.add.reduceat(vals, starts)
             rows, cols = rows[starts], cols[starts]
+        return rows, cols, vals
+
+    @staticmethod
+    def _from_coo(rows, cols, vals, shape, prune_zeros=True):
+        rows, cols, vals = CSRNDArray._merge_coo(rows, cols, vals)
         if prune_zeros and len(rows):
             keep = vals != 0
             rows, cols, vals = rows[keep], cols[keep], vals[keep]
@@ -313,9 +319,10 @@ class CSRNDArray(BaseSparseNDArray):
             if other._shape != self._shape:
                 raise MXNetError(f"shape mismatch {self._shape} vs "
                                  f"{other._shape}")
-            # sparse intersection on linearized keys — never densifies
-            r1, c1, v1 = self._coo()
-            r2, c2, v2 = other._coo()
+            # sparse intersection on linearized keys — never densifies;
+            # canonicalize first so duplicate entries sum before multiplying
+            r1, c1, v1 = self._merge_coo(*self._coo())
+            r2, c2, v2 = self._merge_coo(*other._coo())
             ncols = self._shape[1]
             k1 = r1 * ncols + c1
             k2 = r2 * ncols + c2
@@ -325,6 +332,10 @@ class CSRNDArray(BaseSparseNDArray):
                                   v1[i1] * v2[i2], self._shape)
         dense = np.asarray(other.asnumpy() if hasattr(other, "asnumpy")
                            else other)
+        if tuple(dense.shape) != tuple(self._shape):
+            raise MXNetError(f"shape mismatch {self._shape} vs "
+                             f"{tuple(dense.shape)} (csr * dense requires "
+                             "identical shapes)")
         rows, cols, vals = self._coo()
         return self._from_coo(rows, cols, vals * dense[rows, cols],
                               self._shape, prune_zeros=False)
@@ -367,10 +378,9 @@ def add_n(*arrays):
         arrays = tuple(arrays[0])
     out = arrays[0]
     for a in arrays[1:]:
-        if isinstance(a, BaseSparseNDArray) \
-                and not isinstance(out, BaseSparseNDArray):
-            a = a.todense()   # dense accumulator: dense NDArray ops can't
-                              # consume a sparse rhs
+        if isinstance(a, BaseSparseNDArray) and type(a) is not type(out):
+            a = a.todense()   # dense accumulator or MIXED sparse storage
+                              # types: neither +-path can consume the rhs
         out = out + a
     return out
 

@@ -160,6 +160,7 @@ class SGD(Optimizer):
     def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
         super().__init__(**kwargs)
         self.momentum = momentum
+        self.lazy_update = lazy_update
 
     def create_state(self, index, weight):
         if self.momentum == 0.0:
@@ -556,23 +557,32 @@ class Updater:
         stateful optimizers fall back to a dense update because their
         per-row state must decay every step."""
         opt = self.optimizer
-        # plain SGD only: momentum/delay-compensation/master-copy state must
-        # evolve every step, which a touched-rows-only update cannot honor
+        # plain lazy SGD only: momentum/delay-compensation/master-copy state
+        # must evolve every step, which a touched-rows-only update cannot
+        # honor; lazy_update=False requests reference std_update semantics
+        # (weight decay applied to EVERY row each step)
         if not (type(opt).__name__ == "SGD"
                 and getattr(opt, "momentum", 0) == 0
+                and getattr(opt, "lazy_update", True)
                 and not getattr(opt, "multi_precision", False)):
             return False
         import jax.numpy as jnp
         opt._update_count(index)
         lr = opt._get_lr(index)
         wd = opt._get_wd(index)
+        # merge duplicate indices first — the raw (values, indices) ctor
+        # permits them, and todense() sums them, so the lazy path must too
         idx = jnp.asarray(grad._indices).astype(jnp.int32)
-        g = jnp.asarray(grad._values) * opt.rescale_grad
+        vals = jnp.asarray(grad._values)
+        uniq, inv = jnp.unique(idx, return_inverse=True)
+        g = jnp.zeros((uniq.shape[0],) + vals.shape[1:],
+                      vals.dtype).at[inv].add(vals)
+        g = g * opt.rescale_grad
         if getattr(opt, "clip_gradient", None):
             g = jnp.clip(g, -opt.clip_gradient, opt.clip_gradient)
         w = weight._data
-        rows = w[idx]
-        weight._set_data(w.at[idx].set(rows - lr * (g + wd * rows)))
+        rows = w[uniq]
+        weight._set_data(w.at[uniq].set(rows - lr * (g + wd * rows)))
         return True
 
     def get_states(self, dump_optimizer=False):

@@ -1,0 +1,58 @@
+"""Smoke tests for the example recipes: each must train and improve within
+a tiny budget (the reference gates examples the same way in its CI tutorials
+job). Also regression-tests the gluon CTC blank convention the OCR example
+exposed."""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(ROOT, "example", "recommenders"))
+sys.path.insert(0, os.path.join(ROOT, "example", "gluon"))
+sys.path.insert(0, os.path.join(ROOT, "example", "ctc"))
+
+
+def test_matrix_factorization_converges():
+    import matrix_factorization as mf
+    first, last = mf.train(epochs=3, verbose=False)
+    assert last < first * 0.5, (first, last)
+
+
+def test_dcgan_trains():
+    import dcgan
+    netG, netD, hist = dcgan.train(epochs=1, steps_per_epoch=6,
+                                   verbose=False)
+    dlosses = [d for d, _ in hist]
+    assert dlosses[-1] < dlosses[0]          # D learns real vs fake
+    assert np.isfinite(hist[-1]).all()
+
+
+def test_lstm_ocr_learns():
+    import lstm_ocr
+    first, last, acc = lstm_ocr.train(epochs=3, steps_per_epoch=20,
+                                      verbose=False)
+    assert last < first * 0.65, (first, last)
+    assert first > 0 and last > 0           # CTC is a negative log-likelihood
+
+
+def test_ctc_loss_blank_is_last_and_nonnegative():
+    """Gluon convention: blank = alphabet_size-1 (reference gluon/loss.py
+    blank_label='last'); labels may legally contain class id 0."""
+    ctc = gluon.loss.CTCLoss()
+    # perfect prediction of label [0]: logits peak class 0 then blank (id 2)
+    logits = np.full((1, 2, 3), -10.0, "float32")
+    logits[0, 0, 0] = 10.0       # t=0 -> class 0
+    logits[0, 1, 2] = 10.0       # t=1 -> blank
+    label = np.array([[0, -1]], "float32")   # -1 padding
+    loss = float(ctc(mx.nd.array(logits), mx.nd.array(label)).asnumpy().ravel()[0])
+    assert -1e-6 <= loss < 0.01  # ~perfect alignment -> NLL ~ 0
+    # a hard batch must still be >= 0
+    rng = np.random.RandomState(0)
+    loss2 = ctc(mx.nd.array(rng.randn(4, 12, 11).astype("f4")),
+                mx.nd.array(rng.randint(0, 10, (4, 4)).astype("f4")))
+    assert (loss2.asnumpy() >= 0).all()

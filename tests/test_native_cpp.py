@@ -1,0 +1,26 @@
+"""Build and run the C++-level native tests (tests/cpp/native_test.cc) —
+the reference's tests/cpp/{engine,storage} tier. Skips cleanly if no
+toolchain is available."""
+import os
+import shutil
+import subprocess
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_native_cpp_suite(tmp_path):
+    if shutil.which("g++") is None:
+        pytest.skip("no g++")
+    exe = str(tmp_path / "native_test")
+    build = subprocess.run(
+        ["g++", "-O2", "-std=c++17", "-pthread",
+         os.path.join(ROOT, "tests", "cpp", "native_test.cc"),
+         os.path.join(ROOT, "mxnet_tpu", "native", "engine_storage.cc"),
+         "-o", exe],
+        capture_output=True, text=True)
+    assert build.returncode == 0, build.stderr[:800]
+    run = subprocess.run([exe], capture_output=True, text=True, timeout=120)
+    assert run.returncode == 0, f"stdout:{run.stdout}\nstderr:{run.stderr}"
+    assert "ALL NATIVE C++ TESTS PASSED" in run.stdout

@@ -276,3 +276,45 @@ def test_lazy_sparse_update_advances_lr_schedule(rng):
               opt_mod.SGD(learning_rate=0.1, multi_precision=True)):
         u = opt_mod.Updater(o)
         assert not u._lazy_row_sparse_update(0, g, w)
+
+
+def test_review_fixes_sparse_edge_cases(rng):
+    from mxnet_tpu.ndarray import sparse as sp
+    from mxnet_tpu import optimizer as opt_mod
+    from mxnet_tpu.base import MXNetError
+    import pytest
+
+    # lazy_update=False keeps reference std_update semantics (wd every row)
+    w = mx.nd.array(np.ones((4, 2), "f4"))
+    g = sp.row_sparse_array((np.zeros((1, 2), "f4"), np.array([0])),
+                            shape=(4, 2))
+    upd = opt_mod.get_updater(opt_mod.SGD(learning_rate=0.5, wd=0.1,
+                                          lazy_update=False))
+    upd(0, g, w)
+    np.testing.assert_allclose(w.asnumpy(), 0.95)     # ALL rows decayed
+
+    # duplicate gradient indices sum in the lazy path (= dense semantics)
+    w = mx.nd.array(np.zeros((4, 2), "f4"))
+    g = sp.row_sparse_array((np.ones((2, 2), "f4"), np.array([1, 1])),
+                            shape=(4, 2))
+    upd = opt_mod.get_updater(opt_mod.SGD(learning_rate=1.0))
+    upd(0, g, w)
+    np.testing.assert_allclose(w.asnumpy()[1], -2.0)
+
+    # csr * dense shape mismatch raises, not silently mis-multiplies
+    c = _mk_csr(np.eye(2, 3, dtype="f4"))
+    with pytest.raises(MXNetError, match="shape mismatch"):
+        c * np.ones((8, 8), "f4")
+
+    # csr*csr with duplicate stored entries canonicalizes first
+    dup = sp.csr_matrix((np.array([1., 1.]), np.array([0, 0], np.int64),
+                         np.array([0, 2, 2], np.int64)), shape=(2, 2))
+    prod = dup * dup
+    np.testing.assert_allclose(prod.asnumpy(), [[4.0, 0.0], [0.0, 0.0]])
+
+    # mixed sparse storage types in add_n densify
+    rs = sp.row_sparse_array((np.ones((1, 3), "f4"), np.array([0])),
+                             shape=(2, 3))
+    out = sp.add_n(rs, _mk_csr(np.eye(2, 3, dtype="f4")))
+    np.testing.assert_allclose(out.asnumpy(),
+                               rs.asnumpy() + np.eye(2, 3, dtype="f4"))

@@ -117,6 +117,11 @@ def test_estimator_evaluate(rng):
 
 # ------------------------------------------------------------ FeedForward
 def test_feedforward_fit_predict_save_load(rng, tmp_path):
+    # pin BOTH global streams: init uses mx.random, NDArrayIter shuffling
+    # uses np.random, and the test's 0.85 gate sits near the boundary —
+    # stream positions otherwise depend on which tests ran before this one
+    mx.random.seed(42)
+    np.random.seed(4242)
     X = rng.randn(64, 5).astype("float32")
     y = (X.sum(1) > 0).astype("float32")
     net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=8)
@@ -140,6 +145,7 @@ def test_feedforward_fit_predict_save_load(rng, tmp_path):
 
 # ------------------------------------------------------------ AMP
 def test_amp_loss_scaling_trains_and_skips_overflow(rng):
+    mx.random.seed(1234)   # decouple from the shared stream's position
     from mxnet_tpu.contrib import amp
     X = rng.randn(32, 4).astype("float32")
     y = (X.sum(1) > 0).astype("float32")
@@ -241,3 +247,25 @@ def test_det_augmenter_std_only_and_norm_sharing(rng):
     for a in augs:
         img, label = a(img, label)
     assert img.shape == (16, 16, 3)          # std-only must not crash
+
+
+def test_backward_do_mirror_rematerializes(monkeypatch):
+    """MXNET_BACKWARD_DO_MIRROR must be honored, not silently ignored:
+    the train step still computes identical gradients under remat."""
+    import mxnet_tpu.symbol as sym
+    x = sym.Variable("data")
+    y = sym.FullyConnected(x, num_hidden=3, name="fc")
+    z = sym.sum(sym.square(y))
+
+    def grads_with(flag):
+        monkeypatch.setenv("MXNET_BACKWARD_DO_MIRROR", "1" if flag else "0")
+        e = z.bind(mx.cpu(), {"data": mx.nd.ones((2, 4)),
+                              "fc_weight": mx.nd.ones((3, 4)) * 0.5,
+                              "fc_bias": mx.nd.zeros((3,))},
+                   args_grad={"fc_weight": mx.nd.zeros((3, 4))})
+        e.forward(is_train=True)
+        e.backward()
+        return e.grad_dict["fc_weight"].asnumpy()
+
+    np.testing.assert_allclose(grads_with(True), grads_with(False),
+                               rtol=1e-6)
