@@ -45,6 +45,7 @@ def synth_batch(rng, batch):
 
 def train(epochs=3, batch=32, steps_per_epoch=30, verbose=True):
     rng = np.random.RandomState(3)
+    mx.random.seed(0)   # reproducible runs (and stable CI gates)
     net = tfm.TransformerLM(vocab_size=VOCAB, units=64, num_layers=2,
                             num_heads=4, max_len=SEQ)
     net.initialize(mx.init.Xavier())

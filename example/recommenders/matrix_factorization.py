@@ -3,12 +3,12 @@
 
 Embedding(user) . Embedding(item) -> rating, trained with MSE on synthetic
 low-rank ratings. TPU-first notes:
-- The embedding tables are exactly the row-sparse-gradient workload the
-  lazy sparse SGD path exists for; with ``--sparse-grad`` the updater
-  touches only the rows each batch hit.
+- Embedding tables are the row-sparse-gradient workload the lazy sparse SGD
+  path exists for (``optimizer.Updater._lazy_row_sparse_update``); this
+  recipe trains with Adam for convergence speed, so gradients stay dense.
 - The reference's model-parallel variant places the two tables on two GPUs
-  via group2ctx; here ``--shard`` shards both tables over the mesh with
-  ``parallel.shard_gluon_params`` (the TPU equivalent, README de-scope #4).
+  via group2ctx; the TPU equivalent is sharding both tables over a mesh
+  with ``parallel.shard_gluon_params`` (README de-scope #4).
 
 Run: python example/recommenders/matrix_factorization.py [--epochs 8]
 """
@@ -53,6 +53,7 @@ def synthetic_ratings(n_users=64, n_items=48, rank=4, n=4096, seed=0):
 
 def train(epochs=8, batch=256, dim=8, lr=0.05, verbose=True):
     users, items, ratings = synthetic_ratings()
+    mx.random.seed(0)   # reproducible runs (and stable CI gates)
     net = MFBlock(64, 48, dim)
     net.initialize(mx.init.Normal(0.05))
     net.hybridize()

@@ -8,9 +8,15 @@ TPU-first: these lower straight to ``lax.scan`` / ``lax.while_loop`` /
 ``lax.cond`` — XLA's native structured control flow, compiled once regardless
 of trip count (the reference re-executes the subgraph per step through the
 engine). Gradients flow through ``foreach``/``cond`` via the tape by treating
-the whole construct as one vjp node, like CachedOp; ``while_loop`` is
-forward-only (XLA while is not reverse-differentiable — same restriction the
-reference documents for non-static loops).
+the whole construct as one vjp node, like CachedOp; the imperative
+``while_loop`` is forward-only (raw XLA while is not reverse-differentiable).
+
+Both forms exist, like the reference: called with NDArrays these execute
+eagerly; called with Symbols they build ``_foreach``/``_cond``/``_while_loop``
+GRAPH nodes whose bodies are stored subgraphs, lowered inside the enclosing
+whole-graph XLA program (symbolic ``while_loop`` compiles to a gated
+``lax.scan`` over ``max_iterations``, which makes it differentiable — better
+than the reference, which documents its while gradient as unsupported).
 """
 from __future__ import annotations
 
@@ -45,7 +51,15 @@ def _maybe_single(lst, was_single):
 def foreach(body: Callable, data, init_states):
     """Scan ``body(x_t, states) -> (out_t, new_states)`` over axis 0 of
     ``data`` (reference control_flow.cc _foreach). Compiles to one
-    ``lax.scan``; differentiable through the tape."""
+    ``lax.scan``; differentiable through the tape. Accepts Symbols too —
+    then it builds a ``_foreach`` graph node whose body is a stored
+    subgraph, exactly the reference's symbolic form."""
+    from ..symbol.symbol import Symbol as _Sym
+    if isinstance(data, _Sym) or (isinstance(data, (list, tuple)) and data
+                                  and isinstance(data[0], _Sym)):
+        if isinstance(data, (list, tuple)):
+            raise MXNetError("symbolic foreach takes ONE data symbol")
+        return _sym_foreach(body, data, init_states)
     single_data = isinstance(data, NDArray)
     single_state = isinstance(init_states, NDArray)
     data_list = _unwrap_list(data)
@@ -118,33 +132,68 @@ def while_loop(cond_fn: Callable, func: Callable, loop_vars,
     collects per-step outputs into a max_iterations buffer; same here.
     Forward-only (document parity: gradients require bounded scan — use
     foreach)."""
+    from ..symbol.symbol import Symbol as _Sym
+    if isinstance(loop_vars, _Sym) or (isinstance(loop_vars, (list, tuple))
+                                       and loop_vars
+                                       and isinstance(loop_vars[0], _Sym)):
+        return _sym_while_loop(cond_fn, func, loop_vars, max_iterations)
     single = isinstance(loop_vars, NDArray)
     vars_list = _unwrap_list(loop_vars)
     if max_iterations is None:
         raise MXNetError("while_loop requires max_iterations (static bound "
                          "for XLA; the reference requires it too)")
 
-    def c(state):
-        i, vs = state
+    # reference contract (ndarray/contrib.py:231-290): returns (per-step
+    # outputs stacked along axis 0 and padded to max_iterations, final
+    # states). ONE lax.while_loop whose carry holds preallocated output
+    # buffers — true early exit (no wasted iterations after the predicate
+    # stops) while still collecting per-step outputs.
+    def probe(vs):
+        with autograd.pause():
+            out, new_vars = func(_maybe_single(_wrap_list(list(vs)), single))
+        out_list = [] if out is None else _unwrap_list(out)
+        return tuple(out_list), tuple(_unwrap_list(new_vars))
+
+    out_shapes = jax.eval_shape(lambda vs: probe(vs)[0], tuple(vars_list))
+    bufs = tuple(jnp.zeros((int(max_iterations),) + tuple(s.shape), s.dtype)
+                 for s in out_shapes)
+
+    def keep_going(carry):
+        i, vs, _ = carry
         with autograd.pause():
             keep = cond_fn(_maybe_single(_wrap_list(list(vs)), single))
-        return jnp.logical_and(i < max_iterations,
+        return jnp.logical_and(i < int(max_iterations),
                                jnp.asarray(_unwrap(keep), bool).reshape(()))
 
-    def b(state):
-        i, vs = state
-        with autograd.pause():
-            _, new_vars = func(_maybe_single(_wrap_list(list(vs)), single))
-        return i + 1, tuple(_unwrap_list(new_vars))
+    def body(carry):
+        i, vs, bs = carry
+        ys, nv = probe(vs)
+        bs = tuple(lax.dynamic_update_index_in_dim(b, y, i, 0)
+                   for b, y in zip(bs, ys))
+        return i + 1, nv, bs
 
-    steps, final = lax.while_loop(c, b, (jnp.asarray(0), tuple(vars_list)))
-    return _wrap(steps), _maybe_single(_wrap_list(list(final)), single)
+    _, final, bufs = lax.while_loop(keep_going, body,
+                                    (jnp.asarray(0), tuple(vars_list), bufs))
+    outputs = _wrap_list(list(bufs))
+    outputs = (outputs[0] if len(outputs) == 1 else outputs) \
+        if outputs else []
+    return outputs, _maybe_single(_wrap_list(list(final)), single)
 
 
 def cond(pred_fn: Union[Callable, NDArray], then_func: Callable,
          else_func: Callable, inputs=None):
     """Reference _cond: both branches traced once, selected at run time by
     ``lax.cond``."""
+    from ..symbol.symbol import Symbol as _Sym
+    any_sym = isinstance(pred_fn, _Sym) or any(isinstance(x, _Sym)
+                                               for x in (inputs or []))
+    if any_sym:
+        mixed = (isinstance(pred_fn, NDArray)
+                 or any(isinstance(x, NDArray) for x in (inputs or [])))
+        if mixed:
+            raise MXNetError("cond: predicate and inputs must be all "
+                             "Symbols or all NDArrays, not a mix")
+        return _sym_cond(pred_fn, then_func, else_func, inputs)
     if callable(pred_fn):
         with autograd.pause():
             pred = pred_fn(*(inputs or []))
@@ -166,3 +215,254 @@ def cond(pred_fn: Union[Callable, NDArray], then_func: Callable,
     res = lax.cond(p, t, e, tuple(ins))
     wrapped = _wrap_list(list(res))
     return wrapped[0] if len(wrapped) == 1 else wrapped
+
+
+# ---------------------------------------------------------------------------
+# symbolic control flow — reference _foreach / _while_loop / _cond as GRAPH
+# nodes (src/operator/control_flow.cc:1255-1423), so hybridized blocks and
+# Module-bound symbols can contain loops. The subgraph body is stored in the
+# subgraph registry (subgraph.py) and lowered to lax.scan / lax.cond /
+# gated-scan inside the enclosing whole-graph XLA program; gradients flow
+# because jax differentiates through the structured control flow primitive.
+# ---------------------------------------------------------------------------
+import itertools as _itertools
+
+_cf_uid = _itertools.count()
+
+
+def _free_var_entries(sub, bound_names):
+    """(names, entries) of the subgraph's free variables — outer-graph vars
+    the body closed over (weights etc.), wired as extra node inputs."""
+    names, entries = [], []
+    for n in sub.topo_nodes():
+        if n.is_var and n.name not in bound_names:
+            names.append(n.name)
+            entries.append((n, 0))
+        if not n.is_var:
+            from ..executor import _AUX_UPDATE_RULES
+            if n.op in _AUX_UPDATE_RULES:
+                raise MXNetError(
+                    f"op {n.op!r} ({n.name}) updates auxiliary state, which "
+                    "a control-flow subgraph cannot propagate (its scan "
+                    "carry holds loop states only) — move it outside the "
+                    "loop or use use_global_stats/inference mode")
+    return names, entries
+
+
+def _lowered_sub(sg_id, is_train):
+    from ..subgraph import _LOWERED_SUBGRAPHS, get_stored_subgraph
+    from ..executor import _GraphLowering
+    key = ("cf", int(sg_id), bool(is_train))
+    fn = _LOWERED_SUBGRAPHS.get(key)
+    if fn is None:
+        fn = _GraphLowering(get_stored_subgraph(int(sg_id))).lower(
+            is_train=bool(is_train))
+        _LOWERED_SUBGRAPHS[key] = fn
+    return fn
+
+
+def _sym_foreach(body, data, init_states):
+    from ..symbol.symbol import Symbol, Variable, _Node, Group
+    from ..subgraph import _store_subgraph
+    uid = next(_cf_uid)
+    single_state = not isinstance(init_states, (list, tuple))
+    states = [init_states] if single_state else list(init_states)
+    x_name = f"__foreach{uid}_x"
+    s_names = [f"__foreach{uid}_s{i}" for i in range(len(states))]
+    x_var = Variable(x_name)
+    s_vars = [Variable(n) for n in s_names]
+    out, new_states = body(x_var, s_vars[0] if single_state else s_vars)
+    outs = [out] if isinstance(out, Symbol) else list(out)
+    new_states = [new_states] if isinstance(new_states, Symbol) \
+        else list(new_states)
+    if len(new_states) != len(states):
+        raise MXNetError("foreach body must return as many states as given")
+    sub = Group(outs + new_states)
+    sg_id = _store_subgraph(sub)
+    bound = {x_name, *s_names}
+    free_names, free_entries = _free_var_entries(sub, bound)
+    node = _Node("_foreach", f"foreach{uid}",
+                 {"subgraph_id": sg_id, "n_out": len(outs),
+                  "n_state": len(states), "x_name": x_name,
+                  "state_names": tuple(s_names),
+                  "free_names": tuple(free_names)},
+                 [data._outputs[0]] + [s._outputs[0] for s in states]
+                 + free_entries)
+    result = Symbol([(node, i) for i in range(len(outs) + len(states))])
+    out_syms = [result[i] for i in range(len(outs))]
+    state_syms = [result[len(outs) + i] for i in range(len(states))]
+    return (out_syms[0] if len(out_syms) == 1 else out_syms), \
+        (state_syms[0] if single_state else state_syms)
+
+
+def _sym_cond(pred, then_func, else_func, inputs=None):
+    from ..symbol.symbol import Symbol, Variable, _Node, Group
+    from ..subgraph import _store_subgraph
+    uid = next(_cf_uid)
+    ins = list(inputs or [])
+    if callable(pred):
+        # predicate composed in the OUTER graph over the actual inputs
+        pred = pred(*ins)
+    in_names = [f"__cond{uid}_i{k}" for k in range(len(ins))]
+    in_vars = [Variable(n) for n in in_names]
+
+    def build(func):
+        out = func(*in_vars)
+        outs = [out] if isinstance(out, Symbol) else list(out)
+        return outs
+
+    t_outs = build(then_func)
+    e_outs = build(else_func)
+    if len(t_outs) != len(e_outs):
+        raise MXNetError("cond branches must return the same arity")
+    t_sub, e_sub = Group(t_outs), Group(e_outs)
+    t_id, e_id = _store_subgraph(t_sub), _store_subgraph(e_sub)
+    bound = set(in_names)
+    t_free, t_entries = _free_var_entries(t_sub, bound)
+    e_free, e_entries = _free_var_entries(e_sub, bound)
+    node = _Node("_cond", f"cond{uid}",
+                 {"then_id": t_id, "else_id": e_id, "n_out": len(t_outs),
+                  "n_in": len(ins), "in_names": tuple(in_names),
+                  "then_free": tuple(t_free), "else_free": tuple(e_free)},
+                 [pred._outputs[0]] + [s._outputs[0] for s in ins]
+                 + t_entries + e_entries)
+    result = Symbol([(node, i) for i in range(len(t_outs))])
+    return result if len(t_outs) > 1 else result[0]
+
+
+def _sym_while_loop(cond_fn, func, loop_vars, max_iterations):
+    from ..symbol.symbol import Symbol, Variable, _Node, Group
+    from ..subgraph import _store_subgraph
+    if max_iterations is None:
+        raise MXNetError("while_loop requires max_iterations")
+    uid = next(_cf_uid)
+    single = isinstance(loop_vars, Symbol)
+    states = [loop_vars] if single else list(loop_vars)
+    s_names = [f"__while{uid}_s{i}" for i in range(len(states))]
+    s_vars = [Variable(n) for n in s_names]
+    arg = s_vars[0] if single else s_vars
+    pred = cond_fn(arg)
+    step = func(arg)
+    out, new_states = step
+    outs = [] if out is None else (
+        [out] if isinstance(out, Symbol) else list(out))
+    new_states = [new_states] if isinstance(new_states, Symbol) \
+        else list(new_states)
+    cond_sub = Group([pred])
+    body_sub = Group(outs + new_states)
+    c_id, b_id = _store_subgraph(cond_sub), _store_subgraph(body_sub)
+    bound = set(s_names)
+    c_free, c_entries = _free_var_entries(cond_sub, bound)
+    b_free, b_entries = _free_var_entries(body_sub, bound)
+    node = _Node("_while_loop", f"while{uid}",
+                 {"cond_id": c_id, "body_id": b_id, "n_out": len(outs),
+                  "n_state": len(states), "state_names": tuple(s_names),
+                  "max_iterations": int(max_iterations),
+                  "cond_free": tuple(c_free), "body_free": tuple(b_free)},
+                 [s._outputs[0] for s in states] + c_entries + b_entries)
+    result = Symbol([(node, i) for i in range(len(outs) + len(states))])
+    out_syms = [result[i] for i in range(len(outs))]
+    state_syms = [result[len(outs) + i] for i in range(len(states))]
+    return (out_syms[0] if len(out_syms) == 1 else out_syms), \
+        (state_syms[0] if single else state_syms)
+
+
+# ------------------------------------------------------- the op kernels
+from ..ops.registry import register as _register
+
+
+@_register("_foreach",
+           num_outputs=lambda a: int(a["n_out"]) + int(a["n_state"]),
+           needs_rng=True)
+def _foreach_op(*inputs, subgraph_id=0, n_out=1, n_state=0, x_name="x",
+                state_names=(), free_names=(), is_train=False, rng=None):
+    """lax.scan over the stored subgraph; outputs = stacked per-step outs
+    then final states (control_flow.cc _foreach output contract)."""
+    fn = _lowered_sub(subgraph_id, is_train)
+    data = inputs[0]
+    states = tuple(inputs[1:1 + int(n_state)])
+    frees = dict(zip(free_names, inputs[1 + int(n_state):]))
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    step_keys = jax.random.split(rng, data.shape[0])  # fresh key per step
+
+    def step(carry, xs):
+        x, key = xs
+        feed = {x_name: x}
+        feed.update(zip(state_names, carry))
+        feed.update(frees)
+        outs, _ = fn(feed, key)
+        return tuple(outs[int(n_out):]), tuple(outs[:int(n_out)])
+
+    final_states, ys = lax.scan(step, states, (data, step_keys))
+    return tuple(ys) + tuple(final_states)
+
+
+@_register("_cond", num_outputs=lambda a: int(a["n_out"]), needs_rng=True)
+def _cond_op(*inputs, then_id=0, else_id=0, n_out=1, n_in=0, in_names=(),
+             then_free=(), else_free=(), is_train=False, rng=None):
+    t_fn = _lowered_sub(then_id, is_train)
+    e_fn = _lowered_sub(else_id, is_train)
+    pred = jnp.asarray(inputs[0], bool).reshape(())
+    ins = inputs[1:1 + int(n_in)]
+    t_frees = dict(zip(then_free,
+                       inputs[1 + int(n_in):1 + int(n_in) + len(then_free)]))
+    e_frees = dict(zip(else_free, inputs[1 + int(n_in) + len(then_free):]))
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+
+    def t(xs):
+        feed = dict(zip(in_names, xs))
+        feed.update(t_frees)
+        outs, _ = t_fn(feed, jax.random.fold_in(rng, 0))
+        return tuple(outs)
+
+    def e(xs):
+        feed = dict(zip(in_names, xs))
+        feed.update(e_frees)
+        outs, _ = e_fn(feed, jax.random.fold_in(rng, 1))
+        return tuple(outs)
+
+    res = lax.cond(pred, t, e, tuple(ins))
+    return tuple(res)
+
+
+@_register("_while_loop",
+           num_outputs=lambda a: int(a["n_out"]) + int(a["n_state"]),
+           needs_rng=True)
+def _while_loop_op(*inputs, cond_id=0, body_id=0, n_out=1, n_state=1,
+                   state_names=(), max_iterations=1, cond_free=(),
+                   body_free=(), is_train=False, rng=None):
+    """Gated scan over max_iterations (differentiable, unlike raw
+    lax.while_loop): steps past the predicate keep state frozen and emit
+    zero-padded outputs, the reference's padding contract."""
+    c_fn = _lowered_sub(cond_id, is_train)
+    b_fn = _lowered_sub(body_id, is_train)
+    states = tuple(inputs[:int(n_state)])
+    c_frees = dict(zip(cond_free,
+                       inputs[int(n_state):int(n_state) + len(cond_free)]))
+    b_frees = dict(zip(body_free, inputs[int(n_state) + len(cond_free):]))
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+
+    step_keys = jax.random.split(rng, int(max_iterations))
+
+    def step(carry, key):
+        done, st = carry
+        feed = dict(zip(state_names, st))
+        c_feed = dict(feed)
+        c_feed.update(c_frees)
+        (pred,), _ = c_fn(c_feed, key)
+        run = jnp.logical_and(jnp.asarray(pred, bool).reshape(()),
+                              jnp.logical_not(done))
+        b_feed = dict(feed)
+        b_feed.update(b_frees)
+        outs, _ = b_fn(b_feed, jax.random.fold_in(key, 1))
+        new_st = tuple(jnp.where(run, n, o) for n, o in
+                       zip(outs[int(n_out):], st))
+        ys = tuple(jnp.where(run, y, jnp.zeros_like(y))
+                   for y in outs[:int(n_out)])
+        return (jnp.logical_not(run), new_st), ys
+
+    (_, final), ys = lax.scan(step, (jnp.asarray(False), states), step_keys)
+    return tuple(ys) + tuple(final)

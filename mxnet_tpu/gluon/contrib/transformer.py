@@ -141,7 +141,7 @@ class SinusoidalPositionalEmbedding(HybridBlock):
         angle = pos / np.power(10000.0, dim / units)
         table = np.zeros((max_len, units), "float32")
         table[:, 0::2] = np.sin(angle)
-        table[:, 1::2] = np.cos(angle[:, : units - units // 2])
+        table[:, 1::2] = np.cos(angle[:, : units // 2])
         with self.name_scope():
             self.table = self.params.get_constant("pos_table", table)
 
@@ -162,23 +162,23 @@ class TransformerLM(Block):
                  tie_weights=False, **kw):
         super().__init__(**kw)
         hidden_size = hidden_size or 4 * units
+        self._tie = tie_weights
         with self.name_scope():
             self.embed = Embedding(vocab_size, units, prefix="embed_")
             self.pos = SinusoidalPositionalEmbedding(max_len, units)
             self.body = TransformerEncoder(num_layers, units, hidden_size,
                                            num_heads, dropout, pre_norm=True,
                                            causal=True, prefix="body_")
-            self.head = Dense(vocab_size, flatten=False, use_bias=False,
-                              prefix="head_")
-        self._tie = tie_weights
+            if not tie_weights:   # tied head reuses the embedding table
+                self.head = Dense(vocab_size, flatten=False, use_bias=False,
+                                  prefix="head_")
 
     def forward(self, tokens):
         x = self.pos(self.embed(tokens))
         x = self.body(x)
         if self._tie:
-            from ...ndarray import NDArray
-            w = self.embed.weight.data()
             from ... import nd as _nd
+            w = self.embed.weight.data()
             return _nd.dot(x.reshape((-1, x.shape[-1])), w,
                            transpose_b=True).reshape(
                                (x.shape[0], x.shape[1], -1))

@@ -189,6 +189,13 @@ class Symbol:
     def __truediv__(self, o): return self._binop("broadcast_div", o, "_div_scalar")
     def __rtruediv__(self, o): return self._binop("broadcast_div", o, "_rdiv_scalar", True)
     def __pow__(self, o): return self._binop("broadcast_power", o, "_power_scalar")
+    # comparisons build graph nodes (reference symbol.py __gt__ etc.);
+    # __eq__/__hash__ stay identity-based — Symbols live in dict keys
+    def __lt__(self, o): return self._binop("_lesser", o, "_lesser_scalar")
+    def __le__(self, o): return self._binop("_lesser_equal", o, "_lesser_equal_scalar")
+    def __gt__(self, o): return self._binop("_greater", o, "_greater_scalar")
+    def __ge__(self, o): return self._binop("_greater_equal", o, "_greater_equal_scalar")
+    def __mod__(self, o): return self._binop("broadcast_mod", o, "_mod_scalar")
     def __neg__(self):
         from . import _invoke_sym
         return _invoke_sym("negative", [self], {})
@@ -279,15 +286,29 @@ class Symbol:
         return ex.forward()
 
     # ---------------------------------------------------------------- serialization
+    #: attr keys whose int values index the process-local subgraph store
+    #: (control-flow/partition nodes); serialized as embedded graph JSON so
+    #: save/load works across processes (reference embeds subgraphs in the
+    #: node JSON the same way, control_flow.cc __subgraph__ attrs)
+    _SUBGRAPH_ATTRS = ("subgraph_id", "then_id", "else_id", "cond_id",
+                       "body_id")
+
     def tojson(self) -> str:
         nodes = self.topo_nodes()
         nid = {id(n): i for i, n in enumerate(nodes)}
         jnodes = []
         for n in nodes:
+            attrs = {}
+            for k, v in (n.attrs or {}).items():
+                if k in self._SUBGRAPH_ATTRS:
+                    from ..subgraph import get_stored_subgraph
+                    v = {"__subgraph__":
+                         json.loads(get_stored_subgraph(int(v)).tojson())}
+                attrs[k] = json.dumps(v)
             jnodes.append({
                 "op": n.op or "null",
                 "name": n.name,
-                "attrs": {k: json.dumps(v) for k, v in (n.attrs or {}).items()},
+                "attrs": attrs,
                 "inputs": [[nid[id(src)], idx, 0] for (src, idx) in n.inputs],
             })
         heads = [[nid[id(node)], idx, 0] for (node, idx) in self._outputs]
@@ -369,7 +390,17 @@ def load_json(json_str: str) -> Symbol:
     nodes: List[_Node] = []
     for jn in data["nodes"]:
         op = None if jn["op"] == "null" else jn["op"]
-        attrs = {k: json.loads(v) for k, v in jn.get("attrs", {}).items()}
+        attrs = {}
+        for k, v in jn.get("attrs", {}).items():
+            v = json.loads(v)
+            if isinstance(v, dict) and "__subgraph__" in v:
+                # re-store the embedded subgraph, rebind to a fresh local id
+                from ..subgraph import _store_subgraph
+                sub = load_json(json.dumps(v["__subgraph__"]))
+                v = _store_subgraph(sub)
+            elif isinstance(v, list):
+                v = tuple(tuple(x) if isinstance(x, list) else x for x in v)
+            attrs[k] = v
         inputs = [(nodes[i], idx) for (i, idx, _) in jn.get("inputs", [])]
         nodes.append(_Node(op, jn["name"], attrs, inputs))
     heads = [(nodes[i], idx) for (i, idx, _) in data["heads"]]

@@ -45,9 +45,21 @@ def test_while_loop(rng):
     def body_fn(v):
         return None, v * 2
 
-    steps, out = while_loop(cond_fn, body_fn, nd.ones((2,)), max_iterations=50)
+    # reference contract: (stacked per-step outputs, final states); a None
+    # step output yields an empty outputs list
+    outs, out = while_loop(cond_fn, body_fn, nd.ones((2,)), max_iterations=50)
+    assert outs == []
     assert float(out.sum().asscalar()) >= 100.0
-    assert int(steps.asscalar()) == 6  # 2^6 * 2 = 128 >= 100
+    np.testing.assert_allclose(out.asnumpy(), 64.0)  # sum [64,64]=128 >= 100
+
+    def body_with_out(v):
+        return v, v * 2
+    outs2, fin2 = while_loop(cond_fn, body_with_out, nd.ones((2,)),
+                             max_iterations=8)
+    ys = outs2.asnumpy()
+    assert ys.shape == (8, 2)
+    np.testing.assert_allclose(ys[:6, 0], [1, 2, 4, 8, 16, 32])
+    assert (ys[6:] == 0).all()
 
 
 def test_cond(rng):

@@ -1,0 +1,182 @@
+"""Symbolic control flow (_foreach/_cond/_while_loop graph nodes,
+contrib/control_flow.py symbolic path): forward known values, gradients
+through lax.scan, free-variable capture, JSON non-goal documented."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.contrib import control_flow as cf
+
+
+def test_sym_foreach_forward_and_grad():
+    data = mx.sym.Variable("data")
+    s0 = mx.sym.Variable("s0")
+    w = mx.sym.Variable("w")          # free variable captured by the body
+
+    def body(x, s):
+        ns = s + x * w
+        return ns, ns
+
+    outs, fin = cf.foreach(body, data, s0)
+    x = np.arange(12, dtype="f4").reshape(4, 3)
+    feed = {"data": mx.nd.array(x), "s0": mx.nd.zeros((3,)),
+            "w": mx.nd.array(np.array(2.0, "f4"))}
+    e = outs.bind(mx.cpu(), dict(feed))
+    np.testing.assert_allclose(e.forward()[0].asnumpy(),
+                               np.cumsum(x * 2.0, axis=0), rtol=1e-6)
+    # final state == last output
+    ef = fin.bind(mx.cpu(), dict(feed))
+    np.testing.assert_allclose(ef.forward()[0].asnumpy(),
+                               np.cumsum(x * 2.0, axis=0)[-1], rtol=1e-6)
+    # gradient through the scan: d(sum cumsum)/dx_t = w * (T - t)
+    e2 = outs.bind(mx.cpu(), dict(feed),
+                   args_grad={"data": mx.nd.zeros((4, 3))})
+    e2.forward(is_train=True)
+    e2.backward()
+    expect = 2.0 * (4 - np.arange(4))[:, None] * np.ones((1, 3))
+    np.testing.assert_allclose(e2.grad_dict["data"].asnumpy(), expect,
+                               rtol=1e-6)
+
+
+def test_sym_foreach_multi_state():
+    data = mx.sym.Variable("data")
+    a0, b0 = mx.sym.Variable("a0"), mx.sym.Variable("b0")
+
+    def body(x, states):
+        a, b = states
+        return x + a, [a + 1, b * 2]
+
+    outs, (fa, fb) = cf.foreach(body, data, [a0, b0])
+    x = np.ones((3, 2), "f4")
+    feed = {"data": mx.nd.array(x), "a0": mx.nd.zeros((2,)),
+            "b0": mx.nd.ones((2,))}
+    ea = fa.bind(mx.cpu(), dict(feed))
+    np.testing.assert_allclose(ea.forward()[0].asnumpy(), 3.0)
+    eb = fb.bind(mx.cpu(), dict(feed))
+    np.testing.assert_allclose(eb.forward()[0].asnumpy(), 8.0)
+
+
+def test_sym_cond_selects_branch():
+    p = mx.sym.Variable("p")
+    a = mx.sym.Variable("a")
+    res = cf.cond(p, lambda x: x * 2, lambda x: x - 1, [a])
+    for pv, want in ((1.0, 6.0), (0.0, 2.0)):
+        e = res.bind(mx.cpu(), {"p": mx.nd.array(np.array(pv, "f4")),
+                                "a": mx.nd.ones((2,)) * 3})
+        np.testing.assert_allclose(e.forward()[0].asnumpy(), want)
+
+
+def test_sym_while_loop_padding_and_final():
+    v = mx.sym.Variable("v")
+    outs, fin = cf.while_loop(lambda s: mx.sym.max(s) < 100,
+                              lambda s: (s, s * 2), v, max_iterations=10)
+    ew = fin.bind(mx.cpu(), {"v": mx.nd.ones((1,))})
+    np.testing.assert_allclose(ew.forward()[0].asnumpy(), 128.0)
+    eo = outs.bind(mx.cpu(), {"v": mx.nd.ones((1,))})
+    ys = eo.forward()[0].asnumpy()
+    assert ys.shape == (10, 1)
+    np.testing.assert_allclose(ys[:7, 0], [1, 2, 4, 8, 16, 32, 64])
+    assert (ys[7:] == 0).all()     # zero-padded past the stop step
+
+
+def test_sym_foreach_inside_module_trains():
+    """A Module-bound graph containing _foreach must train end-to-end:
+    a scan-based mean over time feeding a classifier."""
+    data = mx.sym.Variable("data")              # (B, T, F) -> scan over T
+    dT = mx.sym.transpose(data, axes=(1, 0, 2))
+    s0 = mx.sym.sum(dT, axis=0) * 0             # (B, F) zero state
+
+    def body(x, s):
+        ns = s + x
+        return ns, ns
+
+    outs, fin = cf.foreach(body, dT, s0)
+    fc = mx.sym.FullyConnected(fin, num_hidden=2, name="fc")
+    net = mx.sym.SoftmaxOutput(fc, mx.sym.Variable("sm_label"), name="sm")
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, 5, 3).astype("f4")
+    y = (X.sum(axis=(1, 2)) > 0).astype("f4")
+    it = mx.io.NDArrayIter({"data": X}, y, batch_size=16,
+                           label_name="sm_label")
+    mod = mx.mod.Module(net, data_names=["data"], label_names=["sm_label"],
+                        context=mx.cpu())
+    mod.fit(it, num_epoch=6, optimizer="adam",
+            optimizer_params={"learning_rate": 0.05})
+    it.reset()
+    acc = dict(mod.score(it, "acc"))["accuracy"]
+    assert acc > 0.9, acc
+
+
+def test_sym_cond_with_callable_pred():
+    """A callable predicate over Symbol inputs must route to the symbolic
+    path (it composes the predicate in the outer graph)."""
+    a = mx.sym.Variable("a")
+    res = cf.cond(lambda x: mx.sym.sum(x) > 5, lambda x: x * 10,
+                  lambda x: x - 10, [a])
+    e = res.bind(mx.cpu(), {"a": mx.nd.ones((4,)) * 2})   # sum 8 > 5
+    np.testing.assert_allclose(e.forward()[0].asnumpy(), 20.0)
+    e2 = res.bind(mx.cpu(), {"a": mx.nd.ones((4,))})      # sum 4 < 5
+    np.testing.assert_allclose(e2.forward()[0].asnumpy(), -9.0)
+
+
+def test_aux_updating_body_raises():
+    """BatchNorm inside a control-flow body cannot propagate running stats
+    through the scan carry — must raise, not silently freeze them."""
+    data = mx.sym.Variable("data")
+    s0 = mx.sym.Variable("s0")
+    g = mx.sym.Variable("g"); b = mx.sym.Variable("b")
+    mm = mx.sym.Variable("mm"); mv = mx.sym.Variable("mv")
+
+    def body(x, s):
+        y = mx.sym.BatchNorm(x, g, b, mm, mv, name="bn")
+        return y, s
+
+    with pytest.raises(MXNetError, match="auxiliary state"):
+        cf.foreach(body, data, s0)
+
+
+def test_dropout_in_foreach_varies_per_step():
+    """Per-step PRNG keys: dropout masks must differ across scan steps."""
+    data = mx.sym.Variable("data")
+    s0 = mx.sym.Variable("s0")
+
+    def body(x, s):
+        y = mx.sym.Dropout(x, p=0.5)
+        return y, s
+
+    outs, _ = cf.foreach(body, data, s0)
+    e = outs.bind(mx.cpu(), {"data": mx.nd.ones((6, 64)),
+                             "s0": mx.nd.zeros((1,))})
+    ys = e.forward(is_train=True)[0].asnumpy()
+    masks = (ys != 0)
+    # identical masks across steps would mean one key reused T times
+    assert any((masks[i] != masks[0]).any() for i in range(1, 6))
+
+
+def test_control_flow_json_roundtrip():
+    """Graphs with control-flow nodes must save/load: the stored subgraph
+    is embedded in the node JSON and re-registered on load."""
+    data = mx.sym.Variable("data")
+    s0 = mx.sym.Variable("s0")
+    outs, fin = cf.foreach(lambda x, s: (s + x, s + x), data, s0)
+    js = fin.tojson()
+    loaded = mx.sym.load_json(js)
+    x = np.arange(6, dtype="f4").reshape(3, 2)
+    feed = {"data": mx.nd.array(x), "s0": mx.nd.zeros((2,))}
+    a = fin.bind(mx.cpu(), dict(feed)).forward()[0].asnumpy()
+    b = loaded.bind(mx.cpu(), dict(feed)).forward()[0].asnumpy()
+    np.testing.assert_allclose(b, a)
+
+
+def test_sym_while_none_output_and_mixed_cond_raises():
+    v = mx.sym.Variable("v")
+    outs, fin = cf.while_loop(lambda s: mx.sym.max(s) < 100,
+                              lambda s: (None, s * 2), v, max_iterations=10)
+    assert outs == []
+    e = fin.bind(mx.cpu(), {"v": mx.nd.ones((1,))})
+    np.testing.assert_allclose(e.forward()[0].asnumpy(), 128.0)
+    with pytest.raises(MXNetError, match="mix"):
+        cf.cond(mx.nd.array([1.0]), lambda x: x, lambda x: x,
+                [mx.sym.Variable("a")])

@@ -1,0 +1,189 @@
+"""Numeric forward checks (vs numpy/scipy references) for the operator long
+tail: detection/vision ops, signal ops, legacy layers — the reference's
+test_operator.py depth for the ops the per-op gradient sweep covers only
+generically."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def A(x):
+    return nd.array(np.asarray(x, "float32"))
+
+
+def test_roi_pooling_known_values():
+    # 1x1x4x4 feature map with values 0..15; one ROI covering the top-left
+    # 2x2 -> max is 5 for pooled 1x1
+    data = np.arange(16, dtype="float32").reshape(1, 1, 4, 4)
+    rois = np.array([[0, 0, 0, 1, 1]], "float32")   # (batch_idx, x1,y1,x2,y2)
+    out = nd.ROIPooling(A(data), A(rois), pooled_size=(1, 1),
+                        spatial_scale=1.0)
+    assert float(np.ravel(out.asnumpy())[0]) == 5.0
+    # 2x2 pooling over the full map
+    rois = np.array([[0, 0, 0, 3, 3]], "float32")
+    out = nd.ROIPooling(A(data), A(rois), pooled_size=(2, 2),
+                        spatial_scale=1.0).asnumpy()
+    np.testing.assert_allclose(out[0, 0], [[5, 7], [13, 15]])
+
+
+def test_roi_align_is_interpolated():
+    data = np.arange(16, dtype="float32").reshape(1, 1, 4, 4)
+    rois = np.array([[0, 0.5, 0.5, 2.5, 2.5]], "float32")
+    out = nd.contrib.ROIAlign(A(data), A(rois), pooled_size=(2, 2),
+                              spatial_scale=1.0).asnumpy()
+    assert out.shape == (1, 1, 2, 2)
+    # bilinear sampling of a linear ramp stays within the ramp's range and
+    # increases along both axes
+    assert (np.diff(out[0, 0], axis=0) > 0).all()
+    assert (np.diff(out[0, 0], axis=1) > 0).all()
+    assert out.min() >= 0 and out.max() <= 15
+
+
+def test_correlation_identical_inputs_peak_at_zero_disp():
+    rng = np.random.RandomState(0)
+    x = rng.rand(1, 3, 8, 8).astype("float32")
+    out = nd.Correlation(A(x), A(x), kernel_size=1, max_displacement=2,
+                         stride1=1, stride2=1, pad_size=2).asnumpy()
+    # channel layout: displacement grid (5x5=25 channels); center channel
+    # (12) is zero displacement — summed over positions it dominates every
+    # displaced channel (rearrangement inequality; pointwise it need not)
+    sums = out[0].reshape(25, -1).sum(axis=1)
+    assert sums.argmax() == 12
+    # zero-displacement correlation of x with itself is mean(x^2) per pixel
+    np.testing.assert_allclose(out[0, 12], (x ** 2).mean(axis=1)[0],
+                               rtol=1e-4)
+
+
+def test_fft_ifft_roundtrip(rng):
+    x = rng.randn(2, 16).astype("float32")
+    f = nd.fft(A(x), compute_size=128)
+    assert f.shape == (2, 32)            # interleaved re/im
+    # reference ifft is UNNORMALIZED (cuFFT semantics): roundtrip gains N
+    back = nd.ifft(f, compute_size=128).asnumpy()
+    np.testing.assert_allclose(back / 16.0, x, rtol=1e-4, atol=1e-5)
+    # parseval: energy matches (re^2+im^2 sum = N * time energy)
+    fr = f.asnumpy().reshape(2, 16, 2)
+    np.testing.assert_allclose((fr ** 2).sum(), (x ** 2).sum() * 16,
+                               rtol=1e-4)
+
+
+def test_count_sketch_preserves_inner_products(rng):
+    """Count sketch is an inner-product-preserving projection in
+    expectation; with out_dim == in_dim and a random hash it is exact per
+    draw only in expectation, so test the unbiased-ness loosely over many
+    hashes."""
+    d, k = 32, 64
+    x = rng.randn(1, d).astype("float32")
+    dots = []
+    for seed in range(20):
+        r2 = np.random.RandomState(seed)
+        h = r2.randint(0, k, size=d).astype("float32")
+        s = r2.choice([-1.0, 1.0], size=d).astype("float32")
+        sk = nd.count_sketch(A(x), A(h), A(s), out_dim=k).asnumpy()
+        dots.append((sk ** 2).sum())
+    np.testing.assert_allclose(np.mean(dots), (x ** 2).sum(), rtol=0.25)
+
+
+def test_svm_output_forward_is_identity_and_grad_is_hinge(rng):
+    x = rng.randn(4, 3).astype("float32")
+    y = np.array([0, 1, 2, 1], "float32")
+    out = nd.SVMOutput(A(x), A(y), margin=1.0)
+    np.testing.assert_allclose(out.asnumpy(), x, rtol=1e-6)
+
+
+def test_bilinear_sampler_identity_grid(rng):
+    x = rng.rand(1, 1, 5, 5).astype("float32")
+    ys, xs = np.meshgrid(np.linspace(-1, 1, 5), np.linspace(-1, 1, 5),
+                         indexing="ij")
+    grid = np.stack([xs, ys])[None].astype("float32")   # (1, 2, H, W)
+    out = nd.BilinearSampler(A(x), A(grid)).asnumpy()
+    np.testing.assert_allclose(out, x, rtol=1e-5, atol=1e-5)
+
+
+def test_spatial_transformer_identity_theta(rng):
+    x = rng.rand(1, 1, 6, 6).astype("float32")
+    theta = np.array([[1, 0, 0, 0, 1, 0]], "float32")   # identity affine
+    out = nd.SpatialTransformer(A(x), A(theta), target_shape=(6, 6),
+                                transform_type="affine",
+                                sampler_type="bilinear").asnumpy()
+    np.testing.assert_allclose(out, x, rtol=1e-4, atol=1e-5)
+
+
+def test_grid_generator_affine_identity():
+    theta = np.array([[1, 0, 0, 0, 1, 0]], "float32")
+    grid = nd.GridGenerator(A(theta), transform_type="affine",
+                            target_shape=(4, 4)).asnumpy()
+    assert grid.shape == (1, 2, 4, 4)
+    np.testing.assert_allclose(grid[0, 0], np.tile(np.linspace(-1, 1, 4),
+                                                   (4, 1)), atol=1e-5)
+    np.testing.assert_allclose(grid[0, 1],
+                               np.tile(np.linspace(-1, 1, 4)[:, None],
+                                       (1, 4)), atol=1e-5)
+
+
+def test_upsampling_nearest(rng):
+    x = rng.rand(1, 2, 3, 3).astype("float32")
+    out = nd.UpSampling(A(x), scale=2, sample_type="nearest").asnumpy()
+    assert out.shape == (1, 2, 6, 6)
+    np.testing.assert_allclose(out[:, :, ::2, ::2], x)
+    np.testing.assert_allclose(out[:, :, 1::2, 1::2], x)
+
+
+def test_pad_modes(rng):
+    x = rng.rand(1, 1, 3, 3).astype("float32")
+    pw = (0, 0, 0, 0, 1, 1, 1, 1)
+    outc = nd.Pad(A(x), mode="constant", pad_width=pw,
+                  constant_value=7.0).asnumpy()
+    assert outc.shape == (1, 1, 5, 5)
+    assert (outc[0, 0, 0] == 7.0).all() and outc[0, 0, 1, 1] == x[0, 0, 0, 0]
+    oute = nd.Pad(A(x), mode="edge", pad_width=pw).asnumpy()
+    assert oute[0, 0, 0, 1] == x[0, 0, 0, 0]
+    outr = nd.Pad(A(x), mode="reflect", pad_width=pw).asnumpy()
+    assert outr[0, 0, 0, 1] == x[0, 0, 1, 0]
+
+
+def test_depth_space_roundtrip(rng):
+    x = rng.rand(2, 8, 3, 3).astype("float32")
+    d = nd.depth_to_space(A(x), block_size=2)
+    assert d.shape == (2, 2, 6, 6)
+    back = nd.space_to_depth(d, block_size=2).asnumpy()
+    np.testing.assert_allclose(back, x)
+
+
+def test_histogram_and_unravel(rng):
+    x = np.array([0.5, 1.5, 1.6, 3.2, 9.9], "float32")
+    cnt, edges = nd.histogram(A(x), bin_cnt=5, range=(0.0, 10.0))
+    np.testing.assert_allclose(cnt.asnumpy(), [3, 1, 0, 0, 1])
+    # layout (ndim, n) like np.unravel_index's stacked tuple
+    idx = nd.unravel_index(nd.array(np.array([7, 11], "float32")),
+                           shape=(3, 4)).asnumpy()
+    np.testing.assert_allclose(idx, np.stack(
+        np.unravel_index([7, 11], (3, 4))))
+
+
+def test_digamma_vs_known_values():
+    # psi(1) = -euler_gamma; psi(0.5) = -gamma - 2 ln 2
+    g = 0.5772156649
+    out = nd.digamma(A([1.0, 0.5])).asnumpy()
+    np.testing.assert_allclose(out, [-g, -g - 2 * np.log(2)], rtol=1e-5)
+
+
+def test_adaptive_avg_pooling(rng):
+    x = rng.rand(1, 2, 6, 6).astype("float32")
+    out = nd.AdaptiveAvgPooling2D(A(x), output_size=(3, 3)).asnumpy()
+    ref = x.reshape(1, 2, 3, 2, 3, 2).mean(axis=(3, 5))
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+    # global pooling: output_size 1
+    out1 = nd.AdaptiveAvgPooling2D(A(x), output_size=(1, 1)).asnumpy()
+    np.testing.assert_allclose(out1[..., 0, 0], x.mean(axis=(2, 3)),
+                               rtol=1e-5)
+
+
+def test_crop_center_and_offset(rng):
+    x = rng.rand(1, 1, 6, 6).astype("float32")
+    out = nd.Crop(A(x), h_w=(4, 4), center_crop=True).asnumpy()
+    np.testing.assert_allclose(out[0, 0], x[0, 0, 1:5, 1:5])
+    out2 = nd.Crop(A(x), offset=(2, 0), h_w=(4, 4)).asnumpy()
+    np.testing.assert_allclose(out2[0, 0], x[0, 0, 2:6, 0:4])

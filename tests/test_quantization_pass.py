@@ -1,0 +1,92 @@
+"""int8 graph pass (contrib/quantization.py quantize_graph/quantize_model):
+the rewritten conv/FC islands must track the float graph closely, across
+runtime-range and calibrated modes, and the rewritten graph must actually
+contain int8 ops (not a passthrough)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.contrib import quantization as q
+
+
+class _Batch:
+    def __init__(self, x):
+        self.data = [mx.nd.array(x)]
+
+
+def _small_convnet(rng):
+    data = mx.sym.Variable("data")
+    c = mx.sym.Convolution(data, kernel=(3, 3), num_filter=4, pad=(1, 1),
+                           name="conv0")
+    r = mx.sym.Activation(c, act_type="relu")
+    out = mx.sym.FullyConnected(mx.sym.Flatten(r), num_hidden=3, name="fc0")
+    arg = {
+        "conv0_weight": mx.nd.array(rng.randn(4, 1, 3, 3).astype("f4") * 0.5),
+        "conv0_bias": mx.nd.array(rng.randn(4).astype("f4") * 0.1),
+        "fc0_weight": mx.nd.array(rng.randn(3, 144).astype("f4") * 0.1),
+        "fc0_bias": mx.nd.array(rng.randn(3).astype("f4") * 0.1),
+    }
+    return out, arg
+
+
+def _rel_err(sym, arg, qsym, qarg, x, reduce="max"):
+    ref = sym.bind(mx.cpu(), dict(arg, data=mx.nd.array(x))) \
+        .forward()[0].asnumpy()
+    got = qsym.bind(mx.cpu(), dict(qarg, data=mx.nd.array(x))) \
+        .forward()[0].asnumpy()
+    err = np.abs(got - ref) / (np.abs(ref).max() + 1e-9)
+    return err.max() if reduce == "max" else err.mean()
+
+
+# entropy calibration deliberately clips outliers, so its MAX error is
+# larger by design; judge it on mean error instead
+@pytest.mark.parametrize("mode,reduce,tol", [
+    ("none", "max", 0.08), ("naive", "max", 0.08),
+    ("entropy", "mean", 0.08)])
+def test_int8_islands_track_float(rng, mode, reduce, tol):
+    sym, arg = _small_convnet(rng)
+    x = rng.randn(8, 1, 6, 6).astype("f4")
+    kw = {"calib_mode": mode}
+    if mode != "none":
+        kw["calib_data"] = [_Batch(x)]
+    qsym, qarg, _ = q.quantize_model(sym, arg, {}, **kw)
+    # the pass really rewrote the graph: int8 ops present, originals gone
+    ops = {n.op for n in qsym.topo_nodes() if not n.is_var}
+    assert "_contrib_quantized_conv" in ops
+    assert "_contrib_quantized_fully_connected" in ops
+    assert "_contrib_requantize" in ops and "_contrib_dequantize" in ops
+    assert "Convolution" not in ops and "FullyConnected" not in ops
+    # int8 weights shipped alongside ranges
+    assert qarg["conv0_weight_quantized"].asnumpy().dtype == np.int8
+    assert _rel_err(sym, arg, qsym, qarg, x, reduce=reduce) < tol
+
+
+def test_excluded_layers_stay_float(rng):
+    sym, arg = _small_convnet(rng)
+    qsym, qarg, _ = q.quantize_model(sym, arg, {},
+                                     excluded_sym_names=("fc0",))
+    ops = {n.op for n in qsym.topo_nodes() if not n.is_var}
+    assert "FullyConnected" in ops            # excluded: untouched
+    assert "_contrib_quantized_conv" in ops   # conv still quantized
+    x = np.random.RandomState(1).randn(4, 1, 6, 6).astype("f4")
+    assert _rel_err(sym, arg, qsym, qarg, x) < 0.08
+
+
+def test_no_bias_conv_quantizes(rng):
+    data = mx.sym.Variable("data")
+    c = mx.sym.Convolution(data, kernel=(3, 3), num_filter=2, no_bias=True,
+                           name="convnb")
+    arg = {"convnb_weight":
+           mx.nd.array(rng.randn(2, 1, 3, 3).astype("f4") * 0.3)}
+    qsym, qarg, _ = q.quantize_model(c, arg, {})
+    x = rng.randn(2, 1, 5, 5).astype("f4")
+    assert _rel_err(c, arg, qsym, qarg, x) < 0.08
+
+
+def test_bad_modes_raise(rng):
+    sym, arg = _small_convnet(rng)
+    with pytest.raises(MXNetError, match="calib_data"):
+        q.quantize_model(sym, arg, {}, calib_mode="naive")
+    with pytest.raises(MXNetError, match="calib_mode"):
+        q.quantize_model(sym, arg, {}, calib_mode="bogus")

@@ -81,3 +81,20 @@ def test_transformer_lm_trains():
                                             verbose=False)
     assert last < first * 0.6
     assert acc > 0.5
+
+
+def test_positional_embedding_odd_units():
+    pos = tfm.SinusoidalPositionalEmbedding(16, 7)   # odd units
+    pos.initialize()
+    out = pos(mx.nd.zeros((1, 4, 7)))
+    assert out.shape == (1, 4, 7)
+
+
+def test_tied_lm_has_no_head_params():
+    lm = tfm.TransformerLM(vocab_size=11, units=8, num_layers=1, num_heads=2,
+                           max_len=8, tie_weights=True)
+    lm.initialize(mx.init.Xavier())
+    names = [p.name for p in lm.collect_params().values()]
+    assert not any("head" in n for n in names)
+    out = lm(mx.nd.array(np.zeros((1, 4), "float32")))
+    assert out.shape == (1, 4, 11)
