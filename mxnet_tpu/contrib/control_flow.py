@@ -54,9 +54,8 @@ def foreach(body: Callable, data, init_states):
     ``lax.scan``; differentiable through the tape. Accepts Symbols too —
     then it builds a ``_foreach`` graph node whose body is a stored
     subgraph, exactly the reference's symbolic form."""
-    from ..symbol.symbol import Symbol as _Sym
-    if isinstance(data, _Sym) or (isinstance(data, (list, tuple)) and data
-                                  and isinstance(data[0], _Sym)):
+    if _check_homogeneous("foreach", data, init_states):
+        from ..symbol.symbol import Symbol as _Sym
         if isinstance(data, (list, tuple)):
             raise MXNetError("symbolic foreach takes ONE data symbol")
         return _sym_foreach(body, data, init_states)
@@ -132,10 +131,7 @@ def while_loop(cond_fn: Callable, func: Callable, loop_vars,
     collects per-step outputs into a max_iterations buffer; same here.
     Forward-only (document parity: gradients require bounded scan — use
     foreach)."""
-    from ..symbol.symbol import Symbol as _Sym
-    if isinstance(loop_vars, _Sym) or (isinstance(loop_vars, (list, tuple))
-                                       and loop_vars
-                                       and isinstance(loop_vars[0], _Sym)):
+    if _check_homogeneous("while_loop", loop_vars):
         return _sym_while_loop(cond_fn, func, loop_vars, max_iterations)
     single = isinstance(loop_vars, NDArray)
     vars_list = _unwrap_list(loop_vars)
@@ -184,15 +180,8 @@ def cond(pred_fn: Union[Callable, NDArray], then_func: Callable,
          else_func: Callable, inputs=None):
     """Reference _cond: both branches traced once, selected at run time by
     ``lax.cond``."""
-    from ..symbol.symbol import Symbol as _Sym
-    any_sym = isinstance(pred_fn, _Sym) or any(isinstance(x, _Sym)
-                                               for x in (inputs or []))
-    if any_sym:
-        mixed = (isinstance(pred_fn, NDArray)
-                 or any(isinstance(x, NDArray) for x in (inputs or [])))
-        if mixed:
-            raise MXNetError("cond: predicate and inputs must be all "
-                             "Symbols or all NDArrays, not a mix")
+    pred_group = None if callable(pred_fn) else pred_fn
+    if _check_homogeneous("cond", pred_group, inputs):
         return _sym_cond(pred_fn, then_func, else_func, inputs)
     if callable(pred_fn):
         with autograd.pause():
@@ -230,6 +219,27 @@ import itertools as _itertools
 _cf_uid = _itertools.count()
 
 
+def _truthy(v) -> bool:
+    return str(v).lower() in ("true", "1")
+
+
+def _check_homogeneous(name, *groups):
+    """All-Symbol or all-NDArray across every listed value; mixing the two
+    graph forms has no meaning — raise the same clear error cond does."""
+    from ..symbol.symbol import Symbol as _Sym
+    flat = []
+    for g in groups:
+        if g is None:
+            continue
+        flat.extend(g if isinstance(g, (list, tuple)) else [g])
+    has_sym = any(isinstance(x, _Sym) for x in flat)
+    has_nd = any(isinstance(x, NDArray) for x in flat)
+    if has_sym and has_nd:
+        raise MXNetError(f"{name}: inputs must be all Symbols or all "
+                         "NDArrays, not a mix")
+    return has_sym
+
+
 def _free_var_entries(sub, bound_names):
     """(names, entries) of the subgraph's free variables — outer-graph vars
     the body closed over (weights etc.), wired as extra node inputs."""
@@ -240,25 +250,19 @@ def _free_var_entries(sub, bound_names):
             entries.append((n, 0))
         if not n.is_var:
             from ..executor import _AUX_UPDATE_RULES
-            if n.op in _AUX_UPDATE_RULES:
+            if n.op in _AUX_UPDATE_RULES and not _truthy(
+                    (n.attrs or {}).get("use_global_stats")):
                 raise MXNetError(
                     f"op {n.op!r} ({n.name}) updates auxiliary state, which "
                     "a control-flow subgraph cannot propagate (its scan "
                     "carry holds loop states only) — move it outside the "
-                    "loop or use use_global_stats/inference mode")
+                    "loop or set use_global_stats=True")
     return names, entries
 
 
 def _lowered_sub(sg_id, is_train):
-    from ..subgraph import _LOWERED_SUBGRAPHS, get_stored_subgraph
-    from ..executor import _GraphLowering
-    key = ("cf", int(sg_id), bool(is_train))
-    fn = _LOWERED_SUBGRAPHS.get(key)
-    if fn is None:
-        fn = _GraphLowering(get_stored_subgraph(int(sg_id))).lower(
-            is_train=bool(is_train))
-        _LOWERED_SUBGRAPHS[key] = fn
-    return fn
+    from ..subgraph import lowered_subgraph
+    return lowered_subgraph(sg_id, is_train)
 
 
 def _sym_foreach(body, data, init_states):

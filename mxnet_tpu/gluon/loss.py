@@ -167,8 +167,20 @@ class CTCLoss(Loss):
             return _wrap(optax.ctc_loss(logits, logit_pad,
                                         jnp.maximum(labels, 0), lab_pad,
                                         blank_id=blank))
-        raise NotImplementedError("symbolic CTCLoss: call imperatively or use "
-                                  "F.CTCLoss op once registered")
+        # symbolic path: route through the registered CTCLoss op (TNC
+        # layout, gluon blank-last convention, -1 label padding)
+        p = pred if self._layout == "TNC" else F.transpose(pred,
+                                                           axes=(1, 0, 2))
+        lab = label if self._label_layout == "NT" else F.transpose(
+            label, axes=(1, 0))
+        # the op's positional arg list is fixed; unused length slots get
+        # zero placeholders the kernel ignores (use_*_lengths=False)
+        import mxnet_tpu.symbol as _sym
+        pl = pred_lengths if pred_lengths is not None else _sym.zeros((1,))
+        ll = label_lengths if label_lengths is not None else _sym.zeros((1,))
+        return F.CTCLoss(p, lab, pl, ll, blank_label="last",
+                         use_data_lengths=pred_lengths is not None,
+                         use_label_lengths=label_lengths is not None)
 
 
 class HuberLoss(Loss):

@@ -11,6 +11,9 @@ from ..ops.registry import _REGISTRY
 
 
 def __getattr__(name: str):
+    if name in ("foreach", "while_loop", "cond"):
+        from ..contrib import control_flow as _cf
+        return getattr(_cf, name)
     if name.startswith("dgl_"):
         # graph-sampling ops take/return CSRNDArrays — host functions, not
         # registry ops (reference: CPU-only FComputeEx, dgl_graph.cc)

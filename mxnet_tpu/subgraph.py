@@ -110,20 +110,27 @@ def get_stored_subgraph(idx: int) -> Symbol:
 _LOWERED_SUBGRAPHS: Dict[tuple, object] = {}
 
 
-@_register_op("_subgraph", needs_rng=True,
-              num_outputs=lambda attrs: int(attrs.get("num_out", 1)))
-def _subgraph_exec(*inputs, subgraph_id=0, num_out=1, input_names=(),
-                   is_train=False, rng=None):
-    """Execute a partitioned region as one lowered XLA computation."""
+def lowered_subgraph(subgraph_id: int, is_train: bool):
+    """Lower a stored subgraph to a callable, memoized per (id, is_train) —
+    the single cache shared by the partition op and the control-flow ops."""
     from .executor import _GraphLowering
-    import jax
-
     cache_key = (int(subgraph_id), bool(is_train))
     fn = _LOWERED_SUBGRAPHS.get(cache_key)
     if fn is None:
         sym = get_stored_subgraph(int(subgraph_id))
         fn = _GraphLowering(sym).lower(is_train=bool(is_train))
         _LOWERED_SUBGRAPHS[cache_key] = fn
+    return fn
+
+
+@_register_op("_subgraph", needs_rng=True,
+              num_outputs=lambda attrs: int(attrs.get("num_out", 1)))
+def _subgraph_exec(*inputs, subgraph_id=0, num_out=1, input_names=(),
+                   is_train=False, rng=None):
+    """Execute a partitioned region as one lowered XLA computation."""
+    import jax
+
+    fn = lowered_subgraph(subgraph_id, is_train)
     feed = dict(zip(input_names, inputs))
     if rng is None:
         rng = jax.random.PRNGKey(0)

@@ -7,6 +7,11 @@ from ..ops.registry import _REGISTRY
 
 
 def __getattr__(name: str):
+    if name in ("foreach", "while_loop", "cond"):
+        # control flow functions serve both namespaces (reference
+        # symbol/contrib.py defines symbolic twins of the ndarray trio)
+        from ..contrib import control_flow as _cf
+        return getattr(_cf, name)
     from . import __getattr__ as _sym_getattr
     for cand in (f"_contrib_{name}", f"contrib_{name}"):
         if cand in _REGISTRY:

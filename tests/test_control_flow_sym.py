@@ -180,3 +180,35 @@ def test_sym_while_none_output_and_mixed_cond_raises():
     with pytest.raises(MXNetError, match="mix"):
         cf.cond(mx.nd.array([1.0]), lambda x: x, lambda x: x,
                 [mx.sym.Variable("a")])
+
+
+def test_contrib_namespace_exposes_trio():
+    assert mx.nd.contrib.foreach is mx.sym.contrib.foreach
+    outs, fin = mx.sym.contrib.foreach(
+        lambda x, s: (s + x, s + x), mx.sym.Variable("d"),
+        mx.sym.Variable("s"))
+    e = fin.bind(mx.cpu(), {"d": mx.nd.ones((3, 2)), "s": mx.nd.zeros((2,))})
+    np.testing.assert_allclose(e.forward()[0].asnumpy(), 3.0)
+
+
+def test_mixed_inputs_raise_and_global_stats_bn_allowed():
+    with pytest.raises(MXNetError, match="mix"):
+        cf.foreach(lambda x, s: (x, s), mx.sym.Variable("d"),
+                   mx.nd.zeros((2,)))
+    with pytest.raises(MXNetError, match="mix"):
+        cf.while_loop(lambda s: s, lambda s: (None, s),
+                      [mx.sym.Variable("v"), mx.nd.ones((1,))],
+                      max_iterations=3)
+    # inference-mode BN (use_global_stats) never updates aux: allowed
+    data = mx.sym.Variable("data")
+    s0 = mx.sym.Variable("s0")
+    g = mx.sym.Variable("g"); b = mx.sym.Variable("b")
+    mm = mx.sym.Variable("mm"); mv = mx.sym.Variable("mv")
+
+    def body(x, s):
+        y = mx.sym.BatchNorm(x, g, b, mm, mv, use_global_stats=True,
+                             name="bn")
+        return y, s
+
+    outs, _ = cf.foreach(body, data, s0)   # must not raise
+    assert outs is not None

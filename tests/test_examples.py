@@ -56,3 +56,20 @@ def test_ctc_loss_blank_is_last_and_nonnegative():
     loss2 = ctc(mx.nd.array(rng.randn(4, 12, 11).astype("f4")),
                 mx.nd.array(rng.randint(0, 10, (4, 4)).astype("f4")))
     assert (loss2.asnumpy() >= 0).all()
+
+
+def test_ctc_loss_symbolic_matches_imperative():
+    """Hybrid/symbolic CTCLoss routes through the registered op and agrees
+    with the imperative optax path (same blank-last convention)."""
+    ctc = gluon.loss.CTCLoss()
+    pred = mx.sym.Variable("pred")
+    lab = mx.sym.Variable("label")
+    loss_sym = ctc(pred, lab)
+    rng = np.random.RandomState(0)
+    lg = rng.randn(4, 12, 11).astype("f4")
+    lb = rng.randint(0, 10, (4, 4)).astype("f4")
+    e = loss_sym.bind(mx.cpu(), {"pred": mx.nd.array(lg),
+                                 "label": mx.nd.array(lb)})
+    np.testing.assert_allclose(
+        e.forward()[0].asnumpy(),
+        ctc(mx.nd.array(lg), mx.nd.array(lb)).asnumpy(), rtol=1e-4)
