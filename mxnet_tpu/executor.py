@@ -45,7 +45,11 @@ def _conv_param_shapes(attrs, ds):
     nf = int(attrs["num_filter"])
     g = int(attrs.get("num_group", 1))
     kernel = tuple(attrs["kernel"])
-    shapes = {"weight": (nf, ds[1] // g) + kernel}
+    layout = str(attrs.get("layout") or "")
+    if layout.endswith("C"):  # channel-last (NHWC): weight is (O, *k, I)
+        shapes = {"weight": (nf,) + kernel + (ds[-1] // g,)}
+    else:
+        shapes = {"weight": (nf, ds[1] // g) + kernel}
     if not attrs.get("no_bias", False):
         shapes["bias"] = (nf,)
     return shapes

@@ -36,14 +36,24 @@ class _Conv(HybridBlock):
         self._act_type = activation
         with self.name_scope():
             if op_name == "Convolution":
-                wshape = (channels, in_channels // groups if in_channels else 0) \
-                    + tuple(kernel_size)
+                if layout and layout.endswith("C"):  # channel-last: (O, *k, I)
+                    wshape = (channels,) + tuple(kernel_size) \
+                        + (in_channels // groups if in_channels else 0,)
+                else:
+                    wshape = (channels,
+                              in_channels // groups if in_channels else 0) \
+                        + tuple(kernel_size)
             else:  # Deconvolution: (in, out//g, *k)
                 wshape = (in_channels if in_channels else 0, channels // groups) \
                     + tuple(kernel_size)
             self.weight = self.params.get("weight", shape=wshape,
                                           init=weight_initializer,
                                           allow_deferred_init=True)
+            if op_name == "Convolution" and layout and layout.endswith("C"):
+                # initializers see the canonical (O,I,*k) view so fan-in/out
+                # scaling (and the drawn values) match the NCHW twin exactly
+                self.weight._init_perm = (0,) + tuple(
+                    range(2, 2 + ndim)) + (1,)
             if use_bias:
                 self.bias = self.params.get("bias", shape=(channels,),
                                             init=bias_initializer,
@@ -127,7 +137,7 @@ class _Pooling(HybridBlock):
             "kernel": pool_size, "stride": strides, "pad": padding,
             "pool_type": pool_type, "global_pool": global_pool,
             "pooling_convention": "full" if ceil_mode else "valid",
-            "count_include_pad": count_include_pad}
+            "count_include_pad": count_include_pad, "layout": layout}
 
     def hybrid_forward(self, F, x):
         return F.Pooling(x, **self._kwargs)

@@ -84,8 +84,20 @@ class Parameter:
         self._finish_init(chosen, ctx)
 
     def _finish_init(self, init, ctx) -> None:
-        host = np.zeros(self.shape, dtype=self.dtype)
-        initializer.create(init)(self.name, host)
+        perm = getattr(self, "_init_perm", None)
+        if perm is not None:
+            # draw in the canonical (reference NCHW-style) axis order, then
+            # permute — channel-last weights get the exact same init values
+            # and fan-in/fan-out scaling as their channel-first twins
+            canon = [0] * len(self.shape)
+            for i, p in enumerate(perm):
+                canon[p] = self.shape[i]
+            host = np.zeros(tuple(canon), dtype=self.dtype)
+            initializer.create(init)(self.name, host)
+            host = np.ascontiguousarray(host.transpose(perm))
+        else:
+            host = np.zeros(self.shape, dtype=self.dtype)
+            initializer.create(init)(self.name, host)
         self._data = nd_array(host, ctx=ctx, dtype=self.dtype)
         if self._grad_req != "null":
             self._data.attach_grad(self._grad_req)

@@ -43,8 +43,18 @@ def _fully_connected(data, weight, bias=None, num_hidden=None, no_bias=False,
 
 
 # ---------------------------------------------------------------- Convolution
-_CONV_DIMS = {1: ("NCW", "OIW", "NCW"), 2: ("NCHW", "OIHW", "NCHW"),
-              3: ("NCDHW", "OIDHW", "NCDHW")}
+_DEFAULT_CONV_LAYOUT = {1: "NCW", 2: "NCHW", 3: "NCDHW"}
+
+
+def _conv_layout(nd, layout):
+    """Resolve the mxnet layout string (reference conv param `layout`;
+    channel-last NHWC/NWC/NDHWC is the TPU-preferred form — convs lower to
+    the MXU without transposes). Weight layout follows the data layout as in
+    the reference: NCHW->OIHW, NHWC->OHWI."""
+    lhs = str(layout) if layout not in (None, "None", "") \
+        else _DEFAULT_CONV_LAYOUT[nd]
+    rhs = lhs.replace("N", "O").replace("C", "I")
+    return lhs, rhs
 
 
 @register("Convolution", arg_names=("data", "weight", "bias"))
@@ -55,14 +65,16 @@ def _convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(), pad=(
     stride = _pair(stride, nd)
     dilate = _pair(dilate, nd)
     pad = _pair(pad, nd) if pad else (0,) * nd
-    dn = lax.conv_dimension_numbers(data.shape, weight.shape, _CONV_DIMS[nd])
+    lhs, rhs = _conv_layout(nd, layout)
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape, (lhs, rhs, lhs))
     out = lax.conv_general_dilated(
         data, weight, window_strides=stride,
         padding=[(p, p) for p in pad],
         rhs_dilation=dilate, dimension_numbers=dn,
         feature_group_count=int(num_group))
     if not no_bias and bias is not None:
-        out = out + bias.reshape((1, -1) + (1,) * nd)
+        bshape = tuple(-1 if a == "C" else 1 for a in lhs)
+        out = out + bias.reshape(bshape)
     return out
 
 
@@ -78,8 +90,13 @@ def _deconvolution(data, weight, bias=None, kernel=(), stride=(), dilate=(), pad
     dilate = _pair(dilate, nd)
     pad = _pair(pad, nd) if pad else (0,) * nd
     adj = _pair(adj, nd) if adj else (0,) * nd
+    if layout not in (None, "None", "") and not str(layout).startswith("NC"):
+        raise MXNetError(
+            f"Deconvolution supports channel-first layouts only (got "
+            f"{layout!r}); the reference restricts NHWC deconv to cuDNN too")
     # weight layout: (in_channels, num_filter//group, *kernel)
-    dn = lax.conv_dimension_numbers(data.shape, weight.shape, _CONV_DIMS[nd])
+    lhs, rhs = _conv_layout(nd, None)
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape, (lhs, rhs, lhs))
     k_eff = [(int(kernel[i]) - 1) * dilate[i] + 1 for i in range(nd)]
     padding = [(k_eff[i] - 1 - pad[i], k_eff[i] - 1 - pad[i] + adj[i]) for i in range(nd)]
     g = int(num_group)
@@ -103,26 +120,32 @@ def _pooling(data, kernel=(), pool_type="max", global_pool=False, stride=(), pad
              pooling_convention="valid", cudnn_off=False, p_value=2,
              count_include_pad=True, layout=None):
     nd = data.ndim - 2
+    lhs, _ = _conv_layout(nd, layout)
+    spatial = [i for i, a in enumerate(lhs) if a not in ("N", "C")]
     if global_pool:
-        kernel = data.shape[2:]
+        kernel = tuple(data.shape[i] for i in spatial)
         stride = (1,) * nd
         pad = (0,) * nd
     kernel = _pair(kernel, nd)
     stride = _pair(stride, nd) if stride else kernel if global_pool else (1,) * nd
     pad = _pair(pad, nd) if pad else (0,) * nd
-    window = (1, 1) + kernel
-    strides = (1, 1) + stride
-    padcfg = []
-    for i in range(nd):
+    window = [1] * data.ndim
+    strides = [1] * data.ndim
+    padding = [(0, 0)] * data.ndim
+    for i, ax in enumerate(spatial):
+        window[ax] = kernel[i]
+        strides[ax] = stride[i]
         lo = hi = pad[i]
         if pooling_convention == "full":
             # ceil output size (reference pooling-inl.h kFull)
-            size = data.shape[2 + i]
+            size = data.shape[ax]
             out_sz = -(-(size + 2 * pad[i] - kernel[i]) // stride[i]) + 1
             need = (out_sz - 1) * stride[i] + kernel[i] - size - pad[i]
             hi = max(need, pad[i])
-        padcfg.append((lo, hi))
-    padding = ((0, 0), (0, 0)) + tuple(padcfg)
+        padding[ax] = (lo, hi)
+    window = tuple(window)
+    strides = tuple(strides)
+    padding = tuple(padding)
     if pool_type == "max":
         init = -jnp.inf
         out = lax.reduce_window(data, init, lax.max, window, strides, padding)

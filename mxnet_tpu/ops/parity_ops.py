@@ -551,14 +551,17 @@ def _quantized_conv(data, weight, bias, min_data, max_data, min_weight,
                     stride=(), dilate=(), pad=(), num_filter=1, num_group=1,
                     no_bias=False, layout="NCHW"):
     """int8 conv accumulating int32 on the MXU (quantized_conv.cc)."""
+    from .nn import _conv_layout
     nd = len(kernel)
     strides = tuple(stride) or (1,) * nd
     dil = tuple(dilate) or (1,) * nd
     padding = tuple((p, p) for p in (tuple(pad) or (0,) * nd))
+    lhs, rhs = _conv_layout(nd, layout)
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape, (lhs, rhs, lhs))
     acc = lax.conv_general_dilated(
         data.astype(jnp.int32), weight.astype(jnp.int32),
         window_strides=strides, padding=padding, rhs_dilation=dil,
-        feature_group_count=int(num_group),
+        dimension_numbers=dn, feature_group_count=int(num_group),
         preferred_element_type=jnp.int32)
     scale_d = jnp.maximum(jnp.abs(min_data), jnp.abs(max_data)) / 127.0
     scale_w = jnp.maximum(jnp.abs(min_weight), jnp.abs(max_weight)) / 127.0
@@ -567,7 +570,8 @@ def _quantized_conv(data, weight, bias, min_data, max_data, min_weight,
         scale_b = jnp.maximum(jnp.abs(min_bias), jnp.abs(max_bias)) / 127.0
         q_bias = jnp.round(bias.astype(jnp.float32)
                            * (scale_b / out_scale)).astype(jnp.int32)
-        acc = acc + q_bias.reshape(1, -1, *([1] * nd))
+        bshape = tuple(-1 if a == "C" else 1 for a in lhs)
+        acc = acc + q_bias.reshape(bshape)
     rng = out_scale * 0x7FFFFFFF
     return acc, -rng, rng
 
@@ -576,13 +580,13 @@ def _quantized_conv(data, weight, bias, min_data, max_data, min_weight,
           arg_names=("data", "min_data", "max_data"))
 def _quantized_pooling(data, min_data, max_data, kernel=(), pool_type="max",
                        stride=(), pad=(), global_pool=False,
-                       pooling_convention="valid"):
+                       pooling_convention="valid", layout=None):
     """Pooling on int8 keeps the input range (quantized_pooling.cc)."""
     pooling = get_op("Pooling").fn
     out = pooling(data.astype(jnp.float32), kernel=kernel,
                   pool_type=pool_type, stride=stride, pad=pad,
                   global_pool=global_pool,
-                  pooling_convention=pooling_convention)
+                  pooling_convention=pooling_convention, layout=layout)
     return out.astype(data.dtype), min_data, max_data
 
 

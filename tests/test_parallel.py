@@ -47,7 +47,7 @@ def test_data_parallel_trainer_matches_single_device(rng):
     Y = rng.randint(0, 4, 32).astype("float32")
 
     # single-device gluon training
-    np.random.seed(3)
+    mx.random.seed(3)
     net_a = make_net()
     net_a.initialize(mx.init.Xavier())
     tr = gluon.Trainer(net_a.collect_params(), "sgd",
@@ -63,7 +63,7 @@ def test_data_parallel_trainer_matches_single_device(rng):
     ref_loss = float(loss_fn(net_a(nd.array(X)), nd.array(Y)).mean().asscalar())
 
     # dp-sharded fused trainer, same init
-    np.random.seed(3)
+    mx.random.seed(3)
     net_b = make_net()
     net_b.initialize(mx.init.Xavier())
     dpt = parallel.DataParallelTrainer(net_b, loss_fn, "sgd",

@@ -1,0 +1,129 @@
+#!/usr/bin/env python
+"""Perf lab for the ResNet-50 north star (BASELINE.json: >=3000 img/s/chip,
+MFU >= 0.20 on one chip).
+
+Runs a ladder of training-step variants in ONE process / ONE TPU client
+(the axon tunnel is single-client) and prints one JSON line per variant:
+
+    python tools/perf_lab.py                  # default ladder
+    PERF_VARIANTS="NHWC:512,NHWC:1024" python tools/perf_lab.py
+
+Also dumps the compiled HLO of the last variant to /tmp/perf_lab_hlo.txt
+and greps it for un-fused transposes/converts so BN/ReLU fusion claims are
+backed by the compiler's own output, not guesswork.
+"""
+import json
+import os
+import re
+import sys
+import time
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, HERE)
+
+
+def main():
+    import jax
+    try:
+        jax.config.update("jax_compilation_cache_dir", "/tmp/mxtpu_jax_cache")
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
+
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, parallel
+    from mxnet_tpu.gluon.model_zoo import vision
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    devices = jax.devices()
+    on_accel = any(d.platform != "cpu" for d in devices)
+    kind = devices[0].device_kind
+    print(f"# devices: {len(devices)} x {kind}", file=sys.stderr, flush=True)
+
+    spec_env = os.environ.get(
+        "PERF_VARIANTS", "NCHW:256,NHWC:256,NHWC:512,NHWC:1024")
+    variants = []
+    for tok in spec_env.split(","):
+        layout, b = tok.strip().split(":")
+        variants.append((layout, int(b)))
+
+    steps = int(os.environ.get("PERF_STEPS", 30))
+    warmup = int(os.environ.get("PERF_WARMUP", 5))
+    image = int(os.environ.get("PERF_IMAGE", 224))
+
+    last = None
+    for layout, batch in variants:
+        t_var = time.perf_counter()
+        try:
+            np.random.seed(0)
+            mx.random.seed(0)
+            net = vision.resnet50_v1(classes=1000, layout=layout)
+            net.initialize(mx.init.Xavier())
+            loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+            trainer = parallel.DataParallelTrainer(
+                net, loss_fn, "sgd",
+                {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4},
+                compute_dtype="bfloat16" if on_accel else None)
+            shape = (batch, image, image, 3) if layout == "NHWC" \
+                else (batch, 3, image, image)
+            x = np.random.uniform(-1, 1, shape).astype("float32")
+            y = np.random.randint(0, 1000, (batch,)).astype("float32")
+            spec = NamedSharding(trainer.mesh, P("dp"))
+            t0 = time.perf_counter()
+            loss = trainer.step(x, y)
+            float(loss)
+            compile_s = time.perf_counter() - t0
+            xd = jax.device_put(x, spec)
+            yd = jax.device_put(y, spec)
+            for _ in range(warmup):
+                loss = trainer.step(xd, yd)
+            float(loss)
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                loss = trainer.step(xd, yd)
+            float(loss)
+            dt = time.perf_counter() - t0
+            ips = steps * batch / dt
+            flops = 12.3e9 * (image / 224.0) ** 2 * batch * (steps / dt)
+            print(json.dumps({
+                "variant": f"{layout}:{batch}", "img_s": round(ips, 1),
+                "step_ms": round(1e3 * dt / steps, 2),
+                "compile_s": round(compile_s, 1),
+                "analytic_tflops": round(flops / 1e12, 1),
+                "loss": float(loss),
+            }), flush=True)
+            last = (trainer, xd, yd, layout, batch)
+        except Exception as e:
+            print(json.dumps({"variant": f"{layout}:{batch}",
+                              "error": repr(e)[:300]}), flush=True)
+        print(f"# variant took {time.perf_counter() - t_var:.0f}s total",
+              file=sys.stderr, flush=True)
+
+    if last is None:
+        return
+    trainer, xd, yd, layout, batch = last
+    try:
+        lowered = trainer._step_fn.lower(
+            trainer._params, trainer._aux, trainer._opt_state,
+            jax.random.PRNGKey(0), xd, yd)
+        txt = lowered.compile().as_text()
+        with open("/tmp/perf_lab_hlo.txt", "w") as f:
+            f.write(txt)
+        # crude fusion audit: standalone transpose/convert ops at the top
+        # level of the entry computation indicate layout/dtype traffic XLA
+        # could not fuse into the convs
+        ops = re.findall(r"^\s*%?\S+ = \S+ (\w+)\(", txt, re.M)
+        from collections import Counter
+        c = Counter(ops)
+        audit = {k: c[k] for k in
+                 ("transpose", "convert", "convolution", "fusion",
+                  "custom-call", "all-reduce", "copy") if k in c}
+        print(json.dumps({"hlo_audit": audit,
+                          "hlo_path": "/tmp/perf_lab_hlo.txt"}), flush=True)
+    except Exception as e:
+        print(json.dumps({"hlo_audit_error": repr(e)[:300]}), flush=True)
+
+
+if __name__ == "__main__":
+    main()
