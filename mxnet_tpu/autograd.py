@@ -98,7 +98,7 @@ class _Node:
     """One recorded op application (the AGInfo equivalent)."""
 
     __slots__ = ("vjp_fn", "parents", "parent_slots", "n_outputs", "order",
-                 "op_name", "saved_outputs")
+                 "op_name", "saved_outputs", "primal", "diff_datas")
 
     def __init__(self, vjp_fn, parents, parent_slots, n_outputs, order, op_name):
         self.vjp_fn = vjp_fn
@@ -108,6 +108,11 @@ class _Node:
         self.order = order
         self.op_name = op_name
         self.saved_outputs = None
+        # create_graph support: the differentiable primal closure and its
+        # positional (differentiable) input arrays, so the backward of this
+        # node can be RE-derived inside a recorded call (jax.vjp composes)
+        self.primal = None
+        self.diff_datas = None
 
 
 class _Leaf:
@@ -166,6 +171,8 @@ def _record_invoke(opdef, inputs, in_datas, attrs):
 
     n_out = len(out) if isinstance(out, tuple) else 1
     node = _Node(vjp_fn, parents, slots, n_out, st.counter, opdef.name)
+    node.primal = closed
+    node.diff_datas = diff_args
     if n_out > 1:
         node.saved_outputs = list(out)
     st.counter += 1
@@ -207,16 +214,30 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
 
 def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,
          train_mode=True):
-    """Return grads of heads wrt variables without touching .grad buffers."""
+    """Return grads of heads wrt variables without touching .grad buffers.
+
+    With ``create_graph=True`` the backward pass itself is recorded on the
+    tape (reference Imperative::Backward honoring create_graph,
+    imperative.cc:278-460), so the returned grads are differentiable —
+    grad-of-grad, gradient penalties, etc. compose to arbitrary order."""
     from .ndarray.ndarray import NDArray, _wrap
     if isinstance(heads, NDArray):
         heads = [heads]
+        if head_grads is not None and not isinstance(head_grads, (list, tuple)):
+            head_grads = [head_grads]
     if isinstance(variables, NDArray):
         variables = [variables]
     if create_graph:
-        raise MXNetError("create_graph=True (higher-order autograd) is not yet "
-                         "supported by the tape; use mxnet_tpu.functional.grad")
-    grads = _backward_impl(heads, head_grads, retain_graph or create_graph,
+        recs = _backward_create_graph(heads, head_grads, variables)
+        out = []
+        for r in recs:
+            w = _wrap(r._data)
+            if r._ag_node is not None:
+                w._ag_node = r._ag_node
+                w._ag_slot = r._ag_slot
+            out.append(w)
+        return out
+    grads = _backward_impl(heads, head_grads, retain_graph,
                            accumulate_to_leaves=False, wrt=variables)
     return [_wrap(g) for g in grads]
 
@@ -287,7 +308,9 @@ def _backward_impl(heads, head_grads, retain_graph, accumulate_to_leaves=True,
                 k = (id(p), slot)
                 cotangents[k] = cotangents.get(k, 0) + ict
         if not retain_graph:
-            n.vjp_fn = None  # free residuals eagerly
+            n.vjp_fn = None       # free residuals eagerly
+            n.primal = None       # the closure pins all op inputs
+            n.diff_datas = None
 
     # head that IS a leaf (x.backward() on a var directly)
     for i, h in enumerate(heads):
@@ -312,6 +335,147 @@ def _backward_impl(heads, head_grads, retain_graph, accumulate_to_leaves=True,
         if not retain_graph:
             st.tape.clear()
         return out
+
+
+class _Rec:
+    """A value with tape provenance flowing through the create_graph
+    backward walk (a lightweight stand-in for a full NDArray)."""
+
+    __slots__ = ("_data", "_ag_node", "_ag_slot")
+
+    def __init__(self, data, node=None, slot=0):
+        self._data = data
+        self._ag_node = node
+        self._ag_slot = slot
+
+
+def _record_call(fn, wrappers, name):
+    """Run ``fn(*datas)`` under jax.vjp and push a tape node whose parents
+    are the wrappers' provenance — the create_graph recording primitive."""
+    st = _st()
+    datas = [w._data for w in wrappers]
+    out, vjp_fn = jax.vjp(fn, *datas)
+    parents = [w._ag_node for w in wrappers]
+    slots = [w._ag_slot for w in wrappers]
+    n_out = len(out) if isinstance(out, tuple) else 1
+    node = _Node(vjp_fn, parents, slots, n_out, st.counter, name)
+    node.primal = fn
+    node.diff_datas = datas
+    if n_out > 1:
+        node.saved_outputs = list(out)
+    st.counter += 1
+    st.tape.append(node)
+    return out, node
+
+
+def _racc(a, b):
+    """Recorded accumulation of two provenance-carrying cotangents."""
+    if a is None:
+        return b
+    if b is None:
+        return a
+    if a._ag_node is None and b._ag_node is None:
+        return _Rec(a._data + b._data)
+    out, node = _record_call(lambda x, y: x + y, [a, b], "_ct_add")
+    return _Rec(out, node, 0)
+
+
+def _backward_create_graph(heads, head_grads, wrt):
+    """Backward walk that RECORDS the gradient computation. Each node's
+    input cotangents are computed by re-deriving its vjp inside a recorded
+    call taking (original inputs, output cotangents) — so gradients flow
+    both through the cotangent chain and through the residuals, and jax's
+    vjp-of-vjp gives exact higher-order derivatives. The forward of each op
+    is recomputed inside its backward (the memory/compute tradeoff the
+    reference makes with create_graph's full backward graph)."""
+    cotangents: Dict[Any, _Rec] = {}
+    roots: List[_Node] = []
+    for i, h in enumerate(heads):
+        node = getattr(h, "_ag_node", None)
+        if node is None:
+            raise MXNetError("head array is not part of a recorded graph "
+                             "(did you compute it under autograd.record()?)")
+        if head_grads is not None and head_grads[i] is not None:
+            hgv = head_grads[i]
+            hg = _Rec(hgv._data if hasattr(hgv, "_data") else hgv,
+                      getattr(hgv, "_ag_node", None),
+                      getattr(hgv, "_ag_slot", 0))
+        else:
+            hg = _Rec(jnp.ones_like(h._data))
+        slot = getattr(h, "_ag_slot", 0)
+        key = (id(node), slot)
+        cotangents[key] = _racc(cotangents.get(key), hg)
+        if isinstance(node, _Node):
+            roots.append(node)
+
+    seen: Dict[int, _Node] = {}
+    stack = list(roots)
+    while stack:
+        n = stack.pop()
+        if id(n) in seen or not isinstance(n, _Node):
+            continue
+        seen[id(n)] = n
+        for p in n.parents:
+            if isinstance(p, _Node) and id(p) not in seen:
+                stack.append(p)
+
+    order = sorted(seen.values(), key=lambda n: n.order, reverse=True)
+
+    leaf_grads: Dict[int, _Rec] = {}
+    for n in order:
+        cts = [cotangents.get((id(n), s)) for s in range(n.n_outputs)]
+        if all(c is None for c in cts):
+            continue
+        if n.primal is None:
+            raise MXNetError(
+                f"create_graph=True cannot differentiate through "
+                f"{n.op_name!r}: its backward is an opaque callback "
+                f"(autograd.Function); express it with registry ops instead")
+        for s, c in enumerate(cts):
+            if c is None:
+                proto = (n.saved_outputs[s] if n.saved_outputs is not None
+                         else None)
+                cts[s] = _Rec(jnp.zeros(proto.shape, proto.dtype))
+        k = len(n.diff_datas)
+        in_wrappers = [_Rec(d, p, sl) for d, p, sl in
+                       zip(n.diff_datas, n.parents, n.parent_slots)]
+
+        def bwd(*args, _primal=n.primal, _k=k):
+            d, c = args[:_k], args[_k:]
+            out, vjp = jax.vjp(_primal, *d)
+            ct_arg = tuple(c) if isinstance(out, tuple) else c[0]
+            res = vjp(ct_arg)           # tuple of _k input cotangents
+            return res if _k > 1 else res[0]
+
+        outs, node2 = _record_call(bwd, in_wrappers + cts,
+                                   "_grad_of_" + n.op_name)
+        outs_t = outs if isinstance(outs, tuple) else (outs,)
+        for i, (p, slot) in enumerate(zip(n.parents, n.parent_slots)):
+            if p is None:
+                continue
+            ict = _Rec(outs_t[i], node2, i)
+            if isinstance(p, _Leaf):
+                key = id(p.array_ref)
+                leaf_grads[key] = _racc(leaf_grads.get(key), ict)
+            else:
+                kk = (id(p), slot)
+                cotangents[kk] = _racc(cotangents.get(kk), ict)
+
+    # heads that ARE leaves
+    for i, h in enumerate(heads):
+        node = getattr(h, "_ag_node", None)
+        if isinstance(node, _Leaf):
+            key = id(node.array_ref)
+            hg = cotangents[(id(node), getattr(h, "_ag_slot", 0))]
+            leaf_grads[key] = _racc(leaf_grads.get(key), hg)
+
+    out = []
+    for v in wrt:
+        g = leaf_grads.get(id(v))
+        if g is None:
+            g = _Rec(jnp.zeros_like(v._data))
+        out.append(g)
+    return out
 
 
 _all_leaves: Dict[int, Any] = {}
