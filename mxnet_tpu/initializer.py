@@ -9,6 +9,7 @@ from typing import Callable, Dict, Optional
 import numpy as np
 
 from .base import MXNetError
+from .random import host_rng as _host_rng
 
 __all__ = ["Initializer", "Zero", "One", "Constant", "Uniform", "Normal",
            "Orthogonal", "Xavier", "MSRAPrelu", "Bilinear", "LSTMBias",
@@ -114,7 +115,7 @@ class Uniform(Initializer):
         self.scale = scale
 
     def _init_weight(self, name, arr):
-        arr[:] = np.random.uniform(-self.scale, self.scale, arr.shape)
+        arr[:] = _host_rng().uniform(-self.scale, self.scale, arr.shape)
 
 
 @register
@@ -124,7 +125,7 @@ class Normal(Initializer):
         self.sigma = sigma
 
     def _init_weight(self, name, arr):
-        arr[:] = np.random.normal(0.0, self.sigma, arr.shape)
+        arr[:] = _host_rng().normal(0.0, self.sigma, arr.shape)
 
 
 @register
@@ -138,9 +139,9 @@ class Orthogonal(Initializer):
         nout = arr.shape[0]
         nin = int(np.prod(arr.shape[1:]))
         if self.rand_type == "uniform":
-            tmp = np.random.uniform(-1.0, 1.0, (nout, nin))
+            tmp = _host_rng().uniform(-1.0, 1.0, (nout, nin))
         else:
-            tmp = np.random.normal(0.0, 1.0, (nout, nin))
+            tmp = _host_rng().normal(0.0, 1.0, (nout, nin))
         u, _, v = np.linalg.svd(tmp, full_matrices=False)
         q = u if u.shape == tmp.shape else v
         arr[:] = (self.scale * q).reshape(arr.shape)
@@ -173,9 +174,9 @@ class Xavier(Initializer):
             raise MXNetError(f"bad factor_type {self.factor_type}")
         scale = math.sqrt(self.magnitude / factor)
         if self.rnd_type == "uniform":
-            arr[:] = np.random.uniform(-scale, scale, shape)
+            arr[:] = _host_rng().uniform(-scale, scale, shape)
         else:
-            arr[:] = np.random.normal(0, scale, shape)
+            arr[:] = _host_rng().normal(0, scale, shape)
 
 
 @register

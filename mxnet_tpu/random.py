@@ -18,10 +18,10 @@ import jax
 
 from .base import get_env
 
-__all__ = ["seed", "next_key", "current_seed"]
+__all__ = ["seed", "next_key", "current_seed", "host_rng"]
 
 _state = threading.local()
-_global = {"seed": None}
+_global = {"seed": None, "host": None}
 _lock = threading.Lock()
 
 
@@ -39,6 +39,20 @@ def seed(seed_state: int, ctx="all") -> None:
     with _lock:
         _global["seed"] = int(seed_state)
         _global["counter"] = 0
+        _global["host"] = None      # host stream re-derives from the new seed
+
+
+def host_rng():
+    """Framework-owned numpy RandomState for host-side randomness
+    (initializers, shufflers). Re-seeded by :func:`seed` like the
+    reference's global RNG (src/resource.cc:87-162 SeedRandom), so
+    ``mx.random.seed(n)`` makes parameter init reproducible WITHOUT
+    touching the user's ``np.random`` global state."""
+    import numpy as np
+    with _lock:
+        if _global["host"] is None:
+            _global["host"] = np.random.RandomState(_root() & 0x7FFFFFFF)
+        return _global["host"]
 
 
 def current_seed() -> int:
