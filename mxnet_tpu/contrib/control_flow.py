@@ -55,9 +55,6 @@ def foreach(body: Callable, data, init_states):
     then it builds a ``_foreach`` graph node whose body is a stored
     subgraph, exactly the reference's symbolic form."""
     if _check_homogeneous("foreach", data, init_states):
-        from ..symbol.symbol import Symbol as _Sym
-        if isinstance(data, (list, tuple)):
-            raise MXNetError("symbolic foreach takes ONE data symbol")
         return _sym_foreach(body, data, init_states)
     single_data = isinstance(data, NDArray)
     single_state = isinstance(init_states, NDArray)
@@ -269,13 +266,16 @@ def _sym_foreach(body, data, init_states):
     from ..symbol.symbol import Symbol, Variable, _Node, Group
     from ..subgraph import _store_subgraph
     uid = next(_cf_uid)
+    single_data = not isinstance(data, (list, tuple))
+    datas = [data] if single_data else list(data)
     single_state = not isinstance(init_states, (list, tuple))
     states = [init_states] if single_state else list(init_states)
-    x_name = f"__foreach{uid}_x"
+    x_names = [f"__foreach{uid}_x{i}" for i in range(len(datas))]
     s_names = [f"__foreach{uid}_s{i}" for i in range(len(states))]
-    x_var = Variable(x_name)
+    x_vars = [Variable(n) for n in x_names]
     s_vars = [Variable(n) for n in s_names]
-    out, new_states = body(x_var, s_vars[0] if single_state else s_vars)
+    out, new_states = body(x_vars[0] if single_data else x_vars,
+                           s_vars[0] if single_state else s_vars)
     outs = [out] if isinstance(out, Symbol) else list(out)
     new_states = [new_states] if isinstance(new_states, Symbol) \
         else list(new_states)
@@ -283,14 +283,15 @@ def _sym_foreach(body, data, init_states):
         raise MXNetError("foreach body must return as many states as given")
     sub = Group(outs + new_states)
     sg_id = _store_subgraph(sub)
-    bound = {x_name, *s_names}
+    bound = {*x_names, *s_names}
     free_names, free_entries = _free_var_entries(sub, bound)
     node = _Node("_foreach", f"foreach{uid}",
                  {"subgraph_id": sg_id, "n_out": len(outs),
-                  "n_state": len(states), "x_name": x_name,
+                  "n_state": len(states), "x_names": tuple(x_names),
                   "state_names": tuple(s_names),
                   "free_names": tuple(free_names)},
-                 [data._outputs[0]] + [s._outputs[0] for s in states]
+                 [d._outputs[0] for d in datas]
+                 + [s._outputs[0] for s in states]
                  + free_entries)
     result = Symbol([(node, i) for i in range(len(outs) + len(states))])
     out_syms = [result[i] for i in range(len(outs))]
@@ -378,27 +379,34 @@ from ..ops.registry import register as _register
 @_register("_foreach",
            num_outputs=lambda a: int(a["n_out"]) + int(a["n_state"]),
            needs_rng=True)
-def _foreach_op(*inputs, subgraph_id=0, n_out=1, n_state=0, x_name="x",
-                state_names=(), free_names=(), is_train=False, rng=None):
+def _foreach_op(*inputs, subgraph_id=0, n_out=1, n_state=0, x_name=None,
+                x_names=(), state_names=(), free_names=(), is_train=False,
+                rng=None):
     """lax.scan over the stored subgraph; outputs = stacked per-step outs
-    then final states (control_flow.cc _foreach output contract)."""
+    then final states (control_flow.cc _foreach output contract). Accepts
+    multiple scanned inputs via x_names (reference foreach takes a list of
+    data symbols); legacy single-input graphs carry x_name."""
     fn = _lowered_sub(subgraph_id, is_train)
-    data = inputs[0]
-    states = tuple(inputs[1:1 + int(n_state)])
-    frees = dict(zip(free_names, inputs[1 + int(n_state):]))
+    if not x_names:
+        x_names = (x_name if x_name is not None else "x",)
+    x_names = tuple(x_names)
+    nd_ = len(x_names)
+    datas = tuple(inputs[:nd_])
+    states = tuple(inputs[nd_:nd_ + int(n_state)])
+    frees = dict(zip(free_names, inputs[nd_ + int(n_state):]))
     if rng is None:
         rng = jax.random.PRNGKey(0)
-    step_keys = jax.random.split(rng, data.shape[0])  # fresh key per step
+    step_keys = jax.random.split(rng, datas[0].shape[0])  # fresh key per step
 
     def step(carry, xs):
-        x, key = xs
-        feed = {x_name: x}
+        xvals, key = xs
+        feed = dict(zip(x_names, xvals))
         feed.update(zip(state_names, carry))
         feed.update(frees)
         outs, _ = fn(feed, key)
         return tuple(outs[int(n_out):]), tuple(outs[:int(n_out)])
 
-    final_states, ys = lax.scan(step, states, (data, step_keys))
+    final_states, ys = lax.scan(step, states, (datas, step_keys))
     return tuple(ys) + tuple(final_states)
 
 
@@ -459,7 +467,11 @@ def _while_loop_op(*inputs, cond_id=0, body_id=0, n_out=1, n_state=1,
         (pred,), _ = c_fn(c_feed, key)
         run = jnp.logical_and(jnp.asarray(pred, bool).reshape(()),
                               jnp.logical_not(done))
-        b_feed = dict(feed)
+        # double-where: past-exit iterations see SAFE (all-ones) state so a
+        # body like 1/x cannot produce NaN/Inf whose gradient would poison
+        # the jnp.where gating below (the classic where-NaN pitfall)
+        b_feed = {n: jnp.where(run, s, jnp.ones_like(s))
+                  for n, s in zip(state_names, st)}
         b_feed.update(b_frees)
         outs, _ = b_fn(b_feed, jax.random.fold_in(key, 1))
         new_st = tuple(jnp.where(run, n, o) for n, o in

@@ -89,9 +89,16 @@ def calib_minmax(activations: np.ndarray):
 
 
 def calib_entropy(activations: np.ndarray, num_bins: int = 8001,
-                  num_quantized_bins: int = 255):
+                  num_quantized_bins: int = 255,
+                  min_percentile: float = None):
     """KL-divergence threshold search (reference quantization.py
-    _get_optimal_threshold)."""
+    _get_optimal_threshold).
+
+    ``min_percentile`` (default None = pure reference behavior) floors the
+    KL-optimal threshold at that percentile of |x|; pass e.g. 99.0 to stop
+    a noisy KL search from clipping below the bulk of the distribution.
+    This floor is a divergence from the reference when enabled — calibrated
+    ranges will differ from reference-calibrated models."""
     arr = np.abs(activations.ravel())
     amax = float(arr.max()) if arr.size else 1.0
     if amax == 0:
@@ -127,9 +134,8 @@ def calib_entropy(activations: np.ndarray, num_bins: int = 8001,
         kl = float(np.sum(p[mask] * np.log(p[mask] / np.maximum(q[mask], 1e-12))))
         if kl < best_kl:
             best_kl, best_t = kl, t
-    # clipping below the bulk of the distribution is never right — keep at
-    # least the 99th percentile of |x| representable
-    best_t = max(best_t, float(np.percentile(arr, 99.0)))
+    if min_percentile is not None:
+        best_t = max(best_t, float(np.percentile(arr, min_percentile)))
     return -best_t, best_t
 
 
@@ -254,7 +260,8 @@ def quantize_graph(sym, arg_params, excluded_sym_names=(),
 
 
 def _collect_calib_ranges(sym, arg_params, aux_params, data_names,
-                          calib_data, num_calib_examples, mode):
+                          calib_data, num_calib_examples, mode,
+                          min_percentile=None):
     """Run the FLOAT graph over calibration batches, recording each
     quantizable node's input range (reference calibration pass)."""
     import mxnet_tpu as mx
@@ -307,7 +314,8 @@ def _collect_calib_ranges(sym, arg_params, aux_params, data_names,
     for n in names:
         if mode == "entropy":
             ranges[n] = calib_entropy(np.concatenate(samples[n])
-                                      if samples[n] else np.zeros(1))
+                                      if samples[n] else np.zeros(1),
+                                      min_percentile=min_percentile)
         else:
             ranges[n] = minmax[n]
     return ranges
@@ -393,12 +401,19 @@ def quantized_resnet_bench(net, x, steps=20):
 
 def quantize_model(sym, arg_params, aux_params, data_names=("data",),
                    excluded_sym_names=(), calib_mode="none", calib_data=None,
-                   num_calib_examples=None, quantized_dtype="int8", **kwargs):
+                   num_calib_examples=None, quantized_dtype="int8",
+                   calib_min_percentile=99.0, **kwargs):
     """Driver with the reference signature
     (contrib/quantization.py:quantize_model): rewrites conv/FC into int8
     islands via :func:`quantize_graph`. calib_mode 'none' quantizes
     activations from runtime min/max; 'naive' (min/max over calib_data) and
-    'entropy' (KL threshold) bake calibrated constant ranges in."""
+    'entropy' (KL threshold) bake calibrated constant ranges in.
+
+    ``calib_min_percentile`` (framework extension, NOT in the reference):
+    floors the entropy-calibrated threshold at that percentile of |x| so a
+    noisy small-sample KL search cannot clip below the bulk of the
+    distribution. Default 99.0; pass None for bit-faithful reference
+    calibration (ranges then match reference-calibrated models)."""
     if quantized_dtype not in ("int8", "auto"):
         raise MXNetError(f"unsupported quantized_dtype {quantized_dtype!r}")
     calib_ranges = {}
@@ -407,7 +422,8 @@ def quantize_model(sym, arg_params, aux_params, data_names=("data",),
             raise MXNetError(f"calib_mode={calib_mode!r} requires calib_data")
         calib_ranges = _collect_calib_ranges(
             sym, arg_params, aux_params, data_names, calib_data,
-            num_calib_examples, calib_mode)
+            num_calib_examples, calib_mode,
+            min_percentile=calib_min_percentile)
     elif calib_mode != "none":
         raise MXNetError(f"unknown calib_mode {calib_mode!r}")
     qsym, extra = quantize_graph(sym, arg_params,

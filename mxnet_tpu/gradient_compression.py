@@ -17,7 +17,8 @@ dequantize are single fused XLA computations (compare/select + shift/or
 reductions), jitted once per gradient shape. Compression factor 16 vs fp32
 (``GetCompressionFactor``, gradient_compression.cc:86-91).
 
-The wire payload is ``uint8[ceil(n/4)]`` + the float threshold carried in
+The wire payload is ``uint8[4*ceil(n/16)]`` (2-bit codes padded to whole
+float32 words, the reference's allocation unit) + the float threshold carried in
 band by the kvstore, exactly the reference server protocol.
 """
 from __future__ import annotations
@@ -43,7 +44,10 @@ def _quantize_2bit(grad, residual, *, threshold: float):
     new_res = res - jnp.where(pos, threshold, 0.0) + jnp.where(neg, threshold,
                                                                0.0)
     n = codes.size
-    pad = (-n) % 4
+    # pad to 16-element granularity: the reference allocates ceil(n/16)
+    # float32 WORDS (GetCompressedSize), i.e. 4*ceil(n/16) bytes — matching
+    # the padded byte count keeps payload lengths wire-identical for ALL n
+    pad = (-n) % 16
     codes = jnp.concatenate([codes.ravel(),
                              jnp.zeros((pad,), jnp.uint8)]).reshape(-1, 4)
     packed = ((codes[:, 0] << 6) | (codes[:, 1] << 4) |
@@ -92,9 +96,10 @@ class GradientCompression:
         return out if isinstance(shape, int) else out.reshape(shape)
 
     def compressed_size(self, original_size: int) -> int:
-        """Bytes on the wire for ``original_size`` float32 elements
-        (GetCompressedSize, gradient_compression.cc:93-98)."""
-        return (original_size + 3) // 4
+        """Bytes on the wire for ``original_size`` float32 elements:
+        4*ceil(n/16), matching the reference's ceil(n/16) float32-word
+        allocation (GetCompressedSize, gradient_compression.cc:93-98)."""
+        return 4 * ((original_size + 15) // 16)
 
     def get_compression_factor(self) -> int:
         return 16

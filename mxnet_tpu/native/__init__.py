@@ -358,3 +358,32 @@ class NativeRecordReader:
             self.close()
         except Exception:
             pass
+
+
+# ---------------------------------------------------------------- predict ABI
+_PREDICT_SO = os.path.join(_HERE, "libmxtpu_predict.so")
+
+
+def build_predict_lib(root: str | None = None) -> str | None:
+    """Build libmxtpu_predict.so from c_predict_api.cc (lazily, like the
+    main native lib — the binary is never committed; see ADVICE r2). Returns
+    the path, or None if the toolchain cannot build it."""
+    import sys
+    src = os.path.join(_HERE, "c_predict_api.cc")
+    if (os.path.exists(_PREDICT_SO)
+            and os.path.getmtime(_PREDICT_SO) >= os.path.getmtime(src)):
+        return _PREDICT_SO
+    root = root or os.path.dirname(os.path.dirname(_HERE))
+    try:
+        inc = subprocess.run(["python3-config", "--includes"],
+                             capture_output=True, text=True,
+                             timeout=30).stdout.split()
+        r = subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-o", _PREDICT_SO, src, *inc,
+             f'-DMXTPU_DEFAULT_ROOT="{root}"',
+             "-L/usr/local/lib",
+             f"-lpython3.{sys.version_info[1]}", "-ldl"],
+            capture_output=True, text=True, timeout=180)
+        return _PREDICT_SO if r.returncode == 0 else None
+    except Exception:
+        return None

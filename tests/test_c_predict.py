@@ -20,17 +20,8 @@ SRC = os.path.join(ROOT, "mxnet_tpu", "native", "c_predict_api.cc")
 
 
 def _build_so():
-    if (os.path.exists(SO)
-            and os.path.getmtime(SO) >= os.path.getmtime(SRC)):
-        return True
-    inc = subprocess.run(["python3-config", "--includes"],
-                         capture_output=True, text=True).stdout.split()
-    r = subprocess.run(
-        ["g++", "-O2", "-shared", "-fPIC", "-o", SO, SRC, *inc,
-         f'-DMXTPU_DEFAULT_ROOT="{ROOT}"',
-         "-L/usr/local/lib", f"-lpython3.{sys.version_info[1]}", "-ldl"],
-        capture_output=True, text=True)
-    return r.returncode == 0
+    from mxnet_tpu.native import build_predict_lib
+    return build_predict_lib(ROOT) is not None
 
 
 def _export_model(tmp_path):

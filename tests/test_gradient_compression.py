@@ -16,11 +16,13 @@ def test_codec_known_values():
     res = np.zeros(5, "float32")
     packed, new_res = gc.quantize(grad, res)
     packed = np.asarray(packed)
-    # 5 values -> 2 bytes; first byte holds v0..v3 MSB-first:
+    # 5 values -> one float32 word = 4 bytes (reference GetCompressedSize
+    # allocates ceil(n/16) words); first byte holds v0..v3 MSB-first:
     # v0=+t (11), v1=-t (10), v2=0 (00), v3=0 (00) -> 0b11100000 = 0xe0
-    # v4=+t (11) in byte 1's top bits -> 0xc0
-    assert packed.dtype == np.uint8 and packed.shape == (2,)
+    # v4=+t (11) in byte 1's top bits -> 0xc0; bytes 2-3 are zero padding
+    assert packed.dtype == np.uint8 and packed.shape == (4,)
     assert packed[0] == 0xE0 and packed[1] == 0xC0
+    assert packed[2] == 0 and packed[3] == 0
     out = np.asarray(gc.dequantize(packed, (5,)))
     np.testing.assert_allclose(out, [0.5, -0.5, 0.0, 0.0, 0.5])
     # residual = grad - emitted
@@ -44,9 +46,10 @@ def test_error_feedback_accumulates():
 
 def test_codec_roundtrip_random(rng):
     gc = GradientCompression({"type": "2bit", "threshold": 0.25})
-    g = rng.randn(257).astype("float32")  # non-multiple of 4 exercises pad
+    g = rng.randn(257).astype("float32")  # non-multiple of 16 exercises pad
     packed, res = gc.quantize(g, np.zeros(257, "float32"))
-    assert np.asarray(packed).shape == (gc.compressed_size(257),) == (65,)
+    # 4 * ceil(257/16) = 68 bytes, the reference's word-granular allocation
+    assert np.asarray(packed).shape == (gc.compressed_size(257),) == (68,)
     out = np.asarray(gc.dequantize(packed, (257,)))
     assert set(np.unique(out)).issubset({-0.25, 0.0, 0.25})
     # reconstruction + residual == original gradient (exact identity)
