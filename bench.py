@@ -87,6 +87,17 @@ def _write_cache(result):
 # parent can fall back without burning its budget.
 # --------------------------------------------------------------------------
 def run_bench():
+    import atexit
+
+    def _cleanup_pidfile():
+        try:
+            with open("/tmp/mxtpu_bench_child.pid") as f:
+                if int(f.read().strip()) == os.getpid():
+                    os.unlink("/tmp/mxtpu_bench_child.pid")
+        except Exception:
+            pass
+
+    atexit.register(_cleanup_pidfile)
     soft_deadline = float(os.environ.get("BENCH_CHILD_DEADLINE", 0)) or None
 
     def time_left():
@@ -133,15 +144,22 @@ def run_bench():
     steps = int(os.environ.get("BENCH_STEPS", 30 if on_accel else 3))
     warmup = int(os.environ.get("BENCH_WARMUP", 5 if on_accel else 1))
 
+    # channel-last is the TPU-preferred layout (convs lower to the MXU
+    # without layout transposes); overridable for A/B via BENCH_LAYOUT
+    layout = os.environ.get("BENCH_LAYOUT", "NHWC" if on_accel else "NCHW")
+
     np.random.seed(0)
-    net = vision.resnet50_v1(classes=1000)
+    mx.random.seed(0)   # initializers draw from the framework host stream
+    net = vision.resnet50_v1(classes=1000, layout=layout)
     net.initialize(mx.init.Xavier())
     loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
     trainer = parallel.DataParallelTrainer(
         net, loss_fn, "sgd", {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4},
         compute_dtype="bfloat16" if on_accel else None)
 
-    x = np.random.uniform(-1, 1, (batch, 3, image, image)).astype("float32")
+    shape = (batch, image, image, 3) if layout == "NHWC" \
+        else (batch, 3, image, image)
+    x = np.random.uniform(-1, 1, shape).astype("float32")
     y = np.random.randint(0, 1000, (batch,)).astype("float32")
 
     # pre-stage the synthetic batch on device BEFORE warmup (reference
@@ -177,7 +195,7 @@ def run_bench():
         "value": round(per_chip, 2),
         "unit": "img/s/chip",
         "vs_baseline": round(per_chip / BASELINE_IMG_S, 3),
-        "batch": batch, "image": image, "steps": steps,
+        "batch": batch, "image": image, "steps": steps, "layout": layout,
         "n_chips": n_chips, "device_kind": device_kind,
         "platform": devices[0].platform,
     }
@@ -315,10 +333,19 @@ def main():
     try:
         with open(pidfile) as f:
             pid = int(f.read().strip())
-        os.kill(pid, 0)  # raises if gone
-        orphan = pid
+        # guard against PID recycling: only a live process whose cmdline is
+        # actually this script's --run child counts as an orphan
+        with open("/proc/%d/cmdline" % pid, "rb") as f:
+            cmd = f.read().decode(errors="replace")
+        if "bench.py" in cmd and "--run" in cmd:
+            orphan = pid
+        else:
+            os.unlink(pidfile)
     except Exception:
-        pass
+        try:
+            os.unlink(pidfile)
+        except OSError:
+            pass
     live = None
     if orphan is not None:
         # a previous run's TPU child still holds the single-client tunnel;
@@ -390,16 +417,21 @@ def main():
         emit_final()
         return
 
-    # 3. CPU fallback — tiny shapes, never touches the tunnel, safe to kill.
+    # 3. CPU fallback — tiny shapes, safe to kill BECAUSE the axon plugin
+    #    is stripped from its environment: JAX_PLATFORMS=cpu alone does NOT
+    #    stop the plugin (loaded via PYTHONPATH) from opening the tunnel.
     remaining = deadline - time.time()
     if remaining > 30:
+        cpu_env = dict(os.environ, BENCH_FORCE_CPU="1", JAX_PLATFORMS="cpu",
+                       BENCH_BATCH="8", BENCH_IMAGE="64", BENCH_STEPS="3",
+                       BENCH_WARMUP="1", BENCH_INT8="0")
+        cpu_env["PYTHONPATH"] = os.pathsep.join(
+            p for p in cpu_env.get("PYTHONPATH", "").split(os.pathsep)
+            if p and "axon" not in p)
         try:
             proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__), "--run"],
-                env=dict(os.environ, BENCH_FORCE_CPU="1", JAX_PLATFORMS="cpu",
-                         BENCH_BATCH="8", BENCH_IMAGE="64", BENCH_STEPS="3",
-                         BENCH_WARMUP="1", BENCH_INT8="0"),
-                capture_output=True, text=True,
+                env=cpu_env, capture_output=True, text=True,
                 timeout=max(30.0, remaining - 10))
             lines = _metric_lines(proc.stdout)
             if lines:
