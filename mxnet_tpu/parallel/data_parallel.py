@@ -91,9 +91,14 @@ class DataParallelTrainer:
 
     def __init__(self, net, loss, optimizer="sgd", optimizer_params=None,
                  mesh: Optional[Mesh] = None, data_axis: str = "dp",
-                 compute_dtype=None, donate: bool = True):
+                 compute_dtype=None, donate: bool = True, kvstore=None):
         self._net = net
         self._loss_block = loss
+        if mesh is None and kvstore is not None:
+            # hybrid mode: the jitted step spans only THIS process's devices
+            # (the kvstore is the cross-process channel), so the mesh must
+            # be local — a global mesh would make XLA itself the channel
+            mesh = local_mesh(data_axis, devices=jax.local_devices())
         self._mesh = mesh or local_mesh(data_axis)
         self._axis = data_axis
         self._compute_dtype = (jnp.dtype(compute_dtype)
@@ -107,6 +112,16 @@ class DataParallelTrainer:
         self._opt_state = None
         self._rng_counter = 0
         self._donate = donate
+        # hybrid multi-host mode (reference dist_sync_device: fast intra-node
+        # reduce + PS inter-node): the fused step computes LOCAL grads over
+        # this process's mesh, the kvstore moves them across processes
+        # (optionally 2-bit-compressed on the wire), a second jitted program
+        # applies the optimizer. kvstore=None keeps the fully-fused
+        # single-program path where XLA's allreduce spans the whole mesh.
+        self._kv = kvstore
+        self._kv_inited = False
+        self._grad_fn = None
+        self._apply_fn = None
 
     # ------------------------------------------------------------- capture
     def _capture(self, n_inputs: int, sample_arrays=None):
@@ -193,6 +208,48 @@ class DataParallelTrainer:
                                 donate_argnums=donate)
         self._n_inputs = n_inputs
 
+        if self._kv is not None:
+            def grad_step(params, aux, rng, *data):
+                inputs = dict(aux)
+                for name, x in zip(data_names, data):
+                    inputs[name] = x.astype(cdtype) if (
+                        cdtype is not None
+                        and jnp.issubdtype(x.dtype, jnp.floating)
+                        and name != "__label") else x
+
+                def loss_of(p):
+                    ins = dict(inputs)
+                    if cdtype is not None:
+                        ins.update({k: v.astype(cdtype)
+                                    for k, v in p.items()})
+                    else:
+                        ins.update(p)
+                    outs, aux_updates = raw_fn(ins, rng)
+                    return jnp.mean(outs[0].astype(jnp.float32)), aux_updates
+
+                (loss, aux_updates), grads = jax.value_and_grad(
+                    loss_of, has_aux=True)(params)
+                grads = {k: g.astype(jnp.float32) for k, g in grads.items()}
+                new_aux = dict(aux)
+                for k, v in aux_updates.items():
+                    if k in new_aux:
+                        new_aux[k] = v.astype(new_aux[k].dtype)
+                return grads, new_aux, loss
+
+            def apply_step(params, opt_state, grads):
+                updates, opt_state = tx.update(grads, opt_state, params)
+                import optax
+                return optax.apply_updates(params, updates), opt_state
+
+            gspec = jax.tree_util.tree_map(lambda _: repl, self._params)
+            self._grad_fn = jax.jit(
+                grad_step,
+                in_shardings=(gspec, {k: repl for k in self._aux}, repl)
+                + tuple(dataspec for _ in data_names),
+                out_shardings=(gspec, {k: repl for k in self._aux}, repl))
+            self._apply_fn = jax.jit(
+                apply_step, donate_argnums=(0, 1) if self._donate else ())
+
     # ------------------------------------------------------------- stepping
     def step(self, *data) -> float:
         """One fused fwd+bwd+allreduce+update step on a global batch.
@@ -207,8 +264,32 @@ class DataParallelTrainer:
         rng = jax.random.fold_in(jax.random.PRNGKey(_random.current_seed()),
                                  self._rng_counter)
         self._rng_counter += 1
+        if self._kv is not None:
+            return self._kv_step(rng, arrays)
         self._params, self._aux, self._opt_state, loss = self._step_fn(
             self._params, self._aux, self._opt_state, rng, *arrays)
+        return loss
+
+    def _kv_step(self, rng, arrays):
+        """Grad -> kvstore wire sync (summed across workers; 2-bit codec if
+        active) -> jitted optimizer apply."""
+        grads, self._aux, loss = self._grad_fn(
+            self._params, self._aux, rng, *arrays)
+        kv = self._kv
+        if not self._kv_inited:
+            for n in self._param_names:
+                kv.init("dpt_grad_" + n, _wrap(jnp.zeros_like(grads[n])))
+            self._kv_inited = True
+        for i, n in enumerate(self._param_names):
+            kv.push("dpt_grad_" + n, _wrap(grads[n]), priority=-i)
+        nworkers = max(1, getattr(kv, "num_workers", 1))
+        synced = {}
+        for n in self._param_names:
+            out = _wrap(grads[n])
+            kv.pull("dpt_grad_" + n, out=out)
+            synced[n] = out._data / nworkers
+        self._params, self._opt_state = self._apply_fn(
+            self._params, self._opt_state, synced)
         return loss
 
     def sync_to_net(self) -> None:

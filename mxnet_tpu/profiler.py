@@ -73,7 +73,15 @@ def start():
         _prof.events = []
     if _prof.xla_trace_dir:
         import jax
-        jax.profiler.start_trace(_prof.xla_trace_dir)
+        try:
+            # device/XLA lanes only — the python tracer adds tens of
+            # thousands of interpreter-frame events we don't want merged
+            opts = jax.profiler.ProfileOptions()
+            opts.python_tracer_level = 0
+            jax.profiler.start_trace(_prof.xla_trace_dir,
+                                     profiler_options=opts)
+        except Exception:
+            jax.profiler.start_trace(_prof.xla_trace_dir)
 
 
 def stop():
@@ -85,6 +93,57 @@ def stop():
             jax.profiler.stop_trace()
         except Exception:
             pass
+        n = _merge_xla_trace(_prof.xla_trace_dir)
+        if n:
+            record_event("xla_device_trace_merged", "profiler", _prof.us(),
+                         0.0, {"events": n})
+
+
+def _merge_xla_trace(trace_dir: str) -> int:
+    """Fold the XLA profiler's own chrome trace (device lanes: per-op XLA
+    timings, TPU steps) into our event list so ``dump()`` emits ONE trace
+    with host + device rows — the reference's engine ``opr_profile`` gives
+    the same merged view (src/profiler/profiler.h:556).
+
+    jax.profiler.stop_trace writes plugins/profile/<run>/<host>.trace.json.gz
+    (TensorBoard layout); we take the newest run, shift its timestamps to
+    this profiler's zero, and keep its pid/tid lane metadata."""
+    import glob
+    import gzip
+    paths = glob.glob(os.path.join(trace_dir, "plugins", "profile", "*",
+                                   "*.trace.json.gz"))
+    if not paths:
+        return 0
+    latest = max(paths, key=os.path.getmtime)
+    try:
+        with gzip.open(latest, "rt") as f:
+            data = json.load(f)
+    except Exception:
+        return 0
+    evs = data.get("traceEvents") or []
+    stamped = [e for e in evs if isinstance(e.get("ts"), (int, float))
+               and e.get("ph") != "M"]
+    if not stamped:
+        return 0
+    t_min = min(e["ts"] for e in stamped)
+    merged = 0
+    with _lock:
+        for e in evs:
+            e = dict(e)
+            if str(e.get("name", "")).startswith("$"):
+                continue        # python-tracer interpreter frames
+            # device lanes keep their own pid; offset into our pid space so
+            # they can never collide with the host process row
+            if isinstance(e.get("pid"), int):
+                e["pid"] = e["pid"] + (1 << 20)
+            if isinstance(e.get("ts"), (int, float)) and e.get("ph") != "M":
+                e["ts"] = e["ts"] - t_min
+            e.setdefault("args", {})
+            if e.get("ph") != "M":
+                e["args"]["lane"] = "xla-device"
+            _prof.events.append(e)
+            merged += 1
+    return merged
 
 
 def pause(profile_process="worker"):

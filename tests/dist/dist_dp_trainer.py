@@ -1,0 +1,118 @@
+"""2-process x 4-virtual-device DataParallelTrainer over dist_sync with
+2-bit gradient compression ACTIVE on the wire (VERDICT r2 #8; reference
+nightly dist_sync_kvstore.py gluon-trainer variant + gradient_compression).
+
+Each process runs the fused SPMD grad step over its own 4-device CPU mesh;
+gradients cross processes through KVStoreDist where they are 2-bit
+quantized (error feedback) before the wire. Rank 0 then REPLAYS the exact
+same math single-process — two half-batch grad computations, each quantized
+against its own residual stream, decoded, summed, averaged, SGD-applied —
+and asserts the distributed parameters match the replay to float tolerance.
+That checks the whole chain end-to-end: local mesh reduce, wire codec,
+cross-process sum, optimizer apply.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = ("--xla_force_host_platform_device_count=4 "
+                           + os.environ.get("XLA_FLAGS", ""))
+os.environ.pop("PYTHONPATH", None)
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, gluon, nd, parallel  # noqa: E402
+from mxnet_tpu.gradient_compression import GradientCompression  # noqa: E402
+
+STEPS = 4
+LR = 0.1
+THRESH = 0.05
+GLOBAL = 16
+
+
+def build_net():
+    mx.random.seed(11)
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(16, activation="relu"))
+    net.add(gluon.nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    return net
+
+
+def main():
+    import jax
+
+    rng = np.random.RandomState(5)
+    full_x = rng.randn(GLOBAL, 6).astype(np.float32)
+    full_y = (rng.rand(GLOBAL) * 4).astype(np.float32)
+
+    kv = mx.kv.create("dist_sync")
+    kv.set_gradient_compression({"type": "2bit", "threshold": THRESH})
+    nw, rank = kv.num_workers, kv.rank
+    assert len(jax.local_devices()) == 4, jax.local_devices()
+    shard = GLOBAL // nw
+
+    net = build_net()
+    net(nd.array(full_x))                      # materialize params
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    dpt = parallel.DataParallelTrainer(net, loss_fn, "sgd",
+                                       {"learning_rate": LR}, kvstore=kv)
+    x = full_x[rank * shard:(rank + 1) * shard]
+    y = full_y[rank * shard:(rank + 1) * shard]
+    for _ in range(STEPS):
+        loss = dpt.step(x, y)
+    float(loss)
+    dist_params = {n: np.asarray(v) for n, v in dpt._params.items()}
+
+    kv.barrier()
+    if rank == 0:
+        # ---- single-process replay of the exact distributed math --------
+        ref = build_net()                      # same seed -> same init
+        ref(nd.array(full_x))
+        pnames = list(dpt._param_names)
+        # layer name counters are process-global, so the replay net's param
+        # names differ by prefix — pair by position in collect_params order
+        dist_order = [p.name for p in net.collect_params().values()]
+        ref_order = list(ref.collect_params().values())
+        pmap = {dn: ref_order[dist_order.index(dn)] for dn in pnames}
+        gcs = [GradientCompression({"type": "2bit", "threshold": THRESH})
+               for _ in range(nw)]
+        residuals = [{} for _ in range(nw)]
+        velocity = {n: 0.0 for n in pnames}
+
+        for _ in range(STEPS):
+            summed = {n: 0.0 for n in pnames}
+            for w in range(nw):
+                xs = nd.array(full_x[w * shard:(w + 1) * shard])
+                ys = nd.array(full_y[w * shard:(w + 1) * shard])
+                with autograd.record():
+                    L = loss_fn(ref(xs), ys).mean()
+                grads = autograd.grad(L, [pmap[n].data() for n in pnames],
+                                      retain_graph=False)
+                for n, g in zip(pnames, grads):
+                    gnp = g.asnumpy()
+                    res = residuals[w].get(n, np.zeros_like(gnp))
+                    packed, res = gcs[w].quantize(gnp, res)
+                    residuals[w][n] = np.asarray(res)
+                    deq = np.asarray(
+                        gcs[w].dequantize(packed, gnp.shape))
+                    summed[n] = summed[n] + deq
+            for n in pnames:
+                g = summed[n] / nw
+                p = pmap[n]
+                p.set_data(nd.array(p.data().asnumpy() - LR * g))
+
+        for n in pnames:
+            want = pmap[n].data().asnumpy()
+            got = dist_params[n]
+            np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5,
+                                       err_msg=f"param {n} diverged")
+        print("dp_trainer compressed parity OK", flush=True)
+    kv.barrier()
+    print(f"worker {rank}/{nw}: dp_trainer done", flush=True)
+
+
+if __name__ == "__main__":
+    main()

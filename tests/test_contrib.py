@@ -185,3 +185,25 @@ def test_naive_engine_mode(rng):
         y = nd.dot(x, x)  # blocks internally
     assert not engine.is_naive()
     engine.wait_all()
+
+
+def test_profiler_merges_xla_device_lanes(tmp_path, rng):
+    """One dump() shows host rows AND the XLA device lanes of a jitted step
+    (reference engine opr_profile view, profiler.h:556; VERDICT r2 #9)."""
+    import json as _json
+    from mxnet_tpu import profiler
+    f = str(tmp_path / "prof.json")
+    profiler.set_config(profile_all=True, filename=f,
+                        xla_trace_dir=str(tmp_path / "xla"))
+    profiler.start()
+    a = nd.array(rng.randn(64, 64).astype("f4"))
+    b = nd.dot(a, a)
+    b.wait_to_read()
+    profiler.stop()
+    profiler.dump()
+    evs = _json.load(open(f))["traceEvents"]
+    dev = [e for e in evs if e.get("args", {}).get("lane") == "xla-device"]
+    host = [e for e in evs if "lane" not in e.get("args", {})]
+    assert dev and host
+    # interpreter-frame noise is filtered out
+    assert not any(str(e.get("name", "")).startswith("$") for e in dev)

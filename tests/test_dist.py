@@ -48,3 +48,14 @@ def test_dist_trainer_convergence_parity():
     r = _launch(2, os.path.join(ROOT, "tests", "dist", "dist_trainer.py"))
     assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
     assert "parity OK" in r.stdout
+
+
+def test_dist_dp_trainer_compressed_parity():
+    """2 procs x 4 virtual devices: fused DataParallelTrainer grads cross
+    the wire through KVStoreDist with 2-bit compression; rank 0 replays the
+    identical math single-process and asserts parameter parity
+    (VERDICT r2 #8)."""
+    r = _launch(2, os.path.join(ROOT, "tests", "dist", "dist_dp_trainer.py"),
+                timeout=600)
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+    assert "dp_trainer compressed parity OK" in r.stdout

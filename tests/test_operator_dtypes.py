@@ -1,0 +1,255 @@
+"""Dtype × edge-shape operator matrix (VERDICT r2 #4).
+
+Reference model: ``tests/python/unittest/test_operator.py`` runs each op
+across dtypes with tolerance-by-dtype (``python/mxnet/test_utils.py``
+check_consistency), plus zero-size / broadcast-corner / high-rank shapes.
+
+Three tiers here:
+1. dtype sweep — each op runs at fp16/bf16, PRESERVES the input dtype
+   (mxnet convention: out dtype == in dtype), and tracks its own fp32
+   result within a dtype-scaled tolerance.
+2. edge shapes — zero-size axes, size-1 broadcast corners, rank-1 and
+   rank-5 operands: result shapes must match numpy semantics exactly.
+3. dtype gradients — autograd grads of FC/conv/BN at bf16 vs fp32.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+
+try:
+    import ml_dtypes
+    _BF16 = np.dtype(ml_dtypes.bfloat16)
+except ImportError:                     # pragma: no cover
+    _BF16 = None
+
+_TOL = {"float16": (2e-2, 2e-3), "bfloat16": (6e-2, 6e-3),
+        "float32": (1e-5, 1e-6)}
+
+
+def _np_dtype(name):
+    return _BF16 if name == "bfloat16" else np.dtype(name)
+
+
+def _run(fn, *arrs, **kw):
+    out = fn(*[nd.array(a) for a in arrs], **kw)
+    if isinstance(out, (list, tuple)):
+        out = out[0]
+    return out
+
+
+# (id, fn, arg shapes, kwargs, positive_only)
+_UNARY = [
+    ("relu", nd.relu, False), ("sigmoid", nd.sigmoid, False),
+    ("tanh", nd.tanh, False), ("exp", nd.exp, False),
+    ("log", nd.log, True), ("sqrt", nd.sqrt, True),
+    ("rsqrt", nd.rsqrt, True), ("square", nd.square, False),
+    ("abs", nd.abs, False), ("negative", nd.negative, False),
+    ("floor", nd.floor, False), ("ceil", nd.ceil, False),
+    ("sin", nd.sin, False), ("cos", nd.cos, False),
+    ("softsign", nd.softsign, False), ("erf", nd.erf, False),
+    ("gamma", nd.gamma, True), ("expm1", nd.expm1, False),
+    ("log1p", nd.log1p, True), ("cbrt", nd.cbrt, True),
+]
+
+_BINARY = [
+    ("add", lambda a, b: a + b), ("sub", lambda a, b: a - b),
+    ("mul", lambda a, b: a * b), ("div", lambda a, b: a / (b + 2.0)),
+    ("max", nd.broadcast_maximum), ("min", nd.broadcast_minimum),
+    ("hypot", nd.broadcast_hypot), ("broadcast_power",
+                          lambda a, b: nd.broadcast_power(nd.abs(a) + 0.5, b)),
+]
+
+_DTYPES = ["float16", "bfloat16"]
+
+
+@pytest.mark.parametrize("dtype", _DTYPES)
+@pytest.mark.parametrize("name,fn,pos", _UNARY, ids=[u[0] for u in _UNARY])
+def test_unary_dtype_matrix(rng, name, fn, pos, dtype):
+    x32 = rng.uniform(0.3 if pos else -1.0, 1.5, (3, 4)).astype("float32")
+    ref = _run(fn, x32).asnumpy().astype("float64")
+    xlo = x32.astype(_np_dtype(dtype))
+    out = _run(fn, xlo)
+    assert str(out.dtype) == dtype, f"{name}: dtype {out.dtype} != {dtype}"
+    rtol, atol = _TOL[dtype]
+    np.testing.assert_allclose(out.asnumpy().astype("float64"), ref,
+                               rtol=rtol, atol=atol)
+
+
+@pytest.mark.parametrize("dtype", _DTYPES)
+@pytest.mark.parametrize("name,fn", _BINARY, ids=[b[0] for b in _BINARY])
+def test_binary_dtype_matrix(rng, name, fn, dtype):
+    a32 = rng.uniform(-1, 1, (3, 4)).astype("float32")
+    b32 = rng.uniform(-1, 1, (3, 4)).astype("float32")
+    ref = _run(fn, a32, b32).asnumpy().astype("float64")
+    out = _run(fn, a32.astype(_np_dtype(dtype)), b32.astype(_np_dtype(dtype)))
+    assert str(out.dtype) == dtype
+    rtol, atol = _TOL[dtype]
+    np.testing.assert_allclose(out.asnumpy().astype("float64"), ref,
+                               rtol=rtol, atol=atol)
+
+
+@pytest.mark.parametrize("dtype", _DTYPES + ["float32"])
+def test_fc_conv_bn_softmax_dtype(rng, dtype):
+    """The MXU quartet at every compute dtype."""
+    npdt = _np_dtype(dtype)
+    rtol, atol = _TOL[dtype]
+    x = rng.uniform(-1, 1, (2, 3, 8, 8)).astype("float32")
+    w = rng.uniform(-0.5, 0.5, (4, 3, 3, 3)).astype("float32")
+    ref = nd.Convolution(nd.array(x), nd.array(w), kernel=(3, 3),
+                         num_filter=4, no_bias=True).asnumpy()
+    out = nd.Convolution(nd.array(x.astype(npdt)), nd.array(w.astype(npdt)),
+                         kernel=(3, 3), num_filter=4, no_bias=True)
+    assert str(out.dtype) == dtype
+    np.testing.assert_allclose(out.asnumpy().astype("float64"),
+                               ref.astype("float64"), rtol=rtol,
+                               atol=atol * 30)
+
+    xf = rng.uniform(-1, 1, (4, 6)).astype("float32")
+    wf = rng.uniform(-1, 1, (5, 6)).astype("float32")
+    bf = rng.uniform(-1, 1, (5,)).astype("float32")
+    ref = nd.FullyConnected(nd.array(xf), nd.array(wf), nd.array(bf),
+                            num_hidden=5).asnumpy()
+    out = nd.FullyConnected(nd.array(xf.astype(npdt)),
+                            nd.array(wf.astype(npdt)),
+                            nd.array(bf.astype(npdt)), num_hidden=5)
+    assert str(out.dtype) == dtype
+    np.testing.assert_allclose(out.asnumpy().astype("float64"),
+                               ref.astype("float64"), rtol=rtol,
+                               atol=atol * 10)
+
+    sm_ref = nd.softmax(nd.array(xf)).asnumpy()
+    sm = nd.softmax(nd.array(xf.astype(npdt)))
+    assert str(sm.dtype) == dtype
+    np.testing.assert_allclose(sm.asnumpy().astype("float64"),
+                               sm_ref.astype("float64"), rtol=rtol, atol=atol)
+
+
+# ---------------------------------------------------------------------------
+# edge shapes
+# ---------------------------------------------------------------------------
+
+_ZERO_SHAPES = [(0,), (0, 3), (3, 0), (2, 0, 4)]
+
+
+@pytest.mark.parametrize("shape", _ZERO_SHAPES, ids=str)
+def test_zero_size_unary_and_reduce(shape):
+    x = np.zeros(shape, "float32")
+    for fn in (nd.relu, nd.exp, nd.negative):
+        out = fn(nd.array(x))
+        assert out.shape == shape
+    s = nd.sum(nd.array(x))
+    assert float(s.asnumpy()) == 0.0
+    # axis-reduce of a zero axis keeps numpy semantics
+    if len(shape) >= 2:
+        r = nd.sum(nd.array(x), axis=0)
+        assert r.shape == tuple(np.sum(x, axis=0).shape)
+
+
+def test_zero_size_binary_and_concat():
+    a = np.zeros((0, 3), "float32")
+    b = np.ones((2, 3), "float32")
+    out = nd.concat(nd.array(a), nd.array(b), dim=0)
+    assert out.shape == (2, 3)
+    add = nd.array(a) + nd.array(a)
+    assert add.shape == (0, 3)
+
+
+def test_zero_batch_fc_and_conv():
+    x = np.zeros((0, 6), "float32")
+    w = np.ones((5, 6), "float32")
+    out = nd.FullyConnected(nd.array(x), nd.array(w), num_hidden=5,
+                            no_bias=True)
+    assert out.shape == (0, 5)
+    xc = np.zeros((0, 3, 8, 8), "float32")
+    wc = np.ones((4, 3, 3, 3), "float32")
+    outc = nd.Convolution(nd.array(xc), nd.array(wc), kernel=(3, 3),
+                          num_filter=4, no_bias=True)
+    assert outc.shape == (0, 4, 6, 6)
+
+
+_BCAST_CASES = [
+    ((1, 3), (3, 1)), ((1,), (4, 1)), ((2, 1, 3), (1, 5, 1)),
+    ((1, 1), (1, 1)), ((2, 1, 1, 1, 2), (1, 3, 1, 4, 1)),
+]
+
+
+@pytest.mark.parametrize("sa,sb", _BCAST_CASES, ids=str)
+def test_broadcast_corners(rng, sa, sb):
+    a = rng.randn(*sa).astype("float32")
+    b = rng.randn(*sb).astype("float32")
+    for fn, npfn in ((lambda x, y: x + y, np.add),
+                     (lambda x, y: x * y, np.multiply),
+                     (nd.broadcast_maximum, np.maximum)):
+        out = fn(nd.array(a), nd.array(b))
+        want = npfn(a, b)
+        assert out.shape == want.shape
+        np.testing.assert_allclose(out.asnumpy(), want, rtol=1e-6)
+
+
+def test_rank1_and_rank5(rng):
+    x1 = rng.randn(7).astype("float32")
+    np.testing.assert_allclose(nd.softmax(nd.array(x1)).asnumpy().sum(), 1.0,
+                               rtol=1e-5)
+    assert nd.sum(nd.array(x1), axis=0).shape == ()
+    x5 = rng.randn(2, 3, 2, 2, 3).astype("float32")
+    out = nd.transpose(nd.array(x5), axes=(4, 0, 2, 1, 3))
+    assert out.shape == (3, 2, 2, 3, 2)
+    np.testing.assert_allclose(out.asnumpy(), x5.transpose(4, 0, 2, 1, 3))
+    r = nd.sum(nd.array(x5), axis=(1, 3))
+    np.testing.assert_allclose(r.asnumpy(), x5.sum(axis=(1, 3)), rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# gradients per dtype
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("dtype", _DTYPES)
+def test_fc_gradient_dtype(rng, dtype):
+    npdt = _np_dtype(dtype)
+    x32 = rng.uniform(-1, 1, (4, 6)).astype("float32")
+    w32 = rng.uniform(-1, 1, (5, 6)).astype("float32")
+
+    def grads(xa, wa):
+        x, w = nd.array(xa), nd.array(wa)
+        x.attach_grad(); w.attach_grad()
+        with autograd.record():
+            y = nd.FullyConnected(x, w, num_hidden=5, no_bias=True).sum()
+        y.backward()
+        return x.grad.asnumpy().astype("float64"), \
+            w.grad.asnumpy().astype("float64")
+
+    gx32, gw32 = grads(x32, w32)
+    gx, gw = grads(x32.astype(npdt), w32.astype(npdt))
+    rtol, atol = _TOL[dtype]
+    np.testing.assert_allclose(gx, gx32, rtol=rtol, atol=atol * 10)
+    np.testing.assert_allclose(gw, gw32, rtol=rtol, atol=atol * 10)
+
+
+@pytest.mark.parametrize("dtype", _DTYPES)
+def test_batchnorm_gradient_dtype(rng, dtype):
+    npdt = _np_dtype(dtype)
+    x32 = rng.uniform(-1, 1, (4, 3, 5, 5)).astype("float32")
+    g32 = np.ones(3, "float32")
+    b32 = np.zeros(3, "float32")
+    mm = np.zeros(3, "float32")
+    mv = np.ones(3, "float32")
+
+    def grad_x(xa):
+        x = nd.array(xa)
+        x.attach_grad()
+        with autograd.record():
+            outs = nd.BatchNorm(x, nd.array(g32.astype(xa.dtype)),
+                                nd.array(b32.astype(xa.dtype)),
+                                nd.array(mm.astype(xa.dtype)),
+                                nd.array(mv.astype(xa.dtype)),
+                                fix_gamma=False)
+            y = (outs[0] if isinstance(outs, (list, tuple)) else outs).sum()
+        y.backward()
+        return x.grad.asnumpy().astype("float64")
+
+    ref = grad_x(x32)
+    got = grad_x(x32.astype(npdt))
+    rtol, atol = _TOL[dtype]
+    np.testing.assert_allclose(got, ref, rtol=rtol * 5, atol=atol * 50)
