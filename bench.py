@@ -290,6 +290,7 @@ def main():
     deadline = time.time() + budget
     best = None          # the line we will print LAST (official result)
     printed_final = []   # guard so the SIGTERM handler prints at most once
+    live_measurements = []  # any live line (even cpu fallback) this run
 
     errors = []
 
@@ -298,6 +299,14 @@ def main():
             return
         printed_final.append(True)
         if best is not None:
+            # machine-consumer honesty: a cache re-print must be flagged as
+            # degraded, not just in the free-form provenance string
+            if (str(best.get("provenance", "")).startswith("cached")
+                    and "degraded" not in best):
+                best["degraded"] = (
+                    "cached-official: live run was only a cpu fallback"
+                    if live_measurements else
+                    "cached-only: no live measurement this run")
             print(json.dumps(best), flush=True)
         else:
             print(json.dumps({
@@ -380,6 +389,7 @@ def main():
             lines = []
         if lines:
             live = lines[-1]
+            live_measurements.append(live)
             if not exited:
                 live["provenance"] = "live (partial: diagnostics still running)"
             else:
@@ -436,6 +446,7 @@ def main():
             lines = _metric_lines(proc.stdout)
             if lines:
                 cpu_line = lines[-1]
+                live_measurements.append(cpu_line)
                 cpu_line["degraded"] = ("cpu-fallback: " +
                                         "; ".join(errors)[:400])
                 cpu_line["provenance"] = "live cpu fallback"

@@ -98,7 +98,7 @@ class _Node:
     """One recorded op application (the AGInfo equivalent)."""
 
     __slots__ = ("vjp_fn", "parents", "parent_slots", "n_outputs", "order",
-                 "op_name", "saved_outputs", "primal", "diff_datas")
+                 "op_name", "saved_outputs", "primal", "diff_datas", "freed")
 
     def __init__(self, vjp_fn, parents, parent_slots, n_outputs, order, op_name):
         self.vjp_fn = vjp_fn
@@ -113,6 +113,7 @@ class _Node:
         # node can be RE-derived inside a recorded call (jax.vjp composes)
         self.primal = None
         self.diff_datas = None
+        self.freed = False      # True once a backward pass released residuals
 
 
 class _Leaf:
@@ -201,6 +202,22 @@ def mark_variables(variables, gradients, grad_reqs="write"):
         v._ag_slot = 0
 
 
+def _check_head_grads(heads, head_grads):
+    """Reject shape-class mismatches the reference catches at the C API
+    boundary (a bare NDArray for a list of heads would otherwise be
+    silently row-sliced by head_grads[i])."""
+    if head_grads is None:
+        return
+    if not isinstance(head_grads, (list, tuple)):
+        raise MXNetError(
+            "head_grads must be None or a list/tuple matching heads; got %s"
+            % type(head_grads).__name__)
+    if len(head_grads) != len(heads):
+        raise MXNetError(
+            "head_grads length %d does not match heads length %d"
+            % (len(head_grads), len(heads)))
+
+
 def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
     """Accumulate gradients of ``heads`` into attached leaf grads
     (reference Imperative::Backward, imperative.cc:278)."""
@@ -209,6 +226,7 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
         heads = [heads]
         if head_grads is not None and not isinstance(head_grads, (list, tuple)):
             head_grads = [head_grads]
+    _check_head_grads(heads, head_grads)
     _backward_impl(heads, head_grads, retain_graph, accumulate_to_leaves=True)
 
 
@@ -227,8 +245,12 @@ def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=Fals
             head_grads = [head_grads]
     if isinstance(variables, NDArray):
         variables = [variables]
+    _check_head_grads(heads, head_grads)
+    if retain_graph is None:
+        retain_graph = create_graph   # reference autograd.grad default
     if create_graph:
-        recs = _backward_create_graph(heads, head_grads, variables)
+        recs = _backward_create_graph(heads, head_grads, variables,
+                                      retain_graph=retain_graph)
         out = []
         for r in recs:
             w = _wrap(r._data)
@@ -280,14 +302,18 @@ def _backward_impl(heads, head_grads, retain_graph, accumulate_to_leaves=True,
 
     leaf_grads: Dict[int, Any] = {}
     for n in order:
-        outs = []
-        missing = True
-        for s in range(n.n_outputs):
-            ct = cotangents.get((id(n), s))
-            if ct is not None:
-                missing = False
-        if missing:
+        if all(cotangents.get((id(n), s)) is None
+               for s in range(n.n_outputs)):
             continue
+        if n.vjp_fn is None:
+            # residuals were released by an earlier backward pass —
+            # reference ThreadedEngine raises the same way on a re-walked
+            # freed graph ("second backward"); never let the None leak as
+            # a TypeError
+            raise MXNetError(
+                f"cannot backward through {n.op_name!r} a second time: its "
+                f"residuals were freed; pass retain_graph=True to the "
+                f"first backward/grad call")
         # build full cotangent tuple for the vjp
         if n.n_outputs == 1:
             ct0 = cotangents.get((id(n), 0))
@@ -311,6 +337,7 @@ def _backward_impl(heads, head_grads, retain_graph, accumulate_to_leaves=True,
             n.vjp_fn = None       # free residuals eagerly
             n.primal = None       # the closure pins all op inputs
             n.diff_datas = None
+            n.freed = True
 
     # head that IS a leaf (x.backward() on a var directly)
     for i, h in enumerate(heads):
@@ -380,7 +407,7 @@ def _racc(a, b):
     return _Rec(out, node, 0)
 
 
-def _backward_create_graph(heads, head_grads, wrt):
+def _backward_create_graph(heads, head_grads, wrt, retain_graph=True):
     """Backward walk that RECORDS the gradient computation. Each node's
     input cotangents are computed by re-deriving its vjp inside a recorded
     call taking (original inputs, output cotangents) — so gradients flow
@@ -427,6 +454,12 @@ def _backward_create_graph(heads, head_grads, wrt):
         if all(c is None for c in cts):
             continue
         if n.primal is None:
+            if n.freed:
+                raise MXNetError(
+                    f"create_graph=True reached {n.op_name!r} whose "
+                    f"residuals were already freed by a previous backward "
+                    f"pass; call the earlier backward/grad with "
+                    f"retain_graph=True to keep the graph alive")
             raise MXNetError(
                 f"create_graph=True cannot differentiate through "
                 f"{n.op_name!r}: its backward is an opaque callback "
@@ -468,6 +501,21 @@ def _backward_create_graph(heads, head_grads, wrt):
             key = id(node.array_ref)
             hg = cotangents[(id(node), getattr(h, "_ag_slot", 0))]
             leaf_grads[key] = _racc(leaf_grads.get(key), hg)
+
+    if not retain_graph:
+        # release residuals of the walked forward nodes AND drop them from
+        # the tape so repeated grad(create_graph=True, retain_graph=False)
+        # calls cannot grow memory without bound; the freshly recorded
+        # _grad_of_* nodes stay alive (they ARE the returned grads)
+        walked = set()
+        for n in order:
+            n.vjp_fn = None
+            n.primal = None
+            n.diff_datas = None
+            n.freed = True
+            walked.add(id(n))
+        st = _st()
+        st.tape = [n for n in st.tape if id(n) not in walked]
 
     out = []
     for v in wrt:

@@ -84,8 +84,9 @@ class GradientCompression:
 
     # ----------------------------------------------------------------- codec
     def quantize(self, grad, residual):
-        """-> (packed uint8[ceil(n/4)], updated residual). Shapes of grad
-        and residual must match; residual starts at zeros."""
+        """-> (packed uint8[4*ceil(n/16)] — 16-element padding granularity,
+        see compressed_nbytes — , updated residual). Shapes of grad and
+        residual must match; residual starts at zeros."""
         return _quantize_2bit(jnp.asarray(grad, jnp.float32),
                               jnp.asarray(residual, jnp.float32),
                               threshold=self.threshold)
@@ -96,10 +97,16 @@ class GradientCompression:
         return out if isinstance(shape, int) else out.reshape(shape)
 
     def compressed_size(self, original_size: int) -> int:
-        """Bytes on the wire for ``original_size`` float32 elements:
-        4*ceil(n/16), matching the reference's ceil(n/16) float32-word
-        allocation (GetCompressedSize, gradient_compression.cc:93-98)."""
-        return 4 * ((original_size + 15) // 16)
+        """float32-WORD count of the compressed buffer for ``original_size``
+        float32 elements: ceil(n/16), unit-for-unit with the reference's
+        GetCompressedSize (gradient_compression.cc:93-98) so offset math
+        ported against that API agrees."""
+        return (original_size + 15) // 16
+
+    def compressed_nbytes(self, original_size: int) -> int:
+        """Bytes on the wire (our packed codec is uint8): 4*ceil(n/16) —
+        same wire size as the reference's float32-word buffer."""
+        return 4 * self.compressed_size(original_size)
 
     def get_compression_factor(self) -> int:
         return 16

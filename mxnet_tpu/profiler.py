@@ -199,7 +199,10 @@ def dumps(reset=False) -> str:
     agg: Dict[str, List[float]] = defaultdict(list)
     with _lock:
         for e in _prof.events:
-            agg[e["name"]].append(e["dur"])
+            name, dur = e.get("name"), e.get("dur")
+            if name is None or dur is None:  # metadata / phase-less rows
+                continue
+            agg[name].append(dur)
         if reset:
             _prof.events = []
     lines = [f"{'Name':<40}{'Calls':>8}{'Total(us)':>14}{'Mean(us)':>12}"]

@@ -49,7 +49,9 @@ def test_codec_roundtrip_random(rng):
     g = rng.randn(257).astype("float32")  # non-multiple of 16 exercises pad
     packed, res = gc.quantize(g, np.zeros(257, "float32"))
     # 4 * ceil(257/16) = 68 bytes, the reference's word-granular allocation
-    assert np.asarray(packed).shape == (gc.compressed_size(257),) == (68,)
+    assert np.asarray(packed).shape == (gc.compressed_nbytes(257),) == (68,)
+    # reference GetCompressedSize parity: float32-word count, not bytes
+    assert gc.compressed_size(257) == 17
     out = np.asarray(gc.dequantize(packed, (257,)))
     assert set(np.unique(out)).issubset({-0.25, 0.0, 0.25})
     # reconstruction + residual == original gradient (exact identity)

@@ -165,3 +165,49 @@ def test_create_graph_through_function_raises():
         y = f(x).sum()
         with pytest.raises(mx.MXNetError):
             autograd.grad(y, x, create_graph=True, retain_graph=True)
+
+
+def test_create_graph_after_freed_graph_says_retain():
+    """A graph freed by a prior backward must be diagnosed as freed (pass
+    retain_graph=True), not blamed on an opaque Function (ADVICE r3)."""
+    x = nd.array(np.array([0.5, 1.5], "float32"))
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+        autograd.grad(y, x, retain_graph=False)   # frees residuals
+        with pytest.raises(mx.MXNetError, match="retain_graph=True"):
+            autograd.grad(y, x, create_graph=True)
+
+
+def test_create_graph_retain_false_frees_residuals():
+    """grad(create_graph=True, retain_graph=False) must release the walked
+    forward nodes (no unbounded tape growth), while the returned grad stays
+    differentiable never having needed the freed nodes again."""
+    x = nd.array(np.array([0.3, 0.9], "float32"))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.sin(x).sum()
+        g1 = autograd.grad(y, x, create_graph=True, retain_graph=False)[0]
+        node = y._ag_node
+        assert node.primal is None and node.freed
+        np.testing.assert_allclose(g1.asnumpy(), np.cos([0.3, 0.9]),
+                                   rtol=1e-4)
+        # the forward residuals are gone, so a second-order grad (which
+        # needs them through the input chain) must fail CLEANLY, telling
+        # the user to retain the graph — not leak a TypeError
+        with pytest.raises(mx.MXNetError, match="retain_graph=True"):
+            autograd.grad(g1.sum(), x, retain_graph=True)
+
+
+def test_head_grads_shape_class_mismatch_raises():
+    x = nd.array(np.ones((3, 2), "float32"))
+    x.attach_grad()
+    with autograd.record():
+        y1 = (x * 2).sum()
+        y2 = (x * 3).sum()
+        with pytest.raises(mx.MXNetError):
+            autograd.grad([y1, y2], x, head_grads=nd.array(
+                np.ones((2,), "float32")))
+        with pytest.raises(mx.MXNetError):
+            autograd.grad([y1, y2], x,
+                          head_grads=[nd.array(np.ones((), "float32"))])
