@@ -26,6 +26,9 @@ def add_fit_args(parser):
     train.add_argument("--load-epoch", type=int, default=None)
     train.add_argument("--top-k", type=int, default=0)
     train.add_argument("--dtype", type=str, default="float32")
+    train.add_argument("--device-feed", type=int, default=1,
+                       help="stage batches onto the device ahead of compute "
+                            "(async double-buffered feed; 0 disables)")
     return train
 
 
@@ -61,6 +64,14 @@ def fit(args, network, data_loader, **kwargs):
 
     epoch_size = max(len(getattr(train, "idx", [0])) // args.batch_size, 1)
     lr, lr_scheduler = _get_lr_scheduler(args, kv, epoch_size)
+
+    if getattr(args, "device_feed", 0):
+        # overlap host->device staging of batch k+1 with step k (the
+        # reference's PrefetcherIter design, src/io/iter_prefetcher.h:1)
+        from mxnet_tpu.io import DeviceFeedIter
+        train = DeviceFeedIter(train)
+        if val is not None:
+            val = DeviceFeedIter(val)
 
     model = mx.mod.Module(context=devs, symbol=network)
 

@@ -22,42 +22,9 @@ from ..base import MXNetError
 from ..ndarray import NDArray
 from ..ndarray.ndarray import _unwrap, _wrap
 from ..ops.registry import register
-
-
-@register("_contrib_quantize", aliases=["contrib_quantize"], num_outputs=3,
-          differentiable=False)
-def _quantize(data, min_range, max_range, out_type="int8"):
-    """Affine-quantize float → int8 given calibrated range (reference
-    quantization/quantize.cc)."""
-    mn = jnp.minimum(min_range, 0.0)
-    mx = jnp.maximum(max_range, 0.0)
-    scale = 127.0 / jnp.maximum(jnp.maximum(jnp.abs(mn), jnp.abs(mx)), 1e-8)
-    q = jnp.clip(jnp.round(data * scale), -127, 127).astype(jnp.int8)
-    amax = jnp.maximum(jnp.abs(mn), jnp.abs(mx))
-    return q, -amax, amax
-
-
-@register("_contrib_dequantize", aliases=["contrib_dequantize"],
-          differentiable=False)
-def _dequantize(data, min_range, max_range, out_type="float32"):
-    amax = jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))
-    return data.astype(jnp.float32) * (amax / 127.0)
-
-
-@register("_contrib_requantize", aliases=["contrib_requantize"], num_outputs=3,
-          differentiable=False)
-def _requantize(data, min_range, max_range, min_calib_range=None,
-                max_calib_range=None, out_type="int8"):
-    f = data.astype(jnp.float32) * (jnp.maximum(jnp.abs(min_range),
-                                                jnp.abs(max_range)) / 0x7FFFFFFF)
-    if min_calib_range is not None:
-        mn, mx = min_calib_range, max_calib_range
-    else:
-        mn, mx = jnp.min(f), jnp.max(f)
-    amax = jnp.maximum(abs(mn) if not hasattr(mn, "shape") else jnp.abs(mn),
-                       abs(mx) if not hasattr(mx, "shape") else jnp.abs(mx))
-    q = jnp.clip(jnp.round(f * (127.0 / amax)), -127, 127).astype(jnp.int8)
-    return q, -amax, amax
+# the codec ops themselves are registered at package import time in
+# ops/quantize_ops.py so the registry names exist without importing contrib
+from ..ops.quantize_ops import _dequantize, _quantize, _requantize  # noqa: F401
 
 
 @register("_contrib_quantized_fully_connected", num_outputs=3,

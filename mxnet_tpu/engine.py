@@ -59,14 +59,39 @@ def naive_mode():
 
 def wait_all() -> None:
     """Engine::WaitForAll — block until all dispatched work completes
-    (device XLA queues + the host task engine, if one was started)."""
+    (device XLA queues + the host task engine, if one was started).
+
+    Like the reference (ThreadedEngine::WaitForAll re-throwing captured
+    exceptions, src/engine/threaded_engine.cc:429-481), a failure captured
+    by an async computation RE-RAISES here as MXNetError — waitall is a
+    failure-surfacing point, not just a barrier. All remaining work is
+    drained before raising so the engine is quiescent either way."""
+    from .base import MXNetError
+    first_err = None
     try:
-        for a in jax.live_arrays():
-            a.block_until_ready()
+        arrays = list(jax.live_arrays())
     except Exception:
-        pass
+        arrays = []
+    for a in arrays:
+        try:
+            a.block_until_ready()
+        except Exception as e:  # keep draining; surface the FIRST failure
+            msg = str(e)
+            # a deleted/donated buffer is lifecycle bookkeeping, not an
+            # async computation failure — never promote it to MXNetError
+            if "deleted" in msg or "donated" in msg:
+                continue
+            if first_err is None:
+                first_err = e
     if _host_engine is not None:
-        _host_engine.wait_all()
+        try:
+            _host_engine.wait_all()
+        except Exception as e:
+            if first_err is None:
+                first_err = e
+    if first_err is not None:
+        raise MXNetError(
+            "async error surfaced at waitall: %s" % first_err) from first_err
 
 
 _bulk_size = [0]

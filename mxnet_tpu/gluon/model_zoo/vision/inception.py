@@ -1,164 +1,187 @@
-"""Inception V3 (reference: model_zoo/vision/inception.py)."""
+"""Inception V3.
+
+Behavioral parity with the reference zoo entry
+(``python/mxnet/gluon/model_zoo/vision/inception.py``) — same stage order,
+branch widths, and factorized 7x7/3x3 convolutions (Szegedy et al. 2015).
+
+TPU extension beyond parity (matching the resnet treatment): every stage
+takes ``layout`` so the whole net can build channel-last ("NHWC") — convs
+then lower onto the MXU without layout transposes; branch concatenation
+happens on the trailing channel axis.
+"""
+from __future__ import annotations
+
 from ...block import HybridBlock
 from ... import nn
 
 __all__ = ["Inception3", "inception_v3"]
 
 
-def _make_basic_conv(**kwargs):
-    out = nn.HybridSequential(prefix="")
-    out.add(nn.Conv2D(use_bias=False, **kwargs))
-    out.add(nn.BatchNorm(epsilon=0.001))
-    out.add(nn.Activation("relu"))
-    return out
+def _ch_axis(layout):
+    return -1 if layout.endswith("C") else 1
 
 
-def _make_branch(use_pool, *conv_settings):
-    out = nn.HybridSequential(prefix="")
-    if use_pool == "avg":
-        out.add(nn.AvgPool2D(pool_size=3, strides=1, padding=1))
-    elif use_pool == "max":
-        out.add(nn.MaxPool2D(pool_size=3, strides=2))
-    for setting in conv_settings:
-        kwargs = {}
-        if setting[0] is not None:
-            kwargs["channels"] = setting[0]
-        if setting[1] is not None:
-            kwargs["kernel_size"] = setting[1]
-        if setting[2] is not None:
-            kwargs["strides"] = setting[2]
-        if setting[3] is not None:
-            kwargs["padding"] = setting[3]
-        out.add(_make_basic_conv(**kwargs))
-    return out
+class _ConvUnit(nn.HybridSequential):
+    """conv(no bias) -> BN(eps 1e-3) -> relu, the building unit every
+    Inception branch is made of."""
+
+    def __init__(self, channels, kernel, stride=1, pad=0, layout="NCHW"):
+        super().__init__(prefix="")
+        self.add(nn.Conv2D(channels, kernel_size=kernel, strides=stride,
+                           padding=pad, use_bias=False, layout=layout))
+        self.add(nn.BatchNorm(epsilon=0.001, axis=_ch_axis(layout)))
+        self.add(nn.Activation("relu"))
 
 
-class _Concurrent(HybridBlock):
-    """Parallel branches concatenated on channels (gluon.contrib HybridConcurrent)."""
+class _Branches(HybridBlock):
+    """Run child branches on the same input and concatenate on channels
+    (the inception "mixed" pattern; gluon.contrib.HybridConcurrent)."""
 
-    def __init__(self, axis=1, **kwargs):
+    def __init__(self, branches, layout="NCHW", **kwargs):
         super().__init__(**kwargs)
-        self._axis = axis
-
-    def add(self, block):
-        self.register_child(block)
+        self._axis = _ch_axis(layout)
+        for b in branches:
+            self.register_child(b)
 
     def hybrid_forward(self, F, x):
-        outs = [child(x) for child in self._children.values()]
-        return F.Concat(*outs, dim=self._axis)
+        return F.Concat(*[child(x) for child in self._children.values()],
+                        dim=self._axis)
 
 
-def _make_A(pool_features, prefix):
-    out = _Concurrent(prefix=prefix)
-    with out.name_scope():
-        out.add(_make_branch(None, (64, 1, None, None)))
-        out.add(_make_branch(None, (48, 1, None, None), (64, 5, None, 2)))
-        out.add(_make_branch(None, (64, 1, None, None), (96, 3, None, 1),
-                             (96, 3, None, 1)))
-        out.add(_make_branch("avg", (pool_features, 1, None, None)))
+def _seq(*blocks):
+    out = nn.HybridSequential(prefix="")
+    for b in blocks:
+        out.add(b)
     return out
 
 
-def _make_B(prefix):
-    out = _Concurrent(prefix=prefix)
-    with out.name_scope():
-        out.add(_make_branch(None, (384, 3, 2, None)))
-        out.add(_make_branch(None, (64, 1, None, None), (96, 3, None, 1),
-                             (96, 3, 2, None)))
-        out.add(_make_branch("max"))
-    return out
+def _stage_a(pool_features, layout, prefix):
+    """35x35 stage: 1x1 / 5x5 / double-3x3 / pooled-1x1 branches."""
+    L = layout
+    return _Branches([
+        _ConvUnit(64, kernel=1, layout=L),
+        _seq(_ConvUnit(48, kernel=1, layout=L),
+             _ConvUnit(64, kernel=5, pad=2, layout=L)),
+        _seq(_ConvUnit(64, kernel=1, layout=L),
+             _ConvUnit(96, kernel=3, pad=1, layout=L),
+             _ConvUnit(96, kernel=3, pad=1, layout=L)),
+        _seq(nn.AvgPool2D(pool_size=3, strides=1, padding=1, layout=L),
+             _ConvUnit(pool_features, kernel=1, layout=L)),
+    ], layout=L, prefix=prefix)
 
 
-def _make_C(channels_7x7, prefix):
-    out = _Concurrent(prefix=prefix)
-    with out.name_scope():
-        out.add(_make_branch(None, (192, 1, None, None)))
-        out.add(_make_branch(None, (channels_7x7, 1, None, None),
-                             (channels_7x7, (1, 7), None, (0, 3)),
-                             (192, (7, 1), None, (3, 0))))
-        out.add(_make_branch(None, (channels_7x7, 1, None, None),
-                             (channels_7x7, (7, 1), None, (3, 0)),
-                             (channels_7x7, (1, 7), None, (0, 3)),
-                             (channels_7x7, (7, 1), None, (3, 0)),
-                             (192, (1, 7), None, (0, 3))))
-        out.add(_make_branch("avg", (192, 1, None, None)))
-    return out
+def _reduction_b(layout, prefix):
+    """35x35 -> 17x17 grid reduction."""
+    L = layout
+    return _Branches([
+        _ConvUnit(384, kernel=3, stride=2, layout=L),
+        _seq(_ConvUnit(64, kernel=1, layout=L),
+             _ConvUnit(96, kernel=3, pad=1, layout=L),
+             _ConvUnit(96, kernel=3, stride=2, layout=L)),
+        nn.MaxPool2D(pool_size=3, strides=2, layout=L),
+    ], layout=L, prefix=prefix)
 
 
-def _make_D(prefix):
-    out = _Concurrent(prefix=prefix)
-    with out.name_scope():
-        out.add(_make_branch(None, (192, 1, None, None), (320, 3, 2, None)))
-        out.add(_make_branch(None, (192, 1, None, None),
-                             (192, (1, 7), None, (0, 3)),
-                             (192, (7, 1), None, (3, 0)),
-                             (192, 3, 2, None)))
-        out.add(_make_branch("max"))
-    return out
+def _stage_c(mid, layout, prefix):
+    """17x17 stage with 7x7 factorized into 1x7/7x1 pairs; ``mid`` is the
+    bottleneck width (128/160/192 across the four C stages)."""
+    L = layout
+    return _Branches([
+        _ConvUnit(192, kernel=1, layout=L),
+        _seq(_ConvUnit(mid, kernel=1, layout=L),
+             _ConvUnit(mid, kernel=(1, 7), pad=(0, 3), layout=L),
+             _ConvUnit(192, kernel=(7, 1), pad=(3, 0), layout=L)),
+        _seq(_ConvUnit(mid, kernel=1, layout=L),
+             _ConvUnit(mid, kernel=(7, 1), pad=(3, 0), layout=L),
+             _ConvUnit(mid, kernel=(1, 7), pad=(0, 3), layout=L),
+             _ConvUnit(mid, kernel=(7, 1), pad=(3, 0), layout=L),
+             _ConvUnit(192, kernel=(1, 7), pad=(0, 3), layout=L)),
+        _seq(nn.AvgPool2D(pool_size=3, strides=1, padding=1, layout=L),
+             _ConvUnit(192, kernel=1, layout=L)),
+    ], layout=L, prefix=prefix)
 
 
-class _SplitBranch(HybridBlock):
-    def __init__(self, stem, left, right, **kwargs):
+def _reduction_d(layout, prefix):
+    """17x17 -> 8x8 grid reduction."""
+    L = layout
+    return _Branches([
+        _seq(_ConvUnit(192, kernel=1, layout=L),
+             _ConvUnit(320, kernel=3, stride=2, layout=L)),
+        _seq(_ConvUnit(192, kernel=1, layout=L),
+             _ConvUnit(192, kernel=(1, 7), pad=(0, 3), layout=L),
+             _ConvUnit(192, kernel=(7, 1), pad=(3, 0), layout=L),
+             _ConvUnit(192, kernel=3, stride=2, layout=L)),
+        nn.MaxPool2D(pool_size=3, strides=2, layout=L),
+    ], layout=L, prefix=prefix)
+
+
+class _Fork(HybridBlock):
+    """stem -> concat(left(stem_out), right(stem_out)): the expanded-filter
+    bank of the 8x8 stage, where a shared stem fans into two sibling convs."""
+
+    def __init__(self, stem, left, right, layout="NCHW", **kwargs):
         super().__init__(**kwargs)
+        self._axis = _ch_axis(layout)
         self.stem = stem
         self.left = left
         self.right = right
 
     def hybrid_forward(self, F, x):
         x = self.stem(x)
-        return F.Concat(self.left(x), self.right(x), dim=1)
+        return F.Concat(self.left(x), self.right(x), dim=self._axis)
 
 
-def _make_E(prefix):
-    out = _Concurrent(prefix=prefix)
-    with out.name_scope():
-        out.add(_make_branch(None, (320, 1, None, None)))
-        out.add(_SplitBranch(
-            _make_basic_conv(channels=384, kernel_size=1),
-            _make_basic_conv(channels=384, kernel_size=(1, 3), padding=(0, 1)),
-            _make_basic_conv(channels=384, kernel_size=(3, 1), padding=(1, 0))))
-        stem3 = nn.HybridSequential(prefix="")
-        stem3.add(_make_basic_conv(channels=448, kernel_size=1))
-        stem3.add(_make_basic_conv(channels=384, kernel_size=3, padding=1))
-        out.add(_SplitBranch(
-            stem3,
-            _make_basic_conv(channels=384, kernel_size=(1, 3), padding=(0, 1)),
-            _make_basic_conv(channels=384, kernel_size=(3, 1), padding=(1, 0))))
-        out.add(_make_branch("avg", (192, 1, None, None)))
-    return out
+def _stage_e(layout, prefix):
+    """8x8 stage: 3x3s expanded into parallel 1x3 + 3x1 siblings."""
+    L = layout
+    return _Branches([
+        _ConvUnit(320, kernel=1, layout=L),
+        _Fork(_ConvUnit(384, kernel=1, layout=L),
+              _ConvUnit(384, kernel=(1, 3), pad=(0, 1), layout=L),
+              _ConvUnit(384, kernel=(3, 1), pad=(1, 0), layout=L),
+              layout=L),
+        _Fork(_seq(_ConvUnit(448, kernel=1, layout=L),
+                   _ConvUnit(384, kernel=3, pad=1, layout=L)),
+              _ConvUnit(384, kernel=(1, 3), pad=(0, 1), layout=L),
+              _ConvUnit(384, kernel=(3, 1), pad=(1, 0), layout=L),
+              layout=L),
+        _seq(nn.AvgPool2D(pool_size=3, strides=1, padding=1, layout=L),
+             _ConvUnit(192, kernel=1, layout=L)),
+    ], layout=L, prefix=prefix)
 
 
 class Inception3(HybridBlock):
-    def __init__(self, classes=1000, **kwargs):
+    """Inception V3 (input 299x299; ``layout`` in {"NCHW", "NHWC"})."""
+
+    def __init__(self, classes=1000, layout="NCHW", **kwargs):
         super().__init__(**kwargs)
+        L = layout
         with self.name_scope():
-            self.features = nn.HybridSequential(prefix="")
-            self.features.add(_make_basic_conv(channels=32, kernel_size=3, strides=2))
-            self.features.add(_make_basic_conv(channels=32, kernel_size=3))
-            self.features.add(_make_basic_conv(channels=64, kernel_size=3, padding=1))
-            self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
-            self.features.add(_make_basic_conv(channels=80, kernel_size=1))
-            self.features.add(_make_basic_conv(channels=192, kernel_size=3))
-            self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
-            self.features.add(_make_A(32, "A1_"))
-            self.features.add(_make_A(64, "A2_"))
-            self.features.add(_make_A(64, "A3_"))
-            self.features.add(_make_B("B_"))
-            self.features.add(_make_C(128, "C1_"))
-            self.features.add(_make_C(160, "C2_"))
-            self.features.add(_make_C(160, "C3_"))
-            self.features.add(_make_C(192, "C4_"))
-            self.features.add(_make_D("D_"))
-            self.features.add(_make_E("E1_"))
-            self.features.add(_make_E("E2_"))
-            self.features.add(nn.AvgPool2D(pool_size=8))
+            self.features = _seq(
+                _ConvUnit(32, kernel=3, stride=2, layout=L),
+                _ConvUnit(32, kernel=3, layout=L),
+                _ConvUnit(64, kernel=3, pad=1, layout=L),
+                nn.MaxPool2D(pool_size=3, strides=2, layout=L),
+                _ConvUnit(80, kernel=1, layout=L),
+                _ConvUnit(192, kernel=3, layout=L),
+                nn.MaxPool2D(pool_size=3, strides=2, layout=L),
+            )
+            for i, pool_ch in enumerate((32, 64, 64)):
+                self.features.add(_stage_a(pool_ch, L, f"A{i + 1}_"))
+            self.features.add(_reduction_b(L, "B_"))
+            for i, mid in enumerate((128, 160, 160, 192)):
+                self.features.add(_stage_c(mid, L, f"C{i + 1}_"))
+            self.features.add(_reduction_d(L, "D_"))
+            self.features.add(_stage_e(L, "E1_"))
+            self.features.add(_stage_e(L, "E2_"))
+            self.features.add(nn.AvgPool2D(pool_size=8, layout=L))
             self.features.add(nn.Dropout(0.5))
             self.output = nn.Dense(classes)
 
     def hybrid_forward(self, F, x):
-        x = self.features(x)
-        return self.output(x)
+        return self.output(self.features(x))
 
 
 def inception_v3(pretrained=False, ctx=None, root=None, **kwargs):
+    """Constructor used by ``model_zoo.get_model('inceptionv3')``."""
     return Inception3(**kwargs)

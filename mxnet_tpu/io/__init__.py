@@ -3,6 +3,9 @@ iterators in ``src/io/``)."""
 from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter,
                  PrefetchingIter, CSVIter, ImageRecordIter,
                  ImageDetRecordIter, MNISTIter, LibSVMIter)
+from .device_feed import DeviceFeedIter, prefetch_to_device
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
-           "PrefetchingIter", "CSVIter", "ImageRecordIter", "ImageDetRecordIter", "MNISTIter", "LibSVMIter"]
+           "PrefetchingIter", "CSVIter", "ImageRecordIter",
+           "ImageDetRecordIter", "MNISTIter", "LibSVMIter",
+           "DeviceFeedIter", "prefetch_to_device"]

@@ -1,0 +1,253 @@
+"""Async double-buffered device staging for input pipelines.
+
+The reference hides host->device input latency by running decode/augment in
+a C++ thread pool and handing the engine pre-staged batches (PrefetcherIter,
+src/io/iter_prefetcher.h:1; the OMP decode loop in
+src/io/iter_image_recordio_2.cc:672-736). The TPU-native equivalent: a
+background thread issues ``jax.device_put`` for batch k+1 (and k+2, ...,
+up to ``depth``) while the jitted train step for batch k runs on the chip,
+so the H2D DMA overlaps compute instead of serializing with it.
+
+Two extra levers the reference's design also uses:
+
+- **uint8 on the wire**: images travel as uint8 and are normalized ON the
+  device (the reference augmenters emit uint8 records; mean/std live in the
+  graph). 4x fewer bytes than float32 -> 4x the effective feed rate when
+  the interconnect, not the decode, is the bottleneck. Labels are never
+  cast or rescaled.
+- **depth>1 double buffering**: transfers for multiple future batches are
+  in flight concurrently; jax arrays are functional so "buffers" need no
+  explicit alternation — each staged batch owns fresh device memory and is
+  dropped when the consumer moves on.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Iterable, Iterator, Optional
+
+import jax
+import numpy as np
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray, _unwrap, _wrap
+from .io import DataBatch, DataIter
+
+__all__ = ["prefetch_to_device", "DeviceFeedIter"]
+
+_STOP = object()
+
+
+def _stage(tree, sharding):
+    """Issue (async) device transfers for every array leaf of ``tree``."""
+
+    def put(a):
+        if isinstance(a, NDArray):
+            a = _unwrap(a)
+        if a is None:
+            return None
+        if sharding is not None:
+            return jax.device_put(a, sharding)
+        return jax.device_put(a)
+
+    return jax.tree_util.tree_map(put, tree,
+                                  is_leaf=lambda x: isinstance(x, NDArray))
+
+
+def _put_or_stop(q, item, stop):
+    """Blocking q.put that gives up when ``stop`` is set (so an abandoned
+    consumer can never strand the producer holding staged device buffers).
+    Returns False if stopped."""
+    while not stop.is_set():
+        try:
+            q.put(item, timeout=0.2)
+            return True
+        except queue.Full:
+            continue
+    return False
+
+
+def prefetch_to_device(source: Iterable, sharding=None,
+                       depth: int = 2) -> Iterator:
+    """Yield items of ``source`` with their array leaves already committed
+    to device memory, staging ``depth`` items ahead on a background thread.
+
+    ``source`` yields pytrees (tuples/lists/dicts) of numpy arrays,
+    NDArrays, or jax arrays; ``sharding`` is an optional
+    ``jax.sharding.Sharding`` the leaves are placed with (e.g.
+    ``NamedSharding(mesh, P('dp'))`` to split the batch across the mesh).
+
+    The producer thread only *issues* transfers (``jax.device_put`` is
+    asynchronous); the PJRT runtime performs the DMA concurrently with
+    whatever computation the consumer has in flight. Closing/abandoning the
+    generator stops the producer and releases its staged buffers.
+    """
+    if depth < 1:
+        raise MXNetError("prefetch depth must be >= 1")
+    q: "queue.Queue" = queue.Queue(maxsize=depth)
+    stop = threading.Event()
+
+    def producer():
+        try:
+            for item in source:
+                if not _put_or_stop(q, _stage(item, sharding), stop):
+                    return
+        except Exception as e:                 # surface at the consumer
+            _put_or_stop(q, e, stop)
+            return
+        _put_or_stop(q, _STOP, stop)
+
+    t = threading.Thread(target=producer, daemon=True,
+                         name="mxtpu-device-feed")
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _STOP:
+                return
+            if isinstance(item, Exception):
+                raise item
+            yield item
+    finally:
+        # consumer done/abandoned: unblock and drain the producer so no
+        # staged device buffers stay pinned
+        stop.set()
+        try:
+            while True:
+                q.get_nowait()
+        except queue.Empty:
+            pass
+
+
+class DeviceFeedIter(DataIter):
+    """DataIter combinator: batches come out with ``.data``/``.label``
+    already resident on device (optionally sharded over a mesh axis),
+    staged ``depth`` batches ahead of the consumer.
+
+    Drop-in around any DataIter — the TPU-native PrefetcherIter
+    (reference src/io/iter_prefetcher.h:1)::
+
+        feed = DeviceFeedIter(ImageRecordIter(...),
+                              sharding=NamedSharding(mesh, P('dp')),
+                              wire_dtype='uint8', scale=1/255.)
+        for batch in feed:
+            trainer.step(batch.data[0], batch.label[0])  # no H2D stall
+
+    ``wire_dtype``/``scale``/``shift``: when set, DATA leaves are cast to
+    ``wire_dtype`` BEFORE the transfer and rescaled on device afterwards
+    (``x * scale + shift`` in float32) — the reference's uint8-record
+    design, cutting wire bytes 4x vs float32. Labels travel untouched.
+    """
+
+    def __init__(self, base: DataIter, sharding=None, depth: int = 2,
+                 wire_dtype: Optional[str] = None, scale: float = 1.0,
+                 shift: float = 0.0):
+        super().__init__(getattr(base, "batch_size", 0))
+        self._base = base
+        self._sharding = sharding
+        self._depth = depth
+        self._wire_dtype = np.dtype(wire_dtype) if wire_dtype else None
+        self._rescale = None
+        if self._wire_dtype is not None:
+            import jax.numpy as jnp
+            scale_, shift_ = float(scale), float(shift)
+
+            @jax.jit
+            def rescale(a):
+                return a.astype(jnp.float32) * scale_ + shift_
+
+            self._rescale = rescale
+        self._queue: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._thread = None
+        self._start()
+
+    # DataDesc passthrough so Module/fit loops see the base iterator's shape
+    @property
+    def provide_data(self):
+        return self._base.provide_data
+
+    @property
+    def provide_label(self):
+        return self._base.provide_label
+
+    def _put_arrays(self, arrs, is_label):
+        out = []
+        for a in arrs or []:
+            h = _unwrap(a) if isinstance(a, NDArray) else a
+            wire = (not is_label and self._wire_dtype is not None
+                    and np.issubdtype(np.asarray(h).dtype, np.floating))
+            if wire:
+                h = np.asarray(h).astype(self._wire_dtype)
+            d = (jax.device_put(h, self._sharding)
+                 if self._sharding is not None else jax.device_put(h))
+            if wire and self._rescale is not None and \
+                    np.issubdtype(self._wire_dtype, np.integer):
+                d = self._rescale(d)
+            out.append(_wrap(d))
+        return out
+
+    def _producer(self, q, stop):
+        # q/stop arrive as ARGUMENTS (not re-read from self) so a stale
+        # thread from before a reset() can never touch the new queue
+        try:
+            while not stop.is_set():
+                try:
+                    b = self._base.next()
+                except StopIteration:
+                    _put_or_stop(q, _STOP, stop)
+                    return
+                staged = DataBatch(
+                    data=self._put_arrays(b.data, is_label=False),
+                    label=self._put_arrays(b.label, is_label=True),
+                    pad=b.pad, index=b.index,
+                    bucket_key=getattr(b, "bucket_key", None))
+                if not _put_or_stop(q, staged, stop):
+                    return
+        except Exception as e:
+            _put_or_stop(q, e, stop)
+
+    def _start(self):
+        self._thread = threading.Thread(
+            target=self._producer, args=(self._queue, self._stop),
+            daemon=True, name="mxtpu-device-feed-iter")
+        self._thread.start()
+
+    def reset(self):
+        """Stop the producer, rewind the base iterator, restart staging.
+        The old thread is fully joined BEFORE base.reset() so two threads
+        never drive the base iterator concurrently."""
+        self._stop.set()
+        deadline = time.monotonic() + 60.0
+        while self._thread is not None and self._thread.is_alive():
+            try:                 # keep the queue drained so puts can't block
+                while True:
+                    self._queue.get_nowait()
+            except queue.Empty:
+                pass
+            self._thread.join(timeout=0.1)
+            if time.monotonic() > deadline:
+                raise MXNetError(
+                    "DeviceFeedIter.reset: producer thread failed to stop "
+                    "(base iterator blocked in next()?)")
+        try:
+            while True:
+                self._queue.get_nowait()
+        except queue.Empty:
+            pass
+        self._base.reset()
+        self._stop = threading.Event()
+        self._queue = queue.Queue(maxsize=self._depth)
+        self._start()
+
+    def next(self) -> DataBatch:
+        item = self._queue.get()
+        if item is _STOP:
+            raise StopIteration
+        if isinstance(item, Exception):
+            raise item
+        return item
+
+    def iter_next(self):
+        raise MXNetError("use next() on DeviceFeedIter")

@@ -20,4 +20,5 @@ from . import multibox       # noqa: F401
 from . import contrib_ops    # noqa: F401
 from . import ctc            # noqa: F401
 from . import parity_ops     # noqa: F401
+from . import quantize_ops   # noqa: F401
 from . import tail_ops       # noqa: F401

@@ -99,6 +99,49 @@ def _sample_gamma(alpha, beta, shape=(), dtype="float32", rng=None):
     return g * beta.reshape(beta.shape + (1,) * len(s))
 
 
+# the remaining multisample family (reference multisample_op.cc:281-320):
+# per-element parameter arrays, output shape = param_shape + shape.
+@register("_sample_exponential", aliases=["sample_exponential"],
+          needs_rng=True, differentiable=False)
+def _sample_exponential(lam, shape=(), dtype="float32", rng=None):
+    s = tuple(shape) if shape else ()
+    e = jax.random.exponential(rng, lam.shape + s, dtype=_dt(dtype))
+    return e / lam.reshape(lam.shape + (1,) * len(s))
+
+
+@register("_sample_poisson", aliases=["sample_poisson"], needs_rng=True,
+          differentiable=False)
+def _sample_poisson(lam, shape=(), dtype="float32", rng=None):
+    s = tuple(shape) if shape else ()
+    l = jnp.broadcast_to(lam.reshape(lam.shape + (1,) * len(s)), lam.shape + s)
+    return jax.random.poisson(rng, l).astype(_dt(dtype))
+
+
+@register("_sample_negative_binomial", aliases=["sample_negative_binomial"],
+          needs_rng=True, differentiable=False)
+def _sample_negative_binomial(k, p, shape=(), dtype="float32", rng=None):
+    s = tuple(shape) if shape else ()
+    k1, k2 = jax.random.split(rng)
+    kk = jnp.broadcast_to(k.reshape(k.shape + (1,) * len(s)), k.shape + s)
+    pp = jnp.broadcast_to(p.reshape(p.shape + (1,) * len(s)), p.shape + s)
+    lam = jax.random.gamma(k1, kk.astype(jnp.float32)) * ((1 - pp) / pp)
+    return jax.random.poisson(k2, lam).astype(_dt(dtype))
+
+
+@register("_sample_generalized_negative_binomial",
+          aliases=["sample_generalized_negative_binomial"], needs_rng=True,
+          differentiable=False)
+def _sample_gen_neg_binomial(mu, alpha, shape=(), dtype="float32", rng=None):
+    s = tuple(shape) if shape else ()
+    k1, k2 = jax.random.split(rng)
+    mm = jnp.broadcast_to(mu.reshape(mu.shape + (1,) * len(s)), mu.shape + s)
+    aa = jnp.broadcast_to(alpha.reshape(alpha.shape + (1,) * len(s)),
+                          alpha.shape + s)
+    aa = jnp.maximum(aa.astype(jnp.float32), 1e-12)
+    lam = jax.random.gamma(k1, 1.0 / aa) * (mm * aa)
+    return jax.random.poisson(k2, lam).astype(_dt(dtype))
+
+
 @register("_sample_multinomial", aliases=["sample_multinomial"], needs_rng=True,
           differentiable=False, num_outputs=lambda a: 2 if a.get("get_prob") else 1)
 def _sample_multinomial(data, shape=(), get_prob=False, dtype="int32", rng=None):

