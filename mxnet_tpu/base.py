@@ -127,6 +127,12 @@ register_config("MXNET_KVSTORE_BIGARRAY_BOUND", 1 << 20, int,
                 "Size above which a gradient is sharded across the reduce axis.")
 register_config("MXNET_UPDATE_AGGREGATION_SIZE", 4, int,
                 "Number of gradient tensors aggregated per fused allreduce bucket.")
+register_config("MXNET_KVSTORE_HEARTBEAT_INTERVAL", 2.0, float,
+                "Seconds between liveness heartbeats a dist kvstore rank "
+                "writes to the coordination service.")
+register_config("MXNET_KVSTORE_BARRIER_TIMEOUT", 300.0, float,
+                "Seconds a dist kvstore barrier waits before raising with a "
+                "dead-peer diagnosis (num_dead_node).")
 register_config("MXNET_ENFORCE_DETERMINISM", False, bool,
                 "Disallow non-deterministic reductions.")
 register_config("MXNET_PROFILER_AUTOSTART", False, bool,

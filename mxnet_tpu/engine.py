@@ -77,9 +77,14 @@ def wait_all() -> None:
             a.block_until_ready()
         except Exception as e:  # keep draining; surface the FIRST failure
             msg = str(e)
-            # a deleted/donated buffer is lifecycle bookkeeping, not an
-            # async computation failure — never promote it to MXNetError
-            if "deleted" in msg or "donated" in msg:
+            # lifecycle bookkeeping, not an async computation failure: jax
+            # raises exactly this for a buffer freed by delete()/donation
+            # (INVALID_ARGUMENT: BlockHostUntilReady() called on deleted or
+            # donated buffer). Match the full phrase — a real async failure
+            # whose text merely mentions a deleted/donated buffer must
+            # still surface.
+            if "BlockHostUntilReady() called on deleted or donated buffer" \
+                    in msg or msg.startswith("Array has been deleted"):
                 continue
             if first_err is None:
                 first_err = e

@@ -65,6 +65,22 @@ def _dequantize_2bit(packed, *, threshold: float, size: int):
                      ).astype(jnp.float32)
 
 
+@functools.partial(jax.jit, static_argnames=("threshold",))
+def _dequantize_sum_rows(rows, *, threshold: float):
+    """rows: uint8 (nranks, s) — one packed shard per rank. Decode every
+    rank's 2-bit codes and sum them in one fused computation, returning the
+    dense f32 (4*s,) partial reduction. This is the server-side half of the
+    reference's compressed push (the server dequantizes each worker's
+    payload into its merge buffer, kvstore_dist_server.h DataHandleEx) as
+    one XLA kernel over all ranks at once."""
+    fields = jnp.stack([(rows >> 6) & 3, (rows >> 4) & 3,
+                        (rows >> 2) & 3, rows & 3], axis=-1)   # (n, s, 4)
+    vals = jnp.where(fields == 3, jnp.float32(threshold),
+                     jnp.where(fields == 2, jnp.float32(-threshold),
+                               jnp.float32(0.0)))
+    return vals.sum(axis=0).reshape(-1)
+
+
 class GradientCompression:
     """Stateless codec; the kvstore owns per-key residuals."""
 
@@ -95,6 +111,12 @@ class GradientCompression:
         size = int(math.prod(shape)) if not isinstance(shape, int) else shape
         out = _dequantize_2bit(packed, threshold=self.threshold, size=size)
         return out if isinstance(shape, int) else out.reshape(shape)
+
+    def dequantize_rows_sum(self, rows):
+        """Decode a (nranks, s)-byte stack of packed shards and return the
+        summed dense (4*s,) float32 contribution (see _dequantize_sum_rows)."""
+        return _dequantize_sum_rows(jnp.asarray(rows, jnp.uint8),
+                                    threshold=self.threshold)
 
     def compressed_size(self, original_size: int) -> int:
         """float32-WORD count of the compressed buffer for ``original_size``

@@ -176,14 +176,19 @@ class DeviceFeedIter(DataIter):
         out = []
         for a in arrs or []:
             h = _unwrap(a) if isinstance(a, NDArray) else a
-            wire = (not is_label and self._wire_dtype is not None
-                    and np.issubdtype(np.asarray(h).dtype, np.floating))
+            # contract (docstring): with wire_dtype set, every DATA leaf is
+            # cast to wire_dtype for the transfer and rescaled to f32 on
+            # device as x*scale + shift — including leaves that ALREADY
+            # arrive as the wire dtype (uint8 image records) and float wire
+            # dtypes; source dtype never silently disables the rescale
+            wire = not is_label and self._wire_dtype is not None
             if wire:
-                h = np.asarray(h).astype(self._wire_dtype)
+                h = np.asarray(h)
+                if h.dtype != self._wire_dtype:
+                    h = h.astype(self._wire_dtype)
             d = (jax.device_put(h, self._sharding)
                  if self._sharding is not None else jax.device_put(h))
-            if wire and self._rescale is not None and \
-                    np.issubdtype(self._wire_dtype, np.integer):
+            if wire:
                 d = self._rescale(d)
             out.append(_wrap(d))
         return out

@@ -140,6 +140,11 @@ class KVStore:
                 raise MXNetError(f"key {k} was not init'd")
             if not isinstance(olist, list):
                 olist = [olist]
+            # Copy-on-write alias: every out shares the stored buffer. This
+            # is sound because jax arrays are immutable — NDArray "mutation"
+            # (o[:] = ..., +=) always rebinds o._data to a NEW array and can
+            # never write through to the store. Any future raw-buffer
+            # mutation path (e.g. dlpack in-place) must copy here first.
             src = self._store[k]._data
             for o in olist:
                 o._set_data(src)
@@ -242,11 +247,115 @@ class KVStoreDist(KVStore):
     jax.distributed coordinator + psum over DCN/ICI (replaces ps-lite
     workers/servers/scheduler and tools/launch.py roles)."""
 
+    _next_instance = 0
+
     def __init__(self, name: str):
         super().__init__(name)
         _maybe_join_cluster()
         self._nprocs = jax.process_count()
         self._rank = jax.process_index()
+        # barrier ids must be unique across kvstore instances in one job;
+        # ranks create their dist stores in the same program order, so a
+        # class-level creation index agrees everywhere without a handshake
+        self._instance_id = KVStoreDist._next_instance
+        KVStoreDist._next_instance += 1
+        self._barrier_seq = 0
+        self._last_compressed_stats: Dict[str, int] = {}
+        self._hb_stop = threading.Event()
+        if self._nprocs > 1:
+            self._start_heartbeat()
+
+    # ------------------------------------------------------- fault surface
+    # The reference's ps-lite van exchanges heartbeats and the scheduler
+    # tracks dead nodes (include/mxnet/kvstore.h:345-355 get_num_dead_node,
+    # ps-lite postoffice UpdateHeartbeat). TPU-native: the jax.distributed
+    # coordination service IS the scheduler — each rank beats a timestamp
+    # into its key-value store, and liveness reads are plain KV lookups.
+
+    def _start_heartbeat(self) -> None:
+        client = _dist_client()
+        if client is None:
+            return
+        interval = float(get_env("MXNET_KVSTORE_HEARTBEAT_INTERVAL", 2.0))
+        rank = self._rank
+
+        def beat():
+            while not self._hb_stop.wait(interval):
+                try:
+                    client.key_value_set("mxtpu_hb/%d" % rank,
+                                         repr(time.time()),
+                                         allow_overwrite=True)
+                except Exception:
+                    return      # coordinator gone: nothing left to report to
+        try:
+            client.key_value_set("mxtpu_hb/%d" % rank, repr(time.time()),
+                                 allow_overwrite=True)
+        except Exception:
+            return
+        t = threading.Thread(target=beat, daemon=True,
+                             name="mxtpu-kv-heartbeat")
+        t.start()
+        self._hb_thread = t
+
+    def num_dead_node(self, node_id: int = -1, timeout: float = 60.0) -> int:
+        """Number of peer processes with no heartbeat in the last ``timeout``
+        seconds (reference ``get_num_dead_node(node_id, timeout)``,
+        include/mxnet/kvstore.h:345-355; node_id -1 means every node, else
+        probe that single rank). A rank that never wrote a heartbeat (never
+        created its kvstore, or died before connecting) counts as dead."""
+        if self._nprocs == 1:
+            return 0
+        client = _dist_client()
+        if client is None:
+            raise MXNetError("num_dead_node requires a joined cluster")
+        ids = list(range(self._nprocs)) if node_id < 0 else [int(node_id)]
+        now = time.time()
+        dead = 0
+        for i in ids:
+            try:
+                ts = float(client.key_value_try_get("mxtpu_hb/%d" % i))
+            except Exception:
+                ts = None
+            if ts is None or now - ts > timeout:
+                dead += 1
+        return dead
+
+    def barrier(self, timeout: Optional[float] = None) -> None:
+        """Global barrier with dead-peer detection. Uses the coordination
+        service's native timed barrier (replacing ps-lite's scheduler
+        BARRIER control message); on timeout the error names how many peers
+        look dead so a hung job fails loud instead of forever (reference
+        worker behavior when the scheduler reports dead nodes)."""
+        self._flush()
+        if self._nprocs <= 1:
+            return
+        if timeout is None:
+            timeout = float(get_env("MXNET_KVSTORE_BARRIER_TIMEOUT", 300.0))
+        client = _dist_client()
+        if client is None:
+            raise MXNetError("dist kvstore barrier requires a joined cluster")
+        self._barrier_seq += 1
+        try:
+            client.wait_at_barrier(
+                "mxtpu_kv_barrier_%d_%d" % (self._instance_id,
+                                            self._barrier_seq),
+                int(timeout * 1000))
+        except Exception as e:
+            msg = repr(e).lower()
+            if "deadline" not in msg and "timeout" not in msg \
+                    and "timed out" not in msg:
+                raise   # a programming/transport error, not a hung peer
+            hb_window = min(timeout, 60.0)
+            try:
+                ndead = self.num_dead_node(-1, timeout=hb_window)
+            except Exception:
+                ndead = -1
+            raise MXNetError(
+                "kvstore barrier timed out after %.1fs (%s peer(s) sent no "
+                "heartbeat in the last %.0fs — a worker likely died; see "
+                "num_dead_node()): %s"
+                % (timeout, "unknown" if ndead < 0 else ndead, hb_window,
+                   e)) from e
 
     @property
     def rank(self) -> int:
@@ -278,40 +387,66 @@ class KVStoreDist(KVStore):
         return collectives.cross_process_allreduce_many(merged_list)
 
     def _reduce_compressed(self, packed_list, shapes):
-        """The compressed wire path: ONE allgather of the bucket's packed
-        uint8 payloads (16x smaller than fp32), then decode each rank's
-        contribution and sum. This is the reference's worker->server
-        compressed push direction (kvstore_dist.h PushCompressed) mapped
-        onto an allgather+local-reduce, since there is no server."""
+        """The compressed wire path, reduce-scatter shaped (the reference
+        fans each worker's compressed push out across server shards by part
+        offset, kvstore_dist.h:593-643, so no node ever decodes more than
+        its share; with no server the shard owners are the ranks
+        themselves):
+
+        1. alltoall — each rank ships packed shard ``j`` (1/N of the bucket's
+           uint8 payload, 16x smaller than fp32) to rank ``j``: the packed
+           bytes cross the wire ONCE per rank, not N times;
+        2. each rank decodes + sums ONLY its own shard from all N peers —
+           per-rank decode work is the payload size, independent of N;
+        3. one tiled allgather of the dense f32 partial sums rebuilds the
+           full reduced gradient everywhere (the reference's dense server->
+           worker pull direction — compressed is push-only there too,
+           gradient_compression.cc:44-50).
+        """
         if self._nprocs == 1:
             return super()._reduce_compressed(packed_list, shapes)
-        gc = self._gc
         import numpy as _np
-        from jax.experimental import multihost_utils
+        from .parallel import collectives
+        nprocs = self._nprocs
         sizes = [int(p.size) for p in packed_list]
         flat = packed_list[0] if len(packed_list) == 1 \
             else jnp.concatenate(packed_list)
-        gathered = jnp.asarray(
-            multihost_utils.process_allgather(flat[None], tiled=True))
+        nbytes = int(flat.size)
+        shard = -(-nbytes // nprocs)                 # ceil: bytes per shard
+        pad = shard * nprocs - nbytes
+        if pad:
+            # trailing pad bytes decode to code 0b00 == 0.0 — sliced off below
+            flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.uint8)])
+        recv = collectives.cross_process_alltoall(
+            flat.reshape(nprocs, shard))             # (nprocs, shard)
+        dense_shard = self._gc.dequantize_rows_sum(recv)      # (4*shard,)
+        dense = collectives.cross_process_allgather_tiled(dense_shard)
+        # instrumentation for the O(1/N)-decode contract (tests/dist)
+        self._last_compressed_stats = {
+            "payload_bytes": nbytes,
+            "wire_packed_bytes_per_rank": shard * nprocs,    # alltoall total
+            "decode_bytes_per_rank": int(recv.size),         # == padded payload
+            "dense_allgather_elems": int(dense.size),
+        }
         out, off = [], 0
         for psize, shape in zip(sizes, shapes):
-            chunk = gathered[:, off:off + psize]     # (nprocs, bytes)
             n = int(_np.prod(shape)) if shape else 1
-            per_rank = jax.vmap(lambda row: gc.dequantize(row, n))(chunk)
-            out.append(per_rank.sum(axis=0).reshape(shape))
+            out.append(dense[4 * off:4 * off + n].reshape(shape))
             off += psize
         return out
-
-    def barrier(self) -> None:
-        self._flush()
-        if self._nprocs > 1:
-            from jax.experimental import multihost_utils
-            multihost_utils.sync_global_devices("mxnet_tpu_kvstore_barrier")
-
 
 # ----------------------------------------------------------------- helpers
 import functools
 import os
+import time
+
+
+def _dist_client():
+    """The jax.distributed coordination-service client (None when no
+    cluster was joined) — the TPU-native stand-in for ps-lite's scheduler
+    connection."""
+    from jax._src import distributed as _jdist
+    return getattr(_jdist.global_state, "client", None)
 
 _cluster_joined = False
 

@@ -182,33 +182,57 @@ def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3, momentum=0
     bshape = tuple(data.shape[ax] if i == ax else 1 for i in range(data.ndim))
     g = jnp.ones_like(gamma) if fix_gamma else gamma
     if is_train and not use_global_stats:
-        mean = jnp.mean(data, axis=red)
-        var = jnp.mean(jnp.square(data - mean.reshape(bshape)), axis=red)
+        # ONE pass over the activation for both statistics: E[x] and E[x^2]
+        # are sibling reduces of the same input, which XLA fuses into a
+        # single multi-output kLoop read (the two-pass mean/centered-var
+        # form serializes two full HBM reads of x — measured 30%+ of the
+        # ResNet step). Accumulate in f32: the convert fuses INTO the
+        # reduce pass, costing no extra traffic for bf16 activations.
+        xf = data.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=red)
+        # clamp: f32 cancellation can push E[x^2]-E[x]^2 a hair negative
+        var = jnp.maximum(
+            jnp.mean(jnp.square(xf), axis=red) - jnp.square(mean), 0.0)
     else:
-        mean, var = moving_mean, moving_var
-    inv = lax.rsqrt(var.reshape(bshape) + eps)
-    out = (data - mean.reshape(bshape)) * inv * g.reshape(bshape) + beta.reshape(bshape)
-    return out, mean, var
+        mean = moving_mean.astype(jnp.float32)
+        var = moving_var.astype(jnp.float32)
+    # fold the whole normalization into per-channel scale/shift vectors so
+    # the per-element work is a single fused multiply-add in the data dtype
+    # (no f32 promotion of the activation tensor), and the backward's
+    # dL/dscale, dL/dshift become one fused (dy, dy*x) reduction pass
+    inv = lax.rsqrt(var + eps)
+    scale = (inv * g.astype(jnp.float32))
+    shift = beta.astype(jnp.float32) - mean * scale
+    out = data * scale.astype(data.dtype).reshape(bshape) \
+        + shift.astype(data.dtype).reshape(bshape)
+    return out, mean.astype(moving_mean.dtype), var.astype(moving_var.dtype)
 
 
 @register("LayerNorm", num_outputs=3, arg_names=("data", "gamma", "beta"))
 def _layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False):
     ax = int(axis) % data.ndim
-    mean = jnp.mean(data, axis=ax, keepdims=True)
-    var = jnp.mean(jnp.square(data - mean), axis=ax, keepdims=True)
+    xf = data.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=ax, keepdims=True)           # one fused pass:
+    var = jnp.maximum(jnp.mean(jnp.square(xf), axis=ax, keepdims=True)
+                      - jnp.square(mean), 0.0)            # sibling reduces
     inv = lax.rsqrt(var + eps)
     shape = tuple(data.shape[ax] if i == ax else 1 for i in range(data.ndim))
-    out = (data - mean) * inv * gamma.reshape(shape) + beta.reshape(shape)
-    return out, jnp.squeeze(mean, ax), jnp.squeeze(var, ax)
+    out = ((xf - mean) * inv).astype(data.dtype) * gamma.reshape(shape) \
+        + beta.reshape(shape)
+    return (out, jnp.squeeze(mean.astype(data.dtype), ax),
+            jnp.squeeze(var.astype(data.dtype), ax))
 
 
 @register("InstanceNorm", arg_names=("data", "gamma", "beta"))
 def _instance_norm(data, gamma, beta, eps=1e-3):
     red = tuple(range(2, data.ndim))
-    mean = jnp.mean(data, axis=red, keepdims=True)
-    var = jnp.mean(jnp.square(data - mean), axis=red, keepdims=True)
+    xf = data.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=red, keepdims=True)           # one-pass stats
+    var = jnp.maximum(jnp.mean(jnp.square(xf), axis=red, keepdims=True)
+                      - jnp.square(mean), 0.0)
     shape = (1, -1) + (1,) * (data.ndim - 2)
-    return (data - mean) * lax.rsqrt(var + eps) * gamma.reshape(shape) + beta.reshape(shape)
+    return (((xf - mean) * lax.rsqrt(var + eps)).astype(data.dtype)
+            * gamma.reshape(shape) + beta.reshape(shape))
 
 
 @register("LRN", num_outputs=2, arg_names=("data",))

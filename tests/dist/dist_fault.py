@@ -1,0 +1,70 @@
+"""Dead-worker detection: a killed peer must surface as a clean error.
+
+Model: the reference scheduler tracks worker heartbeats and
+``get_num_dead_node(node_id, timeout)`` reports the casualties
+(include/mxnet/kvstore.h:345-355); a worker stuck at a barrier whose peer
+died hangs forever in stock ps-lite — our barrier(timeout=...) raises
+MXNetError naming the dead count instead.
+
+Plan (2 ranks):
+  1. both ranks create the dist kvstore (heartbeats start) and meet at a
+     normal barrier — proves the coordination-service barrier works;
+  2. rank 1 exits hard (os._exit — no shutdown, no atexit), simulating a
+     crashed worker;
+  3. rank 0 polls num_dead_node until the stale heartbeat flips it to 1,
+     then calls barrier(timeout=3) and asserts it raises MXNetError.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PYTHONPATH", None)
+os.environ["MXNET_KVSTORE_HEARTBEAT_INTERVAL"] = "0.3"
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu.base import MXNetError  # noqa: E402
+
+
+def main():
+    kv = mx.kv.create("dist_sync")
+    rank, nw = kv.rank, kv.num_workers
+    print(f"worker {rank}: kvstore up", flush=True)
+    assert nw == 2, "this scenario is written for 2 workers"
+
+    # barrier BEFORE the liveness probe: a rank that races ahead could
+    # otherwise read the peer's slot before its first heartbeat lands and
+    # miscount it dead (reference heartbeats also only start at connect)
+    kv.barrier()                      # both alive: must pass quickly
+    print(f"worker {rank}: first barrier passed", flush=True)
+    assert kv.num_dead_node(-1, timeout=60) == 0
+
+    if rank == 1:
+        time.sleep(0.5)               # let rank 0 observe a live heartbeat
+        print("worker 1: dying without shutdown", flush=True)
+        os._exit(0)                   # crash: no cleanup, heartbeats stop
+
+    # rank 0: peer's heartbeat goes stale -> dead count flips to 1
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if kv.num_dead_node(1, timeout=1.5) == 1:
+            break
+        time.sleep(0.5)
+    else:
+        raise AssertionError("dead peer was never detected")
+    assert kv.num_dead_node(-1, timeout=1.5) == 1    # group form agrees
+    assert kv.num_dead_node(0, timeout=60) == 0      # self still beating
+
+    try:
+        kv.barrier(timeout=3)
+    except MXNetError as e:
+        assert "timed out" in str(e) and "1 peer" in str(e), e
+        print("worker 0: fault surface OK", flush=True)
+        os._exit(0)   # skip jax shutdown: it would wait on the dead peer
+    raise AssertionError("barrier with a dead peer did not raise")
+
+
+if __name__ == "__main__":
+    main()
