@@ -211,6 +211,15 @@ class KVStore:
         self._gc_residuals = {}
         self._compression_params = dict(compression_params)
 
+    # ------------------------------------------------------------- control
+    def _send_command_to_servers(self, head: int, body: str) -> None:
+        """Send a control command to every server node and return once all
+        have executed it (reference MXKVStoreSendCommmandToServers,
+        python/mxnet/kvstore.py:616). In the serverless TPU design each
+        process hosts its own store shard, so a single-process store IS its
+        server: execute locally."""
+        _exec_server_command(head, body, self.rank)
+
     # ------------------------------------------------------------- topology
     @property
     def rank(self) -> int:
@@ -264,6 +273,7 @@ class KVStoreDist(KVStore):
         self._hb_stop = threading.Event()
         if self._nprocs > 1:
             self._start_heartbeat()
+            self._start_command_listener()
 
     # ------------------------------------------------------- fault surface
     # The reference's ps-lite van exchanges heartbeats and the scheduler
@@ -271,6 +281,66 @@ class KVStoreDist(KVStore):
     # ps-lite postoffice UpdateHeartbeat). TPU-native: the jax.distributed
     # coordination service IS the scheduler — each rank beats a timestamp
     # into its key-value store, and liveness reads are plain KV lookups.
+
+    def _send_command_to_servers(self, head: int, body: str) -> None:
+        """Broadcast a control command to every rank's server role over the
+        coordination service and block until ALL ranks ack execution — the
+        reference's ps-lite control channel (kvstore_dist.h SendCommandToServers
+        waits on each server's reply) without servers: an atomic sequence
+        counter orders commands, every rank's listener thread executes them
+        in order and writes an ack key."""
+        if self._nprocs == 1:
+            return super()._send_command_to_servers(head, body)
+        client = _dist_client()
+        import json as _json
+        seq = client.key_value_increment("mxtpu_cmd_seq", 1)
+        client.key_value_set("mxtpu_cmd/%d" % seq,
+                             _json.dumps([int(head), str(body)]),
+                             allow_overwrite=True)
+        timeout_ms = int(float(get_env("MXNET_KVSTORE_BARRIER_TIMEOUT",
+                                       300.0)) * 1000)
+        for r in range(self._nprocs):
+            client.blocking_key_value_get("mxtpu_cmd_ack/%d/%d" % (seq, r),
+                                          timeout_ms)
+
+    _listener_started = False
+
+    def _start_command_listener(self) -> None:
+        client = _dist_client()
+        # one listener per PROCESS: the command channel is global, a second
+        # kvstore instance must not double-execute (or double-ack) commands
+        if client is None or KVStoreDist._listener_started:
+            return
+        KVStoreDist._listener_started = True
+        rank = self._rank
+
+        def listen():
+            import json as _json
+            next_seq = 1
+            while not self._hb_stop.wait(0.0):
+                try:
+                    raw = client.blocking_key_value_get(
+                        "mxtpu_cmd/%d" % next_seq, 1000)
+                except Exception:
+                    continue        # nothing yet: poll again
+                try:
+                    head, body = _json.loads(raw)
+                    _exec_server_command(int(head), body, rank)
+                    ack = "ok"
+                except Exception as e:   # command failed: still ack (the
+                    ack = "error: %r" % (e,)   # sender must not hang)
+                try:
+                    client.key_value_set(
+                        "mxtpu_cmd_ack/%d/%d" % (next_seq, rank), ack,
+                        allow_overwrite=True)
+                except Exception:
+                    return
+                next_seq += 1
+
+        t = threading.Thread(target=listen, daemon=True,
+                             name="mxtpu-kv-cmd-listener")
+        t.start()
+        self._cmd_thread = t
 
     def _start_heartbeat(self) -> None:
         client = _dist_client()
@@ -439,6 +509,40 @@ class KVStoreDist(KVStore):
 import functools
 import os
 import time
+
+
+# Server-side control commands (reference KVStoreServerProfilerCommand,
+# include/mxnet/kvstore.h:49: kSetConfig, kState, kPause, kDump — plus the
+# optimizer/controller blob channel the reference runs over the same wire).
+CMD_SET_PROFILER_CONFIG = 0
+CMD_SET_PROFILER_STATE = 1
+CMD_PROFILER_PAUSE = 2
+CMD_PROFILER_DUMP = 3
+
+_server_controller = [None]     # KVStoreServer-installed custom handler
+
+
+def set_controller(fn) -> None:
+    """Install the server-command handler (reference KVStoreServer.controller:
+    servers dispatch unrecognized command heads to the user controller)."""
+    _server_controller[0] = fn
+
+
+def _exec_server_command(head: int, body: str, rank: int) -> None:
+    """Run one control command in this process's server role."""
+    from . import profiler as _profiler
+    if head == CMD_SET_PROFILER_CONFIG:
+        _profiler._server_set_config(body, rank)
+    elif head == CMD_SET_PROFILER_STATE:
+        _profiler._server_set_state(body)
+    elif head == CMD_PROFILER_PAUSE:
+        _profiler._server_pause(body)
+    elif head == CMD_PROFILER_DUMP:
+        _profiler._server_dump(rank)
+    elif _server_controller[0] is not None:
+        _server_controller[0](head, body)
+    # unknown heads without a controller are ignored, like the reference
+    # server's default switch arm
 
 
 def _dist_client():

@@ -41,6 +41,8 @@ class KVStoreServer(object):
 
     def run(self):
         """Block for the duration of the job (reference: ps serve loop)."""
+        from . import kvstore as kv_mod
+        kv_mod.set_controller(self._controller)   # custom command heads
         logging.info("TPU kvstore server shim: no parameter-server role; "
                      "waiting for workers")
         # nothing to serve: the process simply stays alive so reference

@@ -14,6 +14,7 @@ need for any locks: the old buffer stays alive for whoever recorded it.
 """
 from __future__ import annotations
 
+import threading
 from typing import Any, Optional, Sequence, Tuple, Union
 
 import jax
@@ -27,9 +28,13 @@ from ..context import Context, current_context
 
 __all__ = ["NDArray", "array", "_wrap", "_unwrap"]
 
+_tls = threading.local()   # set by engine tasks (see operator.Custom)
+
 
 def _unwrap(x):
     if isinstance(x, NDArray):
+        if x._pending is not None:
+            x._sync()
         return x._data
     return x
 
@@ -40,7 +45,7 @@ def _wrap(data) -> "NDArray":
 
 def _to_jax(source_array, ctx: Optional[Context], dtype) -> jax.Array:
     if isinstance(source_array, NDArray):
-        data = source_array._data
+        data = _unwrap(source_array)
     elif isinstance(source_array, jax.Array):
         data = source_array
     else:
@@ -57,7 +62,8 @@ def _to_jax(source_array, ctx: Optional[Context], dtype) -> jax.Array:
 class NDArray:
     """An n-dimensional array on a device, with async execution semantics."""
 
-    __slots__ = ("_data", "_grad", "_ag_node", "_ag_slot", "_version", "__weakref__")
+    __slots__ = ("_data", "_grad", "_ag_node", "_ag_slot", "_version",
+                 "_pending", "__weakref__")
 
     # make numpy defer to our reflected operators (np_array + NDArray etc.)
     __array_priority__ = 100.0
@@ -70,6 +76,7 @@ class NDArray:
         self._ag_node = None
         self._ag_slot = 0
         self._version = 0
+        self._pending = None    # host-engine var an async writer will signal
 
     # ------------------------------------------------------------- properties
     @property
@@ -110,9 +117,31 @@ class NDArray:
         return self._grad
 
     # ------------------------------------------------------------- sync / host
+    def _sync(self) -> None:
+        """Wait for an async host-engine writer (e.g. a CustomOp dispatched
+        on the engine pool) to finish filling this array; deferred errors
+        re-raise here. Shape/dtype are known before the write completes, so
+        only VALUE reads pay this. Inside an engine task the engine's var
+        deps already order every access — and a task writing its own output
+        must not wait on itself — so the guard is skipped there."""
+        pending = self._pending
+        if pending is None or getattr(_tls, "in_engine_task", False):
+            return
+        self._pending = None
+        from .. import engine as _engine
+        try:
+            _engine.wait_var(pending)
+        except Exception as e:
+            raise MXNetError(
+                "async custom-op failure surfaced at read: %s" % e) from e
+        finally:
+            _engine.free_var(pending)
+
     def wait_to_read(self) -> None:
         """Block until all pending writes finish (reference
         NDArray::WaitToRead). Async errors raise here."""
+        if self._pending is not None:
+            self._sync()
         try:
             self._data.block_until_ready()
         except Exception as e:  # surface XLA async errors as MXNetError
@@ -169,6 +198,8 @@ class NDArray:
         self._version += 1
 
     def copyto(self, other: Union["NDArray", Context]) -> "NDArray":
+        if self._pending is not None:
+            self._sync()
         if isinstance(other, Context):
             return NDArray(jax.device_put(self._data, other.jax_device()))
         other._set_data(jax.device_put(self._data, list(other._data.devices())[0]))
@@ -182,15 +213,15 @@ class NDArray:
     as_in_ctx = as_in_context
 
     def copy(self) -> "NDArray":
-        return NDArray(jnp.copy(self._data))
+        return NDArray(jnp.copy(_unwrap(self)))
 
     def astype(self, dtype, copy=True) -> "NDArray":
         if not copy and self.dtype == np.dtype(dtype):
             return self
-        return NDArray(self._data.astype(jnp.dtype(dtype)))
+        return NDArray(_unwrap(self).astype(jnp.dtype(dtype)))
 
     def detach(self) -> "NDArray":
-        out = NDArray(self._data)
+        out = NDArray(_unwrap(self))
         return out
 
     def attach_grad(self, grad_req: str = "write", stype=None) -> None:
@@ -208,16 +239,18 @@ class NDArray:
     # ------------------------------------------------------------- indexing
     def __getitem__(self, key) -> "NDArray":
         if isinstance(key, NDArray):
-            key = key._data
+            key = _unwrap(key)
             if jnp.issubdtype(key.dtype, jnp.floating):
                 key = key.astype(jnp.int32)
-        return NDArray(self._data[key])
+        return NDArray(_unwrap(self)[key])
 
     def __setitem__(self, key, value) -> None:
+        if self._pending is not None:
+            self._sync()    # writes must order AFTER the async fill
         if isinstance(key, NDArray):
-            key = key._data.astype(jnp.int32)
+            key = _unwrap(key).astype(jnp.int32)
         if isinstance(value, NDArray):
-            value = value._data
+            value = _unwrap(value)
         if isinstance(key, slice) and key == slice(None) and not np.isscalar(value):
             value = jnp.asarray(value, dtype=self._data.dtype)
             self._set_data(jnp.broadcast_to(value, self.shape).astype(self._data.dtype))

@@ -4,9 +4,14 @@ Reference parity: ``python/mxnet/operator.py`` (CustomOp/CustomOpProp/register)
 backed by ``src/operator/custom/custom-inl.h:50-170`` — the reference runs
 Python callbacks on a dedicated thread pool so they can't deadlock the engine.
 
-TPU-first: the imperative path runs the callback eagerly and records a tape
-node whose vjp calls ``CustomOp.backward`` (same plumbing as
-``autograd.Function``). The symbolic path registers a ``Custom`` op whose
+TPU-first: the imperative path dispatches ``forward`` on the host
+dependency engine (reference CustomOperator worker pool) with const vars
+for async inputs and a fresh mutable var per output — the call returns
+immediately, the callback overlaps device work, and readers synchronize
+through ``NDArray._sync``/``wait_to_read``/``engine.wait_all``. The tape
+node's vjp calls ``CustomOp.backward`` inline (its cotangents are consumed
+synchronously by the surrounding backward pass, so dispatching it would
+buy nothing). The symbolic path registers a ``Custom`` op whose
 compute is a ``jax.pure_callback`` — a host-callback sync region inside the
 otherwise fused XLA program, exactly the "explicit sync region" noted in
 SURVEY.md hard part #5. Gradients through the symbolic path are supported
@@ -18,7 +23,7 @@ from typing import Any, Dict, List, Sequence
 
 import numpy as np
 
-from .base import MXNetError
+from .base import MXNetError, get_env
 
 __all__ = ["CustomOp", "CustomOpProp", "register", "get_prop_cls", "Custom"]
 
@@ -149,10 +154,43 @@ def Custom(*inputs, **kwargs):
 
     out_data = [nd_zeros(tuple(s), dtype=t)
                 for s, t in zip(out_shapes, out_types)]
-    with autograd.pause():
-        op.forward(is_train=autograd.is_training(),
-                   req=["write"] * len(out_data),
-                   in_data=in_data, out_data=out_data, aux=aux)
+    is_train = autograd.is_training()
+
+    def _run_forward():
+        from .ndarray import ndarray as _ndimpl
+        _ndimpl._tls.in_engine_task = True
+        try:
+            with autograd.pause():
+                op.forward(is_train=is_train, req=["write"] * len(out_data),
+                           in_data=in_data, out_data=out_data, aux=aux)
+        finally:
+            _ndimpl._tls.in_engine_task = False
+
+    from . import engine as _engine
+    if _engine.is_naive() or str(get_env("MXNET_CUSTOM_OP_ASYNC", 1)) in \
+            ("0", "False", "false"):
+        # deterministic replay / explicit opt-out: run on the calling thread
+        _run_forward()
+    else:
+        # dispatch on the host dependency engine, the reference's dedicated
+        # CustomOperator thread pool (src/operator/custom/custom-inl.h:
+        # 50-170): the call returns immediately and the callback overlaps
+        # with device work. Inputs still being filled by earlier async ops
+        # contribute their vars as const deps; each output (and mutable aux)
+        # gets a fresh var a reader blocks on via NDArray._sync().
+        const_vars = [x._pending for x in in_data if x._pending is not None]
+        out_vars = [_engine.new_var() for _ in out_data]
+        aux_vars = []
+        for a in aux:   # aux is mutated in place by the callback
+            if a._pending is not None:
+                a._sync()   # serialize chained writers of the same aux
+            aux_vars.append(_engine.new_var())
+        for o, v in zip(out_data, out_vars):
+            o._pending = v
+        for a, v in zip(aux, aux_vars):
+            a._pending = v
+        _engine.push(_run_forward, const_vars=const_vars,
+                     mutable_vars=out_vars + aux_vars)
 
     if autograd.is_recording():
         st = autograd._st()

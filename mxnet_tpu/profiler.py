@@ -24,7 +24,8 @@ from typing import Dict, List, Optional
 from .base import get_env
 
 __all__ = ["set_config", "start", "stop", "pause", "resume", "dump", "dumps",
-           "Domain", "Task", "Event", "Counter", "Marker", "profiler_set_state"]
+           "Domain", "Task", "Event", "Counter", "Marker", "profiler_set_state",
+           "set_state", "set_kvstore_handle"]
 
 _lock = threading.Lock()
 
@@ -48,10 +49,82 @@ class _ProfilerState:
 _prof = _ProfilerState()
 
 
+# ---- server-process profiling over the kvstore control channel -----------
+# Reference: profiler commands ride the ps-lite control wire to server nodes
+# (KVStoreServerProfilerCommand, include/mxnet/kvstore.h:49; exercised by
+# tests/nightly/test_server_profiling.py). TPU-native: "servers" are every
+# rank's in-process store shard; commands broadcast through the coordination
+# service (kvstore._send_command_to_servers) and each rank applies them to
+# its server-role profile state below.
+
+profiler_kvstore_handle = None
+
+# the server role shares the process-wide event stream but owns its state:
+# config/run/pause arriving on the control channel never clobber what the
+# local worker-side profiler is doing
+_server = {"filename": "server_profile.json", "running": False,
+           "paused": False, "started_engine": False}
+
+
+def set_kvstore_handle(kvstore) -> None:
+    """Register the kvstore whose control channel carries
+    profile_process='server' commands (reference profiler.py:29)."""
+    global profiler_kvstore_handle
+    profiler_kvstore_handle = kvstore
+
+
+def _send_server_cmd(head: int, body: str) -> None:
+    from .base import MXNetError
+    if profiler_kvstore_handle is None:
+        raise MXNetError(
+            "profile_process='server' needs a dist kvstore registered via "
+            "profiler.set_kvstore_handle(kv)")
+    profiler_kvstore_handle._send_command_to_servers(head, body)
+
+
+def _server_set_config(body: str, rank: int) -> None:
+    cfg = json.loads(body)
+    with _lock:
+        fname = cfg.get("filename")
+        if fname:
+            _server["filename"] = "rank%d_%s" % (rank, fname)
+
+
+def _server_set_state(body: str) -> None:
+    st = json.loads(body).get("state", "stop")
+    if st == "run":
+        _server["running"] = True
+        if not _prof.running:           # share the process event stream
+            start()
+            _server["started_engine"] = True
+    else:
+        _server["running"] = False
+        if _server["started_engine"]:
+            stop()
+            _server["started_engine"] = False
+
+
+def _server_pause(body: str) -> None:
+    _server["paused"] = bool(json.loads(body).get("paused", True))
+
+
+def _server_dump(rank: int) -> None:
+    with _lock:
+        trace = {"traceEvents": list(_prof.events), "displayTimeUnit": "ms"}
+    with open(_server["filename"], "w") as f:
+        json.dump(trace, f)
+
+
 def set_config(profile_all=False, profile_symbolic=False, profile_imperative=False,
                profile_memory=False, profile_api=False, filename="profile.json",
                aggregate_stats=False, profile_process="worker",
                xla_trace_dir=None, **kwargs):
+    if profile_process == "server":
+        from .kvstore import CMD_SET_PROFILER_CONFIG
+        _send_server_cmd(CMD_SET_PROFILER_CONFIG,
+                         json.dumps({"filename": filename,
+                                     "profile_all": bool(profile_all)}))
+        return
     with _lock:
         _prof.filename = filename
         _prof.aggregate = aggregate_stats
@@ -147,10 +220,18 @@ def _merge_xla_trace(trace_dir: str) -> int:
 
 
 def pause(profile_process="worker"):
+    if profile_process == "server":
+        from .kvstore import CMD_PROFILER_PAUSE
+        return _send_server_cmd(CMD_PROFILER_PAUSE,
+                                json.dumps({"paused": True}))
     _prof.paused = True
 
 
 def resume(profile_process="worker"):
+    if profile_process == "server":
+        from .kvstore import CMD_PROFILER_PAUSE
+        return _send_server_cmd(CMD_PROFILER_PAUSE,
+                                json.dumps({"paused": False}))
     _prof.paused = False
 
 
@@ -159,6 +240,17 @@ def profiler_set_state(state="stop"):
         start()
     else:
         stop()
+
+
+def set_state(state="stop", profile_process="worker"):
+    """Reference mx.profiler.set_state: run/stop the worker profiler, or —
+    with profile_process='server' — every server role over the kvstore
+    control channel (tests/nightly/test_server_profiling.py)."""
+    if profile_process == "server":
+        from .kvstore import CMD_SET_PROFILER_STATE
+        return _send_server_cmd(CMD_SET_PROFILER_STATE,
+                                json.dumps({"state": state}))
+    profiler_set_state(state)
 
 
 def is_active(kind: str = "imperative") -> bool:
@@ -214,6 +306,9 @@ def dumps(reset=False) -> str:
 
 def dump(finished=True, profile_process="worker"):
     """Write the chrome trace JSON (load in chrome://tracing / Perfetto)."""
+    if profile_process == "server":
+        from .kvstore import CMD_PROFILER_DUMP
+        return _send_server_cmd(CMD_PROFILER_DUMP, "")
     with _lock:
         trace = {"traceEvents": list(_prof.events), "displayTimeUnit": "ms"}
         with open(_prof.filename, "w") as f:

@@ -1,0 +1,59 @@
+"""Server-side profiler control over the kvstore control channel.
+
+Model: reference ``tests/nightly/test_server_profiling.py`` — rank 0 turns
+profiling on/off on every server node via KVStoreServerProfilerCommand
+(include/mxnet/kvstore.h:49) and each node ends up with a parseable
+chrome-trace file. Here every rank hosts its own server role; commands
+broadcast through the coordination service and each rank writes
+``rank<r>_<suffix>`` in its own working directory.
+"""
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PYTHONPATH", None)
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import profiler  # noqa: E402
+
+
+def main():
+    os.chdir(tempfile.mkdtemp(prefix="mxtpu_srvprof_"))
+    kv = mx.kv.create("dist_sync")
+    rank, nw = kv.rank, kv.num_workers
+    profiler.set_kvstore_handle(kv)
+
+    suffix = "test_profile_server.json"
+    if rank == 0:
+        profiler.set_config(filename=suffix, profile_all=True,
+                            profile_process="server")
+        profiler.set_state(state="run", profile_process="server")
+
+    kv.barrier()                        # config/run applied everywhere
+    kv.init("w", mx.nd.zeros((8, 8)))
+    kv.push("w", mx.nd.ones((8, 8)) * (rank + 1))
+    out = mx.nd.zeros((8, 8))
+    kv.pull("w", out=out)
+    assert abs(float(out.asnumpy()[0, 0]) - nw * (nw + 1) / 2) < 1e-5
+
+    kv.barrier()
+    if rank == 0:
+        profiler.set_state(state="stop", profile_process="server")
+        profiler.dump(profile_process="server")   # blocks until all ranks ack
+    kv.barrier()
+
+    fname = "rank%d_%s" % (rank, suffix)
+    assert os.path.exists(fname), fname
+    with open(fname) as f:
+        trace = json.load(f)              # must be proper chrome-trace JSON
+    assert "traceEvents" in trace
+    print(f"worker {rank}/{nw}: server profiling OK", flush=True)
+    os._exit(0)     # listener threads may hold the coordination client
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,110 @@
+"""Async CustomOp dispatch on the host dependency engine.
+
+Reference: the CustomOperator singleton runs frontend callbacks on its own
+worker pool with engine var deps (src/operator/custom/custom-inl.h:50-170),
+so a slow Python op never serializes against device work. VERDICT r3 #10.
+"""
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, engine
+from mxnet_tpu.base import MXNetError
+
+
+class _SlowScale(mx.operator.CustomOp):
+    def __init__(self, delay, factor):
+        self._delay = float(delay)
+        self._factor = float(factor)
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        time.sleep(self._delay)
+        self.assign(out_data[0], req[0], in_data[0] * self._factor)
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        self.assign(in_grad[0], req[0], out_grad[0] * self._factor)
+
+
+@mx.operator.register("_test_slow_scale")
+class _SlowScaleProp(mx.operator.CustomOpProp):
+    def __init__(self, delay="0.0", factor="2.0"):
+        super().__init__(need_top_grad=True)
+        self._delay = delay
+        self._factor = factor
+
+    def list_arguments(self):
+        return ["data"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return _SlowScale(self._delay, self._factor)
+
+
+def test_dispatch_returns_immediately_and_overlaps_device_work():
+    """Custom() must hand the slow callback to the engine pool and return;
+    device work issued right after runs DURING the callback's sleep."""
+    x = nd.ones((8, 8))
+    delay = 0.8
+    t0 = time.perf_counter()
+    out = nd.Custom(x, op_type="_test_slow_scale", delay=delay, factor=3.0)
+    t_dispatch = time.perf_counter() - t0
+    assert t_dispatch < delay / 2, \
+        f"dispatch blocked for {t_dispatch:.2f}s — forward ran inline"
+    # overlapping device work completes while the callback sleeps
+    dev = nd.dot(nd.ones((64, 64)), nd.ones((64, 64)))
+    dev.wait_to_read()
+    np.testing.assert_allclose(out.asnumpy(), 3.0)     # sync point
+    total = time.perf_counter() - t0
+    assert total < 2 * delay, f"no overlap: {total:.2f}s"
+
+
+def test_chained_async_ops_order_through_engine_vars():
+    """Op B consuming op A's still-pending output must wait for A via the
+    const-var dependency, not read the placeholder."""
+    x = nd.ones((4, 4))
+    a = nd.Custom(x, op_type="_test_slow_scale", delay=0.3, factor=2.0)
+    b = nd.Custom(a, op_type="_test_slow_scale", delay=0.0, factor=5.0)
+    np.testing.assert_allclose(b.asnumpy(), 10.0)
+    np.testing.assert_allclose(a.asnumpy(), 2.0)
+
+
+def test_pool_runs_independent_ops_concurrently():
+    xs = [nd.ones((2, 2)) * i for i in range(1, 4)]
+    t0 = time.perf_counter()
+    outs = [nd.Custom(x, op_type="_test_slow_scale", delay=0.5, factor=2.0)
+            for x in xs]
+    for i, o in enumerate(outs, 1):
+        np.testing.assert_allclose(o.asnumpy(), 2.0 * i)
+    total = time.perf_counter() - t0
+    assert total < 1.25, f"three 0.5s ops took {total:.2f}s — pool serialized"
+
+
+def test_waitall_drains_async_custom_ops():
+    x = nd.ones((2, 2))
+    out = nd.Custom(x, op_type="_test_slow_scale", delay=0.2, factor=4.0)
+    nd.waitall()
+    np.testing.assert_allclose(out.asnumpy(), 4.0)
+
+
+def test_backward_through_async_forward():
+    x = nd.ones((3, 3))
+    x.attach_grad()
+    with mx.autograd.record():
+        y = nd.Custom(x, op_type="_test_slow_scale", delay=0.1, factor=2.0)
+        s = y.sum()
+    s.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 2.0)
+
+
+def test_naive_mode_forces_inline_execution():
+    with engine.naive_mode():
+        t0 = time.perf_counter()
+        out = nd.Custom(nd.ones((2, 2)), op_type="_test_slow_scale",
+                        delay=0.3, factor=2.0)
+        t_call = time.perf_counter() - t0
+        assert t_call >= 0.28, "naive mode must run the callback inline"
+        np.testing.assert_allclose(out.asnumpy(), 2.0)

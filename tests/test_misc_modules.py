@@ -166,3 +166,34 @@ def test_context_memory_info():
     info = mx.cpu().memory_info()
     assert "device" in info and info["live_arrays"] >= 1
     assert info["live_array_bytes"] >= 256 * 256 * 4
+
+
+def test_server_profiler_commands_local(tmp_path, monkeypatch):
+    """profile_process='server' routes through the kvstore control channel;
+    a single-process store executes its own server role (reference
+    KVStoreServerProfilerCommand, include/mxnet/kvstore.h:49)."""
+    from mxnet_tpu import profiler
+    monkeypatch.chdir(tmp_path)
+    kv = mx.kv.create("local")
+    profiler.set_kvstore_handle(kv)
+    try:
+        profiler.set_config(filename="srv.json", profile_all=True,
+                            profile_process="server")
+        profiler.set_state(state="run", profile_process="server")
+        mx.nd.ones((4, 4)).asnumpy()
+        profiler.pause(profile_process="server")
+        profiler.resume(profile_process="server")
+        profiler.set_state(state="stop", profile_process="server")
+        profiler.dump(profile_process="server")
+        import json as _json
+        with open("rank0_srv.json") as f:
+            assert "traceEvents" in _json.load(f)
+    finally:
+        profiler.set_kvstore_handle(None)
+
+
+def test_server_profiler_requires_kvstore_handle():
+    from mxnet_tpu import profiler
+    profiler.set_kvstore_handle(None)
+    with pytest.raises(mx.base.MXNetError, match="set_kvstore_handle"):
+        profiler.set_state(state="run", profile_process="server")
