@@ -15,6 +15,9 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.join(ROOT, "example", "recommenders"))
 sys.path.insert(0, os.path.join(ROOT, "example", "gluon"))
 sys.path.insert(0, os.path.join(ROOT, "example", "ctc"))
+sys.path.insert(0, os.path.join(ROOT, "example", "rcnn"))
+sys.path.insert(0, os.path.join(ROOT, "example", "neural-style"))
+sys.path.insert(0, os.path.join(ROOT, "example", "bi-lstm-sort"))
 
 
 def test_matrix_factorization_converges():
@@ -73,3 +76,32 @@ def test_ctc_loss_symbolic_matches_imperative():
     np.testing.assert_allclose(
         e.forward()[0].asnumpy(),
         ctc(mx.nd.array(lg), mx.nd.array(lb)).asnumpy(), rtol=1e-4)
+
+
+def test_mini_rcnn_detects():
+    """Two-stage detector (RPN -> MultiProposal -> ROIPooling -> heads)
+    trains to localize synthetic rectangles (reference example/rcnn;
+    VERDICT r3 #8)."""
+    import mini_rcnn
+    first, last, iou = mini_rcnn.train(steps=80, verbose=False)
+    assert last < first * 0.2, (first, last)
+    assert iou > 0.5, iou
+
+
+def test_neural_style_optimizes_input():
+    """Gradient-descent ON THE IMAGE: content+Gram style losses shrink 10x
+    (reference example/neural-style; exercises gradient-wrt-input)."""
+    import neural_style
+    first, last, img = neural_style.train(steps=60, verbose=False)
+    assert last < first * 0.1, (first, last)
+    assert np.isfinite(img.asnumpy()).all()
+
+
+def test_bi_lstm_sort_learns():
+    """Bidirectional LSTM seq2seq sorting through BucketingModule: two
+    bucket lengths share parameters and reach >=90% per-digit accuracy
+    (reference example/bi-lstm-sort)."""
+    import lstm_sort
+    first, last = lstm_sort.train(epochs=30, verbose=False)
+    assert last > 0.9, (first, last)
+    assert last > first + 0.3
