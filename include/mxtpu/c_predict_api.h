@@ -101,6 +101,51 @@ int MXNDListGet(NDListHandle handle, mx_uint index, const char **out_key,
 /*! \brief Free the list. */
 int MXNDListFree(NDListHandle handle);
 
+/*
+ * NDArray + operator invocation — the minimal slice of the reference's full
+ * c_api.h (MXNDArrayCreate / MXNDArraySyncCopyFromCPU / MXNDArraySyncCopyToCPU
+ * / MXImperativeInvoke / MXListAllOpNames) that lets a C host build arrays
+ * and call ANY registered operator, not just replay a frozen graph.
+ */
+typedef void *NDArrayHandle;
+
+/*! \brief Zero-filled array; dtype e.g. "float32" (NULL = float32). */
+int MXTPUNDArrayCreate(const mx_uint *shape, mx_uint ndim, const char *dtype,
+                       NDArrayHandle *out);
+
+/*! \brief float32 array initialized from the caller's buffer. */
+int MXTPUNDArrayFromData(const mx_uint *shape, mx_uint ndim,
+                         const mx_float *data, NDArrayHandle *out);
+
+/*! \brief Shape; pointers owned by the handle, valid until its next call. */
+int MXTPUNDArrayGetShape(NDArrayHandle handle, mx_uint **shape_data,
+                         mx_uint *ndim);
+
+/*! \brief Copy the array (as float32) into the caller's buffer of `size`
+ *         elements; errors if the element counts differ. */
+int MXTPUNDArrayGetData(NDArrayHandle handle, mx_float *data, mx_uint size);
+
+/*! \brief Free the array handle. */
+int MXTPUNDArrayFree(NDArrayHandle handle);
+
+/*! \brief Drain async work; deferred async errors surface here as -1. */
+int MXTPUNDArrayWaitAll();
+
+/*! \brief All registered operator names (process-lifetime buffers). */
+int MXTPUListOps(mx_uint *out_size, const char ***out_array);
+
+/*!
+ * \brief Run operator `op_name` on `inputs`, attrs as parallel string
+ * key/value arrays (reference MXImperativeInvoke wire convention). Writes up
+ * to `out_capacity` fresh handles into `outputs`; fails if the op produces
+ * more.
+ */
+int MXTPUImperativeInvoke(const char *op_name, mx_uint num_inputs,
+                          NDArrayHandle *inputs, mx_uint num_params,
+                          const char **param_keys, const char **param_vals,
+                          mx_uint out_capacity, NDArrayHandle *outputs,
+                          mx_uint *num_outputs);
+
 #ifdef __cplusplus
 }  // extern "C"
 #endif

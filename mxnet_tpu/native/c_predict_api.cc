@@ -402,4 +402,192 @@ int MXNDListFree(NDListHandle handle) {
   return 0;
 }
 
+// ---------------------------------------------------------------------------
+// NDArray + operator-invoke surface: the minimal slice of the reference's
+// full c_api.h (MXNDArrayCreate / MXNDArraySyncCopy* / MXImperativeInvoke /
+// MXListAllOpNames) that lets a C host BUILD arrays and RUN operators
+// instead of only replaying a frozen graph.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct NDHandle {
+  PyObject *obj;                     // bridge CNDArray
+  std::vector<mx_uint> shape_buf;
+};
+
+PyObject *shape_tuple(const mx_uint *shape, mx_uint ndim) {
+  PyObject *t = PyTuple_New(ndim);
+  for (mx_uint i = 0; i < ndim; ++i)
+    PyTuple_SET_ITEM(t, i, PyLong_FromUnsignedLong(shape[i]));
+  return t;
+}
+
+}  // namespace
+
+typedef void *NDArrayHandle;
+
+int MXTPUNDArrayCreate(const mx_uint *shape, mx_uint ndim, const char *dtype,
+                       NDArrayHandle *out) {
+  GIL gil;
+  PyObject *mod = bridge_module();
+  if (!mod) return -1;
+  PyObject *cls = PyObject_GetAttrString(mod, "CNDArray");
+  if (!cls) { set_error_from_python(); return -1; }
+  PyObject *t = shape_tuple(shape, ndim);
+  PyObject *obj = PyObject_CallFunction(cls, "Os", t,
+                                        dtype ? dtype : "float32");
+  Py_DECREF(cls);
+  Py_DECREF(t);
+  if (!obj) { set_error_from_python(); return -1; }
+  *out = new NDHandle{obj, {}};
+  return 0;
+}
+
+int MXTPUNDArrayFromData(const mx_uint *shape, mx_uint ndim,
+                         const mx_float *data, NDArrayHandle *out) {
+  GIL gil;
+  PyObject *mod = bridge_module();
+  if (!mod) return -1;
+  PyObject *cls = PyObject_GetAttrString(mod, "CNDArray");
+  if (!cls) { set_error_from_python(); return -1; }
+  size_t n = 1;
+  for (mx_uint i = 0; i < ndim; ++i) n *= shape[i];
+  PyObject *t = shape_tuple(shape, ndim);
+  PyObject *bytes = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char *>(data), sizeof(mx_float) * n);
+  PyObject *obj = PyObject_CallFunction(cls, "OsO", t, "float32", bytes);
+  Py_DECREF(cls);
+  Py_DECREF(t);
+  Py_DECREF(bytes);
+  if (!obj) { set_error_from_python(); return -1; }
+  *out = new NDHandle{obj, {}};
+  return 0;
+}
+
+int MXTPUNDArrayGetShape(NDArrayHandle handle, mx_uint **shape_data,
+                         mx_uint *ndim) {
+  GIL gil;
+  auto *h = static_cast<NDHandle *>(handle);
+  PyObject *shape = PyObject_CallMethod(h->obj, "shape", nullptr);
+  if (!shape) { set_error_from_python(); return -1; }
+  Py_ssize_t n = PyTuple_Size(shape);
+  h->shape_buf.resize(n);
+  for (Py_ssize_t i = 0; i < n; ++i)
+    h->shape_buf[i] =
+        static_cast<mx_uint>(PyLong_AsLong(PyTuple_GET_ITEM(shape, i)));
+  Py_DECREF(shape);
+  *shape_data = h->shape_buf.data();
+  *ndim = static_cast<mx_uint>(n);
+  return 0;
+}
+
+int MXTPUNDArrayGetData(NDArrayHandle handle, mx_float *data, mx_uint size) {
+  GIL gil;
+  auto *h = static_cast<NDHandle *>(handle);
+  PyObject *bytes = PyObject_CallMethod(h->obj, "to_bytes", nullptr);
+  if (!bytes) { set_error_from_python(); return -1; }
+  char *buf = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(bytes, &buf, &len) != 0) {
+    Py_DECREF(bytes);
+    set_error_from_python();
+    return -1;
+  }
+  if (static_cast<size_t>(len) != sizeof(mx_float) * size) {
+    Py_DECREF(bytes);
+    set_error("MXTPUNDArrayGetData: size mismatch (array has " +
+              std::to_string(len / sizeof(mx_float)) + " floats, caller asked "
+              + std::to_string(size) + ")");
+    return -1;
+  }
+  std::memcpy(data, buf, len);
+  Py_DECREF(bytes);
+  return 0;
+}
+
+int MXTPUNDArrayFree(NDArrayHandle handle) {
+  GIL gil;
+  auto *h = static_cast<NDHandle *>(handle);
+  Py_XDECREF(h->obj);
+  delete h;
+  return 0;
+}
+
+int MXTPUNDArrayWaitAll() {
+  GIL gil;
+  PyObject *mod = bridge_module();
+  if (!mod) return -1;
+  PyObject *r = PyObject_CallMethod(mod, "nd_waitall", nullptr);
+  if (!r) { set_error_from_python(); return -1; }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTPUListOps(mx_uint *out_size, const char ***out_array) {
+  GIL gil;
+  // process-lifetime buffers: the registry is append-only, names are stable
+  static std::vector<std::string> storage;
+  static std::vector<const char *> ptrs;
+  PyObject *mod = bridge_module();
+  if (!mod) return -1;
+  PyObject *names = PyObject_CallMethod(mod, "nd_list_ops", nullptr);
+  if (!names) { set_error_from_python(); return -1; }
+  Py_ssize_t n = PyList_Size(names);
+  storage.clear();
+  storage.reserve(n);
+  for (Py_ssize_t i = 0; i < n; ++i)
+    storage.emplace_back(PyUnicode_AsUTF8(PyList_GET_ITEM(names, i)));
+  Py_DECREF(names);
+  ptrs.clear();
+  for (auto &s : storage) ptrs.push_back(s.c_str());
+  *out_size = static_cast<mx_uint>(n);
+  *out_array = ptrs.data();
+  return 0;
+}
+
+int MXTPUImperativeInvoke(const char *op_name, mx_uint num_inputs,
+                          NDArrayHandle *inputs, mx_uint num_params,
+                          const char **param_keys, const char **param_vals,
+                          mx_uint out_capacity, NDArrayHandle *outputs,
+                          mx_uint *num_outputs) {
+  GIL gil;
+  PyObject *mod = bridge_module();
+  if (!mod) return -1;
+  PyObject *arrs = PyList_New(num_inputs);
+  for (mx_uint i = 0; i < num_inputs; ++i) {
+    PyObject *o = static_cast<NDHandle *>(inputs[i])->obj;
+    Py_INCREF(o);
+    PyList_SET_ITEM(arrs, i, o);
+  }
+  PyObject *keys = PyList_New(num_params);
+  PyObject *vals = PyList_New(num_params);
+  for (mx_uint i = 0; i < num_params; ++i) {
+    PyList_SET_ITEM(keys, i, PyUnicode_FromString(param_keys[i]));
+    PyList_SET_ITEM(vals, i, PyUnicode_FromString(param_vals[i]));
+  }
+  PyObject *res = PyObject_CallMethod(mod, "nd_invoke", "sOOO", op_name,
+                                      arrs, keys, vals);
+  Py_DECREF(arrs);
+  Py_DECREF(keys);
+  Py_DECREF(vals);
+  if (!res) { set_error_from_python(); return -1; }
+  Py_ssize_t n = PyList_Size(res);
+  if (static_cast<mx_uint>(n) > out_capacity) {
+    Py_DECREF(res);
+    set_error("MXTPUImperativeInvoke: op produced " + std::to_string(n) +
+              " outputs, caller provided room for " +
+              std::to_string(out_capacity));
+    return -1;
+  }
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *o = PyList_GET_ITEM(res, i);
+    Py_INCREF(o);
+    outputs[i] = new NDHandle{o, {}};
+  }
+  Py_DECREF(res);
+  *num_outputs = static_cast<mx_uint>(n);
+  return 0;
+}
+
 }  // extern "C"
