@@ -105,3 +105,45 @@ def test_bi_lstm_sort_learns():
     first, last = lstm_sort.train(epochs=30, verbose=False)
     assert last > 0.9, (first, last)
     assert last > first + 0.3
+
+
+def test_fgsm_attack_degrades_accuracy():
+    """FGSM (reference example/adversary): input-gradient perturbation must
+    break a trained convnet — clean acc high, adversarial acc collapsed."""
+    sys.path.insert(0, os.path.join(ROOT, "example", "adversary"))
+    import fgsm
+    clean, adv = fgsm.run(epochs=8, verbose=False)
+    assert clean > 0.9, clean
+    assert adv < clean - 0.3, (clean, adv)
+
+
+def test_svm_classifier_learns():
+    """SVMOutput hinge-loss head (reference example/svm_mnist) trains a
+    blob classifier via the Module fit loop."""
+    sys.path.insert(0, os.path.join(ROOT, "example", "svm_mnist"))
+    import svm_classifier
+    first, last = svm_classifier.train(epochs=10, verbose=False)
+    assert last > 0.85, (first, last)
+    # the L1-hinge variant must train too
+    first_l1, last_l1 = svm_classifier.train(epochs=10, use_linear=True,
+                                             seed=1, verbose=False)
+    assert last_l1 > 0.8, (first_l1, last_l1)
+
+
+def test_multitask_both_heads_learn():
+    """sym.Group two-head training (reference example/multi-task): both
+    losses backprop into the shared trunk and both accuracies rise."""
+    sys.path.insert(0, os.path.join(ROOT, "example", "multi-task"))
+    import multitask
+    (c0, c1), (p0, p1) = multitask.train(epochs=10, verbose=False)
+    assert c1 > 0.85, (c0, c1)
+    assert p1 > 0.85, (p0, p1)
+
+
+def test_numpy_custom_op_trains():
+    """A numpy CustomOp output layer (reference example/numpy-ops) supplies
+    its own gradient (need_top_grad=False) and the net still learns."""
+    sys.path.insert(0, os.path.join(ROOT, "example", "numpy-ops"))
+    import custom_softmax
+    first, last = custom_softmax.train(epochs=10, verbose=False)
+    assert last > 0.85, (first, last)
