@@ -168,8 +168,29 @@ def run_bench():
     # not what we measure — transfer exactly once.
     from jax.sharding import NamedSharding, PartitionSpec as P
     spec = NamedSharding(trainer.mesh, P("dp"))
+    # AOT executable reuse: the fused step takes minutes to compile over a
+    # remote-compile tunnel and the persistent HLO cache does NOT survive
+    # across processes there — but a serialized executable does
+    # (tools/aot_warm.py writes it outside the bench window). Exactly one
+    # compile ever happens: aot_save IS the compile when the blob is cold.
+    aot_path = os.environ.get(
+        "BENCH_AOT", os.path.join(HERE, ".bench_aot", "resnet50_step.pkl"))
     t_compile = time.perf_counter()
-    loss = trainer.step(x, y)  # capture + lower + compile (first call)
+    loaded = False
+    try:
+        os.makedirs(os.path.dirname(aot_path), exist_ok=True)
+        loaded = trainer.aot_load(aot_path, x, y)
+    except Exception as e:
+        print("aot_load failed (will compile): %s" % e, file=sys.stderr)
+    if loaded:
+        print("AOT executable loaded in %.1fs (compile skipped)"
+              % (time.perf_counter() - t_compile), file=sys.stderr, flush=True)
+    else:
+        try:
+            trainer.aot_save(aot_path, x, y)
+        except Exception as e:
+            print("aot_save failed (jit fallback): %s" % e, file=sys.stderr)
+    loss = trainer.step(x, y)  # AOT: runs the executable; else jit-compiles
     float(loss)
     print("first step (compile) took %.1fs" % (time.perf_counter() - t_compile),
           file=sys.stderr, flush=True)

@@ -1,0 +1,84 @@
+"""AOT executable serialization for the fused data-parallel step.
+
+The remote-compile TPU backend takes minutes to compile the ResNet-50 step
+and its persistent HLO cache does not survive across processes; the
+serialized-executable path (``DataParallelTrainer.aot_save``/``aot_load``)
+is what lets a fresh process (the driver's bench window) skip compilation.
+Here we verify the mechanism end to end on the CPU mesh: save, reload in a
+fresh trainer, numerical equivalence with the jit path, and key-mismatch
+rejection.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, parallel
+from mxnet_tpu.gluon import nn
+
+
+def _make(seed=0):
+    mx.random.seed(seed)
+    np.random.seed(seed)
+    # fixed prefixes: param names are part of the executable's input
+    # pytree, and a fresh process (the real AOT consumer) starts naming
+    # from zero — mimic that determinism here
+    net = nn.HybridSequential(prefix="aotnet_")
+    net.add(nn.Dense(16, activation="relu", prefix="aotd0_"),
+            nn.Dense(4, prefix="aotd1_"))
+    net.initialize(mx.init.Xavier())
+    loss = gluon.loss.SoftmaxCrossEntropyLoss()
+    return parallel.DataParallelTrainer(net, loss, "sgd",
+                                        {"learning_rate": 0.1})
+
+
+def _batch(rng, b=8):
+    return (rng.randn(b, 12).astype("float32"),
+            rng.randint(0, 4, (b,)).astype("float32"))
+
+
+def test_aot_roundtrip_matches_jit(tmp_path):
+    rng = np.random.RandomState(0)
+    x, y = _batch(rng)
+    path = str(tmp_path / "step.pkl")
+
+    t1 = _make(seed=3)
+    t1.aot_save(path, x, y)
+    assert os.path.exists(path)
+    losses_aot = [float(t1.step(x, y)) for _ in range(3)]
+
+    # a FRESH trainer (same init seed) loads the executable instead of
+    # compiling and produces the identical trajectory
+    t2 = _make(seed=3)
+    assert t2.aot_load(path, x, y)
+    assert t2._compiled is not None
+    losses_loaded = [float(t2.step(x, y)) for _ in range(3)]
+    np.testing.assert_allclose(losses_aot, losses_loaded, rtol=1e-5)
+
+    # and the plain jit path agrees too
+    t3 = _make(seed=3)
+    losses_jit = [float(t3.step(x, y)) for _ in range(3)]
+    np.testing.assert_allclose(losses_aot, losses_jit, rtol=1e-5)
+
+
+def test_aot_load_rejects_mismatched_key(tmp_path):
+    rng = np.random.RandomState(0)
+    x, y = _batch(rng)
+    path = str(tmp_path / "step.pkl")
+    t1 = _make()
+    t1.aot_save(path, x, y)
+
+    # different batch shape -> key mismatch -> clean refusal, jit fallback
+    x2, y2 = _batch(rng, b=16)
+    t2 = _make()
+    assert not t2.aot_load(path, x2, y2)
+    assert t2._compiled is None
+    assert np.isfinite(float(t2.step(x2, y2)))
+
+
+def test_aot_load_missing_file_is_false(tmp_path):
+    rng = np.random.RandomState(0)
+    x, y = _batch(rng)
+    t = _make()
+    assert not t.aot_load(str(tmp_path / "nope.pkl"), x, y)

@@ -1,0 +1,73 @@
+#!/usr/bin/env python
+"""Pre-compile the bench's fused ResNet-50 train step and serialize the
+executable so ``bench.py`` (the driver's 10-minute window) skips XLA
+compilation entirely.
+
+Run this OUTSIDE the bench window (it holds the single-client tunnel for
+the ~4-minute compile)::
+
+    python tools/aot_warm.py
+
+The blob lands at ``.bench_aot/resnet50_step.pkl`` (and is keyed on jax
+version / device kind / shapes, so a stale blob is ignored, never wrongly
+used). ``bench.py`` falls back to a normal jit compile when the blob is
+missing or mismatched — this tool is an optimization, not a dependency.
+"""
+import os
+import sys
+import time
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, HERE)
+
+
+def main():
+    import jax
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, parallel
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    devices = jax.devices()
+    on_accel = any(d.platform != "cpu" for d in devices)
+    kind = devices[0].device_kind
+    print("devices: %d x %s" % (len(devices), kind), file=sys.stderr)
+
+    batch = int(os.environ.get("BENCH_BATCH", 256 if on_accel else 8))
+    image = int(os.environ.get("BENCH_IMAGE", 224 if on_accel else 64))
+    layout = os.environ.get("BENCH_LAYOUT", "NHWC" if on_accel else "NCHW")
+    aot_path = os.environ.get(
+        "BENCH_AOT", os.path.join(HERE, ".bench_aot", "resnet50_step.pkl"))
+    os.makedirs(os.path.dirname(aot_path), exist_ok=True)
+
+    np.random.seed(0)
+    mx.random.seed(0)
+    net = vision.resnet50_v1(classes=1000, layout=layout)
+    net.initialize(mx.init.Xavier())
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = parallel.DataParallelTrainer(
+        net, loss_fn, "sgd",
+        {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4},
+        compute_dtype="bfloat16" if on_accel else None)
+    shape = (batch, image, image, 3) if layout == "NHWC" \
+        else (batch, 3, image, image)
+    x = np.random.uniform(-1, 1, shape).astype("float32")
+    y = np.random.randint(0, 1000, (batch,)).astype("float32")
+
+    t0 = time.perf_counter()
+    if trainer.aot_load(aot_path, x, y):
+        print("blob already warm (%.1fs to load) — nothing to do"
+              % (time.perf_counter() - t0), file=sys.stderr)
+    else:
+        trainer.aot_save(aot_path, x, y)
+        print("compiled + serialized in %.1fs -> %s (%.1f MB)"
+              % (time.perf_counter() - t0, aot_path,
+                 os.path.getsize(aot_path) / 1e6), file=sys.stderr)
+    # sanity: one step through the AOT executable must run and be finite
+    loss = float(trainer.step(x, y))
+    assert np.isfinite(loss), loss
+    print("verification step ok, loss=%.4f" % loss, file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()
