@@ -1,0 +1,119 @@
+"""Deep autoencoder + Deep Embedded Clustering — the reference's
+``example/autoencoder`` and ``example/deep-embedded-clustering`` recipes
+on synthetic blobs.
+
+What it exercises: two-phase training (reconstruction pretrain, then a
+self-supervised KL objective on the embedding), hand-rolled soft-assignment
+math in NDArray ops, and parameter reuse across training phases.
+
+Reference parity: /root/reference/example/deep-embedded-clustering/dec.py
+(Student-t soft assignment q_ij, sharpened target p_ij, KL(p||q) loss).
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+
+
+def make_blobs(rng, n=600, dim=16, k=3):
+    centers = rng.randn(k, dim) * 3.0
+    y = rng.randint(0, k, (n,))
+    x = centers[y] + 0.6 * rng.randn(n, dim)
+    return x.astype("float32"), y
+
+
+class AutoEncoder(gluon.HybridBlock):
+    def __init__(self, n_embed=2, **kw):
+        super().__init__(**kw)
+        self.enc = nn.HybridSequential()
+        self.enc.add(nn.Dense(32, activation="relu"), nn.Dense(n_embed))
+        self.dec = nn.HybridSequential()
+        self.dec.add(nn.Dense(32, activation="relu"), nn.Dense(16))
+
+    def forward(self, x):
+        z = self.enc(x)
+        return self.dec(z), z
+
+
+def soft_assign(z, centers, alpha=1.0):
+    """Student-t kernel q_ij ~ (1 + |z_i - mu_j|^2/alpha)^-(alpha+1)/2."""
+    d2 = mx.nd.sum(mx.nd.square(mx.nd.expand_dims(z, axis=1) - centers),
+                   axis=2)
+    q = (1.0 + d2 / alpha) ** (-(alpha + 1.0) / 2.0)
+    return q / mx.nd.sum(q, axis=1, keepdims=True)
+
+
+def target_distribution(q):
+    """Sharpen: p_ij = q^2/f_j, renormalized (DEC eq. 3)."""
+    w = q ** 2 / q.sum(axis=0)
+    return (w.T / w.sum(axis=1)).T
+
+
+def cluster_accuracy(pred, truth, k):
+    """Best 1:1 label matching (greedy — fine for k=3)."""
+    from itertools import permutations
+    best = 0.0
+    for perm in permutations(range(k)):
+        remap = np.array(perm)[pred]
+        best = max(best, (remap == truth).mean())
+    return best
+
+
+def train(pretrain_epochs=40, dec_epochs=30, lr=0.003, seed=0, verbose=True):
+    """Returns (recon_first, recon_last, cluster_acc)."""
+    rng = np.random.RandomState(seed)
+    mx.random.seed(seed)
+    x, y = make_blobs(rng)
+    xa = mx.nd.array(x)
+    net = AutoEncoder()
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": lr})
+
+    def recon_loss():
+        recon, _ = net(xa)
+        return float(mx.nd.mean(mx.nd.square(recon - xa)).asnumpy())
+
+    # ---- phase 1: reconstruction pretrain --------------------------------
+    recon_first = recon_loss()
+    for _ in range(pretrain_epochs):
+        with autograd.record():
+            recon, _ = net(xa)
+            loss = mx.nd.mean(mx.nd.square(recon - xa))
+        loss.backward()
+        trainer.step(1)
+    recon_last = recon_loss()
+
+    # ---- phase 2: DEC — KL(p||q) on the embedding ------------------------
+    _, z = net(xa)
+    zn = z.asnumpy()
+    # k-means++-lite init: pick 3 spread points as centers
+    idx = [int(rng.randint(len(zn)))]
+    for _ in range(2):
+        d = np.min([((zn - zn[i]) ** 2).sum(axis=1) for i in idx], axis=0)
+        idx.append(int(d.argmax()))
+    centers = mx.nd.array(zn[idx])
+    centers.attach_grad()
+    for _ in range(dec_epochs):
+        q = soft_assign(mx.nd.array(z.asnumpy()), centers)  # frozen-z target
+        p = mx.nd.array(target_distribution(q.asnumpy()))
+        with autograd.record():
+            _, z2 = net(xa)
+            q2 = soft_assign(z2, centers)
+            kl = mx.nd.sum(p * (mx.nd.log(p + 1e-10) - mx.nd.log(q2 + 1e-10)))
+        kl.backward()
+        trainer.step(1)
+        centers = centers - 0.1 * centers.grad
+        centers.attach_grad()
+    _, z = net(xa)
+    pred = soft_assign(z, centers).asnumpy().argmax(axis=1)
+    acc = cluster_accuracy(pred, y, 3)
+    if verbose:
+        print(f"recon {recon_first:.3f} -> {recon_last:.3f}; "
+              f"cluster acc {acc:.3f}")
+    return recon_first, recon_last, acc
+
+
+if __name__ == "__main__":
+    train()

@@ -147,3 +147,44 @@ def test_numpy_custom_op_trains():
     import custom_softmax
     first, last = custom_softmax.train(epochs=10, verbose=False)
     assert last > 0.85, (first, last)
+
+
+def test_vae_elbo_improves():
+    """Reparameterized VAE (reference example/vae-gan): grad flows through
+    the sampling op; -ELBO must drop sharply on the synthetic manifold."""
+    sys.path.insert(0, os.path.join(ROOT, "example", "vae"))
+    import vae
+    first, last = vae.train(epochs=30, verbose=False)
+    assert last < first * 0.5, (first, last)
+
+
+def test_dec_autoencoder_clusters():
+    """AE pretrain + DEC KL refinement (reference example/autoencoder,
+    deep-embedded-clustering): reconstruction drops and the embedding
+    clusters match the true blobs."""
+    sys.path.insert(0, os.path.join(ROOT, "example", "autoencoder"))
+    import dec
+    r0, r1, acc = dec.train(verbose=False)
+    assert r1 < r0 * 0.3, (r0, r1)
+    assert acc > 0.85, acc
+
+
+def test_rbm_reconstruction_improves():
+    """CD-1 RBM (reference example/restricted-boltzmann-machine): training
+    without autograd — reconstruction error must fall."""
+    sys.path.insert(0, os.path.join(ROOT, "example",
+                                    "restricted-boltzmann-machine"))
+    import rbm
+    first, last = rbm.train(epochs=30, verbose=False)
+    assert last < first * 0.6, (first, last)
+
+
+def test_text_cnn_learns_order():
+    """Multi-width conv sentence classifier (reference
+    example/cnn_text_classification): must beat bag-of-words chance on an
+    order-dependent task."""
+    sys.path.insert(0, os.path.join(ROOT, "example",
+                                    "cnn_text_classification"))
+    import text_cnn
+    first, last = text_cnn.train(epochs=12, verbose=False)
+    assert last > 0.9, (first, last)
