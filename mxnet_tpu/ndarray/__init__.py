@@ -26,6 +26,29 @@ __all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
            "save", "load", "concat", "stack", "split", "one_hot", "waitall"]
 
 
+def _scalar_or_elemwise(elem_op, scalar_op, rscalar_op=None):
+    """Reference-style top-level binary convenience (ndarray.py:2938-3100
+    power/maximum/minimum): dispatch on scalar vs array operands."""
+    def fn(lhs, rhs):
+        l_scalar = np.isscalar(lhs)
+        r_scalar = np.isscalar(rhs)
+        if l_scalar and r_scalar:
+            raise ValueError("at least one operand must be an NDArray")
+        if r_scalar:
+            return invoke(scalar_op, [lhs], {"scalar": float(rhs)})
+        if l_scalar:
+            op = rscalar_op or scalar_op
+            return invoke(op, [rhs], {"scalar": float(lhs)})
+        return invoke(elem_op, [lhs, rhs], {})
+    return fn
+
+
+maximum = _scalar_or_elemwise("broadcast_maximum", "_maximum_scalar")
+minimum = _scalar_or_elemwise("broadcast_minimum", "_minimum_scalar")
+power = _scalar_or_elemwise("broadcast_power", "_power_scalar",
+                            "_rpower_scalar")
+
+
 def waitall() -> None:
     """Block until all launched work completes (reference Engine::WaitForAll:
     device XLA queues + host task engine, surfacing deferred errors)."""

@@ -24,9 +24,27 @@ from ..base import MXNetError
 _GATES = {"lstm": 4, "gru": 3, "rnn_relu": 1, "rnn_tanh": 1}
 
 
+def _match_vma(state, ref):
+    """Inside shard_map, scan carries must carry the same varying-manual-axes
+    set as values derived from the inputs; a replicated initial state meeting
+    a device-varying input projection (the pipeline-parallel case) needs an
+    explicit pvary or the scan type check rejects it."""
+    try:
+        want = jax.core.get_aval(ref).vma
+        have = jax.core.get_aval(state).vma
+        extra = tuple(sorted(want - have))
+        if extra:
+            return lax.pvary(state, extra)
+    except (AttributeError, TypeError):
+        pass
+    return state
+
+
 def _lstm_scan(xp, h0, c0, whh, bhh):
     """xp: (T, B, 4H) precomputed input projection."""
     H = h0.shape[-1]
+    h0 = _match_vma(h0, xp)
+    c0 = _match_vma(c0, xp)
 
     def step(carry, xt):
         h, c = carry
@@ -42,6 +60,7 @@ def _lstm_scan(xp, h0, c0, whh, bhh):
 
 def _gru_scan(xp, h0, whh, bhh):
     H = h0.shape[-1]
+    h0 = _match_vma(h0, xp)
     whh_rz, whh_n = whh[:2 * H], whh[2 * H:]
     bhh_rz, bhh_n = bhh[:2 * H], bhh[2 * H:]
 
@@ -58,6 +77,8 @@ def _gru_scan(xp, h0, whh, bhh):
 
 
 def _vanilla_scan(xp, h0, whh, bhh, act):
+    h0 = _match_vma(h0, xp)
+
     def step(h, xt):
         h = act(xt + jnp.dot(h, whh.T) + bhh)
         return h, h

@@ -25,7 +25,7 @@ except ImportError:  # older jax
     from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
-__all__ = ["pipeline_apply"]
+__all__ = ["pipeline_apply", "GluonPipelineStack"]
 
 
 def pipeline_apply(stage_fn: Callable, stacked_params, x_microbatches,
@@ -74,3 +74,127 @@ def pipeline_apply(stage_fn: Callable, stacked_params, x_microbatches,
                              P()),
                    out_specs=P())
     return fn(stacked_params, x_microbatches)
+
+
+class GluonPipelineStack:
+    """Bridge structurally-identical gluon Blocks onto ``pipeline_apply``.
+
+    This is the TPU-native expression of the reference's model-parallel
+    LSTM doc case (``docs/faq/model_parallel_lstm.md`` /
+    ``group2ctx``-based layer placement): the homogeneous middle of a
+    model — e.g. a stack of LSTM layers, each ``(B, T, H) -> (B, T, H)``
+    — runs one-stage-per-device over the ``pp`` mesh axis, while the
+    heterogeneous ends (embedding, decoder) stay replicated outside.
+
+    Usage::
+
+        stack = GluonPipelineStack(layer_blocks, sample, mesh, axis='pp')
+        y_mb = stack.apply(stack.stacked_params, x_microbatches)
+        # ... train on a params pytree via jax.grad, then:
+        stack.write_back(trained_params)
+
+    The blocks must already be initialized and share parameter structure
+    (same shapes in the same topological order); an input microbatch shape
+    equals the inter-stage activation shape.
+    """
+
+    def __init__(self, blocks, sample, mesh: Mesh, axis: str = "pp"):
+        from ..base import MXNetError
+        from .. import symbol as sym_mod
+        from .. import autograd
+        from ..executor import _GraphLowering
+        from ..ndarray.ndarray import _unwrap, _wrap
+
+        if mesh.shape[axis] != len(blocks):
+            raise MXNetError(
+                f"GluonPipelineStack needs one block per '{axis}' device: "
+                f"{len(blocks)} blocks vs mesh[{axis!r}]={mesh.shape[axis]}")
+        self._blocks = list(blocks)
+        self._mesh = mesh
+        self._axis = axis
+
+        sample = jnp.asarray(sample)
+        with autograd.pause():                 # materialize deferred params
+            for b in self._blocks:
+                b(_wrap(sample))
+
+        per_block_names = []
+        per_block_pmaps = []
+        lowering = None
+        for b in self._blocks:
+            x_sym = sym_mod.Variable("__pp_x")
+            out = b(x_sym)
+            if isinstance(out, (list, tuple)):
+                out = out[0]
+            low = _GraphLowering(out)
+            names = [n for n in low.var_names if n != "__pp_x"]
+            per_block_names.append(names)
+            per_block_pmaps.append(
+                {p.name: p for p in b.collect_params().values()})
+            if lowering is None:
+                lowering = low
+        shapes0 = [per_block_pmaps[0][n].shape for n in per_block_names[0]]
+        for pmap, names in zip(per_block_pmaps[1:], per_block_names[1:]):
+            shapes = [pmap[n].shape for n in names]
+            if shapes != shapes0:
+                raise MXNetError(
+                    "pipeline stages must be structurally identical; "
+                    f"got param shapes {shapes} vs {shapes0}")
+        self._canonical = per_block_names[0]
+        self._per_block_names = per_block_names
+        self._per_block_pmaps = per_block_pmaps
+        raw = lowering.lower(is_train=True)
+
+        has_rng = lowering.has_rng
+
+        def stage_fn(params, x):
+            ins = dict(zip(self._canonical, params))
+            ins["__pp_x"] = x
+            # rng-capable ops (e.g. RNN's dropout arg) get a FIXED stream:
+            # the pipeline schedule is traced once, so per-tick rng would
+            # leak schedule state into the stage; in-stage dropout is
+            # deterministic per trace — put stochastic dropout outside the
+            # pipelined stack if that matters
+            outs, _ = raw(ins, jax.random.PRNGKey(0) if has_rng else None)
+            return outs[0]
+
+        self._stage_fn = stage_fn
+        from jax.sharding import NamedSharding
+        stage_spec = NamedSharding(mesh, P(axis))
+        self.stacked_params = tuple(
+            jax.device_put(
+                jnp.stack([_unwrap(per_block_pmaps[j][per_block_names[j][i]]
+                                   .data())
+                           for j in range(len(self._blocks))]), stage_spec)
+            for i in range(len(self._canonical)))
+
+    def apply(self, stacked_params, x_microbatches):
+        """(n_micro, B, ...) -> (n_micro, B, ...) through the device-mapped
+        stage stack (GPipe schedule, differentiable)."""
+        from jax.sharding import NamedSharding
+        stage_spec = NamedSharding(self._mesh, P(self._axis))
+        repl = NamedSharding(self._mesh, P())
+
+        def _put(a, spec):
+            # concrete arrays get placed here for caller convenience; under
+            # a jit trace placement is the enclosing jit's job (pass
+            # mesh-placed params in, as the example recipe does)
+            if isinstance(a, jax.core.Tracer):
+                return a
+            a = jnp.asarray(a)
+            return a if a.sharding == spec else jax.device_put(a, spec)
+
+        stacked_params = jax.tree_util.tree_map(
+            lambda a: _put(a, stage_spec), stacked_params)
+        x_microbatches = _put(x_microbatches, repl)
+        return pipeline_apply(self._stage_fn, stacked_params, x_microbatches,
+                              self._mesh, self._axis)
+
+    def write_back(self, stacked_params) -> None:
+        """Push a trained stacked pytree back into the gluon blocks."""
+        for i in range(len(self._canonical)):
+            leaf = stacked_params[i]
+            for j in range(len(self._blocks)):
+                name = self._per_block_names[j][i]
+                self._per_block_pmaps[j][name].data()._set_data(
+                    jnp.asarray(leaf[j]))

@@ -41,6 +41,20 @@ def _time_axis(layout):
     return ax
 
 
+def _split_merged_ndarray(inputs, length, layout):
+    """A merged (N,T,C)/(T,N,C) NDArray → length-T list of (N, C) arrays
+    (the reference's unroll accepts the merged imperative form too)."""
+    from .._imperative import invoke
+    ax = _time_axis(layout)
+    if length is not None and inputs.shape[ax] != length:
+        raise MXNetError(f"time axis has {inputs.shape[ax]} steps, "
+                         f"expected {length}")
+    return [invoke("squeeze", [invoke(
+        "slice_axis", [inputs],
+        {"axis": ax, "begin": t, "end": t + 1})], {"axis": ax})
+        for t in range(inputs.shape[ax])]
+
+
 def _to_steps(inputs, length, layout):
     """Whatever form ``inputs`` is in → a length-T list of (N, C) Symbols."""
     if isinstance(inputs, Symbol):
@@ -49,6 +63,8 @@ def _to_steps(inputs, length, layout):
         return list(symbol.SliceChannel(
             inputs, axis=_time_axis(layout), num_outputs=length,
             squeeze_axis=1))
+    if hasattr(inputs, "ndim") and getattr(inputs, "ndim", 0) == 3:
+        return _split_merged_ndarray(inputs, length, layout)
     steps = list(inputs)
     if length is not None and len(steps) != length:
         raise MXNetError(f"got {len(steps)} step inputs, expected {length}")
@@ -58,6 +74,8 @@ def _to_steps(inputs, length, layout):
 def _to_merged(inputs, length, layout):
     """Whatever form ``inputs`` is in → one (N,T,C)/(T,N,C) Symbol."""
     if isinstance(inputs, Symbol):
+        return inputs
+    if hasattr(inputs, "ndim") and getattr(inputs, "ndim", 0) == 3:
         return inputs
     steps = list(inputs)
     if length is not None and len(steps) != length:

@@ -188,3 +188,51 @@ def test_text_cnn_learns_order():
     import text_cnn
     first, last = text_cnn.train(epochs=12, verbose=False)
     assert last > 0.9, (first, last)
+
+
+def test_sparse_linear_classification():
+    """CSR forward + row_sparse gradient + lazy SGD (reference
+    example/sparse/linear_classification): only touched rows update."""
+    sys.path.insert(0, os.path.join(ROOT, "example", "sparse"))
+    import linear_classification
+    first, last = linear_classification.train(epochs=15, verbose=False)
+    assert last > 0.9, (first, last)
+
+
+def test_nce_recovers_full_softmax():
+    """NCE with k=8 negatives (reference example/nce-loss) must recover the
+    bigram map under FULL-softmax evaluation."""
+    sys.path.insert(0, os.path.join(ROOT, "example", "nce-loss"))
+    import nce_lm
+    first, last = nce_lm.train(epochs=15, verbose=False)
+    assert last > 0.8, (first, last)
+
+
+def test_reinforce_cartpole_improves():
+    """REINFORCE (reference example/reinforcement-learning): average episode
+    length must grow substantially over training."""
+    sys.path.insert(0, os.path.join(ROOT, "example",
+                                    "reinforcement-learning"))
+    import cartpole_reinforce
+    first, last = cartpole_reinforce.train(episodes=120, verbose=False)
+    assert last > first * 2, (first, last)
+    assert last > 80, (first, last)
+
+
+def test_fcn_segments():
+    """Deconvolution upsampling + skip fusion + per-pixel multi_output
+    softmax (reference example/fcn-xs): foreground IoU must be real."""
+    sys.path.insert(0, os.path.join(ROOT, "example", "fcn-xs"))
+    import fcn
+    first, last, iou = fcn.train(epochs=15, verbose=False)
+    assert last > 0.93, (first, last)
+    assert iou > 0.5, iou
+
+
+def test_capsnet_routing_learns():
+    """Dynamic routing-by-agreement, static 3-iteration unroll (reference
+    example/capsnet): capsule lengths classify the quadrant task."""
+    sys.path.insert(0, os.path.join(ROOT, "example", "capsnet"))
+    import capsnet
+    first, last = capsnet.train(epochs=10, verbose=False)
+    assert last > 0.9, (first, last)
