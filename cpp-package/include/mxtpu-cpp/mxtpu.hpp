@@ -1,0 +1,159 @@
+/*!
+ * Header-only C++ frontend over the mxtpu C ABI — the role the reference's
+ * ``cpp-package/include/mxnet-cpp`` plays over its flat c_api.h: RAII
+ * NDArrays, operator invocation with attribute maps, and the autograd
+ * entry points that make the ABI training-capable.
+ *
+ * Everything routes through the public C surface in
+ * ``include/mxtpu/c_predict_api.h``; no Python appears in user code — the
+ * shared library brings up (or joins) the interpreter internally.
+ *
+ * Reference parity: cpp-package/include/mxnet-cpp/ndarray.h (NDArray),
+ * operator.h (Operator::Invoke), and the MXAutograd* usage in its training
+ * examples.
+ */
+#ifndef MXTPU_CPP_MXTPU_HPP_
+#define MXTPU_CPP_MXTPU_HPP_
+
+#include <mxtpu/c_predict_api.h>
+
+#include <map>
+#include <stdexcept>
+#include <string>
+#include <utility>
+#include <vector>
+
+namespace mxtpu {
+
+inline void check(int rc, const char *what) {
+  if (rc != 0)
+    throw std::runtime_error(std::string(what) + ": " + MXGetLastError());
+}
+
+/*! RAII array owning an ABI handle. Copy = handle share is disallowed;
+ *  move transfers ownership (reference cpp-package NDArray is a
+ *  shared-handle type; explicit moves keep this header dependency-free). */
+class NDArray {
+ public:
+  NDArray() = default;
+  explicit NDArray(NDArrayHandle h) : h_(h) {}
+
+  static NDArray zeros(const std::vector<mx_uint> &shape) {
+    NDArrayHandle h = nullptr;
+    check(MXTPUNDArrayCreate(shape.data(),
+                             static_cast<mx_uint>(shape.size()), "float32",
+                             &h), "NDArrayCreate");
+    return NDArray(h);
+  }
+
+  static NDArray from_data(const std::vector<mx_uint> &shape,
+                           const std::vector<mx_float> &data) {
+    NDArrayHandle h = nullptr;
+    check(MXTPUNDArrayFromData(shape.data(),
+                               static_cast<mx_uint>(shape.size()),
+                               data.data(), &h), "NDArrayFromData");
+    return NDArray(h);
+  }
+
+  NDArray(const NDArray &) = delete;
+  NDArray &operator=(const NDArray &) = delete;
+  NDArray(NDArray &&o) noexcept : h_(o.h_) { o.h_ = nullptr; }
+  NDArray &operator=(NDArray &&o) noexcept {
+    if (this != &o) { reset(); h_ = o.h_; o.h_ = nullptr; }
+    return *this;
+  }
+  ~NDArray() { reset(); }
+
+  std::vector<mx_uint> shape() const {
+    mx_uint *d = nullptr, n = 0;
+    check(MXTPUNDArrayGetShape(h_, &d, &n), "NDArrayGetShape");
+    return std::vector<mx_uint>(d, d + n);
+  }
+
+  mx_uint size() const {
+    mx_uint s = 1;
+    for (mx_uint d : shape()) s *= d;
+    return s;
+  }
+
+  std::vector<mx_float> to_vector() const {
+    std::vector<mx_float> out(size());
+    check(MXTPUNDArrayGetData(h_, out.data(),
+                              static_cast<mx_uint>(out.size())),
+          "NDArrayGetData");
+    return out;
+  }
+
+  void attach_grad() {
+    check(MXTPUNDArrayAttachGrad(h_), "NDArrayAttachGrad");
+  }
+
+  NDArray grad() const {
+    NDArrayHandle g = nullptr;
+    check(MXTPUNDArrayGetGrad(h_, &g), "NDArrayGetGrad");
+    return NDArray(g);
+  }
+
+  void backward() { check(MXTPUAutogradBackward(h_), "AutogradBackward"); }
+
+  NDArrayHandle handle() const { return h_; }
+
+ private:
+  void reset() {
+    if (h_) MXTPUNDArrayFree(h_);
+    h_ = nullptr;
+  }
+  NDArrayHandle h_ = nullptr;
+};
+
+/*! Invoke any registered operator (reference Operator("name")(...).Invoke).
+ *  Returns the op's outputs (usually one). */
+inline std::vector<NDArray> invoke(
+    const std::string &op, const std::vector<const NDArray *> &inputs,
+    const std::map<std::string, std::string> &attrs = {}) {
+  std::vector<NDArrayHandle> in;
+  in.reserve(inputs.size());
+  for (const NDArray *a : inputs) in.push_back(a->handle());
+  std::vector<const char *> keys, vals;
+  for (const auto &kv : attrs) {
+    keys.push_back(kv.first.c_str());
+    vals.push_back(kv.second.c_str());
+  }
+  NDArrayHandle outs[8] = {nullptr};
+  mx_uint n_out = 0;
+  check(MXTPUImperativeInvoke(op.c_str(),
+                              static_cast<mx_uint>(in.size()), in.data(),
+                              static_cast<mx_uint>(keys.size()),
+                              keys.data(), vals.data(), 8, outs, &n_out),
+        op.c_str());
+  std::vector<NDArray> result;
+  result.reserve(n_out);
+  for (mx_uint i = 0; i < n_out; ++i) result.emplace_back(outs[i]);
+  return result;
+}
+
+inline NDArray invoke1(const std::string &op,
+                       const std::vector<const NDArray *> &inputs,
+                       const std::map<std::string, std::string> &attrs = {}) {
+  auto v = invoke(op, inputs, attrs);
+  if (v.empty()) throw std::runtime_error(op + " produced no outputs");
+  return std::move(v[0]);
+}
+
+/*! RAII autograd recording scope (reference MXAutogradSetIsRecording). */
+class AutogradRecord {
+ public:
+  AutogradRecord() {
+    check(MXTPUAutogradSetRecording(1, &prev_), "AutogradSetRecording");
+  }
+  ~AutogradRecord() { MXTPUAutogradSetRecording(prev_, nullptr); }
+
+ private:
+  int prev_ = 0;
+};
+
+inline void waitall() { check(MXTPUNDArrayWaitAll(), "NDArrayWaitAll"); }
+
+}  // namespace mxtpu
+
+#endif  // MXTPU_CPP_MXTPU_HPP_

@@ -140,6 +140,28 @@ int MXTPUListOps(mx_uint *out_size, const char ***out_array);
  * to `out_capacity` fresh handles into `outputs`; fails if the op produces
  * more.
  */
+/*
+ * Autograd — the slice that makes this ABI TRAINING-capable (reference
+ * c_api.h MXAutogradSetIsRecording / MXAutogradMarkVariables /
+ * MXAutogradBackward / MXNDArrayGetGrad): a C/C++ host records ops on the
+ * tape, runs the reverse pass, reads gradients, and applies updates with
+ * further op invocations. See cpp-package/example/train_mlp.cc.
+ */
+
+/*! \brief Enter (1) / exit (0) the recorded region; *prev gets the old
+ *         state. */
+int MXTPUAutogradSetRecording(int on, int *prev);
+
+/*! \brief Mark the array as a differentiable input (allocates its grad). */
+int MXTPUNDArrayAttachGrad(NDArrayHandle handle);
+
+/*! \brief Reverse pass from `head` (non-scalars use an implicit ones
+ *         head-gradient, as the reference does). */
+int MXTPUAutogradBackward(NDArrayHandle head);
+
+/*! \brief Gradient of a marked array as a NEW handle (caller frees). */
+int MXTPUNDArrayGetGrad(NDArrayHandle handle, NDArrayHandle *out);
+
 int MXTPUImperativeInvoke(const char *op_name, mx_uint num_inputs,
                           NDArrayHandle *inputs, mx_uint num_params,
                           const char **param_keys, const char **param_vals,

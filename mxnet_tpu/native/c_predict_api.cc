@@ -546,6 +546,50 @@ int MXTPUListOps(mx_uint *out_size, const char ***out_array) {
   return 0;
 }
 
+int MXTPUAutogradSetRecording(int on, int *prev) {
+  GIL gil;
+  PyObject *mod = bridge_module();
+  if (!mod) return -1;
+  PyObject *r = PyObject_CallMethod(mod, "autograd_set_recording", "i", on);
+  if (!r) { set_error_from_python(); return -1; }
+  if (prev) *prev = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTPUNDArrayAttachGrad(NDArrayHandle handle) {
+  GIL gil;
+  PyObject *mod = bridge_module();
+  if (!mod) return -1;
+  PyObject *r = PyObject_CallMethod(mod, "nd_attach_grad", "O",
+                                    static_cast<NDHandle *>(handle)->obj);
+  if (!r) { set_error_from_python(); return -1; }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTPUAutogradBackward(NDArrayHandle head) {
+  GIL gil;
+  PyObject *mod = bridge_module();
+  if (!mod) return -1;
+  PyObject *r = PyObject_CallMethod(mod, "autograd_backward", "O",
+                                    static_cast<NDHandle *>(head)->obj);
+  if (!r) { set_error_from_python(); return -1; }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTPUNDArrayGetGrad(NDArrayHandle handle, NDArrayHandle *out) {
+  GIL gil;
+  PyObject *mod = bridge_module();
+  if (!mod) return -1;
+  PyObject *r = PyObject_CallMethod(mod, "nd_get_grad", "O",
+                                    static_cast<NDHandle *>(handle)->obj);
+  if (!r) { set_error_from_python(); return -1; }
+  *out = new NDHandle{r, {}};
+  return 0;
+}
+
 int MXTPUImperativeInvoke(const char *op_name, mx_uint num_inputs,
                           NDArrayHandle *inputs, mx_uint num_params,
                           const char **param_keys, const char **param_vals,

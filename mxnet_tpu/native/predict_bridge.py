@@ -226,6 +226,46 @@ def nd_waitall():
     mx.nd.waitall()
 
 
+# ---- autograd slice: what makes the C ABI TRAINING-capable ----------------
+# (reference c_api.h MXAutogradSetIsRecording / MXAutogradMarkVariables /
+#  MXAutogradBackward / MXNDArrayGetGrad — the four entry points the
+#  reference's cpp-package trains through.)
+_record_scope = []
+
+
+def autograd_set_recording(on: int) -> int:
+    """MXTPUAutogradSetRecording: enter/exit the taped region; returns the
+    previous state like the reference."""
+    from mxnet_tpu import autograd
+    prev = 1 if autograd.is_recording() else 0
+    if on and not _record_scope:
+        scope = autograd.record()
+        scope.__enter__()
+        _record_scope.append(scope)
+    elif not on and _record_scope:
+        _record_scope.pop().__exit__(None, None, None)
+    return prev
+
+
+def nd_attach_grad(arr) -> None:
+    """MXTPUNDArrayAttachGrad (reference MXAutogradMarkVariables)."""
+    arr.nd.attach_grad()
+
+
+def autograd_backward(head) -> None:
+    """MXTPUAutogradBackward: reverse pass from a (scalar or summed) head."""
+    head.nd.backward()
+
+
+def nd_get_grad(arr):
+    """MXTPUNDArrayGetGrad: the gradient buffer as a new C handle."""
+    g = arr.nd.grad
+    if g is None:
+        raise ValueError("array has no gradient: call AttachGrad and "
+                         "Backward first")
+    return CNDArray.wrap(g)
+
+
 class NDList:
     """MXNDListCreate / MXNDListGet: read an ndarray file's contents."""
 
