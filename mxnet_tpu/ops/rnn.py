@@ -30,10 +30,15 @@ def _match_vma(state, ref):
     a device-varying input projection (the pipeline-parallel case) needs an
     explicit pvary or the scan type check rejects it."""
     try:
-        want = jax.core.get_aval(ref).vma
-        have = jax.core.get_aval(state).vma
+        typeof = getattr(jax, "typeof", None)
+        if typeof is None:
+            typeof = jax.core.get_aval
+        want = typeof(ref).vma
+        have = typeof(state).vma
         extra = tuple(sorted(want - have))
         if extra:
+            if hasattr(lax, "pcast"):
+                return lax.pcast(state, extra, to="varying")
             return lax.pvary(state, extra)
     except (AttributeError, TypeError):
         pass
