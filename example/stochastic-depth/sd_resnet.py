@@ -1,0 +1,117 @@
+"""Stochastic-depth residual network — the reference's
+``example/stochastic-depth`` (Huang et al. 2016) on a synthetic task.
+
+What it exercises: per-batch random block dropping (death_rate schedule
+linear in depth), host-side coin flips selecting among a SMALL set of
+static graphs (the XLA-friendly alternative to data-dependent control
+flow inside the program), and inference-time survival-probability
+rescaling of each residual branch.
+
+Reference parity: /root/reference/example/stochastic-depth/sd_cifar10.py
+(residual blocks skipped with linearly increasing death rate).
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+
+CLASSES = 4
+SIDE = 8
+
+
+class ResBlock(gluon.HybridBlock):
+    def __init__(self, channels, **kw):
+        super().__init__(**kw)
+        self.conv1 = nn.Conv2D(channels, 3, padding=1, activation="relu")
+        self.conv2 = nn.Conv2D(channels, 3, padding=1)
+
+    def forward(self, x, gate=1.0):
+        """gate: 1.0 = keep branch, 0.0 = identity skip; at inference the
+        caller passes the survival probability instead (expectation)."""
+        if gate == 0.0:
+            return x
+        return x + gate * self.conv2(self.conv1(x))
+
+
+class SDNet(gluon.HybridBlock):
+    def __init__(self, n_blocks=4, channels=8, death_rate=0.5, **kw):
+        super().__init__(**kw)
+        self.stem = nn.Conv2D(channels, 3, padding=1, activation="relu")
+        self.blocks = []
+        for i in range(n_blocks):
+            blk = ResBlock(channels)
+            setattr(self, f"block{i}", blk)
+            self.blocks.append(blk)
+        # linear death-rate schedule: deeper blocks die more often
+        self.death = [death_rate * (i + 1) / n_blocks
+                      for i in range(n_blocks)]
+        self.head = nn.Dense(CLASSES)
+
+    def forward(self, x, rng=None):
+        h = self.stem(x)
+        for blk, d in zip(self.blocks, self.death):
+            if rng is not None:                      # training: coin flip
+                gate = 1.0 if rng.rand() >= d else 0.0
+            else:                                    # inference: expectation
+                gate = 1.0 - d
+            h = blk(h, gate)
+        return self.head(h)
+
+
+def make_data(rng, n=256):
+    x = rng.uniform(0, 0.3, (n, 1, SIDE, SIDE)).astype("float32")
+    y = rng.randint(0, CLASSES, (n,))
+    h = SIDE // 2
+    for i, c in enumerate(y):
+        r, col = divmod(int(c), 2)
+        x[i, 0, r * h:(r + 1) * h, col * h:(col + 1) * h] += 0.6
+    return x, y.astype("float32")
+
+
+def train(epochs=10, batch_size=32, lr=0.005, seed=0, verbose=True):
+    """Returns (first_acc, last_acc, n_graphs): n_graphs counts the distinct
+    gate patterns seen — stochastic depth really did vary the graph."""
+    rng = np.random.RandomState(seed)
+    mx.random.seed(seed)
+    x, y = make_data(rng)
+    net = SDNet()
+    net.initialize(mx.init.Xavier())
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": lr})
+
+    def accuracy():
+        out = net(mx.nd.array(x)).asnumpy()          # inference: expectation
+        return (out.argmax(axis=1) == y).mean()
+
+    seen_patterns = set()
+
+    class _SpyRng:
+        def rand(self):
+            v = rng.rand()
+            self.pattern.append(v)
+            return v
+
+    first = accuracy()
+    for _ in range(epochs):
+        for i in range(0, len(x), batch_size):
+            xb = mx.nd.array(x[i:i + batch_size])
+            yb = mx.nd.array(y[i:i + batch_size])
+            spy = _SpyRng()
+            spy.pattern = []
+            with autograd.record():
+                loss = loss_fn(net(xb, spy), yb)
+            loss.backward()
+            trainer.step(len(xb))
+            seen_patterns.add(tuple(v >= d for v, d in
+                                    zip(spy.pattern, net.death)))
+    last = accuracy()
+    if verbose:
+        print(f"sd-resnet accuracy: {first:.3f} -> {last:.3f} "
+              f"({len(seen_patterns)} gate patterns)")
+    return first, last, len(seen_patterns)
+
+
+if __name__ == "__main__":
+    train()

@@ -236,3 +236,42 @@ def test_capsnet_routing_learns():
     import capsnet
     first, last = capsnet.train(epochs=10, verbose=False)
     assert last > 0.9, (first, last)
+
+
+def test_svrg_regression_converges():
+    """SVRGModule full-gradient snapshots + control variates (reference
+    example/svrg_module): MSE collapses on the linear problem."""
+    sys.path.insert(0, os.path.join(ROOT, "example", "svrg_module"))
+    import svrg_regression
+    first, last = svrg_regression.train(epochs=12, verbose=False)
+    assert last < first * 0.05, (first, last)
+
+
+def test_profiler_demo_captures_ops():
+    """Profiler example (reference example/profiler): the chrome trace has
+    duration events for the ops the training loop ran."""
+    sys.path.insert(0, os.path.join(ROOT, "example", "profiler"))
+    import profiler_demo
+    n_events, op_names = profiler_demo.run(steps=8, verbose=False)
+    assert n_events > 20
+    assert "FullyConnected" in op_names
+
+
+def test_stochastic_depth_trains_and_varies():
+    """Stochastic depth (reference example/stochastic-depth): accuracy
+    rises AND multiple distinct gate patterns actually executed."""
+    sys.path.insert(0, os.path.join(ROOT, "example", "stochastic-depth"))
+    import sd_resnet
+    first, last, n_patterns = sd_resnet.train(epochs=10, verbose=False)
+    assert last > 0.9, (first, last)
+    assert n_patterns >= 4, n_patterns
+
+
+def test_quantize_mlp_keeps_accuracy():
+    """Entropy-calibrated int8 quantization (reference
+    example/quantization): int8 accuracy within 2% of float."""
+    sys.path.insert(0, os.path.join(ROOT, "example", "quantization"))
+    import quantize_mlp
+    facc, qacc = quantize_mlp.run(verbose=False)
+    assert facc > 0.95, facc
+    assert qacc > facc - 0.02, (facc, qacc)
