@@ -340,6 +340,18 @@ class Executor:
             self._jit_cache["train_step"] = jax.jit(step)
         return self._jit_cache["train_step"]
 
+    def debug_str(self) -> str:
+        """Human-readable lowered program (reference Executor::DebugStr):
+        the jaxpr of the inference graph — one line per primitive AFTER
+        framework lowering, i.e. what is handed to XLA."""
+        from .ndarray.ndarray import _unwrap
+        raw = self._lowering.lower(False)
+        inputs = {n: _unwrap(a) for n, a in self.arg_dict.items()}
+        inputs.update({n: _unwrap(a) for n, a in self.aux_dict.items()})
+        jaxpr = jax.make_jaxpr(lambda ins: raw(ins, jax.random.PRNGKey(0)))(
+            inputs)
+        return str(jaxpr)
+
     def set_monitor_callback(self, callback, monitor_all=False):
         self.monitor_callback = callback
 
