@@ -275,3 +275,48 @@ def test_quantize_mlp_keeps_accuracy():
     facc, qacc = quantize_mlp.run(verbose=False)
     assert facc > 0.95, facc
     assert qacc > facc - 0.02, (facc, qacc)
+
+
+def test_ner_span_f1():
+    """Masked bi-LSTM sequence tagging (reference
+    example/named_entity_recognition): SequenceMask'd loss over padded
+    batches reaches high span-level F1."""
+    sys.path.insert(0, os.path.join(ROOT, "example",
+                                    "named_entity_recognition"))
+    import ner
+    first, last = ner.train(epochs=12, verbose=False)
+    assert last > 0.9, (first, last)
+
+
+def test_lstnet_beats_persistence():
+    """LSTNet CNN->GRU->AR forecaster (reference
+    example/multivariate_time_series) must beat the naive persistence
+    baseline on held-out data."""
+    sys.path.insert(0, os.path.join(ROOT, "example",
+                                    "multivariate_time_series"))
+    import lstnet
+    naive, model = lstnet.train(epochs=15, verbose=False)
+    assert model < naive * 0.75, (naive, model)
+
+
+def test_dsd_pruning_phases():
+    """Dense-Sparse-Dense (reference example/dsd): the sparse phase holds
+    the pruning mask (measured zeros ~= target sparsity) and accuracy
+    survives all three phases."""
+    sys.path.insert(0, os.path.join(ROOT, "example", "dsd"))
+    import dsd_pruning
+    dense, sparse, redense, zeros = dsd_pruning.train(verbose=False)
+    assert dense > 0.95 and sparse > 0.95 and redense > 0.95, \
+        (dense, sparse, redense)
+    assert abs(zeros - 0.5) < 0.05, zeros
+
+
+def test_bayes_by_backprop():
+    """BBB variational net (reference example/bayesian-methods): MC-mean
+    fit improves sharply and the weight posterior is neither collapsed
+    nor prior-wide."""
+    sys.path.insert(0, os.path.join(ROOT, "example", "bayesian-methods"))
+    import bbb
+    first, last, mean_sigma = bbb.train(epochs=150, verbose=False)
+    assert last < first * 0.4, (first, last)
+    assert 0.005 < mean_sigma < 0.5, mean_sigma
