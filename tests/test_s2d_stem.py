@@ -1,0 +1,57 @@
+"""Space-to-depth stem (MLPerf ResNet trick): the 4x4/s1-over-12-channels
+conv must compute EXACTLY the original 7x7/s2-over-3-channels stem when its
+weights are the block-rearranged originals — the transform is a
+reparameterization, not an approximation.
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.gluon.model_zoo.vision.resnet import SpaceToDepthStem
+from mxnet_tpu.gluon.model_zoo import vision
+
+
+def _s2d_weights(w):
+    """(O,7,7,3) OHWI -> (O,4,4,12) with W'[o,du,dv,(r*2+s)*3+c] =
+    W[o,2du+r,2dv+s,c], zero-padded where 2du+r > 6."""
+    O = w.shape[0]
+    out = np.zeros((O, 4, 4, 12), w.dtype)
+    for du in range(4):
+        for dv in range(4):
+            for r in range(2):
+                for s in range(2):
+                    u, v = 2 * du + r, 2 * dv + s
+                    if u < 7 and v < 7:
+                        out[:, du, dv, (r * 2 + s) * 3:(r * 2 + s) * 3 + 3] \
+                            = w[:, u, v, :]
+    return out
+
+
+def test_s2d_stem_exactly_matches_7x7_conv(rng):
+    B, H = 2, 32                      # any even spatial size works
+    x = rng.uniform(-1, 1, (B, H, H, 3)).astype("float32")
+    w = rng.uniform(-1, 1, (64, 7, 7, 3)).astype("float32")
+
+    ref = nd.Convolution(nd.array(x), nd.array(w), None, kernel=(7, 7),
+                         stride=(2, 2), pad=(3, 3), num_filter=64,
+                         no_bias=True, layout="NHWC")
+
+    mx.random.seed(0)
+    stem = SpaceToDepthStem(64, prefix="s2dtest_")
+    stem.initialize(mx.init.Xavier())
+    stem(nd.array(x))                 # materialize
+    stem.conv.weight.set_data(nd.array(_s2d_weights(w)))
+    got = stem(nd.array(x))
+
+    np.testing.assert_allclose(got.asnumpy(), ref.asnumpy(),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_resnet50_s2d_builds_and_runs(rng):
+    mx.random.seed(0)
+    net = vision.resnet50_v1(classes=10, layout="NHWC", stem_s2d=True)
+    net.initialize(mx.init.Xavier())
+    x = nd.array(rng.uniform(-1, 1, (2, 32, 32, 3)).astype("float32"))
+    out = net(x)
+    assert out.shape == (2, 10)
+    assert np.isfinite(out.asnumpy()).all()

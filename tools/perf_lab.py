@@ -58,7 +58,14 @@ def main():
         try:
             np.random.seed(0)
             mx.random.seed(0)
-            net = vision.resnet50_v1(classes=1000, layout=layout)
+            # variant token "S2D" = NHWC + space-to-depth stem (exact
+            # 7x7/s2 reparameterization, tests/test_s2d_stem.py)
+            if layout == "S2D":
+                layout = "NHWC"
+                net = vision.resnet50_v1(classes=1000, layout=layout,
+                                         stem_s2d=True)
+            else:
+                net = vision.resnet50_v1(classes=1000, layout=layout)
             net.initialize(mx.init.Xavier())
             loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
             trainer = parallel.DataParallelTrainer(
