@@ -320,3 +320,13 @@ def test_bayes_by_backprop():
     first, last, mean_sigma = bbb.train(epochs=150, verbose=False)
     assert last < first * 0.4, (first, last)
     assert 0.005 < mean_sigma < 0.5, mean_sigma
+
+
+def test_captcha_multi_head():
+    """Four parallel digit heads over one trunk (reference
+    example/captcha): whole-string accuracy must be high, which requires
+    every head to have learned."""
+    sys.path.insert(0, os.path.join(ROOT, "example", "captcha"))
+    import captcha_cnn
+    digit, string = captcha_cnn.train(epochs=10, verbose=False)
+    assert string > 0.9, (digit, string)
