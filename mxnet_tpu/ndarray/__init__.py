@@ -71,6 +71,33 @@ def _make_op_func(name: str):
                 inputs.append(a)
         nds = [x for x in inputs if isinstance(x, NDArray)]
         pos_scalars = [x for x in inputs if not isinstance(x, NDArray)]
+        # reference calling convention: tensor arguments may be passed by
+        # KEYWORD (`SequenceMask(x, sequence_length=lens)`); lift any
+        # array-valued kwarg whose name is a declared tensor arg into the
+        # input list at its declared position
+        try:
+            arg_names = tuple(opdef.arg_names() or ())
+        except Exception:
+            arg_names = ()
+        named = {}
+        for k in list(kwargs):
+            if k in arg_names and isinstance(kwargs[k],
+                                             (NDArray, np.ndarray, jax.Array)):
+                v = kwargs.pop(k)
+                named[k] = v if isinstance(v, NDArray) else array(v)
+        if named:
+            slots = {n: named.get(n) for n in arg_names}
+            queue = list(nds)
+            for n in arg_names:
+                if slots[n] is None and queue:
+                    slots[n] = queue.pop(0)
+            ordered = [slots[n] for n in arg_names]
+            # an unfilled slot BEFORE a named one must stay as an explicit
+            # None placeholder (e.g. op(data, c=c) with optional middle b),
+            # or c would silently shift into b's position
+            while ordered and ordered[-1] is None:
+                ordered.pop()
+            nds = ordered + queue
         if pos_scalars:
             kwargs.setdefault("_pos", tuple(pos_scalars))
             # clip is the only common positional-scalar op

@@ -275,6 +275,7 @@ class DataParallelTrainer:
     def aot_save(self, path, *data) -> None:
         """Compile the fused step for this batch spec and serialize the
         executable (+ a compatibility key) to ``path``."""
+        import os
         import pickle
         from jax.experimental.serialize_executable import serialize
         arrays = [_unwrap(d) if isinstance(d, NDArray) else jnp.asarray(d)
@@ -287,11 +288,11 @@ class DataParallelTrainer:
         compiled = self._step_fn.lower(
             self._params, self._aux, self._opt_state, rng, *arrays).compile()
         ser, in_tree, out_tree = serialize(compiled)
-        tmp = "%s.tmp.%d" % (path, __import__("os").getpid())
+        tmp = "%s.tmp.%d" % (path, os.getpid())
         with open(tmp, "wb") as f:
             pickle.dump({"key": self._aot_key(arrays), "exe": ser,
                          "in_tree": in_tree, "out_tree": out_tree}, f)
-        __import__("os").replace(tmp, path)
+        os.replace(tmp, path)
         self._compiled = compiled
         self._place_state()
 
