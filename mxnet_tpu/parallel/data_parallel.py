@@ -103,6 +103,12 @@ class DataParallelTrainer:
         self._axis = data_axis
         self._compute_dtype = (jnp.dtype(compute_dtype)
                                if compute_dtype is not None else None)
+        # recorded for the AOT key: lr/momentum/wd are baked into the
+        # compiled executable as constants, so a blob from different
+        # hyperparameters must never be silently reused
+        self._opt_desc = (str(optimizer),
+                          tuple(sorted((str(k), repr(v)) for k, v in
+                                       (optimizer_params or {}).items())))
         self._tx = _make_optax(optimizer, optimizer_params)
         self._step_fn = None
         self._n_inputs = None
@@ -270,6 +276,7 @@ class DataParallelTrainer:
             "n_devices": int(self._mesh.devices.size),
             "in_shapes": [tuple(a.shape) + (str(a.dtype),) for a in arrays],
             "compute_dtype": str(self._compute_dtype),
+            "optimizer": self._opt_desc,
         }
 
     def aot_save(self, path, *data) -> None:
