@@ -279,6 +279,15 @@ class DataParallelTrainer:
             "optimizer": self._opt_desc,
         }
 
+    def _lowered_digest(self, lowered) -> str:
+        """Hash of the FULL lowered computation (StableHLO text): the model
+        graph, loss, optimizer constants — everything baked into the
+        executable. This is what actually guarantees a blob matches; the
+        config fields in the key are a cheap first filter."""
+        import hashlib
+        return hashlib.sha256(
+            lowered.as_text().encode("utf-8", "replace")).hexdigest()
+
     def aot_save(self, path, *data) -> None:
         """Compile the fused step for this batch spec and serialize the
         executable (+ a compatibility key) to ``path``."""
@@ -292,13 +301,16 @@ class DataParallelTrainer:
         dataspec = NamedSharding(self._mesh, P(self._axis))
         arrays = [jax.device_put(a, dataspec) for a in arrays]
         rng = jax.random.PRNGKey(0)
-        compiled = self._step_fn.lower(
-            self._params, self._aux, self._opt_state, rng, *arrays).compile()
+        lowered = self._step_fn.lower(
+            self._params, self._aux, self._opt_state, rng, *arrays)
+        digest = self._lowered_digest(lowered)
+        compiled = lowered.compile()
         ser, in_tree, out_tree = serialize(compiled)
         tmp = "%s.tmp.%d" % (path, os.getpid())
         with open(tmp, "wb") as f:
-            pickle.dump({"key": self._aot_key(arrays), "exe": ser,
-                         "in_tree": in_tree, "out_tree": out_tree}, f)
+            pickle.dump({"key": self._aot_key(arrays), "digest": digest,
+                         "exe": ser, "in_tree": in_tree,
+                         "out_tree": out_tree}, f)
         os.replace(tmp, path)
         self._compiled = compiled
         self._place_state()
@@ -329,6 +341,17 @@ class DataParallelTrainer:
             ((self._params, self._aux, self._opt_state,
               jax.random.PRNGKey(0)) + tuple(arrays), {}))
         if str(my_tree) != str(blob["in_tree"]):
+            return False
+        # strongest check: the blob must come from THIS lowered computation
+        # (model graph + loss + baked constants), not merely one with the
+        # same shapes. Lowering is local tracing — seconds, not the
+        # minutes a remote compile costs.
+        dataspec = NamedSharding(self._mesh, P(self._axis))
+        placed = [jax.device_put(a, dataspec) for a in arrays]
+        lowered = self._step_fn.lower(
+            self._params, self._aux, self._opt_state,
+            jax.random.PRNGKey(0), *placed)
+        if blob.get("digest") != self._lowered_digest(lowered):
             return False
         try:
             self._compiled = deserialize_and_load(

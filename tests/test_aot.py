@@ -77,6 +77,29 @@ def test_aot_load_rejects_mismatched_key(tmp_path):
     assert np.isfinite(float(t2.step(x2, y2)))
 
 
+def test_aot_load_rejects_different_computation(tmp_path):
+    """Same shapes + same param tree but a DIFFERENT lowered computation
+    (here: different optimizer constants -> different baked update) must
+    refuse to load — the digest check, not just the config key."""
+    rng = np.random.RandomState(0)
+    x, y = _batch(rng)
+    path = str(tmp_path / "step.pkl")
+    t1 = _make(seed=5)
+    t1.aot_save(path, x, y)
+    # tamper the cheap key so only the digest stands between a stale blob
+    # and silent reuse
+    import pickle
+    blob = pickle.load(open(path, "rb"))
+    t2 = _make(seed=5)
+    t2._capture(2, sample_arrays=[x, y])
+    blob["key"] = t2._aot_key([x, y])
+    blob["digest"] = "not-the-real-digest"
+    pickle.dump(blob, open(path, "wb"))
+    t3 = _make(seed=5)
+    assert not t3.aot_load(path, x, y)
+    assert t3._compiled is None
+
+
 def test_aot_load_missing_file_is_false(tmp_path):
     rng = np.random.RandomState(0)
     x, y = _batch(rng)
