@@ -330,3 +330,13 @@ def test_captcha_multi_head():
     import captcha_cnn
     digit, string = captcha_cnn.train(epochs=10, verbose=False)
     assert string > 0.9, (digit, string)
+
+
+def test_module_checkpoint_resume_walkthrough():
+    """Module lifecycle (reference example/module): checkpoint during
+    fit, reload in a fresh Module, verify bit-identical accuracy at the
+    resume point, finish training."""
+    sys.path.insert(0, os.path.join(ROOT, "example", "module"))
+    import mnist_module_walkthrough
+    mid, final = mnist_module_walkthrough.train(verbose=False)
+    assert final >= mid > 0.9, (mid, final)
