@@ -1,0 +1,180 @@
+"""Attribute-grid tests, round 3: optimizer update rules against
+torch.optim step-for-step, the indexing family (take/pick/gather_nd/
+one_hot/Embedding backward), and reduction grids (axis x keepdims x
+exclude) against numpy — reference test_operator.py/test_optimizer.py
+depth (VERDICT r3 weak #4).
+"""
+import itertools
+
+import numpy as np
+import pytest
+
+import torch
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu import optimizer as opt_mod
+
+
+# ---------------------------------------------------------------------------
+# Optimizer updates vs torch.optim: same trajectory over several steps
+# ---------------------------------------------------------------------------
+def _run_mx(opt, w0, grads):
+    upd = opt_mod.get_updater(opt)
+    w = nd.array(w0.copy())
+    for g in grads:
+        upd(0, nd.array(g), w)
+    return w.asnumpy()
+
+
+def _run_torch(make_opt, w0, grads):
+    w = torch.tensor(w0.copy(), requires_grad=True)
+    o = make_opt([w])
+    for g in grads:
+        o.zero_grad()
+        w.grad = torch.tensor(g)
+        o.step()
+    return w.detach().numpy()
+
+
+@pytest.fixture
+def traj(rng):
+    w0 = rng.uniform(-1, 1, (5, 4)).astype("float32")
+    grads = [rng.uniform(-1, 1, (5, 4)).astype("float32") for _ in range(6)]
+    return w0, grads
+
+
+@pytest.mark.parametrize("momentum", [0.0, 0.9])
+def test_sgd_matches_torch(traj, momentum):
+    w0, grads = traj
+    got = _run_mx(opt_mod.SGD(learning_rate=0.1, momentum=momentum, wd=0.0,
+                              rescale_grad=1.0), w0, grads)
+    want = _run_torch(lambda p: torch.optim.SGD(p, lr=0.1,
+                                                momentum=momentum), w0, grads)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_sgd_weight_decay_matches_torch(traj):
+    w0, grads = traj
+    got = _run_mx(opt_mod.SGD(learning_rate=0.1, momentum=0.9, wd=0.01,
+                              rescale_grad=1.0), w0, grads)
+    want = _run_torch(lambda p: torch.optim.SGD(p, lr=0.1, momentum=0.9,
+                                                weight_decay=0.01), w0, grads)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_adam_matches_torch(traj):
+    w0, grads = traj
+    got = _run_mx(opt_mod.Adam(learning_rate=0.01, beta1=0.9, beta2=0.999,
+                               epsilon=1e-8, wd=0.0, rescale_grad=1.0),
+                  w0, grads)
+    want = _run_torch(lambda p: torch.optim.Adam(p, lr=0.01,
+                                                 betas=(0.9, 0.999),
+                                                 eps=1e-8), w0, grads)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-6)
+
+
+def test_adagrad_matches_torch(traj):
+    w0, grads = traj
+    got = _run_mx(opt_mod.AdaGrad(learning_rate=0.05, eps=1e-10,
+                                  rescale_grad=1.0, wd=0.0), w0, grads)
+    want = _run_torch(lambda p: torch.optim.Adagrad(p, lr=0.05, eps=1e-10),
+                      w0, grads)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# Indexing family: take axes, pick, gather_nd, one_hot, Embedding grads
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("axis", [0, 1, 2])
+def test_take_axis_grid(rng, axis):
+    x = rng.uniform(-1, 1, (4, 5, 6)).astype("float32")
+    idx = rng.randint(0, x.shape[axis], (3,)).astype("float32")
+    out = nd.take(nd.array(x), nd.array(idx), axis=axis)
+    want = np.take(x, idx.astype(int), axis=axis)
+    np.testing.assert_allclose(out.asnumpy(), want, rtol=1e-6)
+
+
+@pytest.mark.parametrize("keepdims", [False, True])
+def test_pick_grid(rng, keepdims):
+    x = rng.uniform(-1, 1, (6, 5)).astype("float32")
+    idx = rng.randint(0, 5, (6,)).astype("float32")
+    out = nd.pick(nd.array(x), nd.array(idx), axis=1, keepdims=keepdims)
+    want = x[np.arange(6), idx.astype(int)]
+    if keepdims:
+        want = want[:, None]
+    np.testing.assert_allclose(out.asnumpy(), want, rtol=1e-6)
+
+
+def test_gather_nd_and_grad(rng):
+    x = rng.uniform(-1, 1, (4, 5)).astype("float32")
+    ids = np.array([[0, 1, 3], [2, 0, 4]], "float32")   # (2, K)
+    xm = nd.array(x)
+    xm.attach_grad()
+    with autograd.record():
+        out = nd.gather_nd(xm, nd.array(ids))
+        out.backward(nd.ones(out.shape))
+    want = x[ids[0].astype(int), ids[1].astype(int)]
+    np.testing.assert_allclose(out.asnumpy(), want, rtol=1e-6)
+    g = np.zeros_like(x)
+    for r, c in zip(ids[0].astype(int), ids[1].astype(int)):
+        g[r, c] += 1.0
+    np.testing.assert_allclose(xm.grad.asnumpy(), g, rtol=1e-6)
+
+
+def test_one_hot_grid(rng):
+    idx = rng.randint(0, 7, (3, 4)).astype("float32")
+    out = nd.one_hot(nd.array(idx), 7, on_value=2.0, off_value=-1.0)
+    assert out.shape == (3, 4, 7)
+    want = np.full((3, 4, 7), -1.0, "float32")
+    for i in range(3):
+        for j in range(4):
+            want[i, j, int(idx[i, j])] = 2.0
+    np.testing.assert_allclose(out.asnumpy(), want)
+
+
+def test_embedding_gradient_accumulates_duplicates(rng):
+    w = rng.uniform(-1, 1, (6, 3)).astype("float32")
+    idx = np.array([1.0, 1.0, 4.0], "float32")       # duplicate row 1
+    wm = nd.array(w)
+    wm.attach_grad()
+    with autograd.record():
+        out = nd.Embedding(nd.array(idx), wm, input_dim=6, output_dim=3)
+        out.backward(nd.ones(out.shape))
+    g = wm.grad.asnumpy()
+    np.testing.assert_allclose(g[1], [2, 2, 2], rtol=1e-6)   # accumulated
+    np.testing.assert_allclose(g[4], [1, 1, 1], rtol=1e-6)
+    np.testing.assert_allclose(g[[0, 2, 3, 5]], 0.0)
+
+
+# ---------------------------------------------------------------------------
+# Reductions: op x axis x keepdims x exclude vs numpy
+# ---------------------------------------------------------------------------
+_RED_GRID = list(itertools.product(
+    ["sum", "mean", "max", "min", "prod"],
+    [0, 1, (0, 2), None],
+    [False, True],
+    [False, True]))
+
+
+@pytest.mark.parametrize("op,axis,keepdims,exclude", _RED_GRID,
+                         ids=[f"{o}-ax{a}-k{int(k)}-x{int(e)}"
+                              for o, a, k, e in _RED_GRID])
+def test_reduction_grid(rng, op, axis, keepdims, exclude):
+    x = rng.uniform(0.5, 1.5, (3, 4, 5)).astype("float32")
+    kwargs = {"keepdims": keepdims, "exclude": exclude}
+    if axis is not None:
+        kwargs["axis"] = axis
+    out = getattr(nd, op)(nd.array(x), **kwargs)
+    ax = axis
+    if exclude and axis is not None:
+        # reference semantics: exclude inverts a GIVEN axis set; with no
+        # axis the reduction covers everything and exclude is a no-op
+        all_ax = set(range(3))
+        sel = {axis} if isinstance(axis, int) else set(axis)
+        ax = tuple(sorted(all_ax - sel)) or None
+    npop = {"sum": np.sum, "mean": np.mean, "max": np.max,
+            "min": np.min, "prod": np.prod}[op]
+    want = npop(x, axis=ax, keepdims=keepdims)
+    np.testing.assert_allclose(out.asnumpy(), np.asarray(want, "float32"),
+                               rtol=1e-5, atol=1e-6)
