@@ -255,6 +255,71 @@ def _cast(ctx, name, ins, attrs):
     return name
 
 
+@register("squeeze")
+def _squeeze(ctx, name, ins, attrs):
+    ax = attrs.get("axis")
+    kw = {}
+    if ax is not None and ax != ():
+        axes = (ax,) if isinstance(ax, int) else tuple(ax)
+        kw["axes"] = [int(a) for a in axes]
+    ctx.add_node("Squeeze", ins[:1], [name], **kw)
+    return name
+
+
+@register("expand_dims")
+def _expand_dims(ctx, name, ins, attrs):
+    ctx.add_node("Unsqueeze", ins[:1], [name],
+                 axes=[int(attrs.get("axis", 0))])
+    return name
+
+
+@register("slice_axis")
+def _slice_axis(ctx, name, ins, attrs):
+    end = attrs.get("end")
+    ctx.add_node("Slice", ins[:1], [name],
+                 axes=[int(attrs.get("axis", 0))],
+                 starts=[int(attrs.get("begin", 0))],
+                 ends=[2 ** 31 - 1 if end in (None, "None") else int(end)])
+    return name
+
+
+@register("SliceChannel")
+@register("split")
+def _slice_channel(ctx, name, ins, attrs):
+    n = int(attrs.get("num_outputs", 1))
+    outs = [f"{name}_out{i}" for i in range(n)]
+    ctx.add_node("Split", ins[:1], outs, axis=int(attrs.get("axis", 1)))
+    if str(attrs.get("squeeze_axis", False)) in ("True", "1", "true"):
+        sq = []
+        for o in outs:
+            ctx.add_node("Squeeze", [o], [o + "_sq"],
+                         axes=[int(attrs.get("axis", 1))])
+            sq.append(o + "_sq")
+        outs = sq
+    return outs
+
+
+@register("LRN")
+def _lrn_export(ctx, name, ins, attrs):
+    ctx.add_node("LRN", ins[:1], [name],
+                 alpha=float(attrs.get("alpha", 1e-4)),
+                 beta=float(attrs.get("beta", 0.75)),
+                 bias=float(attrs.get("knorm", 2.0)),
+                 size=int(attrs.get("nsize", 5)))
+    return name
+
+
+@register("Pad")
+@register("pad")
+def _pad_export(ctx, name, ins, attrs):
+    pw = [int(x) for x in attrs.get("pad_width", ())]
+    nd = len(pw) // 2
+    pads = [pw[2 * i] for i in range(nd)] + [pw[2 * i + 1] for i in range(nd)]
+    ctx.add_node("Pad", ins[:1], [name], mode=attrs.get("mode", "constant"),
+                 pads=pads, value=float(attrs.get("constant_value", 0.0)))
+    return name
+
+
 def _binary(onnx_op):
     def fn(ctx, name, ins, attrs):
         ctx.add_node(onnx_op, ins, [name])

@@ -228,6 +228,68 @@ def _pad(name, ins, attrs, st):
                       constant_value=float(attrs.get("value", 0.0)))
 
 
+@register("Squeeze")
+def _squeeze(name, ins, attrs, st):
+    if len(ins) > 1:        # opset >= 13 axes-as-input form
+        raise MXNetError("ONNX import: Squeeze with axes as an input "
+                         "(opset >= 13) is not supported; use opset 11")
+    axes = [int(a) for a in attrs.get("axes", ())]
+    return _sym().squeeze(ins[0], name=name,
+                          axis=tuple(axes) if axes else None)
+
+
+@register("Unsqueeze")
+def _unsqueeze(name, ins, attrs, st):
+    if len(ins) > 1:        # opset >= 13 axes-as-input form
+        raise MXNetError("ONNX import: Unsqueeze with axes as an input "
+                         "(opset >= 13) is not supported; use opset 11")
+    out = ins[0]
+    for a in sorted(int(a) for a in attrs.get("axes", ())):
+        out = _sym().expand_dims(out, axis=a)
+    return out
+
+
+@register("Slice")
+def _slice(name, ins, attrs, st):
+    starts = [int(a) for a in attrs.get("starts", ())]
+    ends = [int(a) for a in attrs.get("ends", ())]
+    if not starts:
+        # opset >= 10 moved starts/ends/axes to INPUTS; silently returning
+        # the tensor unsliced would corrupt numerics downstream
+        raise MXNetError(
+            "ONNX import: Slice with input-form starts/ends (opset >= 10) "
+            "is not supported; re-export at opset 9 attribute form")
+    axes = [int(a) for a in attrs.get("axes", range(len(starts)))]
+    out = ins[0]
+    for ax, b, e in zip(axes, starts, ends):
+        out = _sym().slice_axis(out, axis=ax, begin=b,
+                                end=None if e >= 2 ** 31 - 1 else e)
+    return out
+
+
+@register("Split")
+def _split(name, ins, attrs, st):
+    axis = int(attrs.get("axis", 0))
+    sizes = [int(s) for s in attrs.get("split", ())]
+    if sizes and len(set(sizes)) > 1:
+        raise MXNetError(
+            f"ONNX import: uneven Split sizes {sizes} are not supported "
+            "(SliceChannel is equal-section)")
+    n = len(sizes) or int(st.get("n_outputs", 0))
+    if n < 1:
+        raise MXNetError("ONNX import: Split with no output count")
+    return _sym().SliceChannel(ins[0], name=name, num_outputs=n, axis=axis)
+
+
+@register("LRN")
+def _lrn(name, ins, attrs, st):
+    return _sym().LRN(ins[0], name=name,
+                      alpha=float(attrs.get("alpha", 1e-4)),
+                      beta=float(attrs.get("beta", 0.75)),
+                      knorm=float(attrs.get("bias", 1.0)),
+                      nsize=int(attrs.get("size", 5)))
+
+
 def _binary(mx_op):
     def fn(name, ins, attrs, st):
         return getattr(_sym(), mx_op)(ins[0], ins[1], name=name)
@@ -303,6 +365,7 @@ def import_model(model_file: str):
                 f"ONNX import: op {node.op_type} not supported")
         name = node.name or node.outputs[0]
         st["raw_inputs"][name] = node.inputs
+        st["n_outputs"] = len(node.outputs)
         ins = [env[i] for i in node.inputs if i in env]
         if node.op_type == "Reshape" and len(ins) == 2:
             ins = ins[:1]  # shape tensor consumed via st["consts"] instead
