@@ -150,7 +150,10 @@ def run_bench():
 
     np.random.seed(0)
     mx.random.seed(0)   # initializers draw from the framework host stream
-    net = vision.resnet50_v1(classes=1000, layout=layout)
+    # BENCH_S2D=1 enables the space-to-depth stem (exact 7x7/s2
+    # reparameterization, tests/test_s2d_stem.py) — NHWC only
+    s2d = os.environ.get("BENCH_S2D") == "1" and layout == "NHWC"
+    net = vision.resnet50_v1(classes=1000, layout=layout, stem_s2d=s2d)
     net.initialize(mx.init.Xavier())
     loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
     trainer = parallel.DataParallelTrainer(
@@ -174,7 +177,9 @@ def run_bench():
     # (tools/aot_warm.py writes it outside the bench window). Exactly one
     # compile ever happens: aot_save IS the compile when the blob is cold.
     aot_path = os.environ.get(
-        "BENCH_AOT", os.path.join(HERE, ".bench_aot", "resnet50_step.pkl"))
+        "BENCH_AOT", os.path.join(
+            HERE, ".bench_aot",
+            "resnet50_step_s2d.pkl" if s2d else "resnet50_step.pkl"))
     t_compile = time.perf_counter()
     loaded = False
     try:
@@ -216,7 +221,8 @@ def run_bench():
         "value": round(per_chip, 2),
         "unit": "img/s/chip",
         "vs_baseline": round(per_chip / BASELINE_IMG_S, 3),
-        "batch": batch, "image": image, "steps": steps, "layout": layout,
+        "batch": batch, "image": image, "steps": steps,
+        "layout": layout + ("+s2d" if s2d else ""),
         "n_chips": n_chips, "device_kind": device_kind,
         "platform": devices[0].platform,
     }

@@ -243,8 +243,20 @@ def _unsqueeze(name, ins, attrs, st):
     if len(ins) > 1:        # opset >= 13 axes-as-input form
         raise MXNetError("ONNX import: Unsqueeze with axes as an input "
                          "(opset >= 13) is not supported; use opset 11")
+    axes = [int(a) for a in attrs.get("axes", ())]
+    # ONNX axes index the OUTPUT rank; insertion order matters. Positive
+    # axes insert ascending; all-negative axes insert descending (the one
+    # closest to the end first), so e.g. axes=[-2,-1] on (3,) correctly
+    # yields (3,1,1). Mixed signs would need the (unknown) input rank.
     out = ins[0]
-    for a in sorted(int(a) for a in attrs.get("axes", ())):
+    if all(a >= 0 for a in axes):
+        order = sorted(axes)
+    elif all(a < 0 for a in axes):
+        order = sorted(axes, reverse=True)
+    else:
+        raise MXNetError("ONNX import: Unsqueeze with mixed-sign axes "
+                         f"{axes} needs a static input rank")
+    for a in order:
         out = _sym().expand_dims(out, axis=a)
     return out
 

@@ -60,12 +60,11 @@ def main():
             mx.random.seed(0)
             # variant token "S2D" = NHWC + space-to-depth stem (exact
             # 7x7/s2 reparameterization, tests/test_s2d_stem.py)
-            if layout == "S2D":
+            s2d = layout == "S2D"
+            if s2d:
                 layout = "NHWC"
-                net = vision.resnet50_v1(classes=1000, layout=layout,
-                                         stem_s2d=True)
-            else:
-                net = vision.resnet50_v1(classes=1000, layout=layout)
+            net = vision.resnet50_v1(classes=1000, layout=layout,
+                                     stem_s2d=s2d)
             net.initialize(mx.init.Xavier())
             loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
             trainer = parallel.DataParallelTrainer(
@@ -78,12 +77,40 @@ def main():
             y = np.random.randint(0, 1000, (batch,)).astype("float32")
             spec = NamedSharding(trainer.mesh, P("dp"))
             t0 = time.perf_counter()
+            # bench-default variant: route the one compile through
+            # aot_save so the ladder run doubles as the driver bench's
+            # AOT warm (exactly one compile either way — step() then
+            # reuses the serialized executable)
+            warm_bench = (on_accel and layout == "NHWC" and batch == 256
+                          and image == 224)
+            # s2d gets its OWN blob: the two executables would otherwise
+            # evict each other and re-pay the multi-minute compile
+            blob_name = ("resnet50_step_s2d.pkl" if s2d
+                         else "resnet50_step.pkl")
+            aot_path = os.environ.get(
+                "BENCH_AOT", os.path.join(HERE, ".bench_aot", blob_name))
+
+            def first_call():
+                if warm_bench:
+                    try:
+                        d = os.path.dirname(aot_path)
+                        if d:
+                            os.makedirs(d, exist_ok=True)
+                        if not trainer.aot_load(aot_path, x, y):
+                            trainer.aot_save(aot_path, x, y)
+                            print(f"# bench AOT blob refreshed -> "
+                                  f"{aot_path}", file=sys.stderr, flush=True)
+                    except Exception as e:   # warm is a nicety, not a dep
+                        print(f"# aot warm failed (jit fallback): "
+                              f"{repr(e)[:200]}", file=sys.stderr, flush=True)
+                return trainer.step(x, y)
+
             # the axon tunnel's remote_compile occasionally drops the
             # connection mid-body; that is transient — retry, don't lose
             # the whole variant (and the cache warm) to it
             for attempt in range(3):
                 try:
-                    loss = trainer.step(x, y)
+                    loss = first_call()
                     float(loss)
                     break
                 except Exception as e:
