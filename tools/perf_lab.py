@@ -61,6 +61,7 @@ def main():
             # variant token "S2D" = NHWC + space-to-depth stem (exact
             # 7x7/s2 reparameterization, tests/test_s2d_stem.py)
             s2d = layout == "S2D"
+            label = layout
             if s2d:
                 layout = "NHWC"
             net = vision.resnet50_v1(classes=1000, layout=layout,
@@ -133,7 +134,7 @@ def main():
             ips = steps * batch / dt
             flops = 12.3e9 * (image / 224.0) ** 2 * batch * (steps / dt)
             print(json.dumps({
-                "variant": f"{layout}:{batch}", "img_s": round(ips, 1),
+                "variant": f"{label}:{batch}", "img_s": round(ips, 1),
                 "step_ms": round(1e3 * dt / steps, 2),
                 "compile_s": round(compile_s, 1),
                 "analytic_tflops": round(flops / 1e12, 1),
@@ -141,7 +142,7 @@ def main():
             }), flush=True)
             last = (trainer, xd, yd, layout, batch)
         except Exception as e:
-            print(json.dumps({"variant": f"{layout}:{batch}",
+            print(json.dumps({"variant": f"{label}:{batch}",
                               "error": repr(e)[:300]}), flush=True)
         print(f"# variant took {time.perf_counter() - t_var:.0f}s total",
               file=sys.stderr, flush=True)
