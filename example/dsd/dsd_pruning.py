@@ -6,8 +6,8 @@ loop (sparse phase keeps gradients flowing but re-zeros pruned weights
 after every update), then mask release for the re-dense phase — the
 train/prune/retrain pattern, and direct Parameter surgery between phases.
 
-Reference parity: /root/reference/example/dsd/sparsity.py (apply_pruning
-with per-layer sparsity schedule).
+Reference parity: /root/reference/example/dsd/mlp.py + sparse_sgd.py
+(SGD variant that re-applies the pruning mask each update).
 """
 import numpy as np
 
