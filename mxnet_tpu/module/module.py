@@ -62,10 +62,11 @@ class Module(BaseModule):
         from ..model import load_checkpoint
         sym, args, auxs = load_checkpoint(prefix, epoch)
         mod = Module(sym, **kwargs)
+        # consumed by init_params() after bind: loaded values win over the
+        # initializer (reference Module.load -> set_params flow)
         mod._arg_params = args
         mod._aux_params = auxs
         mod.params_initialized = False
-        mod._preloaded_params = (args, auxs)
         return mod
 
     # ------------------------------------------------------------- binding
