@@ -182,19 +182,22 @@ def run_bench():
             "resnet50_step_s2d.pkl" if s2d else "resnet50_step.pkl"))
     t_compile = time.perf_counter()
     loaded = False
-    try:
-        os.makedirs(os.path.dirname(aot_path), exist_ok=True)
-        loaded = trainer.aot_load(aot_path, x, y)
-    except Exception as e:
-        print("aot_load failed (will compile): %s" % e, file=sys.stderr)
-    if loaded:
-        print("AOT executable loaded in %.1fs (compile skipped)"
-              % (time.perf_counter() - t_compile), file=sys.stderr, flush=True)
-    else:
+    if on_accel:   # CPU-fallback compiles are fast; don't pollute the blob
         try:
-            trainer.aot_save(aot_path, x, y)
+            os.makedirs(os.path.dirname(aot_path), exist_ok=True)
+            loaded = trainer.aot_load(aot_path, x, y)
         except Exception as e:
-            print("aot_save failed (jit fallback): %s" % e, file=sys.stderr)
+            print("aot_load failed (will compile): %s" % e, file=sys.stderr)
+        if loaded:
+            print("AOT executable loaded in %.1fs (compile skipped)"
+                  % (time.perf_counter() - t_compile), file=sys.stderr,
+                  flush=True)
+        else:
+            try:
+                trainer.aot_save(aot_path, x, y)
+            except Exception as e:
+                print("aot_save failed (jit fallback): %s" % e,
+                      file=sys.stderr)
     loss = trainer.step(x, y)  # AOT: runs the executable; else jit-compiles
     float(loss)
     print("first step (compile) took %.1fs" % (time.perf_counter() - t_compile),
