@@ -1,0 +1,77 @@
+"""bench.py driver-contract tests: the metric-line parser, the last-good
+cache, and the degradation marking the driver's machine consumers rely on
+(ADVICE r3: cached re-prints must be machine-distinguishable from live
+measurements).
+"""
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test", os.path.join(ROOT, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_metric_lines_parser():
+    bench = _load_bench()
+    text = "\n".join([
+        "random stderr noise",
+        json.dumps({"metric": "m", "value": 1.0}),
+        '{"not_metric": true}',
+        '{"metric": "m", broken json',
+        "  " + json.dumps({"metric": "m", "value": 2.0}) + "  ",
+    ])
+    lines = bench._metric_lines(text)
+    assert [ln["value"] for ln in lines] == [1.0, 2.0]
+
+
+def test_cache_roundtrip(tmp_path, monkeypatch):
+    bench = _load_bench()
+    monkeypatch.setattr(bench, "CACHE_PATH", str(tmp_path / "cache.json"))
+    assert bench._read_cache() is None
+    bench._write_cache({"metric": "m", "value": 3.0, "unit": "u"})
+    got = bench._read_cache()
+    assert got["value"] == 3.0
+    # corrupt file -> clean None, not an exception
+    with open(bench.CACHE_PATH, "w") as f:
+        f.write("{broken")
+    assert bench._read_cache() is None
+
+
+def test_peak_flops_lookup():
+    bench = _load_bench()
+    assert bench._peak_flops("TPU v5 lite") == 197e12
+    assert bench._peak_flops("TPU v5p") == 459e12
+    assert bench._peak_flops("TPU v4") == 275e12
+    assert bench._peak_flops("unknown accelerator") is None
+    assert bench._peak_flops(None) is None
+
+
+def test_driver_run_emits_final_line_without_tpu(tmp_path):
+    """End-to-end parent run with the TPU skipped: the LAST stdout line
+    must be valid metric JSON, and with no cache the CPU fallback must be
+    marked degraded."""
+    env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+    env.update(JAX_PLATFORMS="cpu", BENCH_SKIP_TPU="1",
+               BENCH_TOTAL_BUDGET="150", HOME=str(tmp_path))
+    # run from a scratch cwd copy of bench.py so the repo cache file is
+    # not consulted (cached-first would mask the degradation path)
+    bench_copy = tmp_path / "bench.py"
+    bench_copy.write_bytes(open(os.path.join(ROOT, "bench.py"), "rb").read())
+    (tmp_path / "mxnet_tpu").symlink_to(os.path.join(ROOT, "mxnet_tpu"))
+    r = subprocess.run([sys.executable, str(bench_copy)],
+                       capture_output=True, text=True, env=env, timeout=240)
+    lines = [ln for ln in r.stdout.splitlines() if ln.strip()]
+    assert lines, r.stderr[-400:]
+    final = json.loads(lines[-1])
+    assert final["metric"] == "resnet50_train_throughput_per_chip"
+    assert "value" in final and "vs_baseline" in final
+    assert "degraded" in final        # no cache + no TPU => must be flagged
