@@ -383,6 +383,9 @@ class Marker:
                                  "pid": os.getpid()})
 
 
+# reference back-compat alias (python/mxnet/profiler.py dump_profile)
+dump_profile = dump
+
 if get_env("MXNET_PROFILER_AUTOSTART", False):
     set_config(profile_all=True)
     start()
