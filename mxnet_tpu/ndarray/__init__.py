@@ -14,11 +14,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from .ndarray import NDArray, array, _wrap, _unwrap
-from .utils import (zeros, ones, full, empty, arange, save, load, concat,
-                    stack, split, one_hot, concatenate, moveaxis)
+from .utils import (zeros, ones, full, empty, arange, save, load,
+                    load_frombuffer, concat, stack, split, one_hot,
+                    concatenate, moveaxis)
 from . import sparse
 from .. import random as _random
 from .._imperative import invoke
+from ..base import MXNetError
 from ..context import Context, current_context
 from ..ops.registry import get_op, list_ops, _REGISTRY
 
@@ -47,6 +49,70 @@ maximum = _scalar_or_elemwise("broadcast_maximum", "_maximum_scalar")
 minimum = _scalar_or_elemwise("broadcast_minimum", "_minimum_scalar")
 power = _scalar_or_elemwise("broadcast_power", "_power_scalar",
                             "_rpower_scalar")
+add = _scalar_or_elemwise("broadcast_add", "_plus_scalar")
+subtract = _scalar_or_elemwise("broadcast_sub", "_minus_scalar",
+                               "_rminus_scalar")
+multiply = _scalar_or_elemwise("broadcast_mul", "_mul_scalar")
+divide = _scalar_or_elemwise("broadcast_div", "_div_scalar", "_rdiv_scalar")
+true_divide = divide
+modulo = _scalar_or_elemwise("broadcast_mod", "_mod_scalar", "_rmod_scalar")
+equal = _scalar_or_elemwise("broadcast_equal", "_equal_scalar")
+not_equal = _scalar_or_elemwise("broadcast_not_equal", "_not_equal_scalar")
+greater = _scalar_or_elemwise("broadcast_greater", "_greater_scalar")
+greater_equal = _scalar_or_elemwise("broadcast_greater_equal",
+                                    "_greater_equal_scalar")
+lesser = _scalar_or_elemwise("broadcast_lesser", "_lesser_scalar")
+lesser_equal = _scalar_or_elemwise("broadcast_lesser_equal",
+                                   "_lesser_equal_scalar")
+logical_and = _scalar_or_elemwise("broadcast_logical_and",
+                                  "_logical_and_scalar")
+logical_or = _scalar_or_elemwise("broadcast_logical_or",
+                                 "_logical_or_scalar")
+logical_xor = _scalar_or_elemwise("broadcast_logical_xor",
+                                  "_logical_xor_scalar")
+
+
+def onehot_encode(indices, out):
+    """Legacy one-hot fill (reference ndarray.py onehot_encode): writes the
+    one-hot expansion of ``indices`` into ``out`` and returns it."""
+    depth = out.shape[1]
+    hot = invoke("one_hot", [indices], {"depth": int(depth)})
+    out._set_data(hot._data.astype(out.dtype))
+    return out
+
+
+def from_dlpack(ext_tensor) -> NDArray:
+    """Zero-copy import of a DLPack tensor (reference from_dlpack).
+
+    Takes a modern DLPack PROVIDER (any object with ``__dlpack__`` /
+    ``__dlpack_device__`` — a torch tensor, numpy array, jax array, or the
+    view :func:`to_dlpack_for_read` returns). Raw legacy PyCapsules are
+    rejected with guidance — the 2018-era capsule protocol predates the
+    standardized one every current framework speaks."""
+    if type(ext_tensor).__name__ == "PyCapsule":
+        raise MXNetError(
+            "from_dlpack takes a DLPack provider object (torch tensor, "
+            "numpy array, ...), not a raw capsule; pass the tensor itself")
+    return NDArray(jnp.from_dlpack(ext_tensor))
+
+
+def to_dlpack_for_read(arr: NDArray):
+    """Export as a DLPack provider; the array is synced first (reference
+    to_dlpack_for_read). jax arrays are immutable, so the read/write
+    variants coincide; consumers call ``torch.from_dlpack(view)`` /
+    ``np.from_dlpack(view)`` on the result."""
+    arr.wait_to_read()
+    return _unwrap(arr)
+
+
+to_dlpack_for_write = to_dlpack_for_read
+
+
+def imdecode(buf, **kwargs) -> NDArray:
+    """Decode an image buffer (reference nd.imdecode; delegates to the
+    image module's decoder)."""
+    from .. import image as _image
+    return _image.imdecode(buf, **kwargs)
 
 
 def waitall() -> None:

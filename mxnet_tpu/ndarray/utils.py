@@ -128,28 +128,48 @@ def save(fname: str, data) -> None:
             f.write(b)
 
 
+def _load_stream(f, label):
+    """Shared body of :func:`load`/:func:`load_frombuffer`: parse either
+    this framework's container or the reference's (via interop)."""
+    magic = f.read(8)
+    if magic != _MAGIC:
+        import tempfile
+        from .. import interop
+        f.seek(0)
+        data = f.read()
+        with tempfile.NamedTemporaryFile(suffix=".params") as tmp:
+            tmp.write(data)
+            tmp.flush()
+            if interop.is_reference_params_file(tmp.name):
+                arrays, names = interop.load_reference_ndarrays(tmp.name)
+                return dict(zip(names, arrays)) if names else arrays
+        raise MXNetError(f"{label}: not a mxnet_tpu NDArray file "
+                         f"(bad magic {magic!r}) and not a reference "
+                         f".params file either")
+    (hlen,) = struct.unpack("<Q", f.read(8))
+    header = json.loads(f.read(hlen).decode())
+    arrays = []
+    for meta in header["metas"]:
+        (blen,) = struct.unpack("<Q", f.read(8))
+        buf = f.read(blen)
+        np_a = np.frombuffer(buf, dtype=meta["dtype"]).reshape(meta["shape"])
+        arrays.append(array(np_a))
+    if header["keys"] is None:
+        return arrays
+    return dict(zip(header["keys"], arrays))
+
+
 def load(fname: str):
     """Load NDArrays saved by :func:`save` — or by the reference's
     ``mx.nd.save`` (the dmlc ``0x112`` list container, auto-detected and
     routed through :mod:`mxnet_tpu.interop`); returns list or dict."""
     with open(fname, "rb") as f:
-        magic = f.read(8)
-        if magic != _MAGIC:
-            from .. import interop
-            if interop.is_reference_params_file(fname):
-                arrays, names = interop.load_reference_ndarrays(fname)
-                return dict(zip(names, arrays)) if names else arrays
-            raise MXNetError(f"{fname}: not a mxnet_tpu NDArray file "
-                             f"(bad magic {magic!r}) and not a reference "
-                             f".params file either")
-        (hlen,) = struct.unpack("<Q", f.read(8))
-        header = json.loads(f.read(hlen).decode())
-        arrays = []
-        for meta in header["metas"]:
-            (blen,) = struct.unpack("<Q", f.read(8))
-            buf = f.read(blen)
-            np_a = np.frombuffer(buf, dtype=meta["dtype"]).reshape(meta["shape"])
-            arrays.append(array(np_a))
-    if header["keys"] is None:
-        return arrays
-    return dict(zip(header["keys"], arrays))
+        return _load_stream(f, fname)
+
+
+def load_frombuffer(buf: bytes):
+    """Load NDArrays from an in-memory file image (reference
+    ``nd.load_frombuffer``) — same container auto-detection as
+    :func:`load`."""
+    import io
+    return _load_stream(io.BytesIO(buf), "<buffer>")

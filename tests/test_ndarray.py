@@ -132,3 +132,51 @@ def test_dynamic_method_dispatch(rng):
     assert_almost_equal(x.sqrt(), np.sqrt(x.asnumpy()), rtol=1e-5)
     assert x.sum(axis=0).shape == (4,)
     assert x.mean().shape == ()
+
+
+def test_module_level_binary_conveniences(rng):
+    """Reference nd top-level dispatchers (add/subtract/.../logical_xor):
+    scalar and array operands, both orders."""
+    import numpy as np
+    a = mx.nd.array(np.array([1., 2., 3.], "f4"))
+    b = mx.nd.array(np.array([3., 2., 1.], "f4"))
+    np.testing.assert_allclose(mx.nd.add(a, b).asnumpy(), [4, 4, 4])
+    np.testing.assert_allclose(mx.nd.subtract(10.0, a).asnumpy(), [9, 8, 7])
+    np.testing.assert_allclose(mx.nd.multiply(a, 2.0).asnumpy(), [2, 4, 6])
+    np.testing.assert_allclose(mx.nd.divide(a, b).asnumpy(),
+                               [1 / 3, 1.0, 3.0], rtol=1e-6)
+    np.testing.assert_allclose(mx.nd.modulo(a, 2.0).asnumpy(), [1, 0, 1])
+    np.testing.assert_allclose(mx.nd.greater(a, b).asnumpy(), [0, 0, 1])
+    np.testing.assert_allclose(mx.nd.lesser_equal(a, 2.0).asnumpy(),
+                               [1, 1, 0])
+    np.testing.assert_allclose(mx.nd.not_equal(a, b).asnumpy(), [1, 0, 1])
+    np.testing.assert_allclose(mx.nd.logical_and(a - 1.0, b).asnumpy(),
+                               [0, 1, 1])
+    np.testing.assert_allclose(mx.nd.logical_xor(a - 1.0, b - 1.0).asnumpy(),
+                               [1, 0, 1])
+
+
+def test_onehot_encode_and_load_frombuffer(tmp_path):
+    import numpy as np
+    out = mx.nd.zeros((3, 4))
+    mx.nd.onehot_encode(mx.nd.array(np.array([0., 3., 1.], "f4")), out)
+    got = out.asnumpy()
+    assert got.sum() == 3 and got[0, 0] == 1 and got[1, 3] == 1
+    a = mx.nd.array(np.arange(6, dtype="f4").reshape(2, 3))
+    p = str(tmp_path / "arrs.nd")
+    mx.nd.save(p, {"w": a})
+    loaded = mx.nd.load_frombuffer(open(p, "rb").read())
+    np.testing.assert_allclose(loaded["w"].asnumpy(), a.asnumpy())
+
+
+def test_dlpack_roundtrip_with_torch():
+    import numpy as np
+    import torch
+    a = mx.nd.array(np.array([1., 2., 3.], "f4"))
+    view = mx.nd.to_dlpack_for_read(a)
+    back = mx.nd.from_dlpack(view)
+    np.testing.assert_allclose(back.asnumpy(), a.asnumpy())
+    t = torch.tensor([5.0, 6.0])
+    np.testing.assert_allclose(mx.nd.from_dlpack(t).asnumpy(), [5, 6])
+    tt = torch.from_dlpack(mx.nd.to_dlpack_for_read(a))
+    np.testing.assert_allclose(tt.numpy(), a.asnumpy())
