@@ -113,3 +113,26 @@ def zeros(shape, dtype="float32", **kw):
 
 def ones(shape, dtype="float32", **kw):
     return _invoke_sym("_ones", [], {"shape": tuple(shape) if not isinstance(shape, int) else (shape,), "dtype": dtype})
+
+
+def _scalar_or_bcast(bcast_op, scalar_op, rscalar_op=None):
+    """Reference-style module-level binary (symbol.py:pow/maximum/minimum/
+    hypot): Symbol-Symbol uses the broadcast op, Symbol-scalar the scalar
+    op (reversed variant when the scalar is on the left)."""
+    def fn(left, right):
+        l_sym = isinstance(left, Symbol)
+        r_sym = isinstance(right, Symbol)
+        if l_sym and r_sym:
+            return _invoke_sym(bcast_op, [left, right], {})
+        if l_sym:
+            return _invoke_sym(scalar_op, [left], {"scalar": float(right)})
+        if r_sym:
+            return _invoke_sym(rscalar_op or scalar_op, [right],
+                               {"scalar": float(left)})
+        raise TypeError("at least one operand must be a Symbol")
+    return fn
+
+
+maximum = _scalar_or_bcast("broadcast_maximum", "_maximum_scalar")
+minimum = _scalar_or_bcast("broadcast_minimum", "_minimum_scalar")
+hypot = _scalar_or_bcast("broadcast_hypot", "_hypot_scalar")
