@@ -1,0 +1,64 @@
+#!/usr/bin/env python
+"""Control-flow micro-benchmark (reference benchmark/python/control_flow):
+``contrib.foreach`` (lax.scan lowering) vs a Python-unrolled step loop —
+the reason compiler-friendly control flow matters on TPU.
+
+    python benchmark/python/bench_control_flow.py --seq 64 --hidden 128
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seq", type=int, default=32)
+    ap.add_argument("--batch", type=int, default=16)
+    ap.add_argument("--hidden", type=int, default=64)
+    ap.add_argument("--steps", type=int, default=10)
+    args = ap.parse_args()
+
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu.contrib import control_flow as cf
+
+    rng = np.random.RandomState(0)
+    seq = mx.nd.array(rng.randn(args.seq, args.batch, args.hidden)
+                      .astype("float32"))
+    w = mx.nd.array((rng.randn(args.hidden, args.hidden) * 0.1)
+                    .astype("float32"))
+    h0 = mx.nd.zeros((args.batch, args.hidden))
+
+    def cell(x_t, h):
+        return mx.nd.tanh(mx.nd.dot(x_t, w) + h)
+
+    def run_foreach():
+        outs, final = cf.foreach(lambda x, s: (cell(x, s[0]),
+                                               [cell(x, s[0])]), seq, [h0])
+        final[0].wait_to_read()
+
+    def run_unrolled():
+        h = h0
+        for t in range(args.seq):
+            h = cell(seq[t], h)
+        h.wait_to_read()
+
+    for name, fn in (("foreach_scan", run_foreach),
+                     ("python_unrolled", run_unrolled)):
+        fn()                              # warm/compile
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            fn()
+        dt = (time.perf_counter() - t0) / args.steps
+        print(json.dumps({"bench": "control_flow", "variant": name,
+                          "seq": args.seq, "hidden": args.hidden,
+                          "ms": round(dt * 1e3, 3)}))
+
+
+if __name__ == "__main__":
+    main()

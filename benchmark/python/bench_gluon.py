@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""Gluon model micro-benchmark (reference benchmark/python/gluon): forward
+and forward+backward timing for zoo models on the current backend.
+
+    python benchmark/python/bench_gluon.py --model resnet18_v1 --batch 8
+
+Prints one JSON row per phase; synthetic data, any backend.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="resnet18_v1")
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--image", type=int, default=64)
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--warmup", type=int, default=3)
+    ap.add_argument("--layout", default="NCHW")
+    args = ap.parse_args()
+
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    mx.random.seed(0)
+    kwargs = {"classes": 10}
+    if "resnet" in args.model:
+        kwargs["layout"] = args.layout
+    net = getattr(vision, args.model)(**kwargs)
+    net.initialize(mx.init.Xavier())
+    shape = ((args.batch, args.image, args.image, 3)
+             if args.layout == "NHWC"
+             else (args.batch, 3, args.image, args.image))
+    x = mx.nd.array(np.random.RandomState(0).uniform(-1, 1, shape)
+                    .astype("float32"))
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    y = mx.nd.array(np.random.RandomState(1).randint(0, 10, (args.batch,))
+                    .astype("float32"))
+
+    def timed(fn):
+        for _ in range(args.warmup):
+            fn()
+        mx.nd.waitall()
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            fn()
+        mx.nd.waitall()
+        return (time.perf_counter() - t0) / args.steps
+
+    fwd = timed(lambda: net(x).wait_to_read())
+
+    def fwd_bwd():
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+
+    fb = timed(fwd_bwd)
+    for phase, dt in (("forward", fwd), ("forward_backward", fb)):
+        print(json.dumps({"bench": "gluon", "model": args.model,
+                          "phase": phase, "batch": args.batch,
+                          "ms": round(dt * 1e3, 3),
+                          "samples_per_sec": round(args.batch / dt, 1)}))
+
+
+if __name__ == "__main__":
+    main()

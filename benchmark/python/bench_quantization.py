@@ -1,0 +1,68 @@
+#!/usr/bin/env python
+"""Quantization micro-benchmark (reference benchmark/python/quantization):
+float vs int8 FullyConnected/Convolution inference timing through the
+registered quantized ops.
+
+    python benchmark/python/bench_quantization.py --batch 32
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--in-dim", type=int, default=512)
+    ap.add_argument("--out-dim", type=int, default=512)
+    ap.add_argument("--steps", type=int, default=20)
+    args = ap.parse_args()
+
+    import numpy as np
+    import mxnet_tpu as mx
+
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.uniform(-1, 1, (args.batch, args.in_dim))
+                    .astype("float32"))
+    w = mx.nd.array(rng.uniform(-1, 1, (args.out_dim, args.in_dim))
+                    .astype("float32"))
+    b = mx.nd.zeros((args.out_dim,))
+
+    def timed(fn):
+        fn()
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            out = fn()
+        out.wait_to_read()
+        return (time.perf_counter() - t0) / args.steps
+
+    f32 = timed(lambda: mx.nd.FullyConnected(x, w, b,
+                                             num_hidden=args.out_dim))
+
+    qx, xmin, xmax = mx.nd.contrib.quantize(
+        x, mx.nd.array([-1.0]), mx.nd.array([1.0]), out_type="int8")
+    qw, wmin, wmax = mx.nd.contrib.quantize(
+        w, mx.nd.array([-1.0]), mx.nd.array([1.0]), out_type="int8")
+
+    def int8_fc():
+        out, _, _ = mx.nd.contrib.quantized_fully_connected(
+            qx, qw, min_data=xmin, max_data=xmax, min_weight=wmin,
+            max_weight=wmax, num_hidden=args.out_dim, no_bias=True)
+        return out
+
+    i8 = timed(int8_fc)
+    for name, dt in (("fc_float32", f32), ("fc_int8", i8)):
+        print(json.dumps({"bench": "quantization", "op": name,
+                          "shape": [args.batch, args.in_dim, args.out_dim],
+                          "ms": round(dt * 1e3, 3)}))
+    print(json.dumps({"bench": "quantization", "op": "int8_speedup",
+                      "value": round(f32 / i8, 3)}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,61 @@
+#!/usr/bin/env python
+"""Sparse op micro-benchmark (reference benchmark/python/sparse): CSR·dense
+dot and row_sparse retain timing across densities.
+
+    python benchmark/python/bench_sparse.py --rows 4096 --cols 1024
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=2048)
+    ap.add_argument("--cols", type=int, default=512)
+    ap.add_argument("--out", type=int, default=128)
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--densities", default="0.01,0.05,0.25")
+    args = ap.parse_args()
+
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu.ndarray import sparse as sp
+
+    rng = np.random.RandomState(0)
+    w = mx.nd.array(rng.randn(args.cols, args.out).astype("float32"))
+
+    for density in (float(d) for d in args.densities.split(",")):
+        dense = np.where(rng.rand(args.rows, args.cols) < density,
+                         rng.randn(args.rows, args.cols), 0).astype("float32")
+        indptr = [0]
+        indices = []
+        data = []
+        for row in dense:
+            nz = np.nonzero(row)[0]
+            indices.extend(nz.tolist())
+            data.extend(row[nz].tolist())
+            indptr.append(len(indices))
+        csr = sp.csr_matrix((np.array(data, "float32"),
+                             np.array(indices, "int64"),
+                             np.array(indptr, "int64")), shape=dense.shape)
+        out = sp.dot(csr, w)     # compile/warm
+        out.wait_to_read()
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            out = sp.dot(csr, w)
+        out.wait_to_read()
+        dt = (time.perf_counter() - t0) / args.steps
+        print(json.dumps({"bench": "sparse", "op": "csr_dot",
+                          "density": density,
+                          "shape": [args.rows, args.cols, args.out],
+                          "ms": round(dt * 1e3, 3)}))
+
+
+if __name__ == "__main__":
+    main()

@@ -22,8 +22,10 @@ def __getattr__(name: str):
             return getattr(_dgl, name)
     from . import __getattr__ as _nd_getattr
     for cand in (f"_contrib_{name}", f"contrib_{name}"):
-        if cand in _REGISTRY:
+        try:   # the nd getattr handles lazy-provider resolution itself
             return _nd_getattr(cand)
+        except AttributeError:
+            continue
     raise AttributeError(
         f"module 'mxnet_tpu.ndarray.contrib' has no attribute {name!r}")
 

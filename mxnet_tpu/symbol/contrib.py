@@ -14,8 +14,10 @@ def __getattr__(name: str):
         return getattr(_cf, name)
     from . import __getattr__ as _sym_getattr
     for cand in (f"_contrib_{name}", f"contrib_{name}"):
-        if cand in _REGISTRY:
+        try:   # the sym getattr handles lazy-provider resolution itself
             return _sym_getattr(cand)
+        except AttributeError:
+            continue
     raise AttributeError(
         f"module 'mxnet_tpu.symbol.contrib' has no attribute {name!r}")
 
