@@ -92,7 +92,7 @@ def phone_error_rate(model, x, y):
     return errs / max(total, 1)
 
 
-def train(epochs=12, batch_size=32, lr=0.003, seed=0, verbose=True):
+def train(epochs=16, batch_size=32, lr=0.01, seed=0, verbose=True):
     """Returns (first_per, last_per): phone error rate (1.0 = everything
     wrong, 0 = perfect transcripts)."""
     rng = np.random.RandomState(seed)

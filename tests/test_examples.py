@@ -340,3 +340,13 @@ def test_module_checkpoint_resume_walkthrough():
     import mnist_module_walkthrough
     mid, final = mnist_module_walkthrough.train(verbose=False)
     assert final >= mid > 0.9, (mid, final)
+
+
+def test_speech_ctc_learns_transcripts():
+    """Conv + bi-GRU + CTC acoustic model (reference
+    example/speech_recognition): phone error rate collapses from ~1.0
+    (blank-collapse phase) to low, via unaligned CTC supervision only."""
+    sys.path.insert(0, os.path.join(ROOT, "example", "speech_recognition"))
+    import speech_ctc
+    first, last = speech_ctc.train(epochs=16, verbose=False)
+    assert last < 0.35, (first, last)
