@@ -305,6 +305,30 @@ class KVStoreDist(KVStore):
 
     _listener_started = False
 
+    # Background threads that talk to the coordination client must be
+    # stopped and joined BEFORE interpreter teardown: one caught mid-RPC
+    # while the client is destroyed throws in C++ with no Python frame left
+    # ("FATAL: exception not rethrown", exit 250 on otherwise-successful
+    # workers). One module-wide atexit handler; entries hold only
+    # (event, thread, join_timeout) so kvstore instances stay collectable.
+    _bg_threads: list = []
+    _shutdown_hooked = False
+
+    @classmethod
+    def _register_bg_thread(cls, stop_event, thread, join_timeout):
+        cls._bg_threads.append((stop_event, thread, join_timeout))
+        if not cls._shutdown_hooked:
+            cls._shutdown_hooked = True
+            import atexit
+
+            def _stop_all():
+                for ev, _, _ in cls._bg_threads:
+                    ev.set()
+                for _, t, to in cls._bg_threads:
+                    t.join(timeout=to)
+
+            atexit.register(_stop_all)
+
     def _start_command_listener(self) -> None:
         client = _dist_client()
         # one listener per PROCESS: the command channel is global, a second
@@ -313,11 +337,12 @@ class KVStoreDist(KVStore):
             return
         KVStoreDist._listener_started = True
         rank = self._rank
+        stop = self._hb_stop
 
         def listen():
             import json as _json
             next_seq = 1
-            while not self._hb_stop.wait(0.0):
+            while not stop.wait(0.0):
                 try:
                     raw = client.blocking_key_value_get(
                         "mxtpu_cmd/%d" % next_seq, 1000)
@@ -341,6 +366,8 @@ class KVStoreDist(KVStore):
                              name="mxtpu-kv-cmd-listener")
         t.start()
         self._cmd_thread = t
+        # the listener blocks in 1s-bounded gets; join a bit past that
+        KVStoreDist._register_bg_thread(stop, t, 2.0)
 
     def _start_heartbeat(self) -> None:
         client = _dist_client()
@@ -348,9 +375,10 @@ class KVStoreDist(KVStore):
             return
         interval = float(get_env("MXNET_KVSTORE_HEARTBEAT_INTERVAL", 2.0))
         rank = self._rank
+        stop = self._hb_stop
 
         def beat():
-            while not self._hb_stop.wait(interval):
+            while not stop.wait(interval):
                 try:
                     client.key_value_set("mxtpu_hb/%d" % rank,
                                          repr(time.time()),
@@ -366,6 +394,7 @@ class KVStoreDist(KVStore):
                              name="mxtpu-kv-heartbeat")
         t.start()
         self._hb_thread = t
+        KVStoreDist._register_bg_thread(stop, t, interval + 1.0)
 
     def num_dead_node(self, node_id: int = -1, timeout: float = 60.0) -> int:
         """Number of peer processes with no heartbeat in the last ``timeout``
