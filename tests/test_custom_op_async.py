@@ -85,7 +85,8 @@ def test_pool_runs_independent_ops_concurrently():
     for i, o in enumerate(outs, 1):
         np.testing.assert_allclose(o.asnumpy(), 2.0 * i)
     total = time.perf_counter() - t0
-    assert total < 1.25, f"three 0.5s ops took {total:.2f}s — pool serialized"
+    # full serialization would be >= 1.5s; generous margin for loaded CI
+    assert total < 1.4, f"three 0.5s ops took {total:.2f}s — pool serialized"
 
 
 def test_waitall_drains_async_custom_ops():
