@@ -350,3 +350,14 @@ def test_speech_ctc_learns_transcripts():
     import speech_ctc
     first, last = speech_ctc.train(epochs=16, verbose=False)
     assert last < 0.35, (first, last)
+
+
+def test_module_gan_cross_module_gradients():
+    """Module-pair GAN (reference example/gan): generator trains purely on
+    get_input_grads() from a discriminator bound with
+    inputs_need_grad=True; generated points must land near the target
+    ring manifold."""
+    sys.path.insert(0, os.path.join(ROOT, "example", "gan"))
+    import module_gan
+    d_acc, radius_err = module_gan.train(iters=800, verbose=False)
+    assert radius_err < 0.3, (d_acc, radius_err)
