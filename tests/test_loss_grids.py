@@ -1,0 +1,164 @@
+"""Gluon loss-layer grid vs torch.nn.functional: value AND input-gradient
+agreement for every loss family both frameworks define (reference
+tests/python/unittest/test_loss.py depth).
+"""
+import numpy as np
+import pytest
+
+import torch
+import torch.nn.functional as F
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+
+
+def _mx_loss_and_grad(loss_fn, pred, *args):
+    p = nd.array(pred)
+    p.attach_grad()
+    with autograd.record():
+        loss = loss_fn(p, *[nd.array(a) for a in args])
+        total = nd.sum(loss)
+    total.backward()
+    return loss.asnumpy(), p.grad.asnumpy()
+
+
+def _torch_loss_and_grad(fn, pred, *args):
+    p = torch.tensor(pred, dtype=torch.float64, requires_grad=True)
+    loss = fn(p, *[torch.tensor(a, dtype=torch.float64) for a in args])
+    loss.sum().backward()
+    return loss.detach().numpy(), p.grad.numpy()
+
+
+def test_l2_loss_vs_torch(rng):
+    pred = rng.randn(6, 5).astype("float32")
+    lab = rng.randn(6, 5).astype("float32")
+    mv, mg = _mx_loss_and_grad(gluon.loss.L2Loss(), pred, lab)
+    # gluon convention: 0.5 * mse, mean over the non-batch axes
+    tv, tg = _torch_loss_and_grad(
+        lambda p, l: 0.5 * ((p - l) ** 2).mean(dim=1), pred, lab)
+    np.testing.assert_allclose(mv, tv, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(mg, tg, rtol=1e-5, atol=1e-6)
+
+
+def test_l1_loss_vs_torch(rng):
+    pred = rng.randn(6, 5).astype("float32")
+    lab = rng.randn(6, 5).astype("float32")
+    mv, mg = _mx_loss_and_grad(gluon.loss.L1Loss(), pred, lab)
+    tv, tg = _torch_loss_and_grad(
+        lambda p, l: (p - l).abs().mean(dim=1), pred, lab)
+    np.testing.assert_allclose(mv, tv, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(mg, tg, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("from_sigmoid", [False, True])
+def test_sigmoid_bce_vs_torch(rng, from_sigmoid):
+    logits = rng.randn(6, 4).astype("float32")
+    lab = rng.randint(0, 2, (6, 4)).astype("float32")
+    pred = (1 / (1 + np.exp(-logits))).astype("float32") if from_sigmoid \
+        else logits
+    mv, mg = _mx_loss_and_grad(
+        gluon.loss.SigmoidBinaryCrossEntropyLoss(from_sigmoid=from_sigmoid),
+        pred, lab)
+
+    def tfn(p, l):
+        if from_sigmoid:
+            return F.binary_cross_entropy(p, l, reduction="none").mean(dim=1)
+        return F.binary_cross_entropy_with_logits(
+            p, l, reduction="none").mean(dim=1)
+
+    tv, tg = _torch_loss_and_grad(tfn, pred, lab)
+    np.testing.assert_allclose(mv, tv, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(mg, tg, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("sparse_label", [True, False])
+def test_softmax_ce_vs_torch(rng, sparse_label):
+    logits = rng.randn(6, 5).astype("float32")
+    idx = rng.randint(0, 5, (6,))
+    if sparse_label:
+        lab = idx.astype("float32")
+    else:
+        lab = np.eye(5, dtype="float32")[idx]
+    mv, mg = _mx_loss_and_grad(
+        gluon.loss.SoftmaxCrossEntropyLoss(sparse_label=sparse_label),
+        logits, lab)
+    tv, tg = _torch_loss_and_grad(
+        lambda p: F.cross_entropy(p, torch.tensor(idx),
+                                  reduction="none"), logits)
+    np.testing.assert_allclose(mv, tv, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(mg, tg, rtol=1e-5, atol=1e-6)
+
+
+def test_kldiv_loss_vs_torch(rng):
+    logp = np.log(rng.dirichlet(np.ones(5), 6)).astype("float32")
+    target = rng.dirichlet(np.ones(5), 6).astype("float32")
+    mv, mg = _mx_loss_and_grad(
+        gluon.loss.KLDivLoss(from_logits=True), logp, target)
+    tv, tg = _torch_loss_and_grad(
+        lambda p, t: F.kl_div(p, t, reduction="none").mean(dim=1),
+        logp, target)
+    np.testing.assert_allclose(mv, tv, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(mg, tg, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("rho", [0.5, 1.0, 2.0])
+def test_huber_loss_vs_torch(rng, rho):
+    pred = rng.randn(6, 5).astype("float32") * 2
+    lab = rng.randn(6, 5).astype("float32")
+    mv, mg = _mx_loss_and_grad(gluon.loss.HuberLoss(rho=rho), pred, lab)
+    # torch huber_loss = gluon HuberLoss * rho (gluon divides by rho
+    # inside the quadratic zone and keeps |x|-rho/2 outside)
+    tv, tg = _torch_loss_and_grad(
+        lambda p, l: F.huber_loss(p, l, delta=rho,
+                                  reduction="none").mean(dim=1) / rho,
+        pred, lab)
+    np.testing.assert_allclose(mv, tv, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(mg, tg, rtol=1e-5, atol=1e-6)
+
+
+def test_hinge_losses_vs_torch(rng):
+    pred = rng.randn(8).astype("float32")
+    lab = (rng.randint(0, 2, (8,)) * 2 - 1).astype("float32")
+    mv, mg = _mx_loss_and_grad(gluon.loss.HingeLoss(), pred, lab)
+    tv, tg = _torch_loss_and_grad(
+        lambda p, l: torch.clamp(1 - p * l, min=0), pred, lab)
+    np.testing.assert_allclose(mv.ravel(), tv, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(mg, tg, rtol=1e-5, atol=1e-6)
+    mv2, mg2 = _mx_loss_and_grad(gluon.loss.SquaredHingeLoss(), pred, lab)
+    tv2, tg2 = _torch_loss_and_grad(
+        lambda p, l: torch.clamp(1 - p * l, min=0) ** 2, pred, lab)
+    np.testing.assert_allclose(mv2.ravel(), tv2, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(mg2, tg2, rtol=1e-5, atol=1e-6)
+
+
+def test_triplet_loss_vs_torch(rng):
+    a = rng.randn(6, 8).astype("float32")
+    pos = rng.randn(6, 8).astype("float32")
+    neg = rng.randn(6, 8).astype("float32")
+    mv, mg = _mx_loss_and_grad(gluon.loss.TripletLoss(margin=1.0),
+                               a, pos, neg)
+    # gluon TripletLoss: SUM over feature axis of (d(a,p)^2 - d(a,n)^2),
+    # hinged at margin (loss.py TripletLoss)
+    tv, tg = _torch_loss_and_grad(
+        lambda x, p, n: torch.clamp(((x - p) ** 2).sum(dim=1)
+                                    - ((x - n) ** 2).sum(dim=1) + 1.0,
+                                    min=0), a, pos, neg)
+    np.testing.assert_allclose(mv, tv, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(mg, tg, rtol=1e-4, atol=1e-5)
+
+
+def test_ctc_loss_vs_torch(rng):
+    B, T, C = 3, 8, 6                  # C includes blank (gluon: LAST)
+    logits = rng.randn(B, T, C).astype("float32")
+    labels = rng.randint(0, C - 1, (B, 4)).astype("float32")
+    mv, _ = _mx_loss_and_grad(gluon.loss.CTCLoss(), logits, labels)
+    # torch: blank index 0, log-probs (T, B, C); remap gluon blank-last
+    perm = [C - 1] + list(range(C - 1))
+    tl = torch.tensor(logits[:, :, perm], dtype=torch.float64)
+    logp = F.log_softmax(tl, dim=2).permute(1, 0, 2)
+    tgt = torch.tensor(labels + 1, dtype=torch.long)
+    tv = F.ctc_loss(logp, tgt,
+                    input_lengths=torch.full((B,), T, dtype=torch.long),
+                    target_lengths=torch.full((B,), 4, dtype=torch.long),
+                    blank=0, reduction="none")
+    np.testing.assert_allclose(mv.ravel(), tv.numpy(), rtol=1e-4, atol=1e-4)
