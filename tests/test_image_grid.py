@@ -1,0 +1,86 @@
+"""Image-API grid (reference tests/python/unittest/test_image.py):
+resize/crop/normalize geometry and value checks over the mx.image
+functions and the Augmenter pipeline.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import image, nd
+
+
+def _img(rng, h=20, w=30):
+    return nd.array(rng.randint(0, 255, (h, w, 3)).astype("float32"))
+
+
+@pytest.mark.parametrize("interp", [0, 1, 2])
+def test_imresize_shapes_and_range(rng, interp):
+    src = _img(rng)
+    out = image.imresize(src, 15, 10, interp=interp)
+    assert out.shape == (10, 15, 3)
+    a = out.asnumpy()
+    assert a.min() >= 0 and a.max() <= 255
+
+
+def test_resize_short_keeps_aspect(rng):
+    src = _img(rng, 20, 30)                  # short side = 20
+    out = image.resize_short(src, 10)
+    assert out.shape == (10, 15, 3)          # 20->10 halves both sides
+    tall = image.resize_short(_img(rng, 40, 16), 8)
+    assert tall.shape == (20, 8, 3)
+
+
+def test_fixed_and_center_crop(rng):
+    src = _img(rng, 20, 30)
+    out = image.fixed_crop(src, 5, 4, 10, 8)
+    np.testing.assert_allclose(out.asnumpy(),
+                               src.asnumpy()[4:12, 5:15], rtol=1e-6)
+    c, rect = image.center_crop(src, (10, 8))
+    assert c.shape == (8, 10, 3)
+    x0, y0, w, h = rect
+    assert (x0, y0, w, h) == (10, 6, 10, 8)
+
+
+def test_random_crop_stays_in_bounds(rng):
+    mx.random.seed(3)
+    src = _img(rng, 20, 30)
+    for _ in range(5):
+        out, (x0, y0, w, h) = image.random_crop(src, (12, 9))
+        assert out.shape == (9, 12, 3)
+        assert 0 <= x0 <= 30 - 12 and 0 <= y0 <= 20 - 9
+        np.testing.assert_allclose(out.asnumpy(),
+                                   src.asnumpy()[y0:y0 + h, x0:x0 + w],
+                                   rtol=1e-6)
+
+
+def test_color_normalize(rng):
+    src = _img(rng)
+    mean = nd.array(np.array([100.0, 110.0, 120.0], "float32"))
+    std = nd.array(np.array([2.0, 3.0, 4.0], "float32"))
+    out = image.color_normalize(src, mean, std)
+    np.testing.assert_allclose(
+        out.asnumpy(), (src.asnumpy() - mean.asnumpy()) / std.asnumpy(),
+        rtol=1e-5)
+
+
+def test_create_augmenter_pipeline(rng):
+    """CreateAugmenter composition (reference image.py): resize + crop +
+    mean/std produce the final data_shape with normalized stats."""
+    augs = image.CreateAugmenter(
+        data_shape=(3, 8, 8), resize=12,
+        mean=np.array([0.0, 0.0, 0.0], "float32"),
+        std=np.array([255.0, 255.0, 255.0], "float32"))
+    out = _img(rng, 20, 30)
+    for a in augs:
+        out = a(out)
+    assert out.shape == (8, 8, 3)
+    v = out.asnumpy()
+    assert v.min() >= 0.0 and v.max() <= 1.0
+
+
+def test_horizontal_flip_is_exact_mirror(rng):
+    src = _img(rng)
+    flip = image.HorizontalFlipAug(p=1.0)
+    out = flip(src)
+    np.testing.assert_allclose(out.asnumpy(), src.asnumpy()[:, ::-1],
+                               rtol=1e-6)
