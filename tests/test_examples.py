@@ -361,3 +361,14 @@ def test_module_gan_cross_module_gradients():
     import module_gan
     d_acc, radius_err = module_gan.train(iters=800, verbose=False)
     assert radius_err < 0.3, (d_acc, radius_err)
+
+
+def test_fine_tune_warm_start():
+    """Checkpoint -> new-head fine-tune (reference
+    image-classification/fine-tune.py): trunk weights provably load into
+    the new module and the adapted model reaches high held-out accuracy."""
+    sys.path.insert(0, os.path.join(ROOT, "example", "image-classification"))
+    import fine_tune
+    warm, acc = fine_tune.demo(verbose=False)
+    assert warm
+    assert acc > 0.9, acc
