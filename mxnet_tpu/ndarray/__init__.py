@@ -25,7 +25,13 @@ from ..context import Context, current_context
 from ..ops.registry import get_op, list_ops, _REGISTRY
 
 __all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
-           "save", "load", "concat", "stack", "split", "one_hot", "waitall"]
+           "save", "load", "load_frombuffer", "concat", "stack", "split",
+           "one_hot", "waitall", "onehot_encode", "imdecode",
+           "from_dlpack", "to_dlpack_for_read", "to_dlpack_for_write",
+           "add", "subtract", "multiply", "divide", "true_divide", "modulo",
+           "maximum", "minimum", "power", "equal", "not_equal", "greater",
+           "greater_equal", "lesser", "lesser_equal", "logical_and",
+           "logical_or", "logical_xor", "concatenate", "moveaxis"]
 
 
 def _scalar_or_elemwise(elem_op, scalar_op, rscalar_op=None):
@@ -77,6 +83,11 @@ def onehot_encode(indices, out):
     one-hot expansion of ``indices`` into ``out`` and returns it."""
     depth = out.shape[1]
     hot = invoke("one_hot", [indices], {"depth": int(depth)})
+    if tuple(hot.shape) != tuple(out.shape):
+        raise MXNetError(
+            "onehot_encode: output shape %s does not match the one-hot "
+            "expansion %s of the given indices" %
+            (tuple(out.shape), tuple(hot.shape)))
     out._set_data(hot._data.astype(out.dtype))
     return out
 
@@ -105,7 +116,18 @@ def to_dlpack_for_read(arr: NDArray):
     return _unwrap(arr)
 
 
-to_dlpack_for_write = to_dlpack_for_read
+def to_dlpack_for_write(arr: NDArray):
+    """Export a WRITABLE DLPack provider (reference to_dlpack_for_write).
+
+    jax buffers are immutable, so sharing the live buffer (as the read
+    variant does) would let a writable consumer — ``torch.from_dlpack``
+    tensors are writable — mutate memory XLA assumes constant. Instead a
+    fresh host copy is exported: writes land in the copy, never in the
+    source array, and the caller re-imports via :func:`from_dlpack` /
+    ``nd.array`` to see them (a divergence from the reference's in-place
+    semantics, forced by the functional buffer model)."""
+    arr.wait_to_read()
+    return np.array(_unwrap(arr))
 
 
 def imdecode(buf, **kwargs) -> NDArray:

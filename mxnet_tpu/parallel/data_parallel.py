@@ -50,6 +50,12 @@ def sgd_momentum_update(params, grads, state, lr, momentum=0.9, wd=0.0):
     return new_params, new_state
 
 
+def _shape_key(arrays):
+    """Exact (shape, dtype) signature of a batch — the unit the AOT
+    executable is keyed to, shared by _aot_key/aot_save/aot_load/step."""
+    return [tuple(a.shape) + (str(a.dtype),) for a in arrays]
+
+
 def _make_optax(optimizer: str, optimizer_params: Dict):
     import optax
     p = dict(optimizer_params or {})
@@ -129,6 +135,7 @@ class DataParallelTrainer:
         self._grad_fn = None
         self._apply_fn = None
         self._compiled = None   # AOT-deserialized executable (aot_load)
+        self._compiled_shapes = None  # exact input shapes the AOT exe accepts
 
     # ------------------------------------------------------------- capture
     def _capture(self, n_inputs: int, sample_arrays=None):
@@ -274,7 +281,7 @@ class DataParallelTrainer:
             "jax": _jax.__version__,
             "device_kind": dev.device_kind,
             "n_devices": int(self._mesh.devices.size),
-            "in_shapes": [tuple(a.shape) + (str(a.dtype),) for a in arrays],
+            "in_shapes": _shape_key(arrays),
             "compute_dtype": str(self._compute_dtype),
             "optimizer": self._opt_desc,
         }
@@ -313,11 +320,18 @@ class DataParallelTrainer:
                          "out_tree": out_tree}, f)
         os.replace(tmp, path)
         self._compiled = compiled
+        self._compiled_shapes = _shape_key(arrays)
         self._place_state()
 
     def aot_load(self, path, *data) -> bool:
         """Load a serialized step executable; returns False (and stays on
-        the jit path) if the blob is missing or its key does not match."""
+        the jit path) if the blob is missing or its key does not match.
+
+        Trust boundary: the blob is unpickled BEFORE the digest check, so
+        ``path`` must point at a cache this process itself wrote (e.g.
+        ``.bench_aot/`` under the repo) — never at untrusted bytes. An
+        attacker who can write the cache file can already write the code
+        that loads it, so the boundary is the filesystem, not the format."""
         import os
         import pickle
         from jax.experimental.serialize_executable import deserialize_and_load
@@ -358,6 +372,7 @@ class DataParallelTrainer:
                 blob["exe"], blob["in_tree"], blob["out_tree"])
         except Exception:
             return False
+        self._compiled_shapes = _shape_key(arrays)
         self._place_state()
         return True
 
@@ -387,7 +402,11 @@ class DataParallelTrainer:
         if self._kv is not None:
             return self._kv_step(rng, arrays)
         fn = self._step_fn
-        if self._compiled is not None:
+        if (self._compiled is not None
+                and _shape_key(arrays) == self._compiled_shapes):
+            # the deserialized executable is shape-exact; a batch with
+            # other shapes (e.g. a ragged final batch) takes the jit path
+            # for that call only, keeping the executable for exact matches
             fn = self._compiled
             rng = jax.device_put(rng, NamedSharding(self._mesh, P()))
         self._params, self._aux, self._opt_state, loss = fn(

@@ -105,3 +105,22 @@ def test_aot_load_missing_file_is_false(tmp_path):
     x, y = _batch(rng)
     t = _make()
     assert not t.aot_load(str(tmp_path / "nope.pkl"), x, y)
+
+
+def test_aot_step_with_new_shapes_falls_back_to_jit(tmp_path):
+    """A loaded executable is shape-exact; a batch with the same ARITY but
+    different shapes (e.g. a ragged final batch) must transparently take
+    the jit path for that call — not crash inside the fixed executable —
+    while exact-shape batches keep using the executable afterwards."""
+    rng = np.random.RandomState(0)
+    x, y = _batch(rng)
+    path = str(tmp_path / "step.pkl")
+    t = _make(seed=7)
+    t.aot_save(path, x, y)
+    assert t._compiled is not None
+    # same arity, different batch size: jit path serves it
+    x2, y2 = _batch(rng, b=16)
+    assert np.isfinite(float(t.step(x2, y2)))
+    # the executable was NOT discarded: exact shapes still use it
+    assert t._compiled is not None
+    assert np.isfinite(float(t.step(x, y)))

@@ -180,3 +180,20 @@ def test_dlpack_roundtrip_with_torch():
     np.testing.assert_allclose(mx.nd.from_dlpack(t).asnumpy(), [5, 6])
     tt = torch.from_dlpack(mx.nd.to_dlpack_for_read(a))
     np.testing.assert_allclose(tt.numpy(), a.asnumpy())
+
+
+def test_onehot_encode_shape_mismatch_raises():
+    import numpy as np
+    out = mx.nd.zeros((2, 4))  # 3 indices -> (3, 4) expansion: mismatch
+    with pytest.raises(mx.MXNetError):
+        mx.nd.onehot_encode(mx.nd.array(np.array([0., 3., 1.], "f4")), out)
+
+
+def test_to_dlpack_for_write_is_a_copy():
+    import numpy as np
+    import torch
+    a = mx.nd.array(np.array([1., 2., 3.], "f4"))
+    t = torch.from_dlpack(mx.nd.to_dlpack_for_write(a))
+    t[0] = 99.0  # writable consumer mutates the EXPORT, not the source
+    np.testing.assert_allclose(a.asnumpy(), [1., 2., 3.])
+    assert float(t[0]) == 99.0
