@@ -59,6 +59,15 @@ class KVStore:
         self._gc_residuals: Dict[Any, Any] = {}
         # (priority, seq, key, [per-device arrays]) awaiting dispatch
         self._pending: List[tuple] = []
+        # communication instrumentation (reference ps-lite counts its sent
+        # bytes per van connection; here the unit is the fused bucket):
+        # bucket_reduces = dispatched fused buckets, compressed_payload_bytes
+        # = packed uint8 bytes that would cross the wire, dense_reduce_elems
+        # = f32 elements reduced uncompressed. Read by the dryrun/driver to
+        # prove the collective path actually ran.
+        self.comm_stats: Dict[str, int] = {
+            "pushes": 0, "bucket_reduces": 0,
+            "compressed_payload_bytes": 0, "dense_reduce_elems": 0}
 
     # ------------------------------------------------------------- data plane
     def init(self, key, value) -> None:
@@ -84,6 +93,7 @@ class KVStore:
                 raise MXNetError(f"key {k} was not init'd")
             self._pending.append((priority, len(self._pending), k,
                                   [_unwrap(v) for v in vlist]))
+            self.comm_stats["pushes"] += 1
 
     def _flush(self) -> None:
         """Dispatch pending pushes: highest priority first (ties keep push
@@ -119,12 +129,17 @@ class KVStore:
                     packed, res = self._gc.quantize(m, res)
                     self._gc_residuals[k] = res
                     packed_list.append(packed)
+                self.comm_stats["compressed_payload_bytes"] += sum(
+                    int(p.size) for p in packed_list)
                 merged_list = self._reduce_compressed(packed_list, shapes)
             else:
                 # ONE cross-process collective per bucket, not per key —
                 # this is where the aggregation actually reaches the network
+                self.comm_stats["dense_reduce_elems"] += sum(
+                    int(m.size) for m in merged_list)
                 merged_list = self._global_reduce_bucket(
                     merged_list, [k for _, _, k, _ in bucket])
+            self.comm_stats["bucket_reduces"] += 1
             for (prio, _, k, _), merged in zip(bucket, merged_list):
                 if self._updater is not None:
                     # server-side optimizer semantics (update_on_kvstore=True)

@@ -141,6 +141,11 @@ class DataParallelTrainer:
     def _capture(self, n_inputs: int, sample_arrays=None):
         from .. import symbol as sym_mod
         from .. import autograd
+        # a re-capture rebuilds params/opt_state from the net; any loaded
+        # executable is keyed to the OLD pytree/placement and must not be
+        # re-entered afterwards
+        self._compiled = None
+        self._compiled_shapes = None
         if sample_arrays is not None:
             # materialize deferred-init params with one tiny host forward;
             # the sample batch may arrive pre-sharded over the mesh (e.g.
@@ -423,14 +428,21 @@ class DataParallelTrainer:
             for n in self._param_names:
                 kv.init("dpt_grad_" + n, _wrap(jnp.zeros_like(grads[n])))
             self._kv_inited = True
+            # the apply program spans the local mesh: params must sit
+            # replicated on it, not wherever capture left them
+            self._place_state()
         for i, n in enumerate(self._param_names):
             kv.push("dpt_grad_" + n, _wrap(grads[n]), priority=-i)
         nworkers = max(1, getattr(kv, "num_workers", 1))
+        repl = NamedSharding(self._mesh, P())
         synced = {}
         for n in self._param_names:
             out = _wrap(grads[n])
             kv.pull("dpt_grad_" + n, out=out)
-            synced[n] = out._data / nworkers
+            # the store round-trip (esp. the codec decode) may land the
+            # gradient on a single device; re-replicate over the mesh so
+            # the jitted apply sees one consistent placement
+            synced[n] = jax.device_put(out._data / nworkers, repl)
         self._params, self._opt_state = self._apply_fn(
             self._params, self._opt_state, synced)
         return loss
