@@ -125,6 +125,13 @@ register_config("MXNET_BACKWARD_DO_MIRROR", False, bool,
                 "Trade FLOPs for memory via rematerialization (jax.checkpoint).")
 register_config("MXNET_KVSTORE_BIGARRAY_BOUND", 1 << 20, int,
                 "Size above which a gradient is sharded across the reduce axis.")
+register_config("MXNET_KVSTORE_ASYNC_MAX_STALENESS", 0, int,
+                "dist_async only: max pushes a key's owner may lag before "
+                "pushers throttle. 0 = unbounded (reference async behavior).")
+register_config("MXNET_KVSTORE_ASYNC_GAP_TIMEOUT", 30.0, float,
+                "dist_async only: seconds the key owner waits on a missing "
+                "push sequence number (a pusher that died mid-send) before "
+                "skipping it.")
 register_config("MXNET_UPDATE_AGGREGATION_SIZE", 4, int,
                 "Number of gradient tensors aggregated per fused allreduce bucket.")
 register_config("MXNET_KVSTORE_HEARTBEAT_INTERVAL", 2.0, float,

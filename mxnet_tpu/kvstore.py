@@ -121,14 +121,8 @@ class KVStore:
                 # residual; what travels further (and what lands in the
                 # store) is the {-t,0,+t} reconstruction
                 shapes = [m.shape for m in merged_list]
-                packed_list = []
-                for (_, _, k, _), m in zip(bucket, merged_list):
-                    res = self._gc_residuals.get(k)
-                    if res is None:
-                        res = jnp.zeros(m.shape, jnp.float32)
-                    packed, res = self._gc.quantize(m, res)
-                    self._gc_residuals[k] = res
-                    packed_list.append(packed)
+                packed_list = [self._quantize_with_residual(k, m)
+                               for (_, _, k, _), m in zip(bucket, merged_list)]
                 self.comm_stats["compressed_payload_bytes"] += sum(
                     int(p.size) for p in packed_list)
                 merged_list = self._reduce_compressed(packed_list, shapes)
@@ -187,6 +181,17 @@ class KVStore:
                 o._set_data(full)
 
     # ------------------------------------------------------------- reduction
+    def _quantize_with_residual(self, k, merged):
+        """2-bit error-feedback quantization of one merged gradient against
+        its key's residual stream (shared by the sync bucket path and the
+        async push encoder)."""
+        res = self._gc_residuals.get(k)
+        if res is None:
+            res = jnp.zeros(merged.shape, jnp.float32)
+        packed, res = self._gc.quantize(merged, res)
+        self._gc_residuals[k] = res
+        return packed
+
     def _global_reduce_bucket(self, merged_list, keys):
         return merged_list  # single-host: nothing to do
 
@@ -286,9 +291,18 @@ class KVStoreDist(KVStore):
         self._barrier_seq = 0
         self._last_compressed_stats: Dict[str, int] = {}
         self._hb_stop = threading.Event()
+        # True async mode (reference kvstore_dist_server.h:348-358
+        # sync_mode_=false): each push is applied IMMEDIATELY by the rank
+        # that owns the key — no barrier, no cross-worker aggregation —
+        # and pulls read the owner's latest published weight, which may be
+        # stale. Single-process dist_async degenerates to the local
+        # immediate-apply semantics, which is already exact.
+        self._async_mode = (name == "dist_async" and self._nprocs > 1)
         if self._nprocs > 1:
             self._start_heartbeat()
             self._start_command_listener()
+        if self._async_mode:
+            self._start_async_applier()
 
     # ------------------------------------------------------- fault surface
     # The reference's ps-lite van exchanges heartbeats and the scheduler
@@ -411,6 +425,245 @@ class KVStoreDist(KVStore):
         self._hb_thread = t
         KVStoreDist._register_bg_thread(stop, t, interval + 1.0)
 
+    # ----------------------------------------------------- true async mode
+    # Serverless translation of the reference's async server loop
+    # (kvstore_dist_server.h:164,348-358): key ownership is sharded over
+    # ranks by stable hash; a push SHIPS the local gradient to the owner's
+    # mailbox in the coordination KV and returns immediately; the owner's
+    # applier thread consumes mailboxes in sequence order, runs the
+    # store-side optimizer, and republishes the weight; a pull reads the
+    # latest published weight with no barrier. Staleness is bounded (when
+    # MXNET_KVSTORE_ASYNC_MAX_STALENESS > 0) by throttling pushers while
+    # the owner's applied counter lags the global push counter.
+
+    def _owner(self, key) -> int:
+        import zlib
+        return zlib.crc32(str(key).encode()) % self._nprocs
+
+    def _as_key(self, kind: str, k, seq: Optional[int] = None) -> str:
+        base = "mxas_%s/%d/%s" % (kind, self._instance_id, k)
+        return base if seq is None else "%s/%d" % (base, seq)
+
+    def _publish_weight(self, client, k) -> None:
+        client.key_value_set_bytes(self._as_key("w", k),
+                                   _encode_array(self._store[k]._data),
+                                   allow_overwrite=True)
+
+    def _encode_push(self, k, merged) -> bytes:
+        """Gradient wire format: '2bit' payloads carry the same packed
+        uint8 stream the sync compressed path ships (quantized against
+        this worker's residual), dense ones the raw f32 bytes. The header
+        is self-describing (codec type + shape + threshold) so the owner
+        decodes with the PUSHER's codec parameters — ranks need no
+        set_gradient_compression ordering handshake."""
+        if self._gc is not None:
+            packed = self._quantize_with_residual(k, merged)
+            self.comm_stats["compressed_payload_bytes"] += int(packed.size)
+            import numpy as _np
+            import json as _json
+            head = _json.dumps(["2bit", list(merged.shape),
+                                self._gc.threshold]).encode()
+            return (b"\x01" + len(head).to_bytes(4, "big") + head
+                    + _np.asarray(packed).tobytes())
+        return b"\x00" + _encode_array(merged)
+
+    @staticmethod
+    def _decode_push(blob: bytes):
+        if blob[:1] == b"\x00":
+            return _decode_array(blob[1:])
+        import numpy as _np
+        import json as _json
+        from .gradient_compression import GradientCompression
+        hl = int.from_bytes(blob[1:5], "big")
+        enc, shape, threshold = _json.loads(blob[5:5 + hl].decode())
+        packed = jnp.asarray(_np.frombuffer(blob[5 + hl:], _np.uint8))
+        return GradientCompression(
+            {"type": enc, "threshold": threshold}).dequantize(
+                packed, tuple(shape))
+
+    def _publish_weight_retry(self, client, k, attempts: int = 5) -> bool:
+        for i in range(attempts):
+            try:
+                self._publish_weight(client, k)
+                return True
+            except Exception:
+                time.sleep(0.05 * (i + 1))
+        return False
+
+    def _start_async_applier(self) -> None:
+        client = _dist_client()
+        if client is None:
+            return
+        stop = self._hb_stop
+        rank = self._rank
+
+        def _mark_done(k, nxt, delete_push: bool) -> bool:
+            try:
+                client.key_value_set(self._as_key("done", k), str(nxt),
+                                     allow_overwrite=True)
+                if delete_push:
+                    client.key_value_delete(self._as_key("push", k, nxt))
+                return True
+            except Exception:
+                return False        # coordinator gone: shut the role down
+
+        def apply_loop():
+            applied: Dict[Any, int] = {}
+            gap_since: Dict[Any, float] = {}
+            gap_timeout = float(get_env("MXNET_KVSTORE_ASYNC_GAP_TIMEOUT",
+                                        30.0))
+            while not stop.wait(0.0):
+                owned = [k for k in list(self._store.keys())
+                         if self._owner(k) == rank]
+                if self._updater is None or not owned:
+                    if stop.wait(0.05):
+                        return
+                    continue
+                for k in owned:
+                    if stop.is_set():
+                        return
+                    nxt = applied.get(k, 0) + 1
+                    try:
+                        # bounded server-side wait, not client polling: the
+                        # coordinator holds the request until the key lands
+                        # or 50 ms pass, keeping other keys + stop serviced
+                        blob = client.blocking_key_value_get_bytes(
+                            self._as_key("push", k, nxt), 50)
+                    except Exception:
+                        # nothing at seq nxt. If the global counter shows
+                        # LATER pushes exist, the pusher of nxt died between
+                        # increment and mailbox write; after a grace window
+                        # skip the gap so healthy workers keep applying
+                        # (the reference's server likewise survives a dead
+                        # pusher — its unsent message simply never arrives).
+                        try:
+                            total = int(client.key_value_try_get(
+                                self._as_key("seq", k)))
+                        except Exception:
+                            total = 0
+                        if total >= nxt:
+                            first = gap_since.setdefault((k, nxt),
+                                                         time.time())
+                            if time.time() - first > gap_timeout:
+                                gap_since.pop((k, nxt), None)
+                                applied[k] = nxt
+                                if not _mark_done(k, nxt, delete_push=False):
+                                    return
+                        continue
+                    gap_since.pop((k, nxt), None)
+                    try:
+                        grad = _wrap(jnp.asarray(self._decode_push(blob)))
+                        self._updater(k, grad, self._store[k])
+                        ok = True
+                    except Exception:
+                        ok = False  # poisoned push: skip it, keep serving
+                                    # (reference server catch-all)
+                    if ok and not self._publish_weight_retry(client, k):
+                        # update applied locally but could not be published:
+                        # do NOT advance 'done' — bounded-staleness pushers
+                        # then block loudly instead of losing the update
+                        return
+                    applied[k] = nxt
+                    if not _mark_done(k, nxt, delete_push=True):
+                        return
+
+        t = threading.Thread(target=apply_loop, daemon=True,
+                             name="mxtpu-kv-async-applier")
+        t.start()
+        self._async_thread = t
+        KVStoreDist._register_bg_thread(stop, t, 1.0)
+
+    def _flush(self) -> None:
+        if not self._async_mode:
+            return super()._flush()
+        if not self._pending:
+            return
+        if self._updater is None:
+            raise MXNetError(
+                "dist_async applies updates in the store: call "
+                "set_optimizer (update_on_kvstore) before pushing — the "
+                "reference's async mode is server-side-update only "
+                "(kvstore_dist_server.h:348-358)")
+        pending, self._pending = self._pending, []
+        client = _dist_client()
+        merged: Dict[Any, Any] = {}
+        order: List[Any] = []
+        for _, _, k, vlist in pending:
+            s = vlist[0]
+            for v in vlist[1:]:
+                s = s + v
+            if k in merged:
+                merged[k] = merged[k] + s
+            else:
+                merged[k] = s
+                order.append(k)
+        bound = int(get_env("MXNET_KVSTORE_ASYNC_MAX_STALENESS", 0))
+        for k in order:
+            seq = client.key_value_increment(self._as_key("seq", k), 1)
+            client.key_value_set_bytes(self._as_key("push", k, seq),
+                                       self._encode_push(k, merged[k]))
+            self.comm_stats["bucket_reduces"] += 1
+            if bound > 0:
+                # bounded staleness: wait while the owner's applied counter
+                # lags the global push counter by more than the bound
+                deadline = time.time() + float(
+                    get_env("MXNET_KVSTORE_BARRIER_TIMEOUT", 300.0))
+                while time.time() < deadline:
+                    try:
+                        done = int(client.key_value_try_get(
+                            self._as_key("done", k)))
+                    except Exception:
+                        done = 0
+                    if seq - done <= bound:
+                        break
+                    time.sleep(0.02)
+
+    def pull(self, key, out=None, priority: int = 0,
+             ignore_sparse: bool = True):
+        if not self._async_mode:
+            return super().pull(key, out, priority, ignore_sparse)
+        self._flush()
+        client = _dist_client()
+        timeout_ms = int(float(get_env("MXNET_KVSTORE_BARRIER_TIMEOUT",
+                                       300.0)) * 1000)
+        keys, outs = _key_value(key, out)
+        for k, olist in zip(keys, outs):
+            if k not in self._store:
+                raise MXNetError(f"key {k} was not init'd")
+            if not isinstance(olist, list):
+                olist = [olist]
+            blob = client.blocking_key_value_get_bytes(self._as_key("w", k),
+                                                       timeout_ms)
+            arr = jnp.asarray(_decode_array(blob))
+            for o in olist:
+                o._set_data(arr)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        if not self._async_mode:
+            return super().row_sparse_pull(key, out, priority, row_ids)
+        if row_ids is None:
+            raise MXNetError("row_sparse_pull requires row_ids")
+        # async: the authoritative value is the owner's PUBLISHED weight,
+        # not this rank's local store copy (which only the owner updates)
+        self._flush()
+        client = _dist_client()
+        timeout_ms = int(float(get_env("MXNET_KVSTORE_BARRIER_TIMEOUT",
+                                       300.0)) * 1000)
+        keys, outs = _key_value(key, out)
+        rid_list = row_ids if isinstance(row_ids, list) else [row_ids]
+        for k, olist in zip(keys, outs):
+            if k not in self._store:
+                raise MXNetError(f"key {k} was not init'd")
+            if not isinstance(olist, list):
+                olist = [olist]
+            blob = client.blocking_key_value_get_bytes(self._as_key("w", k),
+                                                       timeout_ms)
+            src = jnp.asarray(_decode_array(blob))
+            for o, rid in zip(olist, rid_list):
+                idx = _unwrap(rid).astype(jnp.int32)
+                rows = jnp.take(src, idx, axis=0)
+                o._set_data(jnp.zeros_like(src).at[idx].set(rows))
+
     def num_dead_node(self, node_id: int = -1, timeout: float = 60.0) -> int:
         """Number of peer processes with no heartbeat in the last ``timeout``
         seconds (reference ``get_num_dead_node(node_id, timeout)``,
@@ -493,6 +746,12 @@ class KVStoreDist(KVStore):
             v = self._store[k]._data
             self._store[k]._set_data(
                 jnp.asarray(multihost_utils.broadcast_one_to_all(v)))
+        if self._async_mode:
+            # the owner seeds the published weight every pull will read
+            client = _dist_client()
+            for k in keys:
+                if self._owner(k) == self._rank:
+                    self._publish_weight(client, k)
 
     def _global_reduce_bucket(self, merged_list, keys):
         if self._nprocs == 1:
@@ -587,6 +846,24 @@ def _exec_server_command(head: int, body: str, rank: int) -> None:
         _server_controller[0](head, body)
     # unknown heads without a controller are ignored, like the reference
     # server's default switch arm
+
+
+def _encode_array(a) -> bytes:
+    """Self-describing tensor wire format for the coordination KV:
+    4-byte header length, JSON [dtype, shape] header, raw bytes."""
+    import json as _json
+    import numpy as _np
+    a = _np.asarray(a)
+    head = _json.dumps([a.dtype.str, list(a.shape)]).encode()
+    return len(head).to_bytes(4, "big") + head + a.tobytes()
+
+
+def _decode_array(b: bytes):
+    import json as _json
+    import numpy as _np
+    hl = int.from_bytes(b[:4], "big")
+    dt, shape = _json.loads(b[4:4 + hl].decode())
+    return _np.frombuffer(b[4 + hl:], dtype=_np.dtype(dt)).reshape(shape)
 
 
 def _dist_client():

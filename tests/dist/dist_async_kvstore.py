@@ -1,0 +1,108 @@
+"""Multi-process TRUE dist_async kvstore: per-worker pushes apply at the
+key's owner immediately, with no barrier and no cross-worker aggregation.
+
+Model: reference ``tests/nightly/dist_async_kvstore.py`` (each worker's
+test_kv_sync trains alone; the server's sync_mode_=false branch applies
+every push the moment it arrives, kvstore_dist_server.h:348-358). Here the
+"server" is the owner rank's applier thread; weights travel through the
+jax.distributed coordination KV.
+
+The known-value phases below prove the async contract:
+
+1. ONE worker (rank 0) pushes while every other worker does nothing.
+   Under dist_sync this would deadlock (allreduce needs all ranks); here
+   rank 0's pull must observe its own updates applied — without any
+   participation from rank 1 — within a bounded wait.
+2. The other worker then pushes and observes BOTH workers' updates
+   (its own plus the already-applied rank-0 ones) — stale-but-converging
+   shared state, the async SGD semantics.
+3. With plain-SGD store-side updates (w -= lr*g), every applied push
+   moves the weight by exactly -lr*g, so the final value is exact once
+   the applied counter says all pushes landed.
+
+Run directly:   python tools/launch.py -n 2 python tests/dist/dist_async_kvstore.py
+Run from CI:    tests/test_dist.py spawns it and asserts rc == 0.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PYTHONPATH", None)
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def wait_until(pred, timeout=60.0, what=""):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def main():
+    kv = mx.kv.create("dist_async")
+    nw, rank = kv.num_workers, kv.rank
+    assert kv.type == "dist_async"
+
+    lr = 0.5
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=lr))
+
+    shape = (4, 8)
+    kv.init("w", mx.nd.ones(shape))          # rank 0's value broadcast
+    kv.barrier()                             # phases ordered, data-path free
+
+    out = mx.nd.zeros(shape)
+
+    if rank == 0:
+        # ---- phase 1: rank 0 alone pushes 3 unit gradients. No other
+        # rank participates — a sync store would block forever here.
+        for _ in range(3):
+            kv.push("w", mx.nd.ones(shape))
+        # async pull returns the owner's latest published weight; poll
+        # until all 3 of our pushes are visible: w = 1 - 3*lr*1 = -0.5
+        def mine_applied():
+            kv.pull("w", out=out)
+            return abs(float(out.asnumpy()[0, 0]) - (1 - 3 * lr)) < 1e-5
+        wait_until(mine_applied, what="rank0's own async pushes")
+    kv.barrier()                             # phase boundary only
+
+    if rank == 1:
+        # ---- phase 2: the late worker pushes once; the store already
+        # carries rank 0's updates. w = 1 - 4*lr.
+        kv.push("w", mx.nd.ones(shape))
+        def all_applied():
+            kv.pull("w", out=out)
+            return abs(float(out.asnumpy()[0, 0]) - (1 - 4 * lr)) < 1e-5
+        wait_until(all_applied, what="rank1's push on top of rank0's")
+    kv.barrier()
+
+    # ---- phase 3: everyone sees the identical final value, exact.
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(),
+                               np.full(shape, 1 - 4 * lr, np.float32),
+                               rtol=1e-5)
+
+    # ---- bounded staleness smoke: with a bound of 1 the pusher throttles
+    # until the owner catches up, so a burst still lands completely.
+    os.environ["MXNET_KVSTORE_ASYNC_MAX_STALENESS"] = "1"
+    if rank == 0:
+        for _ in range(5):
+            kv.push("w", mx.nd.ones(shape))
+        def burst_applied():
+            kv.pull("w", out=out)
+            return abs(float(out.asnumpy()[0, 0]) - (1 - 9 * lr)) < 1e-5
+        wait_until(burst_applied, what="bounded-staleness burst")
+    kv.barrier()
+
+    print(f"worker {rank}/{nw}: dist_async kvstore OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()
