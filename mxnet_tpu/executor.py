@@ -26,7 +26,7 @@ from .ops.registry import get_op
 from ._imperative import _op_signature_flags
 from . import random as _random
 
-__all__ = ["Executor", "_GraphLowering"]
+__all__ = ["Executor", "PipelinedExecutor", "_GraphLowering"]
 
 
 # Per-op parameter shape rules: op -> fn(attrs, data_shape) -> {param: shape}.
@@ -294,10 +294,15 @@ class Executor:
     def aux_arrays(self):
         return [self.aux_dict[n] for n in self._symbol.list_auxiliary_states()]
 
+    #: subclasses set False to run the composed program eagerly (the
+    #: placed executor: per-segment programs are jitted individually)
+    _jit_outer = True
+
     def _compiled(self, is_train: bool) -> Callable:
         if is_train not in self._jit_cache:
             raw = self._lowering.lower(is_train)
-            self._jit_cache[is_train] = jax.jit(raw)
+            self._jit_cache[is_train] = jax.jit(raw) if self._jit_outer \
+                else raw
         return self._jit_cache[is_train]
 
     def _diff_names(self):
@@ -337,7 +342,8 @@ class Executor:
                 (grads,) = vjp_fn((cts, aux_ct))
                 return outs, aux, grads
 
-            self._jit_cache["train_step"] = jax.jit(step)
+            self._jit_cache["train_step"] = jax.jit(step) \
+                if self._jit_outer else step
         return self._jit_cache["train_step"]
 
     def debug_str(self) -> str:
@@ -431,7 +437,8 @@ class Executor:
                 (grads,) = vjp_fn((list(cts), aux_ct))
                 return grads
 
-            self._jit_cache["custom_bwd"] = jax.jit(step)
+            self._jit_cache["custom_bwd"] = jax.jit(step) \
+                if self._jit_outer else step
         return self._jit_cache["custom_bwd"]
 
     # ------------------------------------------------------------- backward
@@ -451,6 +458,18 @@ class Executor:
             if req == "null" or name not in self.grad_dict:
                 continue
             buf = self.grad_dict[name]
+            # under group2ctx placement the cotangent may arrive on a
+            # different device than the parameter; align the gradient with
+            # the ARG array (no-op single-device) so optimizer math
+            # (w, g elementwise) and += accumulation stay coherent
+            anchor = self.arg_dict.get(name, buf)
+            if hasattr(g, "devices") and hasattr(anchor._data, "devices") \
+                    and g.devices() != anchor._data.devices():
+                g = jax.device_put(g, next(iter(anchor._data.devices())))
+            if hasattr(buf._data, "devices") and hasattr(g, "devices") \
+                    and req == "add" and buf._data.devices() != g.devices():
+                buf._set_data(jax.device_put(buf._data,
+                                             next(iter(g.devices()))))
             if req == "add":
                 buf._set_data(buf._data + g)
             else:
@@ -477,6 +496,11 @@ class Executor:
                     new_grads[n] = nd.zeros(s, ctx=self._ctx)
         new_aux = {n: self.aux_dict.get(n, nd.zeros(s, ctx=self._ctx))
                    for n, s in zip(aux_names, aux_shapes)}
+        return self._rebuild(new_args, new_grads, new_aux)
+
+    def _rebuild(self, new_args, new_grads, new_aux):
+        """Construct the same-kind executor over new arrays (reshape hook;
+        PipelinedExecutor overrides to keep its placement)."""
         return Executor(self._symbol, self._ctx, new_args, new_grads,
                         self.grad_req, new_aux)
 
@@ -492,3 +516,250 @@ class Executor:
                 self.aux_dict[k]._set_data(v._data)
             elif not allow_extra_params:
                 raise MXNetError(f"unknown aux state {k}")
+
+
+# --------------------------------------------------------------------------
+# Inter-layer model parallelism (group2ctx): placed lowering + executor.
+# --------------------------------------------------------------------------
+
+def _assign_devices(symbol, group2ctx, default_ctx):
+    """AssignContext (reference common/exec_utils.h:500): map every graph
+    node to a concrete jax.Device from its ``ctx_group`` attribute via
+    ``group2ctx``; ungrouped op nodes fall to the bind context, ungrouped
+    variables co-locate with their first consumer (the reference plans the
+    same way to avoid gratuitous copies)."""
+    from .context import Context
+    nodes = symbol.topo_nodes()
+    dev_of_group = {}
+    for g, c in (group2ctx or {}).items():
+        c = c if isinstance(c, Context) else Context(c)
+        dev_of_group[g] = c.jax_device()
+    default_dev = default_ctx.jax_device() if default_ctx is not None \
+        else jax.devices()[0]
+    node_device = {}
+    for n in nodes:
+        if n.is_var:
+            continue
+        g = n._attr_dict.get("ctx_group")
+        node_device[id(n)] = dev_of_group.get(g, default_dev)
+    first_consumer_dev = {}
+    for n in nodes:                 # topo order: first consumer wins
+        if n.is_var:
+            continue
+        for (src, _) in n.inputs:
+            if src.is_var and id(src) not in first_consumer_dev:
+                first_consumer_dev[id(src)] = node_device[id(n)]
+    for n in nodes:
+        if not n.is_var:
+            continue
+        g = n._attr_dict.get("ctx_group")
+        if g in dev_of_group:
+            node_device[id(n)] = dev_of_group[g]
+        else:
+            node_device[id(n)] = first_consumer_dev.get(id(n), default_dev)
+    return node_device
+
+
+class _PlacedLowering:
+    """Device-placed lowering for ``group2ctx`` inter-layer model
+    parallelism (reference AssignContext + kCrossDeviceCopy nodes,
+    common/exec_utils.h:500, graph_executor.cc:1346).
+
+    Consecutive topo-order nodes on the same device form a SEGMENT; each
+    segment lowers to one jitted program whose committed inputs pin it to
+    its device, and the host-side transfers between segments are the
+    cross-device copies. Pipeline overlap across a stream of calls (e.g.
+    microbatches) comes from XLA's per-device async dispatch queues —
+    device A starts microbatch k+1 while device B still runs k, which is
+    what the reference's DAG engine buys in its model-parallel LSTM case
+    (docs/faq/model_parallel_lstm.md)."""
+
+    def __init__(self, symbol, node_device):
+        self.symbol = symbol
+        self.nodes = symbol.topo_nodes()
+        self.var_names = [n.name for n in self.nodes if n.is_var]
+        self.has_rng = any(
+            n.op is not None and get_op(n.op).needs_rng for n in self.nodes)
+        self._gid = {id(n): i for i, n in enumerate(self.nodes)}
+        self._node_device = node_device
+        segs: List[Tuple[Any, List[int]]] = []
+        for i, n in enumerate(self.nodes):
+            d = node_device[id(n)]
+            if segs and segs[-1][0] == d:
+                segs[-1][1].append(i)
+            else:
+                segs.append((d, [i]))
+        self._segments = [(d, tuple(ix)) for d, ix in segs]
+        # entries that cross a segment boundary: graph outputs plus any
+        # entry whose consumer sits in a different segment (which covers
+        # cross-device edges AND same-device segments split by an
+        # interleaved group)
+        needed: set = set()
+        for (node, idx) in symbol._outputs:
+            if not node.is_var:
+                needed.add((self._gid[id(node)], idx))
+        seg_of = {}
+        for si, (_, ix) in enumerate(self._segments):
+            for i in ix:
+                seg_of[i] = si
+        for n in self.nodes:
+            if n.is_var:
+                continue
+            for (src, idx) in n.inputs:
+                if not src.is_var and \
+                        seg_of[self._gid[id(src)]] != seg_of[self._gid[id(n)]]:
+                    needed.add((self._gid[id(src)], idx))
+        self._boundary = needed
+        self._seg_cache: Dict[Any, Tuple] = {}
+
+    # ------------------------------------------------------------ segments
+    def _segment_program(self, seg_idx: int, is_train: bool):
+        key = (seg_idx, is_train)
+        if key in self._seg_cache:
+            return self._seg_cache[key]
+        _, idxs = self._segments[seg_idx]
+        seg_set = set(idxs)
+        nodes, gid = self.nodes, self._gid
+        # ordered external inputs: var names + boundary entries from
+        # other segments
+        ext_keys: List[Any] = []
+        seen = set()
+        for i in idxs:
+            n = nodes[i]
+            if n.is_var:
+                if ("var", n.name) not in seen:
+                    seen.add(("var", n.name))
+                    ext_keys.append(("var", n.name))
+                continue
+            for (src, idx) in n.inputs:
+                sgid = gid[id(src)]
+                if src.is_var:
+                    k = ("var", src.name)
+                elif sgid not in seg_set:
+                    k = (sgid, idx)
+                else:
+                    continue
+                if k not in seen:
+                    seen.add(k)
+                    ext_keys.append(k)
+        out_keys = [k for k in sorted(self._boundary)
+                    if k[0] in seg_set and not nodes[k[0]].is_var]
+
+        def seg_raw(ext_vals, rng):
+            env = dict(zip(ext_keys, ext_vals))
+            local: Dict[Tuple[int, int], Any] = {}
+            aux_updates: Dict[str, Any] = {}
+
+            def read(src, idx):
+                if src.is_var:
+                    return env[("var", src.name)]
+                sgid = gid[id(src)]
+                if sgid in seg_set:
+                    return local[(sgid, idx)]
+                return env[(sgid, idx)]
+
+            for i in idxs:
+                node = nodes[i]
+                if node.is_var:
+                    continue
+                opdef = get_op(node.op)
+                in_arrays = [read(src, idx) for (src, idx) in node.inputs]
+                attrs = dict(node.attrs)
+                accepts_train, accepts_rng = _op_signature_flags(opdef)
+                if accepts_train and "is_train" not in attrs:
+                    attrs["is_train"] = is_train
+                if accepts_rng:
+                    # same stream as _GraphLowering: fold by GLOBAL index
+                    attrs["rng"] = jax.random.fold_in(rng, i)
+                out = opdef.fn(*in_arrays, **attrs)
+                out = out if isinstance(out, tuple) else (out,)
+                for oi, o in enumerate(out):
+                    local[(i, oi)] = o
+                if is_train and node.op in _AUX_UPDATE_RULES:
+                    upd = _AUX_UPDATE_RULES[node.op](attrs, in_arrays, out)
+                    for in_idx, new_val in upd.items():
+                        src, _ = node.inputs[in_idx]
+                        if src.is_var:
+                            aux_updates[src.name] = new_val
+            return [local[k] for k in out_keys], aux_updates
+
+        prog = (jax.jit(seg_raw), ext_keys, out_keys)
+        self._seg_cache[key] = prog
+        return prog
+
+    # ------------------------------------------------------------- lower
+    def lower(self, is_train: bool) -> Callable:
+        out_entries = self.symbol._outputs
+        gid = self._gid
+
+        def fn(inputs: Dict[str, Any], rng):
+            vals: Dict[Tuple[int, int], Any] = {}
+            aux_updates: Dict[str, Any] = {}
+            for si, (dev, _) in enumerate(self._segments):
+                seg_fn, ext_keys, out_keys = self._segment_program(si,
+                                                                   is_train)
+                ext_vals = []
+                for k in ext_keys:
+                    v = inputs[k[1]] if k[0] == "var" else vals[k]
+                    ext_vals.append(jax.device_put(v, dev))
+                outs, aux = seg_fn(ext_vals, jax.device_put(rng, dev))
+                vals.update(zip(out_keys, outs))
+                aux_updates.update(aux)
+            outs = []
+            for (node, idx) in out_entries:
+                if node.is_var:
+                    outs.append(inputs[node.name])
+                else:
+                    outs.append(vals[(gid[id(node)], idx)])
+            return outs, aux_updates
+
+        return fn
+
+
+class PipelinedExecutor(Executor):
+    """Executor honoring ``group2ctx`` placement across DISTINCT devices —
+    the reference's inter-layer model parallelism (Symbol.bind group2ctx,
+    python/mxnet/symbol/symbol.py:1290; docs/faq/model_parallel_lstm.md).
+
+    The compiled paths swap ``_GraphLowering`` for ``_PlacedLowering`` and
+    drop the outer whole-graph jit: per-device segment programs dispatch
+    asynchronously and the eager inter-segment transfers are the
+    kCrossDeviceCopy edges. forward/backward/arg_dict semantics are
+    inherited unchanged."""
+
+    def __init__(self, symbol, ctx, args, args_grad=None, grad_req="write",
+                 aux_states=None, group2ctx=None):
+        super().__init__(symbol, ctx, args, args_grad, grad_req, aux_states)
+        self.group2ctx = dict(group2ctx or {})
+        node_device = _assign_devices(symbol, group2ctx, ctx)
+        self._lowering = _PlacedLowering(symbol, node_device)
+        # commit bound arrays to their assigned devices so the per-call
+        # device_put in the placed lowering is a no-op rather than a
+        # per-step re-upload of every weight; forward() re-commits lazily
+        # because external writers (init_params, optimizers) may rebind an
+        # array onto the default device
+        self._var_device = {n.name: node_device[id(n)]
+                            for n in self._lowering.nodes if n.is_var}
+        self._commit_placement()
+
+    def _commit_placement(self) -> None:
+        for d in (self.arg_dict, self.aux_dict, self.grad_dict):
+            for name, arr in d.items():
+                dev = self._var_device.get(name)
+                if dev is not None and arr is not None and \
+                        dev not in arr._data.devices():
+                    arr._set_data(jax.device_put(arr._data, dev))
+
+    def forward(self, is_train: bool = False, **kwargs):
+        self._commit_placement()
+        return super().forward(is_train=is_train, **kwargs)
+
+    def _rebuild(self, new_args, new_grads, new_aux):
+        return PipelinedExecutor(self._symbol, self._ctx, new_args,
+                                 new_grads, self.grad_req, new_aux,
+                                 group2ctx=self.group2ctx)
+
+    # _compiled/_compiled_train_step/_compiled_custom_bwd are inherited:
+    # _jit_outer=False keeps the composed program eager (segments are
+    # individually jitted and placed), incl. MXNET_BACKWARD_DO_MIRROR.
+    _jit_outer = False

@@ -605,10 +605,15 @@ class KVStoreDist(KVStore):
             self.comm_stats["bucket_reduces"] += 1
             if bound > 0:
                 # bounded staleness: wait while the owner's applied counter
-                # lags the global push counter by more than the bound
-                deadline = time.time() + float(
-                    get_env("MXNET_KVSTORE_BARRIER_TIMEOUT", 300.0))
-                while time.time() < deadline:
+                # lags the global push counter by more than the bound; a
+                # deadline overrun FAILS LOUD (the owner's applier is gone
+                # — matching barrier()'s dead-peer semantics) instead of
+                # silently pushing into the void
+                timeout = float(get_env("MXNET_KVSTORE_BARRIER_TIMEOUT",
+                                        300.0))
+                deadline = time.time() + timeout
+                done = 0
+                while True:
                     try:
                         done = int(client.key_value_try_get(
                             self._as_key("done", k)))
@@ -616,6 +621,14 @@ class KVStoreDist(KVStore):
                         done = 0
                     if seq - done <= bound:
                         break
+                    if time.time() >= deadline:
+                        raise MXNetError(
+                            "dist_async staleness bound %d violated for "
+                            "key %r after %.0fs: owner rank %d applied "
+                            "%d of %d pushes — the owner's applier is "
+                            "likely dead (check num_dead_node())"
+                            % (bound, k, timeout, self._owner(k), done,
+                               seq))
                     time.sleep(0.02)
 
     def pull(self, key, out=None, priority: int = 0,

@@ -25,16 +25,10 @@ class BucketingModule(BaseModule):
                  state_names=None, group2ctxs=None, compression_params=None):
         super().__init__(logger)
         assert default_bucket_key is not None
-        if group2ctxs:
-            from ..symbol.symbol import _check_group2ctx
-            from ..context import current_context
-            base_ctx = context if context is not None else current_context()
-            base_ctx = base_ctx[0] if isinstance(base_ctx, (list, tuple)) \
-                else base_ctx
-            specs = group2ctxs if isinstance(group2ctxs, (list, tuple)) \
-                else [group2ctxs]
-            for spec in specs:
-                _check_group2ctx(base_ctx, spec)
+        # forwarded to every per-bucket Module (reference BucketingModule
+        # passes group2ctxs through); a multi-device spec makes each bucket
+        # bind a PipelinedExecutor
+        self._group2ctxs = group2ctxs
         self._sym_gen = sym_gen
         self._default_bucket_key = default_bucket_key
         self._compression_params = compression_params
@@ -57,7 +51,8 @@ class BucketingModule(BaseModule):
         return Module(sym, data_names, label_names, logger=self.logger,
                       context=self._context,
                       fixed_param_names=self._fixed_param_names,
-                      compression_params=self._compression_params)
+                      compression_params=self._compression_params,
+                      group2ctxs=self._group2ctxs)
 
     def bind(self, data_shapes, label_shapes=None, for_training=True,
              inputs_need_grad=False, force_rebind=False, shared_module=None,

@@ -33,13 +33,13 @@ class Module(BaseModule):
         if isinstance(context, Context):
             context = [context]
         self._context = context
-        if group2ctxs:
-            # honor-or-raise like Symbol.bind (README de-scope #4)
-            from ..symbol.symbol import _check_group2ctx
-            specs = group2ctxs if isinstance(group2ctxs, (list, tuple)) \
-                else [group2ctxs]
-            for spec in specs:
-                _check_group2ctx(context[0], spec)
+        # inter-layer placement spec (reference Module group2ctxs →
+        # AssignContext): one dict per context; the SPMD design needs only
+        # the first (per-process), which Symbol.simple_bind maps onto a
+        # PipelinedExecutor when it spans distinct devices
+        specs = group2ctxs if isinstance(group2ctxs, (list, tuple)) \
+            else ([group2ctxs] if group2ctxs else [])
+        self._group2ctx = dict(specs[0]) if specs else None
         self._symbol = symbol
         self._data_names = list(data_names or [])
         self._label_names = list(label_names or [])
@@ -122,7 +122,8 @@ class Module(BaseModule):
             self._symbol, self._context, None, self._data_shapes,
             self._label_shapes, self._param_names, for_training,
             inputs_need_grad, shared_group=shared_group,
-            fixed_param_names=self._fixed_param_names, grad_req=grad_req)
+            fixed_param_names=self._fixed_param_names, grad_req=grad_req,
+            group2ctx=self._group2ctx)
         self.binded = True
         self.for_training = for_training
 

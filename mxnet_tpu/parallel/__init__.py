@@ -23,6 +23,7 @@ from .ring_attention import ring_attention, local_attention
 from .ulysses import ulysses_attention
 from . import tensor_parallel
 from .tensor_parallel import shard_gluon_params
-from .pipeline import pipeline_apply
+from .pipeline import (pipeline_apply, GluonPipelineStack,
+                       HeterogeneousPipeline)
 from . import expert_parallel
 from .expert_parallel import ep_moe_ffn, moe_ffn_reference, MoEParams

@@ -25,7 +25,7 @@ except ImportError:  # older jax
     from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
-__all__ = ["pipeline_apply", "GluonPipelineStack"]
+__all__ = ["pipeline_apply", "GluonPipelineStack", "HeterogeneousPipeline"]
 
 
 def pipeline_apply(stage_fn: Callable, stacked_params, x_microbatches,
@@ -198,3 +198,170 @@ class GluonPipelineStack:
                 name = self._per_block_names[j][i]
                 self._per_block_pmaps[j][name].data()._set_data(
                     jnp.asarray(leaf[j]))
+
+
+class HeterogeneousPipeline:
+    """UNEVEN pipeline stages: arbitrary gluon blocks placed on distinct
+    devices (reference docs/faq/model_parallel_lstm.md — embed, LSTM
+    layers and decoder on different devices with cross-device copies).
+
+    Unlike :class:`GluonPipelineStack` (one shared stage program ppermuted
+    SPMD-style, which requires structurally identical stages), each block
+    here becomes its own ``ctx_group`` and the whole chain binds through
+    ``PipelinedExecutor``: per-device jitted segment programs with
+    explicit transfers. Microbatch overlap comes from XLA's per-device
+    async dispatch queues — ``step()`` issues every microbatch's
+    forward/backward before synchronizing, so device k runs microbatch m
+    while device k+1 still runs m-1 (the GPipe schedule, scheduled by the
+    runtime rather than by a traced loop).
+
+    Usage::
+
+        pipe = HeterogeneousPipeline(
+            [embed_block, body_block, head_block],
+            [mx.cpu(0), mx.cpu(1), mx.cpu(2)],
+            sample, loss=gluon.loss.SoftmaxCrossEntropyLoss())
+        for epoch in ...:
+            loss = pipe.step(x_microbatches, y_microbatches, lr=0.1)
+        pipe.write_back()      # trained values -> the gluon blocks
+    """
+
+    def __init__(self, blocks, contexts, sample, loss=None):
+        from .. import symbol as sym_mod
+        from .. import autograd
+        from ..attribute import AttrScope
+        from ..base import MXNetError
+        from ..ndarray.ndarray import _unwrap, _wrap
+
+        if len(blocks) != len(contexts):
+            raise MXNetError(
+                f"one context per stage: {len(blocks)} blocks vs "
+                f"{len(contexts)} contexts")
+        self._blocks = list(blocks)
+        self._contexts = list(contexts)
+
+        sample = jnp.asarray(sample)
+        with autograd.pause():                 # materialize deferred params
+            cur_a = _wrap(sample)
+            for b in self._blocks:
+                cur_a = b(cur_a)
+                if isinstance(cur_a, (list, tuple)):
+                    cur_a = cur_a[0]
+
+        cur = sym_mod.Variable("data")
+        group2ctx = {}
+        for i, (b, c) in enumerate(zip(self._blocks, self._contexts)):
+            gname = f"pp_stage{i}"
+            group2ctx[gname] = c
+            with AttrScope(ctx_group=gname):
+                cur = b(cur)
+                if isinstance(cur, (list, tuple)):
+                    cur = cur[0]
+        self._raw_symbol = cur        # pre-loss chain, used for inference
+        shapes = {"data": tuple(sample.shape)}
+        if loss is not None:
+            with AttrScope(ctx_group=f"pp_stage{len(blocks) - 1}"):
+                label = sym_mod.Variable("label")
+                cur = loss(cur, label)
+
+        self._pmap = {}
+        for b in self._blocks:
+            self._pmap.update({p.name: p for p in b.collect_params().values()})
+        self._has_loss = loss is not None
+        self._symbol = cur
+        self._group2ctx = group2ctx
+        self._shapes = shapes
+        self._exec = None
+        self._infer_exec = None
+        self._infer_shape = None
+
+    def _seed_executor(self, ex) -> None:
+        """Seed an executor's params: from the current training executor
+        when one exists (a rebind must carry trained values forward, not
+        reset to the blocks' initial state), else from the gluon blocks."""
+        from ..ndarray.ndarray import _unwrap
+        src_args = self._exec.arg_dict if self._exec is not None else {}
+        src_aux = self._exec.aux_dict if self._exec is not None else {}
+        for dst, src in ((ex.arg_dict, src_args), (ex.aux_dict, src_aux)):
+            for n, a in dst.items():
+                if n in ("data", "label"):
+                    continue
+                if n in src:
+                    a._set_data(src[n]._data)
+                elif n in self._pmap:
+                    a._set_data(_unwrap(self._pmap[n].data()))
+
+    def _bind(self, data_shape, label_shape):
+        shapes = {"data": tuple(data_shape)}
+        if self._has_loss:
+            shapes["label"] = tuple(label_shape)
+        # inputs need no cotangents: step() never reads them, and under
+        # grad_req='add' they would cost an extra accumulation per micro
+        grad_req = {n: ("null" if n in ("data", "label") else "add")
+                    for n in self._symbol.list_arguments()}
+        ex = self._symbol.simple_bind(self._contexts[0], grad_req=grad_req,
+                                      group2ctx=self._group2ctx, **shapes)
+        self._seed_executor(ex)
+        self._exec = ex
+        self._bound_shapes = (tuple(data_shape),
+                              tuple(label_shape) if label_shape else None)
+
+    def forward(self, x):
+        """Single-microbatch inference: the PRE-LOSS chain's predictions
+        (whether or not a loss block was attached for training), read with
+        the current trained weights."""
+        from .. import nd
+        x = nd.array(x) if not hasattr(x, "_data") else x
+        if self._infer_exec is None or self._infer_shape != tuple(x.shape):
+            self._infer_exec = self._raw_symbol.simple_bind(
+                self._contexts[0], grad_req="null",
+                group2ctx=self._group2ctx, data=tuple(x.shape))
+            self._infer_shape = tuple(x.shape)
+        self._seed_executor(self._infer_exec)
+        self._infer_exec.forward(is_train=False, data=x)
+        return self._infer_exec.outputs[0]
+
+    def step(self, x_microbatches, y_microbatches, lr=0.05):
+        """One GPipe step: accumulate grads over all microbatches (their
+        stage programs overlap via async dispatch), then one SGD apply.
+        Returns the mean scalar loss."""
+        from .. import nd
+        from ..base import MXNetError
+        if not self._has_loss:
+            raise MXNetError("step() needs a loss block at construction")
+        n_micro = len(x_microbatches)
+        x0 = jnp.asarray(x_microbatches[0])
+        y0 = jnp.asarray(y_microbatches[0])
+        if self._exec is None or self._bound_shapes != (tuple(x0.shape),
+                                                        tuple(y0.shape)):
+            self._bind(x0.shape, y0.shape)
+        ex = self._exec
+        for n in ex.grad_dict:
+            g = ex.grad_dict[n]
+            g._set_data(jnp.zeros_like(g._data))   # keeps device placement
+        losses = []
+        for xm, ym in zip(x_microbatches, y_microbatches):
+            ex.forward(is_train=True, data=nd.array(jnp.asarray(xm)),
+                       label=nd.array(jnp.asarray(ym)))
+            losses.append(ex.outputs[0])
+            ex.backward()       # grad_req='add' accumulates across micro
+        for n, a in ex.arg_dict.items():
+            if n in ("data", "label"):
+                continue
+            g = ex.grad_dict.get(n)
+            if g is None:
+                continue
+            gd = jax.device_put(g._data, next(iter(a._data.devices())))
+            a._set_data(a._data - (lr / n_micro) * gd)
+        return float(sum(float(l.asnumpy().mean()) for l in losses) / n_micro)
+
+    def write_back(self) -> None:
+        """Trained executor values -> the originating gluon blocks,
+        re-homed onto each parameter's own device (stage placement must
+        not leak into the imperative blocks)."""
+        for n, a in list(self._exec.arg_dict.items()) + \
+                list(self._exec.aux_dict.items()):
+            if n in self._pmap:
+                home = self._pmap[n].list_ctx()[0].jax_device()
+                self._pmap[n].data()._set_data(
+                    jax.device_put(a._data, home))

@@ -258,16 +258,18 @@ class Symbol:
     # ---------------------------------------------------------------- binding
     def bind(self, ctx, args, args_grad=None, grad_req="write", aux_states=None,
              group2ctx=None, shared_exec=None):
-        from ..executor import Executor
-        _check_group2ctx(ctx, group2ctx)
+        from ..executor import Executor, PipelinedExecutor
+        if _group2ctx_spans_devices(ctx, group2ctx):
+            return PipelinedExecutor(self, ctx, args, args_grad, grad_req,
+                                     aux_states, group2ctx=group2ctx)
         return Executor(self, ctx, args, args_grad, grad_req, aux_states)
 
     def simple_bind(self, ctx, grad_req="write", type_dict=None, shared_arg_names=None,
                     shared_exec=None, shared_buffer=None, group2ctx=None,
                     **kwargs):
         from .. import ndarray as nd
-        from ..executor import Executor
-        _check_group2ctx(ctx, group2ctx)
+        from ..executor import Executor, PipelinedExecutor
+        pipelined = _group2ctx_spans_devices(ctx, group2ctx)
         arg_shapes, _, aux_shapes = self.infer_shape(**kwargs)
         arg_names = self.list_arguments()
         aux_names = self.list_auxiliary_states()
@@ -278,6 +280,9 @@ class Symbol:
         args_grad = {n: nd.zeros(s, ctx=ctx) for n, s in zip(arg_names, arg_shapes)
                      if grad_req != "null"}
         aux = {n: nd.zeros(s, ctx=ctx) for n, s in zip(aux_names, aux_shapes)}
+        if pipelined:
+            return PipelinedExecutor(self, ctx, args, args_grad, grad_req,
+                                     aux, group2ctx=group2ctx)
         return Executor(self, ctx, args, args_grad, grad_req, aux)
 
     # eval sugar: run imperatively
@@ -320,18 +325,18 @@ class Symbol:
             f.write(self.tojson())
 
 
-def _check_group2ctx(ctx, group2ctx) -> None:
-    """Honor-or-raise for the reference's ctx_group placement spec
-    (symbol.py:1290 group2ctx → AssignContext, exec_utils.h:500).
+def _group2ctx_spans_devices(ctx, group2ctx) -> bool:
+    """Does this ``ctx_group`` placement spec ask for more than one device
+    (symbol.py:1290 group2ctx → AssignContext, exec_utils.h:500)?
 
-    On TPU, inter-layer model parallelism is expressed through mesh
-    sharding, not per-group device contexts: a group2ctx that maps every
-    group to the bind context is honored trivially; one that asks for
-    placement across DISTINCT devices raises with a pointer to the
-    sharding APIs instead of being silently dropped."""
+    A group2ctx that maps every group to the bind context is honored
+    trivially by the ordinary single-program executor; one that places
+    groups on DISTINCT devices routes to ``PipelinedExecutor``, whose
+    per-device segment programs + explicit transfers are the TPU-native
+    form of the reference's inter-layer model parallelism
+    (docs/faq/model_parallel_lstm.md)."""
     if not group2ctx:
-        return
-    from ..base import MXNetError
+        return False
     from ..context import Context
 
     def key(c):
@@ -341,13 +346,12 @@ def _check_group2ctx(ctx, group2ctx) -> None:
     distinct = {key(c) for c in group2ctx.values()}
     if ctx is not None:
         distinct.add(key(ctx))
-    if len(distinct) > 1:
-        raise MXNetError(
-            "group2ctx placement across distinct devices is expressed via "
-            "mesh sharding on TPU: use mxnet_tpu.parallel.shard_gluon_params "
-            "(tensor/model parallel) or mxnet_tpu.parallel.pipeline "
-            "(inter-layer stages) instead of per-group contexts. See "
-            "README 'Design decisions & de-scopes'.")
+    return len(distinct) > 1
+
+
+# back-compat shim for older callers of the honor-or-raise era
+def _check_group2ctx(ctx, group2ctx) -> None:
+    _group2ctx_spans_devices(ctx, group2ctx)
 
 
 def Variable(name: str, attr=None, shape=None, lr_mult=None, wd_mult=None,

@@ -89,12 +89,16 @@ def main():
                                np.full(shape, 1 - 4 * lr, np.float32),
                                rtol=1e-5)
 
-    # ---- bounded staleness smoke: with a bound of 1 the pusher throttles
-    # until the owner catches up, so a burst still lands completely.
+    # ---- bounded staleness: with a bound of 1 each flushed push throttles
+    # until the owner catches up. Pushes are flushed one-per-pull here so
+    # every iteration advances the seq counter by exactly 1 and the
+    # throttle loop actually engages (pushes left in _pending would merge
+    # into a single mailbox message and never test it).
     os.environ["MXNET_KVSTORE_ASYNC_MAX_STALENESS"] = "1"
     if rank == 0:
         for _ in range(5):
             kv.push("w", mx.nd.ones(shape))
+            kv.pull("w", out=out)       # flush -> seq += 1, throttle runs
         def burst_applied():
             kv.pull("w", out=out)
             return abs(float(out.asnumpy()[0, 0]) - (1 - 9 * lr)) < 1e-5

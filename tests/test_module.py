@@ -117,16 +117,15 @@ def test_bucketing_module(rng):
     assert m20.arg_dict["fc_shared_bias"] is m10.arg_dict["fc_shared_bias"]
 
 
-def test_module_group2ctxs_honor_or_raise():
-    import pytest
-    from mxnet_tpu.base import MXNetError
+def test_module_group2ctxs_places():
+    """r5: Module(group2ctxs=...) binds a placed executor instead of the
+    old honor-or-raise (training coverage: tests/test_hetero_pipeline.py)."""
+    from mxnet_tpu.executor import PipelinedExecutor
     net = mx.sym.relu(mx.sym.Variable("data"))
-    # trivial spec accepted
-    mx.mod.Module(net, label_names=None, context=mx.cpu(),
-                  group2ctxs={"g": mx.cpu()})
-    with pytest.raises(MXNetError, match="sharding"):
-        mx.mod.Module(net, label_names=None, context=mx.cpu(),
-                      group2ctxs=[{"g": mx.cpu(1)}])
+    mod = mx.mod.Module(net, label_names=None, context=mx.cpu(),
+                        group2ctxs=[{"g": mx.cpu(1)}])
+    mod.bind(data_shapes=[("data", (2, 2))], label_shapes=None)
+    assert isinstance(mod._exec_group.execs[0], PipelinedExecutor)
 
 
 def test_sequential_module_chains(rng):

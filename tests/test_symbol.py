@@ -179,17 +179,16 @@ def test_util_np_shape():
     assert mx.util.is_np_shape() is False
 
 
-def test_group2ctx_honor_or_raise():
-    """group2ctx: trivial spec honored, cross-device placement raises with
-    sharding guidance (README de-scope #4)."""
-    import pytest
-    from mxnet_tpu.base import MXNetError
+def test_group2ctx_binds_by_span():
+    """group2ctx: trivial spec -> ordinary executor; distinct devices ->
+    PipelinedExecutor placement (r5: the honor-or-raise de-scope is gone;
+    full coverage in tests/test_hetero_pipeline.py)."""
+    from mxnet_tpu.executor import PipelinedExecutor
     a = mx.sym.Variable("a")
     net = mx.sym.relu(a)
-    # trivial: all groups on the bind context -> honored
+    # trivial: all groups on the bind context -> ordinary executor
     ex = net.simple_bind(mx.cpu(), a=(2, 2), group2ctx={"g0": mx.cpu()})
-    assert ex is not None
-    # distinct devices -> explicit error, not a silent drop
-    with pytest.raises(MXNetError, match="sharding"):
-        net.simple_bind(mx.cpu(), a=(2, 2),
-                        group2ctx={"g0": mx.cpu(1)})
+    assert ex is not None and not isinstance(ex, PipelinedExecutor)
+    # distinct devices -> placed executor, not a silent drop
+    ex2 = net.simple_bind(mx.cpu(), a=(2, 2), group2ctx={"g0": mx.cpu(1)})
+    assert isinstance(ex2, PipelinedExecutor)
