@@ -24,6 +24,7 @@ TPU-first: ALL five tiers collapse into XLA collectives.
 """
 from __future__ import annotations
 
+import sys
 import threading
 from typing import Any, Callable, Dict, List, Optional, Union
 
@@ -298,6 +299,7 @@ class KVStoreDist(KVStore):
         # stale. Single-process dist_async degenerates to the local
         # immediate-apply semantics, which is already exact.
         self._async_mode = (name == "dist_async" and self._nprocs > 1)
+        self._async_dead = None     # set by the applier thread on fatal error
         if self._nprocs > 1:
             self._start_heartbeat()
             self._start_command_listener()
@@ -507,6 +509,15 @@ class KVStoreDist(KVStore):
             except Exception:
                 return False        # coordinator gone: shut the role down
 
+        def _die(reason: str):
+            # the owner role is down: record it LOUDLY. The local rank's
+            # next pull/flush raises; remote ranks notice via the
+            # staleness bound (or stale reads) — thread death is invisible
+            # to process-level heartbeats by construction.
+            self._async_dead = reason
+            print("mxtpu dist_async: applier on rank %d died: %s"
+                  % (rank, reason), file=sys.stderr, flush=True)
+
         def apply_loop():
             applied: Dict[Any, int] = {}
             gap_since: Dict[Any, float] = {}
@@ -548,7 +559,9 @@ class KVStoreDist(KVStore):
                                 gap_since.pop((k, nxt), None)
                                 applied[k] = nxt
                                 if not _mark_done(k, nxt, delete_push=False):
-                                    return
+                                    return _die(
+                                        "coordination service unreachable "
+                                        "skipping dead push of %r" % (k,))
                         continue
                     gap_since.pop((k, nxt), None)
                     try:
@@ -561,11 +574,13 @@ class KVStoreDist(KVStore):
                     if ok and not self._publish_weight_retry(client, k):
                         # update applied locally but could not be published:
                         # do NOT advance 'done' — bounded-staleness pushers
-                        # then block loudly instead of losing the update
-                        return
+                        # block, and this rank fails loud on its next call
+                        return _die("publish of key %r failed after "
+                                    "retries" % (k,))
                     applied[k] = nxt
                     if not _mark_done(k, nxt, delete_push=True):
-                        return
+                        return _die("coordination service unreachable "
+                                    "marking key %r done" % (k,))
 
         t = threading.Thread(target=apply_loop, daemon=True,
                              name="mxtpu-kv-async-applier")
@@ -576,6 +591,9 @@ class KVStoreDist(KVStore):
     def _flush(self) -> None:
         if not self._async_mode:
             return super()._flush()
+        if self._async_dead:
+            raise MXNetError("dist_async owner role on this rank is dead: "
+                             + str(self._async_dead))
         if not self._pending:
             return
         if self._updater is None:
@@ -635,6 +653,9 @@ class KVStoreDist(KVStore):
              ignore_sparse: bool = True):
         if not self._async_mode:
             return super().pull(key, out, priority, ignore_sparse)
+        if self._async_dead:
+            raise MXNetError("dist_async owner role on this rank is dead: "
+                             + str(self._async_dead))
         self._flush()
         client = _dist_client()
         timeout_ms = int(float(get_env("MXNET_KVSTORE_BARRIER_TIMEOUT",

@@ -50,14 +50,19 @@ class DataParallelExecutorGroup:
         shared_exec = shared_group.execs[0] if shared_group is not None else None
         ctx = contexts[0]
         if shared_exec is not None:
-            # bucketing: share argument arrays with the largest-bucket executor
+            # bucketing: share argument arrays with the largest-bucket
+            # executor; group2ctx rides along so every bucket keeps the
+            # same device placement as the default bucket
+            if group2ctx is None:
+                group2ctx = getattr(shared_exec, "group2ctx", None)
             exec_ = symbol.bind(ctx,
                                 {k: v for k, v in shared_exec.arg_dict.items()
                                  if k in arg_names},
                                 {k: v for k, v in shared_exec.grad_dict.items()
                                  if k in arg_names},
                                 self.grad_req,
-                                dict(shared_exec.aux_dict))
+                                dict(shared_exec.aux_dict),
+                                group2ctx=group2ctx)
             # (re)size data/label arrays for this bucket's shapes
             for name, shape in shapes.items():
                 if name not in exec_.arg_dict or \

@@ -55,6 +55,16 @@ def main():
     last = None
     for layout, batch in variants:
         t_var = time.perf_counter()
+        if layout == "IMP":
+            # imperative-dispatch lab (north-star config #3, SURVEY hard
+            # part #2): per-op dispatch rate + LSTM-PTB step time with the
+            # un-hybridized imperative path vs the hybridized one
+            try:
+                _imperative_lab(batch or 32)
+            except Exception as e:
+                print(json.dumps({"variant": f"IMP:{batch}",
+                                  "error": repr(e)[:300]}), flush=True)
+            continue
         try:
             np.random.seed(0)
             mx.random.seed(0)
@@ -209,6 +219,119 @@ def main():
                           "hlo_path": "/tmp/perf_lab_hlo.txt"}), flush=True)
     except Exception as e:
         print(json.dumps({"hlo_audit_error": repr(e)[:300]}), flush=True)
+
+
+
+def _imperative_lab(batch=32):
+    """Imperative-dispatch measurements (VERDICT r4 next #4).
+
+    The reference's risk case (SURVEY hard part #2,
+    src/imperative/imperative.cc:38-120): per-op Python dispatch on small
+    tensors, and the LSTM-PTB training step (north-star config #3) run
+    UN-hybridized — every op a separate cached-jit dispatch — vs
+    hybridized into one program. Prints one JSON line:
+
+        {"variant": "IMP:32", "elemwise_ops_per_s": ..., "chain10_ms": ...,
+         "ptb_imperative_ms": ..., "ptb_hybrid_ms": ..., "imp_vs_hybrid": ...}
+
+    Contract tracked by the ladder: imperative within 5x of hybrid at PTB
+    sizes (batch 32, bptt 35, 2x200 LSTM, vocab 10k).
+    """
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, nd
+
+    # ---- per-op dispatch rate on small tensors -----------------------
+    a = nd.array(np.random.randn(64, 64).astype("float32"))
+    b = nd.array(np.random.randn(64, 64).astype("float32"))
+    for _ in range(20):                      # warm the jitted-op caches
+        c = a + b
+    c.wait_to_read()
+    n = 2000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        c = a + b
+    c.wait_to_read()
+    elemwise_rate = n / (time.perf_counter() - t0)
+
+    def chain(x):
+        for _ in range(10):                  # 10 distinct dispatches
+            x = nd.relu(x + 1.0) * 0.5
+        return x
+    chain(a).wait_to_read()
+    t0 = time.perf_counter()
+    reps = 100
+    for _ in range(reps):
+        out = chain(a)
+    out.wait_to_read()
+    chain10_ms = 1e3 * (time.perf_counter() - t0) / reps
+
+    # ---- LSTM-PTB step: imperative vs hybridized ----------------------
+    VOCAB, T, H, L = 10000, 35, 200, 2
+
+    class PTBModel(gluon.HybridBlock):
+        """Embedding -> 2x200 LSTM -> vocab decoder; states built inline
+        so the same block runs imperatively AND hybridized."""
+
+        def __init__(self, prefix):
+            super().__init__(prefix=prefix)
+            with self.name_scope():
+                self.emb = gluon.nn.Embedding(VOCAB, H)
+                self.lstm = gluon.rnn.LSTM(H, num_layers=L, layout="NTC")
+                self.dec = gluon.nn.Dense(VOCAB, flatten=False)
+
+        def hybrid_forward(self, F, x):
+            h = self.emb(x)
+            states = [F.zeros(shape=(L, batch, H)),
+                      F.zeros(shape=(L, batch, H))]
+            h = self.lstm(h, *states)
+            if isinstance(h, (list, tuple)):
+                h = h[0]
+            return self.dec(h)
+
+    def build(prefix):
+        net = PTBModel(prefix)
+        net.initialize(mx.init.Xavier())
+        return net
+
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.randint(0, VOCAB, (batch, T)).astype("float32"))
+    y = nd.array(rng.randint(0, VOCAB, (batch, T)).astype("float32"))
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def step_time(net, steps=8, warmup=3):
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.1})
+        def one():
+            with autograd.record():
+                out = net(x)
+                l = loss_fn(out, y)
+            l.backward()
+            trainer.step(batch)
+            return l
+        for _ in range(warmup):
+            one().wait_to_read()
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            l = one()
+        l.wait_to_read()
+        return 1e3 * (time.perf_counter() - t0) / steps
+
+    imp_net = build("implab_")
+    imp_ms = step_time(imp_net)
+    hyb_net = build("hyblab_")
+    hyb_net(x).wait_to_read()     # materialize params imperatively first
+    hyb_net.hybridize()
+    hyb_ms = step_time(hyb_net)
+
+    print(json.dumps({
+        "variant": f"IMP:{batch}",
+        "elemwise_ops_per_s": round(elemwise_rate, 1),
+        "chain10_ms": round(chain10_ms, 3),
+        "ptb_imperative_ms": round(imp_ms, 2),
+        "ptb_hybrid_ms": round(hyb_ms, 2),
+        "imp_vs_hybrid": round(imp_ms / hyb_ms, 2) if hyb_ms else None,
+    }), flush=True)
 
 
 if __name__ == "__main__":
