@@ -73,11 +73,29 @@ def _deconv(name, ins, attrs, st):
 
 @register("Gemm")
 def _gemm(name, ins, attrs, st):
-    if int(attrs.get("transB", 0)) != 1 or int(attrs.get("transA", 0)) != 0:
-        raise MXNetError("ONNX import: only Gemm(transA=0, transB=1)")
-    num_hidden = st["shapes"][ins[1].name][0]
-    return _sym().FullyConnected(ins[0], ins[1], ins[2], name=name,
-                                 num_hidden=num_hidden, flatten=False)
+    """All four transA/transB forms with alpha/beta scaling. The
+    FC-shaped case (transA=0, transB=1, alpha=beta=1) lowers to
+    FullyConnected; the rest compose transpose/dot/broadcast_add —
+    matching the reference's general Gemm lowering."""
+    transA = int(attrs.get("transA", 0))
+    transB = int(attrs.get("transB", 0))
+    alpha = float(attrs.get("alpha", 1.0))
+    beta = float(attrs.get("beta", 1.0))
+    if (transA, transB, alpha, beta) == (0, 1, 1.0, 1.0) and len(ins) == 3:
+        num_hidden = st["shapes"][ins[1].name][0]
+        return _sym().FullyConnected(ins[0], ins[1], ins[2], name=name,
+                                     num_hidden=num_hidden, flatten=False)
+    a, b = ins[0], ins[1]
+    out = _sym().dot(a, b, transpose_a=bool(transA),
+                     transpose_b=bool(transB))
+    if alpha != 1.0:
+        out = _sym()._mul_scalar(out, scalar=alpha)
+    if len(ins) == 3:
+        c = ins[2]
+        if beta != 1.0:
+            c = _sym()._mul_scalar(c, scalar=beta)
+        out = _sym().broadcast_add(out, c, name=name)
+    return out
 
 
 @register("MatMul")
@@ -85,6 +103,7 @@ def _matmul(name, ins, attrs, st):
     return _sym().dot(ins[0], ins[1], name=name)
 
 
+@register("SpatialBN")
 @register("BatchNormalization")
 def _bn(name, ins, attrs, st):
     return _sym().BatchNorm(*ins, name=name,
@@ -265,13 +284,37 @@ def _unsqueeze(name, ins, attrs, st):
 def _slice(name, ins, attrs, st):
     starts = [int(a) for a in attrs.get("starts", ())]
     ends = [int(a) for a in attrs.get("ends", ())]
-    if not starts:
-        # opset >= 10 moved starts/ends/axes to INPUTS; silently returning
-        # the tensor unsliced would corrupt numerics downstream
+    steps = []
+    raw = st["raw_inputs"].get(name, ())
+    if not starts and len(raw) >= 3:
+        # opset >= 10 input form: starts/ends/axes/steps are tensors. The
+        # overwhelmingly common exported case has them as initializers —
+        # fold them; truly dynamic slicing is rejected, never silently
+        # mis-sliced.
+        def _const(i):
+            if i < len(raw) and raw[i] in st["consts"]:
+                return [int(v) for v in np.ravel(st["consts"][raw[i]])]
+            return None
+        starts, ends = _const(1), _const(2)
+        axes = _const(3)
+        steps = _const(4)
+        if starts is None or ends is None \
+                or (len(raw) >= 4 and axes is None) \
+                or (len(raw) >= 5 and steps is None):
+            raise MXNetError(
+                "ONNX import: Slice with dynamic (non-initializer) "
+                "starts/ends/axes/steps is not supported")
+        if axes is None:
+            axes = list(range(len(starts)))
+        steps = steps or []
+    else:
+        axes = [int(a) for a in attrs.get("axes", range(len(starts)))]
+    if not starts or len(ends) != len(starts) or len(axes) != len(starts):
         raise MXNetError(
-            "ONNX import: Slice with input-form starts/ends (opset >= 10) "
-            "is not supported; re-export at opset 9 attribute form")
-    axes = [int(a) for a in attrs.get("axes", range(len(starts)))]
+            "ONNX import: Slice starts/ends/axes lengths disagree "
+            f"({len(starts)}/{len(ends) if ends else 0}/{len(axes)})")
+    if any(int(st_) != 1 for st_ in steps):
+        raise MXNetError("ONNX import: Slice steps != 1 not supported")
     out = ins[0]
     for ax, b, e in zip(axes, starts, ends):
         out = _sym().slice_axis(out, axis=ax, begin=b,
@@ -333,6 +376,225 @@ def _softplus(name, ins, attrs, st):
 
 
 # ---------------------------------------------------------------------------
+# round-5 breadth: the rest of the reference import table
+# (python/mxnet/contrib/onnx/onnx2mx/_import_helper.py:1 — ~92 ops)
+# ---------------------------------------------------------------------------
+
+for _onnx, _mx in [("Sin", "sin"), ("Cos", "cos"), ("Tan", "tan"),
+                   ("Asin", "arcsin"), ("Acos", "arccos"),
+                   ("Atan", "arctan"), ("Reciprocal", "reciprocal"),
+                   ("Softsign", "softsign"), ("Not", "logical_not")]:
+    register(_onnx)(_unary(_mx))
+
+for _onnx, _mx in [("And", "broadcast_logical_and"),
+                   ("Or", "broadcast_logical_or"),
+                   ("Xor", "broadcast_logical_xor"),
+                   ("Equal", "broadcast_equal"),
+                   ("Greater", "broadcast_greater"),
+                   ("Less", "broadcast_lesser")]:
+    register(_onnx)(_binary(_mx))
+
+
+@register("Selu")
+def _selu(name, ins, attrs, st):
+    a = float(attrs.get("alpha", 1.6732632423543772))
+    g = float(attrs.get("gamma", 1.0507009873554805))
+    if abs(a - 1.6732632423543772) > 1e-6 or \
+            abs(g - 1.0507009873554805) > 1e-6:
+        raise MXNetError(
+            "ONNX import: Selu with non-default alpha/gamma "
+            f"({a}, {g}) has no counterpart (selu constants are fixed)")
+    return _sym().LeakyReLU(ins[0], name=name, act_type="selu")
+
+
+@register("HardSigmoid")
+def _hard_sigmoid(name, ins, attrs, st):
+    return _sym().hard_sigmoid(ins[0], name=name,
+                               alpha=float(attrs.get("alpha", 0.2)),
+                               beta=float(attrs.get("beta", 0.5)))
+
+
+@register("LogSoftmax")
+def _log_softmax(name, ins, attrs, st):
+    return _sym().log_softmax(ins[0], name=name,
+                              axis=int(attrs.get("axis", 1)))
+
+
+def _arg_reduce(mx_op):
+    def fn(name, ins, attrs, st):
+        out = getattr(_sym(), mx_op)(ins[0], name=name,
+                                     axis=int(attrs.get("axis", 0)),
+                                     keepdims=bool(attrs.get("keepdims", 1)))
+        return out
+    return fn
+
+
+register("ArgMax")(_arg_reduce("argmax"))
+register("ArgMin")(_arg_reduce("argmin"))
+
+
+def _reduce(mx_op, post=None, pre=None):
+    """ONNX Reduce* -> mx reduce with axis/keepdims; pre/post wrap the
+    composed forms (ReduceLogSum = log(sum), ReduceSumSquare =
+    sum(square), ReduceLogSumExp = log(sum(exp)) — the reference composes
+    them the same way)."""
+    def fn(name, ins, attrs, st):
+        x = ins[0]
+        if pre is not None:
+            x = getattr(_sym(), pre)(x)
+        axes = attrs.get("axes")
+        kw = dict(keepdims=bool(attrs.get("keepdims", 1)))
+        if axes is not None:
+            kw["axis"] = tuple(int(a) for a in axes)
+        out = getattr(_sym(), mx_op)(x, **kw)
+        if post is not None:
+            out = getattr(_sym(), post)(out, name=name)
+        return out
+    return fn
+
+
+register("ReduceSum")(_reduce("sum"))
+register("ReduceMax")(_reduce("max"))
+register("ReduceMin")(_reduce("min"))
+register("ReduceProd")(_reduce("prod"))
+register("ReduceLogSum")(_reduce("sum", post="log"))
+register("ReduceLogSumExp")(_reduce("sum", post="log", pre="exp"))
+register("ReduceSumSquare")(_reduce("sum", pre="square"))
+
+
+@register("Shape")
+def _shape(name, ins, attrs, st):
+    return _sym().shape_array(ins[0], name=name)
+
+
+@register("Size")
+def _size(name, ins, attrs, st):
+    return _sym().size_array(ins[0], name=name)
+
+
+@register("Constant")
+def _constant(name, ins, attrs, st):
+    """Materialize the tensor as an initializer: the output Variable binds
+    to it through arg_params like any other weight."""
+    t = attrs.get("value")
+    if t is None:
+        raise MXNetError("ONNX import: Constant node without a value attr")
+    arr = t.to_array() if hasattr(t, "to_array") else np.asarray(t)
+    out_name = st["node_outputs"][0]
+    st["consts"][out_name] = arr
+    st["shapes"][out_name] = arr.shape
+    from ... import symbol as sym_mod
+    return sym_mod.Variable(out_name)
+
+
+@register("InstanceNormalization")
+def _instance_norm(name, ins, attrs, st):
+    return _sym().InstanceNorm(ins[0], ins[1], ins[2], name=name,
+                               eps=float(attrs.get("epsilon", 1e-5)))
+
+
+@register("DepthToSpace")
+def _depth_to_space(name, ins, attrs, st):
+    return _sym().depth_to_space(ins[0], name=name,
+                                 block_size=int(attrs["blocksize"]))
+
+
+@register("SpaceToDepth")
+def _space_to_depth(name, ins, attrs, st):
+    return _sym().space_to_depth(ins[0], name=name,
+                                 block_size=int(attrs["blocksize"]))
+
+
+@register("LpPool")
+def _lp_pool(name, ins, attrs, st):
+    return _sym().Pooling(ins[0], name=name, pool_type="lp",
+                          kernel=tuple(attrs["kernel_shape"]),
+                          stride=tuple(attrs.get("strides", ())) or None,
+                          pad=_sym_pads(attrs, len(attrs["kernel_shape"])),
+                          p_value=int(attrs.get("p", 2)))
+
+
+@register("GlobalLpPool")
+def _global_lp_pool(name, ins, attrs, st):
+    return _sym().Pooling(ins[0], name=name, pool_type="lp",
+                          global_pool=True, kernel=(1, 1),
+                          p_value=int(attrs.get("p", 2)))
+
+
+@register("MaxRoiPool")
+def _max_roi_pool(name, ins, attrs, st):
+    return _sym().ROIPooling(ins[0], ins[1], name=name,
+                             pooled_size=tuple(attrs["pooled_shape"]),
+                             spatial_scale=float(attrs.get("spatial_scale",
+                                                           1.0)))
+
+
+@register("Mean")
+def _mean_nary(name, ins, attrs, st):
+    out = ins[0]
+    for other in ins[1:]:
+        out = _sym().broadcast_add(out, other)
+    return _sym()._mul_scalar(out, scalar=1.0 / len(ins), name=name)
+
+
+@register("Multinomial")
+def _multinomial(name, ins, attrs, st):
+    # ONNX feeds unnormalized LOG probabilities; sample_multinomial takes
+    # probabilities — normalize through a softmax first
+    probs = _sym().softmax(ins[0], axis=-1)
+    return _sym().sample_multinomial(
+        probs, name=name, shape=int(attrs.get("sample_size", 1)))
+
+
+@register("RandomNormal")
+def _random_normal(name, ins, attrs, st):
+    return _sym().random_normal(loc=float(attrs.get("mean", 0.0)),
+                                scale=float(attrs.get("scale", 1.0)),
+                                shape=tuple(attrs["shape"]), name=name)
+
+
+@register("RandomUniform")
+def _random_uniform(name, ins, attrs, st):
+    return _sym().random_uniform(low=float(attrs.get("low", 0.0)),
+                                 high=float(attrs.get("high", 1.0)),
+                                 shape=tuple(attrs["shape"]), name=name)
+
+
+@register("RandomNormalLike")
+def _random_normal_like(name, ins, attrs, st):
+    return _sym()._random_normal_like(ins[0], name=name,
+                                      loc=float(attrs.get("mean", 0.0)),
+                                      scale=float(attrs.get("scale", 1.0)))
+
+
+@register("RandomUniformLike")
+def _random_uniform_like(name, ins, attrs, st):
+    return _sym()._random_uniform_like(ins[0], name=name,
+                                       low=float(attrs.get("low", 0.0)),
+                                       high=float(attrs.get("high", 1.0)))
+
+
+@register("FC")
+def _fc(name, ins, attrs, st):
+    """The reference exporter's own FullyConnected passthrough op."""
+    num_hidden = st["shapes"][ins[1].name][0]
+    return _sym().FullyConnected(*ins, name=name, num_hidden=num_hidden,
+                                 no_bias=len(ins) == 2)
+
+
+@register("LpNormalization")
+def _lp_normalization(name, ins, attrs, st):
+    if int(attrs.get("p", 2)) != 2:
+        raise MXNetError("ONNX import: LpNormalization supports p=2 only")
+    ax = int(attrs.get("axis", -1))
+    if ax not in (-1, 1):
+        raise MXNetError("ONNX import: LpNormalization axis must be the "
+                         "channel axis")
+    return _sym().L2Normalization(ins[0], name=name,
+                                  mode="channel" if ax == 1 else "instance")
+
+
+# ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
 
@@ -378,7 +640,20 @@ def import_model(model_file: str):
         name = node.name or node.outputs[0]
         st["raw_inputs"][name] = node.inputs
         st["n_outputs"] = len(node.outputs)
+        st["node_outputs"] = list(node.outputs)
         ins = [env[i] for i in node.inputs if i in env]
+        if node.op_type == "Slice" and len(node.inputs) >= 3:
+            ins = ins[:1]       # starts/ends/axes/steps folded from consts
+            for k1, pname in enumerate(node.inputs[1:], start=1):
+                if pname not in consts:
+                    continue
+                used_elsewhere = any(
+                    inp == pname
+                    for other in g.nodes
+                    for k2, inp in enumerate(other.inputs)
+                    if not (other is node and k2 == k1))
+                if not used_elsewhere:
+                    consumed_consts.add(pname)
         if node.op_type == "Reshape" and len(ins) == 2:
             ins = ins[:1]  # shape tensor consumed via st["consts"] instead
             shp = node.inputs[1]

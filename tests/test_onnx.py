@@ -212,3 +212,255 @@ def test_split_three_ways_and_alias(tmp_path):
     got = sym2.bind(mx.cpu(), {"data": mx.nd.array(x), **args2},
                     aux_states=aux2 or None).forward()[0].asnumpy()
     np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# round-5 import breadth (reference _import_helper.py table, ~92 ops)
+# ---------------------------------------------------------------------------
+
+def _import_graph(tmp_path, nodes, inits, in_infos, out_names, feeds):
+    """Build a ModelProto directly, import it, bind with feeds, forward."""
+    g = GraphProto(name="g")
+    g.nodes.extend(nodes)
+    for name, arr in inits.items():
+        g.initializers.append(TensorProto.from_array(np.asarray(arr), name))
+        g.inputs.append(ValueInfoProto(name, 1, np.asarray(arr).shape))
+    for name, shape in in_infos.items():
+        g.inputs.append(ValueInfoProto(name, 1, shape))
+    for o in out_names:
+        g.outputs.append(ValueInfoProto(o, 1, ()))
+    path = str(tmp_path / "m.onnx")
+    ModelProto(graph=g, opset_version=11).save(path)
+    sym, arg_params, aux_params = import_model(path)
+    args = dict(arg_params)
+    for k, v in feeds.items():
+        args[k] = mx.nd.array(np.asarray(v, dtype="float32"))
+    ex = sym.bind(mx.cpu(), args, aux_states=aux_params)
+    return [o.asnumpy() for o in ex.forward(is_train=False)]
+
+
+def test_onnx_import_unary_binary_breadth(tmp_path):
+    rng = np.random.RandomState(0)
+    x = rng.uniform(0.2, 0.9, (2, 3)).astype("float32")
+    y = rng.uniform(0.2, 0.9, (2, 3)).astype("float32")
+    cases = [
+        ("Sin", np.sin(x)), ("Cos", np.cos(x)), ("Tan", np.tan(x)),
+        ("Asin", np.arcsin(x)), ("Acos", np.arccos(x)),
+        ("Atan", np.arctan(x)), ("Reciprocal", 1.0 / x),
+        ("Softsign", x / (1 + np.abs(x))),
+    ]
+    for op, want in cases:
+        (got,) = _import_graph(
+            tmp_path, [NodeProto(op, "n", ["x"], ["out"])], {},
+            {"x": x.shape}, ["out"], {"x": x})
+        np.testing.assert_allclose(got, want, rtol=1e-5, err_msg=op)
+    for op, want in [("Greater", (x > y)), ("Less", (x < y)),
+                     ("Equal", (x == y))]:
+        (got,) = _import_graph(
+            tmp_path, [NodeProto(op, "n", ["x", "y"], ["out"])], {},
+            {"x": x.shape, "y": y.shape}, ["out"], {"x": x, "y": y})
+        np.testing.assert_allclose(got, want.astype("float32"), err_msg=op)
+    b1 = (x > 0.5).astype("float32")
+    b2 = (y > 0.5).astype("float32")
+    for op, want in [("And", np.logical_and(b1, b2)),
+                     ("Or", np.logical_or(b1, b2)),
+                     ("Xor", np.logical_xor(b1, b2))]:
+        (got,) = _import_graph(
+            tmp_path, [NodeProto(op, "n", ["x", "y"], ["out"])], {},
+            {"x": b1.shape, "y": b2.shape}, ["out"], {"x": b1, "y": b2})
+        np.testing.assert_allclose(got, want.astype("float32"), err_msg=op)
+    (got,) = _import_graph(tmp_path, [NodeProto("Not", "n", ["x"], ["out"])],
+                           {}, {"x": b1.shape}, ["out"], {"x": b1})
+    np.testing.assert_allclose(got, 1.0 - b1)
+
+
+def test_onnx_import_reduce_family(tmp_path):
+    rng = np.random.RandomState(1)
+    x = rng.uniform(0.1, 2.0, (2, 3, 4)).astype("float32")
+    cases = [
+        ("ReduceSum", x.sum(1, keepdims=True)),
+        ("ReduceMax", x.max(1, keepdims=True)),
+        ("ReduceMin", x.min(1, keepdims=True)),
+        ("ReduceProd", x.prod(1, keepdims=True)),
+        ("ReduceMean", x.mean(1, keepdims=True)),
+        ("ReduceLogSum", np.log(x.sum(1, keepdims=True))),
+        ("ReduceLogSumExp", np.log(np.exp(x).sum(1, keepdims=True))),
+        ("ReduceSumSquare", (x ** 2).sum(1, keepdims=True)),
+    ]
+    for op, want in cases:
+        (got,) = _import_graph(
+            tmp_path, [NodeProto(op, "n", ["x"], ["out"], {"axes": [1]})],
+            {}, {"x": x.shape}, ["out"], {"x": x})
+        np.testing.assert_allclose(got, want, rtol=1e-4, err_msg=op)
+    for op, want in [("ArgMax", x.argmax(2)[..., None]),
+                     ("ArgMin", x.argmin(2)[..., None])]:
+        (got,) = _import_graph(
+            tmp_path, [NodeProto(op, "n", ["x"], ["out"], {"axis": 2})],
+            {}, {"x": x.shape}, ["out"], {"x": x})
+        np.testing.assert_allclose(got, want, err_msg=op)
+
+
+def test_onnx_import_activations_and_norms(tmp_path):
+    rng = np.random.RandomState(2)
+    x = rng.randn(2, 3, 4, 4).astype("float32")
+    (got,) = _import_graph(
+        tmp_path, [NodeProto("Selu", "n", ["x"], ["out"])], {},
+        {"x": x.shape}, ["out"], {"x": x})
+    a, l = 1.6732632423543772, 1.0507009873554805
+    np.testing.assert_allclose(
+        got, np.where(x > 0, l * x, l * a * (np.exp(x) - 1)), rtol=1e-5)
+    (got,) = _import_graph(
+        tmp_path,
+        [NodeProto("HardSigmoid", "n", ["x"], ["out"],
+                   {"alpha": 0.25, "beta": 0.5})],
+        {}, {"x": x.shape}, ["out"], {"x": x})
+    np.testing.assert_allclose(got, np.clip(0.25 * x + 0.5, 0, 1), rtol=1e-5)
+    (got,) = _import_graph(
+        tmp_path,
+        [NodeProto("LogSoftmax", "n", ["x"], ["out"], {"axis": 1})],
+        {}, {"x": (2, 5)}, ["out"],
+        {"x": rng.randn(2, 5).astype("float32")})
+    assert np.allclose(np.exp(got).sum(1), 1.0, atol=1e-5)
+    gamma = np.array([1.5, 0.5, 2.0], "float32")
+    beta = np.array([0.1, -0.2, 0.3], "float32")
+    (got,) = _import_graph(
+        tmp_path,
+        [NodeProto("InstanceNormalization", "n", ["x", "g", "b"], ["out"],
+                   {"epsilon": 1e-5})],
+        {"g": gamma, "b": beta}, {"x": x.shape}, ["out"], {"x": x})
+    m = x.mean(axis=(2, 3), keepdims=True)
+    v = x.var(axis=(2, 3), keepdims=True)
+    want = gamma[None, :, None, None] * (x - m) / np.sqrt(v + 1e-5) \
+        + beta[None, :, None, None]
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+    (got,) = _import_graph(
+        tmp_path,
+        [NodeProto("LpNormalization", "n", ["x"], ["out"],
+                   {"axis": 1, "p": 2})],
+        {}, {"x": x.shape}, ["out"], {"x": x})
+    want = x / np.sqrt((x ** 2).sum(axis=1, keepdims=True) + 1e-10)
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+
+def test_onnx_import_structural_breadth(tmp_path):
+    rng = np.random.RandomState(3)
+    x = rng.randn(1, 4, 2, 2).astype("float32")
+    (got,) = _import_graph(
+        tmp_path,
+        [NodeProto("DepthToSpace", "n", ["x"], ["out"], {"blocksize": 2})],
+        {}, {"x": x.shape}, ["out"], {"x": x})
+    assert got.shape == (1, 1, 4, 4)
+    (back,) = _import_graph(
+        tmp_path,
+        [NodeProto("SpaceToDepth", "n", ["x"], ["out"], {"blocksize": 2})],
+        {}, {"x": got.shape}, ["out"], {"x": got})
+    np.testing.assert_allclose(back, x, rtol=1e-6)
+    (shp,) = _import_graph(
+        tmp_path, [NodeProto("Shape", "n", ["x"], ["out"])], {},
+        {"x": x.shape}, ["out"], {"x": x})
+    np.testing.assert_array_equal(shp, [1, 4, 2, 2])
+    (size,) = _import_graph(
+        tmp_path, [NodeProto("Size", "n", ["x"], ["out"])], {},
+        {"x": x.shape}, ["out"], {"x": x})
+    assert int(size.ravel()[0]) == 16
+    # Constant feeds a Add downstream
+    cval = np.full((2, 2), 3.0, "float32")
+    (got,) = _import_graph(
+        tmp_path,
+        [NodeProto("Constant", "c", [], ["cv"],
+                   {"value": TensorProto.from_array(cval, "cv")}),
+         NodeProto("Add", "a", ["x2", "cv"], ["out"])],
+        {}, {"x2": (2, 2)}, ["out"],
+        {"x2": np.ones((2, 2), "float32")})
+    np.testing.assert_allclose(got, 4.0)
+    # Mean over three inputs
+    (got,) = _import_graph(
+        tmp_path, [NodeProto("Mean", "m", ["a", "b", "c"], ["out"])], {},
+        {"a": (2,), "b": (2,), "c": (2,)}, ["out"],
+        {"a": [1., 2.], "b": [3., 4.], "c": [5., 6.]})
+    np.testing.assert_allclose(got, [3., 4.])
+    # opset-10 input-form Slice with initializer starts/ends
+    xs = np.arange(20, dtype="float32").reshape(4, 5)
+    (got,) = _import_graph(
+        tmp_path,
+        [NodeProto("Slice", "s", ["x3", "st", "en", "ax"], ["out"])],
+        {"st": np.array([1, 0], "int64"), "en": np.array([3, 4], "int64"),
+         "ax": np.array([0, 1], "int64")},
+        {"x3": xs.shape}, ["out"], {"x3": xs})
+    np.testing.assert_allclose(got, xs[1:3, 0:4])
+
+
+def test_onnx_import_gemm_forms(tmp_path):
+    rng = np.random.RandomState(4)
+    a = rng.randn(3, 4).astype("float32")
+    c = rng.randn(5).astype("float32")
+    for transA in (0, 1):
+        for transB in (0, 1):
+            A = a if not transA else a.T
+            B = rng.randn(4, 5).astype("float32")
+            Bv = B if not transB else B.T
+            want = 0.5 * (A.T if transA else A) @ \
+                (Bv.T if transB else Bv) + 2.0 * c
+            (got,) = _import_graph(
+                tmp_path,
+                [NodeProto("Gemm", "g", ["A", "B", "C"], ["out"],
+                           {"alpha": 0.5, "beta": 2.0,
+                            "transA": transA, "transB": transB})],
+                {"B": Bv, "C": c}, {"A": A.shape}, ["out"], {"A": A})
+            np.testing.assert_allclose(got, want, rtol=1e-4,
+                                       err_msg=f"t{transA}{transB}")
+
+
+def test_onnx_import_pool_and_random(tmp_path):
+    rng = np.random.RandomState(5)
+    x = np.abs(rng.randn(1, 2, 4, 4)).astype("float32")
+    (got,) = _import_graph(
+        tmp_path,
+        [NodeProto("LpPool", "n", ["x"], ["out"],
+                   {"kernel_shape": [2, 2], "strides": [2, 2], "p": 2})],
+        {}, {"x": x.shape}, ["out"], {"x": x})
+    want = np.sqrt((x ** 2).reshape(1, 2, 2, 2, 2, 2)
+                   .transpose(0, 1, 2, 4, 3, 5).reshape(1, 2, 4, 4)
+                   .reshape(1, 2, 4, 2, 2).sum(-1)
+                   .reshape(1, 2, 2, 2, 2).sum(-1))
+    assert got.shape == (1, 2, 2, 2)
+    np.testing.assert_allclose(np.sort(got.ravel()),
+                               np.sort(want.ravel()), rtol=1e-4)
+    (gl,) = _import_graph(
+        tmp_path,
+        [NodeProto("GlobalLpPool", "n", ["x"], ["out"], {"p": 2})],
+        {}, {"x": x.shape}, ["out"], {"x": x})
+    np.testing.assert_allclose(
+        gl.ravel(), np.sqrt((x ** 2).sum(axis=(2, 3))).ravel(), rtol=1e-4)
+    # statistical check only for the random family
+    (r,) = _import_graph(
+        tmp_path,
+        [NodeProto("RandomNormal", "n", [], ["out"],
+                   {"shape": [2000], "mean": 1.0, "scale": 0.5})],
+        {}, {}, ["out"], {})
+    assert abs(r.mean() - 1.0) < 0.1 and abs(r.std() - 0.5) < 0.1
+    (ru,) = _import_graph(
+        tmp_path,
+        [NodeProto("RandomUniformLike", "n", ["x"], ["out"],
+                   {"low": 2.0, "high": 3.0})],
+        {}, {"x": x.shape}, ["out"], {"x": x})
+    assert ru.shape == x.shape and 2.0 <= ru.min() and ru.max() <= 3.0
+
+
+def test_export_import_alexnet_zoo_roundtrip(tmp_path):
+    """Second zoo family round-trip (the reference's onnx test zoo walks
+    bvlc_alexnet etc.; with zero egress we round-trip our own zoo build)."""
+    from mxnet_tpu.gluon.model_zoo import vision
+    net = vision.alexnet(classes=10)
+    net.initialize(mx.init.Xavier())
+    x = np.random.RandomState(6).randn(1, 3, 224, 224).astype("float32")
+    ref = net(mx.nd.array(x)).asnumpy()
+    data = mx.sym.Variable("data")
+    sym = net(data)
+    params = {p.name: p.data() for p in net.collect_params().values()}
+    path = str(tmp_path / "alexnet.onnx")
+    export_model(sym, params, x.shape, np.float32, path)
+    sym2, args2, aux2 = import_model(path)
+    ex = sym2.bind(mx.cpu(), {**args2, **aux2, "data": mx.nd.array(x)})
+    got = ex.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(got, ref, rtol=2e-2, atol=2e-3)
