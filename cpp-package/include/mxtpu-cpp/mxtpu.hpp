@@ -154,6 +154,139 @@ class AutogradRecord {
 
 inline void waitall() { check(MXTPUNDArrayWaitAll(), "NDArrayWaitAll"); }
 
+/*! RAII KVStore over MXTPUKVStore*: the data-parallel reduction +
+ *  store-side-optimizer channel (reference cpp-package kvstore.h). */
+class KVStore {
+ public:
+  explicit KVStore(const std::string &type = "local") {
+    check(MXTPUKVStoreCreate(type.c_str(), &h_), "KVStoreCreate");
+  }
+  ~KVStore() { if (h_) MXTPUKVStoreFree(h_); }
+  KVStore(const KVStore &) = delete;
+  KVStore &operator=(const KVStore &) = delete;
+
+  void init(const std::string &key, const NDArray &v) {
+    check(MXTPUKVStoreInit(h_, key.c_str(), v.handle()), "KVStoreInit");
+  }
+  void push(const std::string &key, const NDArray &v, int priority = 0) {
+    check(MXTPUKVStorePush(h_, key.c_str(), v.handle(), priority),
+          "KVStorePush");
+  }
+  void pull(const std::string &key, NDArray *out) {
+    check(MXTPUKVStorePull(h_, key.c_str(), out->handle()), "KVStorePull");
+  }
+  void set_optimizer(const std::string &name,
+                     const std::string &params_json = "{}") {
+    check(MXTPUKVStoreSetOptimizer(h_, name.c_str(), params_json.c_str()),
+          "KVStoreSetOptimizer");
+  }
+  void barrier() { check(MXTPUKVStoreBarrier(h_), "KVStoreBarrier"); }
+  int rank() const {
+    int r = 0;
+    check(MXTPUKVStoreGetRank(h_, &r), "KVStoreGetRank");
+    return r;
+  }
+  int num_workers() const {
+    int n = 0;
+    check(MXTPUKVStoreGetGroupSize(h_, &n), "KVStoreGetGroupSize");
+    return n;
+  }
+
+ private:
+  KVStoreHandle h_ = nullptr;
+};
+
+/*! RAII trainable executor over MXTPUExecutor*: simple_bind a symbol
+ *  JSON, run forward/backward, read/write args and gradients — what the
+ *  reference cpp-package Executor wraps over its c_api executor calls. */
+class Executor {
+ public:
+  Executor(const std::string &symbol_json, int dev_type, int dev_id,
+           const std::map<std::string, std::vector<mx_uint>> &input_shapes,
+           const std::string &grad_req = "write") {
+    std::vector<const char *> keys;
+    std::vector<mx_uint> indptr{0};
+    std::vector<mx_uint> data;
+    for (const auto &kv : input_shapes) {
+      keys.push_back(kv.first.c_str());
+      data.insert(data.end(), kv.second.begin(), kv.second.end());
+      indptr.push_back(static_cast<mx_uint>(data.size()));
+    }
+    check(MXTPUExecutorSimpleBind(symbol_json.c_str(), dev_type, dev_id,
+                                  static_cast<mx_uint>(keys.size()),
+                                  keys.data(), indptr.data(), data.data(),
+                                  grad_req.c_str(), &h_),
+          "ExecutorSimpleBind");
+  }
+  ~Executor() { if (h_) MXTPUExecutorFree(h_); }
+  Executor(const Executor &) = delete;
+  Executor &operator=(const Executor &) = delete;
+
+  std::vector<std::string> list_arguments() const {
+    mx_uint n = 0;
+    const char **names = nullptr;
+    check(MXTPUExecutorListArguments(h_, &n, &names), "ListArguments");
+    return std::vector<std::string>(names, names + n);
+  }
+  std::vector<mx_uint> arg_shape(const std::string &name) const {
+    mx_uint *shp = nullptr, nd = 0;
+    check(MXTPUExecutorArgShape(h_, name.c_str(), &shp, &nd), "ArgShape");
+    return std::vector<mx_uint>(shp, shp + nd);
+  }
+  void set_arg(const std::string &name, const std::vector<mx_float> &v) {
+    check(MXTPUExecutorSetArg(h_, name.c_str(), v.data(),
+                              static_cast<mx_uint>(v.size())), "SetArg");
+  }
+  std::vector<mx_float> get_arg(const std::string &name) const {
+    std::vector<mx_float> out(numel(arg_shape(name)));
+    check(MXTPUExecutorGetArg(h_, name.c_str(), out.data(),
+                              static_cast<mx_uint>(out.size())), "GetArg");
+    return out;
+  }
+  std::vector<mx_float> get_grad(const std::string &name) const {
+    std::vector<mx_float> out(numel(arg_shape(name)));
+    check(MXTPUExecutorGetGrad(h_, name.c_str(), out.data(),
+                               static_cast<mx_uint>(out.size())), "GetGrad");
+    return out;
+  }
+  NDArray arg_array(const std::string &name) const {
+    NDArrayHandle h = nullptr;
+    check(MXTPUExecutorArgNDArray(h_, name.c_str(), &h), "ArgNDArray");
+    return NDArray(h);
+  }
+  NDArray grad_array(const std::string &name) const {
+    NDArrayHandle h = nullptr;
+    check(MXTPUExecutorGradNDArray(h_, name.c_str(), &h), "GradNDArray");
+    return NDArray(h);
+  }
+  mx_uint forward(bool is_train) {
+    mx_uint n = 0;
+    check(MXTPUExecutorForward(h_, is_train ? 1 : 0, &n), "Forward");
+    return n;
+  }
+  void backward() { check(MXTPUExecutorBackward(h_), "Backward"); }
+  std::vector<mx_uint> output_shape(mx_uint index) const {
+    mx_uint *shp = nullptr, nd = 0;
+    check(MXTPUExecutorOutputShape(h_, index, &shp, &nd), "OutputShape");
+    return std::vector<mx_uint>(shp, shp + nd);
+  }
+  std::vector<mx_float> get_output(mx_uint index) const {
+    std::vector<mx_float> out(numel(output_shape(index)));
+    check(MXTPUExecutorGetOutput(h_, index, out.data(),
+                                 static_cast<mx_uint>(out.size())),
+          "GetOutput");
+    return out;
+  }
+
+ private:
+  static size_t numel(const std::vector<mx_uint> &shape) {
+    size_t n = 1;
+    for (mx_uint d : shape) n *= d;
+    return n;
+  }
+  ExecutorHandle h_ = nullptr;
+};
+
 }  // namespace mxtpu
 
 #endif  // MXTPU_CPP_MXTPU_HPP_

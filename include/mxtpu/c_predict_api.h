@@ -168,6 +168,64 @@ int MXTPUImperativeInvoke(const char *op_name, mx_uint num_inputs,
                           mx_uint out_capacity, NDArrayHandle *outputs,
                           mx_uint *num_outputs);
 
+/* ------------------------------------------------------------------------
+ * KVStore + trainable-executor slice (reference include/mxnet/c_api.h
+ * kvstore + executor sections): what a non-Python binding needs to TRAIN
+ * data-parallel — create/init/push/pull with an optional store-side
+ * optimizer, and simple_bind/forward/backward over a symbol JSON.
+ * ---------------------------------------------------------------------- */
+typedef void *KVStoreHandle;
+typedef void *ExecutorHandle;
+
+int MXTPUKVStoreCreate(const char *type, KVStoreHandle *out);
+int MXTPUKVStoreInit(KVStoreHandle handle, const char *key,
+                     NDArrayHandle value);
+int MXTPUKVStorePush(KVStoreHandle handle, const char *key,
+                     NDArrayHandle value, int priority);
+int MXTPUKVStorePull(KVStoreHandle handle, const char *key,
+                     NDArrayHandle out);
+/*! \brief Store-side optimizer (update_on_kvstore): after this, pushes
+ *  apply gradients and pulls return weights. params_json e.g.
+ *  "{\"learning_rate\": 0.1, \"momentum\": 0.9}". */
+int MXTPUKVStoreSetOptimizer(KVStoreHandle handle, const char *optimizer,
+                             const char *params_json);
+int MXTPUKVStoreBarrier(KVStoreHandle handle);
+int MXTPUKVStoreGetRank(KVStoreHandle handle, int *rank);
+int MXTPUKVStoreGetGroupSize(KVStoreHandle handle, int *size);
+int MXTPUKVStoreFree(KVStoreHandle handle);
+
+/*! \brief Bind a trainable executor: shapes CSR-encoded like MXPredCreate;
+ *  grad_req "write"/"add"/"null". dev_type 1 = cpu, 2 = accelerator. */
+int MXTPUExecutorSimpleBind(const char *symbol_json, int dev_type, int dev_id,
+                            mx_uint num_inputs, const char **input_keys,
+                            const mx_uint *input_shape_indptr,
+                            const mx_uint *input_shape_data,
+                            const char *grad_req, ExecutorHandle *out);
+int MXTPUExecutorListArguments(ExecutorHandle handle, mx_uint *out_size,
+                               const char ***out_array);
+int MXTPUExecutorArgShape(ExecutorHandle handle, const char *name,
+                          mx_uint **shape_data, mx_uint *ndim);
+int MXTPUExecutorSetArg(ExecutorHandle handle, const char *name,
+                        const mx_float *data, mx_uint size);
+int MXTPUExecutorGetArg(ExecutorHandle handle, const char *name,
+                        mx_float *data, mx_uint size);
+int MXTPUExecutorGetGrad(ExecutorHandle handle, const char *name,
+                         mx_float *data, mx_uint size);
+/*! \brief Handles onto the executor's arg/grad arrays — usable directly
+ *  with MXTPUKVStorePush/Pull for data-parallel reduction. */
+int MXTPUExecutorArgNDArray(ExecutorHandle handle, const char *name,
+                            NDArrayHandle *out);
+int MXTPUExecutorGradNDArray(ExecutorHandle handle, const char *name,
+                             NDArrayHandle *out);
+int MXTPUExecutorForward(ExecutorHandle handle, int is_train,
+                         mx_uint *num_outputs);
+int MXTPUExecutorBackward(ExecutorHandle handle);
+int MXTPUExecutorOutputShape(ExecutorHandle handle, mx_uint index,
+                             mx_uint **shape_data, mx_uint *ndim);
+int MXTPUExecutorGetOutput(ExecutorHandle handle, mx_uint index,
+                           mx_float *data, mx_uint size);
+int MXTPUExecutorFree(ExecutorHandle handle);
+
 #ifdef __cplusplus
 }  // extern "C"
 #endif

@@ -587,11 +587,13 @@ def _lp_normalization(name, ins, attrs, st):
     if int(attrs.get("p", 2)) != 2:
         raise MXNetError("ONNX import: LpNormalization supports p=2 only")
     ax = int(attrs.get("axis", -1))
-    if ax not in (-1, 1):
-        raise MXNetError("ONNX import: LpNormalization axis must be the "
-                         "channel axis")
-    return _sym().L2Normalization(ins[0], name=name,
-                                  mode="channel" if ax == 1 else "instance")
+    # exact single-axis L2 normalization for ANY axis (ONNX semantics);
+    # L2Normalization's instance/channel modes cover different axis SETS
+    # and would be silently wrong for ndim > 2
+    norm = _sym().sqrt(_sym().sum(_sym().square(ins[0]), axis=ax,
+                                  keepdims=True))
+    return _sym().broadcast_div(
+        ins[0], _sym()._plus_scalar(norm, scalar=1e-10), name=name)
 
 
 # ---------------------------------------------------------------------------
@@ -626,6 +628,16 @@ def import_model(model_file: str):
 
     env: Dict[str, "object"] = {}
     consumed_consts = set()  # attr-like tensors (e.g. Reshape shapes)
+    # one pass index: name -> [(node, input position)] for the
+    # "is this initializer read as data anywhere else" checks below
+    consumers: Dict[str, list] = {}
+    for _n in g.nodes:
+        for _k, _inp in enumerate(_n.inputs):
+            consumers.setdefault(_inp, []).append((_n, _k))
+
+    def used_elsewhere(tensor_name, at_node, at_pos):
+        return any(not (n2 is at_node and k2 == at_pos)
+                   for (n2, k2) in consumers.get(tensor_name, ()))
     for vi in g.inputs:
         if vi.name not in consts:
             env[vi.name] = sym_mod.Variable(vi.name)
@@ -645,25 +657,14 @@ def import_model(model_file: str):
         if node.op_type == "Slice" and len(node.inputs) >= 3:
             ins = ins[:1]       # starts/ends/axes/steps folded from consts
             for k1, pname in enumerate(node.inputs[1:], start=1):
-                if pname not in consts:
-                    continue
-                used_elsewhere = any(
-                    inp == pname
-                    for other in g.nodes
-                    for k2, inp in enumerate(other.inputs)
-                    if not (other is node and k2 == k1))
-                if not used_elsewhere:
+                if pname in consts and \
+                        not used_elsewhere(pname, node, k1):
                     consumed_consts.add(pname)
         if node.op_type == "Reshape" and len(ins) == 2:
             ins = ins[:1]  # shape tensor consumed via st["consts"] instead
             shp = node.inputs[1]
             # drop from params only if no OTHER node reads it as data
-            used_elsewhere = any(
-                inp == shp
-                for other in g.nodes
-                for k, inp in enumerate(other.inputs)
-                if not (other is node and k == 1))
-            if not used_elsewhere:
+            if not used_elsewhere(shp, node, 1):
                 consumed_consts.add(shp)
         out = fn(name, ins, node.attrs, st)
         outs = [out[j] for j in range(len(out))] if len(out) > 1 else [out]

@@ -157,7 +157,15 @@ class KVStore:
             # mutation path (e.g. dlpack in-place) must copy here first.
             src = self._store[k]._data
             for o in olist:
-                o._set_data(src)
+                # broadcast back to each out's home device (the reference
+                # comm broadcast direction): a pull into a replica on
+                # another device must not silently rehome the replica
+                if hasattr(src, "devices") and hasattr(o._data, "devices") \
+                        and o._data.devices() != src.devices():
+                    o._set_data(jax.device_put(
+                        src, next(iter(o._data.devices()))))
+                else:
+                    o._set_data(src)
 
     def pushpull(self, key, value, out=None, priority: int = 0) -> None:
         self.push(key, value, priority)
@@ -967,7 +975,18 @@ def _bucket_sum_compiled(sig):
 
 
 def _fused_bucket_sum(groups):
-    """groups: tuple of per-key tuples of arrays → list of merged arrays."""
+    """groups: tuple of per-key tuples of arrays → list of merged arrays.
+
+    Mixed-device groups (one executor replica per device pushing into the
+    same store) are aligned onto one device first — the reference CommCPU
+    copies every device's gradient into the CPU merge buffer the same way
+    (comm.h:103)."""
+    devs = {next(iter(a.devices())) for g in groups for a in g
+            if hasattr(a, "devices")}
+    if len(devs) > 1:
+        target = sorted(devs, key=str)[0]
+        groups = tuple(tuple(jax.device_put(a, target) for a in g)
+                       for g in groups)
     sig = tuple((len(g), tuple(g[0].shape), str(g[0].dtype)) for g in groups)
     flat = [x for g in groups for x in g]
     return list(_bucket_sum_compiled(sig)(*flat))

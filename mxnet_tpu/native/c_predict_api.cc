@@ -19,6 +19,7 @@
 #include <Python.h>
 #include <dlfcn.h>
 
+#include <cstdarg>
 #include <cstring>
 #include <mutex>
 #include <string>
@@ -631,6 +632,310 @@ int MXTPUImperativeInvoke(const char *op_name, mx_uint num_inputs,
   }
   Py_DECREF(res);
   *num_outputs = static_cast<mx_uint>(n);
+  return 0;
+}
+
+
+// ---------------------------------------------------------------------------
+// KVStore + trainable-executor slice (reference include/mxnet/c_api.h
+// kvstore + executor sections): create/init/push/pull with a store-side
+// optimizer, and simple_bind/forward/backward — the calls that let a
+// non-Python binding TRAIN data-parallel, closing the structural gap to
+// "any language can do what Python does".
+// ---------------------------------------------------------------------------
+
+typedef void *KVStoreHandle;
+typedef void *ExecutorHandle;
+
+namespace {
+
+struct PyHandle {
+  PyObject *obj;
+  std::vector<mx_uint> shape_buf;
+};
+
+int call_void(PyObject *obj, const char *method, const char *fmt, ...) {
+  va_list ap;
+  va_start(ap, fmt);
+  PyObject *m = PyObject_GetAttrString(obj, method);
+  if (!m) { va_end(ap); set_error_from_python(); return -1; }
+  PyObject *args = Py_VaBuildValue(fmt ? fmt : "()", ap);
+  va_end(ap);
+  if (!args) { Py_DECREF(m); set_error_from_python(); return -1; }
+  if (!PyTuple_Check(args)) {
+    PyObject *t = PyTuple_Pack(1, args);
+    Py_DECREF(args);
+    args = t;
+  }
+  PyObject *res = PyObject_CallObject(m, args);
+  Py_DECREF(m);
+  Py_DECREF(args);
+  if (!res) { set_error_from_python(); return -1; }
+  Py_DECREF(res);
+  return 0;
+}
+
+int copy_bytes_out(PyObject *bytes, mx_float *data, mx_uint size,
+                   const char *who) {
+  char *buf = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(bytes, &buf, &len) != 0) {
+    set_error_from_python();
+    return -1;
+  }
+  if (static_cast<size_t>(len) != sizeof(mx_float) * size) {
+    set_error(std::string(who) + ": size mismatch (have " +
+              std::to_string(len / sizeof(mx_float)) + " floats, caller asked "
+              + std::to_string(size) + ")");
+    return -1;
+  }
+  std::memcpy(data, buf, len);
+  return 0;
+}
+
+}  // namespace
+
+int MXTPUKVStoreCreate(const char *type, KVStoreHandle *out) {
+  GIL gil;
+  PyObject *mod = bridge_module();
+  if (!mod) return -1;
+  PyObject *obj = PyObject_CallMethod(mod, "CKVStore", "s",
+                                      type ? type : "local");
+  if (!obj) { set_error_from_python(); return -1; }
+  *out = new PyHandle{obj, {}};
+  return 0;
+}
+
+int MXTPUKVStoreInit(KVStoreHandle handle, const char *key,
+                     NDArrayHandle value) {
+  GIL gil;
+  auto *h = static_cast<PyHandle *>(handle);
+  return call_void(h->obj, "init", "(sO)", key,
+                   static_cast<NDHandle *>(value)->obj);
+}
+
+int MXTPUKVStorePush(KVStoreHandle handle, const char *key,
+                     NDArrayHandle value, int priority) {
+  GIL gil;
+  auto *h = static_cast<PyHandle *>(handle);
+  return call_void(h->obj, "push", "(sOi)", key,
+                   static_cast<NDHandle *>(value)->obj, priority);
+}
+
+int MXTPUKVStorePull(KVStoreHandle handle, const char *key,
+                     NDArrayHandle out) {
+  GIL gil;
+  auto *h = static_cast<PyHandle *>(handle);
+  return call_void(h->obj, "pull", "(sO)", key,
+                   static_cast<NDHandle *>(out)->obj);
+}
+
+int MXTPUKVStoreSetOptimizer(KVStoreHandle handle, const char *optimizer,
+                             const char *params_json) {
+  GIL gil;
+  auto *h = static_cast<PyHandle *>(handle);
+  return call_void(h->obj, "set_optimizer", "(ss)", optimizer,
+                   params_json ? params_json : "{}");
+}
+
+int MXTPUKVStoreBarrier(KVStoreHandle handle) {
+  GIL gil;
+  auto *h = static_cast<PyHandle *>(handle);
+  return call_void(h->obj, "barrier", "()");
+}
+
+int MXTPUKVStoreGetRank(KVStoreHandle handle, int *rank) {
+  GIL gil;
+  auto *h = static_cast<PyHandle *>(handle);
+  PyObject *r = PyObject_CallMethod(h->obj, "rank", nullptr);
+  if (!r) { set_error_from_python(); return -1; }
+  *rank = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTPUKVStoreGetGroupSize(KVStoreHandle handle, int *size) {
+  GIL gil;
+  auto *h = static_cast<PyHandle *>(handle);
+  PyObject *r = PyObject_CallMethod(h->obj, "num_workers", nullptr);
+  if (!r) { set_error_from_python(); return -1; }
+  *size = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTPUKVStoreFree(KVStoreHandle handle) {
+  GIL gil;
+  auto *h = static_cast<PyHandle *>(handle);
+  Py_XDECREF(h->obj);
+  delete h;
+  return 0;
+}
+
+int MXTPUExecutorSimpleBind(const char *symbol_json, int dev_type, int dev_id,
+                            mx_uint num_inputs, const char **input_keys,
+                            const mx_uint *input_shape_indptr,
+                            const mx_uint *input_shape_data,
+                            const char *grad_req, ExecutorHandle *out) {
+  GIL gil;
+  PyObject *mod = bridge_module();
+  if (!mod) return -1;
+  PyObject *cls = PyObject_GetAttrString(mod, "CExecutor");
+  if (!cls) { set_error_from_python(); return -1; }
+  PyObject *shapes = shapes_dict(num_inputs, input_keys, input_shape_indptr,
+                                 input_shape_data);
+  PyObject *obj = PyObject_CallFunction(cls, "siiOs", symbol_json, dev_type,
+                                        dev_id, shapes,
+                                        grad_req ? grad_req : "write");
+  Py_DECREF(cls);
+  Py_DECREF(shapes);
+  if (!obj) { set_error_from_python(); return -1; }
+  *out = new PyHandle{obj, {}};
+  return 0;
+}
+
+int MXTPUExecutorListArguments(ExecutorHandle handle, mx_uint *out_size,
+                               const char ***out_array) {
+  GIL gil;
+  auto *h = static_cast<PyHandle *>(handle);
+  static thread_local std::vector<std::string> storage;
+  static thread_local std::vector<const char *> ptrs;
+  PyObject *names = PyObject_CallMethod(h->obj, "list_arguments", nullptr);
+  if (!names) { set_error_from_python(); return -1; }
+  Py_ssize_t n = PyList_Size(names);
+  storage.clear();
+  for (Py_ssize_t i = 0; i < n; ++i)
+    storage.emplace_back(PyUnicode_AsUTF8(PyList_GET_ITEM(names, i)));
+  Py_DECREF(names);
+  ptrs.clear();
+  for (auto &s : storage) ptrs.push_back(s.c_str());
+  *out_size = static_cast<mx_uint>(n);
+  *out_array = ptrs.data();
+  return 0;
+}
+
+int MXTPUExecutorArgShape(ExecutorHandle handle, const char *name,
+                          mx_uint **shape_data, mx_uint *ndim) {
+  GIL gil;
+  auto *h = static_cast<PyHandle *>(handle);
+  PyObject *shape = PyObject_CallMethod(h->obj, "arg_shape", "s", name);
+  if (!shape) { set_error_from_python(); return -1; }
+  Py_ssize_t n = PyTuple_Size(shape);
+  h->shape_buf.resize(n);
+  for (Py_ssize_t i = 0; i < n; ++i)
+    h->shape_buf[i] =
+        static_cast<mx_uint>(PyLong_AsLong(PyTuple_GET_ITEM(shape, i)));
+  Py_DECREF(shape);
+  *shape_data = h->shape_buf.data();
+  *ndim = static_cast<mx_uint>(n);
+  return 0;
+}
+
+int MXTPUExecutorSetArg(ExecutorHandle handle, const char *name,
+                        const mx_float *data, mx_uint size) {
+  GIL gil;
+  auto *h = static_cast<PyHandle *>(handle);
+  PyObject *bytes = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char *>(data), sizeof(mx_float) * size);
+  if (!bytes) { set_error_from_python(); return -1; }
+  int rc = call_void(h->obj, "set_arg", "(sO)", name, bytes);
+  Py_DECREF(bytes);
+  return rc;
+}
+
+int MXTPUExecutorGetArg(ExecutorHandle handle, const char *name,
+                        mx_float *data, mx_uint size) {
+  GIL gil;
+  auto *h = static_cast<PyHandle *>(handle);
+  PyObject *bytes = PyObject_CallMethod(h->obj, "get_arg", "s", name);
+  if (!bytes) { set_error_from_python(); return -1; }
+  int rc = copy_bytes_out(bytes, data, size, "MXTPUExecutorGetArg");
+  Py_DECREF(bytes);
+  return rc;
+}
+
+int MXTPUExecutorGetGrad(ExecutorHandle handle, const char *name,
+                         mx_float *data, mx_uint size) {
+  GIL gil;
+  auto *h = static_cast<PyHandle *>(handle);
+  PyObject *bytes = PyObject_CallMethod(h->obj, "get_grad", "s", name);
+  if (!bytes) { set_error_from_python(); return -1; }
+  int rc = copy_bytes_out(bytes, data, size, "MXTPUExecutorGetGrad");
+  Py_DECREF(bytes);
+  return rc;
+}
+
+int MXTPUExecutorArgNDArray(ExecutorHandle handle, const char *name,
+                            NDArrayHandle *out) {
+  GIL gil;
+  auto *h = static_cast<PyHandle *>(handle);
+  PyObject *r = PyObject_CallMethod(h->obj, "arg_nd", "s", name);
+  if (!r) { set_error_from_python(); return -1; }
+  *out = new NDHandle{r, {}};
+  return 0;
+}
+
+int MXTPUExecutorGradNDArray(ExecutorHandle handle, const char *name,
+                             NDArrayHandle *out) {
+  GIL gil;
+  auto *h = static_cast<PyHandle *>(handle);
+  PyObject *r = PyObject_CallMethod(h->obj, "grad_nd", "s", name);
+  if (!r) { set_error_from_python(); return -1; }
+  *out = new NDHandle{r, {}};
+  return 0;
+}
+
+int MXTPUExecutorForward(ExecutorHandle handle, int is_train,
+                         mx_uint *num_outputs) {
+  GIL gil;
+  auto *h = static_cast<PyHandle *>(handle);
+  PyObject *r = PyObject_CallMethod(h->obj, "forward", "i", is_train);
+  if (!r) { set_error_from_python(); return -1; }
+  if (num_outputs)
+    *num_outputs = static_cast<mx_uint>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTPUExecutorBackward(ExecutorHandle handle) {
+  GIL gil;
+  auto *h = static_cast<PyHandle *>(handle);
+  return call_void(h->obj, "backward", "()");
+}
+
+int MXTPUExecutorOutputShape(ExecutorHandle handle, mx_uint index,
+                             mx_uint **shape_data, mx_uint *ndim) {
+  GIL gil;
+  auto *h = static_cast<PyHandle *>(handle);
+  PyObject *shape = PyObject_CallMethod(h->obj, "output_shape", "I", index);
+  if (!shape) { set_error_from_python(); return -1; }
+  Py_ssize_t n = PyTuple_Size(shape);
+  h->shape_buf.resize(n);
+  for (Py_ssize_t i = 0; i < n; ++i)
+    h->shape_buf[i] =
+        static_cast<mx_uint>(PyLong_AsLong(PyTuple_GET_ITEM(shape, i)));
+  Py_DECREF(shape);
+  *shape_data = h->shape_buf.data();
+  *ndim = static_cast<mx_uint>(n);
+  return 0;
+}
+
+int MXTPUExecutorGetOutput(ExecutorHandle handle, mx_uint index,
+                           mx_float *data, mx_uint size) {
+  GIL gil;
+  auto *h = static_cast<PyHandle *>(handle);
+  PyObject *bytes = PyObject_CallMethod(h->obj, "get_output", "I", index);
+  if (!bytes) { set_error_from_python(); return -1; }
+  int rc = copy_bytes_out(bytes, data, size, "MXTPUExecutorGetOutput");
+  Py_DECREF(bytes);
+  return rc;
+}
+
+int MXTPUExecutorFree(ExecutorHandle handle) {
+  GIL gil;
+  auto *h = static_cast<PyHandle *>(handle);
+  Py_XDECREF(h->obj);
+  delete h;
   return 0;
 }
 

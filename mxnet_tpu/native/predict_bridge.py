@@ -283,3 +283,115 @@ class NDList:
     def get(self, index: int):
         a = self._arrays[index]
         return self._names[index], a.tobytes(), tuple(a.shape)
+
+
+# ---------------------------------------------------------------------------
+# KVStore + trainable-executor slice of the flat C ABI
+# (reference include/mxnet/c_api.h kvstore + executor sections: the calls a
+#  non-Python binding needs to train data-parallel, not just predict).
+# ---------------------------------------------------------------------------
+
+class CKVStore:
+    """Handle target of MXTPUKVStore*: wraps mxnet_tpu.kvstore.KVStore."""
+
+    def __init__(self, type_str: str):
+        from .. import kvstore as kv_mod
+        self._kv = kv_mod.create(type_str)
+
+    def init(self, key: str, arr: "CNDArray") -> None:
+        self._kv.init(key, arr.nd)
+
+    def push(self, key: str, arr: "CNDArray", priority: int = 0) -> None:
+        self._kv.push(key, arr.nd, priority=priority)
+
+    def pull(self, key: str, out: "CNDArray") -> None:
+        self._kv.pull(key, out=out.nd)
+
+    def set_optimizer(self, name: str, params_json: str) -> None:
+        """Server-side optimizer (update_on_kvstore): pushes become
+        gradient applications, pulls return weights."""
+        import json as _json
+        from .. import optimizer as opt_mod
+        kwargs = _json.loads(params_json) if params_json else {}
+        self._kv.set_optimizer(opt_mod.create(name, **kwargs))
+
+    def rank(self) -> int:
+        return self._kv.rank
+
+    def num_workers(self) -> int:
+        return self._kv.num_workers
+
+    def barrier(self) -> None:
+        self._kv.barrier()
+
+    def type(self) -> str:
+        return self._kv.type
+
+
+class CExecutor:
+    """Handle target of MXTPUExecutor*: a trainable bound executor.
+
+    simple_bind semantics: argument shapes inferred from the provided
+    input shapes; grad buffers allocated per grad_req. dev_type 1 = cpu,
+    2 = accelerator, mirroring the predictor convention."""
+
+    def __init__(self, symbol_json: str, dev_type: int, dev_id: int,
+                 input_shapes: Dict[str, Sequence[int]],
+                 grad_req: str = "write"):
+        import mxnet_tpu as mx
+        from .. import symbol as sym_mod
+        sym = sym_mod.load_json(symbol_json)
+        ctx = mx.cpu(dev_id) if dev_type == 1 else mx.context.tpu(dev_id)
+        shapes = {k: tuple(int(x) for x in v)
+                  for k, v in input_shapes.items()}
+        self._exec = sym.simple_bind(ctx, grad_req=grad_req, **shapes)
+        self._sym = sym
+
+    def list_arguments(self):
+        return list(self._sym.list_arguments())
+
+    def arg_shape(self, name: str):
+        return tuple(int(x) for x in self._exec.arg_dict[name].shape)
+
+    def set_arg(self, name: str, data: bytes) -> None:
+        import jax
+        arr = self._exec.arg_dict[name]
+        flat = np.frombuffer(data, dtype=np.float32)
+        # keep the executor's device placement (dev_id): asarray alone
+        # would land the new buffer on the default device
+        dev = next(iter(arr._data.devices()))
+        arr._set_data(jax.device_put(
+            _jnp().asarray(flat.reshape(arr.shape)), dev))
+
+    def get_arg(self, name: str) -> bytes:
+        return np.asarray(self._exec.arg_dict[name].asnumpy(),
+                          dtype=np.float32).tobytes()
+
+    def get_grad(self, name: str) -> bytes:
+        return np.asarray(self._exec.grad_dict[name].asnumpy(),
+                          dtype=np.float32).tobytes()
+
+    def arg_nd(self, name: str) -> "CNDArray":
+        return CNDArray.wrap(self._exec.arg_dict[name])
+
+    def grad_nd(self, name: str) -> "CNDArray":
+        return CNDArray.wrap(self._exec.grad_dict[name])
+
+    def forward(self, is_train: int) -> int:
+        self._exec.forward(is_train=bool(is_train))
+        return len(self._exec.outputs)
+
+    def backward(self) -> None:
+        self._exec.backward()
+
+    def output_shape(self, index: int):
+        return tuple(int(x) for x in self._exec.outputs[index].shape)
+
+    def get_output(self, index: int) -> bytes:
+        return np.asarray(self._exec.outputs[index].asnumpy(),
+                          dtype=np.float32).tobytes()
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp

@@ -53,3 +53,49 @@ def test_cpp_train_mlp(lib, tmp_path):
     acc = float(vals["accuracy"])
     assert last < first * 0.5, (first, last)
     assert acc > 0.9, acc
+
+
+def test_cpp_train_mlp_kvstore_data_parallel(lib, tmp_path):
+    """Data-parallel training from C++ through the kvstore + executor
+    slice (VERDICT r4 next #8): two executor replicas on cpu:0/cpu:1,
+    gradients pushed per key, store-side SGD, weights pulled back."""
+    # the example loads its graph from a symbol JSON, like the reference
+    # cpp-package examples do — generate the MLP symbol here
+    import mxnet_tpu as mx
+    data = mx.sym.Variable("data")
+    h = mx.sym.Activation(
+        mx.sym.FullyConnected(data, mx.sym.Variable("w1"),
+                              mx.sym.Variable("b1"), num_hidden=32),
+        act_type="relu")
+    out = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(h, mx.sym.Variable("w2"),
+                              mx.sym.Variable("b2"), num_hidden=4),
+        mx.sym.Variable("sm_label"), name="sm")
+    sym_path = tmp_path / "mlp.json"
+    out.save(str(sym_path))
+
+    exe = tmp_path / "train_mlp_kvstore"
+    r = subprocess.run(
+        ["g++", "-O2", "-std=c++17",
+         os.path.join(ROOT, "cpp-package", "example",
+                      "train_mlp_kvstore.cc"),
+         "-I", os.path.join(ROOT, "include"),
+         "-I", os.path.join(ROOT, "cpp-package", "include"),
+         "-L", os.path.dirname(lib), "-lmxtpu_predict",
+         f"-Wl,-rpath,{os.path.dirname(lib)}", "-o", str(exe)],
+        capture_output=True, text=True)
+    if r.returncode != 0:
+        pytest.skip(f"cannot link: {r.stderr[:400]}")
+    env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["MXTPU_ROOT"] = ROOT
+    r = subprocess.run([str(exe), str(sym_path)], capture_output=True,
+                       text=True, env=env, timeout=600)
+    assert r.returncode == 0, f"stdout:{r.stdout}\nstderr:{r.stderr}"
+    vals = dict(line.split() for line in r.stdout.strip().splitlines())
+    assert int(vals["workers"]) == 1          # single-process local store
+    first, last = float(vals["first_loss"]), float(vals["last_loss"])
+    acc = float(vals["accuracy"])
+    assert last < first * 0.5, (first, last)
+    assert acc > 0.9, acc

@@ -464,3 +464,15 @@ def test_export_import_alexnet_zoo_roundtrip(tmp_path):
     ex = sym2.bind(mx.cpu(), {**args2, **aux2, "data": mx.nd.array(x)})
     got = ex.forward(is_train=False)[0].asnumpy()
     np.testing.assert_allclose(got, ref, rtol=2e-2, atol=2e-3)
+
+
+def test_onnx_import_lp_normalization_last_axis(tmp_path):
+    """axis=-1 (the ONNX default) must normalize over ONLY the last axis
+    for ndim > 2 inputs."""
+    rng = np.random.RandomState(8)
+    x = rng.randn(2, 3, 4).astype("float32")
+    (got,) = _import_graph(
+        tmp_path, [NodeProto("LpNormalization", "n", ["x"], ["out"])],
+        {}, {"x": x.shape}, ["out"], {"x": x})
+    want = x / np.sqrt((x ** 2).sum(-1, keepdims=True) + 1e-10)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
