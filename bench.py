@@ -369,6 +369,67 @@ def _metric_lines(text):
     return out
 
 
+def _foreign_tunnel_clients():
+    """Names of OTHER processes that may hold the single-client tunnel
+    (perf_lab / aot_warm / tpu session leftovers). A second concurrent
+    client hangs behind them, so the live attempt must be skipped."""
+    markers = ("aot_warm.py", "perf_lab.py", "tpu_session")
+    found = []
+    try:
+        for pid in os.listdir("/proc"):
+            if not pid.isdigit() or int(pid) == os.getpid():
+                continue
+            try:
+                with open("/proc/%s/cmdline" % pid, "rb") as f:
+                    cmd = f.read().decode(errors="replace")
+            except OSError:
+                continue
+            if "python" not in cmd:
+                continue      # an editor/tail/grep naming the file is not
+                              # a tunnel client; only python processes are
+            for m in markers:
+                if m in cmd:
+                    found.append("%s(pid %s)" % (m, pid))
+                    break
+    except OSError:
+        pass
+    return found
+
+
+def _tunnel_preflight(timeout_s):
+    """Classify the accelerator backend fast: 'ok' (devices() returned a
+    non-cpu platform), 'down' (init raised), 'hung' (no answer within
+    timeout_s — the probe is ABANDONED, never killed, because a client
+    killed mid-handshake wedges the tunnel for everyone)."""
+    out = "/tmp/mxtpu_bench_preflight_%d.out" % os.getpid()
+    code = ("import jax\n"
+            "ds = jax.devices()\n"
+            "print('PREFLIGHT_OK' if any(d.platform != 'cpu' for d in ds)"
+            " else 'PREFLIGHT_CPU', flush=True)\n")
+    try:
+        with open(out, "w") as fo:
+            proc = subprocess.Popen([sys.executable, "-c", code], stdout=fo,
+                                    stderr=subprocess.STDOUT,
+                                    start_new_session=True)
+    except Exception:
+        return "down"
+    cutoff = time.time() + timeout_s
+    while time.time() < cutoff:
+        if proc.poll() is not None:
+            try:
+                with open(out) as f:
+                    txt = f.read()
+            except OSError:
+                txt = ""
+            if "PREFLIGHT_OK" in txt:
+                return "ok"
+            if "PREFLIGHT_CPU" in txt:
+                return "down"       # only the cpu backend answered
+            return "down"
+        time.sleep(2)
+    return "hung"
+
+
 def main():
     budget = float(os.environ.get("BENCH_TOTAL_BUDGET", 840))
     deadline = time.time() + budget
@@ -440,12 +501,43 @@ def main():
         except OSError:
             pass
     live = None
+    foreign = _foreign_tunnel_clients()
+    preflight = None
+    if orphan is None and not foreign \
+            and os.environ.get("BENCH_SKIP_TPU") != "1" and tpu_window > 90:
+        # health-check the tunnel BEFORE committing the window to a child:
+        # the observed failure mode is an init that hangs 25+ minutes and
+        # then raises UNAVAILABLE — a child stuck there burns the whole
+        # window. A short detached probe classifies the backend fast; a
+        # hung probe is abandoned (never killed: a mid-handshake kill
+        # wedges the tunnel) and the live attempt skipped.
+        preflight = _tunnel_preflight(min(
+            float(os.environ.get("BENCH_PREFLIGHT_TIMEOUT", 240)),
+            tpu_window / 3))
     if orphan is not None:
         # a previous run's TPU child still holds the single-client tunnel;
         # spawning a second client would wedge it — rely on the cache.
         errors.append("previous bench child pid=%d still alive; "
                       "skipping live TPU attempt" % orphan)
+    elif foreign:
+        # another tool (perf_lab/aot_warm/a leftover session) holds the
+        # single-client tunnel; a second client would hang behind it
+        errors.append("foreign tunnel client(s) alive: %s; "
+                      "skipping live TPU attempt" % ", ".join(foreign))
+    elif preflight in ("down", "hung"):
+        errors.append("tunnel preflight: backend %s; skipping live TPU "
+                      "attempt (cached row stands)" % preflight)
     elif os.environ.get("BENCH_SKIP_TPU") != "1" and tpu_window > 90:
+        # preflight consumed part of the window: rebase on the absolute
+        # deadline so the child's budget stays honest, and re-check the
+        # same 90s floor that gated the attempt in the first place
+        tpu_window = deadline - time.time() - cpu_reserve
+        if tpu_window <= 90:
+            errors.append("window too small after preflight "
+                          "(%.0fs); skipping live TPU attempt" % tpu_window)
+            tpu_window = 0
+    if live is None and orphan is None and not foreign \
+            and preflight == "ok" and tpu_window > 90:
         env = dict(os.environ)
         env["BENCH_CHILD_DEADLINE"] = str(time.time() + tpu_window)
         with open(child_out, "w") as fo, open(child_err, "w") as fe:

@@ -97,7 +97,8 @@ class DataParallelTrainer:
 
     def __init__(self, net, loss, optimizer="sgd", optimizer_params=None,
                  mesh: Optional[Mesh] = None, data_axis: str = "dp",
-                 compute_dtype=None, donate: bool = True, kvstore=None):
+                 compute_dtype=None, donate: bool = True, kvstore=None,
+                 remat=None):
         self._net = net
         self._loss_block = loss
         if mesh is None and kvstore is not None:
@@ -109,6 +110,25 @@ class DataParallelTrainer:
         self._axis = data_axis
         self._compute_dtype = (jnp.dtype(compute_dtype)
                                if compute_dtype is not None else None)
+        # rematerialization of the forward during backward — the lever
+        # that lets batch 512 fit without XLA spilling (reference
+        # MXNET_BACKWARD_DO_MIRROR, graph_executor.cc:232). None = keep
+        # all activations; "full" = recompute everything (max memory
+        # savings, ~1.3x FLOPs); "dots" = keep matmul outputs only; or
+        # pass any jax.checkpoint_policies callable.
+        if remat in (None, "none"):
+            self._remat_policy = False
+        elif remat == "full":
+            self._remat_policy = None
+        elif remat == "dots":
+            self._remat_policy = \
+                jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        elif callable(remat):
+            self._remat_policy = remat
+        else:
+            raise MXNetError(f"unknown remat mode {remat!r}")
+        self._remat = remat not in (None, "none")
+        self._remat_mode = remat
         # recorded for the AOT key: lr/momentum/wd are baked into the
         # compiled executable as constants, so a blob from different
         # hyperparameters must never be silently reused
@@ -201,8 +221,14 @@ class DataParallelTrainer:
                     ins.update({k: v.astype(cdtype) for k, v in p.items()})
                 else:
                     ins.update(p)
-                outs, aux_updates = raw_fn(ins, rng)
-                return jnp.mean(outs[0].astype(jnp.float32)), aux_updates
+
+                def run(ins_):
+                    outs, aux_updates = raw_fn(ins_, rng)
+                    return jnp.mean(outs[0].astype(jnp.float32)), aux_updates
+
+                if self._remat:
+                    run = jax.checkpoint(run, policy=self._remat_policy)
+                return run(ins)
 
             (loss, aux_updates), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(params)
@@ -247,8 +273,15 @@ class DataParallelTrainer:
                                     for k, v in p.items()})
                     else:
                         ins.update(p)
-                    outs, aux_updates = raw_fn(ins, rng)
-                    return jnp.mean(outs[0].astype(jnp.float32)), aux_updates
+
+                    def run(ins_):
+                        outs, aux_updates = raw_fn(ins_, rng)
+                        return (jnp.mean(outs[0].astype(jnp.float32)),
+                                aux_updates)
+
+                    if self._remat:
+                        run = jax.checkpoint(run, policy=self._remat_policy)
+                    return run(ins)
 
                 (loss, aux_updates), grads = jax.value_and_grad(
                     loss_of, has_aux=True)(params)
@@ -288,6 +321,7 @@ class DataParallelTrainer:
             "n_devices": int(self._mesh.devices.size),
             "in_shapes": _shape_key(arrays),
             "compute_dtype": str(self._compute_dtype),
+            "remat": str(getattr(self, "_remat_mode", None)),
             "optimizer": self._opt_desc,
         }
 
