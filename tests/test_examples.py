@@ -384,3 +384,28 @@ def test_ptb_bucketing_lm_perplexity_improves():
     # multiple buckets actually exercised (the point of the API)
     assert len(mod._buckets) >= 3, list(mod._buckets)
     assert last < 4.0 < first, (first, last)
+
+
+def test_vaegan_trains_all_three_networks():
+    """VAE-GAN (reference example/vae-gan): discriminator loss and the
+    encoder's KL+feature-reconstruction both improve while training all
+    three networks jointly."""
+    sys.path.insert(0, os.path.join(ROOT, "example", "vae-gan"))
+    import vaegan
+    first, last = vaegan.train(epochs=10, verbose=False)
+    assert last["dis"] < first["dis"], (first, last)
+    assert last["enc"] < first["enc"], (first, last)
+    assert np.isfinite(last["dec"])
+
+
+def test_chinese_text_cnn_learns_char_bigram():
+    """Char-level CNN variant (reference
+    example/cnn_chinese_text_classification): the class signal is a
+    character BIGRAM, so only the conv window (not unigram counts) can
+    separate it — accuracy must be near-perfect."""
+    sys.path.insert(0, os.path.join(ROOT, "example",
+                                    "cnn_text_classification"))
+    import chinese_text_cnn
+    first, last, acc = chinese_text_cnn.train(epochs=8, verbose=False)
+    assert last < first * 0.3, (first, last)
+    assert acc > 0.9, acc
