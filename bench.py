@@ -250,7 +250,7 @@ def run_bench():
         try:
             lowered = trainer._step_fn.lower(
                 trainer._params, trainer._aux, trainer._opt_state,
-                jax.random.PRNGKey(0), xd, yd)
+                trainer._guard_state, jax.random.PRNGKey(0), xd, yd)
             try:
                 ca = lowered.cost_analysis()  # compile-free when supported
             except Exception:

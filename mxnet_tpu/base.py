@@ -16,6 +16,7 @@ from typing import Any, Callable, Dict, Optional, Type
 
 __all__ = [
     "MXNetError",
+    "TransientKVError",
     "config",
     "register_config",
     "get_env",
@@ -35,6 +36,14 @@ integer_types = (int,)
 class MXNetError(RuntimeError):
     """Framework error type (mirrors the reference's ``MXNetError`` raised
     through the C-API thread-local error string, ``src/c_api/c_api_error.cc``)."""
+
+
+class TransientKVError(MXNetError):
+    """A kvstore operation failed for a plausibly-transient reason (the
+    coordination service was briefly unreachable, a publish lost a race)
+    after its internal retry budget was exhausted. The resilience layer
+    (``mxnet_tpu.resilience.retry_transient``) treats this — unlike a bare
+    ``MXNetError`` — as retryable with backoff rather than fatal."""
 
 
 @dataclass
@@ -149,6 +158,36 @@ register_config("MXNET_DEFAULT_DTYPE", "float32", str,
 register_config("MXNET_TPU_MATMUL_PRECISION", "default", str,
                 "jax matmul precision: default|high|highest.")
 register_config("MXNET_SEED", -1, int, "Global PRNG seed; -1 = nondeterministic.")
+register_config("MXNET_KV_RETRY_ATTEMPTS", 5, int,
+                "Max attempts for transient kvstore coordination-service "
+                "operations (e.g. dist_async weight publish) before raising "
+                "TransientKVError.")
+register_config("MXNET_KV_RETRY_BASE", 0.05, float,
+                "Initial backoff delay (seconds) between kvstore retries; "
+                "doubles every attempt.")
+register_config("MXNET_KV_RETRY_MAX", 2.0, float,
+                "Upper bound (seconds) on a single kvstore retry backoff "
+                "delay.")
+register_config("MXNET_KV_RETRY_JITTER", 0.25, float,
+                "Multiplicative jitter fraction on kvstore retry delays "
+                "(delay *= 1 + jitter*U[0,1)) to decorrelate rank retries.")
+register_config("MXNET_RESILIENCE_RETRY_ATTEMPTS", 3, int,
+                "Max attempts resilience.retry_transient makes around a "
+                "transiently-failing training step.")
+register_config("MXNET_RESILIENCE_RETRY_BASE", 0.5, float,
+                "Initial backoff delay (seconds) for resilience.retry_transient.")
+register_config("MXNET_RESILIENCE_RETRY_MAX", 30.0, float,
+                "Upper bound (seconds) on a single resilience retry delay.")
+register_config("MXNET_RESILIENCE_SAVE_EVERY", 0, int,
+                "Default ResilientTrainer checkpoint cadence in steps "
+                "(0 = only explicit/preemption saves).")
+register_config("MXNET_RESILIENCE_KEEP", 3, int,
+                "Committed checkpoints a ResilientTrainer keeps before "
+                "pruning old steps.")
+register_config("MXNET_RESILIENCE_STEP_DEADLINE", 0.0, float,
+                "Seconds a single ResilientTrainer step may take before the "
+                "watchdog dumps all thread stacks and fails loud "
+                "(0 = watchdog off).")
 
 
 class classproperty:  # noqa: N801  (descriptor, lowercase by convention)

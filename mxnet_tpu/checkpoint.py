@@ -16,6 +16,14 @@ TPU-first design (the part the reference never needed):
 - **Resharded restore**: restore accepts a target sharding tree (or live
   example arrays) and lands shards directly on the right devices, so a
   checkpoint taken on one mesh restores onto a different mesh/topology.
+- **Atomic commit** (preemption safety): every save lands in a hidden
+  ``.pending_*`` temp dir and is published with a single ``rename`` after a
+  commit marker and a per-file checksum manifest are written. A crash at ANY
+  point mid-save can only leave an ignored temp dir — never a ``step_N/``
+  that ``restore``/``latest_step`` would trust. ``verify`` re-checks file
+  sizes and CRCs so torn (post-commit truncated) directories are rejected
+  too. The manifest carries caller-provided resume metadata (step counter,
+  rng state, AOT cache key — see ``resilience.ResilientTrainer``).
 
 Works on any backend (the unit tests restore across different virtual CPU
 mesh shardings). Gluon/Module save/load keep their reference-compatible
@@ -23,8 +31,13 @@ single-file formats; this module is the additive pod path.
 """
 from __future__ import annotations
 
+import json
 import os
-from typing import Any, Dict, Optional
+import shutil
+import threading
+import uuid
+import zlib
+from typing import Any, Dict, List, Optional
 
 import jax
 import numpy as np
@@ -32,6 +45,17 @@ import numpy as np
 from .base import MXNetError
 
 __all__ = ["ShardedCheckpointer", "save_sharded", "load_sharded"]
+
+# Name of the commit marker written inside a checkpoint directory as the
+# LAST file before the atomic publish rename. Only directories carrying it
+# are ever listed/restored.
+COMMIT_MARKER = "_MXTPU_COMMITTED"
+MANIFEST_NAME = "_MXTPU_MANIFEST.json"
+
+# Indirection over the final publish rename so the chaos harness
+# (resilience/chaos.py torn_checkpoint_writes) can crash a commit at the
+# worst possible moment without monkeypatching os itself.
+_commit_rename = os.rename
 
 
 def _ocp():
@@ -59,12 +83,35 @@ def _to_tree(params) -> Dict[str, Any]:
     return out
 
 
+def _crc_file(path: str) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(1 << 20)
+            if not chunk:
+                break
+            crc = zlib.crc32(chunk, crc)
+    return crc & 0xFFFFFFFF
+
+
 class ShardedCheckpointer:
     """Directory-of-steps checkpointer (one numbered subdir per step).
 
     >>> ckpt = ShardedCheckpointer("/path/run1")
     >>> ckpt.save(step, params, async_save=True)   # returns immediately
     >>> params = ckpt.restore(step, like=params)   # reshards onto `like`
+
+    Commit protocol (crash-safe by construction):
+
+    1. orbax writes the tree into ``<dir>/.pending_step_N.<pid>.<nonce>/``;
+    2. a manifest (relative path, size, crc32 of every file, plus caller
+       resume metadata) is written inside the temp dir;
+    3. the commit marker is written inside the temp dir and fsynced;
+    4. ONE ``rename(temp, step_N)`` publishes the checkpoint.
+
+    ``steps()``/``latest_step()`` list only directories with the marker;
+    ``restore`` additionally verifies the manifest, so a directory torn
+    AFTER commit (bit rot, truncation) is rejected instead of half-loaded.
     """
 
     def __init__(self, directory: str):
@@ -75,39 +122,246 @@ class ShardedCheckpointer:
         # an async save actually happens, and close both in close()
         self._async_ckpt = None
         self._sync_ckpt = ocp.Checkpointer(ocp.StandardCheckpointHandler())
+        # step -> (temp_dir, user_manifest) awaiting finalize; guarded by
+        # _lock (saves may come from a trainer thread, joins from atexit)
+        self._pending: Dict[int, tuple] = {}
+        self._lock = threading.Lock()
+        self._closed = False
 
     def _step_dir(self, step: int) -> str:
         return os.path.join(self.directory, f"step_{int(step)}")
 
+    def _tmp_dir(self, step: int) -> str:
+        return os.path.join(self.directory, ".pending_step_%d.%d.%s" % (
+            int(step), os.getpid(), uuid.uuid4().hex[:8]))
+
     # ------------------------------------------------------------------ save
     def save(self, step: int, params, aux: Optional[Dict] = None,
-             async_save: bool = False, overwrite: bool = True) -> None:
+             async_save: bool = False, overwrite: bool = True,
+             manifest: Optional[Dict] = None) -> None:
+        """Save ``params`` (+ ``aux``, stored under ``__aux__`` keys) as step
+        ``step``. ``manifest`` is an arbitrary JSON-serializable dict stored
+        alongside (the resume manifest: step counter, rng, AOT key, ...).
+
+        The checkpoint becomes visible to ``steps()``/``restore`` only once
+        fully written and committed; with ``async_save`` that happens at the
+        NEXT save (any step), restore, steps() or close — so at most one
+        checkpoint is ever in the uncommitted window, bounding what a hard
+        kill (SIGKILL, OOM) can lose to a single cadence interval."""
+        step = int(step)
         tree = _to_tree(params)
         if aux:
             tree = dict(tree, **{f"__aux__{k}": v
                                  for k, v in _to_tree(aux).items()})
-        if async_save and self._async_ckpt is None:
-            ocp = _ocp()
-            self._async_ckpt = ocp.AsyncCheckpointer(
-                ocp.StandardCheckpointHandler())
-        ckpt = self._async_ckpt if async_save else self._sync_ckpt
-        ckpt.save(self._step_dir(step), tree, force=overwrite)
+        with self._lock:
+            have_pending = bool(self._pending)
+        if have_pending:
+            # join + COMMIT everything in flight before starting a new save:
+            # (a) a re-save of the same step must not race the serialization
+            # of the old buffers, and (b) an async save parked uncommitted
+            # until process exit would be lost to a hard crash — publishing
+            # it here makes the loss window one save interval, not the whole
+            # run. The orbax async layer serializes back-to-back saves
+            # anyway, so by the next cadence this join is effectively free.
+            self.wait_until_finished()
+        if self._is_committed(self._step_dir(step)) and not overwrite:
+            raise MXNetError(f"checkpoint step {step} already exists at "
+                             f"{self._step_dir(step)} (overwrite=False)")
+        tmp = self._tmp_dir(step)
+        user_manifest = dict(manifest) if manifest else {}
+        if async_save:
+            if self._async_ckpt is None:
+                ocp = _ocp()
+                self._async_ckpt = ocp.AsyncCheckpointer(
+                    ocp.StandardCheckpointHandler())
+            self._async_ckpt.save(tmp, tree)
+            with self._lock:
+                self._pending[step] = (tmp, user_manifest)
+        else:
+            self._sync_ckpt.save(tmp, tree)
+            self._commit(step, tmp, user_manifest)
+
+    def _commit(self, step: int, tmp: str, user_manifest: Dict) -> None:
+        """Manifest + marker inside the temp dir, then one atomic rename."""
+        files: List[Dict[str, Any]] = []
+        for root, _, names in os.walk(tmp):
+            for name in sorted(names):
+                full = os.path.join(root, name)
+                rel = os.path.relpath(full, tmp)
+                files.append({"path": rel, "size": os.path.getsize(full),
+                              "crc32": _crc_file(full)})
+        man = {"format": 1, "step": step, "files": files,
+               "user": user_manifest}
+        man_path = os.path.join(tmp, MANIFEST_NAME)
+        with open(man_path, "w") as f:
+            json.dump(man, f, indent=1, sort_keys=True)
+            f.flush()
+            os.fsync(f.fileno())
+        marker = os.path.join(tmp, COMMIT_MARKER)
+        with open(marker, "w") as f:
+            f.write("ok\n")
+            f.flush()
+            os.fsync(f.fileno())
+        final = self._step_dir(step)
+        if os.path.isdir(final):
+            # overwrite of a published step: retire the old dir out of the
+            # namespace first (rename is atomic; rmtree of the retired copy
+            # is not, but a crash only leaks an ignored hidden dir)
+            retired = os.path.join(
+                self.directory,
+                ".retired_step_%d.%s" % (step, uuid.uuid4().hex[:8]))
+            os.rename(final, retired)
+            try:
+                _commit_rename(tmp, final)
+            except BaseException:
+                os.rename(retired, final)   # roll the old checkpoint back
+                raise
+            shutil.rmtree(retired, ignore_errors=True)
+        else:
+            _commit_rename(tmp, final)
+        self._fsync_dir(self.directory)
+
+    @staticmethod
+    def _fsync_dir(path: str) -> None:
+        try:
+            fd = os.open(path, os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+        except OSError:  # pragma: no cover - platform-dependent
+            pass
 
     def wait_until_finished(self) -> None:
-        """Join any in-flight async save (call before exiting or before
-        deleting the checkpoint)."""
+        """Join any in-flight async save and COMMIT it (call before exiting
+        or before deleting the checkpoint)."""
         if self._async_ckpt is not None:
             self._async_ckpt.wait_until_finished()
+        with self._lock:
+            pending, self._pending = self._pending, {}
+        for step in sorted(pending):
+            tmp, user_manifest = pending[step]
+            if os.path.isdir(tmp):
+                self._commit(step, tmp, user_manifest)
+
+    # --------------------------------------------------------------- inspect
+    def _is_committed(self, path: str) -> bool:
+        return os.path.isfile(os.path.join(path, COMMIT_MARKER))
+
+    def verify(self, step: int) -> bool:
+        """True iff step ``step`` is committed AND every file listed in its
+        manifest still matches its recorded size and crc32 — i.e. the
+        directory is safe to restore from. Torn/truncated/uncommitted
+        directories return False."""
+        path = self._step_dir(step)
+        if not self._is_committed(path):
+            return False
+        try:
+            with open(os.path.join(path, MANIFEST_NAME)) as f:
+                man = json.load(f)
+        except (OSError, ValueError):
+            return False
+        for ent in man.get("files", []):
+            full = os.path.join(path, ent["path"])
+            try:
+                if os.path.getsize(full) != ent["size"]:
+                    return False
+                if _crc_file(full) != ent["crc32"]:
+                    return False
+            except OSError:
+                return False
+        return True
+
+    def adopt(self, step: int) -> None:
+        """Trust an existing UNCOMMITTED ``step_N`` directory — e.g. one
+        written by the pre-atomic-commit layout, or copied in by hand — and
+        commit it in place (manifest over its current files + marker).
+        Explicit by design: auto-trusting unmarked dirs would re-open the
+        torn-checkpoint hole the commit protocol closes. No-op if already
+        committed."""
+        path = self._step_dir(step)
+        if not os.path.isdir(path):
+            raise MXNetError(f"no checkpoint directory at {path} to adopt")
+        if self._is_committed(path):
+            return
+        files: List[Dict[str, Any]] = []
+        for root, _, names in os.walk(path):
+            for name in sorted(names):
+                if name in (COMMIT_MARKER, MANIFEST_NAME):
+                    continue
+                full = os.path.join(root, name)
+                rel = os.path.relpath(full, path)
+                files.append({"path": rel, "size": os.path.getsize(full),
+                              "crc32": _crc_file(full)})
+        man = {"format": 1, "step": int(step), "files": files,
+               "user": {"adopted": True}}
+        with open(os.path.join(path, MANIFEST_NAME), "w") as f:
+            json.dump(man, f, indent=1, sort_keys=True)
+            f.flush()
+            os.fsync(f.fileno())
+        with open(os.path.join(path, COMMIT_MARKER), "w") as f:
+            f.write("ok (adopted)\n")
+            f.flush()
+            os.fsync(f.fileno())
+
+    def read_manifest(self, step: int) -> Dict[str, Any]:
+        """The manifest committed with step ``step`` (``user`` holds the
+        caller's resume metadata)."""
+        path = os.path.join(self._step_dir(step), MANIFEST_NAME)
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except OSError:
+            raise MXNetError(f"no committed checkpoint manifest at {path}") \
+                from None
+
+    def _committed_steps(self):
+        """Committed steps on disk right now — no join, so gc() can run
+        concurrently with an in-flight async save without serializing it."""
+        out = []
+        for name in os.listdir(self.directory):
+            if name.startswith("step_"):
+                try:
+                    step = int(name[5:])
+                except ValueError:
+                    continue
+                if self._is_committed(os.path.join(self.directory, name)):
+                    out.append(step)
+        return sorted(out)
+
+    def steps(self):
+        """Available COMMITTED checkpoint steps, sorted. Pending async
+        saves are joined+committed first; torn temp dirs and uncommitted
+        directories are not listed."""
+        self.wait_until_finished()
+        return self._committed_steps()
+
+    def latest_step(self) -> Optional[int]:
+        """The newest committed step, or None. (Commit marker check only;
+        ``verify`` adds the checksum pass — ``ResilientTrainer`` walks
+        backwards over ``steps()`` verifying each candidate.)"""
+        steps = self.steps()
+        return steps[-1] if steps else None
 
     # --------------------------------------------------------------- restore
     def restore(self, step: int, like=None, shardings=None) -> Dict[str, Any]:
         """Restore step ``step``. ``like`` (a params tree of live arrays) or
         ``shardings`` (a {name: Sharding} tree) reshards on load; with
-        neither, arrays land replicated on the default device."""
+        neither, arrays land replicated on the default device.
+
+        Refuses uncommitted or torn directories: the commit marker must be
+        present and every manifest entry must match on disk."""
         path = self._step_dir(step)
-        if not os.path.isdir(path):
-            raise MXNetError(f"no checkpoint at {path}")
         self.wait_until_finished()
+        if not os.path.isdir(path) or not self._is_committed(path):
+            raise MXNetError(f"no checkpoint at {path}"
+                             + (" (directory exists but was never committed"
+                                " — a save died mid-write)"
+                                if os.path.isdir(path) else ""))
+        if not self.verify(step):
+            raise MXNetError(
+                f"checkpoint at {path} is torn: a file fails its manifest "
+                f"size/crc32 check — refusing to restore partial state")
         ocp = _ocp()
         target = None
         if like is not None:
@@ -120,7 +374,8 @@ class ShardedCheckpointer:
             # checkpoint's own metadata, restored replicated
             try:
                 meta = self._sync_ckpt.metadata(path)
-                saved = dict(meta.item_metadata.tree)
+                saved = dict(meta) if isinstance(meta, dict) \
+                    else dict(meta.item_metadata.tree)
             except Exception:
                 saved = {}
             for k, m in saved.items():
@@ -137,23 +392,48 @@ class ShardedCheckpointer:
             restored = self._sync_ckpt.restore(path)
         return restored
 
-    def steps(self):
-        """Available checkpoint steps, sorted."""
-        out = []
+    # ------------------------------------------------------------------- gc
+    def gc(self, keep: Optional[int] = None) -> None:
+        """Remove stale temp/retired dirs from dead processes and (with
+        ``keep``) all but the newest ``keep`` committed steps. Never touches
+        this process's own in-flight saves."""
+        with self._lock:
+            live = {tmp for tmp, _ in self._pending.values()}
         for name in os.listdir(self.directory):
-            if name.startswith("step_"):
-                try:
-                    out.append(int(name[5:]))
-                except ValueError:
-                    pass
-        return sorted(out)
+            if name.startswith((".pending_step_", ".retired_step_")):
+                full = os.path.join(self.directory, name)
+                # orbax writes through ITS OWN temp suffix on our temp path
+                # (<tmp>.orbax-checkpoint-tmp-N) before renaming to <tmp>,
+                # so an in-flight async save's on-disk dir only PREFIX-
+                # matches its registered temp path — exact matching here
+                # would reap the live write out from under the serializer
+                if any(full.startswith(t) for t in live):
+                    continue
+                shutil.rmtree(full, ignore_errors=True)
+            elif name.startswith("step_"):
+                # a dir without the commit marker is a torn pre-marker crash
+                # from an OLD layout or a manual copy: leave it (restore and
+                # steps() already ignore it) — deleting data we did not
+                # write is not this method's job
+                pass
+        if keep is not None and keep > 0:
+            # committed-only listing, deliberately WITHOUT joining: pruning
+            # after an async save must not serialize the save it overlaps
+            steps = self._committed_steps()
+            for step in steps[:-keep]:
+                shutil.rmtree(self._step_dir(step), ignore_errors=True)
 
     def close(self) -> None:
+        """Always joins + commits any in-flight async save, then releases
+        both checkpointers. Idempotent."""
+        if self._closed:
+            return
         self.wait_until_finished()
         if self._async_ckpt is not None:
             self._async_ckpt.close()
             self._async_ckpt = None
         self._sync_ckpt.close()
+        self._closed = True
 
 
 def _sharding_of(v):

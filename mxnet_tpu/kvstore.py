@@ -31,7 +31,7 @@ from typing import Any, Callable, Dict, List, Optional, Union
 import jax
 import jax.numpy as jnp
 
-from .base import MXNetError, get_env
+from .base import MXNetError, TransientKVError, get_env
 from .ndarray import NDArray
 from .ndarray.ndarray import _unwrap, _wrap
 
@@ -491,14 +491,32 @@ class KVStoreDist(KVStore):
             {"type": enc, "threshold": threshold}).dequantize(
                 packed, tuple(shape))
 
-    def _publish_weight_retry(self, client, k, attempts: int = 5) -> bool:
+    def _publish_weight_retry(self, client, k) -> None:
+        """Publish key ``k``'s weight with exponential backoff + jitter
+        (MXNET_KV_RETRY_ATTEMPTS/BASE/MAX/JITTER). Exhaustion raises
+        TransientKVError — typed so the resilience layer can distinguish
+        "coordination service flaked, retry the step" from a fatal
+        programming error."""
+        attempts = max(1, int(get_env("MXNET_KV_RETRY_ATTEMPTS", 5)))
+        last = None
         for i in range(attempts):
             try:
-                self._publish_weight(client, k)
-                return True
-            except Exception:
-                time.sleep(0.05 * (i + 1))
-        return False
+                return self._publish_weight(client, k)
+            except (TypeError, ValueError, KeyError, AttributeError,
+                    MXNetError):
+                # deterministic programming errors: retrying cannot help
+                # and typing them transient would feed them into the
+                # resilience retry loop — propagate as-is, immediately
+                raise
+            except Exception as e:
+                last = e
+                if i < attempts - 1:
+                    time.sleep(_kv_backoff_delay(i))
+        raise TransientKVError(
+            "publish of key %r failed after %d attempts (last: %r) — the "
+            "coordination service looks unreachable; tune MXNET_KV_RETRY_* "
+            "to retry longer" % (k, int(get_env("MXNET_KV_RETRY_ATTEMPTS",
+                                                5)), last)) from last
 
     def _start_async_applier(self) -> None:
         client = _dist_client()
@@ -579,12 +597,15 @@ class KVStoreDist(KVStore):
                     except Exception:
                         ok = False  # poisoned push: skip it, keep serving
                                     # (reference server catch-all)
-                    if ok and not self._publish_weight_retry(client, k):
-                        # update applied locally but could not be published:
-                        # do NOT advance 'done' — bounded-staleness pushers
-                        # block, and this rank fails loud on its next call
-                        return _die("publish of key %r failed after "
-                                    "retries" % (k,))
+                    if ok:
+                        try:
+                            self._publish_weight_retry(client, k)
+                        except TransientKVError as e:
+                            # update applied locally but could not be
+                            # published: do NOT advance 'done' — bounded-
+                            # staleness pushers block, and this rank fails
+                            # loud on its next call
+                            return _die(str(e))
                     applied[k] = nxt
                     if not _mark_done(k, nxt, delete_push=True):
                         return _die("coordination service unreachable "
@@ -854,6 +875,16 @@ class KVStoreDist(KVStore):
 import functools
 import os
 import time
+
+
+def _kv_backoff_delay(attempt: int) -> float:
+    """MXNET_KV_RETRY_* knobs bound to the shared backoff policy
+    (resilience.retry.backoff_delay)."""
+    from .resilience.retry import backoff_delay
+    return backoff_delay(attempt,
+                         float(get_env("MXNET_KV_RETRY_BASE", 0.05)),
+                         float(get_env("MXNET_KV_RETRY_MAX", 2.0)),
+                         float(get_env("MXNET_KV_RETRY_JITTER", 0.25)))
 
 
 # Server-side control commands (reference KVStoreServerProfilerCommand,

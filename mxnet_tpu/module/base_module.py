@@ -16,6 +16,7 @@ import numpy as np
 from .. import metric as metric_mod
 from .. import ndarray as nd
 from ..base import MXNetError
+from ..resilience.preemption import check_preempted
 
 __all__ = ["BaseModule", "BatchEndParam"]
 
@@ -142,6 +143,11 @@ class BaseModule:
                                            locals=locals())
                     for cb in _as_list(batch_end_callback):
                         cb(params)
+                # preemption (SIGTERM) latches a flag; honor it at the batch
+                # boundary — params are consistent here, so the resilience
+                # layer (resilient_fit / the caller's except) can checkpoint
+                # and exit instead of dying mid-update
+                check_preempted()
                 nbatch += 1
             for name, val in eval_metric.get_name_value():
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)

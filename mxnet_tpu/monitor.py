@@ -24,6 +24,7 @@ class Monitor:
         self.queue: List[Tuple[int, str, NDArray]] = []
         self.step = 0
         self.exes = []
+        self.trainers = []
         self.re_prog = re.compile(pattern)
         self.sort = sort
 
@@ -38,6 +39,13 @@ class Monitor:
         exe.set_monitor_callback(self.stat_helper, monitor_all)
         self.exes.append(exe)
 
+    def install_trainer(self, trainer) -> None:
+        """Tap a trainer exposing ``anomaly_stats()`` (DataParallelTrainer
+        with grad_guard, resilience.ResilientTrainer): each ``toc`` drains
+        its grad-anomaly counters (skip count, norm EMA, last norm) into the
+        stat stream next to the layer taps."""
+        self.trainers.append(trainer)
+
     def tic(self) -> None:
         if self.step % self.interval == 0:
             self.queue = []
@@ -48,6 +56,13 @@ class Monitor:
         if not self.activated:
             return []
         self.activated = False
+        for trainer in self.trainers:
+            stats = getattr(trainer, "anomaly_stats", None)
+            if stats is None:
+                continue
+            for name, value in sorted(stats().items()):
+                if self.re_prog.match(name):
+                    self.queue.append((self.step, name, value))
         res = []
         queue = sorted(self.queue, key=lambda x: x[1]) if self.sort else self.queue
         for n, k, v_list in queue:

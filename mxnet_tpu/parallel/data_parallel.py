@@ -56,6 +56,59 @@ def _shape_key(arrays):
     return [tuple(a.shape) + (str(a.dtype),) for a in arrays]
 
 
+# ---- grad-anomaly guard (NaN/Inf + norm-spike skip inside the step) -------
+def _guard_config(grad_guard):
+    """Normalize the ``grad_guard`` ctor arg. None/False = off; True = NaN/
+    Inf + spike detection with defaults; a dict overrides ``spike_factor``
+    (0 disables spike detection, keeping only the NaN/Inf check),
+    ``ema_decay`` and ``warmup`` (good steps before spikes can fire)."""
+    if not grad_guard:
+        return None
+    g = dict(grad_guard) if isinstance(grad_guard, dict) else {}
+    return {"spike_factor": float(g.get("spike_factor", 10.0)),
+            "ema_decay": float(g.get("ema_decay", 0.99)),
+            "warmup": int(g.get("warmup", 5))}
+
+
+def _guard_init_state():
+    return {"ema": jnp.zeros((), jnp.float32),
+            "last_norm": jnp.zeros((), jnp.float32),
+            "skips": jnp.zeros((), jnp.int32),
+            "good": jnp.zeros((), jnp.int32),
+            "steps": jnp.zeros((), jnp.int32),
+            "last_skipped": jnp.zeros((), jnp.int32)}
+
+
+def _guard_apply(cfg, gstate, gnorm, new_tree, old_tree):
+    """Inside the jitted step: keep ``new_tree`` on a healthy step, fall
+    back to ``old_tree`` (skip-step) when the gradient norm is NaN/Inf or
+    spikes past ``spike_factor``× its EMA. Returns (tree, new_gstate)."""
+    gnorm = gnorm.astype(jnp.float32)
+    finite = jnp.isfinite(gnorm)
+    if cfg["spike_factor"] > 0:
+        warm = gstate["good"] >= cfg["warmup"]
+        spike = jnp.logical_and(
+            warm, gnorm > cfg["spike_factor"] * gstate["ema"])
+    else:
+        spike = jnp.zeros((), jnp.bool_)
+    bad = jnp.logical_or(jnp.logical_not(finite), spike)
+    tree = jax.tree_util.tree_map(
+        lambda o, n: jnp.where(bad, o, n), old_tree, new_tree)
+    d = cfg["ema_decay"]
+    safe_norm = jnp.where(finite, gnorm, gstate["ema"])
+    ema = jnp.where(
+        bad, gstate["ema"],
+        jnp.where(gstate["good"] == 0, safe_norm,
+                  d * gstate["ema"] + (1.0 - d) * safe_norm))
+    badi = bad.astype(jnp.int32)
+    new_gstate = {"ema": ema, "last_norm": gnorm,
+                  "skips": gstate["skips"] + badi,
+                  "good": gstate["good"] + (1 - badi),
+                  "steps": gstate["steps"] + 1,
+                  "last_skipped": badi}
+    return tree, new_gstate
+
+
 def _make_optax(optimizer: str, optimizer_params: Dict):
     import optax
     p = dict(optimizer_params or {})
@@ -98,7 +151,7 @@ class DataParallelTrainer:
     def __init__(self, net, loss, optimizer="sgd", optimizer_params=None,
                  mesh: Optional[Mesh] = None, data_axis: str = "dp",
                  compute_dtype=None, donate: bool = True, kvstore=None,
-                 remat=None):
+                 remat=None, grad_guard=None):
         self._net = net
         self._loss_block = loss
         if mesh is None and kvstore is not None:
@@ -136,6 +189,13 @@ class DataParallelTrainer:
                           tuple(sorted((str(k), repr(v)) for k, v in
                                        (optimizer_params or {}).items())))
         self._tx = _make_optax(optimizer, optimizer_params)
+        # grad-anomaly guard: when enabled, the jitted step computes the
+        # global grad norm, skips the update on NaN/Inf or spike steps
+        # (params/aux/opt_state pass through unchanged) and counts skips in
+        # a small state tree that rides along the step like opt_state. The
+        # counters surface through anomaly_stats() / Monitor.install_trainer.
+        self._guard_cfg = _guard_config(grad_guard)
+        self._guard_state = None
         self._step_fn = None
         self._n_inputs = None
         self._param_names = None
@@ -195,6 +255,7 @@ class DataParallelTrainer:
         self._params = {n: _unwrap(pmap[n].data()) for n in param_names}
         self._aux = {n: _unwrap(pmap[n].data()) for n in aux_names}
         self._opt_state = self._tx.init(self._params)
+        self._guard_state = _guard_init_state()
         raw_fn = lowering.lower(is_train=True)
 
         mesh, axis = self._mesh, self._axis
@@ -202,8 +263,9 @@ class DataParallelTrainer:
         dataspec = NamedSharding(mesh, P(axis))
         cdtype = self._compute_dtype
         tx = self._tx
+        guard_cfg = self._guard_cfg
 
-        def train_step(params, aux, opt_state, rng, *data):
+        def train_step(params, aux, opt_state, gstate, rng, *data):
             inputs = {}
             if cdtype is not None:
                 inputs.update({k: v.astype(cdtype) for k, v in params.items()})
@@ -234,24 +296,36 @@ class DataParallelTrainer:
                 loss_of, has_aux=True)(params)
             if cdtype is not None:
                 grads = {k: g.astype(jnp.float32) for k, g in grads.items()}
-            updates, opt_state = tx.update(grads, opt_state, params)
             import optax
-            params = optax.apply_updates(params, updates)
+            updates, new_opt_state = tx.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
             new_aux = dict(aux)
             for k, v in aux_updates.items():
                 if k in new_aux:
                     new_aux[k] = v.astype(new_aux[k].dtype)
-            return params, new_aux, opt_state, loss
+            if guard_cfg is not None:
+                # skip-step: an anomalous gradient keeps params, aux AND
+                # opt_state at their pre-step values (a NaN forward would
+                # poison batchnorm running stats too)
+                gnorm = optax.global_norm(grads)
+                (new_params, new_aux, new_opt_state), gstate = _guard_apply(
+                    guard_cfg, gstate, gnorm,
+                    (new_params, new_aux, new_opt_state),
+                    (params, aux, opt_state))
+            return new_params, new_aux, new_opt_state, gstate, loss
 
+        gstate_spec = {k: repl for k in self._guard_state}
         in_shardings = (jax.tree_util.tree_map(lambda _: repl, self._params),
                         {k: repl for k in self._aux},
                         jax.tree_util.tree_map(lambda _: repl, self._opt_state),
+                        gstate_spec,
                         repl) + tuple(dataspec for _ in data_names)
         out_shardings = (jax.tree_util.tree_map(lambda _: repl, self._params),
                          {k: repl for k in self._aux},
                          jax.tree_util.tree_map(lambda _: repl, self._opt_state),
+                         gstate_spec,
                          repl)
-        donate = (0, 1, 2) if self._donate else ()
+        donate = (0, 1, 2, 3) if self._donate else ()
         self._step_fn = jax.jit(train_step, in_shardings=in_shardings,
                                 out_shardings=out_shardings,
                                 donate_argnums=donate)
@@ -292,10 +366,21 @@ class DataParallelTrainer:
                         new_aux[k] = v.astype(new_aux[k].dtype)
                 return grads, new_aux, loss
 
-            def apply_step(params, opt_state, grads):
-                updates, opt_state = tx.update(grads, opt_state, params)
+            def apply_step(params, opt_state, gstate, grads):
                 import optax
-                return optax.apply_updates(params, updates), opt_state
+                updates, new_opt_state = tx.update(grads, opt_state, params)
+                new_params = optax.apply_updates(params, updates)
+                if guard_cfg is not None:
+                    # guard the synced (cross-worker summed) gradient: a NaN
+                    # from ANY worker poisons the sum, so the skip decision
+                    # is naturally global. aux was already updated by
+                    # grad_step — on the hybrid path only params/opt_state
+                    # are protected.
+                    gnorm = optax.global_norm(grads)
+                    (new_params, new_opt_state), gstate = _guard_apply(
+                        guard_cfg, gstate, gnorm,
+                        (new_params, new_opt_state), (params, opt_state))
+                return new_params, new_opt_state, gstate
 
             gspec = jax.tree_util.tree_map(lambda _: repl, self._params)
             self._grad_fn = jax.jit(
@@ -304,7 +389,7 @@ class DataParallelTrainer:
                 + tuple(dataspec for _ in data_names),
                 out_shardings=(gspec, {k: repl for k in self._aux}, repl))
             self._apply_fn = jax.jit(
-                apply_step, donate_argnums=(0, 1) if self._donate else ())
+                apply_step, donate_argnums=(0, 1, 2) if self._donate else ())
 
     # ---------------------------------------------------- AOT serialization
     # The compiled fused step can be serialized and reloaded by a LATER
@@ -323,6 +408,10 @@ class DataParallelTrainer:
             "compute_dtype": str(self._compute_dtype),
             "remat": str(getattr(self, "_remat_mode", None)),
             "optimizer": self._opt_desc,
+            # guard thresholds are baked constants in the executable: a blob
+            # compiled with different anomaly policy must not be reused
+            "grad_guard": repr(sorted(self._guard_cfg.items())
+                               if self._guard_cfg else None),
         }
 
     def _lowered_digest(self, lowered) -> str:
@@ -348,7 +437,8 @@ class DataParallelTrainer:
         arrays = [jax.device_put(a, dataspec) for a in arrays]
         rng = jax.random.PRNGKey(0)
         lowered = self._step_fn.lower(
-            self._params, self._aux, self._opt_state, rng, *arrays)
+            self._params, self._aux, self._opt_state, self._guard_state,
+            rng, *arrays)
         digest = self._lowered_digest(lowered)
         compiled = lowered.compile()
         ser, in_tree, out_tree = serialize(compiled)
@@ -391,7 +481,7 @@ class DataParallelTrainer:
         # a structural mismatch must be a clean refusal here, not a
         # confusing TypeError at the first step
         my_tree = jax.tree_util.tree_structure(
-            ((self._params, self._aux, self._opt_state,
+            ((self._params, self._aux, self._opt_state, self._guard_state,
               jax.random.PRNGKey(0)) + tuple(arrays), {}))
         if str(my_tree) != str(blob["in_tree"]):
             return False
@@ -402,7 +492,7 @@ class DataParallelTrainer:
         dataspec = NamedSharding(self._mesh, P(self._axis))
         placed = [jax.device_put(a, dataspec) for a in arrays]
         lowered = self._step_fn.lower(
-            self._params, self._aux, self._opt_state,
+            self._params, self._aux, self._opt_state, self._guard_state,
             jax.random.PRNGKey(0), *placed)
         if blob.get("digest") != self._lowered_digest(lowered):
             return False
@@ -423,6 +513,8 @@ class DataParallelTrainer:
         self._params = jax.tree_util.tree_map(put, self._params)
         self._aux = jax.tree_util.tree_map(put, self._aux)
         self._opt_state = jax.tree_util.tree_map(put, self._opt_state)
+        if self._guard_state is not None:
+            self._guard_state = jax.tree_util.tree_map(put, self._guard_state)
 
     # ------------------------------------------------------------- stepping
     def step(self, *data) -> float:
@@ -448,8 +540,9 @@ class DataParallelTrainer:
             # for that call only, keeping the executable for exact matches
             fn = self._compiled
             rng = jax.device_put(rng, NamedSharding(self._mesh, P()))
-        self._params, self._aux, self._opt_state, loss = fn(
-            self._params, self._aux, self._opt_state, rng, *arrays)
+        (self._params, self._aux, self._opt_state, self._guard_state,
+         loss) = fn(self._params, self._aux, self._opt_state,
+                    self._guard_state, rng, *arrays)
         return loss
 
     def _kv_step(self, rng, arrays):
@@ -477,8 +570,8 @@ class DataParallelTrainer:
             # gradient on a single device; re-replicate over the mesh so
             # the jitted apply sees one consistent placement
             synced[n] = jax.device_put(out._data / nworkers, repl)
-        self._params, self._opt_state = self._apply_fn(
-            self._params, self._opt_state, synced)
+        self._params, self._opt_state, self._guard_state = self._apply_fn(
+            self._params, self._opt_state, self._guard_state, synced)
         return loss
 
     def sync_to_net(self) -> None:
@@ -490,6 +583,19 @@ class DataParallelTrainer:
         for n in self._aux_names:
             home = self._pmap[n].list_ctx()[0].jax_device()
             self._pmap[n].data()._set_data(jax.device_put(self._aux[n], home))
+
+    def anomaly_stats(self) -> Dict[str, Any]:
+        """Grad-anomaly guard counters (empty dict when the guard is off or
+        no step ran): skipped-step count, grad-norm EMA, last step's norm
+        and whether it was skipped. Reading syncs the small scalars to host;
+        surfaced through ``Monitor.install_trainer``."""
+        if self._guard_cfg is None or self._guard_state is None:
+            return {}
+        gs = self._guard_state
+        return {"grad_skipped_steps": int(gs["skips"]),
+                "grad_norm_ema": float(gs["ema"]),
+                "last_grad_norm": float(gs["last_norm"]),
+                "last_step_skipped": bool(int(gs["last_skipped"]))}
 
     @property
     def mesh(self) -> Mesh:

@@ -1,0 +1,183 @@
+"""Fault-injection harness — the failures the resilience layer must survive,
+on demand and deterministic.
+
+Used by ``tests/test_resilience.py`` (the ``chaos`` pytest marker) and
+``tools/crashloop.py`` to reproduce recovery bugs locally: mid-step SIGTERM,
+dropped kvstore pushes, killed heartbeat threads, NaN gradients and torn
+checkpoint writes. Every injector is either a context manager that restores
+the patched surface on exit, or a one-shot function — nothing leaks into
+subsequent tests.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import signal
+import threading
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError
+
+__all__ = ["ChaosError", "sigterm_self", "dropped_pushes", "kill_heartbeat",
+           "nan_gradients", "nan_batch", "tear_checkpoint",
+           "torn_checkpoint_writes"]
+
+
+class ChaosError(MXNetError):
+    """Raised by an injector itself (e.g. a deliberately-crashed commit)."""
+
+
+# ------------------------------------------------------------- preemption
+def sigterm_self(delay: float = 0.0) -> Optional[threading.Timer]:
+    """Deliver SIGTERM to this process — immediately, or from a background
+    timer ``delay`` seconds from now (mid-step preemption)."""
+    if delay <= 0:
+        os.kill(os.getpid(), signal.SIGTERM)
+        return None
+    t = threading.Timer(delay, os.kill, args=(os.getpid(), signal.SIGTERM))
+    t.daemon = True
+    t.start()
+    return t
+
+
+# ---------------------------------------------------------------- kvstore
+@contextlib.contextmanager
+def dropped_pushes(kv, drop: int = 1,
+                   match: Optional[Callable] = None):
+    """Silently drop the next ``drop`` matching ``kv.push`` calls — a
+    gradient lost on the wire (the reference's dead-pusher scenario,
+    kvstore_dist_server gap handling). Yields a dict with the live
+    ``dropped`` count."""
+    orig = kv.push
+    state = {"left": int(drop), "dropped": 0}
+
+    def push(key, value, priority=0):
+        if state["left"] > 0 and (match is None or match(key)):
+            state["left"] -= 1
+            state["dropped"] += 1
+            return None
+        return orig(key, value, priority)
+
+    kv.push = push
+    try:
+        yield state
+    finally:
+        kv.push = orig
+
+
+def kill_heartbeat(kv) -> None:
+    """Stop a dist kvstore's heartbeat thread without killing the process:
+    the silent-liveness-loss failure peers must detect via
+    ``num_dead_node``. (Also stops the other background roles sharing the
+    stop event, matching what thread death after a fatal error looks
+    like.)"""
+    stop = getattr(kv, "_hb_stop", None)
+    if stop is None:
+        raise ChaosError("kvstore has no heartbeat role to kill")
+    stop.set()
+    t = getattr(kv, "_hb_thread", None)
+    if t is not None:
+        t.join(timeout=5.0)
+
+
+# -------------------------------------------------------------- gradients
+@contextlib.contextmanager
+def nan_gradients(trainer, steps: int = 1):
+    """Poison the hybrid-kvstore path's computed gradients with NaN for the
+    next ``steps`` steps (requires the trainer to be captured, i.e. one
+    step already ran). For the fused path — where grads never surface to
+    the host — feed :func:`nan_batch` data instead."""
+    t = getattr(trainer, "trainer", trainer)   # unwrap ResilientTrainer
+    if t._grad_fn is None:
+        raise ChaosError("trainer has no hybrid grad fn (not captured yet, "
+                         "or fused path — use nan_batch)")
+    orig = t._grad_fn
+    state = {"left": int(steps), "poisoned": 0}
+
+    def grad_fn(params, aux, rng, *data):
+        grads, new_aux, loss = orig(params, aux, rng, *data)
+        if state["left"] > 0:
+            state["left"] -= 1
+            state["poisoned"] += 1
+            grads = {k: jnp.full_like(v, jnp.nan) for k, v in grads.items()}
+        return grads, new_aux, loss
+
+    t._grad_fn = grad_fn
+    try:
+        yield state
+    finally:
+        t._grad_fn = orig
+
+
+def nan_batch(like):
+    """A batch of NaNs shaped like ``like`` — poisons the fused train
+    step's loss and gradients (the guard must skip that step)."""
+    a = np.asarray(like)
+    return np.full(a.shape, np.nan, dtype=a.dtype)
+
+
+# ------------------------------------------------------------ checkpoints
+def tear_checkpoint(directory: str, step: int, mode: str = "truncate") -> str:
+    """Corrupt a COMMITTED checkpoint in place; returns the damaged path.
+
+    mode='truncate': chop the largest data file in half (bit-rot/partial
+    write after commit — caught by the manifest crc pass);
+    mode='uncommit': delete the commit marker (what a crash before the
+    publish rename leaves if the temp dir were taken at face value);
+    mode='manifest': corrupt the manifest JSON.
+    """
+    from ..checkpoint import COMMIT_MARKER, MANIFEST_NAME
+    path = os.path.join(os.path.abspath(directory), "step_%d" % int(step))
+    if not os.path.isdir(path):
+        raise ChaosError("no checkpoint dir at %s" % path)
+    if mode == "uncommit":
+        os.remove(os.path.join(path, COMMIT_MARKER))
+        return path
+    if mode == "manifest":
+        with open(os.path.join(path, MANIFEST_NAME), "w") as f:
+            f.write("{ torn")
+        return path
+    if mode != "truncate":
+        raise ChaosError("unknown tear mode %r" % mode)
+    largest, size = None, -1
+    for root, _, names in os.walk(path):
+        for name in names:
+            if name in (COMMIT_MARKER, MANIFEST_NAME):
+                continue
+            full = os.path.join(root, name)
+            s = os.path.getsize(full)
+            if s > size:
+                largest, size = full, s
+    if largest is None or size <= 0:
+        raise ChaosError("no data file to truncate under %s" % path)
+    with open(largest, "r+b") as f:
+        f.truncate(max(1, size // 2))
+    return path
+
+
+@contextlib.contextmanager
+def torn_checkpoint_writes(crashes: int = 1):
+    """Crash the next ``crashes`` checkpoint commits at the worst moment:
+    after all data is written, just before the atomic publish rename. The
+    directory must be left as an ignored temp dir — ``steps()``/``restore``
+    never seeing it is exactly the property under test."""
+    from .. import checkpoint as ckpt_mod
+    orig = ckpt_mod._commit_rename
+    state = {"left": int(crashes), "crashed": 0}
+
+    def rename(src, dst):
+        if state["left"] > 0:
+            state["left"] -= 1
+            state["crashed"] += 1
+            raise ChaosError("chaos: process died before commit rename "
+                             "(%s -> %s)" % (src, dst))
+        return orig(src, dst)
+
+    ckpt_mod._commit_rename = rename
+    try:
+        yield state
+    finally:
+        ckpt_mod._commit_rename = orig

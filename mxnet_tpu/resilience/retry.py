@@ -1,0 +1,96 @@
+"""Retry-with-backoff around transiently-failing operations.
+
+The policy layer that gives :class:`~mxnet_tpu.base.TransientKVError` (and
+transient XLA/device errors) a different fate from programming errors:
+retry with exponential backoff + jitter instead of killing the run. Knobs:
+``MXNET_RESILIENCE_RETRY_ATTEMPTS`` / ``_BASE`` / ``_MAX`` (see
+``mxnet_tpu.base.config.describe()``).
+"""
+from __future__ import annotations
+
+import random as _pyrandom
+import time
+from typing import Callable, Iterable, Optional, Tuple, Type
+
+from ..base import MXNetError, TransientKVError, get_env, logger
+
+__all__ = ["retry_transient", "is_transient", "backoff_delay",
+           "backoff_delays"]
+
+# Substrings in an XlaRuntimeError (or generic RuntimeError from the
+# runtime) that mark a transient infrastructure failure rather than a
+# miscompiled/misused program. Mirrors the retryable gRPC status classes.
+_TRANSIENT_MARKERS = ("resource exhausted", "unavailable", "aborted",
+                      "deadline exceeded", "cancelled", "connection reset",
+                      "socket closed", "failed to connect")
+
+
+def is_transient(exc: BaseException) -> bool:
+    """Heuristic: is this exception worth retrying? TransientKVError always;
+    XLA runtime errors only when they carry a retryable status marker."""
+    if isinstance(exc, TransientKVError):
+        return True
+    if isinstance(exc, MXNetError):
+        return False            # typed framework errors are deliberate
+    name = type(exc).__name__
+    if name == "XlaRuntimeError" or isinstance(exc, (OSError, IOError)):
+        msg = str(exc).lower()
+        return any(m in msg for m in _TRANSIENT_MARKERS)
+    return False
+
+
+def backoff_delay(attempt: int, base: float, cap: float,
+                  jitter: float = 0.25) -> float:
+    """Sleep before retry ``attempt + 1``: exponential from ``base``,
+    capped at ``cap``, with multiplicative jitter so peers that failed
+    together don't retry in lockstep. THE backoff policy — kvstore and the
+    step retry both delegate here."""
+    d = min(cap, base * (2.0 ** attempt))
+    if jitter > 0:
+        d *= 1.0 + jitter * _pyrandom.random()
+    return d
+
+
+def backoff_delays(attempts: int, base: float, cap: float,
+                   jitter: float = 0.25) -> Iterable[float]:
+    """The ``attempts - 1`` sleep intervals between ``attempts`` tries."""
+    for i in range(max(0, attempts - 1)):
+        yield backoff_delay(i, base, cap, jitter)
+
+
+def retry_transient(fn: Callable, *, attempts: Optional[int] = None,
+                    base_delay: Optional[float] = None,
+                    max_delay: Optional[float] = None,
+                    retry_on: Optional[Tuple[Type[BaseException], ...]] = None,
+                    on_retry: Optional[Callable] = None,
+                    sleep: Callable[[float], None] = time.sleep):
+    """Call ``fn()``; on a transient failure, back off and retry.
+
+    ``retry_on`` overrides the :func:`is_transient` classifier with an
+    explicit exception allowlist. ``on_retry(attempt_idx, exc, delay)`` is
+    invoked before each sleep (telemetry hook). The final failure is
+    re-raised unchanged.
+    """
+    attempts = int(attempts if attempts is not None
+                   else get_env("MXNET_RESILIENCE_RETRY_ATTEMPTS", 3))
+    base_delay = float(base_delay if base_delay is not None
+                       else get_env("MXNET_RESILIENCE_RETRY_BASE", 0.5))
+    max_delay = float(max_delay if max_delay is not None
+                      else get_env("MXNET_RESILIENCE_RETRY_MAX", 30.0))
+    attempts = max(1, attempts)
+    delays = list(backoff_delays(attempts, base_delay, max_delay))
+    for i in range(attempts):
+        try:
+            return fn()
+        except BaseException as e:  # noqa: BLE001 - reclassified below
+            retryable = (isinstance(e, retry_on) if retry_on is not None
+                         else is_transient(e))
+            if not retryable or i >= attempts - 1:
+                raise
+            delay = delays[i]
+            if on_retry is not None:
+                on_retry(i, e, delay)
+            else:
+                logger.warning("transient failure (attempt %d/%d), retrying "
+                               "in %.2fs: %r", i + 1, attempts, delay, e)
+            sleep(delay)

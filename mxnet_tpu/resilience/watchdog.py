@@ -1,0 +1,114 @@
+"""Step-deadline watchdog: a hung collective fails loud, not silent.
+
+A deadlocked allreduce (one dead peer, the rest blocked in ICI/DCN) is the
+worst TPU failure mode: the job burns pod-hours doing nothing and the only
+symptom is the absence of log lines. The reference's scheduler noticed dead
+workers via ps-lite heartbeats; an XLA collective has no such channel — so
+the watchdog bounds every step from the host side: if a step exceeds its
+deadline, every Python thread's stack is dumped to stderr and the process
+fails loud (``KeyboardInterrupt`` in the main thread by default, or a
+custom ``on_timeout`` — e.g. ``os._exit`` under an orchestrator that
+restarts the job).
+"""
+from __future__ import annotations
+
+import contextlib
+import faulthandler
+import sys
+import threading
+import _thread
+from typing import Callable, Optional
+
+from ..base import logger
+
+__all__ = ["Watchdog"]
+
+
+class Watchdog:
+    """Arm a deadline around each step::
+
+        wd = Watchdog(deadline=120.0)
+        with wd.arm("step 42"):
+            loss = trainer.step(x, y)
+            jax.block_until_ready(loss)   # the deadline must see the hang
+
+    One persistent daemon thread serves every arm; ``fired`` latches True
+    after a timeout. The dispatch-async caveat: XLA returns futures, so the
+    guarded region must synchronize (block_until_ready) or a hang escapes
+    the deadline — ResilientTrainer does this automatically.
+    """
+
+    def __init__(self, deadline: float,
+                 on_timeout: Optional[Callable[[str], None]] = None):
+        if deadline <= 0:
+            raise ValueError("watchdog deadline must be > 0")
+        self.deadline = float(deadline)
+        self.fired = False
+        self._on_timeout = on_timeout
+        self._armed = threading.Event()
+        self._done = threading.Event()
+        self._stop = threading.Event()
+        self._label = ""
+        self._gen = 0
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+
+    def _ensure_thread(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="mxtpu-step-watchdog")
+            self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            if not self._armed.wait(0.1):
+                continue
+            gen = self._gen
+            if self._done.wait(self.deadline):
+                continue        # step finished in time; next arm re-cycles
+            # deadline passed: fire only if still the SAME armed region
+            if self._stop.is_set() or self._done.is_set() or gen != self._gen:
+                continue
+            self.fired = True
+            self._armed.clear()
+            label = self._label
+            sys.stderr.write(
+                "\n=== mxtpu watchdog: %r exceeded its %.1fs deadline — "
+                "dumping all thread stacks ===\n" % (label, self.deadline))
+            sys.stderr.flush()
+            try:
+                faulthandler.dump_traceback(file=sys.stderr)
+            except Exception:   # pragma: no cover - best effort
+                pass
+            logger.error("watchdog fired on %r after %.1fs", label,
+                         self.deadline)
+            if self._on_timeout is not None:
+                self._on_timeout(label)
+            else:
+                # fail loud in the main thread (KeyboardInterrupt at the
+                # next bytecode boundary). A hard-hung C call can't be
+                # interrupted this way — pass on_timeout=lambda _:
+                # os._exit(124) when running under a supervisor.
+                _thread.interrupt_main()
+
+    @contextlib.contextmanager
+    def arm(self, label: str = "step"):
+        with self._lock:
+            self._ensure_thread()
+            self._label = label
+            self._gen += 1
+            self._done.clear()
+            self._armed.set()
+        try:
+            yield self
+        finally:
+            self._done.set()
+            self._armed.clear()
+
+    def close(self) -> None:
+        self._stop.set()
+        self._done.set()
+        self._armed.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=1.0)

@@ -82,6 +82,49 @@ def test_missing_step_raises(tmp_path):
         ckpt.restore(99)
 
 
+def test_atomic_commit_and_latest_step(tmp_path):
+    """Saves publish atomically: a step_N dir without the commit marker
+    (e.g. copied by hand, or an old-layout crash artifact) is invisible to
+    steps()/latest_step() and restore() refuses it."""
+    import os
+    from mxnet_tpu.checkpoint import COMMIT_MARKER
+    ckpt = ShardedCheckpointer(str(tmp_path / "run"))
+    ckpt.save(1, {"w": jnp.ones((4,))})
+    ckpt.save(4, {"w": jnp.ones((4,)) * 4})
+    assert ckpt.latest_step() == 4
+    # fabricate an uncommitted dir
+    os.makedirs(str(tmp_path / "run" / "step_9"))
+    assert ckpt.steps() == [1, 4]
+    assert ckpt.latest_step() == 4
+    with pytest.raises(mx.MXNetError, match="no checkpoint"):
+        ckpt.restore(9)
+    # stripping the marker de-publishes a committed step
+    os.remove(str(tmp_path / "run" / "step_4" / COMMIT_MARKER))
+    assert ckpt.steps() == [1]
+    assert ckpt.latest_step() == 1
+    ckpt.close()
+
+
+def test_manifest_verifies_files(tmp_path):
+    """verify() is the torn-file detector: any size/crc mismatch flips it."""
+    ckpt = ShardedCheckpointer(str(tmp_path / "run"))
+    ckpt.save(0, {"w": jnp.arange(256.0)})
+    assert ckpt.verify(0)
+    man = ckpt.read_manifest(0)
+    assert man["step"] == 0 and man["files"]
+    # truncate the biggest payload file
+    import os
+    target = max((os.path.join(str(tmp_path / "run" / "step_0"), e["path"])
+                  for e in man["files"]),
+                 key=os.path.getsize)
+    with open(target, "r+b") as f:
+        f.truncate(max(1, os.path.getsize(target) // 2))
+    assert not ckpt.verify(0)
+    with pytest.raises(mx.MXNetError, match="torn"):
+        ckpt.restore(0)
+    ckpt.close()
+
+
 def test_restore_like_with_aux(tmp_path):
     """Resharded restore must work on checkpoints that carry aux state —
     missing target keys are filled from the checkpoint's own metadata."""

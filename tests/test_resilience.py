@@ -1,0 +1,609 @@
+"""Fault-tolerance suite (ISSUE: robustness tentpole).
+
+Resume-equivalence is the acceptance bar: train N steps straight vs. train
+k steps -> simulated preemption -> restore -> N-k steps, bitwise-identical
+params on the CPU backend — for the fused AND hybrid-kvstore capture paths,
+remat on and off. The `chaos` marker tags deterministic fault injections
+(mid-step SIGTERM, torn checkpoint writes, NaN gradients, dropped pushes);
+all of them are fast enough for tier-1.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, parallel
+from mxnet_tpu.base import TransientKVError
+from mxnet_tpu.checkpoint import ShardedCheckpointer
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.resilience import (Preempted, ResilientTrainer, Watchdog,
+                                  chaos, install, resilient_fit,
+                                  retry_transient)
+
+
+def _make_net(prefix):
+    """Same seed + same explicit prefix => identical init AND identical
+    parameter names, so a 'restarted process' net maps 1:1 onto the dead
+    run's checkpoint keys."""
+    mx.random.seed(11)
+    net = nn.HybridSequential(prefix=prefix)
+    net.add(nn.Dense(8, activation="relu", prefix=prefix + "d0_"),
+            nn.Dense(3, prefix=prefix + "d1_"))
+    net.initialize(mx.init.Xavier())
+    return net
+
+
+def _batches(n=6, b=16, d=6):
+    rng = np.random.RandomState(42)
+    return [(rng.randn(b, d).astype("f4"),
+             rng.randint(0, 3, (b,)).astype("f4")) for _ in range(n)]
+
+
+def _trainer_kwargs(kv, remat):
+    kw = {"remat": remat}
+    if kv:
+        kw["kvstore"] = mx.kv.create("local")
+    return kw
+
+
+def _params_np(trainer):
+    return {k: np.asarray(v) for k, v in trainer._params.items()}
+
+
+# ------------------------------------------------------------ resume equiv
+@pytest.mark.parametrize("kv,remat", [(False, None), (False, "full"),
+                                      (True, None), (True, "full")],
+                         ids=["fused", "fused-remat", "kv", "kv-remat"])
+def test_resume_equivalence_bitwise(tmp_path, kv, remat):
+    """k steps -> preemption -> restore -> N-k steps == N straight steps,
+    bit for bit (params AND optimizer state drive the trajectory)."""
+    N, k = 6, 3
+    batches = _batches(N)
+    opt, opt_p = "sgd", {"learning_rate": 0.1, "momentum": 0.9}
+    prefix = "req%d%s_" % (int(kv), remat or "n")
+
+    straight = parallel.DataParallelTrainer(
+        _make_net(prefix), gluon.loss.SoftmaxCrossEntropyLoss(), opt, opt_p,
+        **_trainer_kwargs(kv, remat))
+    for x, y in batches:
+        straight.step(x, y)
+    ref = _params_np(straight)
+
+    d = str(tmp_path / "run")
+    rt = ResilientTrainer(
+        _make_net(prefix), gluon.loss.SoftmaxCrossEntropyLoss(), opt, opt_p,
+        directory=d, preemption=False, **_trainer_kwargs(kv, remat))
+    for x, y in batches[:k]:
+        rt.step(x, y)
+    rt.save()            # the final pre-preemption commit
+    rt.close()
+
+    rt2 = ResilientTrainer(
+        _make_net(prefix), gluon.loss.SoftmaxCrossEntropyLoss(), opt, opt_p,
+        directory=d, preemption=False, **_trainer_kwargs(kv, remat))
+    for x, y in batches[k:]:
+        rt2.step(x, y)
+    assert rt2.resumed_from == k
+    got = _params_np(rt2.trainer)
+    assert sorted(got) == sorted(ref)
+    for name in ref:
+        assert np.array_equal(ref[name], got[name]), name
+    rt2.close()
+
+
+@pytest.mark.chaos
+def test_sigterm_mid_run_resumes_bitwise(tmp_path):
+    """A real SIGTERM: the guard latches it, the trainer commits a final
+    sync checkpoint and raises Preempted; a restarted trainer reaches the
+    same params as a run that was never killed."""
+    N = 5
+    batches = _batches(N)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    straight = parallel.DataParallelTrainer(
+        _make_net("sig_"), loss_fn, "sgd", {"learning_rate": 0.1})
+    for x, y in batches:
+        straight.step(x, y)
+    ref = _params_np(straight)
+
+    d = str(tmp_path / "run")
+    guard = install()
+    guard.reset()
+    rt = ResilientTrainer(_make_net("sig_"), loss_fn, "sgd",
+                          {"learning_rate": 0.1}, directory=d)
+    killed_at = None
+    try:
+        for i, (x, y) in enumerate(batches):
+            if i == 2:
+                chaos.sigterm_self()        # mid-run preemption
+            rt.step(x, y)
+        pytest.fail("Preempted was not raised")
+    except Preempted:
+        killed_at = rt.step_count
+    finally:
+        guard.reset()
+    assert killed_at == 3                   # the in-flight step completed
+    assert rt.checkpointer.steps()[-1] == killed_at
+    rt.close()
+
+    rt2 = ResilientTrainer(_make_net("sig_"), loss_fn, "sgd",
+                           {"learning_rate": 0.1}, directory=d,
+                           preemption=False)
+    for x, y in batches[killed_at:]:
+        rt2.step(x, y)
+    got = _params_np(rt2.trainer)
+    for name in ref:
+        assert np.array_equal(ref[name], got[name]), name
+    rt2.close()
+
+
+# --------------------------------------------------------- torn checkpoints
+@pytest.mark.chaos
+def test_torn_write_never_becomes_visible(tmp_path):
+    """A commit crashed before the publish rename leaves only a hidden temp
+    dir: steps()/latest_step never see it, gc() reaps it."""
+    ck = ShardedCheckpointer(str(tmp_path / "run"))
+    ck.save(1, {"w": jnp.ones((4,))})
+    with chaos.torn_checkpoint_writes(1) as st:
+        with pytest.raises(chaos.ChaosError):
+            ck.save(2, {"w": jnp.ones((4,)) * 2})
+    assert st["crashed"] == 1
+    assert ck.steps() == [1]
+    assert ck.latest_step() == 1
+    hidden = [n for n in os.listdir(ck.directory) if n.startswith(".pending")]
+    assert hidden
+    ck.gc()
+    assert not [n for n in os.listdir(ck.directory)
+                if n.startswith(".pending")]
+    ck.close()
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("mode", ["truncate", "manifest", "uncommit"])
+def test_torn_checkpoint_rejected_and_skipped(tmp_path, mode):
+    """Chaos-damage a committed step_N: restore refuses it, steps()/
+    latest_step skip uncommitted dirs, and auto-resume falls back to the
+    newest intact step instead."""
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    d = str(tmp_path / "run")
+    batches = _batches(4)
+    rt = ResilientTrainer(_make_net("torn%s_" % mode[0]), loss_fn, "sgd",
+                          {"learning_rate": 0.1}, directory=d,
+                          preemption=False)
+    for i, (x, y) in enumerate(batches):
+        rt.step(x, y)
+        if i in (1, 3):
+            rt.save()
+    rt.close()
+    ck = ShardedCheckpointer(d)
+    assert ck.steps() == [2, 4]
+
+    chaos.tear_checkpoint(d, 4, mode=mode)
+    if mode == "uncommit":
+        assert ck.steps() == [2]            # vanishes from the listing
+        assert ck.latest_step() == 2
+    else:
+        assert not ck.verify(4)
+        with pytest.raises(mx.MXNetError, match="torn|no checkpoint"):
+            ck.restore(4)
+    ck.close()
+
+    rt2 = ResilientTrainer(_make_net("torn%s_" % mode[0]), loss_fn, "sgd",
+                           {"learning_rate": 0.1}, directory=d,
+                           preemption=False)
+    x, y = batches[0]
+    rt2.step(x, y)
+    assert rt2.resumed_from == 2            # fell back past the torn step
+    rt2.close()
+
+
+def test_save_overwrite_joins_inflight_async(tmp_path):
+    """save(overwrite=True) of a step whose async save is still in flight
+    must join that save first, not race it."""
+    ck = ShardedCheckpointer(str(tmp_path / "run"))
+    ck.save(7, {"w": jnp.full((64, 64), 1.0)}, async_save=True)
+    ck.save(7, {"w": jnp.full((64, 64), 2.0)})      # joins, then overwrites
+    assert ck.steps() == [7]
+    assert ck.verify(7)
+    out = ck.restore(7)
+    np.testing.assert_allclose(np.asarray(out["w"]), 2.0)
+    ck.close()
+
+
+def test_close_always_joins_async(tmp_path):
+    """close() without an explicit wait_until_finished still commits the
+    in-flight async save."""
+    ck = ShardedCheckpointer(str(tmp_path / "run"))
+    ck.save(3, {"w": jnp.ones((32, 32))}, async_save=True)
+    ck.close()
+    ck2 = ShardedCheckpointer(str(tmp_path / "run"))
+    assert ck2.steps() == [3]
+    assert ck2.verify(3)
+    ck2.close()
+
+
+def test_next_save_commits_prior_async(tmp_path):
+    """The hard-kill loss window is ONE save interval: starting save N+1
+    publishes async save N, without an explicit wait_until_finished."""
+    ck = ShardedCheckpointer(str(tmp_path / "run"))
+    ck.save(1, {"w": jnp.ones((16, 16))}, async_save=True)
+    ck.save(2, {"w": jnp.ones((16, 16)) * 2}, async_save=True)
+    # a second checkpointer sees only what is COMMITTED on disk — step 1
+    # must already be published even though this one never joined
+    other = ShardedCheckpointer(str(tmp_path / "run"))
+    assert 1 in other.steps()
+    other.close()
+    ck.close()
+
+
+def test_adopt_uncommitted_checkpoint(tmp_path):
+    """Pre-atomic-layout dirs (no marker) are untrusted until explicitly
+    adopted; adopt() commits them in place."""
+    import os
+    from mxnet_tpu.checkpoint import COMMIT_MARKER, MANIFEST_NAME
+    ck = ShardedCheckpointer(str(tmp_path / "run"))
+    ck.save(5, {"w": jnp.arange(8.0)})
+    # strip the commit metadata: what an old-layout checkpoint looks like
+    os.remove(str(tmp_path / "run" / "step_5" / COMMIT_MARKER))
+    os.remove(str(tmp_path / "run" / "step_5" / MANIFEST_NAME))
+    assert ck.steps() == []
+    with pytest.raises(mx.MXNetError, match="no checkpoint"):
+        ck.restore(5)
+    ck.adopt(5)
+    assert ck.steps() == [5] and ck.verify(5)
+    np.testing.assert_allclose(np.asarray(ck.restore(5)["w"]),
+                               np.arange(8.0))
+    assert ck.read_manifest(5)["user"]["adopted"] is True
+    ck.close()
+
+
+def test_preemption_guard_refcounted_release():
+    """acquire/release pair: the last release restores the previous SIGTERM
+    disposition instead of leaving a latch nobody polls."""
+    import signal
+    from mxnet_tpu.resilience import preemption
+    # normalize whatever earlier tests left installed
+    while preemption._refcount > 0:
+        preemption.release()
+    if preemption.current() is not None:
+        preemption.current().uninstall()
+        preemption._current = None
+    before = signal.getsignal(signal.SIGTERM)
+    g1 = preemption.acquire()
+    g2 = preemption.acquire()
+    assert g1 is g2
+    assert signal.getsignal(signal.SIGTERM) != before
+    preemption.release()
+    assert signal.getsignal(signal.SIGTERM) != before   # still held by g1
+    preemption.release()
+    assert signal.getsignal(signal.SIGTERM) == before
+    assert preemption.current() is None
+
+
+def test_ensure_initialized_resumes_without_stepping(tmp_path):
+    """Eager resume: a restarted process whose checkpoint already hit the
+    target must see the restored step_count BEFORE running any step (a
+    kill between the final save and process exit must not overshoot)."""
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    d = str(tmp_path / "run")
+    x, y = _batches(1)[0]
+    rt = ResilientTrainer(_make_net("ei_"), loss_fn, "sgd",
+                          {"learning_rate": 0.1}, directory=d,
+                          preemption=False)
+    for _ in range(3):
+        rt.step(x, y)
+    rt.save()
+    ref = _params_np(rt.trainer)
+    rt.close()
+
+    rt2 = ResilientTrainer(_make_net("ei_"), loss_fn, "sgd",
+                           {"learning_rate": 0.1}, directory=d,
+                           preemption=False)
+    rt2.ensure_initialized(x, y)
+    assert rt2.step_count == 3 and rt2.resumed_from == 3
+    got = _params_np(rt2.trainer)        # no step ran: params unchanged
+    for name in ref:
+        assert np.array_equal(ref[name], got[name]), name
+    rt2.close()
+
+
+def test_publish_retry_propagates_programming_errors():
+    """A deterministic error inside publish must raise as-is immediately —
+    not spin through backoff nor get typed transient."""
+    kv = mx.kv.create("dist_sync")
+    kv.init("w2", mx.nd.ones((2,)))
+    calls = []
+
+    class BuggyClient:
+        def key_value_set_bytes(self, *a, **kw):
+            calls.append(1)
+            raise TypeError("bad argument wiring")
+
+    with pytest.raises(TypeError, match="bad argument wiring"):
+        kv._publish_weight_retry(BuggyClient(), "w2")
+    assert len(calls) == 1                  # no retries for a TypeError
+
+
+def test_overwrite_false_raises_only_for_committed(tmp_path):
+    ck = ShardedCheckpointer(str(tmp_path / "run"))
+    ck.save(1, {"w": jnp.ones((2,))})
+    with pytest.raises(mx.MXNetError, match="already exists"):
+        ck.save(1, {"w": jnp.ones((2,))}, overwrite=False)
+    ck.close()
+
+
+def test_resume_manifest_contents(tmp_path):
+    """The resume manifest records step, rng counter, seed and the AOT
+    cache key of the executable the run was using."""
+    d = str(tmp_path / "run")
+    rt = ResilientTrainer(_make_net("man_"),
+                          gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+                          {"learning_rate": 0.1}, directory=d,
+                          preemption=False)
+    x, y = _batches(1)[0]
+    rt.step(x, y)
+    step = rt.save()
+    man = rt.checkpointer.read_manifest(step)
+    user = man["user"]
+    assert user["step"] == 1 and user["rng_counter"] == 1
+    assert user["seed"] == mx.random.current_seed()
+    assert user["aot_key"]["in_shapes"] == [list(x.shape) + [str(x.dtype)],
+                                            list(y.shape) + [str(y.dtype)]]
+    assert "optimizer" in user["aot_key"]
+    assert all(ent["crc32"] >= 0 for ent in man["files"])
+    rt.close()
+
+
+# ------------------------------------------------------------- grad guard
+@pytest.mark.chaos
+def test_grad_guard_skips_nan_fused():
+    """A NaN batch on the fused path: params/opt state unchanged, skip
+    counted, Monitor surfaces the counters."""
+    t = parallel.DataParallelTrainer(
+        _make_net("gg1_"), gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.1}, grad_guard=True)
+    x, y = _batches(1)[0]
+    for _ in range(2):
+        t.step(x, y)
+    before = _params_np(t)
+    t.step(chaos.nan_batch(x), y)
+    after = _params_np(t)
+    for name in before:
+        assert np.array_equal(before[name], after[name]), name
+    stats = t.anomaly_stats()
+    assert stats["grad_skipped_steps"] == 1 and stats["last_step_skipped"]
+    # healthy step resumes updating
+    t.step(x, y)
+    assert not t.anomaly_stats()["last_step_skipped"]
+
+    mon = mx.monitor.Monitor(1)
+    mon.install_trainer(t)
+    mon.tic()
+    t.step(x, y)
+    names = [k for _, k, _ in mon.toc()]
+    assert "grad_skipped_steps" in names and "grad_norm_ema" in names
+
+
+@pytest.mark.chaos
+def test_grad_guard_skips_nan_kv_path():
+    """chaos.nan_gradients poisons the hybrid path's synced grads; the
+    jitted apply must skip the update."""
+    t = parallel.DataParallelTrainer(
+        _make_net("gg2_"), gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.1}, kvstore=mx.kv.create("local"),
+        grad_guard=True)
+    x, y = _batches(1)[0]
+    t.step(x, y)
+    before = _params_np(t)
+    with chaos.nan_gradients(t) as st:
+        t.step(x, y)
+    assert st["poisoned"] == 1
+    after = _params_np(t)
+    for name in before:
+        assert np.array_equal(before[name], after[name]), name
+    assert t.anomaly_stats()["grad_skipped_steps"] == 1
+
+
+def test_grad_guard_spike_detection():
+    """A gradient-norm spike past spike_factor x EMA is skipped after
+    warmup."""
+    t = parallel.DataParallelTrainer(
+        _make_net("gg3_"), gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.01},
+        grad_guard={"spike_factor": 5.0, "warmup": 2})
+    x, y = _batches(1)[0]
+    for _ in range(3):
+        t.step(x, y)
+    assert t.anomaly_stats()["grad_skipped_steps"] == 0
+    before = _params_np(t)
+    t.step(x * 1e6, y)                      # blows up the grad norm
+    after = _params_np(t)
+    assert t.anomaly_stats()["grad_skipped_steps"] == 1
+    for name in before:
+        assert np.array_equal(before[name], after[name]), name
+
+
+def test_guard_off_keeps_plain_signature_trajectory():
+    """grad_guard=None must not perturb numerics (the bitwise contract all
+    existing training tests rely on)."""
+    def run(guard):
+        t = parallel.DataParallelTrainer(
+            _make_net("gg4%d_" % bool(guard)),
+            gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+            {"learning_rate": 0.1}, grad_guard=guard)
+        for x, y in _batches(3):
+            t.step(x, y)
+        return _params_np(t)
+
+    a, b = run(None), run(True)
+    for (ka, va), (kb, vb) in zip(sorted(a.items()), sorted(b.items())):
+        assert np.array_equal(va, vb), (ka, kb)
+
+
+# --------------------------------------------------------------- kv chaos
+@pytest.mark.chaos
+def test_dropped_push_loses_gradient(tmp_path):
+    """A dropped push is simply absent from the reduce — the store value
+    stays put (the async gap-skip semantics pushers must tolerate)."""
+    kv = mx.kv.create("local")
+    kv.init("w", mx.nd.zeros((4,)))
+    with chaos.dropped_pushes(kv, drop=1) as st:
+        kv.push("w", mx.nd.ones((4,)))      # dropped on the floor
+    assert st["dropped"] == 1
+    out = mx.nd.zeros((4,))
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(), 0.0)
+    kv.push("w", mx.nd.ones((4,)))          # next push lands
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(), 1.0)
+
+
+@pytest.mark.chaos
+def test_kill_heartbeat_detected():
+    """Killing the heartbeat thread is detectable (join dead), and stores
+    without a heartbeat role refuse the injection."""
+    import threading
+    kv = mx.kv.create("local")
+    with pytest.raises(chaos.ChaosError):
+        chaos.kill_heartbeat(kv)
+
+    class FakeDist:
+        pass
+
+    fake = FakeDist()
+    fake._hb_stop = threading.Event()
+    fake._hb_thread = threading.Thread(
+        target=fake._hb_stop.wait, daemon=True)
+    fake._hb_thread.start()
+    chaos.kill_heartbeat(fake)
+    assert not fake._hb_thread.is_alive()
+
+
+def test_publish_weight_retry_typed_error(monkeypatch):
+    """Exhausted publish retries raise TransientKVError and honor the
+    MXNET_KV_RETRY_* knobs."""
+    monkeypatch.setenv("MXNET_KV_RETRY_ATTEMPTS", "3")
+    monkeypatch.setenv("MXNET_KV_RETRY_BASE", "0.001")
+    monkeypatch.setenv("MXNET_KV_RETRY_JITTER", "0")
+    kv = mx.kv.create("dist_sync")          # single-process dist store
+    kv.init("w", mx.nd.ones((2,)))
+    calls = []
+
+    class DeadClient:
+        def key_value_set_bytes(self, *a, **kw):
+            calls.append(1)
+            raise RuntimeError("coordination service unreachable")
+
+    with pytest.raises(TransientKVError, match="after 3 attempts"):
+        kv._publish_weight_retry(DeadClient(), "w")
+    assert len(calls) == 3
+    assert isinstance(TransientKVError("x"), mx.MXNetError)
+
+
+def test_retry_transient_backoff_schedule():
+    """retry_transient: transient errors back off exponentially and
+    eventually succeed; deliberate errors raise immediately."""
+    sleeps = []
+    state = {"n": 0}
+
+    def flaky():
+        state["n"] += 1
+        if state["n"] < 3:
+            raise TransientKVError("flake")
+        return "ok"
+
+    out = retry_transient(flaky, attempts=4, base_delay=0.01, max_delay=1.0,
+                          sleep=sleeps.append)
+    assert out == "ok" and state["n"] == 3
+    assert len(sleeps) == 2 and sleeps[1] > sleeps[0] * 1.2
+
+    def fatal():
+        raise mx.MXNetError("programming error")
+
+    sleeps.clear()
+    with pytest.raises(mx.MXNetError, match="programming error"):
+        retry_transient(fatal, attempts=5, base_delay=0.01,
+                        sleep=sleeps.append)
+    assert sleeps == []                     # no retry for typed MXNetError
+
+
+# ---------------------------------------------------------------- watchdog
+def test_watchdog_fires_and_labels():
+    import time
+    fired = []
+    wd = Watchdog(0.2, on_timeout=fired.append)
+    with wd.arm("hung step"):
+        time.sleep(0.7)
+    assert wd.fired and fired == ["hung step"]
+    wd.close()
+
+
+def test_watchdog_quiet_on_fast_steps():
+    fired = []
+    wd = Watchdog(5.0, on_timeout=fired.append)
+    for i in range(3):
+        with wd.arm("step %d" % i):
+            pass
+    assert not wd.fired and fired == []
+    wd.close()
+
+
+# -------------------------------------------------------------- Module.fit
+@pytest.mark.chaos
+def test_resilient_fit_epoch_resume(tmp_path):
+    """Module.fit path: SIGTERM mid-epoch -> Preempted at a batch boundary;
+    restart resumes from the last committed epoch and finishes with params
+    identical to an uninterrupted run (plain SGD is stateless, so
+    epoch-granular resume is exact)."""
+    from mxnet_tpu import sym
+    from mxnet_tpu.io import NDArrayIter
+    from mxnet_tpu.module import Module
+
+    def mlp():
+        data = sym.Variable("data")
+        fc1 = sym.FullyConnected(data, num_hidden=8, name="fc1")
+        act = sym.Activation(fc1, act_type="relu")
+        fc2 = sym.FullyConnected(act, num_hidden=3, name="fc2")
+        return sym.SoftmaxOutput(fc2, sym.Variable("softmax_label"),
+                                 name="softmax")
+
+    rng = np.random.RandomState(7)
+    x = rng.randn(48, 6).astype("f4")
+    y = rng.randint(0, 3, (48,)).astype("f4")
+    fitkw = dict(optimizer="sgd",
+                 optimizer_params={"learning_rate": 0.1},
+                 initializer=mx.init.Xavier(), kvstore=None)
+
+    mx.random.seed(5)
+    ref_mod = Module(mlp(), context=mx.cpu())
+    ref_mod.fit(NDArrayIter(x, y, batch_size=16), num_epoch=4, **fitkw)
+    ref = {k: v.asnumpy() for k, v in ref_mod.get_params()[0].items()}
+
+    guard = install()
+    guard.reset()
+    d = str(tmp_path / "fit")
+    mx.random.seed(5)
+    mod = Module(mlp(), context=mx.cpu())
+    stop = {"after": 2}
+
+    def tick(param):
+        if param.epoch == 2 and param.nbatch == stop["after"]:
+            guard.trigger()                 # SIGTERM equivalent mid-epoch
+
+    try:
+        with pytest.raises(Preempted):
+            resilient_fit(mod, NDArrayIter(x, y, batch_size=16), d,
+                          num_epoch=4, batch_end_callback=tick, **fitkw)
+    finally:
+        guard.reset()
+
+    mx.random.seed(5)
+    mod2 = Module(mlp(), context=mx.cpu())
+    resilient_fit(mod2, NDArrayIter(x, y, batch_size=16), d, num_epoch=4,
+                  **fitkw)
+    got = {k: v.asnumpy() for k, v in mod2.get_params()[0].items()}
+    for name in ref:
+        np.testing.assert_array_equal(ref[name], got[name], err_msg=name)

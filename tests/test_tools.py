@@ -73,6 +73,43 @@ def test_launch_local_runs_n_processes(tmp_path):
     assert (out / "1").read_text() == "3"
 
 
+def test_crashloop_cli_parses_and_completes(tmp_path):
+    """crashloop runs a trivially-succeeding command to completion and
+    relays its digest line."""
+    import crashloop
+    script = tmp_path / "ok.py"
+    script.write_text("print('FINAL_PARAM_DIGEST=abc123')\n")
+    rc = crashloop.main(["--interval", "30", "--max-restarts", "2",
+                         "--expect-digest", "abc123", "--",
+                         sys.executable, str(script)])
+    assert rc == 0
+    rc = crashloop.main(["--interval", "30", "--max-restarts", "0",
+                         "--expect-digest", "different", "--",
+                         sys.executable, str(script)])
+    assert rc == 3          # digest mismatch is a recovery bug
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_crashloop_kills_and_recovers_example(tmp_path):
+    """End-to-end recovery: the resilient example, SIGTERM'd repeatedly,
+    still completes and reaches the uninterrupted run's exact digest."""
+    import crashloop
+    example = os.path.join(REPO, "example", "resilient_training.py")
+    # uninterrupted reference digest
+    p = subprocess.run([sys.executable, example, "--ckpt-dir",
+                        str(tmp_path / "ref"), "--steps", "25"],
+                       capture_output=True, text=True, timeout=300)
+    assert p.returncode == 0, p.stdout + p.stderr
+    digest = [l for l in p.stdout.splitlines()
+              if l.startswith("FINAL_PARAM_DIGEST=")][0].split("=", 1)[1]
+    rc = crashloop.main(["--interval", "6", "--max-restarts", "20",
+                         "--expect-digest", digest, "--",
+                         sys.executable, example, "--ckpt-dir",
+                         str(tmp_path / "run"), "--steps", "25"])
+    assert rc == 0
+
+
 def test_diagnose_runs():
     p = subprocess.run([sys.executable, os.path.join(REPO, "tools",
                                                      "diagnose.py")],

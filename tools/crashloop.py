@@ -1,0 +1,128 @@
+"""crashloop — run a command under repeated kill/restart to reproduce
+recovery bugs locally.
+
+The harness behind the resilience acceptance bar: launch the target, kill
+it after ``--interval`` seconds (SIGTERM by default, so the preemption
+guard gets its grace window; ``--signal KILL`` for the no-grace case),
+restart, repeat — until the target exits 0 on its own or ``--max-restarts``
+is hit.
+
+    python tools/crashloop.py --interval 2.0 -- \
+        python example/resilient_training.py --ckpt-dir /tmp/resilient_run
+
+If the target prints ``FINAL_PARAM_DIGEST=...`` on success, crashloop
+echoes it — run once with an interval longer than the job to get the
+uninterrupted digest, then compare: identical digests prove the resume
+path is bitwise-faithful under any kill schedule.
+"""
+from __future__ import annotations
+
+import argparse
+import signal
+import subprocess
+import sys
+import time
+
+DIGEST_PREFIX = "FINAL_PARAM_DIGEST="
+
+
+def run_once(cmd, kill_after, sig, grace):
+    """Run cmd; kill it after kill_after seconds. Returns (exited, rc,
+    digest): exited=False means we killed it."""
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+    deadline = time.time() + kill_after
+    lines = []
+    digest = None
+    import threading
+
+    def pump():
+        for line in proc.stdout:
+            lines.append(line)
+            sys.stdout.write(line)
+            sys.stdout.flush()
+
+    t = threading.Thread(target=pump, daemon=True)
+    t.start()
+    while True:
+        rc = proc.poll()
+        if rc is not None:
+            t.join(timeout=5)
+            for line in lines:
+                if line.startswith(DIGEST_PREFIX):
+                    digest = line.strip()[len(DIGEST_PREFIX):]
+            return True, rc, digest
+        if time.time() >= deadline:
+            print("crashloop: sending %s to pid %d"
+                  % (sig.name, proc.pid), flush=True)
+            proc.send_signal(sig)
+            try:
+                proc.wait(timeout=grace)
+            except subprocess.TimeoutExpired:
+                print("crashloop: no exit after %.1fs grace — SIGKILL"
+                      % grace, flush=True)
+                proc.kill()
+                proc.wait()
+            t.join(timeout=5)
+            return False, proc.returncode, None
+        time.sleep(0.05)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--interval", type=float, default=3.0,
+                    help="seconds to let the target run before killing it")
+    ap.add_argument("--signal", default="TERM", choices=["TERM", "KILL"],
+                    help="kill signal (TERM exercises the preemption "
+                         "guard's graceful save; KILL the crash path)")
+    ap.add_argument("--grace", type=float, default=30.0,
+                    help="seconds to wait for a clean exit after SIGTERM "
+                         "before escalating to SIGKILL")
+    ap.add_argument("--max-restarts", type=int, default=50)
+    ap.add_argument("--expect-digest", default=None,
+                    help="fail unless the final FINAL_PARAM_DIGEST matches")
+    ap.add_argument("cmd", nargs=argparse.REMAINDER,
+                    help="-- command to run")
+    args = ap.parse_args(argv)
+    cmd = args.cmd
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        ap.error("no command given (put it after --)")
+    sig = signal.SIGTERM if args.signal == "TERM" else signal.SIGKILL
+
+    for attempt in range(args.max_restarts + 1):
+        print("crashloop: attempt %d/%d" % (attempt + 1,
+                                            args.max_restarts + 1),
+              flush=True)
+        exited, rc, digest = run_once(cmd, args.interval, sig, args.grace)
+        if exited and rc == 0 and digest is None \
+                and sig is signal.SIGTERM and attempt < args.max_restarts:
+            # a graceful preemption exit is ALSO rc 0 (by design) but has
+            # no final digest: the job is not done yet — restart it
+            continue
+        if exited:
+            if rc != 0:
+                print("crashloop: target exited rc=%d — a recovery bug "
+                      "(it should resume, not fail)" % rc, flush=True)
+                return rc
+            print("crashloop: target completed after %d restart(s)"
+                  % attempt, flush=True)
+            if digest is not None:
+                print("crashloop: %s%s" % (DIGEST_PREFIX, digest),
+                      flush=True)
+                if args.expect_digest and digest != args.expect_digest:
+                    print("crashloop: DIGEST MISMATCH (expected %s) — the "
+                          "resumed trajectory diverged"
+                          % args.expect_digest, flush=True)
+                    return 3
+            return 0
+    print("crashloop: target never completed within %d restarts"
+          % args.max_restarts, flush=True)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

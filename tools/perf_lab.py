@@ -206,7 +206,7 @@ def main():
     try:
         lowered = trainer._step_fn.lower(
             trainer._params, trainer._aux, trainer._opt_state,
-            jax.random.PRNGKey(0), xd, yd)
+            trainer._guard_state, jax.random.PRNGKey(0), xd, yd)
         txt = lowered.compile().as_text()
         with open("/tmp/perf_lab_hlo.txt", "w") as f:
             f.write(txt)
