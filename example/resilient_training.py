@@ -44,6 +44,23 @@ def make_net():
     return net
 
 
+def make_lint_spec():
+    """mxlint trace target — lints the exact fused data-parallel step this
+    example trains with (ResilientTrainer wraps DataParallelTrainer)::
+
+        python tools/mxlint.py trace example/resilient_training.py:make_lint_spec
+    """
+    from mxnet_tpu.parallel import DataParallelTrainer
+    trainer = DataParallelTrainer(
+        make_net(), gluon.loss.SoftmaxCrossEntropyLoss(),
+        "sgd", {"learning_rate": 0.1, "momentum": 0.9}, grad_guard=True)
+    rng = np.random.RandomState(0)
+    x = rng.randn(64, 20).astype("float32")
+    y = (x @ rng.randn(20, 10).astype("float32")).argmax(axis=1) \
+        .astype("float32")
+    return {"trainer": trainer, "data": (x, y)}
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--ckpt-dir", required=True)

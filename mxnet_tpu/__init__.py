@@ -81,6 +81,7 @@ _lazy = {
     "checkpoint": ".checkpoint",
     "gradient_compression": ".gradient_compression",
     "resilience": ".resilience",
+    "analysis": ".analysis",
 }
 
 

@@ -1,0 +1,24 @@
+"""mxlint — static graph & trace analysis for TPU correctness/perf hazards.
+
+Two front ends over one diagnostic core:
+
+* :func:`lint_symbol` / :func:`lint_symbol_json` — walk a Symbol/CachedOp
+  graph (shape+dtype abstract eval, registry cross-check) before it binds.
+* :func:`lint_step` / :func:`lint_trainer` — abstract-eval a trainer step
+  function the way jit will see it, plus source/closure inspection for the
+  hazards a jaxpr can't show (host syncs, retrace triggers).
+
+Findings are :class:`Diagnostic` records in a :class:`Report` (text / JSON /
+``assert_clean`` for pytest). ``tools/mxlint.py`` is the CLI. Rule catalog:
+``docs/static_analysis.md``.
+
+    from mxnet_tpu import analysis
+    analysis.lint_symbol(net_sym, shapes={"data": (64, 3, 224, 224)})
+    analysis.lint_step(train_step, (params, batch)).assert_clean()
+"""
+from .diagnostics import Diagnostic, Report, RuleDef, RULES, Severity
+from .graph_lint import lint_symbol, lint_symbol_json
+from .trace_lint import lint_step, lint_trainer
+
+__all__ = ["Diagnostic", "Report", "RuleDef", "RULES", "Severity",
+           "lint_symbol", "lint_symbol_json", "lint_step", "lint_trainer"]

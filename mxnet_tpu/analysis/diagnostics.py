@@ -1,0 +1,200 @@
+"""Diagnostic core shared by mxlint's two front ends (graph & trace).
+
+A finding is a :class:`Diagnostic` — rule id, severity, human message,
+location, fix hint — and a lint run returns a :class:`Report` that renders
+as text or JSON, filters by severity, honors suppressions, and asserts
+cleanliness inside pytest. The structure deliberately mirrors what NNVM's
+pass manager surfaces as CHECK failures in the reference
+(``infer_graph_attr_pass.cc``), except findings are *data*, not aborts:
+every later perf PR can regression-test against rule ids.
+
+Severity contract (what the CLI exit code keys off):
+
+* ``error``   — will run wrong or unacceptably slow on TPU; CI should fail.
+* ``warning`` — likely perf hazard / footgun; surfaced, does not fail CI
+  unless ``--fail-on warning``.
+* ``info``    — advisory.
+
+Suppression: every rule can be silenced per-site with a source comment
+``# mxlint: disable=MXL-Txxx[,MXL-Tyyy]`` on the flagged line (or on the
+``def`` line for whole-function findings), or per-run via the
+``suppress=(...)`` argument / ``--suppress`` CLI flag.
+"""
+from __future__ import annotations
+
+import json
+import re
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Sequence, Tuple
+
+__all__ = ["Severity", "Diagnostic", "Report", "RuleDef", "RULES",
+           "register_rule", "parse_disable_comment"]
+
+# ordered severities, lowest first
+_SEVERITY_ORDER = ("info", "warning", "error")
+
+
+class Severity:
+    INFO = "info"
+    WARNING = "warning"
+    ERROR = "error"
+
+    @staticmethod
+    def rank(sev: str) -> int:
+        return _SEVERITY_ORDER.index(sev)
+
+
+@dataclass(frozen=True)
+class RuleDef:
+    """One lint rule in the catalog. docs/static_analysis.md mirrors this
+    registry by hand; tests/test_mxlint.py cross-checks ids and severities
+    against the doc so they cannot drift."""
+    rule_id: str
+    severity: str
+    title: str
+    doc: str
+
+
+RULES: Dict[str, RuleDef] = {}
+
+
+def register_rule(rule_id: str, severity: str, title: str, doc: str) -> RuleDef:
+    rd = RuleDef(rule_id, severity, title, doc)
+    RULES[rule_id] = rd
+    return rd
+
+
+@dataclass
+class Diagnostic:
+    rule_id: str
+    message: str
+    #: where: op/node name for graph findings, ``file:line`` for trace ones
+    location: str = ""
+    hint: str = ""
+    #: severity defaults to the rule's registered severity
+    severity: str = ""
+
+    def __post_init__(self):
+        if not self.severity:
+            rd = RULES.get(self.rule_id)
+            self.severity = rd.severity if rd else Severity.WARNING
+
+    def to_dict(self) -> Dict[str, str]:
+        return {"rule": self.rule_id, "severity": self.severity,
+                "message": self.message, "location": self.location,
+                "hint": self.hint}
+
+    def render(self) -> str:
+        loc = f" [{self.location}]" if self.location else ""
+        hint = f"\n      hint: {self.hint}" if self.hint else ""
+        return f"{self.severity.upper():7s} {self.rule_id}{loc}: " \
+               f"{self.message}{hint}"
+
+
+_DISABLE_RE = re.compile(r"#\s*mxlint:\s*disable=([A-Za-z0-9_,\-\s]+)")
+
+
+def parse_disable_comment(line: str) -> Tuple[str, ...]:
+    """Rule ids suppressed by an inline ``# mxlint: disable=...`` comment
+    (``all`` silences every rule on that line)."""
+    m = _DISABLE_RE.search(line)
+    if not m:
+        return ()
+    return tuple(t.strip() for t in m.group(1).split(",") if t.strip())
+
+
+class Report:
+    """Ordered collection of findings from one lint run."""
+
+    def __init__(self, subject: str = "", front_end: str = ""):
+        self.subject = subject
+        self.front_end = front_end
+        self.findings: List[Diagnostic] = []
+        self._suppressed: List[Diagnostic] = []
+        self._suppress_ids: set = set()
+
+    # ------------------------------------------------------------- building
+    def set_suppressions(self, rule_ids: Iterable[str]) -> "Report":
+        self._suppress_ids = {r.strip() for r in rule_ids if r and r.strip()}
+        return self
+
+    def add(self, diag: Diagnostic, inline_disables: Sequence[str] = ()) -> None:
+        if diag.rule_id in self._suppress_ids or "all" in self._suppress_ids \
+                or diag.rule_id in inline_disables or "all" in inline_disables:
+            self._suppressed.append(diag)
+        else:
+            self.findings.append(diag)
+
+    # ------------------------------------------------------------- querying
+    def __len__(self):
+        return len(self.findings)
+
+    def __iter__(self):
+        return iter(self.findings)
+
+    def by_rule(self, rule_id: str) -> List[Diagnostic]:
+        return [d for d in self.findings if d.rule_id == rule_id]
+
+    def at_least(self, severity: str) -> List[Diagnostic]:
+        r = Severity.rank(severity)
+        return [d for d in self.findings if Severity.rank(d.severity) >= r]
+
+    @property
+    def errors(self) -> List[Diagnostic]:
+        return self.at_least(Severity.ERROR)
+
+    @property
+    def warnings(self) -> List[Diagnostic]:
+        return [d for d in self.findings if d.severity == Severity.WARNING]
+
+    @property
+    def suppressed(self) -> List[Diagnostic]:
+        return list(self._suppressed)
+
+    def ok(self, fail_on: str = Severity.ERROR) -> bool:
+        return not self.at_least(fail_on)
+
+    # ------------------------------------------------------------ rendering
+    def to_text(self) -> str:
+        head = f"mxlint ({self.front_end or 'lint'}): {self.subject}"
+        if not self.findings:
+            body = "  clean — no findings"
+            if self._suppressed:
+                body += f" ({len(self._suppressed)} suppressed)"
+            return f"{head}\n{body}"
+        lines = [head]
+        order = sorted(self.findings,
+                       key=lambda d: -Severity.rank(d.severity))
+        lines += ["  " + d.render() for d in order]
+        n_err = len(self.errors)
+        lines.append(f"  {len(self.findings)} finding(s): {n_err} error(s), "
+                     f"{len(self.warnings)} warning(s)"
+                     + (f", {len(self._suppressed)} suppressed"
+                        if self._suppressed else ""))
+        return "\n".join(lines)
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "subject": self.subject,
+            "front_end": self.front_end,
+            "findings": [d.to_dict() for d in self.findings],
+            "suppressed": [d.to_dict() for d in self._suppressed],
+            "summary": {"errors": len(self.errors),
+                        "warnings": len(self.warnings),
+                        "total": len(self.findings)},
+        }, indent=2)
+
+    # ------------------------------------------------------------- pytest
+    def assert_clean(self, fail_on: str = Severity.ERROR) -> None:
+        """Raise AssertionError (with the rendered report) if any finding at
+        or above ``fail_on`` severity survived suppression — the pytest
+        front door, e.g. ``lint_step(step, args).assert_clean()``."""
+        bad = self.at_least(fail_on)
+        if bad:
+            raise AssertionError(
+                f"mxlint found {len(bad)} finding(s) at severity >= "
+                f"{fail_on}:\n{self.to_text()}")
+
+    def __repr__(self):
+        return (f"<Report {self.subject!r}: {len(self.findings)} finding(s), "
+                f"{len(self.errors)} error(s)>")

@@ -1,0 +1,317 @@
+"""Graph lint — static analysis over Symbol / CachedOp graphs.
+
+The reference validates graphs with NNVM passes (shape/dtype inference,
+``infer_graph_attr_pass.cc:325``) that hard-abort on the first violation.
+Here the same walk runs as a *linter*: every node is abstract-evaluated with
+``jax.eval_shape`` (dtype-tracking, unlike ``Symbol.infer_shape`` which only
+carries shapes), and violations accumulate as diagnostics instead of
+aborting, so one run reports every hazard in the graph.
+
+Rules (catalog in docs/static_analysis.md):
+
+* MXL-G100 infer-failure       (error)   node fails abstract evaluation
+* MXL-G101 float64-creep       (error)   node widens to float64 on TPU
+* MXL-G102 unregistered-op     (error)   op has no TPU lowering in the registry
+* MXL-G103 host-op             (warning) host=True op inside a jitted graph
+* MXL-G104 dangling-input      (error)   graph input whose shape can't be
+                                         inferred or bound
+* MXL-G105 unused-input        (warning) provided binding not consumed
+* MXL-G106 dead-subgraph       (warning) saved-graph nodes unreachable from
+                                         any head
+"""
+from __future__ import annotations
+
+import ast
+import json
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError
+from .diagnostics import Diagnostic, Report, register_rule
+
+__all__ = ["lint_symbol", "lint_symbol_json"]
+
+register_rule(
+    "MXL-G100", "error", "infer-failure",
+    "A node fails shape/dtype abstract evaluation — binding this graph "
+    "would raise at executor build time.")
+register_rule(
+    "MXL-G101", "error", "float64-creep",
+    "A node produces float64 from non-float64 inputs (or an input is "
+    "declared float64). TPUs have no native f64: XLA emulates it at a "
+    "severe slowdown and doubles HBM traffic.")
+register_rule(
+    "MXL-G102", "error", "unregistered-op",
+    "The op has no entry in ops/registry.py — there is no TPU lowering; "
+    "executing the graph raises at bind time.")
+register_rule(
+    "MXL-G103", "warning", "host-op",
+    "The op is registered host=True (data-dependent shapes, eager host "
+    "execution). Inside a jitted graph it forces a host round-trip per "
+    "step and blocks whole-graph XLA fusion.")
+register_rule(
+    "MXL-G104", "error", "dangling-input",
+    "A graph input's shape can neither be inferred from the graph nor was "
+    "it provided — simple_bind/infer_shape on this symbol will fail.")
+register_rule(
+    "MXL-G105", "warning", "unused-input",
+    "A provided input binding is not consumed by any node reachable from "
+    "the outputs — a stale feed dict entry or a typo'd name.")
+register_rule(
+    "MXL-G106", "warning", "dead-subgraph",
+    "A saved graph contains nodes unreachable from any head — dead weight "
+    "that inflates load time and usually indicates a truncated or "
+    "mis-exported model.")
+
+
+def _parse_shape_attr(v: str) -> Optional[Tuple[int, ...]]:
+    try:
+        t = ast.literal_eval(v)
+        return tuple(int(x) for x in t)
+    except (ValueError, SyntaxError, TypeError):
+        return None
+
+
+def _parse_dtype_attr(v: str):
+    """``__dtype__`` attrs are ``str(dtype)`` — accept 'float64',
+    "<class 'numpy.float64'>", 'np.float64' and friends."""
+    try:
+        return np.dtype(v)
+    except TypeError:
+        pass
+    # longest names first: 'bfloat16' contains 'float16', every 'uintN'
+    # contains 'intN' — repr-style attrs ("<class 'ml_dtypes.bfloat16'>")
+    # must not match the shorter substring
+    for name in ("float64", "bfloat16", "float32", "float16",
+                 "uint64", "uint32", "uint16", "uint8",
+                 "int64", "int32", "int8", "bool"):
+        if name in str(v):
+            return jnp.bfloat16 if name == "bfloat16" else np.dtype(name)
+    return None
+
+
+def _is_f64(aval) -> bool:
+    return aval is not None and np.dtype(aval.dtype) in (
+        np.dtype(np.float64), np.dtype(np.complex128))
+
+
+def lint_symbol(symbol, shapes: Optional[Dict[str, Sequence[int]]] = None,
+                dtypes: Optional[Dict[str, Any]] = None,
+                suppress: Sequence[str] = (),
+                subject: str = "") -> Report:
+    """Lint a Symbol graph. ``shapes``/``dtypes`` play the role of the
+    bind-time feed dict: shapes the walker can't backfill from parameter
+    rules must come from here (exactly like ``simple_bind``'s kwargs)."""
+    from ..ops.registry import get_op
+    from ..executor import _PARAM_SHAPE_RULES
+    from .._imperative import _op_signature_flags
+
+    report = Report(subject or f"symbol {symbol.name!r}", "graph")
+    report.set_suppressions(suppress)
+    shapes = {k: tuple(v) for k, v in (shapes or {}).items()}
+    dtypes = dict(dtypes or {})
+
+    nodes = symbol.topo_nodes()
+    var_shape: Dict[str, Tuple[int, ...]] = {}
+    var_dtype: Dict[str, Any] = {}
+    consumed_vars = set()
+    for n in nodes:
+        if not n.is_var:
+            continue
+        s = shapes.get(n.name)
+        if s is None and "__shape__" in n._attr_dict:
+            s = _parse_shape_attr(n._attr_dict["__shape__"])
+        if s is not None:
+            var_shape[n.name] = tuple(s)
+        dt = dtypes.get(n.name)
+        if dt is None and "__dtype__" in n._attr_dict:
+            dt = _parse_dtype_attr(n._attr_dict["__dtype__"])
+        if dt is not None:
+            var_dtype[n.name] = np.dtype(dt)   # ml_dtypes covers bfloat16
+            if var_dtype[n.name] == np.dtype(np.float64):
+                report.add(Diagnostic(
+                    "MXL-G101",
+                    f"input {n.name!r} is declared float64",
+                    location=f"var:{n.name}", severity="warning",
+                    hint="declare float32 (MXNET_DEFAULT_DTYPE) unless the "
+                         "math genuinely needs f64 emulation"))
+
+    # a variable that IS a graph output counts as consumed (passthrough
+    # heads in a Group): its binding is required, not stale
+    for (node, _idx) in symbol._outputs:
+        if node.is_var:
+            consumed_vars.add(node.name)
+
+    entry_aval: Dict[Tuple[int, int], Any] = {}
+    dead_vars = set()    # consumed vars whose shape never resolved
+
+    for node in nodes:
+        if node.is_var:
+            continue
+        loc = f"{node.op}:{node.name}"
+        try:
+            opdef = get_op(node.op)
+        except MXNetError:
+            report.add(Diagnostic(
+                "MXL-G102", f"op {node.op!r} has no TPU lowering "
+                f"(not in ops/registry.py)", location=loc,
+                hint="register a jax lowering or replace the op before "
+                     "binding on TPU"))
+            for (src, _) in node.inputs:
+                if src.is_var:
+                    consumed_vars.add(src.name)
+            continue
+        if opdef.host:
+            report.add(Diagnostic(
+                "MXL-G103", f"op {node.op!r} is host-only (host=True): it "
+                "executes eagerly on CPU and forces a device round-trip",
+                location=loc,
+                hint="keep host ops out of jitted training graphs; run "
+                     "them in the input pipeline instead"))
+            # host ops have data-dependent shapes and host-side numpy
+            # bodies — abstract eval would spuriously fail; their outputs
+            # stay unknown and downstream nodes are skipped
+            for (src, _) in node.inputs:
+                if src.is_var:
+                    consumed_vars.add(src.name)
+            continue
+
+        arg_names = opdef.arg_names() or []
+        # parameter-shape backfill, same rule table the executor uses
+        rule = _PARAM_SHAPE_RULES.get(node.op)
+        if rule is not None and node.inputs:
+            src0, idx0 = node.inputs[0]
+            ds = (var_shape.get(src0.name) if src0.is_var
+                  else (tuple(entry_aval[(id(src0), idx0)].shape)
+                        if (id(src0), idx0) in entry_aval else None))
+            if ds is not None:
+                try:
+                    param_shapes = rule(dict(node.attrs), tuple(ds))
+                except KeyError:
+                    param_shapes = {}
+                for i, (src, _) in enumerate(node.inputs):
+                    if src.is_var and src.name not in var_shape \
+                            and i < len(arg_names) \
+                            and arg_names[i] in param_shapes:
+                        var_shape[src.name] = param_shapes[arg_names[i]]
+
+        in_avals = []
+        missing = False
+        unresolved_vars = []
+        poisoned = False   # depends on a skipped node (host/unregistered/
+                           # failed): its unknowns are NOT the user's fault
+        for (src, idx) in node.inputs:
+            if src.is_var:
+                consumed_vars.add(src.name)
+                if src.name not in var_shape:
+                    unresolved_vars.append(src.name)
+                    missing = True
+                    continue
+                dt = var_dtype.get(src.name, jnp.float32)
+                in_avals.append(jax.ShapeDtypeStruct(var_shape[src.name], dt))
+            else:
+                av = entry_aval.get((id(src), idx))
+                if av is None:
+                    poisoned = True
+                    missing = True
+                    continue
+                in_avals.append(av)
+        if missing:
+            if not poisoned:
+                # genuinely dangling: no upstream finding explains it.
+                # Downstream of a host op (MXL-G103, warning) or a failed/
+                # unregistered node the shape backfill simply couldn't run —
+                # flagging those params as errors would escalate a warning-
+                # severity graph into a CI failure
+                dead_vars.update(unresolved_vars)
+            continue   # root cause reported above or as MXL-G104 below
+
+        attrs = dict(node.attrs)
+        accepts_train, accepts_rng = _op_signature_flags(opdef)
+        if accepts_train and "is_train" not in attrs:
+            attrs["is_train"] = True
+
+        def run(*arrs):
+            kw = dict(attrs)
+            if accepts_rng:
+                kw["rng"] = jax.random.PRNGKey(0)
+            return opdef.fn(*arrs, **kw)
+
+        try:
+            out_avals = jax.eval_shape(run, *in_avals)
+        except Exception as e:
+            report.add(Diagnostic(
+                "MXL-G100", f"abstract evaluation failed: {e}", location=loc,
+                hint="fix the shape/attr mismatch; this graph cannot bind"))
+            continue
+        if not isinstance(out_avals, tuple):
+            out_avals = (out_avals,)
+        for i, av in enumerate(out_avals):
+            entry_aval[(id(node), i)] = av
+        # a node is the f64 *source* when its output is f64 but its inputs
+        # aren't all f64 — including zero-input creators (zeros/arange/
+        # random with dtype='float64'), where all([]) would vacuously pass
+        if any(_is_f64(av) for av in out_avals) \
+                and not (in_avals and all(_is_f64(av) for av in in_avals)):
+            report.add(Diagnostic(
+                "MXL-G101", f"op {node.op!r} widens to float64 "
+                f"(inputs: {[str(a.dtype) for a in in_avals]})", location=loc,
+                hint="TPUs emulate f64; cast to float32 or drop the "
+                     "widening attr (e.g. dtype='float64')"))
+
+    for name in sorted(dead_vars):
+        report.add(Diagnostic(
+            "MXL-G104", f"input {name!r} is dangling: consumed by the graph "
+            "but its shape can neither be inferred nor was it provided",
+            location=f"var:{name}",
+            hint="pass its shape to lint_symbol/simple_bind, or declare it "
+                 "with Variable(shape=...)"))
+
+    for name in sorted(set(shapes) | set(dtypes)):
+        if name not in consumed_vars and name != "__outputs__":
+            report.add(Diagnostic(
+                "MXL-G105", f"provided input {name!r} is not consumed by "
+                "any node reachable from the outputs",
+                location=f"var:{name}",
+                hint="remove the stale binding or check the name for typos"))
+    return report
+
+
+def lint_symbol_json(json_str: str, shapes=None, dtypes=None,
+                     suppress: Sequence[str] = (),
+                     subject: str = "") -> Report:
+    """Lint a *serialized* graph (``Symbol.tojson`` / ``.save`` output).
+    Runs the reachability check JSON makes possible — nodes present in the
+    file but unreachable from any head (MXL-G106) — then the full
+    :func:`lint_symbol` walk over the loaded graph."""
+    from ..symbol import load_json
+
+    data = json.loads(json_str)
+    sym = load_json(json_str)
+    report = lint_symbol(sym, shapes=shapes, dtypes=dtypes,
+                         suppress=suppress,
+                         subject=subject or "saved graph")
+    report.front_end = "graph"
+    if isinstance(data, dict) and "nodes" in data and "heads" in data:
+        reach = set()
+        stack = [h[0] for h in data["heads"]]
+        while stack:
+            i = stack.pop()
+            if i in reach:
+                continue
+            reach.add(i)
+            stack.extend(src for (src, _i, _v)
+                         in data["nodes"][i].get("inputs", []))
+        dead = [jn["name"] for i, jn in enumerate(data["nodes"])
+                if i not in reach]
+        if dead:
+            shown = ", ".join(dead[:5]) + ("…" if len(dead) > 5 else "")
+            report.add(Diagnostic(
+                "MXL-G106", f"{len(dead)} node(s) unreachable from any "
+                f"head: {shown}", location="graph json",
+                hint="re-export the symbol from its live outputs "
+                     "(Symbol.tojson only serializes reachable nodes)"))
+    return report

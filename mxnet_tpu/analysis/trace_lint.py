@@ -1,0 +1,455 @@
+"""Trace lint — static analysis over trainer step functions.
+
+Where graph lint walks a Symbol DAG, trace lint inspects a *Python step
+function* the way jit will see it: ``jax.make_jaxpr`` abstract-evaluates the
+function (nothing executes on device), the AOT ``lower()`` surface exposes
+donation, and the function's own source/closure are scanned for the hazards
+that never show up in a jaxpr — host syncs and retrace triggers. This is the
+layer the reference gets from NNVM's pass manager between graph and engine;
+for a trace-and-compile stack it has to look at the trace instead.
+
+Rules (catalog in docs/static_analysis.md):
+
+* MXL-T200 trace-failure        (error)   step function fails abstract eval
+* MXL-T201 host-sync-in-step    (error)   .item()/np.asarray()/device_get/
+                                          wait_to_read in the step body
+* MXL-T202 retrace-closure-scalar (warning) Python scalar captured by closure
+* MXL-T203 weak-type-arg        (warning) Python-scalar / weak-typed sample
+                                          arg (weak-type flip ⇒ retrace)
+* MXL-T204 unhashable-static-arg (error)  static_argnums arg is an array /
+                                          unhashable (retrace per value or
+                                          TypeError)
+* MXL-T205 missed-donation      (warning) input buffer matches an output but
+                                          is not donated
+* MXL-T206 replicated-constant  (warning) large constant baked into the
+                                          trace (replicated per device under
+                                          a sharded mesh)
+* MXL-T207 float64-in-trace     (error)   f64 appears in args or is
+                                          introduced by a primitive
+"""
+from __future__ import annotations
+
+import ast
+import inspect
+import textwrap
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from .diagnostics import (Diagnostic, Report, parse_disable_comment,
+                          register_rule)
+
+__all__ = ["lint_step", "lint_trainer"]
+
+register_rule(
+    "MXL-T200", "error", "trace-failure",
+    "The step function fails jax abstract evaluation with the given sample "
+    "arguments — jit of this function will raise the same way.")
+register_rule(
+    "MXL-T201", "error", "host-sync-in-step",
+    "The step body forces a host↔device synchronization (.item(), "
+    ".asnumpy(), np.asarray(...), jax.device_get(...), wait_to_read()): "
+    "inside a hot loop this serializes the async dispatch pipeline; inside "
+    "a jitted function it fails tracing outright.")
+register_rule(
+    "MXL-T202", "warning", "retrace-closure-scalar",
+    "A Python scalar is captured by closure. jit bakes it in as a "
+    "constant: changing it either retraces (re-jit per step) or is "
+    "silently ignored (stale trace).")
+register_rule(
+    "MXL-T203", "warning", "weak-type-arg",
+    "A sample argument is a Python scalar (weak-typed). Alternating weak "
+    "and strong types for the same parameter triggers a retrace per flip.")
+register_rule(
+    "MXL-T204", "error", "unhashable-static-arg",
+    "A static_argnums position receives an array or unhashable value — "
+    "jit either raises TypeError or recompiles for every distinct value.")
+register_rule(
+    "MXL-T205", "warning", "missed-donation",
+    "An input buffer has the same shape/dtype as an output (param/state "
+    "threading) but is not donated — XLA must double-buffer it, costing "
+    "HBM equal to the undonated bytes.")
+register_rule(
+    "MXL-T206", "warning", "replicated-constant",
+    "A large constant is baked into the trace (closure-captured array). "
+    "It is embedded in the executable and replicated on every device of a "
+    "sharded mesh; pass it as an argument and shard it instead.")
+register_rule(
+    "MXL-T207", "error", "float64-in-trace",
+    "float64 appears in the traced computation. TPUs emulate f64 at a "
+    "severe slowdown (jax_enable_x64 is on package-wide, so np.float64 "
+    "inputs silently stay f64).")
+
+_HOST_SYNC_METHODS = ("item", "asscalar", "asnumpy", "wait_to_read")
+_NP_NAMES = ("np", "numpy", "onp")
+
+
+def _fmt_bytes(n: int) -> str:
+    if n >= 1 << 20:
+        return f"{n / 2**20:.1f} MiB"
+    if n >= 1 << 10:
+        return f"{n / 2**10:.1f} KiB"
+    return f"{n} B"
+
+
+def _f64(aval) -> bool:
+    try:
+        return np.dtype(aval.dtype) in (np.dtype(np.float64),
+                                        np.dtype(np.complex128))
+    except TypeError:
+        return False
+
+
+def _iter_eqns(jaxpr):
+    """All eqns, recursing into call/control-flow sub-jaxprs."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for v in eqn.params.values():
+            sub = getattr(v, "jaxpr", None)
+            if sub is not None:
+                yield from _iter_eqns(sub)
+            elif isinstance(v, (list, tuple)):
+                for x in v:
+                    s = getattr(x, "jaxpr", None)
+                    if s is not None:
+                        yield from _iter_eqns(s)
+
+
+def _source_info(fn):
+    """(source_lines, first_lineno, filename) or None when source is
+    unavailable (builtins, exec'd code, C extensions)."""
+    try:
+        lines, start = inspect.getsourcelines(fn)
+        filename = inspect.getsourcefile(fn) or "<unknown>"
+        return lines, start, filename
+    except (OSError, TypeError):
+        return None
+
+
+class _HostSyncVisitor(ast.NodeVisitor):
+    def __init__(self):
+        self.hits = []   # (lineno, description)
+
+    def visit_Call(self, node: ast.Call):
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            if f.attr in _HOST_SYNC_METHODS:
+                self.hits.append((node.lineno, f".{f.attr}()"))
+            elif f.attr in ("asarray", "array") and \
+                    isinstance(f.value, ast.Name) and f.value.id in _NP_NAMES:
+                self.hits.append((node.lineno, f"{f.value.id}.{f.attr}(...)"))
+            elif f.attr == "device_get":
+                self.hits.append((node.lineno, "device_get(...)"))
+        elif isinstance(f, ast.Name) and f.id == "device_get":
+            self.hits.append((node.lineno, "device_get(...)"))
+        self.generic_visit(node)
+
+
+def _def_line(lines):
+    """Index of the actual ``def``/``async def`` line — decorated functions'
+    source starts at the first decorator, and the suppression contract puts
+    the disable comment on the def line, not the decorator."""
+    for i, l in enumerate(lines):
+        if l.lstrip().startswith(("def ", "async def ")):
+            return i
+    return 0
+
+
+def _scan_source(inner, report: Report) -> Tuple[str, int, str]:
+    """AST host-sync scan + returns (filename, def_lineno, def_line_text)
+    for locating whole-function findings."""
+    si = _source_info(inner)
+    if si is None:
+        return "<unknown>", 0, ""
+    lines, start, filename = si
+    d = _def_line(lines)
+    src = textwrap.dedent("".join(lines))
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return filename, start + d, lines[d] if lines else ""
+    v = _HostSyncVisitor()
+    v.visit(tree)
+    for rel_line, desc in v.hits:
+        abs_line = start + rel_line - 1
+        text = lines[rel_line - 1] if rel_line - 1 < len(lines) else ""
+        report.add(Diagnostic(
+            "MXL-T201", f"host sync {desc} inside the step function",
+            location=f"{filename}:{abs_line}",
+            hint="move host readbacks out of the step; for logging, read "
+                 "asynchronously every N steps (the value is a future)"),
+            inline_disables=parse_disable_comment(text))
+    return filename, start + d, lines[d] if lines else ""
+
+
+def lint_step(fn, args: Sequence[Any] = (), kwargs: Optional[Dict] = None,
+              *, donate_argnums: Optional[Sequence[int]] = None,
+              static_argnums: Sequence[int] = (),
+              const_bytes_threshold: int = 1 << 20,
+              donate_bytes_threshold: int = 1024,
+              suppress: Sequence[str] = (),
+              subject: str = "") -> Report:
+    """Trace-lint a step function against sample arguments.
+
+    ``fn`` may be a plain function or a ``jax.jit``-wrapped one; for jitted
+    functions donation is read off the AOT lowering, otherwise pass the
+    intended ``donate_argnums``. Sample args are abstract-evaluated only —
+    nothing runs on device, so full-size production shapes are cheap.
+    """
+    kwargs = dict(kwargs or {})
+    inner = inspect.unwrap(fn)
+    jitted = fn is not inner or type(fn).__name__ in (
+        "PjitFunction", "CompiledFunction", "Wrapped")
+    name = getattr(inner, "__qualname__", getattr(inner, "__name__", "step"))
+    report = Report(subject or f"step {name!r}", "trace")
+    report.set_suppressions(suppress)
+
+    filename, def_line, def_text = _scan_source(inner, report)
+    fn_loc = f"{filename}:{def_line}"
+    def_disables = parse_disable_comment(def_text)
+
+    # ---- closure-captured Python scalars (MXL-T202). Module-global
+    # scalars bake in identically but are far more often deliberate
+    # constants, so they report at info severity instead of warning.
+    try:
+        cv = inspect.getclosurevars(inner)
+        scalar_cells = {k: v for k, v in cv.nonlocals.items()
+                        if isinstance(v, (bool, int, float))}
+        scalar_globals = {k: v for k, v in cv.globals.items()
+                          if isinstance(v, (bool, int, float))}
+    except (TypeError, ValueError):
+        scalar_cells, scalar_globals = {}, {}
+    for k, v in sorted(scalar_cells.items()):
+        report.add(Diagnostic(
+            "MXL-T202", f"closure captures Python scalar {k}={v!r}; jit "
+            "bakes it into the compiled program",
+            location=fn_loc,
+            hint="pass it as a traced argument (or static_argnums if it "
+                 "selects code paths), or wrap in jnp.asarray"),
+            inline_disables=def_disables)
+    for k, v in sorted(scalar_globals.items()):
+        report.add(Diagnostic(
+            "MXL-T202", f"module-global Python scalar {k}={v!r} is baked "
+            "into the compiled program; rebinding the global after jit is "
+            "silently ignored", location=fn_loc, severity="info",
+            hint="fine for a true constant; pass as an argument if it is "
+                 "ever meant to change"),
+            inline_disables=def_disables)
+
+    # ---- static-argument hygiene (MXL-T204)
+    static_argnums = tuple(static_argnums or ())
+    for i in static_argnums:
+        if i >= len(args):
+            continue
+        a = args[i]
+        bad = isinstance(a, (np.ndarray, jax.Array))
+        if not bad:
+            try:
+                hash(a)
+            except TypeError:
+                bad = True
+        if bad:
+            report.add(Diagnostic(
+                "MXL-T204", f"static arg {i} is "
+                f"{type(a).__name__} — unhashable/array-valued static "
+                "args retrace per value (or raise TypeError)",
+                location=fn_loc,
+                hint="make it a traced argument, or reduce it to a "
+                     "hashable config (shape tuple, enum)"),
+                inline_disables=def_disables)
+
+    # ---- abstract eval. Jitted fns go through their own .trace(), which
+    # honors the jit's static_argnums/donate_argnums and treats kwargs as
+    # real inputs; raw fns are traced with user-supplied static args fixed
+    # and kwargs as a traced input tree (NOT closed over — a closed-over
+    # batch would masquerade as a baked constant).
+    dyn_idx = [i for i in range(len(args)) if i not in static_argnums]
+    dyn_args = [args[i] for i in dyn_idx]
+    donated_flags = None
+    try:
+        if jitted and hasattr(fn, "trace"):
+            traced = fn.trace(*args, **kwargs)
+            closed = traced.jaxpr
+            donated_flags = [bool(a.donated) for a in
+                             jax.tree_util.tree_leaves(traced.args_info)]
+        else:
+            fixed = {i: args[i] for i in static_argnums if i < len(args)}
+
+            def traceable(dyn, kw):
+                full = list(fixed.items()) + list(zip(dyn_idx, dyn))
+                return inner(*(v for _, v in sorted(full)), **kw)
+
+            closed = jax.make_jaxpr(traceable)(tuple(dyn_args), kwargs)
+    except Exception as e:
+        hint = "jit of this step will fail identically; fix the trace " \
+               "error first — remaining trace rules were skipped"
+        disables = def_disables
+        concretization = "Tracer" in type(e).__name__ \
+            or "Concretization" in type(e).__name__
+        if concretization and report.by_rule("MXL-T201"):
+            hint = "likely caused by the host sync(s) flagged above " \
+                   "(MXL-T201): a traced array cannot be read back on host"
+        elif concretization and any(d.rule_id == "MXL-T201"
+                                    for d in report.suppressed):
+            # every host sync was explicitly acknowledged with a disable
+            # comment — the consequent trace failure is the same root
+            # cause, so it rides along as suppressed (eager-only steps)
+            disables = ("all",)
+        msg = str(e).split("\n")[0]
+        report.add(Diagnostic(
+            "MXL-T200", f"abstract evaluation failed: "
+            f"{type(e).__name__}: {msg}", location=fn_loc, hint=hint),
+            inline_disables=disables)
+        return report
+
+    # the trace succeeded, so no flagged host sync ran on a *traced* value
+    # (that would have raised above) — each is a trace-time constant or a
+    # per-call sync only on the eager path; hazard stands, but not provably
+    # per-step, so the finding rides as warning instead of error
+    for d in report.by_rule("MXL-T201"):
+        d.severity = "warning"
+        d.hint += " (trace succeeded: this sync is not on a traced value — "\
+                  "likely a baked constant; still per-call if run eagerly)"
+
+    in_avals = [v.aval for v in closed.jaxpr.invars]
+
+    # ---- weak types (MXL-T203): read off the traced avals, so statically
+    # consumed Python scalars (a jit's own static_argnums) never
+    # false-positive — only values that actually trace weak are flagged
+    weak = [i for i, av in enumerate(in_avals)
+            if getattr(av, "weak_type", False)]
+    if weak:
+        report.add(Diagnostic(
+            "MXL-T203", f"{len(weak)} input leaf/leaves trace weak-typed "
+            f"(flat arg indices {weak[:8]}) — Python scalars; alternating "
+            "weak/strong types for the same parameter retraces per flip",
+            location=fn_loc,
+            hint="pass jnp.asarray(x, dtype) so the committed dtype is "
+                 "stable across steps"),
+            inline_disables=def_disables)
+
+    # ---- float64 (MXL-T207): args first, then introducing primitives
+    f64_args = [i for i, av in enumerate(in_avals) if _f64(av)]
+    if f64_args:
+        report.add(Diagnostic(
+            "MXL-T207", f"{len(f64_args)} input leaf/leaves are float64 "
+            f"(flat arg indices {f64_args[:8]})", location=fn_loc,
+            hint="cast inputs to float32 before the step; np arrays "
+                 "default to f64 under jax_enable_x64"),
+            inline_disables=def_disables)
+    introducers = []
+    for eqn in _iter_eqns(closed.jaxpr):
+        outs_f64 = any(_f64(v.aval) for v in eqn.outvars)
+        ins_f64 = [_f64(v.aval) for v in eqn.invars
+                   if hasattr(v, "aval")]
+        if outs_f64 and not (ins_f64 and all(ins_f64)):
+            introducers.append(str(eqn.primitive))
+    if introducers:
+        shown = ", ".join(sorted(set(introducers))[:5])
+        report.add(Diagnostic(
+            "MXL-T207", f"{len(introducers)} primitive(s) introduce "
+            f"float64 into the trace ({shown})", location=fn_loc,
+            hint="look for np.float64 scalars, python floats in "
+                 "jnp.array(..., dtype=None), or explicit astype('float64')"),
+            inline_disables=def_disables)
+
+    # ---- large baked constants (MXL-T206)
+    for c in closed.consts:
+        nbytes = getattr(c, "nbytes", 0)
+        if nbytes >= const_bytes_threshold:
+            report.add(Diagnostic(
+                "MXL-T206", f"constant of shape "
+                f"{tuple(getattr(c, 'shape', ()))} "
+                f"{getattr(c, 'dtype', '?')} ({_fmt_bytes(nbytes)}) is "
+                "baked into the trace and replicated per device",
+                location=fn_loc,
+                hint="pass it as an argument (sharded/replicated "
+                     "explicitly) instead of closing over it"),
+                inline_disables=def_disables)
+
+    # ---- donation (MXL-T205): per-buffer. Donated inputs consume their
+    # matching output slots first (they genuinely alias); any leftover
+    # non-donated input matching a remaining output is a missed donation —
+    # partial donation (opt_state donated, params forgotten) still fires.
+    if donated_flags is None:
+        donate_set = set(donate_argnums or ())
+        flags_tree = (tuple(jax.tree_util.tree_map(
+                          lambda _, _i=i: _i in donate_set, args[i])
+                          for i in dyn_idx),
+                      jax.tree_util.tree_map(lambda _: False, kwargs))
+        donated_flags = jax.tree_util.tree_leaves(flags_tree)
+    if len(donated_flags) != len(in_avals):
+        # structure drifted (exotic pytree); fail open rather than misreport
+        donated_flags = [True] * len(in_avals)
+    out_pool: Dict[Tuple, int] = {}
+    for v in closed.jaxpr.outvars:
+        if hasattr(v, "aval"):
+            k = (tuple(v.aval.shape), str(v.aval.dtype))
+            out_pool[k] = out_pool.get(k, 0) + 1
+
+    def _nbytes(av):
+        n = int(np.prod(av.shape, dtype=np.int64)) if av.shape else 1
+        return n * np.dtype(av.dtype).itemsize
+
+    for av, donated in zip(in_avals, donated_flags):
+        if donated:
+            k = (tuple(av.shape), str(av.dtype))
+            if out_pool.get(k, 0) > 0:
+                out_pool[k] -= 1
+    cand_bytes = 0
+    cand_leaves = 0
+    for av, donated in zip(in_avals, donated_flags):
+        k = (tuple(av.shape), str(av.dtype))
+        if not donated and out_pool.get(k, 0) > 0 \
+                and _nbytes(av) >= donate_bytes_threshold:
+            out_pool[k] -= 1
+            cand_bytes += _nbytes(av)
+            cand_leaves += 1
+    if cand_leaves:
+        report.add(Diagnostic(
+            "MXL-T205", f"{cand_leaves} input buffer(s) totalling "
+            f"{_fmt_bytes(cand_bytes)} match output shapes/dtypes "
+            "but are not donated",
+            location=fn_loc,
+            hint="jit(fn, donate_argnums=...) on the params/optimizer-"
+                 "state arguments halves their HBM footprint"),
+            inline_disables=def_disables)
+    return report
+
+
+def lint_trainer(trainer, *data, suppress: Sequence[str] = (),
+                 const_bytes_threshold: int = 1 << 20,
+                 donate_bytes_threshold: int = 1024,
+                 subject: str = "") -> Report:
+    """Trace-lint a :class:`~mxnet_tpu.parallel.DataParallelTrainer`'s fused
+    step against a sample batch, running :func:`lint_step` over the exact
+    jitted step (donation read off the lowering, f64/const/source checks
+    over the real trace). On an uncaptured trainer this captures the net
+    first (one tiny host forward for deferred init); the lint itself is
+    abstract evaluation only. A batch whose arity differs from an
+    already-captured step is refused — recapturing from a diagnostics entry
+    point would silently reset params/opt-state and drop any loaded AOT
+    executable."""
+    import jax.numpy as jnp
+    from ..base import MXNetError
+    from ..ndarray import NDArray
+    from ..ndarray.ndarray import _unwrap
+
+    arrays = [_unwrap(d) if isinstance(d, NDArray) else jnp.asarray(d)
+              for d in data]
+    if trainer._step_fn is None:
+        trainer._capture(len(arrays), sample_arrays=arrays)
+    elif trainer._n_inputs != len(arrays):
+        raise MXNetError(
+            f"lint_trainer: sample batch has {len(arrays)} array(s) but the "
+            f"captured step takes {trainer._n_inputs}; pass a batch of the "
+            "training arity (lint never recaptures a live trainer)")
+    rng = jax.random.PRNGKey(0)
+    step_args = (trainer._params, trainer._aux, trainer._opt_state,
+                 trainer._guard_state, rng) + tuple(arrays)
+    return lint_step(trainer._step_fn, step_args,
+                     const_bytes_threshold=const_bytes_threshold,
+                     donate_bytes_threshold=donate_bytes_threshold,
+                     suppress=suppress,
+                     subject=subject or "DataParallelTrainer fused step")

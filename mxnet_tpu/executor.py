@@ -361,6 +361,20 @@ class Executor:
     def set_monitor_callback(self, callback, monitor_all=False):
         self.monitor_callback = callback
 
+    def lint(self, suppress=()):
+        """Static-analyze the bound graph (mxlint graph front end) with the
+        exact shapes/dtypes of the bound arrays — what NNVM's validation
+        passes would check before InitCachedOps. Returns an
+        ``analysis.Report``."""
+        from .analysis import lint_symbol
+        shapes = {n: tuple(a.shape) for n, a in self.arg_dict.items()}
+        shapes.update({n: tuple(a.shape) for n, a in self.aux_dict.items()})
+        dtypes = {n: a.dtype for n, a in self.arg_dict.items()}
+        dtypes.update({n: a.dtype for n, a in self.aux_dict.items()})
+        return lint_symbol(self._symbol, shapes=shapes, dtypes=dtypes,
+                           suppress=suppress,
+                           subject=f"executor over {self._symbol.name!r}")
+
     # ------------------------------------------------------------- forward
     def forward(self, is_train: bool = False, **kwargs):
         from .ndarray.ndarray import NDArray, _wrap

@@ -127,6 +127,13 @@ class Module(BaseModule):
         self.binded = True
         self.for_training = for_training
 
+    def lint(self, suppress=()):
+        """Static-analyze the bound graph with this module's data/label
+        shapes (mxlint graph front end). Call after ``bind``; returns an
+        ``analysis.Report`` — ``report.assert_clean()`` in tests."""
+        assert self.binded, "lint requires a bound module"
+        return self._exec_group.execs[0].lint(suppress=suppress)
+
     # ------------------------------------------------------------- params
     def init_params(self, initializer=None, arg_params=None, aux_params=None,
                     allow_missing=False, force_init=False, allow_extra=False):

@@ -157,7 +157,10 @@ def get_op(name: str) -> OpDef:
             # a genuinely broken provider isn't silently invisible
             provider_errs.append(f"{mod}: {e!r}")
             continue
-        _LAZY_PROVIDERS.remove(mod)
+        # the provider import may re-enter get_op (ops registering ops) and
+        # already have removed itself via the inner call
+        if mod in _LAZY_PROVIDERS:
+            _LAZY_PROVIDERS.remove(mod)
         if name in _REGISTRY:
             return _REGISTRY[name]
     msg = f"operator {name!r} is not registered"

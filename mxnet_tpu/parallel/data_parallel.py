@@ -584,6 +584,14 @@ class DataParallelTrainer:
             home = self._pmap[n].list_ctx()[0].jax_device()
             self._pmap[n].data()._set_data(jax.device_put(self._aux[n], home))
 
+    def lint(self, *data, suppress=()) -> Any:
+        """Trace-lint the fused step against a sample batch (mxlint trace
+        front end): donation, f64, baked constants, host syncs. Captures the
+        net if needed; nothing executes on device. Returns an
+        ``analysis.Report``."""
+        from ..analysis import lint_trainer
+        return lint_trainer(self, *data, suppress=suppress)
+
     def anomaly_stats(self) -> Dict[str, Any]:
         """Grad-anomaly guard counters (empty dict when the guard is off or
         no step ran): skipped-step count, grad-norm EMA, last step's norm

@@ -39,7 +39,11 @@ __all__ = ["ring_attention", "local_attention", "ring_attention_sharded"]
 def _pvary(x, axis_name):
     if hasattr(lax, "pcast"):
         return lax.pcast(x, (axis_name,), to="varying")
-    return lax.pvary(x, (axis_name,))
+    if hasattr(lax, "pvary"):
+        return lax.pvary(x, (axis_name,))
+    # jax < 0.5: no varying-axis type system inside shard_map — values are
+    # implicitly device-varying, so the cast is the identity
+    return x
 
 
 def local_attention(q, k, v, causal: bool = False, scale: Optional[float] = None,

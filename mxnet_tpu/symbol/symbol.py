@@ -290,6 +290,18 @@ class Symbol:
         ex = self.bind(ctx, kwargs)
         return ex.forward()
 
+    def lint(self, shapes=None, dtypes=None, suppress=(), **shape_kwargs):
+        """Static-analyze this graph (mxlint graph front end): shape/dtype
+        abstract eval, f64 creep, registry cross-check, dangling inputs.
+        Shapes go in like ``infer_shape``'s kwargs. Returns an
+        ``analysis.Report``; ``.assert_clean()`` raises on errors."""
+        from ..analysis import lint_symbol
+        all_shapes = dict(shapes or {})
+        all_shapes.update({k: v for k, v in shape_kwargs.items()
+                           if v is not None})
+        return lint_symbol(self, shapes=all_shapes, dtypes=dtypes,
+                           suppress=suppress)
+
     # ---------------------------------------------------------------- serialization
     #: attr keys whose int values index the process-local subgraph store
     #: (control-flow/partition nodes); serialized as embedded graph JSON so

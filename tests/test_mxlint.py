@@ -1,0 +1,494 @@
+"""mxlint: one known-bad fixture per rule class, asserting each rule fires
+exactly there and stays silent on a clean twin — plus the self-check that
+our own trainers lint clean (the regression gate every later perf PR rides).
+
+Rule catalog: docs/static_analysis.md; engine: mxnet_tpu/analysis/.
+"""
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import analysis, sym
+from mxnet_tpu.analysis import lint_step, lint_symbol, lint_symbol_json
+
+pytestmark = pytest.mark.lint
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+F32 = jnp.float32
+
+
+def _rules(report):
+    return [d.rule_id for d in report]
+
+
+# ===========================================================================
+# graph front end
+# ===========================================================================
+
+def _fc_symbol():
+    return mx.sym.FullyConnected(data=sym.Variable("data"), num_hidden=8,
+                                 name="fc")
+
+
+def test_clean_symbol_has_no_findings():
+    r = lint_symbol(_fc_symbol(), shapes={"data": (4, 16)})
+    assert _rules(r) == []
+    assert r.ok() and r.ok("warning")
+
+
+def test_float64_creep_fires_on_widening_cast():
+    bad = _fc_symbol().cast(dtype="float64")
+    r = bad.lint(data=(4, 16))
+    assert _rules(r) == ["MXL-G101"]
+    assert r.errors and not r.ok()
+    clean = _fc_symbol().cast(dtype="float32")
+    assert _rules(clean.lint(data=(4, 16))) == []
+
+
+def test_float64_creep_fires_on_zero_input_creator():
+    from mxnet_tpu import symbol as sym_mod
+    bad = sym_mod.zeros((4, 4), dtype="float64") + sym.Variable("x")
+    r = bad.lint(x=(4, 4))
+    assert "MXL-G101" in _rules(r)
+    assert any(d.severity == "error" for d in r.by_rule("MXL-G101"))
+    clean = sym_mod.zeros((4, 4), dtype="float32") + sym.Variable("x")
+    assert _rules(clean.lint(x=(4, 4))) == []
+
+
+def test_float64_declared_input_warns():
+    x = sym.Variable("x", dtype="float64", shape=(2, 3))
+    r = (x + 1.0).lint()
+    assert "MXL-G101" in _rules(r)
+    # declared (not widened) f64 is a warning, not an error
+    assert all(d.severity == "warning" for d in r.by_rule("MXL-G101"))
+
+
+def test_dangling_input_fires_and_clean_twin_passes():
+    z = sym.Variable("a") + sym.Variable("b")
+    r = z.lint(a=(2, 3))
+    assert _rules(r) == ["MXL-G104"]
+    assert "b" in r.findings[0].message
+    assert _rules(z.lint(a=(2, 3), b=(2, 3))) == []
+
+
+def test_unused_input_warns():
+    r = _fc_symbol().lint(shapes={"data": (4, 16), "ghost": (1,)})
+    assert _rules(r) == ["MXL-G105"]
+    assert r.ok()          # warning severity: exit-clean under default gate
+
+
+def test_passthrough_head_variable_is_consumed():
+    g = sym.Group([sym.Variable("x"), _fc_symbol()])
+    r = g.lint(shapes={"x": (2, 2), "data": (4, 16)})
+    assert _rules(r) == []     # x is a head: its binding is not stale
+
+
+def test_unregistered_op_detected_when_lowering_missing():
+    net = _fc_symbol()
+    from mxnet_tpu.ops import registry
+    saved = registry._REGISTRY.pop("FullyConnected")
+    try:
+        r = lint_symbol(net, shapes={"data": (4, 16)})
+    finally:
+        registry._REGISTRY["FullyConnected"] = saved
+    assert _rules(r) == ["MXL-G102"]
+    assert not r.ok()
+
+
+def test_host_op_warns_and_is_not_abstract_evaled():
+    from mxnet_tpu.symbol import _invoke_sym
+    s = _invoke_sym("_sample_unique_zipfian", [],
+                    {"range_max": 64, "shape": (2, 4)})
+    r = lint_symbol(s)
+    assert _rules(r) == ["MXL-G103"]
+
+
+def test_host_op_downstream_params_not_escalated_to_dangling():
+    """A node fed by a host op can't have its param shapes backfilled, but
+    that must stay the MXL-G103 warning — not become MXL-G104 errors."""
+    from mxnet_tpu.symbol import _invoke_sym
+    s = _invoke_sym("_sample_unique_zipfian", [],
+                    {"range_max": 64, "shape": (2, 4)})
+    fc = mx.sym.FullyConnected(data=s[0], num_hidden=8, name="fc")
+    r = lint_symbol(fc)
+    assert _rules(r) == ["MXL-G103"]
+    assert r.ok()      # warning-only graph must not fail the default gate
+
+
+def test_dtype_attr_parser_handles_repr_and_ml_dtypes():
+    from mxnet_tpu.analysis.graph_lint import _parse_dtype_attr
+    assert _parse_dtype_attr("<class 'ml_dtypes.bfloat16'>") == jnp.bfloat16
+    assert _parse_dtype_attr("np.uint8") == np.dtype(np.uint8)
+    assert _parse_dtype_attr("<class 'numpy.uint32'>") == np.dtype(np.uint32)
+    assert _parse_dtype_attr("<class 'numpy.float64'>") == np.dtype(np.float64)
+
+
+def test_dead_subgraph_in_saved_json():
+    j = json.loads(_fc_symbol().tojson())
+    j["nodes"].append({"op": "relu", "name": "orphan", "attrs": {},
+                       "inputs": [[0, 0, 0]]})
+    r = lint_symbol_json(json.dumps(j), shapes={"data": (4, 16)})
+    assert _rules(r) == ["MXL-G106"]
+    assert "orphan" in r.findings[0].message
+    clean = lint_symbol_json(_fc_symbol().tojson(), shapes={"data": (4, 16)})
+    assert _rules(clean) == []
+
+
+def test_infer_failure_reported_not_raised():
+    bad = mx.sym.FullyConnected(data=sym.Variable("data"),
+                                weight=sym.Variable("w"),
+                                num_hidden=8, name="fc")
+    # wrong explicit weight shape: eval fails, lint reports instead of raising
+    r = lint_symbol(bad, shapes={"data": (4, 16), "w": (3, 3)})
+    assert _rules(r) == ["MXL-G100"]
+
+
+def test_executor_and_module_lint_hooks():
+    net = _fc_symbol()
+    ex = net.simple_bind(mx.cpu(), data=(4, 16))
+    ex.lint().assert_clean()
+    mod = mx.mod.Module(net, data_names=("data",), label_names=None)
+    mod.bind(data_shapes=[("data", (4, 16))])
+    mod.lint().assert_clean()
+
+
+# ===========================================================================
+# trace front end — fixtures are module-level so source/AST scan sees them
+# ===========================================================================
+
+def _host_sync_step(p, g):
+    total = np.asarray(g).sum()            # host sync: the hazard
+    return p - 0.1 * g + total * 0
+
+
+def _acknowledged_sync_step(p, g):
+    total = np.asarray(g).sum()  # mxlint: disable=MXL-T201
+    return p - 0.1 * g + total * 0
+
+
+def _clean_sgd_step(p, g, lr):
+    return p - lr * g
+
+
+def _make_closure_steps():
+    lr = 0.1
+    lr_arr = jnp.asarray(0.1, F32)
+
+    def bad(p, g):
+        return p - lr * g
+
+    def clean(p, g):
+        return p - lr_arr * g
+
+    return bad, clean
+
+
+def _f64_step(p):
+    return p + np.float64(1.0)
+
+
+def _make_const_steps(n):
+    big = jnp.ones((n,), F32)
+
+    def bad(p):
+        return p + big.sum()
+
+    def clean(p, c):
+        return p + c.sum()
+
+    return bad, clean, big
+
+
+def _small_args(n=64):
+    # 64 f32 = 256 B: below the 1 KiB donation threshold, so donation
+    # findings never co-fire with the rule actually under test
+    return (jnp.zeros((n,), F32), jnp.ones((n,), F32))
+
+
+def test_host_sync_fires_with_location_and_clean_twin_passes():
+    r = lint_step(_host_sync_step, _small_args())
+    assert "MXL-T201" in _rules(r)
+    t201 = r.by_rule("MXL-T201")[0]
+    assert "test_mxlint.py" in t201.location
+    # the consequent trace failure points back at the sync as root cause
+    assert [d.hint for d in r.by_rule("MXL-T200")] \
+        and "MXL-T201" in r.by_rule("MXL-T200")[0].hint
+    clean = lint_step(_clean_sgd_step,
+                      _small_args() + (jnp.asarray(0.1, F32),))
+    assert _rules(clean) == []
+
+
+def _const_idx_step(p):
+    idx = np.asarray([0, 2, 1])
+    return p[idx] * 1.0
+
+
+def test_host_sync_downgrades_to_warning_when_trace_succeeds():
+    """np.asarray on a Python list is a trace-time constant, not a per-step
+    sync: the trace succeeds, so MXL-T201 must not fail CI as an error."""
+    r = lint_step(_const_idx_step, (jnp.zeros((4,), F32),))
+    assert _rules(r) == ["MXL-T201"]
+    assert r.findings[0].severity == "warning"
+    assert r.ok()
+
+
+_GLOBAL_LR = 0.05
+
+
+def _global_scalar_step(p, g):
+    return p - _GLOBAL_LR * g
+
+
+def test_module_global_scalar_reported_as_info():
+    r = lint_step(_global_scalar_step, _small_args())
+    assert _rules(r) == ["MXL-T202"]
+    assert r.findings[0].severity == "info"
+    assert r.ok("warning")      # advisory only: never fails a gate
+
+
+def test_host_sync_suppression_comment_silences_rule_and_consequence():
+    r = lint_step(_acknowledged_sync_step, _small_args())
+    assert _rules(r) == []
+    assert {d.rule_id for d in r.suppressed} == {"MXL-T200", "MXL-T201"}
+
+
+def _noop_deco(f):
+    return f
+
+
+def _make_decorated_suppressed_step():
+    lr = 0.1
+
+    @_noop_deco
+    def step(p, g):  # mxlint: disable=MXL-T202
+        return p - lr * g
+
+    return step
+
+
+def test_def_line_suppression_works_on_decorated_function():
+    r = lint_step(_make_decorated_suppressed_step(), _small_args())
+    assert _rules(r) == []
+    assert [d.rule_id for d in r.suppressed] == ["MXL-T202"]
+
+
+def test_retrace_closure_scalar_fires_and_array_twin_passes():
+    bad, clean = _make_closure_steps()
+    r = lint_step(bad, _small_args())
+    assert _rules(r) == ["MXL-T202"]
+    assert "lr=0.1" in r.findings[0].message
+    assert _rules(lint_step(clean, _small_args())) == []
+
+
+def test_weak_type_arg_fires_on_python_scalar():
+    r = lint_step(_clean_sgd_step, _small_args() + (1,))
+    assert _rules(r) == ["MXL-T203"]
+    # a python FLOAT is worse: weak AND f64 under jax_enable_x64 — both fire
+    r = lint_step(_clean_sgd_step, _small_args() + (0.1,))
+    assert {"MXL-T203", "MXL-T207"} <= set(_rules(r))
+    strong = lint_step(_clean_sgd_step, _small_args() + (jnp.asarray(0.1, F32),))
+    assert _rules(strong) == []
+
+
+def test_unhashable_static_arg_is_error():
+    r = lint_step(_clean_sgd_step,
+                  (jnp.zeros((64,), F32), jnp.ones((64,), F32),
+                   np.float32(0.1)), static_argnums=(2,))
+    assert _rules(r) == []     # np scalar is hashable: legit static
+    r = lint_step(_clean_sgd_step,
+                  (jnp.zeros((64,), F32), np.ones((64,), np.float32),
+                   np.float32(0.1)), static_argnums=(1,))
+    assert "MXL-T204" in _rules(r)
+    assert not r.ok()
+
+
+def test_missed_donation_fires_and_donated_twin_passes():
+    args = (jnp.zeros((512,), F32), jnp.ones((512,), F32),
+            jnp.asarray(0.1, F32))
+    r = lint_step(_clean_sgd_step, args)
+    assert _rules(r) == ["MXL-T205"]
+    assert "2.0 KiB" in r.findings[0].message
+    # twin 1: intent declared via donate_argnums
+    assert _rules(lint_step(_clean_sgd_step, args,
+                            donate_argnums=(0,))) == []
+    # twin 2: a genuinely jitted-with-donation step (flags read off AOT)
+    jitted = jax.jit(_clean_sgd_step, donate_argnums=(0,))
+    assert _rules(lint_step(jitted, args)) == []
+
+
+def _flag_select_step(p, g, use_sign):
+    if use_sign:
+        return p - 0.1 * jnp.sign(g)
+    return p - 0.1 * g
+
+
+def test_jitted_static_argnums_are_honored():
+    """jit's own static_argnums route through PjitFunction.trace: the bool
+    selects a code path statically — no false MXL-T200/T203."""
+    jitted = jax.jit(_flag_select_step, static_argnums=(2,))
+    r = lint_step(jitted, _small_args() + (True,))
+    assert _rules(r) == []
+
+
+def _two_buffer_step(p, m, g):
+    return p - 0.1 * g, m * 0.9
+
+
+def test_partial_donation_still_flags_forgotten_buffer():
+    args = (jnp.zeros((512,), F32), jnp.ones((512,), F32),
+            jnp.ones((512,), F32))
+    jitted = jax.jit(_two_buffer_step, donate_argnums=(1,))   # m donated...
+    r = lint_step(jitted, args)
+    assert _rules(r) == ["MXL-T205"]                          # ...p forgotten
+    assert "1 input buffer" in r.findings[0].message
+    full = jax.jit(_two_buffer_step, donate_argnums=(0, 1))
+    assert _rules(lint_step(full, args)) == []
+
+
+def _kwarg_table_step(p, *, table):
+    return p + table.sum()
+
+
+def test_kwargs_are_traced_as_inputs_not_constants():
+    r = lint_step(_kwarg_table_step, (jnp.zeros((64,), F32),),
+                  {"table": jnp.ones((16384,), F32)},
+                  const_bytes_threshold=1 << 12)
+    assert _rules(r) == []     # a kwarg is an argument, not a baked const
+
+
+def test_replicated_constant_fires_above_threshold_and_arg_twin_passes():
+    bad, clean, big = _make_const_steps(16384)      # 64 KiB
+    p = (jnp.zeros((64,), F32),)
+    r = lint_step(bad, p, const_bytes_threshold=1 << 12)
+    assert _rules(r) == ["MXL-T206"]
+    assert "64.0 KiB" in r.findings[0].message
+    assert _rules(lint_step(clean, p + (big,),
+                            const_bytes_threshold=1 << 12)) == []
+    # below threshold: silent
+    assert _rules(lint_step(bad, p)) == []
+
+
+def test_float64_in_trace_fires_on_introducing_primitive():
+    r = lint_step(_f64_step, (jnp.zeros((4,), F32),))
+    assert _rules(r) == ["MXL-T207"]
+    r = lint_step(lambda p: p + jnp.float32(1.0),
+                  (jnp.zeros((4,), np.float64),))
+    assert "MXL-T207" in _rules(r)      # f64 *input* also flagged
+
+
+def test_trace_failure_reported_for_broken_step():
+    r = lint_step(lambda p: p @ jnp.zeros((3, 3), F32),
+                  (jnp.zeros((4, 4), F32),))
+    assert _rules(r) == ["MXL-T200"]
+
+
+def test_api_suppression_and_assert_clean():
+    bad, _ = _make_closure_steps()
+    r = lint_step(bad, _small_args(), suppress=("MXL-T202",))
+    assert _rules(r) == [] and len(r.suppressed) == 1
+    with pytest.raises(AssertionError) as ei:
+        lint_step(bad, _small_args()).assert_clean(fail_on="warning")
+    assert "MXL-T202" in str(ei.value)
+
+
+def test_report_json_roundtrip():
+    bad, _ = _make_closure_steps()
+    data = json.loads(lint_step(bad, _small_args()).to_json())
+    assert data["summary"] == {"errors": 0, "warnings": 1, "total": 1}
+    (f,) = data["findings"]
+    assert f["rule"] == "MXL-T202" and f["severity"] == "warning"
+    assert f["hint"]
+
+
+def test_rule_catalog_is_complete_and_consistent():
+    ids = set(analysis.RULES)
+    assert {"MXL-G100", "MXL-G101", "MXL-G102", "MXL-G103", "MXL-G104",
+            "MXL-G105", "MXL-G106", "MXL-T200", "MXL-T201", "MXL-T202",
+            "MXL-T203", "MXL-T204", "MXL-T205", "MXL-T206",
+            "MXL-T207"} <= ids
+    for rd in analysis.RULES.values():
+        assert rd.severity in ("error", "warning", "info")
+        assert rd.title and rd.doc
+
+
+def test_rule_catalog_matches_docs():
+    """docs/static_analysis.md's rule tables must agree with analysis.RULES
+    on id, severity and title — the doc is handwritten, this is the drift
+    check."""
+    import re
+    doc = open(os.path.join(ROOT, "docs", "static_analysis.md")).read()
+    rows = re.findall(
+        r"^\|\s*(MXL-[GT]\d{3})\s*\|\s*(\w+)\s*\|\s*([\w\-]+)\s*\|",
+        doc, re.MULTILINE)
+    documented = {rid: (sev, title) for rid, sev, title in rows}
+    assert set(documented) == set(analysis.RULES), (
+        set(documented) ^ set(analysis.RULES))
+    for rid, rd in analysis.RULES.items():
+        assert documented[rid] == (rd.severity, rd.title), (
+            rid, documented[rid], (rd.severity, rd.title))
+
+
+def test_lint_trainer_refuses_arity_mismatch(rng):
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.parallel import DataParallelTrainer
+    mx.random.seed(9)
+    net = nn.HybridSequential(prefix="ar_")
+    net.add(nn.Dense(4, prefix="ar_d0_"))
+    net.initialize(mx.init.Xavier())
+    tr = DataParallelTrainer(net, gluon.loss.L2Loss(), "sgd",
+                             {"learning_rate": 0.1})
+    x = rng.randn(16, 8).astype("float32")
+    y = rng.randn(16, 4).astype("float32")
+    tr.step(x, y)
+    params_before = {k: np.asarray(v) for k, v in tr._params.items()}
+    with pytest.raises(mx.MXNetError, match="arity"):
+        tr.lint(x)
+    # the live trainer was not recaptured/reset
+    for k, v in tr._params.items():
+        assert np.array_equal(np.asarray(v), params_before[k])
+
+
+# ===========================================================================
+# self-check: our own trainers must lint clean (the dogfooding gate)
+# ===========================================================================
+
+def test_data_parallel_fused_step_lints_clean(rng):
+    """The fused DataParallelTrainer step: donated, f32, no host syncs, no
+    baked constants — zero findings at ANY severity."""
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.parallel import DataParallelTrainer
+    mx.random.seed(7)
+    net = nn.HybridSequential(prefix="lint_")
+    net.add(nn.Dense(16, activation="relu", prefix="lint_d0_"),
+            nn.Dense(4, prefix="lint_d1_"))
+    net.initialize(mx.init.Xavier())
+    trainer = DataParallelTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.1, "momentum": 0.9}, grad_guard=True)
+    x = rng.randn(32, 8).astype("float32")
+    y = rng.randint(0, 4, (32,)).astype("float32")
+    report = trainer.lint(x, y)
+    assert report.findings == [], report.to_text()
+
+
+def test_example_resilient_training_step_lints_clean():
+    """Satellite self-check: the exact step example/resilient_training.py
+    trains with reports zero findings through the mxlint trace front end."""
+    sys.path.insert(0, os.path.join(ROOT, "example"))
+    try:
+        import resilient_training
+    finally:
+        sys.path.pop(0)
+    spec = resilient_training.make_lint_spec()
+    report = analysis.lint_trainer(spec["trainer"], *spec["data"])
+    assert report.findings == [], report.to_text()

@@ -110,6 +110,60 @@ def test_crashloop_kills_and_recovers_example(tmp_path):
     assert rc == 0
 
 
+_LINT_FIXTURE = """\
+import numpy as np
+import jax.numpy as jnp
+
+def _bad(p):
+    return p + np.float64(1.0)          # f64 creep: MXL-T207
+
+def make_bad_spec():
+    return (_bad, (jnp.zeros((8,), jnp.float32),))
+
+def _clean(p):
+    return p * jnp.float32(2.0)
+
+def make_clean_spec():
+    return {"fn": _clean, "args": (jnp.zeros((8,), jnp.float32),),
+            "donate_argnums": (0,)}
+"""
+
+
+@pytest.mark.lint
+def test_mxlint_cli_json_smoke(tmp_path):
+    """tools/mxlint.py end-to-end: JSON output, exit code 0 on a clean step,
+    1 on an error-severity finding, 2 on an unloadable target — no network,
+    no TPU (abstract eval only)."""
+    import json
+    fixture = tmp_path / "step_specs.py"
+    fixture.write_text(_LINT_FIXTURE)
+    mxlint = os.path.join(REPO, "tools", "mxlint.py")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": ""}
+
+    p = subprocess.run(
+        [sys.executable, mxlint, "trace", f"{fixture}:make_clean_spec",
+         "--format", "json"],
+        capture_output=True, text=True, timeout=240, env=env)
+    assert p.returncode == 0, p.stdout + p.stderr
+    data = json.loads(p.stdout)
+    assert data["summary"] == {"errors": 0, "warnings": 0, "total": 0}
+
+    p = subprocess.run(
+        [sys.executable, mxlint, "trace", f"{fixture}:make_bad_spec",
+         "--format", "json"],
+        capture_output=True, text=True, timeout=240, env=env)
+    assert p.returncode == 1, p.stdout + p.stderr
+    data = json.loads(p.stdout)
+    assert any(f["rule"] == "MXL-T207" for f in data["findings"])
+    assert data["summary"]["errors"] >= 1
+
+    p = subprocess.run(
+        [sys.executable, mxlint, "graph", f"{fixture}:no_such_thing"],
+        capture_output=True, text=True, timeout=240, env=env)
+    assert p.returncode == 2
+    assert "cannot lint" in p.stderr
+
+
 def test_diagnose_runs():
     p = subprocess.run([sys.executable, os.path.join(REPO, "tools",
                                                      "diagnose.py")],

@@ -1,0 +1,193 @@
+#!/usr/bin/env python
+"""mxlint — static graph & trace analyzer for mxnet_tpu.
+
+Catches TPU correctness and performance hazards *before* anything runs:
+float64 creep, ops with no TPU lowering, dangling graph inputs, host↔device
+syncs in step functions, retrace triggers, missed buffer donation, large
+replicated constants. Rule catalog: docs/static_analysis.md.
+
+Usage::
+
+    # graph front end: a Symbol, a factory returning one, or a saved .json
+    python tools/mxlint.py graph mypkg.model:build_symbol --shape data:64,3,32,32
+    python tools/mxlint.py graph model-symbol.json
+
+    # trace front end: a factory returning the step spec
+    python tools/mxlint.py trace example/resilient_training.py:make_lint_spec
+    python tools/mxlint.py trace mypkg.train:step_fn --input 64,20 --input 64
+
+    python tools/mxlint.py trace ... --format json --suppress MXL-T203
+
+A trace factory may return ``(fn, args)``, ``(fn, args, kwargs)``, a dict
+``{"fn":..., "args":..., "kwargs":..., "donate_argnums":...,
+"static_argnums":...}`` or ``{"trainer": DataParallelTrainer, "data": (...)}``.
+
+Exit codes: 0 clean (below ``--fail-on``), 1 findings at/above it, 2 the
+target could not be loaded. Everything is abstract evaluation — no TPU, no
+network; the tool forces ``JAX_PLATFORMS=cpu`` unless already set.
+"""
+import argparse
+import importlib
+import importlib.util
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def _resolve(target):
+    """'pkg.mod:obj' / 'path/to/file.py:obj' / bare module → the object."""
+    if ":" in target:
+        mod_part, obj_part = target.rsplit(":", 1)
+    else:
+        mod_part, obj_part = target, None
+    if mod_part.endswith(".py") or os.path.sep in mod_part:
+        name = os.path.splitext(os.path.basename(mod_part))[0]
+        spec = importlib.util.spec_from_file_location(name, mod_part)
+        if spec is None:
+            raise ImportError(f"cannot load {mod_part!r}")
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules.setdefault(name, mod)
+        spec.loader.exec_module(mod)
+    else:
+        mod = importlib.import_module(mod_part)
+    if obj_part is None:
+        return mod
+    obj = mod
+    for part in obj_part.split("."):
+        obj = getattr(obj, part)
+    return obj
+
+
+def _parse_shape_opt(items):
+    """['data:64,3,32,32', ...] → {'data': (64, 3, 32, 32)}"""
+    out = {}
+    for it in items or []:
+        name, _, dims = it.partition(":")
+        if not dims:
+            raise ValueError(f"--shape wants name:d1,d2,... got {it!r}")
+        out[name] = tuple(int(d) for d in dims.split(",") if d)
+    return out
+
+
+def _parse_dtype_opt(items):
+    import numpy as np
+    return {k: np.dtype(v) for k, v in
+            (it.split(":", 1) for it in items or [])}
+
+
+def _parse_input_opt(items):
+    """['64,20', '64:int32'] → ShapeDtypeStruct sample args."""
+    import jax
+    args = []
+    for it in items or []:
+        dims, _, dt = it.partition(":")
+        shape = tuple(int(d) for d in dims.split(",") if d)
+        args.append(jax.ShapeDtypeStruct(shape, dt or "float32"))
+    return tuple(args)
+
+
+def _run_graph(args, suppress):
+    from mxnet_tpu import analysis
+    shapes = _parse_shape_opt(args.shape)
+    dtypes = _parse_dtype_opt(args.dtype)
+    if args.target.endswith(".json") and os.path.exists(args.target):
+        with open(args.target) as f:
+            return analysis.lint_symbol_json(
+                f.read(), shapes=shapes, dtypes=dtypes, suppress=suppress,
+                subject=os.path.basename(args.target))
+    obj = _resolve(args.target)
+    from mxnet_tpu.symbol import Symbol
+    if callable(obj) and not isinstance(obj, Symbol):
+        obj = obj()
+    if not isinstance(obj, Symbol):
+        raise TypeError(f"graph target resolved to {type(obj).__name__}, "
+                        "expected a Symbol or a factory returning one")
+    return analysis.lint_symbol(obj, shapes=shapes, dtypes=dtypes,
+                                suppress=suppress, subject=args.target)
+
+
+def _run_trace(args, suppress):
+    from mxnet_tpu import analysis
+    obj = _resolve(args.target)
+    spec = None
+    inputs = _parse_input_opt(args.input)
+    if callable(obj) and not inputs:
+        # factory contract: zero-arg callable returning the step spec
+        try:
+            import inspect
+            n_required = sum(
+                1 for p in inspect.signature(obj).parameters.values()
+                if p.default is p.empty
+                and p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD))
+        except (TypeError, ValueError):
+            n_required = 1
+        if n_required == 0:
+            spec = obj()
+    if spec is None:
+        spec = {"fn": obj, "args": inputs}
+    if isinstance(spec, tuple):
+        spec = dict(zip(("fn", "args", "kwargs"), spec))
+    if "trainer" in spec:
+        return analysis.lint_trainer(spec["trainer"], *spec.get("data", ()),
+                                     const_bytes_threshold=args.const_threshold,
+                                     donate_bytes_threshold=args.donate_threshold,
+                                     suppress=suppress, subject=args.target)
+    return analysis.lint_step(
+        spec["fn"], spec.get("args", ()), spec.get("kwargs"),
+        donate_argnums=spec.get("donate_argnums"),
+        static_argnums=spec.get("static_argnums", ()),
+        const_bytes_threshold=args.const_threshold,
+        donate_bytes_threshold=args.donate_threshold,
+        suppress=suppress, subject=args.target)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="mxlint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("front_end", choices=("graph", "trace"))
+    ap.add_argument("target", help="pkg.mod:obj, path/to/file.py:obj, or a "
+                                   "saved symbol .json (graph mode)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--suppress", default="",
+                    help="comma-separated rule ids to silence")
+    ap.add_argument("--fail-on", choices=("error", "warning"),
+                    default="error",
+                    help="lowest severity that makes the exit code nonzero")
+    ap.add_argument("--shape", action="append", metavar="NAME:D1,D2,...",
+                    help="graph mode: input shape (repeatable)")
+    ap.add_argument("--dtype", action="append", metavar="NAME:DTYPE",
+                    help="graph mode: input dtype (repeatable)")
+    ap.add_argument("--input", action="append", metavar="D1,D2[:DTYPE]",
+                    help="trace mode: positional sample arg as an abstract "
+                         "shape (repeatable)")
+    ap.add_argument("--const-threshold", type=int, default=1 << 20,
+                    help="bytes above which a baked constant is flagged "
+                         "(MXL-T206; default 1 MiB)")
+    ap.add_argument("--donate-threshold", type=int, default=1024,
+                    help="bytes below which a donation candidate is ignored "
+                         "(MXL-T205; default 1 KiB)")
+    args = ap.parse_args(argv)
+    suppress = tuple(s for s in args.suppress.split(",") if s.strip())
+
+    try:
+        if args.front_end == "graph":
+            report = _run_graph(args, suppress)
+        else:
+            report = _run_trace(args, suppress)
+    except Exception as e:
+        print(f"mxlint: cannot lint {args.target!r}: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        return 2
+
+    print(report.to_json() if args.format == "json" else report.to_text())
+    return 0 if report.ok(args.fail_on) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
