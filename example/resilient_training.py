@@ -67,6 +67,10 @@ def main(argv=None):
     ap.add_argument("--steps", type=int, default=60)
     ap.add_argument("--save-every", type=int, default=5)
     ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--telemetry-snapshot", default=None, metavar="PATH",
+                    help="write a metrics snapshot (JSON, or Prometheus "
+                         "text for .prom/.txt) on completion — inspect "
+                         "with tools/mxtop.py")
     args = ap.parse_args(argv)
 
     rng = np.random.RandomState(0)
@@ -106,6 +110,11 @@ def main(argv=None):
         digest.update(np.asarray(rt.trainer._params[name]).tobytes())
     rt.save()
     rt.close()
+    if args.telemetry_snapshot:
+        from mxnet_tpu import observability
+        rt.anomaly_stats()      # drain guard counters into the registry
+        print("telemetry snapshot written to %s"
+              % observability.write_snapshot(args.telemetry_snapshot))
     print("training complete at step %d" % rt.step_count)
     print("FINAL_PARAM_DIGEST=%s" % digest.hexdigest(), flush=True)
     return 0

@@ -82,6 +82,7 @@ _lazy = {
     "gradient_compression": ".gradient_compression",
     "resilience": ".resilience",
     "analysis": ".analysis",
+    "observability": ".observability",
 }
 
 

@@ -53,6 +53,12 @@ class Speedometer:
         if self.init:
             if count % self.frequent == 0:
                 speed = self.frequent * self.batch_size / (time.time() - self.tic)
+                # same number the log line reports, mirrored into the shared
+                # telemetry registry (log format stays byte-identical)
+                from .observability import catalog as _telemetry
+                from .observability import metrics as _obs_metrics
+                if _obs_metrics.enabled():
+                    _telemetry.SPEEDOMETER_SPS.set(speed)
                 if param.eval_metric is not None:
                     name_value = param.eval_metric.get_name_value()
                     if self.auto_reset:

@@ -35,6 +35,7 @@ import json
 import os
 import shutil
 import threading
+import time
 import uuid
 import zlib
 from typing import Any, Dict, List, Optional
@@ -43,6 +44,8 @@ import jax
 import numpy as np
 
 from .base import MXNetError
+from .observability import catalog as _telemetry
+from .observability import metrics as _obs_metrics
 
 __all__ = ["ShardedCheckpointer", "save_sharded", "load_sharded"]
 
@@ -148,6 +151,8 @@ class ShardedCheckpointer:
         NEXT save (any step), restore, steps() or close — so at most one
         checkpoint is ever in the uncommitted window, bounding what a hard
         kill (SIGKILL, OOM) can lose to a single cadence interval."""
+        tel = _obs_metrics.enabled()
+        t0 = time.perf_counter() if tel else 0.0
         step = int(step)
         tree = _to_tree(params)
         if aux:
@@ -180,9 +185,17 @@ class ShardedCheckpointer:
         else:
             self._sync_ckpt.save(tmp, tree)
             self._commit(step, tmp, user_manifest)
+        if tel:
+            # async timing = snapshot + dispatch (serialization overlaps
+            # training by design); sync timing = the full write + commit
+            _telemetry.CKPT_SAVE_MS.observe(
+                (time.perf_counter() - t0) * 1000.0,
+                mode="async" if async_save else "sync")
 
     def _commit(self, step: int, tmp: str, user_manifest: Dict) -> None:
         """Manifest + marker inside the temp dir, then one atomic rename."""
+        tel = _obs_metrics.enabled()
+        t0 = time.perf_counter() if tel else 0.0
         files: List[Dict[str, Any]] = []
         for root, _, names in os.walk(tmp):
             for name in sorted(names):
@@ -220,6 +233,12 @@ class ShardedCheckpointer:
         else:
             _commit_rename(tmp, final)
         self._fsync_dir(self.directory)
+        if tel:
+            _telemetry.CKPT_COMMIT_MS.observe(
+                (time.perf_counter() - t0) * 1000.0)
+            nbytes = sum(int(ent["size"]) for ent in files)
+            _telemetry.CKPT_BYTES.inc(nbytes)
+            _telemetry.CKPT_LAST_BYTES.set(nbytes)
 
     @staticmethod
     def _fsync_dir(path: str) -> None:
@@ -253,6 +272,12 @@ class ShardedCheckpointer:
         manifest still matches its recorded size and crc32 — i.e. the
         directory is safe to restore from. Torn/truncated/uncommitted
         directories return False."""
+        ok = self._verify_impl(step)
+        if not ok and _obs_metrics.enabled():
+            _telemetry.CKPT_VERIFY_FAILURES.inc()
+        return ok
+
+    def _verify_impl(self, step: int) -> bool:
         path = self._step_dir(step)
         if not self._is_committed(path):
             return False
@@ -351,6 +376,8 @@ class ShardedCheckpointer:
 
         Refuses uncommitted or torn directories: the commit marker must be
         present and every manifest entry must match on disk."""
+        tel = _obs_metrics.enabled()
+        t0 = time.perf_counter() if tel else 0.0
         path = self._step_dir(step)
         self.wait_until_finished()
         if not os.path.isdir(path) or not self._is_committed(path):
@@ -390,6 +417,9 @@ class ShardedCheckpointer:
                 path, args=ocp.args.StandardRestore(target))
         else:
             restored = self._sync_ckpt.restore(path)
+        if tel:
+            _telemetry.CKPT_RESTORE_MS.observe(
+                (time.perf_counter() - t0) * 1000.0)
         return restored
 
     # ------------------------------------------------------------------- gc

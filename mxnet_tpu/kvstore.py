@@ -34,6 +34,8 @@ import jax.numpy as jnp
 from .base import MXNetError, TransientKVError, get_env
 from .ndarray import NDArray
 from .ndarray.ndarray import _unwrap, _wrap
+from .observability import catalog as _telemetry
+from .observability import metrics as _obs_metrics
 
 __all__ = ["KVStore", "create"]
 
@@ -95,6 +97,8 @@ class KVStore:
             self._pending.append((priority, len(self._pending), k,
                                   [_unwrap(v) for v in vlist]))
             self.comm_stats["pushes"] += 1
+            if _obs_metrics.enabled():
+                _telemetry.KV_PUSH_TOTAL.inc()
 
     def _flush(self) -> None:
         """Dispatch pending pushes: highest priority first (ties keep push
@@ -145,6 +149,8 @@ class KVStore:
     def pull(self, key, out=None, priority: int = 0, ignore_sparse: bool = True):
         self._flush()
         keys, outs = _key_value(key, out)
+        if _obs_metrics.enabled():
+            _telemetry.KV_PULL_TOTAL.inc(len(keys))
         for k, olist in zip(keys, outs):
             if k not in self._store:
                 raise MXNetError(f"key {k} was not init'd")
@@ -499,9 +505,19 @@ class KVStoreDist(KVStore):
         programming error."""
         attempts = max(1, int(get_env("MXNET_KV_RETRY_ATTEMPTS", 5)))
         last = None
+        tel = _obs_metrics.enabled()
         for i in range(attempts):
+            t0 = time.perf_counter() if tel else 0.0
             try:
-                return self._publish_weight(client, k)
+                # EVERY attempt lands in the latency histogram, failed ones
+                # included — during an incident the slow/timed-out attempts
+                # are exactly the signal a dashboard must not hide
+                try:
+                    return self._publish_weight(client, k)
+                finally:
+                    if tel:
+                        _telemetry.KV_PUBLISH_MS.observe(
+                            (time.perf_counter() - t0) * 1000.0)
             except (TypeError, ValueError, KeyError, AttributeError,
                     MXNetError):
                 # deterministic programming errors: retrying cannot help
@@ -510,8 +526,12 @@ class KVStoreDist(KVStore):
                 raise
             except Exception as e:
                 last = e
+                if tel:
+                    _telemetry.KV_PUBLISH_RETRIES.inc()
                 if i < attempts - 1:
                     time.sleep(_kv_backoff_delay(i))
+        if tel:
+            _telemetry.KV_PUBLISH_FAILURES.inc()
         raise TransientKVError(
             "publish of key %r failed after %d attempts (last: %r) — the "
             "coordination service looks unreachable; tune MXNET_KV_RETRY_* "

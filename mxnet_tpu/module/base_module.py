@@ -16,6 +16,9 @@ import numpy as np
 from .. import metric as metric_mod
 from .. import ndarray as nd
 from ..base import MXNetError
+from ..observability import catalog as _telemetry
+from ..observability import metrics as _obs_metrics
+from ..observability.spans import span as _span
 from ..resilience.preemption import check_preempted
 
 __all__ = ["BaseModule", "BatchEndParam"]
@@ -129,26 +132,33 @@ class BaseModule:
             eval_metric.reset()
             nbatch = 0
             train_data.reset()
-            for data_batch in train_data:
-                if monitor is not None:
-                    monitor.tic()
-                self.forward_backward(data_batch)
-                self.update()
-                self.update_metric(eval_metric, data_batch.label)
-                if monitor is not None:
-                    monitor.toc_print()
-                if batch_end_callback is not None:
-                    params = BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                           eval_metric=eval_metric,
-                                           locals=locals())
-                    for cb in _as_list(batch_end_callback):
-                        cb(params)
-                # preemption (SIGTERM) latches a flag; honor it at the batch
-                # boundary — params are consistent here, so the resilience
-                # layer (resilient_fit / the caller's except) can checkpoint
-                # and exit instead of dying mid-update
-                check_preempted()
-                nbatch += 1
+            # one span per epoch: shows up in the span histogram AND — when
+            # a profiler session is recording — as a chrome-trace row
+            with _span("module_fit_epoch", category="module"):
+                for data_batch in train_data:
+                    if monitor is not None:
+                        monitor.tic()
+                    self.forward_backward(data_batch)
+                    self.update()
+                    self.update_metric(eval_metric, data_batch.label)
+                    if monitor is not None:
+                        monitor.toc_print()
+                    if batch_end_callback is not None:
+                        params = BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                               eval_metric=eval_metric,
+                                               locals=locals())
+                        for cb in _as_list(batch_end_callback):
+                            cb(params)
+                    # preemption (SIGTERM) latches a flag; honor it at the
+                    # batch boundary — params are consistent here, so the
+                    # resilience layer (resilient_fit / the caller's except)
+                    # can checkpoint and exit instead of dying mid-update
+                    check_preempted()
+                    nbatch += 1
+                    if _obs_metrics.enabled():
+                        _telemetry.FIT_BATCHES.inc()
+            if _obs_metrics.enabled():
+                _telemetry.FIT_EPOCH_MS.observe((time.time() - tic) * 1000.0)
             for name, val in eval_metric.get_name_value():
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
             self.logger.info("Epoch[%d] Time cost=%.3f", epoch, time.time() - tic)

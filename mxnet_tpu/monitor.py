@@ -8,6 +8,8 @@ import re
 from typing import Callable, List, Optional, Tuple
 
 from .ndarray import NDArray
+from .observability import catalog as _telemetry
+from .observability import metrics as _obs_metrics
 
 __all__ = ["Monitor"]
 
@@ -64,13 +66,32 @@ class Monitor:
                 if self.re_prog.match(name):
                     self.queue.append((self.step, name, value))
         res = []
-        queue = sorted(self.queue, key=lambda x: x[1]) if self.sort else self.queue
+        # sort=True orders by (name, step): fully deterministic regardless
+        # of the callback arrival order the executor happened to produce
+        # (a name-only key left equal names in arrival order)
+        queue = sorted(self.queue, key=lambda x: (x[1], x[0])) \
+            if self.sort else self.queue
+        publish = _obs_metrics.enabled()
         for n, k, v_list in queue:
             if isinstance(v_list, NDArray):
                 v_list = [v_list]
-            v = ", ".join(f"{float(v.asnumpy().reshape(-1)[0]):.5f}"
-                          if isinstance(v, NDArray) else str(v) for v in
-                          ([v_list] if not isinstance(v_list, list) else v_list))
+            items = [v_list] if not isinstance(v_list, list) else v_list
+            # one device->host sync per NDArray stat, reused by both the
+            # formatted log string and the gauge below
+            host = [float(v.asnumpy().reshape(-1)[0])
+                    if isinstance(v, NDArray) else v for v in items]
+            if publish:
+                # mirror each stat into the shared registry so layer
+                # statistics land in the same exposition endpoint as the
+                # step/kv/checkpoint metrics (first element of multi-value
+                # stats — the stat_func scalar in the common case)
+                try:
+                    _telemetry.MONITOR_STAT.set(float(host[0]), stat=k)
+                except (TypeError, ValueError, IndexError):
+                    pass        # non-numeric or empty user stat: log-only
+            v = ", ".join(f"{h:.5f}" if isinstance(orig, NDArray)
+                          else str(orig)
+                          for orig, h in zip(items, host))
             res.append((n, k, v))
         self.queue = []
         return res

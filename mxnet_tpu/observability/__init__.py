@@ -1,0 +1,61 @@
+"""mxnet_tpu.observability — unified runtime telemetry.
+
+One metrics model for everything the framework previously measured through
+disconnected islands (profiler chrome-trace, Monitor stat queue,
+Speedometer log lines, anomaly_stats, the resilience watchdog):
+
+==================  ======================================================
+piece                what it gives you
+==================  ======================================================
+metrics             thread-safe labeled counters / gauges / histograms,
+                    JSON + Prometheus text exposition, periodic background
+                    exporter (``MXNET_TELEMETRY_EXPORT``)
+spans               ``with span("name"):`` / ``@span("name")`` — one timed
+                    region feeding BOTH the span histogram and the chrome-
+                    trace profiler stream
+catalog             every built-in family (trainer step time, kv publish
+                    latency, checkpoint save duration, ...), pre-declared
+                    so snapshots are schema-stable
+flight_recorder     ring buffer of recent step records; dumped to a JSON
+                    artifact on watchdog timeout / preemption / unhandled
+                    trainer exception (crash forensics)
+jit_hooks           jax.monitoring taps: trace/compile counts + compile
+                    time (the dynamic retrace truth)
+tools/mxtop.py      pretty-printer for live or dumped snapshots
+==================  ======================================================
+
+Everything is host-side: with ``MXNET_TELEMETRY=0`` instrumentation points
+no-op and the jitted step's compiled HLO is bitwise identical (guarded by
+``tests/test_observability.py``). Docs: ``docs/observability.md``.
+"""
+from __future__ import annotations
+
+from ..base import get_env
+from . import metrics
+from . import catalog
+from . import spans
+from . import flight_recorder
+from . import jit_hooks
+from .metrics import (Counter, Gauge, Histogram, MetricsRegistry, REGISTRY,
+                      counter, gauge, histogram, enabled, snapshot,
+                      render_json, render_prometheus, write_snapshot,
+                      start_exporter, stop_exporter)
+from .spans import span, active_spans
+from .flight_recorder import FlightRecorder, get_recorder, record_step
+
+__all__ = ["metrics", "catalog", "spans", "flight_recorder", "jit_hooks",
+           "Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
+           "counter", "gauge", "histogram", "enabled", "snapshot",
+           "render_json", "render_prometheus", "write_snapshot",
+           "start_exporter", "stop_exporter", "span", "active_spans",
+           "FlightRecorder", "get_recorder", "record_step"]
+
+# jax.monitoring listeners are cheap (no work between compile events) and
+# honor the live MXNET_TELEMETRY switch themselves, so install eagerly —
+# the first compile after import is already counted.
+jit_hooks.install()
+
+# Exporter autostart: opt-in by env, so `MXNET_TELEMETRY_EXPORT=/run/m.json
+# python train.py` needs no code change.
+if get_env("MXNET_TELEMETRY_EXPORT", ""):
+    start_exporter()

@@ -1,0 +1,116 @@
+"""Built-in metric catalog — every family the framework itself publishes.
+
+Declared centrally (not at each instrumentation site) so a snapshot always
+contains the full catalog regardless of which subsystems a given run
+imported: a dashboard scraping ``mxtpu_kv_publish_ms`` sees the family (with
+zero series) even in a run that never created a dist kvstore, instead of a
+404-shaped absence. Instrumentation sites import their family objects from
+here; user code can mint additional metrics via ``observability.counter``/
+``gauge``/``histogram`` freely.
+
+The human-oriented catalog with semantics lives in ``docs/observability.md``
+— keep the two in sync.
+"""
+from __future__ import annotations
+
+from . import metrics as _m
+
+# --------------------------------------------------------------- trainer
+STEP_MS = _m.histogram(
+    "mxtpu_trainer_step_ms",
+    "Wall time of DataParallelTrainer.step (host dispatch + any sync the "
+    "caller's loop forces).")
+STEPS_TOTAL = _m.counter(
+    "mxtpu_trainer_steps_total", "Fused train steps dispatched.")
+SAMPLES_TOTAL = _m.counter(
+    "mxtpu_trainer_samples_total",
+    "Training samples consumed (leading batch dim of the first input).")
+SAMPLES_PER_SEC = _m.gauge(
+    "mxtpu_trainer_samples_per_sec",
+    "Throughput of the most recent step (samples / step wall time).")
+CAPTURES_TOTAL = _m.counter(
+    "mxtpu_trainer_captures_total",
+    "Net captures (graph trace + jit rebuild). More than one per input "
+    "signature means something is forcing re-capture.")
+GRAD_SKIPPED = _m.gauge(
+    "mxtpu_trainer_grad_skipped_steps",
+    "Grad-guard skip-step count (published when anomaly_stats()/Monitor "
+    "drains the device counters — never synced per step).")
+GRAD_NORM_EMA = _m.gauge(
+    "mxtpu_trainer_grad_norm_ema", "Grad-guard gradient-norm EMA.")
+GRAD_LAST_NORM = _m.gauge(
+    "mxtpu_trainer_last_grad_norm", "Gradient norm of the last guarded step.")
+STEP_RETRIES = _m.counter(
+    "mxtpu_trainer_step_retries_total",
+    "Transient step failures retried by ResilientTrainer.")
+
+# ---------------------------------------------------------------- module
+FIT_EPOCH_MS = _m.histogram(
+    "mxtpu_fit_epoch_ms", "Module.fit wall time per epoch.",
+    buckets=(100, 500, 1000, 5000, 15000, 60000, 300000, 1800000))
+FIT_BATCHES = _m.counter(
+    "mxtpu_fit_batches_total", "Batches processed by Module.fit.")
+
+# --------------------------------------------------------------- kvstore
+KV_PUBLISH_MS = _m.histogram(
+    "mxtpu_kv_publish_ms",
+    "dist kvstore weight-publish latency (coordination-service round "
+    "trip), per attempt.")
+KV_PUBLISH_RETRIES = _m.counter(
+    "mxtpu_kv_publish_retries_total",
+    "Publish attempts that failed transiently and backed off.")
+KV_PUBLISH_FAILURES = _m.counter(
+    "mxtpu_kv_publish_failures_total",
+    "Publishes that exhausted their retry budget (TransientKVError).")
+KV_PUSH_TOTAL = _m.counter(
+    "mxtpu_kv_push_total", "kvstore push operations.")
+KV_PULL_TOTAL = _m.counter(
+    "mxtpu_kv_pull_total", "kvstore pull operations.")
+
+# ------------------------------------------------------------ checkpoint
+CKPT_SAVE_MS = _m.histogram(
+    "mxtpu_checkpoint_save_ms",
+    "ShardedCheckpointer.save wall time, labeled mode=sync|async (async "
+    "measures snapshot+dispatch; serialization overlaps training).",
+    buckets=(5, 25, 100, 500, 1000, 5000, 15000, 60000, 300000))
+CKPT_COMMIT_MS = _m.histogram(
+    "mxtpu_checkpoint_commit_ms",
+    "Manifest + marker + atomic publish rename time.",
+    buckets=(1, 5, 25, 100, 500, 1000, 5000, 15000))
+CKPT_RESTORE_MS = _m.histogram(
+    "mxtpu_checkpoint_restore_ms", "Checkpoint restore wall time.",
+    buckets=(5, 25, 100, 500, 1000, 5000, 15000, 60000, 300000))
+CKPT_BYTES = _m.counter(
+    "mxtpu_checkpoint_bytes_total", "Bytes committed to checkpoints.")
+CKPT_LAST_BYTES = _m.gauge(
+    "mxtpu_checkpoint_last_bytes", "Size of the most recent checkpoint.")
+CKPT_VERIFY_FAILURES = _m.counter(
+    "mxtpu_checkpoint_verify_failures_total",
+    "verify() calls that found a torn/uncommitted checkpoint.")
+
+# ------------------------------------------------------------ collectives
+COLL_DISPATCHES = _m.counter(
+    "mxtpu_collective_dispatches_total",
+    "Host-level collective dispatches, labeled op=psum|cp_allreduce|"
+    "cp_alltoall|cp_allgather.")
+COLL_BYTES = _m.counter(
+    "mxtpu_collective_bytes_total",
+    "Payload bytes entering host-level collectives, labeled op=.")
+
+# ------------------------------------------------------------- resilience
+WATCHDOG_FIRED = _m.counter(
+    "mxtpu_watchdog_timeouts_total", "Watchdog deadline expirations.")
+PREEMPTIONS = _m.counter(
+    "mxtpu_preemptions_total",
+    "Preemption signals honored at a step boundary (final save + exit).")
+FLIGHT_DUMPS = _m.counter(
+    "mxtpu_flight_recorder_dumps_total",
+    "Flight-recorder artifacts written, labeled reason=.")
+
+# -------------------------------------------------------------- callbacks
+SPEEDOMETER_SPS = _m.gauge(
+    "mxtpu_speedometer_samples_per_sec",
+    "Speedometer throughput (same number as its log line).")
+MONITOR_STAT = _m.gauge(
+    "mxtpu_monitor_stat",
+    "Monitor layer statistics, labeled stat= (the Monitor.toc stream).")

@@ -1,0 +1,192 @@
+"""Flight recorder — the last N step records, dumped when the run dies.
+
+A hung collective, a preemption, or an unhandled trainer exception leaves
+nothing behind but a stack trace; the question that actually matters —
+*what was the run doing in the steps leading up to it* — needs data that was
+being recorded BEFORE the failure. The flight recorder is a bounded ring
+buffer of per-step records (step index, loss, wall step time, active spans)
+appended by the instrumented trainers at effectively zero cost:
+
+- no host sync: the loss is stored as whatever reference the trainer already
+  holds (an async XLA scalar); it is resolved to a float only at dump time,
+  on the crash path, where a blocking read costs nothing that matters.
+- bounded memory: a ``deque(maxlen=N)``; N scalars worth of device buffers
+  pinned at most (outputs, never donated inputs).
+
+Dump triggers (all write the same artifact):
+
+- :class:`~mxnet_tpu.resilience.watchdog.Watchdog` timeout — the dump path
+  also appends the recorder tail to the thread-stack dump on stderr;
+- preemption (``ResilientTrainer``'s final save before raising Preempted);
+- any unhandled exception escaping ``ResilientTrainer.step``.
+
+Artifact schema (``docs/observability.md``): ``{"version": 1, "reason":
+str, "time": float, "pid": int, "extra": {...}, "records": [{"step": int,
+"time": float, "loss": float|None, "step_ms": float|None, "spans": [...],
+...}]}`` — newest record last.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from ..base import get_env, logger, register_config
+from . import metrics as _metrics
+from . import spans as _spans
+
+__all__ = ["FlightRecorder", "get_recorder", "record_step", "dump",
+           "tail_lines"]
+
+register_config("MXNET_TELEMETRY_FLIGHT_RECORDS", 256, int,
+                "Flight-recorder ring size (per-step records kept for crash "
+                "forensics). 0 disables the recorder.")
+register_config("MXNET_TELEMETRY_FLIGHT_PATH", "mxtpu_flight_recorder.json",
+                str, "Where crash-triggered flight-recorder dumps land.")
+
+
+class FlightRecorder:
+    """Bounded ring of per-step records with a crash-dump serializer."""
+
+    def __init__(self, capacity: Optional[int] = None):
+        if capacity is None:
+            capacity = int(get_env("MXNET_TELEMETRY_FLIGHT_RECORDS", 256))
+        self.capacity = max(0, int(capacity))
+        self._ring: deque = deque(maxlen=self.capacity or 1)
+        self._lock = threading.Lock()
+
+    @property
+    def enabled(self) -> bool:
+        return self.capacity > 0 and _metrics.enabled()
+
+    def record(self, step: int, loss: Any = None,
+               step_ms: Optional[float] = None, **extra) -> None:
+        """Append one step record. ``loss`` may be a live device scalar —
+        it is NOT synced here; resolution happens at dump time."""
+        if not self.enabled:
+            return
+        rec = {"step": int(step), "time": time.time(), "loss": loss,
+               "step_ms": step_ms, "spans": list(_spans.active_spans())}
+        if extra:
+            rec.update(extra)
+        with self._lock:
+            self._ring.append(rec)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+    # ------------------------------------------------------------- readout
+    def records(self) -> List[Dict[str, Any]]:
+        """Resolved copies of every record, oldest first. Lazy values (device
+        scalars) are materialized here; a deleted/unreadable buffer becomes
+        None rather than failing the dump."""
+        with self._lock:
+            raw = list(self._ring)
+        return [self._resolve(r) for r in raw]
+
+    def tail(self, n: int = 8) -> List[Dict[str, Any]]:
+        with self._lock:
+            raw = list(self._ring)[-n:]
+        return [self._resolve(r) for r in raw]
+
+    @staticmethod
+    def _resolve(rec: Dict[str, Any]) -> Dict[str, Any]:
+        out = dict(rec)
+        loss = out.get("loss")
+        if loss is not None and not isinstance(loss, (int, float)):
+            try:
+                # NEVER block here: the dump runs on crash paths — on a
+                # watchdog timeout the device program is by definition
+                # stuck, and a float() of a value queued behind it would
+                # hang the watchdog thread itself. An unready value reads
+                # as None ('not resolved before the crash' is signal too).
+                if hasattr(loss, "is_ready") and not loss.is_ready():
+                    out["loss"] = None
+                else:
+                    out["loss"] = float(loss)
+            except Exception:
+                out["loss"] = None
+        return out
+
+    # ---------------------------------------------------------------- dump
+    def dump(self, path: Optional[str] = None, reason: str = "",
+             extra: Optional[Dict[str, Any]] = None) -> Optional[str]:
+        """Write the artifact; returns its path (None when the recorder is
+        empty AND disabled — an empty artifact from an enabled run is still
+        written: 'recorder was on but nothing completed' is itself signal).
+        Never raises: this runs on crash paths."""
+        if self.capacity <= 0 or not _metrics.enabled():
+            return None
+        try:
+            path = path or str(get_env("MXNET_TELEMETRY_FLIGHT_PATH",
+                                       "mxtpu_flight_recorder.json"))
+            doc = {"version": 1, "reason": reason, "time": time.time(),
+                   "pid": os.getpid(), "extra": extra or {},
+                   "records": self.records()}
+            tmp = "%s.tmp.%d" % (path, os.getpid())
+            with open(tmp, "w") as f:
+                json.dump(doc, f, indent=1, sort_keys=True, default=_json_default)
+            os.replace(tmp, path)
+            return path
+        except Exception as e:  # pragma: no cover - crash-path best effort
+            try:
+                logger.warning("flight recorder dump failed: %r", e)
+            except Exception:
+                pass
+            return None
+
+    def tail_lines(self, n: int = 8) -> List[str]:
+        """Human-oriented one-liners of the newest records (appended to the
+        watchdog's thread-stack dump)."""
+        out = []
+        for r in self.tail(n):
+            loss = r.get("loss")
+            ms = r.get("step_ms")
+            out.append("step %6d  loss %-12s step_ms %-10s spans %s" % (
+                r.get("step", -1),
+                ("%.6f" % loss) if isinstance(loss, float) else "n/a",
+                ("%.1f" % ms) if isinstance(ms, (int, float)) else "n/a",
+                ",".join(r.get("spans") or ()) or "-"))
+        return out
+
+
+def _json_default(o):
+    try:
+        return float(o)
+    except Exception:
+        return repr(o)
+
+
+# ---- process-wide default recorder -----------------------------------------
+_default_lock = threading.Lock()
+_default: Optional[FlightRecorder] = None
+
+
+def get_recorder() -> FlightRecorder:
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = FlightRecorder()
+        return _default
+
+
+def record_step(step: int, loss: Any = None,
+                step_ms: Optional[float] = None, **extra) -> None:
+    get_recorder().record(step, loss=loss, step_ms=step_ms, **extra)
+
+
+def dump(reason: str = "", path: Optional[str] = None,
+         extra: Optional[Dict[str, Any]] = None) -> Optional[str]:
+    return get_recorder().dump(path=path, reason=reason, extra=extra)
+
+
+def tail_lines(n: int = 8) -> List[str]:
+    return get_recorder().tail_lines(n)

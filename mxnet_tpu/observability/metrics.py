@@ -1,0 +1,380 @@
+"""Low-overhead metrics registry — counters, gauges, histograms with labels.
+
+The reference framework measured itself through three disconnected channels
+(profiler chrome-trace, Monitor stat queue, Speedometer log lines); this
+module is the shared metrics model they all publish into. Design constraints,
+in order:
+
+1. **Never enter the XLA trace.** Every observation is host-side Python on
+   concrete floats; instrumented code gates on :func:`enabled` so a disabled
+   run does no registry work at all and the jitted step's HLO is bitwise
+   unchanged (tier-1 guards this).
+2. **Cheap when on.** An observation is one lock acquire + a dict update;
+   label series are keyed by a pre-sorted tuple. No string formatting until
+   exposition.
+3. **Exposition-agnostic.** ``snapshot()`` is the canonical plain-dict form;
+   ``render_json``/``render_prometheus`` serialize it. A background exporter
+   thread (``MXNET_TELEMETRY_EXPORT``) writes either format periodically so
+   a sidecar/scraper can watch a training run without touching the loop.
+
+Env knobs (registered in ``base.config``): ``MXNET_TELEMETRY`` master switch,
+``MXNET_TELEMETRY_EXPORT`` snapshot path (``.prom``/``.txt`` → Prometheus
+text format, else JSON), ``MXNET_TELEMETRY_EXPORT_INTERVAL`` seconds.
+"""
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+import time
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from ..base import MXNetError, get_env, logger, register_config
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
+           "enabled", "counter", "gauge", "histogram", "snapshot",
+           "render_json", "render_prometheus", "write_snapshot",
+           "start_exporter", "stop_exporter", "DEFAULT_BUCKETS_MS"]
+
+register_config("MXNET_TELEMETRY", True, bool,
+                "Master switch for the runtime telemetry registry. 0 turns "
+                "every instrumentation point into a no-op; the jitted step's "
+                "HLO is identical either way (telemetry is host-side only).")
+register_config("MXNET_TELEMETRY_EXPORT", "", str,
+                "Path the background exporter periodically writes metric "
+                "snapshots to (.prom/.txt = Prometheus text format, "
+                "anything else = JSON). Empty = no exporter thread.")
+register_config("MXNET_TELEMETRY_EXPORT_INTERVAL", 10.0, float,
+                "Seconds between background exporter snapshots.")
+
+# Histogram default: latency-in-ms oriented, exponential-ish. +Inf is
+# implicit — every histogram gets a catch-all bucket.
+DEFAULT_BUCKETS_MS = (1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
+                      1000.0, 2500.0, 5000.0, 10000.0, 30000.0)
+
+
+def enabled() -> bool:
+    """Live read of the master switch (env wins over programmatic set) —
+    cheap enough for per-step gates, and monkeypatch/setenv takes effect
+    immediately, no process restart."""
+    return bool(get_env("MXNET_TELEMETRY", True))
+
+
+def _label_key(labels: Dict[str, Any]) -> Tuple[Tuple[str, str], ...]:
+    if not labels:
+        return ()
+    return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+
+class _Metric:
+    """Shared series bookkeeping; subclasses define the per-series value."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str = "", registry=None):
+        self.name = name
+        self.help = help
+        self._series: Dict[Tuple[Tuple[str, str], ...], Any] = {}
+        self._lock = threading.Lock() if registry is None else registry._lock
+
+    # -- exposition ---------------------------------------------------------
+    def series(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            items = list(self._series.items())
+        return [dict(labels=dict(k), **self._series_dict(v))
+                for k, v in items]
+
+    def _series_dict(self, value) -> Dict[str, Any]:
+        return {"value": value}
+
+
+class Counter(_Metric):
+    """Monotonically increasing count (events, bytes, retries)."""
+
+    kind = "counter"
+
+    def inc(self, amount: float = 1, **labels) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0) + amount
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return self._series.get(_label_key(labels), 0)
+
+
+class Gauge(_Metric):
+    """Point-in-time value (samples/sec, queue depth, last norm)."""
+
+    kind = "gauge"
+
+    def set(self, value: float, **labels) -> None:
+        with self._lock:
+            self._series[_label_key(labels)] = value
+
+    def inc(self, amount: float = 1, **labels) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0) + amount
+
+    def dec(self, amount: float = 1, **labels) -> None:
+        self.inc(-amount, **labels)
+
+    def value(self, **labels) -> Optional[float]:
+        with self._lock:
+            return self._series.get(_label_key(labels))
+
+
+class Histogram(_Metric):
+    """Bucketed distribution (latencies, sizes): cumulative-style buckets at
+    exposition, per-bucket counts internally."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str = "",
+                 buckets: Iterable[float] = DEFAULT_BUCKETS_MS, registry=None):
+        super().__init__(name, help, registry=registry)
+        bs = tuple(sorted(float(b) for b in buckets))
+        if not bs:
+            raise MXNetError(f"histogram {name!r} needs at least one bucket")
+        self.buckets = bs
+
+    def observe(self, value: float, **labels) -> None:
+        key = _label_key(labels)
+        value = float(value)
+        with self._lock:
+            st = self._series.get(key)
+            if st is None:
+                st = {"counts": [0] * (len(self.buckets) + 1),
+                      "sum": 0.0, "count": 0, "max": -math.inf}
+                self._series[key] = st
+            i = 0
+            for b in self.buckets:
+                if value <= b:
+                    break
+                i += 1
+            st["counts"][i] += 1
+            st["sum"] += value
+            st["count"] += 1
+            if value > st["max"]:
+                st["max"] = value
+
+    def _series_dict(self, st) -> Dict[str, Any]:
+        # cumulative counts per upper bound (prometheus 'le' semantics)
+        cum, total = {}, 0
+        for b, c in zip(self.buckets, st["counts"]):
+            total += c
+            cum[repr(b) if b != int(b) else str(int(b))] = total
+        cum["+Inf"] = total + st["counts"][-1]
+        return {"sum": st["sum"], "count": st["count"],
+                "max": (st["max"] if st["count"] else 0.0), "buckets": cum}
+
+    def count(self, **labels) -> int:
+        with self._lock:
+            st = self._series.get(_label_key(labels))
+            return st["count"] if st else 0
+
+
+class MetricsRegistry:
+    """Thread-safe name → metric map with get-or-create semantics: a metric
+    family is declared once (module import time at the instrumentation
+    site or in ``catalog.py``) and re-requests return the same object, so
+    declaration order never matters. Re-declaring under a different type is
+    a programming error and raises."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._metrics: Dict[str, _Metric] = {}
+
+    def _get_or_create(self, cls, name, help, **kwargs):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                if not isinstance(m, cls):
+                    raise MXNetError(
+                        f"metric {name!r} already registered as {m.kind}, "
+                        f"cannot re-register as {cls.kind}")
+                return m
+            m = cls(name, help, registry=self, **kwargs)
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_create(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get_or_create(Gauge, name, help)
+
+    def histogram(self, name: str, help: str = "",
+                  buckets: Iterable[float] = DEFAULT_BUCKETS_MS) -> Histogram:
+        return self._get_or_create(Histogram, name, help, buckets=buckets)
+
+    def get(self, name: str) -> Optional[_Metric]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def clear_values(self) -> None:
+        """Reset every series (families stay declared) — test isolation."""
+        with self._lock:
+            for m in self._metrics.values():
+                m._series.clear()
+
+    # ---------------------------------------------------------- exposition
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            metrics = dict(self._metrics)
+        return {
+            "version": 1,
+            "time": time.time(),
+            "pid": os.getpid(),
+            "metrics": {
+                name: {"type": m.kind, "help": m.help,
+                       **({"buckets": list(m.buckets)}
+                          if isinstance(m, Histogram) else {}),
+                       "series": m.series()}
+                for name, m in sorted(metrics.items())},
+        }
+
+    def render_json(self, indent: Optional[int] = 1) -> str:
+        return json.dumps(self.snapshot(), indent=indent, sort_keys=True)
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition format 0.0.4."""
+        out: List[str] = []
+        snap = self.snapshot()
+        for name, m in snap["metrics"].items():
+            if m["help"]:
+                out.append(f"# HELP {name} {_esc_help(m['help'])}")
+            out.append(f"# TYPE {name} {m['type']}")
+            for s in m["series"]:
+                lbl = s["labels"]
+                if m["type"] == "histogram":
+                    for le, c in s["buckets"].items():
+                        out.append("%s_bucket%s %s" % (
+                            name, _fmt_labels(dict(lbl, le=le)), c))
+                    out.append("%s_sum%s %s" % (name, _fmt_labels(lbl),
+                                                _fmt_val(s["sum"])))
+                    out.append("%s_count%s %s" % (name, _fmt_labels(lbl),
+                                                  s["count"]))
+                else:
+                    out.append("%s%s %s" % (name, _fmt_labels(lbl),
+                                            _fmt_val(s["value"])))
+        return "\n".join(out) + "\n"
+
+
+def _esc_help(s: str) -> str:
+    return s.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _esc_label(s: str) -> str:
+    return s.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _fmt_labels(labels: Dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join('%s="%s"' % (k, _esc_label(str(v)))
+                     for k, v in sorted(labels.items()))
+    return "{%s}" % inner
+
+
+def _fmt_val(v) -> str:
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+# ---- default registry + module-level conveniences --------------------------
+REGISTRY = MetricsRegistry()
+
+counter = REGISTRY.counter
+gauge = REGISTRY.gauge
+histogram = REGISTRY.histogram
+snapshot = REGISTRY.snapshot
+render_json = REGISTRY.render_json
+render_prometheus = REGISTRY.render_prometheus
+
+
+def write_snapshot(path: str, registry: Optional[MetricsRegistry] = None) -> str:
+    """Write one snapshot to ``path`` (atomic rename; format by extension:
+    .prom/.txt → Prometheus text, else JSON). Returns the path."""
+    reg = registry or REGISTRY
+    text = (reg.render_prometheus()
+            if path.endswith((".prom", ".txt")) else reg.render_json())
+    # temp name must be unique per WRITER, not just per process: the
+    # exporter thread and a direct write_snapshot call may race on the
+    # same path (e.g. the final-on-stop write vs the periodic one)
+    tmp = "%s.tmp.%d.%d" % (path, os.getpid(), threading.get_ident())
+    with open(tmp, "w") as f:
+        f.write(text)
+    os.replace(tmp, path)
+    return path
+
+
+# ---- background exporter ----------------------------------------------------
+_exporter_lock = threading.Lock()
+_exporter_stop: Optional[threading.Event] = None
+_exporter_thread: Optional[threading.Thread] = None
+_atexit_registered = False
+
+
+def start_exporter(path: Optional[str] = None,
+                   interval: Optional[float] = None) -> bool:
+    """Start the periodic snapshot writer (idempotent). Returns True if a
+    thread is running after the call. Arguments default to the
+    MXNET_TELEMETRY_EXPORT / _EXPORT_INTERVAL knobs; no path = no-op.
+    The MXNET_TELEMETRY master switch wins: disabled telemetry means no
+    exporter thread and no files on disk."""
+    global _exporter_stop, _exporter_thread
+    if not enabled():
+        return False
+    path = path or str(get_env("MXNET_TELEMETRY_EXPORT", "") or "")
+    if not path:
+        return False
+    interval = float(interval if interval is not None
+                     else get_env("MXNET_TELEMETRY_EXPORT_INTERVAL", 10.0))
+    with _exporter_lock:
+        if _exporter_thread is not None and _exporter_thread.is_alive():
+            return True
+        stop = threading.Event()
+
+        def loop():
+            while not stop.wait(interval):
+                try:
+                    write_snapshot(path)
+                except Exception as e:  # never kill the host program
+                    logger.warning("telemetry exporter write failed: %r", e)
+            try:       # final snapshot on clean stop so short runs export
+                write_snapshot(path)
+            except Exception:
+                pass
+
+        t = threading.Thread(target=loop, daemon=True,
+                             name="mxtpu-telemetry-exporter")
+        t.start()
+        _exporter_stop, _exporter_thread = stop, t
+        # a daemon thread dies silently at interpreter exit — without this
+        # hook a run shorter than the interval would export NOTHING, and
+        # any run would lose its final partial interval
+        global _atexit_registered
+        if not _atexit_registered:
+            import atexit
+            atexit.register(stop_exporter)
+            _atexit_registered = True
+        return True
+
+
+def stop_exporter() -> None:
+    """Stop the exporter thread (it writes one final snapshot on the way
+    out, so even a run shorter than the interval exports something)."""
+    global _exporter_stop, _exporter_thread
+    with _exporter_lock:
+        stop, t = _exporter_stop, _exporter_thread
+        _exporter_stop = _exporter_thread = None
+    if stop is None:
+        return
+    stop.set()
+    if t is not None:
+        t.join(timeout=2.0)

@@ -1,0 +1,104 @@
+"""Spans — one instrumentation point, two backends.
+
+A span is a named timed region (context manager or decorator). On exit it
+
+- observes its duration into the ``mxtpu_span_ms`` histogram (labeled by
+  span name, plus any user labels), and
+- emits a chrome-trace event into :mod:`mxnet_tpu.profiler` when a profiling
+  session is recording,
+
+so the same ``with span("data_load"):`` lights up the Prometheus/JSON
+exposition AND the chrome://tracing timeline. The flight recorder reads the
+thread's active-span stack to note what was in flight at each step record
+(and therefore at crash time).
+
+Both gates (telemetry switch, profiler session) are evaluated at ``__enter__``
+time, so a span created at import/decoration time tracks runtime toggles; a
+fully-disabled span does nothing but two boolean checks.
+"""
+from __future__ import annotations
+
+import functools
+import threading
+import time
+from typing import Tuple
+
+from . import metrics as _metrics
+
+__all__ = ["span", "active_spans", "SPAN_MS"]
+
+SPAN_MS = _metrics.histogram(
+    "mxtpu_span_ms", "Duration of instrumented spans, by span name.")
+
+_tls = threading.local()
+
+
+def _stack() -> list:
+    st = getattr(_tls, "stack", None)
+    if st is None:
+        st = _tls.stack = []
+    return st
+
+
+def active_spans() -> Tuple[str, ...]:
+    """Names of spans currently open on THIS thread, outermost first."""
+    return tuple(_stack())
+
+
+def _profiler_recording() -> bool:
+    try:
+        from .. import profiler
+        return profiler.recording()
+    except Exception:
+        return False
+
+
+class span:
+    """Timed region: ``with span("kv_publish", key=k): ...`` or
+    ``@span("evaluate")`` on a function (a fresh region per call). Feeds the
+    span histogram and — when a profiler session is recording — the
+    chrome-trace stream."""
+
+    __slots__ = ("name", "category", "labels", "_t0", "_us0", "_tel",
+                 "_prof")
+
+    def __init__(self, name: str, category: str = "span", **labels):
+        self.name = name
+        self.category = category
+        self.labels = labels
+
+    def __enter__(self):
+        self._tel = _metrics.enabled()
+        self._prof = _profiler_recording()
+        if self._tel or self._prof:
+            _stack().append(self.name)
+            self._t0 = time.perf_counter()
+            if self._prof:
+                from .. import profiler
+                self._us0 = profiler._prof.us()
+        return self
+
+    def __exit__(self, *exc):
+        if not (self._tel or self._prof):
+            return False
+        dt = time.perf_counter() - self._t0
+        st = _stack()
+        if st and st[-1] == self.name:
+            st.pop()
+        if self._tel:
+            SPAN_MS.observe(dt * 1000.0, span=self.name, **self.labels)
+        if self._prof:
+            from .. import profiler
+            profiler.record_event(self.name, self.category, self._us0,
+                                  dt * 1e6, self.labels or None)
+        return False
+
+    def __call__(self, fn):
+        name, category, labels = self.name, self.category, self.labels
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with span(name, category=category, **labels):
+                return fn(*args, **kwargs)
+
+        return wrapper

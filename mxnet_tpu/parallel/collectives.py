@@ -24,6 +24,25 @@ except ImportError:  # older jax
     from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..observability import catalog as _telemetry
+from ..observability import metrics as _obs_metrics
+
+
+def _count_dispatch(op: str, arrays) -> None:
+    """Host-side dispatch accounting (counters only, never inside a traced
+    function — the inside-shard_map primitives above stay untouched)."""
+    if not _obs_metrics.enabled():
+        return
+    _telemetry.COLL_DISPATCHES.inc(op=op)
+    nbytes = 0
+    for a in arrays:
+        size = getattr(a, "size", None)
+        dt = getattr(a, "dtype", None)
+        if size is not None and dt is not None:
+            nbytes += int(size) * int(jnp.dtype(dt).itemsize)
+    if nbytes:
+        _telemetry.COLL_BYTES.inc(nbytes, op=op)
+
 __all__ = ["allreduce", "allgather", "reduce_scatter", "broadcast", "ppermute",
            "all_to_all", "psum_arrays", "cross_process_allreduce",
            "cross_process_allreduce_many", "cross_process_alltoall",
@@ -71,6 +90,7 @@ def _psum_fn(mesh: Mesh, axis: str, n: int):
 
 def psum_arrays(arrays: Sequence, mesh: Mesh, axis: str = "dp") -> List:
     """Allreduce a list of arrays sharded on ``axis`` (leading dim)."""
+    _count_dispatch("psum", arrays)
     fn = _psum_fn(mesh, axis, len(arrays))
     return list(fn(*arrays))
 
@@ -81,6 +101,7 @@ def cross_process_allreduce(x):
     stacking path rejects multi-host arrays) and reduces it."""
     if jax.process_count() == 1:
         return x
+    _count_dispatch("cp_allreduce", (x,))
     from jax.experimental import multihost_utils
     gathered = multihost_utils.process_allgather(x[None], tiled=True)
     return jnp.asarray(gathered).sum(axis=0)
@@ -129,6 +150,7 @@ def cross_process_alltoall(x):
     x = jnp.asarray(x)
     if nprocs == 1:
         return x
+    _count_dispatch("cp_alltoall", (x,))
     from jax.experimental import multihost_utils
     mesh, fn = _alltoall_fn(nprocs)
     g = multihost_utils.host_local_array_to_global_array(
@@ -166,6 +188,7 @@ def cross_process_allgather_tiled(x):
     concatenation ``(nprocs * s,)`` on every process."""
     if jax.process_count() == 1:
         return jnp.asarray(x)
+    _count_dispatch("cp_allgather", (x,))
     from jax.experimental import multihost_utils
     return jnp.asarray(
         multihost_utils.process_allgather(jnp.asarray(x)[None], tiled=True)

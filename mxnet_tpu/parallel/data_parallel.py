@@ -14,6 +14,7 @@ parameters live as a pytree; after training, ``sync_to_net()`` writes back.
 from __future__ import annotations
 
 import functools
+import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
@@ -25,6 +26,9 @@ from ..base import MXNetError
 from ..executor import _GraphLowering
 from ..ndarray import NDArray
 from ..ndarray.ndarray import _unwrap, _wrap
+from ..observability import catalog as _telemetry
+from ..observability import flight_recorder as _flight
+from ..observability import metrics as _metrics
 from .mesh import local_mesh
 
 __all__ = ["DataParallelTrainer", "make_train_step", "sgd_momentum_init",
@@ -221,6 +225,8 @@ class DataParallelTrainer:
     def _capture(self, n_inputs: int, sample_arrays=None):
         from .. import symbol as sym_mod
         from .. import autograd
+        if _metrics.enabled():
+            _telemetry.CAPTURES_TOTAL.inc()
         # a re-capture rebuilds params/opt_state from the net; any loaded
         # executable is keyed to the OLD pytree/placement and must not be
         # re-entered afterwards
@@ -519,7 +525,16 @@ class DataParallelTrainer:
     # ------------------------------------------------------------- stepping
     def step(self, *data) -> float:
         """One fused fwd+bwd+allreduce+update step on a global batch.
-        Returns the scalar loss (an async device value; float() to sync)."""
+        Returns the scalar loss (an async device value; float() to sync).
+
+        Telemetry (``observability``): step wall time, samples/sec and a
+        flight-recorder record per step — all strictly host-side, OUTSIDE
+        the jitted function, so the compiled HLO is identical with
+        telemetry on or off, and nothing here syncs the device (the loss
+        stays an async value; the recorder resolves it only at dump time).
+        """
+        tel = _metrics.enabled()
+        t0 = time.perf_counter() if tel else 0.0
         arrays = [_unwrap(d) if isinstance(d, NDArray) else jnp.asarray(d)
                   for d in data]
         if self._step_fn is None or self._n_inputs != len(arrays):
@@ -531,18 +546,34 @@ class DataParallelTrainer:
                                  self._rng_counter)
         self._rng_counter += 1
         if self._kv is not None:
-            return self._kv_step(rng, arrays)
-        fn = self._step_fn
-        if (self._compiled is not None
-                and _shape_key(arrays) == self._compiled_shapes):
-            # the deserialized executable is shape-exact; a batch with
-            # other shapes (e.g. a ragged final batch) takes the jit path
-            # for that call only, keeping the executable for exact matches
-            fn = self._compiled
-            rng = jax.device_put(rng, NamedSharding(self._mesh, P()))
-        (self._params, self._aux, self._opt_state, self._guard_state,
-         loss) = fn(self._params, self._aux, self._opt_state,
-                    self._guard_state, rng, *arrays)
+            loss = self._kv_step(rng, arrays)
+        else:
+            fn = self._step_fn
+            if (self._compiled is not None
+                    and _shape_key(arrays) == self._compiled_shapes):
+                # the deserialized executable is shape-exact; a batch with
+                # other shapes (e.g. a ragged final batch) takes the jit
+                # path for that call only, keeping the executable for
+                # exact matches
+                fn = self._compiled
+                rng = jax.device_put(rng, NamedSharding(self._mesh, P()))
+            (self._params, self._aux, self._opt_state, self._guard_state,
+             loss) = fn(self._params, self._aux, self._opt_state,
+                        self._guard_state, rng, *arrays)
+        if tel:
+            dt = time.perf_counter() - t0
+            ms = dt * 1000.0
+            samples = int(arrays[0].shape[0]) if (
+                arrays and getattr(arrays[0], "ndim", 0)) else 0
+            _telemetry.STEP_MS.observe(ms)
+            _telemetry.STEPS_TOTAL.inc()
+            if samples:
+                _telemetry.SAMPLES_TOTAL.inc(samples)
+                if dt > 0:
+                    _telemetry.SAMPLES_PER_SEC.set(samples / dt)
+            # rng_counter just advanced: it IS the completed-step count
+            # (ResilientTrainer.step_count tracks the same number)
+            _flight.record_step(self._rng_counter, loss=loss, step_ms=ms)
         return loss
 
     def _kv_step(self, rng, arrays):
@@ -600,10 +631,17 @@ class DataParallelTrainer:
         if self._guard_cfg is None or self._guard_state is None:
             return {}
         gs = self._guard_state
-        return {"grad_skipped_steps": int(gs["skips"]),
-                "grad_norm_ema": float(gs["ema"]),
-                "last_grad_norm": float(gs["last_norm"]),
-                "last_step_skipped": bool(int(gs["last_skipped"]))}
+        stats = {"grad_skipped_steps": int(gs["skips"]),
+                 "grad_norm_ema": float(gs["ema"]),
+                 "last_grad_norm": float(gs["last_norm"]),
+                 "last_step_skipped": bool(int(gs["last_skipped"]))}
+        if _metrics.enabled():
+            # publish at drain time (Monitor interval / user poll), never
+            # per step — reading the guard scalars syncs the device
+            _telemetry.GRAD_SKIPPED.set(stats["grad_skipped_steps"])
+            _telemetry.GRAD_NORM_EMA.set(stats["grad_norm_ema"])
+            _telemetry.GRAD_LAST_NORM.set(stats["last_grad_norm"])
+        return stats
 
     @property
     def mesh(self) -> Mesh:

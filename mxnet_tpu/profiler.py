@@ -33,7 +33,10 @@ _lock = threading.Lock()
 class _ProfilerState:
     def __init__(self):
         self.running = False
-        self.paused = False
+        # pause depth, not a flag: pause()/resume() nest (refcounted), so a
+        # library span that brackets its own pause/resume can never un-pause
+        # a user's outer pause (reference profiler.cc pause counter)
+        self.pause_depth = 0
         self.events: List[dict] = []
         self.filename = "profile.json"
         self.modes = {"symbolic": True, "imperative": True, "api": False,
@@ -141,7 +144,7 @@ def set_config(profile_all=False, profile_symbolic=False, profile_imperative=Fal
 def start():
     with _lock:
         _prof.running = True
-        _prof.paused = False
+        _prof.pause_depth = 0
         _prof.t0 = time.perf_counter()
         _prof.events = []
     if _prof.xla_trace_dir:
@@ -220,19 +223,26 @@ def _merge_xla_trace(trace_dir: str) -> int:
 
 
 def pause(profile_process="worker"):
+    """Suspend event recording. Nestable: each ``pause()`` must be matched
+    by one ``resume()`` — recording restarts only when the depth returns to
+    zero, so instrumentation bracketing its own pause/resume cannot
+    un-pause an enclosing user pause."""
     if profile_process == "server":
         from .kvstore import CMD_PROFILER_PAUSE
         return _send_server_cmd(CMD_PROFILER_PAUSE,
                                 json.dumps({"paused": True}))
-    _prof.paused = True
+    with _lock:
+        _prof.pause_depth += 1
 
 
 def resume(profile_process="worker"):
+    """Undo one ``pause()`` (refcounted; extra resumes are no-ops)."""
     if profile_process == "server":
         from .kvstore import CMD_PROFILER_PAUSE
         return _send_server_cmd(CMD_PROFILER_PAUSE,
                                 json.dumps({"paused": False}))
-    _prof.paused = False
+    with _lock:
+        _prof.pause_depth = max(0, _prof.pause_depth - 1)
 
 
 def profiler_set_state(state="stop"):
@@ -254,7 +264,15 @@ def set_state(state="stop", profile_process="worker"):
 
 
 def is_active(kind: str = "imperative") -> bool:
-    return _prof.running and not _prof.paused and _prof.modes.get(kind, False)
+    return _prof.running and _prof.pause_depth == 0 \
+        and _prof.modes.get(kind, False)
+
+
+def recording() -> bool:
+    """True while a worker profiling session is running and not paused —
+    the gate observability spans use to mirror themselves into the
+    chrome-trace stream regardless of mode bits."""
+    return _prof.running and _prof.pause_depth == 0
 
 
 def record_event(name: str, category: str, t_start_us: float, dur_us: float,
@@ -286,35 +304,52 @@ def scope(name: str, category: str = "operator") -> _Scope:
     return _Scope(name, category)
 
 
-def dumps(reset=False) -> str:
-    """Aggregate text summary (reference aggregate_stats.cc table)."""
+def _aggregate_table(events) -> str:
+    """Per-name count/total/mean/max table (reference aggregate_stats.cc
+    ``DumpTable``), sorted by total descending."""
     agg: Dict[str, List[float]] = defaultdict(list)
-    with _lock:
-        for e in _prof.events:
-            name, dur = e.get("name"), e.get("dur")
-            if name is None or dur is None:  # metadata / phase-less rows
-                continue
-            agg[name].append(dur)
-        if reset:
-            _prof.events = []
-    lines = [f"{'Name':<40}{'Calls':>8}{'Total(us)':>14}{'Mean(us)':>12}"]
+    for e in events:
+        name, dur = e.get("name"), e.get("dur")
+        if name is None or dur is None:  # metadata / phase-less rows
+            continue
+        agg[name].append(dur)
+    lines = [f"{'Name':<40}{'Calls':>8}{'Total(us)':>14}{'Mean(us)':>12}"
+             f"{'Max(us)':>12}"]
     for name, durs in sorted(agg.items(), key=lambda kv: -sum(kv[1])):
         lines.append(f"{name:<40}{len(durs):>8}{sum(durs):>14.1f}"
-                     f"{sum(durs)/len(durs):>12.1f}")
+                     f"{sum(durs)/len(durs):>12.1f}{max(durs):>12.1f}")
     return "\n".join(lines)
 
 
+def dumps(reset=False) -> str:
+    """Aggregate text summary (reference aggregate_stats.cc table)."""
+    with _lock:
+        events = list(_prof.events)
+        if reset:
+            _prof.events = []
+    return _aggregate_table(events)
+
+
 def dump(finished=True, profile_process="worker"):
-    """Write the chrome trace JSON (load in chrome://tracing / Perfetto)."""
+    """Write the chrome trace JSON (load in chrome://tracing / Perfetto).
+
+    When the session was configured with ``aggregate_stats=True``, also
+    write the aggregate summary table (count/total/mean/max per name —
+    reference aggregate_stats.cc) to ``<filename>.aggregate.txt``."""
     if profile_process == "server":
         from .kvstore import CMD_PROFILER_DUMP
         return _send_server_cmd(CMD_PROFILER_DUMP, "")
     with _lock:
-        trace = {"traceEvents": list(_prof.events), "displayTimeUnit": "ms"}
+        events = list(_prof.events)
+        trace = {"traceEvents": events, "displayTimeUnit": "ms"}
         with open(_prof.filename, "w") as f:
             json.dump(trace, f)
+        aggregate, filename = _prof.aggregate, _prof.filename
         if finished:
             _prof.events = []
+    if aggregate:
+        with open(filename + ".aggregate.txt", "w") as f:
+            f.write(_aggregate_table(events) + "\n")
 
 
 # ---- user-facing objects (reference profiler.py:Domain/Task/Event/...) ----

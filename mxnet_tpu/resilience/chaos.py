@@ -23,7 +23,7 @@ from ..base import MXNetError
 
 __all__ = ["ChaosError", "sigterm_self", "dropped_pushes", "kill_heartbeat",
            "nan_gradients", "nan_batch", "tear_checkpoint",
-           "torn_checkpoint_writes"]
+           "torn_checkpoint_writes", "hung_step"]
 
 
 class ChaosError(MXNetError):
@@ -110,6 +110,35 @@ def nan_gradients(trainer, steps: int = 1):
         yield state
     finally:
         t._grad_fn = orig
+
+
+@contextlib.contextmanager
+def hung_step(trainer, hang: float = 3600.0, after: int = 0):
+    """Make the trainer's next step (after ``after`` healthy ones) hang for
+    ``hang`` seconds — the dead-peer-in-a-collective failure mode the
+    watchdog exists for. Patches the *inner* ``DataParallelTrainer.step`` so
+    a wrapping ``ResilientTrainer``'s watchdog/retry machinery sees the hang
+    exactly where a stuck allreduce would sit. The sleep is interruptible by
+    the watchdog's ``KeyboardInterrupt``. Yields a dict with the live
+    ``hung`` count."""
+    import time as _time
+    t = getattr(trainer, "trainer", trainer)   # unwrap ResilientTrainer
+    orig = t.step
+    state = {"skip": int(after), "hung": 0}
+
+    def step(*data):
+        if state["skip"] > 0:
+            state["skip"] -= 1
+            return orig(*data)
+        state["hung"] += 1
+        _time.sleep(hang)
+        return orig(*data)
+
+    t.step = step
+    try:
+        yield state
+    finally:
+        t.step = orig
 
 
 def nan_batch(like):

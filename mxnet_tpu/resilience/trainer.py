@@ -40,7 +40,11 @@ import numpy as np
 
 from ..base import MXNetError, get_env, logger
 from ..checkpoint import ShardedCheckpointer
-from .preemption import acquire as acquire_guard, release as release_guard
+from ..observability import catalog as _telemetry
+from ..observability import flight_recorder as _flight
+from ..observability import metrics as _metrics
+from .preemption import Preempted, acquire as acquire_guard, \
+    release as release_guard
 from .retry import retry_transient
 from .watchdog import Watchdog
 
@@ -167,7 +171,39 @@ class ResilientTrainer:
 
     # ------------------------------------------------------------- stepping
     def step(self, *data) -> float:
-        """One guarded train step. Returns the (async) scalar loss."""
+        """One guarded train step. Returns the (async) scalar loss.
+
+        Crash forensics: an unhandled exception escaping this method (after
+        retries, if enabled) dumps the flight recorder before propagating;
+        a latched preemption dumps it next to the final checkpoint. The
+        watchdog dumps from its own timeout path, so every way a run dies
+        leaves the same artifact behind."""
+        try:
+            return self._step_inner(*data)
+        except Preempted:
+            raise                       # dumped at the latch site below
+        except BaseException as e:
+            if self._watchdog is None or not self._watchdog.fired:
+                # a watchdog timeout already dumped (with the richer
+                # watchdog_timeout reason) from its own thread
+                self._flight_dump("trainer_exception: %r" % (e,))
+            raise
+
+    def _flight_dump(self, reason: str) -> None:
+        path = _flight.dump(reason=reason,
+                            extra={"anomaly_stats": self._safe_anomaly(),
+                                   "step_count": self.step_count})
+        if path:
+            _telemetry.FLIGHT_DUMPS.inc(reason=reason.split(":", 1)[0])
+            logger.warning("flight recorder dumped to %s (%s)", path, reason)
+
+    def _safe_anomaly(self) -> Dict[str, Any]:
+        try:    # guard scalars may be deleted/poisoned on the crash path
+            return self.trainer.anomaly_stats()
+        except Exception:
+            return {}
+
+    def _step_inner(self, *data) -> float:
         if not self._initialized:
             self._initialize(data)
 
@@ -188,6 +224,8 @@ class ResilientTrainer:
             def on_retry(i, exc, delay):
                 logger.warning("transient step failure (attempt %d), "
                                "retrying in %.2fs: %r", i + 1, delay, exc)
+                if _metrics.enabled():
+                    _telemetry.STEP_RETRIES.inc()
                 # the failed dispatch may have consumed donated buffers;
                 # a retry on deleted arrays is a guaranteed crash — restore
                 # the newest committed checkpoint first if state died
@@ -203,6 +241,9 @@ class ResilientTrainer:
             # checkpoint at this safe boundary, then fail with intent
             self.save(async_save=False)
             self.checkpointer.wait_until_finished()
+            if _metrics.enabled():
+                _telemetry.PREEMPTIONS.inc()
+            self._flight_dump("preemption")
             self._guard.check()     # raises Preempted
         return loss
 

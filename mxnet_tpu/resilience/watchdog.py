@@ -32,8 +32,10 @@ class Watchdog:
             loss = trainer.step(x, y)
             jax.block_until_ready(loss)   # the deadline must see the hang
 
-    One persistent daemon thread serves every arm; ``fired`` latches True
-    after a timeout. The dispatch-async caveat: XLA returns futures, so the
+    One persistent daemon thread serves every arm; ``fired`` reports
+    whether the MOST RECENT armed region timed out (it resets on each
+    ``arm``, so a survived timeout can't mask a later, unrelated failure's
+    diagnostics). The dispatch-async caveat: XLA returns futures, so the
     guarded region must synchronize (block_until_ready) or a hang escapes
     the deadline — ResilientTrainer does this automatically.
     """
@@ -80,6 +82,7 @@ class Watchdog:
                 faulthandler.dump_traceback(file=sys.stderr)
             except Exception:   # pragma: no cover - best effort
                 pass
+            self._dump_flight_recorder(label)
             logger.error("watchdog fired on %r after %.1fs", label,
                          self.deadline)
             if self._on_timeout is not None:
@@ -91,10 +94,36 @@ class Watchdog:
                 # os._exit(124) when running under a supervisor.
                 _thread.interrupt_main()
 
+    def _dump_flight_recorder(self, label: str) -> None:
+        """Crash forensics: append the flight recorder's tail to the stack
+        dump (the 'what was the run doing' half of the picture) and write
+        the full ring to its JSON artifact. Best-effort by construction —
+        the watchdog must fail loud even if telemetry is broken."""
+        try:
+            from ..observability import catalog as _telemetry
+            from ..observability import flight_recorder as _flight
+            from ..observability import metrics as _metrics
+            if _metrics.enabled():
+                _telemetry.WATCHDOG_FIRED.inc()
+            lines = _flight.tail_lines(8)
+            if lines:
+                sys.stderr.write(
+                    "--- flight recorder tail (newest last) ---\n"
+                    + "\n".join(lines) + "\n")
+            path = _flight.dump(reason="watchdog_timeout: %s" % label)
+            if path:
+                if _metrics.enabled():
+                    _telemetry.FLIGHT_DUMPS.inc(reason="watchdog_timeout")
+                sys.stderr.write("flight recorder dumped to %s\n" % path)
+            sys.stderr.flush()
+        except Exception:   # pragma: no cover - best effort
+            pass
+
     @contextlib.contextmanager
     def arm(self, label: str = "step"):
         with self._lock:
             self._ensure_thread()
+            self.fired = False
             self._label = label
             self._gen += 1
             self._done.clear()

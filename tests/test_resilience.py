@@ -531,8 +531,11 @@ def test_retry_transient_backoff_schedule():
 
 
 # ---------------------------------------------------------------- watchdog
-def test_watchdog_fires_and_labels():
+def test_watchdog_fires_and_labels(tmp_path, monkeypatch):
     import time
+    # the fire path dumps the flight recorder; keep the artifact out of CWD
+    monkeypatch.setenv("MXNET_TELEMETRY_FLIGHT_PATH",
+                       str(tmp_path / "flight.json"))
     fired = []
     wd = Watchdog(0.2, on_timeout=fired.append)
     with wd.arm("hung step"):

@@ -173,3 +173,65 @@ def test_diagnose_runs():
     assert p.returncode == 0, p.stderr
     assert "Framework Info" in p.stdout
     assert "native lib   : ok" in p.stdout
+
+
+@pytest.mark.obs
+def test_mxtop_cli_smoke(tmp_path):
+    """tools/mxtop.py end-to-end on both artifact kinds — exit codes follow
+    the mxlint convention: 0 healthy, 1 anomalies, 2 unloadable."""
+    import json
+    mxtop = os.path.join(REPO, "tools", "mxtop.py")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": ""}
+
+    # healthy metrics snapshot → 0
+    snap = {"version": 1, "time": 1.0, "pid": 1, "metrics": {
+        "mxtpu_trainer_step_ms": {"type": "histogram", "help": "", "series": [
+            {"labels": {}, "sum": 30.0, "count": 3, "max": 20.0,
+             "buckets": {"10": 2, "+Inf": 3}}]},
+        "mxtpu_trainer_steps_total": {"type": "counter", "help": "",
+                                      "series": [{"labels": {}, "value": 3}]},
+    }}
+    ok = tmp_path / "snap.json"
+    ok.write_text(json.dumps(snap))
+    p = subprocess.run([sys.executable, mxtop, str(ok)], env=env,
+                       capture_output=True, text=True, timeout=60)
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "mxtpu_trainer_step_ms" in p.stdout
+
+    # anomaly counter above zero → 1
+    snap["metrics"]["mxtpu_watchdog_timeouts_total"] = {
+        "type": "counter", "help": "",
+        "series": [{"labels": {}, "value": 1}]}
+    bad = tmp_path / "snap_bad.json"
+    bad.write_text(json.dumps(snap))
+    p = subprocess.run([sys.executable, mxtop, str(bad)], env=env,
+                       capture_output=True, text=True, timeout=60)
+    assert p.returncode == 1, p.stdout + p.stderr
+    assert "anomaly signal" in p.stdout
+
+    # crash-reason flight recording → 1; --format json round-trips
+    flight = {"version": 1, "reason": "watchdog_timeout: step 7", "time": 1.0,
+              "pid": 1, "extra": {}, "records": [
+                  {"step": 7, "time": 1.0, "loss": 0.5, "step_ms": 9.0,
+                   "spans": ["module_fit_epoch"]}]}
+    fp = tmp_path / "flight.json"
+    fp.write_text(json.dumps(flight))
+    p = subprocess.run([sys.executable, mxtop, str(fp)], env=env,
+                       capture_output=True, text=True, timeout=60)
+    assert p.returncode == 1, p.stdout + p.stderr
+    assert "watchdog_timeout: step 7" in p.stdout
+    p = subprocess.run([sys.executable, mxtop, "--format", "json", str(fp)],
+                       env=env, capture_output=True, text=True, timeout=60)
+    assert p.returncode == 0
+    assert json.loads(p.stdout)["kind"] == "flight"
+
+    # unloadable → 2
+    p = subprocess.run([sys.executable, mxtop, str(tmp_path / "nope.json")],
+                       env=env, capture_output=True, text=True, timeout=60)
+    assert p.returncode == 2
+    garbage = tmp_path / "garbage.json"
+    garbage.write_text("{not json")
+    p = subprocess.run([sys.executable, mxtop, str(garbage)], env=env,
+                       capture_output=True, text=True, timeout=60)
+    assert p.returncode == 2
+    assert "cannot read" in p.stderr

@@ -1,0 +1,214 @@
+#!/usr/bin/env python
+"""mxtop — pretty-print mxnet_tpu telemetry snapshots & flight recordings.
+
+Reads either artifact the observability layer produces and renders a
+terminal-friendly view:
+
+- a **metrics snapshot** (JSON written by ``observability.write_snapshot``
+  or the ``MXNET_TELEMETRY_EXPORT`` background exporter): counters, gauges
+  and histogram summaries (count/mean/max + bucket sparkline);
+- a **flight recorder dump** (``mxtpu_flight_recorder.json`` written on
+  watchdog timeout / preemption / trainer crash): dump reason, anomaly
+  stats, and the per-step record tail.
+
+Usage::
+
+    python tools/mxtop.py /run/metrics.json            # one-shot render
+    python tools/mxtop.py --watch 2 /run/metrics.json  # live top-style view
+    python tools/mxtop.py mxtpu_flight_recorder.json   # crash forensics
+    python tools/mxtop.py --format json snap.json      # normalized JSON out
+    python tools/mxtop.py --tail 20 flight.json        # more records
+
+Exit codes (mxlint convention): 0 = healthy, 1 = the artifact shows
+anomalies (a crash-reason flight dump, grad-skip/verify-failure/watchdog/
+retry counters above zero), 2 = the artifact could not be loaded/parsed.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+# metric names whose nonzero value means "something went wrong" — the same
+# families docs/observability.md lists under crash forensics
+_ANOMALY_COUNTERS = (
+    "mxtpu_trainer_grad_skipped_steps",
+    "mxtpu_checkpoint_verify_failures_total",
+    "mxtpu_watchdog_timeouts_total",
+    "mxtpu_kv_publish_failures_total",
+    "mxtpu_trainer_step_retries_total",
+    "mxtpu_flight_recorder_dumps_total",
+    "mxtpu_preemptions_total",
+)
+
+_SPARK = " ▁▂▃▄▅▆▇█"
+
+
+def load(path):
+    with open(path) as f:
+        return json.load(f)
+
+
+def kind_of(doc) -> str:
+    if isinstance(doc, dict) and "records" in doc:
+        return "flight"
+    if isinstance(doc, dict) and "metrics" in doc:
+        return "metrics"
+    raise ValueError("not a telemetry snapshot or flight recording "
+                     "(expected a 'metrics' or 'records' key)")
+
+
+def _fmt_labels(labels) -> str:
+    if not labels:
+        return ""
+    return "{%s}" % ",".join("%s=%s" % kv for kv in sorted(labels.items()))
+
+
+def _fmt_num(v) -> str:
+    if v is None:
+        return "n/a"
+    f = float(v)
+    if f == int(f) and abs(f) < 1e12:
+        return str(int(f))
+    return "%.3f" % f
+
+
+def _le(key: str) -> float:
+    return float("inf") if key == "+Inf" else float(key)
+
+
+def _sparkline(buckets) -> str:
+    # per-bucket (non-cumulative) counts → tiny bar chart. JSON serializers
+    # may have alphabetized the keys; re-sort by upper bound before diffing
+    # the cumulative counts.
+    vals, prev = [], 0
+    for _, cum in sorted(buckets.items(), key=lambda kv: _le(kv[0])):
+        vals.append(cum - prev)
+        prev = cum
+    top = max(vals) if vals else 0
+    if top <= 0:
+        return ""
+    return "".join(_SPARK[min(8, int(round(v / top * 8)))] for v in vals)
+
+
+def render_metrics(doc, out) -> int:
+    """Render a snapshot; returns the number of anomaly signals found."""
+    anomalies = 0
+    ts = doc.get("time")
+    out.write("mxtop — metrics snapshot (pid %s%s)\n" % (
+        doc.get("pid", "?"),
+        time.strftime(", %Y-%m-%d %H:%M:%S", time.localtime(ts))
+        if ts else ""))
+    rows = {"counter": [], "gauge": [], "histogram": []}
+    for name, m in sorted(doc.get("metrics", {}).items()):
+        mtype = m.get("type")
+        for s in m.get("series", []):
+            label = name + _fmt_labels(s.get("labels"))
+            if mtype == "histogram":
+                cnt = s.get("count", 0)
+                mean = (s.get("sum", 0.0) / cnt) if cnt else 0.0
+                rows["histogram"].append(
+                    (label, cnt, mean, s.get("max", 0.0),
+                     _sparkline(s.get("buckets", {}))))
+            else:
+                val = s.get("value", 0)
+                rows.setdefault(mtype, rows["gauge"]).append((label, val))
+                if name in _ANOMALY_COUNTERS and float(val or 0) > 0:
+                    anomalies += 1
+    if rows["histogram"]:
+        out.write("\n%-52s %10s %12s %12s  %s\n"
+                  % ("histogram", "count", "mean", "max", "dist"))
+        for label, cnt, mean, mx, spark in rows["histogram"]:
+            if not cnt:
+                continue
+            out.write("%-52s %10d %12s %12s  %s\n"
+                      % (label, cnt, _fmt_num(mean), _fmt_num(mx), spark))
+    for kind in ("counter", "gauge"):
+        live = [(l, v) for l, v in rows[kind] if v not in (0, 0.0, None)]
+        if live:
+            out.write("\n%-52s %12s\n" % (kind, "value"))
+            for label, val in live:
+                flag = " !" if any(label.startswith(a)
+                                   for a in _ANOMALY_COUNTERS) else ""
+                out.write("%-52s %12s%s\n" % (label, _fmt_num(val), flag))
+    if anomalies:
+        out.write("\n%d anomaly signal(s) — see '!' rows\n" % anomalies)
+    return anomalies
+
+
+def render_flight(doc, out, tail: int) -> int:
+    reason = doc.get("reason", "")
+    ts = doc.get("time")
+    out.write("mxtop — flight recording (pid %s%s)\n" % (
+        doc.get("pid", "?"),
+        time.strftime(", %Y-%m-%d %H:%M:%S", time.localtime(ts))
+        if ts else ""))
+    out.write("reason: %s\n" % (reason or "(manual dump)"))
+    extra = doc.get("extra") or {}
+    if extra:
+        out.write("extra:  %s\n" % json.dumps(extra, sort_keys=True))
+    records = doc.get("records", [])
+    out.write("records: %d total, showing last %d\n\n"
+              % (len(records), min(tail, len(records))))
+    out.write("%8s %22s %12s %10s  %s\n"
+              % ("step", "wall time", "loss", "step_ms", "spans"))
+    for r in records[-tail:]:
+        t = r.get("time")
+        out.write("%8s %22s %12s %10s  %s\n" % (
+            r.get("step", "?"),
+            time.strftime("%H:%M:%S", time.localtime(t)) + (
+                ".%03d" % ((t % 1) * 1000)) if t else "n/a",
+            _fmt_num(r.get("loss")), _fmt_num(r.get("step_ms")),
+            ",".join(r.get("spans") or ()) or "-"))
+    # a crash-triggered dump is an anomaly by definition; a manual/test dump
+    # (empty reason) is healthy
+    return 1 if reason else 0
+
+
+def run_once(path: str, fmt: str, tail: int, out) -> int:
+    try:
+        doc = load(path)
+        kind = kind_of(doc)
+    except (OSError, ValueError) as e:
+        sys.stderr.write("mxtop: cannot read %s: %s\n" % (path, e))
+        return 2
+    if fmt == "json":
+        out.write(json.dumps({"kind": kind, "doc": doc}, indent=1,
+                             sort_keys=True) + "\n")
+        return 0
+    if kind == "flight":
+        anomalies = render_flight(doc, out, tail)
+    else:
+        anomalies = render_metrics(doc, out)
+    return 1 if anomalies else 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="pretty-print mxnet_tpu telemetry snapshots and "
+                    "flight recordings")
+    ap.add_argument("path", help="metrics snapshot JSON or flight-recorder "
+                                 "dump JSON")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--tail", type=int, default=10,
+                    help="flight records to show (default 10)")
+    ap.add_argument("--watch", type=float, metavar="SECONDS", default=0,
+                    help="re-render every N seconds (live exporter view); "
+                         "Ctrl-C to stop — exit code reflects the LAST "
+                         "render")
+    args = ap.parse_args(argv)
+    if args.watch > 0:
+        rc = 0
+        try:
+            while True:
+                sys.stdout.write("\x1b[2J\x1b[H")     # clear + home
+                rc = run_once(args.path, args.format, args.tail, sys.stdout)
+                sys.stdout.flush()
+                time.sleep(args.watch)
+        except KeyboardInterrupt:
+            return rc
+    return run_once(args.path, args.format, args.tail, sys.stdout)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
