@@ -67,6 +67,13 @@ def main(argv=None):
     ap.add_argument("--steps", type=int, default=60)
     ap.add_argument("--save-every", type=int, default=5)
     ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--epochs", type=int, default=0,
+                    help="epoch-structured mode: train --epochs epochs over "
+                         "a shuffling NDArrayIter ATTACHED to the trainer, "
+                         "so checkpoints carry the iterator's exact "
+                         "mid-epoch resume point; prints 'epoch E batch B' "
+                         "per batch (what crashloop --kill-mid-epoch keys "
+                         "on). Overrides --steps.")
     ap.add_argument("--telemetry-snapshot", default=None, metavar="PATH",
                     help="write a metrics snapshot (JSON, or Prometheus "
                          "text for .prom/.txt) on completion — inspect "
@@ -78,18 +85,43 @@ def main(argv=None):
     W = rng.randn(20, 10).astype("float32")
     Y = (X @ W).argmax(axis=1).astype("float32")
 
+    data_iter = None
+    if args.epochs:
+        # epoch-structured mode: the iterator's state (epoch, cursor,
+        # shuffle seed) rides in every checkpoint manifest; a restarted
+        # process resumes EXACTLY mid-epoch — no batch skipped or repeated
+        from mxnet_tpu.io import NDArrayIter
+        data_iter = NDArrayIter(X, Y, batch_size=args.batch_size,
+                                shuffle=True, last_batch_handle="discard")
     rt = ResilientTrainer(
         make_net(), gluon.loss.SoftmaxCrossEntropyLoss(),
         "sgd", {"learning_rate": 0.1, "momentum": 0.9},
         directory=args.ckpt_dir, save_every=args.save_every,
-        grad_guard=True)
+        grad_guard=True, data_iter=data_iter)
 
+    bpe = X.shape[0] // args.batch_size          # batches per epoch
+    total = args.epochs * bpe if args.epochs else args.steps
     try:
         # eager resume: step_count must be correct BEFORE the loop condition
         # first runs, or a restart after the final step would train one past
         # the target (and diverge from the uninterrupted digest)
         rt.ensure_initialized(X[:args.batch_size], Y[:args.batch_size])
-        while rt.step_count < args.steps:
+        while rt.step_count < total:
+            if data_iter is not None:
+                try:
+                    b = data_iter.next()
+                except StopIteration:
+                    data_iter.reset()
+                    b = data_iter.next()
+                loss = rt.step(b.data[0], b.label[0])
+                print("epoch %d batch %d step %d loss %.5f%s" % (
+                    (rt.step_count - 1) // bpe, (rt.step_count - 1) % bpe,
+                    rt.step_count, float(loss),
+                    "  (resumed from %s)" % rt.resumed_from
+                    if rt.resumed_from is not None
+                    and rt.step_count == rt.resumed_from + 1 else ""),
+                    flush=True)
+                continue
             i = rt.step_count % 4
             x = X[i * args.batch_size:(i + 1) * args.batch_size]
             y = Y[i * args.batch_size:(i + 1) * args.batch_size]

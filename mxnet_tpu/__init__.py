@@ -23,7 +23,8 @@ import jax as _jax
 _jax.config.update("jax_enable_x64", True)
 
 from . import base
-from .base import MXNetError, TransientKVError
+from .base import (MXNetError, TransientKVError, TransientIOError,
+                   CorruptRecordError)
 from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, num_gpus, num_tpus, gpu_memory_info
 from . import ops
 from . import ndarray

@@ -18,7 +18,8 @@ Findings are :class:`Diagnostic` records in a :class:`Report` (text / JSON /
 """
 from .diagnostics import Diagnostic, Report, RuleDef, RULES, Severity
 from .graph_lint import lint_symbol, lint_symbol_json
-from .trace_lint import lint_step, lint_trainer
+from .trace_lint import lint_step, lint_trainer, lint_data_iter
 
 __all__ = ["Diagnostic", "Report", "RuleDef", "RULES", "Severity",
-           "lint_symbol", "lint_symbol_json", "lint_step", "lint_trainer"]
+           "lint_symbol", "lint_symbol_json", "lint_step", "lint_trainer",
+           "lint_data_iter"]

@@ -40,7 +40,7 @@ import numpy as np
 from .diagnostics import (Diagnostic, Report, parse_disable_comment,
                           register_rule)
 
-__all__ = ["lint_step", "lint_trainer"]
+__all__ = ["lint_step", "lint_trainer", "lint_data_iter"]
 
 register_rule(
     "MXL-T200", "error", "trace-failure",
@@ -80,6 +80,12 @@ register_rule(
     "float64 appears in the traced computation. TPUs emulate f64 at a "
     "severe slowdown (jax_enable_x64 is on package-wide, so np.float64 "
     "inputs silently stay f64).")
+register_rule(
+    "MXL-T208", "warning", "unresumable-data-iter",
+    "A ResilientTrainer / resilient_fit run is fed by a data iterator "
+    "without the checkpointable-iterator state protocol (state()/"
+    "set_state()): a resume silently restarts the epoch from batch 0, "
+    "re-training already-seen batches and skewing convergence.")
 
 _HOST_SYNC_METHODS = ("item", "asscalar", "asnumpy", "wait_to_read")
 _NP_NAMES = ("np", "numpy", "onp")
@@ -415,6 +421,46 @@ def lint_step(fn, args: Sequence[Any] = (), kwargs: Optional[Dict] = None,
             hint="jit(fn, donate_argnums=...) on the params/optimizer-"
                  "state arguments halves their HBM footprint"),
             inline_disables=def_disables)
+    return report
+
+
+def lint_data_iter(data_iter, *, suppress: Sequence[str] = (),
+                   subject: str = "") -> Report:
+    """Lint a data iterator for resilience-readiness (MXL-T208).
+
+    A resilient training loop (``ResilientTrainer.attach_data``,
+    ``resilient_fit``) can only resume **exactly mid-epoch** when its
+    iterator implements the checkpointable-iterator state protocol —
+    ``state() -> dict`` / ``set_state(dict)`` covering epoch, cursor and
+    shuffle-RNG seed (``mxnet_tpu.io.has_state``). This check also
+    *exercises* ``state()``: composite iterators (``PrefetchingIter``,
+    ``DeviceFeedIter``, ``ResilientDataIter``) expose the protocol but
+    raise when a wrapped base cannot deliver it, which is the same silent
+    epoch restart one layer down."""
+    from ..io.io import has_state
+    name = type(data_iter).__name__
+    report = Report(subject or f"data iterator {name}", "trace")
+    report.set_suppressions(suppress)
+    hint = ("use a built-in iterator (NDArrayIter, ImageRecordIter, "
+            "DeviceFeedIter, ...) or implement state()/set_state() "
+            "(epoch, cursor, shuffle-RNG seed) — see docs/resilience.md")
+    if not has_state(data_iter):
+        report.add(Diagnostic(
+            "MXL-T208",
+            f"{name} has no state()/set_state(): a ResilientTrainer/"
+            "resilient_fit resume restarts its epoch from batch 0 "
+            "(duplicated batches, skewed convergence)",
+            location=name, hint=hint))
+        return report
+    try:
+        data_iter.state()
+    except Exception as e:
+        report.add(Diagnostic(
+            "MXL-T208",
+            f"{name}.state() raises {type(e).__name__} ({e}) — the "
+            "protocol is advertised but cannot capture a resume point, so "
+            "resume still restarts the epoch",
+            location=name, hint=hint))
     return report
 
 

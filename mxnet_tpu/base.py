@@ -17,6 +17,8 @@ from typing import Any, Callable, Dict, Optional, Type
 __all__ = [
     "MXNetError",
     "TransientKVError",
+    "TransientIOError",
+    "CorruptRecordError",
     "config",
     "register_config",
     "get_env",
@@ -44,6 +46,21 @@ class TransientKVError(MXNetError):
     after its internal retry budget was exhausted. The resilience layer
     (``mxnet_tpu.resilience.retry_transient``) treats this — unlike a bare
     ``MXNetError`` — as retryable with backoff rather than fatal."""
+
+
+class TransientIOError(MXNetError):
+    """A data read failed for a plausibly-transient reason (torn read off a
+    network filesystem, a briefly-unreachable object store). Like
+    :class:`TransientKVError`, ``retry_transient`` retries it with backoff
+    instead of killing the run; ``io.ResilientDataIter`` raises it through
+    only after the ``MXNET_IO_RETRY_*`` budget is exhausted."""
+
+
+class CorruptRecordError(MXNetError):
+    """A record decoded to garbage (bad magic, truncated payload, failed
+    checksum). Deliberately NOT transient — re-reading the same bytes gives
+    the same garbage — but ``io.ResilientDataIter`` may *skip* the batch
+    within its ``MXNET_IO_SKIP_BUDGET`` instead of failing the run."""
 
 
 @dataclass

@@ -1,11 +1,16 @@
 """``mx.io`` — data iterators (reference: ``python/mxnet/io/io.py`` + the C++
-iterators in ``src/io/``)."""
+iterators in ``src/io/``), plus the TPU-side resilience layer: the
+checkpointable-iterator state protocol (``has_state``, ``state()``/
+``set_state()`` on every built-in iterator) and :class:`ResilientDataIter`
+(transient-read retry, corrupt-batch skip budget, hung-reader watchdog)."""
 from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter,
                  PrefetchingIter, CSVIter, ImageRecordIter,
-                 ImageDetRecordIter, MNISTIter, LibSVMIter)
+                 ImageDetRecordIter, MNISTIter, LibSVMIter, has_state)
 from .device_feed import DeviceFeedIter, prefetch_to_device
+from .resilient import ResilientDataIter
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
            "PrefetchingIter", "CSVIter", "ImageRecordIter",
            "ImageDetRecordIter", "MNISTIter", "LibSVMIter",
-           "DeviceFeedIter", "prefetch_to_device"]
+           "DeviceFeedIter", "prefetch_to_device",
+           "has_state", "ResilientDataIter"]

@@ -32,7 +32,9 @@ import numpy as np
 
 from ..base import MXNetError
 from ..ndarray.ndarray import NDArray, _unwrap, _wrap
-from .io import DataBatch, DataIter
+from ..observability import catalog as _telemetry
+from ..observability import metrics as _metrics
+from .io import DataBatch, DataIter, has_state, _join_producer, _put_or_stop
 
 __all__ = ["prefetch_to_device", "DeviceFeedIter"]
 
@@ -55,17 +57,9 @@ def _stage(tree, sharding):
                                   is_leaf=lambda x: isinstance(x, NDArray))
 
 
-def _put_or_stop(q, item, stop):
-    """Blocking q.put that gives up when ``stop`` is set (so an abandoned
-    consumer can never strand the producer holding staged device buffers).
-    Returns False if stopped."""
-    while not stop.is_set():
-        try:
-            q.put(item, timeout=0.2)
-            return True
-        except queue.Full:
-            continue
-    return False
+# _put_or_stop lives in io.py (shared with PrefetchingIter); re-exported
+# here because "a stop-aware bounded put like device_feed._put_or_stop" is
+# the documented idiom.
 
 
 def prefetch_to_device(source: Iterable, sharding=None,
@@ -158,6 +152,20 @@ class DeviceFeedIter(DataIter):
                 return a.astype(jnp.float32) * scale_ + shift_
 
             self._rescale = rescale
+        # state protocol (see PrefetchingIter): the resume point is the
+        # base state after the last batch DELIVERED to the consumer; the
+        # producer snapshots base state alongside every batch it stages, so
+        # staged-but-undelivered depth is implicitly credited back on resume
+        # (neither skipped nor duplicated)
+        self._track_state = has_state(base)
+        self._last_state = base.state() if self._track_state else None
+        self._closed = False
+        # terminal condition already delivered (StopIteration or a producer
+        # exception): the producer thread has exited, so a further next()
+        # must re-raise instead of blocking forever on an empty queue (a
+        # retry wrapper re-calling next() after a transient error would
+        # otherwise hang silently). reset()/set_state() clear it.
+        self._terminal = None
         self._queue: "queue.Queue" = queue.Queue(maxsize=depth)
         self._stop = threading.Event()
         self._thread = None
@@ -203,12 +211,13 @@ class DeviceFeedIter(DataIter):
                 except StopIteration:
                     _put_or_stop(q, _STOP, stop)
                     return
+                state = self._base.state() if self._track_state else None
                 staged = DataBatch(
                     data=self._put_arrays(b.data, is_label=False),
                     label=self._put_arrays(b.label, is_label=True),
                     pad=b.pad, index=b.index,
                     bucket_key=getattr(b, "bucket_key", None))
-                if not _put_or_stop(q, staged, stop):
+                if not _put_or_stop(q, (staged, state), stop):
                     return
         except Exception as e:
             _put_or_stop(q, e, stop)
@@ -219,40 +228,86 @@ class DeviceFeedIter(DataIter):
             daemon=True, name="mxtpu-device-feed-iter")
         self._thread.start()
 
-    def reset(self):
-        """Stop the producer, rewind the base iterator, restart staging.
-        The old thread is fully joined BEFORE base.reset() so two threads
-        never drive the base iterator concurrently."""
-        self._stop.set()
-        deadline = time.monotonic() + 60.0
-        while self._thread is not None and self._thread.is_alive():
-            try:                 # keep the queue drained so puts can't block
-                while True:
-                    self._queue.get_nowait()
-            except queue.Empty:
-                pass
-            self._thread.join(timeout=0.1)
-            if time.monotonic() > deadline:
-                raise MXNetError(
-                    "DeviceFeedIter.reset: producer thread failed to stop "
-                    "(base iterator blocked in next()?)")
-        try:
-            while True:
-                self._queue.get_nowait()
-        except queue.Empty:
-            pass
-        self._base.reset()
+    def _stop_producer(self):
+        # drain-while-join (shared helper): dropping the staged items also
+        # releases their pinned device buffers
+        _join_producer(self._thread, self._queue, self._stop,
+                       "DeviceFeedIter")
+        self._thread = None
+
+    def _restart(self):
         self._stop = threading.Event()
         self._queue = queue.Queue(maxsize=self._depth)
         self._start()
 
+    def reset(self):
+        """Stop the producer, rewind the base iterator, restart staging."""
+        if self._closed:
+            raise MXNetError("DeviceFeedIter is closed")
+        self._stop_producer()
+        self._terminal = None
+        self._base.reset()
+        if self._track_state:
+            self._last_state = self._base.state()
+        self._restart()
+
+    # ------------------------------------------------- checkpointable state
+    def state(self) -> dict:
+        """Resume point of the base iterator as of the last batch this feed
+        DELIVERED — in-flight staged batches are excluded by construction."""
+        if not self._track_state:
+            raise MXNetError(
+                "DeviceFeedIter.state: base iterator %s has no state "
+                "protocol" % type(self._base).__name__)
+        return {"iter": "DeviceFeedIter", "base": dict(self._last_state)}
+
+    def set_state(self, state: dict) -> None:
+        """Rewind the base iterator to a checkpointed resume point and
+        restart staging from there. The producer is stopped and its staged
+        depth drained first (those batches were never consumed, so dropping
+        them neither skips nor duplicates data)."""
+        if self._closed:
+            raise MXNetError("DeviceFeedIter is closed")
+        if not self._track_state:
+            raise MXNetError("DeviceFeedIter.set_state: base iterator has "
+                             "no state protocol")
+        self._stop_producer()
+        self._terminal = None
+        self._base.set_state(state["base"])
+        self._last_state = dict(state["base"])
+        self._restart()
+
+    def close(self):
+        """Stop the producer and release the staged (pinned) device
+        buffers; closes the base iterator too. Idempotent; terminal."""
+        if self._closed:
+            return
+        self._closed = True
+        self._stop_producer()
+        self._base.close()
+
     def next(self) -> DataBatch:
+        if self._closed:
+            raise MXNetError("DeviceFeedIter is closed")
+        if self._terminal is not None:
+            # producer already exited: fail fast, never block on the queue
+            if self._terminal is StopIteration:
+                raise StopIteration
+            raise self._terminal
         item = self._queue.get()
         if item is _STOP:
+            self._terminal = StopIteration
             raise StopIteration
         if isinstance(item, Exception):
+            self._terminal = item
             raise item
-        return item
+        staged, state = item
+        if state is not None:
+            self._last_state = state
+        if _metrics.enabled():
+            _telemetry.IO_QUEUE_DEPTH.set(self._queue.qsize(),
+                                          iter="DeviceFeedIter")
+        return staged
 
     def iter_next(self):
         raise MXNetError("use next() on DeviceFeedIter")

@@ -13,17 +13,73 @@ import os
 import queue
 import struct
 import threading
+import time
 from collections import namedtuple
 from typing import Dict, List, Optional, Sequence, Union
 
 import numpy as np
 
 from .. import ndarray as nd
+from .. import random as _mxrandom
 from ..base import MXNetError
 from ..ndarray import NDArray
+from ..observability import catalog as _telemetry
+from ..observability import metrics as _metrics
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
-           "PrefetchingIter", "CSVIter", "ImageRecordIter", "ImageDetRecordIter", "MNISTIter", "LibSVMIter"]
+           "PrefetchingIter", "CSVIter", "ImageRecordIter", "ImageDetRecordIter", "MNISTIter", "LibSVMIter",
+           "has_state"]
+
+
+def has_state(it) -> bool:
+    """True when ``it`` implements the checkpointable-iterator protocol —
+    ``state() -> dict`` and ``set_state(dict)`` capturing epoch, cursor and
+    shuffle-RNG seed, so a resumed run continues **exactly** mid-epoch (no
+    skipped or duplicated batches). Iterators without it still train, but a
+    resilience-layer resume restarts their epoch from batch 0 (mxlint rule
+    MXL-T208 flags that pairing)."""
+    return callable(getattr(it, "state", None)) \
+        and callable(getattr(it, "set_state", None))
+
+
+def _put_or_stop(q, item, stop) -> bool:
+    """Blocking ``q.put`` that gives up when ``stop`` is set, so an
+    abandoned/resetting consumer can never strand a producer thread blocked
+    in ``Queue.put`` (the classic drained-then-refilled-queue race).
+    Returns False if stopped before the put landed."""
+    while not stop.is_set():
+        try:
+            q.put(item, timeout=0.2)
+            return True
+        except queue.Full:
+            continue
+    return False
+
+
+def _join_producer(thread, q, stop, what: str, deadline_s: float = 60.0):
+    """Stop + JOIN a prefetch producer, draining ``q`` the whole time so a
+    producer blocked in ``Queue.put`` observes ``stop`` via its bounded put
+    instead of hanging forever. Verifies the thread actually exited —
+    touching base iterators under a live producer is a data race. Shared by
+    PrefetchingIter and DeviceFeedIter (their reset/set_state/close)."""
+    stop.set()
+    deadline = time.monotonic() + deadline_s
+    while thread is not None and thread.is_alive():
+        try:
+            while True:
+                q.get_nowait()
+        except queue.Empty:
+            pass
+        thread.join(timeout=0.1)
+        if time.monotonic() > deadline:
+            raise MXNetError(
+                "%s: producer thread failed to stop (base iterator "
+                "blocked in next()?)" % what)
+    try:        # final drain: staged items must not outlive the producer
+        while True:
+            q.get_nowait()
+    except queue.Empty:
+        pass
 
 
 class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
@@ -92,6 +148,18 @@ class DataIter:
     def getpad(self):
         return 0
 
+    def close(self):
+        """Release resources held by the iterator (producer threads, staged
+        device buffers). Default: no-op — composite iterators override.
+        Idempotent; a closed iterator must not be iterated again."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
 
 def _init_data(data, allow_empty, default_name):
     if data is None:
@@ -132,6 +200,17 @@ class NDArrayIter(DataIter):
         self.last_batch_handle = last_batch_handle
         self.idx = np.arange(self.num_data)
         self._cache_data = None
+        # shuffle runs off a PRIVATE RandomState seeded (once) from the
+        # framework host stream: the permutation sequence is then a pure
+        # function of (seed, epoch) and O(1) to checkpoint — state() records
+        # the seed + epoch count and set_state replays the shuffles, instead
+        # of trying to serialize a shared RNG's state out from under
+        # everyone else. host_rng means mx.random.seed(n) pins it.
+        self._shuffle_seed = (int(_mxrandom.host_rng().randint(0, 2 ** 31 - 1))
+                              if shuffle else None)
+        self._shuffle_rng = (np.random.RandomState(self._shuffle_seed)
+                             if shuffle else None)
+        self._epoch = -1                      # reset() below makes it 0
         self.reset()
 
     @property
@@ -145,14 +224,52 @@ class NDArrayIter(DataIter):
                 for k, v in self.label]
 
     def reset(self):
+        self._epoch += 1
         if self.shuffle:
-            np.random.shuffle(self.idx)
+            self._shuffle_rng.shuffle(self.idx)
         if self.last_batch_handle == "roll_over" and \
                 -self.batch_size < self.cursor < self.num_data:
             self.cursor = -self.batch_size + (self.cursor % self.num_data) % \
                 self.batch_size
         else:
             self.cursor = -self.batch_size
+
+    # ------------------------------------------------- checkpointable state
+    def state(self) -> Dict:
+        """O(1) resume point: epoch count, cursor, shuffle seed. The idx
+        permutation is NOT stored — it is a pure function of
+        (shuffle_seed, epoch) and is replayed by :meth:`set_state`."""
+        return {"iter": "NDArrayIter", "epoch": self._epoch,
+                "cursor": int(self.cursor), "num_data": int(self.num_data),
+                "shuffle_seed": self._shuffle_seed}
+
+    def set_state(self, state: Dict) -> None:
+        if int(state["num_data"]) != self.num_data:
+            raise MXNetError(
+                "NDArrayIter.set_state: checkpointed iterator had %d "
+                "samples, this one has %d — not the same dataset"
+                % (int(state["num_data"]), self.num_data))
+        epoch = int(state["epoch"])
+        if bool(self.shuffle) != (state.get("shuffle_seed") is not None):
+            # one-directional checks would let a shuffled checkpoint load
+            # into a sequential iterator (or vice versa): the "resume"
+            # would re-train some batches and skip others, silently
+            raise MXNetError(
+                "NDArrayIter.set_state: checkpoint was written with "
+                "shuffle=%s but this iterator has shuffle=%s"
+                % (state.get("shuffle_seed") is not None, self.shuffle))
+        self.idx = np.arange(self.num_data)
+        if self.shuffle:
+            seed = state.get("shuffle_seed")
+            # replay the cumulative in-place shuffles reset() performed
+            # (epoch counts resets: construction already applied one)
+            self._shuffle_seed = int(seed)
+            self._shuffle_rng = np.random.RandomState(self._shuffle_seed)
+            for _ in range(epoch + 1):
+                self._shuffle_rng.shuffle(self.idx)
+        self._epoch = epoch
+        self.cursor = int(state["cursor"])
+        self._cache_data = None
 
     def iter_next(self) -> bool:
         self.cursor += self.batch_size
@@ -204,6 +321,22 @@ class ResizeIter(DataIter):
         if self.reset_internal:
             self.data_iter.reset()
 
+    def state(self) -> Dict:
+        if not has_state(self.data_iter):
+            raise MXNetError(
+                "ResizeIter.state: base iterator %s has no state protocol"
+                % type(self.data_iter).__name__)
+        return {"iter": "ResizeIter", "cur": int(self.cur),
+                "base": self.data_iter.state()}
+
+    def set_state(self, state: Dict) -> None:
+        self.cur = int(state["cur"])
+        self.data_iter.set_state(state["base"])
+        self.current_batch = None
+
+    def close(self):
+        self.data_iter.close()
+
     def iter_next(self):
         if self.cur == self.size:
             return False
@@ -239,6 +372,20 @@ class PrefetchingIter(DataIter):
         self.iters = iters
         self.rename_data = rename_data
         self.rename_label = rename_label
+        # state protocol: the producer runs AHEAD of the consumer, so the
+        # resume point is the base state after the last *delivered* batch —
+        # the producer snapshots base state with every batch it stages and
+        # next() keeps the snapshot of what it actually handed out (batches
+        # still sitting in the queue are implicitly "un-consumed" that way)
+        self._track_state = all(has_state(it) for it in iters)
+        self._last_states = ([it.state() for it in iters]
+                             if self._track_state else None)
+        self._closed = False
+        # terminal condition already delivered (StopIteration or a producer
+        # exception): the producer thread has exited, so a further next()
+        # must re-raise instead of blocking forever on an empty queue.
+        # reset()/set_state() clear it (they restart the producer).
+        self._terminal = None
         self._queue: "queue.Queue" = queue.Queue(maxsize=4)
         self._stop = threading.Event()
         self._thread = None
@@ -264,43 +411,113 @@ class PrefetchingIter(DataIter):
                 out.append(DataDesc(name, d.shape, d.dtype))
         return out
 
-    def _producer(self):
+    def _producer(self, q, stop):
+        # q/stop arrive as ARGUMENTS (not re-read from self) so a stale
+        # thread from before a reset() can never touch the new queue
         try:
-            while not self._stop.is_set():
+            while not stop.is_set():
                 try:
                     batches = [it.next() for it in self.iters]
                 except StopIteration:
-                    self._queue.put(None)
+                    _put_or_stop(q, None, stop)
                     return
-                self._queue.put(batches)
+                states = ([it.state() for it in self.iters]
+                          if self._track_state else None)
+                if not _put_or_stop(q, (batches, states), stop):
+                    return
         except Exception as e:  # surface errors at the consumer
-            self._queue.put(e)
+            _put_or_stop(q, e, stop)
 
     def _start(self):
-        self._thread = threading.Thread(target=self._producer, daemon=True)
+        self._thread = threading.Thread(
+            target=self._producer, args=(self._queue, self._stop),
+            daemon=True, name="mxtpu-prefetch-iter")
         self._thread.start()
 
-    def reset(self):
-        self._stop.set()
-        try:
-            while True:
-                self._queue.get_nowait()
-        except queue.Empty:
-            pass
-        self._thread.join(timeout=5)
-        for it in self.iters:
-            it.reset()
+    def _stop_producer(self):
+        _join_producer(self._thread, self._queue, self._stop,
+                       "PrefetchingIter")
+        self._thread = None
+
+    def _restart(self):
         self._stop = threading.Event()
         self._queue = queue.Queue(maxsize=4)
         self._start()
 
+    def reset(self):
+        if self._closed:
+            raise MXNetError("PrefetchingIter is closed")
+        self._stop_producer()
+        self._terminal = None
+        for it in self.iters:
+            it.reset()
+        if self._track_state:
+            self._last_states = [it.state() for it in self.iters]
+        self._restart()
+
+    def state(self) -> Dict:
+        if not self._track_state:
+            raise MXNetError(
+                "PrefetchingIter.state: base iterator(s) without the state "
+                "protocol: %s" % [type(it).__name__ for it in self.iters
+                                  if not has_state(it)])
+        return {"iter": "PrefetchingIter",
+                "base": [dict(s) for s in self._last_states]}
+
+    def set_state(self, state: Dict) -> None:
+        """Rewind to a checkpointed resume point. Staged-but-undelivered
+        batches from the current producer are discarded (they were never
+        consumed, so dropping them neither skips nor duplicates data)."""
+        if self._closed:
+            raise MXNetError("PrefetchingIter is closed")
+        if not self._track_state:
+            raise MXNetError("PrefetchingIter.set_state: base iterator(s) "
+                             "without the state protocol")
+        if len(state["base"]) != len(self.iters):
+            raise MXNetError(
+                "PrefetchingIter.set_state: checkpoint carries %d base "
+                "state(s) but this iterator composes %d — a partial "
+                "restore would silently mispair the streams"
+                % (len(state["base"]), len(self.iters)))
+        self._stop_producer()
+        self._terminal = None
+        for it, s in zip(self.iters, state["base"]):
+            it.set_state(s)
+        self._last_states = [dict(s) for s in state["base"]]
+        self._restart()
+
+    def close(self):
+        """Stop the producer, drop staged batches, and close the base
+        iterators (their own threads/watchdogs/buffers) — interrupted
+        epochs must not leak anything at any layer. Idempotent; terminal."""
+        if self._closed:
+            return
+        self._closed = True
+        self._stop_producer()
+        for it in self.iters:
+            it.close()
+
     def next(self):
+        if self._closed:
+            raise MXNetError("PrefetchingIter is closed")
+        if self._terminal is not None:
+            # producer already exited: fail fast, never block on the queue
+            if self._terminal is StopIteration:
+                raise StopIteration
+            raise self._terminal
         item = self._queue.get()
         if item is None:
+            self._terminal = StopIteration
             raise StopIteration
         if isinstance(item, Exception):
+            self._terminal = item
             raise item
-        batches = item
+        batches, states = item
+        if states is not None:
+            self._last_states = states
+        if _metrics.enabled():
+            _telemetry.IO_QUEUE_DEPTH.set(self._queue.qsize(),
+                                          iter="PrefetchingIter")
         data = [d for b in batches for d in b.data]
         label = [l for b in batches for l in (b.label or [])]
         return DataBatch(data=data, label=label, pad=batches[0].pad,
@@ -332,6 +549,12 @@ class CSVIter(DataIter):
 
     def reset(self):
         self._inner.reset()
+
+    def state(self) -> Dict:
+        return {"iter": "CSVIter", "base": self._inner.state()}
+
+    def set_state(self, state: Dict) -> None:
+        self._inner.set_state(state["base"])
 
     def next(self):
         return self._inner.next()
@@ -369,6 +592,12 @@ class MNISTIter(DataIter):
 
     def reset(self):
         self._inner.reset()
+
+    def state(self) -> Dict:
+        return {"iter": "MNISTIter", "base": self._inner.state()}
+
+    def set_state(self, state: Dict) -> None:
+        self._inner.set_state(state["base"])
 
     def next(self):
         return self._inner.next()
@@ -420,6 +649,16 @@ class ImageRecordIter(DataIter):
         self.label_name = label_name
         self._order = None
         self._pos = 0
+        # private shuffle RNG (see NDArrayIter): the record ORDER is a pure
+        # function of (seed, epoch); state() is record-offset based. The
+        # already-accepted ``seed`` kwarg (reference parity) pins it.
+        self._shuffle_seed = (
+            (int(seed) if seed is not None
+             else int(_mxrandom.host_rng().randint(0, 2 ** 31 - 1)))
+            if shuffle else None)
+        self._shuffle_rng = (np.random.RandomState(self._shuffle_seed)
+                             if shuffle else None)
+        self._epoch = -1
         self.reset()
 
     @property
@@ -433,13 +672,60 @@ class ImageRecordIter(DataIter):
         return [DataDesc(self.label_name, shape)]
 
     def reset(self):
+        self._epoch += 1
         self._pos = 0
         if self._keys is not None:
             self._order = list(self._keys)
             if self.shuffle:
-                np.random.shuffle(self._order)
+                self._shuffle_rng.shuffle(self._order)
         else:
             self._rec.reset()
+
+    # ------------------------------------------------- checkpointable state
+    def state(self) -> Dict:
+        """Record-offset resume point: epoch count, position within the
+        (seed, epoch)-determined record order. Augmentation randomness
+        (rand_crop/rand_mirror) is deliberately NOT part of the state —
+        record identity and order are exact on resume; pixel-level
+        augmentation draws continue from the process RNG."""
+        return {"iter": "ImageRecordIter", "epoch": self._epoch,
+                "pos": int(self._pos),
+                "num_records": (len(self._keys)
+                                if self._keys is not None else None),
+                "shuffle_seed": self._shuffle_seed}
+
+    def set_state(self, state: Dict) -> None:
+        epoch, pos = int(state["epoch"]), int(state["pos"])
+        if bool(self.shuffle) != (state.get("shuffle_seed") is not None):
+            raise MXNetError(
+                "ImageRecordIter.set_state: checkpoint was written with "
+                "shuffle=%s but this iterator has shuffle=%s"
+                % (state.get("shuffle_seed") is not None, self.shuffle))
+        if self._keys is not None:
+            if state.get("num_records") != len(self._keys):
+                raise MXNetError(
+                    "ImageRecordIter.set_state: checkpointed iterator had "
+                    "%s records, this one has %d — not the same recfile"
+                    % (state.get("num_records"), len(self._keys)))
+            if self.shuffle:
+                seed = state.get("shuffle_seed")
+                # each reset() shuffles a FRESH copy of keys: replaying
+                # epoch+1 shuffles advances the stream to the same order
+                self._shuffle_seed = int(seed)
+                self._shuffle_rng = np.random.RandomState(self._shuffle_seed)
+                for _ in range(epoch + 1):
+                    self._order = list(self._keys)
+                    self._shuffle_rng.shuffle(self._order)
+            else:
+                self._order = list(self._keys)
+        else:
+            # sequential (index-less) reader: rewind, then skip `pos`
+            # records — offset-exact, O(pos) bytes re-read
+            self._rec.reset()
+            for _ in range(pos):
+                self._rec.read()
+        self._epoch = epoch
+        self._pos = pos
 
     def _read_record(self, key):
         if self._native is not None:
@@ -654,6 +940,15 @@ class LibSVMIter(DataIter):
 
     def reset(self):
         self._pos = 0
+
+    def state(self) -> Dict:
+        return {"iter": "LibSVMIter", "pos": int(self._pos),
+                "nrows": int(self._nrows)}
+
+    def set_state(self, state: Dict) -> None:
+        if int(state["nrows"]) != self._nrows:
+            raise MXNetError("LibSVMIter.set_state: row count mismatch")
+        self._pos = int(state["pos"])
 
     def next(self) -> DataBatch:
         from ..ndarray import sparse as sp

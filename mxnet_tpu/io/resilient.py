@@ -1,0 +1,212 @@
+"""ResilientDataIter — fault-tolerant wrapper around any DataIter.
+
+On TPUs the host feed is the classic weak link: XLA can hide almost any
+compute inefficiency, but a reader thread hung on a flaky NFS mount or one
+torn record in a 10TB recfile kills the whole run (the reference's
+ThreadedIter, dmlc-core ``threadediter.h``, simply rethrows and dies). This
+wrapper gives the io layer the same three-tier answer the trainer got in
+the resilience PR:
+
+- **transient-read retry** — a read that fails with a typed
+  :class:`~mxnet_tpu.base.TransientIOError` (or an OS error carrying a
+  retryable marker) backs off through the *shared* exponential-backoff
+  policy (``resilience.retry``) and is retried up to
+  ``MXNET_IO_RETRY_ATTEMPTS`` times before the error propagates.
+- **corrupt-batch skip** — a :class:`~mxnet_tpu.base.CorruptRecordError`
+  (bad magic, truncated payload) is *not* retryable: re-reading the same
+  bytes yields the same garbage. Within ``MXNET_IO_SKIP_BUDGET`` the batch
+  is skipped (counted, logged); past the budget the run fails loudly —
+  silently dropping unbounded data would skew the training distribution.
+- **bounded ``next()``** — with a deadline set, a hung reader trips the
+  shared :class:`~mxnet_tpu.resilience.watchdog.Watchdog`: all-thread stack
+  dump + flight-recorder artifact + fail loud, instead of a silent stall
+  that burns pod-hours.
+
+Telemetry (catalog-declared): ``mxtpu_io_batches_total``,
+``mxtpu_io_read_retries_total``, ``mxtpu_io_corrupt_skipped_total``,
+``mxtpu_io_feed_stall_ms`` (plus the prefetch iterators'
+``mxtpu_io_queue_depth`` gauge).
+
+The wrapper is transparent to the checkpointable-iterator state protocol:
+``state()``/``set_state()`` delegate to the base iterator, so the stack
+composes with ``ResilientTrainer``'s exact mid-epoch resume.
+
+**Composition order matters**: wrap the RAW READER, inside any prefetcher —
+``DeviceFeedIter(ResilientDataIter(ImageRecordIter(...)))`` — so retries
+and skips run on the producer thread, right where the read can actually be
+re-issued. Wrapping *outside* a prefetcher still bounds ``next()`` and
+fails fast (a prefetcher whose producer died re-raises its terminal error
+instead of blocking), but a transient fault below the prefetcher cannot be
+retried from above: the producer thread is already gone.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+from ..base import (CorruptRecordError, MXNetError, TransientIOError,
+                    get_env, logger, register_config)
+from ..observability import catalog as _telemetry
+from ..observability import metrics as _metrics
+from ..resilience.retry import retry_transient
+from .io import DataBatch, DataIter, has_state
+
+__all__ = ["ResilientDataIter"]
+
+register_config("MXNET_IO_RETRY_ATTEMPTS", 3, int,
+                "Attempts per data read for ResilientDataIter before a "
+                "transient read error propagates.")
+register_config("MXNET_IO_RETRY_BASE", 0.1, float,
+                "Initial io-read backoff (s); doubles per attempt "
+                "(shared resilience backoff policy, with jitter).")
+register_config("MXNET_IO_RETRY_MAX", 5.0, float,
+                "Io-read backoff cap (s).")
+register_config("MXNET_IO_SKIP_BUDGET", 0, int,
+                "Corrupt batches ResilientDataIter may skip over the "
+                "iterator's lifetime; one past the budget fails the run "
+                "loudly. 0 = never skip (corrupt data raises immediately).")
+register_config("MXNET_IO_NEXT_DEADLINE", 0.0, float,
+                "Seconds a single ResilientDataIter.next() read may take "
+                "before the watchdog dumps stacks + flight recorder and "
+                "fails loud. 0 = unbounded.")
+
+
+class ResilientDataIter(DataIter):
+    """Retry / skip / deadline guard around a base :class:`DataIter`::
+
+        feed = io.DeviceFeedIter(
+            io.ResilientDataIter(io.ImageRecordIter(...),
+                                 skip_budget=16, next_deadline=120.0),
+            sharding=spec)
+        for batch in feed:
+            trainer.step(batch.data[0], batch.label[0])
+
+    (Retry/skip sit on the raw reader so the producer thread can re-issue
+    the failed read — see the module docstring on composition order.)
+
+    Ctor args override the ``MXNET_IO_*`` env knobs; ``on_timeout`` is
+    forwarded to the watchdog (default: ``KeyboardInterrupt`` in the main
+    thread — pass ``lambda _: os._exit(124)`` under a supervisor).
+    """
+
+    def __init__(self, base: DataIter, retries: Optional[int] = None,
+                 skip_budget: Optional[int] = None,
+                 next_deadline: Optional[float] = None,
+                 on_timeout=None, name: Optional[str] = None):
+        super().__init__(getattr(base, "batch_size", 0))
+        self._base = base
+        self._name = name or type(base).__name__
+        self._attempts = int(retries if retries is not None
+                             else get_env("MXNET_IO_RETRY_ATTEMPTS", 3))
+        # knobs resolved ONCE: next() is the per-batch hot path (the stall
+        # the feed exists to hide), so no env parsing per read
+        self._retry_base = float(get_env("MXNET_IO_RETRY_BASE", 0.1))
+        self._retry_max = float(get_env("MXNET_IO_RETRY_MAX", 5.0))
+        self._skip_budget = int(skip_budget if skip_budget is not None
+                                else get_env("MXNET_IO_SKIP_BUDGET", 0))
+        deadline = float(next_deadline if next_deadline is not None
+                         else get_env("MXNET_IO_NEXT_DEADLINE", 0.0))
+        self._watchdog = None
+        if deadline > 0:
+            from ..resilience.watchdog import Watchdog
+            self._watchdog = Watchdog(deadline, on_timeout=on_timeout)
+        self._skips = 0
+        self._retries = 0
+        self._batches = 0
+
+    # ------------------------------------------------------------ delegation
+    @property
+    def provide_data(self):
+        return self._base.provide_data
+
+    @property
+    def provide_label(self):
+        return self._base.provide_label
+
+    def reset(self):
+        self._base.reset()
+
+    def state(self) -> Dict:
+        """Delegates to the base iterator (retry/skip counters are run
+        diagnostics, not resume state)."""
+        if not has_state(self._base):
+            raise MXNetError(
+                "ResilientDataIter.state: base iterator %s has no state "
+                "protocol" % type(self._base).__name__)
+        return {"iter": "ResilientDataIter", "base": self._base.state()}
+
+    def set_state(self, state: Dict) -> None:
+        self._base.set_state(state["base"])
+
+    def close(self):
+        if self._watchdog is not None:
+            self._watchdog.close()
+        self._base.close()
+
+    def stats(self) -> Dict[str, int]:
+        """Lifetime counters: batches delivered, reads retried, corrupt
+        batches skipped."""
+        return {"batches": self._batches, "retries": self._retries,
+                "skips": self._skips}
+
+    # --------------------------------------------------------------- reading
+    def _read_once(self):
+        """One guarded base read. The watchdog arms around the *attempt*,
+        not the whole retry loop, so backoff sleeps never count against the
+        read deadline."""
+        if self._watchdog is not None:
+            with self._watchdog.arm(
+                    "data next %d (%s)" % (self._batches, self._name)):
+                return self._base.next()
+        return self._base.next()
+
+    def _read_with_retry(self):
+        def on_retry(i, exc, delay):
+            self._retries += 1
+            if _metrics.enabled():
+                _telemetry.IO_READ_RETRIES.inc(iter=self._name)
+            logger.warning(
+                "transient data-read failure on %s (attempt %d/%d), "
+                "retrying in %.2fs: %r", self._name, i + 1, self._attempts,
+                delay, exc)
+
+        return retry_transient(
+            self._read_once, attempts=self._attempts,
+            base_delay=self._retry_base, max_delay=self._retry_max,
+            on_retry=on_retry)
+
+    def next(self) -> DataBatch:
+        t0 = time.perf_counter()
+        while True:
+            try:
+                batch = self._read_with_retry()
+            except StopIteration:
+                raise
+            except CorruptRecordError as e:
+                # the batch that EXHAUSTS the budget is not skipped — it
+                # fails the run — so neither stats() nor the telemetry
+                # counter may include it
+                if self._skips + 1 > self._skip_budget:
+                    raise MXNetError(
+                        "corrupt-batch skip budget exhausted on %s: %d "
+                        "already skipped, budget %d (MXNET_IO_SKIP_BUDGET) "
+                        "— refusing to silently drop more data: %s"
+                        % (self._name, self._skips, self._skip_budget,
+                           e)) from e
+                self._skips += 1
+                if _metrics.enabled():
+                    _telemetry.IO_SKIPPED_BATCHES.inc(iter=self._name)
+                logger.warning(
+                    "skipping corrupt batch on %s (%d/%d of skip budget "
+                    "used): %r", self._name, self._skips,
+                    self._skip_budget, e)
+                continue
+            self._batches += 1
+            if _metrics.enabled():
+                _telemetry.IO_BATCHES.inc(iter=self._name)
+                _telemetry.IO_FEED_STALL_MS.observe(
+                    (time.perf_counter() - t0) * 1000.0)
+            return batch
+
+    def iter_next(self):
+        raise MXNetError("use next() on ResilientDataIter")

@@ -127,54 +127,75 @@ class BaseModule:
         if not isinstance(eval_metric, metric_mod.EvalMetric):
             eval_metric = metric_mod.create(eval_metric)
 
-        for epoch in range(begin_epoch, num_epoch):
-            tic = time.time()
-            eval_metric.reset()
-            nbatch = 0
-            train_data.reset()
-            # one span per epoch: shows up in the span histogram AND — when
-            # a profiler session is recording — as a chrome-trace row
-            with _span("module_fit_epoch", category="module"):
-                for data_batch in train_data:
-                    if monitor is not None:
-                        monitor.tic()
-                    self.forward_backward(data_batch)
-                    self.update()
-                    self.update_metric(eval_metric, data_batch.label)
-                    if monitor is not None:
-                        monitor.toc_print()
-                    if batch_end_callback is not None:
-                        params = BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                               eval_metric=eval_metric,
-                                               locals=locals())
-                        for cb in _as_list(batch_end_callback):
-                            cb(params)
-                    # preemption (SIGTERM) latches a flag; honor it at the
-                    # batch boundary — params are consistent here, so the
-                    # resilience layer (resilient_fit / the caller's except)
-                    # can checkpoint and exit instead of dying mid-update
-                    check_preempted()
-                    nbatch += 1
-                    if _obs_metrics.enabled():
-                        _telemetry.FIT_BATCHES.inc()
-            if _obs_metrics.enabled():
-                _telemetry.FIT_EPOCH_MS.observe((time.time() - tic) * 1000.0)
-            for name, val in eval_metric.get_name_value():
-                self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
-            self.logger.info("Epoch[%d] Time cost=%.3f", epoch, time.time() - tic)
+        try:
+            for epoch in range(begin_epoch, num_epoch):
+                tic = time.time()
+                eval_metric.reset()
+                nbatch = 0
+                train_data.reset()
+                # one span per epoch: shows up in the span histogram AND —
+                # when a profiler session is recording — as a chrome-trace
+                # row
+                with _span("module_fit_epoch", category="module"):
+                    for data_batch in train_data:
+                        if monitor is not None:
+                            monitor.tic()
+                        self.forward_backward(data_batch)
+                        self.update()
+                        self.update_metric(eval_metric, data_batch.label)
+                        if monitor is not None:
+                            monitor.toc_print()
+                        if batch_end_callback is not None:
+                            params = BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                                   eval_metric=eval_metric,
+                                                   locals=locals())
+                            for cb in _as_list(batch_end_callback):
+                                cb(params)
+                        # preemption (SIGTERM) latches a flag; honor it at
+                        # the batch boundary — params are consistent here,
+                        # so the resilience layer (resilient_fit / the
+                        # caller's except) can checkpoint and exit instead
+                        # of dying mid-update
+                        check_preempted()
+                        nbatch += 1
+                        if _obs_metrics.enabled():
+                            _telemetry.FIT_BATCHES.inc()
+                if _obs_metrics.enabled():
+                    _telemetry.FIT_EPOCH_MS.observe(
+                        (time.time() - tic) * 1000.0)
+                for name, val in eval_metric.get_name_value():
+                    self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
+                self.logger.info("Epoch[%d] Time cost=%.3f", epoch,
+                                 time.time() - tic)
 
-            arg_p, aux_p = self.get_params()
-            self.set_params(arg_p, aux_p, allow_missing=False, force_init=True)
-            if epoch_end_callback is not None:
-                for cb in _as_list(epoch_end_callback):
-                    cb(epoch, self.symbol, arg_p, aux_p)
-            if eval_data is not None:
-                res = self.score(eval_data, validation_metric,
-                                 score_end_callback=eval_end_callback,
-                                 batch_end_callback=eval_batch_end_callback,
-                                 epoch=epoch)
-                for name, val in res:
-                    self.logger.info("Epoch[%d] Validation-%s=%f", epoch, name, val)
+                arg_p, aux_p = self.get_params()
+                self.set_params(arg_p, aux_p, allow_missing=False,
+                                force_init=True)
+                if epoch_end_callback is not None:
+                    for cb in _as_list(epoch_end_callback):
+                        cb(epoch, self.symbol, arg_p, aux_p)
+                if eval_data is not None:
+                    res = self.score(eval_data, validation_metric,
+                                     score_end_callback=eval_end_callback,
+                                     batch_end_callback=eval_batch_end_callback,
+                                     epoch=epoch)
+                    for name, val in res:
+                        self.logger.info("Epoch[%d] Validation-%s=%f",
+                                         epoch, name, val)
+        except BaseException:
+            # interrupted epoch (Preempted, KeyboardInterrupt, a hung-reader
+            # watchdog, any crash): close the feeds so prefetch producer
+            # threads and staged device buffers don't outlive the loop. A
+            # NORMAL return leaves them open — callers may keep iterating.
+            for feed in (train_data, eval_data):
+                close = getattr(feed, "close", None)
+                if callable(close):
+                    try:
+                        close()
+                    except Exception as e:
+                        self.logger.warning(
+                            "closing data feed on fit failure raised: %r", e)
+            raise
 
     # ------------------------------------------------------------- interface
     @property

@@ -44,6 +44,29 @@ STEP_RETRIES = _m.counter(
     "mxtpu_trainer_step_retries_total",
     "Transient step failures retried by ResilientTrainer.")
 
+# -------------------------------------------------------------------- io
+IO_BATCHES = _m.counter(
+    "mxtpu_io_batches_total",
+    "Batches delivered by ResilientDataIter, labeled iter= (base iterator "
+    "class).")
+IO_READ_RETRIES = _m.counter(
+    "mxtpu_io_read_retries_total",
+    "Transient data-read failures retried with backoff "
+    "(ResilientDataIter, MXNET_IO_RETRY_*).")
+IO_SKIPPED_BATCHES = _m.counter(
+    "mxtpu_io_corrupt_skipped_total",
+    "Corrupt batches skipped under MXNET_IO_SKIP_BUDGET (past the budget "
+    "the run fails loudly instead).")
+IO_QUEUE_DEPTH = _m.gauge(
+    "mxtpu_io_queue_depth",
+    "Staged batches in a prefetch queue at last delivery, labeled iter=. "
+    "Persistently 0 under load = the producer can't keep up.")
+IO_FEED_STALL_MS = _m.histogram(
+    "mxtpu_io_feed_stall_ms",
+    "Time the consumer blocked in next() waiting for data — the host-feed "
+    "stall XLA cannot hide.",
+    buckets=(0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000, 5000, 30000))
+
 # ---------------------------------------------------------------- module
 FIT_EPOCH_MS = _m.histogram(
     "mxtpu_fit_epoch_ms", "Module.fit wall time per epoch.",

@@ -19,11 +19,12 @@ from typing import Callable, Optional
 import jax.numpy as jnp
 import numpy as np
 
-from ..base import MXNetError
+from ..base import CorruptRecordError, MXNetError, TransientIOError
 
 __all__ = ["ChaosError", "sigterm_self", "dropped_pushes", "kill_heartbeat",
            "nan_gradients", "nan_batch", "tear_checkpoint",
-           "torn_checkpoint_writes", "hung_step"]
+           "torn_checkpoint_writes", "hung_step",
+           "torn_reads", "corrupt_records", "hung_reader"]
 
 
 class ChaosError(MXNetError):
@@ -146,6 +147,78 @@ def nan_batch(like):
     step's loss and gradients (the guard must skip that step)."""
     a = np.asarray(like)
     return np.full(a.shape, np.nan, dtype=a.dtype)
+
+
+# ------------------------------------------------------------ data faults
+@contextlib.contextmanager
+def _faulty_next(it, count: int, key: str, fault, after: int = 0):
+    """Shared scaffolding for the data-fault injectors: the next ``count``
+    calls of ``it.next()`` (after ``after`` healthy ones) run
+    ``fault(orig)`` instead of the plain read; the patch is restored on
+    exit. Yields the live state dict (``key`` counts injections)."""
+    orig = it.next
+    state = {"skip": int(after), "left": int(count), key: 0}
+
+    def next_():
+        if state["skip"] > 0:
+            state["skip"] -= 1
+            return orig()
+        if state["left"] > 0:
+            state["left"] -= 1
+            state[key] += 1
+            return fault(orig)
+        return orig()
+
+    it.next = next_
+    try:
+        yield state
+    finally:
+        it.next = orig
+
+
+def torn_reads(it, reads: int = 1):
+    """Make the next ``reads`` calls of ``it.next()`` fail with a typed
+    :class:`~mxnet_tpu.base.TransientIOError` (a torn read off a flaky
+    filesystem) BEFORE any batch is produced — the retry path must re-read
+    and get the batch the failed attempt never delivered (no skip, no
+    duplicate). Yields a dict with the live ``torn`` count."""
+    def fault(orig):
+        raise TransientIOError(
+            "chaos: torn read (connection reset mid-record)")
+
+    return _faulty_next(it, reads, "torn", fault)
+
+
+def corrupt_records(it, records: int = 1):
+    """Make the next ``records`` calls of ``it.next()`` raise
+    :class:`~mxnet_tpu.base.CorruptRecordError` — garbage bytes that decode
+    the same way on every re-read, so retrying is useless and the skip
+    budget (``MXNET_IO_SKIP_BUDGET``) is the only way past. Yields a dict
+    with the live ``corrupted`` count."""
+    def fault(orig):
+        raise CorruptRecordError("chaos: record failed its magic/"
+                                 "checksum (truncated payload)")
+
+    return _faulty_next(it, records, "corrupted", fault)
+
+
+def hung_reader(it, hang: float = 3600.0, after: int = 0):
+    """Make ``it.next()`` hang for ``hang`` seconds (after ``after`` healthy
+    reads) — the dead-NFS-mount / wedged-decoder failure mode a bounded
+    ``next()`` deadline exists for. A small ``hang`` models a *slow*
+    producer (feed-stall telemetry); a large one a hung producer (the
+    ResilientDataIter watchdog must dump and fail loud). The sleep is
+    interruptible by the watchdog's ``KeyboardInterrupt``. Yields a dict
+    with the live ``hung`` count."""
+    import time as _time
+
+    def fault(orig):
+        _time.sleep(hang)
+        return orig()
+
+    # every post-`after` read hangs (count is effectively unbounded): a
+    # wedged mount does not heal after one slow read
+    return _faulty_next(it, 1 << 30, "hung", fault, after=after)
 
 
 # ------------------------------------------------------------ checkpoints

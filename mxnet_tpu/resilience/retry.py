@@ -12,7 +12,8 @@ import random as _pyrandom
 import time
 from typing import Callable, Iterable, Optional, Tuple, Type
 
-from ..base import MXNetError, TransientKVError, get_env, logger
+from ..base import (MXNetError, TransientIOError, TransientKVError, get_env,
+                    logger)
 
 __all__ = ["retry_transient", "is_transient", "backoff_delay",
            "backoff_delays"]
@@ -26,9 +27,10 @@ _TRANSIENT_MARKERS = ("resource exhausted", "unavailable", "aborted",
 
 
 def is_transient(exc: BaseException) -> bool:
-    """Heuristic: is this exception worth retrying? TransientKVError always;
-    XLA runtime errors only when they carry a retryable status marker."""
-    if isinstance(exc, TransientKVError):
+    """Heuristic: is this exception worth retrying? TransientKVError /
+    TransientIOError always; XLA runtime errors only when they carry a
+    retryable status marker."""
+    if isinstance(exc, (TransientKVError, TransientIOError)):
         return True
     if isinstance(exc, MXNetError):
         return False            # typed framework errors are deliberate

@@ -55,6 +55,21 @@ _GUARD_KEY = "__guard__%s"
 _AUX_KEY = "__aux__%s"
 
 
+def _probe_state(data_iter) -> bool:
+    """True iff the iterator can ACTUALLY capture a resume point right now.
+    ``has_state`` alone is structural: composite iterators expose the
+    protocol but raise from ``state()`` when a wrapped base lacks it, and a
+    crash inside a periodic checkpoint is the wrong place to find out."""
+    from ..io.io import has_state
+    if not has_state(data_iter):
+        return False
+    try:
+        data_iter.state()
+    except Exception:
+        return False
+    return True
+
+
 class ResilientTrainer:
     """Survivable training loop around ``DataParallelTrainer``.
 
@@ -73,12 +88,17 @@ class ResilientTrainer:
                  directory: Optional[str] = None, save_every: Optional[int] = None,
                  keep: Optional[int] = None, resume: bool = True,
                  preemption: bool = True, step_deadline: Optional[float] = None,
-                 retry: bool = True, **trainer_kwargs):
+                 retry: bool = True, data_iter=None, **trainer_kwargs):
         if not directory:
             raise MXNetError("ResilientTrainer needs a checkpoint directory")
         from ..parallel.data_parallel import DataParallelTrainer
         self.trainer = DataParallelTrainer(net, loss, optimizer,
                                            optimizer_params, **trainer_kwargs)
+        self._data_iter = None
+        self._data_state_ok = False
+        self._pending_data_state = None
+        if data_iter is not None:
+            self.attach_data(data_iter)
         self.checkpointer = ShardedCheckpointer(directory)
         self.save_every = int(save_every if save_every is not None
                               else get_env("MXNET_RESILIENCE_SAVE_EVERY", 0))
@@ -97,6 +117,36 @@ class ResilientTrainer:
         self._watchdog = Watchdog(deadline) if deadline > 0 else None
         # stale temp dirs from a previous (killed) process are dead weight
         self.checkpointer.gc()
+
+    # ------------------------------------------------------------ data feed
+    def attach_data(self, data_iter) -> "ResilientTrainer":
+        """Attach the training data iterator so checkpoints carry its
+        resume point: every ``save`` embeds ``data_iter.state()`` in the
+        manifest, and restore applies ``set_state`` — resume then continues
+        **exactly mid-epoch** (no skipped or duplicated batches; the
+        shuffle-RNG stream continues too). Attaching hands the iterator's
+        lifecycle to the trainer: ``close()`` closes it.
+
+        An iterator without the state protocol still trains, but resume
+        restarts its epoch from batch 0 — flagged here (and by mxlint rule
+        MXL-T208) instead of failing, because a stateless source (an
+        infinite generator wrapper) can be a deliberate choice. The check
+        EXERCISES ``state()``: composite iterators (PrefetchingIter,
+        DeviceFeedIter, ...) advertise the protocol structurally but raise
+        when a wrapped base cannot deliver it — that must downgrade to the
+        same warning, not kill the run at the first periodic save."""
+        self._data_iter = data_iter
+        self._data_state_ok = _probe_state(data_iter)
+        if not self._data_state_ok:
+            logger.warning(
+                "data iterator %s cannot capture a resume point (no "
+                "working state()/set_state() protocol) — a resumed run "
+                "will restart the epoch from batch 0, duplicating data "
+                "(mxlint MXL-T208)", type(data_iter).__name__)
+        elif self._pending_data_state is not None:
+            data_iter.set_state(self._pending_data_state)
+            self._pending_data_state = None
+        return self
 
     # ---------------------------------------------------------------- setup
     def _initialize(self, data) -> None:
@@ -155,9 +205,25 @@ class ResilientTrainer:
                 and int(saved_seed) != int(_random.current_seed()):
             _random.seed(int(saved_seed))
         self.step_count = int(user.get("step", step))
+        data_state = user.get("data_state")
+        if data_state is not None:
+            if self._data_iter is not None and self._data_state_ok:
+                self._data_iter.set_state(data_state)
+            elif self._data_iter is not None:
+                logger.warning(
+                    "checkpoint carries a data-iterator resume point but "
+                    "the attached iterator cannot be rewound — the epoch "
+                    "restarts from batch 0")
+            else:
+                # applied when attach_data happens (the trainer may be
+                # constructed before the feed); dropped silently only if
+                # no stateful iterator is ever attached
+                self._pending_data_state = data_state
         self.resumed_from = step
-        logger.info("resumed from checkpoint step %d (rng_counter=%d)",
-                    step, t._rng_counter)
+        logger.info("resumed from checkpoint step %d (rng_counter=%d%s)",
+                    step, t._rng_counter,
+                    ", data iterator rewound mid-epoch"
+                    if data_state is not None else "")
 
     def ensure_initialized(self, *data) -> "ResilientTrainer":
         """Eagerly capture + auto-resume using ``data`` as the sample batch
@@ -290,6 +356,18 @@ class ResilientTrainer:
             "aot_key": self._last_aot_key,
             "wall_time": time.time(),
         }
+        if self._data_iter is not None and self._data_state_ok:
+            # the iterator's exact resume point as of the batch the loop
+            # last consumed — a restore lands on the NEXT batch. Probed at
+            # attach time, but a checkpoint must never die on telemetry of
+            # any kind, so a late failure downgrades to the warned path.
+            try:
+                manifest["data_state"] = self._data_iter.state()
+            except Exception as e:
+                self._data_state_ok = False
+                logger.warning(
+                    "data iterator state capture failed (%r) — this and "
+                    "later checkpoints resume at epoch granularity", e)
         self.checkpointer.save(self.step_count, tree, aux=t._aux,
                                async_save=async_save, manifest=manifest)
         if self.keep:
@@ -306,6 +384,11 @@ class ResilientTrainer:
         self.checkpointer.close()
         if self._watchdog is not None:
             self._watchdog.close()
+        if self._data_iter is not None:
+            try:    # attached feed: stop producer threads / staged buffers
+                self._data_iter.close()
+            except Exception as e:  # pragma: no cover - best effort
+                logger.warning("closing attached data iterator failed: %r", e)
         if self._guard_acquired:
             self._guard_acquired = False
             release_guard()
@@ -323,28 +406,85 @@ class ResilientTrainer:
 
 
 # --------------------------------------------------------------- Module API
+# Checkpoint step-id encoding for resilient_fit: an epoch-end save must
+# sort after every mid-epoch save of the same epoch and before any save of
+# the next one, so latest-step resume picks the right granularity.
+#   mid-epoch save of epoch e after batch b  ->  e * SCALE + b
+#   epoch-end  save of epoch e               ->  (e + 1) * SCALE
+_FIT_STEP_SCALE = 1_000_000
+
+
+class _SkipFirstReset:
+    """DataIter proxy whose FIRST ``reset()`` is a no-op: a mid-epoch
+    resumed ``fit`` re-enters the epoch loop, which resets the iterator at
+    the epoch top — that reset would wipe the just-restored mid-epoch
+    cursor. Everything else (provide_data, state, close, iteration)
+    passes straight through."""
+
+    def __init__(self, it):
+        self._it = it
+        self._skipped = False
+
+    def reset(self):
+        if not self._skipped:
+            self._skipped = True
+            return
+        self._it.reset()
+
+    def next(self):
+        return self._it.next()
+
+    def __next__(self):
+        return self._it.next()
+
+    def __iter__(self):
+        return self
+
+    def __getattr__(self, name):
+        return getattr(self._it, name)
+
+
 def resilient_fit(mod, train_data, directory: str, num_epoch: int,
                   keep: Optional[int] = None, **fit_kwargs):
-    """Preemption-safe ``Module.fit``: epoch-granular checkpoints + resume.
+    """Preemption-safe ``Module.fit``: checkpoints + **exact mid-epoch**
+    resume.
 
-    Each epoch end commits the module's arg/aux params atomically; on entry
-    the newest verified checkpoint sets ``arg_params``/``begin_epoch`` so a
-    restarted process re-enters ``fit`` at the epoch after the last
-    committed one. Combined with the preemption guard polled inside the fit
-    batch loop, a SIGTERM'd run loses at most the current epoch.
+    Each epoch end commits the module's arg/aux params atomically, along
+    with the iterator's resume point when ``train_data`` implements the
+    state protocol (``state()``/``set_state()``) — so the shuffle stream of
+    epoch N+1 continues exactly across a restart. A preemption (SIGTERM)
+    honored at a batch boundary additionally commits a *mid-epoch*
+    checkpoint: params after the last completed batch plus the iterator
+    state right after that batch. A restarted process then re-enters
+    ``fit`` at that epoch with the iterator rewound to the next batch —
+    no sample is skipped or trained twice (bitwise-exact for stateless
+    optimizers like plain SGD).
 
-    (Step-granular bitwise resume is the ``ResilientTrainer`` path; the
-    Module path keeps the reference's epoch-checkpoint granularity,
-    ``mx.callback.do_checkpoint``, but adds the resume half the reference
-    never had.)
+    Iterators WITHOUT the state protocol fall back to the old epoch-granular
+    behavior: resume restarts at the epoch after the last committed one
+    (mxlint rule MXL-T208 flags that pairing).
+
+    On any exception escaping ``fit`` (including ``Preempted``) the train
+    and eval feeds are closed by ``Module.fit`` itself, so interrupted
+    epochs leak neither prefetch threads nor staged device buffers.
     """
+    stateful = _probe_state(train_data)
+    if not stateful:
+        logger.warning(
+            "resilient_fit: data iterator %s cannot capture a resume point "
+            "— resume falls back to epoch granularity (mxlint MXL-T208)",
+            type(train_data).__name__)
     ckpt = ShardedCheckpointer(directory)
     ckpt.gc()
     begin_epoch = 0
     arg_params = aux_params = None
+    resume_state = None
+    mid_epoch = False
+    resume_batch_offset = 0   # fit's nbatch restarts at 0 mid-epoch; keep
+    # the manifest's batch ids (and checkpoint step ids) monotonic anyway
     for step in reversed(ckpt.steps()):
         if not ckpt.verify(step):
-            logger.warning("epoch checkpoint %d is torn; skipping", step)
+            logger.warning("fit checkpoint %d is torn; skipping", step)
             continue
         tree = ckpt.restore(step)
         from .. import nd
@@ -352,22 +492,75 @@ def resilient_fit(mod, train_data, directory: str, num_epoch: int,
                       for k, v in tree.items() if k.startswith("arg:")}
         aux_params = {k[len("aux:"):]: nd.array(np.asarray(v))
                       for k, v in tree.items() if k.startswith("aux:")}
-        begin_epoch = int(ckpt.read_manifest(step)["user"]["epoch"]) + 1
-        logger.info("resilient_fit: resuming at epoch %d", begin_epoch)
+        user = ckpt.read_manifest(step)["user"]
+        mid_epoch = bool(user.get("mid_epoch"))
+        resume_state = user.get("data_state") if stateful else None
+        if mid_epoch:
+            begin_epoch = int(user["epoch"])
+            if resume_state is None:
+                # mid-epoch checkpoint but no (usable) iterator state:
+                # restarting the epoch would re-train its first batches on
+                # mid-epoch params — fall back to the previous epoch-end.
+                # Every candidate variable is reset: if NO older committed
+                # step exists, the run must start truly fresh, not on this
+                # rejected checkpoint's params.
+                logger.warning(
+                    "fit checkpoint %d is mid-epoch but the iterator "
+                    "cannot be rewound; falling back to the last "
+                    "epoch-end checkpoint", step)
+                begin_epoch = 0
+                arg_params = aux_params = None
+                mid_epoch = False
+                continue
+            resume_batch_offset = int(user.get("batch", 0))
+            logger.info("resilient_fit: resuming MID-epoch %d at batch %d",
+                        begin_epoch, resume_batch_offset)
+        else:
+            begin_epoch = int(user["epoch"]) + 1
+            logger.info("resilient_fit: resuming at epoch %d", begin_epoch)
         break
     if begin_epoch >= num_epoch:
         ckpt.close()
         return ckpt
 
-    user_cb = fit_kwargs.pop("epoch_end_callback", None)
+    if resume_state is not None:
+        train_data.set_state(resume_state)
+        if mid_epoch:
+            # fit resets the iterator at the epoch top; the first reset
+            # must not wipe the restored mid-epoch cursor
+            train_data = _SkipFirstReset(train_data)
 
-    def _epoch_end(epoch, symbol, arg_p, aux_p):
+    user_cb = fit_kwargs.pop("epoch_end_callback", None)
+    user_batch_cb = fit_kwargs.pop("batch_end_callback", None)
+
+    # live progress for the preemption handler: the batch loop polls the
+    # guard AFTER batch callbacks, so `progress` always names the last
+    # COMPLETED batch (params consistent, iterator just past it)
+    progress = {"epoch": None, "nbatch": None, "state": None}
+
+    def _track(param):
+        progress["epoch"], progress["nbatch"] = param.epoch, param.nbatch
+        if stateful:
+            progress["state"] = train_data.state()
+
+    batch_cbs = [_track]
+    if user_batch_cb is not None:
+        batch_cbs += (list(user_batch_cb)
+                      if isinstance(user_batch_cb, (list, tuple))
+                      else [user_batch_cb])
+
+    def _save(step_id, arg_p, aux_p, manifest):
         tree = {("arg:%s" % k): v._data for k, v in arg_p.items()}
         tree.update({("aux:%s" % k): v._data for k, v in aux_p.items()})
-        ckpt.save(epoch, tree, manifest={"epoch": epoch,
-                                         "wall_time": time.time()})
+        ckpt.save(step_id, tree, manifest=manifest)
         if keep:
             ckpt.gc(keep=keep)
+
+    def _epoch_end(epoch, symbol, arg_p, aux_p):
+        man = {"epoch": epoch, "wall_time": time.time()}
+        if stateful:
+            man["data_state"] = train_data.state()
+        _save((epoch + 1) * _FIT_STEP_SCALE, arg_p, aux_p, man)
         if user_cb is not None:
             cbs = user_cb if isinstance(user_cb, (list, tuple)) else [user_cb]
             for cb in cbs:
@@ -376,7 +569,33 @@ def resilient_fit(mod, train_data, directory: str, num_epoch: int,
     try:
         mod.fit(train_data, num_epoch=num_epoch, begin_epoch=begin_epoch,
                 arg_params=arg_params, aux_params=aux_params,
-                epoch_end_callback=_epoch_end, **fit_kwargs)
+                epoch_end_callback=_epoch_end,
+                batch_end_callback=batch_cbs, **fit_kwargs)
+    except Preempted:
+        # honor the preemption WITH a mid-epoch commit: params after the
+        # last completed batch + the iterator state just past it
+        if progress["epoch"] is not None and progress["state"] is not None:
+            arg_p, aux_p = mod.get_params()
+            e = int(progress["epoch"])
+            b = int(progress["nbatch"]) + 1
+            if e == begin_epoch:        # still in the epoch we resumed into
+                b += resume_batch_offset
+            if b >= _FIT_STEP_SCALE:
+                # step-id encoding holds batch < SCALE; past it, clamp so
+                # the id can never collide with the epoch-end id (the
+                # data_state, not the id, is the resume authority)
+                logger.warning(
+                    "resilient_fit: epoch has >= %d batches; mid-epoch "
+                    "checkpoint ids clamp at the encoding limit",
+                    _FIT_STEP_SCALE)
+                b = _FIT_STEP_SCALE - 1
+            _save(e * _FIT_STEP_SCALE + b, arg_p, aux_p,
+                  {"epoch": e, "batch": b, "mid_epoch": True,
+                   "data_state": progress["state"],
+                   "wall_time": time.time()})
+            logger.info("resilient_fit: preempted — committed mid-epoch "
+                        "checkpoint (epoch %d, batch %d)", e, b)
+        raise
     finally:
         ckpt.close()
     return ckpt

@@ -123,3 +123,61 @@ def test_device_feed_into_trainer_step():
     for batch in mio.DeviceFeedIter(base, sharding=spec):
         losses.append(float(trainer.step(batch.data[0], batch.label[0])))
     assert len(losses) == 2 and all(np.isfinite(l) for l in losses)
+
+
+def test_device_feed_state_resumes_without_skip_or_dup():
+    """The feed stages `depth` batches ahead; state() is the base resume
+    point of the last DELIVERED batch, so a resumed feed re-produces the
+    staged-but-undelivered batches instead of dropping them."""
+    x = np.arange(64, dtype="float32").reshape(64, 1)
+    mx.random.seed(41)
+    base = mio.NDArrayIter(data=x, label=None, batch_size=4, shuffle=True,
+                           last_batch_handle="discard")
+    feed = mio.DeviceFeedIter(base, depth=3)
+    got = [feed.next().data[0].asnumpy().ravel() for _ in range(5)]
+    time.sleep(0.2)                     # producer runs ahead
+    st = feed.state()
+    assert st["iter"] == "DeviceFeedIter"
+    mx.random.seed(4242)                # "restarted process"
+    feed2 = mio.DeviceFeedIter(
+        mio.NDArrayIter(data=x, label=None, batch_size=4, shuffle=True,
+                        last_batch_handle="discard"), depth=3)
+    feed2.set_state(st)
+    got += [feed2.next().data[0].asnumpy().ravel() for _ in range(11)]
+    flat = np.sort(np.concatenate(got))
+    np.testing.assert_array_equal(flat, np.arange(64, dtype="float32"))
+    feed.close(), feed2.close()
+
+
+def test_device_feed_close_and_context_manager():
+    x = np.zeros((32, 2), "float32")
+    with mio.DeviceFeedIter(mio.NDArrayIter(data=x, batch_size=4)) as feed:
+        feed.next()
+        t = feed._thread
+    assert t is None or not t.is_alive()    # producer joined, buffers freed
+    with pytest.raises(mx.MXNetError, match="closed"):
+        feed.next()
+    feed.close()                            # idempotent
+
+
+def test_device_feed_error_terminal_not_blocking():
+    """Regression: a producer that died on an error re-raises it on every
+    subsequent next() instead of blocking on the empty queue (what an
+    outer retry wrapper would otherwise hang on)."""
+    class Bad(mio.DataIter):
+        def __init__(self):
+            super().__init__(2)
+            self.n = 0
+
+        def next(self):
+            self.n += 1
+            if self.n > 1:
+                raise ValueError("torn stream")
+            return mio.DataBatch(data=[np.zeros((2, 2), "f4")])
+
+    feed = mio.DeviceFeedIter(Bad(), depth=2)
+    feed.next()
+    for _ in range(3):
+        with pytest.raises(ValueError, match="torn stream"):
+            feed.next()
+    feed.close()

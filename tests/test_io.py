@@ -133,3 +133,242 @@ def test_csv_iter(tmp_path, rng):
     batches = list(it)
     assert len(batches) == 2
     np.testing.assert_allclose(batches[0].data[0].asnumpy(), data[:5], rtol=1e-5)
+
+
+# ---------------------------------------------------------------- state
+# Checkpointable-iterator protocol (resilient data pipeline): state() /
+# set_state() capture epoch, cursor and shuffle-RNG seed so a fresh
+# iterator resumes EXACTLY mid-epoch — no skipped or duplicated batches.
+
+def _drain(it, n):
+    return [it.next().data[0].asnumpy().copy() for _ in range(n)]
+
+
+def test_ndarray_iter_state_mid_epoch_roundtrip(rng):
+    from mxnet_tpu.io import has_state
+    data = rng.randn(40, 3).astype("float32")
+    mx.random.seed(23)
+    it = NDArrayIter(data, None, batch_size=8, shuffle=True)
+    assert has_state(it)
+    _drain(it, 2)
+    st = it.state()
+    assert st["epoch"] == 0 and st["cursor"] == 8
+    # a "restarted process": fresh iterator, different construction seed
+    mx.random.seed(99)
+    it2 = NDArrayIter(data, None, batch_size=8, shuffle=True)
+    it2.set_state(st)
+    for mine, orig in zip(_drain(it2, 3), _drain(it, 3)):
+        np.testing.assert_array_equal(mine, orig)
+    # replay across the epoch boundary: reset() continues the SAME
+    # deterministic shuffle stream on both
+    it.reset(), it2.reset()
+    for mine, orig in zip(_drain(it2, 5), _drain(it, 5)):
+        np.testing.assert_array_equal(mine, orig)
+
+
+def test_ndarray_iter_state_covers_every_batch_exactly_once(rng):
+    """Kill/resume mid-epoch: resumed batches + pre-kill batches tile the
+    epoch with no overlap and no gap."""
+    data = np.arange(32, dtype="float32").reshape(32, 1)
+    mx.random.seed(7)
+    it = NDArrayIter(data, None, batch_size=4, shuffle=True,
+                     last_batch_handle="discard")
+    seen = [b.ravel() for b in _drain(it, 3)]          # "killed" after 3
+    st = it.state()
+    mx.random.seed(1234)                               # restart w/ new seed
+    it2 = NDArrayIter(data, None, batch_size=4, shuffle=True,
+                      last_batch_handle="discard")
+    it2.set_state(st)
+    seen += [b.ravel() for b in _drain(it2, 5)]        # rest of the epoch
+    flat = np.sort(np.concatenate(seen))
+    np.testing.assert_array_equal(flat, np.arange(32, dtype="float32"))
+
+
+def test_ndarray_iter_state_rejects_wrong_dataset(rng):
+    a = NDArrayIter(rng.randn(10, 2).astype("f4"), None, batch_size=2)
+    b = NDArrayIter(rng.randn(12, 2).astype("f4"), None, batch_size=2)
+    with pytest.raises(mx.MXNetError, match="not the same dataset"):
+        b.set_state(a.state())
+
+
+def test_resize_and_csv_iter_state(tmp_path, rng):
+    data = rng.randn(12, 3).astype("float32")
+    base = NDArrayIter(data, None, batch_size=4)
+    it = ResizeIter(base, size=5)
+    it.next(); it.next()
+    st = it.state()
+    it2 = ResizeIter(NDArrayIter(data, None, batch_size=4), size=5)
+    it2.set_state(st)
+    n = 0
+    while True:
+        try:
+            a, b = it.next(), it2.next()
+        except StopIteration:
+            break
+        np.testing.assert_array_equal(a.data[0].asnumpy(),
+                                      b.data[0].asnumpy())
+        n += 1
+    assert n == 3
+
+    dpath = str(tmp_path / "d.csv")
+    np.savetxt(dpath, rng.randn(9, 4).astype("f4"), delimiter=",")
+    c = CSVIter(data_csv=dpath, data_shape=(4,), batch_size=3)
+    c.next()
+    st = c.state()
+    c2 = CSVIter(data_csv=dpath, data_shape=(4,), batch_size=3)
+    c2.set_state(st)
+    np.testing.assert_array_equal(c.next().data[0].asnumpy(),
+                                  c2.next().data[0].asnumpy())
+
+
+def test_mnist_iter_state(tmp_path, rng):
+    import gzip, struct
+    imgs = (rng.rand(24, 28, 28) * 255).astype("uint8")
+    labels = rng.randint(0, 10, 24).astype("uint8")
+    ipath, lpath = str(tmp_path / "img.gz"), str(tmp_path / "lbl.gz")
+    with gzip.open(ipath, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, 24, 28, 28) + imgs.tobytes())
+    with gzip.open(lpath, "wb") as f:
+        f.write(struct.pack(">II", 2049, 24) + labels.tobytes())
+    mx.random.seed(3)
+    it = mx.io.MNISTIter(image=ipath, label=lpath, batch_size=4,
+                         shuffle=True)
+    it.next()
+    st = it.state()
+    mx.random.seed(77)
+    it2 = mx.io.MNISTIter(image=ipath, label=lpath, batch_size=4,
+                          shuffle=True)
+    it2.set_state(st)
+    a, b = it.next(), it2.next()
+    np.testing.assert_array_equal(a.data[0].asnumpy(), b.data[0].asnumpy())
+    np.testing.assert_array_equal(a.label[0].asnumpy(), b.label[0].asnumpy())
+
+
+def test_image_record_iter_state(tmp_path, rng):
+    rec_path = str(tmp_path / "img.rec")
+    idx_path = str(tmp_path / "img.idx")
+    w = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    for i in range(12):
+        img = (rng.rand(16, 16, 3) * 255).astype("uint8")
+        w.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i % 3), i, 0), img, img_fmt=".png"))
+    w.close()
+    kw = dict(path_imgrec=rec_path, path_imgidx=idx_path,
+              data_shape=(3, 16, 16), batch_size=4, shuffle=True,
+              preprocess_threads=1)
+    mx.random.seed(13)
+    it = ImageRecordIter(**kw)
+    it.next()
+    st = it.state()
+    assert st["pos"] == 4 and st["epoch"] == 0
+    mx.random.seed(555)
+    it2 = ImageRecordIter(**kw)
+    it2.set_state(st)
+    a, b = it.next(), it2.next()
+    np.testing.assert_array_equal(a.label[0].asnumpy(), b.label[0].asnumpy())
+    np.testing.assert_allclose(a.data[0].asnumpy(), b.data[0].asnumpy())
+    # across the epoch boundary the replayed order stays in lockstep
+    it.reset(), it2.reset()
+    a, b = it.next(), it2.next()
+    np.testing.assert_array_equal(a.label[0].asnumpy(), b.label[0].asnumpy())
+
+
+def test_prefetching_iter_state_credits_inflight_depth(rng):
+    """The producer runs up to 4 batches AHEAD of the consumer; state()
+    must be the resume point of the last DELIVERED batch, so staged
+    batches are neither lost nor duplicated on resume."""
+    import time as _time
+    data = np.arange(48, dtype="float32").reshape(48, 1)
+    mx.random.seed(31)
+    it = PrefetchingIter(NDArrayIter(data, None, batch_size=4, shuffle=True,
+                                     last_batch_handle="discard"))
+    got = [it.next().data[0].asnumpy().ravel() for _ in range(3)]
+    _time.sleep(0.2)          # let the producer fill its staging queue
+    st = it.state()
+    mx.random.seed(400)
+    it2 = PrefetchingIter(NDArrayIter(data, None, batch_size=4,
+                                      shuffle=True,
+                                      last_batch_handle="discard"))
+    it2.set_state(st)
+    got += [it2.next().data[0].asnumpy().ravel() for _ in range(9)]
+    flat = np.sort(np.concatenate(got))
+    np.testing.assert_array_equal(flat, np.arange(48, dtype="float32"))
+    it.close(), it2.close()
+
+
+def test_prefetching_iter_reset_not_stranded_by_blocked_producer(rng):
+    """Regression (reset race): a producer blocked in Queue.put after the
+    drain must observe _stop via its bounded put; reset() verifies thread
+    exit BEFORE touching the base iterators."""
+    import threading as _threading
+    data = np.zeros((400, 1), "float32")
+    it = PrefetchingIter(NDArrayIter(data, None, batch_size=2))
+    it.next()                         # producer running and queue full
+    for _ in range(3):
+        t = it._thread
+        it.reset()                    # must not hang, must join the thread
+        assert not t.is_alive()
+        it.next()
+    it.close()
+    assert not it._thread or not it._thread.is_alive()
+
+
+def test_prefetching_iter_close_and_context_manager(rng):
+    data = np.zeros((64, 2), "float32")
+    with PrefetchingIter(NDArrayIter(data, None, batch_size=4)) as it:
+        it.next()
+        t = it._thread
+    assert not t.is_alive()           # no daemon-thread leak
+    with pytest.raises(mx.MXNetError, match="closed"):
+        it.next()
+    it.close()                        # idempotent
+
+
+def test_libsvm_iter_state(tmp_path):
+    p = str(tmp_path / "d.svm")
+    with open(p, "w") as f:
+        for i in range(6):
+            f.write("%d 1:%d 3:%d\n" % (i % 2, i + 1, i + 2))
+    it = mx.io.LibSVMIter(data_libsvm=p, data_shape=(4,), batch_size=2)
+    it.next()
+    st = it.state()
+    it2 = mx.io.LibSVMIter(data_libsvm=p, data_shape=(4,), batch_size=2)
+    it2.set_state(st)
+    np.testing.assert_array_equal(it.next().label[0].asnumpy(),
+                                  it2.next().label[0].asnumpy())
+
+
+def test_prefetching_iter_terminal_conditions_are_sticky(rng):
+    """Regression: once the producer exits (exhaustion OR error), further
+    next() calls must re-raise the terminal condition immediately — a retry
+    wrapper re-calling next() would otherwise block forever on a queue no
+    thread will ever fill."""
+    from mxnet_tpu.io import DataIter, DataBatch
+
+    class Bad(DataIter):
+        def __init__(self):
+            super().__init__(2)
+            self.n = 0
+
+        def next(self):
+            self.n += 1
+            if self.n > 1:
+                raise ValueError("decode exploded")
+            return DataBatch(data=[nd.array(np.zeros((2, 2), "f4"))])
+
+    p = PrefetchingIter(Bad())
+    p.next()
+    for _ in range(3):                      # sticky, instant, no hang
+        with pytest.raises(ValueError, match="decode exploded"):
+            p.next()
+    p.close()
+
+    base = NDArrayIter(rng.randn(4, 2).astype("f4"), None, batch_size=2)
+    p2 = PrefetchingIter(base)
+    assert sum(1 for _ in p2) == 2
+    for _ in range(2):                      # exhaustion is sticky too
+        with pytest.raises(StopIteration):
+            p2.next()
+    p2.reset()                              # reset clears the terminal
+    assert sum(1 for _ in p2) == 2
+    p2.close()

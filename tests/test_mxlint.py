@@ -492,3 +492,62 @@ def test_example_resilient_training_step_lints_clean():
     spec = resilient_training.make_lint_spec()
     report = analysis.lint_trainer(spec["trainer"], *spec["data"])
     assert report.findings == [], report.to_text()
+
+
+# ------------------------------------------------------------- MXL-T208
+def test_lint_data_iter_flags_stateless_iterator():
+    """An iterator without state()/set_state() driving a resilient loop
+    means resume restarts the epoch — MXL-T208."""
+
+    class Stateless:
+        batch_size = 8
+
+        def next(self):
+            raise StopIteration
+
+    r = analysis.lint_data_iter(Stateless())
+    assert _rules(r) == ["MXL-T208"]
+    (d,) = r.findings
+    assert d.severity == "warning" and "state()" in d.message
+
+
+def test_lint_data_iter_clean_on_builtin_iterators(rng):
+    from mxnet_tpu.io import NDArrayIter, ResilientDataIter
+    data = rng.randn(8, 2).astype("float32")
+    it = NDArrayIter(data, None, batch_size=4, shuffle=True)
+    assert analysis.lint_data_iter(it).ok(fail_on="warning")
+    assert analysis.lint_data_iter(ResilientDataIter(it)) \
+        .ok(fail_on="warning")
+
+
+def test_lint_data_iter_exercises_state_through_wrappers(rng):
+    """Composite iterators advertise the protocol but raise when the
+    wrapped base can't deliver it — lint_data_iter exercises state() so
+    the hidden epoch-restart hazard still surfaces."""
+    from mxnet_tpu import io as mio
+
+    class StatelessBase(mio.DataIter):
+        def __init__(self):
+            super().__init__(4)
+            self.provide_data = []
+            self.provide_label = []
+
+        def next(self):
+            raise StopIteration
+
+    p = mio.PrefetchingIter(StatelessBase())
+    try:
+        r = analysis.lint_data_iter(p)
+        assert _rules(r) == ["MXL-T208"]
+        assert "state() raises" in r.findings[0].message
+    finally:
+        p.close()
+
+
+def test_lint_data_iter_suppression():
+    class Stateless:
+        def next(self):
+            raise StopIteration
+
+    r = analysis.lint_data_iter(Stateless(), suppress=("MXL-T208",))
+    assert not r.findings and len(r.suppressed) == 1

@@ -610,3 +610,276 @@ def test_resilient_fit_epoch_resume(tmp_path):
     got = {k: v.asnumpy() for k, v in mod2.get_params()[0].items()}
     for name in ref:
         np.testing.assert_array_equal(ref[name], got[name], err_msg=name)
+
+
+# --------------------------------------------------- mid-epoch data resume
+@pytest.mark.parametrize("kv", [False, True], ids=["fused", "kv"])
+def test_mid_epoch_kill_resume_bitwise(tmp_path, kv):
+    """Tentpole acceptance: kill mid-epoch -> restore -> the resumed run
+    consumes EXACTLY the batches the straight run consumes (no skipped or
+    duplicated data, shuffle stream continued) and reaches bitwise-equal
+    params. Data iterator state rides in the checkpoint manifest."""
+    from mxnet_tpu.io import NDArrayIter
+    b, d, n, total = 8, 6, 40, 10          # 5 batches/epoch, 2 epochs
+    rs = np.random.RandomState(21)
+    X = rs.randn(n, d).astype("f4")
+    Y = rs.randint(0, 3, (n,)).astype("f4")
+    opt, opt_p = "sgd", {"learning_rate": 0.1, "momentum": 0.9}
+    prefix = "mep%d_" % int(kv)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def make_iter():
+        mx.random.seed(29)                 # pins the shuffle seed draw
+        return NDArrayIter(X, Y, batch_size=b, shuffle=True,
+                           last_batch_handle="discard")
+
+    def make_rt(directory):
+        return ResilientTrainer(
+            _make_net(prefix), loss_fn, opt, opt_p, directory=directory,
+            preemption=False, data_iter=make_iter(),
+            **_trainer_kwargs(kv, None))
+
+    def drive(rt, total, seen):
+        it = rt._data_iter
+        rt.ensure_initialized(np.zeros((b, d), "f4"), np.zeros((b,), "f4"))
+        while rt.step_count < total:
+            try:
+                batch = it.next()
+            except StopIteration:
+                it.reset()
+                batch = it.next()
+            seen.append(batch.label[0].asnumpy().copy())
+            rt.step(batch.data[0], batch.label[0])
+
+    straight_seen = []
+    rt = make_rt(str(tmp_path / "straight"))
+    drive(rt, total, straight_seen)
+    ref = _params_np(rt.trainer)
+    rt.close()
+
+    run_dir = str(tmp_path / "run")
+    seen = []
+    rt1 = make_rt(run_dir)
+    drive(rt1, 7, seen)                    # "killed" mid-epoch 2 (batch 2/5)
+    rt1.save()
+    rt1.close()
+
+    rt2 = make_rt(run_dir)
+    drive(rt2, total, seen)
+    assert rt2.resumed_from == 7
+    # exact batch coverage: killed + resumed == straight, in order
+    assert len(seen) == len(straight_seen)
+    for a, bb in zip(straight_seen, seen):
+        np.testing.assert_array_equal(a, bb)
+    got = _params_np(rt2.trainer)
+    for name in ref:
+        assert np.array_equal(ref[name], got[name]), name
+    rt2.close()
+
+
+@pytest.mark.chaos
+def test_resilient_fit_mid_epoch_resume_bitwise(tmp_path):
+    """Module path: preemption mid-epoch commits params + iterator state;
+    the restarted fit re-enters the SAME epoch at the next batch (shuffle
+    stream continued) and finishes bitwise-equal to an uninterrupted run."""
+    from mxnet_tpu import sym
+    from mxnet_tpu.io import NDArrayIter
+    from mxnet_tpu.module import Module
+
+    def mlp():
+        data = sym.Variable("data")
+        fc1 = sym.FullyConnected(data, num_hidden=8, name="fc1")
+        act = sym.Activation(fc1, act_type="relu")
+        fc2 = sym.FullyConnected(act, num_hidden=3, name="fc2")
+        return sym.SoftmaxOutput(fc2, sym.Variable("softmax_label"),
+                                 name="softmax")
+
+    rng = np.random.RandomState(9)
+    x = rng.randn(48, 6).astype("f4")
+    y = rng.randint(0, 3, (48,)).astype("f4")
+    fitkw = dict(optimizer="sgd",
+                 optimizer_params={"learning_rate": 0.1},
+                 initializer=mx.init.Xavier(), kvstore=None)
+
+    def make_iter():
+        return NDArrayIter(x, y, batch_size=16, shuffle=True)
+
+    mx.random.seed(5)
+    ref_mod = Module(mlp(), context=mx.cpu())
+    ref_mod.fit(make_iter(), num_epoch=3, **fitkw)
+    ref = {k: v.asnumpy() for k, v in ref_mod.get_params()[0].items()}
+
+    guard = install()
+    guard.reset()
+    d = str(tmp_path / "fit")
+    mx.random.seed(5)
+    mod = Module(mlp(), context=mx.cpu())
+
+    def tick(param):
+        if param.epoch == 1 and param.nbatch == 0:
+            guard.trigger()            # preempt MID-epoch 1 (batch 0 of 3)
+
+    try:
+        with pytest.raises(Preempted):
+            resilient_fit(mod, make_iter(), d, num_epoch=3,
+                          batch_end_callback=tick, **fitkw)
+    finally:
+        guard.reset()
+    # the preemption committed a mid-epoch checkpoint
+    from mxnet_tpu.checkpoint import ShardedCheckpointer
+    ck = ShardedCheckpointer(d)
+    man = ck.read_manifest(max(ck.steps()))["user"]
+    assert man["mid_epoch"] and man["epoch"] == 1 and man["batch"] == 1
+    assert man["data_state"]["iter"] == "NDArrayIter"
+    ck.close()
+
+    mx.random.seed(5)
+    mod2 = Module(mlp(), context=mx.cpu())
+    resilient_fit(mod2, make_iter(), d, num_epoch=3, **fitkw)
+    got = {k: v.asnumpy() for k, v in mod2.get_params()[0].items()}
+    for name in ref:
+        np.testing.assert_array_equal(ref[name], got[name], err_msg=name)
+
+
+# ------------------------------------------------------------- data chaos
+@pytest.mark.chaos
+def test_torn_read_retried_and_survived(monkeypatch):
+    """Transient torn reads are retried with the shared backoff; every
+    batch is still delivered exactly once, and the telemetry counters
+    prove the retry path fired."""
+    from mxnet_tpu.io import NDArrayIter, ResilientDataIter
+    from mxnet_tpu.observability import catalog
+    monkeypatch.setenv("MXNET_IO_RETRY_BASE", "0.001")
+    data = np.arange(24, dtype="f4").reshape(24, 1)
+    base = NDArrayIter(data, None, batch_size=4)
+    feed = ResilientDataIter(base, retries=3)
+    r0 = catalog.IO_READ_RETRIES.value(iter="NDArrayIter")
+    b0 = catalog.IO_BATCHES.value(iter="NDArrayIter")
+    with chaos.torn_reads(base, reads=2) as st:
+        seen = [feed.next().data[0].asnumpy() for _ in range(6)]
+    assert st["torn"] == 2
+    assert feed.stats()["retries"] == 2 and feed.stats()["skips"] == 0
+    np.testing.assert_array_equal(np.concatenate(seen).ravel(),
+                                  np.arange(24, dtype="f4"))
+    assert catalog.IO_READ_RETRIES.value(iter="NDArrayIter") == r0 + 2
+    assert catalog.IO_BATCHES.value(iter="NDArrayIter") == b0 + 6
+    # exhausted retry budget propagates the typed error
+    with chaos.torn_reads(base, reads=3):
+        with pytest.raises(mx.TransientIOError):
+            ResilientDataIter(base, retries=2).next()
+
+
+@pytest.mark.chaos
+def test_corrupt_skip_budget_bounded(monkeypatch):
+    """Corrupt batches are skipped (counted) within MXNET_IO_SKIP_BUDGET;
+    one past the budget fails LOUDLY — unbounded silent skipping would
+    skew the training distribution."""
+    from mxnet_tpu.io import NDArrayIter, ResilientDataIter
+    from mxnet_tpu.observability import catalog
+    data = np.arange(24, dtype="f4").reshape(24, 1)
+    base = NDArrayIter(data, None, batch_size=4)
+    feed = ResilientDataIter(base, skip_budget=1)
+    s0 = catalog.IO_SKIPPED_BATCHES.value(iter="NDArrayIter")
+    with chaos.corrupt_records(base, records=1) as st:
+        batch = feed.next()                 # skip 1 corrupt, deliver next
+    assert st["corrupted"] == 1 and feed.stats()["skips"] == 1
+    np.testing.assert_array_equal(batch.data[0].asnumpy().ravel(),
+                                  np.arange(4, dtype="f4"))
+    assert catalog.IO_SKIPPED_BATCHES.value(iter="NDArrayIter") == s0 + 1
+    with chaos.corrupt_records(base, records=1):
+        with pytest.raises(mx.MXNetError,
+                           match="skip budget exhausted.*MXNET_IO_SKIP_BUDGET"):
+            feed.next()
+    # corrupt data is NOT retried (same bytes, same garbage): zero retries
+    assert feed.stats()["retries"] == 0
+    # a zero-budget iterator (the default) fails on the first corrupt batch
+    with chaos.corrupt_records(base, records=1):
+        with pytest.raises(mx.MXNetError, match="skip budget exhausted"):
+            ResilientDataIter(base).next()
+
+
+@pytest.mark.chaos
+def test_hung_reader_watchdog_dumps_flight_recorder(tmp_path, monkeypatch):
+    """A reader stuck past the next() deadline trips the shared watchdog:
+    flight-recorder artifact written, counters bumped — a dump instead of
+    a silent stall."""
+    import json
+    from mxnet_tpu.io import NDArrayIter, ResilientDataIter
+    from mxnet_tpu.observability import catalog, flight_recorder
+    flight_path = str(tmp_path / "flight.json")
+    monkeypatch.setenv("MXNET_TELEMETRY_FLIGHT_PATH", flight_path)
+    flight_recorder.record_step(1, loss=0.25, step_ms=1.0)
+    data = np.zeros((16, 2), "f4")
+    base = NDArrayIter(data, None, batch_size=4)
+    fired = []
+    feed = ResilientDataIter(base, next_deadline=0.2,
+                             on_timeout=fired.append)
+    w0 = catalog.WATCHDOG_FIRED.value()
+    f0 = catalog.FLIGHT_DUMPS.value(reason="watchdog_timeout")
+    with chaos.hung_reader(base, hang=0.8) as st:
+        batch = feed.next()        # slow-not-dead: returns after the dump
+    assert st["hung"] == 1 and batch is not None
+    assert fired and "data next" in fired[0]
+    assert catalog.WATCHDOG_FIRED.value() == w0 + 1
+    assert catalog.FLIGHT_DUMPS.value(reason="watchdog_timeout") == f0 + 1
+    with open(flight_path) as f:
+        doc = json.load(f)
+    assert doc["reason"].startswith("watchdog_timeout: data next")
+    assert doc["records"]
+    feed.close()
+
+
+def test_attach_data_warns_on_stateless_iterator(tmp_path, caplog):
+    """MXL-T208's runtime mirror: attaching an iterator without the state
+    protocol logs the epoch-restart hazard instead of failing."""
+    import logging
+
+    class Stateless:
+        batch_size = 4
+
+        def next(self):
+            raise StopIteration
+
+    rt = ResilientTrainer(_make_net("t208_"),
+                          gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+                          {"learning_rate": 0.1},
+                          directory=str(tmp_path / "d"), preemption=False)
+    with caplog.at_level(logging.WARNING, logger="mxnet_tpu"):
+        rt.attach_data(Stateless())
+    assert any("MXL-T208" in r.message for r in caplog.records)
+    rt.close()
+
+
+def test_save_survives_composite_iterator_with_stateless_base(tmp_path,
+                                                              caplog):
+    """Regression: DeviceFeedIter/PrefetchingIter ADVERTISE the state
+    protocol structurally but raise when the wrapped base lacks it — that
+    must downgrade to the MXL-T208 warning at attach time, never kill the
+    run inside a periodic checkpoint."""
+    import logging
+    from mxnet_tpu.io import DataBatch, DataIter, DeviceFeedIter
+
+    class StatelessBase(DataIter):
+        def __init__(self):
+            super().__init__(16)
+            self.rs = np.random.RandomState(0)
+
+        def next(self):
+            return DataBatch(data=[self.rs.randn(16, 6).astype("f4")],
+                             label=[self.rs.randint(0, 3, (16,))
+                                    .astype("f4")])
+
+    feed = DeviceFeedIter(StatelessBase(), depth=2)
+    rt = ResilientTrainer(_make_net("slb_"),
+                          gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+                          {"learning_rate": 0.1},
+                          directory=str(tmp_path / "d"), preemption=False)
+    with caplog.at_level(logging.WARNING, logger="mxnet_tpu"):
+        rt.attach_data(feed)
+    assert any("MXL-T208" in r.message for r in caplog.records)
+    b = feed.next()
+    rt.step(b.data[0], b.label[0])
+    step = rt.save()                       # must not raise
+    man = rt.checkpointer.read_manifest(step)["user"]
+    assert "data_state" not in man         # epoch-granular fallback
+    rt.close()

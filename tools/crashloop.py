@@ -18,32 +18,46 @@ path is bitwise-faithful under any kill schedule.
 from __future__ import annotations
 
 import argparse
+import re
 import signal
 import subprocess
 import sys
 import time
 
 DIGEST_PREFIX = "FINAL_PARAM_DIGEST="
+# the per-batch progress line the resilient example prints in --epochs
+# mode; batch >= 1 means the target is strictly MID-epoch
+_MID_EPOCH_RE = re.compile(r"\bepoch\s+(\d+)\s+batch\s+(\d+)\b")
 
 
-def run_once(cmd, kill_after, sig, grace):
+def run_once(cmd, kill_after, sig, grace, kill_mid_epoch=False):
     """Run cmd; kill it after kill_after seconds. Returns (exited, rc,
-    digest): exited=False means we killed it."""
+    digest): exited=False means we killed it.
+
+    With ``kill_mid_epoch`` the kill additionally waits (past the
+    interval) for a FRESH 'epoch E batch B' progress line with B >= 1, so
+    the signal always lands strictly inside an epoch — the worst case for
+    a resume implementation that can only restart epochs."""
     proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
                             stderr=subprocess.STDOUT, text=True)
     deadline = time.time() + kill_after
     lines = []
     digest = None
     import threading
+    mid_mark = threading.Event()
 
     def pump():
         for line in proc.stdout:
             lines.append(line)
             sys.stdout.write(line)
             sys.stdout.flush()
+            m = _MID_EPOCH_RE.search(line)
+            if m and int(m.group(2)) >= 1:
+                mid_mark.set()
 
     t = threading.Thread(target=pump, daemon=True)
     t.start()
+    armed = False
     while True:
         rc = proc.poll()
         if rc is not None:
@@ -53,6 +67,15 @@ def run_once(cmd, kill_after, sig, grace):
                     digest = line.strip()[len(DIGEST_PREFIX):]
             return True, rc, digest
         if time.time() >= deadline:
+            if kill_mid_epoch:
+                if not armed:
+                    mid_mark.clear()     # only a line AFTER the deadline
+                    armed = True         # proves we are mid-epoch NOW
+                if not mid_mark.is_set():
+                    time.sleep(0.05)
+                    continue
+                print("crashloop: mid-epoch progress seen — killing "
+                      "strictly inside the epoch", flush=True)
             print("crashloop: sending %s to pid %d"
                   % (sig.name, proc.pid), flush=True)
             proc.send_signal(sig)
@@ -81,6 +104,12 @@ def main(argv=None):
                     help="seconds to wait for a clean exit after SIGTERM "
                          "before escalating to SIGKILL")
     ap.add_argument("--max-restarts", type=int, default=50)
+    ap.add_argument("--kill-mid-epoch", action="store_true",
+                    help="after --interval seconds, wait for a fresh "
+                         "'epoch E batch B' (B >= 1) progress line and "
+                         "kill THEN — every kill lands strictly mid-epoch, "
+                         "exercising exact iterator-state resume (pair "
+                         "with example/resilient_training.py --epochs)")
     ap.add_argument("--expect-digest", default=None,
                     help="fail unless the final FINAL_PARAM_DIGEST matches")
     ap.add_argument("cmd", nargs=argparse.REMAINDER,
@@ -97,7 +126,8 @@ def main(argv=None):
         print("crashloop: attempt %d/%d" % (attempt + 1,
                                             args.max_restarts + 1),
               flush=True)
-        exited, rc, digest = run_once(cmd, args.interval, sig, args.grace)
+        exited, rc, digest = run_once(cmd, args.interval, sig, args.grace,
+                                      kill_mid_epoch=args.kill_mid_epoch)
         if exited and rc == 0 and digest is None \
                 and sig is signal.SIGTERM and attempt < args.max_restarts:
             # a graceful preemption exit is ALSO rc 0 (by design) but has
