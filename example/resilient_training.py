@@ -13,6 +13,7 @@ On completion it prints ``FINAL_PARAM_DIGEST=<sha256>`` — deterministic
 across any kill schedule, which is what crashloop asserts.
 """
 import argparse
+import contextlib
 import hashlib
 import os
 import sys
@@ -78,7 +79,36 @@ def main(argv=None):
                     help="write a metrics snapshot (JSON, or Prometheus "
                          "text for .prom/.txt) on completion — inspect "
                          "with tools/mxtop.py")
+    ap.add_argument("--inject-nan", type=int, metavar="K",
+                    default=int(os.environ.get("MXNET_CHAOS_NAN_STORM") or 0),
+                    help="chaos: poison K consecutive steps with NaN "
+                         "batches mid-run (default from "
+                         "$MXNET_CHAOS_NAN_STORM, which is how "
+                         "tools/crashloop.py --inject-nan passes it). "
+                         "Implies --recovery: the run trains in bf16 with "
+                         "in-trace loss scaling and the recovery ladder, "
+                         "self-heals via snapshot rollback, and still "
+                         "prints the uninjected FINAL_PARAM_DIGEST — "
+                         "provided the storm reaches the ladder's "
+                         "ROLLBACK rung (2*max_skips = 6 here; the first "
+                         "trip only cuts the loss scale, which replays "
+                         "nothing): shorter storms are absorbed as plain "
+                         "guard skips, which lose those batches by "
+                         "design")
+    ap.add_argument("--recovery", action="store_true",
+                    default=os.environ.get("MXNET_CHAOS_RECOVERY", "")
+                    not in ("", "0"),
+                    help="enable the self-healing stack: bf16 compute, "
+                         "in-trace dynamic loss scaling, rolling in-memory "
+                         "snapshots and the escalating recovery ladder "
+                         "(docs/resilience.md 'Recovery ladder'). Defaults "
+                         "on when $MXNET_CHAOS_RECOVERY is set — how "
+                         "crashloop --inject-nan keeps the stack (and its "
+                         "arithmetic) on for restarted attempts whose "
+                         "storm env was disarmed")
     args = ap.parse_args(argv)
+    if args.inject_nan:
+        args.recovery = True
 
     rng = np.random.RandomState(0)
     X = rng.randn(args.batch_size * 4, 20).astype("float32")
@@ -93,47 +123,66 @@ def main(argv=None):
         from mxnet_tpu.io import NDArrayIter
         data_iter = NDArrayIter(X, Y, batch_size=args.batch_size,
                                 shuffle=True, last_batch_handle="discard")
+    extra = {}
+    if args.recovery:
+        # deterministic, demo-scaled ladder: snapshot often, trip after 3
+        # consecutive skips, observe synchronously (lag=0) so the chaos
+        # window and the recovery land at reproducible steps
+        extra = {"compute_dtype": "bfloat16", "loss_scaling": True,
+                 "recovery": {"snapshot_every": 5, "max_skips": 3,
+                              "lag": 0, "heal_steps": 10,
+                              "lr_backoff": 1.0}}
     rt = ResilientTrainer(
         make_net(), gluon.loss.SoftmaxCrossEntropyLoss(),
         "sgd", {"learning_rate": 0.1, "momentum": 0.9},
         directory=args.ckpt_dir, save_every=args.save_every,
-        grad_guard=True, data_iter=data_iter)
+        grad_guard=True, data_iter=data_iter, **extra)
 
     bpe = X.shape[0] // args.batch_size          # batches per epoch
     total = args.epochs * bpe if args.epochs else args.steps
+    storm = contextlib.nullcontext({})
+    if args.inject_nan:
+        from mxnet_tpu.resilience import chaos
+        # a storm of 2*max_skips poisons exactly through cut_scale AND the
+        # snapshot rollback, so the replayed steps are clean and the final
+        # digest matches the uninjected run (the acceptance bar)
+        storm = chaos.nan_storm(rt, steps=args.inject_nan, after=12)
     try:
         # eager resume: step_count must be correct BEFORE the loop condition
         # first runs, or a restart after the final step would train one past
         # the target (and diverge from the uninterrupted digest)
         rt.ensure_initialized(X[:args.batch_size], Y[:args.batch_size])
-        while rt.step_count < total:
-            if data_iter is not None:
-                try:
-                    b = data_iter.next()
-                except StopIteration:
-                    data_iter.reset()
-                    b = data_iter.next()
-                loss = rt.step(b.data[0], b.label[0])
-                print("epoch %d batch %d step %d loss %.5f%s" % (
-                    (rt.step_count - 1) // bpe, (rt.step_count - 1) % bpe,
-                    rt.step_count, float(loss),
-                    "  (resumed from %s)" % rt.resumed_from
-                    if rt.resumed_from is not None
-                    and rt.step_count == rt.resumed_from + 1 else ""),
-                    flush=True)
-                continue
-            i = rt.step_count % 4
-            x = X[i * args.batch_size:(i + 1) * args.batch_size]
-            y = Y[i * args.batch_size:(i + 1) * args.batch_size]
-            loss = rt.step(x, y)
-            if rt.step_count % 10 == 0 or rt.step_count == args.steps:
-                print("step %3d  loss %.5f%s" % (
-                    rt.step_count, float(loss),
-                    "  (resumed from %s)" % rt.resumed_from
-                    if rt.resumed_from is not None else ""), flush=True)
+        with storm as storm_state:
+            while rt.step_count < total:
+                if data_iter is not None:
+                    try:
+                        b = data_iter.next()
+                    except StopIteration:
+                        data_iter.reset()
+                        b = data_iter.next()
+                    loss = rt.step(b.data[0], b.label[0])
+                    print("epoch %d batch %d step %d loss %.5f%s" % (
+                        (rt.step_count - 1) // bpe, (rt.step_count - 1) % bpe,
+                        rt.step_count, float(loss),
+                        "  (resumed from %s)" % rt.resumed_from
+                        if rt.resumed_from is not None
+                        and rt.step_count == rt.resumed_from + 1 else ""),
+                        flush=True)
+                    continue
+                i = rt.step_count % 4
+                x = X[i * args.batch_size:(i + 1) * args.batch_size]
+                y = Y[i * args.batch_size:(i + 1) * args.batch_size]
+                loss = rt.step(x, y)
+                if rt.step_count % 10 == 0 or rt.step_count == args.steps:
+                    print("step %3d  loss %.5f%s" % (
+                        rt.step_count, float(loss),
+                        "  (resumed from %s)" % rt.resumed_from
+                        if rt.resumed_from is not None else ""), flush=True)
     except Preempted:
-        print("preempted at step %d — checkpoint committed, exiting clean"
-              % rt.step_count, flush=True)
+        # the final save is deferred when skipped steps still await rollback
+        # replay — resume then falls back to the last healthy checkpoint
+        print("preempted at step %d — exiting clean (resume continues from "
+              "the newest committed checkpoint)" % rt.step_count, flush=True)
         rt.close()
         return 0
 
@@ -148,6 +197,10 @@ def main(argv=None):
         print("telemetry snapshot written to %s"
               % observability.write_snapshot(args.telemetry_snapshot))
     print("training complete at step %d" % rt.step_count)
+    if args.inject_nan:
+        print("chaos: poisoned %d step(s); recovery ladder history: %s"
+              % (storm_state.get("poisoned", 0), rt.recovery_history),
+              flush=True)
     print("FINAL_PARAM_DIGEST=%s" % digest.hexdigest(), flush=True)
     return 0
 

@@ -26,6 +26,11 @@ Rules (catalog in docs/static_analysis.md):
                                           a sharded mesh)
 * MXL-T207 float64-in-trace     (error)   f64 appears in args or is
                                           introduced by a primitive
+* MXL-T208 unresumable-data-iter (warning) resilient run fed by an iterator
+                                          without state()/set_state()
+* MXL-T209 unscaled-lowprec-loss (warning) bf16/fp16 compute_dtype step
+                                          with no loss-scale state (tiny
+                                          grads underflow silently)
 """
 from __future__ import annotations
 
@@ -86,6 +91,14 @@ register_rule(
     "without the checkpointable-iterator state protocol (state()/"
     "set_state()): a resume silently restarts the epoch from batch 0, "
     "re-training already-seen batches and skewing convergence.")
+register_rule(
+    "MXL-T209", "warning", "unscaled-lowprec-loss",
+    "A bf16/fp16 compute_dtype step trains with no loss-scale state: the "
+    "short low-precision mantissa underflows tiny gradients to zero "
+    "(silently, unlike overflow — no NaN ever surfaces), stalling or "
+    "skewing convergence late in training. Enable in-trace dynamic loss "
+    "scaling (DataParallelTrainer(loss_scaling=True)) or contrib.amp's "
+    "LossScaler.")
 
 _HOST_SYNC_METHODS = ("item", "asscalar", "asnumpy", "wait_to_read")
 _NP_NAMES = ("np", "numpy", "onp")
@@ -494,8 +507,36 @@ def lint_trainer(trainer, *data, suppress: Sequence[str] = (),
     rng = jax.random.PRNGKey(0)
     step_args = (trainer._params, trainer._aux, trainer._opt_state,
                  trainer._guard_state, rng) + tuple(arrays)
-    return lint_step(trainer._step_fn, step_args,
-                     const_bytes_threshold=const_bytes_threshold,
-                     donate_bytes_threshold=donate_bytes_threshold,
-                     suppress=suppress,
-                     subject=subject or "DataParallelTrainer fused step")
+    report = lint_step(trainer._step_fn, step_args,
+                       const_bytes_threshold=const_bytes_threshold,
+                       donate_bytes_threshold=donate_bytes_threshold,
+                       suppress=suppress,
+                       subject=subject or "DataParallelTrainer fused step")
+
+    # ---- unscaled low-precision loss (MXL-T209): read off the trainer's
+    # own config, not the trace — the hazard is the ABSENCE of scaler state
+    cdtype = trainer._compute_dtype
+    lowprec = cdtype is not None and str(np.dtype(cdtype)) in (
+        "bfloat16", "float16")
+    if lowprec and trainer._scaler_cfg is None:
+        amp_on = False
+        try:
+            from ..contrib import amp as _amp
+            amp_on = _amp.is_enabled()
+        except Exception:
+            pass
+        report.add(Diagnostic(
+            "MXL-T209",
+            f"compute_dtype={np.dtype(cdtype)} step has no loss-scale "
+            "state: gradients below the low-precision normal range "
+            "underflow to zero silently (no NaN, no guard skip — just "
+            "stalled convergence)"
+            + (" — contrib.amp is enabled but its LossScaler is not wired "
+               "into this fused step (and is not checkpointed here)"
+               if amp_on else ""),
+            location=type(trainer).__name__,
+            hint="construct with loss_scaling=True (in-trace dynamic "
+                 "scaling: overflow halves, growth_interval clean steps "
+                 "double, zero per-step host syncs) — state rides in "
+                 "checkpoints automatically"))
+    return report

@@ -368,6 +368,22 @@ class ShardedCheckpointer:
         steps = self.steps()
         return steps[-1] if steps else None
 
+    def prune_newer(self, step: int) -> int:
+        """Remove committed checkpoints saved AFTER ``step``: called when
+        training rewinds past them (a recovery rollback or mid-run durable
+        restore), because a later resume would otherwise pick one from the
+        abandoned timeline and jump training forward into the very state
+        the rewind escaped. Joins in-flight async saves first so a pending
+        abandoned-timeline save cannot commit after the prune. Returns the
+        number removed."""
+        self.wait_until_finished()
+        dropped = 0
+        for s in self._committed_steps():
+            if s > step:
+                shutil.rmtree(self._step_dir(s), ignore_errors=True)
+                dropped += 1
+        return dropped
+
     # --------------------------------------------------------------- restore
     def restore(self, step: int, like=None, shardings=None) -> Dict[str, Any]:
         """Restore step ``step``. ``like`` (a params tree of live arrays) or

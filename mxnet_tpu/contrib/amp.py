@@ -12,15 +12,40 @@ after ``growth_interval`` clean steps.
 """
 from __future__ import annotations
 
+import pickle
 from contextlib import contextmanager
-from typing import Optional
+from typing import Any, Dict, Optional, Tuple
 
 from ..base import MXNetError
 
 __all__ = ["init", "is_enabled", "convert_hybrid_block", "init_trainer",
-           "scale_loss", "LossScaler"]
+           "scale_loss", "LossScaler", "pack_states", "unpack_states"]
 
 _state = {"enabled": False, "dtype": "bfloat16"}
+
+# one fused jitted reduction over ALL gradients -> a single non-finite
+# count on device (the reference's multi_all_finite as one XLA program).
+# jit caches one executable per distinct (shapes, dtypes) pytree — i.e.
+# one compile per model, not per step. The scalar it returns is ASYNC:
+# nothing blocks until the caller actually needs the boolean.
+_nonfinite_count_fn = None
+
+
+def _nonfinite_count(grads: Tuple) -> Any:
+    global _nonfinite_count_fn
+    if _nonfinite_count_fn is None:
+        import jax
+        import jax.numpy as jnp
+
+        def count(gs):
+            total = jnp.zeros((), jnp.int32)
+            for g in jax.tree_util.tree_leaves(gs):
+                total = total + jnp.sum(
+                    ~jnp.isfinite(g)).astype(jnp.int32)
+            return total
+
+        _nonfinite_count_fn = jax.jit(count)
+    return _nonfinite_count_fn(grads)
 
 
 def init(target_dtype: str = "bfloat16") -> None:
@@ -42,24 +67,51 @@ class LossScaler:
     def __init__(self, init_scale: float = 2.0 ** 10,
                  growth_interval: int = 200):
         self.loss_scale = float(init_scale)
+        self._init_scale = float(init_scale)
         self.growth_interval = growth_interval
         self._good_steps = 0
 
-    def has_overflow(self, params) -> bool:
-        """Device-side finiteness check: one reduced scalar crosses to the
-        host (the reference's multi_all_finite), never the gradients."""
-        import jax.numpy as jnp
+    def reset(self) -> None:
+        """Back to construction state: loading a states file from a
+        lineage that never had a scaler must not keep another run's earned
+        scale alive."""
+        self.loss_scale = self._init_scale
+        self._good_steps = 0
+
+    def overflow_scalar(self, params):
+        """Non-finite-gradient count as a LAZY device scalar: ONE fused
+        jitted reduction over every gradient (one dispatch, no host sync
+        here — the reference's multi_all_finite). ``None`` when no
+        parameter has a gradient. Resolve with ``bool(...)`` only at the
+        point the skip decision is actually made; until then training
+        dispatch keeps flowing. The same reduction serves the gluon path
+        (``init_trainer``) and diagnostics."""
         from ..ndarray.ndarray import _unwrap
-        bad = None
-        for p in params:
-            if p.grad_req == "null":
-                continue
-            g = p.grad
-            if g is None:
-                continue
-            cnt = jnp.sum(~jnp.isfinite(_unwrap(g)))
-            bad = cnt if bad is None else bad + cnt
-        return bool(bad) if bad is not None else False
+        grads = tuple(_unwrap(p.grad) for p in params
+                      if p.grad_req != "null" and p.grad is not None)
+        if not grads:
+            return None
+        return _nonfinite_count(grads)
+
+    def has_overflow(self, params) -> bool:
+        """Blocking form of :meth:`overflow_scalar` (back-compat): the one
+        reduced scalar crosses to the host, never the gradients."""
+        cnt = self.overflow_scalar(params)
+        return bool(cnt) if cnt is not None else False
+
+    # scaler state round-trips through gluon Trainer.save_states /
+    # Module.save_checkpoint(save_optimizer_states=True) so an AMP run
+    # resumes with the scale it had earned, not init_scale
+    def state_dict(self) -> Dict[str, Any]:
+        return {"loss_scale": float(self.loss_scale),
+                "good_steps": int(self._good_steps),
+                "growth_interval": int(self.growth_interval)}
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        self.loss_scale = float(state["loss_scale"])
+        self._good_steps = int(state.get("good_steps", 0))
+        if "growth_interval" in state:
+            self.growth_interval = int(state["growth_interval"])
 
     def update(self, overflow: bool) -> None:
         if overflow:
@@ -75,8 +127,17 @@ class LossScaler:
 def init_trainer(trainer, loss_scaler: Optional[LossScaler] = None) -> None:
     """Attach a dynamic loss scaler to a gluon Trainer and wrap its step:
     grads are unscaled via the trainer's rescale machinery; overflowed steps
-    are SKIPPED (the reference amp trainer hook)."""
+    are SKIPPED (the reference amp trainer hook). The finiteness check is
+    ONE fused jitted reduction (``LossScaler.overflow_scalar``), not a
+    dispatch per parameter; its single scalar is resolved at the branch
+    point — the only host read the imperative gluon path fundamentally
+    needs. A scaler state loaded by ``Trainer.load_states`` BEFORE this
+    call is applied here."""
     scaler = loss_scaler or LossScaler()
+    pending = getattr(trainer, "_pending_amp_state", None)
+    if pending is not None:
+        scaler.load_state_dict(pending)
+        trainer._pending_amp_state = None
     trainer._amp_loss_scaler = scaler
     orig_step = trainer.step
 
@@ -89,6 +150,39 @@ def init_trainer(trainer, loss_scaler: Optional[LossScaler] = None) -> None:
         scaler.update(overflow)
 
     trainer.step = step
+
+
+# ------------------------------------------------- state-file envelope
+# gluon Trainer.save_states / Module's optimizer .states files are opaque
+# updater bytes; when a LossScaler is attached its state must ride along
+# or a resumed AMP run silently restarts from init_scale. The envelope is
+# a magic byte prefix + pickled wrapper around the original payload: the
+# sniff on load is an O(1) startswith, never a speculative unpickle of a
+# potentially-large plain updater payload. Readers without a scaler (or
+# old files without an envelope) keep working.
+_STATES_MAGIC = b"\x93MXTPU_AMP_STATES_V1\n"
+
+
+def pack_states(payload: bytes, scaler) -> bytes:
+    """Wrap opaque optimizer-state bytes with the scaler state — a
+    :class:`LossScaler` or an already-materialized state dict (the
+    load-before-init_trainer stash). No-op passthrough when ``scaler`` is
+    None."""
+    if scaler is None:
+        return payload
+    state = scaler.state_dict() if isinstance(scaler, LossScaler) \
+        else dict(scaler)
+    return _STATES_MAGIC + pickle.dumps(
+        {"updater": payload, "amp_scaler": state})
+
+
+def unpack_states(data: bytes) -> Tuple[bytes, Optional[Dict[str, Any]]]:
+    """Inverse of :func:`pack_states`: returns ``(updater_bytes,
+    scaler_state_or_None)``. Non-envelope bytes pass through untouched."""
+    if not data.startswith(_STATES_MAGIC):
+        return data, None
+    obj = pickle.loads(data[len(_STATES_MAGIC):])
+    return obj["updater"], obj.get("amp_scaler")
 
 
 @contextmanager

@@ -146,11 +146,42 @@ class Trainer:
 
     # ------------------------------------------------------------- states
     def save_states(self, fname: str) -> None:
+        payload = self._updaters[0].get_states(dump_optimizer=False)
+        # an AMP run's dynamic loss scale is earned state: resuming from
+        # init_scale would re-walk the whole growth ramp (and overflow-skip
+        # early steps a matured scale handles). A stashed-but-unconsumed
+        # load (amp.init_trainer not run yet) counts too — a re-save must
+        # not strip the envelope it was loaded with
+        scaler = getattr(self, "_amp_loss_scaler", None)
+        if scaler is None:
+            scaler = getattr(self, "_pending_amp_state", None)
+        if scaler is not None:
+            from ..contrib import amp
+            payload = amp.pack_states(payload, scaler)
         with open(fname, "wb") as f:
-            f.write(self._updaters[0].get_states(dump_optimizer=False))
+            f.write(payload)
 
     def load_states(self, fname: str) -> None:
         if not self._kv_initialized:
             self._init_kvstore()
         with open(fname, "rb") as f:
-            self._updaters[0].set_states(f.read())
+            data = f.read()
+        from ..contrib import amp
+        payload, scaler_state = amp.unpack_states(data)
+        self._updaters[0].set_states(payload)
+        if scaler_state is not None:
+            scaler = getattr(self, "_amp_loss_scaler", None)
+            if scaler is not None:
+                scaler.load_state_dict(scaler_state)
+            else:
+                # amp.init_trainer has not run yet — it applies this
+                self._pending_amp_state = scaler_state
+        else:
+            # a non-AMP file supersedes any scaler state from a previously
+            # loaded AMP file — both the init_trainer stash AND a live
+            # attached scaler's earned scale (keeping either would graft
+            # the abandoned run's scale onto this lineage)
+            self._pending_amp_state = None
+            scaler = getattr(self, "_amp_loss_scaler", None)
+            if scaler is not None:
+                scaler.reset()

@@ -270,12 +270,37 @@ class Module(BaseModule):
         arg, aux = self.get_params()
         save_checkpoint(prefix, epoch, self._symbol, arg, aux)
         if save_optimizer_states:
+            payload = self._updater.get_states()
+            scaler = getattr(self, "_amp_loss_scaler", None)
+            if scaler is not None:
+                # AMP runs resume with the loss scale they earned, not
+                # init_scale (same envelope as gluon Trainer.save_states)
+                from ..contrib import amp
+                payload = amp.pack_states(payload, scaler)
             with open(f"{prefix}-{epoch:04d}.states", "wb") as f:
-                f.write(self._updater.get_states())
+                f.write(payload)
 
     def load_optimizer_states(self, fname):
+        from ..contrib import amp
         with open(fname, "rb") as f:
-            self._updater.set_states(f.read())
+            payload, scaler_state = amp.unpack_states(f.read())
+        self._updater.set_states(payload)
+        if scaler_state is not None:
+            scaler = getattr(self, "_amp_loss_scaler", None)
+            if scaler is None:
+                # the state carries everything a scaler needs (scale, growth
+                # counter, interval) — attach a restored one here, because
+                # unlike the gluon path there is no later init_trainer hook
+                # to consume a stash
+                scaler = amp.LossScaler()
+                self._amp_loss_scaler = scaler
+            scaler.load_state_dict(scaler_state)
+        else:
+            scaler = getattr(self, "_amp_loss_scaler", None)
+            if scaler is not None:
+                # a non-AMP file: an attached scaler keeping another run's
+                # earned scale would graft it onto this lineage
+                scaler.reset()
 
     def reshape(self, data_shapes, label_shapes=None):
         self.bind(data_shapes, label_shapes, for_training=self.for_training,

@@ -129,6 +129,30 @@ PREEMPTIONS = _m.counter(
 FLIGHT_DUMPS = _m.counter(
     "mxtpu_flight_recorder_dumps_total",
     "Flight-recorder artifacts written, labeled reason=.")
+RECOVERY_TRIPS = _m.counter(
+    "mxtpu_recovery_trips_total",
+    "Recovery-ladder detector trips, labeled kind=skip_streak|"
+    "loss_divergence|escalated.")
+RECOVERY_ROLLBACKS = _m.counter(
+    "mxtpu_recovery_rollbacks_total",
+    "Recovery-ladder actions taken, labeled action=cut_scale|rollback|"
+    "restore|fail|heal (rollback = in-memory snapshot, restore = durable "
+    "checkpoint).")
+RECOVERY_RUNG = _m.gauge(
+    "mxtpu_recovery_rung",
+    "Current recovery-ladder rung (0 = healthy; de-escalates after "
+    "MXNET_RECOVERY_HEAL_STEPS clean steps).")
+RECOVERY_SNAPSHOTS = _m.counter(
+    "mxtpu_recovery_snapshots_total",
+    "Rolling in-memory snapshots captured (rollback targets).")
+RECOVERY_DEFERRED_SAVES = _m.counter(
+    "mxtpu_recovery_deferred_saves_total",
+    "Durable checkpoints deferred because guard-skipped steps were still "
+    "awaiting rollback replay, labeled kind=periodic|preemption.")
+LOSS_SCALE = _m.gauge(
+    "mxtpu_loss_scale",
+    "Live dynamic loss scale of the in-trace scaler (published when "
+    "anomaly_stats()/recovery drains it — never synced per step).")
 
 # -------------------------------------------------------------- callbacks
 SPEEDOMETER_SPS = _m.gauge(

@@ -29,6 +29,7 @@ from ..ndarray.ndarray import _unwrap, _wrap
 from ..observability import catalog as _telemetry
 from ..observability import flight_recorder as _flight
 from ..observability import metrics as _metrics
+from ..resilience import recovery as _recovery
 from .mesh import local_mesh
 
 __all__ = ["DataParallelTrainer", "make_train_step", "sgd_momentum_init",
@@ -86,7 +87,9 @@ def _guard_init_state():
 def _guard_apply(cfg, gstate, gnorm, new_tree, old_tree):
     """Inside the jitted step: keep ``new_tree`` on a healthy step, fall
     back to ``old_tree`` (skip-step) when the gradient norm is NaN/Inf or
-    spikes past ``spike_factor``× its EMA. Returns (tree, new_gstate)."""
+    spikes past ``spike_factor``× its EMA. Returns (tree, new_gstate, bad);
+    extra keys riding in ``gstate`` (loss-scaler state, lr_scale) pass
+    through untouched."""
     gnorm = gnorm.astype(jnp.float32)
     finite = jnp.isfinite(gnorm)
     if cfg["spike_factor"] > 0:
@@ -105,12 +108,55 @@ def _guard_apply(cfg, gstate, gnorm, new_tree, old_tree):
         jnp.where(gstate["good"] == 0, safe_norm,
                   d * gstate["ema"] + (1.0 - d) * safe_norm))
     badi = bad.astype(jnp.int32)
-    new_gstate = {"ema": ema, "last_norm": gnorm,
-                  "skips": gstate["skips"] + badi,
-                  "good": gstate["good"] + (1 - badi),
-                  "steps": gstate["steps"] + 1,
-                  "last_skipped": badi}
-    return tree, new_gstate
+    new_gstate = dict(gstate)
+    new_gstate.update({"ema": ema, "last_norm": gnorm,
+                       "skips": gstate["skips"] + badi,
+                       "good": gstate["good"] + (1 - badi),
+                       "steps": gstate["steps"] + 1,
+                       "last_skipped": badi})
+    return tree, new_gstate, bad
+
+
+def _scaled_loss_run(raw_fn, rng, scale):
+    """Innermost loss closure shared by both capture paths: mean f32 loss,
+    multiplied by the live scale when one is threaded. The UNSCALED loss
+    rides in the aux slot so the host always observes the true value."""
+    def run(ins_):
+        outs, aux_updates = raw_fn(ins_, rng)
+        loss_ = jnp.mean(outs[0].astype(jnp.float32))
+        if scale is None:
+            return loss_, aux_updates
+        return loss_ * scale, (aux_updates, loss_)
+    return run
+
+
+def _unscale_grads(grads, loss, aux_updates, scale, cast_f32):
+    """Post-backward epilogue shared by both capture paths: recover the
+    unscaled loss smuggled through aux and divide the f32 gradients by the
+    scale (exact — the scale stays a power of two)."""
+    if scale is not None:
+        aux_updates, loss = aux_updates
+        grads = {k: g.astype(jnp.float32) / scale for k, g in grads.items()}
+    elif cast_f32:
+        grads = {k: g.astype(jnp.float32) for k, g in grads.items()}
+    return grads, loss, aux_updates
+
+
+def _guard_scaler_apply(guard_cfg, scaler_cfg, gstate, grads,
+                        new_tree, old_tree):
+    """Guard + scaler epilogue shared by the fused step and the kv
+    apply_step: skip-step on an anomalous gradient norm, then advance the
+    in-trace scaler off the same norm (overflow = non-finite)."""
+    import optax
+    gnorm = optax.global_norm(grads)
+    tree, gstate, bad = _guard_apply(guard_cfg, gstate, gnorm,
+                                     new_tree, old_tree)
+    if scaler_cfg is not None:
+        overflow = jnp.logical_not(jnp.isfinite(gnorm))
+        gstate = dict(gstate)
+        gstate.update(_recovery.scaler_apply(
+            scaler_cfg, gstate, overflow, bad))
+    return tree, gstate
 
 
 def _make_optax(optimizer: str, optimizer_params: Dict):
@@ -155,7 +201,8 @@ class DataParallelTrainer:
     def __init__(self, net, loss, optimizer="sgd", optimizer_params=None,
                  mesh: Optional[Mesh] = None, data_axis: str = "dp",
                  compute_dtype=None, donate: bool = True, kvstore=None,
-                 remat=None, grad_guard=None):
+                 remat=None, grad_guard=None, loss_scaling=None,
+                 dynamic_lr_scale: bool = False):
         self._net = net
         self._loss_block = loss
         if mesh is None and kvstore is not None:
@@ -199,6 +246,29 @@ class DataParallelTrainer:
         # a small state tree that rides along the step like opt_state. The
         # counters surface through anomaly_stats() / Monitor.install_trainer.
         self._guard_cfg = _guard_config(grad_guard)
+        # in-trace dynamic loss scaling (ISSUE 5 tentpole): LossScaler
+        # semantics as functional device-scalar state riding in the guard
+        # state tree — the loss is multiplied by the live scale before the
+        # backward and the f32 grads unscaled after (exact: scale stays a
+        # power of two), overflow halves the scale and skips the update,
+        # growth_interval clean steps double it. Everything happens INSIDE
+        # the jitted step: zero per-step host syncs (contrast
+        # contrib.amp.init_trainer's imperative bool(overflow) read).
+        self._scaler_cfg = _recovery.scaler_config(loss_scaling)
+        if self._scaler_cfg is not None and self._guard_cfg is None:
+            # the scaler's overflow response IS the guard's skip-step; a
+            # scaler without a guard would rescale but never skip. Any
+            # explicit off spelling (False/0/{}) is rejected — only the
+            # unset default (None) silently upgrades to guard-on
+            if grad_guard is not None:
+                raise MXNetError(
+                    "loss_scaling= requires the grad-anomaly guard; drop "
+                    "grad_guard=%r or disable loss scaling" % (grad_guard,))
+            self._guard_cfg = _guard_config(True)
+        # a device-scalar multiplier on the optimizer update (recovery
+        # ladder's LR backoff lever — lr itself is baked into the compiled
+        # executable). Off by default so the step HLO is untouched.
+        self._dynamic_lr = bool(dynamic_lr_scale)
         self._guard_state = None
         self._step_fn = None
         self._n_inputs = None
@@ -262,6 +332,11 @@ class DataParallelTrainer:
         self._aux = {n: _unwrap(pmap[n].data()) for n in aux_names}
         self._opt_state = self._tx.init(self._params)
         self._guard_state = _guard_init_state()
+        if self._scaler_cfg is not None:
+            self._guard_state.update(
+                _recovery.scaler_init_state(self._scaler_cfg))
+        if self._dynamic_lr:
+            self._guard_state["lr_scale"] = jnp.ones((), jnp.float32)
         raw_fn = lowering.lower(is_train=True)
 
         mesh, axis = self._mesh, self._axis
@@ -270,6 +345,10 @@ class DataParallelTrainer:
         cdtype = self._compute_dtype
         tx = self._tx
         guard_cfg = self._guard_cfg
+        scaler_cfg = self._scaler_cfg
+        # a key (str) rather than a bool flag: closure-captured Python
+        # scalars are exactly what mxlint MXL-T202 flags in our own step
+        lr_key = "lr_scale" if self._dynamic_lr else None
 
         def train_step(params, aux, opt_state, gstate, rng, *data):
             inputs = {}
@@ -283,27 +362,33 @@ class DataParallelTrainer:
                     cdtype is not None and jnp.issubdtype(x.dtype, jnp.floating)
                     and name != "__label") else x
 
+            # live loss scale (a traced scalar from the state tree): the
+            # loss is scaled BEFORE the backward so tiny low-precision
+            # grads stay representable, and the f32 grads are unscaled
+            # after. Scale transitions are powers of two, so in f32 the
+            # round trip is bitwise-exact.
+            scale = gstate["loss_scale"] if scaler_cfg is not None else None
+
             def loss_of(p):
                 ins = dict(inputs)
                 if cdtype is not None:
                     ins.update({k: v.astype(cdtype) for k, v in p.items()})
                 else:
                     ins.update(p)
-
-                def run(ins_):
-                    outs, aux_updates = raw_fn(ins_, rng)
-                    return jnp.mean(outs[0].astype(jnp.float32)), aux_updates
-
+                run = _scaled_loss_run(raw_fn, rng, scale)
                 if self._remat:
                     run = jax.checkpoint(run, policy=self._remat_policy)
                 return run(ins)
 
             (loss, aux_updates), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(params)
-            if cdtype is not None:
-                grads = {k: g.astype(jnp.float32) for k, g in grads.items()}
+            grads, loss, aux_updates = _unscale_grads(
+                grads, loss, aux_updates, scale, cdtype is not None)
             import optax
             updates, new_opt_state = tx.update(grads, opt_state, params)
+            if lr_key is not None:
+                lrs = gstate[lr_key]
+                updates = jax.tree_util.tree_map(lambda u: u * lrs, updates)
             new_params = optax.apply_updates(params, updates)
             new_aux = dict(aux)
             for k, v in aux_updates.items():
@@ -313,11 +398,10 @@ class DataParallelTrainer:
                 # skip-step: an anomalous gradient keeps params, aux AND
                 # opt_state at their pre-step values (a NaN forward would
                 # poison batchnorm running stats too)
-                gnorm = optax.global_norm(grads)
-                (new_params, new_aux, new_opt_state), gstate = _guard_apply(
-                    guard_cfg, gstate, gnorm,
-                    (new_params, new_aux, new_opt_state),
-                    (params, aux, opt_state))
+                (new_params, new_aux, new_opt_state), gstate = \
+                    _guard_scaler_apply(guard_cfg, scaler_cfg, gstate, grads,
+                                        (new_params, new_aux, new_opt_state),
+                                        (params, aux, opt_state))
             return new_params, new_aux, new_opt_state, gstate, loss
 
         gstate_spec = {k: repl for k in self._guard_state}
@@ -338,7 +422,12 @@ class DataParallelTrainer:
         self._n_inputs = n_inputs
 
         if self._kv is not None:
-            def grad_step(params, aux, rng, *data):
+            # with a scaler, grad_step takes the live scale as an extra
+            # scalar arg: the backward runs on the SCALED loss, and the
+            # grads are unscaled to f32 before they touch the wire, so the
+            # kvstore sums plain gradients and every worker (whose state is
+            # identical) applies the same scale transition in apply_step.
+            def grad_step(params, aux, rng, *data, scale=None):
                 inputs = dict(aux)
                 for name, x in zip(data_names, data):
                     inputs[name] = x.astype(cdtype) if (
@@ -353,28 +442,33 @@ class DataParallelTrainer:
                                     for k, v in p.items()})
                     else:
                         ins.update(p)
-
-                    def run(ins_):
-                        outs, aux_updates = raw_fn(ins_, rng)
-                        return (jnp.mean(outs[0].astype(jnp.float32)),
-                                aux_updates)
-
+                    run = _scaled_loss_run(raw_fn, rng, scale)
                     if self._remat:
                         run = jax.checkpoint(run, policy=self._remat_policy)
                     return run(ins)
 
                 (loss, aux_updates), grads = jax.value_and_grad(
                     loss_of, has_aux=True)(params)
-                grads = {k: g.astype(jnp.float32) for k, g in grads.items()}
+                # kv grads always go to f32 before they touch the wire
+                grads, loss, aux_updates = _unscale_grads(
+                    grads, loss, aux_updates, scale, True)
                 new_aux = dict(aux)
                 for k, v in aux_updates.items():
                     if k in new_aux:
                         new_aux[k] = v.astype(new_aux[k].dtype)
                 return grads, new_aux, loss
 
+            if scaler_cfg is not None:
+                def scaled_grad_step(params, aux, scale, rng, *data):
+                    return grad_step(params, aux, rng, *data, scale=scale)
+
             def apply_step(params, opt_state, gstate, grads):
                 import optax
                 updates, new_opt_state = tx.update(grads, opt_state, params)
+                if lr_key is not None:
+                    lrs = gstate[lr_key]
+                    updates = jax.tree_util.tree_map(
+                        lambda u: u * lrs, updates)
                 new_params = optax.apply_updates(params, updates)
                 if guard_cfg is not None:
                     # guard the synced (cross-worker summed) gradient: a NaN
@@ -382,18 +476,24 @@ class DataParallelTrainer:
                     # is naturally global. aux was already updated by
                     # grad_step — on the hybrid path only params/opt_state
                     # are protected.
-                    gnorm = optax.global_norm(grads)
-                    (new_params, new_opt_state), gstate = _guard_apply(
-                        guard_cfg, gstate, gnorm,
-                        (new_params, new_opt_state), (params, opt_state))
+                    (new_params, new_opt_state), gstate = \
+                        _guard_scaler_apply(guard_cfg, scaler_cfg, gstate,
+                                            grads,
+                                            (new_params, new_opt_state),
+                                            (params, opt_state))
                 return new_params, new_opt_state, gstate
 
             gspec = jax.tree_util.tree_map(lambda _: repl, self._params)
+            # one jit call for both variants: the scaled wrapper only adds
+            # a replicated scale scalar ahead of rng
+            scaled = scaler_cfg is not None
             self._grad_fn = jax.jit(
-                grad_step,
-                in_shardings=(gspec, {k: repl for k in self._aux}, repl)
+                scaled_grad_step if scaled else grad_step,
+                in_shardings=(gspec, {k: repl for k in self._aux})
+                + ((repl,) if scaled else ()) + (repl,)
                 + tuple(dataspec for _ in data_names),
-                out_shardings=(gspec, {k: repl for k in self._aux}, repl))
+                out_shardings=(gspec, {k: repl for k in self._aux},
+                               repl))
             self._apply_fn = jax.jit(
                 apply_step, donate_argnums=(0, 1, 2) if self._donate else ())
 
@@ -418,6 +518,11 @@ class DataParallelTrainer:
             # compiled with different anomaly policy must not be reused
             "grad_guard": repr(sorted(self._guard_cfg.items())
                                if self._guard_cfg else None),
+            # ditto for the scaler policy constants and the lr_scale state
+            # key — both change the compiled program
+            "loss_scaling": repr(sorted(self._scaler_cfg.items())
+                                 if self._scaler_cfg else None),
+            "dynamic_lr_scale": self._dynamic_lr,
         }
 
     def _lowered_digest(self, lowered) -> str:
@@ -579,8 +684,13 @@ class DataParallelTrainer:
     def _kv_step(self, rng, arrays):
         """Grad -> kvstore wire sync (summed across workers; 2-bit codec if
         active) -> jitted optimizer apply."""
-        grads, self._aux, loss = self._grad_fn(
-            self._params, self._aux, rng, *arrays)
+        if self._scaler_cfg is not None:
+            grads, self._aux, loss = self._grad_fn(
+                self._params, self._aux, self._guard_state["loss_scale"],
+                rng, *arrays)
+        else:
+            grads, self._aux, loss = self._grad_fn(
+                self._params, self._aux, rng, *arrays)
         kv = self._kv
         if not self._kv_inited:
             for n in self._param_names:
@@ -635,13 +745,53 @@ class DataParallelTrainer:
                  "grad_norm_ema": float(gs["ema"]),
                  "last_grad_norm": float(gs["last_norm"]),
                  "last_step_skipped": bool(int(gs["last_skipped"]))}
+        if self._scaler_cfg is not None:
+            stats["loss_scale"] = float(gs["loss_scale"])
+            stats["scaler_overflows"] = int(gs["ls_overflows"])
+            stats["scaler_good_steps"] = int(gs["ls_good"])
+        if self._dynamic_lr:
+            stats["lr_scale"] = float(gs["lr_scale"])
         if _metrics.enabled():
             # publish at drain time (Monitor interval / user poll), never
             # per step — reading the guard scalars syncs the device
             _telemetry.GRAD_SKIPPED.set(stats["grad_skipped_steps"])
             _telemetry.GRAD_NORM_EMA.set(stats["grad_norm_ema"])
             _telemetry.GRAD_LAST_NORM.set(stats["last_grad_norm"])
+            if "loss_scale" in stats:
+                _telemetry.LOSS_SCALE.set(stats["loss_scale"])
         return stats
+
+    # ------------------------------------------------- recovery state hooks
+    def set_loss_scale(self, scale: float) -> None:
+        """Host-side override of the in-trace loss scale (the recovery
+        ladder's ``cut_scale`` rung). A no-op trainer error when no scaler
+        is configured."""
+        if self._scaler_cfg is None or self._guard_state is None:
+            raise MXNetError("trainer has no in-trace loss scaler "
+                             "(construct with loss_scaling=...)")
+        # the override obeys the same invariants as every in-trace
+        # transition: power of two (bitwise-exact scaling) and the
+        # configured clamp range
+        _recovery._require_pow2("loss scale override", scale)
+        scale = min(max(float(scale), float(self._scaler_cfg["min_scale"])),
+                    float(self._scaler_cfg["max_scale"]))
+        self._guard_state = dict(self._guard_state)
+        self._guard_state["loss_scale"] = jax.device_put(
+            jnp.asarray(scale, jnp.float32),
+            NamedSharding(self._mesh, P()))
+        self._guard_state["ls_good"] = jax.device_put(
+            jnp.zeros((), jnp.int32), NamedSharding(self._mesh, P()))
+
+    def set_lr_scale(self, scale: float) -> None:
+        """Host-side override of the dynamic LR multiplier (recovery
+        rollback backoff / heal restore)."""
+        if not self._dynamic_lr or self._guard_state is None:
+            raise MXNetError("trainer has no dynamic lr scale "
+                             "(construct with dynamic_lr_scale=True)")
+        self._guard_state = dict(self._guard_state)
+        self._guard_state["lr_scale"] = jax.device_put(
+            jnp.asarray(float(scale), jnp.float32),
+            NamedSharding(self._mesh, P()))
 
     @property
     def mesh(self) -> Mesh:

@@ -16,6 +16,11 @@ crash mid-run           ResilientTrainer auto-resume from newest VERIFIED
 transient infra error   retry.retry_transient exponential backoff+jitter
 hung collective         watchdog.Watchdog stack-dump + fail loud
 NaN / grad spike        DataParallelTrainer grad_guard skip-step counters
+NaN storm / loss        recovery.RecoveryLadder: cut loss scale ->
+divergence              rollback to an in-memory RollingSnapshots entry ->
+                        durable restore -> RecoveryFailed (fail loud)
+bf16 grad underflow     in-trace dynamic loss scaling
+                        (DataParallelTrainer(loss_scaling=...))
 any of the above,       chaos.* injectors (tests' `chaos` marker,
 on demand               tools/crashloop.py)
 =====================  ==================================================
@@ -29,8 +34,9 @@ import importlib as _importlib
 
 __all__ = ["Preempted", "PreemptionGuard", "install", "current", "requested",
            "check_preempted", "ResilientTrainer", "resilient_fit",
-           "retry_transient", "is_transient", "Watchdog", "chaos",
-           "preemption", "retry", "watchdog", "trainer"]
+           "retry_transient", "is_transient", "Watchdog", "RecoveryFailed",
+           "RecoveryLadder", "RollingSnapshots", "chaos",
+           "preemption", "recovery", "retry", "watchdog", "trainer"]
 
 _lazy_attrs = {
     "Preempted": ".preemption", "PreemptionGuard": ".preemption",
@@ -39,8 +45,11 @@ _lazy_attrs = {
     "ResilientTrainer": ".trainer", "resilient_fit": ".trainer",
     "retry_transient": ".retry", "is_transient": ".retry",
     "Watchdog": ".watchdog",
+    "RecoveryFailed": ".recovery", "RecoveryLadder": ".recovery",
+    "RollingSnapshots": ".recovery",
 }
-_lazy_mods = {"chaos", "preemption", "retry", "watchdog", "trainer"}
+_lazy_mods = {"chaos", "preemption", "recovery", "retry", "watchdog",
+              "trainer"}
 
 
 def __getattr__(name):

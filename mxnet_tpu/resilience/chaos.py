@@ -22,8 +22,8 @@ import numpy as np
 from ..base import CorruptRecordError, MXNetError, TransientIOError
 
 __all__ = ["ChaosError", "sigterm_self", "dropped_pushes", "kill_heartbeat",
-           "nan_gradients", "nan_batch", "tear_checkpoint",
-           "torn_checkpoint_writes", "hung_step",
+           "nan_gradients", "nan_batch", "nan_storm", "diverge_loss",
+           "tear_checkpoint", "torn_checkpoint_writes", "hung_step",
            "torn_reads", "corrupt_records", "hung_reader"]
 
 
@@ -147,6 +147,65 @@ def nan_batch(like):
     step's loss and gradients (the guard must skip that step)."""
     a = np.asarray(like)
     return np.full(a.shape, np.nan, dtype=a.dtype)
+
+
+@contextlib.contextmanager
+def nan_storm(trainer, steps: int = 8, after: int = 0):
+    """K CONSECUTIVE non-finite-gradient steps — the failure mode a one-shot
+    skip-step guard turns into "skip forever" and the recovery ladder
+    exists to break. Patches the inner ``DataParallelTrainer.step`` to feed
+    a NaN-poisoned first input for the next ``steps`` calls (after
+    ``after`` healthy ones), so it hits the fused path where gradients
+    never surface to the host. Works on a bare trainer or through a
+    wrapping ``ResilientTrainer`` (whose rollback replays run through the
+    same patched step — by then the storm has passed, exactly like a real
+    transient). Yields a dict with the live ``poisoned`` count."""
+    t = getattr(trainer, "trainer", trainer)   # unwrap ResilientTrainer
+    orig = t.step
+    state = {"skip": int(after), "left": int(steps), "poisoned": 0}
+
+    def step(*data):
+        if state["skip"] > 0:
+            state["skip"] -= 1
+        elif state["left"] > 0:
+            state["left"] -= 1
+            state["poisoned"] += 1
+            data = (nan_batch(data[0]),) + tuple(data[1:])
+        return orig(*data)
+
+    t.step = step
+    try:
+        yield state
+    finally:
+        t.step = orig
+
+
+@contextlib.contextmanager
+def diverge_loss(trainer, factor: float = 2.0, after: int = 0):
+    """Monotone loss inflation: every post-``after`` step's REPORTED loss is
+    multiplied by a growing power of ``factor`` — the quietly-diverging-run
+    signature the ladder's loss-trend detector must trip on. The multiply
+    happens on the device scalar, so the loss stays an async value (no host
+    sync is smuggled in). Parameters are untouched; only the health signal
+    diverges. Yields a dict with the live ``inflated`` count."""
+    t = getattr(trainer, "trainer", trainer)   # unwrap ResilientTrainer
+    orig = t.step
+    state = {"skip": int(after), "inflated": 0}
+
+    def step(*data):
+        loss = orig(*data)
+        if state["skip"] > 0:
+            state["skip"] -= 1
+            return loss
+        state["inflated"] += 1
+        return loss * jnp.asarray(float(factor) ** state["inflated"],
+                                  jnp.float32)
+
+    t.step = step
+    try:
+        yield state
+    finally:
+        t.step = orig
 
 
 # ------------------------------------------------------------ data faults

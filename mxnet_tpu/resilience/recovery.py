@@ -1,0 +1,439 @@
+"""Self-healing recovery — the layer between "skip one step" and "restart
+from disk".
+
+PR 1's grad-anomaly guard answers a single bad step (skip it); the durable
+checkpoints in this package answer a dead process (restore it). Everything
+in between — a NaN storm that skips forever, a loss that quietly diverges,
+a bf16 run whose tiny gradients underflowed to zero — previously had no
+automatic answer. This module supplies the three missing pieces:
+
+1. **In-trace dynamic loss scaling** (:func:`scaler_config` /
+   :func:`scaler_init_state` / :func:`scaler_apply`): the semantics of
+   ``contrib.amp.LossScaler`` moved INSIDE the jitted train step as
+   functional device-scalar state riding alongside the grad-guard counters.
+   bf16's exponent range matches fp32, but its 8-bit mantissa underflows
+   tiny gradients (PAPERS.md, the bf16/MXU execution model) — the scaler
+   multiplies the loss before the backward, unscales the f32 gradients
+   after, halves the scale and skips the update on overflow, and doubles it
+   after ``growth_interval`` clean steps. Scale transitions stay powers of
+   two, so in f32 the scaling is bitwise-exact; and because everything is
+   in-trace there are **zero per-step host syncs** (contrast
+   ``contrib.amp.init_trainer``, whose imperative update needs the overflow
+   boolean on host).
+
+2. **Rolling in-memory snapshots** (:class:`RollingSnapshots`): a bounded
+   ring of host-offloaded copies of the full training state (params, aux,
+   optimizer state, guard+scaler state, rng counter, attached data-iterator
+   cursor), captured every ``snapshot_every`` steps outside the jitted hot
+   path. Rolling back to one costs a host→device transfer, not a disk
+   restore — and unlike the durable checkpoints it rewinds the *step
+   counter* too, so every batch the rollback un-trains is replayed.
+
+3. **The escalating recovery ladder** (:class:`RecoveryLadder`): host-side
+   detectors (consecutive-skip streak, loss-trend divergence) fed by the
+   trainer's lag-resolved health ring. Each trip takes the next rung::
+
+       cut loss scale → rollback to newest snapshot (with LR backoff)
+                      → restore newest durable checkpoint → fail loud
+
+   ``heal_steps`` consecutive clean steps de-escalate back to rung 0 (and
+   restore the LR scale). Every rung is counted in telemetry
+   (``mxtpu_recovery_*``), recorded in the flight ring, and the ladder's
+   own state is persisted in checkpoint manifests so a kill/resume
+   continues the escalation exactly where it stood.
+
+The wiring lives in ``parallel.data_parallel`` (the in-trace pieces) and
+``resilience.trainer`` (snapshots + ladder); this module holds the policy
+and state so both stay importable without each other.
+"""
+from __future__ import annotations
+
+import math
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError, get_env, register_config
+
+__all__ = ["RecoveryFailed", "RollingSnapshots", "RecoveryLadder",
+           "recovery_config", "scaler_config", "scaler_init_state",
+           "scaler_apply"]
+
+register_config("MXNET_RECOVERY_SNAPSHOT_EVERY", 50, int,
+                "Steps between rolling in-memory snapshots (recovery "
+                "ladder rung 2's rollback target).")
+register_config("MXNET_RECOVERY_SNAPSHOT_DEPTH", 2, int,
+                "In-memory snapshots kept (a bounded ring; oldest evicted).")
+register_config("MXNET_RECOVERY_MAX_SKIPS", 8, int,
+                "Consecutive guard-skipped steps before the ladder trips.")
+register_config("MXNET_RECOVERY_WINDOW", 25, int,
+                "Recent-loss window size for the divergence detector.")
+register_config("MXNET_RECOVERY_DIVERGENCE_FACTOR", 10.0, float,
+                "Loss-trend trip threshold: newest loss >= factor * both "
+                "the window minimum AND median (and is the window "
+                "maximum).")
+register_config("MXNET_RECOVERY_LR_BACKOFF", 0.5, float,
+                "LR-scale multiplier applied on every rollback/restore rung "
+                "(1.0 disables; healing restores the scale to 1.0).")
+register_config("MXNET_RECOVERY_SCALE_CUT", 16.0, float,
+                "Loss-scale divisor for the ladder's first rung (stronger "
+                "than the scaler's own per-overflow halving).")
+register_config("MXNET_RECOVERY_MAX_ROLLBACKS", 2, int,
+                "Snapshot-rollback rungs before escalating to a durable "
+                "restore.")
+register_config("MXNET_RECOVERY_MAX_RESTORES", 1, int,
+                "Durable-restore rungs before failing loud.")
+register_config("MXNET_RECOVERY_HEAL_STEPS", 50, int,
+                "Consecutive clean steps that de-escalate the ladder back "
+                "to rung 0 (and restore lr_scale to 1.0).")
+register_config("MXNET_RECOVERY_LAG", 2, int,
+                "Steps a health record may age before its device scalars "
+                "are force-resolved (0 = resolve every step, synchronous "
+                "but deterministic — what the tests use).")
+
+
+class RecoveryFailed(MXNetError):
+    """The ladder's last rung: every automatic recovery strategy was
+    exhausted and the run still cannot make healthy progress. The flight
+    recorder has already been dumped when this propagates."""
+
+
+# ----------------------------------------------------------- configuration
+_RECOVERY_KNOBS = {
+    "snapshot_every": ("MXNET_RECOVERY_SNAPSHOT_EVERY", int),
+    "snapshot_depth": ("MXNET_RECOVERY_SNAPSHOT_DEPTH", int),
+    "max_skips": ("MXNET_RECOVERY_MAX_SKIPS", int),
+    "window": ("MXNET_RECOVERY_WINDOW", int),
+    "divergence_factor": ("MXNET_RECOVERY_DIVERGENCE_FACTOR", float),
+    "lr_backoff": ("MXNET_RECOVERY_LR_BACKOFF", float),
+    "scale_cut": ("MXNET_RECOVERY_SCALE_CUT", float),
+    "max_rollbacks": ("MXNET_RECOVERY_MAX_ROLLBACKS", int),
+    "max_restores": ("MXNET_RECOVERY_MAX_RESTORES", int),
+    "heal_steps": ("MXNET_RECOVERY_HEAL_STEPS", int),
+    "lag": ("MXNET_RECOVERY_LAG", int),
+}
+
+
+def _require_pow2(name: str, value) -> None:
+    """Scale arithmetic is only bitwise-exact (``loss * s`` then ``g / s``
+    round-trips in f32) when every factor the scale is built from is a
+    power of two — reject anything else instead of silently breaking the
+    documented digest/resume-equivalence guarantees."""
+    v = float(value)
+    if v <= 0 or math.frexp(v)[0] != 0.5:
+        raise MXNetError(
+            "%s must be a positive power of two (got %r): non-power-of-two "
+            "loss-scale factors make scaling inexact in f32, breaking the "
+            "bitwise resume-equivalence guarantee" % (name, value))
+
+
+def recovery_config(recovery) -> Optional[Dict[str, Any]]:
+    """Normalize ``ResilientTrainer(recovery=...)``: any falsy spelling
+    (None/False/0/{}) = off, matching ``_guard_config``; True =
+    MXNET_RECOVERY_* env defaults; a non-empty dict overrides individual
+    knobs (unknown keys are a hard error — a typo'd threshold must not
+    silently fall back to a default)."""
+    if not recovery:
+        return None
+    over = dict(recovery) if isinstance(recovery, dict) else {}
+    unknown = set(over) - set(_RECOVERY_KNOBS)
+    if unknown:
+        raise MXNetError("unknown recovery knob(s) %s; valid: %s"
+                         % (sorted(unknown), sorted(_RECOVERY_KNOBS)))
+    cfg = {k: typ(over[k]) if k in over else typ(get_env(env))
+           for k, (env, typ) in _RECOVERY_KNOBS.items()}
+    _require_pow2("recovery scale_cut", cfg["scale_cut"])
+    return cfg
+
+
+_SCALER_DEFAULTS = {"init_scale": 2.0 ** 10, "growth_interval": 200,
+                    "growth": 2.0, "backoff": 0.5, "min_scale": 1.0,
+                    "max_scale": 2.0 ** 24}
+
+
+def scaler_config(loss_scaling) -> Optional[Dict[str, float]]:
+    """Normalize ``DataParallelTrainer(loss_scaling=...)``: any falsy
+    spelling (None/False/0/{}) = off, matching ``_guard_config``; True =
+    amp.LossScaler-compatible defaults; a non-empty dict overrides
+    ``init_scale``/``growth_interval``/``growth``/``backoff``/
+    ``min_scale``/``max_scale``."""
+    if not loss_scaling:
+        return None
+    over = dict(loss_scaling) if isinstance(loss_scaling, dict) else {}
+    unknown = set(over) - set(_SCALER_DEFAULTS)
+    if unknown:
+        raise MXNetError("unknown loss_scaling knob(s) %s; valid: %s"
+                         % (sorted(unknown), sorted(_SCALER_DEFAULTS)))
+    cfg = dict(_SCALER_DEFAULTS, **over)
+    cfg["growth_interval"] = int(cfg["growth_interval"])
+    for knob in ("init_scale", "growth", "backoff", "min_scale", "max_scale"):
+        _require_pow2("loss_scaling %s" % knob, cfg[knob])
+    return cfg
+
+
+def scaler_init_state(cfg) -> Dict[str, jnp.ndarray]:
+    """Fresh scaler state as device scalars, merged into the trainer's
+    guard-state tree (so it is donated, checkpointed and restored exactly
+    like the guard counters)."""
+    return {"loss_scale": jnp.asarray(cfg["init_scale"], jnp.float32),
+            "ls_good": jnp.zeros((), jnp.int32),
+            "ls_overflows": jnp.zeros((), jnp.int32)}
+
+
+def scaler_apply(cfg, gstate, overflow, bad) -> Dict[str, jnp.ndarray]:
+    """One in-trace scale transition (runs INSIDE the jitted step — no host
+    sync anywhere). ``overflow`` = the gradient was non-finite; ``bad`` =
+    the guard skipped the step for any reason (overflow OR norm spike).
+    Overflow halves the scale and resets the growth counter; a clean step
+    advances it and every ``growth_interval`` of them doubles the scale; a
+    spike-skip leaves both alone (the gradient was finite — rescaling would
+    not have helped)."""
+    scale, good = gstate["loss_scale"], gstate["ls_good"]
+    halved = jnp.maximum(scale * cfg["backoff"], cfg["min_scale"])
+    good2 = jnp.where(overflow, 0, jnp.where(bad, good, good + 1))
+    grow = jnp.logical_and(jnp.logical_not(bad),
+                           good2 >= cfg["growth_interval"])
+    new_scale = jnp.where(
+        overflow, halved,
+        jnp.where(grow, jnp.minimum(scale * cfg["growth"], cfg["max_scale"]),
+                  scale))
+    new_good = jnp.where(jnp.logical_or(grow, overflow), 0, good2)
+    return {"loss_scale": new_scale.astype(jnp.float32),
+            "ls_good": new_good.astype(jnp.int32),
+            "ls_overflows": gstate["ls_overflows"]
+            + overflow.astype(jnp.int32)}
+
+
+# ------------------------------------------------------- rolling snapshots
+class RollingSnapshots:
+    """Bounded ring of host-offloaded training-state copies.
+
+    ``capture`` materializes params/aux/opt-state/guard-state (plus the rng
+    counter and, when provided, the data iterator's resume cursor) to host
+    memory — device→host copies for every leaf are started asynchronously
+    first, then collected, so the transfers overlap each other. It runs
+    between steps, never inside the jitted step, and only every
+    ``snapshot_every`` steps, so the one device sync it forces is amortized
+    off the hot path. ``restore`` puts the newest (or a given) snapshot
+    back on device and re-pins the trainer's sharding."""
+
+    def __init__(self, depth: int = 2):
+        self._ring: deque = deque(maxlen=max(1, int(depth)))
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+    @property
+    def newest_step(self) -> Optional[int]:
+        return self._ring[-1]["step"] if self._ring else None
+
+    def capture(self, trainer, step: int, data_state=None) -> Dict[str, Any]:
+        tree = (trainer._params, trainer._aux, trainer._opt_state,
+                trainer._guard_state)
+        for leaf in jax.tree_util.tree_leaves(tree):
+            start = getattr(leaf, "copy_to_host_async", None)
+            if start is not None:
+                start()
+        host = jax.tree_util.tree_map(np.asarray, tree)
+        snap = {"step": int(step),
+                "rng_counter": int(trainer._rng_counter),
+                "tree": host, "data_state": data_state,
+                "wall_time": time.time()}
+        self._ring.append(snap)
+        return snap
+
+    def newest(self) -> Optional[Dict[str, Any]]:
+        return self._ring[-1] if self._ring else None
+
+    def prune_newer(self, step: int) -> int:
+        """Drop snapshots captured AFTER ``step``: called when training
+        rewinds past the ring (a durable restore), because entries from the
+        abandoned timeline would otherwise stay ``newest()`` and a later
+        rollback would jump training *forward* into the very state the
+        restore rewound away from. Returns the number dropped."""
+        dropped = 0
+        while self._ring and self._ring[-1]["step"] > step:
+            self._ring.pop()
+            dropped += 1
+        return dropped
+
+    def restore(self, trainer, snap: Optional[Dict[str, Any]] = None):
+        snap = snap if snap is not None else self.newest()
+        if snap is None:
+            raise MXNetError("no in-memory snapshot to restore")
+        params, aux, opt, guard = snap["tree"]
+        trainer._params = {k: jnp.asarray(v) for k, v in params.items()}
+        trainer._aux = {k: jnp.asarray(v) for k, v in aux.items()}
+        trainer._opt_state = jax.tree_util.tree_map(jnp.asarray, opt)
+        if guard is not None and trainer._guard_state is not None:
+            trainer._guard_state = {k: jnp.asarray(v)
+                                    for k, v in guard.items()}
+        trainer._place_state()
+        trainer._rng_counter = int(snap["rng_counter"])
+        return snap
+
+    def clear(self) -> None:
+        self._ring.clear()
+
+
+# -------------------------------------------------------- escalation ladder
+class RecoveryLadder:
+    """Divergence detectors + the rung state machine.
+
+    Feed it one resolved health record per step via :meth:`observe`; it
+    returns ``(kind, action)`` when a detector trips, where ``action`` is
+    the next rung of::
+
+        ["cut_scale"?] + ["rollback"] * max_rollbacks
+                       + ["restore"] * max_restores + ["fail"]
+
+    (``cut_scale`` only when the trainer has an in-trace loss scaler.)
+    An impossible rung (no snapshot captured yet, no durable checkpoint on
+    disk) is skipped via :meth:`escalate`. ``heal_steps`` consecutive clean
+    steps reset the rung to 0 and report a ``("healed", "heal")`` event.
+    The whole ladder state round-trips through :meth:`state_dict` /
+    :meth:`load_state_dict` so checkpoint manifests can carry it."""
+
+    def __init__(self, cfg: Dict[str, Any], has_scaler: bool = False):
+        self.cfg = cfg
+        self.has_scaler = bool(has_scaler)
+        self.rung = 0
+        self.consecutive_skips = 0
+        # guard-skipped steps whose batches a rollback/restore has not yet
+        # rewound past: while this is nonzero a durable checkpoint would
+        # bake the skipped batches into the resumed timeline (they advanced
+        # the clock without updating params), permanently breaking the
+        # any-kill-schedule digest determinism — ResilientTrainer defers
+        # periodic/preemption saves on it
+        self.unreplayed_skips = 0
+        self.healthy_streak = 0
+        self.scale_cuts = 0
+        self.rollbacks = 0
+        self.restores = 0
+        self._window: deque = deque(maxlen=max(2, int(cfg["window"])))
+        self._warmup = min(8, self._window.maxlen)
+        self.history: List[Dict[str, Any]] = []
+
+    # ------------------------------------------------------------ detectors
+    def observe(self, step: int, skipped: bool,
+                loss: Optional[float]) -> Optional[Tuple[str, str]]:
+        """One resolved per-step health record. Returns a ``(kind, action)``
+        trip, a ``("healed", "heal")`` de-escalation, or None."""
+        if skipped:
+            self.consecutive_skips += 1
+            self.unreplayed_skips += 1
+            self.healthy_streak = 0
+            if self.consecutive_skips >= self.cfg["max_skips"]:
+                return self._trip(step, "skip_streak")
+            return None
+        self.consecutive_skips = 0
+        if self.rung == 0:
+            # a clean step at rung 0 closes a streak too short to ever trip
+            # the ladder: those skips are the guard's accepted-loss
+            # semantics (PR 1), not replay debt — durable saves unblock
+            self.unreplayed_skips = 0
+        self.healthy_streak += 1
+        finite = loss is not None and np.isfinite(loss)
+        if finite:
+            self._window.append(float(loss))
+            if (len(self._window) >= self._warmup
+                    and loss >= max(self._window)):
+                lo = min(self._window)
+                # baseline on the window MEDIAN as well as the minimum: a
+                # single unusually-good batch must not turn ordinary loss
+                # noise into a rollback — the spike has to clear factor x
+                # the TYPICAL loss, not just factor x the best-ever one
+                med = sorted(self._window)[len(self._window) // 2]
+                if (lo > 1e-12
+                        and loss >= self.cfg["divergence_factor"] * lo
+                        and loss >= self.cfg["divergence_factor"] * med):
+                    return self._trip(step, "loss_divergence")
+        if self.rung and self.healthy_streak >= self.cfg["heal_steps"]:
+            self.rung = 0
+            # healing accepts the current trajectory as the new baseline:
+            # skips the escalation never replayed (a cut_scale-only storm)
+            # are written off exactly like rung-0 accepted losses above
+            self.unreplayed_skips = 0
+            self.history.append({"step": int(step), "kind": "healed",
+                                 "action": "heal"})
+            return "healed", "heal"
+        return None
+
+    # ----------------------------------------------------------- escalation
+    def _actions(self) -> List[str]:
+        seq = ["cut_scale"] if self.has_scaler else []
+        seq += ["rollback"] * max(0, int(self.cfg["max_rollbacks"]))
+        seq += ["restore"] * max(0, int(self.cfg["max_restores"]))
+        seq.append("fail")
+        return seq
+
+    def _trip(self, step: int, kind: str) -> Tuple[str, str]:
+        seq = self._actions()
+        action = seq[min(self.rung, len(seq) - 1)]
+        if action == "cut_scale" and kind == "loss_divergence":
+            # scaling is numerically exact (power-of-two scale, grads
+            # unscaled before the update), so a scale cut cannot alter a
+            # finite-loss trajectory — spending the rung on it would train
+            # a full detector-warmup window more on the diverging run
+            # before the first rung that can act (rollback)
+            self.rung += 1
+            action = seq[min(self.rung, len(seq) - 1)]
+        self.rung += 1
+        self.history.append({"step": int(step), "kind": kind,
+                             "action": action})
+        self.reset_detectors()
+        return kind, action
+
+    def escalate(self, step: int, kind: str = "escalated") -> Tuple[str, str]:
+        """The current rung's action is impossible (no snapshot / no durable
+        checkpoint): advance to the next rung immediately. The entry
+        recorded for the impossible action is marked ``skipped`` — history
+        must not report a rollback/restore that never executed."""
+        if self.history:
+            self.history[-1]["skipped"] = True
+        return self._trip(step, kind)
+
+    def note_rewound(self) -> None:
+        """A rollback/restore rung rewound the clock past every outstanding
+        skip (snapshots and durable checkpoints are only ever captured with
+        zero replay debt, so any rewind target predates the oldest one):
+        the replay re-trains those batches and durable saves are safe
+        again."""
+        self.unreplayed_skips = 0
+
+    def reset_detectors(self) -> None:
+        """Forget detector history (NOT the rung or the replay debt):
+        called after every recovery action, because pre-recovery records
+        would re-trip on state the action just replaced."""
+        self.consecutive_skips = 0
+        self.healthy_streak = 0
+        self._window.clear()
+
+    # ---------------------------------------------------------- persistence
+    def state_dict(self) -> Dict[str, Any]:
+        return {"rung": self.rung,
+                "consecutive_skips": self.consecutive_skips,
+                "unreplayed_skips": self.unreplayed_skips,
+                "healthy_streak": self.healthy_streak,
+                "scale_cuts": self.scale_cuts,
+                "rollbacks": self.rollbacks,
+                "restores": self.restores,
+                "loss_window": [float(x) for x in self._window],
+                "history": list(self.history)[-32:]}
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        self.rung = int(state.get("rung", 0))
+        self.consecutive_skips = int(state.get("consecutive_skips", 0))
+        self.unreplayed_skips = int(state.get("unreplayed_skips", 0))
+        self.healthy_streak = int(state.get("healthy_streak", 0))
+        self.scale_cuts = int(state.get("scale_cuts", 0))
+        self.rollbacks = int(state.get("rollbacks", 0))
+        self.restores = int(state.get("restores", 0))
+        self._window.clear()
+        for x in state.get("loss_window", []):
+            self._window.append(float(x))
+        self.history = list(state.get("history", []))

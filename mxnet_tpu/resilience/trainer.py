@@ -32,6 +32,7 @@ resumed anything by itself).
 from __future__ import annotations
 
 import time
+from collections import deque
 from typing import Any, Dict, Optional
 
 import jax
@@ -45,6 +46,8 @@ from ..observability import flight_recorder as _flight
 from ..observability import metrics as _metrics
 from .preemption import Preempted, acquire as acquire_guard, \
     release as release_guard
+from .recovery import (RecoveryFailed, RecoveryLadder, RollingSnapshots,
+                       recovery_config)
 from .retry import retry_transient
 from .watchdog import Watchdog
 
@@ -88,12 +91,55 @@ class ResilientTrainer:
                  directory: Optional[str] = None, save_every: Optional[int] = None,
                  keep: Optional[int] = None, resume: bool = True,
                  preemption: bool = True, step_deadline: Optional[float] = None,
-                 retry: bool = True, data_iter=None, **trainer_kwargs):
+                 retry: bool = True, data_iter=None, recovery=None,
+                 **trainer_kwargs):
         if not directory:
             raise MXNetError("ResilientTrainer needs a checkpoint directory")
+        # self-healing recovery (recovery.py): the escalation layer between
+        # "skip one step" and "restart from disk". Parsed BEFORE the inner
+        # trainer is built because the ladder needs in-trace hooks: the
+        # skip-streak detector needs the grad guard's last_skipped scalar,
+        # and an lr_backoff != 1 needs the dynamic lr_scale multiplier.
+        self._recovery_cfg = recovery_config(recovery)
+        if self._recovery_cfg is not None:
+            # any falsy spelling (False, None, {}, 0) is _guard_config's
+            # "off" — and merely being PRESENT in trainer_kwargs would also
+            # defeat the setdefault below. Without the guard the skip-streak
+            # detector can never fire and a NaN loss is counted as a healthy
+            # step: recovery would be configured but completely inert.
+            if not trainer_kwargs.get("grad_guard", True):
+                raise MXNetError(
+                    "recovery= requires the grad-anomaly guard; drop "
+                    "grad_guard=%r or disable recovery"
+                    % (trainer_kwargs["grad_guard"],))
+            trainer_kwargs.setdefault("grad_guard", True)
+            if self._recovery_cfg["lr_backoff"] != 1.0:
+                # same inert-config rule as grad_guard above: an explicit
+                # dynamic_lr_scale off would silently disable the documented
+                # compounding LR backoff on every rollback/restore rung
+                if not trainer_kwargs.get("dynamic_lr_scale", True):
+                    raise MXNetError(
+                        "recovery lr_backoff=%g requires dynamic_lr_scale; "
+                        "drop dynamic_lr_scale=%r or set lr_backoff to 1.0"
+                        % (self._recovery_cfg["lr_backoff"],
+                           trainer_kwargs["dynamic_lr_scale"]))
+                trainer_kwargs.setdefault("dynamic_lr_scale", True)
         from ..parallel.data_parallel import DataParallelTrainer
         self.trainer = DataParallelTrainer(net, loss, optimizer,
                                            optimizer_params, **trainer_kwargs)
+        if self._recovery_cfg is not None:
+            self._snapshots = RollingSnapshots(
+                self._recovery_cfg["snapshot_depth"])
+            self._ladder = RecoveryLadder(
+                self._recovery_cfg,
+                has_scaler=self.trainer._scaler_cfg is not None)
+            # per-step (step, skipped?, loss) device scalars, resolved
+            # `lag` steps late so observation never blocks dispatch
+            self._health: deque = deque()
+        else:
+            self._snapshots = None
+            self._ladder = None
+            self._health = None
         self._data_iter = None
         self._data_state_ok = False
         self._pending_data_state = None
@@ -168,17 +214,23 @@ class ResilientTrainer:
                 self._restore(step)
         self._initialized = True
 
-    def _find_restorable(self) -> Optional[int]:
+    def _find_restorable(self, max_step=None) -> Optional[int]:
         """Newest committed step that also passes the torn-file checksum
-        verification; corrupt candidates are skipped loudly, never loaded."""
+        verification; corrupt candidates are skipped loudly, never loaded.
+        ``max_step`` bounds the search: the recovery ladder's restore rung
+        runs with a rewound clock, and a checkpoint newer than it belongs
+        to the abandoned timeline — restoring one would jump training
+        FORWARD into the very state the ladder is escaping."""
         for step in reversed(self.checkpointer.steps()):
+            if max_step is not None and step > max_step:
+                continue
             if self.checkpointer.verify(step):
                 return step
             logger.warning("checkpoint step %d is torn (manifest mismatch); "
                            "skipping it for resume", step)
         return None
 
-    def _restore(self, step: int) -> None:
+    def _restore(self, step: int, load_ladder: bool = True) -> None:
         t = self.trainer
         tree = self.checkpointer.restore(step)
         t._params = {n: jnp.asarray(tree[n]) for n in t._param_names}
@@ -190,8 +242,20 @@ class ResilientTrainer:
         if t._guard_state is not None:
             restored = {k: jnp.asarray(tree[_GUARD_KEY % k])
                         for k in t._guard_state if _GUARD_KEY % k in tree}
-            if len(restored) == len(t._guard_state):
-                t._guard_state = restored
+            if restored:
+                # partial merge, not all-or-nothing: a checkpoint saved
+                # under a different loss_scaling/dynamic_lr_scale config
+                # still restores the counters it carries; only the missing
+                # keys keep their fresh-init values — and say so, because
+                # a scaler restarting from init_scale is exactly the
+                # silent reset this subsystem exists to prevent
+                missing = sorted(set(t._guard_state) - set(restored))
+                t._guard_state = dict(t._guard_state, **restored)
+                if missing:
+                    logger.warning(
+                        "checkpoint step %d lacks guard/scaler key(s) %s "
+                        "(saved under a different config); they keep "
+                        "fresh-init values", step, missing)
         t._place_state()
         user = self.checkpointer.read_manifest(step).get("user", {})
         t._rng_counter = int(user.get("rng_counter", 0))
@@ -205,6 +269,16 @@ class ResilientTrainer:
                 and int(saved_seed) != int(_random.current_seed()):
             _random.seed(int(saved_seed))
         self.step_count = int(user.get("step", step))
+        if self._snapshots is not None:
+            # a restore rewinds time: ring entries captured after this step
+            # belong to the abandoned timeline, and leaving them would let
+            # a later rollback jump training FORWARD into that state (no-op
+            # on process-start resume — the ring is empty)
+            dropped = self._snapshots.prune_newer(self.step_count)
+            if dropped:
+                logger.warning("dropped %d in-memory snapshot(s) from the "
+                               "abandoned timeline (newer than restored "
+                               "step %d)", dropped, self.step_count)
         data_state = user.get("data_state")
         if data_state is not None:
             if self._data_iter is not None and self._data_state_ok:
@@ -219,11 +293,24 @@ class ResilientTrainer:
                 # constructed before the feed); dropped silently only if
                 # no stateful iterator is ever attached
                 self._pending_data_state = data_state
-        self.resumed_from = step
-        logger.info("resumed from checkpoint step %d (rng_counter=%d%s)",
-                    step, t._rng_counter,
-                    ", data iterator rewound mid-epoch"
-                    if data_state is not None else "")
+        if self._ladder is not None and load_ladder:
+            # a restarted process continues the escalation exactly where the
+            # dead one stood; a mid-run recovery restore must NOT do this —
+            # it would reset the rung the ladder is in the middle of
+            # climbing (load_ladder=False on that path)
+            state = user.get("recovery")
+            if state:
+                self._ladder.load_state_dict(state)
+        if load_ladder:
+            # load_ladder=False marks a mid-run recovery restore: the
+            # process never died, so it must not masquerade as a resume
+            # (resumed_from is how callers detect an actual restart) —
+            # _apply_recovery logs its own restore line
+            self.resumed_from = step
+            logger.info("resumed from checkpoint step %d (rng_counter=%d%s)",
+                        step, t._rng_counter,
+                        ", data iterator rewound mid-epoch"
+                        if data_state is not None else "")
 
     def ensure_initialized(self, *data) -> "ResilientTrainer":
         """Eagerly capture + auto-resume using ``data`` as the sample batch
@@ -246,8 +333,8 @@ class ResilientTrainer:
         leaves the same artifact behind."""
         try:
             return self._step_inner(*data)
-        except Preempted:
-            raise                       # dumped at the latch site below
+        except (Preempted, RecoveryFailed):
+            raise                       # both dumped at their raise sites
         except BaseException as e:
             if self._watchdog is None or not self._watchdog.fired:
                 # a watchdog timeout already dumped (with the richer
@@ -300,13 +387,21 @@ class ResilientTrainer:
         else:
             loss = guarded()
         self.step_count += 1
+        if self._ladder is not None:
+            self._recovery_tick(loss)
         if self.save_every and self.step_count % self.save_every == 0:
-            self.save(async_save=True)
+            if self._durable_safe("periodic"):
+                self.save(async_save=True)
         if self._guard is not None and self._guard.triggered:
             # preemption latched mid-step: commit a final synchronous
-            # checkpoint at this safe boundary, then fail with intent
-            self.save(async_save=False)
-            self.checkpointer.wait_until_finished()
+            # checkpoint at this safe boundary, then fail with intent —
+            # unless skipped steps are still awaiting rollback replay, in
+            # which case resume falls back to the last healthy checkpoint
+            # (committing here would bake the skipped batches into the
+            # resumed timeline and lose them forever)
+            if self._durable_safe("preemption"):
+                self.save(async_save=False)
+                self.checkpointer.wait_until_finished()
             if _metrics.enabled():
                 _telemetry.PREEMPTIONS.inc()
             self._flight_dump("preemption")
@@ -332,7 +427,251 @@ class ResilientTrainer:
                 "committed checkpoint exists to restore from — enable "
                 "save_every or save() explicitly before risky sections")
         logger.warning("restoring step %d after invalidated state", step)
-        self._restore(step)
+        # load_ladder=False: the process never died, so this must not
+        # masquerade as a resume (resumed_from) nor replace the live
+        # ladder's mid-climb rung/budget with the manifest's stale copy
+        self._restore(step, load_ladder=False)
+        if self._health is not None:
+            # queued records describe the abandoned pre-restore timeline;
+            # feeding them to the ladder would trip a rung against the
+            # healthy state this restore just put back (same reason
+            # _apply_recovery clears the ring after every action)
+            self._health.clear()
+        if self._ladder is not None:
+            # the rewind replays any outstanding skipped steps (durable
+            # checkpoints are only ever committed debt-free), same as the
+            # ladder's own rollback/restore rungs
+            self._ladder.note_rewound()
+            self._ladder.reset_detectors()
+
+    # ------------------------------------------------------------- recovery
+    def _recovery_tick(self, loss) -> None:
+        """Post-step recovery bookkeeping: enqueue this step's health
+        scalars, resolve records older than ``lag`` (their device values
+        are long since materialized — the read does not block dispatch),
+        feed the ladder, act on trips, and keep the snapshot cadence."""
+        cfg, t = self._recovery_cfg, self.trainer
+        skip_ref = None
+        if t._guard_state is not None and "last_skipped" in t._guard_state:
+            # async device copy: the guard state itself is DONATED into the
+            # next step, which would invalidate a bare reference before the
+            # lag window lets us read it
+            skip_ref = jnp.copy(t._guard_state["last_skipped"])
+        self._health.append((self.step_count, skip_ref, loss))
+        if self._drain_health(cfg["lag"]):
+            return          # ring cleared; later records described old state
+        if (cfg["snapshot_every"] > 0
+                and self.step_count % cfg["snapshot_every"] == 0):
+            # capture syncs the device anyway, so first force-resolve the
+            # still-lagging records: the gate below must see CURRENT
+            # counters — a snapshot capturing unobserved skipped/diverged
+            # steps would make a later rollback drop those batches instead
+            # of replaying them
+            if self._drain_health(0):
+                return
+            if (self._ladder.rung == 0
+                    and self._ladder.consecutive_skips == 0
+                    and self._ladder.unreplayed_skips == 0):
+                self._capture_snapshot()
+
+    def _drain_health(self, keep: int) -> bool:
+        """Resolve queued health records down to ``keep``, feed the ladder,
+        and act on any trip. Returns True when a recovery action ran (the
+        ring is cleared — callers must not touch pre-action records)."""
+        while len(self._health) > keep:
+            step, sref, lref = self._health.popleft()
+            try:
+                skipped = bool(int(np.asarray(sref))) if sref is not None \
+                    else False
+            except Exception:   # deleted buffer on an exotic retry path
+                skipped = False
+            try:
+                lossf = float(np.asarray(lref)) if lref is not None else None
+            except Exception:
+                lossf = None
+            event = self._ladder.observe(step, skipped, lossf)
+            if event is None:
+                continue
+            kind, action = event
+            if action == "heal":
+                self._on_heal(step)
+                continue
+            self._apply_recovery(step, kind, action)
+            return True
+        return False
+
+    def _capture_snapshot(self) -> None:
+        data_state = None
+        if self._data_iter is not None and self._data_state_ok:
+            try:
+                data_state = self._data_iter.state()
+            except Exception as e:
+                self._data_state_ok = False
+                logger.warning("snapshot data-state capture failed (%r); "
+                               "rollbacks will not rewind the iterator", e)
+        self._snapshots.capture(self.trainer, self.step_count,
+                                data_state=data_state)
+        if _metrics.enabled():
+            _telemetry.RECOVERY_SNAPSHOTS.inc()
+
+    def _apply_lr_backoff(self) -> None:
+        t = self.trainer
+        backoff = self._recovery_cfg["lr_backoff"]
+        if backoff == 1.0 or not t._dynamic_lr:
+            return
+        cur = float(np.asarray(t._guard_state["lr_scale"]))
+        t.set_lr_scale(cur * backoff)
+        logger.warning("recovery: lr_scale backed off to %.4g",
+                       cur * backoff)
+
+    def _record_recovery(self, step: int, kind: str, action: str) -> None:
+        if _metrics.enabled():
+            if action != "heal":    # healing is an action, not a trip
+                _telemetry.RECOVERY_TRIPS.inc(kind=kind)
+            _telemetry.RECOVERY_ROLLBACKS.inc(action=action)
+            _telemetry.RECOVERY_RUNG.set(self._ladder.rung)
+        _flight.record_step(step, recovery_kind=kind,
+                            recovery_action=action,
+                            recovery_rung=self._ladder.rung)
+
+    def _damped_knobs(self):
+        """The ladder-owned damping knobs (live loss scale, lr_scale) as
+        they stand RIGHT NOW — read before a rollback/restore replaces the
+        guard tree, because the rewound snapshot/checkpoint carries the
+        pre-damping values: blindly restoring them would revert the
+        preceding cut_scale rung and keep every rollback's LR backoff
+        landing at the same value instead of compounding."""
+        t = self.trainer
+        out = {}
+        if t._scaler_cfg is not None and t._guard_state is not None \
+                and "loss_scale" in t._guard_state:
+            out["loss_scale"] = float(np.asarray(
+                t._guard_state["loss_scale"]))
+        if t._dynamic_lr and t._guard_state is not None \
+                and "lr_scale" in t._guard_state:
+            out["lr_scale"] = float(np.asarray(t._guard_state["lr_scale"]))
+        return out
+
+    def _reapply_damped(self, damped) -> None:
+        t = self.trainer
+        if "loss_scale" in damped:
+            t.set_loss_scale(damped["loss_scale"])
+        if "lr_scale" in damped:
+            t.set_lr_scale(damped["lr_scale"])
+
+    def _durable_safe(self, kind: str) -> bool:
+        """Whether an automatic durable checkpoint (periodic cadence or the
+        preemption final save) may commit RIGHT NOW. While guard-skipped
+        steps await rollback replay, a checkpoint at the current clock
+        embeds their consumed-but-untrained batches — a kill then resumes
+        on the wrong timeline and never replays them, breaking the
+        any-kill-schedule digest determinism crashloop asserts. Pending
+        lag records are force-resolved first so the decision sees current
+        counters (the save itself materializes device state anyway); if
+        that resolution rewinds via a recovery action, the rewound state
+        is clean and saving it is fine. Explicit ``save()`` calls are
+        never gated — the manifest's ladder state records the debt."""
+        if self._ladder is None:
+            return True
+        self._drain_health(0)
+        if (self._ladder.consecutive_skips == 0
+                and self._ladder.unreplayed_skips == 0):
+            return True
+        if _metrics.enabled():
+            _telemetry.RECOVERY_DEFERRED_SAVES.inc(kind=kind)
+        logger.warning(
+            "%s checkpoint at step %d deferred: %d skipped step(s) still "
+            "awaiting rollback replay — committing would lose their "
+            "batches on resume",
+            kind, self.step_count, self._ladder.unreplayed_skips)
+        return False
+
+    def _prune_durable_newer(self) -> None:
+        """Durable checkpoints newer than the rewound clock are the disk
+        half of the abandoned timeline: a kill right now would resume from
+        one and never replay the rewound batches, breaking the any-kill-
+        schedule digest determinism (mirror of the ring's prune_newer)."""
+        dropped = self.checkpointer.prune_newer(self.step_count)
+        if dropped:
+            logger.warning(
+                "pruned %d durable checkpoint(s) from the abandoned "
+                "timeline (newer than step %d)", dropped, self.step_count)
+
+    def _on_heal(self, step: int) -> None:
+        if self.trainer._dynamic_lr:
+            self.trainer.set_lr_scale(1.0)
+        logger.info("recovery: %d clean steps — ladder healed to rung 0",
+                    self._recovery_cfg["heal_steps"])
+        self._record_recovery(step, "healed", "heal")
+
+    def _apply_recovery(self, step: int, kind: str, action: str) -> None:
+        """Take the ladder's next rung; rungs whose precondition is missing
+        (no scaler, no snapshot yet, no durable checkpoint on disk) escalate
+        immediately instead of spinning."""
+        t = self.trainer
+        while True:
+            if action == "cut_scale":
+                if t._scaler_cfg is None:       # ladder mis-advertised
+                    kind, action = self._ladder.escalate(step)
+                    continue
+                cur = float(np.asarray(t._guard_state["loss_scale"]))
+                new = cur / self._recovery_cfg["scale_cut"]
+                t.set_loss_scale(new)
+                self._ladder.scale_cuts += 1
+                logger.warning(
+                    "recovery[%s]: cut loss scale %.4g -> %.4g", kind, cur,
+                    float(np.asarray(t._guard_state["loss_scale"])))
+            elif action == "rollback":
+                snap = self._snapshots.newest()
+                if snap is None:
+                    kind, action = self._ladder.escalate(step)
+                    continue
+                damped = self._damped_knobs()
+                self._snapshots.restore(t, snap)
+                if snap["data_state"] is not None \
+                        and self._data_iter is not None \
+                        and self._data_state_ok:
+                    self._data_iter.set_state(snap["data_state"])
+                self._reapply_damped(damped)
+                self.step_count = int(snap["step"])
+                self._ladder.rollbacks += 1
+                self._ladder.note_rewound()
+                self._apply_lr_backoff()
+                self._prune_durable_newer()
+                logger.warning(
+                    "recovery[%s]: rolled back to in-memory snapshot of "
+                    "step %d (no disk restore)", kind, self.step_count)
+            elif action == "restore":
+                # bounded at the (possibly rewound) clock: the newest
+                # checkpoint on disk may be from the abandoned timeline a
+                # rollback just escaped — restoring it would jump FORWARD
+                dstep = self._find_restorable(max_step=self.step_count)
+                if dstep is None:
+                    kind, action = self._ladder.escalate(step)
+                    continue
+                damped = self._damped_knobs()
+                self._restore(dstep, load_ladder=False)
+                self._reapply_damped(damped)
+                self._ladder.restores += 1
+                self._ladder.note_rewound()
+                self._apply_lr_backoff()
+                self._prune_durable_newer()
+                logger.warning(
+                    "recovery[%s]: restored durable checkpoint step %d",
+                    kind, dstep)
+            else:   # "fail" — the last rung
+                self._record_recovery(step, kind, "fail")
+                self._flight_dump("recovery_failed: %s" % kind)
+                raise RecoveryFailed(
+                    "recovery ladder exhausted at step %d (%s): "
+                    "%d scale cut(s), %d rollback(s), %d durable "
+                    "restore(s) did not restore healthy progress"
+                    % (step, kind, self._ladder.scale_cuts,
+                       self._ladder.rollbacks, self._ladder.restores))
+            break
+        # records still queued describe state the action just replaced
+        self._health.clear()
+        self._record_recovery(step, kind, action)
 
     # ---------------------------------------------------------- persistence
     def save(self, async_save: bool = False) -> Optional[int]:
@@ -356,6 +695,11 @@ class ResilientTrainer:
             "aot_key": self._last_aot_key,
             "wall_time": time.time(),
         }
+        if self._ladder is not None:
+            # scaler state itself rides in the guard-state tree (saved with
+            # the __guard__ keys above); the ladder's host-side escalation
+            # state rides here so kill/resume continues the same rung
+            manifest["recovery"] = self._ladder.state_dict()
         if self._data_iter is not None and self._data_state_ok:
             # the iterator's exact resume point as of the batch the loop
             # last consumed — a restore lands on the NEXT batch. Probed at
@@ -399,6 +743,16 @@ class ResilientTrainer:
 
     def anomaly_stats(self) -> Dict[str, Any]:
         return self.trainer.anomaly_stats()
+
+    @property
+    def recovery_history(self):
+        """The recovery ladder's trip/action log — a list of ``{"step",
+        "kind", "action"}`` dicts, newest last (empty without
+        ``recovery=``). Entries carrying ``"skipped": True`` were chosen
+        but impossible (no snapshot/checkpoint yet) and escalated past
+        without executing. The supported way to inspect what the ladder
+        did."""
+        return list(self._ladder.history) if self._ladder is not None else []
 
     @property
     def mesh(self):

@@ -162,3 +162,52 @@ def test_sequential_module_chains(rng):
     assert metric.get()[1] > 0.9, metric.get()
     arg_params, _ = seq.get_params()
     assert "s1fc_weight" in arg_params and "s2fc_weight" in arg_params
+
+
+def test_module_optimizer_states_carry_amp_scaler(tmp_path, rng):
+    """AMP satellite: Module.save_checkpoint(save_optimizer_states=True)
+    wraps the opaque updater bytes in the amp envelope when a LossScaler is
+    attached, and load_optimizer_states restores the earned scale (stashed
+    for a later attach when none is present yet). Plain modules round-trip
+    untouched."""
+    from mxnet_tpu.contrib import amp
+    x, y = _toy_data(rng)
+    it = NDArrayIter(x, y, batch_size=16)
+    mod = Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(initializer=mx.init.Xavier())
+    mod.init_optimizer(kvstore=None)
+    scaler = amp.LossScaler(init_scale=64.0, growth_interval=2)
+    scaler.update(False)
+    scaler.update(False)                       # grew to 128
+    assert scaler.loss_scale == 128.0
+    mod._amp_loss_scaler = scaler
+    prefix = str(tmp_path / "ampmod")
+    mod.save_checkpoint(prefix, 1, save_optimizer_states=True)
+
+    mod2 = Module(_mlp_sym(), context=mx.cpu())
+    mod2.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod2.init_params(initializer=mx.init.Xavier())
+    mod2.init_optimizer(kvstore=None)
+    mod2.load_optimizer_states(f"{prefix}-0001.states")
+    # no scaler attached yet: one is constructed FROM the state (there is
+    # no later init_trainer hook on the Module path to consume a stash)
+    assert mod2._amp_loss_scaler.loss_scale == 128.0
+    assert mod2._amp_loss_scaler.growth_interval == 2
+
+    mod3 = Module(_mlp_sym(), context=mx.cpu())
+    mod3.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod3.init_params(initializer=mx.init.Xavier())
+    mod3.init_optimizer(kvstore=None)
+    mod3._amp_loss_scaler = amp.LossScaler()
+    mod3.load_optimizer_states(f"{prefix}-0001.states")
+    assert mod3._amp_loss_scaler.loss_scale == 128.0
+
+    # no scaler attached at save time: plain payload, plain load
+    mod4 = Module(_mlp_sym(), context=mx.cpu())
+    mod4.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod4.init_params(initializer=mx.init.Xavier())
+    mod4.init_optimizer(kvstore=None)
+    mod4.save_checkpoint(str(tmp_path / "plain"), 1,
+                         save_optimizer_states=True)
+    mod2.load_optimizer_states(str(tmp_path / "plain") + "-0001.states")

@@ -551,3 +551,50 @@ def test_lint_data_iter_suppression():
 
     r = analysis.lint_data_iter(Stateless(), suppress=("MXL-T208",))
     assert not r.findings and len(r.suppressed) == 1
+
+
+# ------------------------------------------------------------- MXL-T209
+def _lowprec_trainer(rng, prefix, **kw):
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.parallel import DataParallelTrainer
+    mx.random.seed(13)
+    net = nn.HybridSequential(prefix=prefix)
+    net.add(nn.Dense(8, activation="relu", prefix=prefix + "d0_"),
+            nn.Dense(3, prefix=prefix + "d1_"))
+    net.initialize(mx.init.Xavier())
+    t = DataParallelTrainer(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                            "sgd", {"learning_rate": 0.1}, **kw)
+    x = rng.randn(16, 6).astype("float32")
+    y = rng.randint(0, 3, (16,)).astype("float32")
+    return t, x, y
+
+
+def test_lint_trainer_flags_unscaled_bf16(rng):
+    """A bf16 compute_dtype fused step with no loss-scale state underflows
+    tiny grads silently — MXL-T209."""
+    t, x, y = _lowprec_trainer(rng, "t209_", compute_dtype="bfloat16",
+                               grad_guard=True)
+    r = analysis.lint_trainer(t, x, y)
+    hits = r.by_rule("MXL-T209")
+    assert len(hits) == 1, r.to_text()
+    assert hits[0].severity == "warning"
+    assert "loss-scale" in hits[0].message
+    assert "loss_scaling=True" in hits[0].hint
+
+
+def test_lint_trainer_t209_clean_with_scaler_or_f32(rng):
+    """In-trace loss scaling satisfies the rule; f32 never triggers it."""
+    t, x, y = _lowprec_trainer(rng, "t209b_", compute_dtype="bfloat16",
+                               loss_scaling=True)
+    assert not analysis.lint_trainer(t, x, y).by_rule("MXL-T209")
+    t2, x2, y2 = _lowprec_trainer(rng, "t209c_", grad_guard=True)
+    assert not analysis.lint_trainer(t2, x2, y2).by_rule("MXL-T209")
+
+
+def test_lint_trainer_t209_suppression(rng):
+    t, x, y = _lowprec_trainer(rng, "t209d_", compute_dtype="bfloat16",
+                               grad_guard=True)
+    r = analysis.lint_trainer(t, x, y, suppress=("MXL-T209",))
+    assert not r.by_rule("MXL-T209")
+    assert any(d.rule_id == "MXL-T209" for d in r.suppressed)

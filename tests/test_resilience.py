@@ -883,3 +883,636 @@ def test_save_survives_composite_iterator_with_stateless_base(tmp_path,
     man = rt.checkpointer.read_manifest(step)["user"]
     assert "data_state" not in man         # epoch-granular fallback
     rt.close()
+
+
+# ---------------------------------------------------- self-healing recovery
+# (ISSUE 5 tentpole: in-trace loss scaling, rolling snapshots, the ladder)
+
+_REC = {"snapshot_every": 5, "max_skips": 3, "lag": 0, "heal_steps": 10,
+        "lr_backoff": 1.0, "max_rollbacks": 2, "max_restores": 1}
+
+
+def _recovery_trainer(prefix, d, rec=None, **kw):
+    kw.setdefault("compute_dtype", "bfloat16")
+    kw.setdefault("loss_scaling", True)
+    return ResilientTrainer(
+        _make_net(prefix), gluon.loss.SoftmaxCrossEntropyLoss(),
+        "sgd", {"learning_rate": 0.1}, directory=d, preemption=False,
+        recovery=dict(_REC, **(rec or {})), **kw)
+
+
+def test_scaler_apply_transitions():
+    """In-trace scaler unit semantics: overflow halves + zeroes the growth
+    counter, growth_interval clean steps double, a spike-skip (finite grad)
+    leaves the scale alone, min/max clamp."""
+    from mxnet_tpu.resilience import recovery
+
+    cfg = recovery.scaler_config({"init_scale": 8.0, "growth_interval": 2,
+                                  "min_scale": 2.0, "max_scale": 16.0})
+    s = recovery.scaler_init_state(cfg)
+    F, T = jnp.asarray(False), jnp.asarray(True)
+    # clean, clean -> doubled at the interval, counter reset
+    s.update(recovery.scaler_apply(cfg, s, F, F))
+    assert float(s["loss_scale"]) == 8.0 and int(s["ls_good"]) == 1
+    s.update(recovery.scaler_apply(cfg, s, F, F))
+    assert float(s["loss_scale"]) == 16.0 and int(s["ls_good"]) == 0
+    # growth clamps at max_scale
+    s.update(recovery.scaler_apply(cfg, s, F, F))
+    s.update(recovery.scaler_apply(cfg, s, F, F))
+    assert float(s["loss_scale"]) == 16.0
+    # overflow halves and resets the growth counter
+    s.update(recovery.scaler_apply(cfg, s, T, T))
+    assert float(s["loss_scale"]) == 8.0 and int(s["ls_good"]) == 0
+    assert int(s["ls_overflows"]) == 1
+    # spike-skip (bad but finite): scale AND counter untouched
+    s.update(recovery.scaler_apply(cfg, s, F, T))
+    assert float(s["loss_scale"]) == 8.0 and int(s["ls_good"]) == 0
+    # halving clamps at min_scale
+    s.update(recovery.scaler_apply(cfg, s, T, T))
+    s.update(recovery.scaler_apply(cfg, s, T, T))
+    s.update(recovery.scaler_apply(cfg, s, T, T))
+    assert float(s["loss_scale"]) == 2.0
+
+
+@pytest.mark.chaos
+def test_in_trace_scaler_overflow_halves_and_skips():
+    """bf16 fused step with in-trace scaling: a NaN batch skips the update
+    (params unchanged) and halves the device-resident loss scale — no
+    amp.init_trainer wrapper, no per-step host sync."""
+    t = parallel.DataParallelTrainer(
+        _make_net("its_"), gluon.loss.SoftmaxCrossEntropyLoss(),
+        "sgd", {"learning_rate": 0.1},
+        compute_dtype="bfloat16", loss_scaling={"init_scale": 128.0})
+    batches = _batches(3)
+    for x, y in batches:
+        t.step(x, y)
+    stats = t.anomaly_stats()
+    assert stats["loss_scale"] == 128.0
+    assert stats["grad_skipped_steps"] == 0
+    before = _params_np(t)
+    t.step(chaos.nan_batch(batches[0][0]), batches[0][1])
+    stats = t.anomaly_stats()
+    assert stats["loss_scale"] == 64.0          # halved by the overflow
+    assert stats["scaler_overflows"] == 1
+    assert stats["grad_skipped_steps"] == 1     # update skipped
+    after = _params_np(t)
+    for name in before:
+        assert np.array_equal(before[name], after[name]), name
+
+
+@pytest.mark.chaos
+def test_nan_storm_rollback_matches_uninjected_digest(tmp_path):
+    """THE acceptance bar: under chaos.nan_storm a fused bf16 run
+    self-heals via cut_scale -> in-memory snapshot rollback (no process
+    restart, no disk restore) and reaches the exact final params of an
+    uninjected run."""
+    N = 30
+    batches = _batches(6)
+
+    ref = _recovery_trainer("storm_", str(tmp_path / "ref"))
+    ref.ensure_initialized(*batches[0])
+    while ref.step_count < N:
+        ref.step(*batches[ref.step_count % len(batches)])
+    ref_params = _params_np(ref.trainer)
+    ref.close()
+
+    rt = _recovery_trainer("storm_", str(tmp_path / "inj"))
+    rt.ensure_initialized(*batches[0])
+    # 2*max_skips poisoned steps: trip 1 cuts the loss scale, trip 2 rolls
+    # back to the step-10 snapshot; the storm is exhausted by then, so the
+    # replay is clean and re-trains every skipped batch
+    with chaos.nan_storm(rt, steps=6, after=12) as st:
+        while rt.step_count < N:
+            rt.step(*batches[rt.step_count % len(batches)])
+    assert st["poisoned"] == 6
+    actions = [h["action"] for h in rt._ladder.history]
+    assert actions[:2] == ["cut_scale", "rollback"]
+    assert "restore" not in actions             # never touched the disk
+    assert rt._ladder.rollbacks == 1
+    got = _params_np(rt.trainer)
+    for name in ref_params:
+        assert np.array_equal(ref_params[name], got[name]), name
+    rt.close()
+
+
+@pytest.mark.chaos
+def test_recovery_ladder_full_escalation_fails_loud(tmp_path):
+    """An unrecoverable NaN storm climbs every rung — cut_scale, snapshot
+    rollback, durable restore — and then fails LOUD (RecoveryFailed), never
+    silently skipping forever."""
+    from mxnet_tpu.resilience import RecoveryFailed
+
+    rt = _recovery_trainer("esc_", str(tmp_path / "run"),
+                           rec={"max_rollbacks": 1})
+    rt.save_every = 5                           # durable restore target
+    batches = _batches(6)
+    rt.ensure_initialized(*batches[0])
+    with chaos.nan_storm(rt, steps=10_000, after=12):
+        with pytest.raises(RecoveryFailed):
+            for _ in range(200):
+                rt.step(*batches[rt.step_count % len(batches)])
+    kinds = [h["kind"] for h in rt._ladder.history]
+    actions = [h["action"] for h in rt._ladder.history]
+    assert actions == ["cut_scale", "rollback", "restore", "fail"]
+    assert all(k == "skip_streak" for k in kinds)
+    assert rt._ladder.rollbacks == 1 and rt._ladder.restores == 1
+    rt.close()
+
+
+@pytest.mark.chaos
+def test_durable_restore_prunes_stale_snapshots(tmp_path):
+    """A durable restore rewinds time: ring entries captured AFTER the
+    restored step belong to the abandoned timeline and must be dropped, or
+    a later rollback would jump training FORWARD into the very state the
+    restore rewound away from."""
+    rt = _recovery_trainer("prune_", str(tmp_path / "run"),
+                           rec={"max_rollbacks": 0, "snapshot_every": 2,
+                                "heal_steps": 50})
+    batches = _batches(6)
+    rt.ensure_initialized(*batches[0])
+    while rt.step_count < 4:
+        rt.step(*batches[rt.step_count % len(batches)])
+    rt.save()                                   # durable checkpoint @ 4
+    while rt.step_count < 8:
+        rt.step(*batches[rt.step_count % len(batches)])
+    assert rt._snapshots.newest_step == 8       # ring is AHEAD of the disk
+    # trip 1 (skips 9-11) cuts the scale, trip 2 (skips 12-14) must restore
+    # the durable step-4 checkpoint (max_rollbacks=0) and prune the
+    # step-6/8 snapshots; the storm is exhausted, so the replay is clean
+    # (and un-healed: rung>0 gates new captures — the ring stays empty)
+    with chaos.nan_storm(rt, steps=6) as st:
+        while rt.step_count < 16:
+            rt.step(*batches[rt.step_count % len(batches)])
+    assert st["poisoned"] == 6
+    actions = [h["action"] for h in rt._ladder.history]
+    assert actions == ["cut_scale", "restore"]
+    assert rt._ladder.restores == 1
+    assert rt._snapshots.newest_step is None    # stale 6/8 were pruned
+    rt.close()
+
+
+@pytest.mark.chaos
+def test_lagged_snapshot_gate_never_captures_mid_storm(tmp_path):
+    """With lag>0 the ladder counters run behind the clock; the snapshot
+    cadence gate must force-resolve the pending records before deciding —
+    a snapshot capturing an unobserved skipped step would make a later
+    rollback drop that batch instead of replaying it (digest drift)."""
+    N = 30
+    batches = _batches(6)
+    ref = _recovery_trainer("lagsnap_", str(tmp_path / "ref"),
+                            rec={"lag": 2})
+    ref.ensure_initialized(*batches[0])
+    while ref.step_count < N:
+        ref.step(*batches[ref.step_count % len(batches)])
+    refp = _params_np(ref.trainer)
+    ref.close()
+
+    rt = _recovery_trainer("lagsnap_", str(tmp_path / "inj"),
+                           rec={"lag": 2})
+    rt.ensure_initialized(*batches[0])
+    # the storm covers steps 15 AND 20 — both snapshot-cadence steps whose
+    # skips are still lag-unresolved when the gate runs
+    with chaos.nan_storm(rt, steps=8, after=14) as st:
+        while rt.step_count < N:
+            rt.step(*batches[rt.step_count % len(batches)])
+    assert st["poisoned"] == 8
+    actions = [h["action"] for h in rt._ladder.history]
+    assert "rollback" in actions
+    # every ring entry predates the storm or postdates the heal — none
+    # from inside it (the gate refused the step-15/20 cadence captures)
+    assert all(not (14 < s["step"] <= 22) for s in rt._snapshots._ring)
+    got = _params_np(rt.trainer)
+    for name in refp:
+        assert np.array_equal(refp[name], got[name]), name
+    rt.close()
+
+
+@pytest.mark.chaos
+def test_ladder_damping_survives_rollback_and_compounds(tmp_path):
+    """A rollback restores the snapshot's guard tree, but the ladder-owned
+    damping knobs must survive the rewind: the preceding cut_scale (and
+    the scaler's own in-storm halvings) must not be reverted to the
+    snapshot's pre-storm scale, and each rollback's LR backoff compounds
+    (0.5, 0.25, ...) instead of re-landing on the same value."""
+    rt = _recovery_trainer("damp_", str(tmp_path / "run"),
+                           rec={"lr_backoff": 0.5, "heal_steps": 50})
+    batches = _batches(6)
+    rt.ensure_initialized(*batches[0])
+    while rt.step_count < 12:
+        rt.step(*batches[rt.step_count % len(batches)])
+    assert rt.trainer.anomaly_stats()["loss_scale"] == 1024.0  # default init
+    with chaos.nan_storm(rt, steps=9) as st:
+        while rt.step_count < 24:
+            rt.step(*batches[rt.step_count % len(batches)])
+    assert st["poisoned"] == 9
+    actions = [h["action"] for h in rt._ladder.history]
+    assert actions[:3] == ["cut_scale", "rollback", "rollback"]
+    assert rt._ladder.rollbacks == 2
+    stats = rt.trainer.anomaly_stats()
+    # in-storm halvings + the cut survived both rollbacks (snapshot@10
+    # carried the pre-storm 1024) ...
+    assert stats["loss_scale"] == 1.0
+    # ... and the LR backoff compounded across the two rollbacks
+    assert stats["lr_scale"] == 0.25
+    rt.close()
+
+
+@pytest.mark.chaos
+def test_rollback_prunes_abandoned_durable_checkpoints(tmp_path):
+    """The disk half of the abandoned-timeline hazard: a rollback rewinds
+    the clock past durable checkpoints saved mid-storm/pre-storm — a kill
+    right after would resume from one and never replay the rewound batches.
+    The rollback rung must prune them (mirror of the ring's prune_newer);
+    the restore rung is additionally bounded at the rewound clock."""
+    rt = _recovery_trainer("dprune_", str(tmp_path / "run"),
+                           rec={"heal_steps": 50})
+    batches = _batches(6)
+    rt.ensure_initialized(*batches[0])
+    while rt.step_count < 12:
+        rt.step(*batches[rt.step_count % len(batches)])
+    rt.save()                                   # durable @12, ring @5,10
+    assert rt.checkpointer.steps() == [12]
+    # trip 1 (skips 13-15) cuts the scale; trip 2 (16-18) rolls back to the
+    # step-10 snapshot — the step-12 checkpoint is now the future of an
+    # abandoned timeline and must leave the disk
+    with chaos.nan_storm(rt, steps=6) as st:
+        while rt.step_count < 20:
+            rt.step(*batches[rt.step_count % len(batches)])
+    assert st["poisoned"] == 6
+    actions = [h["action"] for h in rt._ladder.history]
+    assert actions[:2] == ["cut_scale", "rollback"]
+    assert rt.step_count == 20
+    assert rt.checkpointer.steps() == []        # abandoned @12 pruned
+    # the bounded restore search never hands back a pruned/newer step
+    assert rt._find_restorable(max_step=10) is None
+    rt.close()
+
+
+@pytest.mark.chaos
+def test_periodic_save_deferred_while_skips_await_replay(tmp_path, caplog):
+    """A periodic save whose cadence lands inside a skip streak must be
+    deferred: committing it would bake the consumed-but-untrained batches
+    into the resumed timeline (a kill right after could never replay
+    them). A short streak the ladder never acts on is written off at the
+    next rung-0 clean step, and the following cadence saves normally."""
+    import logging
+    rt = _recovery_trainer("defer_", str(tmp_path / "run"))
+    rt.save_every = 5
+    batches = _batches(6)
+    rt.ensure_initialized(*batches[0])
+    # poisons steps 14-15 only: streak peaks at 2 < max_skips=3, so the
+    # ladder never trips and the step-15 cadence save must self-defer
+    with chaos.nan_storm(rt, steps=2, after=13) as st:
+        with caplog.at_level(logging.WARNING, logger="mxnet_tpu"):
+            while rt.step_count < 20:
+                rt.step(*batches[rt.step_count % len(batches)])
+    assert st["poisoned"] == 2
+    assert rt._ladder.history == []             # no trip, no rollback
+    assert rt._ladder.unreplayed_skips == 0     # written off at step 16
+    # 5 and 10 committed healthy, 15 deferred, 20 committed again
+    assert rt.checkpointer.steps() == [5, 10, 20]
+    assert any("deferred" in r.message for r in caplog.records)
+    rt.close()
+
+
+@pytest.mark.chaos
+def test_preemption_mid_storm_defers_save_and_resumes_to_digest(tmp_path):
+    """THE crashloop --inject-nan + kill-schedule bar: a SIGTERM landing
+    mid-storm (skipped steps not yet replayed by a rollback) must NOT
+    commit the usual final checkpoint — the restarted process falls back
+    to the last healthy one, replays the poisoned batches clean, and
+    reaches the exact uninjected params."""
+    N = 30
+    batches = _batches(6)
+    kw = {"compute_dtype": "bfloat16", "loss_scaling": True,
+          "grad_guard": True, "recovery": dict(_REC), "save_every": 5}
+
+    ref = _recovery_trainer("pms_", str(tmp_path / "ref"))
+    ref.ensure_initialized(*batches[0])
+    while ref.step_count < N:
+        ref.step(*batches[ref.step_count % len(batches)])
+    ref_params = _params_np(ref.trainer)
+    ref.close()
+
+    d = str(tmp_path / "run")
+    guard = install()
+    guard.reset()
+    rt = ResilientTrainer(_make_net("pms_"),
+                          gluon.loss.SoftmaxCrossEntropyLoss(),
+                          "sgd", {"learning_rate": 0.1}, directory=d, **kw)
+    killed_at = None
+    try:
+        rt.ensure_initialized(*batches[0])
+        with chaos.nan_storm(rt, steps=6, after=12) as st:
+            while rt.step_count < N:
+                if rt.step_count == 14:     # two skips into the storm
+                    chaos.sigterm_self()
+                rt.step(*batches[rt.step_count % len(batches)])
+        pytest.fail("Preempted was not raised")
+    except Preempted:
+        killed_at = rt.step_count
+    finally:
+        guard.reset()
+    assert killed_at == 15 and st["poisoned"] == 3
+    # the step-15 cadence save AND the preemption final save were both
+    # deferred: the newest durable checkpoint predates the storm
+    assert rt.checkpointer.steps() == [5, 10]
+    rt.close()
+
+    rt2 = ResilientTrainer(_make_net("pms_"),
+                           gluon.loss.SoftmaxCrossEntropyLoss(),
+                           "sgd", {"learning_rate": 0.1}, directory=d,
+                           preemption=False, **kw)
+    rt2.ensure_initialized(*batches[0])
+    assert rt2.resumed_from == 10               # healthy, pre-storm
+    assert rt2._ladder.rung == 0 and rt2._ladder.unreplayed_skips == 0
+    while rt2.step_count < N:                   # the transient has passed
+        rt2.step(*batches[rt2.step_count % len(batches)])
+    got = _params_np(rt2.trainer)
+    for name in ref_params:
+        assert np.array_equal(ref_params[name], got[name]), name
+    rt2.close()
+
+
+def test_divergence_detector_ignores_single_good_outlier():
+    """One unusually-good batch must not arm the detector: a later spike
+    that clears factor x the window MINIMUM but not factor x its median is
+    ordinary loss noise, not divergence."""
+    from mxnet_tpu.resilience.recovery import RecoveryLadder, recovery_config
+
+    lad = RecoveryLadder(recovery_config({"window": 12,
+                                          "divergence_factor": 10.0}))
+    losses = [2e-7] * 5 + [1e-8] + [2e-7] * 5     # one outlier minimum
+    for i, l in enumerate(losses):
+        assert lad.observe(i, False, l) is None
+    # 5x the typical loss, 100x the outlier: noise, not a trip
+    assert lad.observe(len(losses), False, 1e-6) is None
+    # 20x the typical loss (and the window max): a genuine trend break
+    assert lad.observe(len(losses) + 1, False, 4e-6) is not None
+
+
+def test_ladder_history_marks_unexecuted_rungs():
+    """An impossible rung (no snapshot yet) is recorded but escalated past
+    without running — its history entry must say so, or recovery_history
+    reports a rollback that never happened."""
+    from mxnet_tpu.resilience.recovery import RecoveryLadder, recovery_config
+
+    lad = RecoveryLadder(recovery_config({"max_skips": 2}), has_scaler=False)
+    ev = None
+    for s in (1, 2):
+        ev = lad.observe(s, True, None)
+    assert ev == ("skip_streak", "rollback")
+    lad.escalate(3)                     # the trainer found no snapshot
+    assert lad.history[0]["action"] == "rollback"
+    assert lad.history[0].get("skipped") is True
+    assert "skipped" not in lad.history[1]      # the escalated-to entry ran
+
+
+def test_find_restorable_bounded_by_max_step(tmp_path):
+    rt = ResilientTrainer(_make_net("bnd_"),
+                          gluon.loss.SoftmaxCrossEntropyLoss(),
+                          "sgd", {"learning_rate": 0.1},
+                          directory=str(tmp_path / "run"), preemption=False)
+    batches = _batches(2)
+    rt.ensure_initialized(*batches[0])
+    rt.step(*batches[0]); rt.step(*batches[1])
+    rt.save()                                   # @2
+    rt.step(*batches[0]); rt.step(*batches[1])
+    rt.save()                                   # @4
+    assert rt._find_restorable() == 4
+    assert rt._find_restorable(max_step=3) == 2
+    assert rt._find_restorable(max_step=1) is None
+    rt.close()
+
+
+def test_partial_guard_state_restore_warns_not_resets(tmp_path, caplog):
+    """A checkpoint saved without the scaler, resumed into a loss_scaling
+    run: the guard counters it carries must be restored (not silently
+    discarded all-or-nothing) and the missing scaler keys warned about."""
+    import logging
+    d = str(tmp_path / "run")
+    rt = ResilientTrainer(_make_net("pgr_"),
+                          gluon.loss.SoftmaxCrossEntropyLoss(),
+                          "sgd", {"learning_rate": 0.1},
+                          directory=d, preemption=False, grad_guard=True)
+    batches = _batches(4)
+    rt.ensure_initialized(*batches[0])
+    for x, y in batches:
+        rt.step(x, y)
+    rt.save()
+    rt.close()
+
+    rt2 = ResilientTrainer(_make_net("pgr_"),
+                           gluon.loss.SoftmaxCrossEntropyLoss(),
+                           "sgd", {"learning_rate": 0.1},
+                           directory=d, preemption=False, grad_guard=True,
+                           compute_dtype="bfloat16", loss_scaling=True)
+    with caplog.at_level(logging.WARNING, logger="mxnet_tpu"):
+        rt2.ensure_initialized(*batches[0])
+    assert rt2.resumed_from == 4
+    # the guard counters the checkpoint carries are restored...
+    assert int(np.asarray(rt2.trainer._guard_state["steps"])) == 4
+    from mxnet_tpu.resilience.recovery import _SCALER_DEFAULTS
+    stats = rt2.trainer.anomaly_stats()
+    assert stats["loss_scale"] == _SCALER_DEFAULTS["init_scale"]  # ...fresh
+    assert any("lacks guard/scaler key" in r.message for r in caplog.records)
+    rt2.close()
+
+
+@pytest.mark.chaos
+def test_diverge_loss_trips_ladder_and_rolls_back(tmp_path):
+    """A quietly diverging loss (finite grads — the guard never skips)
+    trips the loss-trend detector and rolls back to the newest snapshot;
+    clean steps afterwards heal the ladder back to rung 0."""
+    rt = _recovery_trainer(
+        "div_", str(tmp_path / "run"),
+        rec={"window": 6, "divergence_factor": 10.0, "heal_steps": 3})
+    batches = _batches(6)
+    rt.ensure_initialized(*batches[0])
+    with chaos.diverge_loss(rt, factor=3.0) as st:
+        while rt.step_count < 10:
+            rt.step(*batches[rt.step_count % len(batches)])
+    assert st["inflated"] >= 6
+    trips = [h for h in rt._ladder.history if h["kind"] == "loss_divergence"]
+    # a finite-loss trajectory cannot be changed by a (numerically exact)
+    # scale cut: a divergence trip must skip that rung and go straight to
+    # the first action that can help
+    assert trips and trips[0]["action"] == "rollback"
+    for _ in range(6):                          # heal_steps clean steps
+        rt.step(*batches[rt.step_count % len(batches)])
+    assert rt._ladder.rung == 0
+    assert rt._ladder.history[-1]["kind"] == "healed"
+    rt.close()
+
+
+@pytest.mark.parametrize("kv", [False, True], ids=["fused", "kv"])
+def test_resume_equivalence_with_scaler_and_ladder(tmp_path, kv):
+    """Kill/resume with live scaler + ladder state: the resumed run
+    restores the EARNED loss scale / growth counter / ladder rung from the
+    manifest (not init values) and reaches the straight run's params bit
+    for bit."""
+    N, k = 8, 4
+    batches = _batches(N)
+    kw = {"compute_dtype": "bfloat16",
+          "loss_scaling": {"init_scale": 256.0, "growth_interval": 3}}
+    if kv:
+        kw["kvstore"] = mx.kv.create("local")
+    prefix = "rsl%d_" % int(kv)
+
+    straight = parallel.DataParallelTrainer(
+        _make_net(prefix), gluon.loss.SoftmaxCrossEntropyLoss(),
+        "sgd", {"learning_rate": 0.1}, grad_guard=True, **kw)
+    for x, y in batches:
+        straight.step(x, y)
+    ref = _params_np(straight)
+    ref_stats = straight.anomaly_stats()
+    assert ref_stats["loss_scale"] > 256.0      # growth actually happened
+
+    d = str(tmp_path / "run")
+    rt = ResilientTrainer(
+        _make_net(prefix), gluon.loss.SoftmaxCrossEntropyLoss(),
+        "sgd", {"learning_rate": 0.1}, directory=d, preemption=False,
+        recovery=_REC, **dict(kw, kvstore=mx.kv.create("local")
+                              if kv else None))
+    for x, y in batches[:k]:
+        rt.step(x, y)
+    rt._ladder.rung = 1                         # mid-escalation state
+    rt._ladder.scale_cuts = 1
+    rt.save()
+    saved_stats = rt.anomaly_stats()
+    rt.close()
+
+    rt2 = ResilientTrainer(
+        _make_net(prefix), gluon.loss.SoftmaxCrossEntropyLoss(),
+        "sgd", {"learning_rate": 0.1}, directory=d, preemption=False,
+        recovery=_REC, **dict(kw, kvstore=mx.kv.create("local")
+                              if kv else None))
+    rt2.ensure_initialized(*batches[0])
+    assert rt2.resumed_from == k
+    got_stats = rt2.anomaly_stats()
+    # scaler state rode the guard tree; ladder state rode the manifest
+    assert got_stats["loss_scale"] == saved_stats["loss_scale"]
+    assert got_stats["scaler_good_steps"] == saved_stats["scaler_good_steps"]
+    assert rt2._ladder.rung == 1 and rt2._ladder.scale_cuts == 1
+    for x, y in batches[k:]:
+        rt2.step(x, y)
+    got = _params_np(rt2.trainer)
+    for name in ref:
+        assert np.array_equal(ref[name], got[name]), name
+    assert rt2.anomaly_stats()["loss_scale"] == ref_stats["loss_scale"]
+    rt2.close()
+
+
+def test_recovery_off_hlo_identical(tmp_path):
+    """recovery=None / loss_scaling=None must leave the compiled step
+    UNTOUCHED: the ladder and snapshots are host-side only, so the exact
+    same StableHLO lowers with and without them — and the in-trace scaler
+    (the one piece that IS in-trace) must only appear when asked for."""
+
+    def lowered(prefix, resilient_recovery=None, **kw):
+        x, y = _batches(1)[0]
+        if resilient_recovery is not None:
+            rt = ResilientTrainer(
+                _make_net(prefix), gluon.loss.SoftmaxCrossEntropyLoss(),
+                "sgd", {"learning_rate": 0.1},
+                directory=str(tmp_path / "hlo"), preemption=False,
+                recovery=resilient_recovery, **kw)
+            rt.ensure_initialized(x, y)
+            t = rt.trainer
+        else:
+            t = parallel.DataParallelTrainer(
+                _make_net(prefix), gluon.loss.SoftmaxCrossEntropyLoss(),
+                "sgd", {"learning_rate": 0.1}, **kw)
+            t._capture(2, sample_arrays=[x, y])
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        spec = NamedSharding(t._mesh, P(t._axis))
+        ax = [jax.device_put(jnp.asarray(a), spec) for a in (x, y)]
+        rng = jax.random.PRNGKey(0)
+        return t._step_fn.lower(t._params, t._aux, t._opt_state,
+                                t._guard_state, rng, *ax).as_text()
+
+    plain = lowered("hlor_", grad_guard=True)
+    with_ladder = lowered("hlor_", resilient_recovery=dict(_REC),
+                          grad_guard=True)
+    assert plain == with_ladder                 # ladder = zero trace cost
+    with_scaler = lowered("hlor_", grad_guard=True, loss_scaling=True)
+    assert plain != with_scaler                 # the flag actually gates
+
+
+def test_recovery_config_rejects_unknown_knobs(tmp_path):
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.resilience import recovery
+
+    with pytest.raises(MXNetError, match="unknown recovery knob"):
+        recovery.recovery_config({"max_skipz": 3})
+    with pytest.raises(MXNetError, match="unknown loss_scaling knob"):
+        recovery.scaler_config({"init_scalee": 2.0})
+    # every falsy spelling is off (matching _guard_config) — 0 or {} must
+    # not silently enable the subsystem with full defaults
+    for off in (None, False, 0, {}):
+        assert recovery.recovery_config(off) is None
+        assert recovery.scaler_config(off) is None
+
+
+def test_recovery_config_rejects_non_pow2_scale_knobs():
+    # non-power-of-two scale factors would make `loss*s` / `g/s` round in
+    # f32, silently breaking the bitwise resume-equivalence guarantee
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.resilience import recovery
+
+    for knob, val in (("growth", 1.5), ("backoff", 0.3),
+                      ("init_scale", 1000.0), ("min_scale", 0.0),
+                      ("max_scale", -4.0)):
+        with pytest.raises(MXNetError, match="power of two"):
+            recovery.scaler_config({knob: val})
+    with pytest.raises(MXNetError, match="power of two"):
+        recovery.recovery_config({"scale_cut": 10.0})
+    # powers of two (incl. fractional) are accepted
+    assert recovery.scaler_config({"backoff": 0.25})["backoff"] == 0.25
+    assert recovery.recovery_config({"scale_cut": 8})["scale_cut"] == 8.0
+
+
+def test_loss_scaling_guard_conflict_and_override_validation():
+    # same fail-loud convention one layer down: a scaler without the guard
+    # would rescale but never skip; and the host-side scale override obeys
+    # the same pow2 + clamp invariants as every in-trace transition
+    from mxnet_tpu.base import MXNetError
+
+    # every explicit guard-off spelling is rejected, not just `False`
+    for off in (False, 0, {}):
+        with pytest.raises(MXNetError, match="grad-anomaly guard"):
+            parallel.DataParallelTrainer(
+                _make_net("lsg_"), gluon.loss.SoftmaxCrossEntropyLoss(),
+                "sgd", {"learning_rate": 0.1}, grad_guard=off,
+                loss_scaling=True)
+    t = parallel.DataParallelTrainer(
+        _make_net("lso_"), gluon.loss.SoftmaxCrossEntropyLoss(),
+        "sgd", {"learning_rate": 0.1}, loss_scaling={"init_scale": 128.0})
+    x, y = _batches(1)[0]
+    t.step(x, y)
+    with pytest.raises(MXNetError, match="power of two"):
+        t.set_loss_scale(1000.0)
+    assert t.anomaly_stats()["loss_scale"] == 128.0     # unchanged
+    t.set_loss_scale(2.0 ** 30)                         # clamped
+    assert t.anomaly_stats()["loss_scale"] == 2.0 ** 24
+
+
+def test_recovery_requires_grad_guard(tmp_path):
+    # recovery with an explicit grad_guard=False would be silently inert:
+    # the skip-streak detector could never fire — must fail loud instead
+    from mxnet_tpu.base import MXNetError
+
+    with pytest.raises(MXNetError, match="grad-anomaly guard"):
+        ResilientTrainer(_make_net("rgg_"),
+                         gluon.loss.SoftmaxCrossEntropyLoss(),
+                         "sgd", {"learning_rate": 0.1},
+                         directory=str(tmp_path), grad_guard=False,
+                         recovery=True)
+    # same rule for the ladder's other in-trace dependency: an explicit
+    # dynamic_lr_scale off would silently neutralize a configured backoff
+    with pytest.raises(MXNetError, match="dynamic_lr_scale"):
+        ResilientTrainer(_make_net("rgg_"),
+                         gluon.loss.SoftmaxCrossEntropyLoss(),
+                         "sgd", {"learning_rate": 0.1},
+                         directory=str(tmp_path), dynamic_lr_scale=False,
+                         recovery={"lr_backoff": 0.5})

@@ -110,6 +110,61 @@ def test_crashloop_kills_and_recovers_example(tmp_path):
     assert rc == 0
 
 
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_crashloop_inject_nan_self_heals(tmp_path):
+    """crashloop --inject-nan exports the NaN storm to the target; the
+    recovery ladder self-heals (snapshot rollback, no restart) and the
+    digest still matches the uninjected --recovery run."""
+    import crashloop
+    example = os.path.join(REPO, "example", "resilient_training.py")
+    p = subprocess.run([sys.executable, example, "--ckpt-dir",
+                        str(tmp_path / "ref"), "--steps", "30",
+                        "--recovery"],
+                       capture_output=True, text=True, timeout=300)
+    assert p.returncode == 0, p.stdout + p.stderr
+    digest = [l for l in p.stdout.splitlines()
+              if l.startswith("FINAL_PARAM_DIGEST=")][0].split("=", 1)[1]
+    rc = crashloop.main(["--interval", "600", "--max-restarts", "0",
+                         "--inject-nan", "6",
+                         "--expect-digest", digest, "--",
+                         sys.executable, example, "--ckpt-dir",
+                         str(tmp_path / "run"), "--steps", "30"])
+    assert rc == 0
+
+
+def test_crashloop_inject_nan_first_attempt_only(tmp_path, capsys):
+    """The storm env rides the FIRST attempt only: a restart re-arming it
+    would poison fresh relative step windows — including sub-trip tails
+    whose skips are never replayed, breaking --expect-digest."""
+    import crashloop
+    marker = tmp_path / "ran_once"
+    script = tmp_path / "probe.py"
+    # first run: record the storm env, exit 0 with no digest (crashloop
+    # treats that as a graceful preemption and restarts); second run:
+    # record again and print the digest to finish
+    script.write_text(
+        "import os\n"
+        "print('STORM=%s RECOVERY=%s' % ("
+        "os.environ.get('MXNET_CHAOS_NAN_STORM'), "
+        "os.environ.get('MXNET_CHAOS_RECOVERY')))\n"
+        f"m = {str(marker)!r}\n"
+        "if os.path.exists(m):\n"
+        "    print('FINAL_PARAM_DIGEST=abc')\n"
+        "else:\n"
+        "    open(m, 'w').close()\n")
+    rc = crashloop.main(["--interval", "600", "--max-restarts", "3",
+                         "--inject-nan", "4", "--expect-digest", "abc",
+                         "--", sys.executable, str(script)])
+    assert rc == 0
+    storms = [l for l in capsys.readouterr().out.splitlines()
+              if l.startswith("STORM=")]
+    # the storm disarms after attempt 0, but the recovery/bf16 stack it
+    # implied stays on — restarts must not resume the lineage into a
+    # different-arithmetic trainer
+    assert storms == ["STORM=4 RECOVERY=1", "STORM=None RECOVERY=1"]
+
+
 _LINT_FIXTURE = """\
 import numpy as np
 import jax.numpy as jnp

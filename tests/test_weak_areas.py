@@ -269,3 +269,122 @@ def test_backward_do_mirror_rematerializes(monkeypatch):
 
     np.testing.assert_allclose(grads_with(True), grads_with(False),
                                rtol=1e-6)
+
+
+def test_amp_scaler_state_survives_trainer_save_load(rng):
+    """AMP satellite: Trainer.save_states/load_states round-trips the
+    dynamic loss scale (and growth counter) — a resumed run continues with
+    the scale it EARNED, not init_scale, whether the scaler is attached
+    before or after load_states."""
+    from mxnet_tpu.contrib import amp
+    mx.random.seed(77)
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(8, activation="relu"), gluon.nn.Dense(2))
+    net.initialize(mx.init.Xavier())
+    xs = nd.array(rng.randn(8, 4).astype("float32"))
+    ys = nd.array((rng.randn(8) > 0).astype("float32"))
+    net(xs)
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1}, kvstore=None)
+    amp.init_trainer(tr, amp.LossScaler(init_scale=256.0, growth_interval=3))
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    for _ in range(4):
+        with autograd.record():
+            loss = loss_fn(net(xs), ys)
+            with amp.scale_loss(loss, tr) as scaled:
+                pass
+        scaled.backward()
+        tr.step(8)
+    scaler = tr._amp_loss_scaler
+    assert scaler.loss_scale == 512.0          # grew once at interval 3
+
+    with tempfile.TemporaryDirectory() as d:
+        f = os.path.join(d, "t.states")
+        tr.save_states(f)
+
+        # load BEFORE init_trainer (fresh-process order): state is stashed
+        # and applied by init_trainer
+        tr2 = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1}, kvstore=None)
+        tr2.load_states(f)
+        amp.init_trainer(tr2)
+        assert tr2._amp_loss_scaler.loss_scale == 512.0
+        assert tr2._amp_loss_scaler._good_steps == scaler._good_steps
+        assert tr2._amp_loss_scaler.growth_interval == 3
+
+        # load AFTER init_trainer: applied to the attached scaler directly
+        tr3 = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1}, kvstore=None)
+        amp.init_trainer(tr3)
+        tr3.load_states(f)
+        assert tr3._amp_loss_scaler.loss_scale == 512.0
+        # a later non-AMP load supersedes the earned scale on the LIVE
+        # scaler too (not just the stash): that lineage never had one
+        fp = os.path.join(d, "noamp.states")
+        trp = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1}, kvstore=None)
+        trp.step(8)
+        trp.save_states(fp)
+        tr3.load_states(fp)
+        # back to tr3's OWN construction init_scale (the default 2**10),
+        # not the 512 earned by the abandoned AMP lineage
+        assert tr3._amp_loss_scaler.loss_scale == 2.0 ** 10
+        assert tr3._amp_loss_scaler._good_steps == 0
+
+        # non-AMP save/load unaffected by the envelope (passthrough)
+        tr4 = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1}, kvstore=None)
+        tr4.step(8)
+        f2 = os.path.join(d, "plain.states")
+        tr4.save_states(f2)
+        tr5 = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1}, kvstore=None)
+        tr5.load_states(f2)                     # must not raise
+
+        # load -> RE-SAVE before init_trainer ever runs: the stashed
+        # (pending) scaler state must keep riding the envelope — stripping
+        # it would silently reset a later AMP resume to init_scale
+        tr6 = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1}, kvstore=None)
+        tr6.load_states(f)
+        f3 = os.path.join(d, "resaved.states")
+        tr6.save_states(f3)
+        tr7 = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1}, kvstore=None)
+        tr7.load_states(f3)
+        amp.init_trainer(tr7)
+        assert tr7._amp_loss_scaler.loss_scale == 512.0
+
+
+def test_amp_overflow_scalar_is_fused_and_lazy(rng):
+    """AMP satellite: the finiteness check is ONE jitted reduction over all
+    grads returning a lazy device scalar — not a per-parameter host sync.
+    bool() of it at the branch point is the only step-path host read."""
+    from mxnet_tpu.contrib import amp
+    mx.random.seed(78)
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(4), gluon.nn.Dense(2))
+    net.initialize(mx.init.Xavier())
+    xs = nd.array(rng.randn(4, 3).astype("float32"))
+    ys = nd.array((rng.randn(4) > 0).astype("float32"))
+    net(xs)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    with autograd.record():
+        loss = loss_fn(net(xs), ys)
+    loss.backward()
+    scaler = amp.LossScaler()
+    params = list(net.collect_params().values())
+    cnt = scaler.overflow_scalar(params)
+    import jax
+    assert isinstance(cnt, jax.Array)          # lazy device scalar
+    assert cnt.shape == () and not bool(cnt)
+    assert scaler.has_overflow(params) is False
+    params[0].grad[:] = np.inf
+    assert scaler.has_overflow(params) is True
+    # state_dict round-trip (what the checkpoint envelope carries)
+    scaler.update(True)
+    st = scaler.state_dict()
+    s2 = amp.LossScaler()
+    s2.load_state_dict(st)
+    assert s2.loss_scale == scaler.loss_scale
+    assert s2._good_steps == scaler._good_steps

@@ -30,7 +30,7 @@ DIGEST_PREFIX = "FINAL_PARAM_DIGEST="
 _MID_EPOCH_RE = re.compile(r"\bepoch\s+(\d+)\s+batch\s+(\d+)\b")
 
 
-def run_once(cmd, kill_after, sig, grace, kill_mid_epoch=False):
+def run_once(cmd, kill_after, sig, grace, kill_mid_epoch=False, env=None):
     """Run cmd; kill it after kill_after seconds. Returns (exited, rc,
     digest): exited=False means we killed it.
 
@@ -39,7 +39,7 @@ def run_once(cmd, kill_after, sig, grace, kill_mid_epoch=False):
     the signal always lands strictly inside an epoch — the worst case for
     a resume implementation that can only restart epochs."""
     proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
-                            stderr=subprocess.STDOUT, text=True)
+                            stderr=subprocess.STDOUT, text=True, env=env)
     deadline = time.time() + kill_after
     lines = []
     digest = None
@@ -110,6 +110,26 @@ def main(argv=None):
                          "kill THEN — every kill lands strictly mid-epoch, "
                          "exercising exact iterator-state resume (pair "
                          "with example/resilient_training.py --epochs)")
+    ap.add_argument("--inject-nan", type=int, default=0, metavar="K",
+                    help="chaos: export MXNET_CHAOS_NAN_STORM=K to the "
+                         "target so it poisons K consecutive steps with "
+                         "NaN batches mid-run (resilient_training.py "
+                         "reads it as its --inject-nan default). The run "
+                         "must self-heal through the recovery ladder "
+                         "instead of skipping forever — pair with "
+                         "--expect-digest to prove the snapshot-rollback "
+                         "replay converges to the uninjected params (K "
+                         "must reach the ladder's ROLLBACK rung — "
+                         "2*max_skips with loss scaling on, because the "
+                         "first trip only cuts the scale; shorter "
+                         "streaks are the guard's accepted-skip "
+                         "semantics and DO change the digest). Composes "
+                         "with the kill schedule: the "
+                         "storm is injected on the first attempt only, "
+                         "and a kill landing mid-storm is safe because "
+                         "the trainer defers checkpoints while skipped "
+                         "steps await replay — the restart replays them "
+                         "clean from the last healthy checkpoint")
     ap.add_argument("--expect-digest", default=None,
                     help="fail unless the final FINAL_PARAM_DIGEST matches")
     ap.add_argument("cmd", nargs=argparse.REMAINDER,
@@ -121,13 +141,36 @@ def main(argv=None):
     if not cmd:
         ap.error("no command given (put it after --)")
     sig = signal.SIGTERM if args.signal == "TERM" else signal.SIGKILL
+    env = restart_env = None
+    if args.inject_nan:
+        import os
+        # the storm is injected on the FIRST attempt only: re-arming it on
+        # every restart would keep poisoning fresh (process-relative) step
+        # windows — including sub-trip tails near the step budget, whose
+        # skips never reach the rollback threshold and so are never
+        # replayed, silently breaking --expect-digest. A storm cut short
+        # by the kill is safe either way: the trainer defers checkpoints
+        # while skips await replay, so the restart replays those batches
+        # clean
+        restart_env = dict(os.environ)
+        restart_env.pop("MXNET_CHAOS_NAN_STORM", None)
+        # ... but the recovery/bf16 stack the storm implied must stay ON
+        # for restarts (resilient_training.py reads this as its --recovery
+        # default): resuming the bf16-lineage checkpoint into a plain f32
+        # trainer would finish the run in different arithmetic and fail
+        # the digest comparison on config drift, not on a recovery bug
+        restart_env["MXNET_CHAOS_RECOVERY"] = "1"
+        env = dict(restart_env,
+                   MXNET_CHAOS_NAN_STORM=str(args.inject_nan))
 
     for attempt in range(args.max_restarts + 1):
         print("crashloop: attempt %d/%d" % (attempt + 1,
                                             args.max_restarts + 1),
               flush=True)
         exited, rc, digest = run_once(cmd, args.interval, sig, args.grace,
-                                      kill_mid_epoch=args.kill_mid_epoch)
+                                      kill_mid_epoch=args.kill_mid_epoch,
+                                      env=env if attempt == 0
+                                      else restart_env)
         if exited and rc == 0 and digest is None \
                 and sig is signal.SIGTERM and attempt < args.max_restarts:
             # a graceful preemption exit is ALSO rc 0 (by design) but has
