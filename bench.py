@@ -27,6 +27,16 @@ Robustness contract (hardened for round 3; the round-1/2 failure modes):
    it never touches the tunnel). The parent also traps SIGTERM and emits
    the best-known line before exiting, so an external timeout still
    yields a result.
+4. SELF-CLEANING WINDOW. Leftover tunnel clients from OUR OWN tooling
+   (aot_warm/perf_lab register their pids via tools/tunnel_session.py)
+   are killed by the preflight instead of skipping the live attempt —
+   the exact BENCH_r05 failure. "Leftover" = alive past the lifetime the
+   tool declared for itself at registration (expected_s: ~30 min for a
+   warm, hours for a perf-lab ladder; BENCH_PREFLIGHT_KILL_AGE, default
+   1800 s, for undeclared). Active owned clients and genuinely foreign
+   processes still cause a skip, never a kill (BENCH_PREFLIGHT_KILL=0
+   disables killing entirely). Kills are recorded as "preflight_killed"
+   in the emitted row.
 
 The training step is the fused SPMD path (parallel.DataParallelTrainer):
 forward+backward+update in one jitted XLA computation, bfloat16 compute with
@@ -41,23 +51,28 @@ import time
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, HERE)
+# session-owned tunnel-client registry (pure stdlib — safe for the parent,
+# which must never import jax). Absent in stripped-down copies of bench.py:
+# degrade to the old skip-only behavior.
+try:
+    sys.path.insert(1, os.path.join(HERE, "tools"))
+    import tunnel_session as _tunnel
+except Exception:
+    _tunnel = None
 
 BASELINE_IMG_S = 109.0  # reference ResNet-50, 1x K80, batch 32
 CACHE_PATH = os.path.join(HERE, "bench_cache.json")
 
-# bf16 peak FLOP/s per chip by device_kind substring (public TPU specs).
-_PEAK_FLOPS = [
-    ("v6", 918e12), ("v5p", 459e12), ("v5e", 197e12), ("v5 lite", 197e12),
-    ("v5", 459e12), ("v4", 275e12), ("v3", 123e12), ("v2", 45e12),
-]
-
-
 def _peak_flops(device_kind: str):
-    kind = (device_kind or "").lower()
-    for sub, peak in _PEAK_FLOPS:
-        if sub in kind:
-            return peak
-    return None
+    """Per-chip bf16 peak FLOP/s — single source of truth is the perf
+    layer's device table (observability/xcost.py, shared with the live MFU
+    gauge and the roofline classifier). Only called from the child, where
+    mxnet_tpu is imported anyway; the parent never touches it."""
+    try:
+        from mxnet_tpu.observability.xcost import peak_flops
+    except Exception:
+        return None
+    return peak_flops(device_kind)
 
 
 def _read_cache():
@@ -243,8 +258,16 @@ def run_bench():
         _write_cache(cached)
 
     # ---- MFU from the lowered step's own cost analysis --------------------
-    flops_per_step = None
-    flops_source = None
+    # FLOPs unification (ISSUE 6): BOTH sources are always recorded — the
+    # exact XLA count when the backend delivers one, and the analytic
+    # ResNet-50 estimate (fwd ~= 4.1 GFLOP/image at 224^2, 2 FLOPs/MAC,
+    # bwd ~= 2x fwd => ~12.3 GFLOP/image, conv FLOPs ~ HW) — and the XLA
+    # count is preferred consistently, so MFU numbers stay comparable
+    # across rounds whichever source a given window managed to reach.
+    flops_analytic = 12.3e9 * (image / 224.0) ** 2 * batch
+    flops_xla = None
+    ca = None
+    lowered = None
     mfu = None
     if time_left() > 60:
         try:
@@ -258,29 +281,54 @@ def run_bench():
             if isinstance(ca, (list, tuple)):
                 ca = ca[0]
             if ca:  # some PJRT backends (the axon tunnel) return None
-                flops_per_step = float(ca.get("flops", 0.0)) or None
-                flops_source = "xla_cost_analysis"
+                flops_xla = float(ca.get("flops", 0.0)) or None
         except Exception as e:
             print("cost_analysis unavailable: %s" % e, file=sys.stderr)
-    if flops_per_step is None:
-        # analytic fallback: ResNet-50 fwd ~= 4.1 GFLOP/image at 224^2
-        # (2 FLOPs per MAC), bwd ~= 2x fwd => ~12.3 GFLOP/image train,
-        # scaled for non-default image sizes (conv FLOPs ~ HW)
-        per_image = 12.3e9 * (image / 224.0) ** 2
-        flops_per_step = per_image * batch
-        flops_source = "analytic_2flops_per_mac"
+            ca = None
+    if flops_xla is not None:
+        flops_per_step, flops_source = flops_xla, "xla_cost_analysis"
+    else:
+        flops_per_step, flops_source = flops_analytic, \
+            "analytic_2flops_per_mac"
     peak = _peak_flops(device_kind) if on_accel else None
     if flops_per_step and peak:
         achieved = flops_per_step * (steps / dt)
         mfu = achieved / (peak * n_chips)
 
     out = dict(core)
-    if flops_per_step:
-        out["flops_per_step"] = flops_per_step
-        out["flops_source"] = flops_source
+    out["flops_per_step"] = flops_per_step
+    out["flops_source"] = flops_source
+    out["flops_per_step_analytic"] = flops_analytic
+    if flops_xla is not None:
+        out["flops_per_step_xla"] = flops_xla
     if mfu is not None:
         out["mfu"] = round(mfu, 4)
         out["peak_flops_assumed"] = peak
+
+    # ---- cost-ledger row: the bench window is also a compile-time cost
+    # capture — the same append-only ledger the trainer's perf layer and
+    # the ROADMAP-1 autotuner read (observability/xcost.py)
+    if ca:
+        try:
+            from mxnet_tpu.observability import xcost
+            row = xcost.analyze_cost(ca, device_kind=device_kind,
+                                     n_devices=n_chips)
+            row.update({
+                "label": "bench.resnet50",
+                "fingerprint": trainer._lowered_digest(lowered),
+                "platform": devices[0].platform,
+                "batch": batch, "image": image, "layout": core["layout"],
+                "throughput_img_s_per_chip": per_chip,
+                "measured_step_ms": 1e3 * dt / steps,
+            })
+            if mfu is not None:
+                row["mfu"] = mfu
+            ledger_path = os.environ.get("MXNET_PERF_LEDGER") or \
+                os.path.join(HERE, "mxtpu_cost_ledger.jsonl")
+            xcost.CostLedger(ledger_path).append(row)
+            out["cost_ledger"] = ledger_path
+        except Exception as e:
+            print("cost ledger write failed: %s" % e, file=sys.stderr)
 
     # ---- input-overlap diagnostic: batches fed host->device DURING compute
     # via the async device feed (reference PrefetcherIter overlap,
@@ -370,9 +418,11 @@ def _metric_lines(text):
 
 
 def _foreign_tunnel_clients():
-    """Names of OTHER processes that may hold the single-client tunnel
-    (perf_lab / aot_warm / tpu session leftovers). A second concurrent
-    client hangs behind them, so the live attempt must be skipped."""
+    """OTHER processes that may hold the single-client tunnel (perf_lab /
+    aot_warm / tpu session leftovers), as {"name", "pid"} dicts. A second
+    concurrent client hangs behind them, so each must either be killed
+    (session-owned leftovers, see ``_preflight_clear_tunnel``) or the live
+    attempt skipped (genuinely foreign processes)."""
     markers = ("aot_warm.py", "perf_lab.py", "tpu_session")
     found = []
     try:
@@ -389,11 +439,57 @@ def _foreign_tunnel_clients():
                               # a tunnel client; only python processes are
             for m in markers:
                 if m in cmd:
-                    found.append("%s(pid %s)" % (m, pid))
+                    found.append({"name": m, "pid": int(pid)})
                     break
     except OSError:
         pass
     return found
+
+
+def _preflight_clear_tunnel(clients):
+    """Self-cleaning bench window (the exact BENCH_r05 failure: our own
+    leftover aot_warm.py clients made three straight windows skip the live
+    attempt). Clients registered in the session registry
+    (tools/tunnel_session.py) are OURS — SIGTERM→SIGKILL them and take the
+    window; unregistered ones stay untouchable and still skip the live
+    attempt. Ownership alone is not leftover-ness: a warm/perf-lab run the
+    operator started minutes ago is ACTIVE, and killing it mid-compile
+    would be worse than skipping — so a client is only a leftover once its
+    registration is older than the lifetime its tool declared for itself
+    (``expected_s`` in the registry doc: ~30 min for an aot warm, hours
+    for a perf-lab ladder; BENCH_PREFLIGHT_KILL_AGE is the default for
+    registrations that declare nothing). Younger owned clients block the
+    window like foreign ones. Returns
+    (still_blocking, killed_descriptions)."""
+    killed = []
+    if not clients or _tunnel is None \
+            or os.environ.get("BENCH_PREFLIGHT_KILL", "1") != "1":
+        return clients, killed
+    try:
+        owned = _tunnel.owned_pids()
+    except Exception:
+        return clients, killed
+    default_age = float(os.environ.get("BENCH_PREFLIGHT_KILL_AGE", 1800))
+    remaining = []
+    for c in clients:
+        doc = owned.get(c["pid"])
+        # a registration without a start stamp is from a torn write —
+        # nothing alive refreshes it, so it counts as ancient
+        age = (time.time() - float(doc["start"])) if doc and doc.get("start") \
+            else float("inf")
+        min_age = (float(doc.get("expected_s") or default_age)
+                   if doc else default_age)
+        if doc is not None and age >= min_age:
+            try:
+                res = _tunnel.kill(c["pid"])
+            except Exception as e:
+                res = "error: %s" % e
+            killed.append("%s(pid %d): %s" % (c["name"], c["pid"], res))
+            if res.startswith("error"):
+                remaining.append(c)
+        else:
+            remaining.append(c)
+    return remaining, killed
 
 
 def _tunnel_preflight(timeout_s):
@@ -438,12 +534,15 @@ def main():
     live_measurements = []  # any live line (even cpu fallback) this run
 
     errors = []
+    preflight_killed = []   # session-owned leftovers we cleared pre-window
 
     def emit_final():
         if printed_final:
             return
         printed_final.append(True)
         if best is not None:
+            if preflight_killed and "preflight_killed" not in best:
+                best["preflight_killed"] = list(preflight_killed)
             # machine-consumer honesty: a cache re-print must be flagged as
             # degraded, not just in the free-form provenance string
             if (str(best.get("provenance", "")).startswith("cached")
@@ -501,7 +600,13 @@ def main():
         except OSError:
             pass
     live = None
-    foreign = _foreign_tunnel_clients()
+    foreign, killed = _preflight_clear_tunnel(_foreign_tunnel_clients())
+    preflight_killed.extend(killed)
+    if killed:
+        # recorded in the bench row provenance (emit_final/live rows) AND
+        # on stderr for the window log
+        print("preflight killed session-owned tunnel client(s): %s"
+              % ", ".join(killed), file=sys.stderr)
     preflight = None
     if orphan is None and not foreign \
             and os.environ.get("BENCH_SKIP_TPU") != "1" and tpu_window > 90:
@@ -520,10 +625,13 @@ def main():
         errors.append("previous bench child pid=%d still alive; "
                       "skipping live TPU attempt" % orphan)
     elif foreign:
-        # another tool (perf_lab/aot_warm/a leftover session) holds the
-        # single-client tunnel; a second client would hang behind it
+        # a genuinely foreign tool (not in our session registry) holds the
+        # single-client tunnel; a second client would hang behind it, and
+        # killing a process we do not own is off the table
         errors.append("foreign tunnel client(s) alive: %s; "
-                      "skipping live TPU attempt" % ", ".join(foreign))
+                      "skipping live TPU attempt" % ", ".join(
+                          "%s(pid %d)" % (c["name"], c["pid"])
+                          for c in foreign))
     elif preflight in ("down", "hung"):
         errors.append("tunnel preflight: backend %s; skipping live TPU "
                       "attempt (cached row stands)" % preflight)
@@ -570,6 +678,8 @@ def main():
                 live["provenance"] = "live (partial: diagnostics still running)"
             else:
                 live["provenance"] = "live driver run"
+            if preflight_killed:
+                live["preflight_killed"] = list(preflight_killed)
         elif exited:
             try:
                 with open(child_err) as f:

@@ -31,6 +31,9 @@ Rules (catalog in docs/static_analysis.md):
 * MXL-T209 unscaled-lowprec-loss (warning) bf16/fp16 compute_dtype step
                                           with no loss-scale state (tiny
                                           grads underflow silently)
+* MXL-T210 uninstrumented-hot-loop (warning) telemetry is enabled but the
+                                          trainer's step-time attribution
+                                          is switched off (perf blind spot)
 """
 from __future__ import annotations
 
@@ -99,6 +102,13 @@ register_rule(
     "skewing convergence late in training. Enable in-trace dynamic loss "
     "scaling (DataParallelTrainer(loss_scaling=True)) or contrib.amp's "
     "LossScaler.")
+register_rule(
+    "MXL-T210", "warning", "uninstrumented-hot-loop",
+    "The trainer runs with telemetry enabled but step-time attribution "
+    "disabled: the hot loop publishes no mxtpu_step_breakdown_ms / "
+    "mxtpu_device_util / mxtpu_mfu gauges, so a slowdown cannot be "
+    "attributed to device compute vs host dispatch vs data-feed stall — "
+    "exactly the blind spot that kept perf flat across bench rounds.")
 
 _HOST_SYNC_METHODS = ("item", "asscalar", "asnumpy", "wait_to_read")
 _NP_NAMES = ("np", "numpy", "onp")
@@ -539,4 +549,23 @@ def lint_trainer(trainer, *data, suppress: Sequence[str] = (),
                  "scaling: overflow halves, growth_interval clean steps "
                  "double, zero per-step host syncs) — state rides in "
                  "checkpoints automatically"))
+
+    # ---- uninstrumented hot loop (MXL-T210): also a config check — the
+    # hazard is telemetry saying "the run is slow" with attribution unable
+    # to say WHERE. Attribution is on by default with telemetry, so this
+    # only fires on an explicit step_attribution=False / env off pairing.
+    from ..observability import metrics as _obs_metrics
+    if _obs_metrics.enabled() \
+            and getattr(trainer, "_attr_cfg", "absent") is None:
+        report.add(Diagnostic(
+            "MXL-T210",
+            "telemetry is enabled but step-time attribution is disabled: "
+            "the hot loop publishes no step-breakdown / device-util / MFU "
+            "gauges, so a regression cannot be attributed to device "
+            "compute vs host dispatch vs data-feed stall",
+            location=type(trainer).__name__,
+            hint="drop step_attribution=False (or MXNET_PERF_ATTRIBUTION="
+                 "0) — the bookkeeping is host-side only and never enters "
+                 "the compiled step; or disable telemetry entirely if this "
+                 "run truly must not measure itself"))
     return report

@@ -21,7 +21,13 @@ flight_recorder     ring buffer of recent step records; dumped to a JSON
                     trainer exception (crash forensics)
 jit_hooks           jax.monitoring taps: trace/compile counts + compile
                     time (the dynamic retrace truth)
+xcost               XLA cost ledger: per-executable FLOPs/bytes/roofline
+                    rows persisted append-only (``MXNET_PERF_LEDGER``)
+attribution         step-time decomposition + live MFU/device-util gauges
+perfwatch           perf-regression watchdog vs bench baselines
+                    (library + ``tools/perfwatch.py`` CLI)
 tools/mxtop.py      pretty-printer for live or dumped snapshots
+                    (``perf`` view: ledger rows + perf gauges)
 ==================  ======================================================
 
 Everything is host-side: with ``MXNET_TELEMETRY=0`` instrumentation points
@@ -36,19 +42,27 @@ from . import catalog
 from . import spans
 from . import flight_recorder
 from . import jit_hooks
+from . import xcost
+from . import attribution
+from . import perfwatch
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry, REGISTRY,
                       counter, gauge, histogram, enabled, snapshot,
                       render_json, render_prometheus, write_snapshot,
                       start_exporter, stop_exporter)
 from .spans import span, active_spans
 from .flight_recorder import FlightRecorder, get_recorder, record_step
+from .xcost import CostLedger, analyze_cost
+from .attribution import StepAttribution
+from .perfwatch import PerfWatch
 
 __all__ = ["metrics", "catalog", "spans", "flight_recorder", "jit_hooks",
+           "xcost", "attribution", "perfwatch",
            "Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
            "counter", "gauge", "histogram", "enabled", "snapshot",
            "render_json", "render_prometheus", "write_snapshot",
            "start_exporter", "stop_exporter", "span", "active_spans",
-           "FlightRecorder", "get_recorder", "record_step"]
+           "FlightRecorder", "get_recorder", "record_step",
+           "CostLedger", "analyze_cost", "StepAttribution", "PerfWatch"]
 
 # jax.monitoring listeners are cheap (no work between compile events) and
 # honor the live MXNET_TELEMETRY switch themselves, so install eagerly —

@@ -154,6 +154,31 @@ LOSS_SCALE = _m.gauge(
     "Live dynamic loss scale of the in-trace scaler (published when "
     "anomaly_stats()/recovery drains it — never synced per step).")
 
+# ------------------------------------------------------------- performance
+MFU = _m.gauge(
+    "mxtpu_mfu",
+    "Live model-FLOPs utilization over the attribution window: the "
+    "executable's cost-ledger FLOPs per step divided by (mean step cadence "
+    "x per-chip peak FLOP/s x chips). Needs the cost ledger enabled "
+    "(MXNET_PERF_LEDGER) and a known/overridden device peak.")
+DEVICE_UTIL = _m.gauge(
+    "mxtpu_device_util",
+    "Fraction of recent steps whose previous result was still executing "
+    "when the next dispatch completed — a lag-1 saturation probe: ~1.0 = "
+    "device-bound pipeline, ~0.0 = the host/input path is the bottleneck.")
+STEP_BREAKDOWN = _m.gauge(
+    "mxtpu_step_breakdown_ms",
+    "Rolling mean of the wall step cadence decomposed host-side, labeled "
+    "bucket=dispatch|h2d_transfer|host_prep|feed_stall|host_other "
+    "(semantics in docs/observability.md).")
+COST_LEDGER_ROWS = _m.counter(
+    "mxtpu_cost_ledger_rows_total",
+    "Rows appended to the XLA cost ledger (MXNET_PERF_LEDGER).")
+PERF_REGRESSIONS = _m.counter(
+    "mxtpu_perf_regressions_total",
+    "Perf-watchdog checks that found a metric past its regression "
+    "threshold vs the baseline, labeled metric=.")
+
 # -------------------------------------------------------------- callbacks
 SPEEDOMETER_SPS = _m.gauge(
     "mxtpu_speedometer_samples_per_sec",

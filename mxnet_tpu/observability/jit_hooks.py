@@ -23,8 +23,8 @@ import threading
 
 from . import metrics as _metrics
 
-__all__ = ["install", "installed", "JIT_TRACES", "JIT_COMPILES",
-           "JIT_COMPILE_MS", "JIT_CACHE_HITS"]
+__all__ = ["install", "installed", "last_compile_ms", "JIT_TRACES",
+           "JIT_COMPILES", "JIT_COMPILE_MS", "JIT_CACHE_HITS"]
 
 JIT_TRACES = _metrics.counter(
     "mxtpu_jit_traces_total",
@@ -45,6 +45,14 @@ _CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
 
 _lock = threading.Lock()
 _installed = False
+_last_compile_ms = None
+
+
+def last_compile_ms():
+    """Wall time of the most recent XLA backend compile this process
+    performed (None before the first one) — the cost ledger attaches it to
+    the row of the executable captured right after a compile event."""
+    return _last_compile_ms
 
 
 def _on_duration(event: str, duration_secs: float, **kwargs) -> None:
@@ -53,6 +61,8 @@ def _on_duration(event: str, duration_secs: float, **kwargs) -> None:
     if event == _TRACE_EVENT:
         JIT_TRACES.inc()
     elif event == _COMPILE_EVENT:
+        global _last_compile_ms
+        _last_compile_ms = duration_secs * 1000.0
         JIT_COMPILES.inc()
         JIT_COMPILE_MS.observe(duration_secs * 1000.0)
 

@@ -175,6 +175,13 @@ class Histogram(_Metric):
             st = self._series.get(_label_key(labels))
             return st["count"] if st else 0
 
+    def totals(self) -> Tuple[int, float]:
+        """(count, sum) aggregated across every label series — the cheap
+        whole-family read the step-attribution layer diffs per step."""
+        with self._lock:
+            return (sum(st["count"] for st in self._series.values()),
+                    sum(st["sum"] for st in self._series.values()))
+
 
 class MetricsRegistry:
     """Thread-safe name → metric map with get-or-create semantics: a metric

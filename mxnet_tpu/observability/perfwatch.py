@@ -1,0 +1,273 @@
+"""Perf-regression watchdog — compare live/fresh perf facts to baselines.
+
+The bench history (``bench_cache.json``, ``BENCH_*.json``) is the repo's
+measured ground truth; this module turns it into an *enforced* floor
+instead of a number nobody re-reads. Three inputs normalize into one
+comparable shape:
+
+- a **bench row** (``{"metric": ..., "value": ...}``) → throughput, mfu,
+  flops_per_step;
+- a **telemetry snapshot** (``{"metrics": {...}}``) → the live
+  ``mxtpu_mfu`` / ``mxtpu_trainer_samples_per_sec`` gauges of a running
+  or finished run;
+- a **cost-ledger row / JSONL ledger** (``xcost``) → flops_per_step and
+  the roof times (a fatter step program is a regression before a single
+  wall-clock second is measured).
+
+:func:`compare` checks every metric present on BOTH sides against a
+per-metric threshold (percent), honoring direction (throughput/mfu: lower
+is worse; flops/step-time: higher is worse). :class:`PerfWatch` attaches
+the same comparison to a live run (``ResilientTrainer(perfwatch=...)``):
+every ``check_every`` steps it reads the live gauges, and a regression
+logs a loud warning + ``mxtpu_perf_regressions_total{metric=}`` — warn,
+never kill: a perf regression is a bug, not an emergency stop.
+
+CLI: ``tools/perfwatch.py`` (mxlint exit convention — 0 pass, 1
+regression, 2 missing/unloadable artifact).
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..base import get_env, logger, register_config
+from . import catalog as _catalog
+from . import metrics as _metrics
+
+__all__ = ["METRIC_DIRECTIONS", "DEFAULT_THRESHOLD_PCT", "normalize",
+           "load_artifact", "compare", "PerfWatch"]
+
+register_config("MXNET_PERF_BASELINE", "", str,
+                "Default baseline artifact for the perf watchdog (a bench "
+                "row / BENCH_*.json / ledger row). Empty = the repo's "
+                "bench_cache.json.")
+
+# metric -> +1 (higher is better) / -1 (lower is better)
+METRIC_DIRECTIONS: Dict[str, int] = {
+    "throughput": +1,          # img/s/chip from a bench row
+    "mfu": +1,
+    "samples_per_sec": +1,     # live trainer gauge (global, not per-chip)
+    "flops_per_step": -1,      # a fatter compiled step is a regression
+    "step_ms": -1,
+}
+
+DEFAULT_THRESHOLD_PCT = 10.0
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+def default_baseline_path() -> str:
+    return str(get_env("MXNET_PERF_BASELINE", "") or
+               os.path.join(_repo_root(), "bench_cache.json"))
+
+
+def normalize(doc: Any, source: str = "") -> Optional[Dict[str, Any]]:
+    """Map any supported artifact to ``{"metrics": {name: value}, "kind",
+    "source"}`` — or None when the document is not one of them."""
+    if not isinstance(doc, dict):
+        return None
+    if "parsed" in doc and isinstance(doc["parsed"], dict):
+        # BENCH_rNN.json wrapper: the driver's parsed final row
+        return normalize(doc["parsed"], source=source)
+    if "metrics" in doc and isinstance(doc["metrics"], dict):
+        vals: Dict[str, float] = {}
+        fams = doc["metrics"]
+
+        def gauge(name):
+            m = fams.get(name) or {}
+            for s in m.get("series", []):
+                if not s.get("labels"):
+                    return s.get("value")
+            return None
+
+        mfu = gauge("mxtpu_mfu")
+        sps = gauge("mxtpu_trainer_samples_per_sec")
+        if mfu is not None:
+            vals["mfu"] = float(mfu)
+        if sps is not None:
+            vals["samples_per_sec"] = float(sps)
+        return {"kind": "snapshot", "source": source, "metrics": vals}
+    if "metric" in doc and "value" in doc:
+        vals = {"throughput": float(doc["value"])}
+        if doc.get("mfu") is not None:
+            vals["mfu"] = float(doc["mfu"])
+        if doc.get("flops_per_step") is not None:
+            vals["flops_per_step"] = float(doc["flops_per_step"])
+        return {"kind": "bench_row", "source": source, "metrics": vals,
+                "provenance": doc.get("provenance"),
+                "unit": doc.get("unit")}
+    if "roofline" in doc or "arithmetic_intensity" in doc:
+        vals = {}
+        if doc.get("flops") is not None:
+            vals["flops_per_step"] = float(doc["flops"])
+        if doc.get("optimal_ms_compute") is not None:
+            vals["step_ms"] = float(doc["optimal_ms_compute"])
+        return {"kind": "ledger_row", "source": source, "metrics": vals,
+                "roofline": doc.get("roofline")}
+    return None
+
+
+def load_artifact(path: str) -> Tuple[Optional[Dict[str, Any]], str]:
+    """Load + normalize one artifact file. JSONL ledgers take their LAST
+    row (the freshest executable). Returns (normalized, error) — exactly
+    one of the two is truthy."""
+    try:
+        with open(path) as f:
+            text = f.read()
+    except OSError as e:
+        return None, "cannot read %s: %s" % (path, e)
+    doc = None
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        # JSON-lines ledger: last parseable row wins
+        for ln in reversed(text.splitlines()):
+            ln = ln.strip()
+            if not ln:
+                continue
+            try:
+                doc = json.loads(ln)
+                break
+            except ValueError:
+                continue
+    if doc is None:
+        return None, "%s is not JSON or JSON-lines" % path
+    norm = normalize(doc, source=path)
+    if norm is None:
+        return None, ("%s is not a bench row, telemetry snapshot or cost-"
+                      "ledger row" % path)
+    return norm, ""
+
+
+def compare(current: Dict[str, Any], baseline: Dict[str, Any],
+            thresholds: Optional[Dict[str, float]] = None,
+            default_pct: float = DEFAULT_THRESHOLD_PCT) -> Dict[str, Any]:
+    """Check every metric present on both sides. Returns ``{"status":
+    "ok"|"regression"|"incomparable", "checks": [...]}`` where each check
+    carries metric, baseline, current, delta_pct (signed, current vs
+    baseline) and regressed."""
+    thresholds = dict(thresholds or {})
+    cur = current.get("metrics", current) or {}
+    base = baseline.get("metrics", baseline) or {}
+    checks: List[Dict[str, Any]] = []
+    for metric, direction in METRIC_DIRECTIONS.items():
+        b, c = base.get(metric), cur.get(metric)
+        if b is None or c is None or float(b) == 0.0:
+            continue
+        b, c = float(b), float(c)
+        delta_pct = (c - b) / abs(b) * 100.0
+        worse_pct = -delta_pct if direction > 0 else delta_pct
+        thr = float(thresholds.get(metric, default_pct))
+        checks.append({"metric": metric, "baseline": b, "current": c,
+                       "delta_pct": round(delta_pct, 3),
+                       "threshold_pct": thr,
+                       "regressed": worse_pct >= thr})
+    if not checks:
+        status = "incomparable"
+    elif any(ch["regressed"] for ch in checks):
+        status = "regression"
+    else:
+        status = "ok"
+    return {"status": status, "checks": checks,
+            "baseline_source": baseline.get("source"),
+            "current_source": current.get("source")}
+
+
+class PerfWatch:
+    """Warn-on-regression hook for a live run.
+
+    >>> rt = ResilientTrainer(..., perfwatch={"check_every": 200})
+    # every 200 steps the live mxtpu_mfu / samples_per_sec gauges are
+    # compared against bench_cache.json; a breach logs a warning and
+    # bumps mxtpu_perf_regressions_total{metric=}.
+
+    ``baseline`` may be a path (bench row / BENCH_*.json / ledger), an
+    already-normalized dict, or None for the default
+    (``MXNET_PERF_BASELINE`` env, else the repo's bench_cache.json). A
+    missing baseline disarms the watch with one warning — never an error:
+    a fresh clone without bench history must still train.
+    """
+
+    def __init__(self, baseline=None, thresholds: Optional[Dict[str, float]] = None,
+                 default_pct: float = DEFAULT_THRESHOLD_PCT,
+                 check_every: int = 100):
+        self.thresholds = dict(thresholds or {})
+        self.default_pct = float(default_pct)
+        self.check_every = max(1, int(check_every))
+        self.last_result: Optional[Dict[str, Any]] = None
+        self.events: List[Dict[str, Any]] = []
+        self._warned_incomparable = False
+        if isinstance(baseline, dict):
+            self.baseline = (baseline if "metrics" in baseline
+                             else {"kind": "inline", "source": "<dict>",
+                                   "metrics": dict(baseline)})
+            self.baseline_error = ""
+        else:
+            path = baseline or default_baseline_path()
+            self.baseline, self.baseline_error = load_artifact(path)
+            if self.baseline is None:
+                logger.warning(
+                    "perfwatch disarmed: no usable baseline (%s)",
+                    self.baseline_error)
+
+    # ------------------------------------------------------------ checking
+    def live_metrics(self) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        mfu = _catalog.MFU.value()
+        sps = _catalog.SAMPLES_PER_SEC.value()
+        if mfu is not None:
+            out["mfu"] = float(mfu)
+        if sps is not None:
+            out["samples_per_sec"] = float(sps)
+        return out
+
+    def check(self, current: Optional[Dict[str, Any]] = None,
+              step: Optional[int] = None) -> Optional[Dict[str, Any]]:
+        """Compare ``current`` (default: the live gauges) to the baseline.
+        Returns the comparison result, or None when disarmed."""
+        if self.baseline is None:
+            return None
+        if current is None:
+            current = {"kind": "live", "source": "<registry>",
+                       "metrics": self.live_metrics()}
+        res = compare(current, self.baseline, thresholds=self.thresholds,
+                      default_pct=self.default_pct)
+        if step is not None:
+            res["step"] = int(step)
+        self.last_result = res
+        if res["status"] == "incomparable" and not self._warned_incomparable:
+            # an armed watch that can never fire is worse than a disarmed
+            # one — say so ONCE (e.g. a bare-core bench row with only
+            # throughput vs live gauges that only carry mfu/samples_per_sec)
+            self._warned_incomparable = True
+            logger.warning(
+                "perfwatch: baseline %s shares no metric with the current "
+                "artifact (baseline has %s, current has %s) — the watch "
+                "cannot fire; enable the cost ledger so live MFU is "
+                "published, or choose a baseline with mfu/samples_per_sec",
+                res.get("baseline_source"),
+                sorted((self.baseline.get("metrics") or {})),
+                sorted((current.get("metrics") or {})))
+        for ch in res["checks"]:
+            if not ch["regressed"]:
+                continue
+            self.events.append(dict(ch, step=step))
+            if _metrics.enabled():
+                _catalog.PERF_REGRESSIONS.inc(metric=ch["metric"])
+            logger.warning(
+                "perf regression: %s %.4g vs baseline %.4g (%+.1f%%, "
+                "threshold %.1f%%, baseline %s)", ch["metric"],
+                ch["current"], ch["baseline"], ch["delta_pct"],
+                ch["threshold_pct"], res.get("baseline_source"))
+        return res
+
+    def on_step(self, step: int) -> Optional[Dict[str, Any]]:
+        """The ResilientTrainer cadence hook: a real check every
+        ``check_every`` steps, a no-op otherwise."""
+        if self.baseline is None or step % self.check_every != 0:
+            return None
+        return self.check(step=step)

@@ -22,13 +22,15 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..base import MXNetError
+from ..base import MXNetError, logger
 from ..executor import _GraphLowering
 from ..ndarray import NDArray
 from ..ndarray.ndarray import _unwrap, _wrap
+from ..observability import attribution as _attribution
 from ..observability import catalog as _telemetry
 from ..observability import flight_recorder as _flight
 from ..observability import metrics as _metrics
+from ..observability import xcost as _xcost
 from ..resilience import recovery as _recovery
 from .mesh import local_mesh
 
@@ -202,7 +204,7 @@ class DataParallelTrainer:
                  mesh: Optional[Mesh] = None, data_axis: str = "dp",
                  compute_dtype=None, donate: bool = True, kvstore=None,
                  remat=None, grad_guard=None, loss_scaling=None,
-                 dynamic_lr_scale: bool = False):
+                 dynamic_lr_scale: bool = False, step_attribution=None):
         self._net = net
         self._loss_block = loss
         if mesh is None and kvstore is not None:
@@ -290,6 +292,22 @@ class DataParallelTrainer:
         self._apply_fn = None
         self._compiled = None   # AOT-deserialized executable (aot_load)
         self._compiled_shapes = None  # exact input shapes the AOT exe accepts
+        # step-time attribution (ISSUE 6): host-side decomposition of the
+        # step cadence into dispatch/transfer/feed-stall/... buckets plus
+        # live MFU/device-util gauges. Pure bookkeeping around the step —
+        # the jitted program and its HLO are untouched (tier-1 guards it).
+        self._attr_cfg = _attribution.attribution_config(step_attribution)
+        _dev0 = self._mesh.devices.ravel()[0]
+        self._perf = (_attribution.StepAttribution(
+            self._attr_cfg, device_kind=_dev0.device_kind,
+            n_devices=int(self._mesh.devices.size))
+            if self._attr_cfg is not None else None)
+        # per-executable XLA cost capture (observability.xcost): FLOPs /
+        # bytes / roofline row persisted once per compiled step when the
+        # ledger is enabled (MXNET_PERF_LEDGER); also the flops source for
+        # the live MFU gauge
+        self._flops_per_step = None
+        self._cost_rows: Dict[Tuple, Any] = {}
 
     # ------------------------------------------------------------- capture
     def _capture(self, n_inputs: int, sample_arrays=None):
@@ -299,9 +317,12 @@ class DataParallelTrainer:
             _telemetry.CAPTURES_TOTAL.inc()
         # a re-capture rebuilds params/opt_state from the net; any loaded
         # executable is keyed to the OLD pytree/placement and must not be
-        # re-entered afterwards
+        # re-entered afterwards — and any captured cost rows describe the
+        # old executable
         self._compiled = None
         self._compiled_shapes = None
+        self._cost_rows = {}
+        self._flops_per_step = None
         if sample_arrays is not None:
             # materialize deferred-init params with one tiny host forward;
             # the sample batch may arrive pre-sharded over the mesh (e.g.
@@ -552,6 +573,19 @@ class DataParallelTrainer:
             rng, *arrays)
         digest = self._lowered_digest(lowered)
         compiled = lowered.compile()
+        if _metrics.enabled() and _xcost.enabled():
+            # aot_save IS the compile: capture the ledger row here with the
+            # compiled executable attached (adds XLA's memory analysis)
+            dev = self._mesh.devices.ravel()[0]
+            row = _xcost.capture(
+                lowered, key=self._aot_key(arrays), fingerprint=digest,
+                label="DataParallelTrainer.aot_save",
+                device_kind=dev.device_kind, platform=dev.platform,
+                n_devices=int(self._mesh.devices.size), compiled=compiled)
+            if row is not None:
+                self._cost_rows[tuple(_shape_key(arrays))] = row
+                if row.get("flops"):
+                    self._flops_per_step = float(row["flops"])
         ser, in_tree, out_tree = serialize(compiled)
         tmp = "%s.tmp.%d" % (path, os.getpid())
         with open(tmp, "wb") as f:
@@ -639,17 +673,26 @@ class DataParallelTrainer:
         stays an async value; the recorder resolves it only at dump time).
         """
         tel = _metrics.enabled()
+        perf = self._perf if tel else None
         t0 = time.perf_counter() if tel else 0.0
         arrays = [_unwrap(d) if isinstance(d, NDArray) else jnp.asarray(d)
                   for d in data]
         if self._step_fn is None or self._n_inputs != len(arrays):
             self._capture(len(arrays), sample_arrays=arrays)
         dataspec = NamedSharding(self._mesh, P(self._axis))
+        tx0 = time.perf_counter() if perf is not None else 0.0
         arrays = [jax.device_put(a, dataspec) for a in arrays]
+        tx1 = time.perf_counter() if perf is not None else 0.0
         from .. import random as _random
         rng = jax.random.fold_in(jax.random.PRNGKey(_random.current_seed()),
                                  self._rng_counter)
         self._rng_counter += 1
+        if tel and _xcost.enabled():
+            # once per executable, BEFORE dispatch (params still alive):
+            # lower + cost_analysis + persist the ledger row (host-side
+            # metadata only; the compiled program is untouched)
+            self._maybe_capture_cost(rng, arrays)
+        td0 = time.perf_counter() if perf is not None else 0.0
         if self._kv is not None:
             loss = self._kv_step(rng, arrays)
         else:
@@ -666,7 +709,8 @@ class DataParallelTrainer:
              loss) = fn(self._params, self._aux, self._opt_state,
                         self._guard_state, rng, *arrays)
         if tel:
-            dt = time.perf_counter() - t0
+            t1 = time.perf_counter()
+            dt = t1 - t0
             ms = dt * 1000.0
             samples = int(arrays[0].shape[0]) if (
                 arrays and getattr(arrays[0], "ndim", 0)) else 0
@@ -676,10 +720,72 @@ class DataParallelTrainer:
                 _telemetry.SAMPLES_TOTAL.inc(samples)
                 if dt > 0:
                     _telemetry.SAMPLES_PER_SEC.set(samples / dt)
+            if perf is not None:
+                # FLOPs are per-executable: resolve THIS signature's ledger
+                # row (a second batch shape is a different program with
+                # different FLOPs — MFU must never mix them)
+                row = self._cost_rows.get(tuple(_shape_key(arrays)))
+                self._flops_per_step = (
+                    float(row["flops"]) if row and row.get("flops")
+                    else None)
+                # host-side decomposition + live MFU; the loss reference is
+                # kept one step and polled non-blocking, never synced
+                perf.observe(t0, t1, transfer_ms=(tx1 - tx0) * 1e3,
+                             dispatch_ms=(t1 - td0) * 1e3, loss_ref=loss,
+                             flops_per_step=self._flops_per_step)
             # rng_counter just advanced: it IS the completed-step count
             # (ResilientTrainer.step_count tracks the same number)
             _flight.record_step(self._rng_counter, loss=loss, step_ms=ms)
         return loss
+
+    def _maybe_capture_cost(self, rng, arrays) -> None:
+        """Persist this step's cost-ledger row (once per input signature).
+        Lowering is local tracing — no compile, no device work — and the
+        row is keyed by the same aot_key + StableHLO digest the AOT cache
+        trusts. The fused path costs ``_step_fn``; the kv path costs the
+        two programs it ACTUALLY runs (``_grad_fn`` + ``_apply_fn``,
+        summed — the fused step never executes there and its fingerprint
+        would name a nonexistent executable)."""
+        key = tuple(_shape_key(arrays))
+        if key in self._cost_rows:
+            return
+        self._cost_rows[key] = None       # one attempt per signature
+        try:
+            dev = self._mesh.devices.ravel()[0]
+            common = dict(key=self._aot_key(arrays),
+                          device_kind=dev.device_kind, platform=dev.platform,
+                          n_devices=int(self._mesh.devices.size))
+            if self._kv is None:
+                lowered = self._step_fn.lower(
+                    self._params, self._aux, self._opt_state,
+                    self._guard_state, rng, *arrays)
+                row = _xcost.capture(
+                    lowered, fingerprint=self._lowered_digest(lowered),
+                    label="DataParallelTrainer.step", **common)
+            else:
+                gargs = (self._params, self._aux)
+                if self._scaler_cfg is not None:
+                    gargs += (self._guard_state["loss_scale"],)
+                glow = self._grad_fn.lower(*(gargs + (rng,) + tuple(arrays)))
+                # grads share the params avals exactly — params stand in
+                alow = self._apply_fn.lower(
+                    self._params, self._opt_state, self._guard_state,
+                    self._params)
+                import hashlib
+                row = _xcost.capture(
+                    cost=_xcost.merge_costs(_xcost.cost_of(glow),
+                                            _xcost.cost_of(alow)),
+                    fingerprint=hashlib.sha256(
+                        (self._lowered_digest(glow)
+                         + self._lowered_digest(alow)).encode()).hexdigest(),
+                    label="DataParallelTrainer.kv_step", **common)
+        except Exception as e:   # never let the perf layer kill a step
+            logger.warning("cost-ledger capture failed: %r", e)
+            return
+        if row is not None:
+            self._cost_rows[key] = row
+            if row.get("flops"):
+                self._flops_per_step = float(row["flops"])
 
     def _kv_step(self, rng, arrays):
         """Grad -> kvstore wire sync (summed across workers; 2-bit codec if
@@ -759,6 +865,21 @@ class DataParallelTrainer:
             _telemetry.GRAD_LAST_NORM.set(stats["last_grad_norm"])
             if "loss_scale" in stats:
                 _telemetry.LOSS_SCALE.set(stats["loss_scale"])
+        return stats
+
+    def perf_stats(self) -> Dict[str, Any]:
+        """Step-attribution window stats (empty dict when attribution is
+        off or no step ran): rolling bucket means, device_util, cadence —
+        plus flops_per_step and live MFU when the cost ledger captured this
+        executable. All host-side reads; never syncs the device."""
+        if self._perf is None or self._perf.steps == 0:
+            return {}
+        stats = self._perf.stats()
+        if self._flops_per_step:
+            stats["flops_per_step"] = self._flops_per_step
+            mfu = self._perf.mfu(self._flops_per_step)
+            if mfu is not None:
+                stats["mfu"] = mfu
         return stats
 
     # ------------------------------------------------- recovery state hooks

@@ -92,7 +92,7 @@ class ResilientTrainer:
                  keep: Optional[int] = None, resume: bool = True,
                  preemption: bool = True, step_deadline: Optional[float] = None,
                  retry: bool = True, data_iter=None, recovery=None,
-                 **trainer_kwargs):
+                 perfwatch=None, **trainer_kwargs):
         if not directory:
             raise MXNetError("ResilientTrainer needs a checkpoint directory")
         # self-healing recovery (recovery.py): the escalation layer between
@@ -161,6 +161,21 @@ class ResilientTrainer:
         deadline = float(step_deadline if step_deadline is not None
                          else get_env("MXNET_RESILIENCE_STEP_DEADLINE", 0.0))
         self._watchdog = Watchdog(deadline) if deadline > 0 else None
+        # perf-regression watchdog (observability.perfwatch): every
+        # check_every steps the live mxtpu_mfu / samples_per_sec gauges are
+        # compared against the bench baseline — a breach WARNS (and bumps
+        # mxtpu_perf_regressions_total), it never kills the run. Accepts a
+        # PerfWatch, a config dict, a baseline path, or True for defaults.
+        self._perfwatch = None
+        if perfwatch:
+            from ..observability.perfwatch import PerfWatch
+            if isinstance(perfwatch, PerfWatch):
+                self._perfwatch = perfwatch
+            elif isinstance(perfwatch, dict):
+                self._perfwatch = PerfWatch(**perfwatch)
+            else:
+                self._perfwatch = PerfWatch(
+                    baseline=None if perfwatch is True else perfwatch)
         # stale temp dirs from a previous (killed) process are dead weight
         self.checkpointer.gc()
 
@@ -387,6 +402,8 @@ class ResilientTrainer:
         else:
             loss = guarded()
         self.step_count += 1
+        if self._perfwatch is not None and _metrics.enabled():
+            self._perfwatch.on_step(self.step_count)
         if self._ladder is not None:
             self._recovery_tick(loss)
         if self.save_every and self.step_count % self.save_every == 0:
@@ -743,6 +760,16 @@ class ResilientTrainer:
 
     def anomaly_stats(self) -> Dict[str, Any]:
         return self.trainer.anomaly_stats()
+
+    def perf_stats(self) -> Dict[str, Any]:
+        return self.trainer.perf_stats()
+
+    @property
+    def perfwatch(self):
+        """The attached perf-regression watch (None without
+        ``perfwatch=``); ``perfwatch.last_result``/``events`` hold what it
+        found."""
+        return self._perfwatch
 
     @property
     def recovery_history(self):

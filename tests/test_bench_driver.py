@@ -75,3 +75,64 @@ def test_driver_run_emits_final_line_without_tpu(tmp_path):
     assert final["metric"] == "resnet50_train_throughput_per_chip"
     assert "value" in final and "vs_baseline" in final
     assert "degraded" in final        # no cache + no TPU => must be flagged
+
+
+def test_preflight_clear_tunnel_kills_owned_leftovers_only(monkeypatch):
+    """The self-cleaning window: session-registered LEFTOVERS (registration
+    older than BENCH_PREFLIGHT_KILL_AGE) are killed and reported; a
+    just-started owned client (an active warm run) and unregistered
+    (foreign) clients survive and still block; BENCH_PREFLIGHT_KILL=0
+    restores the old skip-only behavior."""
+    import time
+    bench = _load_bench()
+
+    class StubTunnel:
+        def __init__(self):
+            self.killed = []
+
+        def owned_pids(self):
+            return {111: {"role": "aot_warm.py",           # 2h-old, way
+                          "start": time.time() - 7200,     # past its
+                          "expected_s": 1800},             # declared life
+                    333: {"role": "perf_lab.py",
+                          "start": time.time() - 60},      # active run
+                    444: {"role": "perf_lab.py",           # 2h-old but a
+                          "start": time.time() - 7200,     # ladder may run
+                          "expected_s": 3 * 3600}}         # 3h: active
+        def kill(self, pid, grace=8.0):
+            self.killed.append(pid)
+            return "terminated"
+
+    stub = StubTunnel()
+    monkeypatch.setattr(bench, "_tunnel", stub)
+    monkeypatch.delenv("BENCH_PREFLIGHT_KILL", raising=False)
+    monkeypatch.delenv("BENCH_PREFLIGHT_KILL_AGE", raising=False)
+    clients = [{"name": "aot_warm.py", "pid": 111},
+               {"name": "perf_lab.py", "pid": 222},
+               {"name": "perf_lab.py", "pid": 333},
+               {"name": "perf_lab.py", "pid": 444}]
+    remaining, killed = bench._preflight_clear_tunnel(list(clients))
+    assert stub.killed == [111]
+    assert remaining == [{"name": "perf_lab.py", "pid": 222},
+                         {"name": "perf_lab.py", "pid": 333},
+                         {"name": "perf_lab.py", "pid": 444}]
+    assert killed == ["aot_warm.py(pid 111): terminated"]
+
+    monkeypatch.setenv("BENCH_PREFLIGHT_KILL", "0")
+    remaining, killed = bench._preflight_clear_tunnel(list(clients))
+    assert killed == [] and remaining == clients
+
+    # no registry module at all (stripped bench.py copy): skip-only
+    monkeypatch.delenv("BENCH_PREFLIGHT_KILL", raising=False)
+    monkeypatch.setattr(bench, "_tunnel", None)
+    remaining, killed = bench._preflight_clear_tunnel(list(clients))
+    assert killed == [] and remaining == clients
+
+
+def test_peak_flops_shares_xcost_table():
+    """bench's per-chip peaks now come from the perf layer's single
+    source of truth (observability/xcost.py)."""
+    bench = _load_bench()
+    from mxnet_tpu.observability import xcost
+    for kind in ("TPU v5 lite", "TPU v5p", "TPU v4", "TPU v3"):
+        assert bench._peak_flops(kind) == xcost.peak_flops(kind)

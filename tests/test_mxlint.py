@@ -598,3 +598,41 @@ def test_lint_trainer_t209_suppression(rng):
     r = analysis.lint_trainer(t, x, y, suppress=("MXL-T209",))
     assert not r.by_rule("MXL-T209")
     assert any(d.rule_id == "MXL-T209" for d in r.suppressed)
+
+
+# ------------------------------------------------------------- MXL-T210
+def test_lint_trainer_t210_flags_attribution_off(rng):
+    """Telemetry on + step attribution explicitly off = a hot loop that
+    can say it is slow but not why — MXL-T210."""
+    t, x, y = _lowprec_trainer(rng, "t210_", step_attribution=False)
+    r = analysis.lint_trainer(t, x, y)
+    hits = r.by_rule("MXL-T210")
+    assert len(hits) == 1, r.to_text()
+    assert hits[0].severity == "warning"
+    assert "attribution" in hits[0].message
+
+
+def test_lint_trainer_t210_clean_by_default(rng):
+    """Attribution defaults on whenever telemetry is on, so an unconfigured
+    trainer never triggers the rule."""
+    t, x, y = _lowprec_trainer(rng, "t210b_")
+    assert not analysis.lint_trainer(t, x, y).by_rule("MXL-T210")
+
+
+def test_lint_trainer_t210_silent_without_telemetry(rng, monkeypatch):
+    """With telemetry off there is no half-instrumented state to flag."""
+    t, x, y = _lowprec_trainer(rng, "t210c_", step_attribution=False)
+    monkeypatch.setenv("MXNET_TELEMETRY", "0")
+    assert not analysis.lint_trainer(t, x, y).by_rule("MXL-T210")
+
+
+def test_lint_trainer_t210_env_default_and_suppression(rng, monkeypatch):
+    """MXNET_PERF_ATTRIBUTION=0 disables the default (rule fires); the
+    standard suppression channel silences it."""
+    monkeypatch.setenv("MXNET_PERF_ATTRIBUTION", "0")
+    t, x, y = _lowprec_trainer(rng, "t210d_")
+    r = analysis.lint_trainer(t, x, y)
+    assert r.by_rule("MXL-T210")
+    r = analysis.lint_trainer(t, x, y, suppress=("MXL-T210",))
+    assert not r.by_rule("MXL-T210")
+    assert any(d.rule_id == "MXL-T210" for d in r.suppressed)

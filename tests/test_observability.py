@@ -500,3 +500,278 @@ def test_instrumented_step_still_lints_clean():
     report = analysis.lint_trainer(spec["trainer"], *spec["data"])
     assert report.by_rule("MXL-T201") == []
     assert report.findings == [], report.to_text()
+
+
+# ------------------------------------------------- perf observability (ISSUE 6)
+from mxnet_tpu.observability import perfwatch as pw_mod, xcost  # noqa: E402
+
+
+def test_roofline_classification_synthetic(monkeypatch):
+    """Roofline math on synthetic cost dicts: intensity vs the ridge point
+    decides compute- vs memory-bound; missing peaks degrade to unknown."""
+    monkeypatch.setenv("MXNET_PERF_PEAK_FLOPS", "1e12")
+    monkeypatch.setenv("MXNET_PERF_PEAK_HBM_GBPS", "100")   # ridge = 10 F/B
+    hi = xcost.analyze_cost({"flops": 1e9, "bytes accessed": 1e6},
+                            device_kind="weird accelerator")
+    assert hi["arithmetic_intensity"] == pytest.approx(1000.0)
+    assert hi["ridge_intensity"] == pytest.approx(10.0)
+    assert hi["roofline"] == "compute-bound"
+    lo = xcost.analyze_cost({"flops": 5e6, "bytes accessed": 1e6},
+                            device_kind="weird accelerator")
+    assert lo["roofline"] == "memory-bound"
+    assert lo["optimal_ms_compute"] == pytest.approx(5e6 / 1e12 * 1e3)
+    assert lo["optimal_ms_memory"] == pytest.approx(1e6 / 1e11 * 1e3)
+    monkeypatch.delenv("MXNET_PERF_PEAK_FLOPS")
+    monkeypatch.delenv("MXNET_PERF_PEAK_HBM_GBPS")
+    unk = xcost.analyze_cost({"flops": 1e6}, device_kind="cpu")
+    assert unk["roofline"] == "unknown"
+    # the shared device table is the bench table: per-chip bf16 peaks
+    assert xcost.peak_flops("TPU v5 lite") == 197e12
+    assert xcost.peak_flops("TPU v4") == 275e12
+    assert xcost.peak_hbm_bw("TPU v5p") == 2765e9
+    assert xcost.peak_flops("cpu") is None
+
+
+def test_cost_ledger_append_read_and_corruption(tmp_path):
+    led = xcost.CostLedger(str(tmp_path / "ledger.jsonl"))
+    led.append({"label": "a", "fingerprint": "f1", "flops": 1.0})
+    led.append({"label": "b", "fingerprint": "f2", "flops": 2.0})
+    with open(led.path, "a") as f:
+        f.write("{torn line never finishe\n")
+    led.append({"label": "c", "fingerprint": "f1", "flops": 3.0})
+    rows = led.rows()
+    assert [r["label"] for r in rows] == ["a", "b", "c"]
+    assert all(r["version"] == 1 and "time" in r and "pid" in r
+               for r in rows)
+    assert [r["flops"] for r in led.rows(fingerprint="f1")] == [1.0, 3.0]
+    assert led.last()["label"] == "c"
+    assert len(led) == 3
+    assert xcost.CostLedger(str(tmp_path / "missing.jsonl")).rows() == []
+
+
+def _perf_env(monkeypatch, tmp_path):
+    path = str(tmp_path / "cost_ledger.jsonl")
+    monkeypatch.setenv("MXNET_PERF_LEDGER", path)
+    # the CPU backend is not in the device table: pin synthetic peaks so
+    # roofline classification and MFU have a denominator
+    monkeypatch.setenv("MXNET_PERF_PEAK_FLOPS", "1e12")
+    monkeypatch.setenv("MXNET_PERF_PEAK_HBM_GBPS", "100")
+    return path
+
+
+def test_jitted_step_persists_cost_row_and_live_perf_gauges(
+        tmp_path, monkeypatch):
+    """Acceptance: a jitted training step persists a CostLedger row (FLOPs,
+    bytes, roofline class, executable fingerprint) and publishes live
+    mxtpu_mfu / mxtpu_device_util / mxtpu_step_breakdown_ms gauges into a
+    telemetry snapshot."""
+    path = _perf_env(monkeypatch, tmp_path)
+    x, y = _batch()
+    t = parallel.DataParallelTrainer(
+        _make_net("perfacc_"), gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.1})
+    for _ in range(4):
+        t.step(x, y)
+    rows = xcost.CostLedger(path).rows()
+    assert len(rows) == 1          # once per executable, not per step
+    row = rows[0]
+    assert row["flops"] > 0 and row["bytes_accessed"] > 0
+    assert row["roofline"] in ("compute-bound", "memory-bound")
+    assert row["arithmetic_intensity"] == pytest.approx(
+        row["flops"] / row["bytes_accessed"])
+    assert len(row["fingerprint"]) == 64        # the aot StableHLO digest
+    assert row["aot_key"]["in_shapes"]
+    assert row["label"] == "DataParallelTrainer.step"
+    # live gauges in the snapshot
+    snap = obs.snapshot()["metrics"]
+
+    def gauge(name, **labels):
+        for s in snap[name]["series"]:
+            if s["labels"] == {k: str(v) for k, v in labels.items()}:
+                return s["value"]
+        return None
+
+    assert gauge("mxtpu_mfu") > 0
+    assert 0.0 <= gauge("mxtpu_device_util") <= 1.0
+    assert gauge("mxtpu_step_breakdown_ms", bucket="dispatch") > 0
+    for bucket in ("h2d_transfer", "host_prep", "feed_stall", "host_other"):
+        assert gauge("mxtpu_step_breakdown_ms", bucket=bucket) is not None
+    # the counter moved and the trainer's own view agrees
+    stats = t.perf_stats()
+    assert stats["flops_per_step"] == row["flops"]
+    assert stats["mfu"] > 0 and stats["steps"] == 4
+    assert obs.catalog.COST_LEDGER_ROWS.value() >= 1
+
+
+def test_perf_layer_distinct_executables_distinct_rows(tmp_path, monkeypatch):
+    """A second input signature (re-capture) gets its own ledger row keyed
+    by its own fingerprint."""
+    path = _perf_env(monkeypatch, tmp_path)
+    x, y = _batch()
+    x2, y2 = _batch(b=8)
+    t = parallel.DataParallelTrainer(
+        _make_net("perfmulti_"), gluon.loss.SoftmaxCrossEntropyLoss(),
+        "sgd", {"learning_rate": 0.1})
+    t.step(x, y)
+    t.step(x2, y2)      # batch 8: fresh signature, fresh executable
+    rows = xcost.CostLedger(path).rows()
+    assert len(rows) == 2
+    assert rows[0]["fingerprint"] != rows[1]["fingerprint"]
+    # MFU uses the stepped signature's OWN flops, not the last-captured
+    # one: after returning to batch 16 the live value must match row 0
+    assert t.perf_stats()["flops_per_step"] == rows[1]["flops"]
+    t.step(x, y)
+    assert t.perf_stats()["flops_per_step"] == rows[0]["flops"]
+
+
+def test_kv_path_costs_the_programs_it_runs(tmp_path, monkeypatch):
+    """The hybrid kv path never executes the fused step: its ledger row is
+    the SUM of the grad + apply programs it actually dispatches, labeled
+    kv_step, with a fingerprint derived from both."""
+    path = _perf_env(monkeypatch, tmp_path)
+    x, y = _batch()
+    t = parallel.DataParallelTrainer(
+        _make_net("perfkv_"), gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.1}, kvstore=mx.kv.create("local"))
+    for _ in range(3):
+        t.step(x, y)
+    rows = xcost.CostLedger(path).rows()
+    assert len(rows) == 1
+    assert rows[0]["label"] == "DataParallelTrainer.kv_step"
+    assert rows[0]["flops"] > 0 and len(rows[0]["fingerprint"]) == 64
+    assert t.perf_stats()["flops_per_step"] == rows[0]["flops"]
+
+
+def test_attribution_off_no_breakdown_no_ledger_requirement(
+        tmp_path, monkeypatch):
+    """step_attribution=False publishes nothing and perf_stats is empty —
+    but the cost ledger still captures (they are independent gates)."""
+    path = _perf_env(monkeypatch, tmp_path)
+    before = obs.catalog.STEP_BREAKDOWN.series()
+    x, y = _batch()
+    t = parallel.DataParallelTrainer(
+        _make_net("perfoff_"), gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.1}, step_attribution=False)
+    t.step(x, y)
+    t.step(x, y)
+    assert t.perf_stats() == {}
+    assert obs.catalog.STEP_BREAKDOWN.series() == before
+    assert len(xcost.CostLedger(path).rows()) == 1
+
+
+def test_step_hlo_identical_with_perf_layer_on_off(tmp_path, monkeypatch):
+    """Acceptance: the perf layer is host-side only — the fused step
+    lowered with the full perf stack live (ledger capturing, attribution
+    publishing, real steps run) is bitwise identical StableHLO to a run
+    with everything off."""
+    import jax
+
+    def lowered_text(prefix, on):
+        if on:
+            _perf_env(monkeypatch, tmp_path)
+            monkeypatch.setenv("MXNET_TELEMETRY", "1")
+        else:
+            monkeypatch.setenv("MXNET_TELEMETRY", "0")
+            monkeypatch.delenv("MXNET_PERF_LEDGER", raising=False)
+        x, y = _batch()
+        t = parallel.DataParallelTrainer(
+            _make_net(prefix), gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+            {"learning_rate": 0.1},
+            step_attribution=None if on else False)
+        t.step(x, y)        # the perf stack actually runs on-path
+        t.step(x, y)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        spec = NamedSharding(t._mesh, P(t._axis))
+        ax = [jax.device_put(a, spec) for a in (x, y)]
+        rng = jax.random.PRNGKey(0)
+        return t._step_fn.lower(t._params, t._aux, t._opt_state,
+                                t._guard_state, rng, *ax).as_text()
+
+    on = lowered_text("hlop_", True)
+    off = lowered_text("hlop_", False)   # same prefix/seed => same names
+    assert on == off
+
+
+# ------------------------------------------------------- perfwatch (library)
+def test_perfwatch_compare_directions():
+    base = {"metrics": {"throughput": 100.0, "mfu": 0.2,
+                        "flops_per_step": 1e9}}
+    assert pw_mod.compare({"metrics": {"throughput": 95.0}},
+                          base)["status"] == "ok"
+    res = pw_mod.compare({"metrics": {"throughput": 89.9}}, base)
+    assert res["status"] == "regression"
+    [ch] = [c for c in res["checks"] if c["regressed"]]
+    assert ch["metric"] == "throughput"
+    # an improvement is never a regression, whatever its magnitude
+    assert pw_mod.compare({"metrics": {"throughput": 300.0,
+                                       "flops_per_step": 1e8}},
+                          base)["status"] == "ok"
+    # flops direction is inverted: a fatter step program regresses
+    assert pw_mod.compare({"metrics": {"flops_per_step": 1.2e9}},
+                          base)["status"] == "regression"
+    # nothing shared = incomparable, never a silent pass
+    assert pw_mod.compare({"metrics": {}}, base)["status"] == "incomparable"
+    # per-metric threshold override
+    assert pw_mod.compare({"metrics": {"mfu": 0.19}}, base,
+                          thresholds={"mfu": 2.0})["status"] == "regression"
+
+
+def test_perfwatch_normalize_artifacts(tmp_path):
+    bench_row = {"metric": "m", "value": 2468.3, "mfu": 0.154,
+                 "flops_per_step": 3.1e12, "unit": "img/s/chip"}
+    n = pw_mod.normalize(bench_row)
+    assert n["kind"] == "bench_row"
+    assert n["metrics"] == {"throughput": 2468.3, "mfu": 0.154,
+                            "flops_per_step": 3.1e12}
+    # BENCH_rNN wrapper
+    assert pw_mod.normalize({"parsed": bench_row})["kind"] == "bench_row"
+    # ledger JSONL: last parseable row wins
+    led = tmp_path / "l.jsonl"
+    led.write_text(json.dumps({"roofline": "memory-bound", "flops": 1e9})
+                   + "\n" +
+                   json.dumps({"roofline": "compute-bound", "flops": 2e9})
+                   + "\n")
+    norm, err = pw_mod.load_artifact(str(led))
+    assert err == "" and norm["kind"] == "ledger_row"
+    assert norm["metrics"]["flops_per_step"] == 2e9
+    # snapshot
+    snap = {"metrics": {"mxtpu_mfu": {"type": "gauge", "series": [
+        {"labels": {}, "value": 0.5}]}}}
+    assert pw_mod.normalize(snap)["metrics"] == {"mfu": 0.5}
+
+
+def test_perfwatch_live_hook_warns_and_counts():
+    w = pw_mod.PerfWatch(baseline={"mfu": 0.5}, check_every=2)
+    catalog.MFU.set(0.2)
+    c0 = catalog.PERF_REGRESSIONS.value(metric="mfu")
+    assert w.on_step(1) is None          # not on the cadence
+    res = w.on_step(2)
+    assert res["status"] == "regression" and res["step"] == 2
+    assert catalog.PERF_REGRESSIONS.value(metric="mfu") == c0 + 1
+    assert w.events and w.events[-1]["metric"] == "mfu"
+    catalog.MFU.set(0.55)
+    assert w.on_step(4)["status"] == "ok"
+    assert catalog.PERF_REGRESSIONS.value(metric="mfu") == c0 + 1
+
+
+def test_perfwatch_missing_baseline_disarms(tmp_path):
+    w = pw_mod.PerfWatch(baseline=str(tmp_path / "nope.json"))
+    assert w.baseline is None and w.baseline_error
+    assert w.on_step(100) is None and w.check() is None
+
+
+def test_resilient_trainer_perfwatch_hook(tmp_path):
+    """ResilientTrainer(perfwatch=...) checks the live gauges on its step
+    cadence and records the breach (warn-only: training continues)."""
+    x, y = _batch()
+    rt = ResilientTrainer(
+        _make_net("perfrt_"), gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.1}, directory=str(tmp_path / "run"),
+        preemption=False, retry=False,
+        perfwatch={"baseline": {"samples_per_sec": 1e15}, "check_every": 2})
+    for _ in range(4):
+        rt.step(x, y)
+    assert rt.perfwatch.last_result["status"] == "regression"
+    assert any(e["metric"] == "samples_per_sec" for e in rt.perfwatch.events)
+    assert rt.step_count == 4            # warn-only, the loop kept going
+    rt.close()

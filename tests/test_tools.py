@@ -290,3 +290,163 @@ def test_mxtop_cli_smoke(tmp_path):
                        capture_output=True, text=True, timeout=60)
     assert p.returncode == 2
     assert "cannot read" in p.stderr
+
+
+@pytest.mark.obs
+def test_perfwatch_cli_smoke(tmp_path):
+    """tools/perfwatch.py end-to-end: 0 at parity, 1 on a >=10% synthetic
+    throughput regression vs a cached baseline row, 2 on a missing
+    baseline — the mxlint exit convention."""
+    import json
+    pwcli = os.path.join(REPO, "tools", "perfwatch.py")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": ""}
+    baseline = tmp_path / "bench_cache.json"
+    baseline.write_text(json.dumps({
+        "metric": "resnet50_train_throughput_per_chip", "value": 2468.3,
+        "unit": "img/s/chip", "mfu": 0.1541,
+        "flops_per_step": 3.1488e12}))
+
+    parity = tmp_path / "parity.json"
+    parity.write_text(json.dumps({
+        "metric": "resnet50_train_throughput_per_chip", "value": 2470.0,
+        "mfu": 0.155}))
+    p = subprocess.run([sys.executable, pwcli, str(parity),
+                        "--baseline", str(baseline)],
+                       env=env, capture_output=True, text=True, timeout=120)
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "status: ok" in p.stdout
+
+    regressed = tmp_path / "reg.json"
+    regressed.write_text(json.dumps({
+        "metric": "resnet50_train_throughput_per_chip", "value": 2221.0}))
+    p = subprocess.run([sys.executable, pwcli, str(regressed),
+                        "--baseline", str(baseline)],
+                       env=env, capture_output=True, text=True, timeout=120)
+    assert p.returncode == 1, p.stdout + p.stderr
+    assert "REGRESSION" in p.stdout
+
+    # --format json round-trips the checks
+    p = subprocess.run([sys.executable, pwcli, str(regressed),
+                        "--baseline", str(baseline), "--format", "json"],
+                       env=env, capture_output=True, text=True, timeout=120)
+    assert p.returncode == 1
+    doc = json.loads(p.stdout)
+    assert doc["status"] == "regression"
+    assert any(c["metric"] == "throughput" and c["regressed"]
+               for c in doc["checks"])
+
+    # a tighter threshold flips a small delta into a regression
+    p = subprocess.run([sys.executable, pwcli, str(parity),
+                        "--baseline", str(baseline),
+                        "--metric-threshold", "mfu=0.01"],
+                       env=env, capture_output=True, text=True, timeout=120)
+    assert p.returncode == 0          # parity improved mfu: still ok
+
+    p = subprocess.run([sys.executable, pwcli, str(parity),
+                        "--baseline", str(tmp_path / "missing.json")],
+                       env=env, capture_output=True, text=True, timeout=120)
+    assert p.returncode == 2
+    assert "no usable baseline" in p.stderr
+
+
+@pytest.mark.obs
+def test_mxtop_perf_cli_smoke(tmp_path):
+    """mxtop.py perf: ledger + snapshot render, --format json, exit 2 when
+    nothing loads."""
+    import json
+    mxtop = os.path.join(REPO, "tools", "mxtop.py")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": ""}
+    ledger = tmp_path / "ledger.jsonl"
+    ledger.write_text(
+        json.dumps({"time": 1.0, "label": "DataParallelTrainer.step",
+                    "flops": 6877.0, "bytes_accessed": 27793.0,
+                    "arithmetic_intensity": 0.247,
+                    "roofline": "memory-bound", "fingerprint": "f" * 64})
+        + "\n{torn\n")
+    snap = tmp_path / "snap.json"
+    snap.write_text(json.dumps({"version": 1, "pid": 1, "metrics": {
+        "mxtpu_mfu": {"type": "gauge", "help": "", "series": [
+            {"labels": {}, "value": 0.21}]},
+        "mxtpu_device_util": {"type": "gauge", "help": "", "series": [
+            {"labels": {}, "value": 0.9}]},
+        "mxtpu_step_breakdown_ms": {"type": "gauge", "help": "", "series": [
+            {"labels": {"bucket": "dispatch"}, "value": 12.5},
+            {"labels": {"bucket": "feed_stall"}, "value": 2.0}]},
+    }}))
+    p = subprocess.run([sys.executable, mxtop, "perf", str(snap),
+                        "--ledger", str(ledger)],
+                       env=env, capture_output=True, text=True, timeout=60)
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "memory-bound" in p.stdout
+    assert "mxtpu_mfu" in p.stdout and "dispatch" in p.stdout
+    # ledger-only and snapshot-only both render
+    p = subprocess.run([sys.executable, mxtop, "perf", "--ledger",
+                        str(ledger)],
+                       env=env, capture_output=True, text=True, timeout=60)
+    assert p.returncode == 0 and "cost ledger" in p.stdout
+    p = subprocess.run([sys.executable, mxtop, "perf", str(snap),
+                        "--format", "json"],
+                       env=env, capture_output=True, text=True, timeout=60)
+    assert p.returncode == 0
+    assert json.loads(p.stdout)["kind"] == "perf"
+    # nothing loadable -> 2
+    p = subprocess.run([sys.executable, mxtop, "perf", "--ledger",
+                        str(tmp_path / "nope.jsonl")],
+                       env=env, capture_output=True, text=True, timeout=60)
+    assert p.returncode == 2
+    assert "nothing to show" in p.stderr
+
+
+def test_tunnel_session_register_own_kill(tmp_path, monkeypatch):
+    """The self-cleaning bench window's ownership model: a registered
+    tunnel client is recognized as ours and killable; the registry entry
+    is reaped with it (BENCH_r05's leftover-aot_warm failure mode)."""
+    import time as _time
+    monkeypatch.setenv("MXTPU_TUNNEL_REG_DIR", str(tmp_path / "reg"))
+    import tunnel_session
+    tools_dir = os.path.join(REPO, "tools")
+    # the -c source mentions aot_warm.py, so the child's cmdline carries
+    # the same marker bench.py scans /proc for
+    code = ("import sys, time; sys.path.insert(0, %r); "
+            "import tunnel_session; tunnel_session.register('aot_warm.py'); "
+            "time.sleep(120)" % tools_dir)
+    env = {**os.environ, "MXTPU_TUNNEL_REG_DIR": str(tmp_path / "reg"),
+           "PYTHONPATH": ""}
+    proc = subprocess.Popen([sys.executable, "-c", code], env=env)
+    try:
+        deadline = _time.time() + 20
+        while _time.time() < deadline:
+            if proc.pid in tunnel_session.owned_pids():
+                break
+            _time.sleep(0.2)
+        owned = tunnel_session.owned_pids()
+        assert proc.pid in owned
+        assert owned[proc.pid]["role"] == "aot_warm.py"
+        res = tunnel_session.kill(proc.pid, grace=5.0)
+        assert res in ("terminated", "killed")
+        proc.wait(timeout=10)           # reap the zombie
+        assert proc.pid not in tunnel_session.owned_pids()
+        assert not os.path.exists(
+            os.path.join(str(tmp_path / "reg"), "%d.json" % proc.pid))
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+
+def test_tunnel_session_stale_registration_reaped(tmp_path, monkeypatch):
+    """A registry file whose pid is dead (or recycled into a non-client) is
+    never reported owned — and gets cleaned up."""
+    import json
+    monkeypatch.setenv("MXTPU_TUNNEL_REG_DIR", str(tmp_path / "reg"))
+    import tunnel_session
+    os.makedirs(str(tmp_path / "reg"), exist_ok=True)
+    stale = os.path.join(str(tmp_path / "reg"), "999999.json")
+    with open(stale, "w") as f:
+        json.dump({"pid": 999999, "role": "aot_warm.py"}, f)
+    # our own pytest process: live, but not a tunnel client
+    own = os.path.join(str(tmp_path / "reg"), "%d.json" % os.getpid())
+    with open(own, "w") as f:
+        json.dump({"pid": os.getpid(), "role": "aot_warm.py"}, f)
+    assert tunnel_session.owned_pids() == {}
+    assert not os.path.exists(stale)         # dead pid: reaped

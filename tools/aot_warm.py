@@ -19,9 +19,19 @@ import time
 
 HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, HERE)
+sys.path.insert(1, os.path.join(HERE, "tools"))
 
 
 def main():
+    # register as a session-owned tunnel client BEFORE touching the
+    # backend: if this process leaks (killed terminal, lost ssh), the next
+    # bench preflight may kill it instead of skipping its live window
+    try:
+        import tunnel_session
+        # a warm run is one ~4-minute compile; alive past 30 min = wedged
+        tunnel_session.register("aot_warm.py", expected_s=1800)
+    except Exception as e:   # registration is a nicety, never a dependency
+        print("tunnel session registration failed: %s" % e, file=sys.stderr)
     import jax
     import numpy as np
     import mxnet_tpu as mx

@@ -11,6 +11,12 @@ terminal-friendly view:
   watchdog timeout / preemption / trainer crash): dump reason, anomaly
   stats, and the per-step record tail.
 
+plus a **perf view** (``mxtop.py perf``): XLA cost-ledger rows (FLOPs,
+bytes, arithmetic intensity, roofline class — ``observability/xcost.py``)
+side by side with the live perf gauges of a telemetry snapshot
+(``mxtpu_mfu``, ``mxtpu_device_util``, the ``mxtpu_step_breakdown_ms``
+buckets).
+
 Usage::
 
     python tools/mxtop.py /run/metrics.json            # one-shot render
@@ -18,10 +24,13 @@ Usage::
     python tools/mxtop.py mxtpu_flight_recorder.json   # crash forensics
     python tools/mxtop.py --format json snap.json      # normalized JSON out
     python tools/mxtop.py --tail 20 flight.json        # more records
+    python tools/mxtop.py perf --ledger mxtpu_cost_ledger.jsonl
+    python tools/mxtop.py perf /run/metrics.json --watch 2
 
 Exit codes (mxlint convention): 0 = healthy, 1 = the artifact shows
 anomalies (a crash-reason flight dump, grad-skip/verify-failure/watchdog/
-retry counters above zero), 2 = the artifact could not be loaded/parsed.
+retry counters above zero), 2 = the artifact could not be loaded/parsed
+(for ``perf``: neither a ledger nor a snapshot could be read).
 """
 import argparse
 import json
@@ -165,6 +174,121 @@ def render_flight(doc, out, tail: int) -> int:
     return 1 if reason else 0
 
 
+# -------------------------------------------------------------- perf view
+_PERF_GAUGES = ("mxtpu_mfu", "mxtpu_device_util",
+                "mxtpu_trainer_samples_per_sec")
+
+
+def load_ledger_rows(path):
+    """Parseable rows of a JSON-lines cost ledger, oldest first (corrupt
+    lines skipped — same contract as xcost.CostLedger.rows, reimplemented
+    here so mxtop never has to import the framework)."""
+    rows = []
+    with open(path) as f:
+        for ln in f:
+            ln = ln.strip()
+            if not ln:
+                continue
+            try:
+                row = json.loads(ln)
+            except ValueError:
+                continue
+            if isinstance(row, dict):
+                rows.append(row)
+    return rows
+
+
+def _fmt_eng(v, unit="") -> str:
+    if v is None:
+        return "n/a"
+    v = float(v)
+    for scale, suffix in ((1e12, "T"), (1e9, "G"), (1e6, "M"), (1e3, "k")):
+        if abs(v) >= scale:
+            return "%.2f%s%s" % (v / scale, suffix, unit)
+    return "%.3g%s" % (v, unit)
+
+
+def render_perf(ledger_rows, snap, out, tail: int) -> None:
+    out.write("mxtop — perf view\n")
+    if ledger_rows:
+        shown = ledger_rows[-tail:]
+        out.write("\ncost ledger (%d row(s), showing last %d)\n"
+                  % (len(ledger_rows), len(shown)))
+        out.write("%-19s %-28s %10s %10s %8s %-14s %10s\n"
+                  % ("time", "label", "flops", "bytes", "F/B",
+                     "roofline", "fprint"))
+        for r in shown:
+            t = r.get("time")
+            intensity = r.get("arithmetic_intensity")
+            out.write("%-19s %-28s %10s %10s %8s %-14s %10s\n" % (
+                time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(t))
+                if t else "n/a",
+                str(r.get("label", "?"))[:28],
+                _fmt_eng(r.get("flops")), _fmt_eng(r.get("bytes_accessed")),
+                "%.1f" % intensity if intensity is not None else "n/a",
+                r.get("roofline", "?"),
+                str(r.get("fingerprint") or "")[:10]))
+    if snap is not None:
+        fams = snap.get("metrics", {})
+
+        def series(name):
+            return (fams.get(name) or {}).get("series", [])
+
+        out.write("\nlive gauges (snapshot pid %s)\n" % snap.get("pid", "?"))
+        for name in _PERF_GAUGES:
+            for s in series(name):
+                if not s.get("labels"):
+                    out.write("  %-34s %s\n"
+                              % (name, _fmt_num(s.get("value"))))
+        breakdown = [(s.get("labels", {}).get("bucket", "?"),
+                      s.get("value", 0.0))
+                     for s in series("mxtpu_step_breakdown_ms")]
+        if breakdown:
+            total = sum(v for _, v in breakdown) or 1.0
+            out.write("  step breakdown (rolling mean ms):\n")
+            for bucket, v in sorted(breakdown, key=lambda kv: -kv[1]):
+                out.write("    %-16s %10s  %5.1f%%\n"
+                          % (bucket, _fmt_num(v), 100.0 * v / total))
+        for s in series("mxtpu_io_feed_stall_ms"):
+            cnt = s.get("count", 0)
+            if cnt:
+                out.write("  feed stalls: %d, mean %.2f ms, max %s ms\n"
+                          % (cnt, s.get("sum", 0.0) / cnt,
+                             _fmt_num(s.get("max"))))
+
+
+def run_perf_once(snap_path, ledger_path, tail: int, fmt: str, out) -> int:
+    ledger_rows, snap = None, None
+    errs = []
+    if ledger_path:
+        try:
+            ledger_rows = load_ledger_rows(ledger_path)
+        except OSError as e:
+            errs.append("ledger %s: %s" % (ledger_path, e))
+    if snap_path:
+        try:
+            doc = load(snap_path)
+            if kind_of(doc) != "metrics":
+                raise ValueError("not a metrics snapshot")
+            snap = doc
+        except (OSError, ValueError) as e:
+            errs.append("snapshot %s: %s" % (snap_path, e))
+    if ledger_rows is None and snap is None:
+        sys.stderr.write("mxtop perf: nothing to show (%s)\n"
+                         % ("; ".join(errs) or "pass a snapshot and/or "
+                            "--ledger"))
+        return 2
+    for e in errs:
+        sys.stderr.write("mxtop perf: %s\n" % e)
+    if fmt == "json":
+        out.write(json.dumps({"kind": "perf",
+                              "ledger": ledger_rows, "snapshot": snap},
+                             indent=1, sort_keys=True) + "\n")
+        return 0
+    render_perf(ledger_rows or [], snap, out, tail)
+    return 0
+
+
 def run_once(path: str, fmt: str, tail: int, out) -> int:
     try:
         doc = load(path)
@@ -184,9 +308,12 @@ def run_once(path: str, fmt: str, tail: int, out) -> int:
 
 
 def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if argv and argv[0] == "perf":
+        return _perf_main(argv[1:])
     ap = argparse.ArgumentParser(
         description="pretty-print mxnet_tpu telemetry snapshots and "
-                    "flight recordings")
+                    "flight recordings (see also: mxtop.py perf)")
     ap.add_argument("path", help="metrics snapshot JSON or flight-recorder "
                                  "dump JSON")
     ap.add_argument("--format", choices=("text", "json"), default="text")
@@ -198,16 +325,48 @@ def main(argv=None) -> int:
                          "render")
     args = ap.parse_args(argv)
     if args.watch > 0:
-        rc = 0
-        try:
-            while True:
-                sys.stdout.write("\x1b[2J\x1b[H")     # clear + home
-                rc = run_once(args.path, args.format, args.tail, sys.stdout)
-                sys.stdout.flush()
-                time.sleep(args.watch)
-        except KeyboardInterrupt:
-            return rc
+        return _watch_loop(lambda: run_once(args.path, args.format,
+                                            args.tail, sys.stdout),
+                           args.watch)
     return run_once(args.path, args.format, args.tail, sys.stdout)
+
+
+def _watch_loop(render, interval: float) -> int:
+    rc = 0
+    try:
+        while True:
+            sys.stdout.write("\x1b[2J\x1b[H")     # clear + home
+            rc = render()
+            sys.stdout.flush()
+            time.sleep(interval)
+    except KeyboardInterrupt:
+        return rc
+
+
+def _perf_main(argv) -> int:
+    ap = argparse.ArgumentParser(
+        prog="mxtop.py perf",
+        description="cost-ledger rows + live MFU/step-breakdown gauges")
+    ap.add_argument("snapshot", nargs="?", default=None,
+                    help="telemetry snapshot JSON (write_snapshot / "
+                         "MXNET_TELEMETRY_EXPORT output)")
+    ap.add_argument("--ledger", default=None,
+                    help="cost-ledger JSONL (MXNET_PERF_LEDGER / "
+                         "mxtpu_cost_ledger.jsonl)")
+    ap.add_argument("--tail", type=int, default=10,
+                    help="ledger rows to show (default 10)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--watch", type=float, metavar="SECONDS", default=0,
+                    help="re-render every N seconds; Ctrl-C to stop")
+    args = ap.parse_args(argv)
+    if not args.snapshot and not args.ledger:
+        ap.error("pass a snapshot and/or --ledger")
+    if args.watch > 0:
+        return _watch_loop(lambda: run_perf_once(
+            args.snapshot, args.ledger, args.tail, args.format, sys.stdout),
+            args.watch)
+    return run_perf_once(args.snapshot, args.ledger, args.tail, args.format,
+                         sys.stdout)
 
 
 if __name__ == "__main__":

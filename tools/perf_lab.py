@@ -20,9 +20,20 @@ import time
 
 HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, HERE)
+sys.path.insert(1, os.path.join(HERE, "tools"))
 
 
 def main():
+    # session-owned tunnel client registration: a leaked perf_lab no longer
+    # blocks later bench windows — the preflight kills it (tunnel_session)
+    try:
+        import tunnel_session
+        # a full ladder (several variants x minutes-long tunnel compiles +
+        # optional profile pass) can legitimately run for hours
+        tunnel_session.register("perf_lab.py", expected_s=3 * 3600)
+    except Exception as e:
+        print("# tunnel session registration failed: %s" % e,
+              file=sys.stderr)
     import jax
     try:
         jax.config.update("jax_compilation_cache_dir", "/tmp/mxtpu_jax_cache")

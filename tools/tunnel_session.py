@@ -1,0 +1,166 @@
+#!/usr/bin/env python
+"""Session registry for single-client tunnel tools.
+
+The axon tunnel is single-client: a leftover ``aot_warm.py``/``perf_lab.py``
+from an earlier session silently blocks every later client, and three
+consecutive bench windows died exactly that way (BENCH_r05: "foreign tunnel
+client(s) alive: aot_warm.py(pid ...); skipping live TPU attempt"). The fix
+is ownership: every tunnel tool registers its pid here at startup, so a
+later bench preflight can tell OUR leftovers (safe to kill — same session
+infrastructure, same operator) from genuinely foreign processes (never
+killed; the live attempt is skipped as before).
+
+Pure-stdlib, no jax import — ``bench.py``'s parent process (which must not
+touch any backend) imports this safely.
+
+Registry layout: one ``<pid>.json`` per client under ``REG_DIR``
+(``/tmp/mxtpu_tunnel_clients`` by default, ``MXTPU_TUNNEL_REG_DIR`` to
+override — tests point it at a tmp dir). Stale files are harmless: a pid is
+only considered owned while a LIVE process with a matching tunnel-client
+cmdline exists (pid recycling can never mark an innocent process ours).
+"""
+import atexit
+import json
+import os
+import signal
+import sys
+import time
+
+__all__ = ["MARKERS", "reg_dir", "register", "owned_pids", "kill"]
+
+# cmdline substrings that identify a tunnel-client python process — the
+# same marker list bench.py scans /proc for
+MARKERS = ("aot_warm.py", "perf_lab.py", "tpu_session")
+
+
+def reg_dir() -> str:
+    return os.environ.get("MXTPU_TUNNEL_REG_DIR",
+                          "/tmp/mxtpu_tunnel_clients")
+
+
+def _reg_path(pid: int) -> str:
+    return os.path.join(reg_dir(), "%d.json" % pid)
+
+
+def _cmdline(pid: int):
+    """The process's cmdline, '' for zombies, None when the pid is gone."""
+    try:
+        with open("/proc/%d/cmdline" % pid, "rb") as f:
+            return f.read().decode(errors="replace")
+    except OSError:
+        return None
+
+
+def _is_tunnel_client(cmd) -> bool:
+    return bool(cmd) and "python" in cmd and any(m in cmd for m in MARKERS)
+
+
+def register(role=None, expected_s=None) -> str:
+    """Record THIS process as a session-owned tunnel client (idempotent;
+    unregisters automatically on clean exit — a leftover file therefore
+    means a leftover process, which is exactly what the preflight kills).
+
+    ``expected_s`` declares how long this tool may LEGITIMATELY run; a
+    registered client older than that is a leftover/wedged process the
+    bench preflight may kill, while a younger one is an active run that
+    merely blocks the window (skip, never kill). An aot warm is minutes of
+    compile; a perf-lab ladder can be hours — each declares its own
+    budget instead of sharing one global threshold."""
+    d = reg_dir()
+    os.makedirs(d, exist_ok=True)
+    pid = os.getpid()
+    path = _reg_path(pid)
+    doc = {"pid": pid, "role": role or os.path.basename(sys.argv[0]),
+           "argv": list(sys.argv), "start": time.time()}
+    if expected_s is not None:
+        doc["expected_s"] = float(expected_s)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, path)
+
+    def _cleanup():
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+
+    atexit.register(_cleanup)
+    return path
+
+
+def owned_pids() -> dict:
+    """pid -> registry doc for every registered client that is STILL a live
+    tunnel-client process. Registry files whose pid is dead (or was recycled
+    into something that is not a tunnel client) are reaped, not returned."""
+    out = {}
+    d = reg_dir()
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return out
+    for name in names:
+        if not name.endswith(".json"):
+            continue
+        path = os.path.join(d, name)
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+            pid = int(doc.get("pid", 0))
+        except (ValueError, OSError, TypeError):
+            continue
+        if pid <= 0 or pid == os.getpid():
+            continue
+        cmd = _cmdline(pid)
+        if _is_tunnel_client(cmd):
+            out[pid] = doc
+        elif cmd is None or cmd == "":
+            # dead or zombie: the registration is stale — reap it
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+    return out
+
+
+def kill(pid: int, grace: float = 8.0) -> str:
+    """SIGTERM → wait up to ``grace`` seconds → SIGKILL. Returns
+    'gone' | 'terminated' | 'killed' | 'error: ...'. Cleans the registry
+    file once the process is down."""
+    def _down():
+        cmd = _cmdline(pid)
+        return cmd is None or cmd == ""
+
+    def _reap():
+        try:
+            os.unlink(_reg_path(pid))
+        except OSError:
+            pass
+
+    if _down():
+        _reap()
+        return "gone"
+    try:
+        os.kill(pid, signal.SIGTERM)
+    except ProcessLookupError:
+        _reap()
+        return "gone"
+    except OSError as e:
+        return "error: %s" % e
+    deadline = time.time() + grace
+    while time.time() < deadline:
+        if _down():
+            _reap()
+            return "terminated"
+        time.sleep(0.2)
+    try:
+        os.kill(pid, signal.SIGKILL)
+    except ProcessLookupError:
+        _reap()
+        return "terminated"
+    for _ in range(25):
+        if _down():
+            break
+        time.sleep(0.2)
+    _reap()
+    return "killed"
