@@ -290,6 +290,14 @@ def run_bench():
     else:
         flops_per_step, flops_source = flops_analytic, \
             "analytic_2flops_per_mac"
+    # source disagreement is an explicit row field, never a silent
+    # preference: a drifting analytic model (or an XLA count that stops
+    # covering part of the step) shows up in the row, and MFU readers can
+    # judge whether cross-round numbers are comparable
+    flops_disagreement_pct = None
+    if flops_xla is not None and flops_analytic:
+        flops_disagreement_pct = round(
+            (flops_xla - flops_analytic) / flops_analytic * 100.0, 1)
     peak = _peak_flops(device_kind) if on_accel else None
     if flops_per_step and peak:
         achieved = flops_per_step * (steps / dt)
@@ -301,9 +309,31 @@ def run_bench():
     out["flops_per_step_analytic"] = flops_analytic
     if flops_xla is not None:
         out["flops_per_step_xla"] = flops_xla
+    if flops_disagreement_pct is not None:
+        out["flops_source_disagreement_pct"] = flops_disagreement_pct
     if mfu is not None:
         out["mfu"] = round(mfu, 4)
         out["peak_flops_assumed"] = peak
+
+    # ---- tuner provenance: when the autotuner cache holds a best measured
+    # config for this device kind, stamp it into the row so BENCH_* history
+    # records which levers produced the number (and whether this window ran
+    # them). tools/mxtune.py writes the cache; missing/foreign = silent.
+    try:
+        from mxnet_tpu.tuner import best_cached
+        # model- AND topology-filtered: a cache row from another model
+        # (an mxtune --model tiny smoke) or another chip count must never
+        # masquerade as provenance for THIS window's configuration
+        tuned = best_cached(device_kind=device_kind, model="resnet50",
+                            n_devices=n_chips)
+    except Exception as e:
+        print("tuner cache lookup failed: %s" % e, file=sys.stderr)
+        tuned = None
+    if tuned is not None:
+        out["tuned_config"] = tuned.get("tuner_config")
+        if tuned.get("throughput_img_s_per_chip"):
+            out["tuned_img_s_per_chip"] = round(
+                float(tuned["throughput_img_s_per_chip"]), 1)
 
     # ---- cost-ledger row: the bench window is also a compile-time cost
     # capture — the same append-only ledger the trainer's perf layer and
@@ -423,7 +453,7 @@ def _foreign_tunnel_clients():
     concurrent client hangs behind them, so each must either be killed
     (session-owned leftovers, see ``_preflight_clear_tunnel``) or the live
     attempt skipped (genuinely foreign processes)."""
-    markers = ("aot_warm.py", "perf_lab.py", "tpu_session")
+    markers = ("aot_warm.py", "perf_lab.py", "mxtune.py", "tpu_session")
     found = []
     try:
         for pid in os.listdir("/proc"):

@@ -84,6 +84,7 @@ _lazy = {
     "resilience": ".resilience",
     "analysis": ".analysis",
     "observability": ".observability",
+    "tuner": ".tuner",
 }
 
 

@@ -34,11 +34,16 @@ Rules (catalog in docs/static_analysis.md):
 * MXL-T210 uninstrumented-hot-loop (warning) telemetry is enabled but the
                                           trainer's step-time attribution
                                           is switched off (perf blind spot)
+* MXL-T211 untuned-hot-loop     (warning) trainer runs all-default perf
+                                          levers while the tuner cache has
+                                          a differing measured best config
+                                          for the same model/device
 """
 from __future__ import annotations
 
 import ast
 import inspect
+import json
 import textwrap
 from typing import Any, Dict, Optional, Sequence, Tuple
 
@@ -109,6 +114,13 @@ register_rule(
     "mxtpu_device_util / mxtpu_mfu gauges, so a slowdown cannot be "
     "attributed to device compute vs host dispatch vs data-feed stall — "
     "exactly the blind spot that kept perf flat across bench rounds.")
+register_rule(
+    "MXL-T211", "warning", "untuned-hot-loop",
+    "The trainer runs with all-default perf levers while the autotuner "
+    "cache holds a measured best config for the same model/device "
+    "signature that differs from them: the run pays the default-config "
+    "step time although a faster, already-measured configuration is one "
+    "ctor kwarg away (tuner.best_cached / tools/mxtune.py).")
 
 _HOST_SYNC_METHODS = ("item", "asscalar", "asnumpy", "wait_to_read")
 _NP_NAMES = ("np", "numpy", "onp")
@@ -568,4 +580,52 @@ def lint_trainer(trainer, *data, suppress: Sequence[str] = (),
                  "0) — the bookkeeping is host-side only and never enters "
                  "the compiled step; or disable telemetry entirely if this "
                  "run truly must not measure itself"))
+
+    # ---- untuned hot loop (MXL-T211): a config check against the tuner's
+    # warm-start cache. Fires only when (a) the trainer runs all-DEFAULT
+    # perf levers (no remat, no compute_dtype override, default donation),
+    # (b) the cache holds a measured best config for the same model/device
+    # signature, and (c) that config actually differs — on a lever the
+    # trainer owns (remat/donate) or on the batch size the sample batch
+    # shows. A user already running the tuned config is never nagged.
+    all_default = (not getattr(trainer, "_remat", False)
+                   and trainer._compute_dtype is None
+                   and getattr(trainer, "_donate", True))
+    if all_default:
+        tuned = None
+        try:
+            from ..tuner import best_cached
+            dev = trainer._mesh.devices.ravel()[0]
+            # keyed by net_class (the built net's class name — the only
+            # model signature a live trainer can derive about itself; the
+            # tuner stamps it on every row next to the caller's label)
+            # and the trainer's own chip count: a config measured on a
+            # 32-chip slice is no recommendation for this mesh
+            tuned = best_cached(device_kind=dev.device_kind,
+                                net_class=type(trainer._net).__name__,
+                                n_devices=int(trainer._mesh.devices.size))
+        except Exception:
+            tuned = None
+        cfg = (tuned or {}).get("tuner_config") or {}
+        sample_batch = int(arrays[0].shape[0]) if (
+            arrays and getattr(arrays[0], "ndim", 0)) else None
+        differs = cfg and (
+            cfg.get("remat") is not None
+            or cfg.get("donate") is False
+            or (sample_batch is not None and cfg.get("batch") is not None
+                and int(cfg["batch"]) != sample_batch))
+        if differs:
+            tput = tuned.get("throughput_img_s_per_chip")
+            report.add(Diagnostic(
+                "MXL-T211",
+                "trainer runs all-default perf levers, but the tuner cache "
+                "holds a measured best config for %s on %s: %s%s"
+                % (type(trainer._net).__name__, tuned.get("device_kind"),
+                   json.dumps(cfg, sort_keys=True),
+                   " (%.1f img/s/chip measured)" % tput if tput else ""),
+                location=type(trainer).__name__,
+                hint="apply it (Candidate.from_dict(cfg).build_trainer(...)"
+                     " or the matching DataParallelTrainer kwargs/batch), "
+                     "or re-tune with tools/mxtune.py if the workload "
+                     "changed"))
     return report

@@ -178,6 +178,15 @@ PERF_REGRESSIONS = _m.counter(
     "mxtpu_perf_regressions_total",
     "Perf-watchdog checks that found a metric past its regression "
     "threshold vs the baseline, labeled metric=.")
+TUNER_TRIALS = _m.counter(
+    "mxtpu_tuner_trials_total",
+    "Autotuner trials scored, labeled provenance=predicted|measured|"
+    "cached (cached = warm-start ledger hit: nothing re-lowered or "
+    "re-run).")
+TUNER_BEST_MFU = _m.gauge(
+    "mxtpu_tuner_best_mfu",
+    "MFU of the best measured candidate from the most recent tuner "
+    "search (tuner.tune / tools/mxtune.py).")
 
 # -------------------------------------------------------------- callbacks
 SPEEDOMETER_SPS = _m.gauge(

@@ -106,8 +106,19 @@ def normalize(doc: Any, source: str = "") -> Optional[Dict[str, Any]]:
             vals["flops_per_step"] = float(doc["flops"])
         if doc.get("optimal_ms_compute") is not None:
             vals["step_ms"] = float(doc["optimal_ms_compute"])
+        # measured rows (bench windows, tuner trials) carry wall-clock
+        # facts next to the compile-time ones — those win over the
+        # optimal-roof step time and make the row a full baseline
+        # (throughput/mfu/step_ms), e.g. `mxtune --emit-best` output
+        if doc.get("measured_step_ms") is not None:
+            vals["step_ms"] = float(doc["measured_step_ms"])
+        if doc.get("throughput_img_s_per_chip") is not None:
+            vals["throughput"] = float(doc["throughput_img_s_per_chip"])
+        if doc.get("mfu") is not None:
+            vals["mfu"] = float(doc["mfu"])
         return {"kind": "ledger_row", "source": source, "metrics": vals,
-                "roofline": doc.get("roofline")}
+                "roofline": doc.get("roofline"),
+                "provenance": doc.get("provenance")}
     return None
 
 

@@ -821,6 +821,38 @@ class DataParallelTrainer:
             self._params, self._opt_state, self._guard_state, synced)
         return loss
 
+    def lower(self, *data):
+        """Capture (if needed) and lower the fused step for a batch spec
+        WITHOUT compiling or dispatching anything: the data arguments are
+        abstracted to shape/dtype structs, and a deferred-init net is
+        materialized with a batch-1 host forward only. This is the public
+        surface the tuner's predictor and the HLO audit use — cost
+        analysis, fingerprinting (``_lowered_digest``) — so external
+        modules don't each re-implement the step-state argument list.
+        Returns the ``jax.stages.Lowered``."""
+        arrays = [_unwrap(d) if isinstance(d, NDArray) else d
+                  for d in data]
+        if self._step_fn is not None and self._n_inputs != len(arrays):
+            # a diagnostics entry point must never silently re-capture a
+            # live trainer (params/opt-state reset, loaded AOT executable
+            # dropped) — same refusal as analysis.lint_trainer
+            raise MXNetError(
+                f"lower: batch has {len(arrays)} array(s) but the captured "
+                f"step takes {self._n_inputs}; pass a batch of the "
+                "training arity (lower never recaptures a live trainer)")
+        if self._step_fn is None:
+            # one-row slices are enough for deferred-init shape inference
+            # and avoid a full-batch host forward in a predict-only path
+            sample = [np.asarray(a[:1]) if getattr(a, "ndim", 0) else a
+                      for a in arrays]
+            self._capture(len(arrays), sample_arrays=sample)
+        specs = [jax.ShapeDtypeStruct(tuple(a.shape), np.dtype(a.dtype))
+                 for a in arrays]
+        rng = jax.random.PRNGKey(0)
+        return self._step_fn.lower(
+            self._params, self._aux, self._opt_state, self._guard_state,
+            rng, *specs)
+
     def sync_to_net(self) -> None:
         """Write the trained params/aux back into the gluon net (resharded
         onto each parameter's home device)."""

@@ -1,0 +1,126 @@
+"""Step-time predictor: roofline floor + an optional learned correction.
+
+The predictor the tuner ranks candidates with, **without running them**:
+
+1. the *roofline floor* — the candidate step's ``xla_cost_analysis``
+   FLOPs/bytes pushed through :func:`xcost.analyze_cost`, taking the
+   binding roof (``max(optimal_ms_compute, optimal_ms_memory)``). Exact
+   program facts, device peaks from the shared table or the
+   ``MXNET_PERF_PEAK_*`` overrides. A perfectly scheduled execution cannot
+   beat it, so ranking by it is sound even though absolute times are
+   optimistic.
+2. a *learned linear correction* fitted on whatever **measured** ledger
+   rows exist for this device — least squares from the roofline features
+   (the two roof times + a transcendental term + intercept) to measured
+   step ms, the cheap end of "A Learned Performance Model for TPUs"
+   (PAPERS.md): reuse the compiler's feature vector, learn only the
+   mapping to wall time. With fewer than two usable rows (or a degenerate
+   fit) it falls back to the raw roofline — documented, tested behavior,
+   never an error.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..base import logger
+
+__all__ = ["MIN_FIT_ROWS", "roofline_ms", "LinearCorrection",
+           "predict_step_ms"]
+
+# a line needs two points; below that the correction must stand aside
+MIN_FIT_ROWS = 2
+
+
+def roofline_ms(row: Dict[str, Any]) -> Optional[float]:
+    """Binding-roof step time of one analyzed cost row (ms), or None when
+    the device peaks are unknown (no table entry, no override)."""
+    roofs = [row.get("optimal_ms_compute"), row.get("optimal_ms_memory")]
+    roofs = [float(v) for v in roofs if v]
+    return max(roofs) if roofs else None
+
+
+def _features(row: Dict[str, Any]) -> Optional[List[float]]:
+    c = row.get("optimal_ms_compute")
+    m = row.get("optimal_ms_memory")
+    if not c and not m:
+        return None
+    # transcendental count in billions keeps the design matrix conditioned
+    trans = float(row.get("transcendentals") or 0.0) / 1e9
+    return [float(c or 0.0), float(m or 0.0), trans, 1.0]
+
+
+class LinearCorrection:
+    """Least-squares map from roofline features to measured step time.
+
+    ``fit`` returns True only when the model is usable; ``predict_ms``
+    always answers (falling back to the roofline floor), so callers never
+    need to branch on fit success.
+    """
+
+    def __init__(self):
+        self.coef: Optional[np.ndarray] = None
+        self.n_rows = 0
+
+    def fit(self, rows: Sequence[Dict[str, Any]]) -> bool:
+        """Fit on ledger rows that carry both features and a measured step
+        time. Returns False (and stays in fallback mode) with fewer than
+        :data:`MIN_FIT_ROWS` usable rows, or when the fit is degenerate
+        (non-finite coefficients / non-positive predictions on its own
+        training rows)."""
+        self.coef = None
+        X, y = [], []
+        for r in rows or ():
+            ms = r.get("measured_step_ms")
+            f = _features(r)
+            if ms and f:
+                X.append(f)
+                y.append(float(ms))
+        self.n_rows = len(y)
+        if self.n_rows < MIN_FIT_ROWS:
+            return False
+        X_a, y_a = np.asarray(X, np.float64), np.asarray(y, np.float64)
+        try:
+            coef, *_ = np.linalg.lstsq(X_a, y_a, rcond=None)
+        except np.linalg.LinAlgError:
+            return False
+        pred = X_a @ coef
+        if not np.all(np.isfinite(coef)) or np.any(pred <= 0):
+            logger.warning("tuner: learned correction degenerate on %d "
+                           "measured rows; using raw roofline", self.n_rows)
+            return False
+        self.coef = coef
+        return True
+
+    @property
+    def fitted(self) -> bool:
+        return self.coef is not None
+
+    def predict_ms(self, row: Dict[str, Any]) -> Optional[float]:
+        """Corrected step-time estimate for one analyzed cost row; the raw
+        roofline floor when unfitted or when the correction misbehaves on
+        this row (non-finite / below the physical floor's half — a learned
+        model must not claim to beat the hardware)."""
+        base = roofline_ms(row)
+        if self.coef is None:
+            return base
+        f = _features(row)
+        if f is None:
+            return base
+        est = float(np.asarray(f, np.float64) @ self.coef)
+        if not np.isfinite(est) or est <= 0:
+            return base
+        if base is not None and est < 0.5 * base:
+            return base
+        return est
+
+
+def predict_step_ms(row: Dict[str, Any],
+                    correction: Optional[LinearCorrection] = None
+                    ) -> Optional[float]:
+    """One-call prediction: learned correction when provided and fitted,
+    roofline floor otherwise."""
+    if correction is not None:
+        return correction.predict_ms(row)
+    return roofline_ms(row)

@@ -1,0 +1,254 @@
+"""Search-space declaration for the cost-model-guided autotuner.
+
+A :class:`Candidate` is one fully-specified training-step configuration —
+the five perf levers the staged bench ladders have been exercising by hand
+(ROADMAP item 1): global **batch** size, conv **layout** (NCHW/NHWC, plus
+the space-to-depth stem reparameterization), **remat** policy, buffer
+**donation** and device-feed **prefetch depth**. A :class:`SearchSpace` is
+the declared cross product the tuner enumerates; invalid combinations
+(s2d without NHWC) are skipped at enumeration, never at build time.
+
+Candidates are *data*: they serialize to/from plain dicts (the
+``tuner_config`` field of a cost-ledger trial row), produce a stable
+``key()`` for warm-start cache lookups, and apply themselves to a live
+:class:`~mxnet_tpu.parallel.DataParallelTrainer` via ``build_trainer`` /
+``trainer_kwargs`` — the round trip the acceptance test pins bitwise at
+the HLO level.
+"""
+from __future__ import annotations
+
+import itertools
+import json
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+from ..base import MXNetError
+
+__all__ = ["LAYOUTS", "REMAT_MODES", "Candidate", "SearchSpace"]
+
+LAYOUTS = ("NCHW", "NHWC")
+# the remat spellings DataParallelTrainer knows (None == "none" == off);
+# callables are deliberately out of the search space — they don't serialize
+REMAT_MODES = (None, "none", "full", "dots")
+
+
+def _norm_remat(remat) -> Optional[str]:
+    if remat in (None, "none"):
+        return None
+    if remat in ("full", "dots"):
+        return str(remat)
+    raise MXNetError(f"candidate remat must be one of {REMAT_MODES}, "
+                     f"got {remat!r}")
+
+
+class Candidate:
+    """One point of the search space. Immutable value object."""
+
+    __slots__ = ("batch", "layout", "s2d", "remat", "donate",
+                 "prefetch_depth")
+
+    def __init__(self, batch: int, layout: str = "NCHW", s2d: bool = False,
+                 remat=None, donate: bool = True, prefetch_depth: int = 2):
+        batch = int(batch)
+        if batch <= 0:
+            raise MXNetError(f"candidate batch must be positive, got {batch}")
+        if layout not in LAYOUTS:
+            raise MXNetError(f"candidate layout must be one of {LAYOUTS}, "
+                             f"got {layout!r}")
+        if s2d and layout != "NHWC":
+            raise MXNetError("the space-to-depth stem is an NHWC-only "
+                             "reparameterization (tests/test_s2d_stem.py)")
+        object.__setattr__(self, "batch", batch)
+        object.__setattr__(self, "layout", str(layout))
+        object.__setattr__(self, "s2d", bool(s2d))
+        object.__setattr__(self, "remat", _norm_remat(remat))
+        object.__setattr__(self, "donate", bool(donate))
+        object.__setattr__(self, "prefetch_depth", max(0, int(prefetch_depth)))
+
+    def __setattr__(self, *_):
+        raise AttributeError("Candidate is immutable")
+
+    # ------------------------------------------------------------- identity
+    @property
+    def label(self) -> str:
+        """Human-readable tag, perf_lab-style core (``NHWC:512``) plus any
+        non-default lever suffixes."""
+        tag = f"{self.layout}:{self.batch}"
+        if self.s2d:
+            tag += "+s2d"
+        if self.remat:
+            tag += f"+remat={self.remat}"
+        if not self.donate:
+            tag += "+nodonate"
+        if self.prefetch_depth != 2:
+            tag += f"+pf{self.prefetch_depth}"
+        return tag
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {"batch": self.batch, "layout": self.layout, "s2d": self.s2d,
+                "remat": self.remat, "donate": self.donate,
+                "prefetch_depth": self.prefetch_depth}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Candidate":
+        return cls(**{k: d[k] for k in ("batch", "layout", "s2d", "remat",
+                                        "donate", "prefetch_depth")
+                      if k in d})
+
+    def key(self, device_kind: Optional[str] = None, model: str = "",
+            n_devices: int = 1, compute_dtype=None,
+            optimizer=None, data_shapes=None, feed: bool = False) -> str:
+        """Stable warm-start cache key: the full config plus EVERYTHING
+        else that changes the executable or the wall clock it was measured
+        on — device kind, chip count, model signature, compute dtype,
+        optimizer and the sample batch's shape/dtype signature (the
+        ``data()`` callback controls image size/classes beyond
+        batch/layout). A hit must mean "this exact program on this exact
+        topology was scored before"; omitting any of these would let a
+        search silently reuse measurements of a program or hardware that
+        was never run."""
+        doc = dict(self.as_dict())
+        doc["device_kind"] = device_kind
+        doc["n_devices"] = int(n_devices)
+        doc["model"] = model or ""
+        doc["compute_dtype"] = str(compute_dtype) if compute_dtype else None
+        doc["optimizer"] = repr(optimizer) if optimizer else None
+        doc["data_shapes"] = data_shapes
+        # feed-measured wall clocks (prefetch pipeline) are not comparable
+        # to device-resident ones — they must never warm-start each other
+        doc["feed"] = bool(feed)
+        return json.dumps(doc, sort_keys=True)
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Candidate) and \
+            self.as_dict() == other.as_dict()
+
+    def __hash__(self) -> int:
+        return hash(tuple(sorted(self.as_dict().items())))
+
+    def __repr__(self) -> str:
+        return f"Candidate({self.label})"
+
+    # ------------------------------------------------------------ appliers
+    def data_shape(self, image: int = 224,
+                   channels: int = 3) -> Tuple[int, ...]:
+        """The input-batch shape this candidate trains on (conv nets)."""
+        if self.layout == "NHWC":
+            return (self.batch, image, image, channels)
+        return (self.batch, channels, image, image)
+
+    def trainer_kwargs(self) -> Dict[str, Any]:
+        """The DataParallelTrainer ctor levers this candidate carries.
+        ``batch``/``layout``/``s2d`` are data- and net-level choices (the
+        caller's ``build``/``data`` functions consume them); ``prefetch_depth``
+        is a feed-level knob (``io.prefetch_to_device(depth=...)``)."""
+        return {"remat": self.remat, "donate": self.donate}
+
+    def build_trainer(self, net, loss_fn, optimizer: str = "sgd",
+                      optimizer_params: Optional[Dict] = None, **extra):
+        """Apply this candidate to a trainer: the returned
+        ``DataParallelTrainer`` is EXACTLY the one a hand-written
+        ``DataParallelTrainer(net, loss, ..., remat=..., donate=...)`` would
+        build (bitwise-identical lowered HLO — the tuner acceptance test)."""
+        from ..parallel import DataParallelTrainer
+        kw = self.trainer_kwargs()
+        kw.update(extra)
+        return DataParallelTrainer(net, loss_fn, optimizer,
+                                   optimizer_params or {}, **kw)
+
+
+class SearchSpace:
+    """Declared cross product of lever values.
+
+    Dimension order is significant: :meth:`enumerate` varies the LAST
+    dimension fastest, so the first emitted candidate is the first value of
+    every dimension — the space's **baseline** the CLI measures improvement
+    against.
+    """
+
+    DIMS = ("batch", "layout", "s2d", "remat", "donate", "prefetch_depth")
+
+    def __init__(self, batch: Sequence[int] = (256, 512),
+                 layout: Sequence[str] = ("NCHW", "NHWC"),
+                 s2d: Sequence[bool] = (False,),
+                 remat: Sequence = (None,),
+                 donate: Sequence[bool] = (True,),
+                 prefetch_depth: Sequence[int] = (2,)):
+        def tup(v):
+            return tuple(v) if isinstance(v, (list, tuple)) else (v,)
+        self.batch = tup(batch)
+        self.layout = tup(layout)
+        self.s2d = tup(s2d)
+        self.remat = tup(remat)
+        self.donate = tup(donate)
+        self.prefetch_depth = tup(prefetch_depth)
+        for name in self.DIMS:
+            if not getattr(self, name):
+                raise MXNetError(f"search-space dimension {name!r} is empty")
+
+    def enumerate(self) -> List[Candidate]:
+        """Every valid candidate, baseline first. Invalid combinations
+        (s2d on a non-NHWC layout) are skipped, not errors — a space may
+        legitimately declare s2d=(False, True) next to both layouts."""
+        out: List[Candidate] = []
+        for vals in itertools.product(self.batch, self.layout, self.s2d,
+                                      self.remat, self.donate,
+                                      self.prefetch_depth):
+            b, lay, s2d, rm, don, pf = vals
+            if s2d and lay != "NHWC":
+                continue
+            out.append(Candidate(b, lay, s2d=s2d, remat=rm, donate=don,
+                                 prefetch_depth=pf))
+        if not out:
+            raise MXNetError("search space enumerates to zero valid "
+                             "candidates")
+        return out
+
+    def baseline(self) -> Candidate:
+        """First valid candidate — what a user who sets no levers runs."""
+        return self.enumerate()[0]
+
+    def __len__(self) -> int:
+        return len(self.enumerate())
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {k: list(getattr(self, k)) for k in self.DIMS}
+
+    def __repr__(self) -> str:
+        return f"SearchSpace({self.as_dict()})"
+
+    # --------------------------------------------------------------- parse
+    _ALIASES = {"prefetch": "prefetch_depth", "pf": "prefetch_depth"}
+
+    @classmethod
+    def from_spec(cls, spec: str) -> "SearchSpace":
+        """Parse the CLI spelling: ``dim=v1,v2;dim=v1`` — e.g.
+        ``batch=256,512;layout=NHWC;remat=none,full;donate=1,0``."""
+        kw: Dict[str, Any] = {}
+        for part in (spec or "").split(";"):
+            part = part.strip()
+            if not part:
+                continue
+            if "=" not in part:
+                raise MXNetError(f"bad search-space token {part!r} "
+                                 "(want dim=v1,v2)")
+            name, _, vals = part.partition("=")
+            name = cls._ALIASES.get(name.strip(), name.strip())
+            if name not in cls.DIMS:
+                raise MXNetError(f"unknown search-space dimension {name!r} "
+                                 f"(known: {', '.join(cls.DIMS)})")
+            parsed: List[Any] = []
+            for tok in vals.split(","):
+                tok = tok.strip()
+                if name == "batch" or name == "prefetch_depth":
+                    parsed.append(int(tok))
+                elif name in ("s2d", "donate"):
+                    parsed.append(tok.lower() in ("1", "true", "yes", "on"))
+                elif name == "remat":
+                    parsed.append(None if tok.lower() in ("none", "off", "")
+                                  else tok)
+                else:
+                    parsed.append(tok)
+            kw[name] = tuple(parsed)
+        if "batch" not in kw:
+            raise MXNetError("search space needs at least batch=...")
+        return cls(**kw)

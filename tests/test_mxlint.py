@@ -636,3 +636,83 @@ def test_lint_trainer_t210_env_default_and_suppression(rng, monkeypatch):
     r = analysis.lint_trainer(t, x, y, suppress=("MXL-T210",))
     assert not r.by_rule("MXL-T210")
     assert any(d.rule_id == "MXL-T210" for d in r.suppressed)
+
+
+# ------------------------------------------------------------- MXL-T211
+def _tuner_cache_row(kind, net_class="HybridSequential", batch=64,
+                     remat="full", n_devices=None):
+    from mxnet_tpu.tuner import Candidate
+    return {"label": "tuner.trial", "provenance": "measured",
+            "device_kind": kind, "model": "t211-model",
+            "net_class": net_class,
+            "n_devices": (jax.device_count() if n_devices is None
+                          else n_devices),
+            "measured_step_ms": 2.0,
+            "throughput_img_s_per_chip": 3100.0,
+            "tuner_config": Candidate(batch, "NCHW",
+                                      remat=remat).as_dict(),
+            "config_key": "t211"}
+
+
+def test_lint_trainer_t211_flags_untuned_defaults(rng, tmp_path,
+                                                  monkeypatch):
+    """All-default perf levers + a differing measured best config in the
+    tuner cache for the same model/device signature — MXL-T211."""
+    from mxnet_tpu.observability import xcost
+    cache = str(tmp_path / "tuner_cache.jsonl")
+    monkeypatch.setenv("MXNET_TUNER_CACHE", cache)
+    kind = jax.devices()[0].device_kind
+    xcost.CostLedger(cache).append(_tuner_cache_row(kind))
+    t, x, y = _lowprec_trainer(rng, "t211_")
+    r = analysis.lint_trainer(t, x, y)
+    hits = r.by_rule("MXL-T211")
+    assert len(hits) == 1, r.to_text()
+    assert hits[0].severity == "warning"
+    assert "tuner cache" in hits[0].message
+    assert "3100.0 img/s/chip" in hits[0].message
+    # the standard suppression channel silences it
+    r = analysis.lint_trainer(t, x, y, suppress=("MXL-T211",))
+    assert not r.by_rule("MXL-T211")
+    assert any(d.rule_id == "MXL-T211" for d in r.suppressed)
+
+
+def test_lint_trainer_t211_silent_cases(rng, tmp_path, monkeypatch):
+    """No cache entry, a foreign model/device signature, a non-differing
+    config, or a trainer that already applies a lever: all silent."""
+    from mxnet_tpu.observability import xcost
+    cache = str(tmp_path / "tuner_cache.jsonl")
+    monkeypatch.setenv("MXNET_TUNER_CACHE", cache)
+    kind = jax.devices()[0].device_kind
+
+    # empty cache
+    t, x, y = _lowprec_trainer(rng, "t211a_")
+    assert not analysis.lint_trainer(t, x, y).by_rule("MXL-T211")
+
+    # entry for another device kind
+    xcost.CostLedger(cache).append(_tuner_cache_row("TPU v99"))
+    assert not analysis.lint_trainer(t, x, y).by_rule("MXL-T211")
+
+    # entry for another net class (the mxtune-label field does NOT match:
+    # the rule keys on net_class, what a live trainer knows about itself)
+    xcost.CostLedger(cache).append(
+        _tuner_cache_row(kind, net_class="ResNetV1"))
+    assert not analysis.lint_trainer(t, x, y).by_rule("MXL-T211")
+
+    # entry measured on a different chip count of the same device kind
+    xcost.CostLedger(cache).append(
+        _tuner_cache_row(kind, n_devices=jax.device_count() + 24))
+    assert not analysis.lint_trainer(t, x, y).by_rule("MXL-T211")
+
+    # entry whose config does NOT differ (same batch, default levers)
+    cache2 = str(tmp_path / "tuner_cache2.jsonl")
+    monkeypatch.setenv("MXNET_TUNER_CACHE", cache2)
+    xcost.CostLedger(cache2).append(
+        _tuner_cache_row(kind, batch=16, remat=None))
+    assert not analysis.lint_trainer(t, x, y).by_rule("MXL-T211")
+
+    # trainer already running a tuned lever (remat on): not all-default
+    cache3 = str(tmp_path / "tuner_cache3.jsonl")
+    monkeypatch.setenv("MXNET_TUNER_CACHE", cache3)
+    xcost.CostLedger(cache3).append(_tuner_cache_row(kind))
+    t2, x2, y2 = _lowprec_trainer(rng, "t211b_", remat="full")
+    assert not analysis.lint_trainer(t2, x2, y2).by_rule("MXL-T211")

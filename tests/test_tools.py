@@ -397,6 +397,92 @@ def test_mxtop_perf_cli_smoke(tmp_path):
     assert "nothing to show" in p.stderr
 
 
+@pytest.mark.tuner
+def test_mxtune_cli_tunes_and_feeds_perfwatch(tmp_path):
+    """tools/mxtune.py end-to-end on the CPU backend: a 2-candidate space
+    where the big batch wins -> exit 0 (tuned), ranked report with
+    provenance, warm-start cache on disk — and the --emit-best row works
+    as a tools/perfwatch.py --baseline (the tuner->watchdog handoff)."""
+    import json
+    mxtune = os.path.join(REPO, "tools", "mxtune.py")
+    pwcli = os.path.join(REPO, "tools", "perfwatch.py")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": "",
+           "MXNET_PERF_PEAK_FLOPS": "1e12",
+           "MXNET_PERF_PEAK_HBM_GBPS": "1"}
+    cache = tmp_path / "trials.jsonl"
+    best = tmp_path / "best_row.json"
+    p = subprocess.run(
+        [sys.executable, mxtune, "--model", "tiny",
+         "--space", "batch=8,32;layout=NCHW", "--steps", "2",
+         "--warmup", "1", "--top-k", "1", "--cache", str(cache),
+         "--emit-best", str(best), "--format", "json"],
+        env=env, capture_output=True, text=True, timeout=420)
+    assert p.returncode == 0, p.stdout + p.stderr
+    doc = json.loads(p.stdout)
+    assert doc["improved"] is True
+    assert doc["best"]["candidate"]["batch"] == 32
+    assert doc["best"]["provenance"] == "measured"
+    assert {t["provenance"] for t in doc["trials"]} \
+        <= {"predicted", "measured", "cached"}
+    assert cache.exists() and best.exists()
+
+    # the tuner-produced measured ledger row is a usable perfwatch baseline
+    row = json.loads(best.read_text())
+    assert row["label"] == "tuner.trial" and row["measured_step_ms"] > 0
+    worse = tmp_path / "worse.json"
+    worse.write_text(json.dumps({
+        "metric": "resnet50_train_throughput_per_chip",
+        "value": row["throughput_img_s_per_chip"] * 0.5}))
+    p = subprocess.run([sys.executable, pwcli, str(worse),
+                        "--baseline", str(best)],
+                       env=env, capture_output=True, text=True, timeout=120)
+    assert p.returncode == 1, p.stdout + p.stderr
+    assert "REGRESSION" in p.stdout
+    parity = tmp_path / "parity.json"
+    parity.write_text(json.dumps({
+        "metric": "resnet50_train_throughput_per_chip",
+        "value": row["throughput_img_s_per_chip"] * 1.02}))
+    p = subprocess.run([sys.executable, pwcli, str(parity),
+                        "--baseline", str(best)],
+                       env=env, capture_output=True, text=True, timeout=120)
+    assert p.returncode == 0, p.stdout + p.stderr
+
+
+@pytest.mark.tuner
+def test_mxtune_cli_no_improvement_and_cannot_run(tmp_path):
+    """Exit 1 when the baseline IS the best known config (single-candidate
+    space); exit 2 on an unusable space/model — the mxlint convention."""
+    mxtune = os.path.join(REPO, "tools", "mxtune.py")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": "",
+           "MXNET_PERF_PEAK_FLOPS": "1e12",
+           "MXNET_PERF_PEAK_HBM_GBPS": "1"}
+    p = subprocess.run(
+        [sys.executable, mxtune, "--model", "tiny",
+         "--space", "batch=8;layout=NCHW", "--predict-only",
+         "--cache", str(tmp_path / "c1.jsonl"),
+         "--emit-best", str(tmp_path / "nope.json")],
+        env=env, capture_output=True, text=True, timeout=240)
+    assert p.returncode == 1, p.stdout + p.stderr
+    # a predicted-only row is refused as a perfwatch baseline: its
+    # optimal-roof step time would flag every healthy measured run
+    assert not (tmp_path / "nope.json").exists()
+    assert "--emit-best skipped" in p.stderr
+
+    p = subprocess.run(
+        [sys.executable, mxtune, "--model", "tiny",
+         "--space", "bogus=1", "--cache", str(tmp_path / "c2.jsonl")],
+        env=env, capture_output=True, text=True, timeout=240)
+    assert p.returncode == 2
+    assert "unknown search-space dimension" in p.stderr
+
+    p = subprocess.run(
+        [sys.executable, mxtune, "--model", "nope",
+         "--cache", str(tmp_path / "c3.jsonl")],
+        env=env, capture_output=True, text=True, timeout=240)
+    assert p.returncode == 2
+    assert "unknown --model" in p.stderr
+
+
 def test_tunnel_session_register_own_kill(tmp_path, monkeypatch):
     """The self-cleaning bench window's ownership model: a registered
     tunnel client is recognized as ours and killable; the registry entry

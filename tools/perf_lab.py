@@ -7,16 +7,20 @@ Runs a ladder of training-step variants in ONE process / ONE TPU client
 
     python tools/perf_lab.py                  # default ladder
     PERF_VARIANTS="NHWC:512,NHWC:1024" python tools/perf_lab.py
+    PERF_VARIANTS=seed python tools/perf_lab.py   # the staged seed ladder
 
 Also dumps the compiled HLO of the last variant to /tmp/perf_lab_hlo.txt
 and greps it for un-fused transposes/converts so BN/ReLU fusion claims are
 backed by the compiler's own output, not guesswork.
+
+This is a thin CLI: the trial machinery lives in ``mxnet_tpu/tuner/
+ladder.py`` (variants as data, build/measure/report functions) where the
+autotuner (``tools/mxtune.py``) shares it. Output lines are byte-for-byte
+the historical format, so BENCH_* provenance stays comparable.
 """
 import json
 import os
-import re
 import sys
-import time
 
 HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, HERE)
@@ -24,16 +28,13 @@ sys.path.insert(1, os.path.join(HERE, "tools"))
 
 
 def main():
+    from mxnet_tpu.tuner import ladder
+
     # session-owned tunnel client registration: a leaked perf_lab no longer
-    # blocks later bench windows — the preflight kills it (tunnel_session)
-    try:
-        import tunnel_session
-        # a full ladder (several variants x minutes-long tunnel compiles +
-        # optional profile pass) can legitimately run for hours
-        tunnel_session.register("perf_lab.py", expected_s=3 * 3600)
-    except Exception as e:
-        print("# tunnel session registration failed: %s" % e,
-              file=sys.stderr)
+    # blocks later bench windows — the preflight kills it (tunnel_session).
+    # a full ladder (several variants x minutes-long tunnel compiles +
+    # optional profile pass) can legitimately run for hours
+    ladder.register_session("perf_lab.py", expected_s=3 * 3600)
     import jax
     try:
         jax.config.update("jax_compilation_cache_dir", "/tmp/mxtpu_jax_cache")
@@ -41,347 +42,45 @@ def main():
     except Exception:
         pass
 
-    import numpy as np
-    import mxnet_tpu as mx
-    from mxnet_tpu import gluon, parallel
-    from mxnet_tpu.gluon.model_zoo import vision
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
     devices = jax.devices()
     on_accel = any(d.platform != "cpu" for d in devices)
     kind = devices[0].device_kind
     print(f"# devices: {len(devices)} x {kind}", file=sys.stderr, flush=True)
 
-    spec_env = os.environ.get(
-        "PERF_VARIANTS", "NCHW:256,NHWC:256,NHWC:512,NHWC:1024")
-    variants = []
-    for tok in spec_env.split(","):
-        layout, b = tok.strip().split(":")
-        variants.append((layout, int(b)))
+    spec_env = os.environ.get("PERF_VARIANTS", ladder.DEFAULT_VARIANTS)
+    if spec_env.strip().lower() == "seed":
+        spec_env = ladder.SEED_VARIANTS
+    variants = ladder.parse_variants(spec_env)
 
     steps = int(os.environ.get("PERF_STEPS", 30))
     warmup = int(os.environ.get("PERF_WARMUP", 5))
     image = int(os.environ.get("PERF_IMAGE", 224))
 
-    last = None
-    for layout, batch in variants:
-        t_var = time.perf_counter()
-        if layout == "IMP":
-            # imperative-dispatch lab (north-star config #3, SURVEY hard
-            # part #2): per-op dispatch rate + LSTM-PTB step time with the
-            # un-hybridized imperative path vs the hybridized one
-            try:
-                _imperative_lab(batch or 32)
-            except Exception as e:
-                print(json.dumps({"variant": f"IMP:{batch}",
-                                  "error": repr(e)[:300]}), flush=True)
-            continue
-        try:
-            np.random.seed(0)
-            mx.random.seed(0)
-            # variant tokens: "S2D" = NHWC + space-to-depth stem (exact
-            # 7x7/s2 reparameterization, tests/test_s2d_stem.py);
-            # "RMT" = NHWC + full forward rematerialization (the batch-512
-            # fit-without-spilling lever, VERDICT r4 next #1c)
-            s2d = layout == "S2D"
-            remat = "full" if layout == "RMT" else None
-            label = layout
-            if s2d or remat:
-                layout = "NHWC"
-            net = vision.resnet50_v1(classes=1000, layout=layout,
-                                     stem_s2d=s2d)
-            net.initialize(mx.init.Xavier())
-            loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
-            trainer = parallel.DataParallelTrainer(
-                net, loss_fn, "sgd",
-                {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4},
-                compute_dtype="bfloat16" if on_accel else None,
-                remat=remat)
-            shape = (batch, image, image, 3) if layout == "NHWC" \
-                else (batch, 3, image, image)
-            x = np.random.uniform(-1, 1, shape).astype("float32")
-            y = np.random.randint(0, 1000, (batch,)).astype("float32")
-            spec = NamedSharding(trainer.mesh, P("dp"))
-            t0 = time.perf_counter()
-            # bench-default variant: route the one compile through
-            # aot_save so the ladder run doubles as the driver bench's
-            # AOT warm (exactly one compile either way — step() then
-            # reuses the serialized executable)
-            warm_bench = (on_accel and layout == "NHWC" and batch == 256
-                          and image == 224)
-            # s2d gets its OWN blob: the two executables would otherwise
-            # evict each other and re-pay the multi-minute compile
-            blob_name = ("resnet50_step_s2d.pkl" if s2d
-                         else "resnet50_step.pkl")
-            aot_path = os.environ.get(
-                "BENCH_AOT", os.path.join(HERE, ".bench_aot", blob_name))
+    def emit(doc):
+        print(json.dumps(doc), flush=True)
 
-            def first_call():
-                if warm_bench:
-                    try:
-                        d = os.path.dirname(aot_path)
-                        if d:
-                            os.makedirs(d, exist_ok=True)
-                        if not trainer.aot_load(aot_path, x, y):
-                            trainer.aot_save(aot_path, x, y)
-                            print(f"# bench AOT blob refreshed -> "
-                                  f"{aot_path}", file=sys.stderr, flush=True)
-                    except Exception as e:   # warm is a nicety, not a dep
-                        print(f"# aot warm failed (jit fallback): "
-                              f"{repr(e)[:200]}", file=sys.stderr, flush=True)
-                return trainer.step(x, y)
+    def log(msg):
+        print(msg, file=sys.stderr, flush=True)
 
-            # the axon tunnel's remote_compile occasionally drops the
-            # connection mid-body; that is transient — retry, don't lose
-            # the whole variant (and the cache warm) to it
-            for attempt in range(3):
-                try:
-                    loss = first_call()
-                    float(loss)
-                    break
-                except Exception as e:
-                    if attempt == 2 or "remote_compile" not in repr(e):
-                        raise
-                    print(f"# transient compile failure, retrying: "
-                          f"{repr(e)[:120]}", file=sys.stderr, flush=True)
-                    time.sleep(5)
-            compile_s = time.perf_counter() - t0
-            xd = jax.device_put(x, spec)
-            yd = jax.device_put(y, spec)
-            for _ in range(warmup):
-                loss = trainer.step(xd, yd)
-            float(loss)
-            t0 = time.perf_counter()
-            for _ in range(steps):
-                loss = trainer.step(xd, yd)
-            float(loss)
-            dt = time.perf_counter() - t0
-            ips = steps * batch / dt
-            flops = 12.3e9 * (image / 224.0) ** 2 * batch * (steps / dt)
-            print(json.dumps({
-                "variant": f"{label}:{batch}", "img_s": round(ips, 1),
-                "step_ms": round(1e3 * dt / steps, 2),
-                "compile_s": round(compile_s, 1),
-                "analytic_tflops": round(flops / 1e12, 1),
-                "loss": float(loss),
-            }), flush=True)
-            last = (trainer, xd, yd, layout, batch)
-        except Exception as e:
-            print(json.dumps({"variant": f"{label}:{batch}",
-                              "error": repr(e)[:300]}), flush=True)
-        print(f"# variant took {time.perf_counter() - t_var:.0f}s total",
-              file=sys.stderr, flush=True)
-
+    _, last = ladder.run_ladder(variants, steps=steps, warmup=warmup,
+                                image=image, on_accel=on_accel,
+                                emit=emit, log=log)
     if last is None:
         return
     trainer, xd, yd, layout, batch = last
 
     # ---- on-chip profile: where does the step actually spend time? --------
     if os.environ.get("PERF_PROFILE", "0") == "1":
-        import glob
-        import gzip
-        import tempfile
-        from collections import Counter
-        tdir = tempfile.mkdtemp(prefix="perf_lab_trace_")
         try:
-            with jax.profiler.trace(tdir):
-                for _ in range(10):
-                    loss = trainer.step(xd, yd)
-                float(loss)
-            paths = glob.glob(os.path.join(
-                tdir, "plugins", "profile", "*", "*.trace.json.gz"))
-            agg = Counter()
-            total = 0.0
-            for pth in paths:
-                with gzip.open(pth, "rt") as f:
-                    data = json.load(f)
-                pids = {p.get("args", {}).get("name", ""): p.get("pid")
-                        for p in data.get("traceEvents", [])
-                        if p.get("ph") == "M" and p.get("name") ==
-                        "process_name"}
-                device_pids = {pid for nm, pid in pids.items()
-                               if "TPU" in str(nm) or "/device" in str(nm)}
-                for e in data.get("traceEvents", []):
-                    if (e.get("ph") == "X" and e.get("pid") in device_pids
-                            and isinstance(e.get("dur"), (int, float))):
-                        agg[e.get("name", "?")] += e["dur"]
-                        total += e["dur"]
-            top = [{"op": k[:80], "ms": round(v / 1e3, 2),
-                    "pct": round(100 * v / total, 1)}
-                   for k, v in agg.most_common(18)]
-            print(json.dumps({"profile_top_ops": top,
-                              "profile_total_ms": round(total / 1e3, 1),
-                              "trace_dir": tdir}), flush=True)
+            emit(ladder.profile_step(trainer, xd, yd))
         except Exception as e:
-            print(json.dumps({"profile_error": repr(e)[:300]}), flush=True)
+            emit({"profile_error": repr(e)[:300]})
+
+    # ---- fusion audit over the compiled HLO -------------------------------
     try:
-        lowered = trainer._step_fn.lower(
-            trainer._params, trainer._aux, trainer._opt_state,
-            trainer._guard_state, jax.random.PRNGKey(0), xd, yd)
-        txt = lowered.compile().as_text()
-        with open("/tmp/perf_lab_hlo.txt", "w") as f:
-            f.write(txt)
-        # fusion audit. A raw convert COUNT is misleading (r4 counted 950,
-        # but converts INSIDE fused computations ride an existing HBM pass
-        # for free) — what costs bandwidth is a convert that is its own
-        # top-level instruction in the ENTRY computation: a dedicated
-        # read+write of the tensor. Classify by computation and weigh the
-        # standalone ones by element count.
-        from collections import Counter
-        c = Counter()
-        entry_convert_elems = 0
-        entry_converts = 0
-        fused_converts = 0
-        cur_entry = False
-        for line in txt.splitlines():
-            if line and not line[0].isspace():
-                # a computation header (or closing brace) at column 0:
-                # "ENTRY %main... {" vs "%fused_computation.N (...) {"
-                if line.startswith("ENTRY"):
-                    cur_entry = True
-                elif line.startswith("%"):
-                    cur_entry = False
-                continue
-            mo = re.match(r"^\s+(?:ROOT )?%?\S+ = (\S+?)\[([\d,]*)\]\S* "
-                          r"(\w[\w\-]*)\(", line)
-            if not mo:
-                continue
-            dtype_shape, dims, op = mo.groups()
-            c[op] += 1
-            if op == "convert":
-                n = 1
-                for d in dims.split(","):
-                    if d:
-                        n *= int(d)
-                if cur_entry:
-                    entry_converts += 1
-                    entry_convert_elems += n
-                else:
-                    fused_converts += 1
-        audit = {k: c[k] for k in
-                 ("transpose", "convert", "convolution", "fusion",
-                  "custom-call", "all-reduce", "copy") if k in c}
-        audit["convert_standalone_entry"] = entry_converts
-        audit["convert_standalone_entry_melems"] = round(
-            entry_convert_elems / 1e6, 2)
-        audit["convert_inside_fusions"] = fused_converts
-        print(json.dumps({"hlo_audit": audit,
-                          "hlo_path": "/tmp/perf_lab_hlo.txt"}), flush=True)
+        emit(ladder.hlo_audit(trainer, xd, yd))
     except Exception as e:
-        print(json.dumps({"hlo_audit_error": repr(e)[:300]}), flush=True)
-
-
-
-def _imperative_lab(batch=32):
-    """Imperative-dispatch measurements (VERDICT r4 next #4).
-
-    The reference's risk case (SURVEY hard part #2,
-    src/imperative/imperative.cc:38-120): per-op Python dispatch on small
-    tensors, and the LSTM-PTB training step (north-star config #3) run
-    UN-hybridized — every op a separate cached-jit dispatch — vs
-    hybridized into one program. Prints one JSON line:
-
-        {"variant": "IMP:32", "elemwise_ops_per_s": ..., "chain10_ms": ...,
-         "ptb_imperative_ms": ..., "ptb_hybrid_ms": ..., "imp_vs_hybrid": ...}
-
-    Contract tracked by the ladder: imperative within 5x of hybrid at PTB
-    sizes (batch 32, bptt 35, 2x200 LSTM, vocab 10k).
-    """
-    import numpy as np
-    import mxnet_tpu as mx
-    from mxnet_tpu import autograd, gluon, nd
-
-    # ---- per-op dispatch rate on small tensors -----------------------
-    a = nd.array(np.random.randn(64, 64).astype("float32"))
-    b = nd.array(np.random.randn(64, 64).astype("float32"))
-    for _ in range(20):                      # warm the jitted-op caches
-        c = a + b
-    c.wait_to_read()
-    n = 2000
-    t0 = time.perf_counter()
-    for _ in range(n):
-        c = a + b
-    c.wait_to_read()
-    elemwise_rate = n / (time.perf_counter() - t0)
-
-    def chain(x):
-        for _ in range(10):                  # 10 distinct dispatches
-            x = nd.relu(x + 1.0) * 0.5
-        return x
-    chain(a).wait_to_read()
-    t0 = time.perf_counter()
-    reps = 100
-    for _ in range(reps):
-        out = chain(a)
-    out.wait_to_read()
-    chain10_ms = 1e3 * (time.perf_counter() - t0) / reps
-
-    # ---- LSTM-PTB step: imperative vs hybridized ----------------------
-    VOCAB, T, H, L = 10000, 35, 200, 2
-
-    class PTBModel(gluon.HybridBlock):
-        """Embedding -> 2x200 LSTM -> vocab decoder; states built inline
-        so the same block runs imperatively AND hybridized."""
-
-        def __init__(self, prefix):
-            super().__init__(prefix=prefix)
-            with self.name_scope():
-                self.emb = gluon.nn.Embedding(VOCAB, H)
-                self.lstm = gluon.rnn.LSTM(H, num_layers=L, layout="NTC")
-                self.dec = gluon.nn.Dense(VOCAB, flatten=False)
-
-        def hybrid_forward(self, F, x):
-            h = self.emb(x)
-            states = [F.zeros(shape=(L, batch, H)),
-                      F.zeros(shape=(L, batch, H))]
-            h = self.lstm(h, *states)
-            if isinstance(h, (list, tuple)):
-                h = h[0]
-            return self.dec(h)
-
-    def build(prefix):
-        net = PTBModel(prefix)
-        net.initialize(mx.init.Xavier())
-        return net
-
-    rng = np.random.RandomState(0)
-    x = nd.array(rng.randint(0, VOCAB, (batch, T)).astype("float32"))
-    y = nd.array(rng.randint(0, VOCAB, (batch, T)).astype("float32"))
-    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
-
-    def step_time(net, steps=8, warmup=3):
-        trainer = gluon.Trainer(net.collect_params(), "sgd",
-                                {"learning_rate": 0.1})
-        def one():
-            with autograd.record():
-                out = net(x)
-                l = loss_fn(out, y)
-            l.backward()
-            trainer.step(batch)
-            return l
-        for _ in range(warmup):
-            one().wait_to_read()
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            l = one()
-        l.wait_to_read()
-        return 1e3 * (time.perf_counter() - t0) / steps
-
-    imp_net = build("implab_")
-    imp_ms = step_time(imp_net)
-    hyb_net = build("hyblab_")
-    hyb_net(x).wait_to_read()     # materialize params imperatively first
-    hyb_net.hybridize()
-    hyb_ms = step_time(hyb_net)
-
-    print(json.dumps({
-        "variant": f"IMP:{batch}",
-        "elemwise_ops_per_s": round(elemwise_rate, 1),
-        "chain10_ms": round(chain10_ms, 3),
-        "ptb_imperative_ms": round(imp_ms, 2),
-        "ptb_hybrid_ms": round(hyb_ms, 2),
-        "imp_vs_hybrid": round(imp_ms / hyb_ms, 2) if hyb_ms else None,
-    }), flush=True)
+        emit({"hlo_audit_error": repr(e)[:300]})
 
 
 if __name__ == "__main__":
