@@ -168,12 +168,28 @@ def run_bench():
     # BENCH_S2D=1 enables the space-to-depth stem (exact 7x7/s2
     # reparameterization, tests/test_s2d_stem.py) — NHWC only
     s2d = os.environ.get("BENCH_S2D") == "1" and layout == "NHWC"
-    net = vision.resnet50_v1(classes=1000, layout=layout, stem_s2d=s2d)
+    # BENCH_PASSES=1 measures the graph-pass pipeline INSTEAD of the hand
+    # flags: the net is built plain NCHW (like `mxtune --route passes`)
+    # and the default pipeline applies layout/s2d as rewrites over the
+    # channel-last feed — never both hand flags AND passes, so the row's
+    # declared lever config always matches the measured program. Default
+    # OFF so bench rows (and the AOT blob digests) stay comparable with
+    # earlier rounds. Either way the emitted row stamps the provenance.
+    bench_passes = os.environ.get("BENCH_PASSES") == "1"
+    if bench_passes:
+        from mxnet_tpu.passes import PassManager
+        net = vision.resnet50_v1(classes=1000)
+        trainer_passes = PassManager(None, input_layout="NHWC")
+        layout, s2d = "NHWC", False   # the pipeline decides s2d; the
+        #                               passes provenance field records it
+    else:
+        net = vision.resnet50_v1(classes=1000, layout=layout, stem_s2d=s2d)
+        trainer_passes = False
     net.initialize(mx.init.Xavier())
     loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
     trainer = parallel.DataParallelTrainer(
         net, loss_fn, "sgd", {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4},
-        compute_dtype="bfloat16" if on_accel else None)
+        compute_dtype="bfloat16" if on_accel else None, passes=trainer_passes)
 
     shape = (batch, image, image, 3) if layout == "NHWC" \
         else (batch, 3, image, image)
@@ -194,7 +210,8 @@ def run_bench():
     aot_path = os.environ.get(
         "BENCH_AOT", os.path.join(
             HERE, ".bench_aot",
-            "resnet50_step_s2d.pkl" if s2d else "resnet50_step.pkl"))
+            "resnet50_step_passes.pkl" if bench_passes
+            else "resnet50_step_s2d.pkl" if s2d else "resnet50_step.pkl"))
     t_compile = time.perf_counter()
     loaded = False
     if on_accel:   # CPU-fallback compiles are fast; don't pollute the blob
@@ -243,6 +260,10 @@ def run_bench():
         "layout": layout + ("+s2d" if s2d else ""),
         "n_chips": n_chips, "device_kind": device_kind,
         "platform": devices[0].platform,
+        # graph-pass provenance: which rewrite passes (and rewrite counts)
+        # produced this step — perfwatch baselines must be attributable to
+        # their lever configuration, hand flags and passes alike
+        "passes": trainer.passes_provenance(),
     }
     if not on_accel:
         core["degraded"] = "cpu-only-backend"

@@ -85,6 +85,7 @@ _lazy = {
     "analysis": ".analysis",
     "observability": ".observability",
     "tuner": ".tuner",
+    "passes": ".passes",
 }
 
 

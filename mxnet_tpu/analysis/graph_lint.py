@@ -65,6 +65,12 @@ register_rule(
     "A saved graph contains nodes unreachable from any head — dead weight "
     "that inflates load time and usually indicates a truncated or "
     "mis-exported model.")
+register_rule(
+    "MXL-G107", "warning", "layout-propagation-missed",
+    "The graph contains NCHW 2-D convolutions and is being captured with "
+    "the layout pass disabled — each conv pays per-step relayouts the "
+    "automatic NCHW→NHWC propagation (mxnet_tpu.passes) removes; the "
+    "measured r4 win is one knob away.")
 
 
 def _parse_shape_attr(v: str) -> Optional[Tuple[int, ...]]:
@@ -101,10 +107,17 @@ def _is_f64(aval) -> bool:
 def lint_symbol(symbol, shapes: Optional[Dict[str, Sequence[int]]] = None,
                 dtypes: Optional[Dict[str, Any]] = None,
                 suppress: Sequence[str] = (),
-                subject: str = "") -> Report:
+                subject: str = "",
+                passes_applied: Optional[Sequence[str]] = None) -> Report:
     """Lint a Symbol graph. ``shapes``/``dtypes`` play the role of the
     bind-time feed dict: shapes the walker can't backfill from parameter
-    rules must come from here (exactly like ``simple_bind``'s kwargs)."""
+    rules must come from here (exactly like ``simple_bind``'s kwargs).
+
+    ``passes_applied`` names the graph-pass pipeline the caller runs over
+    this graph before binding (``()`` = passes explicitly off).  When the
+    caller declares a pipeline WITHOUT the layout pass and the graph holds
+    NCHW 2-D convolutions, MXL-G107 fires; ``None`` (unknown capture
+    context — e.g. a bare ``Symbol.lint``) keeps the rule silent."""
     from ..ops.registry import get_op
     from ..executor import _PARAM_SHAPE_RULES
     from .._imperative import _op_signature_flags
@@ -144,6 +157,25 @@ def lint_symbol(symbol, shapes: Optional[Dict[str, Sequence[int]]] = None,
     for (node, _idx) in symbol._outputs:
         if node.is_var:
             consumed_vars.add(node.name)
+
+    # pass-rewritten graphs interpose transposes between parameter vars
+    # and the ops whose rules derive their shapes; the single-walk
+    # backfill below can't see through them, so borrow the executor's
+    # fixpoint inference (transpose backward-backfill included) — only
+    # when such a chain exists, and never letting its failure mask the
+    # per-node findings this walk reports
+    if any(not n.is_var and n.op == "transpose" and n.inputs
+           and n.inputs[0][0].is_var
+           and n.inputs[0][0].name not in var_shape for n in nodes):
+        try:
+            from ..executor import _GraphLowering
+            inferred = _GraphLowering(symbol).infer_shapes(dict(var_shape))
+            for n in nodes:
+                if n.is_var and n.name not in var_shape \
+                        and isinstance(inferred.get(n.name), tuple):
+                    var_shape[n.name] = tuple(inferred[n.name])
+        except Exception:
+            pass
 
     entry_aval: Dict[Tuple[int, int], Any] = {}
     dead_vars = set()    # consumed vars whose shape never resolved
@@ -277,6 +309,26 @@ def lint_symbol(symbol, shapes: Optional[Dict[str, Sequence[int]]] = None,
                 "any node reachable from the outputs",
                 location=f"var:{name}",
                 hint="remove the stale binding or check the name for typos"))
+
+    # ---- layout propagation missed (MXL-G107): a capture-context check —
+    # only when the caller DECLARED its pipeline (passes_applied is not
+    # None) and that pipeline lacks the layout pass
+    if passes_applied is not None and "layout" not in tuple(passes_applied):
+        # the SAME predicate the layout pass uses for eligibility, so the
+        # rule can never warn about convs the pass wouldn't convert
+        from ..passes.layout import is_nchw_conv
+        nchw = [n for n in nodes if not n.is_var and is_nchw_conv(n)]
+        if nchw:
+            shown = ", ".join(n.name for n in nchw[:3]) \
+                + ("…" if len(nchw) > 3 else "")
+            report.add(Diagnostic(
+                "MXL-G107",
+                f"{len(nchw)} NCHW conv(s) captured with the layout pass "
+                f"disabled: {shown}",
+                location="graph",
+                hint="drop passes=False (or add 'layout' to MXNET_PASSES) "
+                     "so the automatic NCHW→NHWC propagation converts "
+                     "them, or build the net with layout='NHWC'"))
     return report
 
 

@@ -535,6 +535,23 @@ def lint_trainer(trainer, *data, suppress: Sequence[str] = (),
                        suppress=suppress,
                        subject=subject or "DataParallelTrainer fused step")
 
+    # ---- layout propagation missed (MXL-G107): the trainer counted the
+    # captured graph's NCHW convs at capture time — if any exist and the
+    # pipeline it ran lacks the layout pass, the measured NHWC win was
+    # left on the table (a graph-rule finding surfaced through the trace
+    # front end because the capture context lives on the trainer)
+    pinfo = getattr(trainer, "_pass_info", None) or {}
+    if pinfo.get("nchw_convs") and not pinfo.get("layout_enabled"):
+        report.add(Diagnostic(
+            "MXL-G107",
+            "%d NCHW conv(s) captured with the layout pass disabled — "
+            "each pays per-step relayouts the automatic NCHW→NHWC "
+            "propagation removes" % pinfo["nchw_convs"],
+            location=type(trainer).__name__,
+            hint="drop passes=False (or add 'layout' to MXNET_PASSES); "
+                 "re-homed weights are handled transparently by the "
+                 "capture path"))
+
     # ---- unscaled low-precision loss (MXL-T209): read off the trainer's
     # own config, not the trace — the hazard is the ABSENCE of scaler state
     cdtype = trainer._compute_dtype

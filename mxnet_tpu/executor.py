@@ -173,63 +173,131 @@ class _GraphLowering:
 
         return fn
 
+    @staticmethod
+    def _backfill_through_transposes(entry, shape, shapes) -> None:
+        """Propagate a rule-derived parameter shape BACKWARD through a
+        chain of transpose nodes onto the underlying variable — the graph
+        passes (mxnet_tpu.passes) wrap conv weights in layout transposes,
+        and ``simple_bind`` must still infer the var's shape."""
+        src, _ = entry
+        perms = []
+        while (not src.is_var and src.op == "transpose" and src.inputs):
+            axes = (src.attrs or {}).get("axes")
+            if not axes:
+                return
+            perms.append(tuple(int(a) for a in axes))
+            src, _ = src.inputs[0]
+        if not src.is_var or src.name in shapes:
+            return
+        for perm in perms:          # outermost transpose first
+            if len(perm) != len(shape):
+                return
+            inv = [0] * len(perm)
+            for i, p in enumerate(perm):
+                inv[p] = i
+            shape = tuple(shape[i] for i in inv)
+        shapes[src.name] = tuple(shape)
+
     def infer_shapes(self, known: Dict[str, Tuple[int, ...]]):
         """Forward shape inference with parameter-shape backfill."""
         shapes: Dict[str, Tuple[int, ...]] = dict(known)
         dtypes: Dict[str, Any] = {}
         entry_aval: Dict[Tuple[int, int], jax.ShapeDtypeStruct] = {}
-        for node in self.nodes:
-            if node.is_var:
+        # Fixpoint sweeps: a pass-rewritten graph may interpose transposes
+        # between a parameter variable and the op whose rule derives its
+        # shape, and topo order visits the transpose BEFORE the rule-owning
+        # op — so a node with still-unknown inputs defers to the next sweep
+        # (each sweep unlocks at least one more rule-gated stage).  A
+        # pristine graph resolves fully in sweep one; when a sweep makes no
+        # progress the strict pass below names the first genuinely
+        # unresolvable variable.
+        op_nodes = [n for n in self.nodes if not n.is_var]
+        for _ in range(len(op_nodes) + 1):
+            progress = False
+            for node in op_nodes:
+                if (id(node), 0) in entry_aval:
+                    continue
+                opdef = get_op(node.op)
+                arg_names = opdef.arg_names() or []
+                rule = _PARAM_SHAPE_RULES.get(node.op)
+                if rule is not None and node.inputs:
+                    src0, idx0 = node.inputs[0]
+                    ds = (shapes.get(src0.name) if src0.is_var
+                          else (tuple(entry_aval[(id(src0), idx0)].shape)
+                                if (id(src0), idx0) in entry_aval else None))
+                    if ds is not None:
+                        try:
+                            param_shapes = rule(dict(node.attrs), tuple(ds))
+                        except KeyError:
+                            param_shapes = {}
+                        for i, (src, _) in enumerate(node.inputs):
+                            if i < len(arg_names) \
+                                    and arg_names[i] in param_shapes:
+                                if src.is_var and src.name not in shapes:
+                                    shapes[src.name] = \
+                                        param_shapes[arg_names[i]]
+                                    progress = True
+                                elif not src.is_var:
+                                    before = len(shapes)
+                                    self._backfill_through_transposes(
+                                        node.inputs[i],
+                                        tuple(param_shapes[arg_names[i]]),
+                                        shapes)
+                                    progress |= len(shapes) != before
+                # build avals for this node's inputs
+                in_avals = []
+                defer = False
+                for (src, idx) in node.inputs:
+                    if src.is_var:
+                        if src.name not in shapes:
+                            defer = True
+                            break
+                        dt = dtypes.get(src.name, jnp.float32)
+                        in_avals.append(
+                            jax.ShapeDtypeStruct(shapes[src.name], dt))
+                    else:
+                        av = entry_aval.get((id(src), idx))
+                        if av is None:
+                            defer = True
+                            break
+                        in_avals.append(av)
+                if defer:
+                    continue
+                attrs = dict(node.attrs)
+                accepts_train, accepts_rng = _op_signature_flags(opdef)
+                if accepts_train and "is_train" not in attrs:
+                    attrs["is_train"] = True
+
+                def run(*arrs):
+                    kw = dict(attrs)
+                    if accepts_rng:
+                        kw["rng"] = jax.random.PRNGKey(0)
+                    return opdef.fn(*arrs, **kw)
+
+                try:
+                    out_avals = jax.eval_shape(run, *in_avals)
+                except Exception as e:
+                    raise MXNetError(f"shape inference failed at op "
+                                     f"{node.op} ({node.name}): {e}") from e
+                if not isinstance(out_avals, tuple):
+                    out_avals = (out_avals,)
+                for i, av in enumerate(out_avals):
+                    entry_aval[(id(node), i)] = av
+                progress = True
+            if not progress:
+                break
+        # strict pass: name the first unresolved variable/producer
+        for node in op_nodes:
+            if (id(node), 0) in entry_aval:
                 continue
-            opdef = get_op(node.op)
-            arg_names = opdef.arg_names() or []
-            rule = _PARAM_SHAPE_RULES.get(node.op)
-            if rule is not None and node.inputs:
-                src0, idx0 = node.inputs[0]
-                ds = (shapes.get(src0.name) if src0.is_var
-                      else tuple(entry_aval[(id(src0), idx0)].shape))
-                if ds is not None:
-                    try:
-                        param_shapes = rule(dict(node.attrs), tuple(ds))
-                    except KeyError:
-                        param_shapes = {}
-                    for i, (src, _) in enumerate(node.inputs):
-                        if src.is_var and src.name not in shapes and i < len(arg_names):
-                            pname = arg_names[i]
-                            if pname in param_shapes:
-                                shapes[src.name] = param_shapes[pname]
-            # build avals for this node's inputs
-            in_avals = []
-            for (src, idx) in node.inputs:
-                if src.is_var:
-                    if src.name not in shapes:
-                        raise MXNetError(
-                            f"shape of variable {src.name!r} cannot be inferred; "
-                            f"provide it to infer_shape/simple_bind")
-                    dt = dtypes.get(src.name, jnp.float32)
-                    in_avals.append(jax.ShapeDtypeStruct(shapes[src.name], dt))
-                else:
-                    in_avals.append(entry_aval[(id(src), idx)])
-            attrs = dict(node.attrs)
-            accepts_train, accepts_rng = _op_signature_flags(opdef)
-            if accepts_train and "is_train" not in attrs:
-                attrs["is_train"] = True
-
-            def run(*arrs):
-                kw = dict(attrs)
-                if accepts_rng:
-                    kw["rng"] = jax.random.PRNGKey(0)
-                return opdef.fn(*arrs, **kw)
-
-            try:
-                out_avals = jax.eval_shape(run, *in_avals)
-            except Exception as e:
-                raise MXNetError(f"shape inference failed at op {node.op} "
-                                 f"({node.name}): {e}") from e
-            if not isinstance(out_avals, tuple):
-                out_avals = (out_avals,)
-            for i, av in enumerate(out_avals):
-                entry_aval[(id(node), i)] = av
+            for (src, _idx) in node.inputs:
+                if src.is_var and src.name not in shapes:
+                    raise MXNetError(
+                        f"shape of variable {src.name!r} cannot be "
+                        f"inferred; provide it to infer_shape/simple_bind")
+            raise MXNetError(
+                f"shape inference failed at op {node.op} ({node.name}): "
+                f"inputs unresolved")
         out_shapes = []
         for (node, idx) in self.symbol._outputs:
             if node.is_var:
@@ -361,11 +429,13 @@ class Executor:
     def set_monitor_callback(self, callback, monitor_all=False):
         self.monitor_callback = callback
 
-    def lint(self, suppress=()):
+    def lint(self, suppress=(), passes_applied=None):
         """Static-analyze the bound graph (mxlint graph front end) with the
         exact shapes/dtypes of the bound arrays — what NNVM's validation
         passes would check before InitCachedOps. Returns an
-        ``analysis.Report``."""
+        ``analysis.Report``.  ``passes_applied`` names the graph-pass
+        pipeline that produced this graph (Module.lint supplies it) so
+        MXL-G107 can flag NCHW convs bound with the layout pass off."""
         from .analysis import lint_symbol
         shapes = {n: tuple(a.shape) for n, a in self.arg_dict.items()}
         shapes.update({n: tuple(a.shape) for n, a in self.aux_dict.items()})
@@ -373,6 +443,7 @@ class Executor:
         dtypes.update({n: a.dtype for n, a in self.aux_dict.items()})
         return lint_symbol(self._symbol, shapes=shapes, dtypes=dtypes,
                            suppress=suppress,
+                           passes_applied=passes_applied,
                            subject=f"executor over {self._symbol.name!r}")
 
     # ------------------------------------------------------------- forward

@@ -195,7 +195,14 @@ class ResNetV1(HybridBlock):
                 self.features.add(_conv3x3(channels[0], 1, 0, layout))
             else:
                 if stem_s2d:
-                    self.features.add(SpaceToDepthStem(channels[0]))
+                    # prefix="" so the stem conv keeps the plain stem's
+                    # parameter name (resnetvXY_conv0_weight): the s2d net
+                    # differs from its NCHW/NHWC twins only in that
+                    # parameter's SHAPE, which keeps param orderings (and
+                    # thus lowered-HLO argument order) identical across
+                    # the hand-flag and graph-pass routes
+                    self.features.add(SpaceToDepthStem(channels[0],
+                                                       prefix=""))
                 else:
                     self.features.add(nn.Conv2D(channels[0], 7, 2, 3,
                                                 use_bias=False,

@@ -26,8 +26,18 @@ class Module(BaseModule):
     def __init__(self, symbol, data_names=("data",), label_names=("softmax_label",),
                  logger=logging, context=None, work_load_list=None,
                  fixed_param_names=None, state_names=None, group2ctxs=None,
-                 compression_params=None):
+                 compression_params=None, passes=None):
         super().__init__(logger)
+        # graph-pass pipeline (mxnet_tpu.passes) run over the symbol at
+        # bind time: None = MXNET_PASSES default, False = off.  The module
+        # keeps the ORIGINAL symbol for checkpoints/shape queries; only
+        # the executor group binds the rewritten graph.  Variable
+        # re-homing is disabled on this path (arg arrays, set_params and
+        # load_checkpoint all key on the original shapes), so layout
+        # rewrites materialize as in-graph transposes XLA folds away.
+        from ..passes import resolve as _resolve_passes
+        self._passes = _resolve_passes(passes)
+        self._pass_result = None
         if context is None:
             context = [current_context()]
         if isinstance(context, Context):
@@ -118,8 +128,9 @@ class Module(BaseModule):
         else:
             self._label_shapes = []
         shared_group = shared_module._exec_group if shared_module else None
+        bind_symbol = self._run_passes()
         self._exec_group = DataParallelExecutorGroup(
-            self._symbol, self._context, None, self._data_shapes,
+            bind_symbol, self._context, None, self._data_shapes,
             self._label_shapes, self._param_names, for_training,
             inputs_need_grad, shared_group=shared_group,
             fixed_param_names=self._fixed_param_names, grad_req=grad_req,
@@ -127,12 +138,44 @@ class Module(BaseModule):
         self.binded = True
         self.for_training = for_training
 
+    def _run_passes(self):
+        """The symbol the executor group binds: the pass pipeline's
+        rewrite of ``self._symbol`` (or the original when passes are off /
+        rewrote nothing).  Never raises — a pipeline failure degrades to
+        the unrewritten graph with a warning."""
+        self._pass_result = None
+        if self._passes is None:
+            return self._symbol
+        shapes = {d.name: tuple(d.shape) for d in self._data_shapes}
+        shapes.update({l.name: tuple(l.shape)
+                       for l in (self._label_shapes or [])})
+        try:
+            res = self._passes.run(self._symbol, shapes=shapes,
+                                   input_vars=tuple(shapes),
+                                   rehome_params=False)
+        except Exception as e:
+            self.logger.warning("graph-pass pipeline failed; binding the "
+                                "unrewritten graph: %r", e)
+            return self._symbol
+        if res.total_rewrites == 0:
+            return self._symbol
+        self._pass_result = res
+        return res.symbol
+
+    def passes_provenance(self):
+        """Pipeline names + rewrite counts (bench/row attribution; one
+        schema with DataParallelTrainer: passes.manager.provenance)."""
+        from ..passes import provenance
+        return provenance(self._passes, self._pass_result)
+
     def lint(self, suppress=()):
         """Static-analyze the bound graph with this module's data/label
         shapes (mxlint graph front end). Call after ``bind``; returns an
         ``analysis.Report`` — ``report.assert_clean()`` in tests."""
         assert self.binded, "lint requires a bound module"
-        return self._exec_group.execs[0].lint(suppress=suppress)
+        applied = (self._passes.names if self._passes is not None else ())
+        return self._exec_group.execs[0].lint(suppress=suppress,
+                                              passes_applied=applied)
 
     # ------------------------------------------------------------- params
     def init_params(self, initializer=None, arg_params=None, aux_params=None,

@@ -139,7 +139,8 @@ def alias(existing: str, *names: str) -> None:
 #: modules outside ``ops/`` that register operators on import; tried once on
 #: a registry miss so symbolic graphs referencing them resolve without the
 #: user importing the submodule (the reference registers everything at load).
-_LAZY_PROVIDERS = ["mxnet_tpu.contrib.quantization", "mxnet_tpu.operator"]
+_LAZY_PROVIDERS = ["mxnet_tpu.contrib.quantization", "mxnet_tpu.operator",
+                   "mxnet_tpu.passes.fold"]
 
 
 def get_op(name: str) -> OpDef:

@@ -31,6 +31,7 @@ from ..observability import catalog as _telemetry
 from ..observability import flight_recorder as _flight
 from ..observability import metrics as _metrics
 from ..observability import xcost as _xcost
+from ..passes import manager as _passes
 from ..resilience import recovery as _recovery
 from .mesh import local_mesh
 
@@ -204,9 +205,23 @@ class DataParallelTrainer:
                  mesh: Optional[Mesh] = None, data_axis: str = "dp",
                  compute_dtype=None, donate: bool = True, kvstore=None,
                  remat=None, grad_guard=None, loss_scaling=None,
-                 dynamic_lr_scale: bool = False, step_attribution=None):
+                 dynamic_lr_scale: bool = False, step_attribution=None,
+                 passes=None):
         self._net = net
         self._loss_block = loss
+        # graph-pass pipeline run over the captured symbol graph BEFORE
+        # lowering (mxnet_tpu.passes): the measured perf levers — NHWC
+        # layout propagation, space-to-depth stem, constant folding,
+        # fusion-friendly reordering — as automatic defaults.  None =
+        # MXNET_PASSES-configured default pipeline; False = off (the
+        # captured graph is bitwise what it was before this framework
+        # existed); a PassManager / spec string = custom.  Re-homed
+        # parameter layouts are handled transparently: the trainer applies
+        # the recorded value transforms at capture and inverts them in
+        # sync_to_net, so the gluon net keeps its original layout.
+        self._passes = _passes.resolve(passes)
+        self._pass_result = None
+        self._pass_info: Dict[str, Any] = {}
         if mesh is None and kvstore is not None:
             # hybrid mode: the jitted step spans only THIS process's devices
             # (the kvstore is the cross-process channel), so the mesh must
@@ -309,6 +324,66 @@ class DataParallelTrainer:
         self._flops_per_step = None
         self._cost_rows: Dict[Tuple, Any] = {}
 
+    # ------------------------------------------------------------- passes
+    def _run_passes(self, loss_sym, data_syms, init_arrays):
+        """Run the configured graph-pass pipeline over the captured loss
+        graph (mxnet_tpu.passes).  Input shapes come from the init-view
+        sample batch (the NET's layout); parameter shapes from the
+        materialized gluon params.  A pipeline failure never kills a
+        capture — the unrewritten graph is used and a warning logged."""
+        from ..passes.layout import is_nchw_conv
+        self._pass_result = None
+        data_names = [s.name for s in data_syms] + ["__label"]
+        nchw_convs = sum(1 for n in loss_sym.topo_nodes()
+                         if not n.is_var and is_nchw_conv(n))
+        self._pass_info = {
+            "nchw_convs": nchw_convs,
+            "layout_enabled": (self._passes is not None
+                               and "layout" in self._passes.names)}
+        if self._passes is None:
+            return loss_sym
+        shapes = {}
+        pnames = set()
+        for p in self._net.collect_params().values():
+            pnames.add(p.name)
+            if p.shape and all(int(d) > 0 for d in p.shape):
+                shapes[p.name] = tuple(int(d) for d in p.shape)
+        if init_arrays is not None:
+            for name, a in zip(data_names, init_arrays):
+                if hasattr(a, "shape"):
+                    shapes[name] = tuple(int(d) for d in a.shape)
+        try:
+            res = self._passes.run(loss_sym, shapes=shapes,
+                                   input_vars=data_names,
+                                   param_names=pnames)
+        except Exception as e:
+            logger.warning("graph-pass pipeline failed; capturing the "
+                           "unrewritten graph: %r", e)
+            return loss_sym
+        self._pass_info["rewrites"] = dict(res.counts)
+        if res.total_rewrites == 0:
+            return loss_sym
+        self._pass_result = res
+        return res.symbol
+
+    def _placed_param(self, name, value):
+        """A net parameter's value as the REWRITTEN graph expects it: the
+        pass pipeline may have re-homed the variable (NHWC weight, s2d
+        stem), in which case the recorded transform maps the net's value
+        into the captured layout (sync_to_net applies the inverse)."""
+        if self._pass_result is None or \
+                name not in self._pass_result.var_transforms:
+            return value
+        return jnp.asarray(
+            self._pass_result.transform_var(name, jax.device_get(value)))
+
+    def passes_provenance(self) -> Dict[str, Any]:
+        """Which graph passes this trainer runs and what they rewrote —
+        stamped into bench rows so perf baselines are attributable (one
+        schema with Module: passes.manager.provenance)."""
+        return _passes.provenance(self._passes, self._pass_result,
+                                  self._pass_info.get("rewrites"))
+
     # ------------------------------------------------------------- capture
     def _capture(self, n_inputs: int, sample_arrays=None):
         from .. import symbol as sym_mod
@@ -323,20 +398,27 @@ class DataParallelTrainer:
         self._compiled_shapes = None
         self._cost_rows = {}
         self._flops_per_step = None
+        init_arrays = sample_arrays
         if sample_arrays is not None:
             # materialize deferred-init params with one tiny host forward;
             # the sample batch may arrive pre-sharded over the mesh (e.g.
             # from DeviceFeedIter) — uncommit it to host first so the
-            # imperative forward isn't pinned to mismatched devices
+            # imperative forward isn't pinned to mismatched devices.
+            # Under a passes pipeline with input_layout="NHWC" the caller
+            # feeds channel-last batches to an NCHW-built net: init_view
+            # permutes rank-4 arrays back for the init forward only.
+            if self._passes is not None:
+                init_arrays = self._passes.init_view(sample_arrays)
             with autograd.pause():
                 self._net(*[_wrap(jnp.asarray(jax.device_get(a)))
-                            for a in sample_arrays[:-1]])
+                            for a in init_arrays[:-1]])
         data_syms = [sym_mod.Variable(f"__data{i}") for i in range(n_inputs - 1)]
         label_sym = sym_mod.Variable("__label")
         out = self._net(*data_syms)
         if isinstance(out, (list, tuple)):
             out = out[0]
         loss_sym = self._loss_block(out, label_sym)
+        loss_sym = self._run_passes(loss_sym, data_syms, init_arrays)
         lowering = _GraphLowering(loss_sym)
         var_names = [n.name for n in loss_sym.topo_nodes() if n.is_var]
         data_names = [s.name for s in data_syms] + ["__label"]
@@ -349,8 +431,10 @@ class DataParallelTrainer:
         self._param_names = param_names
         self._aux_names = aux_names
         self._pmap = pmap
-        self._params = {n: _unwrap(pmap[n].data()) for n in param_names}
-        self._aux = {n: _unwrap(pmap[n].data()) for n in aux_names}
+        self._params = {n: self._placed_param(n, _unwrap(pmap[n].data()))
+                        for n in param_names}
+        self._aux = {n: self._placed_param(n, _unwrap(pmap[n].data()))
+                     for n in aux_names}
         self._opt_state = self._tx.init(self._params)
         self._guard_state = _guard_init_state()
         if self._scaler_cfg is not None:
@@ -544,6 +628,12 @@ class DataParallelTrainer:
             "loss_scaling": repr(sorted(self._scaler_cfg.items())
                                  if self._scaler_cfg else None),
             "dynamic_lr_scale": self._dynamic_lr,
+            # the pass pipeline rewrites the captured graph (and may
+            # re-home the parameter pytree): a blob compiled under a
+            # different pipeline must not be reused (the StableHLO digest
+            # is the strong check; this is the cheap first filter)
+            "passes": repr((self._passes.names, self._passes.input_layout)
+                           if self._passes is not None else None),
         }
 
     def _lowered_digest(self, lowered) -> str:
@@ -855,13 +945,22 @@ class DataParallelTrainer:
 
     def sync_to_net(self) -> None:
         """Write the trained params/aux back into the gluon net (resharded
-        onto each parameter's home device)."""
+        onto each parameter's home device).  Pass-re-homed parameters are
+        inverse-transformed first, so the net always sees its own layout."""
+        def back(n, v):
+            if self._pass_result is not None and \
+                    n in self._pass_result.var_transforms:
+                return jnp.asarray(
+                    self._pass_result.inverse_var(n, jax.device_get(v)))
+            return v
         for n in self._param_names:
             home = self._pmap[n].list_ctx()[0].jax_device()
-            self._pmap[n].data()._set_data(jax.device_put(self._params[n], home))
+            self._pmap[n].data()._set_data(
+                jax.device_put(back(n, self._params[n]), home))
         for n in self._aux_names:
             home = self._pmap[n].list_ctx()[0].jax_device()
-            self._pmap[n].data()._set_data(jax.device_put(self._aux[n], home))
+            self._pmap[n].data()._set_data(
+                jax.device_put(back(n, self._aux[n]), home))
 
     def lint(self, *data, suppress=()) -> Any:
         """Trace-lint the fused step against a sample batch (mxlint trace

@@ -1,0 +1,35 @@
+"""Graph-pass manager — optimizing rewrites over symbol graphs.
+
+``analysis/`` is the read-only half of the compiler-pass framework
+(mxlint); this package is the write half: Relay/TVM-style rewrite passes
+that turn the measured perf levers (NHWC layout, space-to-depth stem,
+constant folding, fusion-friendly reordering) into automatic defaults every
+captured graph inherits.  ``Module`` and
+:class:`~mxnet_tpu.parallel.DataParallelTrainer` run the default pipeline
+unless constructed with ``passes=False``; ``MXNET_PASSES`` tunes it;
+``tools/mxopt.py`` is the CLI.  Catalog: docs/passes.md.
+
+    from mxnet_tpu import passes
+    res = passes.PassManager().run(sym, shapes={"data": (8, 3, 224, 224)})
+    res.symbol          # the rewritten graph
+    res.counts          # per-pass rewrite counts
+    res.var_transforms  # value transforms for re-homed parameters
+"""
+from .manager import (Pass, PassContext, PassManager, PassResult,
+                      DEFAULT_PIPELINE, PASS_REGISTRY, register_pass,
+                      default_names, resolve, annotate_graph, apply_spec,
+                      spec_shape, provenance,
+                      s2d_weight_forward, s2d_weight_inverse)
+# importing the pass modules populates PASS_REGISTRY
+from .fold import ConstantFoldPass
+from .layout import LayoutPass
+from .s2d import SpaceToDepthPass
+from .fusion import FusionReorderPass
+
+__all__ = ["Pass", "PassContext", "PassManager", "PassResult",
+           "DEFAULT_PIPELINE", "PASS_REGISTRY", "register_pass",
+           "default_names", "resolve", "annotate_graph", "apply_spec",
+           "spec_shape", "provenance",
+           "s2d_weight_forward", "s2d_weight_inverse",
+           "ConstantFoldPass", "LayoutPass", "SpaceToDepthPass",
+           "FusionReorderPass"]

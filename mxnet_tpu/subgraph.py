@@ -208,6 +208,7 @@ def partition_graph(sym: Symbol, prop: SubgraphProperty) -> Symbol:
     "PartitionGraph" NNVM pass, invoked from bind when
     MXNET_SUBGRAPH_BACKEND is set — graph_executor.cc:1492)."""
     order = sym.topo_nodes()
+    _all_names = {n.name for n in order}
     consumers: Dict[int, List[_Node]] = {}
     for n in order:
         for (src, _) in n.inputs:
@@ -303,6 +304,11 @@ def partition_graph(sym: Symbol, prop: SubgraphProperty) -> Symbol:
                 return inner_map[(id(src), idx)]
             new_inputs = [clone_inner(e) for e in src.inputs]
             nn = _Node(src.op, src.name, src.attrs, new_inputs)
+            # the name-scope attr dict rides along (like map_entry above):
+            # dropping it loses __shape__/__dtype__/ctx_group annotations,
+            # which breaks shape-dependent graph passes and lint over the
+            # inner symbol
+            nn._attr_dict = dict(src._attr_dict)
             for k in range(src.num_outputs):
                 inner_map[(id(src), k)] = (nn, k)
             return inner_map[(id(src), idx)]
@@ -310,6 +316,18 @@ def partition_graph(sym: Symbol, prop: SubgraphProperty) -> Symbol:
         inner_outputs = [clone_inner(e) for e in out_entries]
         inner_sym = Symbol(inner_outputs)
         sg_node = prop.create_subgraph_node(inner_sym, ri)
+        # re-anchor the partition node's name against the surrounding
+        # graph: graph passes (and repeated partitioning) may have
+        # introduced nodes whose names collide with the positional
+        # "subgraph{i}" default, and a duplicate name would corrupt
+        # name-keyed consumers (JSON round trips, monitors, lint
+        # locations)
+        if sg_node.name in _all_names:
+            k = 0
+            while f"{sg_node.name}_r{k}" in _all_names:
+                k += 1
+            sg_node.name = f"{sg_node.name}_r{k}"
+        _all_names.add(sg_node.name)
         # wire the subgraph node's inputs to the REMAPPED outer entries;
         # feed order must be ext-entry order, not list_arguments order
         sg_node.attrs = dict(sg_node.attrs,

@@ -219,11 +219,15 @@ def run_variant(spec: VariantSpec, *, steps: int, warmup: int, image: int,
     net = vision.resnet50_v1(classes=1000, layout=layout, stem_s2d=spec.s2d)
     net.initialize(mx.init.Xavier())
     loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    # ladder variants are explicit hand-flag reference points: the graph
+    # passes are pinned OFF so NHWC:512 measures exactly NHWC:512 (the
+    # default pipeline would e.g. auto-s2d the stem and collapse distinct
+    # rungs onto one program); the emitted row records that provenance
     trainer = parallel.DataParallelTrainer(
         net, loss_fn, "sgd",
         {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4},
         compute_dtype="bfloat16" if on_accel else None,
-        remat=spec.remat)
+        remat=spec.remat, passes=False)
     shape = (batch, image, image, 3) if layout == "NHWC" \
         else (batch, 3, image, image)
     x = np.random.uniform(-1, 1, shape).astype("float32")
@@ -279,6 +283,7 @@ def run_variant(spec: VariantSpec, *, steps: int, warmup: int, image: int,
         "compile_s": round(m["compile_s"], 1),
         "analytic_tflops": round(flops / 1e12, 1),
         "loss": m["loss"],
+        "passes": trainer.passes_provenance(),
     }
     return result, (trainer, m["xd"], m["yd"], layout, batch)
 

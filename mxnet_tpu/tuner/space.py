@@ -143,14 +143,42 @@ class Candidate:
         is a feed-level knob (``io.prefetch_to_device(depth=...)``)."""
         return {"remat": self.remat, "donate": self.donate}
 
+    def passes_manager(self):
+        """This candidate's ``layout``/``s2d`` dimensions as a graph-pass
+        pipeline over an NCHW-built net (``mxnet_tpu.passes``): the
+        flag-vs-pass route.  ``input_layout="NHWC"`` because the
+        candidate's ``data_shape`` feeds channel-last batches; the
+        rewritten step is bitwise-HLO-identical to the hand-flagged net
+        (the tuner round-trip acceptance test).  ``None`` for NCHW
+        candidates — the baseline IS the unrewritten graph."""
+        if self.layout != "NHWC":
+            return None
+        from ..passes import PassManager
+        names = ["fold", "layout"] + (["s2d"] if self.s2d else []) \
+            + ["fusion"]
+        return PassManager(names, input_layout="NHWC")
+
     def build_trainer(self, net, loss_fn, optimizer: str = "sgd",
-                      optimizer_params: Optional[Dict] = None, **extra):
+                      optimizer_params: Optional[Dict] = None,
+                      via_passes: bool = False, **extra):
         """Apply this candidate to a trainer: the returned
         ``DataParallelTrainer`` is EXACTLY the one a hand-written
         ``DataParallelTrainer(net, loss, ..., remat=..., donate=...)`` would
-        build (bitwise-identical lowered HLO — the tuner acceptance test)."""
+        build (bitwise-identical lowered HLO — the tuner acceptance test).
+
+        ``via_passes=True`` applies the layout/s2d dimensions as graph
+        passes instead of expecting a hand-flagged net: ``net`` must be
+        built NCHW, and the candidate's pipeline rewrites the captured
+        graph to the identical HLO.  Either way the candidate PINS its
+        pass configuration explicitly (the flags route runs
+        ``passes=False``) — a tuner trial must measure exactly its
+        declared config, never the ambient default pipeline."""
         from ..parallel import DataParallelTrainer
         kw = self.trainer_kwargs()
+        if via_passes:
+            kw["passes"] = self.passes_manager() or False
+        else:
+            kw["passes"] = False
         kw.update(extra)
         return DataParallelTrainer(net, loss_fn, optimizer,
                                    optimizer_params or {}, **kw)

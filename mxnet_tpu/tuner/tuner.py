@@ -255,7 +255,8 @@ def tune(build: Callable[[Candidate], Tuple[Any, Any]],
          ledger=None,
          model: str = "",
          correction: bool = True,
-         feed: bool = False) -> TuneResult:
+         feed: bool = False,
+         via_passes: bool = False) -> TuneResult:
     """Search the config space for the fastest training-step configuration.
 
     ``build(candidate) -> (net, loss_fn)`` constructs the model for a
@@ -264,6 +265,13 @@ def tune(build: Callable[[Candidate], Tuple[Any, Any]],
     and layout. Everything else — lowering, cost analysis, prediction,
     ranking, the measure budget, ledger persistence, warm-start — is the
     tuner's job. Returns a :class:`TuneResult`.
+
+    ``via_passes=True`` routes each candidate's layout/s2d dimensions
+    through the graph-pass pipeline (``Candidate.passes_manager``) instead
+    of hand-built net flags: ``build`` must construct the NCHW net, and the
+    pass-rewritten step is bitwise-HLO-identical to the hand-flagged one
+    (the flag-vs-pass acceptance test), so measurements and warm-start
+    cache rows are interchangeable between the two routes.
 
     ``feed=True`` measures each trial through a device-feed pipeline
     (``io.prefetch_to_device`` at the candidate's ``prefetch_depth``)
@@ -400,6 +408,7 @@ def tune(build: Callable[[Candidate], Tuple[Any, Any]],
             x, y = sample
             trainer = cand.build_trainer(net, loss_fn, optimizer,
                                          optimizer_params,
+                                         via_passes=via_passes,
                                          compute_dtype=compute_dtype)
             # local tracing only: data abstracted to shape structs, no
             # compile, nothing dispatched (DataParallelTrainer.lower)
@@ -502,7 +511,7 @@ def tune(build: Callable[[Candidate], Tuple[Any, Any]],
                 x, y = data(t.candidate)
                 trainer = t.candidate.build_trainer(
                     net, loss_fn, optimizer, optimizer_params,
-                    compute_dtype=compute_dtype)
+                    via_passes=via_passes, compute_dtype=compute_dtype)
                 m = _ladder.measure_step(
                     trainer, x, y, steps=steps, warmup=warmup, feed=feed,
                     prefetch_depth=t.candidate.prefetch_depth)

@@ -536,3 +536,51 @@ def test_tunnel_session_stale_registration_reaped(tmp_path, monkeypatch):
         json.dump({"pid": os.getpid(), "role": "aot_warm.py"}, f)
     assert tunnel_session.owned_pids() == {}
     assert not os.path.exists(stale)         # dead pid: reaped
+
+
+@pytest.mark.passes
+def test_mxopt_cli_json_and_dead_nodes(tmp_path):
+    """tools/mxopt.py end-to-end: a saved NCHW conv graph gets layout
+    rewrites + a before/after lint delta (MXL-G107 before, clean after),
+    dead JSON nodes are counted, --emit round-trips, and a bad target
+    exits 2."""
+    import json
+    import mxnet_tpu.symbol as sym_mod
+
+    def op(opname, *ins, **kw):
+        return sym_mod._invoke_sym(opname, list(ins), kw)
+
+    data = sym_mod.Variable("data")
+    out = op("Convolution", data, kernel=(3, 3), num_filter=8,
+             no_bias=True, layout="NCHW", stride=(1, 1), pad=(1, 1),
+             num_group=1, dilate=(1, 1), name="mc1")
+    raw = json.loads(out.tojson())
+    # graft an unreachable node so dead-node elimination has work
+    raw["nodes"].append({"op": "null", "name": "orphan", "attrs": {},
+                         "inputs": []})
+    gpath = tmp_path / "net.json"
+    gpath.write_text(json.dumps(raw))
+    mxopt = os.path.join(REPO, "tools", "mxopt.py")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": ""}
+
+    emitted = tmp_path / "net_opt.json"
+    p = subprocess.run(
+        [sys.executable, mxopt, str(gpath), "--shape", "data:2,3,8,8",
+         "--emit", str(emitted), "--format", "json"],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert p.returncode == 0, p.stdout + p.stderr
+    rep = json.loads(p.stdout)
+    assert rep["rewrites"]["layout"] >= 1
+    assert rep["dead_nodes_eliminated"] == 1
+    # G107 fires on the before-lint (passes declared off), not after
+    assert rep["lint_before"]["warnings"] >= 1
+    assert rep["lint_after"]["warnings"] == 0
+    # the emitted graph loads and the orphan is gone
+    re = sym_mod.load_json(emitted.read_text())
+    assert "orphan" not in [n.name for n in re.topo_nodes()]
+    assert "NHWC" in [str((n.attrs or {}).get("layout"))
+                      for n in re.topo_nodes() if n.op == "Convolution"]
+
+    p = subprocess.run([sys.executable, mxopt, str(tmp_path / "nope.json")],
+                       capture_output=True, text=True, timeout=120, env=env)
+    assert p.returncode == 2

@@ -50,8 +50,16 @@ def _build_fns(args):
             from mxnet_tpu.gluon.model_zoo import vision
             np.random.seed(0)
             mx.random.seed(0)
-            net = vision.resnet50_v1(classes=args.classes,
-                                     layout=cand.layout, stem_s2d=cand.s2d)
+            if args.route == "passes":
+                # the layout/s2d dimensions apply as graph passes over ONE
+                # NCHW-built net (Candidate.passes_manager): bitwise the
+                # same HLO as the hand-flagged net, no per-candidate net
+                # zoo variants
+                net = vision.resnet50_v1(classes=args.classes)
+            else:
+                net = vision.resnet50_v1(classes=args.classes,
+                                         layout=cand.layout,
+                                         stem_s2d=cand.s2d)
             net.initialize(mx.init.Xavier())
             return net, gluon.loss.SoftmaxCrossEntropyLoss()
 
@@ -140,6 +148,11 @@ def main(argv=None) -> int:
                          "which the prefetch dimension differentiates; "
                          "default stages data device-resident like "
                          "perf_lab)")
+    ap.add_argument("--route", choices=("passes", "flags"), default="passes",
+                    help="how layout/s2d candidates apply: 'passes' (the "
+                         "default) rewrites one NCHW-built net through the "
+                         "graph-pass pipeline — bitwise-identical HLO to "
+                         "'flags', which builds hand-flagged net variants")
     ap.add_argument("--cache", default=None,
                     help="trial ledger path (MXNET_TUNER_CACHE)")
     ap.add_argument("--compute-dtype", default=None,
@@ -199,7 +212,8 @@ def main(argv=None) -> int:
             top_k=args.top_k,
             measure=False if args.predict_only else None,
             steps=args.steps, warmup=args.warmup,
-            ledger=args.cache, model=args.model, feed=args.feed)
+            ledger=args.cache, model=args.model, feed=args.feed,
+            via_passes=(args.route == "passes"))
     except MXNetError as e:
         sys.stderr.write("mxtune: %s\n" % e)
         return 2
