@@ -454,6 +454,87 @@ def run_bench():
 
 
 # --------------------------------------------------------------------------
+# Multichip mode: a REAL scaling-efficiency row (img/s/chip at N devices vs
+# 1) replacing the empty MULTICHIP_* dryrun tail (ROADMAP item 5). The
+# measurement itself lives in mxnet_tpu/parallel/collbench.py (scaling_row)
+# so the dryrun harness and tests share it; this mode is the bench-window
+# driver around it, plus a collectives bandwidth mini-sweep for the row's
+# context. Knobs: BENCH_MC_MODEL=tiny|resnet50, BENCH_MC_BATCH (per chip),
+# BENCH_MC_IMAGE, BENCH_MC_STEPS, BENCH_GRAD_REDUCE, BENCH_REDUCE_DTYPE.
+# --------------------------------------------------------------------------
+def run_multichip():
+    import jax
+    if os.environ.get("BENCH_FORCE_CPU") == "1":
+        jax.config.update("jax_platforms", "cpu")
+    try:
+        devices = jax.devices()
+    except Exception as e:
+        print("BENCH_MC_BACKEND_FAIL: %s" % e, file=sys.stderr)
+        return 3
+    on_accel = any(d.platform != "cpu" for d in devices)
+    from mxnet_tpu.parallel import collbench
+
+    model = os.environ.get("BENCH_MC_MODEL",
+                           "resnet50" if on_accel else "tiny")
+    if model not in ("tiny", "resnet50"):
+        # an unknown knob value must not stamp false model provenance into
+        # the row while silently measuring the tiny default net
+        print("BENCH_MC_MODEL must be tiny|resnet50, got %r" % model,
+              file=sys.stderr)
+        return 2
+    batch = int(os.environ.get("BENCH_MC_BATCH", 32 if on_accel else 8))
+    image = int(os.environ.get("BENCH_MC_IMAGE", 224 if on_accel else 16))
+    steps = int(os.environ.get("BENCH_MC_STEPS", 10 if on_accel else 4))
+    grad_reduce = os.environ.get("BENCH_GRAD_REDUCE", "reduce_scatter")
+    reduce_dtype = os.environ.get("BENCH_REDUCE_DTYPE") or None
+
+    builder = None
+    if model == "resnet50":
+        def builder(prefix, classes):
+            import mxnet_tpu as mx
+            from mxnet_tpu import gluon
+            from mxnet_tpu.gluon.model_zoo import vision
+            mx.random.seed(0)
+            net = vision.resnet50_v1(classes=classes, prefix=prefix)
+            net.initialize(mx.init.Xavier())
+            return net, gluon.loss.SoftmaxCrossEntropyLoss()
+
+    # provenance decided BEFORE the measurement so the ledger-persisted
+    # row and the printed row are identical (model-filtered readers must
+    # never see a ledger row missing the identity fields)
+    extra = {"model": model,
+             "provenance": "live multichip run at %s" % time.strftime(
+                 "%Y-%m-%dT%H:%MZ", time.gmtime())}
+    if not on_accel:
+        extra["degraded"] = "cpu-only-backend (virtual-device scaling: " \
+            "collective cost is real, chip compute is not)"
+    try:
+        row = collbench.scaling_row(
+            batch_per_chip=batch, image=image, steps=steps,
+            grad_reduce=grad_reduce, grad_reduce_dtype=reduce_dtype,
+            builder=builder, extra=extra)
+    except Exception as e:
+        print(json.dumps({"metric": "multichip_scaling_efficiency",
+                          "value": 0.0, "unit": "ratio",
+                          "degraded": "scaling run failed: %r" % e}),
+              flush=True)
+        return 1
+    print(json.dumps(row), flush=True)
+    # context: a small collectives sweep at the same device count, so the
+    # efficiency number ships next to the bytes/sec curve explaining it
+    if os.environ.get("BENCH_MC_COLLECTIVES", "1") == "1":
+        try:
+            collbench.run(device_counts=(len(devices),),
+                          payload_sizes=(1 << 20,),
+                          steps=max(3, steps // 2), warmup=1,
+                          compression=0.5,
+                          emit=lambda r: print(json.dumps(r), flush=True))
+        except Exception as e:
+            print("collectives sweep failed: %r" % e, file=sys.stderr)
+    return 0
+
+
+# --------------------------------------------------------------------------
 # Parent: orchestrates under a wall-clock budget. No jax is imported here.
 # --------------------------------------------------------------------------
 def _metric_lines(text):
@@ -474,7 +555,8 @@ def _foreign_tunnel_clients():
     concurrent client hangs behind them, so each must either be killed
     (session-owned leftovers, see ``_preflight_clear_tunnel``) or the live
     attempt skipped (genuinely foreign processes)."""
-    markers = ("aot_warm.py", "perf_lab.py", "mxtune.py", "tpu_session")
+    markers = ("aot_warm.py", "perf_lab.py", "mxtune.py", "collbench.py",
+               "tpu_session")
     found = []
     try:
         for pid in os.listdir("/proc"):
@@ -811,5 +893,7 @@ def main():
 if __name__ == "__main__":
     if "--run" in sys.argv:
         run_bench()
+    elif "--multichip" in sys.argv:
+        sys.exit(run_multichip())
     else:
         main()

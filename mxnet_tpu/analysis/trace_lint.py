@@ -38,6 +38,12 @@ Rules (catalog in docs/static_analysis.md):
                                           levers while the tuner cache has
                                           a differing measured best config
                                           for the same model/device
+* MXL-T212 replicated-optimizer-at-scale (warning) multi-device trainer on
+                                          the default all-reduce path with
+                                          fully replicated optimizer state
+                                          while the tuner cache holds a
+                                          measured reduce_scatter win for
+                                          the same signature
 """
 from __future__ import annotations
 
@@ -114,6 +120,15 @@ register_rule(
     "mxtpu_device_util / mxtpu_mfu gauges, so a slowdown cannot be "
     "attributed to device compute vs host dispatch vs data-feed stall — "
     "exactly the blind spot that kept perf flat across bench rounds.")
+register_rule(
+    "MXL-T212", "warning", "replicated-optimizer-at-scale",
+    "A multi-device trainer runs the default all-reduce gradient path with "
+    "fully replicated optimizer state although the autotuner cache holds a "
+    "MEASURED reduce_scatter win for the same model/device/chip-count "
+    "signature: every chip burns N x the optimizer-state HBM and the "
+    "heavier collective, while the ZeRO-1 sharded optimizer "
+    "(DataParallelTrainer(grad_reduce='reduce_scatter')) is one ctor "
+    "kwarg away with a measurement already on file.")
 register_rule(
     "MXL-T211", "warning", "untuned-hot-loop",
     "The trainer runs with all-default perf levers while the autotuner "
@@ -645,4 +660,59 @@ def lint_trainer(trainer, *data, suppress: Sequence[str] = (),
                      " or the matching DataParallelTrainer kwargs/batch), "
                      "or re-tune with tools/mxtune.py if the workload "
                      "changed"))
+
+    # ---- replicated optimizer at scale (MXL-T212): another cache-backed
+    # config check — the trainer spans >1 device on the default all-reduce
+    # path (params AND optimizer state replicated on every chip) while the
+    # tuner cache holds a MEASURED reduce_scatter win for the same
+    # model/device/chip-count signature. Fires only on evidence: no cache
+    # row, a single-device mesh, or a trainer already sharding its
+    # optimizer all stay silent. The gate (and the ~Nx claim) use the DATA
+    # axis extent — the divisor the recommended ZeRO sharding actually
+    # shards by — not the total device count, so a dp=1 x tp=N mesh
+    # (where reduce_scatter would shard nothing) never false-fires.
+    try:
+        n_mesh = int(trainer._mesh.shape[trainer._axis])
+    except (KeyError, TypeError):
+        n_mesh = int(trainer._mesh.devices.size)
+    if n_mesh > 1 and \
+            getattr(trainer, "_grad_reduce", "all_reduce") == "all_reduce":
+        tuned = None
+        try:
+            from ..tuner import best_cached
+            dev = trainer._mesh.devices.ravel()[0]
+            tuned = best_cached(device_kind=dev.device_kind,
+                                net_class=type(trainer._net).__name__,
+                                n_devices=n_mesh)
+        except Exception:
+            tuned = None
+        cfg = (tuned or {}).get("tuner_config") or {}
+        if cfg.get("grad_reduce") == "reduce_scatter":
+            tput = tuned.get("throughput_img_s_per_chip")
+            opt_b = {}
+            try:
+                opt_b = trainer.opt_state_bytes()
+            except Exception:
+                pass
+            report.add(Diagnostic(
+                "MXL-T212",
+                "trainer replicates its optimizer state on every one of %d "
+                "devices (default grad_reduce='all_reduce'%s), but the "
+                "tuner cache holds a measured reduce_scatter win for %s on "
+                "%s%s — the ZeRO-1 sharded optimizer would cut per-chip "
+                "opt-state HBM ~%dx and swap the all-reduce for the "
+                "cheaper reduce-scatter + all-gather pair"
+                % (n_mesh,
+                   ", %d opt-state bytes per chip"
+                   % opt_b["per_chip_bytes"]
+                   if opt_b.get("per_chip_bytes") else "",
+                   type(trainer._net).__name__, tuned.get("device_kind"),
+                   " (%.1f img/s/chip measured)" % tput if tput else "",
+                   n_mesh),
+                location=type(trainer).__name__,
+                hint="construct with grad_reduce='reduce_scatter' (step-"
+                     "equivalent to the replicated baseline; checkpoints "
+                     "round-trip the sharded state bitwise — see "
+                     "docs/performance.md 'Scale-out performance'), or "
+                     "re-tune with tools/mxtune.py if the workload changed"))
     return report

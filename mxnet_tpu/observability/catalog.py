@@ -119,6 +119,12 @@ COLL_DISPATCHES = _m.counter(
 COLL_BYTES = _m.counter(
     "mxtpu_collective_bytes_total",
     "Payload bytes entering host-level collectives, labeled op=.")
+COLL_MS = _m.histogram(
+    "mxtpu_collective_ms",
+    "Measured wall time of one collective operation in the bandwidth lab "
+    "(parallel/collbench.py), labeled op=psum|reduce_scatter|all_gather|"
+    "ppermute|psum_compressed.",
+    buckets=(0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 25, 50, 100, 500, 2000))
 
 # ------------------------------------------------------------- resilience
 WATCHDOG_FIRED = _m.counter(

@@ -7,6 +7,8 @@ reference mechanism     here
 =====================  ==============================================
 KVStore local/device    DataParallelTrainer / bucketed_allreduce (psum on 'dp')
 KVStore dist_sync       same + jax.distributed multi-host mesh
+(absent) ZeRO-1         DataParallelTrainer(grad_reduce='reduce_scatter')
+tools/bandwidth         collbench (collectives bytes/sec lab + scaling row)
 group2ctx model par.    shard_gluon_params / NamedSharding placement
 (absent) tensor par.    tensor_parallel.* (Megatron col/row split on 'tp')
 (absent) pipeline       pipeline.pipeline_apply (GPipe over 'pp')
@@ -18,6 +20,7 @@ from .mesh import (make_mesh, auto_mesh, local_mesh, replicated, shard_spec,
                    Mesh, NamedSharding, PartitionSpec)
 from . import collectives
 from .collectives import psum_arrays, bucketed_allreduce
+from . import collbench
 from .data_parallel import DataParallelTrainer
 from .ring_attention import ring_attention, local_attention
 from .ulysses import ulysses_attention

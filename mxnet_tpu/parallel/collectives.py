@@ -13,7 +13,7 @@ use ``psum_arrays`` which wraps its own shard_map.
 from __future__ import annotations
 
 import functools
-from typing import List, Sequence
+from typing import List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -46,7 +46,8 @@ def _count_dispatch(op: str, arrays) -> None:
 __all__ = ["allreduce", "allgather", "reduce_scatter", "broadcast", "ppermute",
            "all_to_all", "psum_arrays", "cross_process_allreduce",
            "cross_process_allreduce_many", "cross_process_alltoall",
-           "cross_process_allgather_tiled", "bucketed_allreduce"]
+           "cross_process_allgather_tiled", "bucket_assignment",
+           "bucketed_allreduce"]
 
 
 # ---- inside-shard_map primitives (thin, named-axis) -----------------------
@@ -63,8 +64,11 @@ def reduce_scatter(x, axis_name: str, axis: int = 0):
 
 
 def broadcast(x, axis_name: str, src: int = 0):
+    """Every member gets the ``src`` member's value: mask every other
+    contribution to zero and psum (one collective; XLA lowers the
+    one-nonzero-operand psum to a broadcast from ``src`` on TPU)."""
     idx = lax.axis_index(axis_name)
-    return jnp.where(idx == src, x, x)  # value already replicated post-psum
+    return lax.psum(jnp.where(idx == src, x, jnp.zeros_like(x)), axis_name)
 
 
 def ppermute(x, axis_name: str, perm):
@@ -195,21 +199,97 @@ def cross_process_allgather_tiled(x):
     ).reshape(-1)
 
 
+def bucket_assignment(nbytes: Sequence[int],
+                      bucket_bytes: int) -> List[List[int]]:
+    """Greedy order-preserving bucketing: indices are appended in order
+    until a bucket reaches ``bucket_bytes``, then a new one starts. This is
+    the ONE bucket-assignment rule — shared by :func:`bucketed_allreduce`
+    and by ``DataParallelTrainer``'s in-trace gradient bucketing
+    (``bucket_bytes=``), so a tuner-searched bucket size means the same
+    grouping on both paths."""
+    buckets: List[List[int]] = []
+    cur: List[int] = []
+    size = 0
+    for i, n in enumerate(nbytes):
+        cur.append(i)
+        size += int(n)
+        if size >= bucket_bytes:
+            buckets.append(cur)
+            cur, size = [], 0
+    if cur:
+        buckets.append(cur)
+    return buckets
+
+
+@functools.lru_cache(maxsize=32)
+def _compressed_psum_fn(mesh: Mesh, axis: str, threshold: float, n: int):
+    """shard_map'd compressed allreduce of ``n`` arrays: each member 2-bit
+    quantizes its local block against its residual shard, the 16x-smaller
+    packed payloads cross the interconnect via one tiled all_gather per
+    array, and every member dequantize-sums all ranks' codes locally —
+    wire bytes = ranks x packed vs ranks x f32 for the dense psum."""
+    from ..gradient_compression import (_quantize_2bit, _dequantize_sum_rows)
+
+    def f(*xs_and_res):
+        xs, res = xs_and_res[:n], xs_and_res[n:]
+        outs, new_res = [], []
+        for x, r in zip(xs, res):
+            shape = x.shape
+            packed, nr = _quantize_2bit(x.astype(jnp.float32),
+                                        r.astype(jnp.float32),
+                                        threshold=threshold)
+            rows = lax.all_gather(packed, axis)          # (ranks, s) uint8
+            dense = _dequantize_sum_rows(rows, threshold=threshold)
+            outs.append(dense[:x.size].reshape(shape).astype(x.dtype))
+            new_res.append(nr)
+        return tuple(outs) + tuple(new_res)
+
+    specs = tuple(P(axis) for _ in range(2 * n))
+    return jax.jit(shard_map(f, mesh=mesh, in_specs=specs, out_specs=specs))
+
+
 def bucketed_allreduce(grads: List, mesh: Mesh, axis: str = "dp",
-                       bucket_bytes: int = 4 << 20) -> List:
+                       bucket_bytes: int = 4 << 20,
+                       compression=None, residuals: Optional[List] = None):
     """Bucket small gradients into fused allreduce dispatches, preserving
     order so early (high-priority) buckets land first — the reference's
     priority=-index comm overlap (model.py:150-160) and
-    MXNET_UPDATE_AGGREGATION_SIZE batching (kvstore_nccl.h)."""
+    MXNET_UPDATE_AGGREGATION_SIZE batching (kvstore_nccl.h).
+
+    ``compression`` (a :class:`~mxnet_tpu.gradient_compression.
+    GradientCompression` or its params dict) routes every bucket through
+    the 2-bit error-feedback codec: each mesh member quantizes its local
+    shard, only the packed codes cross the interconnect (allgather + local
+    dequantize-sum — the reference's compressed push shape), and the
+    caller-held ``residuals`` (same shapes/shardings as ``grads``; zeros
+    when None) carry the error feedback. With compression the return value
+    is ``(reduced, new_residuals)`` so the caller can thread the residual
+    stream into the next call; without it, just ``reduced`` (unchanged
+    signature)."""
+    gc = None
+    if compression is not None:
+        from ..gradient_compression import GradientCompression
+        gc = compression if isinstance(compression, GradientCompression) \
+            else GradientCompression(compression)
     out: List = [None] * len(grads)
-    bucket: List[int] = []
-    size = 0
-    for i, g in enumerate(grads):
-        bucket.append(i)
-        size += g.size * g.dtype.itemsize
-        if size >= bucket_bytes or i == len(grads) - 1:
+    new_res: List = [None] * len(grads)
+    if gc is not None and residuals is None:
+        residuals = [jnp.zeros_like(jnp.asarray(g, jnp.float32))
+                     for g in grads]
+    for bucket in bucket_assignment(
+            [g.size * g.dtype.itemsize for g in grads], bucket_bytes):
+        if gc is None:
             reduced = psum_arrays([grads[j] for j in bucket], mesh, axis)
             for j, r in zip(bucket, reduced):
                 out[j] = r
-            bucket, size = [], 0
-    return out
+        else:
+            _count_dispatch("psum_compressed", [grads[j] for j in bucket])
+            fn = _compressed_psum_fn(mesh, axis, gc.threshold, len(bucket))
+            res = fn(*([grads[j] for j in bucket]
+                       + [residuals[j] for j in bucket]))
+            for k, j in enumerate(bucket):
+                out[j] = res[k]
+                new_res[j] = res[len(bucket) + k]
+    if gc is None:
+        return out
+    return out, new_res

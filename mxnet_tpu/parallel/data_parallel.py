@@ -206,7 +206,9 @@ class DataParallelTrainer:
                  compute_dtype=None, donate: bool = True, kvstore=None,
                  remat=None, grad_guard=None, loss_scaling=None,
                  dynamic_lr_scale: bool = False, step_attribution=None,
-                 passes=None):
+                 passes=None, grad_reduce: str = "all_reduce",
+                 grad_reduce_dtype=None, bucket_bytes: Optional[int] = None,
+                 compression=None):
         self._net = net
         self._loss_block = loss
         # graph-pass pipeline run over the captured symbol graph BEFORE
@@ -250,6 +252,86 @@ class DataParallelTrainer:
             raise MXNetError(f"unknown remat mode {remat!r}")
         self._remat = remat not in (None, "none")
         self._remat_mode = remat
+        # ---- communication-optimization levers (scale-out path) ----------
+        # grad_reduce: how the cross-chip gradient reduction runs.
+        #   "all_reduce"      (default) XLA's implicit AllReduce; params and
+        #                     optimizer state replicated on every chip.
+        #   "reduce_scatter"  ZeRO-1 sharded optimizer: gradients are
+        #                     reduce-scattered over the data axis, the
+        #                     optimizer update runs on each chip's 1/N
+        #                     parameter shard (optimizer state LIVES sharded
+        #                     — per-chip opt-state HBM shrinks N x), and the
+        #                     updated params all-gather back to replication.
+        #                     Parameters/state leaves whose leading dim does
+        #                     not tile the mesh stay replicated (all-reduce).
+        self._grad_reduce = str(grad_reduce or "all_reduce")
+        if self._grad_reduce not in ("all_reduce", "reduce_scatter"):
+            raise MXNetError(
+                f"unknown grad_reduce mode {grad_reduce!r} "
+                "(want 'all_reduce' or 'reduce_scatter')")
+        # grad_reduce_dtype: the dtype gradients travel in through the
+        # reduction (bf16 halves the collective bytes); the unsharded
+        # master math stays f32 — grads are cast back before the optimizer
+        # consumes them (accumulate-in-f32 semantics, tolerance-tested).
+        self._grad_reduce_dtype = None
+        if grad_reduce_dtype not in (None, "none", "float32", "f32"):
+            alias = {"bf16": "bfloat16", "fp16": "float16"}
+            dt = jnp.dtype(alias.get(str(grad_reduce_dtype),
+                                     grad_reduce_dtype))
+            if not jnp.issubdtype(dt, jnp.floating) or \
+                    dt == jnp.dtype(jnp.float64):
+                raise MXNetError(
+                    f"grad_reduce_dtype must be a sub-f32 float "
+                    f"(bfloat16/float16), got {grad_reduce_dtype!r}")
+            if dt != jnp.dtype(jnp.float32):
+                self._grad_reduce_dtype = dt
+        # bucket_bytes: fuse small gradients into flat buckets of this many
+        # bytes before the reduction (one collective per bucket instead of
+        # one per tensor) — the in-trace twin of collectives.
+        # bucketed_allreduce, sharing its bucket_assignment rule. An
+        # all-reduce-path lever: the ZeRO path already reduces per-shard.
+        self._bucket_bytes = None
+        if bucket_bytes not in (None, 0):
+            if self._grad_reduce == "reduce_scatter":
+                raise MXNetError(
+                    "bucket_bytes= is an all_reduce-path lever; "
+                    "grad_reduce='reduce_scatter' fuses its own per-leaf "
+                    "reduce-scatters (drop one of the two)")
+            if kvstore is not None:
+                # the kv path pushes gradients per key and the kvstore does
+                # its own aggregation; a silently-inert lever would stamp
+                # false provenance into comm_config()/tuner rows
+                raise MXNetError(
+                    "bucket_bytes= applies to the fused in-XLA gradient "
+                    "reduction; the kvstore path aggregates with "
+                    "MXNET_UPDATE_AGGREGATION_SIZE instead (drop one of "
+                    "the two)")
+            self._bucket_bytes = int(bucket_bytes)
+            if self._bucket_bytes <= 0:
+                raise MXNetError(f"bucket_bytes must be positive, got "
+                                 f"{bucket_bytes!r}")
+        # compression: 2-bit error-feedback gradient compression on the
+        # kvstore wire (GradientCompression; reference
+        # gradient_compression.cc). A WIRE lever: the compiled programs are
+        # untouched, so it deliberately stays out of the AOT key.
+        self._compression_params = None
+        if compression:
+            if kvstore is None:
+                raise MXNetError(
+                    "compression= rides the kvstore gradient wire; pass "
+                    "kvstore= (the fused in-XLA collectives have no "
+                    "host-codec hook) or drop compression")
+            from ..gradient_compression import GradientCompression
+            if isinstance(compression, GradientCompression):
+                params = {"type": compression.type,
+                          "threshold": compression.threshold}
+            else:
+                params = dict(compression)
+            kvstore.set_gradient_compression(params)
+            self._compression_params = params
+        # per-leaf ZeRO sharding decisions, derived at capture time
+        self._zero_shard: Dict[str, bool] = {}
+        self._opt_specs = None
         # recorded for the AOT key: lr/momentum/wd are baked into the
         # compiled executable as constants, so a blob from different
         # hyperparameters must never be silently reused
@@ -455,6 +537,93 @@ class DataParallelTrainer:
         # scalars are exactly what mxlint MXL-T202 flags in our own step
         lr_key = "lr_scale" if self._dynamic_lr else None
 
+        # ---- comm-optimization epilogue (grad_reduce / dtype / buckets) --
+        # ZeRO-1 shardability: a leaf shards over the data axis when its
+        # leading dim tiles the mesh; everything else stays replicated.
+        # Optimizer-state leaves mirror their param's shape (sgd momentum,
+        # adam mu/nu), so the same shape rule lands the same verdict on a
+        # param and its state; scalar counts stay replicated. The divisor
+        # is the DATA axis extent — on a multi-axis mesh only 'dp' shards.
+        n_dev = int(mesh.shape[axis])
+        shard1 = NamedSharding(mesh, P(axis))
+        g_mode = self._grad_reduce
+
+        def _zero_ok(v):
+            shp = tuple(getattr(v, "shape", ()))
+            return (g_mode == "reduce_scatter" and len(shp) >= 1
+                    and int(shp[0]) > 0 and int(shp[0]) % n_dev == 0)
+
+        self._zero_shard = {n: _zero_ok(v) for n, v in self._params.items()}
+        self._opt_specs = jax.tree_util.tree_map(
+            lambda l: shard1 if _zero_ok(l) else repl, self._opt_state)
+        if g_mode == "reduce_scatter":
+            # the optimizer state LIVES sharded between steps — per-chip
+            # opt-state HBM is 1/N of the replicated baseline from step 0
+            self._opt_state = jax.tree_util.tree_map(
+                jax.device_put, self._opt_state, self._opt_specs)
+        zshard = dict(self._zero_shard)
+        rdt = self._grad_reduce_dtype
+        bucket_names = None
+        if self._bucket_bytes:
+            from .collectives import bucket_assignment
+            itemsize = (jnp.dtype(rdt).itemsize if rdt is not None else 4)
+            sizes = [int(np.prod(self._params[n].shape)) * itemsize
+                     for n in param_names]
+            bucket_names = [[param_names[i] for i in b] for b in
+                            bucket_assignment(sizes, self._bucket_bytes)]
+
+        def _shard_tree(t, sp):
+            return {k: (jax.lax.with_sharding_constraint(v, sp)
+                        if zshard[k] else v) for k, v in t.items()}
+
+        def _reduce_grads(grads):
+            """Comm epilogue on the freshly-unscaled f32 grads: cast to the
+            wire dtype, fuse buckets (one collective per flat bucket —
+            collectives.bucket_assignment order), anchor the ZeRO
+            reduce-scatter, cast back to f32 (accumulate-in-f32: the
+            master math downstream never sees the wire dtype)."""
+            if rdt is not None:
+                grads = {k: v.astype(rdt) for k, v in grads.items()}
+            if bucket_names is not None:
+                out = dict(grads)
+                for names_ in bucket_names:
+                    flat = jnp.concatenate([grads[n].ravel()
+                                            for n in names_]) \
+                        if len(names_) > 1 else grads[names_[0]].ravel()
+                    flat = jax.lax.with_sharding_constraint(flat, repl)
+                    off = 0
+                    for n in names_:
+                        sz = grads[n].size
+                        out[n] = flat[off:off + sz].reshape(grads[n].shape)
+                        off += sz
+                grads = out
+            if g_mode == "reduce_scatter":
+                # the constraint sits on the WIRE-dtype value so XLA's
+                # implicit psum lowers to a reduce-scatter of those bytes
+                grads = _shard_tree(grads, shard1)
+            if rdt is not None:
+                grads = {k: v.astype(jnp.float32) for k, v in grads.items()}
+            return grads
+
+        def _opt_apply(grads, opt_state, params, gstate):
+            """Optimizer update bracketed by the ZeRO shard/gather: the
+            update runs on each chip's 1/N shard of grads/params/state and
+            the fresh params all-gather back to replication. Shared by the
+            fused step and the kv apply_step so the two paths cannot
+            drift."""
+            import optax
+            if g_mode == "reduce_scatter":
+                grads = _shard_tree(grads, shard1)
+                params = _shard_tree(params, shard1)
+            updates, new_opt_state = tx.update(grads, opt_state, params)
+            if lr_key is not None:
+                lrs = gstate[lr_key]
+                updates = jax.tree_util.tree_map(lambda u: u * lrs, updates)
+            new_params = optax.apply_updates(params, updates)
+            if g_mode == "reduce_scatter":
+                new_params = _shard_tree(new_params, repl)
+            return new_params, new_opt_state
+
         def train_step(params, aux, opt_state, gstate, rng, *data):
             inputs = {}
             if cdtype is not None:
@@ -489,12 +658,9 @@ class DataParallelTrainer:
                 loss_of, has_aux=True)(params)
             grads, loss, aux_updates = _unscale_grads(
                 grads, loss, aux_updates, scale, cdtype is not None)
-            import optax
-            updates, new_opt_state = tx.update(grads, opt_state, params)
-            if lr_key is not None:
-                lrs = gstate[lr_key]
-                updates = jax.tree_util.tree_map(lambda u: u * lrs, updates)
-            new_params = optax.apply_updates(params, updates)
+            grads = _reduce_grads(grads)
+            new_params, new_opt_state = _opt_apply(grads, opt_state,
+                                                   params, gstate)
             new_aux = dict(aux)
             for k, v in aux_updates.items():
                 if k in new_aux:
@@ -512,12 +678,12 @@ class DataParallelTrainer:
         gstate_spec = {k: repl for k in self._guard_state}
         in_shardings = (jax.tree_util.tree_map(lambda _: repl, self._params),
                         {k: repl for k in self._aux},
-                        jax.tree_util.tree_map(lambda _: repl, self._opt_state),
+                        self._opt_specs,
                         gstate_spec,
                         repl) + tuple(dataspec for _ in data_names)
         out_shardings = (jax.tree_util.tree_map(lambda _: repl, self._params),
                          {k: repl for k in self._aux},
-                         jax.tree_util.tree_map(lambda _: repl, self._opt_state),
+                         self._opt_specs,
                          gstate_spec,
                          repl)
         donate = (0, 1, 2, 3) if self._donate else ()
@@ -568,13 +734,8 @@ class DataParallelTrainer:
                     return grad_step(params, aux, rng, *data, scale=scale)
 
             def apply_step(params, opt_state, gstate, grads):
-                import optax
-                updates, new_opt_state = tx.update(grads, opt_state, params)
-                if lr_key is not None:
-                    lrs = gstate[lr_key]
-                    updates = jax.tree_util.tree_map(
-                        lambda u: u * lrs, updates)
-                new_params = optax.apply_updates(params, updates)
+                new_params, new_opt_state = _opt_apply(grads, opt_state,
+                                                       params, gstate)
                 if guard_cfg is not None:
                     # guard the synced (cross-worker summed) gradient: a NaN
                     # from ANY worker poisons the sum, so the skip decision
@@ -600,7 +761,10 @@ class DataParallelTrainer:
                 out_shardings=(gspec, {k: repl for k in self._aux},
                                repl))
             self._apply_fn = jax.jit(
-                apply_step, donate_argnums=(0, 1, 2) if self._donate else ())
+                apply_step,
+                in_shardings=(gspec, self._opt_specs, gstate_spec, gspec),
+                out_shardings=(gspec, self._opt_specs, gstate_spec),
+                donate_argnums=(0, 1, 2) if self._donate else ())
 
     # ---------------------------------------------------- AOT serialization
     # The compiled fused step can be serialized and reloaded by a LATER
@@ -634,6 +798,13 @@ class DataParallelTrainer:
             # is the strong check; this is the cheap first filter)
             "passes": repr((self._passes.names, self._passes.input_layout)
                            if self._passes is not None else None),
+            # the comm levers change the compiled programs (collective
+            # pattern, wire dtype, bucket fusion) AND the opt-state
+            # placement the executable expects; kvstore wire compression
+            # deliberately absent — it never enters the executable
+            "grad_reduce": self._grad_reduce,
+            "grad_reduce_dtype": str(self._grad_reduce_dtype),
+            "bucket_bytes": self._bucket_bytes,
         }
 
     def _lowered_digest(self, lowered) -> str:
@@ -741,13 +912,21 @@ class DataParallelTrainer:
         return True
 
     def _place_state(self):
-        """Pin params/aux/opt_state to their replicated shardings: unlike
-        jit, a deserialized executable does not auto-reshard its inputs."""
+        """Pin params/aux/opt_state to their home shardings (params
+        replicated; opt-state per-leaf — ZeRO leaves sharded over the data
+        axis): unlike jit, a deserialized executable does not auto-reshard
+        its inputs — and every restore path (checkpoint, rolling snapshot)
+        funnels through here, so a ZeRO-sharded optimizer lands back
+        sharded bitwise."""
         repl = NamedSharding(self._mesh, P())
         put = lambda t: jax.device_put(t, repl)  # noqa: E731
         self._params = jax.tree_util.tree_map(put, self._params)
         self._aux = jax.tree_util.tree_map(put, self._aux)
-        self._opt_state = jax.tree_util.tree_map(put, self._opt_state)
+        if self._opt_specs is not None:
+            self._opt_state = jax.tree_util.tree_map(
+                jax.device_put, self._opt_state, self._opt_specs)
+        else:
+            self._opt_state = jax.tree_util.tree_map(put, self._opt_state)
         if self._guard_state is not None:
             self._guard_state = jax.tree_util.tree_map(put, self._guard_state)
 
@@ -888,25 +1067,35 @@ class DataParallelTrainer:
             grads, self._aux, loss = self._grad_fn(
                 self._params, self._aux, rng, *arrays)
         kv = self._kv
+        # grad_reduce_dtype applies to the kv WIRE too: gradients travel
+        # (and merge) in the reduction dtype, and come back to f32 before
+        # the jitted apply — same accumulate-in-f32 contract as the fused
+        # path's in-trace cast
+        rdt = self._grad_reduce_dtype
+
+        def wire(g):
+            return g.astype(rdt) if rdt is not None else g
+
         if not self._kv_inited:
             for n in self._param_names:
-                kv.init("dpt_grad_" + n, _wrap(jnp.zeros_like(grads[n])))
+                kv.init("dpt_grad_" + n, _wrap(wire(jnp.zeros_like(grads[n]))))
             self._kv_inited = True
             # the apply program spans the local mesh: params must sit
             # replicated on it, not wherever capture left them
             self._place_state()
         for i, n in enumerate(self._param_names):
-            kv.push("dpt_grad_" + n, _wrap(grads[n]), priority=-i)
+            kv.push("dpt_grad_" + n, _wrap(wire(grads[n])), priority=-i)
         nworkers = max(1, getattr(kv, "num_workers", 1))
         repl = NamedSharding(self._mesh, P())
         synced = {}
         for n in self._param_names:
-            out = _wrap(grads[n])
+            out = _wrap(wire(grads[n]))
             kv.pull("dpt_grad_" + n, out=out)
             # the store round-trip (esp. the codec decode) may land the
             # gradient on a single device; re-replicate over the mesh so
             # the jitted apply sees one consistent placement
-            synced[n] = jax.device_put(out._data / nworkers, repl)
+            synced[n] = jax.device_put(
+                out._data.astype(jnp.float32) / nworkers, repl)
         self._params, self._opt_state, self._guard_state = self._apply_fn(
             self._params, self._opt_state, self._guard_state, synced)
         return loss
@@ -1012,6 +1201,37 @@ class DataParallelTrainer:
             if mfu is not None:
                 stats["mfu"] = mfu
         return stats
+
+    def comm_config(self) -> Dict[str, Any]:
+        """The communication-lever configuration this trainer runs — the
+        scale-out half of the perf provenance (stamped into bench rows the
+        way ``passes_provenance`` stamps the graph-pass half)."""
+        return {"grad_reduce": self._grad_reduce,
+                "grad_reduce_dtype": (str(self._grad_reduce_dtype)
+                                      if self._grad_reduce_dtype is not None
+                                      else None),
+                "bucket_bytes": self._bucket_bytes,
+                "compression": self._compression_params,
+                "n_devices": int(self._mesh.devices.size)}
+
+    def opt_state_bytes(self) -> Dict[str, int]:
+        """Optimizer-state memory: ``total_bytes`` (the logical tree) and
+        ``per_chip_bytes`` (what one chip actually holds — the number the
+        ZeRO-1 sharded optimizer divides by N). Empty dict before capture."""
+        if self._opt_state is None:
+            return {}
+        dev0 = self._mesh.devices.ravel()[0]
+        total = per_chip = 0
+        for leaf in jax.tree_util.tree_leaves(self._opt_state):
+            nbytes = int(getattr(leaf, "nbytes", 0))
+            total += nbytes
+            shards = getattr(leaf, "addressable_shards", None)
+            if shards:
+                per_chip += sum(int(s.data.nbytes) for s in shards
+                                if s.device == dev0)
+            else:
+                per_chip += nbytes
+        return {"total_bytes": total, "per_chip_bytes": per_chip}
 
     # ------------------------------------------------- recovery state hooks
     def set_loss_scale(self, scale: float) -> None:

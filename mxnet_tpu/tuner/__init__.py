@@ -1,7 +1,8 @@
 """mxnet_tpu.tuner — the self-tuning perf lab (ROADMAP item 1).
 
 Searches the training-step config space — batch size, NCHW/NHWC layout
-(+ space-to-depth stem), remat policy, buffer donation, prefetch depth —
+(+ space-to-depth stem), remat policy, buffer donation, prefetch depth,
+and the comm levers (grad_reduce / grad_reduce_dtype / bucket_bytes) —
 instead of requiring a human to run bench ladders:
 
 ==========  ============================================================

@@ -23,12 +23,16 @@ from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 
 from ..base import MXNetError
 
-__all__ = ["LAYOUTS", "REMAT_MODES", "Candidate", "SearchSpace"]
+__all__ = ["LAYOUTS", "REMAT_MODES", "GRAD_REDUCE_MODES", "Candidate",
+           "SearchSpace"]
 
 LAYOUTS = ("NCHW", "NHWC")
 # the remat spellings DataParallelTrainer knows (None == "none" == off);
 # callables are deliberately out of the search space — they don't serialize
 REMAT_MODES = (None, "none", "full", "dots")
+# the gradient-reduction strategies DataParallelTrainer knows: plain
+# replicated all-reduce vs the ZeRO-1 reduce-scatter + sharded optimizer
+GRAD_REDUCE_MODES = ("all_reduce", "reduce_scatter")
 
 
 def _norm_remat(remat) -> Optional[str]:
@@ -40,14 +44,28 @@ def _norm_remat(remat) -> Optional[str]:
                      f"got {remat!r}")
 
 
+def _norm_reduce_dtype(dt) -> Optional[str]:
+    if dt in (None, "none", "", "float32", "f32"):
+        return None
+    alias = {"bf16": "bfloat16", "fp16": "float16"}
+    dt = alias.get(str(dt), str(dt))
+    if dt not in ("bfloat16", "float16"):
+        raise MXNetError("candidate grad_reduce_dtype must be none/"
+                         f"bfloat16/float16, got {dt!r}")
+    return dt
+
+
 class Candidate:
     """One point of the search space. Immutable value object."""
 
     __slots__ = ("batch", "layout", "s2d", "remat", "donate",
-                 "prefetch_depth")
+                 "prefetch_depth", "grad_reduce", "grad_reduce_dtype",
+                 "bucket_bytes")
 
     def __init__(self, batch: int, layout: str = "NCHW", s2d: bool = False,
-                 remat=None, donate: bool = True, prefetch_depth: int = 2):
+                 remat=None, donate: bool = True, prefetch_depth: int = 2,
+                 grad_reduce: str = "all_reduce", grad_reduce_dtype=None,
+                 bucket_bytes: Optional[int] = None):
         batch = int(batch)
         if batch <= 0:
             raise MXNetError(f"candidate batch must be positive, got {batch}")
@@ -57,12 +75,31 @@ class Candidate:
         if s2d and layout != "NHWC":
             raise MXNetError("the space-to-depth stem is an NHWC-only "
                              "reparameterization (tests/test_s2d_stem.py)")
+        if grad_reduce not in GRAD_REDUCE_MODES:
+            raise MXNetError("candidate grad_reduce must be one of "
+                             f"{GRAD_REDUCE_MODES}, got {grad_reduce!r}")
+        if bucket_bytes in (None, 0, "none"):
+            bucket_bytes = None
+        else:
+            bucket_bytes = int(bucket_bytes)
+            if bucket_bytes <= 0:
+                raise MXNetError("candidate bucket_bytes must be positive, "
+                                 f"got {bucket_bytes}")
+            if grad_reduce == "reduce_scatter":
+                raise MXNetError(
+                    "bucket_bytes is an all_reduce-path lever; the ZeRO "
+                    "reduce_scatter path fuses its own per-leaf collectives "
+                    "(DataParallelTrainer enforces the same)")
         object.__setattr__(self, "batch", batch)
         object.__setattr__(self, "layout", str(layout))
         object.__setattr__(self, "s2d", bool(s2d))
         object.__setattr__(self, "remat", _norm_remat(remat))
         object.__setattr__(self, "donate", bool(donate))
         object.__setattr__(self, "prefetch_depth", max(0, int(prefetch_depth)))
+        object.__setattr__(self, "grad_reduce", str(grad_reduce))
+        object.__setattr__(self, "grad_reduce_dtype",
+                           _norm_reduce_dtype(grad_reduce_dtype))
+        object.__setattr__(self, "bucket_bytes", bucket_bytes)
 
     def __setattr__(self, *_):
         raise AttributeError("Candidate is immutable")
@@ -81,17 +118,28 @@ class Candidate:
             tag += "+nodonate"
         if self.prefetch_depth != 2:
             tag += f"+pf{self.prefetch_depth}"
+        if self.grad_reduce != "all_reduce":
+            tag += "+rs"
+        if self.grad_reduce_dtype is not None:
+            tag += f"+rd={self.grad_reduce_dtype}"
+        if self.bucket_bytes is not None:
+            tag += f"+bb={self.bucket_bytes}"
         return tag
 
     def as_dict(self) -> Dict[str, Any]:
         return {"batch": self.batch, "layout": self.layout, "s2d": self.s2d,
                 "remat": self.remat, "donate": self.donate,
-                "prefetch_depth": self.prefetch_depth}
+                "prefetch_depth": self.prefetch_depth,
+                "grad_reduce": self.grad_reduce,
+                "grad_reduce_dtype": self.grad_reduce_dtype,
+                "bucket_bytes": self.bucket_bytes}
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "Candidate":
         return cls(**{k: d[k] for k in ("batch", "layout", "s2d", "remat",
-                                        "donate", "prefetch_depth")
+                                        "donate", "prefetch_depth",
+                                        "grad_reduce", "grad_reduce_dtype",
+                                        "bucket_bytes")
                       if k in d})
 
     def key(self, device_kind: Optional[str] = None, model: str = "",
@@ -140,8 +188,14 @@ class Candidate:
         """The DataParallelTrainer ctor levers this candidate carries.
         ``batch``/``layout``/``s2d`` are data- and net-level choices (the
         caller's ``build``/``data`` functions consume them); ``prefetch_depth``
-        is a feed-level knob (``io.prefetch_to_device(depth=...)``)."""
-        return {"remat": self.remat, "donate": self.donate}
+        is a feed-level knob (``io.prefetch_to_device(depth=...)``). The
+        comm levers (``grad_reduce``/``grad_reduce_dtype``/``bucket_bytes``)
+        pass straight through — ``mxtune`` searches comm config exactly
+        like it searches layout/remat."""
+        return {"remat": self.remat, "donate": self.donate,
+                "grad_reduce": self.grad_reduce,
+                "grad_reduce_dtype": self.grad_reduce_dtype,
+                "bucket_bytes": self.bucket_bytes}
 
     def passes_manager(self):
         """This candidate's ``layout``/``s2d`` dimensions as a graph-pass
@@ -193,14 +247,18 @@ class SearchSpace:
     against.
     """
 
-    DIMS = ("batch", "layout", "s2d", "remat", "donate", "prefetch_depth")
+    DIMS = ("batch", "layout", "s2d", "remat", "donate", "prefetch_depth",
+            "grad_reduce", "grad_reduce_dtype", "bucket_bytes")
 
     def __init__(self, batch: Sequence[int] = (256, 512),
                  layout: Sequence[str] = ("NCHW", "NHWC"),
                  s2d: Sequence[bool] = (False,),
                  remat: Sequence = (None,),
                  donate: Sequence[bool] = (True,),
-                 prefetch_depth: Sequence[int] = (2,)):
+                 prefetch_depth: Sequence[int] = (2,),
+                 grad_reduce: Sequence[str] = ("all_reduce",),
+                 grad_reduce_dtype: Sequence = (None,),
+                 bucket_bytes: Sequence = (None,)):
         def tup(v):
             return tuple(v) if isinstance(v, (list, tuple)) else (v,)
         self.batch = tup(batch)
@@ -209,23 +267,33 @@ class SearchSpace:
         self.remat = tup(remat)
         self.donate = tup(donate)
         self.prefetch_depth = tup(prefetch_depth)
+        self.grad_reduce = tup(grad_reduce)
+        self.grad_reduce_dtype = tup(grad_reduce_dtype)
+        self.bucket_bytes = tup(bucket_bytes)
         for name in self.DIMS:
             if not getattr(self, name):
                 raise MXNetError(f"search-space dimension {name!r} is empty")
 
     def enumerate(self) -> List[Candidate]:
         """Every valid candidate, baseline first. Invalid combinations
-        (s2d on a non-NHWC layout) are skipped, not errors — a space may
-        legitimately declare s2d=(False, True) next to both layouts."""
+        (s2d on a non-NHWC layout; bucket_bytes next to the ZeRO
+        reduce_scatter path, which fuses its own collectives) are skipped,
+        not errors — a space may legitimately declare both values of every
+        dimension at once."""
         out: List[Candidate] = []
         for vals in itertools.product(self.batch, self.layout, self.s2d,
                                       self.remat, self.donate,
-                                      self.prefetch_depth):
-            b, lay, s2d, rm, don, pf = vals
+                                      self.prefetch_depth, self.grad_reduce,
+                                      self.grad_reduce_dtype,
+                                      self.bucket_bytes):
+            b, lay, s2d, rm, don, pf, gr, grd, bb = vals
             if s2d and lay != "NHWC":
                 continue
+            if bb not in (None, 0) and gr == "reduce_scatter":
+                continue
             out.append(Candidate(b, lay, s2d=s2d, remat=rm, donate=don,
-                                 prefetch_depth=pf))
+                                 prefetch_depth=pf, grad_reduce=gr,
+                                 grad_reduce_dtype=grd, bucket_bytes=bb))
         if not out:
             raise MXNetError("search space enumerates to zero valid "
                              "candidates")
@@ -245,12 +313,16 @@ class SearchSpace:
         return f"SearchSpace({self.as_dict()})"
 
     # --------------------------------------------------------------- parse
-    _ALIASES = {"prefetch": "prefetch_depth", "pf": "prefetch_depth"}
+    _ALIASES = {"prefetch": "prefetch_depth", "pf": "prefetch_depth",
+                "reduce": "grad_reduce", "reduce_dtype": "grad_reduce_dtype",
+                "bucket": "bucket_bytes"}
 
     @classmethod
     def from_spec(cls, spec: str) -> "SearchSpace":
         """Parse the CLI spelling: ``dim=v1,v2;dim=v1`` — e.g.
-        ``batch=256,512;layout=NHWC;remat=none,full;donate=1,0``."""
+        ``batch=256,512;layout=NHWC;remat=none,full;donate=1,0;``
+        ``grad_reduce=all_reduce,reduce_scatter;grad_reduce_dtype=none,bf16;``
+        ``bucket_bytes=none,4194304``."""
         kw: Dict[str, Any] = {}
         for part in (spec or "").split(";"):
             part = part.strip()
@@ -271,9 +343,13 @@ class SearchSpace:
                     parsed.append(int(tok))
                 elif name in ("s2d", "donate"):
                     parsed.append(tok.lower() in ("1", "true", "yes", "on"))
-                elif name == "remat":
+                elif name in ("remat", "grad_reduce_dtype"):
                     parsed.append(None if tok.lower() in ("none", "off", "")
                                   else tok)
+                elif name == "bucket_bytes":
+                    parsed.append(None if tok.lower() in ("none", "off", "0",
+                                                          "")
+                                  else int(tok))
                 else:
                     parsed.append(tok)
             kw[name] = tuple(parsed)

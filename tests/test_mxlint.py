@@ -716,3 +716,89 @@ def test_lint_trainer_t211_silent_cases(rng, tmp_path, monkeypatch):
     xcost.CostLedger(cache3).append(_tuner_cache_row(kind))
     t2, x2, y2 = _lowprec_trainer(rng, "t211b_", remat="full")
     assert not analysis.lint_trainer(t2, x2, y2).by_rule("MXL-T211")
+
+
+# ------------------------------------------------------------- MXL-T212
+def _rs_cache_row(kind, net_class="HybridSequential", n_devices=None,
+                  grad_reduce="reduce_scatter"):
+    from mxnet_tpu.tuner import Candidate
+    return {"label": "tuner.trial", "provenance": "measured",
+            "device_kind": kind, "model": "t212-model",
+            "net_class": net_class,
+            "n_devices": (jax.device_count() if n_devices is None
+                          else n_devices),
+            "measured_step_ms": 2.0,
+            "throughput_img_s_per_chip": 4100.0,
+            "tuner_config": Candidate(16, "NCHW",
+                                      grad_reduce=grad_reduce).as_dict(),
+            "config_key": "t212"}
+
+
+def test_lint_trainer_t212_flags_replicated_optimizer(rng, tmp_path,
+                                                      monkeypatch):
+    """Multi-device trainer on the default all-reduce path + a measured
+    reduce_scatter win in the tuner cache for the same signature —
+    MXL-T212."""
+    from mxnet_tpu.observability import xcost
+    cache = str(tmp_path / "t212.jsonl")
+    monkeypatch.setenv("MXNET_TUNER_CACHE", cache)
+    kind = jax.devices()[0].device_kind
+    xcost.CostLedger(cache).append(_rs_cache_row(kind))
+    t, x, y = _lowprec_trainer(rng, "t212_")
+    r = analysis.lint_trainer(t, x, y)
+    hits = r.by_rule("MXL-T212")
+    assert len(hits) == 1, r.to_text()
+    assert hits[0].severity == "warning"
+    assert "reduce_scatter" in hits[0].message
+    assert "4100.0 img/s/chip" in hits[0].message
+    assert "grad_reduce='reduce_scatter'" in hits[0].hint
+    # the standard suppression channel silences it
+    r = analysis.lint_trainer(t, x, y, suppress=("MXL-T212",))
+    assert not r.by_rule("MXL-T212")
+    assert any(d.rule_id == "MXL-T212" for d in r.suppressed)
+
+
+def test_lint_trainer_t212_silent_cases(rng, tmp_path, monkeypatch):
+    """No cache evidence, a foreign signature, a cached best that is NOT
+    reduce_scatter, or a trainer already sharding its optimizer: silent."""
+    from mxnet_tpu.observability import xcost
+    cache = str(tmp_path / "t212s.jsonl")
+    monkeypatch.setenv("MXNET_TUNER_CACHE", cache)
+    kind = jax.devices()[0].device_kind
+
+    # empty cache
+    t, x, y = _lowprec_trainer(rng, "t212a_")
+    assert not analysis.lint_trainer(t, x, y).by_rule("MXL-T212")
+
+    # cached best is all_reduce: no measured sharded win exists
+    xcost.CostLedger(cache).append(
+        _rs_cache_row(kind, grad_reduce="all_reduce"))
+    assert not analysis.lint_trainer(t, x, y).by_rule("MXL-T212")
+
+    # reduce_scatter row, but for another device kind / net class / count
+    cache2 = str(tmp_path / "t212s2.jsonl")
+    monkeypatch.setenv("MXNET_TUNER_CACHE", cache2)
+    led2 = xcost.CostLedger(cache2)
+    led2.append(_rs_cache_row("TPU v99"))
+    led2.append(_rs_cache_row(kind, net_class="ResNetV1"))
+    led2.append(_rs_cache_row(kind, n_devices=jax.device_count() + 24))
+    assert not analysis.lint_trainer(t, x, y).by_rule("MXL-T212")
+
+    # a trainer ALREADY running the sharded optimizer is never nagged
+    cache3 = str(tmp_path / "t212s3.jsonl")
+    monkeypatch.setenv("MXNET_TUNER_CACHE", cache3)
+    xcost.CostLedger(cache3).append(_rs_cache_row(kind))
+    t2, x2, y2 = _lowprec_trainer(rng, "t212b_",
+                                  grad_reduce="reduce_scatter")
+    assert not analysis.lint_trainer(t2, x2, y2).by_rule("MXL-T212")
+
+    # dp=1 on a multi-axis mesh: reduce_scatter would shard NOTHING (the
+    # ZeRO divisor is the dp extent, not the device count) — silent even
+    # with a matching cache row for the full chip count
+    from mxnet_tpu.parallel import make_mesh
+    cache4 = str(tmp_path / "t212s4.jsonl")
+    monkeypatch.setenv("MXNET_TUNER_CACHE", cache4)
+    xcost.CostLedger(cache4).append(_rs_cache_row(kind, n_devices=1))
+    t3, x3, y3 = _lowprec_trainer(rng, "t212c_",
+                                  mesh=make_mesh({"dp": 1, "tp": 8}))
+    assert not analysis.lint_trainer(t3, x3, y3).by_rule("MXL-T212")

@@ -584,3 +584,103 @@ def test_mxopt_cli_json_and_dead_nodes(tmp_path):
     p = subprocess.run([sys.executable, mxopt, str(tmp_path / "nope.json")],
                        capture_output=True, text=True, timeout=120, env=env)
     assert p.returncode == 2
+
+
+# ------------------------------------------------------------- collbench
+def test_collbench_cli_smoke(tmp_path):
+    """tools/collbench.py end-to-end on the virtual 8-device mesh: JSON
+    rows on stdout, every row persisted to the given ledger, exit 0; bad
+    arguments exit 2 (mxlint convention)."""
+    import json
+    cli = os.path.join(REPO, "tools", "collbench.py")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": ""}
+    ledger = str(tmp_path / "coll.jsonl")
+
+    p = subprocess.run(
+        [sys.executable, cli, "--ops", "psum,reduce_scatter",
+         "--sizes", "16K", "--devices", "1,8", "--steps", "2",
+         "--warmup", "1", "--compression", "0.5",
+         "--ledger", ledger, "--format", "json"],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert p.returncode == 0, p.stdout + p.stderr
+    rows = [json.loads(l) for l in p.stdout.splitlines() if l.strip()]
+    # 2 ops x 2 device counts + 1 compressed comparison per count
+    assert len(rows) == 6, rows
+    ops = {(r["op"], r["n_devices"]) for r in rows}
+    assert ("psum", 8) in ops and ("psum_compressed", 8) in ops
+    for r in rows:
+        assert r["label"] == "collbench" and r["ms"] > 0
+    with open(ledger) as f:
+        assert len(f.readlines()) == len(rows)
+
+    # bad device count -> cannot run
+    p = subprocess.run([sys.executable, cli, "--devices", "99",
+                        "--sizes", "4K", "--steps", "1",
+                        "--ledger", ledger],
+                       capture_output=True, text=True, timeout=300, env=env)
+    assert p.returncode == 2, p.stdout + p.stderr
+
+    # partial sweep: the 1-device cells measure, 99 fails -> exit 1 with
+    # the measured rows still emitted (not misclassified as 'cannot run')
+    p = subprocess.run([sys.executable, cli, "--devices", "1,99",
+                        "--ops", "psum", "--sizes", "4K", "--steps", "1",
+                        "--ledger", ledger, "--format", "json"],
+                       capture_output=True, text=True, timeout=300, env=env)
+    assert p.returncode == 1, p.stdout + p.stderr
+    partial = [json.loads(l) for l in p.stdout.splitlines() if l.strip()]
+    assert len(partial) == 1 and partial[0]["n_devices"] == 1
+
+    # unparsable size -> cannot run, before any backend init
+    p = subprocess.run([sys.executable, cli, "--sizes", "banana"],
+                       capture_output=True, text=True, timeout=60, env=env)
+    assert p.returncode == 2
+
+
+def test_collbench_registered_with_tunnel_session():
+    """The bench preflight must OWN a leaked collbench run: the marker
+    lists on both sides of the registry include it."""
+    import tunnel_session
+    bench_src = open(os.path.join(REPO, "bench.py")).read()
+    # every self-registering tunnel tool must appear on BOTH sides: in the
+    # registry's ownership markers (else owned_pids never returns it and
+    # the preflight can't kill a leftover) AND in bench's /proc scan (else
+    # it never blocks/clears a window) — mxtune was registry-invisible
+    # until this pairing was asserted
+    for tool in ("collbench.py", "mxtune.py", "perf_lab.py", "aot_warm.py"):
+        assert tool in tunnel_session.MARKERS, tool
+        assert tool in bench_src, tool
+
+
+def test_bench_multichip_emits_scaling_row(tmp_path):
+    """bench.py --multichip emits a REAL scaling-efficiency row (img/s/chip
+    at N devices vs 1 with comm-lever provenance) — the line replacing the
+    empty MULTICHIP_* dryrun tail."""
+    import json
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": "",
+           "BENCH_FORCE_CPU": "1", "BENCH_MC_STEPS": "2",
+           "BENCH_MC_COLLECTIVES": "0", "MXNET_SEED": "17",
+           "MXNET_PERF_LEDGER": str(tmp_path / "ledger.jsonl")}
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--multichip"],
+        capture_output=True, text=True, timeout=500, env=env)
+    assert p.returncode == 0, p.stdout + p.stderr
+    rows = [json.loads(l) for l in p.stdout.splitlines() if l.strip()]
+    [row] = [r for r in rows
+             if r.get("metric") == "multichip_scaling_efficiency"]
+    assert row["n_devices"] == 8
+    assert row["img_s_per_chip_1"] > 0 and row["img_s_per_chip_n"] > 0
+    assert row["value"] > 0
+    assert row["comm_config"]["grad_reduce"] == "reduce_scatter"
+    assert row["opt_state_bytes"]["per_chip_bytes"] < \
+        row["opt_state_bytes"]["total_bytes"]
+    assert "provenance" in row
+    # the row also landed in the cost ledger for perfwatch/tuner readers —
+    # WITH its identity fields (a persisted row missing model/provenance
+    # would masquerade as a real-chip measurement to filtered readers)
+    with open(env["MXNET_PERF_LEDGER"]) as f:
+        ledger_rows = [json.loads(l) for l in f if l.strip()]
+    [lrow] = [r for r in ledger_rows
+              if r.get("metric") == "multichip_scaling_efficiency"]
+    assert lrow["model"] == row["model"]
+    assert lrow["provenance"] == row["provenance"]
+    assert "degraded" in lrow          # cpu run: flagged in the ledger too

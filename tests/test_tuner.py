@@ -487,3 +487,108 @@ def test_cache_path_resolution(monkeypatch, tmp_path):
     assert tuner.cache_path() == str(tmp_path / "p.jsonl")
     monkeypatch.delenv("MXNET_PERF_LEDGER")
     assert tuner.cache_path().endswith("mxtpu_cost_ledger.jsonl")
+
+
+# ------------------------------------------------ comm search dimensions
+def test_candidate_comm_levers():
+    """ISSUE 10: grad_reduce / grad_reduce_dtype / bucket_bytes are
+    first-class search dimensions — serialized, keyed, validated."""
+    c = Candidate(256, grad_reduce="reduce_scatter",
+                  grad_reduce_dtype="bf16")
+    assert c.label == "NCHW:256+rs+rd=bfloat16"
+    assert c.grad_reduce_dtype == "bfloat16"          # normalized spelling
+    assert Candidate.from_dict(c.as_dict()) == c
+    b = Candidate(256, bucket_bytes=1 << 20)
+    assert b.label == "NCHW:256+bb=%d" % (1 << 20)
+    # the comm config is part of the warm-start identity: a reduce_scatter
+    # measurement must never warm-start an all_reduce search
+    base_key = Candidate(256).key("cpu")
+    assert c.key("cpu") != base_key
+    assert b.key("cpu") != base_key
+    assert Candidate(256, grad_reduce_dtype="bfloat16").key("cpu") != \
+        base_key
+    with pytest.raises(MXNetError):
+        Candidate(256, grad_reduce="ring")
+    with pytest.raises(MXNetError):
+        Candidate(256, grad_reduce_dtype="float64")
+    with pytest.raises(MXNetError):
+        Candidate(256, grad_reduce="reduce_scatter", bucket_bytes=1024)
+
+
+def test_search_space_comm_dims_enumeration():
+    sp = SearchSpace.from_spec(
+        "batch=32;layout=NCHW;grad_reduce=all_reduce,reduce_scatter;"
+        "grad_reduce_dtype=none,bf16;bucket_bytes=none,65536")
+    cands = sp.enumerate()
+    # 2 x 2 x 2 = 8 minus the 2 invalid reduce_scatter+bucket combos
+    assert len(cands) == 6
+    assert sp.baseline() == Candidate(32)             # first-of-every-dim
+    assert any(c.grad_reduce == "reduce_scatter"
+               and c.grad_reduce_dtype == "bfloat16" for c in cands)
+    assert any(c.bucket_bytes == 65536 for c in cands)
+    assert all(not (c.bucket_bytes and c.grad_reduce == "reduce_scatter")
+               for c in cands)
+    # alias spellings parse too
+    sp2 = SearchSpace.from_spec("batch=8;reduce=reduce_scatter;bucket=none")
+    assert sp2.enumerate()[0].grad_reduce == "reduce_scatter"
+
+
+def test_comm_candidate_builds_bitwise_identical_trainer():
+    """A comm-lever candidate applied through build_trainer lowers to the
+    SAME StableHLO as hand-written DataParallelTrainer kwargs — the tuner
+    measures exactly the program the user would run."""
+    from mxnet_tpu.parallel import DataParallelTrainer
+    cand = Candidate(16, grad_reduce="reduce_scatter",
+                     grad_reduce_dtype="bf16")
+
+    def fresh():
+        mx.random.seed(31)
+        net = nn.HybridSequential(prefix="commrt_")
+        net.add(nn.Dense(16, prefix="commrt_d0_"))
+        net.initialize(mx.init.Xavier())
+        return net, gluon.loss.L2Loss()
+
+    x = np.random.RandomState(3).randn(16, 8).astype("float32")
+    y = np.random.RandomState(4).randn(16, 16).astype("float32")
+    net_a, loss_a = fresh()
+    via_cand = cand.build_trainer(net_a, loss_a, "sgd",
+                                  {"learning_rate": 0.1})
+    net_b, loss_b = fresh()
+    by_hand = DataParallelTrainer(net_b, loss_b, "sgd",
+                                  {"learning_rate": 0.1}, passes=False,
+                                  grad_reduce="reduce_scatter",
+                                  grad_reduce_dtype="bf16")
+    assert via_cand._lowered_digest(via_cand.lower(x, y)) == \
+        by_hand._lowered_digest(by_hand.lower(x, y))
+    # and the lever actually reached the trainer
+    assert via_cand.comm_config()["grad_reduce"] == "reduce_scatter"
+    assert via_cand.comm_config()["grad_reduce_dtype"] == "bfloat16"
+
+
+def test_tune_searches_comm_space(tmp_path, monkeypatch):
+    """mxtune-style search over {grad_reduce, grad_reduce_dtype,
+    bucket_bytes}: every trial lands in the cache with its comm config in
+    tuner_config, and a repeat search is a pure warm start."""
+    _peaks(monkeypatch)
+    led = _ledger(tmp_path)
+    sp = SearchSpace(batch=(16,), layout=("NCHW",),
+                     grad_reduce=("all_reduce", "reduce_scatter"),
+                     grad_reduce_dtype=(None, "bf16"))
+    res = tuner.tune(_build, _data, sp, measure=True, top_k=1, steps=2,
+                     warmup=0, ledger=led, model="commsearch")
+    assert len(res.trials) == 4
+    rows = [r for r in led.rows() if r.get("label") == tuner.TRIAL_LABEL]
+    configs = {(r["tuner_config"]["grad_reduce"],
+                r["tuner_config"]["grad_reduce_dtype"]) for r in rows}
+    assert configs == {("all_reduce", None), ("all_reduce", "bfloat16"),
+                       ("reduce_scatter", None),
+                       ("reduce_scatter", "bfloat16")}
+    assert any(r.get("measured_step_ms") for r in rows)
+    # warm start: the repeat search reuses every row, appends only the
+    # next measured trial's facts (config-key hits re-lower nothing)
+    n_before = len(led.rows())
+    res2 = tuner.tune(_build, _data, sp, measure=False, ledger=led,
+                      model="commsearch")
+    assert all(t.provenance == "cached" for t in res2.trials
+               if t.error is None)
+    assert len(led.rows()) == n_before

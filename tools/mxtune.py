@@ -2,7 +2,8 @@
 """mxtune — cost-model-guided autotuner CLI (mxnet_tpu.tuner).
 
 Searches the training-step config space (batch, layout, remat, donation,
-prefetch depth) with the predict-then-measure loop: every candidate's step
+prefetch depth — and the comm levers grad_reduce / grad_reduce_dtype /
+bucket_bytes) with the predict-then-measure loop: every candidate's step
 is lowered and scored through the XLA-cost roofline model (plus a learned
 correction once measured rows exist), only the top-K predictions are
 actually run, and every trial lands in the warm-start ledger cache
@@ -119,14 +120,17 @@ def _common_basis(best, base):
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
-        description="search (batch, layout, remat, donation, prefetch) "
+        description="search (batch, layout, remat, donation, prefetch, "
+                    "grad_reduce, grad_reduce_dtype, bucket_bytes) "
                     "with the cost-model-guided autotuner")
     ap.add_argument("--model", default="resnet50",
                     help="resnet50 (the bench north star) or tiny "
                          "(CPU-fast MLP smoke)")
     ap.add_argument("--space", default=None,
-                    help="search space, e.g. "
-                         "'batch=256,512;layout=NHWC;remat=none,full'")
+                    help="search space, e.g. 'batch=256,512;layout=NHWC;"
+                         "remat=none,full;grad_reduce=all_reduce,"
+                         "reduce_scatter;grad_reduce_dtype=none,bf16;"
+                         "bucket_bytes=none,4194304'")
     ap.add_argument("--seed-ladder", action="store_true",
                     help="search the staged bench ladder variants "
                          "(RMT:512, S2D:256, NHWC:512, NCHW:256) instead "
