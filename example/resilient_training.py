@@ -95,6 +95,27 @@ def main(argv=None):
                          "nothing): shorter storms are absorbed as plain "
                          "guard skips, which lose those batches by "
                          "design")
+    ap.add_argument("--elastic", action="store_true",
+                    default=os.environ.get("MXNET_ELASTIC", "")
+                    not in ("", "0"),
+                    help="elastic data parallelism: train the ZeRO-1 "
+                         "sharded optimizer (grad_reduce='reduce_scatter')"
+                         " with elastic checkpoint adoption — a restart "
+                         "that sees a DIFFERENT device count re-shards "
+                         "optimizer state N→M and re-splits the global "
+                         "batch instead of dying. Defaults on when "
+                         "$MXNET_ELASTIC is set (how tools/crashloop.py "
+                         "--devices-schedule arms it). Keep --batch-size "
+                         "divisible by every device count in the "
+                         "schedule. NOTE: across a topology change the "
+                         "trajectory is float-equivalent, not bitwise "
+                         "(the reduction order changes) — compare with "
+                         "--dump-params + crashloop --expect-params, not "
+                         "the sha256 digest")
+    ap.add_argument("--dump-params", default=None, metavar="PATH",
+                    help="write the final parameters as an npz on "
+                         "completion — the tolerance-comparison artifact "
+                         "for elastic runs (crashloop --expect-params)")
     ap.add_argument("--recovery", action="store_true",
                     default=os.environ.get("MXNET_CHAOS_RECOVERY", "")
                     not in ("", "0"),
@@ -124,14 +145,22 @@ def main(argv=None):
         data_iter = NDArrayIter(X, Y, batch_size=args.batch_size,
                                 shuffle=True, last_batch_handle="discard")
     extra = {}
+    if args.elastic:
+        import jax
+        # ZeRO-1 sharded optimizer + elastic adoption: the mesh spans
+        # whatever device set THIS attempt sees (crashloop's
+        # --devices-schedule changes it between attempts)
+        extra.update({"grad_reduce": "reduce_scatter", "elastic": True})
+        print("elastic: training on %d visible device(s)"
+              % jax.device_count(), flush=True)
     if args.recovery:
         # deterministic, demo-scaled ladder: snapshot often, trip after 3
         # consecutive skips, observe synchronously (lag=0) so the chaos
         # window and the recovery land at reproducible steps
-        extra = {"compute_dtype": "bfloat16", "loss_scaling": True,
-                 "recovery": {"snapshot_every": 5, "max_skips": 3,
-                              "lag": 0, "heal_steps": 10,
-                              "lr_backoff": 1.0}}
+        extra.update({"compute_dtype": "bfloat16", "loss_scaling": True,
+                      "recovery": {"snapshot_every": 5, "max_skips": 3,
+                                   "lag": 0, "heal_steps": 10,
+                                   "lr_backoff": 1.0}})
     rt = ResilientTrainer(
         make_net(), gluon.loss.SoftmaxCrossEntropyLoss(),
         "sgd", {"learning_rate": 0.1, "momentum": 0.9},
@@ -189,6 +218,10 @@ def main(argv=None):
     digest = hashlib.sha256()
     for name in sorted(rt.trainer._params):
         digest.update(np.asarray(rt.trainer._params[name]).tobytes())
+    if args.dump_params:
+        np.savez(args.dump_params,
+                 **{n: np.asarray(v) for n, v in rt.trainer._params.items()})
+        print("final params dumped to %s" % args.dump_params, flush=True)
     rt.save()
     rt.close()
     if args.telemetry_snapshot:
@@ -197,6 +230,12 @@ def main(argv=None):
         print("telemetry snapshot written to %s"
               % observability.write_snapshot(args.telemetry_snapshot))
     print("training complete at step %d" % rt.step_count)
+    if args.elastic and rt.reshard_history:
+        print("elastic: adopted %d topology change(s): %s"
+              % (len(rt.reshard_history),
+                 ["%s dp %d->%d" % (r["direction"], r["from_dp"],
+                                    r["to_dp"])
+                  for r in rt.reshard_history]), flush=True)
     if args.inject_nan:
         print("chaos: poisoned %d step(s); recovery ladder history: %s"
               % (storm_state.get("poisoned", 0), rt.recovery_history),

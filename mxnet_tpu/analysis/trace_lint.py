@@ -38,6 +38,10 @@ Rules (catalog in docs/static_analysis.md):
                                           levers while the tuner cache has
                                           a differing measured best config
                                           for the same model/device
+* MXL-T213 inelastic-restore    (warning) ResilientTrainer whose newest
+                                          checkpoint manifest records a
+                                          different mesh topology, without
+                                          elastic adoption enabled
 * MXL-T212 replicated-optimizer-at-scale (warning) multi-device trainer on
                                           the default all-reduce path with
                                           fully replicated optimizer state
@@ -129,6 +133,16 @@ register_rule(
     "heavier collective, while the ZeRO-1 sharded optimizer "
     "(DataParallelTrainer(grad_reduce='reduce_scatter')) is one ctor "
     "kwarg away with a measurement already on file.")
+register_rule(
+    "MXL-T213", "warning", "inelastic-restore",
+    "A ResilientTrainer whose checkpoint directory's newest manifest "
+    "records a different mesh topology (n_devices/dp extent) than the "
+    "live mesh, without elastic adoption enabled: the very first "
+    "auto-resume will raise TopologyMismatch instead of training. "
+    "Enable elastic data parallelism (ResilientTrainer(elastic=True), "
+    "MXNET_ELASTIC=1, or resilience.ElasticTrainer) to adopt the "
+    "checkpoint — ZeRO-1 optimizer state re-sharded N→M, global batch "
+    "re-split, iterator state credited back.")
 register_rule(
     "MXL-T211", "warning", "untuned-hot-loop",
     "The trainer runs with all-default perf levers while the autotuner "
@@ -532,6 +546,12 @@ def lint_trainer(trainer, *data, suppress: Sequence[str] = (),
     from ..ndarray import NDArray
     from ..ndarray.ndarray import _unwrap
 
+    # a ResilientTrainer lints as its inner DataParallelTrainer, plus the
+    # resilience-config checks (MXL-T213) only the wrapper can answer
+    resilient = None
+    if hasattr(trainer, "trainer") and hasattr(trainer, "checkpointer"):
+        resilient, trainer = trainer, trainer.trainer
+
     arrays = [_unwrap(d) if isinstance(d, NDArray) else jnp.asarray(d)
               for d in data]
     if trainer._step_fn is None:
@@ -715,4 +735,45 @@ def lint_trainer(trainer, *data, suppress: Sequence[str] = (),
                      "round-trip the sharded state bitwise — see "
                      "docs/performance.md 'Scale-out performance'), or "
                      "re-tune with tools/mxtune.py if the workload changed"))
+
+    # ---- inelastic restore (MXL-T213): a ResilientTrainer pointed at a
+    # checkpoint directory whose newest manifest records a DIFFERENT mesh
+    # topology, without elastic adoption enabled — its first auto-resume
+    # raises TopologyMismatch instead of training. Purely a config check:
+    # nothing is restored here, only the manifest is read. resume=False
+    # never restores, so it is never flagged.
+    if resilient is not None \
+            and getattr(resilient, "_elastic_cfg", None) is None \
+            and getattr(resilient, "resume", True):
+        from ..resilience import elastic as _elastic
+        saved = None
+        try:
+            latest = resilient.checkpointer.latest_step()
+            if latest is not None:
+                saved = resilient.checkpointer.read_manifest(
+                    latest).get("user", {}).get("topology")
+        except Exception:
+            saved = None
+        if saved:
+            live = trainer.topology()
+            # the runtime guard's own mismatch test — the lint verdict
+            # and the TopologyMismatch it predicts cannot drift
+            if _elastic._mismatch(saved, live):
+                saved_dp = _elastic._dp_of(saved)
+                report.add(Diagnostic(
+                    "MXL-T213",
+                    "checkpoint step %s in %s was saved on a %s-device "
+                    "mesh (dp=%s) but this trainer runs %d devices "
+                    "(dp=%d) without elastic adoption: the first "
+                    "auto-resume raises TopologyMismatch instead of "
+                    "training"
+                    % (latest, resilient.checkpointer.directory,
+                       saved.get("n_devices"), saved_dp,
+                       live["n_devices"], live["dp"]),
+                    location=type(resilient).__name__,
+                    hint="construct with elastic=True (or MXNET_ELASTIC=1"
+                         ", or use resilience.ElasticTrainer) so the "
+                         "ZeRO-1 optimizer state re-shards N→M and the "
+                         "global batch re-splits over the live mesh — "
+                         "docs/resilience.md 'Elastic data parallelism'"))
     return report

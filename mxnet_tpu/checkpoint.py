@@ -43,7 +43,7 @@ from typing import Any, Dict, List, Optional
 import jax
 import numpy as np
 
-from .base import MXNetError
+from .base import MXNetError, logger
 from .observability import catalog as _telemetry
 from .observability import metrics as _obs_metrics
 
@@ -384,14 +384,54 @@ class ShardedCheckpointer:
                 dropped += 1
         return dropped
 
+    def _check_like_topology(self, step: int, tree: Dict[str, Any]) -> None:
+        """A ``like=`` restore re-pins shards onto the LIVE arrays' mesh
+        no matter where the checkpoint came from; when the manifest
+        records the saving topology and the device counts differ, that is
+        a silent cross-topology mis-restore — refuse with a typed error
+        pointing at the elastic adoption path (``allow_reshard=True``
+        opts back in for callers that re-tile deliberately)."""
+        try:
+            saved = self.read_manifest(step).get("user", {}).get("topology")
+        except MXNetError:
+            saved = None
+        if not saved or not saved.get("n_devices"):
+            return      # pre-elastic / hand-written manifest: nothing known
+        live = 0
+        for v in tree.values():
+            s = _sharding_of(v)
+            mesh = getattr(s, "mesh", None)
+            if mesh is not None and getattr(mesh, "devices", None) is not None:
+                live = int(mesh.devices.size)
+                break
+            dset = getattr(s, "device_set", None)
+            if dset:
+                live = len(dset)
+                break
+        if live and live != int(saved["n_devices"]):
+            from .resilience.elastic import TopologyMismatch
+            raise TopologyMismatch(
+                "checkpoint step %d records a %d-device topology but the "
+                "like= tree lives on %d device(s): refusing the silent "
+                "cross-topology re-pin — restore(..., allow_reshard=True) "
+                "to re-tile deliberately, or use ResilientTrainer("
+                "elastic=True)/ElasticTrainer for the full N→M adoption "
+                "(docs/resilience.md, 'Elastic data parallelism')"
+                % (step, int(saved["n_devices"]), live),
+                saved=saved, live={"n_devices": live})
+
     # --------------------------------------------------------------- restore
-    def restore(self, step: int, like=None, shardings=None) -> Dict[str, Any]:
+    def restore(self, step: int, like=None, shardings=None,
+                allow_reshard: bool = False) -> Dict[str, Any]:
         """Restore step ``step``. ``like`` (a params tree of live arrays) or
         ``shardings`` (a {name: Sharding} tree) reshards on load; with
         neither, arrays land replicated on the default device.
 
         Refuses uncommitted or torn directories: the commit marker must be
-        present and every manifest entry must match on disk."""
+        present and every manifest entry must match on disk. A ``like=``
+        tree whose mesh device count differs from the manifest's recorded
+        topology is refused too (``TopologyMismatch``) unless
+        ``allow_reshard=True``."""
         tel = _obs_metrics.enabled()
         t0 = time.perf_counter() if tel else 0.0
         path = self._step_dir(step)
@@ -409,6 +449,8 @@ class ShardedCheckpointer:
         target = None
         if like is not None:
             tree = _to_tree(like)
+            if not allow_reshard:
+                self._check_like_topology(step, tree)
             target = {k: jax.ShapeDtypeStruct(v.shape, v.dtype,
                                               sharding=_sharding_of(v))
                       for k, v in tree.items()}
@@ -425,6 +467,23 @@ class ShardedCheckpointer:
                 if k not in target and hasattr(m, "shape"):
                     target[k] = jax.ShapeDtypeStruct(
                         tuple(m.shape), np.dtype(str(m.dtype)))
+            if saved and allow_reshard:
+                # the mirror fill, on the deliberate-reshard path only: a
+                # target key the checkpoint never saved cannot be
+                # restored (orbax refuses structural mismatches) — drop
+                # it, say so, and let the caller's partial merge handle
+                # the absence (e.g. guard/scaler keys from a different
+                # trainer config). Plain like= restores keep the loud
+                # structural error: a silently-short tree is exactly the
+                # partial restore this module exists to prevent.
+                extra = sorted(k for k in target if k not in saved)
+                for k in extra:
+                    del target[k]
+                if extra:
+                    logger.warning(
+                        "checkpoint step %d lacks %d key(s) the restore "
+                        "target carries (%s); they keep their live "
+                        "values", step, len(extra), extra)
         elif shardings is not None:
             raise MXNetError("pass `like=` example arrays (shardings are "
                              "derived from them)")

@@ -159,6 +159,21 @@ LOSS_SCALE = _m.gauge(
     "mxtpu_loss_scale",
     "Live dynamic loss scale of the in-trace scaler (published when "
     "anomaly_stats()/recovery drains it — never synced per step).")
+ELASTIC_RESHARDS = _m.counter(
+    "mxtpu_elastic_reshards_total",
+    "Elastic N→M topology adoptions completed at restore (ZeRO-1 "
+    "opt-state re-tiled, global batch re-split), labeled "
+    "direction=grow|shrink.")
+ACTIVE_DEVICES = _m.gauge(
+    "mxtpu_active_devices",
+    "Devices in the live training mesh (set at capture and on every "
+    "restore topology check — the number elastic resumes reconcile "
+    "checkpoints against).")
+ELASTIC_RESHARD_MS = _m.histogram(
+    "mxtpu_elastic_reshard_ms",
+    "Wall time of one elastic topology adoption: checkpoint restore of "
+    "the gathered state + N→M re-tile under the new mesh + provenance.",
+    buckets=(5, 25, 100, 500, 1000, 5000, 15000, 60000))
 
 # ------------------------------------------------------------- performance
 MFU = _m.gauge(

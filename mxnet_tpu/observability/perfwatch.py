@@ -225,6 +225,18 @@ class PerfWatch:
                     "perfwatch disarmed: no usable baseline (%s)",
                     self.baseline_error)
 
+    def disarm(self, reason: str) -> None:
+        """Drop the baseline so the watch stops checking — ONE warning, no
+        regression spam. Called when the workload's signature changes out
+        from under the baseline (e.g. an elastic reshard moved the run to
+        a different device count: the old throughput/MFU floor describes a
+        mesh that no longer exists). Idempotent."""
+        if self.baseline is None:
+            return
+        self.baseline = None
+        self.baseline_error = reason
+        logger.warning("perfwatch disarmed: %s", reason)
+
     # ------------------------------------------------------------ checking
     def live_metrics(self) -> Dict[str, float]:
         out: Dict[str, float] = {}

@@ -472,6 +472,8 @@ class DataParallelTrainer:
         from .. import autograd
         if _metrics.enabled():
             _telemetry.CAPTURES_TOTAL.inc()
+            # the live device-set gauge elastic resumes reconcile against
+            _telemetry.ACTIVE_DEVICES.set(int(self._mesh.devices.size))
         # a re-capture rebuilds params/opt_state from the net; any loaded
         # executable is keyed to the OLD pytree/placement and must not be
         # re-entered afterwards — and any captured cost rows describe the
@@ -1201,6 +1203,24 @@ class DataParallelTrainer:
             if mfu is not None:
                 stats["mfu"] = mfu
         return stats
+
+    def topology(self) -> Dict[str, Any]:
+        """The mesh identity this trainer trains on: device count, data-
+        axis (dp) extent, full mesh axes and the grad-reduce mode. This is
+        what ``ResilientTrainer.save`` stamps into every resume manifest
+        and what an elastic restore reconciles a checkpoint against
+        (``resilience.elastic``)."""
+        dev = self._mesh.devices.ravel()[0]
+        try:
+            dp = int(self._mesh.shape[self._axis])
+        except (KeyError, TypeError):
+            dp = int(self._mesh.devices.size)
+        return {"n_devices": int(self._mesh.devices.size), "dp": dp,
+                "axis": self._axis,
+                "mesh_axes": {str(n): int(self._mesh.shape[n])
+                              for n in self._mesh.axis_names},
+                "device_kind": dev.device_kind, "platform": dev.platform,
+                "grad_reduce": self._grad_reduce}
 
     def comm_config(self) -> Dict[str, Any]:
         """The communication-lever configuration this trainer runs — the

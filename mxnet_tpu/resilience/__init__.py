@@ -21,8 +21,14 @@ divergence              rollback to an in-memory RollingSnapshots entry ->
                         durable restore -> RecoveryFailed (fail loud)
 bf16 grad underflow     in-trace dynamic loss scaling
                         (DataParallelTrainer(loss_scaling=...))
+device-set churn        elastic.py: ElasticTrainer / elastic=True adopts
+(preempted chips)       a mismatched-topology checkpoint — ZeRO-1 opt
+                        state re-sharded N→M, global batch re-split,
+                        iterator cursor credited back; without elastic a
+                        mismatch is a typed TopologyMismatch, never a
+                        silent mis-restore
 any of the above,       chaos.* injectors (tests' `chaos` marker,
-on demand               tools/crashloop.py)
+on demand               tools/crashloop.py --devices-schedule)
 =====================  ==================================================
 
 Import is lazy: ``from mxnet_tpu.resilience.preemption import ...`` from
@@ -33,23 +39,26 @@ from __future__ import annotations
 import importlib as _importlib
 
 __all__ = ["Preempted", "PreemptionGuard", "install", "current", "requested",
-           "check_preempted", "ResilientTrainer", "resilient_fit",
+           "check_preempted", "ResilientTrainer", "ElasticTrainer",
+           "TopologyMismatch", "resilient_fit",
            "retry_transient", "is_transient", "Watchdog", "RecoveryFailed",
-           "RecoveryLadder", "RollingSnapshots", "chaos",
+           "RecoveryLadder", "RollingSnapshots", "chaos", "elastic",
            "preemption", "recovery", "retry", "watchdog", "trainer"]
 
 _lazy_attrs = {
     "Preempted": ".preemption", "PreemptionGuard": ".preemption",
     "install": ".preemption", "current": ".preemption",
     "requested": ".preemption", "check_preempted": ".preemption",
-    "ResilientTrainer": ".trainer", "resilient_fit": ".trainer",
+    "ResilientTrainer": ".trainer", "ElasticTrainer": ".trainer",
+    "resilient_fit": ".trainer",
+    "TopologyMismatch": ".elastic",
     "retry_transient": ".retry", "is_transient": ".retry",
     "Watchdog": ".watchdog",
     "RecoveryFailed": ".recovery", "RecoveryLadder": ".recovery",
     "RollingSnapshots": ".recovery",
 }
-_lazy_mods = {"chaos", "preemption", "recovery", "retry", "watchdog",
-              "trainer"}
+_lazy_mods = {"chaos", "elastic", "preemption", "recovery", "retry",
+              "watchdog", "trainer"}
 
 
 def __getattr__(name):

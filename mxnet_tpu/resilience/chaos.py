@@ -12,9 +12,10 @@ from __future__ import annotations
 
 import contextlib
 import os
+import re
 import signal
 import threading
-from typing import Callable, Optional
+from typing import Callable, Dict, Optional
 
 import jax.numpy as jnp
 import numpy as np
@@ -24,7 +25,8 @@ from ..base import CorruptRecordError, MXNetError, TransientIOError
 __all__ = ["ChaosError", "sigterm_self", "dropped_pushes", "kill_heartbeat",
            "nan_gradients", "nan_batch", "nan_storm", "diverge_loss",
            "tear_checkpoint", "torn_checkpoint_writes", "hung_step",
-           "torn_reads", "corrupt_records", "hung_reader"]
+           "torn_reads", "corrupt_records", "hung_reader",
+           "device_count_env", "resize_devices"]
 
 
 class ChaosError(MXNetError):
@@ -278,6 +280,50 @@ def hung_reader(it, hang: float = 3600.0, after: int = 0):
     # every post-`after` read hangs (count is effectively unbounded): a
     # wedged mount does not heal after one slow read
     return _faulty_next(it, 1 << 30, "hung", fault, after=after)
+
+
+# ----------------------------------------------------------- device churn
+_DEVCOUNT_RE = re.compile(r"--xla_force_host_platform_device_count=\d+\s*")
+
+
+def device_count_env(n: int, base: Optional[Dict[str, str]] = None
+                     ) -> Dict[str, str]:
+    """An environment for a CHILD process that will see ``n`` virtual CPU
+    devices: any existing ``--xla_force_host_platform_device_count`` in
+    ``XLA_FLAGS`` is replaced (the target's own ``setdefault`` must not
+    win) and ``JAX_PLATFORMS`` is pinned to cpu. Returns a copy of
+    ``base`` (default ``os.environ``) with the overrides applied."""
+    if int(n) <= 0:
+        raise ChaosError("device count must be positive, got %r" % (n,))
+    env = dict(os.environ if base is None else base)
+    flags = _DEVCOUNT_RE.sub("", env.get("XLA_FLAGS", "")).strip()
+    env["XLA_FLAGS"] = ("--xla_force_host_platform_device_count=%d %s"
+                        % (int(n), flags)).strip()
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+@contextlib.contextmanager
+def resize_devices(n: int):
+    """Change the device count visible to the NEXT process: the in-process
+    jax topology is frozen at backend init, so device-set churn is a
+    between-attempts failure mode — this patches ``os.environ`` (what
+    ``subprocess`` children inherit) and restores it on exit. The
+    deterministic shrink/grow half of the crashloop harness
+    (``tools/crashloop.py --devices-schedule`` drives the same env per
+    attempt). Yields the environment overrides applied."""
+    saved = {k: os.environ.get(k) for k in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env = device_count_env(n)
+    os.environ["XLA_FLAGS"] = env["XLA_FLAGS"]
+    os.environ["JAX_PLATFORMS"] = env["JAX_PLATFORMS"]
+    try:
+        yield {k: env[k] for k in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
 
 
 # ------------------------------------------------------------ checkpoints

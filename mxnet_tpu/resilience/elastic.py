@@ -1,0 +1,278 @@
+"""Elastic data parallelism — re-shard, re-bucket and resume across
+device-set churn.
+
+The reference's distributed story (ps-lite, SURVEY §2) tolerates a dead
+*worker* but never a reshaped *job*: a preempted chip means a lost run.
+This module composes the PR 1–5 reliability stack (auto-resume, atomic
+sharded checkpoints, checkpointable iterator state, recovery ladder) with
+PR 9's ZeRO-1 sharded optimizer into the missing production feature:
+a training run whose device set can shrink mid-epoch on preemptible
+capacity and grow back later, with verified trajectory equivalence.
+
+How an elastic adoption works (``ResilientTrainer(elastic=True)`` or
+:class:`~mxnet_tpu.resilience.trainer.ElasticTrainer`):
+
+1. every resume manifest records the saving mesh's **topology** (device
+   count, dp extent, mesh axes, ``grad_reduce`` mode — see
+   ``DataParallelTrainer.topology()``);
+2. on restore, the manifest topology is compared to the live mesh. A
+   match is a plain (bitwise) resume. A mismatch without elastic enabled
+   raises :class:`TopologyMismatch` — fail-loud is the default, because a
+   silent cross-topology restore invalidates AOT blobs, perf baselines
+   and the reduction-order bitwise guarantee;
+3. with elastic enabled, a **reshard plan** is derived: the fixed global
+   batch is re-split over the new dp extent (per-chip batch recomputed;
+   refused cleanly when it no longer divides), ZeRO-1 optimizer-state
+   leaves are re-tiled N→M through the trainer's freshly-derived
+   ``_opt_specs`` tree (the checkpoint holds the gathered logical arrays;
+   ``_place_state`` lands them under the new mesh), and leaves that no
+   longer tile the dp axis fall back to replicated — **loudly**, with the
+   leaf names recorded in the reshard provenance;
+4. the adoption is observable: ``mxtpu_elastic_reshards_total{direction=
+   grow|shrink}``, the ``mxtpu_active_devices`` gauge, a reshard-duration
+   histogram, a flight-recorder record, and an ``elastic`` provenance
+   block stamped into every later manifest. A live perf watch is
+   disarmed (one warning) because the old step-time baseline no longer
+   describes the new topology.
+
+Gradient bucketing and ``comm_config`` need no explicit migration: both
+are re-derived at capture time from the live mesh, and the AOT cache key
+covers ``n_devices``, so a stale executable from the old topology refuses
+cleanly instead of being re-entered.
+
+Equivalence guarantees (chaos-tested by ``tests/test_elastic.py`` and
+``tools/crashloop.py --devices-schedule``): a kill/resume that keeps the
+dp extent is **bitwise** on the CPU backend (reduction order preserved);
+one that changes it matches the uninterrupted run's parameters within
+float tolerance (the batch-mean / gradient all-reduce order changes with
+the shard count) — see docs/resilience.md "Elastic data parallelism".
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+from ..base import MXNetError, get_env, logger, register_config
+from ..observability import catalog as _telemetry
+from ..observability import flight_recorder as _flight
+from ..observability import metrics as _metrics
+
+__all__ = ["TopologyMismatch", "elastic_config", "check_restore",
+           "finish_reshard", "snapshot_guard"]
+
+register_config("MXNET_ELASTIC", False, bool,
+                "Adopt mismatched-topology checkpoints by elastic N→M "
+                "re-shard instead of raising TopologyMismatch "
+                "(ResilientTrainer(elastic=...) overrides).")
+register_config("MXNET_ELASTIC_STRICT", False, bool,
+                "Elastic adoptions refuse (TopologyMismatch) when a "
+                "previously-sharded optimizer-state leaf no longer tiles "
+                "the new dp extent, instead of replicating it.")
+
+
+class TopologyMismatch(MXNetError):
+    """A checkpoint's recorded mesh topology differs from the live mesh
+    and cannot (or must not) be adopted. Carries both topologies as
+    ``saved`` / ``live`` attributes."""
+
+    def __init__(self, msg: str, saved: Optional[Dict] = None,
+                 live: Optional[Dict] = None):
+        super().__init__(msg)
+        self.saved = saved
+        self.live = live
+
+
+def elastic_config(elastic) -> Optional[Dict[str, Any]]:
+    """Normalize ``ResilientTrainer(elastic=...)``. ``None`` defers to the
+    ``MXNET_ELASTIC`` env (so a crashloop harness can arm a stock script);
+    any falsy spelling (False/0/{}) is off, matching ``recovery_config``;
+    True = env-default knobs; a dict overrides ``strict`` (unknown keys
+    are a hard error)."""
+    if elastic is None:
+        elastic = bool(get_env("MXNET_ELASTIC"))
+    if not elastic:
+        return None
+    over = dict(elastic) if isinstance(elastic, dict) else {}
+    unknown = set(over) - {"strict"}
+    if unknown:
+        raise MXNetError("unknown elastic knob(s) %s; valid: ['strict']"
+                         % sorted(unknown))
+    return {"strict": bool(over.get("strict",
+                                    get_env("MXNET_ELASTIC_STRICT")))}
+
+
+def _dp_of(topo: Dict[str, Any]) -> int:
+    return int(topo.get("dp") or topo.get("n_devices") or 0)
+
+
+def _mismatch(saved: Dict[str, Any], live: Dict[str, Any]) -> bool:
+    return (_dp_of(saved) != _dp_of(live)
+            or int(saved.get("n_devices") or 0) != int(live["n_devices"]))
+
+
+def _global_batch(aot_key) -> Optional[int]:
+    """Leading dim of the first input signature in an AOT key — the fixed
+    global batch the run trains with (manifest keys arrive JSON-decoded,
+    so shape tuples may be lists)."""
+    try:
+        return int(aot_key["in_shapes"][0][0])
+    except (KeyError, IndexError, TypeError, ValueError):
+        return None
+
+
+def check_restore(rt, step: int, user: Dict[str, Any],
+                  subject: str = "checkpoint") -> Optional[Dict[str, Any]]:
+    """Validate a durable restore's topology BEFORE any trainer state is
+    touched. Returns None for a same-topology (or pre-elastic, untagged)
+    checkpoint; a reshard plan for an elastic-adoptable mismatch; raises
+    :class:`TopologyMismatch` for everything else. Called by every
+    ``_opt_specs``-re-pinning restore path in ``ResilientTrainer``
+    (process resume, recovery-ladder durable restore)."""
+    t = rt.trainer
+    live = t.topology()
+    if _metrics.enabled():
+        _telemetry.ACTIVE_DEVICES.set(live["n_devices"])
+    saved = user.get("topology")
+    if not saved or not _mismatch(saved, live):
+        return None
+    cfg = rt._elastic_cfg
+    if cfg is None:
+        raise TopologyMismatch(
+            "%s step %d was saved on a %d-device mesh (dp=%d, axes %s) but "
+            "this trainer runs %d devices (dp=%d): refusing to silently "
+            "adopt a checkpoint across a topology change. Enable elastic "
+            "data parallelism — ResilientTrainer(elastic=True), "
+            "MXNET_ELASTIC=1, or resilience.ElasticTrainer — to re-shard "
+            "optimizer state %d→%d and re-split the global batch, or "
+            "resume on the original topology (docs/resilience.md, "
+            "'Elastic data parallelism')."
+            % (subject, step, int(saved.get("n_devices", 0)), _dp_of(saved),
+               saved.get("mesh_axes"), live["n_devices"], _dp_of(live),
+               _dp_of(saved), _dp_of(live)),
+            saved=saved, live=live)
+    old_dp, new_dp = _dp_of(saved), _dp_of(live)
+    # fixed global batch, per-chip batch recomputed: the batch the trainer
+    # just captured with must re-split over the new dp extent — and must
+    # BE the old run's global batch, or the credited-back iterator cursor
+    # would skip/duplicate samples
+    live_batch = _global_batch(rt._last_aot_key or {})
+    saved_batch = _global_batch(user.get("aot_key") or {})
+    if live_batch is not None and live_batch % max(1, new_dp):
+        raise TopologyMismatch(
+            "elastic adoption of %s step %d: global batch %d does not "
+            "re-split over the new dp extent %d (per-chip batch must be "
+            "integral) — choose a global batch divisible by every device "
+            "count in the schedule" % (subject, step, live_batch, new_dp),
+            saved=saved, live=live)
+    if (live_batch is not None and saved_batch is not None
+            and live_batch != saved_batch):
+        logger.warning(
+            "elastic: global batch changed %d → %d across the restart — "
+            "elastic resume keeps the GLOBAL batch fixed and only "
+            "recomputes the per-chip split; a changed global batch "
+            "shifts the credited-back iterator cursor and the loss scale "
+            "of every remaining step", saved_batch, live_batch)
+    # ZeRO-1 re-tile plan: the new mesh's shardability verdicts are
+    # already derived (capture ran before restore); a leaf sharded under
+    # the old dp extent that no longer tiles the new one falls back to
+    # replicated — loudly, and recorded in the provenance below
+    old_mode = saved.get("grad_reduce", t._grad_reduce)
+    new_shard = dict(t._zero_shard)
+    retiled, fallbacks = [], []
+    for name, v in (t._params or {}).items():
+        shp = tuple(getattr(v, "shape", ()))
+        was = (old_mode == "reduce_scatter" and len(shp) >= 1
+               and int(shp[0]) > 0 and old_dp > 0
+               and int(shp[0]) % old_dp == 0)
+        now = bool(new_shard.get(name))
+        if now:
+            retiled.append(name)
+        elif was:
+            fallbacks.append(name)
+    if fallbacks and cfg["strict"]:
+        raise TopologyMismatch(
+            "elastic adoption of %s step %d (strict): %d optimizer-state "
+            "leaf/leaves sharded under dp=%d no longer tile dp=%d and "
+            "would fall back to replicated: %s — drop elastic strict "
+            "mode to accept the replication, or pick a device count that "
+            "tiles every leading dim"
+            % (subject, step, len(fallbacks), old_dp, new_dp,
+               sorted(fallbacks)), saved=saved, live=live)
+    # direction by dp extent, tie-broken on device count: a dp=4 mesh
+    # regrown as dp=4 x tp=2 is a grow even though the ZeRO divisor
+    # didn't move
+    if new_dp != old_dp:
+        direction = "grow" if new_dp > old_dp else "shrink"
+    else:
+        direction = ("grow" if int(live["n_devices"])
+                     > int(saved.get("n_devices") or 0) else "shrink")
+    return {"step": int(step), "subject": subject,
+            "from": dict(saved), "to": live,
+            "direction": direction,
+            "old_dp": old_dp, "new_dp": new_dp,
+            "global_batch": live_batch,
+            "retiled": sorted(retiled), "fallbacks": sorted(fallbacks)}
+
+
+def finish_reshard(rt, plan: Dict[str, Any], duration_ms: float) -> None:
+    """Publish a completed elastic adoption: loud replication-fallback
+    warning, telemetry (reshard counter by direction, active-devices
+    gauge, duration histogram), flight-recorder record, perf-watch
+    disarm, and the provenance entry every later manifest carries."""
+    old_dp, new_dp = plan["old_dp"], plan["new_dp"]
+    if plan["fallbacks"]:
+        logger.warning(
+            "elastic: %d optimizer-state leaf/leaves sharded under dp=%d "
+            "no longer tile dp=%d and fell back to REPLICATED (per-chip "
+            "opt-state HBM for them is back to 1x): %s — provenance "
+            "recorded in the next manifest",
+            len(plan["fallbacks"]), old_dp, new_dp, plan["fallbacks"])
+    gb = plan.get("global_batch")
+    logger.info(
+        "elastic: adopted %s step %d across topology change dp %d → %d "
+        "(%s, %d device(s); %d leaf/leaves re-tiled, %d replicated%s) "
+        "in %.1f ms", plan["subject"], plan["step"], old_dp, new_dp,
+        plan["direction"], plan["to"]["n_devices"], len(plan["retiled"]),
+        len(plan["fallbacks"]),
+        "; per-chip batch %d → %d" % (gb // max(1, old_dp), gb // new_dp)
+        if gb else "", duration_ms)
+    if _metrics.enabled():
+        _telemetry.ELASTIC_RESHARDS.inc(direction=plan["direction"])
+        _telemetry.ELASTIC_RESHARD_MS.observe(duration_ms)
+        _telemetry.ACTIVE_DEVICES.set(plan["to"]["n_devices"])
+    _flight.record_step(plan["step"], elastic_reshard=plan["direction"],
+                        elastic_from_dp=old_dp, elastic_to_dp=new_dp)
+    rt._reshard_history.append({
+        "step": plan["step"], "direction": plan["direction"],
+        "from_dp": old_dp, "to_dp": new_dp,
+        "from_devices": int(plan["from"].get("n_devices", 0)),
+        "to_devices": plan["to"]["n_devices"],
+        "fallback_leaves": plan["fallbacks"],
+        "duration_ms": round(float(duration_ms), 3),
+        "wall_time": time.time()})
+    if rt._perfwatch is not None:
+        # the baseline's step-time/throughput signature was measured on
+        # the OLD topology: every later check would be a false regression
+        # (or a false pass) — disarm once, loudly, instead of spamming
+        rt._perfwatch.disarm(
+            "elastic reshard dp %d → %d changed the step-time baseline "
+            "signature (re-arm with a baseline measured on the new "
+            "topology)" % (old_dp, new_dp))
+
+
+def snapshot_guard(snap: Dict[str, Any], trainer) -> None:
+    """In-memory rolling snapshots live and die with one process, whose
+    device set is frozen at backend init — a topology mismatch here means
+    the snapshot was handed to a different trainer/mesh. Same typed
+    refusal as the durable path (a mis-tiled restore is equally silent)."""
+    saved = snap.get("n_devices")
+    if saved is None:
+        return
+    live = int(trainer._mesh.devices.size)
+    if int(saved) != live:
+        raise TopologyMismatch(
+            "in-memory snapshot of step %s was captured on %d device(s) "
+            "but the trainer's mesh has %d — snapshots cannot cross a "
+            "topology change (only durable checkpoints can, via elastic "
+            "adoption)" % (snap.get("step"), int(saved), live),
+            saved={"n_devices": int(saved)}, live={"n_devices": live})

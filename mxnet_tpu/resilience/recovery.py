@@ -241,6 +241,9 @@ class RollingSnapshots:
         host = jax.tree_util.tree_map(np.asarray, tree)
         snap = {"step": int(step),
                 "rng_counter": int(trainer._rng_counter),
+                # topology stamp, mirroring the durable manifests: restore
+                # refuses a snapshot captured on a different device set
+                "n_devices": int(trainer._mesh.devices.size),
                 "tree": host, "data_state": data_state,
                 "wall_time": time.time()}
         self._ring.append(snap)
@@ -265,6 +268,8 @@ class RollingSnapshots:
         snap = snap if snap is not None else self.newest()
         if snap is None:
             raise MXNetError("no in-memory snapshot to restore")
+        from .elastic import snapshot_guard
+        snapshot_guard(snap, trainer)
         params, aux, opt, guard = snap["tree"]
         trainer._params = {k: jnp.asarray(v) for k, v in params.items()}
         trainer._aux = {k: jnp.asarray(v) for k, v in aux.items()}

@@ -21,9 +21,14 @@ fault-tolerance stack:
 - **watchdog**: ``step_deadline`` seconds per step; a hung collective dumps
   every thread's stack and fails loud instead of burning pod-hours.
 
-The checkpoint layout is a plain :class:`ShardedCheckpointer` directory, so
-a run checkpointed on one mesh topology can resume on another (resharded
-restore) — the current mesh's placement is re-derived by ``_place_state``.
+The checkpoint layout is a plain :class:`ShardedCheckpointer` directory.
+Every resume manifest records the saving mesh's topology; restoring on a
+DIFFERENT device set raises a typed ``TopologyMismatch`` unless elastic
+adoption is enabled (``elastic=True`` / ``MXNET_ELASTIC=1`` /
+:class:`ElasticTrainer`), in which case the ZeRO-1 optimizer state is
+re-sharded N→M under the new mesh, the fixed global batch re-splits, and
+the data-iterator cursor is credited back — see ``resilience.elastic``
+and docs/resilience.md "Elastic data parallelism".
 
 Also here: :func:`resilient_fit`, the same recovery model for the Module
 API at epoch granularity (the reference's ``do_checkpoint`` callback never
@@ -44,6 +49,7 @@ from ..checkpoint import ShardedCheckpointer
 from ..observability import catalog as _telemetry
 from ..observability import flight_recorder as _flight
 from ..observability import metrics as _metrics
+from . import elastic as _elastic
 from .preemption import Preempted, acquire as acquire_guard, \
     release as release_guard
 from .recovery import (RecoveryFailed, RecoveryLadder, RollingSnapshots,
@@ -51,7 +57,7 @@ from .recovery import (RecoveryFailed, RecoveryLadder, RollingSnapshots,
 from .retry import retry_transient
 from .watchdog import Watchdog
 
-__all__ = ["ResilientTrainer", "resilient_fit"]
+__all__ = ["ResilientTrainer", "ElasticTrainer", "resilient_fit"]
 
 _OPT_KEY = "__opt__%04d"
 _GUARD_KEY = "__guard__%s"
@@ -92,9 +98,17 @@ class ResilientTrainer:
                  keep: Optional[int] = None, resume: bool = True,
                  preemption: bool = True, step_deadline: Optional[float] = None,
                  retry: bool = True, data_iter=None, recovery=None,
-                 perfwatch=None, **trainer_kwargs):
+                 perfwatch=None, elastic=None, **trainer_kwargs):
         if not directory:
             raise MXNetError("ResilientTrainer needs a checkpoint directory")
+        # elastic data parallelism (resilience.elastic): adopt a
+        # checkpoint whose recorded mesh topology differs from the live
+        # one — ZeRO-1 opt-state re-tiled N→M, global batch re-split,
+        # non-tiling leaves replicated loudly. None defers to
+        # MXNET_ELASTIC; off (the default) raises TopologyMismatch on a
+        # mismatched restore instead of silently re-pinning.
+        self._elastic_cfg = _elastic.elastic_config(elastic)
+        self._reshard_history: list = []
         # self-healing recovery (recovery.py): the escalation layer between
         # "skip one step" and "restart from disk". Parsed BEFORE the inner
         # trainer is built because the ladder needs in-trace hooks: the
@@ -247,7 +261,34 @@ class ResilientTrainer:
 
     def _restore(self, step: int, load_ladder: bool = True) -> None:
         t = self.trainer
-        tree = self.checkpointer.restore(step)
+        user = self.checkpointer.read_manifest(step).get("user", {})
+        # topology reconciliation FIRST — a TopologyMismatch must fire
+        # before a single leaf of live trainer state is replaced. Returns
+        # a reshard plan when the mismatch is elastic-adoptable: the
+        # restore below then lands the checkpoint's gathered logical
+        # arrays and _place_state re-tiles them under the new mesh's
+        # _opt_specs (the N→M re-shard), which finish_reshard publishes.
+        plan = _elastic.check_restore(self, step, user)
+        t0 = time.perf_counter()
+        if plan is None:
+            tree = self.checkpointer.restore(step)
+        else:
+            # cross-topology restore: the checkpoint's recorded shardings
+            # name devices this process does not have, so orbax must be
+            # handed an explicit target — the LIVE state tree, whose
+            # freshly-derived placements (ZeRO leaves already sharded
+            # over the new mesh) land each shard directly where the new
+            # topology wants it. Keys the checkpoint lacks (e.g. guard
+            # state from another config) are dropped by restore itself.
+            like: Dict[str, Any] = dict(t._params)
+            like.update({_AUX_KEY % n: v for n, v in t._aux.items()})
+            leaves0, _ = jax.tree_util.tree_flatten(t._opt_state)
+            like.update({_OPT_KEY % i: l for i, l in enumerate(leaves0)})
+            if t._guard_state is not None:
+                like.update({_GUARD_KEY % k: v
+                             for k, v in t._guard_state.items()})
+            tree = self.checkpointer.restore(step, like=like,
+                                             allow_reshard=True)
         t._params = {n: jnp.asarray(tree[n]) for n in t._param_names}
         t._aux = {n: jnp.asarray(tree[_AUX_KEY % n]) for n in t._aux_names}
         leaves, treedef = jax.tree_util.tree_flatten(t._opt_state)
@@ -272,7 +313,9 @@ class ResilientTrainer:
                         "(saved under a different config); they keep "
                         "fresh-init values", step, missing)
         t._place_state()
-        user = self.checkpointer.read_manifest(step).get("user", {})
+        if plan is not None:
+            _elastic.finish_reshard(
+                self, plan, (time.perf_counter() - t0) * 1000.0)
         t._rng_counter = int(user.get("rng_counter", 0))
         # the rng stream is fold_in(PRNGKey(seed), counter): restoring the
         # counter without the SEED only continues the stream when MXNET_SEED
@@ -711,7 +754,16 @@ class ResilientTrainer:
             "seed": int(_random.current_seed()),
             "aot_key": self._last_aot_key,
             "wall_time": time.time(),
+            # the saving mesh's identity — what a restore (possibly on a
+            # different device set) reconciles against: a mismatch is a
+            # typed TopologyMismatch unless elastic adoption is enabled
+            "topology": t.topology(),
         }
+        if self._reshard_history:
+            # elastic lineage provenance: every manifest after an N→M
+            # adoption names the reshards this process performed (newest
+            # last), including any leaves that fell back to replicated
+            manifest["elastic"] = {"reshards": self._reshard_history[-8:]}
         if self._ladder is not None:
             # scaler state itself rides in the guard-state tree (saved with
             # the __guard__ keys above); the ladder's host-side escalation
@@ -782,8 +834,47 @@ class ResilientTrainer:
         return list(self._ladder.history) if self._ladder is not None else []
 
     @property
+    def reshard_history(self):
+        """Elastic topology adoptions this process performed — a list of
+        ``{"step", "direction", "from_dp", "to_dp", "fallback_leaves",
+        ...}`` dicts, newest last (empty without a reshard). The same
+        entries ride in every later manifest's ``elastic`` block."""
+        return list(self._reshard_history)
+
+    @property
     def mesh(self):
         return self.trainer.mesh
+
+
+class ElasticTrainer(ResilientTrainer):
+    """``ResilientTrainer`` wired for device-set churn: the mesh is
+    derived from the **live** device set at process start instead of a
+    pinned topology, and a checkpoint recorded on a different device
+    count is adopted by the elastic re-shard path (``elastic=True`` by
+    default) instead of refused.
+
+    >>> rt = resilience.ElasticTrainer(net, loss_fn, "sgd",
+    ...     {"learning_rate": 0.1}, directory="/ckpts/run1",
+    ...     grad_reduce="reduce_scatter", save_every=100)
+    # killed at 8 chips, restarted on 4: opt-state re-shards 8→4, the
+    # global batch re-splits, the iterator cursor is credited back, and
+    # the run continues — then grows back to 8 the same way.
+
+    ``devices`` restricts the mesh to an explicit device list (default:
+    every visible device on the 'dp' axis); passing ``mesh=`` as well is
+    a conflict and refused."""
+
+    def __init__(self, net, loss, optimizer="sgd", optimizer_params=None,
+                 devices=None, **kwargs):
+        if "mesh" in kwargs and devices is not None:
+            raise MXNetError("ElasticTrainer: pass devices= or mesh=, "
+                             "not both")
+        if "mesh" not in kwargs:
+            from ..parallel.mesh import local_mesh
+            kwargs["mesh"] = local_mesh(
+                kwargs.get("data_axis", "dp"), devices=devices)
+        kwargs.setdefault("elastic", True)
+        super().__init__(net, loss, optimizer, optimizer_params, **kwargs)
 
 
 # --------------------------------------------------------------- Module API

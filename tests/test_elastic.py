@@ -1,0 +1,538 @@
+"""Elastic data parallelism (ISSUE 11): re-shard, re-bucket and resume
+across device-set churn.
+
+The virtual 8-CPU-device mesh stands in for a preemptible slice: an
+"attempt at M devices" is a trainer whose mesh spans the first M of the 8
+visible devices (in-process churn; the cross-process half — XLA_FLAGS
+device-count env per attempt — is exercised by tools/crashloop.py
+--devices-schedule in test_tools.py). Covered here: the N→M→N re-shard
+matrix (8→4→8 fused, 8→2 kv; stateful optimizers) with per-chip opt-state
+scaling and digest-within-tolerance trajectory equivalence, bitwise
+equivalence when the dp extent is preserved, the TopologyMismatch
+fail-loud default, replicated fallback for non-tiling leaves, iterator
+credit-back across a shrink, telemetry/provenance, AOT refusal, the
+perfwatch disarm, and the chaos device-churn injector.
+"""
+import os
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, parallel, resilience
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.resilience import TopologyMismatch
+from mxnet_tpu.resilience import chaos
+
+N_DEV = 8
+
+
+def _mesh(n):
+    """A dp mesh over the first ``n`` visible devices — the in-process
+    stand-in for an attempt that sees only ``n`` chips."""
+    return parallel.local_mesh("dp", devices=jax.devices()[:n])
+
+
+def _make_net(prefix, hidden=16, out=8):
+    """Leading dims (16, 8) tile every extent in the 8→4→8 / 8→2
+    matrix, so the ZeRO path shards the complete optimizer state."""
+    mx.random.seed(3)
+    net = nn.HybridSequential(prefix=prefix)
+    net.add(nn.Dense(hidden, activation="relu", prefix=prefix + "d0_"),
+            nn.Dense(out, prefix=prefix + "d1_"))
+    net.initialize(mx.init.Xavier())
+    return net
+
+
+def _batch(n=32, in_dim=10, classes=8):
+    rng = np.random.RandomState(0)
+    return (rng.randn(n, in_dim).astype("float32"),
+            rng.randint(0, classes, n).astype("float32"))
+
+
+def _resilient(prefix, directory, n_dev=N_DEV, optimizer="sgd",
+               use_kv=False, **kw):
+    if use_kv:
+        kw["kvstore"] = mx.kv.create("local")
+    opt_params = ({"learning_rate": 0.5, "momentum": 0.9}
+                  if optimizer == "sgd" else {"learning_rate": 0.05})
+    return resilience.ResilientTrainer(
+        _make_net(prefix), gluon.loss.SoftmaxCrossEntropyLoss(),
+        optimizer, opt_params, directory=directory, preemption=False,
+        mesh=_mesh(n_dev), grad_reduce="reduce_scatter", **kw)
+
+
+def _opt_leaves(t):
+    return jax.tree_util.tree_leaves(t.trainer._opt_state)
+
+
+def _expected_per_chip(t, dp):
+    """Per-chip opt-state bytes under dp: sharded leaves (leading dim
+    tiles dp) contribute 1/dp of their bytes, the rest (e.g. adam's
+    scalar step count) stay replicated."""
+    total = 0
+    for leaf in _opt_leaves(t):
+        n = int(getattr(leaf, "nbytes", 0))
+        shp = tuple(getattr(leaf, "shape", ()))
+        if len(shp) >= 1 and shp[0] > 0 and shp[0] % dp == 0:
+            n //= dp
+        total += n
+    return total
+
+
+# ========================================================== fail-loud default
+def test_manifest_records_topology(tmp_path):
+    X, Y = _batch()
+    mx.random.seed(17)
+    a = _resilient("elt_", str(tmp_path / "run"))
+    a.step(X, Y)
+    a.save()
+    topo = a.checkpointer.read_manifest(
+        a.checkpointer.latest_step())["user"]["topology"]
+    assert topo["n_devices"] == N_DEV and topo["dp"] == N_DEV
+    assert topo["mesh_axes"] == {"dp": N_DEV}
+    assert topo["grad_reduce"] == "reduce_scatter"
+    a.close()
+
+
+def test_topology_mismatch_without_elastic(tmp_path):
+    """Restoring a mismatched-topology checkpoint without elastic enabled
+    is a typed TopologyMismatch pointing at the adoption path — never a
+    silent mis-restore (the acceptance criterion's fail-loud half)."""
+    X, Y = _batch()
+    mx.random.seed(17)
+    a = _resilient("elm_", str(tmp_path / "run"))
+    a.step(X, Y)
+    a.save()
+    a.close()
+    mx.random.seed(17)
+    b = _resilient("elm_", str(tmp_path / "run"), n_dev=4)
+    with pytest.raises(TopologyMismatch, match="elastic"):
+        b.ensure_initialized(X, Y)
+    assert b.resumed_from is None       # nothing was restored
+    b.close()
+    # env spelling of the opt-in: MXNET_ELASTIC=1 adopts without a ctor arg
+    os.environ["MXNET_ELASTIC"] = "1"
+    try:
+        mx.random.seed(17)
+        c = _resilient("elm_", str(tmp_path / "run"), n_dev=4)
+        c.ensure_initialized(X, Y)
+        assert c.resumed_from is not None
+        assert [r["direction"] for r in c.reshard_history] == ["shrink"]
+        c.close()
+    finally:
+        del os.environ["MXNET_ELASTIC"]
+
+
+def test_checkpointer_like_topology_check(tmp_path):
+    """ShardedCheckpointer itself refuses a like= restore whose live mesh
+    contradicts the manifest's recorded topology (allow_reshard=True opts
+    back in) — the raw-API half of the fail-loud satellite."""
+    from mxnet_tpu.checkpoint import ShardedCheckpointer
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    ckpt = ShardedCheckpointer(str(tmp_path / "ck"))
+    w = jnp.arange(16.0, dtype=jnp.float32)
+    ckpt.save(1, {"w": w},
+              manifest={"topology": {"n_devices": N_DEV, "dp": N_DEV}})
+    like = {"w": jax.device_put(w, NamedSharding(_mesh(4), P()))}
+    with pytest.raises(TopologyMismatch, match="allow_reshard"):
+        ckpt.restore(1, like=like)
+    out = ckpt.restore(1, like=like, allow_reshard=True)
+    np.testing.assert_array_equal(np.asarray(out["w"]), np.asarray(w))
+    # allow_reshard also tolerates target keys the checkpoint never
+    # saved (dropped with a warning — the partial-merge contract); plain
+    # like= restores keep orbax's loud structural error instead
+    like2 = dict(like, extra=jnp.zeros(4, jnp.float32))
+    out2 = ckpt.restore(1, like=like2, allow_reshard=True)
+    assert "extra" not in out2 and "w" in out2
+    ckpt.close()
+
+
+def test_reshard_direction_tiebreak_on_device_count(tmp_path):
+    """dp extent unchanged but the mesh regrown with another axis
+    (dp=4 → dp=4 x tp=2): the adoption is a GROW, not a mislabeled
+    shrink — direction tie-breaks on total device count."""
+    X, Y = _batch()
+    mx.random.seed(17)
+    a = _resilient("eld_", str(tmp_path / "run"), n_dev=4)
+    a.step(X, Y)
+    a.save()
+    a.close()
+    mx.random.seed(17)
+    b = resilience.ResilientTrainer(
+        _make_net("eld_"), gluon.loss.SoftmaxCrossEntropyLoss(),
+        "sgd", {"learning_rate": 0.5, "momentum": 0.9},
+        directory=str(tmp_path / "run"), preemption=False,
+        mesh=parallel.make_mesh({"dp": 4, "tp": 2}),
+        grad_reduce="reduce_scatter", elastic=True)
+    b.ensure_initialized(X, Y)
+    assert [r["direction"] for r in b.reshard_history] == ["grow"]
+    assert b.reshard_history[0]["from_devices"] == 4
+    assert b.reshard_history[0]["to_devices"] == 8
+    b.close()
+
+
+# ========================================================= the reshard matrix
+@pytest.mark.chaos
+@pytest.mark.parametrize("mid,use_kv,optimizer", [
+    (4, False, "sgd"),      # 8→4→8, fused capture, momentum state
+    (2, True, "adam"),      # 8→2→8, kv capture, two-moment state
+], ids=["fused-8-4-8-sgd", "kv-8-2-8-adam"])
+def test_elastic_reshard_matrix(tmp_path, monkeypatch, mid, use_kv,
+                                optimizer):
+    """THE acceptance test, in-process: a ZeRO-1 run killed mid-run at 8
+    devices, resumed at M (opt-state re-sharded N→M via checkpoint adopt),
+    killed again and resumed at 8, matches the uninterrupted run's
+    parameters within float tolerance on both capture paths — with
+    per-chip opt-state bytes scaling with the live dp extent at every
+    stage, and the reshards observable (counter + manifest provenance)."""
+    monkeypatch.setenv("MXNET_TELEMETRY", "1")
+    from mxnet_tpu.observability import catalog as tel
+    reshards0 = {d: tel.ELASTIC_RESHARDS.value(direction=d) or 0
+                 for d in ("grow", "shrink")}
+    X, Y = _batch()
+
+    mx.random.seed(17)
+    ref = _resilient("elx%d_" % mid, str(tmp_path / "ref"),
+                     optimizer=optimizer, use_kv=use_kv)
+    for _ in range(9):
+        ref.step(X, Y)
+
+    mx.random.seed(17)
+    a = _resilient("elx%d_" % mid, str(tmp_path / "run"),
+                   optimizer=optimizer, use_kv=use_kv)
+    for _ in range(3):
+        a.step(X, Y)
+    a.save()
+    a.close()
+
+    # ---- shrink: resume the 8-device checkpoint on M devices
+    mx.random.seed(4242)
+    b = _resilient("elx%d_" % mid, str(tmp_path / "run"), n_dev=mid,
+                   optimizer=optimizer, use_kv=use_kv, elastic=True)
+    b.ensure_initialized(X, Y)
+    assert b.resumed_from == 3
+    assert [r["direction"] for r in b.reshard_history] == ["shrink"]
+    assert b.reshard_history[0]["from_dp"] == N_DEV
+    assert b.reshard_history[0]["to_dp"] == mid
+    ob = b.trainer.opt_state_bytes()
+    assert ob["per_chip_bytes"] == _expected_per_chip(b, mid), ob
+    assert ob["per_chip_bytes"] < ob["total_bytes"]
+    for leaf in _opt_leaves(b):
+        if getattr(leaf, "ndim", 0) >= 1:
+            assert "dp" in str(leaf.sharding.spec), leaf.sharding
+    for _ in range(3):
+        b.step(X, Y)
+    b.save()
+    man = b.checkpointer.read_manifest(b.checkpointer.latest_step())["user"]
+    assert man["topology"]["dp"] == mid
+    assert man["elastic"]["reshards"][-1]["direction"] == "shrink"
+    b.close()
+
+    # ---- grow: resume the M-device checkpoint back on all 8
+    mx.random.seed(99)
+    c = _resilient("elx%d_" % mid, str(tmp_path / "run"),
+                   optimizer=optimizer, use_kv=use_kv, elastic=True)
+    c.ensure_initialized(X, Y)
+    assert c.resumed_from == 6
+    assert [r["direction"] for r in c.reshard_history] == ["grow"]
+    oc = c.trainer.opt_state_bytes()
+    assert oc["per_chip_bytes"] == _expected_per_chip(c, N_DEV), oc
+    assert oc["per_chip_bytes"] < ob["per_chip_bytes"]   # 8-way < mid-way
+    for _ in range(3):
+        c.step(X, Y)
+
+    # digest-within-tolerance: a changed dp extent changes the gradient
+    # reduction order, so cross-topology equivalence is float tolerance,
+    # not sha256 (docs/resilience.md documents the per-case bound)
+    for ka, kc in zip(sorted(ref.trainer._params),
+                      sorted(c.trainer._params)):
+        np.testing.assert_allclose(
+            np.asarray(ref.trainer._params[ka]),
+            np.asarray(c.trainer._params[kc]), rtol=1e-4, atol=1e-6,
+            err_msg=ka)
+    # the reshards were observable: one shrink + one grow on the counter
+    assert (tel.ELASTIC_RESHARDS.value(direction="shrink") or 0) \
+        == reshards0["shrink"] + 1
+    assert (tel.ELASTIC_RESHARDS.value(direction="grow") or 0) \
+        == reshards0["grow"] + 1
+    assert tel.ACTIVE_DEVICES.value() == N_DEV
+    ref.close()
+    c.close()
+
+
+@pytest.mark.chaos
+def test_elastic_same_topology_stays_bitwise(tmp_path):
+    """Elastic enabled but no churn: the adoption path must not engage —
+    resume is the plain bitwise path (reduction order preserved), no
+    reshard recorded."""
+    X, Y = _batch()
+    mx.random.seed(17)
+    ref = _resilient("els_", str(tmp_path / "ref"), elastic=True)
+    for _ in range(6):
+        ref.step(X, Y)
+
+    mx.random.seed(17)
+    a = _resilient("els_", str(tmp_path / "run"), elastic=True)
+    for _ in range(3):
+        a.step(X, Y)
+    a.save()
+    a.close()
+    mx.random.seed(4242)
+    b = _resilient("els_", str(tmp_path / "run"), elastic=True)
+    b.ensure_initialized(X, Y)
+    assert b.resumed_from == 3 and b.reshard_history == []
+    for _ in range(3):
+        b.step(X, Y)
+    for ka, kb in zip(sorted(ref.trainer._params),
+                      sorted(b.trainer._params)):
+        assert np.array_equal(np.asarray(ref.trainer._params[ka]),
+                              np.asarray(b.trainer._params[kb])), ka
+    ref.close()
+    b.close()
+
+
+@pytest.mark.chaos
+def test_mid_epoch_kill_shrink_resume_credits_iterator(tmp_path):
+    """Kill mid-epoch at 8, resume at 4: the checkpointed iterator cursor
+    is credited back across the topology change (no batch skipped or
+    duplicated — the global batch is fixed, only the per-chip split
+    changes), and the finished run matches the uninterrupted one within
+    tolerance."""
+    from mxnet_tpu.io import NDArrayIter
+    rng = np.random.RandomState(7)
+    X = rng.randn(96, 10).astype("float32")
+    Y = rng.randint(0, 8, 96).astype("float32")
+
+    def make_iter():
+        return NDArrayIter(X, Y, batch_size=24, shuffle=True,
+                           last_batch_handle="discard")
+
+    def run_steps(rt, it, n):
+        while rt.step_count < n:
+            try:
+                b = it.next()
+            except StopIteration:
+                it.reset()
+                b = it.next()
+            rt.step(b.data[0], b.label[0])
+
+    mx.random.seed(17)
+    ref = _resilient("eli_", str(tmp_path / "ref"))
+    ref_it = make_iter()
+    ref.attach_data(ref_it)
+    ref.ensure_initialized(X[:24], Y[:24])
+    run_steps(ref, ref_it, 8)           # 2 epochs of 4 batches
+
+    mx.random.seed(17)
+    a = _resilient("eli_", str(tmp_path / "run"))
+    a_it = make_iter()
+    a.attach_data(a_it)
+    a.ensure_initialized(X[:24], Y[:24])
+    run_steps(a, a_it, 3)               # killed strictly mid-epoch
+    a.save()
+    a.close()
+
+    mx.random.seed(4242)
+    b = _resilient("eli_", str(tmp_path / "run"), n_dev=4, elastic=True)
+    b_it = make_iter()
+    b.attach_data(b_it)
+    b.ensure_initialized(X[:24], Y[:24])
+    assert b.resumed_from == 3
+    assert [r["direction"] for r in b.reshard_history] == ["shrink"]
+    run_steps(b, b_it, 8)
+    for ka, kb in zip(sorted(ref.trainer._params),
+                      sorted(b.trainer._params)):
+        np.testing.assert_allclose(
+            np.asarray(ref.trainer._params[ka]),
+            np.asarray(b.trainer._params[kb]), rtol=1e-4, atol=1e-6,
+            err_msg=ka)
+    ref.close()
+    b.close()
+
+
+# ==================================================== fallback + validation
+def test_non_tiling_leaves_replicate_loudly(tmp_path, caplog):
+    """A leaf sharded under dp=8 that does not tile dp=3 falls back to
+    replicated — with a loud warning naming the leaves and the fallback
+    recorded in the reshard provenance (per-chip bytes back to 1x)."""
+    X, Y = _batch(n=24)
+    mx.random.seed(17)
+    a = _resilient("elf_", str(tmp_path / "run"))
+    a.step(X, Y)
+    a.save()
+    a.close()
+    mx.random.seed(17)
+    b = _resilient("elf_", str(tmp_path / "run"), n_dev=3, elastic=True)
+    import logging
+    with caplog.at_level(logging.WARNING, logger="mxnet_tpu"):
+        b.ensure_initialized(X, Y)
+    assert any("fell back to REPLICATED" in r.message
+               for r in caplog.records), caplog.records
+    hist = b.reshard_history[0]
+    assert hist["direction"] == "shrink" and hist["fallback_leaves"]
+    ob = b.trainer.opt_state_bytes()
+    assert ob["per_chip_bytes"] == ob["total_bytes"], ob   # nothing tiles 3
+    b.step(X, Y)                       # and the adopted run still trains
+    b.close()
+
+
+def test_strict_mode_refuses_fallback(tmp_path):
+    X, Y = _batch(n=24)
+    mx.random.seed(17)
+    a = _resilient("elst_", str(tmp_path / "run"))
+    a.step(X, Y)
+    a.save()
+    a.close()
+    mx.random.seed(17)
+    b = _resilient("elst_", str(tmp_path / "run"), n_dev=3,
+                   elastic={"strict": True})
+    with pytest.raises(TopologyMismatch, match="strict"):
+        b.ensure_initialized(X, Y)
+    b.close()
+    with pytest.raises(MXNetError, match="elastic knob"):
+        _resilient("elsu_", str(tmp_path / "u"), elastic={"bogus": 1})
+
+
+def test_indivisible_global_batch_refused(tmp_path):
+    """Fixed global batch, per-chip batch recomputed: a batch that does
+    not re-split over the new dp extent is a clean TopologyMismatch, not
+    a confusing XLA sharding error."""
+    X, Y = _batch(n=32)                 # 32 % 3 != 0
+    mx.random.seed(17)
+    a = _resilient("elb_", str(tmp_path / "run"))
+    a.step(X, Y)
+    a.save()
+    a.close()
+    mx.random.seed(17)
+    b = _resilient("elb_", str(tmp_path / "run"), n_dev=3, elastic=True)
+    with pytest.raises(TopologyMismatch, match="global batch"):
+        b.ensure_initialized(X, Y)
+    b.close()
+
+
+def test_snapshot_topology_guard(tmp_path):
+    """In-memory snapshots cannot cross a topology change: a tampered
+    device count is the same typed refusal as the durable path."""
+    X, Y = _batch()
+    mx.random.seed(17)
+    rt = _resilient("elsn_", str(tmp_path / "run"),
+                    recovery={"snapshot_every": 2, "lag": 0})
+    for _ in range(2):
+        rt.step(X, Y)
+    snaps = rt._snapshots
+    assert len(snaps) == 1
+    snap = snaps.newest()
+    assert snap["n_devices"] == N_DEV
+    snap["n_devices"] = 4
+    with pytest.raises(TopologyMismatch, match="snapshot"):
+        snaps.restore(rt.trainer, snap)
+    rt.close()
+
+
+# ============================================================ AOT + perfwatch
+def test_aot_blob_refused_across_topology(tmp_path):
+    """aot_key covers n_devices: an executable serialized on the 8-device
+    mesh refuses to load into a 4-device trainer (stale blobs die cleanly
+    instead of being re-entered on the wrong topology)."""
+    X, Y = _batch()
+    path = str(tmp_path / "step.aot")
+    t8 = parallel.DataParallelTrainer(
+        _make_net("ela_"), gluon.loss.SoftmaxCrossEntropyLoss(),
+        "sgd", {"learning_rate": 0.5}, mesh=_mesh(8))
+    t8.aot_save(path, X, Y)
+    assert t8.aot_load(path, X, Y)      # same topology: accepted
+    t4 = parallel.DataParallelTrainer(
+        _make_net("ela_"), gluon.loss.SoftmaxCrossEntropyLoss(),
+        "sgd", {"learning_rate": 0.5}, mesh=_mesh(4))
+    assert not t4.aot_load(path, X, Y)  # different topology: clean refusal
+
+
+def test_perfwatch_disarms_on_reshard(tmp_path, caplog):
+    """An elastic reshard changes the step-time baseline signature: the
+    live perf watch disarms with ONE warning instead of spamming false
+    regressions against a floor measured on the dead topology."""
+    import logging
+    X, Y = _batch()
+    mx.random.seed(17)
+    a = _resilient("elp_", str(tmp_path / "run"))
+    a.step(X, Y)
+    a.save()
+    a.close()
+    mx.random.seed(17)
+    b = _resilient("elp_", str(tmp_path / "run"), n_dev=4, elastic=True,
+                   perfwatch={"baseline": {"samples_per_sec": 1e15},
+                              "check_every": 1})
+    assert b.perfwatch.baseline is not None
+    with caplog.at_level(logging.WARNING, logger="mxnet_tpu"):
+        b.ensure_initialized(X, Y)
+        for _ in range(3):
+            b.step(X, Y)
+    disarms = [r for r in caplog.records
+               if "perfwatch disarmed" in r.message]
+    assert len(disarms) == 1 and "reshard" in disarms[0].message
+    assert b.perfwatch.baseline is None
+    assert b.perfwatch.events == []     # no false regression spam
+    b.close()
+
+
+# ================================================================ chaos + env
+def test_resize_devices_injector():
+    """chaos.resize_devices shapes the NEXT process: any existing forced
+    device count in XLA_FLAGS is replaced (not merely prepended, or the
+    target's own setdefault would win), JAX_PLATFORMS pins cpu, and the
+    environment is restored on exit."""
+    before = {k: os.environ.get(k) for k in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    with chaos.resize_devices(4) as env:
+        assert "--xla_force_host_platform_device_count=4" in \
+            os.environ["XLA_FLAGS"]
+        assert os.environ["XLA_FLAGS"].count(
+            "--xla_force_host_platform_device_count") == 1
+        assert os.environ["JAX_PLATFORMS"] == "cpu"
+        assert env["XLA_FLAGS"] == os.environ["XLA_FLAGS"]
+    for k, v in before.items():
+        assert os.environ.get(k) == v
+    env = chaos.device_count_env(
+        2, base={"XLA_FLAGS": "--xla_force_host_platform_device_count=8 "
+                              "--xla_foo=1"})
+    assert "--xla_force_host_platform_device_count=2" in env["XLA_FLAGS"]
+    assert "count=8" not in env["XLA_FLAGS"]
+    assert "--xla_foo=1" in env["XLA_FLAGS"]
+    with pytest.raises(chaos.ChaosError):
+        chaos.device_count_env(0)
+
+
+def test_elastic_trainer_derives_mesh(tmp_path):
+    """ElasticTrainer: mesh from the live device set, elastic on by
+    default — the stock resume path for device-churned restarts."""
+    X, Y = _batch()
+    mx.random.seed(17)
+    a = resilience.ElasticTrainer(
+        _make_net("ele_"), gluon.loss.SoftmaxCrossEntropyLoss(),
+        "sgd", {"learning_rate": 0.5, "momentum": 0.9},
+        directory=str(tmp_path / "run"), preemption=False,
+        grad_reduce="reduce_scatter")
+    assert int(a.mesh.devices.size) == N_DEV
+    for _ in range(2):
+        a.step(X, Y)
+    a.save()
+    a.close()
+    mx.random.seed(17)
+    b = resilience.ElasticTrainer(
+        _make_net("ele_"), gluon.loss.SoftmaxCrossEntropyLoss(),
+        "sgd", {"learning_rate": 0.5, "momentum": 0.9},
+        directory=str(tmp_path / "run"), preemption=False,
+        grad_reduce="reduce_scatter", devices=jax.devices()[:2])
+    b.ensure_initialized(X, Y)
+    assert b.resumed_from == 2
+    assert [r["direction"] for r in b.reshard_history] == ["shrink"]
+    b.step(X, Y)
+    b.close()
+    with pytest.raises(MXNetError, match="devices= or mesh="):
+        resilience.ElasticTrainer(
+            _make_net("ele2_"), gluon.loss.SoftmaxCrossEntropyLoss(),
+            directory=str(tmp_path / "x"), preemption=False,
+            devices=jax.devices()[:2], mesh=_mesh(2))

@@ -802,3 +802,95 @@ def test_lint_trainer_t212_silent_cases(rng, tmp_path, monkeypatch):
     t3, x3, y3 = _lowprec_trainer(rng, "t212c_",
                                   mesh=make_mesh({"dp": 1, "tp": 8}))
     assert not analysis.lint_trainer(t3, x3, y3).by_rule("MXL-T212")
+
+
+# ------------------------------------------------------------- MXL-T213
+def _resilient_pair(rng, prefix, directory, n_dev_save=8, n_dev_live=4,
+                    **live_kw):
+    """A ResilientTrainer that SAVED a checkpoint on ``n_dev_save``
+    devices plus a fresh one whose live mesh spans ``n_dev_live`` —
+    the inelastic-restore fixture."""
+    from mxnet_tpu import gluon, parallel, resilience
+    from mxnet_tpu.gluon import nn
+
+    def build(n_dev, **kw):
+        mx.random.seed(13)
+        net = nn.HybridSequential(prefix=prefix)
+        net.add(nn.Dense(8, activation="relu", prefix=prefix + "d0_"),
+                nn.Dense(4, prefix=prefix + "d1_"))
+        net.initialize(mx.init.Xavier())
+        return resilience.ResilientTrainer(
+            net, gluon.loss.SoftmaxCrossEntropyLoss(),
+            "sgd", {"learning_rate": 0.1}, directory=directory,
+            preemption=False,
+            mesh=parallel.local_mesh("dp", devices=jax.devices()[:n_dev]),
+            **kw)
+
+    x = rng.randn(16, 6).astype("float32")
+    y = rng.randint(0, 4, (16,)).astype("float32")
+    saver = build(n_dev_save)
+    saver.step(x, y)
+    saver.save()
+    saver.close()
+    return build(n_dev_live, **live_kw), x, y
+
+
+def test_lint_trainer_t213_flags_inelastic_restore(rng, tmp_path):
+    """A ResilientTrainer whose checkpoint dir's newest manifest records a
+    different n_devices, without elastic enabled: the first auto-resume
+    would raise TopologyMismatch — MXL-T213 says so before it happens."""
+    rt, x, y = _resilient_pair(rng, "t213_", str(tmp_path / "run"))
+    r = analysis.lint_trainer(rt, x, y)
+    hits = r.by_rule("MXL-T213")
+    assert len(hits) == 1, r.to_text()
+    assert hits[0].severity == "warning"
+    assert "TopologyMismatch" in hits[0].message
+    assert "elastic=True" in hits[0].hint
+    # suppression channel works like every other rule
+    r2 = analysis.lint_trainer(rt, x, y, suppress=("MXL-T213",))
+    assert not r2.by_rule("MXL-T213")
+    assert any(d.rule_id == "MXL-T213" for d in r2.suppressed)
+    rt.close()
+
+
+def test_lint_trainer_t213_silent_cases(rng, tmp_path):
+    """Silent when: elastic is enabled (ctor or ElasticTrainer), the
+    topology matches, the directory is empty, or the subject is a bare
+    DataParallelTrainer (no checkpoint dir to reconcile)."""
+    # elastic enabled: the mismatch is exactly what elastic adopts
+    rt, x, y = _resilient_pair(rng, "t213a_", str(tmp_path / "a"),
+                               elastic=True)
+    assert not analysis.lint_trainer(rt, x, y).by_rule("MXL-T213")
+    rt.close()
+
+    # same topology: nothing to warn about
+    rt2, x2, y2 = _resilient_pair(rng, "t213b_", str(tmp_path / "b"),
+                                  n_dev_live=8)
+    assert not analysis.lint_trainer(rt2, x2, y2).by_rule("MXL-T213")
+    rt2.close()
+
+    # empty checkpoint dir: no manifest, no verdict
+    from mxnet_tpu import gluon, resilience
+    from mxnet_tpu.gluon import nn
+    mx.random.seed(13)
+    net = nn.HybridSequential(prefix="t213c_")
+    net.add(nn.Dense(4, prefix="t213c_d0_"))
+    net.initialize(mx.init.Xavier())
+    rt3 = resilience.ResilientTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.1}, directory=str(tmp_path / "empty"),
+        preemption=False)
+    x3 = rng.randn(16, 6).astype("float32")
+    y3 = rng.randint(0, 4, (16,)).astype("float32")
+    assert not analysis.lint_trainer(rt3, x3, y3).by_rule("MXL-T213")
+    rt3.close()
+
+    # bare DataParallelTrainer: the rule needs the resilience wrapper
+    t, x4, y4 = _lowprec_trainer(rng, "t213d_")
+    assert not analysis.lint_trainer(t, x4, y4).by_rule("MXL-T213")
+
+    # resume=False never restores, so the mismatch can never bite
+    rt4, x5, y5 = _resilient_pair(rng, "t213e_", str(tmp_path / "e"),
+                                  resume=False)
+    assert not analysis.lint_trainer(rt4, x5, y5).by_rule("MXL-T213")
+    rt4.close()

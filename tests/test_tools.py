@@ -110,6 +110,97 @@ def test_crashloop_kills_and_recovers_example(tmp_path):
     assert rc == 0
 
 
+def test_crashloop_devices_schedule_env(tmp_path, capsys):
+    """--devices-schedule forces the per-attempt visible device count
+    (replacing any count the target sets itself) and arms MXNET_ELASTIC;
+    attempts past the schedule reuse its last entry."""
+    import crashloop
+    counter = tmp_path / "n"
+    script = tmp_path / "probe.py"
+    # graceful-preemption shape: exit 0 with no digest on the first two
+    # attempts (crashloop restarts), complete with a digest on the third
+    script.write_text(
+        "import os, pathlib\n"
+        "p = pathlib.Path(%r)\n"
+        "n = int(p.read_text()) if p.exists() else 0\n"
+        "p.write_text(str(n + 1))\n"
+        "print('ENV', os.environ['XLA_FLAGS'], '|',\n"
+        "      os.environ.get('JAX_PLATFORMS'), '|',\n"
+        "      os.environ.get('MXNET_ELASTIC'))\n"
+        "if n >= 2:\n"
+        "    print('FINAL_PARAM_DIGEST=done')\n" % str(counter))
+    rc = crashloop.main(["--interval", "30", "--max-restarts", "3",
+                         "--devices-schedule", "8,4", "--expect-digest",
+                         "done", "--", sys.executable, str(script)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    envs = [l for l in out.splitlines() if l.startswith("ENV ")]
+    assert len(envs) == 3
+    for line, n in zip(envs, (8, 4, 4)):    # schedule clamps at its tail
+        assert "--xla_force_host_platform_device_count=%d" % n in line
+        assert line.count("device_count") == 1      # replaced, not stacked
+        assert "| cpu |" in line and line.endswith("1")
+    assert "sees 8 visible device(s)" in out
+    assert "sees 4 visible device(s)" in out
+
+
+def test_crashloop_expect_params_tolerance(tmp_path, capsys):
+    """--expect-params is the digest's float-tolerance sibling for elastic
+    schedules: allclose within rtol/atol passes, beyond it is the same
+    rc=3 'trajectory diverged' verdict."""
+    import crashloop
+    ref = tmp_path / "ref.npz"
+    run = tmp_path / "run.npz"
+    w = np.arange(8.0, dtype="float32")
+    np.savez(ref, w=w)
+    script = tmp_path / "ok.py"
+    script.write_text("print('FINAL_PARAM_DIGEST=x')\n")
+    base = ["--interval", "30", "--max-restarts", "0",
+            "--expect-params", str(ref), "--params-file", str(run),
+            "--", sys.executable, str(script)]
+
+    np.savez(run, w=w + 1e-7)           # within tolerance
+    assert crashloop.main(base) == 0
+    assert "params match" in capsys.readouterr().out
+
+    np.savez(run, w=w + 1.0)            # way outside
+    assert crashloop.main(base) == 3
+    assert "PARAMS MISMATCH" in capsys.readouterr().out
+
+    np.savez(run, v=w)                  # different param set
+    assert crashloop.main(base) == 3
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_crashloop_elastic_device_churn(tmp_path):
+    """The elastic acceptance bar, end to end across real processes: a
+    ZeRO-1 run killed mid-epoch at 8 devices, resumed at 4 (checkpoint
+    adopted, opt-state re-sharded, iterator credited back), later
+    attempts back at 8 — final params within documented tolerance of the
+    uninterrupted 8-device run (cross-topology resumes change the
+    reduction order, so the comparison is --expect-params, not the
+    bitwise digest)."""
+    import crashloop
+    example = os.path.join(REPO, "example", "resilient_training.py")
+    ref = str(tmp_path / "ref.npz")
+    run = str(tmp_path / "run.npz")
+    p = subprocess.run([sys.executable, example, "--ckpt-dir",
+                        str(tmp_path / "ref"), "--epochs", "8",
+                        "--elastic", "--dump-params", ref],
+                       capture_output=True, text=True, timeout=300)
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "elastic: training on 8 visible device(s)" in p.stdout
+    rc = crashloop.main(["--interval", "2", "--grace", "60",
+                         "--max-restarts", "25", "--kill-mid-epoch",
+                         "--devices-schedule", "8,4,8",
+                         "--expect-params", ref, "--params-file", run,
+                         "--", sys.executable, example, "--ckpt-dir",
+                         str(tmp_path / "run"), "--epochs", "8",
+                         "--elastic", "--dump-params", run])
+    assert rc == 0
+
+
 @pytest.mark.slow
 @pytest.mark.chaos
 def test_crashloop_inject_nan_self_heals(tmp_path):

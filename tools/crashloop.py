@@ -14,6 +14,21 @@ If the target prints ``FINAL_PARAM_DIGEST=...`` on success, crashloop
 echoes it — run once with an interval longer than the job to get the
 uninterrupted digest, then compare: identical digests prove the resume
 path is bitwise-faithful under any kill schedule.
+
+Elastic device churn: ``--devices-schedule 8,4,8`` changes the device
+count the target sees per attempt (virtual CPU devices via XLA_FLAGS /
+JAX_PLATFORMS=cpu, replacing any count the target would set itself) and
+exports ``MXNET_ELASTIC=1`` so a stock resilient script adopts the
+mismatched-topology checkpoint. Across a topology change the resumed
+trajectory is only float-equivalent (the gradient reduction order
+changes with the shard count), so pair it with ``--expect-params`` — a
+tolerance comparison against a reference params dump — instead of the
+bitwise ``--expect-digest``:
+
+    python tools/crashloop.py --interval 5 --devices-schedule 8,4,8 \
+        --expect-params ref.npz --params-file run.npz -- \
+        python example/resilient_training.py --elastic \
+            --ckpt-dir /tmp/run --dump-params run.npz
 """
 from __future__ import annotations
 
@@ -23,6 +38,46 @@ import signal
 import subprocess
 import sys
 import time
+
+_DEVCOUNT_RE = re.compile(r"--xla_force_host_platform_device_count=\d+\s*")
+
+
+def _devices_env(base, n):
+    """Copy of ``base`` with the child's visible device count forced to
+    ``n`` (mirrors resilience.chaos.device_count_env without importing
+    the jax-heavy package into the harness process)."""
+    env = dict(base)
+    flags = _DEVCOUNT_RE.sub("", env.get("XLA_FLAGS", "")).strip()
+    env["XLA_FLAGS"] = ("--xla_force_host_platform_device_count=%d %s"
+                        % (int(n), flags)).strip()
+    env["JAX_PLATFORMS"] = "cpu"
+    env["MXNET_ELASTIC"] = "1"
+    return env
+
+
+def _compare_params(expect_path, got_path, rtol, atol):
+    """Tolerance comparison of two params dumps (npz of name->array).
+    Returns an error string or None. The elastic counterpart of the
+    bitwise digest: a changed dp extent changes the gradient reduction
+    order, so cross-topology equivalence is float-tolerance, not sha256."""
+    import numpy as np
+    try:
+        ref = np.load(expect_path)
+        got = np.load(got_path)
+    except Exception as e:
+        return "cannot load params dumps (%s)" % (e,)
+    if sorted(ref.files) != sorted(got.files):
+        return ("param name sets differ: expected %s got %s"
+                % (sorted(ref.files), sorted(got.files)))
+    for name in ref.files:
+        a, b = ref[name], got[name]
+        if a.shape != b.shape:
+            return "param %s shape %s vs %s" % (name, a.shape, b.shape)
+        if not np.allclose(a, b, rtol=rtol, atol=atol):
+            err = float(np.max(np.abs(a - b)))
+            return ("param %s outside tolerance (max abs err %.3g, "
+                    "rtol=%g atol=%g)" % (name, err, rtol, atol))
+    return None
 
 DIGEST_PREFIX = "FINAL_PARAM_DIGEST="
 # the per-batch progress line the resilient example prints in --epochs
@@ -132,6 +187,29 @@ def main(argv=None):
                          "clean from the last healthy checkpoint")
     ap.add_argument("--expect-digest", default=None,
                     help="fail unless the final FINAL_PARAM_DIGEST matches")
+    ap.add_argument("--devices-schedule", default=None, metavar="N,M,...",
+                    help="elastic chaos: visible device count per attempt "
+                         "(virtual CPU devices; attempt i uses entry "
+                         "min(i, last), so '8,4,8' means start at 8, "
+                         "resume the first restart at 4, later restarts "
+                         "at 8). Exports MXNET_ELASTIC=1 to the target so "
+                         "a stock resilient script adopts the mismatched-"
+                         "topology checkpoint instead of raising "
+                         "TopologyMismatch")
+    ap.add_argument("--expect-params", default=None, metavar="REF.npz",
+                    help="tolerance acceptance for elastic schedules: "
+                         "after the target completes, compare the params "
+                         "dump named by --params-file against this "
+                         "reference npz with --params-rtol/--params-atol "
+                         "(cross-topology resumes change the reduction "
+                         "order, so the bitwise --expect-digest cannot "
+                         "apply)")
+    ap.add_argument("--params-file", default=None, metavar="RUN.npz",
+                    help="where the target writes its final params (its "
+                         "--dump-params path); required with "
+                         "--expect-params")
+    ap.add_argument("--params-rtol", type=float, default=1e-4)
+    ap.add_argument("--params-atol", type=float, default=1e-6)
     ap.add_argument("cmd", nargs=argparse.REMAINDER,
                     help="-- command to run")
     args = ap.parse_args(argv)
@@ -141,6 +219,19 @@ def main(argv=None):
     if not cmd:
         ap.error("no command given (put it after --)")
     sig = signal.SIGTERM if args.signal == "TERM" else signal.SIGKILL
+    schedule = None
+    if args.devices_schedule:
+        try:
+            schedule = [int(x) for x in args.devices_schedule.split(",")
+                        if x.strip()]
+        except ValueError:
+            schedule = []
+        if not schedule or any(n <= 0 for n in schedule):
+            ap.error("--devices-schedule wants comma-separated positive "
+                     "ints, got %r" % args.devices_schedule)
+    if args.expect_params and not args.params_file:
+        ap.error("--expect-params needs --params-file (the path the "
+                 "target's --dump-params writes)")
     env = restart_env = None
     if args.inject_nan:
         import os
@@ -167,10 +258,18 @@ def main(argv=None):
         print("crashloop: attempt %d/%d" % (attempt + 1,
                                             args.max_restarts + 1),
               flush=True)
+        attempt_env = env if attempt == 0 else restart_env
+        if schedule is not None:
+            import os
+            n_dev = schedule[min(attempt, len(schedule) - 1)]
+            attempt_env = _devices_env(
+                attempt_env if attempt_env is not None else os.environ,
+                n_dev)
+            print("crashloop: attempt %d sees %d visible device(s)"
+                  % (attempt + 1, n_dev), flush=True)
         exited, rc, digest = run_once(cmd, args.interval, sig, args.grace,
                                       kill_mid_epoch=args.kill_mid_epoch,
-                                      env=env if attempt == 0
-                                      else restart_env)
+                                      env=attempt_env)
         if exited and rc == 0 and digest is None \
                 and sig is signal.SIGTERM and attempt < args.max_restarts:
             # a graceful preemption exit is ALSO rc 0 (by design) but has
@@ -191,6 +290,17 @@ def main(argv=None):
                           "resumed trajectory diverged"
                           % args.expect_digest, flush=True)
                     return 3
+            if args.expect_params:
+                err = _compare_params(args.expect_params, args.params_file,
+                                      args.params_rtol, args.params_atol)
+                if err:
+                    print("crashloop: PARAMS MISMATCH — %s (the resumed "
+                          "trajectory diverged past tolerance)" % err,
+                          flush=True)
+                    return 3
+                print("crashloop: params match %s within rtol=%g atol=%g"
+                      % (args.expect_params, args.params_rtol,
+                         args.params_atol), flush=True)
             return 0
     print("crashloop: target never completed within %d restarts"
           % args.max_restarts, flush=True)
