@@ -555,8 +555,12 @@ def _foreign_tunnel_clients():
     concurrent client hangs behind them, so each must either be killed
     (session-owned leftovers, see ``_preflight_clear_tunnel``) or the live
     attempt skipped (genuinely foreign processes)."""
-    markers = ("aot_warm.py", "perf_lab.py", "mxtune.py", "collbench.py",
-               "tpu_session")
+    # ONE source of truth for the marker list: the registry's own MARKERS
+    # (every self-registering tool extends it there); the literal fallback
+    # only covers stripped-down bench.py copies shipped without tools/
+    markers = (_tunnel.MARKERS if _tunnel is not None else
+               ("aot_warm.py", "perf_lab.py", "mxtune.py", "collbench.py",
+                "mxserve.py", "loadgen.py", "tpu_session"))
     found = []
     try:
         for pid in os.listdir("/proc"):

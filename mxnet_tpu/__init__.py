@@ -86,6 +86,7 @@ _lazy = {
     "observability": ".observability",
     "tuner": ".tuner",
     "passes": ".passes",
+    "serving": ".serving",
 }
 
 

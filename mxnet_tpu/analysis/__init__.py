@@ -18,8 +18,9 @@ Findings are :class:`Diagnostic` records in a :class:`Report` (text / JSON /
 """
 from .diagnostics import Diagnostic, Report, RuleDef, RULES, Severity
 from .graph_lint import lint_symbol, lint_symbol_json
-from .trace_lint import lint_step, lint_trainer, lint_data_iter
+from .trace_lint import (lint_step, lint_trainer, lint_data_iter,
+                         lint_server)
 
 __all__ = ["Diagnostic", "Report", "RuleDef", "RULES", "Severity",
            "lint_symbol", "lint_symbol_json", "lint_step", "lint_trainer",
-           "lint_data_iter"]
+           "lint_data_iter", "lint_server"]

@@ -48,6 +48,11 @@ Rules (catalog in docs/static_analysis.md):
                                           while the tuner cache holds a
                                           measured reduce_scatter win for
                                           the same signature
+* MXL-T214 unbounded-serving-queue (warning) a model server configured with
+                                          no request-queue bound or no
+                                          default deadline — overload
+                                          becomes unbounded latency
+                                          instead of typed rejections
 """
 from __future__ import annotations
 
@@ -63,7 +68,7 @@ import numpy as np
 from .diagnostics import (Diagnostic, Report, parse_disable_comment,
                           register_rule)
 
-__all__ = ["lint_step", "lint_trainer", "lint_data_iter"]
+__all__ = ["lint_step", "lint_trainer", "lint_data_iter", "lint_server"]
 
 register_rule(
     "MXL-T200", "error", "trace-failure",
@@ -143,6 +148,15 @@ register_rule(
     "MXNET_ELASTIC=1, or resilience.ElasticTrainer) to adopt the "
     "checkpoint — ZeRO-1 optimizer state re-sharded N→M, global batch "
     "re-split, iterator state credited back.")
+register_rule(
+    "MXL-T214", "warning", "unbounded-serving-queue",
+    "A serving model is configured with no request-queue bound (max_queue="
+    "0) or no default per-request deadline (deadline_ms=0): under "
+    "overload the server queues without limit and answers arbitrarily "
+    "late instead of shedding load with typed Overloaded/DeadlineExceeded "
+    "rejections — the exact collapse mode admission control exists to "
+    "prevent. Set ModelConfig(max_queue=, deadline_ms=) or the "
+    "MXNET_SERVE_MAX_QUEUE / MXNET_SERVE_DEADLINE_MS knobs.")
 register_rule(
     "MXL-T211", "warning", "untuned-hot-loop",
     "The trainer runs with all-default perf levers while the autotuner "
@@ -525,6 +539,63 @@ def lint_data_iter(data_iter, *, suppress: Sequence[str] = (),
             "protocol is advertised but cannot capture a resume point, so "
             "resume still restarts the epoch",
             location=name, hint=hint))
+    return report
+
+
+def lint_server(server_or_config, *, suppress: Sequence[str] = (),
+                subject: str = "") -> Report:
+    """Lint a serving configuration for overload-safety (MXL-T214).
+
+    Accepts a :class:`~mxnet_tpu.serving.server.ModelServer` (every model
+    is checked) or a single
+    :class:`~mxnet_tpu.serving.server.ModelConfig`. A pure config check —
+    nothing is started or dispatched. Fires once per hazard per model:
+
+    - ``max_queue`` unset/0 → unbounded queue: overload becomes unbounded
+      memory + latency instead of a typed ``Overloaded``;
+    - ``deadline_ms`` unset/0 → no default deadline: a request no client
+      is waiting for anymore still occupies the chip.
+    """
+    configs = []
+    if hasattr(server_or_config, "models") \
+            and hasattr(server_or_config, "config"):
+        configs = [server_or_config.config(m)
+                   for m in server_or_config.models()]
+        name = type(server_or_config).__name__
+    elif hasattr(server_or_config, "max_queue"):
+        configs = [server_or_config]
+        name = "ModelConfig"
+    else:
+        raise TypeError("lint_server expects a ModelServer or ModelConfig, "
+                        "got %r" % type(server_or_config).__name__)
+    report = Report(subject or f"serving config ({name})", "trace")
+    report.set_suppressions(suppress)
+    for cfg in configs:
+        loc = f"model {cfg.name!r}"
+        if not int(getattr(cfg, "max_queue", 0) or 0):
+            report.add(Diagnostic(
+                "MXL-T214",
+                "model %r serves with an UNBOUNDED request queue: under "
+                "overload every request is accepted and answered "
+                "arbitrarily late (queue memory grows without limit) "
+                "instead of fast typed Overloaded rejections"
+                % cfg.name,
+                location=loc,
+                hint="set ModelConfig(max_queue=N) (or "
+                     "MXNET_SERVE_MAX_QUEUE) — docs/serving.md, "
+                     "'Admission control'"))
+        if not float(getattr(cfg, "deadline_ms", 0.0) or 0.0):
+            report.add(Diagnostic(
+                "MXL-T214",
+                "model %r serves with no default per-request deadline: "
+                "requests whose clients have long timed out are still "
+                "queued and dispatched to the device, and the load-"
+                "shedding policy (drop expired work before dispatch) "
+                "never engages" % cfg.name,
+                location=loc,
+                hint="set ModelConfig(deadline_ms=D) (or "
+                     "MXNET_SERVE_DEADLINE_MS) — clients can still "
+                     "override per request; docs/serving.md, 'Deadlines'"))
     return report
 
 

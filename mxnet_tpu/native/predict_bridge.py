@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import os
 import tempfile
+import threading
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -50,7 +51,21 @@ def _load_param_bytes(param_bytes: bytes):
 
 
 class Predictor:
-    """One bound inference executor with fixed input shapes."""
+    """One bound inference executor with fixed input shapes.
+
+    Thread-safety contract (the serving worker pool depends on it):
+    every entry point takes a **per-handle reentrant lock**, so two
+    threads sharing one handle can never interleave mid-call and corrupt
+    the bound args / cached outputs. But the handle's state machine
+    (set_input → forward → get_output) spans *several* calls — per-call
+    locking cannot make that sequence atomic. Callers therefore either
+    (a) use :meth:`predict`, which runs the whole sequence under ONE
+    lock hold, or (b) follow the **handle-per-worker** contract: each
+    concurrent worker owns its own Predictor (``reshape`` clones share
+    parameters but carry their own lock and executor, so a fleet of
+    per-worker handles costs one parameter load). The C ABI exposes the
+    individual calls only — C hosts must go handle-per-worker.
+    """
 
     def __init__(self, symbol_json: str, param_bytes: bytes,
                  dev_type: int, dev_id: int,
@@ -95,61 +110,97 @@ class Predictor:
         self._exec = sym.bind(ctx, args, aux_states=aux if aux else None)
         self._args = args
         self._outputs = None
+        # per-handle lock: entry points are individually atomic (memory
+        # safety for threads sharing a handle); multi-call sequences are
+        # made atomic by predict() or by handle-per-worker (see class doc)
+        self._lock = threading.RLock()
 
     # ------------------------------------------------------------------ API
     def set_input(self, name: str, data: bytes, shape: Sequence[int]):
         arr = np.frombuffer(data, dtype=np.float32).reshape(
             tuple(int(x) for x in shape)).copy()
-        if name not in self._args:
-            raise ValueError(f"unknown input {name!r}")
-        self._args[name]._set_data(arr)
-        self._outputs = None
+        with self._lock:
+            if name not in self._args:
+                raise ValueError(f"unknown input {name!r}")
+            self._args[name]._set_data(arr)
+            self._outputs = None
 
     def set_input_flat(self, name: str, data: bytes, size: int):
         """C ABI entry: flat float32 buffer reshaped to the bound shape."""
-        if name not in self._args:
-            raise ValueError(f"unknown input {name!r}")
-        shape = tuple(self._args[name].shape)
-        n = int(np.prod(shape)) if shape else 1
-        if int(size) != n:
-            raise ValueError(
-                f"input {name!r} expects {n} floats (shape {shape}), "
-                f"got {size}")
-        self.set_input(name, data, shape)
+        with self._lock:
+            if name not in self._args:
+                raise ValueError(f"unknown input {name!r}")
+            shape = tuple(self._args[name].shape)
+            n = int(np.prod(shape)) if shape else 1
+            if int(size) != n:
+                raise ValueError(
+                    f"input {name!r} expects {n} floats (shape {shape}), "
+                    f"got {size}")
+            self.set_input(name, data, shape)
 
     def forward(self):
-        self._outputs = self._exec.forward(is_train=False)
+        with self._lock:
+            self._outputs = self._exec.forward(is_train=False)
 
     def num_outputs(self) -> int:
         return len(self._sym.list_outputs())
 
     def get_output_shape(self, index: int):
-        if self._outputs is None:
-            self.forward()
-        return tuple(int(x) for x in self._outputs[index].shape)
+        with self._lock:
+            if self._outputs is None:
+                self.forward()
+            return tuple(int(x) for x in self._outputs[index].shape)
 
     def get_output(self, index: int) -> bytes:
-        if self._outputs is None:
-            self.forward()
-        return np.ascontiguousarray(
-            self._outputs[index].asnumpy().astype(np.float32)).tobytes()
+        with self._lock:
+            if self._outputs is None:
+                self.forward()
+            return np.ascontiguousarray(
+                self._outputs[index].asnumpy().astype(np.float32)).tobytes()
+
+    def predict(self, inputs: Dict[str, "np.ndarray"]) -> List["np.ndarray"]:
+        """Atomic set-inputs → forward → read-outputs under ONE lock hold:
+        the sequence-level thread-safety the per-call locks cannot give.
+        ``inputs`` maps input name → array of the bound shape; returns
+        every output as a float32 numpy array. This is the entry point
+        the serving worker pool uses."""
+        with self._lock:
+            for name, arr in inputs.items():
+                if name not in self._args:
+                    raise ValueError(f"unknown input {name!r}")
+                a = np.ascontiguousarray(arr, dtype=np.float32)
+                bound = tuple(self._args[name].shape)
+                if tuple(a.shape) != bound:
+                    raise ValueError(
+                        f"input {name!r}: shape {tuple(a.shape)} does not "
+                        f"match bound shape {bound}")
+                self._args[name]._set_data(a)
+            self._outputs = self._exec.forward(is_train=False)
+            return [np.asarray(o.asnumpy(), dtype=np.float32)
+                    for o in self._outputs]
 
     def reshape(self, new_shapes: Dict[str, Sequence[int]]) -> "Predictor":
-        shapes = {n: tuple(self._args[n].shape) for n in self._input_names}
-        shapes.update({k: tuple(int(x) for x in v)
-                       for k, v in new_shapes.items()})
-        clone = object.__new__(Predictor)
-        clone.__dict__.update(self.__dict__)
-        import mxnet_tpu as mx
-        args = dict(self._args)
-        for n, s in shapes.items():
-            args[n] = mx.nd.zeros(s)
-        clone._args = args
-        clone._exec = self._sym.bind(
-            self._ctx, args, aux_states=self._aux if self._aux else None)
-        clone._input_names = list(self._input_names)
-        clone._outputs = None
-        return clone
+        with self._lock:
+            shapes = {n: tuple(self._args[n].shape)
+                      for n in self._input_names}
+            shapes.update({k: tuple(int(x) for x in v)
+                           for k, v in new_shapes.items()})
+            clone = object.__new__(Predictor)
+            clone.__dict__.update(self.__dict__)
+            import mxnet_tpu as mx
+            args = dict(self._args)
+            for n, s in shapes.items():
+                args[n] = mx.nd.zeros(s)
+            clone._args = args
+            clone._exec = self._sym.bind(
+                self._ctx, args, aux_states=self._aux if self._aux else None)
+            clone._input_names = list(self._input_names)
+            clone._outputs = None
+            # a clone is an independent handle: params shared, lock NOT —
+            # sharing the parent's lock would serialize a handle-per-worker
+            # fleet back into one effective handle
+            clone._lock = threading.RLock()
+            return clone
 
 
 def _parse_attr(txt: str):

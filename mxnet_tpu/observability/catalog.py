@@ -209,6 +209,28 @@ TUNER_BEST_MFU = _m.gauge(
     "MFU of the best measured candidate from the most recent tuner "
     "search (tuner.tune / tools/mxtune.py).")
 
+# --------------------------------------------------------------- serving
+SERVE_REQUESTS = _m.counter(
+    "mxtpu_serve_requests_total",
+    "Model-server requests by final outcome, labeled model= and "
+    "outcome=ok|shed|expired|error (shed = typed admission/breaker/drain "
+    "rejection, expired = deadline passed before dispatch — never sent "
+    "to the device, error = executor fault after retries+isolation).")
+SERVE_LATENCY = _m.histogram(
+    "mxtpu_serve_latency_ms",
+    "End-to-end latency of OK requests (submit to completed result), "
+    "labeled model=. Rejected/expired requests are counted, not timed.",
+    buckets=(1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000, 2500, 10000))
+SERVE_BATCH = _m.histogram(
+    "mxtpu_serve_batch_size",
+    "Rows per dispatched batch BEFORE bucket padding, labeled model=. "
+    "Persistently 1 under load = the assembly window is too short.",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512))
+SERVE_QUEUE_DEPTH = _m.gauge(
+    "mxtpu_serve_queue_depth",
+    "Requests queued per model at last admission/dispatch, labeled "
+    "model=. Pinned at the queue bound = shedding load.")
+
 # -------------------------------------------------------------- callbacks
 SPEEDOMETER_SPS = _m.gauge(
     "mxtpu_speedometer_samples_per_sec",

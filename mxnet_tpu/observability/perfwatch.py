@@ -50,6 +50,9 @@ METRIC_DIRECTIONS: Dict[str, int] = {
     "samples_per_sec": +1,     # live trainer gauge (global, not per-chip)
     "flops_per_step": -1,      # a fatter compiled step is a regression
     "step_ms": -1,
+    "qps": +1,                 # serving ledger row (label="serving")
+    "p50_ms": -1,              # serving accepted-request latency
+    "p99_ms": -1,
 }
 
 DEFAULT_THRESHOLD_PCT = 10.0
@@ -100,6 +103,17 @@ def normalize(doc: Any, source: str = "") -> Optional[Dict[str, Any]]:
         return {"kind": "bench_row", "source": source, "metrics": vals,
                 "provenance": doc.get("provenance"),
                 "unit": doc.get("unit")}
+    if doc.get("label") == "serving" and (
+            doc.get("qps") is not None or doc.get("p99_ms") is not None):
+        # serving ledger row (serving/load.py ledger_row): qps up-is-good,
+        # accepted-latency percentiles down-is-good
+        vals = {}
+        for k in ("qps", "p50_ms", "p99_ms"):
+            if doc.get(k) is not None:
+                vals[k] = float(doc[k])
+        return {"kind": "serving_row", "source": source, "metrics": vals,
+                "model": doc.get("model"),
+                "provenance": doc.get("provenance")}
     if "roofline" in doc or "arithmetic_intensity" in doc:
         vals = {}
         if doc.get("flops") is not None:

@@ -1,0 +1,72 @@
+"""mxnet_tpu.serving — overload-safe batching inference serving.
+
+The "millions of users" axis (ROADMAP item 2): a thin, robust router over
+a small bucketed-executable cache. Every piece already existed — AOT
+executor compilation, the C-predict ``Predictor``, the tuner's
+best-config cache, the resilience stack — this package composes them
+into a server whose headline property is graceful degradation:
+
+=====================  ==================================================
+overload scenario       answer here
+=====================  ==================================================
+request storm           bounded queues + typed ``Overloaded`` rejection
+                        (admission control), assembly window shrinks with
+                        queue depth (server.py / queueing.py)
+slow clients            per-request deadlines end-to-end: expired work is
+                        shed BEFORE dispatch — never sent to the chip
+executor flake          shared retry_transient backoff per dispatch
+poison request          single-request isolation: a failing batch re-runs
+                        request-by-request; only the poison fails
+broken executor         per-model circuit breaker fails fast, half-open
+                        probe after cooldown (breaker.py)
+SIGTERM                 drain via the resilience PreemptionGuard:
+                        in-flight batches finish, queue rejects new work
+any of the above,       serving.chaos injectors + serving.load /
+on demand               tools/loadgen.py prove QPS at bounded p99
+=====================  ==================================================
+
+Telemetry: ``mxtpu_serve_*`` (observability/catalog.py); sustained-QPS
+runs land ``label="serving"`` CostLedger rows perfwatch guards. Docs:
+``docs/serving.md``. CLIs: ``tools/mxserve.py``, ``tools/loadgen.py``.
+"""
+from __future__ import annotations
+
+import importlib as _importlib
+
+__all__ = ["ModelConfig", "ModelServer", "PendingResult",
+           "BucketExecutorCache", "default_buckets", "CircuitBreaker",
+           "BoundedRequestQueue", "ServingEndpoints",
+           "ServingError", "Overloaded", "DeadlineExceeded", "Draining",
+           "CircuitOpen", "ExecutorFault",
+           "run_load", "verdict", "ledger_row",
+           "chaos", "load", "server", "errors", "breaker", "queueing",
+           "executors", "endpoints"]
+
+_lazy_attrs = {
+    "ModelConfig": ".server", "ModelServer": ".server",
+    "PendingResult": ".server",
+    "BucketExecutorCache": ".executors", "default_buckets": ".executors",
+    "CircuitBreaker": ".breaker",
+    "BoundedRequestQueue": ".queueing",
+    "ServingEndpoints": ".endpoints",
+    "ServingError": ".errors", "Overloaded": ".errors",
+    "DeadlineExceeded": ".errors", "Draining": ".errors",
+    "CircuitOpen": ".errors", "ExecutorFault": ".errors",
+    "run_load": ".load", "verdict": ".load", "ledger_row": ".load",
+}
+_lazy_mods = {"chaos", "load", "server", "errors", "breaker", "queueing",
+              "executors", "endpoints"}
+
+
+def __getattr__(name):
+    if name in _lazy_attrs:
+        mod = _importlib.import_module(_lazy_attrs[name], __name__)
+        val = getattr(mod, name)
+        globals()[name] = val
+        return val
+    if name in _lazy_mods:
+        mod = _importlib.import_module("." + name, __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(
+        f"module 'mxnet_tpu.serving' has no attribute {name!r}")

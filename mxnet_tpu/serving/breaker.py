@@ -1,0 +1,102 @@
+"""Per-model circuit breaker: fail fast after repeated executor faults.
+
+Classic three-state breaker (closed → open → half-open) over *consecutive
+batch-level executor failures*. While open, the server rejects the model's
+work immediately with :class:`~mxnet_tpu.serving.errors.CircuitOpen`
+instead of queueing requests a broken executor will fail slowly — that
+keeps the queue (and every healthy model sharing the process) responsive.
+After ``cooldown_s`` one probe batch is allowed through (half-open); its
+success closes the breaker, its failure re-opens it for another cooldown.
+
+Transient faults retried successfully inside a dispatch never reach the
+breaker — only a dispatch that exhausted its retries (or failed
+deterministically) counts, so a single flaky RPC can't darken a model.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict
+
+__all__ = ["CircuitBreaker"]
+
+
+class CircuitBreaker:
+    """Thread-safe consecutive-failure breaker.
+
+    ``allow()`` is asked before each dispatch; ``record_failure()`` /
+    ``record_success()`` after. ``threshold`` consecutive failures open
+    the circuit for ``cooldown_s`` seconds.
+    """
+
+    def __init__(self, threshold: int = 3, cooldown_s: float = 5.0,
+                 clock: Callable[[], float] = time.monotonic):
+        if int(threshold) < 1:
+            raise ValueError("breaker threshold must be >= 1, got %r"
+                             % (threshold,))
+        self.threshold = int(threshold)
+        self.cooldown_s = float(cooldown_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = "closed"
+        self._failures = 0
+        self._opened_at = 0.0
+        self._half_open_at = 0.0
+        self._trips = 0
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def allow(self) -> bool:
+        """May a dispatch proceed right now? An open breaker past its
+        cooldown transitions to half-open and admits ONE probe. A probe
+        whose verdict never arrives (its dispatch path died without
+        reaching record_success/record_failure) must not wedge the model
+        into shedding forever: after another cooldown, half-open admits a
+        fresh probe."""
+        with self._lock:
+            now = self._clock()
+            if self._state == "closed":
+                return True
+            if self._state == "open":
+                if now - self._opened_at >= self.cooldown_s:
+                    self._state = "half-open"
+                    self._half_open_at = now
+                    return True
+                return False
+            # half-open: the single probe is in flight — unless it has
+            # been missing for a full cooldown (lost verdict), in which
+            # case admit another
+            if now - self._half_open_at >= self.cooldown_s:
+                self._half_open_at = now
+                return True
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._state = "closed"
+            self._failures = 0
+
+    def record_failure(self) -> bool:
+        """Count one exhausted/deterministic dispatch failure; returns True
+        when this failure opened (or re-opened) the circuit."""
+        with self._lock:
+            self._failures += 1
+            if self._state == "half-open" or self._failures >= self.threshold:
+                opened = self._state != "open"
+                self._state = "open"
+                self._opened_at = self._clock()
+                if opened:
+                    self._trips += 1
+                return opened
+            return False
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            return {"state": self._state,
+                    "consecutive_failures": self._failures,
+                    "threshold": self.threshold,
+                    "cooldown_s": self.cooldown_s,
+                    "trips": self._trips}

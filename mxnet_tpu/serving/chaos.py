@@ -1,0 +1,259 @@
+"""Serving fault injectors — the misbehaving clients and broken executors
+the overload-safe server must survive, on demand and deterministic.
+
+Same contract as :mod:`mxnet_tpu.resilience.chaos`: every injector is a
+context manager that restores the patched surface on exit, or a pure
+helper. Used by ``tests/test_serving.py`` (the ``serve`` + ``chaos``
+markers) and ``tools/loadgen.py --chaos``.
+
+=================  ======================================================
+injector            failure mode
+=================  ======================================================
+slow_client         requests arrive late: the client stamped its deadline
+                    long before the server saw the request (slow network,
+                    GC-pausing client) — the server must shed the expired
+                    ones, never dispatch them
+request_storm       a burst of submissions far above sustainable QPS —
+                    admission control must answer typed Overloaded fast
+                    and keep accepted-request latency bounded
+slow_executor       the compiled forward takes longer than it should
+                    (contended chip) — makes "sustainable QPS" a known,
+                    box-independent number for tests
+executor_fault      the executor raises: transient (retryable infra
+                    error) or deterministic (fails every retry, opens the
+                    circuit breaker)
+poison_request      ONE request's payload deterministically crashes any
+                    batch containing it — single-request isolation must
+                    fail only the poison, not its batchmates
+=================  ======================================================
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..resilience.chaos import ChaosError
+
+__all__ = ["slow_client", "request_storm", "paced_run", "slow_executor",
+           "executor_fault", "poison_request", "poison_payload",
+           "POISON_SENTINEL"]
+
+# a value a legitimate float32 payload never carries (finite, but at the
+# edge of range) — the poison marker the patched executor looks for
+POISON_SENTINEL = 3.0e38
+
+
+def _state(server, model):
+    st = server._models.get(model)
+    if st is None:
+        raise ChaosError("server has no model %r" % (model,))
+    return st
+
+
+# ---------------------------------------------------------------- clients
+@contextlib.contextmanager
+def slow_client(server, delay: float):
+    """Every ``submit`` stamps its deadline at the client's *intent* time,
+    then takes ``delay`` seconds to reach the server — so a request whose
+    deadline is shorter than ``delay`` arrives already expired. Yields a
+    dict with the live ``delayed`` count."""
+    orig = server.submit
+    state = {"delayed": 0}
+
+    def submit(model, data, deadline_ms=None, deadline_at=None):
+        if deadline_at is None:
+            cfg = server.config(model)
+            dl_ms = cfg.deadline_ms if deadline_ms is None \
+                else float(deadline_ms)
+            deadline_at = (time.monotonic() + dl_ms / 1e3) if dl_ms else None
+        state["delayed"] += 1
+        time.sleep(delay)
+        return orig(model, data, deadline_at=deadline_at)
+
+    server.submit = submit
+    try:
+        yield state
+    finally:
+        server.submit = orig
+
+
+def paced_run(fire: Callable[[], None], *, qps: float, duration_s: float,
+              threads: int = 2) -> None:
+    """THE offered-load pacing skeleton: call ``fire()`` once per request
+    slot at ``qps`` total for ``duration_s``, from ``threads`` paced
+    submitter threads; blocks until the window closes. Accounting is the
+    caller's — ``fire`` does one submission and records its own outcome.
+    Shared by :func:`request_storm` and ``tools/loadgen.py``'s HTTP mode
+    so a pacing fix can never diverge between them."""
+    interval = threads / float(qps)
+    t_end = time.monotonic() + float(duration_s)
+
+    def pump():
+        nxt = time.monotonic()
+        while True:
+            now = time.monotonic()
+            if now >= t_end:
+                return
+            if now < nxt:
+                time.sleep(min(nxt - now, t_end - now))
+                continue
+            nxt += interval
+            fire()
+
+    ts = [threading.Thread(target=pump, daemon=True) for _ in range(threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+
+
+def request_storm(server, model: str, payload, *, qps: float,
+                  duration_s: float, threads: int = 4,
+                  deadline_ms: Optional[float] = None,
+                  collect_timeout_s: float = 10.0) -> Dict[str, object]:
+    """Blast ``qps`` requests/s at one model for ``duration_s`` from
+    ``threads`` paced submitter threads; wait for every accepted request
+    to complete and return outcome counts + accepted-latency percentiles.
+
+    ``payload`` is one sample array or a zero-arg callable producing one.
+    Returns ``{"submitted", "ok", "shed", "expired", "error",
+    "latencies_ms", "p50_ms", "p99_ms", "qps_offered", "duration_s"}`` —
+    sheds rejected at admission (typed Overloaded/Draining) count in
+    ``shed`` without ever creating a future.
+    """
+    make: Callable[[], np.ndarray] = (payload if callable(payload)
+                                      else lambda: payload)
+    lock = threading.Lock()
+    futures: List = []
+    counts = {"submitted": 0, "shed": 0}
+
+    from .errors import ServingError
+
+    def fire():
+        with lock:
+            counts["submitted"] += 1
+        try:
+            t_sub = time.monotonic()
+            f = server.submit(model, make(), deadline_ms=deadline_ms)
+        except ServingError:
+            with lock:
+                counts["shed"] += 1
+        else:
+            with lock:
+                futures.append((f, t_sub))
+
+    paced_run(fire, qps=qps, duration_s=duration_s, threads=threads)
+
+    out = {"submitted": counts["submitted"], "shed": counts["shed"],
+           "ok": 0, "expired": 0, "error": 0,
+           "latencies_ms": [], "qps_offered": float(qps),
+           "duration_s": float(duration_s)}
+    deadline = time.monotonic() + collect_timeout_s
+    for f, t_sub in futures:
+        f._ev.wait(timeout=max(0.0, deadline - time.monotonic()))
+        oc = f.outcome()
+        if oc == "ok":
+            out["ok"] += 1
+            if f.done_at is not None:
+                out["latencies_ms"].append((f.done_at - t_sub) * 1e3)
+        elif oc == "expired":
+            out["expired"] += 1
+        elif oc == "shed":
+            out["shed"] += 1
+        else:
+            out["error"] += 1
+    if out["latencies_ms"]:
+        arr = np.asarray(out["latencies_ms"], np.float64)
+        out["p50_ms"] = float(np.percentile(arr, 50))
+        out["p99_ms"] = float(np.percentile(arr, 99))
+    return out
+
+
+# -------------------------------------------------------------- executors
+@contextlib.contextmanager
+def slow_executor(server, model: str, delay: float):
+    """Every bucket dispatch for ``model`` takes an extra ``delay``
+    seconds — a contended/thermally-throttled chip, and the way tests pin
+    "sustainable QPS" to a known number. Yields the live ``calls``
+    count."""
+    st = _state(server, model)
+    orig = st.cache.run
+    state = {"calls": 0}
+
+    def run(batch):
+        state["calls"] += 1
+        time.sleep(delay)
+        return orig(batch)
+
+    st.cache.run = run
+    try:
+        yield state
+    finally:
+        st.cache.run = orig
+
+
+@contextlib.contextmanager
+def executor_fault(server, model: str, faults: int = 1,
+                   transient: bool = True):
+    """The next ``faults`` dispatches for ``model`` raise. ``transient``
+    faults look like retryable infra errors (``OSError('connection
+    reset…')`` — the shared ``is_transient`` classifier retries them);
+    deterministic ones are :class:`ChaosError` (a typed framework error:
+    never retried, counted by the circuit breaker). Yields the live
+    ``faulted`` count."""
+    st = _state(server, model)
+    orig = st.cache.run
+    state = {"left": int(faults), "faulted": 0}
+
+    def run(batch):
+        if state["left"] > 0:
+            state["left"] -= 1
+            state["faulted"] += 1
+            if transient:
+                raise OSError("chaos: connection reset by peer "
+                              "(transient executor fault)")
+            raise ChaosError("chaos: executor fault (deterministic)")
+        return orig(batch)
+
+    st.cache.run = run
+    try:
+        yield state
+    finally:
+        st.cache.run = orig
+
+
+def poison_payload(feature_shape, sentinel: float = POISON_SENTINEL
+                   ) -> np.ndarray:
+    """A request payload that trips :func:`poison_request`'s patched
+    executor — shaped like a normal sample, marked with the sentinel."""
+    arr = np.full(tuple(int(x) for x in feature_shape), sentinel,
+                  dtype=np.float32)
+    return arr
+
+
+@contextlib.contextmanager
+def poison_request(server, model: str, sentinel: float = POISON_SENTINEL):
+    """ANY batch containing a sentinel-marked row fails deterministically
+    (every retry, every bucket) — the executor-crashing-request failure
+    mode single-request isolation exists for: the server must answer the
+    poison request with a typed ExecutorFault and still serve its
+    batchmates. Yields the live ``crashed`` count."""
+    st = _state(server, model)
+    orig = st.cache.run
+    state = {"crashed": 0}
+
+    def run(batch):
+        if np.any(np.asarray(batch) == np.float32(sentinel)):
+            state["crashed"] += 1
+            raise ChaosError("chaos: poison request crashed the executor")
+        return orig(batch)
+
+    st.cache.run = run
+    try:
+        yield state
+    finally:
+        st.cache.run = orig

@@ -1,0 +1,125 @@
+"""HTTP surface: /healthz, /readyz, /predict over the stdlib http.server.
+
+Deliberately tiny — the server's value is the batching/admission core,
+and production fronting belongs to a real ingress; this is the minimal
+transport that makes health/readiness *probe-able* and lets
+``tools/loadgen.py --url`` drive a remote server. Typed rejections map
+to conventional status codes so a load balancer can react without
+parsing bodies:
+
+=============  =====  ==============================================
+rejection       code   LB reaction
+=============  =====  ==============================================
+Overloaded      429    back off / spill to another replica
+DeadlineExceeded 504   request died in queue; client retries elsewhere
+Draining        503    stop routing here (readyz is already red)
+CircuitOpen     503    model broken here; route elsewhere
+ExecutorFault   500    bad request or broken model — don't retry blind
+=============  =====  ==============================================
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+from .errors import (CircuitOpen, DeadlineExceeded, Draining, ExecutorFault,
+                     Overloaded)
+
+__all__ = ["ServingEndpoints"]
+
+_STATUS = ((Overloaded, 429), (DeadlineExceeded, 504), (Draining, 503),
+           (CircuitOpen, 503), (ExecutorFault, 500))
+
+
+def _make_handler(server):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):   # quiet by default
+            pass
+
+        def _reply(self, code: int, doc) -> None:
+            body = json.dumps(doc).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self._reply(200, server.health())
+            elif self.path == "/readyz":
+                ready = server.ready()
+                self._reply(200 if ready else 503, {"ready": ready})
+            else:
+                self._reply(404, {"error": "unknown path %r" % self.path})
+
+        def do_POST(self):
+            if self.path != "/predict":
+                self._reply(404, {"error": "unknown path %r" % self.path})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", "0"))
+                doc = json.loads(self.rfile.read(n) or b"{}")
+                model = doc["model"]
+                data = np.asarray(doc["data"], np.float32)
+                deadline_ms = doc.get("deadline_ms")
+            except (KeyError, ValueError, TypeError) as e:
+                self._reply(400, {"error": "bad request: %r" % (e,)})
+                return
+            try:
+                out = server.predict(model, data, deadline_ms=deadline_ms)
+            except Exception as e:
+                for cls, code in _STATUS:
+                    if isinstance(e, cls):
+                        self._reply(code, {"error": str(e),
+                                           "type": type(e).__name__})
+                        return
+                self._reply(400, {"error": str(e),
+                                  "type": type(e).__name__})
+                return
+            self._reply(200, {"model": model,
+                              "output": np.asarray(out).tolist()})
+
+    return Handler
+
+
+class ServingEndpoints:
+    """Bind /healthz /readyz /predict for one :class:`ModelServer` on a
+    daemon thread. ``port=0`` picks a free port (read ``.port`` after
+    :meth:`start`)."""
+
+    def __init__(self, server, host: str = "127.0.0.1", port: int = 0):
+        self._server = server
+        self._host, self._port = host, int(port)
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1] if self._httpd else self._port
+
+    def start(self) -> "ServingEndpoints":
+        if self._httpd is not None:
+            return self
+        self._httpd = ThreadingHTTPServer((self._host, self._port),
+                                          _make_handler(self._server))
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True, name="mxserve-http")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+            if self._thread is not None:
+                self._thread.join(timeout=5.0)
+                self._thread = None

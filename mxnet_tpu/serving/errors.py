@@ -1,0 +1,63 @@
+"""Typed rejection surface of the model server.
+
+Every way the server refuses or fails a request is a distinct
+:class:`~mxnet_tpu.base.MXNetError` subclass, so clients (and the HTTP
+layer) can tell *shed load* from *expired work* from *broken executor*
+without parsing messages — the graceful-degradation contract is that an
+overloaded server answers quickly with one of these instead of slowly
+with a timeout.
+
+=====================  ====================================================
+error                   meaning / right client reaction
+=====================  ====================================================
+Overloaded              admission control: the model's bounded queue is
+                        full. Back off and retry later (HTTP 429).
+DeadlineExceeded        the request's deadline passed while it waited —
+                        it was never dispatched to the device. Retrying
+                        with the same deadline under the same load will
+                        expire again (HTTP 504).
+Draining                the server is finishing in-flight work after
+                        SIGTERM / begin_drain(); no new work is accepted.
+                        Retry against another replica (HTTP 503).
+CircuitOpen             repeated executor faults tripped the per-model
+                        circuit breaker; the server fails fast instead of
+                        queueing doomed work (HTTP 503).
+ExecutorFault           the compiled executor raised for this request
+                        (after transient retries and single-request
+                        isolation). Usually a poison request (HTTP 500).
+=====================  ====================================================
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+
+__all__ = ["ServingError", "Overloaded", "DeadlineExceeded", "Draining",
+           "CircuitOpen", "ExecutorFault"]
+
+
+class ServingError(MXNetError):
+    """Base of every typed serving rejection/failure."""
+
+
+class Overloaded(ServingError):
+    """The model's bounded request queue is full (admission control)."""
+
+
+class DeadlineExceeded(ServingError):
+    """The request's deadline passed before dispatch; it never reached
+    the device."""
+
+
+class Draining(ServingError):
+    """The server is draining (SIGTERM / begin_drain): in-flight batches
+    finish, new work is rejected."""
+
+
+class CircuitOpen(ServingError):
+    """The per-model circuit breaker is open after repeated executor
+    faults: fail fast instead of queueing doomed work."""
+
+
+class ExecutorFault(ServingError):
+    """The executor failed this request after transient retries and
+    single-request isolation."""

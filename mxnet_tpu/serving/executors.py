@@ -1,0 +1,182 @@
+"""Bucketed executable cache — "compile few executables, route many requests".
+
+One serving model owns a small ladder of padded batch buckets (keyed the
+way ``BucketingModule`` keys its per-length executors); each bucket binds
+ONE :class:`~mxnet_tpu.native.predict_bridge.Predictor` — i.e. one jitted
+XLA program with fixed shapes — built lazily and kept for the life of the
+server. A request batch of ``n`` rows is padded up to the smallest bucket
+``>= n`` and dispatched through that program; the compiled-graph cost is
+paid once per bucket, never per request (the TVM/Relay serving idiom).
+
+Buckets default from the autotuner's warm-start cache when one exists:
+``tuner.best_cached(device_kind, model=name)`` names the fastest measured
+batch for this device, and the ladder is the powers of two up to it — a
+serving deployment inherits the tuned config without re-searching. With
+no cache (or ``MXNET_SERVE_BUCKETS`` set) an explicit/static ladder is
+used. All predictors after the first share parameters via
+``Predictor.reshape`` (the params are loaded and placed once).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..base import MXNetError, get_env, register_config
+
+__all__ = ["BucketExecutorCache", "default_buckets"]
+
+register_config("MXNET_SERVE_BUCKETS", "", str,
+                "Comma list of padded-batch bucket sizes for the serving "
+                "executable cache (e.g. '1,4,16,64'). Empty = derive from "
+                "the tuner cache's best measured batch for this device/"
+                "model, falling back to 1,2,4,8,16,32.")
+
+_FALLBACK_BUCKETS = (1, 2, 4, 8, 16, 32)
+_MAX_DEFAULT_BUCKET = 128
+
+
+def _device_kind() -> Optional[str]:
+    try:
+        import jax
+        return jax.devices()[0].device_kind
+    except Exception:
+        return None
+
+
+def default_buckets(model: Optional[str] = None) -> Tuple[Tuple[int, ...], str]:
+    """The bucket ladder to serve with, plus its provenance string.
+
+    Priority: ``MXNET_SERVE_BUCKETS`` env > tuner warm-start cache (powers
+    of two up to the best MEASURED batch for this device/model signature)
+    > the static fallback ladder.
+    """
+    env = str(get_env("MXNET_SERVE_BUCKETS", "") or "").strip()
+    if env:
+        try:
+            buckets = tuple(sorted({int(t) for t in env.split(",")
+                                    if t.strip()}))
+        except ValueError as e:
+            raise MXNetError("MXNET_SERVE_BUCKETS: bad bucket list %r (%s)"
+                             % (env, e))
+        if not buckets or any(b < 1 for b in buckets):
+            raise MXNetError("MXNET_SERVE_BUCKETS: buckets must be positive "
+                             "ints, got %r" % (env,))
+        return buckets, "env"
+    try:
+        from ..tuner import best_cached
+        best = best_cached(device_kind=_device_kind(), model=model)
+    except Exception:
+        best = None
+    if best and best.get("batch"):
+        top = min(int(best["batch"]), _MAX_DEFAULT_BUCKET)
+        ladder = [1]
+        while ladder[-1] * 2 <= top:
+            ladder.append(ladder[-1] * 2)
+        if ladder[-1] != top:
+            ladder.append(top)
+        return tuple(ladder), "tuner:%s" % (best.get("config_key")
+                                            or best.get("model") or "cached")
+    return _FALLBACK_BUCKETS, "default"
+
+
+class BucketExecutorCache:
+    """bucket batch size -> bound Predictor, built lazily, params shared.
+
+    Thread-use contract: the cache itself is lock-protected, and every
+    Predictor carries its own per-handle lock, but a bucket's predictor is
+    a single bound executor — the server drives each model from ONE worker
+    thread (handle-per-worker), so dispatches never contend on a handle.
+    """
+
+    def __init__(self, symbol_json: str, param_bytes: bytes = b"", *,
+                 input_name: str = "data",
+                 feature_shape: Sequence[int],
+                 buckets: Sequence[int],
+                 dev_type: int = 1, dev_id: int = 0,
+                 output_keys: Optional[List[str]] = None):
+        if not buckets:
+            raise MXNetError("BucketExecutorCache needs at least one bucket")
+        self.input_name = str(input_name)
+        self.feature_shape = tuple(int(x) for x in feature_shape)
+        self.buckets = tuple(sorted({int(b) for b in buckets}))
+        if self.buckets[0] < 1:
+            raise MXNetError("bucket sizes must be >= 1, got %r"
+                             % (self.buckets,))
+        self._symbol_json = symbol_json
+        self._param_bytes = param_bytes
+        self._dev = (int(dev_type), int(dev_id))
+        self._output_keys = output_keys
+        self._lock = threading.Lock()
+        self._preds: Dict[int, object] = {}
+        self._base = None           # first-built predictor: owns the params
+
+    @property
+    def max_bucket(self) -> int:
+        return self.buckets[-1]
+
+    def bucket_for(self, n: int) -> int:
+        """Smallest bucket >= n. n above the largest bucket is a caller
+        bug — the batcher caps assembly at max_bucket."""
+        for b in self.buckets:
+            if b >= n:
+                return b
+        raise MXNetError("batch of %d rows exceeds the largest bucket %d"
+                         % (n, self.max_bucket))
+
+    def get(self, bucket: int):
+        """The bound predictor for one bucket, building it on first use."""
+        with self._lock:
+            p = self._preds.get(bucket)
+            if p is not None:
+                return p
+            if bucket not in self.buckets:
+                raise MXNetError("unknown bucket %d (ladder: %r)"
+                                 % (bucket, self.buckets))
+            from ..native.predict_bridge import Predictor
+            shape = {self.input_name: (bucket,) + self.feature_shape}
+            if self._base is None:
+                p = Predictor(self._symbol_json, self._param_bytes,
+                              self._dev[0], self._dev[1], shape,
+                              output_keys=self._output_keys)
+                self._base = p
+            else:
+                p = self._base.reshape(shape)
+            self._preds[bucket] = p
+            return p
+
+    def warm(self, buckets: Optional[Sequence[int]] = None) -> List[int]:
+        """Compile (bind + one dummy forward) the given buckets — all of
+        them by default — so the first real request never pays a compile.
+        Returns the list warmed."""
+        done = []
+        for b in (buckets or self.buckets):
+            pred = self.get(int(b))
+            dummy = np.zeros((int(b),) + self.feature_shape, np.float32)
+            pred.predict({self.input_name: dummy})
+            done.append(int(b))
+        return done
+
+    def compiled_buckets(self) -> List[int]:
+        with self._lock:
+            return sorted(self._preds)
+
+    def run(self, batch: np.ndarray) -> np.ndarray:
+        """Dispatch ``batch`` (n rows of ``feature_shape``) through the
+        right bucket; returns the FIRST output's first ``n`` rows (the
+        padding rows are computed and discarded — the price of shape
+        stability)."""
+        batch = np.ascontiguousarray(batch, dtype=np.float32)
+        n = int(batch.shape[0])
+        b = self.bucket_for(n)
+        if batch.shape[1:] != self.feature_shape:
+            raise MXNetError(
+                "batch feature shape %r does not match the model's %r"
+                % (tuple(batch.shape[1:]), self.feature_shape))
+        if b != n:
+            padded = np.zeros((b,) + self.feature_shape, np.float32)
+            padded[:n] = batch
+            batch = padded
+        outs = self.get(b).predict({self.input_name: batch})
+        return np.asarray(outs[0])[:n]

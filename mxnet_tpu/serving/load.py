@@ -1,0 +1,195 @@
+"""Load-generation core + the serving CostLedger row.
+
+``tools/loadgen.py`` is the CLI; this module is the library both it and
+``tests/test_serving.py`` drive: paced multi-threaded submission against
+a live :class:`~mxnet_tpu.serving.server.ModelServer`
+(:func:`run_load`, built on :func:`serving.chaos.request_storm` — a storm
+is just a load run above sustainable QPS), a pass/degraded verdict
+(:func:`verdict`), and :func:`ledger_row` which lands the result in the
+cost ledger as a ``label="serving"`` row so ``tools/perfwatch.py`` can
+guard serving regressions exactly like training throughput (qps higher-
+is-better, p50/p99 lower-is-better).
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ..observability import xcost as _xcost
+from .chaos import request_storm
+
+__all__ = ["run_load", "verdict", "ledger_row", "tiny_model",
+           "model_config_from_files"]
+
+
+def model_config_from_files(model: str, *, params: Optional[str] = None,
+                            feature_shape: Optional[str] = None,
+                            name: Optional[str] = None,
+                            input_name: str = "data",
+                            buckets: Optional[str] = None,
+                            **config_kwargs):
+    """THE CLI model loader, shared by ``tools/mxserve.py`` and
+    ``tools/loadgen.py`` so the tiny-vs-file branch, params read and
+    shape/bucket parsing cannot drift between them.
+
+    ``model`` is a symbol-JSON path or the literal ``"tiny"`` (built-in
+    demo MLP — ``params``/``feature_shape`` ignored). ``feature_shape``
+    and ``buckets`` are CLI-style comma strings. Extra kwargs pass
+    through to :class:`~mxnet_tpu.serving.server.ModelConfig`.
+    """
+    import os
+
+    from .server import ModelConfig
+    if model == "tiny":
+        sym_json, pbytes, feat, _ = tiny_model()
+        mname = name or "tiny"
+    else:
+        if not feature_shape:
+            raise ValueError("--feature-shape is required for a model file")
+        with open(model) as f:
+            sym_json = f.read()
+        pbytes = b""
+        if params:
+            with open(params, "rb") as f:
+                pbytes = f.read()
+        feat = tuple(int(t) for t in feature_shape.split(",") if t.strip())
+        mname = name or os.path.splitext(os.path.basename(model))[0]
+    bucket_list = (tuple(int(t) for t in buckets.split(",") if t.strip())
+                   if buckets else None)
+    return ModelConfig(mname, sym_json, pbytes, feature_shape=feat,
+                       input_name=input_name, buckets=bucket_list,
+                       **config_kwargs)
+
+
+def tiny_model(seed: int = 0, features: int = 4, hidden: int = 3):
+    """A known-weight relu-MLP for self-hosted smoke/load runs:
+    ``(symbol_json, param_bytes, feature_shape, reference_fn)`` where
+    ``reference_fn(sample) -> expected output`` (numpy ground truth the
+    tests assert against). Used by ``tools/mxserve.py --selfcheck`` and
+    ``tools/loadgen.py --selfhost``."""
+    import os
+    import tempfile
+
+    from .. import interop, nd
+    from .. import symbol as sym
+
+    x = sym.Variable("data")
+    h = sym.FullyConnected(x, num_hidden=hidden, name="fc1")
+    out = sym.Activation(h, act_type="relu", name="relu1")
+    rng = np.random.RandomState(seed)
+    w = rng.randn(hidden, features).astype("float32")
+    b = rng.randn(hidden).astype("float32")
+    params = {"arg:fc1_weight": nd.array(w), "arg:fc1_bias": nd.array(b)}
+    fd, pfile = tempfile.mkstemp(suffix=".params")
+    os.close(fd)
+    try:
+        interop.save_reference_params(pfile, params)
+        with open(pfile, "rb") as f:
+            pbytes = f.read()
+    finally:
+        os.unlink(pfile)
+
+    def reference(sample: np.ndarray) -> np.ndarray:
+        return np.maximum(np.asarray(sample, np.float32) @ w.T + b, 0.0)
+
+    return out.tojson(), pbytes, (features,), reference
+
+
+def run_load(server, model: str, *, qps: float, duration_s: float,
+             payload=None, threads: int = 2,
+             deadline_ms: Optional[float] = None,
+             collect_timeout_s: float = 10.0) -> Dict[str, Any]:
+    """Offer ``qps`` requests/s for ``duration_s``; wait for completions.
+
+    Returns the :func:`~mxnet_tpu.serving.chaos.request_storm` stats plus
+    achieved-throughput accounting: ``qps`` (ok completions / wall
+    duration), the outcome fractions, and the model's configured
+    deadline for the verdict."""
+    cfg = server.config(model)
+    if payload is None:
+        payload = np.zeros(cfg.feature_shape, np.float32)
+    t0 = time.monotonic()
+    stats = request_storm(server, model, payload, qps=qps,
+                          duration_s=duration_s, threads=threads,
+                          deadline_ms=deadline_ms,
+                          collect_timeout_s=collect_timeout_s)
+    wall = max(1e-9, time.monotonic() - t0)
+    stats["wall_s"] = wall
+    stats["qps"] = stats["ok"] / wall
+    total = max(1, stats["submitted"])
+    for k in ("ok", "shed", "expired", "error"):
+        stats["%s_frac" % k] = stats[k] / total
+    stats["deadline_ms"] = (float(deadline_ms) if deadline_ms is not None
+                            else cfg.deadline_ms)
+    stats["model"] = model
+    return stats
+
+
+def verdict(stats: Dict[str, Any], *, max_degraded_frac: float = 0.01,
+            p99_budget_ms: Optional[float] = None) -> str:
+    """'ok' | 'degraded' — the loadgen exit-code policy.
+
+    Degraded when more than ``max_degraded_frac`` of offered requests
+    were shed/expired/errored, or accepted p99 exceeds the budget
+    (default: the deadline the run used)."""
+    budget = (p99_budget_ms if p99_budget_ms is not None
+              else stats.get("deadline_ms") or None)
+    bad = stats.get("shed", 0) + stats.get("expired", 0) \
+        + stats.get("error", 0)
+    total = max(1, stats.get("submitted", 0))
+    if bad / total > max_degraded_frac:
+        return "degraded"
+    if budget and stats.get("p99_ms") is not None \
+            and stats["p99_ms"] > float(budget):
+        return "degraded"
+    if not stats.get("ok"):
+        return "degraded"
+    return "ok"
+
+
+def _device_kind():
+    try:
+        import jax
+        d = jax.devices()[0]
+        return d.device_kind, d.platform
+    except Exception:
+        return None, None
+
+
+def ledger_row(stats: Dict[str, Any], *,
+               ledger: Optional[_xcost.CostLedger] = None,
+               extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """Persist one ``label="serving"`` cost-ledger row from a load run.
+
+    The row carries the perfwatch-comparable facts (``qps``, ``p50_ms``,
+    ``p99_ms``) next to the shedding counters, so a later run's row can
+    be diffed against it with ``tools/perfwatch.py`` (directions:
+    qps up-is-good, p50/p99 down-is-good). Appends to ``ledger`` (or the
+    ``MXNET_PERF_LEDGER`` default) when one is configured; always returns
+    the row."""
+    kind, platform = _device_kind()
+    row: Dict[str, Any] = {
+        "label": "serving",
+        "model": stats.get("model"),
+        "qps": round(float(stats.get("qps", 0.0)), 3),
+        "qps_offered": stats.get("qps_offered"),
+        "p50_ms": (round(float(stats["p50_ms"]), 3)
+                   if stats.get("p50_ms") is not None else None),
+        "p99_ms": (round(float(stats["p99_ms"]), 3)
+                   if stats.get("p99_ms") is not None else None),
+        "ok": stats.get("ok"), "shed": stats.get("shed"),
+        "expired": stats.get("expired"), "error": stats.get("error"),
+        "submitted": stats.get("submitted"),
+        "duration_s": stats.get("duration_s"),
+        "deadline_ms": stats.get("deadline_ms"),
+        "device_kind": kind, "platform": platform,
+        "provenance": "loadgen",
+    }
+    if extra:
+        row.update(extra)
+    led = ledger if ledger is not None else _xcost.get_ledger()
+    if led is not None:
+        led.append(row)
+    return row

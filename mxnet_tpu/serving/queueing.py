@@ -1,0 +1,162 @@
+"""Bounded per-model request queue + deadline-aware batch assembly.
+
+The admission-control half of the server: :meth:`BoundedRequestQueue.put`
+is called on the client thread and must be cheap (one lock, one append) —
+when the queue is full it first sheds already-expired entries (work that
+would be dropped at dispatch anyway) and only then rejects with a typed
+:class:`~mxnet_tpu.serving.errors.Overloaded`, so a burst of slow clients
+can't wedge the queue with corpses.
+
+:meth:`take_batch` runs on the model's worker thread and implements the
+dynamic batcher's waiting policy: once the first request is in hand it
+waits up to an *effective* assembly window for more — the window shrinks
+linearly with queue depth (a deep queue means batches fill instantly and
+waiting only adds latency), reaching zero at capacity. Expired requests
+are diverted to a separate list on the way out: they are NEVER part of
+the dispatched batch, which is how the server keeps its "no request past
+its deadline reaches the device" invariant.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, List, Optional, Tuple
+
+from .errors import Draining, Overloaded
+
+__all__ = ["BoundedRequestQueue"]
+
+
+class BoundedRequestQueue:
+    """Deque + condition with admission control and batch assembly.
+
+    ``capacity`` <= 0 means unbounded (mxlint MXL-T214 flags a server
+    configured this way). Items must expose a ``deadline`` attribute —
+    an absolute :func:`time.monotonic` second, or None for no deadline.
+    """
+
+    def __init__(self, capacity: int = 0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.capacity = int(capacity or 0)
+        self._clock = clock
+        self._q: deque = deque()
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._shed_expired = 0
+        self._closed = False
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._q)
+
+    @property
+    def depth(self) -> int:
+        return len(self)
+
+    def _drop_expired_locked(self, now: float) -> List:
+        alive, expired = deque(), []
+        for r in self._q:
+            if r.deadline is not None and r.deadline <= now:
+                expired.append(r)
+            else:
+                alive.append(r)
+        self._q = alive
+        self._shed_expired += len(expired)
+        return expired
+
+    def put(self, req) -> List:
+        """Admit one request or raise :class:`Overloaded`.
+
+        Returns the list of already-expired queue entries shed to make
+        room (the caller completes them with DeadlineExceeded) — shedding
+        dead work is always preferred over rejecting live work.
+
+        A closed queue (:meth:`close`) raises :class:`Draining`: the
+        admission decision and the enqueue are atomic under the queue
+        lock, so a request can never slip in after the drain decided the
+        worker may exit (it would hang unanswered forever).
+        """
+        with self._lock:
+            if self._closed:
+                raise Draining("queue closed: server is draining")
+            expired: List = []
+            if self.capacity > 0 and len(self._q) >= self.capacity:
+                expired = self._drop_expired_locked(self._clock())
+                if len(self._q) >= self.capacity:
+                    raise Overloaded(
+                        "request queue full (%d/%d): overloaded — retry "
+                        "with backoff" % (len(self._q), self.capacity))
+            self._q.append(req)
+            self._cond.notify()
+            return expired
+
+    def close(self) -> None:
+        """Reject every future :meth:`put` with :class:`Draining` and wake
+        parked workers. Already-queued work stays takeable (drain
+        semantics: accepted work finishes)."""
+        with self._lock:
+            self._closed = True
+            self._cond.notify_all()
+
+    def effective_wait(self, base_wait_s: float) -> float:
+        """The assembly window under current load: ``base_wait_s`` when
+        idle, shrinking linearly with depth, zero at/after capacity.
+        Unbounded queues keep the full window (nothing to scale by)."""
+        if self.capacity <= 0:
+            return base_wait_s
+        with self._lock:
+            depth = len(self._q)
+        return base_wait_s * max(0.0, 1.0 - depth / float(self.capacity))
+
+    def take_batch(self, max_size: int, wait_s: float,
+                   should_stop: Callable[[], bool],
+                   idle_poll_s: float = 0.1) -> Tuple[Optional[List], List]:
+        """Assemble the next batch.
+
+        Blocks until at least one request is available (waking every
+        ``idle_poll_s`` to re-check ``should_stop``), then collects up to
+        ``max_size`` requests, waiting at most ``wait_s`` beyond the first
+        for the batch to fill. Returns ``(batch, expired)`` — ``batch`` is
+        None when ``should_stop()`` is true and the queue is empty (worker
+        exits), otherwise a possibly-empty list of unexpired requests.
+        """
+        with self._lock:
+            while not self._q:
+                if should_stop():
+                    return None, []
+                self._cond.wait(timeout=idle_poll_s)
+            now = self._clock()
+            batch: List = []
+            expired: List = []
+
+            def _collect():
+                while self._q and len(batch) < max_size:
+                    r = self._q.popleft()
+                    if r.deadline is not None and r.deadline <= self._clock():
+                        expired.append(r)
+                    else:
+                        batch.append(r)
+
+            _collect()
+            assembly_end = now + max(0.0, wait_s)
+            while batch and len(batch) < max_size and not should_stop():
+                remaining = assembly_end - self._clock()
+                if remaining <= 0:
+                    break
+                self._cond.wait(timeout=remaining)
+                _collect()
+            self._shed_expired += len(expired)
+            return batch, expired
+
+    def drain_remaining(self) -> List:
+        """Pop everything (stop path: the caller fails them typed)."""
+        with self._lock:
+            out = list(self._q)
+            self._q.clear()
+            return out
+
+    @property
+    def shed_expired(self) -> int:
+        with self._lock:
+            return self._shed_expired

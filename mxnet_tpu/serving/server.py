@@ -1,0 +1,589 @@
+"""Overload-safe batching model server.
+
+The "millions of users" front end over the C-predict executor stack: a
+:class:`ModelServer` owns, per model, a bounded request queue
+(:mod:`.queueing`), a single dispatch worker (handle-per-worker over the
+:mod:`.executors` bucket cache) and a circuit breaker (:mod:`.breaker`).
+Its headline property is that it *degrades gracefully instead of
+collapsing*:
+
+- **admission control** — a full queue answers a typed
+  :class:`~mxnet_tpu.serving.errors.Overloaded` in microseconds instead of
+  accepting work it cannot finish;
+- **deadlines end-to-end** — every request carries an absolute deadline
+  (default per model); expired work is shed *before* dispatch, so a
+  request past its deadline is never sent to the chip;
+- **load shedding under depth** — the batch-assembly wait shrinks
+  linearly as the queue fills (zero at capacity), and admission sheds
+  already-expired queue entries before rejecting live work;
+- **fault isolation** — executor faults retry with the shared
+  :func:`~mxnet_tpu.resilience.retry.retry_transient` backoff; a batch
+  that still fails is re-dispatched request-by-request so one poison
+  request cannot take its batchmates down; repeated faults open a
+  per-model circuit breaker that fails fast until a cooldown probe
+  succeeds;
+- **drain on SIGTERM** — via the resilience
+  :class:`~mxnet_tpu.resilience.preemption.PreemptionGuard`: accepted
+  work finishes, new work is rejected with a typed ``Draining``.
+
+Telemetry lands in the PR-3 registry (``mxtpu_serve_*`` families,
+pre-declared in ``observability/catalog.py``); ``serving/load.py`` turns
+a load-generator run into a CostLedger row perfwatch can guard.
+Everything here is host-side threading + numpy; the only device work is
+the bucket executor's jitted forward.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..base import MXNetError, get_env, logger, register_config
+from .breaker import CircuitBreaker
+from .errors import (CircuitOpen, DeadlineExceeded, Draining, ExecutorFault,
+                     Overloaded, ServingError)
+from .executors import BucketExecutorCache, default_buckets
+from .queueing import BoundedRequestQueue
+
+__all__ = ["ModelConfig", "ModelServer", "PendingResult"]
+
+register_config("MXNET_SERVE_MAX_QUEUE", 64, int,
+                "Default per-model request-queue bound (admission control). "
+                "0 = unbounded — mxlint MXL-T214 flags a server built this "
+                "way; an unbounded queue turns overload into unbounded "
+                "latency instead of typed rejections.")
+register_config("MXNET_SERVE_DEADLINE_MS", 250.0, float,
+                "Default per-request latency deadline. Expired requests "
+                "are answered DeadlineExceeded and never dispatched to "
+                "the device. 0 = no default deadline (MXL-T214 flags it).")
+register_config("MXNET_SERVE_MAX_WAIT_MS", 5.0, float,
+                "Base batch-assembly window: how long the batcher waits "
+                "after the first request for the batch to fill. Shrinks "
+                "linearly with queue depth, zero at capacity.")
+register_config("MXNET_SERVE_RETRIES", 2, int,
+                "Transient-executor-fault retries per dispatch (shared "
+                "retry_transient backoff underneath).")
+register_config("MXNET_SERVE_BREAKER_THRESHOLD", 3, int,
+                "Consecutive failed dispatches that open a model's "
+                "circuit breaker.")
+register_config("MXNET_SERVE_BREAKER_COOLDOWN", 5.0, float,
+                "Seconds an open circuit breaker waits before letting one "
+                "half-open probe batch through.")
+
+
+def _now() -> float:
+    return time.monotonic()
+
+
+class PendingResult:
+    """Client-side future for one submitted request."""
+
+    __slots__ = ("_ev", "_value", "_error", "_outcome", "done_at")
+
+    def __init__(self):
+        self._ev = threading.Event()
+        self._value = None
+        self._error: Optional[BaseException] = None
+        self._outcome: Optional[str] = None
+        self.done_at: Optional[float] = None    # monotonic completion time
+
+    def done(self) -> bool:
+        return self._ev.is_set()
+
+    def outcome(self) -> Optional[str]:
+        """'ok' | 'shed' | 'expired' | 'error' once completed."""
+        return self._outcome
+
+    def error(self) -> Optional[BaseException]:
+        self._ev.wait()
+        return self._error
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        if not self._ev.wait(timeout):
+            raise TimeoutError("result not ready")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+    def _complete(self, value=None, error=None, outcome="ok") -> None:
+        self._value, self._error, self._outcome = value, error, outcome
+        self.done_at = time.monotonic()
+        self._ev.set()
+
+
+class _Request:
+    __slots__ = ("data", "deadline", "submitted_at", "dispatch_at", "pending")
+
+    def __init__(self, data: np.ndarray, deadline: Optional[float],
+                 submitted_at: float):
+        self.data = data
+        self.deadline = deadline
+        self.submitted_at = submitted_at
+        self.dispatch_at: Optional[float] = None
+        self.pending = PendingResult()
+
+
+class ModelConfig:
+    """Everything the server needs to serve one model.
+
+    ``max_queue`` / ``deadline_ms`` / ``max_wait_ms`` / retry + breaker
+    knobs default from the ``MXNET_SERVE_*`` environment; explicit
+    ``max_queue=0`` or ``deadline_ms=0`` mean *unbounded* / *no default
+    deadline* — both legal, both flagged by mxlint MXL-T214.
+    """
+
+    def __init__(self, name: str, symbol_json: str, param_bytes: bytes = b"",
+                 *, feature_shape: Sequence[int], input_name: str = "data",
+                 buckets: Optional[Sequence[int]] = None,
+                 max_queue: Optional[int] = None,
+                 deadline_ms: Optional[float] = None,
+                 max_wait_ms: Optional[float] = None,
+                 retries: Optional[int] = None,
+                 breaker_threshold: Optional[int] = None,
+                 breaker_cooldown_s: Optional[float] = None,
+                 dev_type: int = 1, dev_id: int = 0,
+                 output_keys: Optional[List[str]] = None):
+        if not name:
+            raise MXNetError("ModelConfig needs a model name")
+        self.name = str(name)
+        self.symbol_json = symbol_json
+        self.param_bytes = param_bytes
+        self.input_name = str(input_name)
+        self.feature_shape = tuple(int(x) for x in feature_shape)
+        if buckets is not None:
+            self.buckets = tuple(sorted({int(b) for b in buckets}))
+            self.bucket_provenance = "explicit"
+        else:
+            self.buckets, self.bucket_provenance = default_buckets(self.name)
+        self.max_queue = int(get_env("MXNET_SERVE_MAX_QUEUE", 64)
+                             if max_queue is None else max_queue)
+        self.deadline_ms = float(get_env("MXNET_SERVE_DEADLINE_MS", 250.0)
+                                 if deadline_ms is None else deadline_ms)
+        self.max_wait_ms = float(get_env("MXNET_SERVE_MAX_WAIT_MS", 5.0)
+                                 if max_wait_ms is None else max_wait_ms)
+        self.retries = int(get_env("MXNET_SERVE_RETRIES", 2)
+                           if retries is None else retries)
+        self.breaker_threshold = int(
+            get_env("MXNET_SERVE_BREAKER_THRESHOLD", 3)
+            if breaker_threshold is None else breaker_threshold)
+        self.breaker_cooldown_s = float(
+            get_env("MXNET_SERVE_BREAKER_COOLDOWN", 5.0)
+            if breaker_cooldown_s is None else breaker_cooldown_s)
+        if self.max_queue < 0:
+            raise MXNetError("max_queue must be >= 0 (0 = unbounded)")
+        if self.deadline_ms < 0 or self.max_wait_ms < 0:
+            raise MXNetError("deadline_ms/max_wait_ms must be >= 0")
+        self.dev_type, self.dev_id = int(dev_type), int(dev_id)
+        self.output_keys = output_keys
+
+
+class _ModelState:
+    """Per-model runtime: queue, worker, bucket cache, breaker, stats."""
+
+    def __init__(self, cfg: ModelConfig):
+        self.cfg = cfg
+        self.queue = BoundedRequestQueue(cfg.max_queue)
+        self.cache = BucketExecutorCache(
+            cfg.symbol_json, cfg.param_bytes, input_name=cfg.input_name,
+            feature_shape=cfg.feature_shape, buckets=cfg.buckets,
+            dev_type=cfg.dev_type, dev_id=cfg.dev_id,
+            output_keys=cfg.output_keys)
+        self.breaker = CircuitBreaker(cfg.breaker_threshold,
+                                      cfg.breaker_cooldown_s)
+        self.worker: Optional[threading.Thread] = None
+        self.lock = threading.Lock()
+        self.counts = {"ok": 0, "shed": 0, "expired": 0, "error": 0}
+        self.batches = 0
+        self.singles = 0            # isolation re-dispatches after a fault
+        self.retries = 0
+        self.deadline_violations = 0
+        self.latencies: List[float] = []   # ok-request ms, bounded ring
+
+
+_LAT_RING = 8192
+
+
+class ModelServer:
+    """The batching front end. Construct with configs, :meth:`start`,
+    :meth:`submit`/:meth:`predict`, then :meth:`close` (or let SIGTERM
+    drain it).
+
+    >>> server = ModelServer([ModelConfig("m", sym_json, params,
+    ...                                   feature_shape=(4,))])
+    >>> server.start(warm=True)
+    >>> out = server.predict("m", np.zeros(4, "float32"))
+    """
+
+    def __init__(self, models: Sequence[ModelConfig], *,
+                 drain_on_preemption: bool = True):
+        if not models:
+            raise MXNetError("ModelServer needs at least one ModelConfig")
+        self._models: Dict[str, _ModelState] = {}
+        for cfg in models:
+            if cfg.name in self._models:
+                raise MXNetError("duplicate model name %r" % cfg.name)
+            self._models[cfg.name] = _ModelState(cfg)
+        self._drain_on_preemption = bool(drain_on_preemption)
+        self._guard = None
+        self._started = False
+        self._stopped = False
+        self._draining = threading.Event()
+        self._drained = threading.Event()
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self, warm: bool = False) -> "ModelServer":
+        if self._started:
+            return self
+        if self._stopped:
+            raise MXNetError("server was closed; build a new one")
+        if self._drain_on_preemption:
+            from ..resilience import preemption
+            self._guard = preemption.acquire()
+        for name, st in self._models.items():
+            if warm:
+                st.cache.warm()
+            t = threading.Thread(target=self._worker, args=(st,),
+                                 daemon=True, name="mxserve-%s" % name)
+            st.worker = t
+            t.start()
+        self._started = True
+        return self
+
+    def begin_drain(self) -> None:
+        """Enter draining: accepted work finishes, new work is rejected
+        with :class:`Draining`. Idempotent; the SIGTERM path lands here."""
+        if not self._draining.is_set():
+            self._draining.set()
+            logger.info("model server draining: queues reject new work, "
+                        "in-flight batches finish")
+            # closing the queues makes admission-vs-drain atomic: a submit
+            # that already passed the draining check but has not enqueued
+            # yet is rejected AT the queue, so no request can land after
+            # the worker decided it may exit (it would hang forever)
+            for st in self._models.values():
+                st.queue.close()
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """begin_drain + wait for every queue to empty and every worker to
+        exit. Returns True when fully drained within ``timeout``."""
+        self.begin_drain()
+        deadline = None if timeout is None else _now() + timeout
+        for st in self._models.values():
+            if st.worker is not None:
+                left = None if deadline is None else max(0.0, deadline - _now())
+                st.worker.join(timeout=left)
+                if st.worker.is_alive():
+                    return False
+        self._drained.set()
+        return True
+
+    def close(self, timeout: float = 30.0) -> bool:
+        """Drain (bounded), fail anything still queued with ``Draining``,
+        release the preemption guard. Returns the drain() verdict."""
+        if self._stopped:
+            return True
+        ok = self.drain(timeout=timeout)
+        for st in self._models.values():
+            for req in st.queue.drain_remaining():
+                self._complete(st, req, error=Draining(
+                    "server closed before this request was dispatched"),
+                    outcome="shed")
+        self._stopped = True
+        if self._guard is not None:
+            from ..resilience import preemption
+            preemption.release()
+            self._guard = None
+        return ok
+
+    def __enter__(self) -> "ModelServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ------------------------------------------------------------ admission
+    def _check_draining(self) -> None:
+        if self._guard is not None and self._guard.triggered:
+            self.begin_drain()
+        if self._draining.is_set() or self._stopped:
+            raise Draining("server is draining: retry against another "
+                           "replica")
+
+    def submit(self, model: str, data, deadline_ms: Optional[float] = None,
+               deadline_at: Optional[float] = None) -> PendingResult:
+        """Admit one request (one sample of the model's feature shape).
+
+        ``deadline_ms`` overrides the model's default; ``deadline_at`` is
+        an absolute :func:`time.monotonic` deadline (wins over both —
+        propagated end-to-end, e.g. from an upstream hop). Raises typed
+        :class:`Overloaded` / :class:`Draining`; executor errors surface
+        on the returned :class:`PendingResult`.
+        """
+        st = self._models.get(model)
+        if st is None:
+            raise MXNetError("unknown model %r (serving: %s)"
+                             % (model, ", ".join(sorted(self._models))))
+        if not self._started:
+            raise MXNetError("server not started")
+        try:
+            self._check_draining()
+        except Draining:
+            self._count(st, "shed")
+            raise
+        arr = np.asarray(data, dtype=np.float32)
+        if tuple(arr.shape) != st.cfg.feature_shape:
+            raise MXNetError(
+                "request shape %r does not match model %r feature shape %r"
+                % (tuple(arr.shape), model, st.cfg.feature_shape))
+        now = _now()
+        if deadline_at is None:
+            dl_ms = (st.cfg.deadline_ms if deadline_ms is None
+                     else float(deadline_ms))
+            deadline_at = now + dl_ms / 1e3 if dl_ms else None
+        req = _Request(arr, deadline_at, now)
+        try:
+            shed = st.queue.put(req)
+        except (Overloaded, Draining):
+            self._count(st, "shed")
+            raise
+        for dead in shed:
+            self._complete(st, dead, error=DeadlineExceeded(
+                "deadline passed while queued (shed at admission)"),
+                outcome="expired")
+        self._gauge_depth(st)
+        return req.pending
+
+    def predict(self, model: str, data,
+                deadline_ms: Optional[float] = None,
+                timeout: Optional[float] = None) -> np.ndarray:
+        """submit + wait: the synchronous convenience."""
+        return self.submit(model, data, deadline_ms=deadline_ms).result(
+            timeout=timeout)
+
+    # ------------------------------------------------------------- workers
+    def _worker(self, st: _ModelState) -> None:
+        cfg = st.cfg
+
+        def should_stop() -> bool:
+            if self._guard is not None and self._guard.triggered:
+                self.begin_drain()
+            return self._draining.is_set() or self._stopped
+
+        while True:
+            wait_s = st.queue.effective_wait(cfg.max_wait_ms / 1e3)
+            batch, expired = st.queue.take_batch(
+                st.cache.max_bucket, wait_s, should_stop)
+            for req in expired:
+                self._complete(st, req, error=DeadlineExceeded(
+                    "deadline passed while queued (shed before dispatch)"),
+                    outcome="expired")
+            self._gauge_depth(st)
+            if batch is None:
+                return                      # draining and queue empty
+            if not batch:
+                continue
+            try:
+                self._dispatch(st, batch)
+            except Exception as e:  # defensive: a worker must never die
+                logger.exception("serving worker for %r: unexpected "
+                                 "dispatch error: %r", cfg.name, e)
+                # the breaker must still get a verdict: a dispatch that
+                # died before record_success/record_failure would leave a
+                # half-open probe unresolved (wedged in CircuitOpen until
+                # the breaker's lost-verdict cooldown)
+                st.breaker.record_failure()
+                for req in batch:
+                    if not req.pending.done():
+                        self._complete(st, req, error=ExecutorFault(
+                            "internal dispatch error: %r" % (e,)),
+                            outcome="error")
+
+    def _dispatch(self, st: _ModelState, batch: List[_Request]) -> None:
+        # ONE decision timestamp: the expiry filter and the dispatch_at
+        # stamp use the same instant, so "dispatched past its deadline"
+        # (the deadline_violations invariant) is structurally impossible
+        # to introduce via a gap between the two reads
+        dispatch_at = _now()
+        ready: List[_Request] = []
+        for req in batch:
+            # the last line of the no-expired-work-on-the-chip invariant:
+            # anything past deadline at dispatch time is answered, not run
+            if req.deadline is not None and req.deadline <= dispatch_at:
+                self._complete(st, req, error=DeadlineExceeded(
+                    "deadline passed at dispatch"), outcome="expired")
+            else:
+                ready.append(req)
+        if not ready:
+            return
+        if not st.breaker.allow():
+            for req in ready:
+                self._complete(st, req, error=CircuitOpen(
+                    "circuit breaker open for model %r after repeated "
+                    "executor faults" % st.cfg.name), outcome="shed")
+            return
+        for req in ready:
+            req.dispatch_at = dispatch_at
+        arr = np.stack([r.data for r in ready])
+        try:
+            rows = self._run_with_retry(st, arr)
+        except Exception as e:
+            if len(ready) > 1:
+                # isolation: one poison request must not fail its
+                # batchmates — re-dispatch one by one
+                self._dispatch_singly(st, ready, cause=e)
+            else:
+                st.breaker.record_failure()
+                self._complete(st, ready[0], error=self._fault(e),
+                               outcome="error")
+            return
+        st.breaker.record_success()
+        with st.lock:
+            st.batches += 1
+        self._observe_batch(st, len(ready))
+        for i, req in enumerate(ready):
+            self._complete(st, req, value=rows[i], outcome="ok")
+
+    def _dispatch_singly(self, st: _ModelState, ready: List[_Request],
+                         cause: BaseException) -> None:
+        logger.warning("batch of %d failed for model %r (%r): isolating "
+                       "per-request", len(ready), st.cfg.name, cause)
+        any_failed = False
+        for req in ready:
+            t = _now()                 # one filter-and-stamp instant
+            if req.deadline is not None and req.deadline <= t:
+                self._complete(st, req, error=DeadlineExceeded(
+                    "deadline passed during fault isolation"),
+                    outcome="expired")
+                continue
+            with st.lock:
+                st.singles += 1
+            req.dispatch_at = t
+            try:
+                rows = self._run_with_retry(st, req.data[None])
+            except Exception as e:
+                any_failed = True
+                self._complete(st, req, error=self._fault(e),
+                               outcome="error")
+            else:
+                self._observe_batch(st, 1)
+                self._complete(st, req, value=rows[0], outcome="ok")
+        if any_failed:
+            st.breaker.record_failure()
+        else:
+            st.breaker.record_success()
+
+    def _run_with_retry(self, st: _ModelState, arr: np.ndarray) -> np.ndarray:
+        from ..resilience.retry import retry_transient
+
+        def on_retry(i, exc, delay):
+            with st.lock:
+                st.retries += 1
+            logger.warning("model %r: transient executor fault "
+                           "(attempt %d), retrying in %.3fs: %r",
+                           st.cfg.name, i + 1, delay, exc)
+
+        return retry_transient(lambda: st.cache.run(arr),
+                               attempts=st.cfg.retries + 1,
+                               base_delay=0.01, max_delay=0.5,
+                               on_retry=on_retry)
+
+    @staticmethod
+    def _fault(e: BaseException) -> ServingError:
+        if isinstance(e, ServingError):
+            return e
+        return ExecutorFault("executor failed: %r" % (e,))
+
+    # ---------------------------------------------------------- accounting
+    def _complete(self, st: _ModelState, req: _Request, value=None,
+                  error=None, outcome="ok") -> None:
+        done_at = _now()
+        if (outcome == "ok" and req.deadline is not None
+                and req.dispatch_at is not None
+                and req.dispatch_at > req.deadline):
+            # must stay zero: the invariant counter the acceptance test
+            # reads — a dispatch after deadline is a server bug
+            with st.lock:
+                st.deadline_violations += 1
+        latency_ms = (done_at - req.submitted_at) * 1e3
+        if outcome == "ok":
+            with st.lock:
+                st.latencies.append(latency_ms)
+                if len(st.latencies) > _LAT_RING:
+                    del st.latencies[:len(st.latencies) - _LAT_RING]
+            self._observe_latency(st, latency_ms)
+        self._count(st, outcome)
+        req.pending._complete(value=value, error=error, outcome=outcome)
+
+    def _count(self, st: _ModelState, outcome: str) -> None:
+        with st.lock:
+            st.counts[outcome] = st.counts.get(outcome, 0) + 1
+        from ..observability import metrics as _m
+        if _m.enabled():
+            from ..observability import catalog as _c
+            _c.SERVE_REQUESTS.inc(model=st.cfg.name, outcome=outcome)
+
+    def _observe_latency(self, st: _ModelState, ms: float) -> None:
+        from ..observability import metrics as _m
+        if _m.enabled():
+            from ..observability import catalog as _c
+            _c.SERVE_LATENCY.observe(ms, model=st.cfg.name)
+
+    def _observe_batch(self, st: _ModelState, size: int) -> None:
+        from ..observability import metrics as _m
+        if _m.enabled():
+            from ..observability import catalog as _c
+            _c.SERVE_BATCH.observe(size, model=st.cfg.name)
+
+    def _gauge_depth(self, st: _ModelState) -> None:
+        from ..observability import metrics as _m
+        if _m.enabled():
+            from ..observability import catalog as _c
+            _c.SERVE_QUEUE_DEPTH.set(st.queue.depth, model=st.cfg.name)
+
+    # ------------------------------------------------------------- surface
+    def models(self) -> List[str]:
+        return sorted(self._models)
+
+    def config(self, model: str) -> ModelConfig:
+        return self._models[model].cfg
+
+    def stats(self, model: str) -> Dict[str, Any]:
+        st = self._models[model]
+        with st.lock:
+            lat = np.asarray(st.latencies, np.float64)
+            out = {
+                "model": model,
+                "counts": dict(st.counts),
+                "batches": st.batches,
+                "singles": st.singles,
+                "retries": st.retries,
+                "deadline_violations": st.deadline_violations,
+                "queue_depth": st.queue.depth,
+                "breaker": st.breaker.snapshot(),
+                "buckets": list(st.cache.buckets),
+                "buckets_compiled": st.cache.compiled_buckets(),
+                "bucket_provenance": st.cfg.bucket_provenance,
+            }
+        if lat.size:
+            out["p50_ms"] = float(np.percentile(lat, 50))
+            out["p99_ms"] = float(np.percentile(lat, 99))
+            out["mean_ms"] = float(lat.mean())
+        return out
+
+    def ready(self) -> bool:
+        """Readiness: started, not draining/stopped — the /readyz answer.
+        (An open breaker keeps ready=true: other models still serve.)"""
+        if self._guard is not None and self._guard.triggered:
+            self.begin_drain()
+        return bool(self._started and not self._draining.is_set()
+                    and not self._stopped)
+
+    def health(self) -> Dict[str, Any]:
+        """Liveness + per-model detail — the /healthz answer."""
+        status = ("stopped" if self._stopped
+                  else "draining" if self._draining.is_set()
+                  else "serving" if self._started else "created")
+        return {"status": status, "ready": self.ready(),
+                "models": {name: self.stats(name) for name in self._models}}

@@ -267,3 +267,91 @@ def test_ndarray_and_invoke_abi(lib):
     assert lib.MXTPUNDArrayWaitAll() == 0
     for h in (a, z, b):
         assert lib.MXTPUNDArrayFree(h) == 0
+
+
+# --------------------------------------------------------------------------
+# Thread-safety of the Predictor handle (the serving worker pool's
+# dependency): predict() makes the set-input→forward→get-output sequence
+# atomic on a SHARED handle, and reshape() clones are independent handles
+# (params shared, lock not) for the handle-per-worker contract.
+# --------------------------------------------------------------------------
+import threading  # noqa: E402
+
+
+def _reference_weights():
+    # same seed/order as _export_model
+    rng = np.random.RandomState(0)
+    w = rng.randn(3, 4).astype("float32")
+    b = rng.randn(3).astype("float32")
+    return w, b
+
+
+def test_predictor_shared_handle_concurrent_predict(tmp_path):
+    """16 threads hammer ONE handle through the atomic predict(): every
+    thread must get the output of ITS input — interleaved set_input/
+    forward corrupts this without the per-handle lock."""
+    from mxnet_tpu.native.predict_bridge import Predictor
+    js, pbytes, _, _ = _export_model(tmp_path)
+    w, b = _reference_weights()
+    pred = Predictor(js, pbytes, 1, 0, {"data": (2, 4)})
+    errors = []
+
+    def worker(seed):
+        rng = np.random.RandomState(seed)
+        for _ in range(10):
+            d = rng.randn(2, 4).astype("float32")
+            out = pred.predict({"data": d})[0]
+            want = np.maximum(d @ w.T + b, 0.0)
+            if not np.allclose(out, want, rtol=1e-4, atol=1e-5):
+                errors.append((seed, out, want))
+                return
+
+    ts = [threading.Thread(target=worker, args=(100 + i,))
+          for i in range(16)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errors, errors[0]
+
+
+def test_predictor_handle_per_worker_clones(tmp_path):
+    """reshape() clones are independent handles: their own lock and
+    executor, shared params — a per-worker fleet never serializes on the
+    parent's lock and still computes correctly, including under a
+    DIFFERENT bound batch size per worker."""
+    from mxnet_tpu.native.predict_bridge import Predictor
+    js, pbytes, _, _ = _export_model(tmp_path)
+    w, b = _reference_weights()
+    base = Predictor(js, pbytes, 1, 0, {"data": (2, 4)})
+    clones = [base.reshape({"data": (n, 4)}) for n in (1, 2, 3, 4)]
+    assert all(c._lock is not base._lock for c in clones)
+    errors = []
+
+    def worker(idx):
+        pred, n = clones[idx], idx + 1
+        rng = np.random.RandomState(idx)
+        for _ in range(10):
+            d = rng.randn(n, 4).astype("float32")
+            out = pred.predict({"data": d})[0]
+            want = np.maximum(d @ w.T + b, 0.0)
+            if not np.allclose(out, want, rtol=1e-4, atol=1e-5):
+                errors.append((idx, out, want))
+                return
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errors, errors[0]
+
+
+def test_predictor_predict_validates_inputs(tmp_path):
+    from mxnet_tpu.native.predict_bridge import Predictor
+    js, pbytes, _, _ = _export_model(tmp_path)
+    pred = Predictor(js, pbytes, 1, 0, {"data": (2, 4)})
+    with pytest.raises(ValueError, match="unknown input"):
+        pred.predict({"nope": np.zeros((2, 4), "float32")})
+    with pytest.raises(ValueError, match="bound shape"):
+        pred.predict({"data": np.zeros((3, 4), "float32")})

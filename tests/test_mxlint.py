@@ -894,3 +894,53 @@ def test_lint_trainer_t213_silent_cases(rng, tmp_path):
                                   resume=False)
     assert not analysis.lint_trainer(rt4, x5, y5).by_rule("MXL-T213")
     rt4.close()
+
+
+# ---------------------------------------------------------------------------
+# MXL-T214: unbounded-serving-queue — a server configured with no queue
+# bound or no default deadline is overload-unsafe (unbounded latency
+# instead of typed rejections). Pure config check via analysis.lint_server.
+# ---------------------------------------------------------------------------
+def _serve_cfg(**kw):
+    from mxnet_tpu.serving import ModelConfig
+    x = sym.Variable("data")
+    out = sym.FullyConnected(x, num_hidden=2, name="t214_fc")
+    name = kw.pop("name", "t214m")
+    d = dict(feature_shape=(4,), buckets=(1, 2), max_queue=8,
+             deadline_ms=100.0)
+    d.update(kw)
+    return ModelConfig(name, out.tojson(), b"", **d)
+
+
+def test_lint_server_t214_flags_unbounded_and_deadline_free():
+    cfg = _serve_cfg(max_queue=0, deadline_ms=0)
+    report = analysis.lint_server(cfg)
+    diags = report.by_rule("MXL-T214")
+    assert len(diags) == 2
+    msgs = " ".join(d.message for d in diags)
+    assert "UNBOUNDED request queue" in msgs
+    assert "no default per-request deadline" in msgs
+    for d in diags:
+        assert d.severity == "warning"
+
+    # one hazard at a time fires one finding
+    assert len(analysis.lint_server(
+        _serve_cfg(max_queue=0)).by_rule("MXL-T214")) == 1
+    assert len(analysis.lint_server(
+        _serve_cfg(deadline_ms=0)).by_rule("MXL-T214")) == 1
+
+
+def test_lint_server_t214_silent_and_suppressed():
+    # bounded + deadline: overload-safe, silent
+    assert not analysis.lint_server(_serve_cfg()).by_rule("MXL-T214")
+    # suppression moves the finding to the suppressed list
+    report = analysis.lint_server(_serve_cfg(max_queue=0),
+                                  suppress=("MXL-T214",))
+    assert not report.by_rule("MXL-T214")
+    assert len(report.suppressed) == 1
+    # a whole server is checked model by model
+    from mxnet_tpu.serving import ModelServer
+    srv = ModelServer([_serve_cfg(max_queue=0)], drain_on_preemption=False)
+    assert len(analysis.lint_server(srv).by_rule("MXL-T214")) == 1
+    with pytest.raises(TypeError):
+        analysis.lint_server(object())

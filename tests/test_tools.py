@@ -775,3 +775,86 @@ def test_bench_multichip_emits_scaling_row(tmp_path):
     assert lrow["model"] == row["model"]
     assert lrow["provenance"] == row["provenance"]
     assert "degraded" in lrow          # cpu run: flagged in the ledger too
+
+
+# ---------------------------------------------------------------------------
+# Serving CLIs: mxserve selfcheck + loadgen exit-code matrices (mxlint 0/1/2
+# convention) and the tunnel-session both-sides pairing.
+# ---------------------------------------------------------------------------
+@pytest.mark.serve
+def test_mxserve_cli_selfcheck_matrix(tmp_path):
+    """mxserve --selfcheck drives N requests through the full batching
+    path in-process: 0 = all served, 1 = degraded (injected executor
+    fault), 2 = cannot load the model."""
+    cli = os.path.join(REPO, "tools", "mxserve.py")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": "",
+           "MXTPU_TUNNEL_REG_DIR": str(tmp_path / "reg")}
+    p = subprocess.run([sys.executable, cli, "--model", "tiny",
+                        "--selfcheck", "8"],
+                       capture_output=True, text=True, timeout=300, env=env)
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "ok=8 failed=0" in p.stdout
+
+    p = subprocess.run([sys.executable, cli, "--model", "tiny",
+                        "--selfcheck", "4", "--chaos", "executor_fault"],
+                       capture_output=True, text=True, timeout=300, env=env)
+    assert p.returncode == 1, p.stdout + p.stderr
+    assert "failed=4" in p.stdout
+
+    p = subprocess.run([sys.executable, cli, "--model",
+                        str(tmp_path / "missing.json"),
+                        "--feature-shape", "4"],
+                       capture_output=True, text=True, timeout=300, env=env)
+    assert p.returncode == 2, p.stdout + p.stderr
+    assert "cannot load the model" in p.stderr
+
+
+@pytest.mark.serve
+def test_loadgen_cli_matrix_and_serving_row(tmp_path):
+    """loadgen --selfhost: 0 = sustained at bounded p99 (serving row in
+    the ledger, perfwatch-comparable), 1 = degraded (impossible deadline
+    forces expiry), 2 = bad args before any backend init."""
+    import json as _json
+    cli = os.path.join(REPO, "tools", "loadgen.py")
+    ledger = str(tmp_path / "serve_ledger.jsonl")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": "",
+           "MXTPU_TUNNEL_REG_DIR": str(tmp_path / "reg")}
+    p = subprocess.run([sys.executable, cli, "--selfhost", "--qps", "60",
+                        "--duration", "0.8", "--ledger", ledger,
+                        "--format", "json"],
+                       capture_output=True, text=True, timeout=300, env=env)
+    assert p.returncode == 0, p.stdout + p.stderr
+    row = _json.loads(p.stdout.strip().splitlines()[-1])
+    assert row["label"] == "serving" and row["qps"] > 0
+    assert row["p99_ms"] > 0 and row["shed"] == 0
+
+    # the persisted row is a full perfwatch baseline: self-compare is ok
+    from mxnet_tpu.observability import perfwatch
+    norm, err = perfwatch.load_artifact(ledger)
+    assert not err and norm["kind"] == "serving_row"
+    assert perfwatch.compare(norm, norm)["status"] == "ok"
+
+    # overload + 1ms deadline: everything expires/sheds -> degraded
+    p = subprocess.run([sys.executable, cli, "--selfhost", "--qps", "80",
+                        "--duration", "0.6", "--deadline-ms", "1",
+                        "--max-queue", "4"],
+                       capture_output=True, text=True, timeout=300, env=env)
+    assert p.returncode == 1, p.stdout + p.stderr
+
+    p = subprocess.run([sys.executable, cli, "--selfhost", "--qps", "-3"],
+                       capture_output=True, text=True, timeout=60, env=env)
+    assert p.returncode == 2, p.stdout + p.stderr
+
+
+def test_serving_tools_registered_with_tunnel_session():
+    """mxserve/loadgen must appear on BOTH sides of the tunnel registry
+    (MARKERS + bench.py's /proc scan) AND actually self-register — the
+    PR-9 review found a tool that registered itself but was invisible to
+    owned_pids(); this pins the pairing for the serving tools."""
+    import tunnel_session
+    bench_src = open(os.path.join(REPO, "bench.py")).read()
+    for tool in ("mxserve.py", "loadgen.py"):
+        assert tool in tunnel_session.MARKERS, tool
+        assert tool in bench_src, tool
+        tool_src = open(os.path.join(REPO, "tools", tool)).read()
+        assert 'tunnel_session.register("%s"' % tool in tool_src, tool

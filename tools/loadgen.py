@@ -1,0 +1,221 @@
+#!/usr/bin/env python
+"""loadgen — prove sustained QPS at bounded tail latency against the
+batching model server.
+
+Two targets: ``--selfhost`` spins the built-in tiny model (or a given
+symbol/params) in-process and drives the full admission → batcher →
+bucket-executor path; ``--url`` drives a remote ``tools/mxserve.py`` over
+HTTP (/predict, typed rejections mapped from status codes). Either way
+the run's verdict follows the serving SLO: every offered request is
+paced, accepted-request p50/p99 are measured end to end, and shed /
+expired / errored fractions are held against a budget. The result lands
+as a ``label="serving"`` CostLedger row so ``tools/perfwatch.py`` guards
+serving throughput/latency regressions exactly like training rows.
+
+Usage::
+
+    python tools/loadgen.py --selfhost --qps 200 --duration 3
+    python tools/loadgen.py --selfhost --qps 600 --duration 2 \
+        --storm 3 --deadline-ms 100          # deliberate overload probe
+    python tools/loadgen.py --url http://127.0.0.1:8080 --model tiny \
+        --feature-shape 4 --qps 100 --duration 5
+
+Exit codes (mxlint convention): 0 = sustained (degraded fraction within
+``--max-degraded-frac`` and p99 within the deadline), 1 = degraded, 2 =
+cannot run (bad args, no target).
+"""
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, HERE)
+sys.path.insert(1, os.path.join(HERE, "tools"))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="load generator for the batching model server")
+    tgt = ap.add_mutually_exclusive_group(required=True)
+    tgt.add_argument("--selfhost", action="store_true",
+                     help="serve the model in-process and drive it")
+    tgt.add_argument("--url", default=None,
+                     help="base URL of a running mxserve (http://host:port)")
+    ap.add_argument("--model", default="tiny",
+                    help="symbol JSON path or 'tiny' (selfhost); model "
+                         "NAME to address (url mode)")
+    ap.add_argument("--params", default=None)
+    ap.add_argument("--feature-shape", default=None,
+                    help="per-sample shape, e.g. 3,224,224 (required for "
+                         "a model file and for --url)")
+    ap.add_argument("--qps", type=float, default=100.0)
+    ap.add_argument("--duration", type=float, default=3.0)
+    ap.add_argument("--threads", type=int, default=2)
+    ap.add_argument("--storm", type=float, default=None, metavar="MULT",
+                    help="multiply --qps by MULT (deliberate overload; "
+                         "the verdict still applies — expect exit 1)")
+    ap.add_argument("--deadline-ms", type=float, default=None)
+    ap.add_argument("--max-queue", type=int, default=None,
+                    help="selfhost queue bound")
+    ap.add_argument("--buckets", default=None)
+    ap.add_argument("--max-degraded-frac", type=float, default=0.01,
+                    help="max tolerated shed+expired+error fraction "
+                         "before the run is 'degraded'")
+    ap.add_argument("--ledger", default=None,
+                    help="cost-ledger path for the serving row (default: "
+                         "MXNET_PERF_LEDGER; empty default = row printed "
+                         "but not persisted)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    args = ap.parse_args(argv)
+
+    if args.qps <= 0 or args.duration <= 0 or args.threads < 1:
+        sys.stderr.write("loadgen: qps/duration/threads must be "
+                         "positive\n")
+        return 2
+    qps = args.qps * (args.storm if args.storm else 1.0)
+
+    try:
+        import tunnel_session
+        tunnel_session.register("loadgen.py", expected_s=3600)
+    except Exception:
+        pass
+
+    if args.url:
+        return _run_http(args, qps)
+    return _run_selfhost(args, qps)
+
+
+def _emit(args, stats, row, verdict) -> None:
+    if args.format == "json":
+        print(json.dumps(row, sort_keys=True), flush=True)
+    else:
+        print("loadgen: %s  offered=%.0f qps  achieved=%.1f qps  "
+              "ok=%d shed=%d expired=%d error=%d  p50=%.2fms p99=%.2fms"
+              % (verdict, stats.get("qps_offered", 0.0),
+                 stats.get("qps", 0.0), stats.get("ok", 0),
+                 stats.get("shed", 0), stats.get("expired", 0),
+                 stats.get("error", 0), stats.get("p50_ms", float("nan")),
+                 stats.get("p99_ms", float("nan"))), flush=True)
+
+
+def _run_selfhost(args, qps) -> int:
+    try:
+        from mxnet_tpu.observability import xcost
+        from mxnet_tpu.serving import ModelServer
+        from mxnet_tpu.serving import load as sload
+    except Exception as e:
+        sys.stderr.write("loadgen: cannot import the backend: %r\n" % e)
+        return 2
+    try:
+        cfg = sload.model_config_from_files(
+            args.model, params=args.params,
+            feature_shape=args.feature_shape, buckets=args.buckets,
+            max_queue=args.max_queue, deadline_ms=args.deadline_ms)
+        server = ModelServer([cfg]).start(warm=True)
+    except Exception as e:
+        sys.stderr.write("loadgen: cannot build the selfhost server: "
+                         "%r\n" % e)
+        return 2
+    try:
+        stats = sload.run_load(server, cfg.name, qps=qps,
+                               duration_s=args.duration,
+                               threads=args.threads,
+                               deadline_ms=args.deadline_ms)
+    finally:
+        server.close(timeout=15.0)
+    ledger = (xcost.CostLedger(args.ledger) if args.ledger
+              else xcost.get_ledger())
+    row = sload.ledger_row(stats, ledger=ledger,
+                           extra={"target": "selfhost"})
+    v = sload.verdict(stats, max_degraded_frac=args.max_degraded_frac)
+    _emit(args, stats, row, v)
+    return 0 if v == "ok" else 1
+
+
+def _run_http(args, qps) -> int:
+    import urllib.error
+    import urllib.request
+
+    import numpy as np
+
+    if not args.feature_shape:
+        sys.stderr.write("loadgen: --feature-shape is required with "
+                         "--url\n")
+        return 2
+    feat = tuple(int(t) for t in args.feature_shape.split(",") if t.strip())
+    url = args.url.rstrip("/") + "/predict"
+    payload = json.dumps({
+        "model": args.model,
+        "data": np.zeros(feat, np.float32).tolist(),
+        **({"deadline_ms": args.deadline_ms}
+           if args.deadline_ms is not None else {}),
+    }).encode()
+    # one probe before the paced run: an unreachable target is 'cannot
+    # run', not a 100%-error 'degraded'
+    try:
+        req = urllib.request.Request(url, data=payload,
+                                     headers={"Content-Type":
+                                              "application/json"})
+        urllib.request.urlopen(req, timeout=10.0).read()
+    except urllib.error.HTTPError:
+        pass                      # server answered: reachable
+    except Exception as e:
+        sys.stderr.write("loadgen: target unreachable: %r\n" % e)
+        return 2
+
+    from mxnet_tpu.serving.chaos import paced_run
+
+    lock = threading.Lock()
+    stats = {"submitted": 0, "ok": 0, "shed": 0, "expired": 0, "error": 0,
+             "latencies_ms": [], "qps_offered": qps,
+             "duration_s": args.duration, "model": args.model,
+             "deadline_ms": args.deadline_ms}
+
+    def fire():
+        with lock:
+            stats["submitted"] += 1
+        t0 = time.monotonic()
+        try:
+            req = urllib.request.Request(
+                url, data=payload,
+                headers={"Content-Type": "application/json"})
+            urllib.request.urlopen(req, timeout=30.0).read()
+            ms = (time.monotonic() - t0) * 1e3
+            with lock:
+                stats["ok"] += 1
+                stats["latencies_ms"].append(ms)
+        except urllib.error.HTTPError as e:
+            key = ("shed" if e.code in (429, 503)
+                   else "expired" if e.code == 504 else "error")
+            with lock:
+                stats[key] += 1
+        except Exception:
+            with lock:
+                stats["error"] += 1
+
+    t0 = time.monotonic()
+    paced_run(fire, qps=qps, duration_s=args.duration,
+              threads=args.threads)
+    wall = max(1e-9, time.monotonic() - t0)
+    stats["wall_s"] = wall
+    stats["qps"] = stats["ok"] / wall
+    if stats["latencies_ms"]:
+        arr = np.asarray(stats["latencies_ms"], np.float64)
+        stats["p50_ms"] = float(np.percentile(arr, 50))
+        stats["p99_ms"] = float(np.percentile(arr, 99))
+
+    from mxnet_tpu.observability import xcost
+    from mxnet_tpu.serving import load as sload
+    ledger = (xcost.CostLedger(args.ledger) if args.ledger
+              else xcost.get_ledger())
+    row = sload.ledger_row(stats, ledger=ledger, extra={"target": args.url})
+    v = sload.verdict(stats, max_degraded_frac=args.max_degraded_frac)
+    _emit(args, stats, row, v)
+    return 0 if v == "ok" else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
