@@ -88,12 +88,18 @@ def paced_run(fire: Callable[[], None], *, qps: float, duration_s: float,
     submitter threads; blocks until the window closes. Accounting is the
     caller's — ``fire`` does one submission and records its own outcome.
     Shared by :func:`request_storm` and ``tools/loadgen.py``'s HTTP mode
-    so a pacing fix can never diverge between them."""
+    so a pacing fix can never diverge between them.
+
+    Thread phases are staggered by ``1/qps`` so the aggregate stream is
+    evenly spaced — unstaggered threads would fire synchronized bursts of
+    ``threads`` requests, measuring the burst pattern (instantaneous
+    queue pressure, inflated t=0 submissions at short durations) instead
+    of the nominal rate."""
     interval = threads / float(qps)
     t_end = time.monotonic() + float(duration_s)
 
-    def pump():
-        nxt = time.monotonic()
+    def pump(offset: float) -> None:
+        nxt = time.monotonic() + offset
         while True:
             now = time.monotonic()
             if now >= t_end:
@@ -104,7 +110,8 @@ def paced_run(fire: Callable[[], None], *, qps: float, duration_s: float,
             nxt += interval
             fire()
 
-    ts = [threading.Thread(target=pump, daemon=True) for _ in range(threads)]
+    ts = [threading.Thread(target=pump, args=(i * interval / threads,),
+                           daemon=True) for i in range(threads)]
     for t in ts:
         t.start()
     for t in ts:
@@ -121,9 +128,12 @@ def request_storm(server, model: str, payload, *, qps: float,
 
     ``payload`` is one sample array or a zero-arg callable producing one.
     Returns ``{"submitted", "ok", "shed", "expired", "error",
-    "latencies_ms", "p50_ms", "p99_ms", "qps_offered", "duration_s"}`` —
-    sheds rejected at admission (typed Overloaded/Draining) count in
-    ``shed`` without ever creating a future.
+    "unfinished", "latencies_ms", "p50_ms", "p99_ms", "qps_offered",
+    "duration_s", "span_s"}`` — sheds rejected at admission (typed
+    Overloaded/Draining) count in ``shed`` without ever creating a
+    future; futures still pending when ``collect_timeout_s`` lapses
+    count in ``unfinished`` (slow, verdict unknown), never in ``error``
+    (which is reserved for actual executor faults).
     """
     make: Callable[[], np.ndarray] = (payload if callable(payload)
                                       else lambda: payload)
@@ -146,26 +156,44 @@ def request_storm(server, model: str, payload, *, qps: float,
             with lock:
                 futures.append((f, t_sub))
 
+    t_start = time.monotonic()
     paced_run(fire, qps=qps, duration_s=duration_s, threads=threads)
 
     out = {"submitted": counts["submitted"], "shed": counts["shed"],
-           "ok": 0, "expired": 0, "error": 0,
+           "ok": 0, "expired": 0, "error": 0, "unfinished": 0,
            "latencies_ms": [], "qps_offered": float(qps),
            "duration_s": float(duration_s)}
     deadline = time.monotonic() + collect_timeout_s
+    last_done = None
     for f, t_sub in futures:
         f._ev.wait(timeout=max(0.0, deadline - time.monotonic()))
+        # snapshot the verdict ONCE: a future read again later (e.g. for
+        # the span) can flip unfinished->ok in between, leaving span/ok/
+        # unfinished mutually inconsistent
         oc = f.outcome()
         if oc == "ok":
             out["ok"] += 1
             if f.done_at is not None:
                 out["latencies_ms"].append((f.done_at - t_sub) * 1e3)
+                last_done = (f.done_at if last_done is None
+                             else max(last_done, f.done_at))
         elif oc == "expired":
             out["expired"] += 1
         elif oc == "shed":
             out["shed"] += 1
+        elif oc is None:
+            # still pending when collect_timeout_s lapsed: slow, not
+            # faulted — folding these into "error" would skew error_frac
+            # and flip the loadgen verdict on a merely-slow run
+            out["unfinished"] += 1
         else:
             out["error"] += 1
+    # the serving span: the paced window, extended to the last ok
+    # completion — NOT the collection wait (a straggler sitting out most
+    # of collect_timeout_s measures the caller's patience, and dividing
+    # ok by it would deflate achieved qps into a phantom regression)
+    out["span_s"] = max(float(duration_s),
+                        (last_done - t_start) if last_done else 0.0)
     if out["latencies_ms"]:
         arr = np.asarray(out["latencies_ms"], np.float64)
         out["p50_ms"] = float(np.percentile(arr, 50))

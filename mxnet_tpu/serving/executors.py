@@ -37,12 +37,16 @@ _FALLBACK_BUCKETS = (1, 2, 4, 8, 16, 32)
 _MAX_DEFAULT_BUCKET = 128
 
 
-def _device_kind() -> Optional[str]:
+def _device_kind() -> Tuple[Optional[str], Optional[str]]:
+    """``(device_kind, platform)`` of device 0, or ``(None, None)`` with
+    no usable backend. THE device-provenance probe for serving — also
+    stamped into ledger rows by :func:`serving.load.ledger_row`."""
     try:
         import jax
-        return jax.devices()[0].device_kind
+        d = jax.devices()[0]
+        return d.device_kind, d.platform
     except Exception:
-        return None
+        return None, None
 
 
 def default_buckets(model: Optional[str] = None) -> Tuple[Tuple[int, ...], str]:
@@ -66,7 +70,7 @@ def default_buckets(model: Optional[str] = None) -> Tuple[Tuple[int, ...], str]:
         return buckets, "env"
     try:
         from ..tuner import best_cached
-        best = best_cached(device_kind=_device_kind(), model=model)
+        best = best_cached(device_kind=_device_kind()[0], model=model)
     except Exception:
         best = None
     if best and best.get("batch"):

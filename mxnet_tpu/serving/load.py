@@ -19,9 +19,40 @@ import numpy as np
 
 from ..observability import xcost as _xcost
 from .chaos import request_storm
+from .executors import _device_kind
 
-__all__ = ["run_load", "verdict", "ledger_row", "tiny_model",
-           "model_config_from_files"]
+__all__ = ["run_load", "finalize_load_stats", "verdict", "ledger_row",
+           "tiny_model", "model_config_from_files"]
+
+
+def finalize_load_stats(stats: Dict[str, Any], *, t_start: float,
+                        last_done: Optional[float] = None,
+                        wall_s: Optional[float] = None) -> Dict[str, Any]:
+    """THE shared accounting tail of a load run — span-based achieved
+    ``qps``, outcome ``*_frac`` fractions and accepted-latency
+    percentiles — used by BOTH :func:`run_load` (future-based, ``span_s``
+    precomputed by ``request_storm``) and ``tools/loadgen.py``'s HTTP
+    mode, so the two targets' ledger rows cannot drift.
+
+    ``stats`` carries the outcome counts, ``duration_s`` and
+    ``latencies_ms``; when ``span_s`` is absent it is derived from
+    ``last_done`` (absolute monotonic second of the last ok completion)
+    — the paced window extended to that completion, never the
+    collection/timeout patience."""
+    if wall_s is not None:
+        stats["wall_s"] = wall_s
+    if "span_s" not in stats:
+        stats["span_s"] = max(float(stats.get("duration_s") or 0.0),
+                              (last_done - t_start) if last_done else 0.0)
+    stats["qps"] = stats["ok"] / max(1e-9, stats["span_s"])
+    total = max(1, stats.get("submitted", 0))
+    for k in ("ok", "shed", "expired", "error", "unfinished"):
+        stats["%s_frac" % k] = stats.get(k, 0) / total
+    if stats.get("latencies_ms") and "p50_ms" not in stats:
+        arr = np.asarray(stats["latencies_ms"], np.float64)
+        stats["p50_ms"] = float(np.percentile(arr, 50))
+        stats["p99_ms"] = float(np.percentile(arr, 99))
+    return stats
 
 
 def model_config_from_files(model: str, *, params: Optional[str] = None,
@@ -104,9 +135,11 @@ def run_load(server, model: str, *, qps: float, duration_s: float,
     """Offer ``qps`` requests/s for ``duration_s``; wait for completions.
 
     Returns the :func:`~mxnet_tpu.serving.chaos.request_storm` stats plus
-    achieved-throughput accounting: ``qps`` (ok completions / wall
-    duration), the outcome fractions, and the model's configured
-    deadline for the verdict."""
+    achieved-throughput accounting: ``qps`` (ok completions / serving
+    span — the paced window extended to the last ok completion, NOT the
+    collection wait, so one straggler can't deflate the perfwatch-guarded
+    number), the outcome fractions, and the model's configured deadline
+    for the verdict."""
     cfg = server.config(model)
     if payload is None:
         payload = np.zeros(cfg.feature_shape, np.float32)
@@ -115,12 +148,8 @@ def run_load(server, model: str, *, qps: float, duration_s: float,
                           duration_s=duration_s, threads=threads,
                           deadline_ms=deadline_ms,
                           collect_timeout_s=collect_timeout_s)
-    wall = max(1e-9, time.monotonic() - t0)
-    stats["wall_s"] = wall
-    stats["qps"] = stats["ok"] / wall
-    total = max(1, stats["submitted"])
-    for k in ("ok", "shed", "expired", "error"):
-        stats["%s_frac" % k] = stats[k] / total
+    finalize_load_stats(stats, t_start=t0,
+                        wall_s=max(1e-9, time.monotonic() - t0))
     stats["deadline_ms"] = (float(deadline_ms) if deadline_ms is not None
                             else cfg.deadline_ms)
     stats["model"] = model
@@ -132,12 +161,13 @@ def verdict(stats: Dict[str, Any], *, max_degraded_frac: float = 0.01,
     """'ok' | 'degraded' — the loadgen exit-code policy.
 
     Degraded when more than ``max_degraded_frac`` of offered requests
-    were shed/expired/errored, or accepted p99 exceeds the budget
-    (default: the deadline the run used)."""
+    were shed/expired/errored (or still unfinished at collection
+    timeout — slow past any budget is not a success), or accepted p99
+    exceeds the budget (default: the deadline the run used)."""
     budget = (p99_budget_ms if p99_budget_ms is not None
               else stats.get("deadline_ms") or None)
     bad = stats.get("shed", 0) + stats.get("expired", 0) \
-        + stats.get("error", 0)
+        + stats.get("error", 0) + stats.get("unfinished", 0)
     total = max(1, stats.get("submitted", 0))
     if bad / total > max_degraded_frac:
         return "degraded"
@@ -147,15 +177,6 @@ def verdict(stats: Dict[str, Any], *, max_degraded_frac: float = 0.01,
     if not stats.get("ok"):
         return "degraded"
     return "ok"
-
-
-def _device_kind():
-    try:
-        import jax
-        d = jax.devices()[0]
-        return d.device_kind, d.platform
-    except Exception:
-        return None, None
 
 
 def ledger_row(stats: Dict[str, Any], *,
@@ -181,6 +202,7 @@ def ledger_row(stats: Dict[str, Any], *,
                    if stats.get("p99_ms") is not None else None),
         "ok": stats.get("ok"), "shed": stats.get("shed"),
         "expired": stats.get("expired"), "error": stats.get("error"),
+        "unfinished": stats.get("unfinished", 0),
         "submitted": stats.get("submitted"),
         "duration_s": stats.get("duration_s"),
         "deadline_ms": stats.get("deadline_ms"),

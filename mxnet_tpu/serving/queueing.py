@@ -117,14 +117,28 @@ class BoundedRequestQueue:
         Blocks until at least one request is available (waking every
         ``idle_poll_s`` to re-check ``should_stop``), then collects up to
         ``max_size`` requests, waiting at most ``wait_s`` beyond the first
-        for the batch to fill. Returns ``(batch, expired)`` — ``batch`` is
-        None when ``should_stop()`` is true and the queue is empty (worker
-        exits), otherwise a possibly-empty list of unexpired requests.
+        for the batch to fill. Returns ``(batch, expired)``:
+
+        - ``batch`` is None only when the queue is CLOSED and empty —
+          both observed under the queue lock, so no :meth:`put` can ever
+          succeed afterwards and the worker may exit without stranding an
+          accepted request;
+        - an *empty* ``batch`` with the queue still open means
+          ``should_stop`` asked to wind down (or every collected request
+          had expired): the caller latches the drain — closing the queue
+          OUTSIDE this lock — and calls again to sweep stragglers.
+
+        ``should_stop`` is invoked while HOLDING the queue lock: it must
+        be a pure flag check and must never call back into this queue
+        (e.g. :meth:`close`), which would self-deadlock on the
+        non-reentrant lock.
         """
         with self._lock:
             while not self._q:
-                if should_stop():
+                if self._closed:
                     return None, []
+                if should_stop():
+                    return [], []
                 self._cond.wait(timeout=idle_poll_s)
             now = self._clock()
             batch: List = []

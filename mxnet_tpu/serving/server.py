@@ -366,24 +366,34 @@ class ModelServer:
     def _worker(self, st: _ModelState) -> None:
         cfg = st.cfg
 
-        def should_stop() -> bool:
-            if self._guard is not None and self._guard.triggered:
-                self.begin_drain()
-            return self._draining.is_set() or self._stopped
+        def stop_requested() -> bool:
+            # flag-only on purpose: take_batch calls this while holding
+            # the queue's non-reentrant lock, and begin_drain ->
+            # queue.close() re-acquires that same lock — calling it here
+            # would wedge the worker (and then drain/close) forever. The
+            # latch happens below, outside the lock.
+            return ((self._guard is not None and self._guard.triggered)
+                    or self._draining.is_set() or self._stopped)
 
         while True:
+            if stop_requested():
+                # latch the drain outside the queue lock (idempotent).
+                # take_batch keeps sweeping until closed-and-empty, so a
+                # submit that raced the close still gets served (drain
+                # semantics: accepted work finishes).
+                self.begin_drain()
             wait_s = st.queue.effective_wait(cfg.max_wait_ms / 1e3)
             batch, expired = st.queue.take_batch(
-                st.cache.max_bucket, wait_s, should_stop)
+                st.cache.max_bucket, wait_s, stop_requested)
             for req in expired:
                 self._complete(st, req, error=DeadlineExceeded(
                     "deadline passed while queued (shed before dispatch)"),
                     outcome="expired")
             self._gauge_depth(st)
             if batch is None:
-                return                      # draining and queue empty
+                return              # queue closed and empty: nothing can land
             if not batch:
-                continue
+                continue            # all expired, or drain requested: loop
             try:
                 self._dispatch(st, batch)
             except Exception as e:  # defensive: a worker must never die
@@ -449,7 +459,7 @@ class ModelServer:
                          cause: BaseException) -> None:
         logger.warning("batch of %d failed for model %r (%r): isolating "
                        "per-request", len(ready), st.cfg.name, cause)
-        any_failed = False
+        any_ok = False
         for req in ready:
             t = _now()                 # one filter-and-stamp instant
             if req.deadline is not None and req.deadline <= t:
@@ -463,16 +473,23 @@ class ModelServer:
             try:
                 rows = self._run_with_retry(st, req.data[None])
             except Exception as e:
-                any_failed = True
                 self._complete(st, req, error=self._fault(e),
                                outcome="error")
             else:
+                any_ok = True
                 self._observe_batch(st, 1)
                 self._complete(st, req, value=rows[0], outcome="ok")
-        if any_failed:
-            st.breaker.record_failure()
-        else:
+        if any_ok:
+            # at least one isolated re-dispatch succeeded: the executor
+            # is healthy and the fault travels with the poison request(s)
+            # as typed ExecutorFault — a persistent poison CLIENT must
+            # not open the breaker and darken the whole model
             st.breaker.record_success()
+        else:
+            # every re-dispatch failed — or none happened at all (every
+            # batchmate expired before its turn), leaving the batch
+            # fault that sent us here as the only executor evidence
+            st.breaker.record_failure()
 
     def _run_with_retry(self, st: _ModelState, arr: np.ndarray) -> np.ndarray:
         from ..resilience.retry import retry_transient

@@ -140,6 +140,32 @@ def test_assembly_window_shrinks_with_depth():
     assert q.effective_wait(0.01) == 0.0                   # full: no wait
 
 
+def test_take_batch_stop_vs_closed_contract():
+    """take_batch's drain contract: a stop request with the queue still
+    OPEN yields an empty batch — the caller latches the drain (closes
+    the queue) outside the queue lock, because should_stop runs under
+    that non-reentrant lock and closing from inside it self-deadlocks.
+    None comes only once the queue is closed AND empty, after which no
+    put() can succeed, so the worker may exit without stranding an
+    accepted request."""
+    q = BoundedRequestQueue(capacity=4)
+
+    class R:
+        deadline = None
+
+    batch, expired = q.take_batch(8, 0.0, lambda: True)
+    assert batch == [] and expired == []       # open + stop: not an exit
+    r = R()
+    q.put(r)
+    q.close()
+    with pytest.raises(Draining):
+        q.put(R())
+    batch, _ = q.take_batch(8, 0.0, lambda: True)
+    assert batch == [r]                        # closed: accepted still served
+    batch, expired = q.take_batch(8, 0.0, lambda: False)
+    assert batch is None and expired == []     # closed and empty: safe exit
+
+
 # ----------------------------------------------------------------- deadlines
 def test_expired_work_never_dispatched(tiny, server):
     before = _outcomes("m")
@@ -199,6 +225,43 @@ def test_poison_request_isolated_from_batchmates(tiny, server):
     assert server.stats("m")["singles"] >= 1
 
 
+def test_persistent_poison_client_does_not_open_breaker(tiny):
+    """Regression: isolation that SERVES the batchmates proves the
+    executor healthy, so a poisoned batch must record breaker SUCCESS —
+    any_failed used to count one failure per poisoned batch, letting one
+    misbehaving client open the circuit (threshold 3) and darken the
+    model for every healthy client. buckets=(1,2) pins each good+poison
+    pair into ONE two-request batch."""
+    _, _, feat, ref = tiny
+    srv = ModelServer([_cfg(tiny, buckets=(1, 2))],
+                      drain_on_preemption=False).start(warm=True)
+    rng = np.random.RandomState(7)
+    try:
+        with schaos.poison_request(srv, "m"), \
+                schaos.slow_executor(srv, "m", 0.05):
+            # a lone poison seeds one real failure AND occupies the
+            # worker so the pairs below queue up in FIFO [good, poison]
+            # batch order behind it
+            seed = srv.submit("m", schaos.poison_payload(feat))
+            time.sleep(0.01)
+            pairs = [(g, srv.submit("m", g),
+                      srv.submit("m", schaos.poison_payload(feat)))
+                     for g in (rng.randn(*feat).astype("float32")
+                               for _ in range(4))]
+            with pytest.raises(ExecutorFault):
+                seed.result(30.0)
+            for g, gf, bf in pairs:
+                np.testing.assert_allclose(gf.result(30.0), ref(g),
+                                           rtol=1e-4, atol=1e-5)
+                with pytest.raises(ExecutorFault):
+                    bf.result(30.0)
+        st = srv.stats("m")
+        assert st["breaker"]["state"] == "closed"
+        assert st["singles"] >= 8          # 4 isolated pairs
+    finally:
+        srv.close(timeout=10.0)
+
+
 def test_repeated_faults_open_breaker_then_recover(tiny, server):
     _, _, feat, ref = tiny
     outcomes = []
@@ -223,6 +286,24 @@ def test_repeated_faults_open_breaker_then_recover(tiny, server):
     np.testing.assert_allclose(server.predict("m", d, timeout=30.0),
                                ref(d), rtol=1e-4, atol=1e-5)
     assert server.stats("m")["breaker"]["state"] == "closed"
+
+
+def test_isolation_all_expired_keeps_batch_fault_verdict(tiny, server):
+    """Regression: a faulted batch whose isolated re-dispatches ALL
+    expired before their turn used to record breaker SUCCESS (zero
+    dispatches, zero failures) — resetting the breaker a faulting
+    executor had just earned. No dispatch is no evidence of recovery:
+    the original batch fault must stand as a failure."""
+    from mxnet_tpu.serving.server import _Request
+    st = server._models["m"]
+    now = time.monotonic()
+    reqs = [_Request(np.zeros(4, "float32"), now - 1.0, now - 2.0)
+            for _ in range(2)]
+    before = st.breaker.snapshot()["consecutive_failures"]
+    server._dispatch_singly(st, reqs, cause=RuntimeError("batch fault"))
+    assert st.breaker.snapshot()["consecutive_failures"] == before + 1
+    for r in reqs:
+        assert r.pending.outcome() == "expired"
 
 
 def test_breaker_unit_half_open_cycle():
@@ -272,6 +353,26 @@ def test_begin_drain_finishes_accepted_rejects_new(tiny):
     assert srv.health()["status"] == "stopped"
 
 
+def test_drain_latched_from_idle_worker_poll_no_deadlock(tiny):
+    """Regression: the worker observing guard.triggered from its idle
+    poll — with no racing submit()/ready() to latch the drain first —
+    must latch begin_drain OUTSIDE the queue lock. should_stop used to
+    call begin_drain from inside take_batch, and queue.close()
+    re-acquiring the held non-reentrant lock wedged the worker, timed
+    out drain() and hung close() on an idle server."""
+    srv = ModelServer([_cfg(tiny)]).start(warm=True)
+    try:
+        srv._guard.trigger()        # the SIGTERM latch, deterministically
+        time.sleep(0.35)            # a few 0.1s idle polls
+        # the WORKER latched the drain: nothing else observed the guard
+        assert srv._draining.is_set()
+        assert srv.drain(timeout=10.0), "worker wedged on the queue lock"
+        with pytest.raises(Draining):
+            srv.submit("m", np.zeros(4, "float32"))
+    finally:
+        srv.close(timeout=10.0)
+
+
 def test_config_env_defaults(tiny, monkeypatch):
     monkeypatch.setenv("MXNET_SERVE_MAX_QUEUE", "7")
     monkeypatch.setenv("MXNET_SERVE_DEADLINE_MS", "123")
@@ -303,6 +404,22 @@ def test_default_buckets_sources(monkeypatch):
     assert prov.startswith("tuner:")
     monkeypatch.setattr(tuner_mod, "best_cached", lambda **kw: None)
     assert default_buckets("resnet50") == ((1, 2, 4, 8, 16, 32), "default")
+
+
+def test_storm_counts_pending_as_unfinished_not_error(tiny, server):
+    """Regression: futures still pending when collect_timeout_s lapsed
+    were folded into 'error', conflating slow-but-successful requests
+    with executor faults (skewing error_frac and the loadgen verdict).
+    They land in 'unfinished' — still degraded, but typed honestly."""
+    with schaos.slow_executor(server, "m", 0.4):
+        out = schaos.request_storm(server, "m", np.zeros(4, "float32"),
+                                   qps=10, duration_s=0.2, threads=1,
+                                   deadline_ms=5000.0,
+                                   collect_timeout_s=0.05)
+    assert out["unfinished"] >= 1
+    assert out["error"] == 0
+    out["deadline_ms"] = 5000.0
+    assert sload.verdict(out) == "degraded"
 
 
 # --------------------------------------------------------------------- http

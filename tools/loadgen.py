@@ -27,6 +27,7 @@ cannot run (bad args, no target).
 import argparse
 import json
 import os
+import socket
 import sys
 import threading
 import time
@@ -93,11 +94,13 @@ def _emit(args, stats, row, verdict) -> None:
         print(json.dumps(row, sort_keys=True), flush=True)
     else:
         print("loadgen: %s  offered=%.0f qps  achieved=%.1f qps  "
-              "ok=%d shed=%d expired=%d error=%d  p50=%.2fms p99=%.2fms"
+              "ok=%d shed=%d expired=%d error=%d unfinished=%d  "
+              "p50=%.2fms p99=%.2fms"
               % (verdict, stats.get("qps_offered", 0.0),
                  stats.get("qps", 0.0), stats.get("ok", 0),
                  stats.get("shed", 0), stats.get("expired", 0),
-                 stats.get("error", 0), stats.get("p50_ms", float("nan")),
+                 stats.get("error", 0), stats.get("unfinished", 0),
+                 stats.get("p50_ms", float("nan")),
                  stats.get("p99_ms", float("nan"))), flush=True)
 
 
@@ -169,8 +172,9 @@ def _run_http(args, qps) -> int:
     from mxnet_tpu.serving.chaos import paced_run
 
     lock = threading.Lock()
+    last_done = [None]
     stats = {"submitted": 0, "ok": 0, "shed": 0, "expired": 0, "error": 0,
-             "latencies_ms": [], "qps_offered": qps,
+             "unfinished": 0, "latencies_ms": [], "qps_offered": qps,
              "duration_s": args.duration, "model": args.model,
              "deadline_ms": args.deadline_ms}
 
@@ -183,32 +187,44 @@ def _run_http(args, qps) -> int:
                 url, data=payload,
                 headers={"Content-Type": "application/json"})
             urllib.request.urlopen(req, timeout=30.0).read()
-            ms = (time.monotonic() - t0) * 1e3
+            t_done = time.monotonic()
+            ms = (t_done - t0) * 1e3
             with lock:
                 stats["ok"] += 1
                 stats["latencies_ms"].append(ms)
+                last_done[0] = (t_done if last_done[0] is None
+                                else max(last_done[0], t_done))
         except urllib.error.HTTPError as e:
             key = ("shed" if e.code in (429, 503)
                    else "expired" if e.code == 504 else "error")
             with lock:
                 stats[key] += 1
+        except (TimeoutError, socket.timeout):
+            # the server never answered within the client timeout: slow,
+            # verdict unknown — same taxonomy as request_storm, never
+            # folded into 'error' (reserved for executor faults)
+            with lock:
+                stats["unfinished"] += 1
+        except urllib.error.URLError as e:
+            with lock:
+                stats["unfinished" if isinstance(
+                    e.reason, (TimeoutError, socket.timeout))
+                    else "error"] += 1
         except Exception:
             with lock:
                 stats["error"] += 1
 
+    from mxnet_tpu.observability import xcost
+    from mxnet_tpu.serving import load as sload
+
     t0 = time.monotonic()
     paced_run(fire, qps=qps, duration_s=args.duration,
               threads=args.threads)
-    wall = max(1e-9, time.monotonic() - t0)
-    stats["wall_s"] = wall
-    stats["qps"] = stats["ok"] / wall
-    if stats["latencies_ms"]:
-        arr = np.asarray(stats["latencies_ms"], np.float64)
-        stats["p50_ms"] = float(np.percentile(arr, 50))
-        stats["p99_ms"] = float(np.percentile(arr, 99))
-
-    from mxnet_tpu.observability import xcost
-    from mxnet_tpu.serving import load as sload
+    # shared accounting tail: span-based qps (one request wedged in the
+    # 30s urlopen timeout must not read as a throughput collapse),
+    # fractions, percentiles — identical to the selfhost path
+    sload.finalize_load_stats(stats, t_start=t0, last_done=last_done[0],
+                              wall_s=max(1e-9, time.monotonic() - t0))
     ledger = (xcost.CostLedger(args.ledger) if args.ledger
               else xcost.get_ledger())
     row = sload.ledger_row(stats, ledger=ledger, extra={"target": args.url})
