@@ -442,6 +442,30 @@ def run_bench():
             from mxnet_tpu.contrib.quantization import quantized_resnet_bench
             int8_row = quantized_resnet_bench(net, xd, steps=min(steps, 20))
             out.update(int8_row)
+            # the same numbers as a label="quant" ledger row, so the tuner
+            # cache / mxlint MXL-T215 / perfwatch see on-chip int8 evidence
+            try:
+                from mxnet_tpu.tuner import get_cache
+                i8 = int8_row.get("int8_infer_img_s_per_chip")
+                bf = int8_row.get("bf16_infer_img_s_per_chip")
+                get_cache().append({
+                    "label": "quant", "model": "resnet50",
+                    "net_class": type(net).__name__, "batch": batch,
+                    "int8_img_s_per_chip": i8, "bf16_img_s_per_chip": bf,
+                    "int8_ms": round(batch / i8 * 1e3, 4) if i8 else None,
+                    # the non-quantized baseline here is the bench's bf16
+                    # run (what the f32 tier actually costs on-chip) —
+                    # baseline_dtype says so, readers must not report the
+                    # number as a true-f32 measurement
+                    "f32_ms": round(batch / bf * 1e3, 4) if bf else None,
+                    "baseline_dtype": "bf16",
+                    "int8_vs_f32": int8_row.get("int8_vs_bf16"),
+                    "device_kind": jax.devices()[0].device_kind,
+                    "platform": jax.devices()[0].platform,
+                    "provenance": "bench",
+                })
+            except Exception as e:
+                print("int8 ledger row failed: %s" % e, file=sys.stderr)
         except Exception as e:
             print("int8 diagnostic failed: %s" % e, file=sys.stderr)
 
@@ -560,7 +584,7 @@ def _foreign_tunnel_clients():
     # only covers stripped-down bench.py copies shipped without tools/
     markers = (_tunnel.MARKERS if _tunnel is not None else
                ("aot_warm.py", "perf_lab.py", "mxtune.py", "collbench.py",
-                "mxserve.py", "loadgen.py", "tpu_session"))
+                "mxserve.py", "loadgen.py", "mxquant.py", "tpu_session"))
     found = []
     try:
         for pid in os.listdir("/proc"):

@@ -87,6 +87,7 @@ _lazy = {
     "tuner": ".tuner",
     "passes": ".passes",
     "serving": ".serving",
+    "quant": ".quant",
 }
 
 

@@ -71,6 +71,14 @@ register_rule(
     "the layout pass disabled — each conv pays per-step relayouts the "
     "automatic NCHW→NHWC propagation (mxnet_tpu.passes) removes; the "
     "measured r4 win is one knob away.")
+register_rule(
+    "MXL-G108", "warning", "uncalibrated-quantized-graph",
+    "The graph contains _contrib_quantize nodes whose activation ranges "
+    "are absent/defaulted (computed from each batch at runtime instead of "
+    "baked-in calibrated constants): every affected island pays two extra "
+    "full reductions per step and quantizes outlier-stretched ranges — "
+    "calibrate once (quant.collect / tools/mxquant.py calibrate) and "
+    "quantize from the CalibTable.")
 
 
 def _parse_shape_attr(v: str) -> Optional[Tuple[int, ...]]:
@@ -309,6 +317,28 @@ def lint_symbol(symbol, shapes: Optional[Dict[str, Sequence[int]]] = None,
                 "any node reachable from the outputs",
                 location=f"var:{name}",
                 hint="remove the stale binding or check the name for typos"))
+
+    # ---- uncalibrated quantized graph (MXL-G108): a quantize node whose
+    # min/max inputs are COMPUTED nodes (runtime min/max over the batch)
+    # rather than constant range variables was quantized without a
+    # calibration table — legal, but slower and less accurate than the
+    # calibrated flow, and usually an oversight in a shipped model
+    uncal = [n for n in nodes
+             if not n.is_var and n.op == "_contrib_quantize"
+             and len(n.inputs) >= 3
+             and any(not src.is_var for (src, _i) in n.inputs[1:3])]
+    if uncal:
+        shown = ", ".join(n.name for n in uncal[:3]) \
+            + ("…" if len(uncal) > 3 else "")
+        report.add(Diagnostic(
+            "MXL-G108",
+            f"{len(uncal)} quantize node(s) run with runtime (uncalibrated)"
+            f" activation ranges: {shown}",
+            location="graph",
+            hint="collect a CalibTable (quant.collect or tools/mxquant.py "
+                 "calibrate) and re-quantize from it — calibrated ranges "
+                 "drop the per-step min/max reductions and clip outliers "
+                 "(docs/quantization.md, 'Calibration')"))
 
     # ---- layout propagation missed (MXL-G107): a capture-context check —
     # only when the caller DECLARED its pipeline (passes_applied is not

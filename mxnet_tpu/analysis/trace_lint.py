@@ -53,6 +53,10 @@ Rules (catalog in docs/static_analysis.md):
                                           default deadline — overload
                                           becomes unbounded latency
                                           instead of typed rejections
+* MXL-T215 fp32-serving-with-int8-win (warning) a model serving on the f32
+                                          tier while the cost ledger holds
+                                          a measured int8 win for the same
+                                          model/device signature
 """
 from __future__ import annotations
 
@@ -157,6 +161,15 @@ register_rule(
     "rejections — the exact collapse mode admission control exists to "
     "prevent. Set ModelConfig(max_queue=, deadline_ms=) or the "
     "MXNET_SERVE_MAX_QUEUE / MXNET_SERVE_DEADLINE_MS knobs.")
+register_rule(
+    "MXL-T215", "warning", "fp32-serving-with-int8-win",
+    "A model serves on the f32 tier while the cost ledger holds a "
+    "MEASURED int8 win for the same model/device signature (a "
+    "label='quant' row where int8 beat f32): every request pays the f32 "
+    "latency although the cheaper executable is one knob away "
+    "(ModelConfig(tier='int8') or MXNET_SERVE_TIER=int8) — the same "
+    "best_cached discipline as MXL-T211/T212: no row, different device, "
+    "or an int8 tier already serving all stay silent.")
 register_rule(
     "MXL-T211", "warning", "untuned-hot-loop",
     "The trainer runs with all-default perf levers while the autotuner "
@@ -596,6 +609,38 @@ def lint_server(server_or_config, *, suppress: Sequence[str] = (),
                 hint="set ModelConfig(deadline_ms=D) (or "
                      "MXNET_SERVE_DEADLINE_MS) — clients can still "
                      "override per request; docs/serving.md, 'Deadlines'"))
+        # ---- fp32 serving with a measured int8 win on file (MXL-T215):
+        # the quant twin of T211/T212 — fires only on evidence (a MEASURED
+        # label="quant" ledger row for this model on this device where
+        # int8 actually won); an int8 tier, no row, or a different device
+        # signature all stay silent
+        if getattr(cfg, "tier", "f32") != "int8":
+            win = None
+            try:
+                from ..quant import best_int8_cached
+                from ..serving.executors import _device_kind
+                win = best_int8_cached(device_kind=_device_kind()[0],
+                                       model=cfg.name)
+            except Exception:
+                win = None
+            if win:
+                report.add(Diagnostic(
+                    "MXL-T215",
+                    "model %r serves on the f32 tier, but the cost ledger "
+                    "holds a measured int8 win for it on %s: %.2fx faster "
+                    "(%s %.3f ms -> int8 %.3f ms per forward) — every "
+                    "request pays the non-quantized latency although the "
+                    "cheaper executable is already measured"
+                    % (cfg.name, win.get("device_kind"),
+                       float(win.get("int8_vs_f32") or 0.0),
+                       win.get("baseline_dtype") or "f32",
+                       float(win.get("f32_ms") or 0.0),
+                       float(win.get("int8_ms") or 0.0)),
+                    location=loc,
+                    hint="serve the int8 tier (ModelConfig(tier='int8') "
+                         "or MXNET_SERVE_TIER=int8); calibrate first with "
+                         "tools/mxquant.py for calibrated ranges — "
+                         "docs/quantization.md, 'Serving tier'"))
     return report
 
 

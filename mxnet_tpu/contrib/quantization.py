@@ -21,34 +21,12 @@ import jax.numpy as jnp
 from ..base import MXNetError
 from ..ndarray import NDArray
 from ..ndarray.ndarray import _unwrap, _wrap
-from ..ops.registry import register
-# the codec ops themselves are registered at package import time in
-# ops/quantize_ops.py so the registry names exist without importing contrib
-from ..ops.quantize_ops import _dequantize, _quantize, _requantize  # noqa: F401
-
-
-@register("_contrib_quantized_fully_connected", num_outputs=3,
-          differentiable=False,
-          arg_names=("data", "weight", "bias", "min_data", "max_data",
-                     "min_weight", "max_weight", "min_bias", "max_bias"))
-def _quantized_fc(data, weight, bias, min_data, max_data, min_weight,
-                  max_weight, min_bias=None, max_bias=None, num_hidden=1,
-                  no_bias=False, flatten=True):
-    """int8×int8→int32 matmul on the MXU (reference quantized_fully_connected.cc)."""
-    d = data.astype(jnp.int32)
-    if flatten and d.ndim > 2:
-        d = d.reshape(d.shape[0], -1)
-    acc = jnp.matmul(d, weight.astype(jnp.int32).T,
-                     preferred_element_type=jnp.int32)
-    scale_d = jnp.maximum(jnp.abs(min_data), jnp.abs(max_data)) / 127.0
-    scale_w = jnp.maximum(jnp.abs(min_weight), jnp.abs(max_weight)) / 127.0
-    out_scale = scale_d * scale_w
-    if not no_bias and bias is not None:
-        scale_b = jnp.maximum(jnp.abs(min_bias), jnp.abs(max_bias)) / 127.0
-        acc = acc + jnp.round(bias.astype(jnp.float32) * (scale_b / out_scale)
-                              ).astype(jnp.int32)
-    rng = out_scale * 0x7FFFFFFF
-    return acc, -rng, rng
+# every int8 op — the codec AND quantized_fully_connected — is registered
+# at package import time in ops/quantize_ops.py / ops/parity_ops.py, so
+# quantized graphs bind (simple_bind included) without importing contrib;
+# the re-exports below keep the historical contrib surface working
+from ..ops.quantize_ops import (_dequantize, _quantize,  # noqa: F401
+                                _quantized_fc, _requantize)
 
 
 def calib_minmax(activations: np.ndarray):
@@ -163,11 +141,19 @@ def quantize_graph(sym, arg_params, excluded_sym_names=(),
             remap[id(node)] = node
             continue
         inputs = [new_entry(e) for e in node.inputs]
+        _no_bias = str(node.attrs.get("no_bias", False)).lower() in ("true",
+                                                                     "1")
+        # same bias discipline as quant.qpass: a node WITH a bias must
+        # have it as a param var — never silently zero a computed bias
+        bias_quantizable = _no_bias or (
+            len(node.inputs) >= 3 and node.inputs[2][0].is_var
+            and node.inputs[2][0].name in arg_params)
         quantizable = (node.op in ("FullyConnected", "Convolution")
                        and node.name not in excluded
                        and len(node.inputs) >= 2
                        and node.inputs[1][0].is_var
-                       and node.inputs[1][0].name in arg_params)
+                       and node.inputs[1][0].name in arg_params
+                       and bias_quantizable)
         if not quantizable:
             nn = _Node(node.op, node.name, dict(node.attrs), inputs)
             remap[id(node)] = nn

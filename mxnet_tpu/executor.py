@@ -104,9 +104,34 @@ def _rnn_param_shapes(attrs, ds):
     return {"parameters": (n,), "state": state, "state_cell": state}
 
 
+def _quantized_fc_param_shapes(attrs, ds):
+    # weight/bias shapes match the float op; the range args are scalars —
+    # what lets a quantized graph (mxnet_tpu.quant) go through simple_bind
+    # exactly like its float twin (reference quantized_fully_connected.cc
+    # FInferShape fills the min/max triple the same way)
+    s = _fc_param_shapes(dict(attrs, no_bias=False), ds)
+    s.update({k: () for k in ("min_data", "max_data", "min_weight",
+                              "max_weight", "min_bias", "max_bias")})
+    return s
+
+
+def _quantized_conv_param_shapes(attrs, ds):
+    s = _conv_param_shapes(dict(attrs, no_bias=False), ds)
+    s.update({k: () for k in ("min_data", "max_data", "min_weight",
+                              "max_weight", "min_bias", "max_bias")})
+    return s
+
+
+def _quantize_param_shapes(attrs, ds):
+    return {"min_range": (), "max_range": ()}
+
+
 _PARAM_SHAPE_RULES: Dict[str, Callable] = {
     "FullyConnected": _fc_param_shapes,
     "Convolution": _conv_param_shapes,
+    "_contrib_quantized_fully_connected": _quantized_fc_param_shapes,
+    "_contrib_quantized_conv": _quantized_conv_param_shapes,
+    "_contrib_quantize": _quantize_param_shapes,
     "Deconvolution": _deconv_param_shapes,
     "BatchNorm": _bn_param_shapes,
     "LayerNorm": _ln_param_shapes,

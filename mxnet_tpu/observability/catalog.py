@@ -231,6 +231,26 @@ SERVE_QUEUE_DEPTH = _m.gauge(
     "Requests queued per model at last admission/dispatch, labeled "
     "model=. Pinned at the queue bound = shedding load.")
 
+# ------------------------------------------------------------ quantization
+QUANT_CALIB_BATCHES = _m.counter(
+    "mxtpu_quant_calib_batches_total",
+    "Calibration batches streamed through quant.collect, labeled "
+    "mode=naive|entropy.")
+QUANT_NODES = _m.gauge(
+    "mxtpu_quant_nodes",
+    "Convolution/FullyConnected nodes rewritten to int8 islands by the "
+    "most recent quantize_symbol run, labeled model=.")
+QUANT_ACC_DELTA = _m.gauge(
+    "mxtpu_quant_acc_delta",
+    "fp32-minus-int8 top-1 accuracy delta of the last "
+    "quant.evaluate_agreement run (positive = the int8 model lost "
+    "accuracy; the flow's ~1% acceptance bar reads this number).")
+QUANT_SERVE_REQUESTS = _m.counter(
+    "mxtpu_quant_serve_requests_total",
+    "Model-server requests answered by an int8-tier model, labeled "
+    "model= and outcome= (same outcomes as mxtpu_serve_requests_total — "
+    "the int8 slice of serving traffic).")
+
 # -------------------------------------------------------------- callbacks
 SPEEDOMETER_SPS = _m.gauge(
     "mxtpu_speedometer_samples_per_sec",

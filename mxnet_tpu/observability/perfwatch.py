@@ -53,6 +53,10 @@ METRIC_DIRECTIONS: Dict[str, int] = {
     "qps": +1,                 # serving ledger row (label="serving")
     "p50_ms": -1,              # serving accepted-request latency
     "p99_ms": -1,
+    "int8_ms": -1,             # quant ledger row (label="quant")
+    "f32_ms": -1,
+    "int8_vs_f32": +1,         # int8 speedup eroding is a regression
+    "int8_acc": +1,            # and so is int8 accuracy drifting down
 }
 
 DEFAULT_THRESHOLD_PCT = 10.0
@@ -112,6 +116,18 @@ def normalize(doc: Any, source: str = "") -> Optional[Dict[str, Any]]:
             if doc.get(k) is not None:
                 vals[k] = float(doc[k])
         return {"kind": "serving_row", "source": source, "metrics": vals,
+                "model": doc.get("model"),
+                "provenance": doc.get("provenance")}
+    if doc.get("label") == "quant" and (
+            doc.get("int8_ms") is not None or doc.get("f32_ms") is not None):
+        # quantization ledger row (quant.compare_latency / bench.py int8
+        # diagnostic): latencies down-is-good, speedup and int8 accuracy
+        # up-is-good — int8 regressions guard exactly like serving ones
+        vals = {}
+        for k in ("int8_ms", "f32_ms", "int8_vs_f32", "int8_acc"):
+            if doc.get(k) is not None:
+                vals[k] = float(doc[k])
+        return {"kind": "quant_row", "source": source, "metrics": vals,
                 "model": doc.get("model"),
                 "provenance": doc.get("provenance")}
     if "roofline" in doc or "arithmetic_intensity" in doc:

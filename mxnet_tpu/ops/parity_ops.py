@@ -563,8 +563,11 @@ def _quantized_conv(data, weight, bias, min_data, max_data, min_weight,
         window_strides=strides, padding=padding, rhs_dilation=dil,
         dimension_numbers=dn, feature_group_count=int(num_group),
         preferred_element_type=jnp.int32)
-    scale_d = jnp.maximum(jnp.abs(min_data), jnp.abs(max_data)) / 127.0
-    scale_w = jnp.maximum(jnp.abs(min_weight), jnp.abs(max_weight)) / 127.0
+    # degenerate-range guard shared with the codec ops: a zero-width data
+    # or weight range must yield a finite scale, never an inf bias term
+    from .quantize_ops import _amax as _q_amax
+    scale_d = _q_amax(min_data, max_data) / 127.0
+    scale_w = _q_amax(min_weight, max_weight) / 127.0
     out_scale = scale_d * scale_w
     if not no_bias and bias is not None:
         scale_b = jnp.maximum(jnp.abs(min_bias), jnp.abs(max_bias)) / 127.0

@@ -1,17 +1,35 @@
-"""int8 quantization codec ops, registered at package import so the names
-are reachable straight from the registry (``nd._contrib_quantize`` /
-``sym._contrib_quantize``) like every other operator — not only through the
-``contrib.quantization`` helpers (VERDICT r3 missing #6).
+"""int8 quantization codec + compute ops, registered at package import so
+the names are reachable straight from the registry (``nd._contrib_quantize``
+/ ``sym._contrib_quantize``) like every other operator — not only through
+the ``contrib.quantization`` helpers (VERDICT r3 missing #6).
 
 Reference parity: ``src/operator/quantization/quantize.cc`` /
-``dequantize.cc`` / ``requantize-inl.h``. The graph-level pass lives in
-``mxnet_tpu.contrib.quantization``.
+``dequantize.cc`` / ``requantize-inl.h`` / ``quantized_fully_connected.cc``.
+The graph-level rewrite lives in ``mxnet_tpu.quant`` (pass pipeline) and
+``mxnet_tpu.contrib.quantization`` (reference-signature driver).
+
+Degenerate-range contract (regression-tested): a zero-width range
+(``min_range == max_range``, e.g. constant or all-zero activations) is
+floored at ``_RANGE_EPS`` so every op in the island produces a well-defined
+scale — never inf/NaN. A constant tensor quantizes to a well-defined int8
+value and dequantizes back to (approximately) itself.
 """
 from __future__ import annotations
 
 import jax.numpy as jnp
 
 from .registry import register
+
+#: floor for the half-range |max(|min|,|max|)| — a calibrated (or runtime)
+#: range of width zero still yields a finite scale; 1e-8 is far below any
+#: representable activation scale so non-degenerate numerics are untouched
+_RANGE_EPS = 1e-8
+
+
+def _amax(min_range, max_range):
+    """Well-defined half-range: max(|min|, |max|) floored at _RANGE_EPS."""
+    return jnp.maximum(jnp.maximum(jnp.abs(min_range), jnp.abs(max_range)),
+                       _RANGE_EPS)
 
 
 @register("_contrib_quantize", aliases=["contrib_quantize"], num_outputs=3,
@@ -21,9 +39,8 @@ def _quantize(data, min_range, max_range, out_type="int8"):
     quantization/quantize.cc)."""
     mn = jnp.minimum(min_range, 0.0)
     mx = jnp.maximum(max_range, 0.0)
-    scale = 127.0 / jnp.maximum(jnp.maximum(jnp.abs(mn), jnp.abs(mx)), 1e-8)
-    q = jnp.clip(jnp.round(data * scale), -127, 127).astype(jnp.int8)
-    amax = jnp.maximum(jnp.abs(mn), jnp.abs(mx))
+    amax = _amax(mn, mx)
+    q = jnp.clip(jnp.round(data * (127.0 / amax)), -127, 127).astype(jnp.int8)
     return q, -amax, amax
 
 
@@ -44,7 +61,33 @@ def _requantize(data, min_range, max_range, min_calib_range=None,
         mn, mx = min_calib_range, max_calib_range
     else:
         mn, mx = jnp.min(f), jnp.max(f)
-    amax = jnp.maximum(abs(mn) if not hasattr(mn, "shape") else jnp.abs(mn),
-                       abs(mx) if not hasattr(mx, "shape") else jnp.abs(mx))
+    amax = _amax(jnp.asarray(mn, jnp.float32), jnp.asarray(mx, jnp.float32))
     q = jnp.clip(jnp.round(f * (127.0 / amax)), -127, 127).astype(jnp.int8)
     return q, -amax, amax
+
+
+@register("_contrib_quantized_fully_connected", num_outputs=3,
+          differentiable=False,
+          arg_names=("data", "weight", "bias", "min_data", "max_data",
+                     "min_weight", "max_weight", "min_bias", "max_bias"))
+def _quantized_fc(data, weight, bias, min_data, max_data, min_weight,
+                  max_weight, min_bias=None, max_bias=None, num_hidden=1,
+                  no_bias=False, flatten=True):
+    """int8×int8→int32 matmul on the MXU (reference
+    quantized_fully_connected.cc). Registered here — not in contrib — so
+    quantized graphs bind through ``simple_bind`` like any other op (the
+    parameter-shape rules live in ``executor._PARAM_SHAPE_RULES``)."""
+    d = data.astype(jnp.int32)
+    if flatten and d.ndim > 2:
+        d = d.reshape(d.shape[0], -1)
+    acc = jnp.matmul(d, weight.astype(jnp.int32).T,
+                     preferred_element_type=jnp.int32)
+    scale_d = _amax(min_data, max_data) / 127.0
+    scale_w = _amax(min_weight, max_weight) / 127.0
+    out_scale = scale_d * scale_w
+    if not no_bias and bias is not None:
+        scale_b = jnp.maximum(jnp.abs(min_bias), jnp.abs(max_bias)) / 127.0
+        acc = acc + jnp.round(bias.astype(jnp.float32) * (scale_b / out_scale)
+                              ).astype(jnp.int32)
+    rng = out_scale * 0x7FFFFFFF
+    return acc, -rng, rng

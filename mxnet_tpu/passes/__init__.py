@@ -25,6 +25,12 @@ from .fold import ConstantFoldPass
 from .layout import LayoutPass
 from .s2d import SpaceToDepthPass
 from .fusion import FusionReorderPass
+# the quantization passes register too (names: quantize/requantize/
+# dequantize) but stay OPT-IN — quantization changes numerics, so they are
+# never part of DEFAULT_PIPELINE.  Imported as a module (not names) so the
+# quant→passes→quant import cycle resolves in either entry order;
+# mxnet_tpu.quant is the driving surface for these passes.
+from ..quant import qpass as _quant_qpass  # noqa: F401
 
 __all__ = ["Pass", "PassContext", "PassManager", "PassResult",
            "DEFAULT_PIPELINE", "PASS_REGISTRY", "register_pass",

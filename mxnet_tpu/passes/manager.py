@@ -366,6 +366,14 @@ class PassContext:
         #: var name -> ordered transform specs (applied left to right to
         #: the ORIGINAL value to obtain the rewritten graph's value)
         self.var_transforms: Dict[str, List[tuple]] = {}
+        #: NEW variables a pass introduced, with a spec describing how to
+        #: derive each value from the original parameter dict (the
+        #: quantize pass mints int8 weights + range scalars this way);
+        #: materialized by :meth:`PassResult.materialize_params`
+        self.synth_params: Dict[str, tuple] = {}
+        #: internal source values synthesized specs may reference (e.g. a
+        #: zero bias) — never returned to the caller themselves
+        self.synth_sources: Dict[str, tuple] = {}
         #: var name -> declared layout after re-homing (inputs only)
         self.input_layouts: Dict[str, str] = {}
         self.counts: Dict[str, int] = {}
@@ -386,6 +394,19 @@ class PassContext:
 
     def add_var_transform(self, name: str, spec: tuple) -> None:
         self.var_transforms.setdefault(name, []).append(spec)
+
+    def add_synth_param(self, name: str, spec: tuple) -> None:
+        """Declare a NEW variable the rewritten graph consumes, derived
+        from the original params per ``spec``: ``("const", value)`` a
+        literal scalar, ``("quant_of", src, part)`` one leg of the int8
+        (quantized/min/max) triple of parameter ``src``."""
+        self.synth_params[name] = tuple(spec)
+
+    def add_synth_source(self, name: str, spec: tuple) -> None:
+        """Declare an internal source value (``("zeros", shape)``) that
+        ``quant_of`` specs may reference but which is not itself a graph
+        variable."""
+        self.synth_sources[name] = tuple(spec)
 
     def annotate(self, sym) -> Dict[Tuple[int, int], Any]:
         key = id(sym)
@@ -409,6 +430,8 @@ class PassResult:
         self.var_transforms = {k: list(v)
                                for k, v in ctx.var_transforms.items()}
         self.input_layouts = dict(ctx.input_layouts)
+        self.synth_params = dict(ctx.synth_params)
+        self.synth_sources = dict(ctx.synth_sources)
         self.names = tuple(names)
 
     @property
@@ -446,6 +469,40 @@ class PassResult:
         for spec in reversed(self.var_transforms.get(name, ())):
             v = apply_spec(spec, v, inverse=True)
         return v
+
+    def materialize_params(self, arg_params: Dict) -> Dict:
+        """Compute the values of every pass-synthesized variable
+        (``ctx.add_synth_param``) from the ORIGINAL parameter dict — the
+        extra params the caller merges into its bind dict. One source of
+        truth for the int8 math: ``contrib.quantization.quantize_params``."""
+        if not self.synth_params:
+            return {}
+        from .. import ndarray as nd_mod
+        src = dict(arg_params)
+        for name, spec in self.synth_sources.items():
+            if spec[0] == "zeros":
+                src[name] = nd_mod.zeros(tuple(int(d) for d in spec[1]))
+            else:
+                raise MXNetError(f"unknown synth-source spec {spec!r}")
+        out: Dict[str, Any] = {}
+        quant_cache: Dict[str, Dict] = {}
+        for name, spec in self.synth_params.items():
+            kind = spec[0]
+            if kind == "const":
+                out[name] = nd_mod.array(np.float32(spec[1]))
+            elif kind == "quant_of":
+                pname, part = spec[1], spec[2]
+                if pname not in quant_cache:
+                    from ..contrib.quantization import quantize_params
+                    if pname not in src:
+                        raise MXNetError(
+                            f"synthesized param {name!r} derives from "
+                            f"{pname!r}, which is not in arg_params")
+                    quant_cache[pname] = quantize_params({pname: src[pname]})
+                out[name] = quant_cache[pname][f"{pname}_{part}"]
+            else:
+                raise MXNetError(f"unknown synth-param spec {spec!r}")
+        return out
 
 
 

@@ -1,0 +1,42 @@
+"""mxnet_tpu.quant — quantization as a first-class subsystem (ROADMAP
+item 3): calibrate → quantize (as graph passes) → evaluate → benchmark →
+serve int8.
+
+=========  =============================================================
+piece       what it gives you
+=========  =============================================================
+calib       :class:`CalibTable` (serializable per-tensor activation
+            ranges) + :func:`collect` (streaming minmax/entropy
+            calibration over a fp32 model, reference estimators)
+qpass       :class:`QuantizePass` / :class:`RequantizePass` /
+            :class:`DequantizePass` — the reference int8 island
+            (``quantize_graph_pass.cc``) as opt-in PR-8 PassManager
+            passes (Relay's quantization-as-graph-rewrite, PAPERS.md);
+            never in the default pipeline
+flow        :func:`quantize_model` (the ``imagenet_gen_qsym.py`` flow),
+            :func:`evaluate_agreement` (accuracy harness),
+            :func:`compare_latency` (int8-vs-f32 ``label="quant"``
+            CostLedger rows), :func:`best_int8_cached` (the cache query
+            behind mxlint MXL-T215), :func:`quantize_model_config` /
+            :func:`ensure_tier` (the ``MXNET_SERVE_TIER=int8`` serving
+            tier)
+=========  =============================================================
+
+CLI: ``tools/mxquant.py``. Telemetry: ``mxtpu_quant_*`` families
+(``observability/catalog.py``). Docs: ``docs/quantization.md``.
+"""
+from __future__ import annotations
+
+from .calib import CalibTable, collect
+from .qpass import (ACC_OPS, QUANT_PIPELINE, QUANT_FAMILY_OPS,
+                    DequantizePass, QuantizePass, RequantizePass)
+from .flow import (best_int8_cached, compare_latency, ensure_tier,
+                   evaluate_agreement, is_quantized_symbol, quant_rows,
+                   quantize_model, quantize_model_config, quantize_symbol)
+
+__all__ = ["CalibTable", "collect",
+           "ACC_OPS", "QUANT_PIPELINE", "QUANT_FAMILY_OPS",
+           "QuantizePass", "RequantizePass", "DequantizePass",
+           "quantize_symbol", "quantize_model", "evaluate_agreement",
+           "compare_latency", "quant_rows", "best_int8_cached",
+           "is_quantized_symbol", "quantize_model_config", "ensure_tier"]

@@ -1,0 +1,347 @@
+"""The end-to-end quantization flow: calibrate → quantize (via passes) →
+evaluate → benchmark → serve.
+
+Mirrors the reference driver ``example/quantization/imagenet_gen_qsym.py``
+(calibrate a fp32 model over a small iterator, rewrite it to int8, ship
+the quantized symbol + params), but every stage is a first-class citizen
+of the repo's other subsystems: the rewrite is the PR-8 pass pipeline
+(:mod:`mxnet_tpu.quant.qpass`), latency rows land in the PR-6
+``CostLedger`` (``label="quant"``) where the tuner/perfwatch can read
+them, and a quantized model drops into the PR-12 serving stack as a
+per-model tier (``MXNET_SERVE_TIER=int8``).
+"""
+from __future__ import annotations
+
+import os
+import tempfile
+import time
+from typing import Any, Dict, Iterable, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..base import MXNetError
+from .calib import CalibTable, collect
+from .qpass import (ACC_OPS, QUANT_PIPELINE, DequantizePass, QuantizePass,
+                    RequantizePass)
+
+__all__ = ["quantize_symbol", "quantize_model", "evaluate_agreement",
+           "compare_latency", "quant_rows", "best_int8_cached",
+           "is_quantized_symbol", "quantize_model_config",
+           "ensure_tier"]
+
+#: the ledger label every quantization benchmark row carries
+QUANT_LABEL = "quant"
+
+
+def is_quantized_symbol(sym) -> bool:
+    """True when the graph already contains int8 compute islands."""
+    return any(not n.is_var and n.op in ACC_OPS for n in sym.topo_nodes())
+
+
+def quantize_symbol(sym, arg_params, *, table: Optional[CalibTable] = None,
+                    excluded_sym_names: Sequence[str] = (),
+                    exclude_first_conv: bool = True,
+                    exclude_last_fc: bool = True,
+                    model: Optional[str] = None):
+    """Rewrite ``sym`` through the opt-in quantization pass pipeline.
+
+    Returns ``(qsym, extra_params, pass_result)`` — merge ``extra_params``
+    (int8 weights + range scalars, materialized from ``arg_params``) into
+    the bind dict.  Equivalent by construction to
+    ``contrib.quantization.quantize_graph`` (tests pin the structural
+    identity), but composable: the same ``PassManager`` machinery, rewrite
+    counts, and provenance as every other graph pass.
+    """
+    from ..passes import PassManager
+    mgr = PassManager([
+        QuantizePass(table=table, excluded=excluded_sym_names,
+                     exclude_first_conv=exclude_first_conv,
+                     exclude_last_fc=exclude_last_fc),
+        RequantizePass(table=table),
+        DequantizePass(),
+    ], rehome_params=False)
+    res = mgr.run(sym, param_names=list(arg_params))
+    extra = res.materialize_params(arg_params)
+    from ..observability import metrics as _m
+    if _m.enabled():
+        from ..observability import catalog as _c
+        _c.QUANT_NODES.set(res.counts.get("quantize", 0),
+                           model=model or sym.name or "graph")
+    return res.symbol, extra, res
+
+
+def quantize_model(sym, arg_params, aux_params=None, *,
+                   calib_iter: Optional[Iterable] = None,
+                   calib_mode: str = "entropy",
+                   data_names: Sequence[str] = ("data",),
+                   num_calib_examples: Optional[int] = None,
+                   excluded_sym_names: Sequence[str] = (),
+                   exclude_first_conv: bool = True,
+                   exclude_last_fc: bool = True,
+                   table: Optional[CalibTable] = None,
+                   calib_min_percentile: Optional[float] = 99.0,
+                   model: Optional[str] = None):
+    """The one-call flow: calibrate (unless a ``table``/``calib_mode
+    'none'`` says otherwise) and quantize via the pass route.
+
+    Returns ``(qsym, qarg_params, qaux_params, table)``; ``table`` is
+    ``None`` under ``calib_mode='none'`` (runtime-range quantization).
+    """
+    aux_params = dict(aux_params or {})
+    if table is None and calib_mode != "none":
+        if calib_iter is None:
+            raise MXNetError(
+                f"calib_mode={calib_mode!r} needs calib_iter (or pass a "
+                "pre-collected table=CalibTable)")
+        table = collect(sym, arg_params, aux_params, calib_iter,
+                        data_names=data_names, mode=calib_mode,
+                        num_calib_examples=num_calib_examples,
+                        min_percentile=calib_min_percentile, model=model)
+    qsym, extra, _res = quantize_symbol(
+        sym, arg_params, table=table, excluded_sym_names=excluded_sym_names,
+        exclude_first_conv=exclude_first_conv,
+        exclude_last_fc=exclude_last_fc, model=model)
+    qarg = dict(arg_params)
+    qarg.update(extra)
+    return qsym, qarg, aux_params, table
+
+
+# --------------------------------------------------------------------------
+# accuracy harness
+# --------------------------------------------------------------------------
+
+def _default_ctx():
+    """The context resolving to the SAME device ``_device_kind()`` stamps
+    into ledger rows (``jax.devices()[0]``) — an accelerator when one is
+    present, cpu otherwise — so a ``provenance="measured"`` row never
+    carries a device signature the timing didn't run on."""
+    import mxnet_tpu as mx
+    from ..serving.executors import _device_kind
+    _kind, platform = _device_kind()
+    return mx.cpu() if platform in (None, "cpu") else mx.gpu(0)
+
+
+def _bind_forward(sym, params, aux, ctx=None):
+    from .. import ndarray as nd_mod
+    ctx = ctx or _default_ctx()
+    exes: Dict[tuple, Any] = {}
+
+    def run(x):
+        # one executor per batch shape: an eval iterator's smaller final
+        # batch rebinds instead of feeding a shape the bound program
+        # can't take
+        key = tuple(np.asarray(x).shape)
+        exe = exes.get(key)
+        if exe is None:
+            feed = dict(params)
+            feed["data"] = nd_mod.array(x)
+            exes[key] = exe = sym.bind(ctx, feed, grad_req="null",
+                                       aux_states=dict(aux) or None)
+            return exe.forward()[0].asnumpy()
+        return exe.forward(data=nd_mod.array(x))[0].asnumpy()
+
+    return run
+
+
+def evaluate_agreement(sym, arg_params, aux_params, qsym, qarg_params,
+                       qaux_params, eval_data: Iterable,
+                       labels: Optional[np.ndarray] = None
+                       ) -> Dict[str, Any]:
+    """The accuracy harness: top-1 accuracy of the fp32 and int8 models
+    over ``eval_data`` (an iterable of input batches).
+
+    ``labels`` (concatenated over batches) ground the accuracy; when
+    absent, the fp32 model's own argmax is the label — accuracy then reads
+    as *top-1 agreement* (fp32 accuracy 1.0 by construction), the standard
+    proxy when no labeled eval set ships with the model.  Returns
+    ``{"fp32_acc", "int8_acc", "acc_delta", "n"}`` and publishes
+    ``mxtpu_quant_acc_delta``.
+    """
+    f32 = _bind_forward(sym, arg_params, aux_params)
+    int8 = _bind_forward(qsym, qarg_params, qaux_params)
+    f32_top, int8_top = [], []
+    for batch in eval_data:
+        x = np.asarray(batch.data[0].asnumpy()
+                       if hasattr(batch, "data") else batch)
+        f32_top.append(np.argmax(f32(x), axis=-1))
+        int8_top.append(np.argmax(int8(x), axis=-1))
+    f32_top = np.concatenate(f32_top) if f32_top else np.zeros(0, np.int64)
+    int8_top = np.concatenate(int8_top) if int8_top else np.zeros(0, np.int64)
+    n = int(f32_top.size)
+    if labels is None:
+        labels = f32_top
+    labels = np.asarray(labels).ravel()[:n]
+    fp32_acc = float((f32_top == labels).mean()) if n else 0.0
+    int8_acc = float((int8_top == labels).mean()) if n else 0.0
+    out = {"fp32_acc": fp32_acc, "int8_acc": int8_acc,
+           "acc_delta": fp32_acc - int8_acc, "n": n}
+    from ..observability import metrics as _m
+    if _m.enabled():
+        from ..observability import catalog as _c
+        _c.QUANT_ACC_DELTA.set(out["acc_delta"])
+    return out
+
+
+# --------------------------------------------------------------------------
+# latency comparison -> CostLedger
+# --------------------------------------------------------------------------
+
+def _timed_forward(run, x, steps: int) -> float:
+    steps = max(1, int(steps))
+    run(x)                              # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = run(x)
+    np.asarray(out)
+    return (time.perf_counter() - t0) / steps * 1e3
+
+
+def compare_latency(sym, arg_params, aux_params, qsym, qarg_params,
+                    qaux_params, x, *, steps: int = 10,
+                    ledger=None, model: Optional[str] = None,
+                    net_class: Optional[str] = None,
+                    quantized_nodes: Optional[int] = None,
+                    extra: Optional[Dict[str, Any]] = None
+                    ) -> Dict[str, Any]:
+    """Measure int8 vs f32 forward step latency on the current default
+    device and persist one ``label="quant"`` CostLedger row (the tuner's
+    warm-start cache by default, so mxlint's int8-win rule and the serving
+    tier can find it).  Returns the row."""
+    from ..serving.executors import _device_kind
+    x = np.asarray(x, np.float32)
+    batch = int(x.shape[0])
+    f32_ms = _timed_forward(_bind_forward(sym, arg_params, aux_params),
+                            x, steps)
+    int8_ms = _timed_forward(
+        _bind_forward(qsym, qarg_params, qaux_params), x, steps)
+    kind, platform = _device_kind()
+    row: Dict[str, Any] = {
+        "label": QUANT_LABEL,
+        "model": model, "net_class": net_class,
+        "batch": batch, "steps": int(steps),
+        "f32_ms": round(f32_ms, 4), "int8_ms": round(int8_ms, 4),
+        "baseline_dtype": "f32",
+        "int8_vs_f32": round(f32_ms / int8_ms, 4) if int8_ms else None,
+        "f32_img_s_per_chip": round(batch / f32_ms * 1e3, 2) if f32_ms
+        else None,
+        "int8_img_s_per_chip": round(batch / int8_ms * 1e3, 2) if int8_ms
+        else None,
+        "quantized_nodes": quantized_nodes,
+        "device_kind": kind, "platform": platform,
+        "provenance": "measured",
+    }
+    if extra:
+        row.update(extra)
+    if ledger is None:
+        from ..tuner import get_cache
+        ledger = get_cache()
+    ledger.append(row)
+    return row
+
+
+def quant_rows(ledger=None, device_kind: Optional[str] = None,
+               model: Optional[str] = None,
+               net_class: Optional[str] = None) -> list:
+    """All ``label="quant"`` ledger rows, oldest first, filtered the same
+    way ``tuner.tuner_rows`` filters trial rows."""
+    if ledger is None:
+        from ..tuner import get_cache
+        ledger = get_cache()
+    out = []
+    for r in ledger.rows():
+        if r.get("label") != QUANT_LABEL:
+            continue
+        if device_kind is not None and r.get("device_kind") != device_kind:
+            continue
+        if model is not None and r.get("model") != model:
+            continue
+        if net_class is not None and r.get("net_class") != net_class:
+            continue
+        out.append(r)
+    return out
+
+
+def best_int8_cached(device_kind: Optional[str] = None,
+                     model: Optional[str] = None,
+                     net_class: Optional[str] = None,
+                     ledger=None) -> Optional[Dict[str, Any]]:
+    """The best MEASURED int8-vs-f32 win for a device/model signature —
+    the quant twin of ``tuner.best_cached`` and the evidence behind mxlint
+    MXL-T215 (fp32 server while a measured int8 win is on file).  Same
+    filter discipline: measured rows only (both latencies present), device
+    and model/net_class scoped, and only rows where int8 actually WON
+    (``int8_vs_f32 > 1``) count.  Returns the row with the largest
+    speedup, or None."""
+    rows = [r for r in quant_rows(ledger, device_kind=device_kind,
+                                  model=model, net_class=net_class)
+            if r.get("f32_ms") and r.get("int8_ms")
+            and float(r.get("int8_vs_f32") or 0.0) > 1.0]
+    if not rows:
+        return None
+    return max(rows, key=lambda r: float(r["int8_vs_f32"]))
+
+
+# --------------------------------------------------------------------------
+# serving tier
+# --------------------------------------------------------------------------
+
+def quantize_model_config(cfg, *, table: Optional[CalibTable] = None,
+                          excluded_sym_names: Sequence[str] = (),
+                          exclude_first_conv: bool = True,
+                          exclude_last_fc: bool = True):
+    """Turn a serving :class:`~mxnet_tpu.serving.server.ModelConfig` into
+    its int8 tier: the symbol is rewritten through the pass pipeline, the
+    params re-serialized with the int8 weights + range scalars, every
+    serving knob (buckets, queue bound, deadline, device) carried over,
+    and ``tier`` stamped ``"int8"``.  The TVM serving idiom, one tier
+    cheaper: compile few executables, route many requests — now at int8
+    cost per request."""
+    from .. import interop
+    from ..native.predict_bridge import _load_param_bytes
+    from ..serving.server import ModelConfig
+    from ..symbol import load_json
+
+    sym = load_json(cfg.symbol_json)
+    arg, aux = _load_param_bytes(cfg.param_bytes)
+    qsym, qarg, qaux, _ = quantize_model(
+        sym, arg, aux, calib_mode="none", table=table,
+        excluded_sym_names=excluded_sym_names,
+        exclude_first_conv=exclude_first_conv,
+        exclude_last_fc=exclude_last_fc, model=cfg.name)
+    live = set(qsym.list_arguments())
+    params = {f"arg:{k}": v for k, v in qarg.items() if k in live}
+    params.update({f"aux:{k}": v for k, v in qaux.items()
+                   if k in set(qsym.list_auxiliary_states())})
+    fd, pfile = tempfile.mkstemp(suffix=".params")
+    os.close(fd)
+    try:
+        interop.save_reference_params(pfile, params)
+        with open(pfile, "rb") as f:
+            pbytes = f.read()
+    finally:
+        os.unlink(pfile)
+    qcfg = ModelConfig(
+        cfg.name, qsym.tojson(), pbytes,
+        feature_shape=cfg.feature_shape, input_name=cfg.input_name,
+        buckets=cfg.buckets, max_queue=cfg.max_queue,
+        deadline_ms=cfg.deadline_ms, max_wait_ms=cfg.max_wait_ms,
+        retries=cfg.retries, breaker_threshold=cfg.breaker_threshold,
+        breaker_cooldown_s=cfg.breaker_cooldown_s, dev_type=cfg.dev_type,
+        dev_id=cfg.dev_id, output_keys=cfg.output_keys, tier="int8")
+    qcfg.bucket_provenance = cfg.bucket_provenance
+    return qcfg
+
+
+def ensure_tier(cfg):
+    """Resolve a ModelConfig to its requested serving tier: a config
+    asking for ``tier="int8"`` (explicitly or via ``MXNET_SERVE_TIER``)
+    whose graph is still float is quantized here — the hook
+    ``ModelServer`` calls once per model at state build, so a server
+    started under ``MXNET_SERVE_TIER=int8`` serves the cheaper executable
+    without the caller touching the model files."""
+    if getattr(cfg, "tier", "f32") != "int8":
+        return cfg
+    from ..symbol import load_json
+    if is_quantized_symbol(load_json(cfg.symbol_json)):
+        return cfg
+    return quantize_model_config(cfg)

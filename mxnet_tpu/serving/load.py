@@ -68,7 +68,9 @@ def model_config_from_files(model: str, *, params: Optional[str] = None,
     ``model`` is a symbol-JSON path or the literal ``"tiny"`` (built-in
     demo MLP — ``params``/``feature_shape`` ignored). ``feature_shape``
     and ``buckets`` are CLI-style comma strings. Extra kwargs pass
-    through to :class:`~mxnet_tpu.serving.server.ModelConfig`.
+    through to :class:`~mxnet_tpu.serving.server.ModelConfig` —
+    ``tier="int8"`` (or ``MXNET_SERVE_TIER=int8``) makes the server
+    quantize the model at start (docs/quantization.md).
     """
     import os
 

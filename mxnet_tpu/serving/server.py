@@ -71,6 +71,13 @@ register_config("MXNET_SERVE_BREAKER_THRESHOLD", 3, int,
 register_config("MXNET_SERVE_BREAKER_COOLDOWN", 5.0, float,
                 "Seconds an open circuit breaker waits before letting one "
                 "half-open probe batch through.")
+register_config("MXNET_SERVE_TIER", "f32", str,
+                "Default serving tier for models whose ModelConfig does "
+                "not name one: 'f32' serves the graph as loaded; 'int8' "
+                "quantizes symbol+params at server start "
+                "(quant.ensure_tier — calibrate offline with "
+                "tools/mxquant.py for calibrated ranges). Per-model "
+                "override: ModelConfig(tier=...).")
 
 
 def _now() -> float:
@@ -144,7 +151,8 @@ class ModelConfig:
                  breaker_threshold: Optional[int] = None,
                  breaker_cooldown_s: Optional[float] = None,
                  dev_type: int = 1, dev_id: int = 0,
-                 output_keys: Optional[List[str]] = None):
+                 output_keys: Optional[List[str]] = None,
+                 tier: Optional[str] = None):
         if not name:
             raise MXNetError("ModelConfig needs a model name")
         self.name = str(name)
@@ -175,6 +183,11 @@ class ModelConfig:
             raise MXNetError("max_queue must be >= 0 (0 = unbounded)")
         if self.deadline_ms < 0 or self.max_wait_ms < 0:
             raise MXNetError("deadline_ms/max_wait_ms must be >= 0")
+        self.tier = str(get_env("MXNET_SERVE_TIER", "f32")
+                        if tier is None else tier).lower()
+        if self.tier not in ("f32", "int8"):
+            raise MXNetError("tier must be 'f32' or 'int8', got %r"
+                             % (self.tier,))
         self.dev_type, self.dev_id = int(dev_type), int(dev_id)
         self.output_keys = output_keys
 
@@ -183,6 +196,14 @@ class _ModelState:
     """Per-model runtime: queue, worker, bucket cache, breaker, stats."""
 
     def __init__(self, cfg: ModelConfig):
+        if cfg.tier == "int8":
+            # resolve the int8 tier ONCE at state build: a still-float
+            # graph is rewritten through the quant pass pipeline here, so
+            # MXNET_SERVE_TIER=int8 serves the cheaper executable without
+            # the caller touching the model files (quant.ensure_tier is a
+            # no-op on an already-quantized symbol)
+            from ..quant import ensure_tier
+            cfg = ensure_tier(cfg)
         self.cfg = cfg
         self.queue = BoundedRequestQueue(cfg.max_queue)
         self.cache = BucketExecutorCache(
@@ -540,6 +561,9 @@ class ModelServer:
         if _m.enabled():
             from ..observability import catalog as _c
             _c.SERVE_REQUESTS.inc(model=st.cfg.name, outcome=outcome)
+            if st.cfg.tier == "int8":
+                _c.QUANT_SERVE_REQUESTS.inc(model=st.cfg.name,
+                                            outcome=outcome)
 
     def _observe_latency(self, st: _ModelState, ms: float) -> None:
         from ..observability import metrics as _m
@@ -582,6 +606,7 @@ class ModelServer:
                 "buckets": list(st.cache.buckets),
                 "buckets_compiled": st.cache.compiled_buckets(),
                 "bucket_provenance": st.cfg.bucket_provenance,
+                "tier": st.cfg.tier,
             }
         if lat.size:
             out["p50_ms"] = float(np.percentile(lat, 50))

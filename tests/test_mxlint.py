@@ -944,3 +944,102 @@ def test_lint_server_t214_silent_and_suppressed():
     assert len(analysis.lint_server(srv).by_rule("MXL-T214")) == 1
     with pytest.raises(TypeError):
         analysis.lint_server(object())
+
+
+# ---------------------------------------------------------------------------
+# MXL-G108: uncalibrated-quantized-graph — quantize nodes running with
+# runtime (defaulted) ranges instead of baked-in calibrated constants.
+# ---------------------------------------------------------------------------
+@pytest.mark.quant
+def test_g108_flags_uncalibrated_quantized_graph(rng):
+    from mxnet_tpu import quant
+    x = sym.Variable("data")
+    out = mx.sym.FullyConnected(x, num_hidden=3, name="g108_fc")
+    arg = {"g108_fc_weight": mx.nd.array(rng.randn(3, 4).astype("f4")),
+           "g108_fc_bias": mx.nd.array(rng.randn(3).astype("f4"))}
+    # no table: runtime min/max ranges -> fires
+    qsym, _, _ = quant.quantize_symbol(out, arg)
+    report = lint_symbol(qsym, shapes={"data": (2, 4)})
+    diags = report.by_rule("MXL-G108")
+    assert len(diags) == 1 and diags[0].severity == "warning"
+    assert "g108_fc_quantize" in diags[0].message
+    # suppression channel works
+    report = lint_symbol(qsym, shapes={"data": (2, 4)},
+                         suppress=("MXL-G108",))
+    assert not report.by_rule("MXL-G108")
+    assert any(d.rule_id == "MXL-G108" for d in report.suppressed)
+
+
+@pytest.mark.quant
+def test_g108_silent_on_calibrated_and_float_graphs(rng):
+    from mxnet_tpu import quant
+    x = sym.Variable("data")
+    out = mx.sym.FullyConnected(x, num_hidden=3, name="g108b_fc")
+    arg = {"g108b_fc_weight": mx.nd.array(rng.randn(3, 4).astype("f4")),
+           "g108b_fc_bias": mx.nd.array(rng.randn(3).astype("f4"))}
+    # float graph: silent
+    assert not lint_symbol(out, shapes={"data": (2, 4)}).by_rule("MXL-G108")
+    # calibrated ranges are constant vars: silent
+    table = quant.CalibTable({"g108b_fc": (-2.0, 2.0)})
+    qsym, _, _ = quant.quantize_symbol(out, arg, table=table)
+    assert not lint_symbol(qsym,
+                           shapes={"data": (2, 4)}).by_rule("MXL-G108")
+
+
+# ---------------------------------------------------------------------------
+# MXL-T215: fp32-serving-with-int8-win — an f32-tier server while the cost
+# ledger holds a measured int8 win for the same model/device signature.
+# Same best_cached discipline as T211/T212: evidence-gated, device-scoped.
+# ---------------------------------------------------------------------------
+def _quant_win_row(kind, model="t215m", speedup=1.8):
+    return {"label": "quant", "model": model, "device_kind": kind,
+            "f32_ms": 10.0, "int8_ms": round(10.0 / speedup, 4),
+            "int8_vs_f32": speedup, "provenance": "measured"}
+
+
+@pytest.mark.quant
+def test_lint_server_t215_flags_f32_with_int8_win(tmp_path, monkeypatch):
+    from mxnet_tpu.observability import xcost
+    from mxnet_tpu.serving.executors import _device_kind
+    cache = str(tmp_path / "quant_cache.jsonl")
+    monkeypatch.setenv("MXNET_TUNER_CACHE", cache)
+    xcost.CostLedger(cache).append(_quant_win_row(_device_kind()[0],
+                                                  model="t215m"))
+    report = analysis.lint_server(_serve_cfg(name="t215m"))
+    diags = report.by_rule("MXL-T215")
+    assert len(diags) == 1 and diags[0].severity == "warning"
+    assert "1.80x" in diags[0].message
+    # suppression channel
+    report = analysis.lint_server(_serve_cfg(name="t215m"),
+                                  suppress=("MXL-T215",))
+    assert not report.by_rule("MXL-T215")
+    assert any(d.rule_id == "MXL-T215" for d in report.suppressed)
+
+
+@pytest.mark.quant
+def test_lint_server_t215_silent_cases(tmp_path, monkeypatch):
+    from mxnet_tpu.observability import xcost
+    from mxnet_tpu.serving.executors import _device_kind
+    kind = _device_kind()[0]
+    cache = str(tmp_path / "quant_cache.jsonl")
+    monkeypatch.setenv("MXNET_TUNER_CACHE", cache)
+
+    # empty cache: silent
+    assert not analysis.lint_server(
+        _serve_cfg(name="t215s")).by_rule("MXL-T215")
+
+    led = xcost.CostLedger(cache)
+    # row for another model / another device: silent
+    led.append(_quant_win_row(kind, model="someone_else"))
+    led.append(_quant_win_row("TPU v99", model="t215s"))
+    # row where int8 LOST: no win, silent
+    led.append(_quant_win_row(kind, model="t215s", speedup=0.8))
+    assert not analysis.lint_server(
+        _serve_cfg(name="t215s")).by_rule("MXL-T215")
+
+    # a server already on the int8 tier is never nagged
+    led.append(_quant_win_row(kind, model="t215s"))
+    assert analysis.lint_server(
+        _serve_cfg(name="t215s")).by_rule("MXL-T215")
+    cfg = _serve_cfg(name="t215s", tier="int8")
+    assert not analysis.lint_server(cfg).by_rule("MXL-T215")

@@ -118,7 +118,10 @@ def test_snapshot_contains_full_catalog():
     snap = obs.snapshot()
     for fam in ("mxtpu_trainer_step_ms", "mxtpu_kv_publish_ms",
                 "mxtpu_checkpoint_save_ms", "mxtpu_span_ms",
-                "mxtpu_jit_traces_total"):
+                "mxtpu_jit_traces_total",
+                "mxtpu_quant_calib_batches_total", "mxtpu_quant_nodes",
+                "mxtpu_quant_acc_delta",
+                "mxtpu_quant_serve_requests_total"):
         assert fam in snap["metrics"], fam
 
 

@@ -1,0 +1,496 @@
+"""The quantization subsystem (mxnet_tpu/quant/): calibration tables,
+quantize/requantize/dequantize as pass-pipeline passes (structurally
+identical to the contrib rewrite), exclusion defaults, the int8 ledger
+row + cache query, the serving tier — and THE acceptance test: calibrate
+a model-zoo net, quantize via the pass route, accuracy within ~1% of
+fp32, a label="quant" CostLedger row, and the PR-12 ModelServer serving
+the int8 tier with deadline_violations == 0."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import quant
+from mxnet_tpu.contrib import quantization as contrib_q
+from mxnet_tpu.observability import catalog, xcost
+from mxnet_tpu.passes import DEFAULT_PIPELINE, PASS_REGISTRY, PassManager
+
+pytestmark = pytest.mark.quant
+
+
+class _Batch:
+    def __init__(self, x):
+        self.data = [mx.nd.array(x)]
+
+
+def _deep_net(rng):
+    """conv0 -> conv1 -> fc0 -> fc1: deep enough that the first/last
+    exclusion defaults leave something to quantize."""
+    data = mx.sym.Variable("data")
+    c0 = mx.sym.Convolution(data, kernel=(3, 3), num_filter=4, pad=(1, 1),
+                            name="conv0")
+    r0 = mx.sym.Activation(c0, act_type="relu")
+    c1 = mx.sym.Convolution(r0, kernel=(3, 3), num_filter=4, pad=(1, 1),
+                            name="conv1")
+    r1 = mx.sym.Activation(c1, act_type="relu")
+    f0 = mx.sym.FullyConnected(mx.sym.Flatten(r1), num_hidden=8, name="fc0")
+    r2 = mx.sym.Activation(f0, act_type="relu")
+    out = mx.sym.FullyConnected(r2, num_hidden=3, name="fc1")
+    arg = {
+        "conv0_weight": mx.nd.array(rng.randn(4, 1, 3, 3).astype("f4") * .5),
+        "conv0_bias": mx.nd.array(rng.randn(4).astype("f4") * .1),
+        "conv1_weight": mx.nd.array(rng.randn(4, 4, 3, 3).astype("f4") * .3),
+        "conv1_bias": mx.nd.array(rng.randn(4).astype("f4") * .1),
+        "fc0_weight": mx.nd.array(rng.randn(8, 144).astype("f4") * .1),
+        "fc0_bias": mx.nd.array(rng.randn(8).astype("f4") * .1),
+        "fc1_weight": mx.nd.array(rng.randn(3, 8).astype("f4") * .3),
+        "fc1_bias": mx.nd.array(rng.randn(3).astype("f4") * .1),
+    }
+    return out, arg
+
+
+def _node_map(sym):
+    """Canonical structural form: name -> (op, attrs, input entries)."""
+    return {n.name: (n.op,
+                     tuple(sorted((k, str(v))
+                                  for k, v in (n.attrs or {}).items())),
+                     tuple((s.name, i) for (s, i) in n.inputs))
+            for n in sym.topo_nodes()}
+
+
+def _fwd(sym, params, x):
+    return sym.bind(mx.cpu(), dict(params, data=mx.nd.array(x))) \
+        .forward()[0].asnumpy()
+
+
+# ------------------------------------------------------------- calib table
+def test_calib_table_roundtrip(tmp_path):
+    t = quant.CalibTable({"conv0": (-1.5, 2.0), "fc0": (0.0, 3.25)},
+                         mode="naive", num_examples=64, model="m")
+    p = str(tmp_path / "calib.json")
+    t.save(p)
+    t2 = quant.CalibTable.load(p)
+    assert t2.ranges == t.ranges
+    assert t2.mode == "naive" and t2.num_examples == 64 and t2.model == "m"
+    assert "conv0" in t2 and t2.get("missing") is None and len(t2) == 2
+
+
+def test_calib_table_rejects_garbage(tmp_path):
+    p = tmp_path / "bad.json"
+    p.write_text("{\"not\": \"a table\"}")
+    with pytest.raises(mx.MXNetError, match="ranges"):
+        quant.CalibTable.load(str(p))
+
+
+def test_collect_records_ranges_and_telemetry(rng):
+    sym, arg = _deep_net(rng)
+    x = rng.randn(8, 1, 6, 6).astype("f4")
+    before = catalog.QUANT_CALIB_BATCHES.value(mode="naive")
+    table = quant.collect(sym, arg, {}, [_Batch(x), _Batch(x)], mode="naive")
+    assert set(table.ranges) == {"conv0", "conv1", "fc0", "fc1"}
+    for lo, hi in table.ranges.values():
+        assert lo <= hi
+    assert table.num_examples == 16
+    assert catalog.QUANT_CALIB_BATCHES.value(mode="naive") == before + 2
+
+
+def test_collect_requires_iterator(rng):
+    sym, arg = _deep_net(rng)
+    with pytest.raises(mx.MXNetError, match="iterator"):
+        quant.collect(sym, arg, {}, None)
+    with pytest.raises(mx.MXNetError, match="mode"):
+        quant.collect(sym, arg, {}, [], mode="bogus")
+
+
+# --------------------------------------- pass route == contrib route
+@pytest.mark.parametrize("calibrated", [False, True])
+def test_pass_route_matches_contrib(rng, calibrated):
+    """The three passes, run in order, must produce the SAME graph as the
+    standalone contrib.quantization.quantize_graph rewrite — identical
+    node names, ops, attrs and wiring, identical extra params, identical
+    outputs (the StableHLO-level identity: same graph in, same HLO out)."""
+    sym, arg = _deep_net(rng)
+    x = rng.randn(8, 1, 6, 6).astype("f4")
+    table = None
+    calib_ranges = None
+    if calibrated:
+        table = quant.collect(sym, arg, {}, [_Batch(x)], mode="naive")
+        calib_ranges = dict(table.ranges)
+    qsym_c, extra_c = contrib_q.quantize_graph(sym, arg,
+                                               calib_ranges=calib_ranges)
+    qsym_p, extra_p, res = quant.quantize_symbol(
+        sym, arg, table=table,
+        exclude_first_conv=False, exclude_last_fc=False)
+    assert res.counts == {"quantize": 4, "requantize": 4, "dequantize": 4}
+    assert _node_map(qsym_c) == _node_map(qsym_p)
+    assert sorted(extra_c) == sorted(extra_p)
+    for k in extra_c:
+        np.testing.assert_array_equal(extra_c[k].asnumpy(),
+                                      extra_p[k].asnumpy())
+    oc = _fwd(qsym_c, {**arg, **extra_c}, x)
+    op = _fwd(qsym_p, {**arg, **extra_p}, x)
+    np.testing.assert_array_equal(oc, op)
+
+
+@pytest.mark.parametrize("calibrated", [False, True])
+def test_adjacent_islands_dequantize_between(rng, calibrated):
+    """Two eligible layers wired back-to-back (no op between them) still
+    dequantize between their islands — the downstream quantize must see
+    FLOAT data, never the upstream island's raw int8 codes (regression:
+    _contrib_quantize used to sit in QUANT_FAMILY_OPS, so a calibrated
+    fc->fc pair skipped the dequantize and saturated)."""
+    data = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(data, num_hidden=6, name="fca")
+    out = mx.sym.FullyConnected(h, num_hidden=3, name="fcb")
+    arg = {"fca_weight": mx.nd.array(rng.randn(6, 4).astype("f4") * .3),
+           "fca_bias": mx.nd.array(rng.randn(6).astype("f4") * .1),
+           "fcb_weight": mx.nd.array(rng.randn(3, 6).astype("f4") * .3),
+           "fcb_bias": mx.nd.array(rng.randn(3).astype("f4") * .1)}
+    x = rng.randn(8, 4).astype("f4")
+    table = quant.collect(out, arg, {}, [_Batch(x)], mode="naive") \
+        if calibrated else None
+    qsym, extra, res = quant.quantize_symbol(
+        out, arg, table=table, exclude_first_conv=False,
+        exclude_last_fc=False)
+    assert res.counts == {"quantize": 2, "requantize": 2, "dequantize": 2}
+    nm = _node_map(qsym)
+    # fcb's quantize consumes fca's DEQUANTIZE output, not its int8 codes
+    assert nm["fcb_quantize"][2][0] == ("fca_dequantize", 0)
+    # and the graph is still node-for-node the contrib rewrite
+    qsym_c, extra_c = contrib_q.quantize_graph(
+        out, arg, calib_ranges=dict(table.ranges) if table else None)
+    assert nm == _node_map(qsym_c)
+    ref = _fwd(out, arg, x)
+    got = _fwd(qsym, {**arg, **extra}, x)
+    assert np.abs(got - ref).max() / (np.abs(ref).max() + 1e-9) < 0.15
+
+
+def test_computed_bias_node_stays_float(rng):
+    """A node whose bias is a COMPUTED value (not a param var) must stay
+    float on both routes — quantizing it would silently replace the real
+    bias with zeros (regression: eligibility only checked the weight)."""
+    data = mx.sym.Variable("data")
+    bias_var = mx.sym.Variable("raw_bias")
+    bias = bias_var * 2.0                     # computed, not a param var
+    out = mx.sym.FullyConnected(data, bias=bias, num_hidden=3, name="fcb")
+    arg = {"fcb_weight": mx.nd.array(rng.randn(3, 4).astype("f4")),
+           "raw_bias": mx.nd.array(rng.randn(3).astype("f4"))}
+    qsym, extra, res = quant.quantize_symbol(
+        out, arg, exclude_first_conv=False, exclude_last_fc=False)
+    assert res.total_rewrites == 0 and not extra
+    assert {n.op for n in qsym.topo_nodes()
+            if not n.is_var}.isdisjoint(quant.qpass.ACC_OPS)
+    qsym_c, extra_c = contrib_q.quantize_graph(out, arg)
+    assert _node_map(qsym_c) == _node_map(qsym) and not extra_c
+
+
+def test_evaluate_agreement_ragged_final_batch(rng):
+    """A standard eval iterator whose final batch is smaller rebinds per
+    shape instead of failing on the first batch's bound program."""
+    sym, arg = _deep_net(rng)
+    qsym, qarg, qaux, _ = quant.quantize_model(sym, arg, calib_mode="none")
+    evald = [_Batch(rng.randn(8, 1, 6, 6).astype("f4")),
+             _Batch(rng.randn(3, 1, 6, 6).astype("f4"))]   # ragged tail
+    res = quant.evaluate_agreement(sym, arg, {}, qsym, qarg, qaux, evald)
+    assert res["n"] == 11
+
+
+def test_pass_route_idempotent(rng):
+    """Re-running the pipeline over an already-quantized graph rewrites
+    nothing and returns the same symbol object."""
+    sym, arg = _deep_net(rng)
+    qsym, extra, _ = quant.quantize_symbol(sym, arg,
+                                           exclude_first_conv=False,
+                                           exclude_last_fc=False)
+    mgr = PassManager([quant.QuantizePass(exclude_first_conv=False,
+                                          exclude_last_fc=False),
+                       quant.RequantizePass(), quant.DequantizePass()],
+                      rehome_params=False)
+    res = mgr.run(qsym, param_names=list(arg) + list(extra))
+    assert res.total_rewrites == 0
+    assert res.symbol is qsym
+
+
+def test_quant_passes_registered_but_opt_in():
+    for name in quant.QUANT_PIPELINE:
+        assert name in PASS_REGISTRY
+        assert name not in DEFAULT_PIPELINE
+    mgr = PassManager("quantize,requantize,dequantize")
+    assert mgr.names == ("quantize", "requantize", "dequantize")
+
+
+# ------------------------------------------------------ exclusion policy
+def test_first_last_layer_defaults(rng):
+    """The reference driver defaults: first conv + classifier head stay
+    float; the interior quantizes."""
+    sym, arg = _deep_net(rng)
+    qsym, qarg, qaux, _ = quant.quantize_model(sym, arg, calib_mode="none")
+    ops = {n.name: n.op for n in qsym.topo_nodes() if not n.is_var}
+    assert ops.get("conv0") == "Convolution"          # first conv: float
+    assert ops.get("fc1") == "FullyConnected"         # head: float
+    assert "conv1_int8" in ops and "fc0_int8" in ops  # interior: int8
+    x = rng.randn(4, 1, 6, 6).astype("f4")
+    ref = _fwd(sym, arg, x)
+    got = _fwd(qsym, qarg, x)
+    assert np.abs(got - ref).max() / (np.abs(ref).max() + 1e-9) < 0.1
+
+
+def test_excluded_op_list_wins(rng):
+    sym, arg = _deep_net(rng)
+    qsym, qarg, _, _ = quant.quantize_model(
+        sym, arg, calib_mode="none", excluded_sym_names=("conv1",),
+        exclude_first_conv=False, exclude_last_fc=False)
+    ops = {n.name: n.op for n in qsym.topo_nodes() if not n.is_var}
+    assert ops.get("conv1") == "Convolution"
+    assert "conv0_int8" in ops and "fc0_int8" in ops and "fc1_int8" in ops
+
+
+def test_exclusion_defaults_never_empty_the_set(rng):
+    """A net too shallow to afford the first/last defaults quantizes
+    anyway (explicit excluded names still win)."""
+    data = mx.sym.Variable("data")
+    out = mx.sym.FullyConnected(data, num_hidden=3, name="only_fc")
+    arg = {"only_fc_weight": mx.nd.array(rng.randn(3, 4).astype("f4")),
+           "only_fc_bias": mx.nd.array(rng.randn(3).astype("f4"))}
+    qsym, _, _, _ = quant.quantize_model(sym=out, arg_params=arg,
+                                         calib_mode="none")
+    ops = {n.op for n in qsym.topo_nodes() if not n.is_var}
+    assert "_contrib_quantized_fully_connected" in ops
+    # explicit exclusion still wins
+    qsym2, _, _, _ = quant.quantize_model(
+        sym=out, arg_params=arg, calib_mode="none",
+        excluded_sym_names=("only_fc",))
+    ops2 = {n.op for n in qsym2.topo_nodes() if not n.is_var}
+    assert "_contrib_quantized_fully_connected" not in ops2
+
+
+# ---------------------------------------------------------- op registry
+def test_quantized_fc_lives_in_ops_registry():
+    """quantized_fully_connected is registered by ops/, not contrib —
+    graphs referencing it resolve without importing contrib."""
+    from mxnet_tpu.ops.registry import get_op
+    opdef = get_op("_contrib_quantized_fully_connected")
+    assert opdef.fn.__module__ == "mxnet_tpu.ops.quantize_ops"
+    assert get_op("_contrib_quantized_conv") is not None
+
+
+def test_quantized_graph_simple_binds(rng):
+    """A quantized graph goes through simple_bind like any other op: the
+    parameter-shape rules fill weight/bias AND the scalar range args."""
+    sym, arg = _deep_net(rng)
+    table = quant.collect(sym, arg, {},
+                          [_Batch(rng.randn(4, 1, 6, 6).astype("f4"))],
+                          mode="naive")
+    qsym, _, _ = quant.quantize_symbol(sym, arg, table=table,
+                                       exclude_first_conv=False,
+                                       exclude_last_fc=False)
+    exe = qsym.simple_bind(mx.cpu(), grad_req="null", data=(4, 1, 6, 6))
+    outs = exe.forward()
+    assert outs[0].shape == (4, 3)
+
+
+# ----------------------------------------------------- ledger + cache
+def test_compare_latency_row_and_best_cached(rng, tmp_path):
+    sym, arg = _deep_net(rng)
+    qsym, qarg, qaux, _ = quant.quantize_model(sym, arg, calib_mode="none")
+    led = xcost.CostLedger(str(tmp_path / "led.jsonl"))
+    x = rng.randn(4, 1, 6, 6).astype("f4")
+    row = quant.compare_latency(sym, arg, {}, qsym, qarg, qaux, x,
+                                steps=2, ledger=led, model="deep")
+    assert row["label"] == "quant"
+    assert row["f32_ms"] > 0 and row["int8_ms"] > 0
+    assert row["baseline_dtype"] == "f32"   # a true-f32 measurement
+    assert row["int8_vs_f32"] == pytest.approx(
+        row["f32_ms"] / row["int8_ms"], rel=1e-3)
+    persisted = led.rows()
+    assert len(persisted) == 1 and persisted[0]["model"] == "deep"
+
+    # best_int8_cached: measured-only + device-scoped + wins-only
+    kind = row["device_kind"]
+    assert quant.best_int8_cached(device_kind="TPUv99", model="deep",
+                                  ledger=led) is None      # other device
+    assert quant.best_int8_cached(device_kind=kind, model="other",
+                                  ledger=led) is None      # other model
+    hit = quant.best_int8_cached(device_kind=kind, model="deep", ledger=led)
+    if row["int8_vs_f32"] > 1.0:
+        assert hit is not None and hit["int8_vs_f32"] == row["int8_vs_f32"]
+    else:
+        assert hit is None        # int8 did not win: no recommendation
+    # a synthetic winning row is returned, and the BEST one wins
+    led.append({"label": "quant", "model": "deep", "device_kind": kind,
+                "f32_ms": 10.0, "int8_ms": 5.0, "int8_vs_f32": 2.0})
+    led.append({"label": "quant", "model": "deep", "device_kind": kind,
+                "f32_ms": 10.0, "int8_ms": 2.0, "int8_vs_f32": 5.0})
+    best = quant.best_int8_cached(device_kind=kind, model="deep", ledger=led)
+    assert best["int8_vs_f32"] == 5.0
+
+
+def test_quant_row_is_a_perfwatch_baseline(rng, tmp_path):
+    """A label="quant" ledger row normalizes into a perfwatch artifact
+    (kind=quant_row) and self-compares ok — int8 latency/speedup/accuracy
+    regressions guard exactly like serving rows."""
+    from mxnet_tpu.observability import perfwatch
+    sym, arg = _deep_net(rng)
+    qsym, qarg, qaux, _ = quant.quantize_model(sym, arg, calib_mode="none")
+    path = str(tmp_path / "led.jsonl")
+    quant.compare_latency(sym, arg, {}, qsym, qarg, qaux,
+                          rng.randn(4, 1, 6, 6).astype("f4"), steps=2,
+                          ledger=xcost.CostLedger(path), model="deep",
+                          extra={"int8_acc": 0.995})
+    norm, err = perfwatch.load_artifact(path)
+    assert not err and norm["kind"] == "quant_row"
+    assert norm["metrics"]["int8_ms"] > 0
+    assert norm["metrics"]["int8_acc"] == 0.995
+    assert perfwatch.compare(norm, norm)["status"] == "ok"
+
+
+def test_evaluate_agreement_identity(rng):
+    """fp32-vs-itself agreement is exactly 1.0 (the labels-from-argmax
+    ground truth) and the acc-delta gauge updates."""
+    sym, arg = _deep_net(rng)
+    evals = [_Batch(rng.randn(8, 1, 6, 6).astype("f4"))]
+    acc = quant.evaluate_agreement(sym, arg, {}, sym, arg, {}, evals)
+    assert acc["fp32_acc"] == 1.0 and acc["int8_acc"] == 1.0
+    assert acc["acc_delta"] == 0.0 and acc["n"] == 8
+    assert catalog.QUANT_ACC_DELTA.value() == 0.0
+
+
+# -------------------------------------------------------- serving tier
+@pytest.mark.serve
+def test_quantize_model_config_serving_tier():
+    from mxnet_tpu.serving import load as sload
+    from mxnet_tpu.serving.server import ModelConfig
+    from mxnet_tpu.serving.executors import BucketExecutorCache
+    from mxnet_tpu.symbol import load_json
+
+    sym_json, pbytes, feat, ref = sload.tiny_model()
+    cfg = ModelConfig("tiny", sym_json, pbytes, feature_shape=feat,
+                      buckets=(1, 2, 4), max_queue=16, deadline_ms=2000.0)
+    assert cfg.tier == "f32"
+    qcfg = quant.quantize_model_config(cfg)
+    assert qcfg.tier == "int8"
+    assert qcfg.buckets == cfg.buckets and qcfg.max_queue == cfg.max_queue
+    assert quant.is_quantized_symbol(load_json(qcfg.symbol_json))
+    cache = BucketExecutorCache(qcfg.symbol_json, qcfg.param_bytes,
+                                input_name="data", feature_shape=feat,
+                                buckets=(1, 2, 4))
+    xs = np.random.RandomState(5).randn(3, 4).astype("f4")
+    got = cache.run(xs)
+    want = np.stack([ref(s) for s in xs])
+    assert np.abs(got - want).max() / (np.abs(want).max() + 1e-9) < 0.1
+
+
+def test_ensure_tier_noop_on_f32_and_quantized():
+    from mxnet_tpu.serving import load as sload
+    from mxnet_tpu.serving.server import ModelConfig
+
+    sym_json, pbytes, feat, _ = sload.tiny_model()
+    cfg = ModelConfig("tiny", sym_json, pbytes, feature_shape=feat,
+                      buckets=(1,))
+    assert quant.ensure_tier(cfg) is cfg          # f32: untouched
+    qcfg = quant.quantize_model_config(cfg)
+    assert quant.ensure_tier(qcfg) is qcfg        # already quantized
+
+
+def test_model_config_tier_env_and_validation(monkeypatch):
+    from mxnet_tpu.serving import load as sload
+    from mxnet_tpu.serving.server import ModelConfig
+
+    sym_json, pbytes, feat, _ = sload.tiny_model()
+    monkeypatch.setenv("MXNET_SERVE_TIER", "int8")
+    cfg = ModelConfig("tiny", sym_json, pbytes, feature_shape=feat,
+                      buckets=(1,))
+    assert cfg.tier == "int8"
+    monkeypatch.delenv("MXNET_SERVE_TIER")
+    with pytest.raises(mx.MXNetError, match="tier"):
+        ModelConfig("tiny", sym_json, pbytes, feature_shape=feat,
+                    buckets=(1,), tier="fp4")
+
+
+# ------------------------------------------------------ THE acceptance
+@pytest.mark.serve
+def test_acceptance_calibrate_quantize_serve_zoo_net(rng, tmp_path):
+    """THE acceptance test: calibrate a model-zoo net on a small
+    iterator, quantize via the pass route, and assert (1) eval accuracy
+    within ~1% of the fp32 model, (2) a label="quant" CostLedger row
+    comparing int8 vs f32 step latency, and (3) the PR-12 ModelServer
+    serving the quantized tier end-to-end with deadline_violations == 0."""
+    import os
+    import tempfile
+
+    from mxnet_tpu import interop
+    from mxnet_tpu.contrib.quantization import _trace_gluon
+    from mxnet_tpu.gluon.model_zoo import vision
+    from mxnet_tpu.serving.server import ModelConfig, ModelServer
+
+    mx.random.seed(0)
+    net = vision.squeezenet1_0(classes=10)
+    net.initialize(mx.init.Xavier())
+    size = 64
+    net(mx.nd.array(rng.rand(2, 3, size, size).astype("f4")))  # deferred init
+    sym, arg_params, aux_params = _trace_gluon(net)
+
+    # squeezenet's classifier is a CONV, so the last-FC default cannot
+    # protect it — exclude it explicitly, the reference recipe for
+    # squeezenet-like heads (excluded-op list + first-conv default)
+    convs = [n.name for n in sym.topo_nodes()
+             if not n.is_var and n.op == "Convolution"]
+
+    # --- calibrate on a small iterator
+    calib = [_Batch(rng.rand(8, 3, size, size).astype("f4"))
+             for _ in range(2)]
+    qsym, qarg, qaux, table = quant.quantize_model(
+        sym, arg_params, aux_params, calib_iter=calib, calib_mode="naive",
+        excluded_sym_names=(convs[-1],), model="squeezenet1.0")
+    assert table is not None and len(table) > 0
+    assert quant.is_quantized_symbol(qsym)
+
+    # --- (1) eval accuracy within ~1% of fp32 on a held-out eval set
+    evals = [_Batch(rng.rand(16, 3, size, size).astype("f4"))
+             for _ in range(4)]
+    acc = quant.evaluate_agreement(sym, arg_params, aux_params,
+                                   qsym, qarg, qaux, evals)
+    assert acc["n"] == 64
+    assert acc["fp32_acc"] == 1.0
+    assert acc["acc_delta"] <= 0.011, acc
+
+    # --- (2) a label="quant" ledger row comparing int8 vs f32 latency
+    led = xcost.CostLedger(str(tmp_path / "quant_ledger.jsonl"))
+    row = quant.compare_latency(
+        sym, arg_params, aux_params, qsym, qarg, qaux,
+        rng.rand(8, 3, size, size).astype("f4"), steps=2, ledger=led,
+        model="squeezenet1.0", net_class=type(net).__name__,
+        extra={"acc_delta": acc["acc_delta"]})
+    assert row["label"] == "quant"
+    assert row["f32_ms"] > 0 and row["int8_ms"] > 0
+    assert led.rows()[-1]["int8_vs_f32"] == row["int8_vs_f32"]
+
+    # --- (3) the ModelServer serves the quantized tier end-to-end
+    live = set(qsym.list_arguments())
+    params = {"arg:%s" % k: v for k, v in qarg.items() if k in live}
+    params.update({"aux:%s" % k: v for k, v in qaux.items()
+                   if k in set(qsym.list_auxiliary_states())})
+    fd, pfile = tempfile.mkstemp(suffix=".params")
+    os.close(fd)
+    try:
+        interop.save_reference_params(pfile, params)
+        with open(pfile, "rb") as f:
+            pbytes = f.read()
+    finally:
+        os.unlink(pfile)
+    cfg = ModelConfig("squeezenet-int8", qsym.tojson(), pbytes,
+                      feature_shape=(3, size, size), buckets=(1, 2, 4),
+                      max_queue=16, deadline_ms=30000.0, tier="int8")
+    srv = ModelServer([cfg]).start(warm=False)
+    try:
+        xs = rng.rand(4, 3, size, size).astype("f4")
+        f32_exe = _fwd(sym, {**arg_params, **aux_params}, xs)
+        outs = np.stack([srv.predict("squeezenet-int8", x, timeout=120.0)
+                         for x in xs])
+        # the served tier agrees with the host-side int8 model's argmax
+        assert (np.argmax(outs, -1) == np.argmax(f32_exe, -1)).mean() >= 0.99
+        st = srv.stats("squeezenet-int8")
+        assert st["tier"] == "int8"
+        assert st["counts"]["ok"] == 4
+        assert st["deadline_violations"] == 0
+    finally:
+        srv.close(timeout=30.0)

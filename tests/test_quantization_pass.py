@@ -90,3 +90,64 @@ def test_bad_modes_raise(rng):
         q.quantize_model(sym, arg, {}, calib_mode="naive")
     with pytest.raises(MXNetError, match="calib_mode"):
         q.quantize_model(sym, arg, {}, calib_mode="bogus")
+
+
+# ------------------------------------------------- degenerate-range cases
+def test_quantize_zero_range_is_finite():
+    """min_range == max_range == 0 (constant-zero activations): the scale
+    must be well-defined — q = 0, finite range, no inf/NaN anywhere."""
+    zero = mx.nd.zeros((2, 3))
+    qv, mn, mx_ = mx.nd._contrib_quantize(zero, mx.nd.array(np.float32(0)),
+                                          mx.nd.array(np.float32(0)))
+    assert qv.asnumpy().dtype == np.int8
+    assert np.all(qv.asnumpy() == 0)
+    assert np.isfinite(mn.asnumpy()).all() and np.isfinite(mx_.asnumpy()).all()
+    # and the value round-trips through dequantize to (approximately) 0
+    back = mx.nd._contrib_dequantize(qv, mn, mx_)
+    assert np.isfinite(back.asnumpy()).all()
+    np.testing.assert_allclose(back.asnumpy(), 0.0, atol=1e-6)
+
+
+def test_quantize_constant_tensor_roundtrips():
+    """A constant (zero-width-range) tensor quantizes to a well-defined
+    int8 value and dequantizes back to itself."""
+    c = mx.nd.array(np.full((4, 2), 2.5, np.float32))
+    qv, mn, mx_ = mx.nd._contrib_quantize(c, mx.nd.array(np.float32(2.5)),
+                                          mx.nd.array(np.float32(2.5)))
+    assert np.all(qv.asnumpy() == 127)
+    back = mx.nd._contrib_dequantize(qv, mn, mx_).asnumpy()
+    np.testing.assert_allclose(back, 2.5, rtol=1e-5)
+
+
+def test_quantize_all_negative_tensor():
+    """All-negative calibrated range: max_range clamps to 0, the scale
+    comes from |min| — finite, sign-preserving."""
+    a = np.array([[-5.0, -1.0], [-2.5, -4.0]], np.float32)
+    qv, mn, mx_ = mx.nd._contrib_quantize(
+        mx.nd.array(a), mx.nd.array(np.float32(-5.0)),
+        mx.nd.array(np.float32(-5.0)))
+    assert np.isfinite(mn.asnumpy()).all()
+    back = mx.nd._contrib_dequantize(qv, mn, mx_).asnumpy()
+    assert np.isfinite(back).all()
+    np.testing.assert_allclose(back, a, atol=5.0 / 127 + 1e-6)
+
+
+def test_requantize_zero_range_is_finite():
+    """_contrib_requantize over an all-zero int32 accumulator used to
+    produce 0 * inf = NaN; it must yield zeros with a finite range."""
+    acc = mx.nd.zeros((3, 3), dtype="int32")
+    rng_in = mx.nd.array(np.float32(1.0))
+    qv, mn, mx_ = mx.nd._contrib_requantize(acc, -rng_in, rng_in)
+    assert np.all(qv.asnumpy() == 0)
+    assert np.isfinite(mn.asnumpy()).all() and np.isfinite(mx_.asnumpy()).all()
+
+
+def test_constant_activation_island_is_finite(rng):
+    """End-to-end: a quantized graph fed a CONSTANT batch (zero-width
+    runtime range) must produce finite outputs, not NaN."""
+    sym, arg = _small_convnet(rng)
+    qsym, qarg, _ = q.quantize_model(sym, arg, {})
+    x = np.zeros((2, 1, 6, 6), np.float32)
+    out = qsym.bind(mx.cpu(), dict(qarg, data=mx.nd.array(x))) \
+        .forward()[0].asnumpy()
+    assert np.isfinite(out).all()

@@ -858,3 +858,73 @@ def test_serving_tools_registered_with_tunnel_session():
         assert tool in bench_src, tool
         tool_src = open(os.path.join(REPO, "tools", tool)).read()
         assert 'tunnel_session.register("%s"' % tool in tool_src, tool
+
+
+@pytest.mark.quant
+def test_mxquant_cli_matrix(tmp_path):
+    """mxquant calibrate→quantize→compare: 0 = ok (table written /
+    nodes quantized / agreement within tolerance), 1 = degraded (nothing
+    quantized), 2 = cannot load the model — the mxlint exit convention."""
+    import json as _json
+    cli = os.path.join(REPO, "tools", "mxquant.py")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": "",
+           "MXTPU_TUNNEL_REG_DIR": str(tmp_path / "reg")}
+    table = str(tmp_path / "calib.json")
+    emitted = str(tmp_path / "q.json")
+    eparams = str(tmp_path / "q.params")
+    ledger = str(tmp_path / "quant_ledger.jsonl")
+
+    # calibrate: writes a loadable CalibTable
+    p = subprocess.run([sys.executable, cli, "calibrate", "--model", "tiny",
+                        "--batches", "2", "--mode", "naive",
+                        "--out", table],
+                       capture_output=True, text=True, timeout=300, env=env)
+    assert p.returncode == 0, p.stdout + p.stderr
+    doc = _json.load(open(table))
+    assert doc["mode"] == "naive" and doc["ranges"]
+
+    # quantize from the table: emits int8 symbol + params, exit 0
+    p = subprocess.run([sys.executable, cli, "quantize", "--model", "tiny",
+                        "--table", table, "--emit", emitted,
+                        "--emit-params", eparams],
+                       capture_output=True, text=True, timeout=300, env=env)
+    assert p.returncode == 0, p.stdout + p.stderr
+    emitted_doc = _json.load(open(emitted))
+    ops = {n.get("op") for n in emitted_doc["nodes"]}
+    assert "_contrib_quantize" in ops and os.path.exists(eparams)
+
+    # compare: agreement within --acc-tol, label="quant" ledger row
+    p = subprocess.run([sys.executable, cli, "compare", "--model", "tiny",
+                        "--table", table, "--steps", "2",
+                        "--eval-samples", "16", "--ledger", ledger],
+                       capture_output=True, text=True, timeout=300, env=env)
+    assert p.returncode == 0, p.stdout + p.stderr
+    row = _json.loads(p.stdout.strip().splitlines()[-1])
+    assert row["label"] == "quant"
+    assert row["f32_ms"] > 0 and row["int8_ms"] > 0
+    assert row["quantized_nodes"] >= 1
+
+    # excluding every candidate leaves nothing to quantize: degraded
+    p = subprocess.run([sys.executable, cli, "quantize", "--model", "tiny",
+                        "--exclude", "conv0,fc0,fc1"],
+                       capture_output=True, text=True, timeout=300, env=env)
+    assert p.returncode == 1, p.stdout + p.stderr
+
+    # a missing model file cannot run
+    p = subprocess.run([sys.executable, cli, "quantize", "--model",
+                        str(tmp_path / "missing.json"),
+                        "--feature-shape", "4"],
+                       capture_output=True, text=True, timeout=120, env=env)
+    assert p.returncode == 2, p.stdout + p.stderr
+
+
+def test_mxquant_registered_with_tunnel_session():
+    """mxquant joins the tunnel-client registry on BOTH sides (MARKERS +
+    bench.py's scan) and actually self-registers — the same pairing pin
+    as the serving tools."""
+    import tunnel_session
+    bench_src = open(os.path.join(REPO, "bench.py")).read()
+    assert "mxquant.py" in tunnel_session.MARKERS
+    assert "mxquant.py" in bench_src
+    tool_src = open(os.path.join(REPO, "tools", "mxquant.py")).read()
+    assert 'tunnel_session.register("mxquant.py"' in tool_src
