@@ -584,7 +584,8 @@ def _foreign_tunnel_clients():
     # only covers stripped-down bench.py copies shipped without tools/
     markers = (_tunnel.MARKERS if _tunnel is not None else
                ("aot_warm.py", "perf_lab.py", "mxtune.py", "collbench.py",
-                "mxserve.py", "loadgen.py", "mxquant.py", "tpu_session"))
+                "mxserve.py", "loadgen.py", "mxquant.py", "mxtrace.py",
+                "tpu_session"))
     found = []
     try:
         for pid in os.listdir("/proc"):

@@ -57,6 +57,11 @@ Rules (catalog in docs/static_analysis.md):
                                           tier while the cost ledger holds
                                           a measured int8 win for the same
                                           model/device signature
+* MXL-T216 untraced-serving-path (warning) a serving model with declared
+                                          deadlines/SLOs but request
+                                          tracing disabled (or sample
+                                          rate 0) — a breach leaves no
+                                          per-request timeline
 """
 from __future__ import annotations
 
@@ -170,6 +175,17 @@ register_rule(
     "(ModelConfig(tier='int8') or MXNET_SERVE_TIER=int8) — the same "
     "best_cached discipline as MXL-T211/T212: no row, different device, "
     "or an int8 tier already serving all stay silent.")
+register_rule(
+    "MXL-T216", "warning", "untraced-serving-path",
+    "A serving model declares latency objectives (a per-request deadline "
+    "and/or an SLO) but serves with request tracing disabled or a zero "
+    "sample rate: when the deadline or SLO is breached there is no "
+    "per-request span timeline to attribute the miss to queue wait vs "
+    "batch assembly vs device time — the exact evidence the objectives "
+    "exist to produce. Enable tracing (ModelConfig(trace=True) / "
+    "MXNET_SERVE_TRACE=1) with a nonzero sample rate "
+    "(MXNET_TRACE_SAMPLE); error/shed/expired and tail traces are "
+    "always retained regardless of the rate.")
 register_rule(
     "MXL-T211", "warning", "untuned-hot-loop",
     "The trainer runs with all-default perf levers while the autotuner "
@@ -557,7 +573,8 @@ def lint_data_iter(data_iter, *, suppress: Sequence[str] = (),
 
 def lint_server(server_or_config, *, suppress: Sequence[str] = (),
                 subject: str = "") -> Report:
-    """Lint a serving configuration for overload-safety (MXL-T214).
+    """Lint a serving configuration for overload-safety and
+    observability (MXL-T214 / MXL-T215 / MXL-T216).
 
     Accepts a :class:`~mxnet_tpu.serving.server.ModelServer` (every model
     is checked) or a single
@@ -641,6 +658,44 @@ def lint_server(server_or_config, *, suppress: Sequence[str] = (),
                          "or MXNET_SERVE_TIER=int8); calibrate first with "
                          "tools/mxquant.py for calibrated ranges — "
                          "docs/quantization.md, 'Serving tier'"))
+        # ---- untraced serving path (MXL-T216): latency objectives are
+        # declared (a default deadline and/or an SLO) but request tracing
+        # is off or sampled at 0 — a breach produces no per-request span
+        # timeline to attribute. Same fires/silent discipline as T214/
+        # T215: a config without objectives, or with tracing on at a
+        # nonzero rate, stays silent; old-style configs without the trace
+        # attributes default to traced and stay silent too.
+        declared = []
+        if float(getattr(cfg, "deadline_ms", 0.0) or 0.0) > 0:
+            declared.append("deadline_ms=%g" % cfg.deadline_ms)
+        if float(getattr(cfg, "slo_p99_ms", 0.0) or 0.0) > 0:
+            declared.append("slo_p99_ms=%g" % cfg.slo_p99_ms)
+        try:
+            from ..base import get_env
+            ring_off = int(get_env("MXNET_TRACE_RING", 512) or 0) <= 0
+        except Exception:
+            ring_off = False
+        untraced = (not bool(getattr(cfg, "trace", True))
+                    or float(getattr(cfg, "trace_sample", 1.0) or 0.0)
+                    <= 0.0
+                    or ring_off)
+        if declared and untraced:
+            how = ("disabled" if not getattr(cfg, "trace", True)
+                   else "disabled process-wide (MXNET_TRACE_RING=0)"
+                   if ring_off else "sampled at 0")
+            report.add(Diagnostic(
+                "MXL-T216",
+                "model %r declares latency objectives (%s) but serves "
+                "with request tracing %s: a deadline/SLO breach leaves "
+                "no per-request span timeline to attribute the miss to "
+                "queue wait vs batch assembly vs device time"
+                % (cfg.name, ", ".join(declared), how),
+                location=loc,
+                hint="enable tracing (ModelConfig(trace=True) / "
+                     "MXNET_SERVE_TRACE=1) with a nonzero "
+                     "MXNET_TRACE_SAMPLE — tail/error traces are always "
+                     "retained; docs/observability.md, 'Request "
+                     "tracing'"))
     return report
 
 

@@ -26,6 +26,10 @@ xcost               XLA cost ledger: per-executable FLOPs/bytes/roofline
 attribution         step-time decomposition + live MFU/device-util gauges
 perfwatch           perf-regression watchdog vs bench baselines
                     (library + ``tools/perfwatch.py`` CLI)
+tracing             end-to-end request tracing: W3C traceparent contexts,
+                    per-request stage-span timelines in a tail-sampled
+                    ring, latency-histogram exemplars, SLO burn-rate
+                    gauges (``tools/mxtrace.py`` pretty-prints the ring)
 tools/mxtop.py      pretty-printer for live or dumped snapshots
                     (``perf`` view: ledger rows + perf gauges)
 ==================  ======================================================
@@ -45,6 +49,7 @@ from . import jit_hooks
 from . import xcost
 from . import attribution
 from . import perfwatch
+from . import tracing
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry, REGISTRY,
                       counter, gauge, histogram, enabled, snapshot,
                       render_json, render_prometheus, write_snapshot,
@@ -54,15 +59,17 @@ from .flight_recorder import FlightRecorder, get_recorder, record_step
 from .xcost import CostLedger, analyze_cost
 from .attribution import StepAttribution
 from .perfwatch import PerfWatch
+from .tracing import TraceContext, Tracer, SLOTracker, get_tracer
 
 __all__ = ["metrics", "catalog", "spans", "flight_recorder", "jit_hooks",
-           "xcost", "attribution", "perfwatch",
+           "xcost", "attribution", "perfwatch", "tracing",
            "Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
            "counter", "gauge", "histogram", "enabled", "snapshot",
            "render_json", "render_prometheus", "write_snapshot",
            "start_exporter", "stop_exporter", "span", "active_spans",
            "FlightRecorder", "get_recorder", "record_step",
-           "CostLedger", "analyze_cost", "StepAttribution", "PerfWatch"]
+           "CostLedger", "analyze_cost", "StepAttribution", "PerfWatch",
+           "TraceContext", "Tracer", "SLOTracker", "get_tracer"]
 
 # jax.monitoring listeners are cheap (no work between compile events) and
 # honor the live MXNET_TELEMETRY switch themselves, so install eagerly —

@@ -231,6 +231,34 @@ SERVE_QUEUE_DEPTH = _m.gauge(
     "Requests queued per model at last admission/dispatch, labeled "
     "model=. Pinned at the queue bound = shedding load.")
 
+# --------------------------------------------------------------- tracing
+TRACE_SPANS = _m.counter(
+    "mxtpu_trace_spans_total",
+    "Request-trace lifecycle spans recorded, labeled stage=admission|"
+    "queue|assembly|dispatch|forward|respond and outcome=ok|shed|"
+    "expired|error (every finished request emits its stage spans here "
+    "regardless of tail-sampling — the sampler only gates ring "
+    "retention, never counting).")
+TRACE_RING_DEPTH = _m.gauge(
+    "mxtpu_trace_ring_depth",
+    "Retained traces in the bounded trace ring (MXNET_TRACE_RING). "
+    "Pinned at capacity = the tail is evicting; read it with "
+    "tools/mxtrace.py before it rolls.")
+TRACE_DROPPED = _m.counter(
+    "mxtpu_trace_dropped_total",
+    "Finished traces not retained in the ring, labeled reason="
+    "sampled_out (boring bulk below MXNET_TRACE_SAMPLE — error/shed/"
+    "expired/violating/slow-tail traces are never sampled out) | "
+    "evicted (ring at capacity, oldest rolled off).")
+SLO_BURN = _m.gauge(
+    "mxtpu_slo_burn_rate",
+    "Rolling SLO error-budget burn rate, labeled model= and window="
+    "fast|slow: the window's SLO-bad fraction divided by the error "
+    "budget (1 - availability target). 1.0 = consuming budget exactly "
+    "as fast as the target allows; crossing "
+    "MXNET_SERVE_SLO_BURN_THRESHOLD on the fast window warns and bumps "
+    "mxtpu_perf_regressions_total{metric='slo_burn_rate'}.")
+
 # ------------------------------------------------------------ quantization
 QUANT_CALIB_BATCHES = _m.counter(
     "mxtpu_quant_calib_batches_total",

@@ -70,6 +70,16 @@ class FlightRecorder:
             return
         rec = {"step": int(step), "time": time.time(), "loss": loss,
                "step_ms": step_ms, "spans": list(_spans.active_spans())}
+        try:
+            # cross-link to the request-trace ring: a record made under
+            # tracing.use(ctx) carries the trace_id, so a watchdog dump
+            # resolves straight to a timeline in tools/mxtrace.py
+            from . import tracing as _tracing
+            tid = _tracing.current_trace_id()
+            if tid:
+                rec["trace_id"] = tid
+        except Exception:
+            pass
         if extra:
             rec.update(extra)
         with self._lock:

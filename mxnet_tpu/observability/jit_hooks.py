@@ -20,11 +20,14 @@ itself costs nothing between compiles.
 from __future__ import annotations
 
 import threading
+import time
+from collections import deque
 
 from . import metrics as _metrics
 
-__all__ = ["install", "installed", "last_compile_ms", "JIT_TRACES",
-           "JIT_COMPILES", "JIT_COMPILE_MS", "JIT_CACHE_HITS"]
+__all__ = ["install", "installed", "last_compile_ms",
+           "recent_compile_events", "JIT_TRACES", "JIT_COMPILES",
+           "JIT_COMPILE_MS", "JIT_CACHE_HITS"]
 
 JIT_TRACES = _metrics.counter(
     "mxtpu_jit_traces_total",
@@ -47,6 +50,11 @@ _lock = threading.Lock()
 _installed = False
 _last_compile_ms = None
 
+# timestamped ring of recent trace/compile events (perf_counter seconds):
+# the shared-clock lane Tracer.chrome_trace merges next to serving spans,
+# so the compile that delayed a request lines up with its queue span
+_COMPILE_EVENTS: deque = deque(maxlen=64)
+
 
 def last_compile_ms():
     """Wall time of the most recent XLA backend compile this process
@@ -55,16 +63,29 @@ def last_compile_ms():
     return _last_compile_ms
 
 
+def recent_compile_events():
+    """Recent jaxpr-trace / backend-compile events as ``{"event",
+    "t0", "dur_s"}`` dicts, ``t0`` in ``time.perf_counter`` seconds —
+    the clock the profiler and the trace ring export against."""
+    return list(_COMPILE_EVENTS)
+
+
 def _on_duration(event: str, duration_secs: float, **kwargs) -> None:
     if not _metrics.enabled():
         return
     if event == _TRACE_EVENT:
         JIT_TRACES.inc()
+        _COMPILE_EVENTS.append({"event": "jaxpr_trace",
+                                "t0": time.perf_counter() - duration_secs,
+                                "dur_s": duration_secs})
     elif event == _COMPILE_EVENT:
         global _last_compile_ms
         _last_compile_ms = duration_secs * 1000.0
         JIT_COMPILES.inc()
         JIT_COMPILE_MS.observe(duration_secs * 1000.0)
+        _COMPILE_EVENTS.append({"event": "backend_compile",
+                                "t0": time.perf_counter() - duration_secs,
+                                "dur_s": duration_secs})
 
 
 def _on_event(event: str, **kwargs) -> None:

@@ -140,7 +140,13 @@ class Histogram(_Metric):
             raise MXNetError(f"histogram {name!r} needs at least one bucket")
         self.buckets = bs
 
-    def observe(self, value: float, **labels) -> None:
+    def observe(self, value: float, exemplar: Optional[str] = None,
+                **labels) -> None:
+        """Record one observation. ``exemplar`` optionally attaches a
+        trace_id to the bucket the value lands in (OpenMetrics-style
+        exemplars: a bad percentile links to a concrete request
+        timeline in the trace ring — docs/observability.md,
+        'Request tracing')."""
         key = _label_key(labels)
         value = float(value)
         with self._lock:
@@ -159,16 +165,44 @@ class Histogram(_Metric):
             st["count"] += 1
             if value > st["max"]:
                 st["max"] = value
+            if exemplar is not None:
+                st.setdefault("exemplars", {})[i] = {
+                    "value": value, "trace_id": str(exemplar),
+                    "time": time.time()}
+
+    def _bucket_label(self, i: int) -> str:
+        if i >= len(self.buckets):
+            return "+Inf"
+        b = self.buckets[i]
+        return repr(b) if b != int(b) else str(int(b))
 
     def _series_dict(self, st) -> Dict[str, Any]:
-        # cumulative counts per upper bound (prometheus 'le' semantics)
+        # cumulative counts per upper bound (prometheus 'le' semantics);
+        # keys come from _bucket_label so they always match the
+        # exemplars dict a reader correlates them with
         cum, total = {}, 0
-        for b, c in zip(self.buckets, st["counts"]):
+        for i, c in enumerate(st["counts"][:-1]):
             total += c
-            cum[repr(b) if b != int(b) else str(int(b))] = total
+            cum[self._bucket_label(i)] = total
         cum["+Inf"] = total + st["counts"][-1]
-        return {"sum": st["sum"], "count": st["count"],
-                "max": (st["max"] if st["count"] else 0.0), "buckets": cum}
+        out = {"sum": st["sum"], "count": st["count"],
+               "max": (st["max"] if st["count"] else 0.0), "buckets": cum}
+        ex = st.get("exemplars")
+        if ex:
+            out["exemplars"] = {self._bucket_label(i): dict(e)
+                                for i, e in sorted(ex.items())}
+        return out
+
+    def exemplars(self, **labels) -> Dict[str, Dict[str, Any]]:
+        """Per-bucket exemplars of one label series: ``{bucket_le:
+        {"value", "trace_id", "time"}}`` (the newest observation that
+        carried an exemplar per bucket)."""
+        with self._lock:
+            st = self._series.get(_label_key(labels))
+            if not st or "exemplars" not in st:
+                return {}
+            return {self._bucket_label(i): dict(e)
+                    for i, e in sorted(st["exemplars"].items())}
 
     def count(self, **labels) -> int:
         with self._lock:

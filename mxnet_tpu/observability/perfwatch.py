@@ -57,6 +57,8 @@ METRIC_DIRECTIONS: Dict[str, int] = {
     "f32_ms": -1,
     "int8_vs_f32": +1,         # int8 speedup eroding is a regression
     "int8_acc": +1,            # and so is int8 accuracy drifting down
+    "slo_burn_rate": -1,       # serving SLO error-budget burn (max over
+                               # model/window series of mxtpu_slo_burn_rate)
 }
 
 DEFAULT_THRESHOLD_PCT = 10.0
@@ -97,6 +99,15 @@ def normalize(doc: Any, source: str = "") -> Optional[Dict[str, Any]]:
             vals["mfu"] = float(mfu)
         if sps is not None:
             vals["samples_per_sec"] = float(sps)
+        # SLO burn: worst series wins (labeled model=/window=, so the
+        # unlabeled-gauge helper above never sees it)
+        burn = None
+        for s in (fams.get("mxtpu_slo_burn_rate") or {}).get("series", []):
+            v = s.get("value")
+            if v is not None:
+                burn = float(v) if burn is None else max(burn, float(v))
+        if burn is not None:
+            vals["slo_burn_rate"] = burn
         return {"kind": "snapshot", "source": source, "metrics": vals}
     if "metric" in doc and "value" in doc:
         vals = {"throughput": float(doc["value"])}
@@ -276,6 +287,13 @@ class PerfWatch:
             out["mfu"] = float(mfu)
         if sps is not None:
             out["samples_per_sec"] = float(sps)
+        burn = None
+        for s in _catalog.SLO_BURN.series():
+            v = s.get("value")
+            if v is not None:
+                burn = float(v) if burn is None else max(burn, float(v))
+        if burn is not None:
+            out["slo_burn_rate"] = burn
         return out
 
     def check(self, current: Optional[Dict[str, Any]] = None,

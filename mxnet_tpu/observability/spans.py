@@ -89,8 +89,20 @@ class span:
             SPAN_MS.observe(dt * 1000.0, span=self.name, **self.labels)
         if self._prof:
             from .. import profiler
+            args = dict(self.labels) if self.labels else {}
+            try:
+                # merged-timeline cross-link: a span opened under
+                # tracing.use(ctx) carries its trace_id into the
+                # chrome-trace stream (never into metric labels — a
+                # per-trace label would explode series cardinality)
+                from . import tracing as _tracing
+                tid = _tracing.current_trace_id()
+                if tid:
+                    args["trace_id"] = tid
+            except Exception:
+                pass
             profiler.record_event(self.name, self.category, self._us0,
-                                  dt * 1e6, self.labels or None)
+                                  dt * 1e6, args or None)
         return False
 
     def __call__(self, fn):

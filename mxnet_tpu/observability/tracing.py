@@ -1,0 +1,644 @@
+"""End-to-end request tracing — span timelines from the HTTP edge to XLA
+dispatch, with tail-sampling and SLO burn-rate guarding.
+
+The PR-3/PR-6 observability layer answers "how fast is the step" and the
+serving counters answer "how many requests"; this module answers *"where
+did THIS slow request spend its 87 ms"*. A :class:`TraceContext`
+(W3C ``traceparent`` parse/emit) enters at the HTTP edge
+(``ServingEndpoints``), rides the queued request through the batching
+model server, and every lifecycle stage — admission, queue wait, batch
+assembly, dispatch, executor forward, respond — lands as a child span
+with outcome tags, so one request's timeline reconstructs exactly where
+its deadline budget went, including which batchmates it was fused with
+(the shared batch-span id).
+
+Finished traces land in a bounded, thread-safe ring (:class:`Tracer`)
+under **tail-sampling**: error/shed/expired and deadline-violating
+traces are ALWAYS retained, the slowest tail (>= the rolling p99 of the
+model's recent latencies) is always retained, and the boring bulk is
+kept at ``MXNET_TRACE_SAMPLE``. Two export paths:
+
+- **chrome-trace** (:meth:`Tracer.chrome_trace`): serving spans,
+  ``jit_hooks`` compile events and the live profiler stream merged on
+  ONE clock (the profiler's perf-counter zero), so a serving span and
+  the XLA compile that delayed it line up in ``chrome://tracing``;
+- **exemplars**: ``mxtpu_serve_latency_ms`` observations carry the
+  trace_id of a ring-retained request, so a bad percentile links
+  directly to a concrete timeline (``Histogram.exemplars``).
+
+On top of that, the SLO layer (:class:`SLOTracker`): per-model
+objectives (``MXNET_SERVE_SLO_P99_MS`` latency target + an availability
+target) evaluated as rolling fast/slow **burn rates** — the fraction of
+the error budget being consumed per unit time — published as
+``mxtpu_slo_burn_rate{model,window}`` gauges; crossing the burn
+threshold bumps the perfwatch regression counter
+(``mxtpu_perf_regressions_total{metric="slo_burn_rate"}``) and warns,
+never kills.
+
+Training shares the spine for free: :func:`use` installs a thread-local
+context, and flight-recorder step records (and the watchdog's crash
+dump) embed the active ``trace_id`` so a hung step cross-links to the
+trace ring.
+
+Host-side only by construction: nothing here enters the XLA trace, and
+the compiled forward's HLO is bitwise identical with tracing on or off
+(guarded by ``tests/test_tracing.py``).
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import random
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from ..base import MXNetError, get_env, logger, register_config
+from . import catalog as _catalog
+from . import metrics as _metrics
+
+__all__ = ["TraceContext", "RequestTrace", "Tracer", "SLOTracker",
+           "get_tracer", "set_tracer", "current", "current_trace_id",
+           "use", "new_span_id", "STAGES"]
+
+register_config("MXNET_TRACE_RING", 512, int,
+                "Trace-ring capacity: finished request traces kept for "
+                "tools/mxtrace.py and exemplar resolution. 0 disables "
+                "request tracing entirely (mxlint MXL-T216 flags a server "
+                "with declared SLOs/deadlines serving untraced).")
+register_config("MXNET_TRACE_SAMPLE", 0.05, float,
+                "Tail-sampling keep probability for BORING traces (ok, "
+                "within deadline, not in the slow tail). Error/shed/"
+                "expired/deadline-violating traces and the rolling-p99 "
+                "slow tail are always retained regardless of this rate.")
+
+# request lifecycle stages, in timeline order
+STAGES = ("admission", "queue", "assembly", "dispatch", "forward", "respond")
+
+# monotonic->perf_counter offset, measured once: server stamps use
+# time.monotonic, the profiler's clock zero is a perf_counter reading —
+# on Linux both read CLOCK_MONOTONIC so the offset is ~0, but the export
+# must not silently assume it
+_MONO_TO_PERF = time.perf_counter() - time.monotonic()
+
+
+def _new_id(nbytes: int) -> str:
+    return os.urandom(nbytes).hex()
+
+
+def new_span_id() -> str:
+    """A fresh 8-byte span id (e.g. the per-dispatch batch-span id)."""
+    return _new_id(8)
+
+
+class TraceContext:
+    """trace_id/span_id pair with W3C ``traceparent`` parse/emit.
+
+    ``traceparent: 00-<32 hex trace-id>-<16 hex span-id>-<2 hex flags>``
+    (flag bit 0 = sampled). :meth:`parse` returns None on any malformed
+    header — an edge must degrade to a fresh context, never 500.
+    """
+
+    __slots__ = ("trace_id", "span_id", "sampled")
+
+    def __init__(self, trace_id: Optional[str] = None,
+                 span_id: Optional[str] = None, sampled: bool = True):
+        self.trace_id = (trace_id or _new_id(16)).lower()
+        self.span_id = (span_id or _new_id(8)).lower()
+        self.sampled = bool(sampled)
+
+    @classmethod
+    def new(cls) -> "TraceContext":
+        return cls()
+
+    @classmethod
+    def parse(cls, traceparent) -> Optional["TraceContext"]:
+        if not traceparent or not isinstance(traceparent, str):
+            return None
+        parts = traceparent.strip().lower().split("-")
+        if len(parts) != 4:
+            return None
+        ver, tid, sid, flags = parts
+        if len(ver) != 2 or len(tid) != 32 or len(sid) != 16 \
+                or len(flags) != 2:
+            return None
+        try:
+            int(ver, 16)
+            int(tid, 16)
+            int(sid, 16)
+            fl = int(flags, 16)
+        except ValueError:
+            return None
+        # version ff is forbidden; all-zero ids are invalid per the spec
+        if ver == "ff" or set(tid) == {"0"} or set(sid) == {"0"}:
+            return None
+        return cls(tid, sid, bool(fl & 0x01))
+
+    def to_traceparent(self) -> str:
+        return "00-%s-%s-%02x" % (self.trace_id, self.span_id,
+                                  0x01 if self.sampled else 0x00)
+
+    def child(self) -> "TraceContext":
+        """Same trace, fresh span id — the server-side hop of an
+        inbound context."""
+        return TraceContext(self.trace_id, _new_id(8), self.sampled)
+
+    def __repr__(self):
+        return "TraceContext(%s)" % self.to_traceparent()
+
+
+# ---- thread-local active context (the training/flight-recorder spine) ----
+_tls = threading.local()
+
+
+def current() -> Optional[TraceContext]:
+    """The context installed on THIS thread by :func:`use`, or None."""
+    return getattr(_tls, "ctx", None)
+
+
+def current_trace_id() -> Optional[str]:
+    c = current()
+    return c.trace_id if c is not None else None
+
+
+@contextlib.contextmanager
+def use(ctx: TraceContext):
+    """Install ``ctx`` as the thread's active context for the block:
+    flight-recorder records and profiler-mirrored spans inside it embed
+    the trace_id (the watchdog-dump → trace-ring cross-link)."""
+    prev = getattr(_tls, "ctx", None)
+    _tls.ctx = ctx
+    try:
+        yield ctx
+    finally:
+        _tls.ctx = prev
+
+
+class RequestTrace:
+    """One request's span timeline. Stages are appended by the serving
+    path (stamps are ``time.monotonic`` seconds); :meth:`to_dict`
+    renders them relative to the submit instant."""
+
+    __slots__ = ("ctx", "model", "submitted_at", "wall_time", "spans",
+                 "outcome", "reason", "latency_ms", "violated",
+                 "batch_span_id", "batch_size", "deadline_ms", "sample",
+                 "kept", "keep_reason")
+
+    def __init__(self, model: str, ctx: Optional[TraceContext] = None,
+                 deadline_ms: Optional[float] = None,
+                 submitted_at: Optional[float] = None,
+                 sample: Optional[float] = None):
+        self.ctx = ctx if ctx is not None else TraceContext.new()
+        self.model = str(model)
+        self.submitted_at = (time.monotonic() if submitted_at is None
+                             else float(submitted_at))
+        self.wall_time = time.time()
+        self.spans: List[Dict[str, Any]] = []
+        self.outcome: Optional[str] = None
+        self.reason: Optional[str] = None
+        self.latency_ms: Optional[float] = None
+        self.violated = False
+        self.batch_span_id: Optional[str] = None
+        self.batch_size: Optional[int] = None
+        self.deadline_ms = deadline_ms
+        self.sample = sample
+        self.kept = False
+        self.keep_reason: Optional[str] = None
+
+    @property
+    def trace_id(self) -> str:
+        return self.ctx.trace_id
+
+    @property
+    def span_id(self) -> str:
+        return self.ctx.span_id
+
+    def span(self, stage: str, t0: float, t1: float, **tags) -> None:
+        """Record one stage span (monotonic seconds; t1 clamped >= t0)."""
+        self.spans.append({"stage": str(stage), "t0": float(t0),
+                           "t1": max(float(t0), float(t1)),
+                           "tags": dict(tags) if tags else {}})
+
+    def stage_ms(self) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for s in self.spans:
+            out[s["stage"]] = out.get(s["stage"], 0.0) \
+                + (s["t1"] - s["t0"]) * 1e3
+        return out
+
+    def to_dict(self) -> Dict[str, Any]:
+        base = self.submitted_at
+        return {
+            "trace_id": self.trace_id, "span_id": self.span_id,
+            "model": self.model, "outcome": self.outcome,
+            "reason": self.reason,
+            "latency_ms": (round(self.latency_ms, 4)
+                           if self.latency_ms is not None else None),
+            "violated": bool(self.violated),
+            "deadline_ms": self.deadline_ms,
+            "batch_span_id": self.batch_span_id,
+            "batch_size": self.batch_size,
+            "time": self.wall_time,
+            "keep_reason": self.keep_reason,
+            "spans": [{"stage": s["stage"],
+                       "t0_ms": round((s["t0"] - base) * 1e3, 4),
+                       "dur_ms": round((s["t1"] - s["t0"]) * 1e3, 4),
+                       "tags": s["tags"]} for s in self.spans],
+        }
+
+
+_TAIL_MIN_SAMPLES = 20          # latencies needed before the p99 tail arms
+_TAIL_WINDOW = 512              # per-model rolling latency window
+_TAIL_REFRESH = 32              # inserts between p99-threshold recomputes
+
+
+class Tracer:
+    """Bounded thread-safe ring of finished request traces with
+    tail-sampling. One process-wide default (:func:`get_tracer`) is
+    shared by every :class:`~mxnet_tpu.serving.server.ModelServer`
+    unless one is passed explicitly."""
+
+    def __init__(self, capacity: Optional[int] = None,
+                 sample: Optional[float] = None):
+        self.capacity = int(get_env("MXNET_TRACE_RING", 512)
+                            if capacity is None else capacity)
+        self.sample = float(get_env("MXNET_TRACE_SAMPLE", 0.05)
+                            if sample is None else sample)
+        if not (0.0 <= self.sample <= 1.0):
+            raise MXNetError("trace sample rate must be in [0, 1], got %r"
+                             % (self.sample,))
+        self._ring: deque = deque(maxlen=max(1, self.capacity))
+        self._lock = threading.Lock()
+        self._lat: Dict[str, deque] = {}    # model -> recent ok latencies
+        self._lat_n: Dict[str, int] = {}    # appends per model
+        self._tail_thr: Dict[str, float] = {}  # cached ~p99 threshold
+        self._rng = random.Random()
+
+    def enabled(self) -> bool:
+        return self.capacity > 0 and _metrics.enabled()
+
+    # ------------------------------------------------------------ lifecycle
+    def start_request(self, model: str, ctx: Optional[TraceContext] = None,
+                      deadline_ms: Optional[float] = None,
+                      submitted_at: Optional[float] = None,
+                      sample: Optional[float] = None
+                      ) -> Optional[RequestTrace]:
+        if not self.enabled():
+            return None
+        return RequestTrace(model, ctx=ctx, deadline_ms=deadline_ms,
+                            submitted_at=submitted_at, sample=sample)
+
+    def tail_latency_ms(self, model: str) -> Optional[float]:
+        """Rolling ~p99 of the model's recent OK latencies (None until
+        the window has enough samples to call a tail a tail). The
+        threshold is a cache refreshed every ``_TAIL_REFRESH`` inserts —
+        finishing a request never pays a full-window sort."""
+        with self._lock:
+            window = self._lat.get(model)
+            if not window or len(window) < _TAIL_MIN_SAMPLES:
+                return None
+            return self._tail_thr.get(model)
+
+    def _note_latency_locked(self, model: str, latency_ms: float) -> None:
+        window = self._lat.get(model)
+        if window is None:
+            window = self._lat[model] = deque(maxlen=_TAIL_WINDOW)
+        window.append(float(latency_ms))
+        n = self._lat_n[model] = self._lat_n.get(model, 0) + 1
+        if len(window) >= _TAIL_MIN_SAMPLES and (
+                model not in self._tail_thr or n % _TAIL_REFRESH == 0):
+            arr = sorted(window)
+            self._tail_thr[model] = arr[min(len(arr) - 1,
+                                            int(len(arr) * 0.99))]
+
+    def _should_keep(self, rt: RequestTrace) -> Optional[str]:
+        """The tail-sampling policy: the reason this trace is retained,
+        or None to drop it. Order matters — forced retention first."""
+        if rt.outcome != "ok":
+            return rt.outcome           # error/shed/expired: always kept
+        if rt.violated:
+            return "violation"
+        tail = self.tail_latency_ms(rt.model)
+        if tail is not None and rt.latency_ms is not None \
+                and rt.latency_ms >= tail:
+            return "slow"
+        rate = self.sample if rt.sample is None else rt.sample
+        if rate > 0.0 and self._rng.random() < rate:
+            return "sampled"
+        return None
+
+    def finish(self, rt: Optional[RequestTrace], outcome: str,
+               latency_ms: Optional[float] = None, violated: bool = False,
+               reason: Optional[str] = None) -> bool:
+        """Seal a request trace: count spans, mirror into a recording
+        profiler session, tail-sample into the ring. Returns True when
+        the trace was retained (the exemplar gate)."""
+        if rt is None:
+            return False
+        rt.outcome = str(outcome)
+        rt.reason = reason
+        rt.latency_ms = latency_ms
+        rt.violated = bool(violated)
+        if _metrics.enabled():
+            for s in rt.spans:
+                _catalog.TRACE_SPANS.inc(stage=s["stage"], outcome=rt.outcome)
+        self._mirror_profiler(rt)
+        why = self._should_keep(rt)
+        evicted = False
+        with self._lock:
+            if outcome == "ok" and latency_ms is not None:
+                self._note_latency_locked(rt.model, latency_ms)
+            if why is not None:
+                rt.kept, rt.keep_reason = True, why
+                evicted = len(self._ring) == self._ring.maxlen
+                self._ring.append(rt)
+            depth = len(self._ring)
+        if _metrics.enabled():
+            if why is None:
+                _catalog.TRACE_DROPPED.inc(reason="sampled_out")
+            elif evicted:
+                _catalog.TRACE_DROPPED.inc(reason="evicted")
+            _catalog.TRACE_RING_DEPTH.set(depth)
+        return why is not None
+
+    def _mirror_profiler(self, rt: RequestTrace) -> None:
+        """When a profiler session is recording, emit every stage span
+        into its chrome-trace stream (same us clock as every other
+        profiler event) — the live half of the merged-timeline story."""
+        try:
+            from .. import profiler
+            if not profiler.recording():
+                return
+            zero = profiler._prof.t0
+            for s in rt.spans:
+                t0_us = (s["t0"] + _MONO_TO_PERF - zero) * 1e6
+                args = {"trace_id": rt.trace_id, "model": rt.model}
+                if s["tags"]:
+                    args.update(s["tags"])
+                profiler.record_event("serve:%s" % s["stage"], "serving",
+                                      t0_us, (s["t1"] - s["t0"]) * 1e6,
+                                      args)
+        except Exception:       # pragma: no cover - never fail the server
+            pass
+
+    # -------------------------------------------------------------- readout
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    @property
+    def depth(self) -> int:
+        return len(self)
+
+    def traces(self, model: Optional[str] = None,
+               outcome: Optional[str] = None) -> List[RequestTrace]:
+        """Retained traces, oldest first, optionally filtered."""
+        with self._lock:
+            out = list(self._ring)
+        if model is not None:
+            out = [t for t in out if t.model == model]
+        if outcome is not None:
+            out = [t for t in out if t.outcome == outcome]
+        return out
+
+    def get(self, trace_id: str) -> Optional[RequestTrace]:
+        """Resolve one trace_id (newest wins) — the exemplar lookup."""
+        tid = str(trace_id).lower()
+        with self._lock:
+            for t in reversed(self._ring):
+                if t.trace_id == tid:
+                    return t
+        return None
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._lat.clear()
+            self._lat_n.clear()
+            self._tail_thr.clear()
+        if _metrics.enabled():
+            _catalog.TRACE_RING_DEPTH.set(0)
+
+    # --------------------------------------------------------------- export
+    def to_dict(self) -> Dict[str, Any]:
+        return {"version": 1, "kind": "trace_ring", "time": time.time(),
+                "pid": os.getpid(),
+                "traces": [t.to_dict() for t in self.traces()]}
+
+    def write_dump(self, path: str) -> str:
+        """Write the ring as a JSON artifact (atomic rename) —
+        the file ``tools/mxtrace.py`` pretty-prints."""
+        doc = self.to_dict()
+        tmp = "%s.tmp.%d.%d" % (path, os.getpid(), threading.get_ident())
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+        return path
+
+    def chrome_trace(self, include_profiler: bool = True,
+                     include_compiles: bool = True) -> Dict[str, Any]:
+        """Chrome-trace JSON: serving spans + jit compile events (+ the
+        live profiler stream) on ONE clock — the profiler's perf-counter
+        zero — so a serving span and the XLA compile that delayed it
+        line up in chrome://tracing."""
+        from .. import profiler
+        zero = profiler._prof.t0
+        events: List[Dict[str, Any]] = []
+        for rt in self.traces():
+            tid = int(rt.trace_id[:8], 16) % (1 << 31)
+            for s in rt.spans:
+                args = {"trace_id": rt.trace_id, "model": rt.model,
+                        "outcome": rt.outcome}
+                if s["tags"]:
+                    args.update(s["tags"])
+                events.append({
+                    "name": s["stage"], "cat": "serving", "ph": "X",
+                    "ts": (s["t0"] + _MONO_TO_PERF - zero) * 1e6,
+                    "dur": (s["t1"] - s["t0"]) * 1e6,
+                    "pid": os.getpid(), "tid": tid, "args": args})
+        if include_compiles:
+            from . import jit_hooks
+            for ev in jit_hooks.recent_compile_events():
+                events.append({
+                    "name": ev["event"], "cat": "jit", "ph": "X",
+                    "ts": (ev["t0"] - zero) * 1e6,
+                    "dur": ev["dur_s"] * 1e6,
+                    "pid": os.getpid(), "tid": 0,
+                    "args": {"lane": "jit-compile"}})
+        if include_profiler:
+            with profiler._lock:
+                events.extend(dict(e) for e in profiler._prof.events)
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+# ---- SLO burn-rate guarding -------------------------------------------------
+
+register_config("MXNET_SERVE_SLO_P99_MS", 0.0, float,
+                "Per-model serving SLO: the p99 latency objective. A "
+                "request is SLO-good when it completes ok within this "
+                "budget. 0 = no SLO declared (no burn-rate gauges).")
+register_config("MXNET_SERVE_SLO_AVAILABILITY", 0.999, float,
+                "Per-model serving SLO availability target: the fraction "
+                "of requests that must be SLO-good; 1-target is the error "
+                "budget the burn rate is measured against.")
+register_config("MXNET_SERVE_SLO_BURN_THRESHOLD", 2.0, float,
+                "Fast-window burn rate above which the SLO guard fires "
+                "(warn + mxtpu_perf_regressions_total{metric="
+                "'slo_burn_rate'}). Burn 1.0 = consuming the error budget "
+                "exactly as fast as the availability target allows.")
+
+_SLO_MIN_EVENTS = 20            # events before the guard may fire
+
+
+class SLOTracker:
+    """Rolling fast/slow burn rates for one model's serving SLO.
+
+    An event is *good* when it completed ``ok`` within the p99 objective;
+    ``burn = bad_fraction / (1 - availability)`` over each window — burn
+    1.0 means the error budget is being consumed exactly at the rate the
+    availability target allows, burn N means N× too fast. Crossing the
+    threshold on the fast window is edge-triggered: one warning + one
+    ``mxtpu_perf_regressions_total{metric="slo_burn_rate"}`` bump per
+    excursion, re-armed when the burn falls back under.
+    """
+
+    def __init__(self, model: str, p99_ms: float,
+                 availability: Optional[float] = None,
+                 fast_window_s: float = 60.0, slow_window_s: float = 600.0,
+                 burn_threshold: Optional[float] = None,
+                 clock=time.monotonic):
+        self.model = str(model)
+        self.p99_ms = float(p99_ms)
+        self.availability = float(
+            get_env("MXNET_SERVE_SLO_AVAILABILITY", 0.999)
+            if availability is None else availability)
+        if not (0.0 < self.availability < 1.0):
+            raise MXNetError("SLO availability target must be in (0, 1), "
+                             "got %r" % (self.availability,))
+        self.budget = 1.0 - self.availability
+        if slow_window_s < fast_window_s:
+            raise MXNetError("slow_window_s must be >= fast_window_s")
+        self.windows = {"fast": float(fast_window_s),
+                        "slow": float(slow_window_s)}
+        self.burn_threshold = float(
+            get_env("MXNET_SERVE_SLO_BURN_THRESHOLD", 2.0)
+            if burn_threshold is None else burn_threshold)
+        self._clock = clock
+        # incremental sliding windows: per window a deque of (t, good)
+        # plus a running bad count, pruned from the left on every touch —
+        # record() stays O(1) amortized at any request rate, and the
+        # hard cap bounds memory if the clock stalls
+        self._win: Dict[str, deque] = {n: deque() for n in self.windows}
+        self._bad: Dict[str, int] = {n: 0 for n in self.windows}
+        self._lock = threading.Lock()
+        self.breaches: List[Dict[str, Any]] = []
+        self._over = False                  # edge trigger state
+
+    _MAX_EVENTS = 100_000                  # per-window hard cap
+
+    def good(self, outcome: str, latency_ms: Optional[float]) -> bool:
+        if outcome != "ok":
+            return False
+        if self.p99_ms > 0 and latency_ms is not None \
+                and latency_ms > self.p99_ms:
+            return False
+        return True
+
+    def _prune_locked(self, name: str, now: float) -> None:
+        win, width = self._win[name], self.windows[name]
+        horizon = now - width
+        while win and (win[0][0] < horizon
+                       or len(win) > self._MAX_EVENTS):
+            _, g = win.popleft()
+            if not g:
+                self._bad[name] -= 1
+
+    def record(self, outcome: str,
+               latency_ms: Optional[float] = None) -> None:
+        t = self._clock()
+        g = self.good(outcome, latency_ms)
+        with self._lock:
+            for name in self.windows:
+                self._win[name].append((t, g))
+                if not g:
+                    self._bad[name] += 1
+                self._prune_locked(name, t)
+        rates = self.burn_rates(publish=True)
+        self._check(rates)
+
+    def burn_rates(self, publish: bool = False) -> Dict[str, float]:
+        t = self._clock()
+        out: Dict[str, float] = {}
+        with self._lock:
+            for name in self.windows:
+                self._prune_locked(name, t)
+                n = len(self._win[name])
+                bad_frac = (self._bad[name] / float(n)) if n else 0.0
+                out[name] = bad_frac / max(1e-9, self.budget)
+        if publish and _metrics.enabled():
+            for name, burn in out.items():
+                _catalog.SLO_BURN.set(round(burn, 4), model=self.model,
+                                      window=name)
+        return out
+
+    def _check(self, rates: Dict[str, float]) -> None:
+        fast = rates.get("fast", 0.0)
+        fire = False
+        with self._lock:
+            # the edge-trigger state flips under the lock: record() runs
+            # concurrently from the worker thread (_complete) and caller
+            # threads (admission sheds, HTTP handlers) — an unlocked
+            # read-then-set would double-fire one excursion
+            if len(self._win["slow"]) < _SLO_MIN_EVENTS:
+                return
+            if fast > self.burn_threshold:
+                if not self._over:
+                    self._over = True
+                    fire = True
+                    self.breaches.append(
+                        {"model": self.model, "burn": round(fast, 3),
+                         "threshold": self.burn_threshold,
+                         "p99_ms": self.p99_ms,
+                         "availability": self.availability,
+                         "time": time.time()})
+            else:
+                self._over = False
+        if fire:
+            if _metrics.enabled():
+                _catalog.PERF_REGRESSIONS.inc(metric="slo_burn_rate")
+            logger.warning(
+                "SLO burn for model %r: fast-window burn rate %.2f "
+                "exceeds threshold %.2f (p99 objective %.1f ms, "
+                "availability target %.4f) — the error budget is "
+                "being consumed %.1fx faster than the target allows; "
+                "see tools/mxtrace.py for retained tail traces",
+                self.model, fast, self.burn_threshold, self.p99_ms,
+                self.availability, fast)
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {"p99_ms": self.p99_ms, "availability": self.availability,
+                "burn": self.burn_rates(), "breaches": len(self.breaches),
+                "burn_threshold": self.burn_threshold}
+
+
+# ---- process-wide default tracer -------------------------------------------
+_default_lock = threading.Lock()
+_default: Optional[Tracer] = None
+
+
+def get_tracer() -> Tracer:
+    """The process-wide trace ring (created on first use)."""
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = Tracer()
+        return _default
+
+
+def set_tracer(tracer: Optional[Tracer]) -> None:
+    """Swap the process-wide tracer (tests install a fresh ring)."""
+    global _default
+    with _default_lock:
+        _default = tracer

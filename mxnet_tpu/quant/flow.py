@@ -327,7 +327,9 @@ def quantize_model_config(cfg, *, table: Optional[CalibTable] = None,
         deadline_ms=cfg.deadline_ms, max_wait_ms=cfg.max_wait_ms,
         retries=cfg.retries, breaker_threshold=cfg.breaker_threshold,
         breaker_cooldown_s=cfg.breaker_cooldown_s, dev_type=cfg.dev_type,
-        dev_id=cfg.dev_id, output_keys=cfg.output_keys, tier="int8")
+        dev_id=cfg.dev_id, output_keys=cfg.output_keys, tier="int8",
+        trace=cfg.trace, trace_sample=cfg.trace_sample,
+        slo_p99_ms=cfg.slo_p99_ms, slo_availability=cfg.slo_availability)
     qcfg.bucket_provenance = cfg.bucket_provenance
     return qcfg
 

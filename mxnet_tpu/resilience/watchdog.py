@@ -110,7 +110,20 @@ class Watchdog:
                 sys.stderr.write(
                     "--- flight recorder tail (newest last) ---\n"
                     + "\n".join(lines) + "\n")
-            path = _flight.dump(reason="watchdog_timeout: %s" % label)
+            # cross-link the dump to the request-trace ring: the newest
+            # retained trace_ids resolve in tools/mxtrace.py, tying the
+            # hang to the requests in flight around it
+            extra = None
+            try:
+                from ..observability import tracing as _tracing
+                tail = [t.trace_id
+                        for t in _tracing.get_tracer().traces()[-8:]]
+                if tail:
+                    extra = {"trace_ring_tail": tail}
+            except Exception:
+                extra = None
+            path = _flight.dump(reason="watchdog_timeout: %s" % label,
+                                extra=extra)
             if path:
                 if _metrics.enabled():
                     _telemetry.FLIGHT_DUMPS.inc(reason="watchdog_timeout")

@@ -38,9 +38,9 @@ import numpy as np
 
 from ..resilience.chaos import ChaosError
 
-__all__ = ["slow_client", "request_storm", "paced_run", "slow_executor",
-           "executor_fault", "poison_request", "poison_payload",
-           "POISON_SENTINEL"]
+__all__ = ["slow_client", "request_storm", "paced_run", "trace_evidence",
+           "slow_executor", "executor_fault", "poison_request",
+           "poison_payload", "POISON_SENTINEL"]
 
 # a value a legitimate float32 payload never carries (finite, but at the
 # edge of range) — the poison marker the patched executor looks for
@@ -64,7 +64,7 @@ def slow_client(server, delay: float):
     orig = server.submit
     state = {"delayed": 0}
 
-    def submit(model, data, deadline_ms=None, deadline_at=None):
+    def submit(model, data, deadline_ms=None, deadline_at=None, trace=None):
         if deadline_at is None:
             cfg = server.config(model)
             dl_ms = cfg.deadline_ms if deadline_ms is None \
@@ -72,7 +72,7 @@ def slow_client(server, delay: float):
             deadline_at = (time.monotonic() + dl_ms / 1e3) if dl_ms else None
         state["delayed"] += 1
         time.sleep(delay)
-        return orig(model, data, deadline_at=deadline_at)
+        return orig(model, data, deadline_at=deadline_at, trace=trace)
 
     server.submit = submit
     try:
@@ -118,6 +118,20 @@ def paced_run(fire: Callable[[], None], *, qps: float, duration_s: float,
         t.join()
 
 
+def trace_evidence(slow, failed, top: int = 5, cap: int = 16
+                   ) -> Dict[str, object]:
+    """THE shared trace-evidence tail of a load run: rank ``slow``
+    ``(ms, trace_id)`` pairs and cap the ``failed`` trace_id list into
+    the ``slow_traces``/``failed_traces`` stat keys. Used by BOTH
+    :func:`request_storm` (in-process futures) and ``tools/loadgen.py``'s
+    HTTP mode, so the evidence shape cannot drift between the two
+    targets (the same discipline as ``load.finalize_load_stats``)."""
+    ranked = sorted(slow, reverse=True)
+    return {"slow_traces": [{"trace_id": tid, "ms": round(ms, 3)}
+                            for ms, tid in ranked[:top]],
+            "failed_traces": list(failed)[:cap]}
+
+
 def request_storm(server, model: str, payload, *, qps: float,
                   duration_s: float, threads: int = 4,
                   deadline_ms: Optional[float] = None,
@@ -129,12 +143,19 @@ def request_storm(server, model: str, payload, *, qps: float,
     ``payload`` is one sample array or a zero-arg callable producing one.
     Returns ``{"submitted", "ok", "shed", "expired", "error",
     "unfinished", "latencies_ms", "p50_ms", "p99_ms", "qps_offered",
-    "duration_s", "span_s"}`` — sheds rejected at admission (typed
-    Overloaded/Draining) count in ``shed`` without ever creating a
-    future; futures still pending when ``collect_timeout_s`` lapses
-    count in ``unfinished`` (slow, verdict unknown), never in ``error``
-    (which is reserved for actual executor faults).
+    "duration_s", "span_s", "slow_traces", "failed_traces"}`` — sheds
+    rejected at admission (typed Overloaded/Draining) count in ``shed``
+    without ever creating a future; futures still pending when
+    ``collect_timeout_s`` lapses count in ``unfinished`` (slow, verdict
+    unknown), never in ``error`` (which is reserved for actual executor
+    faults). Every submission carries a fresh
+    :class:`~mxnet_tpu.observability.tracing.TraceContext` (the same
+    propagation the HTTP edge does for remote callers), so the slowest
+    and failed requests come back as resolvable trace_ids
+    (``slow_traces`` / ``failed_traces``) instead of bare percentiles.
     """
+    from ..observability.tracing import TraceContext
+
     make: Callable[[], np.ndarray] = (payload if callable(payload)
                                       else lambda: payload)
     lock = threading.Lock()
@@ -146,15 +167,17 @@ def request_storm(server, model: str, payload, *, qps: float,
     def fire():
         with lock:
             counts["submitted"] += 1
+        ctx = TraceContext.new()
         try:
             t_sub = time.monotonic()
-            f = server.submit(model, make(), deadline_ms=deadline_ms)
+            f = server.submit(model, make(), deadline_ms=deadline_ms,
+                              trace=ctx)
         except ServingError:
             with lock:
                 counts["shed"] += 1
         else:
             with lock:
-                futures.append((f, t_sub))
+                futures.append((f, t_sub, ctx))
 
     t_start = time.monotonic()
     paced_run(fire, qps=qps, duration_s=duration_s, threads=threads)
@@ -165,7 +188,9 @@ def request_storm(server, model: str, payload, *, qps: float,
            "duration_s": float(duration_s)}
     deadline = time.monotonic() + collect_timeout_s
     last_done = None
-    for f, t_sub in futures:
+    slow: List = []      # (ms, trace_id) of ok completions
+    failed: List = []    # trace_ids of expired/errored requests
+    for f, t_sub, ctx in futures:
         f._ev.wait(timeout=max(0.0, deadline - time.monotonic()))
         # snapshot the verdict ONCE: a future read again later (e.g. for
         # the span) can flip unfinished->ok in between, leaving span/ok/
@@ -174,11 +199,14 @@ def request_storm(server, model: str, payload, *, qps: float,
         if oc == "ok":
             out["ok"] += 1
             if f.done_at is not None:
-                out["latencies_ms"].append((f.done_at - t_sub) * 1e3)
+                ms = (f.done_at - t_sub) * 1e3
+                out["latencies_ms"].append(ms)
+                slow.append((ms, ctx.trace_id))
                 last_done = (f.done_at if last_done is None
                              else max(last_done, f.done_at))
         elif oc == "expired":
             out["expired"] += 1
+            failed.append(ctx.trace_id)
         elif oc == "shed":
             out["shed"] += 1
         elif oc is None:
@@ -188,6 +216,8 @@ def request_storm(server, model: str, payload, *, qps: float,
             out["unfinished"] += 1
         else:
             out["error"] += 1
+            failed.append(ctx.trace_id)
+    out.update(trace_evidence(slow, failed))
     # the serving span: the paced window, extended to the last ok
     # completion — NOT the collection wait (a straggler sitting out most
     # of collect_timeout_s measures the caller's patience, and dividing

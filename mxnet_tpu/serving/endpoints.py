@@ -16,6 +16,15 @@ Draining        503    stop routing here (readyz is already red)
 CircuitOpen     503    model broken here; route elsewhere
 ExecutorFault   500    bad request or broken model — don't retry blind
 =============  =====  ==============================================
+
+/predict is also the trace edge: an inbound W3C ``traceparent`` header
+is parsed into a :class:`~mxnet_tpu.observability.tracing.TraceContext`
+(a fresh one is minted when absent/malformed) and propagated through the
+whole serving path, so the request's span timeline in the trace ring
+continues the caller's trace. EVERY response — success or rejection —
+carries the ``trace_id`` in its JSON body and echoes ``traceparent``, so
+a shed client has something to correlate against server logs instead of
+an opaque status; 429/503 also carry a ``Retry-After`` hint.
 """
 from __future__ import annotations
 
@@ -26,6 +35,7 @@ from typing import Optional
 
 import numpy as np
 
+from ..observability.tracing import TraceContext
 from .errors import (CircuitOpen, DeadlineExceeded, Draining, ExecutorFault,
                      Overloaded)
 
@@ -33,6 +43,11 @@ __all__ = ["ServingEndpoints"]
 
 _STATUS = ((Overloaded, 429), (DeadlineExceeded, 504), (Draining, 503),
            (CircuitOpen, 503), (ExecutorFault, 500))
+
+# Retry-After hints (integer seconds, RFC 9110): 429 = back off briefly
+# and retry HERE once the burst drains; 503 = draining/breaker-open, give
+# the LB time to route elsewhere before probing again
+_RETRY_AFTER = {429: "1", 503: "5"}
 
 
 def _make_handler(server):
@@ -42,11 +57,16 @@ def _make_handler(server):
         def log_message(self, fmt, *args):   # quiet by default
             pass
 
-        def _reply(self, code: int, doc) -> None:
+        def _reply(self, code: int, doc, trace=None,
+                   retry_after: Optional[str] = None) -> None:
             body = json.dumps(doc).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            if trace is not None:
+                self.send_header("traceparent", trace.to_traceparent())
+            if retry_after is not None:
+                self.send_header("Retry-After", retry_after)
             self.end_headers()
             self.wfile.write(body)
 
@@ -63,6 +83,12 @@ def _make_handler(server):
             if self.path != "/predict":
                 self._reply(404, {"error": "unknown path %r" % self.path})
                 return
+            # the trace edge: continue the caller's traceparent (fresh
+            # span id for the server-side hop), or mint a new context —
+            # a malformed header degrades to a fresh trace, never a 4xx
+            inbound = TraceContext.parse(self.headers.get("traceparent"))
+            ctx = inbound.child() if inbound is not None else \
+                TraceContext.new()
             try:
                 n = int(self.headers.get("Content-Length", "0"))
                 doc = json.loads(self.rfile.read(n) or b"{}")
@@ -70,21 +96,28 @@ def _make_handler(server):
                 data = np.asarray(doc["data"], np.float32)
                 deadline_ms = doc.get("deadline_ms")
             except (KeyError, ValueError, TypeError) as e:
-                self._reply(400, {"error": "bad request: %r" % (e,)})
+                self._reply(400, {"error": "bad request: %r" % (e,),
+                                  "trace_id": ctx.trace_id}, trace=ctx)
                 return
             try:
-                out = server.predict(model, data, deadline_ms=deadline_ms)
+                out = server.predict(model, data, deadline_ms=deadline_ms,
+                                     trace=ctx)
             except Exception as e:
                 for cls, code in _STATUS:
                     if isinstance(e, cls):
                         self._reply(code, {"error": str(e),
-                                           "type": type(e).__name__})
+                                           "type": type(e).__name__,
+                                           "trace_id": ctx.trace_id},
+                                    trace=ctx,
+                                    retry_after=_RETRY_AFTER.get(code))
                         return
                 self._reply(400, {"error": str(e),
-                                  "type": type(e).__name__})
+                                  "type": type(e).__name__,
+                                  "trace_id": ctx.trace_id}, trace=ctx)
                 return
             self._reply(200, {"model": model,
-                              "output": np.asarray(out).tolist()})
+                              "output": np.asarray(out).tolist(),
+                              "trace_id": ctx.trace_id}, trace=ctx)
 
     return Handler
 

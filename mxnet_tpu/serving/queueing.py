@@ -147,7 +147,15 @@ class BoundedRequestQueue:
             def _collect():
                 while self._q and len(batch) < max_size:
                     r = self._q.popleft()
-                    if r.deadline is not None and r.deadline <= self._clock():
+                    t = self._clock()
+                    try:
+                        # queue-wait span boundary for request tracing;
+                        # best-effort — items without the slot (tests,
+                        # foreign callers) are still batched normally
+                        r.dequeued_at = t
+                    except AttributeError:
+                        pass
+                    if r.deadline is not None and r.deadline <= t:
                         expired.append(r)
                     else:
                         batch.append(r)

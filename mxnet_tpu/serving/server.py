@@ -28,7 +28,12 @@ collapsing*:
 
 Telemetry lands in the PR-3 registry (``mxtpu_serve_*`` families,
 pre-declared in ``observability/catalog.py``); ``serving/load.py`` turns
-a load-generator run into a CostLedger row perfwatch can guard.
+a load-generator run into a CostLedger row perfwatch can guard. Every
+request additionally records a **trace**: non-overlapping stage spans
+(admission → queue → assembly → dispatch → forward → respond) that sum
+to its latency, tail-sampled into the ring ``tools/mxtrace.py`` reads
+(``observability/tracing.py``), with declared SLOs
+(``ModelConfig(slo_p99_ms=)``) guarded as rolling burn rates.
 Everything here is host-side threading + numpy; the only device work is
 the bucket executor's jitted forward.
 """
@@ -41,6 +46,7 @@ from typing import Any, Dict, List, Optional, Sequence
 import numpy as np
 
 from ..base import MXNetError, get_env, logger, register_config
+from ..observability import tracing as _tracing
 from .breaker import CircuitBreaker
 from .errors import (CircuitOpen, DeadlineExceeded, Draining, ExecutorFault,
                      Overloaded, ServingError)
@@ -71,6 +77,15 @@ register_config("MXNET_SERVE_BREAKER_THRESHOLD", 3, int,
 register_config("MXNET_SERVE_BREAKER_COOLDOWN", 5.0, float,
                 "Seconds an open circuit breaker waits before letting one "
                 "half-open probe batch through.")
+register_config("MXNET_SERVE_TRACE", True, bool,
+                "Per-request tracing on the serving path: every request "
+                "records admission/queue/assembly/dispatch/forward/"
+                "respond spans into the tail-sampled trace ring "
+                "(MXNET_TRACE_RING/_SAMPLE; tools/mxtrace.py). Host-side "
+                "only — the compiled forward's HLO is identical either "
+                "way. 0 disables; mxlint MXL-T216 flags an untraced "
+                "server with declared deadlines/SLOs. Per-model "
+                "override: ModelConfig(trace=).")
 register_config("MXNET_SERVE_TIER", "f32", str,
                 "Default serving tier for models whose ModelConfig does "
                 "not name one: 'f32' serves the graph as loaded; 'int8' "
@@ -121,7 +136,9 @@ class PendingResult:
 
 
 class _Request:
-    __slots__ = ("data", "deadline", "submitted_at", "dispatch_at", "pending")
+    __slots__ = ("data", "deadline", "submitted_at", "dispatch_at",
+                 "pending", "trace", "enqueued_at", "dequeued_at",
+                 "forward_t0", "forward_t1")
 
     def __init__(self, data: np.ndarray, deadline: Optional[float],
                  submitted_at: float):
@@ -130,6 +147,16 @@ class _Request:
         self.submitted_at = submitted_at
         self.dispatch_at: Optional[float] = None
         self.pending = PendingResult()
+        # tracing stamps (monotonic seconds): together with submitted_at/
+        # dispatch_at they bound the non-overlapping stage spans —
+        # admission ends at enqueued_at, queue at dequeued_at, assembly
+        # at dispatch_at, dispatch at forward_t0, forward at forward_t1,
+        # respond at completion
+        self.trace = None
+        self.enqueued_at: Optional[float] = None
+        self.dequeued_at: Optional[float] = None
+        self.forward_t0: Optional[float] = None
+        self.forward_t1: Optional[float] = None
 
 
 class ModelConfig:
@@ -152,7 +179,11 @@ class ModelConfig:
                  breaker_cooldown_s: Optional[float] = None,
                  dev_type: int = 1, dev_id: int = 0,
                  output_keys: Optional[List[str]] = None,
-                 tier: Optional[str] = None):
+                 tier: Optional[str] = None,
+                 trace: Optional[bool] = None,
+                 trace_sample: Optional[float] = None,
+                 slo_p99_ms: Optional[float] = None,
+                 slo_availability: Optional[float] = None):
         if not name:
             raise MXNetError("ModelConfig needs a model name")
         self.name = str(name)
@@ -188,6 +219,20 @@ class ModelConfig:
         if self.tier not in ("f32", "int8"):
             raise MXNetError("tier must be 'f32' or 'int8', got %r"
                              % (self.tier,))
+        self.trace = bool(get_env("MXNET_SERVE_TRACE", True)
+                          if trace is None else trace)
+        self.trace_sample = float(get_env("MXNET_TRACE_SAMPLE", 0.05)
+                                  if trace_sample is None else trace_sample)
+        if not (0.0 <= self.trace_sample <= 1.0):
+            raise MXNetError("trace_sample must be in [0, 1], got %r"
+                             % (self.trace_sample,))
+        self.slo_p99_ms = float(get_env("MXNET_SERVE_SLO_P99_MS", 0.0)
+                                if slo_p99_ms is None else slo_p99_ms)
+        if self.slo_p99_ms < 0:
+            raise MXNetError("slo_p99_ms must be >= 0 (0 = no SLO)")
+        self.slo_availability = float(
+            get_env("MXNET_SERVE_SLO_AVAILABILITY", 0.999)
+            if slo_availability is None else slo_availability)
         self.dev_type, self.dev_id = int(dev_type), int(dev_id)
         self.output_keys = output_keys
 
@@ -213,6 +258,11 @@ class _ModelState:
             output_keys=cfg.output_keys)
         self.breaker = CircuitBreaker(cfg.breaker_threshold,
                                       cfg.breaker_cooldown_s)
+        # declared SLO -> rolling burn-rate guard (tracing.SLOTracker);
+        # no objective declared = no tracker, no gauges
+        self.slo = (_tracing.SLOTracker(cfg.name, cfg.slo_p99_ms,
+                                        cfg.slo_availability)
+                    if cfg.slo_p99_ms > 0 else None)
         self.worker: Optional[threading.Thread] = None
         self.lock = threading.Lock()
         self.counts = {"ok": 0, "shed": 0, "expired": 0, "error": 0}
@@ -238,9 +288,14 @@ class ModelServer:
     """
 
     def __init__(self, models: Sequence[ModelConfig], *,
-                 drain_on_preemption: bool = True):
+                 drain_on_preemption: bool = True,
+                 tracer: Optional[_tracing.Tracer] = None):
         if not models:
             raise MXNetError("ModelServer needs at least one ModelConfig")
+        # the request-trace ring (shared across this server's models);
+        # defaults to the process-wide ring so tools/mxtrace.py dumps and
+        # exemplar lookups see every server in the process
+        self.tracer = tracer if tracer is not None else _tracing.get_tracer()
         self._models: Dict[str, _ModelState] = {}
         for cfg in models:
             if cfg.name in self._models:
@@ -310,7 +365,7 @@ class ModelServer:
             for req in st.queue.drain_remaining():
                 self._complete(st, req, error=Draining(
                     "server closed before this request was dispatched"),
-                    outcome="shed")
+                    outcome="shed", reason="draining")
         self._stopped = True
         if self._guard is not None:
             from ..resilience import preemption
@@ -333,12 +388,17 @@ class ModelServer:
                            "replica")
 
     def submit(self, model: str, data, deadline_ms: Optional[float] = None,
-               deadline_at: Optional[float] = None) -> PendingResult:
+               deadline_at: Optional[float] = None,
+               trace: Optional[_tracing.TraceContext] = None
+               ) -> PendingResult:
         """Admit one request (one sample of the model's feature shape).
 
         ``deadline_ms`` overrides the model's default; ``deadline_at`` is
         an absolute :func:`time.monotonic` deadline (wins over both —
-        propagated end-to-end, e.g. from an upstream hop). Raises typed
+        propagated end-to-end, e.g. from an upstream hop). ``trace`` is
+        an upstream :class:`~mxnet_tpu.observability.tracing.TraceContext`
+        (e.g. parsed from an HTTP ``traceparent``) the request's span
+        timeline continues; None mints a fresh one. Raises typed
         :class:`Overloaded` / :class:`Draining`; executor errors surface
         on the returned :class:`PendingResult`.
         """
@@ -364,24 +424,43 @@ class ModelServer:
                      else float(deadline_ms))
             deadline_at = now + dl_ms / 1e3 if dl_ms else None
         req = _Request(arr, deadline_at, now)
+        if st.cfg.trace and self.tracer.enabled():
+            req.trace = self.tracer.start_request(
+                model, ctx=trace, submitted_at=now,
+                deadline_ms=((deadline_at - now) * 1e3
+                             if deadline_at is not None else None),
+                sample=st.cfg.trace_sample)
         try:
             shed = st.queue.put(req)
-        except (Overloaded, Draining):
+        except (Overloaded, Draining) as e:
+            if req.trace is not None:
+                # admission rejections keep their trace: shed traces are
+                # ALWAYS retained by the tail-sampler, so an overloaded
+                # client's trace_id resolves in the ring
+                req.trace.span("admission", now, _now())
+                self.tracer.finish(
+                    req.trace, "shed", latency_ms=(_now() - now) * 1e3,
+                    reason=("overloaded" if isinstance(e, Overloaded)
+                            else "draining"))
             self._count(st, "shed")
             raise
+        req.enqueued_at = _now()
+        if req.trace is not None:
+            req.trace.span("admission", now, req.enqueued_at)
         for dead in shed:
             self._complete(st, dead, error=DeadlineExceeded(
                 "deadline passed while queued (shed at admission)"),
-                outcome="expired")
+                outcome="expired", reason="shed_at_admission")
         self._gauge_depth(st)
         return req.pending
 
     def predict(self, model: str, data,
                 deadline_ms: Optional[float] = None,
-                timeout: Optional[float] = None) -> np.ndarray:
+                timeout: Optional[float] = None,
+                trace: Optional[_tracing.TraceContext] = None) -> np.ndarray:
         """submit + wait: the synchronous convenience."""
-        return self.submit(model, data, deadline_ms=deadline_ms).result(
-            timeout=timeout)
+        return self.submit(model, data, deadline_ms=deadline_ms,
+                           trace=trace).result(timeout=timeout)
 
     # ------------------------------------------------------------- workers
     def _worker(self, st: _ModelState) -> None:
@@ -429,7 +508,7 @@ class ModelServer:
                     if not req.pending.done():
                         self._complete(st, req, error=ExecutorFault(
                             "internal dispatch error: %r" % (e,)),
-                            outcome="error")
+                            outcome="error", reason="internal")
 
     def _dispatch(self, st: _ModelState, batch: List[_Request]) -> None:
         # ONE decision timestamp: the expiry filter and the dispatch_at
@@ -452,11 +531,22 @@ class ModelServer:
             for req in ready:
                 self._complete(st, req, error=CircuitOpen(
                     "circuit breaker open for model %r after repeated "
-                    "executor faults" % st.cfg.name), outcome="shed")
+                    "executor faults" % st.cfg.name), outcome="shed",
+                    reason="breaker")
             return
         for req in ready:
             req.dispatch_at = dispatch_at
         arr = np.stack([r.data for r in ready])
+        # one shared batch-span id: every batchmate's forward span carries
+        # it, so a slow request's timeline names the batch it was fused
+        # into (and mxtrace can find its batchmates by the shared id)
+        batch_span = _tracing.new_span_id() \
+            if any(r.trace is not None for r in ready) else None
+        with st.lock:
+            retries_before = st.retries
+        t_f0 = _now()
+        for req in ready:
+            req.forward_t0 = t_f0
         try:
             rows = self._run_with_retry(st, arr)
         except Exception as e:
@@ -466,15 +556,52 @@ class ModelServer:
                 self._dispatch_singly(st, ready, cause=e)
             else:
                 st.breaker.record_failure()
+                self._trace_forward(st, ready[0], t_f0, _now(),
+                                    batch_span, len(ready),
+                                    retries_before, outcome_tag="error")
                 self._complete(st, ready[0], error=self._fault(e),
                                outcome="error")
             return
+        t_f1 = _now()
         st.breaker.record_success()
         with st.lock:
             st.batches += 1
         self._observe_batch(st, len(ready))
+        for req in ready:
+            self._trace_forward(st, req, t_f0, t_f1, batch_span,
+                                len(ready), retries_before)
         for i, req in enumerate(ready):
             self._complete(st, req, value=rows[i], outcome="ok")
+
+    def _trace_forward(self, st: _ModelState, req: _Request, t0: float,
+                       t1: float, batch_span: Optional[str], batch: int,
+                       retries_before: int, outcome_tag: Optional[str] = None,
+                       isolated: bool = False) -> None:
+        """Record one request's forward span (the device-time stage),
+        tagged with the shared batch-span id, batch size, the padded
+        bucket and any retries the dispatch burned."""
+        rt = req.trace
+        if rt is None:
+            return
+        req.forward_t1 = t1
+        with st.lock:
+            retries = st.retries - retries_before
+        tags: Dict[str, Any] = {"batch": int(batch)}
+        if batch_span is not None:
+            tags["batch_span"] = batch_span
+            rt.batch_span_id = batch_span
+            rt.batch_size = int(batch)
+        try:
+            tags["bucket"] = st.cache.bucket_for(batch)
+        except Exception:
+            pass
+        if retries > 0:
+            tags["retries"] = int(retries)
+        if isolated:
+            tags["isolated"] = True
+        if outcome_tag:
+            tags["outcome"] = outcome_tag
+        rt.span("forward", t0, t1, **tags)
 
     def _dispatch_singly(self, st: _ModelState, ready: List[_Request],
                          cause: BaseException) -> None:
@@ -486,19 +613,26 @@ class ModelServer:
             if req.deadline is not None and req.deadline <= t:
                 self._complete(st, req, error=DeadlineExceeded(
                     "deadline passed during fault isolation"),
-                    outcome="expired")
+                    outcome="expired", reason="isolation")
                 continue
             with st.lock:
                 st.singles += 1
+                retries_before = st.retries
             req.dispatch_at = t
+            req.forward_t0 = t
             try:
                 rows = self._run_with_retry(st, req.data[None])
             except Exception as e:
+                self._trace_forward(st, req, t, _now(), None, 1,
+                                    retries_before, outcome_tag="error",
+                                    isolated=True)
                 self._complete(st, req, error=self._fault(e),
-                               outcome="error")
+                               outcome="error", reason="isolation")
             else:
                 any_ok = True
                 self._observe_batch(st, 1)
+                self._trace_forward(st, req, t, _now(), None, 1,
+                                    retries_before, isolated=True)
                 self._complete(st, req, value=rows[0], outcome="ok")
         if any_ok:
             # at least one isolated re-dispatch succeeded: the executor
@@ -535,28 +669,76 @@ class ModelServer:
 
     # ---------------------------------------------------------- accounting
     def _complete(self, st: _ModelState, req: _Request, value=None,
-                  error=None, outcome="ok") -> None:
+                  error=None, outcome="ok", reason=None) -> None:
         done_at = _now()
-        if (outcome == "ok" and req.deadline is not None
-                and req.dispatch_at is not None
-                and req.dispatch_at > req.deadline):
+        violated = (outcome == "ok" and req.deadline is not None
+                    and req.dispatch_at is not None
+                    and req.dispatch_at > req.deadline)
+        if violated:
             # must stay zero: the invariant counter the acceptance test
             # reads — a dispatch after deadline is a server bug
             with st.lock:
                 st.deadline_violations += 1
         latency_ms = (done_at - req.submitted_at) * 1e3
+        kept = self._finish_trace(st, req, done_at, outcome, violated,
+                                  reason)
         if outcome == "ok":
             with st.lock:
                 st.latencies.append(latency_ms)
                 if len(st.latencies) > _LAT_RING:
                     del st.latencies[:len(st.latencies) - _LAT_RING]
-            self._observe_latency(st, latency_ms)
-        self._count(st, outcome)
+            self._observe_latency(st, latency_ms,
+                                  trace_id=(req.trace.trace_id
+                                            if kept and req.trace is not None
+                                            else None))
+        self._count(st, outcome,
+                    latency_ms if outcome == "ok" else None)
         req.pending._complete(value=value, error=error, outcome=outcome)
 
-    def _count(self, st: _ModelState, outcome: str) -> None:
+    def _finish_trace(self, st: _ModelState, req: _Request, done_at: float,
+                      outcome: str, violated: bool, reason) -> bool:
+        """Seal the request's span timeline: fill the non-overlapping
+        stage spans from the request's stamps (spans sum to the request
+        latency by construction) and hand it to the tail-sampler.
+        Returns True when the trace was retained (the exemplar gate)."""
+        rt = req.trace
+        if rt is None:
+            return False
+        enq = req.enqueued_at
+        if enq is not None:
+            dq = req.dequeued_at
+            rt.span("queue", enq, dq if dq is not None else done_at)
+            if dq is not None:
+                rt.span("assembly", dq,
+                        req.dispatch_at if req.dispatch_at is not None
+                        else done_at)
+            if req.dispatch_at is not None:
+                rt.span("dispatch", req.dispatch_at,
+                        req.forward_t0 if req.forward_t0 is not None
+                        else done_at)
+            # the forward span (with batch/bucket/retry tags) was
+            # recorded by _trace_forward at dispatch time
+            if req.forward_t1 is not None:
+                rt.span("respond", req.forward_t1, done_at)
+            elif req.forward_t0 is not None:
+                # a forward was attempted but never sealed: the batch
+                # failed and this request exited (expired during fault
+                # isolation, or an internal dispatch error) before any
+                # re-dispatch — account the attempt so the spans still
+                # sum to the request latency
+                rt.span("forward", req.forward_t0, done_at, aborted=True)
+        return self.tracer.finish(
+            rt, outcome, latency_ms=(done_at - req.submitted_at) * 1e3,
+            violated=violated, reason=reason)
+
+    def _count(self, st: _ModelState, outcome: str,
+               latency_ms: Optional[float] = None) -> None:
         with st.lock:
             st.counts[outcome] = st.counts.get(outcome, 0) + 1
+        if st.slo is not None:
+            # every final outcome is one SLO event (sheds and expiries
+            # burn the availability budget exactly like slow successes)
+            st.slo.record(outcome, latency_ms)
         from ..observability import metrics as _m
         if _m.enabled():
             from ..observability import catalog as _c
@@ -565,11 +747,13 @@ class ModelServer:
                 _c.QUANT_SERVE_REQUESTS.inc(model=st.cfg.name,
                                             outcome=outcome)
 
-    def _observe_latency(self, st: _ModelState, ms: float) -> None:
+    def _observe_latency(self, st: _ModelState, ms: float,
+                         trace_id: Optional[str] = None) -> None:
         from ..observability import metrics as _m
         if _m.enabled():
             from ..observability import catalog as _c
-            _c.SERVE_LATENCY.observe(ms, model=st.cfg.name)
+            _c.SERVE_LATENCY.observe(ms, exemplar=trace_id,
+                                     model=st.cfg.name)
 
     def _observe_batch(self, st: _ModelState, size: int) -> None:
         from ..observability import metrics as _m
@@ -607,12 +791,22 @@ class ModelServer:
                 "buckets_compiled": st.cache.compiled_buckets(),
                 "bucket_provenance": st.cfg.bucket_provenance,
                 "tier": st.cfg.tier,
+                "tracing": {"enabled": st.cfg.trace,
+                            "sample": st.cfg.trace_sample,
+                            "ring_depth": self.tracer.depth},
             }
+        if st.slo is not None:
+            out["slo"] = st.slo.snapshot()
         if lat.size:
             out["p50_ms"] = float(np.percentile(lat, 50))
             out["p99_ms"] = float(np.percentile(lat, 99))
             out["mean_ms"] = float(lat.mean())
         return out
+
+    def dump_traces(self, path: str) -> str:
+        """Write the trace ring to ``path`` (the artifact
+        ``tools/mxtrace.py`` pretty-prints)."""
+        return self.tracer.write_dump(path)
 
     def ready(self) -> bool:
         """Readiness: started, not draining/stopped — the /readyz answer.

@@ -928,3 +928,148 @@ def test_mxquant_registered_with_tunnel_session():
     assert "mxquant.py" in bench_src
     tool_src = open(os.path.join(REPO, "tools", "mxquant.py")).read()
     assert 'tunnel_session.register("mxquant.py"' in tool_src
+
+
+# ---------------------------------------------------------------------------
+# Tracing CLI: mxtrace view/exit-code matrix (mxlint 0/1/2 convention), the
+# mxtop trace summary view, and the tunnel-session both-sides pairing.
+# ---------------------------------------------------------------------------
+def _write_trace_dump(path, with_error=False):
+    """Synthesize a trace-ring dump through the REAL tracing API (no
+    hand-rolled schema): finished RequestTraces -> Tracer.write_dump."""
+    from mxnet_tpu.observability.tracing import Tracer
+
+    tracer = Tracer(capacity=16, sample=1.0)
+    for i in range(3):
+        rt = tracer.start_request("m")
+        t0 = rt.submitted_at
+        rt.span("admission", t0, t0 + 0.0001)
+        rt.span("queue", t0 + 0.0001, t0 + 0.001)
+        rt.span("forward", t0 + 0.001, t0 + 0.004, batch=2)
+        tracer.finish(rt, "ok", latency_ms=4.0 + i)
+    last_ok = rt.trace_id
+    if with_error:
+        rt = tracer.start_request("m")
+        rt.span("admission", rt.submitted_at, rt.submitted_at + 0.0001)
+        tracer.finish(rt, "error", latency_ms=0.2, reason="isolation")
+    tracer.write_dump(path)
+    return last_ok
+
+
+@pytest.mark.trace
+def test_mxtrace_cli_matrix(tmp_path):
+    """mxtrace: 0 = healthy dump, 1 = anomalous traces in view, 2 =
+    unloadable artifact / unknown trace id — and the summary, timeline,
+    json and chrome views all render from one dump."""
+    import json as _json
+    cli = os.path.join(REPO, "tools", "mxtrace.py")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": "",
+           "MXTPU_TUNNEL_REG_DIR": str(tmp_path / "reg")}
+    ok_dump = str(tmp_path / "ok.json")
+    bad_dump = str(tmp_path / "bad.json")
+    ok_tid = _write_trace_dump(ok_dump)
+    _write_trace_dump(bad_dump, with_error=True)
+
+    # healthy dump: summary view, exit 0
+    p = subprocess.run([sys.executable, cli, ok_dump],
+                       capture_output=True, text=True, timeout=300, env=env)
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "retained: 3" in p.stdout and "ok=3" in p.stdout
+
+    # anomalous dump: exit 1, '!' marker rows
+    p = subprocess.run([sys.executable, cli, bad_dump],
+                       capture_output=True, text=True, timeout=300, env=env)
+    assert p.returncode == 1, p.stdout + p.stderr
+    assert "anomalous trace(s)" in p.stdout
+
+    # errors-only narrows the view to the anomalies
+    p = subprocess.run([sys.executable, cli, bad_dump, "--errors-only"],
+                       capture_output=True, text=True, timeout=300, env=env)
+    assert p.returncode == 1
+    assert "retained: 1" in p.stdout and "error" in p.stdout
+
+    # single-timeline view resolves a trace id (prefix match works)
+    p = subprocess.run([sys.executable, cli, ok_dump,
+                        "--trace-id", ok_tid[:12]],
+                       capture_output=True, text=True, timeout=300, env=env)
+    assert p.returncode == 0, p.stdout + p.stderr
+    for stage in ("admission", "queue", "forward"):
+        assert stage in p.stdout
+    assert "batch=2" in p.stdout
+
+    # json + chrome formats parse
+    p = subprocess.run([sys.executable, cli, ok_dump, "--format", "json"],
+                       capture_output=True, text=True, timeout=300, env=env)
+    assert p.returncode == 0
+    doc = _json.loads(p.stdout)
+    assert len(doc["traces"]) == 3
+    p = subprocess.run([sys.executable, cli, ok_dump, "--format", "chrome"],
+                       capture_output=True, text=True, timeout=300, env=env)
+    assert p.returncode == 0
+    chrome = _json.loads(p.stdout)
+    assert chrome["traceEvents"] and \
+        {e["ph"] for e in chrome["traceEvents"]} == {"X"}
+
+    # unknown trace id / unloadable artifact: cannot run
+    p = subprocess.run([sys.executable, cli, ok_dump,
+                        "--trace-id", "feedfacefeedface"],
+                       capture_output=True, text=True, timeout=300, env=env)
+    assert p.returncode == 2, p.stdout + p.stderr
+    p = subprocess.run([sys.executable, cli, str(tmp_path / "nope.json")],
+                       capture_output=True, text=True, timeout=300, env=env)
+    assert p.returncode == 2
+
+
+@pytest.mark.trace
+def test_mxtop_trace_view(tmp_path):
+    """mxtop.py trace: the at-a-glance trace-ring summary rides mxtop's
+    exit convention (0 healthy / 1 anomalies / 2 unloadable)."""
+    cli = os.path.join(REPO, "tools", "mxtop.py")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": ""}
+    dump = str(tmp_path / "ring.json")
+    _write_trace_dump(dump, with_error=True)
+    p = subprocess.run([sys.executable, cli, "trace", dump],
+                       capture_output=True, text=True, timeout=300, env=env)
+    assert p.returncode == 1, p.stdout + p.stderr
+    assert "retained: 4" in p.stdout
+    p = subprocess.run([sys.executable, cli, "trace",
+                        str(tmp_path / "missing.json")],
+                       capture_output=True, text=True, timeout=300, env=env)
+    assert p.returncode == 2
+
+
+@pytest.mark.trace
+def test_loadgen_reports_trace_evidence_and_dump(tmp_path):
+    """loadgen --selfhost ends with resolvable trace evidence: slow
+    trace_ids in the text report and a --trace-dump artifact mxtrace
+    can read back."""
+    import json as _json
+    cli = os.path.join(REPO, "tools", "loadgen.py")
+    dump = str(tmp_path / "traces.json")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": "",
+           "MXTPU_TUNNEL_REG_DIR": str(tmp_path / "reg"),
+           "MXNET_TRACE_SAMPLE": "1.0"}
+    p = subprocess.run([sys.executable, cli, "--selfhost", "--qps", "60",
+                        "--duration", "0.8", "--trace-dump", dump],
+                       capture_output=True, text=True, timeout=300, env=env)
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "slow   trace " in p.stdout       # clickable evidence lines
+    doc = _json.load(open(dump))
+    assert doc["kind"] == "trace_ring" and doc["traces"]
+    # every reported slow trace resolves in the dumped ring
+    reported = [l.split()[3] for l in p.stdout.splitlines()
+                if l.startswith("loadgen: slow")]
+    ring_ids = {t["trace_id"] for t in doc["traces"]}
+    assert reported and set(reported) <= ring_ids
+
+
+def test_mxtrace_registered_with_tunnel_session():
+    """mxtrace joins the tunnel-client registry on BOTH sides (MARKERS +
+    bench.py's /proc scan) and actually self-registers — the same
+    pairing pin as the serving/quant tools."""
+    import tunnel_session
+    bench_src = open(os.path.join(REPO, "bench.py")).read()
+    assert "mxtrace.py" in tunnel_session.MARKERS
+    assert "mxtrace.py" in bench_src
+    tool_src = open(os.path.join(REPO, "tools", "mxtrace.py")).read()
+    assert 'tunnel_session.register("mxtrace.py"' in tool_src

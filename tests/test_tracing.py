@@ -1,0 +1,665 @@
+"""End-to-end request tracing (observability/tracing.py + the serving
+integration): W3C traceparent contexts, per-request stage-span timelines
+that sum to the measured latency, tail-sampling (errors/sheds/expiries
+and the slow tail always retained), latency-histogram exemplars that
+resolve in the trace ring, SLO burn-rate guarding, and THE acceptance
+storm: a chaos-faulted server under load yields reconstructable
+timelines, a resolvable exemplar, a shared-clock chrome export and an
+SLO breach perfwatch flags — with the served graph's HLO bitwise
+identical tracing-on vs tracing-off."""
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mxnet_tpu.observability import catalog, tracing
+from mxnet_tpu.observability import metrics as obs_metrics
+from mxnet_tpu.observability.tracing import (RequestTrace, SLOTracker,
+                                             TraceContext, Tracer)
+from mxnet_tpu.serving import (ModelConfig, ModelServer, Overloaded,
+                               ServingEndpoints)
+from mxnet_tpu.serving import chaos as schaos
+from mxnet_tpu.serving import load as sload
+
+pytestmark = pytest.mark.trace
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    return sload.tiny_model()
+
+
+def _cfg(tiny, name="m", **kw):
+    sym_json, pbytes, feat, _ = tiny
+    d = dict(feature_shape=feat, buckets=(1, 2, 4, 8), max_queue=16,
+             deadline_ms=2000.0, max_wait_ms=3.0, breaker_cooldown_s=0.25,
+             trace=True, trace_sample=1.0)
+    d.update(kw)
+    return ModelConfig(name, sym_json, pbytes, **d)
+
+
+def _server(tiny, tracer=None, **kw):
+    tracer = tracer or Tracer(capacity=256, sample=1.0)
+    srv = ModelServer([_cfg(tiny, **kw)], tracer=tracer).start(warm=True)
+    return srv, tracer
+
+
+# ------------------------------------------------------------ TraceContext
+def test_traceparent_round_trip():
+    ctx = TraceContext.new()
+    hdr = ctx.to_traceparent()
+    assert hdr.startswith("00-") and len(hdr) == 55
+    back = TraceContext.parse(hdr)
+    assert back is not None
+    assert back.trace_id == ctx.trace_id
+    assert back.span_id == ctx.span_id
+    assert back.sampled == ctx.sampled
+
+
+@pytest.mark.parametrize("bad", [
+    None, "", "garbage", "00-abc-def-01",
+    "00-" + "0" * 32 + "-" + "1" * 16 + "-01",     # all-zero trace id
+    "00-" + "1" * 32 + "-" + "0" * 16 + "-01",     # all-zero span id
+    "ff-" + "1" * 32 + "-" + "2" * 16 + "-01",     # forbidden version
+    "00-" + "z" * 32 + "-" + "2" * 16 + "-01",     # non-hex
+    "00-" + "1" * 31 + "-" + "2" * 16 + "-01",     # short trace id
+])
+def test_traceparent_malformed_returns_none(bad):
+    assert TraceContext.parse(bad) is None
+
+
+def test_child_same_trace_fresh_span():
+    ctx = TraceContext.new()
+    kid = ctx.child()
+    assert kid.trace_id == ctx.trace_id
+    assert kid.span_id != ctx.span_id
+    assert kid.sampled == ctx.sampled
+
+
+def test_use_installs_thread_local_context():
+    assert tracing.current() is None
+    a, b = TraceContext.new(), TraceContext.new()
+    with tracing.use(a):
+        assert tracing.current_trace_id() == a.trace_id
+        with tracing.use(b):
+            assert tracing.current_trace_id() == b.trace_id
+        assert tracing.current_trace_id() == a.trace_id
+    assert tracing.current() is None
+
+
+# ------------------------------------------------------------ tail-sampling
+def _finished(tracer, outcome, latency_ms, model="m", violated=False):
+    rt = tracer.start_request(model)
+    rt.span("forward", 0.0, latency_ms / 1e3)
+    tracer.finish(rt, outcome, latency_ms=latency_ms, violated=violated)
+    return rt
+
+
+def test_sampling_always_keeps_errors_sheds_expiries():
+    tracer = Tracer(capacity=32, sample=0.0)       # drop ALL boring traffic
+    d0 = catalog.TRACE_DROPPED.value(reason="sampled_out")
+    for oc in ("error", "shed", "expired"):
+        rt = _finished(tracer, oc, 5.0)
+        assert rt.kept and rt.keep_reason == oc
+    ok = _finished(tracer, "ok", 5.0)
+    assert not ok.kept
+    assert catalog.TRACE_DROPPED.value(reason="sampled_out") == d0 + 1
+    assert {t.outcome for t in tracer.traces()} == \
+        {"error", "shed", "expired"}
+
+
+def test_deadline_violation_always_kept():
+    tracer = Tracer(capacity=32, sample=0.0)
+    rt = _finished(tracer, "ok", 5.0, violated=True)
+    assert rt.kept and rt.keep_reason == "violation"
+
+
+def test_slow_tail_retained_at_sample_zero():
+    tracer = Tracer(capacity=64, sample=0.0)
+    for _ in range(30):                    # build the rolling p99 window
+        _finished(tracer, "ok", 1.0)
+    assert tracer.tail_latency_ms("m") is not None
+    slow = _finished(tracer, "ok", 100.0)
+    assert slow.kept and slow.keep_reason == "slow"
+    fast = _finished(tracer, "ok", 0.5)
+    assert not fast.kept
+
+
+def test_ring_bounded_evicts_oldest():
+    tracer = Tracer(capacity=4, sample=0.0)
+    e0 = catalog.TRACE_DROPPED.value(reason="evicted")
+    traces = [_finished(tracer, "error", float(i)) for i in range(7)]
+    assert tracer.depth == 4
+    assert catalog.TRACE_DROPPED.value(reason="evicted") == e0 + 3
+    assert catalog.TRACE_RING_DEPTH.value() == 4
+    # oldest rolled off, newest resolvable
+    assert tracer.get(traces[0].trace_id) is None
+    assert tracer.get(traces[-1].trace_id) is not None
+
+
+def test_spans_counted_even_when_sampled_out():
+    tracer = Tracer(capacity=8, sample=0.0)
+    c0 = catalog.TRACE_SPANS.value(stage="forward", outcome="ok")
+    _finished(tracer, "ok", 1.0)
+    assert catalog.TRACE_SPANS.value(stage="forward", outcome="ok") == c0 + 1
+
+
+# ---------------------------------------------------------------- exemplars
+def test_histogram_exemplar_roundtrip():
+    h = obs_metrics.histogram("test_trace_exemplar_ms", "test",
+                              buckets=(1.0, 10.0, 100.0))
+    h.observe(5.0, exemplar="abc123", kind="t")
+    h.observe(0.5, kind="t")                       # no exemplar
+    ex = h.exemplars(kind="t")
+    assert ex == {"10": {"value": 5.0, "trace_id": "abc123",
+                         "time": ex["10"]["time"]}}
+    # the snapshot carries them next to the buckets
+    [series] = [s for s in h.series() if s["labels"] == {"kind": "t"}]
+    assert series["exemplars"]["10"]["trace_id"] == "abc123"
+    assert series["count"] == 2
+
+
+# ------------------------------------------------- serving path integration
+def test_request_timeline_spans_sum_to_latency(tiny):
+    srv, tracer = _server(tiny)
+    try:
+        ctx = TraceContext.new()
+        srv.predict("m", np.zeros(4, "float32"), trace=ctx, timeout=30.0)
+    finally:
+        srv.close(timeout=10.0)
+    rt = tracer.get(ctx.trace_id)
+    assert rt is not None and rt.outcome == "ok"
+    stages = rt.stage_ms()
+    assert set(stages) == {"admission", "queue", "assembly", "dispatch",
+                           "forward", "respond"}
+    # non-overlapping spans partition the request exactly: their sum IS
+    # the measured latency (the acceptance-test property)
+    assert sum(stages.values()) == pytest.approx(rt.latency_ms, rel=1e-6)
+    # the edge context is the one the timeline continues
+    assert rt.ctx.trace_id == ctx.trace_id
+    d = rt.to_dict()
+    assert d["outcome"] == "ok" and len(d["spans"]) == 6
+    for s in d["spans"]:
+        assert s["dur_ms"] >= 0 and s["t0_ms"] >= 0
+
+
+def test_batchmates_share_batch_span_id(tiny):
+    srv, tracer = _server(tiny)
+    try:
+        with schaos.slow_executor(srv, "m", 0.05):
+            blocker = srv.submit("m", np.zeros(4, "float32"))
+            time.sleep(0.02)               # worker picked the blocker up
+            ctxs = [TraceContext.new() for _ in range(4)]
+            futs = [srv.submit("m", np.zeros(4, "float32"), trace=c)
+                    for c in ctxs]
+            for f in futs:
+                f.result(30.0)
+            blocker.result(30.0)
+    finally:
+        srv.close(timeout=10.0)
+    rts = [tracer.get(c.trace_id) for c in ctxs]
+    assert all(rt is not None for rt in rts)
+    batch_ids = {rt.batch_span_id for rt in rts}
+    sizes = {rt.batch_size for rt in rts}
+    # the burst fused into one batch: every batchmate's forward span
+    # carries the SAME batch-span id and the fused size
+    assert len(batch_ids) == 1 and None not in batch_ids
+    assert sizes == {4}
+    fwd = [s for s in rts[0].spans if s["stage"] == "forward"]
+    assert fwd[0]["tags"]["batch_span"] == rts[0].batch_span_id
+    assert fwd[0]["tags"]["batch"] == 4
+
+
+def test_admission_shed_trace_always_retained(tiny):
+    srv, tracer = _server(tiny, max_queue=2)
+    shed_ctx = []
+    try:
+        with schaos.slow_executor(srv, "m", 0.2):
+            first = srv.submit("m", np.zeros(4, "float32"))
+            time.sleep(0.05)
+            accepted = [srv.submit("m", np.zeros(4, "float32"))
+                        for _ in range(2)]
+            for _ in range(4):
+                ctx = TraceContext.new()
+                try:
+                    accepted.append(srv.submit("m", np.zeros(4, "float32"),
+                                               trace=ctx))
+                except Overloaded:
+                    shed_ctx.append(ctx)
+            first.result(30.0)
+            for f in accepted:
+                f.result(30.0)
+    finally:
+        srv.close(timeout=10.0)
+    assert shed_ctx, "storm never tripped admission control"
+    rt = tracer.get(shed_ctx[0].trace_id)
+    assert rt is not None and rt.kept
+    assert rt.outcome == "shed" and rt.reason == "overloaded"
+    assert [s["stage"] for s in rt.spans] == ["admission"]
+
+
+def test_expired_trace_retained_with_queue_span(tiny):
+    srv, tracer = _server(tiny)
+    try:
+        with schaos.slow_executor(srv, "m", 0.2):
+            blocker = srv.submit("m", np.zeros(4, "float32"))
+            time.sleep(0.05)
+            ctx = TraceContext.new()
+            victim = srv.submit("m", np.zeros(4, "float32"),
+                                deadline_ms=1.0, trace=ctx)
+            blocker.result(30.0)
+            assert victim.error() is not None
+            assert victim.outcome() == "expired"
+    finally:
+        srv.close(timeout=10.0)
+    rt = tracer.get(ctx.trace_id)
+    assert rt is not None and rt.kept and rt.outcome == "expired"
+    stages = {s["stage"] for s in rt.spans}
+    assert "admission" in stages and "queue" in stages
+    assert "forward" not in stages          # never reached the device
+
+
+def test_exemplar_resolves_in_ring(tiny):
+    obs_metrics.REGISTRY.clear_values()
+    srv, tracer = _server(tiny)
+    try:
+        ctx = TraceContext.new()
+        srv.predict("m", np.zeros(4, "float32"), trace=ctx, timeout=30.0)
+    finally:
+        srv.close(timeout=10.0)
+    ex = catalog.SERVE_LATENCY.exemplars(model="m")
+    assert ex, "no exemplar attached to the latency histogram"
+    tid = list(ex.values())[0]["trace_id"]
+    rt = tracer.get(tid)
+    assert rt is not None and rt.outcome == "ok"
+
+
+def test_tracing_disabled_is_a_noop(tiny):
+    tracer = Tracer(capacity=64, sample=1.0)
+    srv = ModelServer([_cfg(tiny, trace=False)], tracer=tracer).start(
+        warm=True)
+    try:
+        srv.predict("m", np.zeros(4, "float32"), timeout=30.0)
+    finally:
+        srv.close(timeout=10.0)
+    assert tracer.depth == 0
+
+
+# ----------------------------------------------------------------- the SLO
+def test_slo_burn_math_and_edge_trigger():
+    clock = [0.0]
+    t = SLOTracker("slom", p99_ms=10.0, availability=0.9,
+                   fast_window_s=60.0, slow_window_s=600.0,
+                   burn_threshold=2.0, clock=lambda: clock[0])
+    r0 = catalog.PERF_REGRESSIONS.value(metric="slo_burn_rate")
+    for _ in range(30):
+        clock[0] += 0.1
+        t.record("ok", 1.0)
+    assert t.burn_rates() == {"fast": 0.0, "slow": 0.0}
+    assert not t.breaches
+    # slow successes burn the budget exactly like sheds
+    for _ in range(30):
+        clock[0] += 0.1
+        t.record("ok", 50.0)               # past the 10ms objective
+    rates = t.burn_rates()
+    # 30 bad / 60 events in window, budget 0.1 -> burn 5.0
+    assert rates["fast"] == pytest.approx(5.0, abs=0.5)
+    assert len(t.breaches) == 1            # edge-triggered: ONE event
+    assert catalog.PERF_REGRESSIONS.value(metric="slo_burn_rate") == r0 + 1
+    assert catalog.SLO_BURN.value(model="slom", window="fast") > 2.0
+    # recover: burn falls back under the threshold, trigger re-arms
+    for _ in range(600):
+        clock[0] += 0.2
+        t.record("ok", 1.0)
+    assert t.burn_rates()["fast"] < 2.0
+    for _ in range(150):
+        clock[0] += 0.1
+        t.record("shed")
+    assert len(t.breaches) == 2
+    assert catalog.PERF_REGRESSIONS.value(metric="slo_burn_rate") == r0 + 2
+
+
+def test_slo_needs_min_events_before_firing():
+    clock = [0.0]
+    t = SLOTracker("slom2", p99_ms=10.0, availability=0.9,
+                   burn_threshold=1.0, clock=lambda: clock[0])
+    for _ in range(10):                    # all bad, but under the gate
+        clock[0] += 0.1
+        t.record("error")
+    assert not t.breaches
+
+
+def test_perfwatch_normalizes_and_directions_slo_burn():
+    from mxnet_tpu.observability import perfwatch
+    assert perfwatch.METRIC_DIRECTIONS["slo_burn_rate"] == -1
+    snap = {"metrics": {"mxtpu_slo_burn_rate": {"series": [
+        {"labels": {"model": "m", "window": "fast"}, "value": 3.5},
+        {"labels": {"model": "m", "window": "slow"}, "value": 1.0},
+    ]}}}
+    norm = perfwatch.normalize(snap, source="<test>")
+    assert norm["metrics"]["slo_burn_rate"] == 3.5   # worst series wins
+
+
+# ------------------------------------------------- flight-recorder spine
+def test_flight_record_embeds_active_trace_id():
+    from mxnet_tpu.observability.flight_recorder import FlightRecorder
+    fr = FlightRecorder(capacity=8)
+    ctx = TraceContext.new()
+    with tracing.use(ctx):
+        fr.record(1, loss=0.5)
+    fr.record(2, loss=0.4)                 # outside any context
+    recs = fr.records()
+    assert recs[0]["trace_id"] == ctx.trace_id
+    assert "trace_id" not in recs[1]
+
+
+# ---------------------------------------------------------- chrome export
+def test_chrome_export_shares_one_clock(tiny):
+    import jax
+
+    from mxnet_tpu import profiler
+    from mxnet_tpu.observability import jit_hooks
+    # force at least one fresh compile event into the jit ring
+    jax.jit(lambda x: x * 2 + 1)(np.arange(3, dtype=np.float32))
+    assert jit_hooks.recent_compile_events(), "no jit events recorded"
+
+    profiler.start()
+    try:
+        srv, tracer = _server(tiny)
+        try:
+            srv.predict("m", np.zeros(4, "float32"), timeout=30.0)
+        finally:
+            srv.close(timeout=10.0)
+        doc = tracer.chrome_trace()
+    finally:
+        profiler.stop()
+        profiler._prof.events = []
+    cats = {e.get("cat") for e in doc["traceEvents"]}
+    assert "serving" in cats and "jit" in cats
+    serving = [e for e in doc["traceEvents"] if e.get("cat") == "serving"]
+    assert any(e["args"].get("trace_id") for e in serving)
+    # shared clock: every serving span of this just-served request sits
+    # AFTER the profiler session's zero (positive us) and within a sane
+    # horizon of it — not in some other epoch
+    for e in serving:
+        assert -1e6 < e["ts"] < 600e6
+    # the live profiler stream ALSO carries the mirrored spans (merged
+    # timeline without calling chrome_trace at all)
+    names = {e.get("name") for e in doc["traceEvents"]}
+    assert "serve:forward" in names
+
+
+# ------------------------------------------------------------- HTTP edge
+def test_endpoints_propagate_traceparent_and_retry_after(tiny):
+    srv, tracer = _server(tiny)
+    eps = ServingEndpoints(srv).start()
+    base = "http://127.0.0.1:%d" % eps.port
+    try:
+        ctx = TraceContext.new()
+        body = json.dumps({"model": "m",
+                           "data": [0.0, 0.0, 0.0, 0.0]}).encode()
+        req = urllib.request.Request(
+            base + "/predict", data=body,
+            headers={"Content-Type": "application/json",
+                     "traceparent": ctx.to_traceparent()})
+        with urllib.request.urlopen(req, timeout=30.0) as resp:
+            doc = json.loads(resp.read())
+            # the server-side hop: same trace, echoed traceparent
+            assert doc["trace_id"] == ctx.trace_id
+            echoed = TraceContext.parse(resp.headers["traceparent"])
+            assert echoed.trace_id == ctx.trace_id
+        # the timeline continued OUR trace id end-to-end
+        assert tracer.get(ctx.trace_id) is not None
+
+        # a draining server answers 503 WITH the trace id and Retry-After
+        srv.begin_drain()
+        req = urllib.request.Request(
+            base + "/predict", data=body,
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=30.0)
+        err = ei.value
+        assert err.code == 503
+        assert err.headers["Retry-After"] == "5"
+        edoc = json.loads(err.read())
+        assert edoc["type"] == "Draining" and edoc["trace_id"]
+        assert TraceContext.parse(err.headers["traceparent"]) is not None
+    finally:
+        eps.stop()
+        srv.close(timeout=10.0)
+
+
+def test_endpoints_malformed_traceparent_degrades_to_fresh(tiny):
+    srv, _ = _server(tiny)
+    eps = ServingEndpoints(srv).start()
+    base = "http://127.0.0.1:%d" % eps.port
+    try:
+        body = json.dumps({"model": "m",
+                           "data": [0.0, 0.0, 0.0, 0.0]}).encode()
+        req = urllib.request.Request(
+            base + "/predict", data=body,
+            headers={"Content-Type": "application/json",
+                     "traceparent": "not-a-traceparent"})
+        with urllib.request.urlopen(req, timeout=30.0) as resp:
+            doc = json.loads(resp.read())
+            assert len(doc["trace_id"]) == 32       # fresh, not a 500
+    finally:
+        eps.stop()
+        srv.close(timeout=10.0)
+
+
+def test_aborted_forward_still_sums_in_isolation_expiry(tiny):
+    """A request that expires during fault isolation (forward attempted,
+    batch failed, never re-dispatched) still reconstructs: the failed
+    attempt lands as an aborted forward span and the spans sum to the
+    latency — the fault+deadline tail is exactly what the tool debugs."""
+    srv, tracer = _server(tiny)
+    try:
+        ctxs = [TraceContext.new() for _ in range(2)]
+        with schaos.slow_executor(srv, "m", 0.1):
+            # the blocker occupies the worker so BOTH victims queue up
+            # and assemble into one batch behind it
+            blocker = srv.submit("m", np.zeros(4, "float32"))
+            time.sleep(0.03)
+            with schaos.executor_fault(srv, "m", faults=1,
+                                       transient=False):
+                futs = [srv.submit("m", np.zeros(4, "float32"),
+                                   deadline_ms=150.0, trace=c)
+                        for c in ctxs]
+                blocker.result(30.0)
+                outcomes = set()
+                for f in futs:
+                    f.error()
+                    outcomes.add(f.outcome())
+    finally:
+        srv.close(timeout=10.0)
+    # at least one batchmate expired during isolation (the first
+    # isolated re-dispatch eats the rest of the budget)
+    assert "expired" in outcomes, outcomes
+    for c in ctxs:
+        rt = tracer.get(c.trace_id)
+        assert rt is not None and rt.kept
+        assert sum(rt.stage_ms().values()) == pytest.approx(
+            rt.latency_ms, rel=1e-6)
+        if rt.outcome == "expired":
+            [fwd] = [s for s in rt.spans if s["stage"] == "forward"]
+            assert fwd["tags"].get("aborted") is True
+
+
+# ------------------------------------------------------------ mxlint T216
+@pytest.mark.lint
+def test_mxl_t216_fires_on_ring_disabled(tiny, monkeypatch):
+    """MXNET_TRACE_RING=0 disables tracing process-wide: a config with
+    objectives fires T216 even with trace=True and a nonzero sample."""
+    from mxnet_tpu import analysis
+    monkeypatch.setenv("MXNET_TRACE_RING", "0")
+    rep = analysis.lint_server(_cfg(tiny))
+    assert [d.rule_id for d in rep.findings] == ["MXL-T216"]
+    assert "MXNET_TRACE_RING" in rep.findings[0].message
+    monkeypatch.setenv("MXNET_TRACE_RING", "512")
+    assert not analysis.lint_server(_cfg(tiny)).findings
+
+
+@pytest.mark.lint
+def test_mxl_t216_fires_silent_suppressed(tiny):
+    from mxnet_tpu import analysis
+    # fires: deadline declared, tracing off
+    rep = analysis.lint_server(_cfg(tiny, trace=False))
+    assert [d.rule_id for d in rep.findings] == ["MXL-T216"]
+    assert "disabled" in rep.findings[0].message
+    # fires: SLO declared, sample rate 0
+    rep = analysis.lint_server(_cfg(tiny, trace_sample=0.0,
+                                    slo_p99_ms=50.0))
+    assert [d.rule_id for d in rep.findings] == ["MXL-T216"]
+    assert "sampled at 0" in rep.findings[0].message
+    # silent: tracing on at a nonzero rate
+    rep = analysis.lint_server(_cfg(tiny))
+    assert not rep.by_rule("MXL-T216")
+    # silent: no objectives declared (deadline 0 fires T214, never T216)
+    rep = analysis.lint_server(_cfg(tiny, deadline_ms=0.0, trace=False))
+    assert not rep.by_rule("MXL-T216")
+    assert rep.by_rule("MXL-T214")
+    # suppressed: the finding moves to the suppressed list
+    rep = analysis.lint_server(_cfg(tiny, trace=False),
+                               suppress=("MXL-T216",))
+    assert not rep.findings
+    assert any(d.rule_id == "MXL-T216" for d in rep.suppressed)
+
+
+# ------------------------------------------------------------- HLO guard
+def test_served_graph_hlo_identical_with_tracing_on_off(tiny, monkeypatch):
+    """Tracing is host-side by construction: the served graph lowered
+    with tracing active (env on + a live context) is bitwise-identical
+    StableHLO to tracing disabled."""
+    import jax
+
+    from mxnet_tpu import symbol as sym_mod
+    from mxnet_tpu.executor import _GraphLowering
+
+    sym_json, _, feat, _ = tiny
+
+    def lowered_text():
+        sym = sym_mod.load_json(sym_json)
+        fn = _GraphLowering(sym).lower(is_train=False)
+        inputs = {"data": np.zeros((2,) + feat, np.float32),
+                  "fc1_weight": np.zeros((3, feat[0]), np.float32),
+                  "fc1_bias": np.zeros((3,), np.float32)}
+        return jax.jit(fn).lower(inputs, jax.random.PRNGKey(0)).as_text()
+
+    monkeypatch.setenv("MXNET_SERVE_TRACE", "1")
+    with tracing.use(TraceContext.new()):
+        on = lowered_text()
+    monkeypatch.setenv("MXNET_SERVE_TRACE", "0")
+    off = lowered_text()
+    assert on == off
+
+
+# ------------------------------------------------------- THE acceptance
+@pytest.mark.chaos
+def test_storm_yields_timelines_exemplar_chrome_and_slo_breach(
+        tiny, tmp_path):
+    """Acceptance: one run_load storm against a chaos-faulted server
+    produces (a) reconstructable per-request timelines for every
+    retained tail/error trace (stage spans summing to the request
+    latency), (b) a latency exemplar whose trace_id resolves in the
+    ring, (c) a chrome export with serving spans, and (d) the SLO burn
+    rate crossing its threshold under the injected breach after staying
+    silent at baseline — flagged through the perfwatch regression
+    counter."""
+    obs_metrics.REGISTRY.clear_values()
+    tracer = Tracer(capacity=512, sample=1.0)
+    cfg = _cfg(tiny, max_queue=32, deadline_ms=250.0,
+               slo_p99_ms=40.0, slo_availability=0.9)
+    srv = ModelServer([cfg], tracer=tracer).start(warm=True)
+    r0 = catalog.PERF_REGRESSIONS.value(metric="slo_burn_rate")
+    try:
+        # baseline: healthy traffic, SLO silent
+        base = sload.run_load(srv, "m", qps=60, duration_s=0.8)
+        assert base["ok"] > 0
+        st = srv.stats("m")
+        assert st["slo"]["breaches"] == 0
+        assert catalog.PERF_REGRESSIONS.value(
+            metric="slo_burn_rate") == r0
+
+        # the breach: a contended executor pushes p99 past the 40ms
+        # objective and expires deadline-bound work
+        with schaos.slow_executor(srv, "m", 0.06):
+            storm = sload.run_load(srv, "m", qps=120, duration_s=1.2,
+                                   deadline_ms=250.0)
+    finally:
+        stats = srv.stats("m")
+        srv.close(timeout=15.0)
+
+    # (d) the SLO fired under the breach
+    assert stats["slo"]["breaches"] >= 1
+    assert catalog.PERF_REGRESSIONS.value(metric="slo_burn_rate") > r0
+    assert catalog.SLO_BURN.value(model="m", window="fast") is not None
+
+    # (a) every retained trace reconstructs: spans sum to its latency
+    retained = tracer.traces(model="m")
+    assert retained
+    for rt in retained:
+        if rt.outcome == "ok" and rt.spans:
+            assert sum(rt.stage_ms().values()) == pytest.approx(
+                rt.latency_ms, rel=1e-6)
+    # expired/shed traces (if the storm produced any) are all retained
+    # with a reconstructable prefix of the lifecycle
+    for rt in retained:
+        if rt.outcome != "ok":
+            assert rt.kept and rt.spans
+
+    # the storm's reported evidence resolves in the ring
+    for t in storm["slow_traces"]:
+        rt = tracer.get(t["trace_id"])
+        assert rt is not None
+        assert rt.latency_ms == pytest.approx(t["ms"], abs=2.0)
+
+    # (b) the exemplar resolves to a concrete timeline
+    ex = catalog.SERVE_LATENCY.exemplars(model="m")
+    assert ex
+    tid = sorted(ex.items())[-1][1]["trace_id"]
+    assert tracer.get(tid) is not None
+
+    # (c) chrome export carries the serving lanes
+    doc = tracer.chrome_trace(include_profiler=False)
+    serving = [e for e in doc["traceEvents"] if e["cat"] == "serving"]
+    assert {e["name"] for e in serving} >= {"queue", "forward"}
+
+    # the dump artifact round-trips through the mxtrace loader
+    dump = tmp_path / "traces.json"
+    tracer.write_dump(str(dump))
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        import mxtrace
+        loaded = mxtrace.load(str(dump))
+    finally:
+        sys.path.pop(0)
+    assert len(loaded["traces"]) == len(retained)
+
+
+# --------------------------------------------------------------- catalog
+def test_trace_families_predeclared_in_snapshot():
+    snap = obs_metrics.snapshot()["metrics"]
+    for fam in ("mxtpu_trace_spans_total", "mxtpu_trace_ring_depth",
+                "mxtpu_trace_dropped_total", "mxtpu_slo_burn_rate"):
+        assert fam in snap, fam
+
+
+def test_storm_reports_trace_evidence_keys(tiny):
+    srv, _ = _server(tiny)
+    try:
+        stats = schaos.request_storm(srv, "m", np.zeros(4, "float32"),
+                                     qps=40, duration_s=0.4)
+    finally:
+        srv.close(timeout=10.0)
+    assert stats["ok"] > 0
+    assert stats["slow_traces"] and "trace_id" in stats["slow_traces"][0]
+    assert stats["failed_traces"] == []

@@ -53,9 +53,16 @@ def launch_local(num_workers, command, coordinator="127.0.0.1:9870"):
             p.terminate()
         sys.exit(1)
 
-    signal.signal(signal.SIGINT, _kill)
-    signal.signal(signal.SIGTERM, _kill)
-    codes = [p.wait() for p in procs]
+    prev_int = signal.signal(signal.SIGINT, _kill)
+    prev_term = signal.signal(signal.SIGTERM, _kill)
+    try:
+        codes = [p.wait() for p in procs]
+    finally:
+        # restore the caller's handlers: leaking _kill process-wide
+        # turns any later KeyboardInterrupt delivery (e.g. the step
+        # watchdog's interrupt_main) into a silent SystemExit
+        signal.signal(signal.SIGINT, prev_int)
+        signal.signal(signal.SIGTERM, prev_term)
     return max(codes) if codes else 0
 
 

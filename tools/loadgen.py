@@ -69,6 +69,11 @@ def main(argv=None) -> int:
                     help="cost-ledger path for the serving row (default: "
                          "MXNET_PERF_LEDGER; empty default = row printed "
                          "but not persisted)")
+    ap.add_argument("--trace-dump", default=None, metavar="PATH",
+                    help="selfhost: write the trace ring to PATH after "
+                         "the run (pretty-print with tools/mxtrace.py) — "
+                         "the retained tail/error timelines behind the "
+                         "reported trace_ids")
     ap.add_argument("--format", choices=("text", "json"), default="text")
     args = ap.parse_args(argv)
 
@@ -102,6 +107,14 @@ def _emit(args, stats, row, verdict) -> None:
                  stats.get("error", 0), stats.get("unfinished", 0),
                  stats.get("p50_ms", float("nan")),
                  stats.get("p99_ms", float("nan"))), flush=True)
+        # clickable evidence, not bare percentiles: the slowest/failed
+        # requests' trace_ids resolve in the trace ring (--trace-dump +
+        # tools/mxtrace.py --trace-id <id>)
+        for t in stats.get("slow_traces") or []:
+            print("loadgen: slow   trace %s  %.2fms"
+                  % (t["trace_id"], t["ms"]), flush=True)
+        for tid in stats.get("failed_traces") or []:
+            print("loadgen: failed trace %s" % tid, flush=True)
 
 
 def _run_selfhost(args, qps) -> int:
@@ -129,10 +142,18 @@ def _run_selfhost(args, qps) -> int:
                                deadline_ms=args.deadline_ms)
     finally:
         server.close(timeout=15.0)
+    if args.trace_dump:
+        try:
+            server.dump_traces(args.trace_dump)
+        except Exception as e:
+            sys.stderr.write("loadgen: trace dump failed: %r\n" % e)
     ledger = (xcost.CostLedger(args.ledger) if args.ledger
               else xcost.get_ledger())
     row = sload.ledger_row(stats, ledger=ledger,
-                           extra={"target": "selfhost"})
+                           extra={"target": "selfhost",
+                                  "slow_traces": stats.get("slow_traces"),
+                                  "failed_traces":
+                                      stats.get("failed_traces")})
     v = sload.verdict(stats, max_degraded_frac=args.max_degraded_frac)
     _emit(args, stats, row, v)
     return 0 if v == "ok" else 1
@@ -169,10 +190,13 @@ def _run_http(args, qps) -> int:
         sys.stderr.write("loadgen: target unreachable: %r\n" % e)
         return 2
 
-    from mxnet_tpu.serving.chaos import paced_run
+    from mxnet_tpu.observability.tracing import TraceContext
+    from mxnet_tpu.serving.chaos import paced_run, trace_evidence
 
     lock = threading.Lock()
     last_done = [None]
+    slow = []      # (ms, trace_id) of ok completions
+    failed = []    # trace_ids of expired/errored requests
     stats = {"submitted": 0, "ok": 0, "shed": 0, "expired": 0, "error": 0,
              "unfinished": 0, "latencies_ms": [], "qps_offered": qps,
              "duration_s": args.duration, "model": args.model,
@@ -181,17 +205,23 @@ def _run_http(args, qps) -> int:
     def fire():
         with lock:
             stats["submitted"] += 1
+        # every request carries a W3C traceparent: the server's span
+        # timeline continues OUR trace_id, so the slowest/failed ids
+        # reported below resolve in the server's trace ring
+        ctx = TraceContext.new()
         t0 = time.monotonic()
         try:
             req = urllib.request.Request(
                 url, data=payload,
-                headers={"Content-Type": "application/json"})
+                headers={"Content-Type": "application/json",
+                         "traceparent": ctx.to_traceparent()})
             urllib.request.urlopen(req, timeout=30.0).read()
             t_done = time.monotonic()
             ms = (t_done - t0) * 1e3
             with lock:
                 stats["ok"] += 1
                 stats["latencies_ms"].append(ms)
+                slow.append((ms, ctx.trace_id))
                 last_done[0] = (t_done if last_done[0] is None
                                 else max(last_done[0], t_done))
         except urllib.error.HTTPError as e:
@@ -199,6 +229,8 @@ def _run_http(args, qps) -> int:
                    else "expired" if e.code == 504 else "error")
             with lock:
                 stats[key] += 1
+                if key in ("expired", "error"):
+                    failed.append(ctx.trace_id)
         except (TimeoutError, socket.timeout):
             # the server never answered within the client timeout: slow,
             # verdict unknown — same taxonomy as request_storm, never
@@ -207,12 +239,15 @@ def _run_http(args, qps) -> int:
                 stats["unfinished"] += 1
         except urllib.error.URLError as e:
             with lock:
-                stats["unfinished" if isinstance(
-                    e.reason, (TimeoutError, socket.timeout))
-                    else "error"] += 1
+                if isinstance(e.reason, (TimeoutError, socket.timeout)):
+                    stats["unfinished"] += 1
+                else:
+                    stats["error"] += 1
+                    failed.append(ctx.trace_id)
         except Exception:
             with lock:
                 stats["error"] += 1
+                failed.append(ctx.trace_id)
 
     from mxnet_tpu.observability import xcost
     from mxnet_tpu.serving import load as sload
@@ -225,9 +260,13 @@ def _run_http(args, qps) -> int:
     # fractions, percentiles — identical to the selfhost path
     sload.finalize_load_stats(stats, t_start=t0, last_done=last_done[0],
                               wall_s=max(1e-9, time.monotonic() - t0))
+    stats.update(trace_evidence(slow, failed))
     ledger = (xcost.CostLedger(args.ledger) if args.ledger
               else xcost.get_ledger())
-    row = sload.ledger_row(stats, ledger=ledger, extra={"target": args.url})
+    row = sload.ledger_row(stats, ledger=ledger,
+                           extra={"target": args.url,
+                                  "slow_traces": stats["slow_traces"],
+                                  "failed_traces": stats["failed_traces"]})
     v = sload.verdict(stats, max_degraded_frac=args.max_degraded_frac)
     _emit(args, stats, row, v)
     return 0 if v == "ok" else 1

@@ -311,9 +311,12 @@ def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else list(argv)
     if argv and argv[0] == "perf":
         return _perf_main(argv[1:])
+    if argv and argv[0] == "trace":
+        return _trace_main(argv[1:])
     ap = argparse.ArgumentParser(
         description="pretty-print mxnet_tpu telemetry snapshots and "
-                    "flight recordings (see also: mxtop.py perf)")
+                    "flight recordings (see also: mxtop.py perf, "
+                    "mxtop.py trace)")
     ap.add_argument("path", help="metrics snapshot JSON or flight-recorder "
                                  "dump JSON")
     ap.add_argument("--format", choices=("text", "json"), default="text")
@@ -341,6 +344,40 @@ def _watch_loop(render, interval: float) -> int:
             time.sleep(interval)
     except KeyboardInterrupt:
         return rc
+
+
+def _trace_main(argv) -> int:
+    """`mxtop.py trace DUMP` — the trace-ring summary view (outcome
+    counts + slowest retained traces). The full toolbox (single-timeline
+    view, chrome export, filters) is tools/mxtrace.py; this is the
+    at-a-glance row next to mxtop's other views."""
+    ap = argparse.ArgumentParser(
+        prog="mxtop.py trace",
+        description="trace-ring summary (see tools/mxtrace.py for "
+                    "timelines and chrome export)")
+    ap.add_argument("path", help="trace-ring dump JSON "
+                                 "(ModelServer.dump_traces / "
+                                 "loadgen --trace-dump)")
+    ap.add_argument("--tail", type=int, default=10,
+                    help="slowest traces to show (default 10)")
+    ap.add_argument("--watch", type=float, metavar="SECONDS", default=0,
+                    help="re-render every N seconds; Ctrl-C to stop")
+    args = ap.parse_args(argv)
+
+    def render() -> int:
+        try:
+            import mxtrace
+            doc = mxtrace.load(args.path)
+        except (ImportError, OSError, ValueError) as e:
+            sys.stderr.write("mxtop trace: cannot read %s: %s\n"
+                             % (args.path, e))
+            return 2
+        return mxtrace.render_summary(doc, doc.get("traces") or [],
+                                      sys.stdout, args.tail)
+
+    if args.watch > 0:
+        return _watch_loop(render, args.watch)
+    return render()
 
 
 def _perf_main(argv) -> int:

@@ -31,7 +31,8 @@ __all__ = ["MARKERS", "reg_dir", "register", "owned_pids", "kill"]
 # cmdline substrings that identify a tunnel-client python process — the
 # same marker list bench.py scans /proc for
 MARKERS = ("aot_warm.py", "perf_lab.py", "mxtune.py", "collbench.py",
-           "mxserve.py", "loadgen.py", "mxquant.py", "tpu_session")
+           "mxserve.py", "loadgen.py", "mxquant.py", "mxtrace.py",
+           "tpu_session")
 
 
 def reg_dir() -> str:
