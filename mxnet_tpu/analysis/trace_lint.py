@@ -187,6 +187,17 @@ register_rule(
     "(MXNET_TRACE_SAMPLE); error/shed/expired and tail traces are "
     "always retained regardless of the rate.")
 register_rule(
+    "MXL-T217", "warning", "unisolated-multi-tenant-fleet",
+    "Multiple models share one serving process with no tenant isolation "
+    "declared: either no fleet controller is attached (no per-tenant "
+    "quotas, fair-share weights or priority classes — one tenant's storm "
+    "is every tenant's outage), or a fleet controller autoscales a "
+    "tenant that declares no SLO (the burn-rate evaluator is blind to "
+    "it: it can neither grow the tenant when it suffers nor trust it as "
+    "a donor). Attach a FleetController with TenantPolicy(quota_qps=/"
+    "priority=) per model, and give every autoscaled tenant a "
+    "ModelConfig(slo_p99_ms=) objective.")
+register_rule(
     "MXL-T211", "warning", "untuned-hot-loop",
     "The trainer runs with all-default perf levers while the autotuner "
     "cache holds a measured best config for the same model/device "
@@ -573,13 +584,15 @@ def lint_data_iter(data_iter, *, suppress: Sequence[str] = (),
 
 def lint_server(server_or_config, *, suppress: Sequence[str] = (),
                 subject: str = "") -> Report:
-    """Lint a serving configuration for overload-safety and
-    observability (MXL-T214 / MXL-T215 / MXL-T216).
+    """Lint a serving configuration for overload-safety, observability
+    and tenant isolation (MXL-T214 / MXL-T215 / MXL-T216 / MXL-T217).
 
     Accepts a :class:`~mxnet_tpu.serving.server.ModelServer` (every model
-    is checked) or a single
-    :class:`~mxnet_tpu.serving.server.ModelConfig`. A pure config check —
-    nothing is started or dispatched. Fires once per hazard per model:
+    is checked), a :class:`~mxnet_tpu.serving.fleet.FleetController`
+    (its server is checked, with the fleet's policies in view), or a
+    single :class:`~mxnet_tpu.serving.server.ModelConfig`. A pure config
+    check — nothing is started or dispatched. Fires once per hazard per
+    model:
 
     - ``max_queue`` unset/0 → unbounded queue: overload becomes unbounded
       memory + latency instead of a typed ``Overloaded``;
@@ -587,19 +600,75 @@ def lint_server(server_or_config, *, suppress: Sequence[str] = (),
       is waiting for anymore still occupies the chip.
     """
     configs = []
+    if hasattr(server_or_config, "policy") \
+            and hasattr(server_or_config, "server"):
+        # a FleetController: lint its server with the policies in view
+        server_or_config = server_or_config.server
     if hasattr(server_or_config, "models") \
             and hasattr(server_or_config, "config"):
         configs = [server_or_config.config(m)
                    for m in server_or_config.models()]
         name = type(server_or_config).__name__
+        fleet = getattr(server_or_config, "_fleet", None)
     elif hasattr(server_or_config, "max_queue"):
         configs = [server_or_config]
         name = "ModelConfig"
+        fleet = None
     else:
-        raise TypeError("lint_server expects a ModelServer or ModelConfig, "
-                        "got %r" % type(server_or_config).__name__)
+        raise TypeError("lint_server expects a ModelServer, "
+                        "FleetController or ModelConfig, got %r"
+                        % type(server_or_config).__name__)
     report = Report(subject or f"serving config ({name})", "trace")
     report.set_suppressions(suppress)
+    # ---- unisolated multi-tenant fleet (MXL-T217), server-level half:
+    # >= 2 models share the process but nothing separates their traffic —
+    # no fleet attached, or a fleet whose policies declare no quota and
+    # one single priority class (nothing to shed, nothing to preempt).
+    # A single-model server, or a fleet with a quota or mixed priorities,
+    # stays silent.
+    if len(configs) >= 2:
+        pols = (list(fleet._policies.values())
+                if fleet is not None else [])
+        isolated = any(p.quota_qps > 0 for p in pols) \
+            or len({p.priority for p in pols}) > 1
+        if not isolated:
+            how = ("no fleet controller attached" if fleet is None else
+                   "the attached fleet declares no per-tenant quota and "
+                   "a single priority class")
+            report.add(Diagnostic(
+                "MXL-T217",
+                "%d models share this serving process with no tenant "
+                "isolation (%s): one tenant's request storm consumes "
+                "the shared queue/worker capacity and becomes every "
+                "tenant's outage" % (len(configs), how),
+                location="server",
+                hint="attach a FleetController with per-tenant "
+                     "TenantPolicy(quota_qps=, priority=) — "
+                     "docs/serving.md, 'Multi-tenant fleet'"))
+    for cfg in configs:
+        loc = f"model {cfg.name!r}"
+        # ---- MXL-T217, tenant-level half: the fleet may autoscale this
+        # tenant (its floor/ceiling leave room to move) but the tenant
+        # declares no SLO — the burn-rate evaluator is blind to it
+        if fleet is not None:
+            pol = fleet._policies.get(cfg.name)
+            autoscaled = pol is not None and (
+                pol.ceiling_chips is None
+                or pol.ceiling_chips > pol.floor_chips)
+            if autoscaled and not float(
+                    getattr(cfg, "slo_p99_ms", 0.0) or 0.0):
+                report.add(Diagnostic(
+                    "MXL-T217",
+                    "tenant %r is autoscaled (floor %d, ceiling %r) but "
+                    "declares no SLO: the burn-rate evaluator can "
+                    "neither detect its excursions nor safely use it as "
+                    "a donor" % (cfg.name, pol.floor_chips,
+                                 pol.ceiling_chips),
+                    location=loc,
+                    hint="declare ModelConfig(slo_p99_ms=) for every "
+                         "autoscaled tenant, or pin ceiling_chips == "
+                         "floor_chips — docs/serving.md, 'Multi-tenant "
+                         "fleet'"))
     for cfg in configs:
         loc = f"model {cfg.name!r}"
         if not int(getattr(cfg, "max_queue", 0) or 0):

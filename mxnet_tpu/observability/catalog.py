@@ -231,6 +231,38 @@ SERVE_QUEUE_DEPTH = _m.gauge(
     "Requests queued per model at last admission/dispatch, labeled "
     "model=. Pinned at the queue bound = shedding load.")
 
+# ----------------------------------------------------------------- fleet
+FLEET_RESIZES = _m.counter(
+    "mxtpu_fleet_resizes_total",
+    "Fleet chip reallocations (serving/fleet.py FleetController), "
+    "labeled direction=grow|shrink — one increment per model whose chip "
+    "assignment changed (a reallocation pair bumps grow once and shrink "
+    "once). Hysteresis (MXNET_FLEET_DWELL_S) bounds the rate; a counter "
+    "climbing faster than one per dwell window per model is thrash.")
+FLEET_ACTIVE_CHIPS = _m.gauge(
+    "mxtpu_fleet_active_chips",
+    "Chips currently assigned to each serving tenant, labeled model=. "
+    "The fleet placement map in gauge form; sums to at most the fleet's "
+    "total_chips budget.")
+FLEET_PREEMPTED = _m.counter(
+    "mxtpu_fleet_preempted_total",
+    "Best-effort requests shed with typed Preempted (admission or queue "
+    "eviction) because a guaranteed tenant was in an SLO excursion, "
+    "labeled tenant=. Never silent: every preempted request's future "
+    "completes with the typed error.")
+FLEET_QUOTA_SHEDS = _m.counter(
+    "mxtpu_fleet_quota_sheds_total",
+    "Requests shed with typed QuotaExceeded at fleet admission because "
+    "the tenant exceeded its declared QPS quota, labeled tenant=. "
+    "Attributes overload to the tenant that over-drove, not to server "
+    "capacity (which lands in mxtpu_serve_requests_total{outcome=shed}).")
+FLEET_RESIZE_MS = _m.histogram(
+    "mxtpu_fleet_resize_ms",
+    "Wall time of one fleet resize: quiesce the replica's in-flight "
+    "batch + re-bind the bucket executor ladder for the new chip count "
+    "(params stay placed; buckets recompile lazily on next use).",
+    buckets=(0.1, 0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 1000))
+
 # --------------------------------------------------------------- tracing
 TRACE_SPANS = _m.counter(
     "mxtpu_trace_spans_total",

@@ -129,6 +129,20 @@ def normalize(doc: Any, source: str = "") -> Optional[Dict[str, Any]]:
         return {"kind": "serving_row", "source": source, "metrics": vals,
                 "model": doc.get("model"),
                 "provenance": doc.get("provenance")}
+    if doc.get("label") == "fleet" and doc.get("qps") is not None:
+        # mixed-tenant fleet ledger row (serving/load.py fleet_row):
+        # aggregate qps plus bracketed per-tenant metrics — `p99_ms[a]`
+        # compares with `p99_ms`'s direction (down-is-good), so tenants
+        # come and go without touching METRIC_DIRECTIONS
+        vals = {}
+        for k, v in doc.items():
+            base_name = k.split("[", 1)[0]
+            if base_name in METRIC_DIRECTIONS and v is not None \
+                    and isinstance(v, (int, float)):
+                vals[k] = float(v)
+        return {"kind": "fleet_row", "source": source, "metrics": vals,
+                "tenants": doc.get("tenants"),
+                "provenance": doc.get("provenance")}
     if doc.get("label") == "quant" and (
             doc.get("int8_ms") is not None or doc.get("f32_ms") is not None):
         # quantization ledger row (quant.compare_latency / bench.py int8
@@ -206,14 +220,24 @@ def compare(current: Dict[str, Any], baseline: Dict[str, Any],
     cur = current.get("metrics", current) or {}
     base = baseline.get("metrics", baseline) or {}
     checks: List[Dict[str, Any]] = []
-    for metric, direction in METRIC_DIRECTIONS.items():
+    # iterate the union of both sides' metric names (sorted for stable
+    # report order): bracketed per-tenant names — `p99_ms[a]` from fleet
+    # rows — inherit the base metric's direction, unknown names skip
+    for metric in sorted(set(base) | set(cur)):
+        direction = METRIC_DIRECTIONS.get(metric)
+        if direction is None:
+            direction = METRIC_DIRECTIONS.get(metric.split("[", 1)[0])
+        if direction is None:
+            continue
         b, c = base.get(metric), cur.get(metric)
         if b is None or c is None or float(b) == 0.0:
             continue
         b, c = float(b), float(c)
         delta_pct = (c - b) / abs(b) * 100.0
         worse_pct = -delta_pct if direction > 0 else delta_pct
-        thr = float(thresholds.get(metric, default_pct))
+        thr = float(thresholds.get(metric,
+                                   thresholds.get(metric.split("[", 1)[0],
+                                                  default_pct)))
         checks.append({"metric": metric, "baseline": b, "current": c,
                        "delta_pct": round(delta_pct, 3),
                        "threshold_pct": thr,

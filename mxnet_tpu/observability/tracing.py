@@ -363,6 +363,22 @@ class Tracer:
             _catalog.TRACE_RING_DEPTH.set(depth)
         return why is not None
 
+    def record_event(self, name: str, model: str = "fleet",
+                     **tags) -> Optional[RequestTrace]:
+        """Record an operational event (e.g. a fleet chip resize) into
+        the trace ring as a zero-length span with outcome ``"event"`` —
+        always retained by the tail-sampler (non-ok outcomes are forced),
+        so ``tools/mxtrace.py`` shows resizes inline with the request
+        timelines they reshaped (without counting them as anomalies).
+        Returns the retained trace, or None when tracing is off."""
+        if not self.enabled():
+            return None
+        rt = RequestTrace(model)
+        t = time.monotonic()
+        rt.span(name, t, t, **tags)
+        self.finish(rt, "event", latency_ms=0.0, reason=name)
+        return rt
+
     def _mirror_profiler(self, rt: RequestTrace) -> None:
         """When a profiler session is recording, emit every stage span
         into its chrome-trace stream (same us clock as every other
@@ -616,6 +632,22 @@ class SLOTracker:
                 "see tools/mxtrace.py for retained tail traces",
                 self.model, fast, self.burn_threshold, self.p99_ms,
                 self.availability, fast)
+
+    def fast_burn(self) -> float:
+        """The fast-window burn rate right now — THE readout the fleet
+        controller's autoscale evaluator polls (``serving/fleet.py``):
+        cheap (one prune under the lock), no gauge publish, no
+        edge-trigger side effects."""
+        return self.burn_rates().get("fast", 0.0)
+
+    def events(self, window: str = "fast") -> int:
+        """Events currently inside one window — consumers (the fleet
+        evaluator) gate on this so an almost-empty window's burn rate
+        (one bad request out of two) is not mistaken for an excursion."""
+        t = self._clock()
+        with self._lock:
+            self._prune_locked(window, t)
+            return len(self._win[window])
 
     def snapshot(self) -> Dict[str, Any]:
         return {"p99_ms": self.p99_ms, "availability": self.availability,

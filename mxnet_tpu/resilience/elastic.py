@@ -58,7 +58,7 @@ from ..observability import flight_recorder as _flight
 from ..observability import metrics as _metrics
 
 __all__ = ["TopologyMismatch", "elastic_config", "check_restore",
-           "finish_reshard", "snapshot_guard"]
+           "finish_reshard", "snapshot_guard", "plan_chip_split"]
 
 register_config("MXNET_ELASTIC", False, bool,
                 "Adopt mismatched-topology checkpoints by elastic N→M "
@@ -258,6 +258,53 @@ def finish_reshard(rt, plan: Dict[str, Any], duration_ms: float) -> None:
             "elastic reshard dp %d → %d changed the step-time baseline "
             "signature (re-arm with a baseline measured on the new "
             "topology)" % (old_dp, new_dp))
+
+
+def plan_chip_split(subject: str, buckets, old_chips: int, new_chips: int,
+                    total: Optional[int] = None) -> Dict[str, Any]:
+    """Validate a SERVING chip resize the way :func:`check_restore`
+    validates a training topology adoption, and return the reshard plan.
+
+    The serving twin of the global-batch re-split: a model's declared
+    bucket ladder is its fixed "global batch" menu, and a bucket is only
+    servable at ``new_chips`` when its per-chip row count stays integral
+    (``bucket % new_chips == 0``). A chip count no declared bucket tiles
+    over — or a non-positive / over-budget count — raises the same typed
+    :class:`TopologyMismatch` the elastic trainer raises, so fleet
+    callers and training callers share one refusal surface.
+
+    Returns ``{"subject", "direction", "old_chips", "new_chips",
+    "buckets", "dropped_buckets"}`` — ``buckets`` is the effective ladder
+    the executor cache re-binds to; ``dropped_buckets`` are declared
+    buckets that no longer tile (served requests pad up past them).
+    """
+    declared = tuple(sorted({int(b) for b in buckets}))
+    old_chips, new_chips = int(old_chips), int(new_chips)
+    saved = {"chips": old_chips, "buckets": declared}
+    if new_chips < 1:
+        raise TopologyMismatch(
+            "%s: cannot resize to %d chip(s) — a serving replica needs "
+            "at least one" % (subject, new_chips),
+            saved=saved, live={"chips": new_chips})
+    if total is not None and new_chips > int(total):
+        raise TopologyMismatch(
+            "%s: resize to %d chip(s) exceeds the fleet's device budget "
+            "of %d" % (subject, new_chips, int(total)),
+            saved=saved, live={"chips": new_chips, "total": int(total)})
+    eff = tuple(b for b in declared if b % new_chips == 0)
+    if not eff:
+        raise TopologyMismatch(
+            "%s: no declared bucket in %r re-splits over %d chip(s) "
+            "(per-chip rows must be integral — the same divisibility the "
+            "elastic trainer demands of its global batch): choose a chip "
+            "count that tiles at least one bucket"
+            % (subject, declared, new_chips),
+            saved=saved, live={"chips": new_chips})
+    return {"subject": str(subject),
+            "direction": "grow" if new_chips > old_chips else "shrink",
+            "old_chips": old_chips, "new_chips": new_chips,
+            "buckets": eff,
+            "dropped_buckets": tuple(b for b in declared if b not in eff)}
 
 
 def snapshot_guard(snap: Dict[str, Any], trainer) -> None:

@@ -35,12 +35,13 @@ import importlib as _importlib
 
 __all__ = ["ModelConfig", "ModelServer", "PendingResult",
            "BucketExecutorCache", "default_buckets", "CircuitBreaker",
-           "BoundedRequestQueue", "ServingEndpoints",
+           "BoundedRequestQueue", "TokenBucket", "FairShare",
+           "ServingEndpoints", "FleetController", "TenantPolicy",
            "ServingError", "Overloaded", "DeadlineExceeded", "Draining",
-           "CircuitOpen", "ExecutorFault",
-           "run_load", "verdict", "ledger_row",
+           "CircuitOpen", "ExecutorFault", "QuotaExceeded", "Preempted",
+           "run_load", "verdict", "ledger_row", "fleet_row",
            "chaos", "load", "server", "errors", "breaker", "queueing",
-           "executors", "endpoints"]
+           "executors", "endpoints", "fleet"]
 
 _lazy_attrs = {
     "ModelConfig": ".server", "ModelServer": ".server",
@@ -48,14 +49,18 @@ _lazy_attrs = {
     "BucketExecutorCache": ".executors", "default_buckets": ".executors",
     "CircuitBreaker": ".breaker",
     "BoundedRequestQueue": ".queueing",
+    "TokenBucket": ".queueing", "FairShare": ".queueing",
     "ServingEndpoints": ".endpoints",
+    "FleetController": ".fleet", "TenantPolicy": ".fleet",
     "ServingError": ".errors", "Overloaded": ".errors",
     "DeadlineExceeded": ".errors", "Draining": ".errors",
     "CircuitOpen": ".errors", "ExecutorFault": ".errors",
+    "QuotaExceeded": ".errors", "Preempted": ".errors",
     "run_load": ".load", "verdict": ".load", "ledger_row": ".load",
+    "fleet_row": ".load",
 }
 _lazy_mods = {"chaos", "load", "server", "errors", "breaker", "queueing",
-              "executors", "endpoints"}
+              "executors", "endpoints", "fleet"}
 
 
 def __getattr__(name):

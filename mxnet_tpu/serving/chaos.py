@@ -25,6 +25,16 @@ executor_fault      the executor raises: transient (retryable infra
 poison_request      ONE request's payload deterministically crashes any
                     batch containing it — single-request isolation must
                     fail only the poison, not its batchmates
+chip_scaled_        the forward costs wall time proportional to rows
+executor            over the model's CURRENT chip assignment — gives a
+                    fleet resize real, measurable capacity consequences
+                    on a dev box (reads ``st.cache.chips`` live, so it
+                    survives rebinds)
+tenant_storm        one tenant stormed at a multiple of sustainable QPS
+                    while the other tenants run their declared load —
+                    THE multi-tenant isolation scenario: the fleet must
+                    keep the victims inside their SLOs (autoscale +
+                    quota + preemption), proven from counter deltas
 =================  ======================================================
 """
 from __future__ import annotations
@@ -40,7 +50,8 @@ from ..resilience.chaos import ChaosError
 
 __all__ = ["slow_client", "request_storm", "paced_run", "trace_evidence",
            "slow_executor", "executor_fault", "poison_request",
-           "poison_payload", "POISON_SENTINEL"]
+           "poison_payload", "POISON_SENTINEL",
+           "chip_scaled_executor", "tenant_storm"]
 
 # a value a legitimate float32 payload never carries (finite, but at the
 # edge of range) — the poison marker the patched executor looks for
@@ -282,6 +293,99 @@ def executor_fault(server, model: str, faults: int = 1,
         yield state
     finally:
         st.cache.run = orig
+
+
+@contextlib.contextmanager
+def chip_scaled_executor(server, model: str, per_row_s: float):
+    """Every dispatch for ``model`` costs ``per_row_s * padded_rows /
+    chips`` seconds of wall time — the capacity model the fleet
+    controller's autoscaler is graded against: twice the chips, half the
+    dispatch time. ``chips`` is read from ``st.cache.chips`` LIVE at each
+    dispatch (the fleet's rebind mutates the cache in place), so a resize
+    mid-run changes throughput immediately. Yields the live ``calls``
+    count."""
+    st = _state(server, model)
+    orig = st.cache.run
+    state = {"calls": 0}
+
+    def run(batch):
+        state["calls"] += 1
+        rows = int(np.asarray(batch).shape[0])
+        chips = max(1, int(getattr(st.cache, "chips", 1)))
+        time.sleep(per_row_s * rows / chips)
+        return orig(batch)
+
+    st.cache.run = run
+    try:
+        yield state
+    finally:
+        st.cache.run = orig
+
+
+def tenant_storm(server, storm_model: str, *, qps: float, duration_s: float,
+                 victims: Dict[str, object],
+                 payload=None, threads: int = 4,
+                 deadline_ms: Optional[float] = None,
+                 collect_timeout_s: float = 10.0) -> Dict[str, object]:
+    """THE multi-tenant isolation scenario: storm ``storm_model`` at
+    ``qps`` while every tenant in ``victims`` runs its own declared load
+    CONCURRENTLY, and return per-tenant :func:`request_storm` stats.
+
+    ``victims`` maps model name -> offered qps (a number), or -> a dict
+    of per-victim overrides (``qps`` required; ``deadline_ms``,
+    ``threads``, ``payload``, ``duration_s`` optional). ``payload``
+    defaults per model to a zero sample of that model's feature shape.
+
+    Returns ``{"storm": stats, "victims": {model: stats}}`` — each value
+    the full request_storm dict, so the acceptance test reads the
+    victims' p99/deadline_violations straight off the result while the
+    fleet's counter deltas (``mxtpu_fleet_resizes_total``) prove the
+    control loop actually moved chips.
+    """
+    def _payload(m, override):
+        if override is not None:
+            return override
+        if payload is not None:
+            return payload
+        shape = server.config(m).feature_shape
+        return np.zeros(shape, np.float32)
+
+    jobs = [(storm_model, {"qps": float(qps),
+                           "duration_s": float(duration_s),
+                           "threads": int(threads),
+                           "deadline_ms": deadline_ms,
+                           "payload": None})]
+    for m, spec in victims.items():
+        o = dict(spec) if isinstance(spec, dict) else {"qps": float(spec)}
+        o.setdefault("duration_s", float(duration_s))
+        o.setdefault("threads", 2)
+        o.setdefault("deadline_ms", deadline_ms)
+        o.setdefault("payload", None)
+        jobs.append((m, o))
+
+    results: Dict[str, object] = {}
+    errors: List[BaseException] = []
+
+    def run_one(m, o):
+        try:
+            results[m] = request_storm(
+                server, m, _payload(m, o["payload"]), qps=o["qps"],
+                duration_s=o["duration_s"], threads=o["threads"],
+                deadline_ms=o["deadline_ms"],
+                collect_timeout_s=collect_timeout_s)
+        except BaseException as e:     # surfaced after join, never lost
+            errors.append(e)
+
+    ts = [threading.Thread(target=run_one, args=(m, o), daemon=True)
+          for m, o in jobs]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    if errors:
+        raise errors[0]
+    return {"storm": results[storm_model],
+            "victims": {m: results[m] for m, _ in jobs[1:]}}
 
 
 def poison_payload(feature_shape, sentinel: float = POISON_SENTINEL

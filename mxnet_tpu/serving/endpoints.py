@@ -11,11 +11,22 @@ parsing bodies:
 rejection       code   LB reaction
 =============  =====  ==============================================
 Overloaded      429    back off / spill to another replica
+QuotaExceeded   429    tenant over its declared quota; back off
 DeadlineExceeded 504   request died in queue; client retries elsewhere
 Draining        503    stop routing here (readyz is already red)
 CircuitOpen     503    model broken here; route elsewhere
+Preempted       503    best-effort shed during a guaranteed tenant's
+                       SLO excursion; retry after the storm
 ExecutorFault   500    bad request or broken model — don't retry blind
 =============  =====  ==============================================
+
+With a fleet controller attached (``serving/fleet.py``), ``GET /fleetz``
+answers the fleet status document (404 with fleet mode off — the
+single-tenant surface is unchanged), ``POST /fleetz/resize`` is the
+operator resize (409 on a typed ``TopologyMismatch``), ``/predict``
+accepts an optional ``"priority"`` field and every /predict response
+carries ``X-Fleet-Tenant`` / ``X-Fleet-Priority`` / ``X-Fleet-Chips``
+headers naming the tenant's current placement.
 
 /predict is also the trace edge: an inbound W3C ``traceparent`` header
 is parsed into a :class:`~mxnet_tpu.observability.tracing.TraceContext`
@@ -37,12 +48,14 @@ import numpy as np
 
 from ..observability.tracing import TraceContext
 from .errors import (CircuitOpen, DeadlineExceeded, Draining, ExecutorFault,
-                     Overloaded)
+                     Overloaded, Preempted)
 
 __all__ = ["ServingEndpoints"]
 
+# order matters only for subclasses: QuotaExceeded is an Overloaded and
+# maps to the same 429 (clients already handling 429 keep working)
 _STATUS = ((Overloaded, 429), (DeadlineExceeded, 504), (Draining, 503),
-           (CircuitOpen, 503), (ExecutorFault, 500))
+           (CircuitOpen, 503), (Preempted, 503), (ExecutorFault, 500))
 
 # Retry-After hints (integer seconds, RFC 9110): 429 = back off briefly
 # and retry HERE once the burst drains; 503 = draining/breaker-open, give
@@ -58,7 +71,8 @@ def _make_handler(server):
             pass
 
         def _reply(self, code: int, doc, trace=None,
-                   retry_after: Optional[str] = None) -> None:
+                   retry_after: Optional[str] = None,
+                   headers=None) -> None:
             body = json.dumps(doc).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
@@ -67,8 +81,22 @@ def _make_handler(server):
                 self.send_header("traceparent", trace.to_traceparent())
             if retry_after is not None:
                 self.send_header("Retry-After", retry_after)
+            for k, v in (headers or {}).items():
+                self.send_header(k, str(v))
             self.end_headers()
             self.wfile.write(body)
+
+        def _fleet_headers(self, model):
+            """Per-tenant placement headers — only with a fleet attached
+            (fleet mode off keeps the response surface byte-identical)."""
+            fleet = getattr(server, "_fleet", None)
+            if fleet is None or model not in getattr(
+                    fleet, "_policies", {}):
+                return None
+            pol = fleet.policy(model)
+            return {"X-Fleet-Tenant": model,
+                    "X-Fleet-Priority": pol.priority,
+                    "X-Fleet-Chips": fleet.chips(model)}
 
         def do_GET(self):
             if self.path == "/healthz":
@@ -76,10 +104,50 @@ def _make_handler(server):
             elif self.path == "/readyz":
                 ready = server.ready()
                 self._reply(200 if ready else 503, {"ready": ready})
+            elif self.path == "/fleetz":
+                fleet = getattr(server, "_fleet", None)
+                if fleet is None:
+                    self._reply(404, {"error": "no fleet controller "
+                                      "attached (fleet mode off)"})
+                else:
+                    self._reply(200, fleet.status())
             else:
                 self._reply(404, {"error": "unknown path %r" % self.path})
 
+        def _post_fleet_resize(self):
+            fleet = getattr(server, "_fleet", None)
+            if fleet is None:
+                self._reply(404, {"error": "no fleet controller attached "
+                                  "(fleet mode off)"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", "0"))
+                doc = json.loads(self.rfile.read(n) or b"{}")
+                model = doc["model"]
+                chips = int(doc["chips"])
+            except (KeyError, ValueError, TypeError) as e:
+                self._reply(400, {"error": "bad request: %r" % (e,)})
+                return
+            from ..base import MXNetError
+            from ..resilience.elastic import TopologyMismatch
+            try:
+                plan = fleet.resize(model, chips, reason="http")
+            except TopologyMismatch as e:
+                # the typed refusal surface: impossible split/overcommit
+                self._reply(409, {"error": str(e),
+                                  "type": "TopologyMismatch"})
+            except MXNetError as e:
+                self._reply(404, {"error": str(e)})
+            else:
+                self._reply(200, {"model": model, "plan": {
+                    k: list(v) if isinstance(v, tuple) else v
+                    for k, v in plan.items()}},
+                    headers=self._fleet_headers(model))
+
         def do_POST(self):
+            if self.path == "/fleetz/resize":
+                self._post_fleet_resize()
+                return
             if self.path != "/predict":
                 self._reply(404, {"error": "unknown path %r" % self.path})
                 return
@@ -95,13 +163,15 @@ def _make_handler(server):
                 model = doc["model"]
                 data = np.asarray(doc["data"], np.float32)
                 deadline_ms = doc.get("deadline_ms")
+                priority = doc.get("priority")
             except (KeyError, ValueError, TypeError) as e:
                 self._reply(400, {"error": "bad request: %r" % (e,),
                                   "trace_id": ctx.trace_id}, trace=ctx)
                 return
+            fleet_headers = self._fleet_headers(model)
             try:
                 out = server.predict(model, data, deadline_ms=deadline_ms,
-                                     trace=ctx)
+                                     trace=ctx, priority=priority)
             except Exception as e:
                 for cls, code in _STATUS:
                     if isinstance(e, cls):
@@ -109,7 +179,8 @@ def _make_handler(server):
                                            "type": type(e).__name__,
                                            "trace_id": ctx.trace_id},
                                     trace=ctx,
-                                    retry_after=_RETRY_AFTER.get(code))
+                                    retry_after=_RETRY_AFTER.get(code),
+                                    headers=fleet_headers)
                         return
                 self._reply(400, {"error": str(e),
                                   "type": type(e).__name__,
@@ -117,7 +188,8 @@ def _make_handler(server):
                 return
             self._reply(200, {"model": model,
                               "output": np.asarray(out).tolist(),
-                              "trace_id": ctx.trace_id}, trace=ctx)
+                              "trace_id": ctx.trace_id}, trace=ctx,
+                        headers=fleet_headers)
 
     return Handler
 

@@ -25,6 +25,15 @@ CircuitOpen             repeated executor faults tripped the per-model
 ExecutorFault           the compiled executor raised for this request
                         (after transient retries and single-request
                         isolation). Usually a poison request (HTTP 500).
+QuotaExceeded           fleet admission: the tenant exceeded its declared
+                        per-tenant QPS quota. An Overloaded subclass —
+                        same client reaction (HTTP 429), but the counter
+                        it bumps (mxtpu_fleet_quota_sheds_total) names
+                        the tenant that over-drove, not the server.
+Preempted               fleet admission: best-effort work shed because a
+                        guaranteed tenant is in an SLO excursion. Typed,
+                        never silent — retry once the excursion clears
+                        (HTTP 503).
 =====================  ====================================================
 """
 from __future__ import annotations
@@ -32,7 +41,7 @@ from __future__ import annotations
 from ..base import MXNetError
 
 __all__ = ["ServingError", "Overloaded", "DeadlineExceeded", "Draining",
-           "CircuitOpen", "ExecutorFault"]
+           "CircuitOpen", "ExecutorFault", "QuotaExceeded", "Preempted"]
 
 
 class ServingError(MXNetError):
@@ -61,3 +70,16 @@ class CircuitOpen(ServingError):
 class ExecutorFault(ServingError):
     """The executor failed this request after transient retries and
     single-request isolation."""
+
+
+class QuotaExceeded(Overloaded):
+    """The tenant exceeded its declared per-tenant QPS quota (fleet
+    admission). Subclass of Overloaded: clients back off identically,
+    but the shed is attributed to the TENANT's offered rate, not to
+    server capacity."""
+
+
+class Preempted(ServingError):
+    """Best-effort work shed by the fleet controller because a guaranteed
+    tenant is in an SLO excursion. Retry after backoff — the excursion
+    clears when the guaranteed tenant's burn rate recovers."""

@@ -99,15 +99,16 @@ class BucketExecutorCache:
                  feature_shape: Sequence[int],
                  buckets: Sequence[int],
                  dev_type: int = 1, dev_id: int = 0,
-                 output_keys: Optional[List[str]] = None):
+                 output_keys: Optional[List[str]] = None,
+                 chips: int = 1):
         if not buckets:
             raise MXNetError("BucketExecutorCache needs at least one bucket")
         self.input_name = str(input_name)
         self.feature_shape = tuple(int(x) for x in feature_shape)
-        self.buckets = tuple(sorted({int(b) for b in buckets}))
-        if self.buckets[0] < 1:
+        self.declared_buckets = tuple(sorted({int(b) for b in buckets}))
+        if self.declared_buckets[0] < 1:
             raise MXNetError("bucket sizes must be >= 1, got %r"
-                             % (self.buckets,))
+                             % (self.declared_buckets,))
         self._symbol_json = symbol_json
         self._param_bytes = param_bytes
         self._dev = (int(dev_type), int(dev_id))
@@ -115,6 +116,49 @@ class BucketExecutorCache:
         self._lock = threading.Lock()
         self._preds: Dict[int, object] = {}
         self._base = None           # first-built predictor: owns the params
+        self.chips = 1
+        self.buckets = self.declared_buckets
+        if int(chips) != 1:
+            self.rebind(int(chips))
+
+    @staticmethod
+    def effective_buckets(declared: Sequence[int],
+                          chips: int) -> Tuple[int, ...]:
+        """The servable ladder at ``chips``: every declared bucket that
+        tiles row-wise over the chip count (per-chip rows integral —
+        the serving twin of the elastic trainer's global-batch re-split).
+        Empty = an impossible split; the fleet refuses it with a typed
+        ``TopologyMismatch`` via ``resilience.elastic.plan_chip_split``
+        before ever calling :meth:`rebind`."""
+        chips = int(chips)
+        return tuple(b for b in sorted({int(x) for x in declared})
+                     if chips >= 1 and b % chips == 0)
+
+    def rebind(self, chips: int) -> Tuple[int, ...]:
+        """Re-bind the cache's executables for a new chip count.
+
+        The effective bucket ladder is re-derived (declared buckets that
+        divide by ``chips``), every bucket's bound executable is dropped
+        (its shapes assumed the old split) — but ``_base`` is KEPT, so
+        the params stay loaded/placed once and new buckets re-bind via
+        ``Predictor.reshape``. Returns the new ladder. Raises
+        :class:`MXNetError` on an impossible split — callers that want
+        the typed ``TopologyMismatch`` validate through
+        ``resilience.elastic.plan_chip_split`` first."""
+        chips = int(chips)
+        eff = self.effective_buckets(self.declared_buckets, chips)
+        if not eff:
+            raise MXNetError(
+                "no declared bucket in %r tiles over %d chip(s) "
+                "(per-chip rows must be integral): impossible split"
+                % (self.declared_buckets, chips))
+        with self._lock:
+            self.chips = chips
+            self.buckets = eff
+            # executables for the old split are stale; params live on in
+            # _base and are re-placed exactly once per server lifetime
+            self._preds = {}
+        return eff
 
     @property
     def max_bucket(self) -> int:

@@ -1,0 +1,616 @@
+"""Multi-tenant model fleet: SLO-burn-driven chip autoscaling, per-tenant
+fair queueing and priority preemption over ONE :class:`ModelServer`.
+
+A :class:`FleetController` runs N models over a fixed budget of
+``total_chips`` and closes the control loop the single-tenant server
+leaves open:
+
+- **placement** — every tenant holds a chip assignment; resizing a
+  tenant quiesces its in-flight batch (the per-model ``dispatch_mutex``),
+  re-binds its :class:`~mxnet_tpu.serving.executors.BucketExecutorCache`
+  for the new chip count (params stay placed once; buckets recompile
+  lazily) and re-derives the effective bucket ladder. An impossible
+  split — no declared bucket tiles row-wise over the new chip count — is
+  refused with the SAME typed
+  :class:`~mxnet_tpu.resilience.errors.TopologyMismatch` the elastic
+  trainer raises (:func:`~mxnet_tpu.resilience.elastic.plan_chip_split`),
+  so training and serving share one refusal surface.
+- **autoscaling** — a background evaluator polls each tenant's
+  :class:`~mxnet_tpu.observability.tracing.SLOTracker` fast-window burn
+  rate plus queue depth and breaker state, and moves chips from
+  under-burning tenants to over-burning ones: at most one reallocation
+  per pass, per-tenant floor/ceiling respected, and a min-dwell
+  hysteresis (``MXNET_FLEET_DWELL_S``) so the fleet never thrashes. A
+  provably-useless resize — taker at ceiling, breaker open (capacity is
+  not the problem), impossible split, or a CostLedger
+  ``tuner.best_cached``-informed estimate showing no capacity gain — is
+  REFUSED loudly (``logger.error`` + a ``refused`` action in the
+  history), never attempted quietly.
+- **admission** — each tenant's :class:`~mxnet_tpu.serving.queueing.
+  TokenBucket` quota sheds over-rate traffic with a typed
+  :class:`~mxnet_tpu.serving.errors.QuotaExceeded`;
+  :class:`~mxnet_tpu.serving.queueing.FairShare` paces tenants running
+  ahead of their weighted fair share; and while any guaranteed tenant is
+  in an SLO excursion, best-effort traffic is preempted — new arrivals
+  rejected and queued work evicted — with a typed
+  :class:`~mxnet_tpu.serving.errors.Preempted`. Never silent: every
+  preempted future completes with the typed error.
+
+Fleet mode is strictly opt-in: a server with no controller attached
+(``server._fleet is None``, the default) behaves — and lowers — bitwise
+identically to a pre-fleet server (pinned by ``tests/test_fleet.py``).
+
+Telemetry: ``mxtpu_fleet_*`` families (pre-declared in
+``observability/catalog.py``), resize events in the trace ring
+(``Tracer.record_event`` — ``tools/mxtrace.py`` shows them inline with
+the request timelines they reshaped), and ``GET /fleetz`` on the HTTP
+endpoint. ``tools/mxfleet.py`` is the operator CLI.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from ..base import MXNetError, get_env, logger, register_config
+from .errors import Preempted, QuotaExceeded
+from .queueing import FairShare, TokenBucket
+
+__all__ = ["TenantPolicy", "FleetController"]
+
+register_config("MXNET_FLEET_DWELL_S", 30.0, float,
+                "Minimum seconds between chip resizes of the same tenant "
+                "(autoscale hysteresis). A tenant resized less than a "
+                "dwell ago is neither grown nor shrunk by the evaluator; "
+                "manual resizes (tools/mxfleet.py resize) bypass it.")
+register_config("MXNET_FLEET_INTERVAL_S", 2.0, float,
+                "Seconds between background autoscale evaluator passes "
+                "(FleetController.start).")
+register_config("MXNET_FLEET_MIN_EVENTS", 20, int,
+                "SLO-window events a tenant needs before its burn rate "
+                "may drive an autoscale decision — an almost-empty "
+                "window's burn (one bad request out of two) is noise, "
+                "not an excursion.")
+
+_PRIORITIES = ("guaranteed", "best_effort")
+_HISTORY_CAP = 256
+
+
+class TenantPolicy:
+    """One tenant's declared place in the fleet.
+
+    ``model`` must name a model served by the attached server. ``weight``
+    is the tenant's fair-queueing weight (rows of chip time per unit of
+    virtual time). ``quota_qps`` > 0 installs a token-bucket admission
+    quota (0 = unmetered). ``priority`` is "guaranteed" (protected by the
+    SLO control loop) or "best_effort" (preemptable while a guaranteed
+    tenant is in excursion). ``floor_chips`` / ``ceiling_chips`` bound
+    the autoscaler; ``chips`` is the initial assignment (defaults to the
+    floor).
+    """
+
+    def __init__(self, model: str, *, weight: float = 1.0,
+                 quota_qps: float = 0.0, priority: str = "guaranteed",
+                 floor_chips: int = 1, ceiling_chips: Optional[int] = None,
+                 chips: Optional[int] = None):
+        if not model:
+            raise MXNetError("TenantPolicy needs a model name")
+        self.model = str(model)
+        self.weight = float(weight)
+        if self.weight <= 0:
+            raise MXNetError("tenant %r: weight must be > 0" % model)
+        self.quota_qps = float(quota_qps)
+        if self.quota_qps < 0:
+            raise MXNetError("tenant %r: quota_qps must be >= 0 "
+                             "(0 = unmetered)" % model)
+        self.priority = str(priority)
+        if self.priority not in _PRIORITIES:
+            raise MXNetError("tenant %r: priority must be one of %r, got "
+                             "%r" % (model, _PRIORITIES, priority))
+        self.floor_chips = int(floor_chips)
+        if self.floor_chips < 1:
+            raise MXNetError("tenant %r: floor_chips must be >= 1" % model)
+        self.ceiling_chips = (None if ceiling_chips is None
+                              else int(ceiling_chips))
+        if self.ceiling_chips is not None \
+                and self.ceiling_chips < self.floor_chips:
+            raise MXNetError("tenant %r: ceiling_chips %d < floor_chips %d"
+                             % (model, self.ceiling_chips, self.floor_chips))
+        self.chips = self.floor_chips if chips is None else int(chips)
+        if self.chips < self.floor_chips or (
+                self.ceiling_chips is not None
+                and self.chips > self.ceiling_chips):
+            raise MXNetError("tenant %r: initial chips %d outside "
+                             "[floor %d, ceiling %r]"
+                             % (model, self.chips, self.floor_chips,
+                                self.ceiling_chips))
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"model": self.model, "weight": self.weight,
+                "quota_qps": self.quota_qps, "priority": self.priority,
+                "floor_chips": self.floor_chips,
+                "ceiling_chips": self.ceiling_chips}
+
+
+class FleetController:
+    """The fleet control loop over one :class:`ModelServer`.
+
+    Constructing the controller ATTACHES it (``server._fleet = self``)
+    and applies the initial placement — every tenant's executor cache is
+    re-bound to its assigned chip count, each validated through
+    :func:`~mxnet_tpu.resilience.elastic.plan_chip_split` (a policy that
+    asks for an impossible split fails the constructor with a typed
+    ``TopologyMismatch``, before any traffic is accepted).
+
+    :meth:`start` spawns the background evaluator; :meth:`evaluate` is
+    one synchronous pass (what the thread calls — tests drive it
+    directly with a fake clock). :meth:`resize` is the manual/operator
+    path (``POST /fleetz/resize``, ``tools/mxfleet.py resize``).
+    """
+
+    def __init__(self, server, total_chips: int,
+                 policies: Sequence[TenantPolicy], *,
+                 dwell_s: Optional[float] = None,
+                 interval_s: Optional[float] = None,
+                 burn_threshold: Optional[float] = None,
+                 min_events: Optional[int] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        if getattr(server, "_fleet", None) is not None:
+            raise MXNetError("server already has a fleet controller "
+                             "attached")
+        self.server = server
+        self.total_chips = int(total_chips)
+        if self.total_chips < 1:
+            raise MXNetError("total_chips must be >= 1")
+        self._policies: Dict[str, TenantPolicy] = {}
+        for pol in policies:
+            if pol.model in self._policies:
+                raise MXNetError("duplicate tenant policy for %r"
+                                 % pol.model)
+            if pol.model not in server._models:
+                raise MXNetError("tenant %r is not served by this server "
+                                 "(models: %s)"
+                                 % (pol.model,
+                                    ", ".join(sorted(server._models))))
+            self._policies[pol.model] = pol
+        missing = sorted(set(server._models) - set(self._policies))
+        if missing:
+            raise MXNetError("fleet needs a TenantPolicy for every served "
+                             "model; missing: %s" % ", ".join(missing))
+        if sum(p.chips for p in self._policies.values()) > self.total_chips:
+            raise MXNetError(
+                "initial placement wants %d chip(s), fleet budget is %d"
+                % (sum(p.chips for p in self._policies.values()),
+                   self.total_chips))
+        self.dwell_s = float(get_env("MXNET_FLEET_DWELL_S", 30.0)
+                             if dwell_s is None else dwell_s)
+        self.interval_s = float(get_env("MXNET_FLEET_INTERVAL_S", 2.0)
+                                if interval_s is None else interval_s)
+        self.burn_threshold = float(
+            get_env("MXNET_SERVE_SLO_BURN_THRESHOLD", 2.0)
+            if burn_threshold is None else burn_threshold)
+        self.min_events = int(get_env("MXNET_FLEET_MIN_EVENTS", 20)
+                              if min_events is None else min_events)
+        self._clock = clock
+        self._lock = threading.Lock()       # placement + history
+        self._chips: Dict[str, int] = {m: p.chips
+                                       for m, p in self._policies.items()}
+        self._last_resize: Dict[str, float] = {}
+        self._history: List[Dict[str, Any]] = []
+        self._excursion: Dict[str, float] = {}   # guaranteed tenants over
+        self._buckets: Dict[str, TokenBucket] = {
+            m: TokenBucket(p.quota_qps, clock=clock)
+            for m, p in self._policies.items() if p.quota_qps > 0}
+        self.fair = FairShare({m: p.weight
+                               for m, p in self._policies.items()},
+                              clock=clock)
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        # initial placement: validate + bind BEFORE attaching, so a
+        # failed constructor leaves the server exactly as it found it
+        for model, pol in self._policies.items():
+            st = server._models[model]
+            from ..resilience.elastic import plan_chip_split
+            plan = plan_chip_split(model, st.cache.declared_buckets,
+                                   st.cache.chips, pol.chips,
+                                   total=self.total_chips)
+            if pol.chips != st.cache.chips:
+                st.cache.rebind(pol.chips)
+            self._publish_chips(model, pol.chips)
+            del plan
+        server._fleet = self
+
+    # ------------------------------------------------------------ admission
+    def admit(self, st, req) -> None:
+        """Fleet admission for one request — called by
+        ``ModelServer.submit`` BEFORE the queue (with no fleet attached
+        the server never calls here). Stamps the tenant's priority class,
+        enforces its QPS quota (typed :class:`QuotaExceeded`) and, while
+        any guaranteed tenant is in SLO excursion, preempts best-effort
+        arrivals (typed :class:`Preempted`)."""
+        model = st.cfg.name
+        pol = self._policies[model]
+        if req.priority is None:
+            req.priority = pol.priority
+        bucket = self._buckets.get(model)
+        if bucket is not None and not bucket.try_take():
+            self._inc_tenant("FLEET_QUOTA_SHEDS", model)
+            raise QuotaExceeded(
+                "tenant %r exceeded its %.1f qps quota — shed at fleet "
+                "admission (retry with backoff)" % (model, pol.quota_qps))
+        if req.priority == "best_effort" and self._excursion:
+            self._inc_tenant("FLEET_PREEMPTED", model)
+            raise Preempted(
+                "best-effort request for tenant %r preempted: guaranteed "
+                "tenant(s) %s in SLO excursion — retry after the storm"
+                % (model, ", ".join(sorted(self._excursion))))
+
+    def before_dispatch(self, st, rows: int) -> None:
+        """Weighted-fair pacing hook — called by the model's worker just
+        before each dispatch. A tenant running ahead of its fair share
+        sleeps a bounded beat (<= 50 ms) so the others' workers get the
+        chip; then the dispatch is charged to its virtual clock."""
+        model = st.cfg.name
+        pause = self.fair.throttle_s(model, rows)
+        if pause > 0:
+            time.sleep(pause)
+        self.fair.charge(model, rows)
+
+    # ------------------------------------------------------------ placement
+    def chips(self, model: str) -> int:
+        with self._lock:
+            return self._chips[model]
+
+    def free_chips(self) -> int:
+        with self._lock:
+            return self.total_chips - sum(self._chips.values())
+
+    def policy(self, model: str) -> TenantPolicy:
+        return self._policies[model]
+
+    def resize(self, model: str, chips: int,
+               reason: str = "manual") -> Dict[str, Any]:
+        """Reassign ``model`` to ``chips`` chips: validate the split
+        (typed ``TopologyMismatch`` on an impossible one), quiesce the
+        replica (its in-flight batch finishes under ``dispatch_mutex``,
+        the next dispatch waits), re-bind the executor ladder, publish
+        the counters/gauge/histogram and drop a resize event into the
+        trace ring. Returns the reshard plan."""
+        from ..resilience.elastic import plan_chip_split
+        st = self.server._models.get(model)
+        if st is None:
+            raise MXNetError("unknown model %r (fleet tenants: %s)"
+                             % (model, ", ".join(sorted(self._policies))))
+        chips = int(chips)
+        with self._lock:
+            old = self._chips[model]
+            others = sum(c for m, c in self._chips.items() if m != model)
+        if others + chips > self.total_chips:
+            from ..resilience.elastic import TopologyMismatch
+            raise TopologyMismatch(
+                "%s: resize to %d chip(s) would overcommit the fleet "
+                "(%d already placed elsewhere, budget %d)"
+                % (model, chips, others, self.total_chips),
+                saved={"chips": old}, live={"chips": chips,
+                                            "total": self.total_chips})
+        plan = plan_chip_split(model, st.cache.declared_buckets, old,
+                               chips, total=self.total_chips)
+        if chips == old:
+            return plan                     # placement already satisfied
+        t0 = time.perf_counter()
+        # quiesce: the worker holds dispatch_mutex for the length of one
+        # dispatch, so acquiring it here means the in-flight batch has
+        # finished on the old binding; queued requests survive and are
+        # served by the new one
+        with st.dispatch_mutex:
+            st.cache.rebind(chips)
+        elapsed_ms = (time.perf_counter() - t0) * 1e3
+        now = self._clock()
+        with self._lock:
+            self._chips[model] = chips
+            self._last_resize[model] = now
+        direction = plan["direction"]
+        self._publish_chips(model, chips)
+        from ..observability import metrics as _m
+        if _m.enabled():
+            from ..observability import catalog as _c
+            _c.FLEET_RESIZES.inc(direction=direction)
+            _c.FLEET_RESIZE_MS.observe(elapsed_ms)
+        self.server.tracer.record_event(
+            "resize", model=model, direction=direction, old_chips=old,
+            new_chips=chips, reason=reason,
+            buckets=",".join(str(b) for b in plan["buckets"]))
+        logger.info("fleet resize: model %r %s %d -> %d chip(s) (%s); "
+                    "effective buckets %r (quiesce+rebind %.2f ms)",
+                    model, direction, old, chips, reason,
+                    plan["buckets"], elapsed_ms)
+        self._record({"action": "resize", "model": model,
+                      "direction": direction, "old_chips": old,
+                      "new_chips": chips, "reason": reason,
+                      "resize_ms": round(elapsed_ms, 3)})
+        return plan
+
+    # ----------------------------------------------------------- autoscaler
+    def _burn(self, st) -> Optional[float]:
+        """A tenant's fast-window burn, or None when it has no SLO or too
+        few window events for the number to mean anything."""
+        if st.slo is None:
+            return None
+        if st.slo.events("fast") < self.min_events:
+            return None
+        return st.slo.fast_burn()
+
+    def _feasible_steps(self, st) -> List[int]:
+        """Chip counts (ascending) at which this tenant's declared ladder
+        keeps at least one servable bucket."""
+        declared = st.cache.declared_buckets
+        return [c for c in range(1, self.total_chips + 1)
+                if any(b % c == 0 for b in declared)]
+
+    def estimate_qps(self, model: str, chips: int) -> Optional[float]:
+        """CostLedger-informed capacity estimate for ``model`` at
+        ``chips`` chips: the tuner cache's best measured per-chip
+        throughput scaled by the chip count and by the batching
+        efficiency the effective ladder retains (a resize that drops the
+        big buckets pads more and wins less). None with no cached
+        measurement — the evaluator then falls back to burn/queue
+        pressure alone."""
+        st = self.server._models.get(model)
+        if st is None:
+            return None
+        try:
+            from ..tuner import best_cached
+            from .executors import BucketExecutorCache, _device_kind
+            best = best_cached(device_kind=_device_kind()[0], model=model)
+        except Exception:
+            return None
+        if not best:
+            return None
+        per_chip = best.get("throughput_img_s_per_chip")
+        if not per_chip:
+            return None
+        declared = st.cache.declared_buckets
+        eff = BucketExecutorCache.effective_buckets(declared, chips)
+        if not eff:
+            return 0.0
+        return float(per_chip) * int(chips) * (eff[-1] / float(declared[-1]))
+
+    def _refuse(self, model: str, why: str, detail: str) -> Dict[str, Any]:
+        logger.error("fleet autoscale REFUSED resize of %r (%s): %s",
+                     model, why, detail)
+        action = {"action": "refused", "model": model, "reason": why,
+                  "detail": detail}
+        self._record(action)
+        return action
+
+    def evaluate(self) -> List[Dict[str, Any]]:
+        """One control-loop pass. Reads every tenant's burn/queue/breaker
+        state, updates the excursion set, preempts queued best-effort
+        work while guaranteed tenants burn, and performs (or loudly
+        refuses) at most ONE chip reallocation. Returns the actions
+        taken; also what the background evaluator calls each interval."""
+        actions: List[Dict[str, Any]] = []
+        now = self._clock()
+        state: Dict[str, Dict[str, Any]] = {}
+        for model, pol in self._policies.items():
+            st = self.server._models[model]
+            state[model] = {
+                "st": st, "pol": pol, "burn": self._burn(st),
+                "depth": st.queue.depth,
+                "breaker_open": st.breaker.snapshot().get(
+                    "state") == "open"}
+        # --- excursion set: guaranteed tenants burning over threshold
+        excursion = {m: s["burn"] for m, s in state.items()
+                     if s["pol"].priority == "guaranteed"
+                     and s["burn"] is not None
+                     and s["burn"] > self.burn_threshold}
+        with self._lock:
+            self._excursion = dict(excursion)
+        # --- preemption: evict queued best-effort work during excursion
+        if excursion:
+            for model, s in state.items():
+                if s["pol"].priority != "guaranteed":
+                    evicted = s["st"].queue.evict(
+                        lambda r: getattr(r, "priority", None)
+                        == "best_effort")
+                    for req in evicted:
+                        self._inc_tenant("FLEET_PREEMPTED", model)
+                        # typed, never silent: the future completes
+                        self.server._complete(
+                            s["st"], req, error=Preempted(
+                                "queued best-effort request for tenant "
+                                "%r preempted mid-queue: guaranteed "
+                                "tenant(s) %s in SLO excursion"
+                                % (model, ", ".join(sorted(excursion)))),
+                            outcome="shed", reason="preempted")
+                    if evicted:
+                        actions.append({"action": "preempt",
+                                        "model": model,
+                                        "evicted": len(evicted)})
+        # --- at most one reallocation per pass
+        def dwelling(m: str) -> bool:
+            with self._lock:
+                last = self._last_resize.get(m)
+            return last is not None and (now - last) < self.dwell_s
+        takers = sorted(
+            (m for m, s in state.items()
+             if s["burn"] is not None and s["burn"] > self.burn_threshold),
+            key=lambda m: -(state[m]["burn"] or 0.0))
+        for taker in takers:
+            s = state[taker]
+            pol, st = s["pol"], s["st"]
+            if dwelling(taker):
+                continue                     # hysteresis: let the dust settle
+            if s["breaker_open"]:
+                # capacity is provably not the problem: the executor is
+                # faulting, and more chips fault identically
+                actions.append(self._refuse(
+                    taker, "breaker_open",
+                    "circuit breaker open — executor faults, not "
+                    "capacity; fix the fault before scaling"))
+                break
+            cur = self.chips(taker)
+            steps = [c for c in self._feasible_steps(st) if c > cur]
+            if pol.ceiling_chips is not None:
+                steps = [c for c in steps if c <= pol.ceiling_chips]
+            if not steps:
+                actions.append(self._refuse(
+                    taker, "ceiling" if (pol.ceiling_chips is not None
+                                         and cur >= pol.ceiling_chips)
+                    else "infeasible",
+                    "at %d chip(s); no feasible step up within "
+                    "[floor %d, ceiling %r] for ladder %r"
+                    % (cur, pol.floor_chips, pol.ceiling_chips,
+                       st.cache.declared_buckets)))
+                break
+            target = steps[0]
+            est_cur = self.estimate_qps(taker, cur)
+            est_new = self.estimate_qps(taker, target)
+            if est_cur is not None and est_new is not None \
+                    and est_new <= est_cur:
+                actions.append(self._refuse(
+                    taker, "no_gain",
+                    "best_cached estimate %.1f qps at %d chip(s) vs "
+                    "%.1f at %d — the resize provably buys nothing "
+                    "(the effective ladder loses more batching than "
+                    "the chips add)" % (est_new, target, est_cur, cur)))
+                break
+            need = target - cur
+            freed = self.free_chips()
+            donor = None
+            if freed < need:
+                donors = sorted(
+                    (m for m, d in state.items()
+                     if m != taker and not dwelling(m)
+                     and m not in excursion
+                     and (d["burn"] is None
+                          or d["burn"] <= self.burn_threshold)),
+                    key=lambda m: (state[m]["burn"] is not None,
+                                   state[m]["burn"] or 0.0))
+                for cand in donors:
+                    dst = state[cand]["st"]
+                    dpol = state[cand]["pol"]
+                    dcur = self.chips(cand)
+                    down = [c for c in self._feasible_steps(dst)
+                            if dpol.floor_chips <= c < dcur
+                            and freed + (dcur - c) >= need]
+                    if down:
+                        donor = (cand, down[-1])   # smallest give that works
+                        break
+                if donor is None:
+                    actions.append(self._refuse(
+                        taker, "no_capacity",
+                        "needs %d more chip(s); %d free and no "
+                        "under-burning tenant can give without "
+                        "breaching its floor/dwell" % (need, freed)))
+                    break
+            if donor is not None:
+                self.resize(donor[0], donor[1], reason="autoscale:donate")
+                actions.append({"action": "shrink", "model": donor[0],
+                                "new_chips": donor[1]})
+            self.resize(taker, target, reason="autoscale:burn=%.2f"
+                        % (s["burn"] or 0.0))
+            actions.append({"action": "grow", "model": taker,
+                            "new_chips": target,
+                            "burn": round(s["burn"] or 0.0, 3)})
+            break                           # one reallocation per pass
+        return actions
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> "FleetController":
+        """Spawn the background evaluator (daemon; one pass per
+        ``interval_s``). Idempotent."""
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop.clear()
+        t = threading.Thread(target=self._run, daemon=True,
+                             name="mxfleet-evaluator")
+        self._thread = t
+        t.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=max(1.0, 2 * self.interval_s))
+        self._thread = None
+
+    def detach(self) -> None:
+        """Stop the evaluator and detach from the server (fleet mode
+        off again; chip assignments and bucket ladders stay as last
+        placed)."""
+        self.stop()
+        if getattr(self.server, "_fleet", None) is self:
+            self.server._fleet = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.evaluate()
+            except Exception as e:      # the evaluator must never die
+                logger.exception("fleet evaluator pass failed: %r", e)
+
+    # -------------------------------------------------------------- readout
+    def model_status(self, model: str) -> Dict[str, Any]:
+        st = self.server._models[model]
+        pol = self._policies[model]
+        with self._lock:
+            chips = self._chips[model]
+            last = self._last_resize.get(model)
+            excursion = model in self._excursion
+        out = {"chips": chips, "priority": pol.priority,
+               "weight": pol.weight, "quota_qps": pol.quota_qps,
+               "floor_chips": pol.floor_chips,
+               "ceiling_chips": pol.ceiling_chips,
+               "burn": self._burn(st), "queue_depth": st.queue.depth,
+               "buckets": list(st.cache.buckets),
+               "in_excursion": excursion,
+               "last_resize_s_ago": (None if last is None
+                                     else round(self._clock() - last, 3))}
+        est = self.estimate_qps(model, chips)
+        if est is not None:
+            out["estimated_qps"] = round(est, 1)
+        return out
+
+    def status(self) -> Dict[str, Any]:
+        """The ``/fleetz`` answer."""
+        with self._lock:
+            placed = dict(self._chips)
+            history = list(self._history[-32:])
+        return {"total_chips": self.total_chips,
+                "free_chips": self.total_chips - sum(placed.values()),
+                "dwell_s": self.dwell_s,
+                "interval_s": self.interval_s,
+                "burn_threshold": self.burn_threshold,
+                "evaluator_running": bool(self._thread is not None
+                                          and self._thread.is_alive()),
+                "models": {m: self.model_status(m)
+                           for m in sorted(self._policies)},
+                "fair_vtime": self.fair.snapshot(),
+                "history": history}
+
+    def history(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._history)
+
+    # -------------------------------------------------------------- helpers
+    def _record(self, action: Dict[str, Any]) -> None:
+        action = dict(action)
+        action["time"] = time.time()
+        with self._lock:
+            self._history.append(action)
+            if len(self._history) > _HISTORY_CAP:
+                del self._history[:len(self._history) - _HISTORY_CAP]
+
+    def _publish_chips(self, model: str, chips: int) -> None:
+        from ..observability import metrics as _m
+        if _m.enabled():
+            from ..observability import catalog as _c
+            _c.FLEET_ACTIVE_CHIPS.set(chips, model=model)
+
+    def _inc_tenant(self, family: str, tenant: str) -> None:
+        from ..observability import metrics as _m
+        if _m.enabled():
+            from ..observability import catalog as _c
+            getattr(_c, family).inc(tenant=tenant)

@@ -22,7 +22,7 @@ from .chaos import request_storm
 from .executors import _device_kind
 
 __all__ = ["run_load", "finalize_load_stats", "verdict", "ledger_row",
-           "tiny_model", "model_config_from_files"]
+           "fleet_row", "tiny_model", "model_config_from_files"]
 
 
 def finalize_load_stats(stats: Dict[str, Any], *, t_start: float,
@@ -211,6 +211,50 @@ def ledger_row(stats: Dict[str, Any], *,
         "device_kind": kind, "platform": platform,
         "provenance": "loadgen",
     }
+    if extra:
+        row.update(extra)
+    led = ledger if ledger is not None else _xcost.get_ledger()
+    if led is not None:
+        led.append(row)
+    return row
+
+
+def fleet_row(stats_by_tenant: Dict[str, Dict[str, Any]], *,
+              ledger: Optional[_xcost.CostLedger] = None,
+              extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """Persist one ``label="fleet"`` cost-ledger row from a mixed-tenant
+    load run (``tools/loadgen.py --tenants``).
+
+    Aggregate ``qps`` is the sum of per-tenant achieved qps; per-tenant
+    facts land as bracketed keys — ``qps[a]``, ``p99_ms[a]``,
+    ``ok_frac[a]`` … — which ``tools/perfwatch.py`` compares with the
+    base metric's direction (``p99_ms[a]`` is down-is-good because
+    ``p99_ms`` is), so adding a tenant never needs a new direction
+    entry."""
+    kind, platform = _device_kind()
+    row: Dict[str, Any] = {
+        "label": "fleet",
+        "tenants": sorted(stats_by_tenant),
+        "qps": round(sum(float(s.get("qps", 0.0))
+                         for s in stats_by_tenant.values()), 3),
+        "device_kind": kind, "platform": platform,
+        "provenance": "loadgen",
+    }
+    violations = 0
+    for tenant in sorted(stats_by_tenant):
+        s = stats_by_tenant[tenant]
+        row["qps[%s]" % tenant] = round(float(s.get("qps", 0.0)), 3)
+        for k in ("p50_ms", "p99_ms"):
+            if s.get(k) is not None:
+                row["%s[%s]" % (k, tenant)] = round(float(s[k]), 3)
+        for k in ("ok_frac", "shed_frac", "expired_frac", "error_frac"):
+            if s.get(k) is not None:
+                row["%s[%s]" % (k, tenant)] = round(float(s[k]), 4)
+        for k in ("priority", "deadline_ms", "submitted"):
+            if s.get(k) is not None:
+                row["%s[%s]" % (k, tenant)] = s[k]
+        violations += int(s.get("deadline_violations", 0))
+    row["deadline_violations"] = violations
     if extra:
         row.update(extra)
     led = ledger if ledger is not None else _xcost.get_ledger()

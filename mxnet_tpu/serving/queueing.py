@@ -15,17 +15,27 @@ waiting only adds latency), reaching zero at capacity. Expired requests
 are diverted to a separate list on the way out: they are NEVER part of
 the dispatched batch, which is how the server keeps its "no request past
 its deadline reaches the device" invariant.
+
+The fleet layer (``serving/fleet.py``) composes two more primitives from
+here: :class:`TokenBucket` (per-tenant QPS quota at admission — over-rate
+tenants shed with a typed ``QuotaExceeded`` instead of starving their
+neighbours) and :class:`FairShare` (weighted fair queueing across the
+models sharing the worker pool: each dispatch charges ``rows / weight``
+virtual time, and a tenant running ahead of the lightest-loaded active
+tenant is paced before its next dispatch). :meth:`BoundedRequestQueue.
+evict` is the preemption hook — queued best-effort work is pulled out
+typed, never silently dropped.
 """
 from __future__ import annotations
 
 import threading
 import time
 from collections import deque
-from typing import Callable, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 from .errors import Draining, Overloaded
 
-__all__ = ["BoundedRequestQueue"]
+__all__ = ["BoundedRequestQueue", "TokenBucket", "FairShare"]
 
 
 class BoundedRequestQueue:
@@ -178,7 +188,127 @@ class BoundedRequestQueue:
             self._q.clear()
             return out
 
+    def evict(self, predicate: Callable[[object], bool]) -> List:
+        """Remove and return every queued request matching ``predicate``
+        (the fleet's preemption hook). The caller MUST complete the
+        evicted requests with a typed error — eviction without an answer
+        would strand their futures forever. ``predicate`` runs under the
+        queue lock: pure attribute checks only."""
+        with self._lock:
+            kept, out = deque(), []
+            for r in self._q:
+                (out if predicate(r) else kept).append(r)
+            self._q = kept
+            return out
+
     @property
     def shed_expired(self) -> int:
         with self._lock:
             return self._shed_expired
+
+
+class TokenBucket:
+    """Per-tenant QPS quota: ``rate`` tokens/s refilled continuously,
+    holding at most ``burst`` (default ``max(rate, 1)`` — one second of
+    headroom). ``try_take`` never blocks: admission answers a typed
+    ``QuotaExceeded`` instead of queueing over-quota work."""
+
+    def __init__(self, rate: float, burst: Optional[float] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        if rate <= 0:
+            raise ValueError("TokenBucket rate must be > 0 (no quota = "
+                             "no bucket)")
+        self.rate = float(rate)
+        self.burst = float(burst if burst is not None else max(rate, 1.0))
+        self._clock = clock
+        self._tokens = self.burst
+        self._t = clock()
+        self._lock = threading.Lock()
+
+    def try_take(self, n: float = 1.0) -> bool:
+        with self._lock:
+            now = self._clock()
+            self._tokens = min(self.burst,
+                               self._tokens + (now - self._t) * self.rate)
+            self._t = now
+            if self._tokens >= n:
+                self._tokens -= n
+                return True
+            return False
+
+
+class FairShare:
+    """Weighted fair queueing across tenants sharing the worker pool.
+
+    Every tenant accrues virtual time ``rows / weight`` per dispatch
+    (:meth:`charge`); a tenant whose virtual time runs more than
+    ``slack_rows`` weighted rows ahead of the *lightest-loaded recently
+    active* tenant is paced (:meth:`throttle_s` returns a small positive
+    backoff its worker sleeps before dispatching). Start-of-day and
+    idle-tenant fairness use the classic virtual-clock fix: a tenant's
+    clock never restarts behind the current minimum, so a tenant that
+    slept through an hour cannot claim an hour of catch-up.
+    """
+
+    def __init__(self, weights: Dict[str, float], *,
+                 slack_rows: float = 32.0, active_window_s: float = 5.0,
+                 pace_s: float = 0.002,
+                 clock: Callable[[], float] = time.monotonic):
+        if not weights:
+            raise ValueError("FairShare needs at least one tenant weight")
+        for name, w in weights.items():
+            if w <= 0:
+                raise ValueError("FairShare weight for %r must be > 0, "
+                                 "got %r" % (name, w))
+        self.weights = {str(k): float(v) for k, v in weights.items()}
+        self.slack_rows = float(slack_rows)
+        self.active_window_s = float(active_window_s)
+        self.pace_s = float(pace_s)
+        self._clock = clock
+        self._vtime: Dict[str, float] = {n: 0.0 for n in self.weights}
+        self._last_seen: Dict[str, float] = {}
+        self._lock = threading.Lock()
+
+    def _min_active_locked(self, now: float, exclude: str) -> Optional[float]:
+        horizon = now - self.active_window_s
+        vals = [self._vtime[n] for n, t in self._last_seen.items()
+                if n != exclude and t >= horizon]
+        return min(vals) if vals else None
+
+    def charge(self, tenant: str, rows: int) -> None:
+        """Account one dispatch of ``rows`` rows against ``tenant``."""
+        w = self.weights.get(tenant)
+        if w is None:
+            return
+        now = self._clock()
+        with self._lock:
+            floor = self._min_active_locked(now, exclude=tenant)
+            v = self._vtime.get(tenant, 0.0)
+            if floor is not None and v < floor:
+                v = floor          # idle tenant rejoins AT the clock, not behind it
+            self._vtime[tenant] = v + rows / w
+            self._last_seen[tenant] = now
+
+    def lag_rows(self, tenant: str) -> float:
+        """How far ``tenant`` runs AHEAD of the lightest-loaded active
+        tenant, in weighted rows (<= 0 = at or behind fair share)."""
+        now = self._clock()
+        with self._lock:
+            floor = self._min_active_locked(now, exclude=tenant)
+            if floor is None:
+                return 0.0         # nobody else active: no one to be unfair to
+            return self._vtime.get(tenant, 0.0) - floor
+
+    def throttle_s(self, tenant: str, rows: int = 0) -> float:
+        """Seconds the tenant's worker should pause before its next
+        dispatch: 0 at/behind fair share, ``pace_s`` per ``slack_rows``
+        of excess (bounded — pacing shapes the share, it never parks a
+        worker)."""
+        ahead = self.lag_rows(tenant) - self.slack_rows
+        if ahead <= 0:
+            return 0.0
+        return min(0.05, self.pace_s * (1.0 + ahead / self.slack_rows))
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._vtime)

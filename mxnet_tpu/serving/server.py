@@ -49,7 +49,7 @@ from ..base import MXNetError, get_env, logger, register_config
 from ..observability import tracing as _tracing
 from .breaker import CircuitBreaker
 from .errors import (CircuitOpen, DeadlineExceeded, Draining, ExecutorFault,
-                     Overloaded, ServingError)
+                     Overloaded, Preempted, QuotaExceeded, ServingError)
 from .executors import BucketExecutorCache, default_buckets
 from .queueing import BoundedRequestQueue
 
@@ -138,14 +138,18 @@ class PendingResult:
 class _Request:
     __slots__ = ("data", "deadline", "submitted_at", "dispatch_at",
                  "pending", "trace", "enqueued_at", "dequeued_at",
-                 "forward_t0", "forward_t1")
+                 "forward_t0", "forward_t1", "priority")
 
     def __init__(self, data: np.ndarray, deadline: Optional[float],
-                 submitted_at: float):
+                 submitted_at: float, priority: Optional[str] = None):
         self.data = data
         self.deadline = deadline
         self.submitted_at = submitted_at
         self.dispatch_at: Optional[float] = None
+        # priority class, stamped by the caller or the fleet's tenant
+        # policy: "guaranteed" | "best_effort" | None (no fleet — single-
+        # tenant servers never consult it)
+        self.priority = priority
         self.pending = PendingResult()
         # tracing stamps (monotonic seconds): together with submitted_at/
         # dispatch_at they bound the non-overlapping stage spans —
@@ -265,6 +269,11 @@ class _ModelState:
                     if cfg.slo_p99_ms > 0 else None)
         self.worker: Optional[threading.Thread] = None
         self.lock = threading.Lock()
+        # held for the duration of one dispatch: a fleet resize acquires
+        # it to quiesce (the in-flight batch finishes, the next dispatch
+        # waits) before re-binding the bucket cache for a new chip count.
+        # Uncontended in single-tenant mode — nothing else takes it.
+        self.dispatch_mutex = threading.Lock()
         self.counts = {"ok": 0, "shed": 0, "expired": 0, "error": 0}
         self.batches = 0
         self.singles = 0            # isolation re-dispatches after a fault
@@ -302,6 +311,11 @@ class ModelServer:
                 raise MXNetError("duplicate model name %r" % cfg.name)
             self._models[cfg.name] = _ModelState(cfg)
         self._drain_on_preemption = bool(drain_on_preemption)
+        # multi-tenant fleet controller (serving/fleet.py), attached via
+        # FleetController(server=...); None (the default) = fleet mode
+        # off — admission, dispatch and the served HLO are bitwise
+        # identical to a pre-fleet server (pinned by test_fleet.py)
+        self._fleet = None
         self._guard = None
         self._started = False
         self._stopped = False
@@ -389,8 +403,8 @@ class ModelServer:
 
     def submit(self, model: str, data, deadline_ms: Optional[float] = None,
                deadline_at: Optional[float] = None,
-               trace: Optional[_tracing.TraceContext] = None
-               ) -> PendingResult:
+               trace: Optional[_tracing.TraceContext] = None,
+               priority: Optional[str] = None) -> PendingResult:
         """Admit one request (one sample of the model's feature shape).
 
         ``deadline_ms`` overrides the model's default; ``deadline_at`` is
@@ -398,9 +412,13 @@ class ModelServer:
         propagated end-to-end, e.g. from an upstream hop). ``trace`` is
         an upstream :class:`~mxnet_tpu.observability.tracing.TraceContext`
         (e.g. parsed from an HTTP ``traceparent``) the request's span
-        timeline continues; None mints a fresh one. Raises typed
-        :class:`Overloaded` / :class:`Draining`; executor errors surface
-        on the returned :class:`PendingResult`.
+        timeline continues; None mints a fresh one. ``priority`` is the
+        request's fleet priority class ("guaranteed" | "best_effort");
+        None defaults to the tenant's policy when a fleet is attached and
+        is ignored otherwise. Raises typed :class:`Overloaded` /
+        :class:`Draining` (and, fleet mode only, :class:`QuotaExceeded` /
+        :class:`Preempted`); executor errors surface on the returned
+        :class:`PendingResult`.
         """
         st = self._models.get(model)
         if st is None:
@@ -423,7 +441,7 @@ class ModelServer:
             dl_ms = (st.cfg.deadline_ms if deadline_ms is None
                      else float(deadline_ms))
             deadline_at = now + dl_ms / 1e3 if dl_ms else None
-        req = _Request(arr, deadline_at, now)
+        req = _Request(arr, deadline_at, now, priority=priority)
         if st.cfg.trace and self.tracer.enabled():
             req.trace = self.tracer.start_request(
                 model, ctx=trace, submitted_at=now,
@@ -431,17 +449,30 @@ class ModelServer:
                              if deadline_at is not None else None),
                 sample=st.cfg.trace_sample)
         try:
+            # fleet admission (quota + priority stamping) runs BEFORE the
+            # queue so a quota shed never occupies a slot; with no fleet
+            # attached this is a single None check — the single-tenant
+            # path is otherwise untouched
+            if self._fleet is not None:
+                self._fleet.admit(st, req)
             shed = st.queue.put(req)
-        except (Overloaded, Draining) as e:
+        except (Overloaded, Draining, Preempted) as e:
             if req.trace is not None:
                 # admission rejections keep their trace: shed traces are
                 # ALWAYS retained by the tail-sampler, so an overloaded
                 # client's trace_id resolves in the ring
                 req.trace.span("admission", now, _now())
+                if isinstance(e, QuotaExceeded):
+                    reason = "quota"
+                elif isinstance(e, Overloaded):
+                    reason = "overloaded"
+                elif isinstance(e, Preempted):
+                    reason = "preempted"
+                else:
+                    reason = "draining"
                 self.tracer.finish(
                     req.trace, "shed", latency_ms=(_now() - now) * 1e3,
-                    reason=("overloaded" if isinstance(e, Overloaded)
-                            else "draining"))
+                    reason=reason)
             self._count(st, "shed")
             raise
         req.enqueued_at = _now()
@@ -457,10 +488,12 @@ class ModelServer:
     def predict(self, model: str, data,
                 deadline_ms: Optional[float] = None,
                 timeout: Optional[float] = None,
-                trace: Optional[_tracing.TraceContext] = None) -> np.ndarray:
+                trace: Optional[_tracing.TraceContext] = None,
+                priority: Optional[str] = None) -> np.ndarray:
         """submit + wait: the synchronous convenience."""
         return self.submit(model, data, deadline_ms=deadline_ms,
-                           trace=trace).result(timeout=timeout)
+                           trace=trace, priority=priority
+                           ).result(timeout=timeout)
 
     # ------------------------------------------------------------- workers
     def _worker(self, st: _ModelState) -> None:
@@ -495,7 +528,18 @@ class ModelServer:
             if not batch:
                 continue            # all expired, or drain requested: loop
             try:
-                self._dispatch(st, batch)
+                fleet = self._fleet
+                if fleet is not None:
+                    # weighted-fair pacing: a tenant far ahead of its fair
+                    # share yields a bounded beat to the others before its
+                    # batch takes the chip
+                    fleet.before_dispatch(st, len(batch))
+                # dispatch_mutex is the fleet's quiesce point: a resize
+                # acquires it, so the in-flight batch finishes on the old
+                # binding and the next waits for the new one. Uncontended
+                # (single-tenant / no resize) it is one futex op.
+                with st.dispatch_mutex:
+                    self._dispatch(st, batch)
             except Exception as e:  # defensive: a worker must never die
                 logger.exception("serving worker for %r: unexpected "
                                  "dispatch error: %r", cfg.name, e)
@@ -797,6 +841,10 @@ class ModelServer:
             }
         if st.slo is not None:
             out["slo"] = st.slo.snapshot()
+        if self._fleet is not None:
+            # only when a fleet is attached: stats() output with fleet
+            # mode off is byte-identical to pre-fleet servers
+            out["fleet"] = self._fleet.model_status(model)
         if lat.size:
             out["p50_ms"] = float(np.percentile(lat, 50))
             out["p99_ms"] = float(np.percentile(lat, 99))

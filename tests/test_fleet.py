@@ -1,0 +1,570 @@
+"""Multi-tenant fleet (serving/fleet.py): chip placement over a fixed
+budget, elastic resize through the shared TopologyMismatch surface,
+SLO-burn-driven autoscaling with hysteresis and loud refusals, per-tenant
+quotas / weighted fair queueing / priority preemption — and THE
+acceptance test: storm tenant A at 3x its sustainable QPS and prove from
+counter deltas that the fleet grew A, victim B's p99 stayed inside its
+SLO with burn under threshold, and no deadline was ever violated."""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.observability import catalog
+from mxnet_tpu.resilience.elastic import TopologyMismatch, plan_chip_split
+from mxnet_tpu.serving import (FleetController, ModelConfig, ModelServer,
+                               Preempted, QuotaExceeded, ServingEndpoints,
+                               TenantPolicy)
+from mxnet_tpu.serving import chaos as schaos
+from mxnet_tpu.serving import load as sload
+from mxnet_tpu.serving.executors import BucketExecutorCache
+from mxnet_tpu.serving.queueing import FairShare, TokenBucket
+
+pytestmark = pytest.mark.fleet
+
+
+class FakeClock:
+    def __init__(self, t=100.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    return sload.tiny_model()
+
+
+def _cfg(tiny, name, **kw):
+    sym_json, pbytes, feat, _ = tiny
+    d = dict(feature_shape=feat, buckets=(1, 2, 4, 8), max_queue=16,
+             deadline_ms=2000.0, max_wait_ms=2.0, slo_p99_ms=200.0)
+    d.update(kw)
+    return ModelConfig(name, sym_json, pbytes, **d)
+
+
+def _fleet2(tiny, total=3, *, a=None, b=None, cfg_a=None, cfg_b=None,
+            start=False, **fkw):
+    """Two-tenant server + fleet: a=1 chip, b=2 by default."""
+    server = ModelServer([_cfg(tiny, "a", **(cfg_a or {})),
+                          _cfg(tiny, "b", **(cfg_b or {}))],
+                         drain_on_preemption=False)
+    fleet = FleetController(
+        server, total,
+        [TenantPolicy("a", **(a or {})),
+         TenantPolicy("b", chips=2, **(b or {}))], **fkw)
+    if start:
+        server.start(warm=True)
+    return server, fleet
+
+
+def _burn_up(st, n=30):
+    """Push a tenant's fast-window burn far over any threshold."""
+    for _ in range(n):
+        st.slo.record("shed")
+
+
+# ------------------------------------------------------------ policy units
+def test_tenant_policy_validation():
+    pol = TenantPolicy("m", weight=2.0, quota_qps=10.0,
+                       priority="best_effort", floor_chips=1,
+                       ceiling_chips=4, chips=2)
+    assert pol.to_dict()["priority"] == "best_effort"
+    assert TenantPolicy("m").chips == 1          # defaults to the floor
+    with pytest.raises(MXNetError):
+        TenantPolicy("")
+    with pytest.raises(MXNetError):
+        TenantPolicy("m", weight=0.0)
+    with pytest.raises(MXNetError):
+        TenantPolicy("m", quota_qps=-1.0)
+    with pytest.raises(MXNetError):
+        TenantPolicy("m", priority="platinum")
+    with pytest.raises(MXNetError):
+        TenantPolicy("m", floor_chips=0)
+    with pytest.raises(MXNetError):
+        TenantPolicy("m", floor_chips=4, ceiling_chips=2)
+    with pytest.raises(MXNetError):
+        TenantPolicy("m", ceiling_chips=2, chips=3)
+
+
+def test_plan_chip_split_matrix():
+    plan = plan_chip_split("m", (1, 2, 4, 8), 1, 2, total=4)
+    assert plan["direction"] == "grow"
+    assert plan["buckets"] == (2, 4, 8)
+    assert plan["dropped_buckets"] == (1,)
+    assert plan_chip_split("m", (1, 2, 4, 8), 4, 1)["direction"] == "shrink"
+    # no declared bucket tiles over 3 chips -> the TYPED refusal, with
+    # the saved/live topology attached like the elastic trainer's
+    with pytest.raises(TopologyMismatch) as ei:
+        plan_chip_split("m", (1, 2, 4, 8), 1, 3, total=4)
+    assert "3" in str(ei.value)
+    with pytest.raises(TopologyMismatch):
+        plan_chip_split("m", (1, 2, 4, 8), 1, 5, total=4)  # over budget
+
+
+def test_effective_buckets_and_rebind(tiny):
+    assert BucketExecutorCache.effective_buckets((1, 2, 4, 8), 1) \
+        == (1, 2, 4, 8)
+    assert BucketExecutorCache.effective_buckets((1, 2, 4, 8), 2) \
+        == (2, 4, 8)
+    assert BucketExecutorCache.effective_buckets((1, 2, 4, 8), 8) == (8,)
+    assert BucketExecutorCache.effective_buckets((1, 2, 4), 8) == ()
+    server = ModelServer([_cfg(tiny, "m")],
+                         drain_on_preemption=False).start(warm=True)
+    try:
+        _, _, feat, ref = tiny
+        st = server._models["m"]
+        base = st.cache._base
+        st.cache.rebind(2)
+        assert st.cache.chips == 2
+        assert st.cache.buckets == (2, 4, 8)
+        assert st.cache._base is base       # params placed once, kept
+        d = np.random.RandomState(0).randn(*feat).astype("float32")
+        np.testing.assert_allclose(server.predict("m", d, timeout=30.0),
+                                   ref(d), rtol=1e-4, atol=1e-5)
+    finally:
+        server.close(timeout=10.0)
+
+
+def test_fairshare_and_tokenbucket_units():
+    clk = FakeClock()
+    tb = TokenBucket(2.0, clock=clk)            # burst = max(rate,1) = 2
+    assert tb.try_take() and tb.try_take() and not tb.try_take()
+    clk.advance(0.5)                            # refills 1 token
+    assert tb.try_take() and not tb.try_take()
+    with pytest.raises(ValueError):
+        TokenBucket(0.0)
+
+    fs = FairShare({"a": 1.0, "b": 1.0}, slack_rows=8.0, clock=clk)
+    fs.charge("b", 1)                           # b active at vtime 1
+    assert fs.throttle_s("a", 4) == 0.0         # a at/behind fair share
+    fs.charge("a", 40)
+    pause = fs.throttle_s("a", 4)
+    assert 0.0 < pause <= 0.05                  # paced, bounded beat
+    assert fs.lag_rows("a") > 0.0
+    # idle-tenant fix: b rejoins AT the min active clock, not behind it
+    clk.advance(60.0)
+    fs.charge("a", 1)
+    fs.charge("b", 1)
+    assert abs(fs.snapshot()["b"] - fs.snapshot()["a"]) <= 8.0
+
+
+# --------------------------------------------------------- ctor / placement
+def test_fleet_ctor_validation(tiny):
+    server = ModelServer([_cfg(tiny, "a"), _cfg(tiny, "b")],
+                         drain_on_preemption=False)
+    with pytest.raises(MXNetError, match="every served model"):
+        FleetController(server, 4, [TenantPolicy("a")])
+    with pytest.raises(MXNetError, match="duplicate"):
+        FleetController(server, 4, [TenantPolicy("a"), TenantPolicy("a"),
+                                    TenantPolicy("b")])
+    with pytest.raises(MXNetError, match="not served"):
+        FleetController(server, 4, [TenantPolicy("a"), TenantPolicy("b"),
+                                    TenantPolicy("ghost")])
+    with pytest.raises(MXNetError, match="budget"):
+        FleetController(server, 2, [TenantPolicy("a", chips=2),
+                                    TenantPolicy("b", chips=2)])
+    # an impossible initial split fails the ctor with the typed error
+    with pytest.raises(TopologyMismatch):
+        FleetController(server, 4, [TenantPolicy("a", chips=3),
+                                    TenantPolicy("b")])
+    assert server._fleet is None                # failed ctor never attaches
+    fleet = FleetController(server, 3, [TenantPolicy("a"),
+                                        TenantPolicy("b", chips=2)])
+    assert server._fleet is fleet
+    with pytest.raises(MXNetError, match="already has a fleet"):
+        FleetController(server, 3, [TenantPolicy("a"), TenantPolicy("b")])
+    fleet.detach()
+    assert server._fleet is None
+
+
+def test_manual_resize_quiesce_and_counters(tiny):
+    server, fleet = _fleet2(tiny, start=True)
+    grew0 = catalog.FLEET_RESIZES.value(direction="grow")
+    try:
+        st_a = server._models["a"]
+        assert st_a.cache.chips == 1 and fleet.chips("b") == 2
+        assert server._models["b"].cache.buckets == (2, 4, 8)
+        # overcommit: typed refusal BEFORE anything is rebound
+        with pytest.raises(TopologyMismatch, match="overcommit"):
+            fleet.resize("a", 2)
+        assert st_a.cache.chips == 1
+        with pytest.raises(MXNetError, match="unknown model"):
+            fleet.resize("ghost", 1)
+        plan = fleet.resize("b", 1)
+        assert plan["direction"] == "shrink"
+        plan = fleet.resize("a", 2)
+        assert plan["direction"] == "grow" and plan["buckets"] == (2, 4, 8)
+        assert st_a.cache.chips == 2 and fleet.free_chips() == 0
+        # served results stay correct across the re-bind
+        _, _, feat, ref = tiny
+        d = np.random.RandomState(1).randn(*feat).astype("float32")
+        np.testing.assert_allclose(server.predict("a", d, timeout=30.0),
+                                   ref(d), rtol=1e-4, atol=1e-5)
+        # no-op resize returns the plan but never counts or records
+        n_hist = len(fleet.history())
+        fleet.resize("a", 2)
+        assert len(fleet.history()) == n_hist
+        assert catalog.FLEET_RESIZES.value(direction="grow") - grew0 == 1
+        assert [h["action"] for h in fleet.history()] \
+            == ["resize", "resize"]
+        assert catalog.FLEET_ACTIVE_CHIPS.value(model="a") == 2
+        # the resize landed as an always-retained trace event
+        events = server.tracer.traces(model="a", outcome="event")
+        assert any(s["tags"].get("direction") == "grow"
+                   for t in events for s in t.spans)
+        assert server.stats("a")["fleet"]["chips"] == 2
+    finally:
+        fleet.detach()
+        server.close(timeout=10.0)
+
+
+# ----------------------------------------------------------- fleet admission
+def test_quota_sheds_typed(tiny):
+    clk = FakeClock()
+    server, fleet = _fleet2(tiny, a={"quota_qps": 2.0}, start=True,
+                            clock=clk)
+    _, _, feat, _ = tiny
+    shed0 = catalog.FLEET_QUOTA_SHEDS.value(tenant="a")
+    try:
+        d = np.zeros(feat, "float32")
+        futs = [server.submit("a", d) for _ in range(2)]  # burst = 2
+        with pytest.raises(QuotaExceeded, match="quota"):
+            server.submit("a", d)
+        server.submit("b", d).result(30.0)      # b is unmetered
+        for f in futs:
+            f.result(30.0)
+        clk.advance(1.0)                        # continuous refill
+        server.submit("a", d).result(30.0)
+        assert catalog.FLEET_QUOTA_SHEDS.value(tenant="a") - shed0 == 1
+        # QuotaExceeded IS an Overloaded: callers' shed handling keeps
+        # working, HTTP keeps answering 429
+        from mxnet_tpu.serving import Overloaded
+        assert issubclass(QuotaExceeded, Overloaded)
+    finally:
+        fleet.detach()
+        server.close(timeout=10.0)
+
+
+def test_preemption_typed_admission_and_eviction(tiny):
+    server, fleet = _fleet2(tiny, b={"priority": "best_effort"},
+                            start=True, min_events=10)
+    _, _, feat, _ = tiny
+    pre0 = catalog.FLEET_PREEMPTED.value(tenant="b")
+    try:
+        d = np.zeros(feat, "float32")
+        st_b = server._models["b"]
+        with schaos.slow_executor(server, "b", 0.6):
+            # pin b's worker inside one slow dispatch...
+            first = server.submit("b", d)
+            deadline = time.monotonic() + 5.0
+            while st_b.queue.depth > 0 and time.monotonic() < deadline:
+                time.sleep(0.002)
+            # ...queue best-effort work behind it...
+            futs = [server.submit("b", d) for _ in range(8)]
+            # ...then a guaranteed tenant enters excursion
+            _burn_up(server._models["a"])
+            actions = fleet.evaluate()
+            assert any(a["action"] == "preempt" and a["model"] == "b"
+                       for a in actions)
+            # new best-effort arrivals now shed typed at admission
+            with pytest.raises(Preempted, match="excursion"):
+                server.submit("b", d)
+            # every evicted future completed with the TYPED error —
+            # never silently dropped
+            evicted = 0
+            for f in futs:
+                try:
+                    f.result(30.0)
+                except Preempted:
+                    evicted += 1
+            assert evicted >= 1
+            assert catalog.FLEET_PREEMPTED.value(tenant="b") - pre0 \
+                == evicted + 1
+            first.result(30.0)      # the in-flight batch was never touched
+        # guaranteed traffic is never preempted
+        server.submit("a", d).result(30.0)
+    finally:
+        fleet.detach()
+        server.close(timeout=10.0)
+
+
+# ------------------------------------------------------------ the evaluator
+def test_evaluate_donor_taker_and_dwell(tiny):
+    clk = FakeClock()
+    server, fleet = _fleet2(tiny, clock=clk, dwell_s=10.0, min_events=10)
+    try:
+        assert fleet.evaluate() == []           # idle fleet: no actions
+        _burn_up(server._models["a"])
+        actions = fleet.evaluate()
+        # one reallocation: the cool tenant donates, the burning one grows
+        assert [a["action"] for a in actions] == ["shrink", "grow"]
+        assert actions[0]["model"] == "b" and actions[0]["new_chips"] == 1
+        assert actions[1]["model"] == "a" and actions[1]["new_chips"] == 2
+        assert fleet.chips("a") == 2 and fleet.chips("b") == 1
+        assert server._models["a"].cache.buckets == (2, 4, 8)
+        # hysteresis: still burning, but inside the dwell -> NO action,
+        # and no refusal spam either (dwell is patience, not refusal)
+        assert fleet.evaluate() == []
+        # past the dwell, no feasible step remains (3 divides no bucket)
+        clk.advance(11.0)
+        actions = fleet.evaluate()
+        assert [a["reason"] for a in actions] == ["infeasible"]
+        assert fleet.chips("a") == 2            # refused loudly, not applied
+        assert fleet.history()[-1]["action"] == "refused"
+    finally:
+        fleet.detach()
+
+
+def test_evaluate_refusals_are_loud_and_typed(tiny):
+    # ceiling: the taker may not grow past its declared ceiling
+    clk = FakeClock()
+    server, fleet = _fleet2(tiny, a={"ceiling_chips": 1}, clock=clk,
+                            min_events=10)
+    _burn_up(server._models["a"])
+    actions = fleet.evaluate()
+    assert [a["reason"] for a in actions] == ["ceiling"]
+    fleet.detach()
+
+    # breaker open: capacity is provably not the problem
+    server2, fleet2 = _fleet2(tiny, clock=clk, min_events=10)
+    _burn_up(server2._models["a"])
+    server2._models["a"].breaker.snapshot = \
+        lambda: {"state": "open", "trips": 1}
+    actions = fleet2.evaluate()
+    assert [a["reason"] for a in actions] == ["breaker_open"]
+    assert fleet2.chips("a") == 1
+    fleet2.detach()
+
+    # no_capacity: nothing free and no donor can give within its floor
+    server3, fleet3 = _fleet2(tiny, b={"floor_chips": 2}, clock=clk,
+                              min_events=10)
+    _burn_up(server3._models["a"])
+    actions = fleet3.evaluate()
+    assert [a["reason"] for a in actions] == ["no_capacity"]
+    fleet3.detach()
+
+    # no_gain: the best_cached-informed estimate shows the step up buys
+    # nothing -> refused BEFORE any chip moves
+    server4, fleet4 = _fleet2(tiny, clock=clk, min_events=10)
+    _burn_up(server4._models["a"])
+    fleet4.estimate_qps = lambda model, chips: 100.0
+    actions = fleet4.evaluate()
+    assert [a["reason"] for a in actions] == ["no_gain"]
+    assert fleet4.chips("a") == 1 and fleet4.chips("b") == 2
+    fleet4.detach()
+
+
+def test_estimate_qps_reads_tuner_cache(tiny, monkeypatch):
+    server, fleet = _fleet2(tiny)
+    try:
+        # no cached measurement -> None (burn/queue pressure only)
+        monkeypatch.setattr("mxnet_tpu.tuner.best_cached",
+                            lambda **kw: None)
+        assert fleet.estimate_qps("a", 2) is None
+        monkeypatch.setattr(
+            "mxnet_tpu.tuner.best_cached",
+            lambda **kw: {"throughput_img_s_per_chip": 100.0})
+        # 2 chips keep buckets (2,4,8): 100 * 2 * (8/8) = 200
+        assert fleet.estimate_qps("a", 2) == pytest.approx(200.0)
+        # 8 chips keep only (8,): same ladder top, scale by chips
+        assert fleet.estimate_qps("a", 8) == pytest.approx(800.0)
+    finally:
+        fleet.detach()
+
+
+def test_background_evaluator_and_status(tiny):
+    server, fleet = _fleet2(tiny, interval_s=0.05, min_events=10)
+    try:
+        _burn_up(server._models["a"])
+        fleet.start()
+        assert fleet.start() is fleet           # idempotent
+        deadline = time.monotonic() + 5.0
+        while fleet.chips("a") != 2 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert fleet.chips("a") == 2            # the loop closed on its own
+        st = fleet.status()
+        assert st["evaluator_running"]
+        assert st["total_chips"] == 3 and st["free_chips"] == 0
+        assert st["models"]["a"]["chips"] == 2
+        assert st["models"]["a"]["in_excursion"]
+        assert st["models"]["a"]["burn"] > fleet.burn_threshold
+        assert {h["action"] for h in st["history"]} >= {"resize"}
+        fleet.stop()
+        assert not fleet.status()["evaluator_running"]
+    finally:
+        fleet.detach()
+
+
+# ------------------------------------------------------------- HTTP surface
+def test_fleetz_endpoint_headers_and_resize(tiny):
+    server, fleet = _fleet2(tiny, start=True)
+    ep = ServingEndpoints(server, port=0).start()
+    base = "http://127.0.0.1:%d" % ep.port
+    _, _, feat, _ = tiny
+    try:
+        doc = json.loads(urllib.request.urlopen(
+            base + "/fleetz", timeout=10).read())
+        assert doc["total_chips"] == 3
+        assert doc["models"]["b"]["chips"] == 2
+        # per-tenant headers on /predict, priority accepted in the body
+        req = urllib.request.Request(
+            base + "/predict",
+            data=json.dumps({"model": "a",
+                             "data": np.zeros(feat).tolist(),
+                             "priority": "guaranteed"}).encode(),
+            headers={"Content-Type": "application/json"})
+        resp = urllib.request.urlopen(req, timeout=30)
+        assert resp.headers["X-Fleet-Tenant"] == "a"
+        assert resp.headers["X-Fleet-Priority"] == "guaranteed"
+        assert resp.headers["X-Fleet-Chips"] == "1"
+        # manual resize over HTTP: shrink b, grow a
+        def post(doc_):
+            r = urllib.request.Request(
+                base + "/fleetz/resize", data=json.dumps(doc_).encode(),
+                headers={"Content-Type": "application/json"})
+            return json.loads(urllib.request.urlopen(r, timeout=30).read())
+        assert post({"model": "b", "chips": 1})["plan"]["direction"] \
+            == "shrink"
+        assert post({"model": "a", "chips": 2})["plan"]["buckets"] \
+            == [2, 4, 8]
+        # an impossible split answers 409 with the TYPED name
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post({"model": "a", "chips": 3})
+        assert ei.value.code == 409
+        assert json.loads(ei.value.read())["type"] == "TopologyMismatch"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post({"model": "ghost", "chips": 1})
+        assert ei.value.code == 404
+    finally:
+        ep.stop()
+        fleet.detach()
+        server.close(timeout=10.0)
+
+
+# -------------------------------------------------------- THE acceptance
+@pytest.mark.chaos
+def test_tenant_storm_isolation_and_autoscale(tiny):
+    """Storm tenant A at ~3x its 1-chip sustainable QPS while guaranteed
+    tenant B runs its declared load: the fleet must notice A's burn and
+    grow it (counter delta), B's accepted p99 must stay inside ITS SLO
+    with burn under threshold, and no request may ever be dispatched
+    past its deadline — all proven from counters, not log text."""
+    sym_json, pbytes, feat, _ = tiny
+    slo_b = 250.0
+    cfg_a = ModelConfig("a", sym_json, pbytes, feature_shape=feat,
+                        buckets=(1, 2, 4, 8), max_queue=64,
+                        deadline_ms=400.0, max_wait_ms=2.0,
+                        slo_p99_ms=100.0, trace_sample=0.02)
+    cfg_b = ModelConfig("b", sym_json, pbytes, feature_shape=feat,
+                        buckets=(1, 2, 4, 8), max_queue=64,
+                        deadline_ms=500.0, max_wait_ms=2.0,
+                        slo_p99_ms=slo_b, slo_availability=0.95,
+                        trace_sample=0.02)
+    server = ModelServer([cfg_a, cfg_b], drain_on_preemption=False)
+    fleet = FleetController(
+        server, 3,
+        [TenantPolicy("a", ceiling_chips=2),
+         TenantPolicy("b", chips=2, ceiling_chips=2)],
+        dwell_s=1.0, interval_s=0.25, min_events=10)
+    server.start(warm=True)
+    grew0 = catalog.FLEET_RESIZES.value(direction="grow")
+    try:
+        per_row_s = 0.004                       # ~250 rows/s per chip
+        with schaos.chip_scaled_executor(server, "a", per_row_s), \
+                schaos.chip_scaled_executor(server, "b", per_row_s):
+            fleet.start()
+            out = schaos.tenant_storm(server, "a", qps=400.0,
+                                      duration_s=6.0, victims={"b": 40.0},
+                                      threads=4, collect_timeout_s=20.0)
+            fleet.stop()
+        grew = catalog.FLEET_RESIZES.value(direction="grow") - grew0
+        # the fleet moved chips toward the storm — and hysteresis bounds
+        # how often (one grow per dwell window at most)
+        assert 1 <= grew <= 6
+        assert fleet.chips("a") == 2
+        # victim isolation: B's accepted p99 inside ITS SLO, burn under
+        # the excursion threshold at the end of the storm
+        victim = out["victims"]["b"]
+        assert victim["ok"] >= 0.98 * victim["submitted"]
+        assert victim["p99_ms"] <= slo_b
+        assert server._models["b"].slo.fast_burn() < fleet.burn_threshold
+        # the invariant counter: NOTHING was dispatched past a deadline
+        assert server.stats("a")["deadline_violations"] == 0
+        assert server.stats("b")["deadline_violations"] == 0
+        # the storm tenant degraded loudly, not silently: whatever was
+        # not served ok was typed-shed or expired-before-dispatch
+        s = out["storm"]
+        assert s["ok"] + s["shed"] + s["expired"] + s["error"] \
+            + s["unfinished"] == s["submitted"]
+    finally:
+        fleet.detach()
+        server.close(timeout=15.0)
+
+
+# ------------------------------------------------------ invariance guard
+def test_single_tenant_invariance(tiny):
+    """Fleet mode OFF (the default) leaves the server bit-identical to a
+    pre-fleet one: no fleet stats, no fleet headers, /fleetz answers
+    404, and the served StableHLO is BITWISE unchanged by the fleet
+    subsystem being importable/instantiated elsewhere in the process."""
+    import jax
+
+    from mxnet_tpu import symbol as sym_mod
+    from mxnet_tpu.executor import _GraphLowering
+
+    sym_json, pbytes, feat, ref = tiny
+
+    def lowered_text():
+        sym = sym_mod.load_json(sym_json)
+        fn = _GraphLowering(sym).lower(is_train=False)
+        inputs = {"data": np.zeros((2,) + feat, np.float32),
+                  "fc1_weight": np.zeros((3, feat[0]), np.float32),
+                  "fc1_bias": np.zeros((3,), np.float32)}
+        return jax.jit(fn).lower(inputs, jax.random.PRNGKey(0)).as_text()
+
+    before = lowered_text()
+    server = ModelServer([_cfg(tiny, "m")],
+                         drain_on_preemption=False).start(warm=True)
+    ep = ServingEndpoints(server, port=0).start()
+    base = "http://127.0.0.1:%d" % ep.port
+    try:
+        assert server._fleet is None
+        d = np.random.RandomState(2).randn(*feat).astype("float32")
+        np.testing.assert_allclose(server.predict("m", d, timeout=30.0),
+                                   ref(d), rtol=1e-4, atol=1e-5)
+        assert "fleet" not in server.stats("m")
+        req = urllib.request.Request(
+            base + "/predict",
+            data=json.dumps({"model": "m",
+                             "data": d.tolist()}).encode(),
+            headers={"Content-Type": "application/json"})
+        resp = urllib.request.urlopen(req, timeout=30)
+        assert resp.headers["X-Fleet-Tenant"] is None
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(base + "/fleetz", timeout=10)
+        assert ei.value.code == 404
+        # a fleet on a DIFFERENT server never leaks into this one's
+        # lowering: the served StableHLO stays bitwise identical
+        other = ModelServer([_cfg(tiny, "a"), _cfg(tiny, "b")],
+                            drain_on_preemption=False)
+        other_fleet = FleetController(other, 3,
+                                      [TenantPolicy("a"),
+                                       TenantPolicy("b", chips=2)])
+        try:
+            assert lowered_text() == before
+        finally:
+            other_fleet.detach()
+        assert "fleet" not in server.stats("m")
+    finally:
+        ep.stop()
+        server.close(timeout=10.0)

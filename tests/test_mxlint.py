@@ -1043,3 +1043,100 @@ def test_lint_server_t215_silent_cases(tmp_path, monkeypatch):
         _serve_cfg(name="t215s")).by_rule("MXL-T215")
     cfg = _serve_cfg(name="t215s", tier="int8")
     assert not analysis.lint_server(cfg).by_rule("MXL-T215")
+
+
+# ---------------------------------------------------------------------------
+# MXL-T217: unisolated-multi-tenant-fleet — >= 2 models sharing a serving
+# process with nothing separating their traffic, and autoscaled tenants
+# that declare no SLO for the burn-rate evaluator to watch
+# ---------------------------------------------------------------------------
+def _t217_server(names=("t217a", "t217b"), **cfg_kw):
+    from mxnet_tpu.serving import ModelServer
+    return ModelServer([_serve_cfg(name=n, **cfg_kw) for n in names],
+                       drain_on_preemption=False)
+
+
+@pytest.mark.fleet
+def test_lint_server_t217_fires_without_isolation():
+    from mxnet_tpu.serving import FleetController, TenantPolicy
+
+    # two models, no fleet attached: the storm of one is the outage of all
+    srv = _t217_server()
+    diags = analysis.lint_server(srv).by_rule("MXL-T217")
+    assert len(diags) == 1
+    assert diags[0].location == "server"
+    assert diags[0].severity == "warning"
+    assert "no tenant isolation" in diags[0].message
+    assert "no fleet controller attached" in diags[0].message
+
+    # a fleet whose policies declare no quota and a single priority class
+    # separates nothing — still fires, with the sharper diagnosis
+    fleet = FleetController(srv, 2, [
+        TenantPolicy("t217a", ceiling_chips=1),
+        TenantPolicy("t217b", ceiling_chips=1)])
+    try:
+        diags = analysis.lint_server(srv).by_rule("MXL-T217")
+        assert len(diags) == 1
+        assert "declares no per-tenant quota" in diags[0].message
+        # a FleetController is accepted directly and unwrapped
+        assert len(analysis.lint_server(fleet).by_rule("MXL-T217")) == 1
+    finally:
+        fleet.detach()
+
+
+@pytest.mark.fleet
+def test_lint_server_t217_tenant_level_no_slo():
+    from mxnet_tpu.serving import FleetController, TenantPolicy
+
+    # quota quiets the server-level half; tenant 'a' is autoscaled
+    # (ceiling above floor) but declares no SLO -> tenant-level finding
+    srv = _t217_server()
+    fleet = FleetController(srv, 3, [
+        TenantPolicy("t217a", quota_qps=50.0, ceiling_chips=2),
+        TenantPolicy("t217b", ceiling_chips=1)])
+    try:
+        diags = analysis.lint_server(srv).by_rule("MXL-T217")
+        assert len(diags) == 1
+        assert diags[0].location == "model 't217a'"
+        assert "declares no SLO" in diags[0].message
+    finally:
+        fleet.detach()
+
+    # same shape with the SLO declared: fully silent
+    srv2 = _t217_server(slo_p99_ms=50.0)
+    fleet2 = FleetController(srv2, 3, [
+        TenantPolicy("t217a", quota_qps=50.0, ceiling_chips=2),
+        TenantPolicy("t217b", ceiling_chips=1)])
+    try:
+        assert not analysis.lint_server(srv2).by_rule("MXL-T217")
+    finally:
+        fleet2.detach()
+
+
+@pytest.mark.fleet
+def test_lint_server_t217_silent_and_suppressed():
+    from mxnet_tpu.serving import FleetController, TenantPolicy
+
+    # a single-model server has no tenants to isolate: silent
+    assert not analysis.lint_server(
+        _t217_server(names=("t217solo",))).by_rule("MXL-T217")
+    # a lone ModelConfig likewise
+    assert not analysis.lint_server(
+        _serve_cfg(name="t217cfg")).by_rule("MXL-T217")
+
+    # mixed priority classes count as isolation (something to preempt),
+    # with every tenant pinned (ceiling == floor) nothing else fires
+    srv = _t217_server()
+    fleet = FleetController(srv, 2, [
+        TenantPolicy("t217a", ceiling_chips=1),
+        TenantPolicy("t217b", priority="best_effort", ceiling_chips=1)])
+    try:
+        assert not analysis.lint_server(srv).by_rule("MXL-T217")
+    finally:
+        fleet.detach()
+
+    # suppression moves the finding to the suppressed list
+    report = analysis.lint_server(_t217_server(),
+                                  suppress=("MXL-T217",))
+    assert not report.by_rule("MXL-T217")
+    assert any(d.rule_id == "MXL-T217" for d in report.suppressed)

@@ -1073,3 +1073,126 @@ def test_mxtrace_registered_with_tunnel_session():
     assert "mxtrace.py" in bench_src
     tool_src = open(os.path.join(REPO, "tools", "mxtrace.py")).read()
     assert 'tunnel_session.register("mxtrace.py"' in tool_src
+
+
+@pytest.mark.fleet
+def test_mxfleet_cli_matrix(tmp_path):
+    """mxfleet: selfcheck proves the fleet control loop in one process
+    (exit 0); status/resize against a live fleet speak /fleetz (0 on
+    healthy, 1 on a typed TopologyMismatch refusal); a dead URL is
+    "cannot run" (2), never a silent 0."""
+    cli = os.path.join(REPO, "tools", "mxfleet.py")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": "",
+           "MXTPU_TUNNEL_REG_DIR": str(tmp_path / "reg")}
+    p = subprocess.run([sys.executable, cli, "selfcheck"],
+                       capture_output=True, text=True, timeout=300, env=env)
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "PASS" in p.stdout
+
+    # nothing listening: cannot run (2), for status and resize alike
+    dead = "http://127.0.0.1:9"
+    p = subprocess.run([sys.executable, cli, "status", "--url", dead],
+                       capture_output=True, text=True, timeout=60, env=env)
+    assert p.returncode == 2, p.stdout + p.stderr
+    p = subprocess.run([sys.executable, cli, "resize", "--url", dead,
+                        "--model", "a", "--chips", "2"],
+                       capture_output=True, text=True, timeout=60, env=env)
+    assert p.returncode == 2, p.stdout + p.stderr
+
+    # against a live two-tenant fleet: status reads /fleetz, resize
+    # round-trips a plan, an over-budget ask is a 409 refusal (exit 1)
+    from mxnet_tpu.serving import load as sload
+    from mxnet_tpu.serving.endpoints import ServingEndpoints
+    from mxnet_tpu.serving.fleet import FleetController, TenantPolicy
+    from mxnet_tpu.serving.server import ModelConfig, ModelServer
+    sym, params, shape, _ = sload.tiny_model()
+    mk = lambda n: ModelConfig(n, sym, params, feature_shape=shape,
+                               buckets=(1, 2), max_queue=8,
+                               deadline_ms=500.0, slo_p99_ms=200.0)
+    server = ModelServer([mk("a"), mk("b")], drain_on_preemption=False)
+    fleet = FleetController(
+        server, 3,
+        [TenantPolicy("a", quota_qps=100.0, ceiling_chips=2),
+         TenantPolicy("b", chips=2, ceiling_chips=2)])
+    server.start(warm=False)
+    ep = ServingEndpoints(server, port=0).start()
+    base = "http://127.0.0.1:%d" % ep.port
+    try:
+        p = subprocess.run([sys.executable, cli, "status", "--url", base],
+                           capture_output=True, text=True, timeout=60,
+                           env=env)
+        assert p.returncode == 0, p.stdout + p.stderr
+        assert "chips placed" in p.stdout and "b" in p.stdout
+        p = subprocess.run([sys.executable, cli, "resize", "--url", base,
+                            "--model", "b", "--chips", "1"],
+                           capture_output=True, text=True, timeout=60,
+                           env=env)
+        assert p.returncode == 0, p.stdout + p.stderr
+        assert "resized 'b' shrink -> 1" in p.stdout
+        p = subprocess.run([sys.executable, cli, "resize", "--url", base,
+                            "--model", "a", "--chips", "2"],
+                           capture_output=True, text=True, timeout=60,
+                           env=env)
+        assert p.returncode == 0, p.stdout + p.stderr
+        assert "resized 'a' grow -> 2" in p.stdout
+        # a=2 b=1 on a 3-chip budget: asking a -> 3 would overcommit
+        p = subprocess.run([sys.executable, cli, "resize", "--url", base,
+                            "--model", "a", "--chips", "3"],
+                           capture_output=True, text=True, timeout=60,
+                           env=env)
+        assert p.returncode == 1, p.stdout + p.stderr
+        assert "REFUSED" in p.stderr and "TopologyMismatch" in p.stderr
+    finally:
+        ep.stop()
+        fleet.detach()
+        server.close(timeout=10.0)
+
+
+@pytest.mark.fleet
+def test_mxfleet_registered_with_tunnel_session():
+    """mxfleet joins the tunnel-client registry on BOTH sides (MARKERS +
+    bench.py's /proc scan) and self-registers in main()."""
+    import tunnel_session
+    bench_src = open(os.path.join(REPO, "bench.py")).read()
+    assert "mxfleet.py" in tunnel_session.MARKERS
+    assert "mxfleet.py" in bench_src
+    tool_src = open(os.path.join(REPO, "tools", "mxfleet.py")).read()
+    assert 'tunnel_session.register("mxfleet.py"' in tool_src
+
+
+@pytest.mark.fleet
+def test_loadgen_tenants_cli_matrix(tmp_path):
+    """loadgen --tenants: mixed-traffic selfhost run over a fleet emits a
+    label="fleet" ledger row perfwatch can baseline (exit 0); malformed
+    specs and --url are rejected before any backend init (exit 2)."""
+    import json as _json
+    cli = os.path.join(REPO, "tools", "loadgen.py")
+    ledger = str(tmp_path / "fleet_ledger.jsonl")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": "",
+           "MXTPU_TUNNEL_REG_DIR": str(tmp_path / "reg")}
+    p = subprocess.run([sys.executable, cli,
+                        "--tenants", "a:50:guaranteed,b:25:best_effort",
+                        "--fleet-chips", "3", "--duration", "0.8",
+                        "--ledger", ledger, "--format", "json"],
+                       capture_output=True, text=True, timeout=300, env=env)
+    assert p.returncode == 0, p.stdout + p.stderr
+    row = _json.loads(p.stdout.strip().splitlines()[-1])
+    assert row["label"] == "fleet"
+    assert row["qps[a]"] > 0 and row["qps[b]"] > 0
+    assert row["priority[b]"] == "best_effort"
+
+    # the persisted row is a perfwatch baseline; bracketed metrics
+    # inherit their family's direction in self-compare
+    from mxnet_tpu.observability import perfwatch
+    norm, err = perfwatch.load_artifact(ledger)
+    assert not err and norm["kind"] == "fleet_row"
+    assert perfwatch.compare(norm, norm)["status"] == "ok"
+
+    # bad args die before any backend init: one tenant, and --url
+    p = subprocess.run([sys.executable, cli, "--tenants", "a:50"],
+                       capture_output=True, text=True, timeout=60, env=env)
+    assert p.returncode == 2, p.stdout + p.stderr
+    p = subprocess.run([sys.executable, cli, "--tenants", "a:50,b:25",
+                        "--url", "http://127.0.0.1:9"],
+                       capture_output=True, text=True, timeout=60, env=env)
+    assert p.returncode == 2, p.stdout + p.stderr

@@ -19,10 +19,22 @@ Usage::
         --storm 3 --deadline-ms 100          # deliberate overload probe
     python tools/loadgen.py --url http://127.0.0.1:8080 --model tiny \
         --feature-shape 4 --qps 100 --duration 5
+    python tools/loadgen.py --selfhost \
+        --tenants a:200:guaranteed,b:40:best_effort --fleet-chips 3
+
+Mixed-traffic mode (``--tenants name:qps[:priority],...``, selfhost
+only): one tiny-model tenant per entry driven concurrently at its
+declared rate; ``--fleet-chips N`` attaches a
+``serving.fleet.FleetController`` over an N-chip budget so the run
+exercises fair queueing + autoscaling, and ``--storm MULT`` multiplies
+the FIRST tenant's rate (the storm tenant). The result lands as one
+``label="fleet"`` CostLedger row with bracketed per-tenant metrics
+(``p99_ms[a]``…) that ``tools/perfwatch.py`` compares with the base
+metric's direction.
 
 Exit codes (mxlint convention): 0 = sustained (degraded fraction within
-``--max-degraded-frac`` and p99 within the deadline), 1 = degraded, 2 =
-cannot run (bad args, no target).
+``--max-degraded-frac`` and p99 within the deadline; every tenant in
+--tenants mode), 1 = degraded, 2 = cannot run (bad args, no target).
 """
 import argparse
 import json
@@ -40,7 +52,7 @@ sys.path.insert(1, os.path.join(HERE, "tools"))
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="load generator for the batching model server")
-    tgt = ap.add_mutually_exclusive_group(required=True)
+    tgt = ap.add_mutually_exclusive_group()
     tgt.add_argument("--selfhost", action="store_true",
                      help="serve the model in-process and drive it")
     tgt.add_argument("--url", default=None,
@@ -69,6 +81,15 @@ def main(argv=None) -> int:
                     help="cost-ledger path for the serving row (default: "
                          "MXNET_PERF_LEDGER; empty default = row printed "
                          "but not persisted)")
+    ap.add_argument("--tenants", default=None, metavar="SPEC",
+                    help="mixed-traffic mode: name:qps[:priority],... "
+                         "(priority guaranteed|best_effort; selfhost "
+                         "only) — one tiny-model tenant per entry, "
+                         "driven concurrently")
+    ap.add_argument("--fleet-chips", type=int, default=None,
+                    help="with --tenants: attach a FleetController over "
+                         "this chip budget (autoscaler + fair queueing "
+                         "live during the run)")
     ap.add_argument("--trace-dump", default=None, metavar="PATH",
                     help="selfhost: write the trace ring to PATH after "
                          "the run (pretty-print with tools/mxtrace.py) — "
@@ -77,6 +98,10 @@ def main(argv=None) -> int:
     ap.add_argument("--format", choices=("text", "json"), default="text")
     args = ap.parse_args(argv)
 
+    if not (args.selfhost or args.url or args.tenants):
+        sys.stderr.write("loadgen: pick a target: --selfhost, --url or "
+                         "--tenants\n")
+        return 2
     if args.qps <= 0 or args.duration <= 0 or args.threads < 1:
         sys.stderr.write("loadgen: qps/duration/threads must be "
                          "positive\n")
@@ -89,6 +114,12 @@ def main(argv=None) -> int:
     except Exception:
         pass
 
+    if args.tenants:
+        if args.url:
+            sys.stderr.write("loadgen: --tenants is selfhost-only (the "
+                             "fleet lives in the serving process)\n")
+            return 2
+        return _run_tenants(args)
     if args.url:
         return _run_http(args, qps)
     return _run_selfhost(args, qps)
@@ -157,6 +188,128 @@ def _run_selfhost(args, qps) -> int:
     v = sload.verdict(stats, max_degraded_frac=args.max_degraded_frac)
     _emit(args, stats, row, v)
     return 0 if v == "ok" else 1
+
+
+def _parse_tenants(spec: str):
+    """``a:200:guaranteed,b:40:best_effort`` -> [(name, qps, priority)]."""
+    out = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        bits = part.split(":")
+        if len(bits) not in (2, 3):
+            raise ValueError("tenant entry %r is not name:qps[:priority]"
+                             % part)
+        name, tqps = bits[0].strip(), float(bits[1])
+        prio = bits[2].strip() if len(bits) == 3 else "guaranteed"
+        if not name or tqps <= 0:
+            raise ValueError("tenant entry %r needs a name and a "
+                             "positive qps" % part)
+        out.append((name, tqps, prio))
+    if len(out) < 2:
+        raise ValueError("--tenants needs at least two entries")
+    if len({n for n, _, _ in out}) != len(out):
+        raise ValueError("duplicate tenant names in --tenants")
+    return out
+
+
+def _run_tenants(args) -> int:
+    try:
+        from mxnet_tpu.observability import xcost
+        from mxnet_tpu.serving import ModelConfig, ModelServer
+        from mxnet_tpu.serving import load as sload
+    except Exception as e:
+        sys.stderr.write("loadgen: cannot import the backend: %r\n" % e)
+        return 2
+    try:
+        tenants = _parse_tenants(args.tenants)
+    except ValueError as e:
+        sys.stderr.write("loadgen: %s\n" % e)
+        return 2
+
+    sym, params, shape, _ = sload.tiny_model()
+    cfgs = [ModelConfig(name, sym, params, feature_shape=shape,
+                        max_queue=args.max_queue,
+                        deadline_ms=args.deadline_ms)
+            for name, _, _ in tenants]
+    fleet = None
+    try:
+        server = ModelServer(cfgs)
+        if args.fleet_chips is not None:
+            from mxnet_tpu.serving.fleet import (FleetController,
+                                                 TenantPolicy)
+            fleet = FleetController(
+                server, args.fleet_chips,
+                [TenantPolicy(name, priority=prio)
+                 for name, _, prio in tenants])
+        server.start(warm=True)
+    except Exception as e:
+        sys.stderr.write("loadgen: cannot build the tenant fleet: %r\n"
+                         % e)
+        return 2
+
+    results = {}
+    errors = []
+
+    def drive(name, tqps):
+        try:
+            results[name] = sload.run_load(
+                server, name, qps=tqps, duration_s=args.duration,
+                threads=args.threads, deadline_ms=args.deadline_ms)
+        except Exception as e:         # noqa: BLE001 — surfaced below
+            errors.append((name, e))
+
+    storm_mult = args.storm if args.storm else 1.0
+    try:
+        if fleet is not None:
+            fleet.start()
+        workers = [threading.Thread(
+            target=drive, name="loadgen-%s" % name,
+            args=(name, tqps * (storm_mult if i == 0 else 1.0)),
+            daemon=True)
+            for i, (name, tqps, _) in enumerate(tenants)]
+        for w in workers:
+            w.start()
+        for w in workers:
+            w.join()
+    finally:
+        if fleet is not None:
+            fleet.stop()
+        server.close(timeout=15.0)
+    if errors:
+        sys.stderr.write("loadgen: tenant %r failed: %r\n" % errors[0])
+        return 2
+
+    worst = "ok"
+    for name, tqps, prio in tenants:
+        stats = results[name]
+        stats["priority"] = prio
+        stats["deadline_violations"] = \
+            server.stats(name)["deadline_violations"]
+        v = sload.verdict(stats, max_degraded_frac=args.max_degraded_frac)
+        if v != "ok":
+            worst = "degraded"
+        if args.format == "text":
+            print("loadgen: tenant %-12s %-11s %s  offered=%.0f qps  "
+                  "achieved=%.1f qps  ok=%d shed=%d expired=%d error=%d  "
+                  "p50=%.2fms p99=%.2fms  deadline_violations=%d"
+                  % (name, prio, v, stats.get("qps_offered", 0.0),
+                     stats.get("qps", 0.0), stats.get("ok", 0),
+                     stats.get("shed", 0), stats.get("expired", 0),
+                     stats.get("error", 0),
+                     stats.get("p50_ms") or float("nan"),
+                     stats.get("p99_ms") or float("nan"),
+                     stats["deadline_violations"]), flush=True)
+    ledger = (xcost.CostLedger(args.ledger) if args.ledger
+              else xcost.get_ledger())
+    row = sload.fleet_row(results, ledger=ledger,
+                          extra={"target": "selfhost",
+                                 "fleet_chips": args.fleet_chips,
+                                 "storm": args.storm})
+    if args.format == "json":
+        print(json.dumps(row, sort_keys=True), flush=True)
+    return 0 if worst == "ok" else 1
 
 
 def _run_http(args, qps) -> int:

@@ -50,7 +50,10 @@ def load(path):
 
 
 def _anomalous(t) -> bool:
-    return t.get("outcome") != "ok" or bool(t.get("violated"))
+    # "event" = operational markers (fleet resizes) recorded into the
+    # ring for context — informative, not failures; they must not flip
+    # the exit code of an otherwise-clean dump
+    return t.get("outcome") not in ("ok", "event") or bool(t.get("violated"))
 
 
 def _dominant_stage(t):
