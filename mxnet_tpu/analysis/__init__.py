@@ -1,26 +1,69 @@
-"""mxlint — static graph & trace analysis for TPU correctness/perf hazards.
+"""mxlint — static graph, trace & concurrency analysis for mxnet_tpu.
 
-Two front ends over one diagnostic core:
+Three front ends over one diagnostic core:
 
 * :func:`lint_symbol` / :func:`lint_symbol_json` — walk a Symbol/CachedOp
   graph (shape+dtype abstract eval, registry cross-check) before it binds.
 * :func:`lint_step` / :func:`lint_trainer` — abstract-eval a trainer step
   function the way jit will see it, plus source/closure inspection for the
   hazards a jaxpr can't show (host syncs, retrace triggers).
+* :func:`lint_concurrency` — AST analysis of the threaded host spine:
+  lock-order inversions, blocking calls under locks, guard-inconsistent
+  shared state (the MXL-C300 family). Runtime twin: :mod:`.lockwatch`
+  (``MXNET_LOCKCHECK=1``).
 
 Findings are :class:`Diagnostic` records in a :class:`Report` (text / JSON /
-``assert_clean`` for pytest). ``tools/mxlint.py`` is the CLI. Rule catalog:
-``docs/static_analysis.md``.
+``assert_clean`` for pytest). ``tools/mxlint.py`` and ``tools/mxrace.py``
+are the CLIs. Rule catalog: ``docs/static_analysis.md``.
 
     from mxnet_tpu import analysis
     analysis.lint_symbol(net_sym, shapes={"data": (64, 3, 224, 224)})
     analysis.lint_step(train_step, (params, batch)).assert_clean()
+    analysis.lint_concurrency(["mxnet_tpu/"]).assert_clean("warning")
+
+The graph/trace front ends import jax and are loaded lazily (PEP 562) so
+that stdlib-only consumers — the concurrency linter, the lockwatch runtime
+sanitizer, and every instrumented lock site — never pay for (or cycle
+into) the heavy half of the package.
 """
-from .diagnostics import Diagnostic, Report, RuleDef, RULES, Severity
-from .graph_lint import lint_symbol, lint_symbol_json
-from .trace_lint import (lint_step, lint_trainer, lint_data_iter,
-                         lint_server)
+from .diagnostics import Diagnostic, Report, RuleDef, Severity
 
 __all__ = ["Diagnostic", "Report", "RuleDef", "RULES", "Severity",
            "lint_symbol", "lint_symbol_json", "lint_step", "lint_trainer",
-           "lint_data_iter", "lint_server"]
+           "lint_data_iter", "lint_server", "lint_concurrency", "lockwatch"]
+
+# symbol -> submodule that defines it (imported on first attribute access)
+_LAZY = {
+    "lint_symbol": ".graph_lint",
+    "lint_symbol_json": ".graph_lint",
+    "lint_step": ".trace_lint",
+    "lint_trainer": ".trace_lint",
+    "lint_data_iter": ".trace_lint",
+    "lint_server": ".trace_lint",
+    "lint_concurrency": ".concurrency",
+    "lockwatch": None,          # the submodule itself
+}
+
+# every front end that registers rules — RULES must reflect all of them
+_FRONT_ENDS = (".graph_lint", ".trace_lint", ".concurrency")
+
+
+def __getattr__(name):
+    import importlib
+    if name == "RULES":
+        # the catalog is complete only once every front end has registered
+        for mod in _FRONT_ENDS:
+            importlib.import_module(mod, __name__)
+        from .diagnostics import RULES
+        return RULES
+    if name in _LAZY:
+        target = _LAZY[name]
+        if target is None:
+            return importlib.import_module("." + name, __name__)
+        mod = importlib.import_module(target, __name__)
+        return getattr(mod, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(__all__))

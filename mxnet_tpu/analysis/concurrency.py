@@ -1,0 +1,954 @@
+"""Concurrency lint — static AST analysis of the threaded host spine.
+
+The reference framework's C++ dependency engine makes concurrency safe by
+construction: every conflicting operation is serialized by the engine, so
+user code never holds a lock. Our host spine (serving, resilience, io,
+observability) is raw Python ``threading``, and lock misuse has been the
+repo's single recurring bug class. This front end models
+``threading.Lock/RLock/Condition`` attributes per class, builds an
+inter-method lock-acquisition graph, and reports the MXL-C300 rule family
+through the shared diagnostics core (inline ``# mxlint: disable=``, JSON,
+``assert_clean``).
+
+What the model can see (and its honest limits):
+
+* Lock identity is ``Class.attr`` (or ``module:NAME``) — two *instances*
+  of one class share an identity, so instance-vs-instance ordering between
+  same-class locks is out of scope (the runtime twin
+  :mod:`~mxnet_tpu.analysis.lockwatch` tracks real instances).
+* Cross-object resolution rides type annotations (``st: _ModelState``)
+  and ``self.attr = ScannedClass(...)`` constructor assignments; anything
+  else is opaque.
+* Call-graph expansion is depth-limited and lexical — callbacks, dynamic
+  dispatch and inheritance are invisible.
+
+Runtime twin: ``MXNET_LOCKCHECK=1`` (:mod:`.lockwatch`). CLI:
+``tools/mxrace.py``. Rule catalog: docs/static_analysis.md.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .diagnostics import (Diagnostic, Report, Severity, register_rule,
+                          parse_disable_comment)
+
+__all__ = ["lint_concurrency"]
+
+# --------------------------------------------------------------------------
+# rule catalog (docs/static_analysis.md mirrors this table; the drift test
+# in tests/test_mxlint.py cross-checks ids/severities/titles)
+# --------------------------------------------------------------------------
+register_rule(
+    "MXL-C300", Severity.ERROR, "lock-order-inversion",
+    "Two locks are acquired in opposite orders on different code paths "
+    "(a cycle in the inter-method lock-acquisition graph). Two threads "
+    "taking the paths concurrently deadlock.")
+register_rule(
+    "MXL-C301", Severity.WARNING, "blocking-under-lock",
+    "An untimed blocking call (queue get/put, Thread.join, sleep, "
+    "socket/HTTP, device sync such as block_until_ready/np.asarray) runs "
+    "while a lock is held — every other thread needing that lock stalls "
+    "for the full blocking duration.")
+register_rule(
+    "MXL-C302", Severity.WARNING, "wait-without-while",
+    "Condition.wait() can return spuriously and after stolen wakeups; "
+    "waiting anywhere but a while-predicate loop acts on a guess.")
+register_rule(
+    "MXL-C303", Severity.ERROR, "reentrant-acquire",
+    "A call path re-enters a method that re-acquires a plain Lock the "
+    "caller already holds — self-deadlock (the PR-12 shape: queue.close() "
+    "called back under the queue's own lock).")
+register_rule(
+    "MXL-C304", Severity.WARNING, "guard-inconsistent-state",
+    "An attribute is written under a lock in one method but read or "
+    "written lock-free in another — the lock guards nothing; readers see "
+    "torn or stale state.")
+register_rule(
+    "MXL-C305", Severity.WARNING, "unjoined-thread",
+    "A Thread is started but its owning scope has no join() and no stop "
+    "Event it ever sets — the thread leaks past shutdown and races "
+    "teardown.")
+register_rule(
+    "MXL-C306", Severity.WARNING, "acquire-without-finally",
+    "lock.acquire() with no release() in a finally block — any exception "
+    "between acquire and release leaves the lock held forever.")
+
+_MAX_DEPTH = 5          # call-graph expansion depth bound
+
+_LOCK_CTORS = {"Lock": "lock", "RLock": "rlock", "Condition": "condition",
+               "make_lock": "lock", "make_rlock": "rlock"}
+_BLOCKING_HTTP_MODULES = {"requests", "urllib", "urllib2", "httpx",
+                          "http", "socket"}
+_SOCKET_ATTRS = {"recv", "recvfrom", "accept", "connect", "sendall"}
+_DEVICE_SYNC_ATTRS = {"block_until_ready", "asnumpy", "wait_to_read",
+                      "device_get"}
+_NP_MODULES = {"np", "numpy"}
+
+
+# --------------------------------------------------------------------------
+# per-function facts
+# --------------------------------------------------------------------------
+class _Ev:
+    """One ordered event inside a function body, with the lock multiset
+    lexically held at its site (``with`` statements seen so far)."""
+    __slots__ = ("kind", "line", "held", "data")
+
+    def __init__(self, kind: str, line: int, held: Tuple[str, ...], data):
+        self.kind = kind        # acquire | blocking | call | wait
+        self.line = line
+        self.held = held
+        self.data = data
+
+
+class _Func:
+    def __init__(self, file: str, cls: str, name: str, def_line: int):
+        self.file = file
+        self.cls = cls                  # "" for module-level functions
+        self.name = name
+        self.def_line = def_line
+        self.events: List[_Ev] = []
+        # attr -> list of (line, frozenset(held), is_write, method)
+        self.accesses: List[Tuple[str, int, frozenset, bool]] = []
+        self.manual_acquires: List[Tuple[str, int]] = []
+        self.finally_released: Set[str] = set()
+
+    @property
+    def qualname(self) -> str:
+        stem = os.path.splitext(os.path.basename(self.file))[0]
+        return ".".join(p for p in (stem, self.cls, self.name) if p)
+
+
+class _Class:
+    def __init__(self, name: str, file: str, line: int):
+        self.name = name
+        self.file = file
+        self.line = line
+        # attr -> (kind, alias_of_lid or "")
+        self.locks: Dict[str, Tuple[str, str]] = {}
+        self.attr_types: Dict[str, str] = {}    # attr -> raw ctor class name
+        self.infra_attrs: Set[str] = set()      # locks/events/threads/queues
+        self.method_names: Set[str] = set()     # known before bodies scan
+        self.methods: Dict[str, _Func] = {}
+        self.thread_starts: List[Tuple[int, str]] = []  # (line, method)
+        self.has_join = False
+        self.event_set = False                  # a stop Event gets .set()
+
+
+class _Model:
+    """Everything the scan learned, across all files."""
+
+    def __init__(self):
+        self.classes: Dict[str, _Class] = {}
+        self.mod_locks: Dict[str, Dict[str, Tuple[str, str]]] = {}
+        self.mod_funcs: Dict[str, Optional[Tuple]] = {}  # name -> fkey|None
+        self.funcs: Dict[Tuple, _Func] = {}              # fkey -> _Func
+        self.lock_kinds: Dict[str, str] = {}             # lid -> kind
+        self.lines: Dict[str, List[str]] = {}
+        # module-scope thread hygiene (C305)
+        self.mod_thread_starts: Dict[str, List[int]] = {}
+        self.mod_has_join: Dict[str, bool] = {}
+        self.mod_event_set: Dict[str, bool] = {}
+
+
+def _lock_ctor_kind(call: ast.Call) -> Optional[str]:
+    f = call.func
+    if isinstance(f, ast.Name):
+        return _LOCK_CTORS.get(f.id)
+    if isinstance(f, ast.Attribute):
+        return _LOCK_CTORS.get(f.attr)
+    return None
+
+
+def _ctor_parts(call: ast.Call) -> Tuple[Optional[str], Optional[str]]:
+    """(ctor name, explicit module prefix or None) for ``Foo()`` /
+    ``mod.Foo()`` call expressions."""
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id, None
+    if isinstance(f, ast.Attribute):
+        mod = f.value.id if isinstance(f.value, ast.Name) else None
+        return f.attr, mod
+    return None, None
+
+
+_INFRA_MODULES = {"threading", "queue", "multiprocessing"}
+
+
+def _is_ctor(call: ast.Call, *names: str) -> bool:
+    got, _ = _ctor_parts(call)
+    return got in names
+
+
+def _is_scanned_ctor(call: ast.Call, classes) -> Optional[str]:
+    """The scanned class a constructor call builds — unless the call is
+    explicitly qualified into threading/queue (``threading.Event()`` must
+    not resolve to a repo class that happens to be named Event)."""
+    got, mod = _ctor_parts(call)
+    if mod in _INFRA_MODULES:
+        return None
+    return got if got in classes else None
+
+
+def _ann_class(ann, classes: Dict[str, _Class]) -> Optional[str]:
+    """Pick the scanned class a parameter annotation refers to, if any."""
+    if ann is None:
+        return None
+    names: List[str] = []
+    for node in ast.walk(ann):
+        if isinstance(node, ast.Name):
+            names.append(node.id)
+        elif isinstance(node, ast.Attribute):
+            names.append(node.attr)
+        elif isinstance(node, ast.Constant) and isinstance(node.value, str):
+            names.extend(re.findall(r"\w+", node.value))
+    for n in names:
+        if n in classes:
+            return n
+    return None
+
+
+# --------------------------------------------------------------------------
+# pass A — collect classes, lock attrs, attr types
+# --------------------------------------------------------------------------
+def _collect(model: _Model, file: str, tree: ast.Module) -> None:
+    mod = os.path.splitext(os.path.basename(file))[0]
+    model.mod_locks.setdefault(mod, {})
+    model.mod_thread_starts.setdefault(mod, [])
+    model.mod_has_join.setdefault(mod, False)
+    model.mod_event_set.setdefault(mod, False)
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            kind = _lock_ctor_kind(node.value)
+            if kind:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        lid = f"{mod}:{t.id}"
+                        model.mod_locks[mod][t.id] = (kind, "")
+                        model.lock_kinds[lid] = kind
+        if isinstance(node, ast.ClassDef) and node.name not in model.classes:
+            cm = _Class(node.name, file, node.lineno)
+            cm.method_names = {s.name for s in node.body if isinstance(
+                s, (ast.FunctionDef, ast.AsyncFunctionDef))}
+            model.classes[node.name] = cm
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # pre-register module functions so calls resolve regardless of
+            # file scan order; ambiguous names resolve to nothing
+            fkey = (file, "", node.name)
+            if node.name in model.mod_funcs and \
+                    model.mod_funcs[node.name] != fkey:
+                model.mod_funcs[node.name] = None
+            else:
+                model.mod_funcs[node.name] = fkey
+
+
+def _collect_class_attrs(model: _Model, file: str, tree: ast.Module) -> None:
+    for node in tree.body:
+        if not isinstance(node, ast.ClassDef):
+            continue
+        cm = model.classes.get(node.name)
+        if cm is None or cm.file != file:
+            continue
+        for stmt in ast.walk(node):
+            if not isinstance(stmt, ast.Assign) or \
+                    not isinstance(stmt.value, ast.Call):
+                continue
+            for t in stmt.targets:
+                if not (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    continue
+                kind = _lock_ctor_kind(stmt.value)
+                if kind:
+                    alias = ""
+                    if kind == "condition" and stmt.value.args:
+                        a0 = stmt.value.args[0]
+                        if isinstance(a0, ast.Attribute) and \
+                                isinstance(a0.value, ast.Name) and \
+                                a0.value.id == "self":
+                            alias = f"{node.name}.{a0.attr}"
+                    cm.locks[t.attr] = (kind, alias)
+                    cm.infra_attrs.add(t.attr)
+                    lid = alias or f"{node.name}.{t.attr}"
+                    if not alias:
+                        model.lock_kinds[lid] = kind
+                elif _is_ctor(stmt.value, "Thread", "Event", "Queue",
+                              "SimpleQueue", "LifoQueue", "Semaphore",
+                              "BoundedSemaphore", "Barrier", "local"):
+                    cm.infra_attrs.add(t.attr)
+                else:
+                    ctor = _is_scanned_ctor(stmt.value, model.classes)
+                    if ctor:
+                        cm.attr_types[t.attr] = ctor
+
+
+# --------------------------------------------------------------------------
+# pass B — scan every function body into ordered events
+# --------------------------------------------------------------------------
+class _FuncScan:
+    def __init__(self, model: _Model, file: str, mod: str, cls: str,
+                 fnode, func: _Func):
+        self.m = model
+        self.file = file
+        self.mod = mod
+        self.cls = cls
+        self.f = func
+        self.held: List[str] = []
+        self.while_depth = 0
+        self.local_types: Dict[str, str] = {}
+        self.thread_names: Set[str] = set()
+        self.event_names: Set[str] = set()
+        if cls:
+            self.local_types["self"] = cls
+        args = fnode.args
+        for a in (list(args.posonlyargs) + list(args.args)
+                  + list(args.kwonlyargs)):
+            t = _ann_class(a.annotation, model.classes)
+            if t:
+                self.local_types[a.arg] = t
+
+    # ------------------------------------------------------------ resolve
+    def _type_of(self, node) -> Optional[str]:
+        if isinstance(node, ast.Name):
+            return self.local_types.get(node.id)
+        if isinstance(node, ast.Attribute):
+            base = self._type_of(node.value)
+            if base and base in self.m.classes:
+                return self.m.classes[base].attr_types.get(node.attr)
+        return None
+
+    def _lock_of(self, node) -> Optional[str]:
+        """Resolve an expression to a lock id, following Condition
+        aliases to the underlying lock."""
+        if isinstance(node, ast.Name):
+            ent = self.m.mod_locks.get(self.mod, {}).get(node.id)
+            if ent:
+                return f"{self.mod}:{node.id}"
+            return None
+        if isinstance(node, ast.Attribute):
+            base = self._type_of(node.value)
+            if base and base in self.m.classes:
+                ent = self.m.classes[base].locks.get(node.attr)
+                if ent:
+                    kind, alias = ent
+                    return alias or f"{base}.{node.attr}"
+        return None
+
+    def _cond_of(self, node) -> Optional[str]:
+        """Lock id when the expression is a *Condition* attribute."""
+        if isinstance(node, ast.Attribute):
+            base = self._type_of(node.value)
+            if base and base in self.m.classes:
+                ent = self.m.classes[base].locks.get(node.attr)
+                if ent and ent[0] == "condition":
+                    return ent[1] or f"{base}.{node.attr}"
+        if isinstance(node, ast.Name):
+            ent = self.m.mod_locks.get(self.mod, {}).get(node.id)
+            if ent and ent[0] == "condition":
+                return f"{self.mod}:{node.id}"
+        return None
+
+    # ------------------------------------------------------------- events
+    def _ev(self, kind: str, line: int, data) -> None:
+        self.f.events.append(_Ev(kind, line, tuple(self.held), data))
+
+    def scan(self, body: Sequence[ast.stmt]) -> None:
+        for s in body:
+            self.stmt(s)
+
+    def stmt(self, s: ast.stmt) -> None:
+        if isinstance(s, ast.With) or isinstance(s, ast.AsyncWith):
+            pushed = 0
+            for item in s.items:
+                self.exprs(item.context_expr)
+                lid = self._lock_of(item.context_expr)
+                if lid is not None:
+                    self._ev("acquire", item.context_expr.lineno, lid)
+                    self.held.append(lid)
+                    pushed += 1
+            self.scan(s.body)
+            for _ in range(pushed):
+                self.held.pop()
+        elif isinstance(s, ast.While):
+            self.exprs(s.test)
+            self.while_depth += 1
+            self.scan(s.body)
+            self.while_depth -= 1
+            self.scan(s.orelse)
+        elif isinstance(s, ast.For):
+            self.exprs(s.iter)
+            self.scan(s.body)
+            self.scan(s.orelse)
+        elif isinstance(s, (ast.If,)):
+            self.exprs(s.test)
+            self.scan(s.body)
+            self.scan(s.orelse)
+        elif isinstance(s, ast.Try):
+            self.scan(s.body)
+            for h in s.handlers:
+                self.scan(h.body)
+            self.scan(s.orelse)
+            for fs in s.finalbody:
+                self._note_finally_releases(fs)
+            self.scan(s.finalbody)
+        elif isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested def: scanned lexically — a closure defined under a
+            # lock usually runs under it (take_batch's collector shape)
+            self.scan(s.body)
+        elif isinstance(s, ast.ClassDef):
+            pass
+        elif isinstance(s, ast.Assign):
+            self._note_types(s)
+            self.exprs(s.value)
+            for t in s.targets:
+                self.target(t)
+        elif isinstance(s, ast.AugAssign):
+            self.exprs(s.value)
+            self.exprs(s.target)        # read
+            self.target(s.target)       # + write
+        elif isinstance(s, ast.AnnAssign):
+            if s.value is not None:
+                self.exprs(s.value)
+                self.target(s.target)
+        elif isinstance(s, (ast.Expr, ast.Return)):
+            v = s.value
+            if v is not None:
+                self.exprs(v)
+        elif isinstance(s, (ast.Assert, ast.Raise, ast.Delete)):
+            for child in ast.iter_child_nodes(s):
+                self.exprs(child)
+
+    def _note_types(self, s: ast.Assign) -> None:
+        if len(s.targets) != 1 or not isinstance(s.targets[0], ast.Name):
+            return
+        name = s.targets[0].id
+        v = s.value
+        if isinstance(v, ast.Call):
+            ctor, cmod = _ctor_parts(v)
+            scanned = _is_scanned_ctor(v, self.m.classes)
+            if scanned:
+                self.local_types[name] = scanned
+            elif ctor == "Thread":
+                self.thread_names.add(name)
+            elif ctor == "Event":
+                self.event_names.add(name)
+        elif isinstance(v, (ast.Name, ast.Attribute)):
+            t = self._type_of(v)
+            if t:
+                self.local_types[name] = t
+            if isinstance(v, ast.Attribute) and \
+                    isinstance(v.value, ast.Name) and v.value.id == "self" \
+                    and self.cls:
+                cm = self.m.classes.get(self.cls)
+                if cm and v.attr in cm.infra_attrs:
+                    # `t = self._thread` — keep threadness for .join checks
+                    self.thread_names.add(name)
+
+    def target(self, t) -> None:
+        """Record attribute *writes* (C304)."""
+        if isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name) \
+                and t.value.id == "self" and self.cls:
+            self.f.accesses.append(
+                (t.attr, t.lineno, frozenset(self.held), True))
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                self.target(e)
+        elif isinstance(t, ast.Subscript):
+            self.exprs(t.value)     # d[k] = v reads (and mutates) d
+
+    # ------------------------------------------------ expression traversal
+    def exprs(self, node) -> None:
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call):
+                self.call(n)
+            elif isinstance(n, ast.Attribute) and \
+                    isinstance(n.value, ast.Name) and n.value.id == "self" \
+                    and isinstance(n.ctx, ast.Load) and self.cls:
+                self.f.accesses.append(
+                    (n.attr, n.lineno, frozenset(self.held), False))
+
+    @staticmethod
+    def _kw(call: ast.Call, *names: str) -> bool:
+        return any(k.arg in names for k in call.keywords)
+
+    def call(self, c: ast.Call) -> None:
+        f = c.func
+        attr = f.attr if isinstance(f, ast.Attribute) else None
+        name = f.id if isinstance(f, ast.Name) else None
+
+        # --- manual acquire / release on a known lock (C306 bookkeeping)
+        if attr in ("acquire", "release"):
+            lid = self._lock_of(f.value)
+            if lid is not None:
+                if attr == "acquire":
+                    nonblocking = self._kw(c, "blocking") and any(
+                        k.arg == "blocking"
+                        and isinstance(k.value, ast.Constant)
+                        and k.value.value is False for k in c.keywords)
+                    if c.args and isinstance(c.args[0], ast.Constant) \
+                            and c.args[0].value in (False, 0):
+                        nonblocking = True
+                    if not nonblocking:
+                        self.f.manual_acquires.append((lid, c.lineno))
+                        self._ev("acquire", c.lineno, lid)
+                return
+
+        # --- Condition.wait: C302 territory, never C301 (wait releases
+        # the lock it rides)
+        if attr == "wait":
+            cond = self._cond_of(f.value)
+            if cond is not None:
+                self._ev("wait", c.lineno, (cond, self.while_depth > 0))
+                return
+            lid = self._lock_of(f.value)
+            if lid is not None:     # Event-style wait on a lock? unlikely
+                return
+            if not c.args and not self._kw(c, "timeout"):
+                self._ev("blocking", c.lineno, "untimed .wait()")
+            return
+
+        # --- thread lifecycle (C305 bookkeeping)
+        if attr == "start":
+            started = False
+            v = f.value
+            if isinstance(v, ast.Name) and v.id in self.thread_names:
+                started = True
+            elif isinstance(v, ast.Call) and _is_ctor(v, "Thread"):
+                started = True
+            elif isinstance(v, ast.Attribute) and \
+                    isinstance(v.value, ast.Name) and v.value.id == "self":
+                cm = self.m.classes.get(self.cls)
+                if cm and v.attr in cm.infra_attrs and \
+                        v.attr in getattr(cm, "_thread_attrs", set()):
+                    started = True
+            if started:
+                self._note_thread_start(c.lineno)
+        if (attr and "join" in attr) or (name and "join" in name):
+            self._note_join()
+        if attr == "set" and isinstance(f.value, (ast.Name, ast.Attribute)):
+            self._note_event_set(f.value)
+
+        # --- blocking-call heuristics (C301)
+        desc = self._blocking_desc(c, attr, name, f)
+        if desc:
+            self._ev("blocking", c.lineno, desc)
+            return
+
+        # --- resolvable calls feed the inter-method expansion
+        if attr is not None:
+            t = self._type_of(f.value)
+            if t and t in self.m.classes and \
+                    attr in self.m.classes[t].method_names:
+                self._ev("call", c.lineno, ("class", t, attr))
+                return
+        if name is not None and self.m.mod_funcs.get(name) is not None:
+            self._ev("call", c.lineno, ("func", name))
+
+    def _blocking_desc(self, c: ast.Call, attr, name, f) -> Optional[str]:
+        # sleep
+        if name == "sleep" or (attr == "sleep" and isinstance(
+                f.value, ast.Name) and f.value.id == "time"):
+            return "time.sleep()"
+        # untimed join: zero positional args, no timeout kwarg (str.join
+        # and os.path.join always pass a positional argument)
+        if attr == "join" and not c.args and not self._kw(c, "timeout"):
+            return "untimed .join()"
+        if attr == "get" and not c.args and not self._kw(c, "timeout"):
+            if self._type_of(f.value) is None:
+                return "untimed queue .get()"
+        if attr == "put" and c.args and not self._kw(c, "timeout") \
+                and self._type_of(f.value) is None:
+            # only receivers that look like stdlib queues — .put on an
+            # unknown dict-like would drown the signal
+            rname = f.value.attr if isinstance(f.value, ast.Attribute) \
+                else (f.value.id if isinstance(f.value, ast.Name) else "")
+            if "q" in rname.lower() and not any(
+                    k.arg == "block" and isinstance(k.value, ast.Constant)
+                    and k.value.value is False for k in c.keywords):
+                return "untimed queue .put()"
+        # network
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+                and f.value.id in _BLOCKING_HTTP_MODULES:
+            return f"{f.value.id}.{attr}() network call"
+        if name == "urlopen" or attr == "urlopen":
+            return "urlopen() network call"
+        if attr in _SOCKET_ATTRS:
+            return f"socket .{attr}()"
+        # device syncs
+        if attr in _DEVICE_SYNC_ATTRS or name in _DEVICE_SYNC_ATTRS:
+            return f"device sync {attr or name}()"
+        if attr == "asarray" and isinstance(f.value, ast.Name) \
+                and f.value.id in _NP_MODULES:
+            return "np.asarray() host transfer"
+        return None
+
+    # hooks filled in by _scan_file (scope-level C305 state)
+    def _note_thread_start(self, line: int) -> None:
+        if self.cls:
+            self.m.classes[self.cls].thread_starts.append((line, self.f.name))
+        else:
+            self.m.mod_thread_starts[self.mod].append(line)
+
+    def _note_join(self) -> None:
+        if self.cls:
+            self.m.classes[self.cls].has_join = True
+        else:
+            self.m.mod_has_join[self.mod] = True
+
+    def _note_event_set(self, recv) -> None:
+        is_event = False
+        if isinstance(recv, ast.Attribute) and \
+                isinstance(recv.value, ast.Name) and recv.value.id == "self":
+            cm = self.m.classes.get(self.cls)
+            if cm and recv.attr in getattr(cm, "_event_attrs", set()):
+                is_event = True
+        elif isinstance(recv, ast.Name) and recv.id in self.event_names:
+            is_event = True     # local stop Event (generator/closure shape)
+        if is_event:
+            if self.cls:
+                self.m.classes[self.cls].event_set = True
+            else:
+                self.m.mod_event_set[self.mod] = True
+
+    def _note_finally_releases(self, s) -> None:
+        for n in ast.walk(s):
+            if isinstance(n, ast.Call) and \
+                    isinstance(n.func, ast.Attribute) and \
+                    n.func.attr == "release":
+                lid = self._lock_of(n.func.value)
+                if lid is not None:
+                    self.f.finally_released.add(lid)
+
+
+def _scan_file(model: _Model, file: str, tree: ast.Module) -> None:
+    mod = os.path.splitext(os.path.basename(file))[0]
+
+    def scan_func(fnode, cls: str) -> None:
+        func = _Func(file, cls, fnode.name, fnode.lineno)
+        model.funcs[(file, cls, fnode.name)] = func
+        if cls:
+            model.classes[cls].methods[fnode.name] = func
+        sc = _FuncScan(model, file, mod, cls, fnode, func)
+        sc.scan(fnode.body)
+
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            scan_func(node, "")
+        elif isinstance(node, ast.ClassDef):
+            cm = model.classes.get(node.name)
+            if cm is None or cm.file != file:
+                continue
+            # pre-compute thread/event attr sets for the scanner hooks
+            thread_attrs, event_attrs = set(), set()
+            for st in ast.walk(node):
+                if isinstance(st, ast.Assign) and \
+                        isinstance(st.value, ast.Call):
+                    for t in st.targets:
+                        if isinstance(t, ast.Attribute) and \
+                                isinstance(t.value, ast.Name) and \
+                                t.value.id == "self":
+                            if _is_ctor(st.value, "Thread"):
+                                thread_attrs.add(t.attr)
+                            elif _is_ctor(st.value, "Event"):
+                                event_attrs.add(t.attr)
+            cm._thread_attrs = thread_attrs
+            cm._event_attrs = event_attrs
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    scan_func(sub, node.name)
+
+
+# --------------------------------------------------------------------------
+# pass C — call-graph expansion: C300 edges, C301, C303
+# --------------------------------------------------------------------------
+class _Expander:
+    def __init__(self, model: _Model):
+        self.m = model
+        # (a, b) -> (file, line, path string)
+        self.edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+        self.findings: Dict[Tuple, Tuple[Diagnostic, List[Tuple[str, int]]]]\
+            = {}
+
+    def _fkey(self, target) -> Optional[Tuple]:
+        if target[0] == "class":
+            _, cls, meth = target
+            fn = self.m.classes[cls].methods.get(meth)
+            return (fn.file, fn.cls, fn.name) if fn else None
+        fkey = self.m.mod_funcs.get(target[1])
+        return fkey
+
+    def _add(self, rule: str, file: str, line: int, msg: str, hint: str,
+             extra_lines: Sequence[Tuple[str, int]] = ()) -> None:
+        key = (rule, file, line, msg)
+        if key in self.findings:
+            return
+        d = Diagnostic(rule, msg, location=f"{file}:{line}", hint=hint)
+        self.findings[key] = (d, [(file, line)] + list(extra_lines))
+
+    def run(self) -> None:
+        for fkey in list(self.m.funcs):
+            self._expand(fkey, (), None, 0, [])
+        self._cycles()
+
+    def _expand(self, fkey, held: Tuple[str, ...], site, depth: int,
+                stack: List) -> None:
+        if fkey in stack or depth > _MAX_DEPTH:
+            return
+        f = self.m.funcs.get(fkey)
+        if f is None:
+            return
+        path = "->".join(self.m.funcs[k].qualname for k in stack + [fkey])
+        for ev in f.events:
+            H = held + ev.held
+            if ev.kind == "acquire":
+                lock = ev.data
+                where = site or (f.file, ev.line)
+                if lock in H and self.m.lock_kinds.get(lock) != "rlock":
+                    self._add(
+                        "MXL-C303", where[0], where[1],
+                        f"call path {path} re-acquires non-reentrant lock "
+                        f"{lock} already held (self-deadlock)",
+                        "make the inner method lock-free (callers hold the "
+                        "lock) or split a _locked() variant; RLock only "
+                        "hides the design smell")
+                else:
+                    seen: Set[str] = set()
+                    for h in H:
+                        if h != lock and h not in seen:
+                            seen.add(h)
+                            self.edges.setdefault(
+                                (h, lock),
+                                (where[0], where[1], path))
+            elif ev.kind == "blocking":
+                if H:
+                    where = site or (f.file, ev.line)
+                    locks = ", ".join(dict.fromkeys(H))
+                    via = f" (via {path})" if site else ""
+                    self._add(
+                        "MXL-C301", where[0], where[1],
+                        f"{ev.data} while holding {locks}{via}",
+                        "move the blocking call outside the lock, or use a "
+                        "timeout and re-check state after reacquiring")
+            elif ev.kind == "wait":
+                cond, in_while = ev.data
+                if not in_while and site is None:
+                    self._add(
+                        "MXL-C302", f.file, ev.line,
+                        f"Condition.wait on {cond} outside a while-predicate "
+                        "loop (spurious wakeups act on a guess)",
+                        "wrap the wait in `while not <predicate>:` and "
+                        "re-test after every wakeup")
+            elif ev.kind == "call":
+                callee = self._fkey(ev.data)
+                if callee is None:
+                    continue
+                if not H:
+                    # the callee's own root pass covers the lock-free case
+                    continue
+                nsite = site or (f.file, ev.line)
+                self._expand(callee, H, nsite, depth + 1, stack + [fkey])
+
+    # ----------------------------------------------------------- C300 SCCs
+    def _cycles(self) -> None:
+        adj: Dict[str, Set[str]] = {}
+        for (a, b) in self.edges:
+            adj.setdefault(a, set()).add(b)
+            adj.setdefault(b, set())
+        index: Dict[str, int] = {}
+        low: Dict[str, int] = {}
+        on: Set[str] = set()
+        stack: List[str] = []
+        sccs: List[List[str]] = []
+        counter = [0]
+
+        def strongconnect(v: str) -> None:
+            index[v] = low[v] = counter[0]
+            counter[0] += 1
+            stack.append(v)
+            on.add(v)
+            for w in adj[v]:
+                if w not in index:
+                    strongconnect(w)
+                    low[v] = min(low[v], low[w])
+                elif w in on:
+                    low[v] = min(low[v], index[w])
+            if low[v] == index[v]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on.discard(w)
+                    comp.append(w)
+                    if w == v:
+                        break
+                if len(comp) > 1:
+                    sccs.append(comp)
+
+        for v in adj:
+            if v not in index:
+                strongconnect(v)
+
+        for comp in sccs:
+            cs = set(comp)
+            cyc_edges = [((a, b), info) for (a, b), info in
+                         self.edges.items() if a in cs and b in cs]
+            cyc_edges.sort(key=lambda e: (e[1][0], e[1][1]))
+            parts = [f"{a} -> {b} at {fl}:{ln} ({p})"
+                     for (a, b), (fl, ln, p) in cyc_edges]
+            file, line = cyc_edges[0][1][0], cyc_edges[0][1][1]
+            self._add(
+                "MXL-C300", file, line,
+                "lock-order inversion between {%s}: %s"
+                % (", ".join(sorted(cs)), "; ".join(parts)),
+                "pick one global order for these locks and acquire them "
+                "in that order on every path (or collapse to one lock)",
+                extra_lines=[(fl, ln) for _, (fl, ln, _) in cyc_edges])
+
+
+# --------------------------------------------------------------------------
+# pass D — per-scope rules: C304, C305, C306
+# --------------------------------------------------------------------------
+def _scope_rules(model: _Model, add) -> None:
+    # C304 — guard-inconsistent attributes, one finding per (class, attr)
+    for cls in model.classes.values():
+        guarded: Dict[str, Tuple[str, int]] = {}     # attr -> write site
+        guarded_meth: Dict[str, str] = {}
+        for mname, fn in cls.methods.items():
+            if mname == "__init__":
+                continue
+            for attr, line, held, is_write in fn.accesses:
+                if is_write and held and attr not in cls.infra_attrs:
+                    guarded.setdefault(attr, (fn.file, line))
+                    guarded_meth.setdefault(attr, mname)
+        for attr, (wfile, wline) in guarded.items():
+            for mname, fn in cls.methods.items():
+                if mname == "__init__" or mname == guarded_meth[attr]:
+                    continue
+                if mname.endswith("_locked"):
+                    # repo convention: a *_locked helper is only ever
+                    # called with the guard already held
+                    continue
+                hit = next(((fn.file, line) for a, line, held, _w
+                            in fn.accesses if a == attr and not held), None)
+                if hit:
+                    add("MXL-C304", hit[0], hit[1],
+                        f"{cls.name}.{attr} is written under a lock in "
+                        f"{guarded_meth[attr]}() ({wfile}:{wline}) but "
+                        f"accessed lock-free in {mname}()",
+                        "take the same lock here, or document why this "
+                        "access is race-free and suppress",
+                        fn.def_line)
+                    break       # one finding per attr is signal enough
+
+    # C305 — threads without a stop/join path
+    for cls in model.classes.values():
+        if cls.thread_starts and not cls.has_join and not cls.event_set:
+            line, meth = cls.thread_starts[0]
+            add("MXL-C305", cls.file, line,
+                f"{cls.name}.{meth}() starts a thread but the class has "
+                "no join() call and never sets a stop Event",
+                "add a close()/stop() that sets a stop Event and joins "
+                "with a timeout")
+    for mod, starts in model.mod_thread_starts.items():
+        if starts and not model.mod_has_join.get(mod) \
+                and not model.mod_event_set.get(mod):
+            for fn in model.funcs.values():
+                if fn.cls == "" and \
+                        os.path.splitext(os.path.basename(fn.file))[0] == mod:
+                    add("MXL-C305", fn.file, starts[0],
+                        f"module {mod} starts a thread with no join() and "
+                        "no stop Event set anywhere in the module",
+                        "pair the start with a stop/join function")
+                    break
+
+    # C306 — manual acquire without a finally release
+    for fn in model.funcs.values():
+        for lid, line in fn.manual_acquires:
+            if lid not in fn.finally_released:
+                add("MXL-C306", fn.file, line,
+                    f"manual {lid}.acquire() in {fn.qualname}() with no "
+                    "release() in a finally block",
+                    "use `with lock:` or wrap in try/finally",
+                    fn.def_line)
+
+
+# --------------------------------------------------------------------------
+# entry point
+# --------------------------------------------------------------------------
+def _iter_py(paths: Iterable[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        p = os.fspath(p)
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d != "__pycache__"
+                                 and not d.startswith("."))
+                out.extend(os.path.join(root, f) for f in sorted(files)
+                           if f.endswith(".py"))
+        else:
+            out.append(p)
+    return out
+
+
+def lint_concurrency(paths, *, suppress: Sequence[str] = (),
+                     subject: str = "") -> Report:
+    """Static concurrency lint over ``paths`` (files or directories).
+
+    Returns a :class:`Report` with MXL-C300..C306 findings. Inline
+    ``# mxlint: disable=MXL-Cxxx`` comments on the flagged line (or the
+    enclosing ``def``/any cycle-edge line for C300) suppress per-site;
+    ``suppress=("MXL-C304",)`` suppresses per-run.
+
+        lint_concurrency(["mxnet_tpu/"]).assert_clean("warning")
+    """
+    if isinstance(paths, (str, os.PathLike)):
+        paths = [paths]
+    files = _iter_py(paths)
+    model = _Model()
+    trees: List[Tuple[str, ast.Module]] = []
+    for f in files:
+        with open(f, "r", encoding="utf-8") as fh:
+            src = fh.read()
+        model.lines[f] = src.splitlines()
+        trees.append((f, ast.parse(src, filename=f)))
+    for f, t in trees:
+        _collect(model, f, t)
+    for f, t in trees:
+        _collect_class_attrs(model, f, t)
+    for f, t in trees:
+        _scan_file(model, f, t)
+
+    report = Report(subject=subject or ", ".join(os.fspath(p) for p in paths),
+                    front_end="concurrency")
+    report.set_suppressions(suppress)
+
+    def disables_at(file: str, line: int) -> Tuple[str, ...]:
+        lines = model.lines.get(file, ())
+        if 1 <= line <= len(lines):
+            return parse_disable_comment(lines[line - 1])
+        return ()
+
+    exp = _Expander(model)
+    exp.run()
+
+    pending: List[Tuple[Diagnostic, List[Tuple[str, int]]]] = \
+        list(exp.findings.values())
+
+    def add(rule, file, line, msg, hint, def_line=None):
+        d = Diagnostic(rule, msg, location=f"{file}:{line}", hint=hint)
+        sites = [(file, line)]
+        if def_line is not None:
+            sites.append((file, def_line))
+        pending.append((d, sites))
+
+    _scope_rules(model, add)
+
+    pending.sort(key=lambda p: (p[0].location, p[0].rule_id))
+    for diag, sites in pending:
+        inline: List[str] = []
+        for file, line in sites:
+            inline.extend(disables_at(file, line))
+        report.add(diag, inline_disables=tuple(inline))
+    return report

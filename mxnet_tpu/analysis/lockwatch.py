@@ -1,0 +1,383 @@
+"""lockwatch — runtime lock-order sanitizer for the threaded host spine.
+
+``MXNET_LOCKCHECK=1`` makes :func:`make_lock` / :func:`make_rlock` hand
+out instrumented locks instead of plain ``threading`` ones. Each watched
+lock keeps a per-thread held-set and feeds a process-wide acquisition-order
+graph; at acquire time the sanitizer flags
+
+* **MXL-C300** — this acquisition creates a cycle in the order graph
+  (lock A is being taken under lock B somewhere after B was taken under
+  A elsewhere): a potential deadlock, reported with *both* stacks.
+* **MXL-C303** — the acquiring thread already holds this exact
+  non-reentrant lock: a certain self-deadlock, reported **and raised** as
+  :class:`LockWatchDeadlock` (blocking forever helps nobody).
+
+It also publishes host-side telemetry (``mxtpu_lock_hold_ms{site}``,
+``mxtpu_lock_contention_total{site}``,
+``mxtpu_lockwatch_findings_total{rule}``) — all of it host-only
+bookkeeping: nothing here runs under ``jit`` or changes a traced program,
+so StableHLO is bitwise identical with the sanitizer on or off (pinned by
+test_mxrace.py's invariance guard).
+
+When ``MXNET_LOCKCHECK`` is off (the default) the factories return plain
+``threading.Lock()``/``RLock()`` — zero overhead, byte-identical
+behavior. The static twin is :mod:`~mxnet_tpu.analysis.concurrency`; the
+CLI report pretty-printer is ``tools/mxrace.py report``.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+import traceback
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..base import get_env, logger, register_config
+
+__all__ = ["make_lock", "make_rlock", "enabled", "findings", "reset",
+           "assert_no_findings", "write_report", "render_report",
+           "WatchedLock", "LockWatchDeadlock"]
+
+register_config(
+    "MXNET_LOCKCHECK", False, bool,
+    "Swap every make_lock()/make_rlock() site for an instrumented lock: "
+    "per-thread held-sets, a process-wide acquisition-order graph, "
+    "deadlock findings with both stacks, and mxtpu_lock_* telemetry. "
+    "Host-only; the traced program is bitwise unchanged.")
+register_config(
+    "MXNET_LOCKCHECK_STACK_DEPTH", 12, int,
+    "Stack frames captured per lockwatch order-graph edge/finding.")
+
+
+def enabled() -> bool:
+    return bool(get_env("MXNET_LOCKCHECK", False))
+
+
+class LockWatchDeadlock(RuntimeError):
+    """Raised when a thread blocking-acquires a non-reentrant watched lock
+    it already holds — the acquire would never return."""
+
+
+class _Tls(threading.local):
+    def __init__(self):
+        self.held: List["WatchedLock"] = []   # acquisition order, newest last
+        self.suppress = False                 # re-entrancy guard (telemetry)
+
+
+_tls = _Tls()
+
+# the graph state below is guarded by a *plain* lock: watched locks are
+# only ever acquired before _graph_lock, never under it, so the sanitizer
+# cannot deadlock the code it watches
+_graph_lock = threading.Lock()
+_edges: Dict[Tuple[str, str], Dict[str, Any]] = {}   # (a,b) -> first sighting
+_adj: Dict[str, set] = {}
+_findings: List[Dict[str, Any]] = []
+_known_cycles: set = set()
+
+
+def _stack(skip: int = 2) -> str:
+    depth = int(get_env("MXNET_LOCKCHECK_STACK_DEPTH", 12))
+    frames = traceback.format_stack()[:-skip]
+    return "".join(frames[-depth:])
+
+
+def _count_finding(rule: str) -> None:
+    try:
+        from ..observability import metrics as _m
+        if _m.enabled():
+            from ..observability import catalog as _c
+            _c.LOCKWATCH_FINDINGS.inc(rule=rule)
+    except Exception:       # telemetry must never break the watched code
+        pass
+
+
+def _record(rule: str, message: str, site: str, stack: str,
+            other_site: str = "", other_stack: str = "") -> Dict[str, Any]:
+    finding = {
+        "rule": rule, "message": message, "site": site,
+        "thread": threading.current_thread().name,
+        "stack": stack, "other_site": other_site,
+        "other_stack": other_stack, "time": time.time(),
+    }
+    with _graph_lock:
+        _findings.append(finding)
+    logger.error("lockwatch %s: %s", rule, message)
+    _count_finding(rule)
+    return finding
+
+
+def _path_exists(src: str, dst: str) -> bool:
+    """Reachability in the order graph (callers hold _graph_lock)."""
+    seen = {src}
+    frontier = [src]
+    while frontier:
+        n = frontier.pop()
+        if n == dst:
+            return True
+        for nxt in _adj.get(n, ()):
+            if nxt not in seen:
+                seen.add(nxt)
+                frontier.append(nxt)
+    return False
+
+
+def _note_order(held: "WatchedLock", acquiring: "WatchedLock",
+                stack: str) -> None:
+    a, b = held.site, acquiring.site
+    if a == b:      # two instances of one site — ordering unknowable here
+        return
+    with _graph_lock:
+        if (a, b) in _edges:
+            return
+        # does b already reach a? then adding a->b closes a cycle
+        cycle = _path_exists(b, a)
+        _edges[(a, b)] = {
+            "stack": stack,
+            "thread": threading.current_thread().name,
+        }
+        _adj.setdefault(a, set()).add(b)
+        cycle_key = frozenset((a, b))
+        if not cycle or cycle_key in _known_cycles:
+            return
+        _known_cycles.add(cycle_key)
+        other = _edges.get((b, a), {})
+    _record(
+        "MXL-C300",
+        "lock-order inversion: %s acquired while holding %s, but the "
+        "order graph already has a %s -> %s path (potential deadlock)"
+        % (b, a, b, a),
+        site=b, stack=stack,
+        other_site=a, other_stack=other.get("stack", ""))
+
+
+class WatchedLock:
+    """Drop-in for ``threading.Lock``/``RLock`` with order tracking.
+
+    Exposes acquire/release/__enter__/__exit__/locked plus the private
+    hooks ``threading.Condition`` uses, so ``Condition(make_lock(...))``
+    works and wait() correctly pops/pushes the held-set.
+    """
+
+    __slots__ = ("site", "reentrant", "_lock", "_depth_tls",
+                 "_acquired_at")
+
+    def __init__(self, site: str, reentrant: bool = False):
+        self.site = site
+        self.reentrant = reentrant
+        self._lock = threading.RLock() if reentrant else threading.Lock()
+        self._acquired_at = 0.0       # valid while held (owner writes it)
+
+    # ------------------------------------------------------------- acquire
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        tls = _tls
+        if tls.suppress:
+            return self._lock.acquire(blocking, timeout)
+        held_here = sum(1 for l in tls.held if l is self)
+        stack = None
+        if held_here and not self.reentrant:
+            stack = _stack()
+            _record(
+                "MXL-C303",
+                "re-entrant acquire of non-reentrant lock %s (depth %d) — "
+                "self-deadlock" % (self.site, held_here + 1),
+                site=self.site, stack=stack)
+            if blocking and timeout in (-1, None):
+                raise LockWatchDeadlock(
+                    "lockwatch: thread %r would deadlock re-acquiring %s\n%s"
+                    % (threading.current_thread().name, self.site, stack))
+        elif not held_here:
+            for h in tls.held:
+                _note_order(h, self, stack or (stack := _stack()))
+        # measure contention: try uncontended first
+        got = self._lock.acquire(False)
+        if not got:
+            if not blocking:
+                return False
+            self._publish_contention()
+            got = self._lock.acquire(True, timeout)
+        if got:
+            tls.held.append(self)
+            if held_here == 0:
+                self._acquired_at = time.perf_counter()
+        return got
+
+    # ------------------------------------------------------------- release
+    def release(self) -> None:
+        tls = _tls
+        if tls.suppress:
+            self._lock.release()
+            return
+        held_ms = None
+        for i in range(len(tls.held) - 1, -1, -1):
+            if tls.held[i] is self:
+                del tls.held[i]
+                break
+        if not any(l is self for l in tls.held):
+            held_ms = (time.perf_counter() - self._acquired_at) * 1e3
+        self._lock.release()
+        if held_ms is not None:
+            self._publish_hold(held_ms)
+
+    def __enter__(self) -> "WatchedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        try:
+            return self._lock.locked()
+        except AttributeError:      # RLock on older Pythons
+            if self._lock.acquire(False):
+                self._lock.release()
+                return False
+            return True
+
+    # Condition() integration: delegate the wait/notify save-restore hooks
+    # through our own acquire/release so the held-set stays truthful
+    def _release_save(self):
+        if self.reentrant:
+            tls = _tls
+            depth = sum(1 for l in tls.held if l is self)
+            for _ in range(depth):
+                self.release()
+            return depth
+        self.release()
+        return 1
+
+    def _acquire_restore(self, state) -> None:
+        for _ in range(state if isinstance(state, int) and state > 0 else 1):
+            self.acquire()
+
+    def _is_owned(self) -> bool:
+        return any(l is self for l in _tls.held)
+
+    # ----------------------------------------------------------- telemetry
+    def _publish_contention(self) -> None:
+        tls = _tls
+        if tls.suppress:
+            return
+        tls.suppress = True
+        try:
+            from ..observability import metrics as _m
+            if _m.enabled():
+                from ..observability import catalog as _c
+                _c.LOCK_CONTENTION.inc(site=self.site)
+        except Exception:
+            pass
+        finally:
+            tls.suppress = False
+
+    def _publish_hold(self, ms: float) -> None:
+        tls = _tls
+        if tls.suppress:
+            return
+        tls.suppress = True
+        try:
+            from ..observability import metrics as _m
+            if _m.enabled():
+                from ..observability import catalog as _c
+                _c.LOCK_HOLD_MS.observe(ms, site=self.site)
+        except Exception:
+            pass
+        finally:
+            tls.suppress = False
+
+    def __repr__(self) -> str:
+        return "<WatchedLock %s%s>" % (self.site,
+                                       " (reentrant)" if self.reentrant
+                                       else "")
+
+
+# --------------------------------------------------------------------------
+# factories — the only API instrumented modules call
+# --------------------------------------------------------------------------
+def make_lock(site: str):
+    """A ``threading.Lock()`` — or a watched one under MXNET_LOCKCHECK=1.
+
+    ``site`` names the lock *class-wide* (e.g. ``"serving.queueing."
+    "BoundedRequestQueue._lock"``): instances share the label, which is
+    exactly what the order graph wants (an order inversion between two
+    queues is an inversion between the queue class's locks).
+    """
+    if enabled():
+        return WatchedLock(site, reentrant=False)
+    return threading.Lock()
+
+
+def make_rlock(site: str):
+    """``threading.RLock()`` — or a watched reentrant lock (re-entry is
+    legal and tracked; ordering findings still apply)."""
+    if enabled():
+        return WatchedLock(site, reentrant=True)
+    return threading.RLock()
+
+
+# --------------------------------------------------------------------------
+# findings API (what chaos tests and tools/mxrace.py consume)
+# --------------------------------------------------------------------------
+def findings() -> List[Dict[str, Any]]:
+    with _graph_lock:
+        return [dict(f) for f in _findings]
+
+
+def reset() -> None:
+    """Clear findings and the acquisition-order graph (test isolation)."""
+    with _graph_lock:
+        _findings.clear()
+        _edges.clear()
+        _adj.clear()
+        _known_cycles.clear()
+
+
+def assert_no_findings() -> None:
+    got = findings()
+    if got:
+        raise AssertionError(
+            "lockwatch recorded %d finding(s):\n%s"
+            % (len(got), render_report({"findings": got})))
+
+
+def edges() -> Dict[str, List[str]]:
+    """The current acquisition-order graph, site -> successor sites."""
+    with _graph_lock:
+        return {a: sorted(bs) for a, bs in _adj.items()}
+
+
+def write_report(path: str) -> str:
+    data = {"findings": findings(), "order_graph": edges()}
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(data, fh, indent=2)
+    return path
+
+
+def render_report(data: Dict[str, Any]) -> str:
+    """Pretty-print a lockwatch report dict (tools/mxrace.py report)."""
+    out: List[str] = []
+    fnd = data.get("findings", [])
+    if not fnd:
+        out.append("lockwatch: no findings")
+    else:
+        out.append("lockwatch: %d finding(s)" % len(fnd))
+        for f in fnd:
+            out.append("  %s [%s] thread=%s" % (
+                f.get("rule", "?"), f.get("site", "?"),
+                f.get("thread", "?")))
+            out.append("    " + f.get("message", ""))
+            if f.get("stack"):
+                out.append("    acquire stack:")
+                out.extend("      " + ln for ln
+                           in f["stack"].rstrip().splitlines())
+            if f.get("other_stack"):
+                out.append("    prior %s stack:" % f.get("other_site", ""))
+                out.extend("      " + ln for ln
+                           in f["other_stack"].rstrip().splitlines())
+    graph = data.get("order_graph") or {}
+    if graph:
+        out.append("acquisition order graph:")
+        for a in sorted(graph):
+            for b in graph[a]:
+                out.append("  %s -> %s" % (a, b))
+    return "\n".join(out)

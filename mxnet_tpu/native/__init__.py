@@ -14,6 +14,8 @@ import threading
 
 import numpy as np
 
+from ..analysis.lockwatch import make_lock
+
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _LIB_PATH = os.path.join(_HERE, "libmxtpu.so")
 _lock = threading.Lock()
@@ -132,7 +134,7 @@ class NativeEngine:
         self._cb_vars = {}        # cb_id -> vars the op touches
         self._done = set()        # ids whose PYTHON body finished
         self._cb_id = [0]
-        self._cb_lock = threading.Lock()
+        self._cb_lock = make_lock("native.NativeEngine._cb_lock")
 
     def _check(self):
         if not self._h:

@@ -22,6 +22,8 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..analysis.lockwatch import make_rlock
+
 
 def _load_param_bytes(param_bytes: bytes):
     """-> (arg_params, aux_params) from raw file bytes, either format."""
@@ -113,7 +115,7 @@ class Predictor:
         # per-handle lock: entry points are individually atomic (memory
         # safety for threads sharing a handle); multi-call sequences are
         # made atomic by predict() or by handle-per-worker (see class doc)
-        self._lock = threading.RLock()
+        self._lock = make_rlock("native.predict_bridge.Predictor._lock")
 
     # ------------------------------------------------------------------ API
     def set_input(self, name: str, data: bytes, shape: Sequence[int]):
@@ -155,8 +157,11 @@ class Predictor:
         with self._lock:
             if self._outputs is None:
                 self.forward()
+            # per-handle lock held across the sync by design: MXPred's
+            # entry-point atomicity means the output read pairs with the
+            # forward that produced it
             return np.ascontiguousarray(
-                self._outputs[index].asnumpy().astype(np.float32)).tobytes()
+                self._outputs[index].asnumpy().astype(np.float32)).tobytes()  # mxlint: disable=MXL-C301
 
     def predict(self, inputs: Dict[str, "np.ndarray"]) -> List["np.ndarray"]:
         """Atomic set-inputs → forward → read-outputs under ONE lock hold:
@@ -176,7 +181,9 @@ class Predictor:
                         f"match bound shape {bound}")
                 self._args[name]._set_data(a)
             self._outputs = self._exec.forward(is_train=False)
-            return [np.asarray(o.asnumpy(), dtype=np.float32)
+            # the atomic set->forward->read sequence is this method's
+            # whole point; the sync must happen under the handle lock
+            return [np.asarray(o.asnumpy(), dtype=np.float32)  # mxlint: disable=MXL-C301
                     for o in self._outputs]
 
     def reshape(self, new_shapes: Dict[str, Sequence[int]]) -> "Predictor":
@@ -199,7 +206,7 @@ class Predictor:
             # a clone is an independent handle: params shared, lock NOT —
             # sharing the parent's lock would serialize a handle-per-worker
             # fleet back into one effective handle
-            clone._lock = threading.RLock()
+            clone._lock = make_rlock("native.predict_bridge.Predictor._lock")
             return clone
 
 

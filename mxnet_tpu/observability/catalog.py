@@ -318,3 +318,23 @@ SPEEDOMETER_SPS = _m.gauge(
 MONITOR_STAT = _m.gauge(
     "mxtpu_monitor_stat",
     "Monitor layer statistics, labeled stat= (the Monitor.toc stream).")
+
+# --------------------------------------------------------------- lockwatch
+LOCK_HOLD_MS = _m.histogram(
+    "mxtpu_lock_hold_ms",
+    "Wall time a lockwatch-instrumented lock was held, labeled site= "
+    "(the class-wide lock name, e.g. serving.queueing."
+    "BoundedRequestQueue._lock). Only populated under MXNET_LOCKCHECK=1 "
+    "— host-side lock telemetry never enters the XLA trace.",
+    buckets=(0.01, 0.05, 0.1, 0.5, 1, 5, 10, 50, 100, 500, 1000))
+LOCK_CONTENTION = _m.counter(
+    "mxtpu_lock_contention_total",
+    "Contended acquisitions of a lockwatch-instrumented lock (the "
+    "uncontended fast path failed and the thread had to block), labeled "
+    "site=. Only populated under MXNET_LOCKCHECK=1.")
+LOCKWATCH_FINDINGS = _m.counter(
+    "mxtpu_lockwatch_findings_total",
+    "Deadlock-hazard findings raised by the runtime lock sanitizer, "
+    "labeled rule=MXL-C300 (order inversion seen live) | MXL-C303 "
+    "(re-entrant acquire of a non-reentrant lock). Any nonzero value "
+    "is a bug report: tools/mxrace.py report pretty-prints the stacks.")

@@ -34,6 +34,7 @@ import time
 from collections import deque
 from typing import Any, Dict, List, Optional
 
+from ..analysis.lockwatch import make_lock
 from ..base import get_env, logger, register_config
 from . import metrics as _metrics
 from . import spans as _spans
@@ -56,7 +57,7 @@ class FlightRecorder:
             capacity = int(get_env("MXNET_TELEMETRY_FLIGHT_RECORDS", 256))
         self.capacity = max(0, int(capacity))
         self._ring: deque = deque(maxlen=self.capacity or 1)
-        self._lock = threading.Lock()
+        self._lock = make_lock("observability.flight_recorder.FlightRecorder._lock")
 
     @property
     def enabled(self) -> bool:

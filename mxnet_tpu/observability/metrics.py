@@ -30,6 +30,7 @@ import threading
 import time
 from typing import Any, Dict, Iterable, List, Optional, Tuple
 
+from ..analysis.lockwatch import make_rlock
 from ..base import MXNetError, get_env, logger, register_config
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
@@ -225,7 +226,7 @@ class MetricsRegistry:
     a programming error and raises."""
 
     def __init__(self):
-        self._lock = threading.RLock()
+        self._lock = make_rlock("observability.metrics.MetricsRegistry._lock")
         self._metrics: Dict[str, _Metric] = {}
 
     def _get_or_create(self, cls, name, help, **kwargs):

@@ -55,6 +55,7 @@ import time
 from collections import deque
 from typing import Any, Dict, List, Optional
 
+from ..analysis.lockwatch import make_lock
 from ..base import MXNetError, get_env, logger, register_config
 from . import catalog as _catalog
 from . import metrics as _metrics
@@ -270,7 +271,7 @@ class Tracer:
             raise MXNetError("trace sample rate must be in [0, 1], got %r"
                              % (self.sample,))
         self._ring: deque = deque(maxlen=max(1, self.capacity))
-        self._lock = threading.Lock()
+        self._lock = make_lock("observability.tracing.Tracer._lock")
         self._lat: Dict[str, deque] = {}    # model -> recent ok latencies
         self._lat_n: Dict[str, int] = {}    # appends per model
         self._tail_thr: Dict[str, float] = {}  # cached ~p99 threshold
@@ -548,7 +549,7 @@ class SLOTracker:
         # hard cap bounds memory if the clock stalls
         self._win: Dict[str, deque] = {n: deque() for n in self.windows}
         self._bad: Dict[str, int] = {n: 0 for n in self.windows}
-        self._lock = threading.Lock()
+        self._lock = make_lock("observability.tracing.SLOTracker._lock")
         self.breaches: List[Dict[str, Any]] = []
         self._over = False                  # edge trigger state
 

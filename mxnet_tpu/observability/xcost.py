@@ -36,6 +36,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from ..analysis.lockwatch import make_lock
 from ..base import get_env, logger, register_config
 from . import metrics as _metrics
 
@@ -156,7 +157,7 @@ class CostLedger:
         if not path:
             raise ValueError("CostLedger needs a path")
         self.path = str(path)
-        self._lock = threading.Lock()
+        self._lock = make_lock("observability.xcost.CostLedger._lock")
 
     def append(self, row: Dict[str, Any]) -> Dict[str, Any]:
         """Stamp and append one row; returns the stamped row."""

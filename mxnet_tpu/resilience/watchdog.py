@@ -19,6 +19,7 @@ import threading
 import _thread
 from typing import Callable, Optional
 
+from ..analysis.lockwatch import make_lock
 from ..base import logger
 
 __all__ = ["Watchdog"]
@@ -53,7 +54,7 @@ class Watchdog:
         self._label = ""
         self._gen = 0
         self._thread: Optional[threading.Thread] = None
-        self._lock = threading.Lock()
+        self._lock = make_lock("resilience.watchdog.Watchdog._lock")
 
     def _ensure_thread(self) -> None:
         if self._thread is None or not self._thread.is_alive():
@@ -65,15 +66,21 @@ class Watchdog:
         while not self._stop.is_set():
             if not self._armed.wait(0.1):
                 continue
-            gen = self._gen
+            with self._lock:
+                gen = self._gen
             if self._done.wait(self.deadline):
                 continue        # step finished in time; next arm re-cycles
-            # deadline passed: fire only if still the SAME armed region
-            if self._stop.is_set() or self._done.is_set() or gen != self._gen:
-                continue
-            self.fired = True
-            self._armed.clear()
-            label = self._label
+            # deadline passed: fire only if still the SAME armed region.
+            # The check-and-fire must be atomic with arm()'s re-arm writes
+            # or a racing arm() can have its fresh `fired = False` / label
+            # clobbered by a stale firing (found by mxrace MXL-C304).
+            with self._lock:
+                if self._stop.is_set() or self._done.is_set() \
+                        or gen != self._gen:
+                    continue
+                self.fired = True
+                self._armed.clear()
+                label = self._label
             sys.stderr.write(
                 "\n=== mxtpu watchdog: %r exceeded its %.1fs deadline — "
                 "dumping all thread stacks ===\n" % (label, self.deadline))

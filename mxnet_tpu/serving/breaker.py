@@ -18,6 +18,8 @@ import threading
 import time
 from typing import Callable, Dict
 
+from ..analysis.lockwatch import make_lock
+
 __all__ = ["CircuitBreaker"]
 
 
@@ -37,7 +39,7 @@ class CircuitBreaker:
         self.threshold = int(threshold)
         self.cooldown_s = float(cooldown_s)
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = make_lock("serving.breaker.CircuitBreaker._lock")
         self._state = "closed"
         self._failures = 0
         self._opened_at = 0.0

@@ -47,6 +47,7 @@ from typing import Callable, Dict, List, Optional
 import numpy as np
 
 from ..resilience.chaos import ChaosError
+from ..analysis.lockwatch import make_lock
 
 __all__ = ["slow_client", "request_storm", "paced_run", "trace_evidence",
            "slow_executor", "executor_fault", "poison_request",
@@ -169,7 +170,7 @@ def request_storm(server, model: str, payload, *, qps: float,
 
     make: Callable[[], np.ndarray] = (payload if callable(payload)
                                       else lambda: payload)
-    lock = threading.Lock()
+    lock = make_lock("serving.chaos.request_storm.lock")
     futures: List = []
     counts = {"submitted": 0, "shed": 0}
 

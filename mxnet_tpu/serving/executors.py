@@ -23,6 +23,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..analysis.lockwatch import make_lock
 from ..base import MXNetError, get_env, register_config
 
 __all__ = ["BucketExecutorCache", "default_buckets"]
@@ -113,7 +114,7 @@ class BucketExecutorCache:
         self._param_bytes = param_bytes
         self._dev = (int(dev_type), int(dev_id))
         self._output_keys = output_keys
-        self._lock = threading.Lock()
+        self._lock = make_lock("serving.executors.BucketExecutorCache._lock")
         self._preds: Dict[int, object] = {}
         self._base = None           # first-built predictor: owns the params
         self.chips = 1
@@ -162,16 +163,19 @@ class BucketExecutorCache:
 
     @property
     def max_bucket(self) -> int:
-        return self.buckets[-1]
+        with self._lock:        # rebind() swaps the ladder concurrently
+            return self.buckets[-1]
 
     def bucket_for(self, n: int) -> int:
         """Smallest bucket >= n. n above the largest bucket is a caller
         bug — the batcher caps assembly at max_bucket."""
-        for b in self.buckets:
+        with self._lock:        # one consistent ladder for the whole scan
+            buckets = self.buckets
+        for b in buckets:
             if b >= n:
                 return b
         raise MXNetError("batch of %d rows exceeds the largest bucket %d"
-                         % (n, self.max_bucket))
+                         % (n, buckets[-1]))
 
     def get(self, bucket: int):
         """The bound predictor for one bucket, building it on first use."""
@@ -199,7 +203,9 @@ class BucketExecutorCache:
         them by default — so the first real request never pays a compile.
         Returns the list warmed."""
         done = []
-        for b in (buckets or self.buckets):
+        with self._lock:        # snapshot the ladder; get() re-validates
+            ladder = self.buckets
+        for b in (buckets or ladder):
             pred = self.get(int(b))
             dummy = np.zeros((int(b),) + self.feature_shape, np.float32)
             pred.predict({self.input_name: dummy})

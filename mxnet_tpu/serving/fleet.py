@@ -52,6 +52,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
+from ..analysis.lockwatch import make_lock
 from ..base import MXNetError, get_env, logger, register_config
 from .errors import Preempted, QuotaExceeded
 from .queueing import FairShare, TokenBucket
@@ -192,7 +193,7 @@ class FleetController:
         self.min_events = int(get_env("MXNET_FLEET_MIN_EVENTS", 20)
                               if min_events is None else min_events)
         self._clock = clock
-        self._lock = threading.Lock()       # placement + history
+        self._lock = make_lock("serving.fleet.FleetController._lock")  # placement + history
         self._chips: Dict[str, int] = {m: p.chips
                                        for m, p in self._policies.items()}
         self._last_resize: Dict[str, float] = {}
@@ -238,12 +239,16 @@ class FleetController:
             raise QuotaExceeded(
                 "tenant %r exceeded its %.1f qps quota — shed at fleet "
                 "admission (retry with backoff)" % (model, pol.quota_qps))
-        if req.priority == "best_effort" and self._excursion:
+        # snapshot under the guard: the evaluator thread swaps _excursion
+        # on every pass, and the message iterates it (mxrace MXL-C304)
+        with self._lock:
+            excursion = dict(self._excursion)
+        if req.priority == "best_effort" and excursion:
             self._inc_tenant("FLEET_PREEMPTED", model)
             raise Preempted(
                 "best-effort request for tenant %r preempted: guaranteed "
                 "tenant(s) %s in SLO excursion — retry after the storm"
-                % (model, ", ".join(sorted(self._excursion))))
+                % (model, ", ".join(sorted(excursion))))
 
     def before_dispatch(self, st, rows: int) -> None:
         """Weighted-fair pacing hook — called by the model's worker just

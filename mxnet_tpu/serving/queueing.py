@@ -33,6 +33,7 @@ import time
 from collections import deque
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ..analysis.lockwatch import make_lock
 from .errors import Draining, Overloaded
 
 __all__ = ["BoundedRequestQueue", "TokenBucket", "FairShare"]
@@ -51,7 +52,7 @@ class BoundedRequestQueue:
         self.capacity = int(capacity or 0)
         self._clock = clock
         self._q: deque = deque()
-        self._lock = threading.Lock()
+        self._lock = make_lock("serving.queueing.BoundedRequestQueue._lock")
         self._cond = threading.Condition(self._lock)
         self._shed_expired = 0
         self._closed = False
@@ -223,7 +224,7 @@ class TokenBucket:
         self._clock = clock
         self._tokens = self.burst
         self._t = clock()
-        self._lock = threading.Lock()
+        self._lock = make_lock("serving.queueing.TokenBucket._lock")
 
     def try_take(self, n: float = 1.0) -> bool:
         with self._lock:
@@ -267,7 +268,7 @@ class FairShare:
         self._clock = clock
         self._vtime: Dict[str, float] = {n: 0.0 for n in self.weights}
         self._last_seen: Dict[str, float] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("serving.queueing.FairShare._lock")
 
     def _min_active_locked(self, now: float, exclude: str) -> Optional[float]:
         horizon = now - self.active_window_s

@@ -45,6 +45,7 @@ from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..analysis.lockwatch import make_lock
 from ..base import MXNetError, get_env, logger, register_config
 from ..observability import tracing as _tracing
 from .breaker import CircuitBreaker
@@ -268,12 +269,12 @@ class _ModelState:
                                         cfg.slo_availability)
                     if cfg.slo_p99_ms > 0 else None)
         self.worker: Optional[threading.Thread] = None
-        self.lock = threading.Lock()
+        self.lock = make_lock("serving.server._ModelState.lock")
         # held for the duration of one dispatch: a fleet resize acquires
         # it to quiesce (the in-flight batch finishes, the next dispatch
         # waits) before re-binding the bucket cache for a new chip count.
         # Uncontended in single-tenant mode — nothing else takes it.
-        self.dispatch_mutex = threading.Lock()
+        self.dispatch_mutex = make_lock("serving.server._ModelState.dispatch_mutex")
         self.counts = {"ok": 0, "shed": 0, "expired": 0, "error": 0}
         self.batches = 0
         self.singles = 0            # isolation re-dispatches after a fault
@@ -539,7 +540,10 @@ class ModelServer:
                 # binding and the next waits for the new one. Uncontended
                 # (single-tenant / no resize) it is one futex op.
                 with st.dispatch_mutex:
-                    self._dispatch(st, batch)
+                    # device work under the quiesce mutex IS the contract:
+                    # holding it for exactly one dispatch (sync + retry
+                    # backoff included) is what makes resize safe
+                    self._dispatch(st, batch)  # mxlint: disable=MXL-C301
             except Exception as e:  # defensive: a worker must never die
                 logger.exception("serving worker for %r: unexpected "
                                  "dispatch error: %r", cfg.name, e)

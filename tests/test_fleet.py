@@ -453,12 +453,15 @@ def test_fleetz_endpoint_headers_and_resize(tiny):
 
 # -------------------------------------------------------- THE acceptance
 @pytest.mark.chaos
-def test_tenant_storm_isolation_and_autoscale(tiny):
+def test_tenant_storm_isolation_and_autoscale(tiny, monkeypatch):
     """Storm tenant A at ~3x its 1-chip sustainable QPS while guaranteed
     tenant B runs its declared load: the fleet must notice A's burn and
     grow it (counter delta), B's accepted p99 must stay inside ITS SLO
     with burn under threshold, and no request may ever be dispatched
     past its deadline — all proven from counters, not log text."""
+    from mxnet_tpu.analysis import lockwatch
+    monkeypatch.setenv("MXNET_LOCKCHECK", "1")   # storm under the sanitizer
+    lockwatch.reset()
     sym_json, pbytes, feat, _ = tiny
     slo_b = 250.0
     cfg_a = ModelConfig("a", sym_json, pbytes, feature_shape=feat,
@@ -509,6 +512,7 @@ def test_tenant_storm_isolation_and_autoscale(tiny):
     finally:
         fleet.detach()
         server.close(timeout=15.0)
+    lockwatch.assert_no_findings()
 
 
 # ------------------------------------------------------ invariance guard

@@ -412,8 +412,9 @@ def test_rule_catalog_is_complete_and_consistent():
     ids = set(analysis.RULES)
     assert {"MXL-G100", "MXL-G101", "MXL-G102", "MXL-G103", "MXL-G104",
             "MXL-G105", "MXL-G106", "MXL-T200", "MXL-T201", "MXL-T202",
-            "MXL-T203", "MXL-T204", "MXL-T205", "MXL-T206",
-            "MXL-T207"} <= ids
+            "MXL-T203", "MXL-T204", "MXL-T205", "MXL-T206", "MXL-T207",
+            "MXL-C300", "MXL-C301", "MXL-C302", "MXL-C303", "MXL-C304",
+            "MXL-C305", "MXL-C306"} <= ids
     for rd in analysis.RULES.values():
         assert rd.severity in ("error", "warning", "info")
         assert rd.title and rd.doc
@@ -426,7 +427,7 @@ def test_rule_catalog_matches_docs():
     import re
     doc = open(os.path.join(ROOT, "docs", "static_analysis.md")).read()
     rows = re.findall(
-        r"^\|\s*(MXL-[GT]\d{3})\s*\|\s*(\w+)\s*\|\s*([\w\-]+)\s*\|",
+        r"^\|\s*(MXL-[GTC]\d{3})\s*\|\s*(\w+)\s*\|\s*([\w\-]+)\s*\|",
         doc, re.MULTILINE)
     documented = {rid: (sev, title) for rid, sev, title in rows}
     assert set(documented) == set(analysis.RULES), (

@@ -1,0 +1,718 @@
+"""mxrace: the MXL-C3xx concurrency front end (one known-bad fixture per
+rule, a clean twin each, the suppression matrix), the lockwatch runtime
+sanitizer (order-inversion, self-deadlock, telemetry on a fake clock), the
+dogfood gate that pins ``mxnet_tpu/`` itself clean, regression tests for
+the races the dogfood run found, and the HLO-invariance guard.
+
+Rule catalog: docs/static_analysis.md; engine: mxnet_tpu/analysis/.
+"""
+import json
+import os
+import textwrap
+import threading
+import time
+import types
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import analysis
+from mxnet_tpu.analysis import lint_concurrency, lockwatch
+from mxnet_tpu.analysis.lockwatch import LockWatchDeadlock, WatchedLock
+
+pytestmark = pytest.mark.lint
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _rules(report):
+    return [d.rule_id for d in report]
+
+
+def _lint(tmp_path, src, **kw):
+    p = tmp_path / "fx.py"
+    p.write_text(textwrap.dedent(src))
+    return lint_concurrency([str(p)], **kw)
+
+
+# ===========================================================================
+# static front end: one bad fixture per rule + a clean twin
+# ===========================================================================
+
+def test_c300_lock_order_inversion(tmp_path):
+    r = _lint(tmp_path, """
+        import threading
+
+        class AB:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+            def one(self):
+                with self._a:
+                    with self._b:
+                        pass
+            def two(self):
+                with self._b:
+                    with self._a:
+                        pass
+        """)
+    assert "MXL-C300" in _rules(r)
+    assert r.errors and not r.ok()          # C300 is an error
+
+
+def test_c300_silent_on_consistent_order(tmp_path):
+    r = _lint(tmp_path, """
+        import threading
+
+        class AB:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+            def one(self):
+                with self._a:
+                    with self._b:
+                        pass
+            def two(self):
+                with self._a:
+                    with self._b:
+                        pass
+        """)
+    assert _rules(r) == []
+
+
+def test_c300_crosses_methods_via_calls(tmp_path):
+    """The inversion hides behind a call: one() holds A and calls into a
+    helper that takes B, two() does the reverse — the inter-method
+    expansion must still see the cycle."""
+    r = _lint(tmp_path, """
+        import threading
+
+        class AB:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+            def _take_b(self):
+                with self._b:
+                    pass
+            def _take_a(self):
+                with self._a:
+                    pass
+            def one(self):
+                with self._a:
+                    self._take_b()
+            def two(self):
+                with self._b:
+                    self._take_a()
+        """)
+    assert "MXL-C300" in _rules(r)
+
+
+def test_c301_blocking_call_under_lock(tmp_path):
+    r = _lint(tmp_path, """
+        import queue
+        import threading
+        import time
+
+        class Blocky:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = queue.Queue()
+            def bad_get(self):
+                with self._lock:
+                    return self._q.get()
+            def bad_sleep(self):
+                with self._lock:
+                    time.sleep(1.0)
+        """)
+    assert _rules(r) == ["MXL-C301", "MXL-C301"]
+    assert r.warnings and r.ok() and not r.ok("warning")
+
+
+def test_c301_silent_with_timeout_or_outside_lock(tmp_path):
+    r = _lint(tmp_path, """
+        import queue
+        import threading
+
+        class Blocky:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = queue.Queue()
+            def good_timeout(self):
+                with self._lock:
+                    return self._q.get(timeout=0.5)
+            def good_outside(self):
+                item = self._q.get()
+                with self._lock:
+                    return item
+        """)
+    assert _rules(r) == []
+
+
+def test_c301_device_sync_under_lock(tmp_path):
+    r = _lint(tmp_path, """
+        import threading
+        import numpy as np
+
+        class Sync:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.out = None
+            def bad(self, fut):
+                with self._lock:
+                    return np.asarray(fut)
+        """)
+    assert _rules(r) == ["MXL-C301"]
+
+
+def test_c302_wait_without_while(tmp_path):
+    r = _lint(tmp_path, """
+        import threading
+
+        class Waity:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cond = threading.Condition(self._lock)
+                self.ready = False
+            def bad_wait(self):
+                with self._cond:
+                    if not self.ready:
+                        self._cond.wait()
+        """)
+    assert _rules(r) == ["MXL-C302"]
+
+
+def test_c302_silent_in_while(tmp_path):
+    r = _lint(tmp_path, """
+        import threading
+
+        class Waity:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cond = threading.Condition(self._lock)
+                self.ready = False
+            def good_wait(self):
+                with self._cond:
+                    while not self.ready:
+                        self._cond.wait(timeout=0.1)
+        """)
+    assert _rules(r) == []
+
+
+def test_c303_reentrant_close_pr12_shape(tmp_path):
+    """THE PR-12 deadlock shape: drain() holds the queue lock and calls
+    close(), which re-acquires the same plain Lock — self-deadlock."""
+    r = _lint(tmp_path, """
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._closed = False
+            def close(self):
+                with self._lock:
+                    self._closed = True
+            def drain(self):
+                with self._lock:
+                    self.close()
+        """)
+    assert "MXL-C303" in _rules(r)
+    assert r.errors and not r.ok()
+
+
+def test_c303_silent_on_rlock(tmp_path):
+    r = _lint(tmp_path, """
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._lock = threading.RLock()
+                self._closed = False
+            def close(self):
+                with self._lock:
+                    self._closed = True
+            def drain(self):
+                with self._lock:
+                    self.close()
+        """)
+    assert _rules(r) == []
+
+
+def test_c304_guard_inconsistent_state(tmp_path):
+    r = _lint(tmp_path, """
+        import threading
+
+        class Guardy:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.count = 0
+            def bump(self):
+                with self._lock:
+                    self.count += 1
+            def peek(self):
+                return self.count
+        """)
+    assert _rules(r) == ["MXL-C304"]
+
+
+def test_c304_silent_when_consistent_or_locked_suffix(tmp_path):
+    """All accesses under the guard is clean; so is the repo's ``*_locked``
+    naming convention (helpers documented as called with the lock held)."""
+    r = _lint(tmp_path, """
+        import threading
+
+        class Guardy:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.count = 0
+            def bump(self):
+                with self._lock:
+                    self._bump_locked()
+            def _bump_locked(self):
+                self.count += 1
+            def peek(self):
+                with self._lock:
+                    return self.count
+        """)
+    assert _rules(r) == []
+
+
+def test_c305_thread_without_stop_or_join(tmp_path):
+    r = _lint(tmp_path, """
+        import threading
+        import time
+
+        class Leaky:
+            def spawn(self):
+                t = threading.Thread(target=time.sleep, args=(1,))
+                t.start()
+        """)
+    assert _rules(r) == ["MXL-C305"]
+
+
+def test_c305_silent_with_join_or_stop_event(tmp_path):
+    r = _lint(tmp_path, """
+        import threading
+        import time
+
+        class Joined:
+            def run(self):
+                t = threading.Thread(target=time.sleep, args=(0.1,))
+                t.start()
+                t.join()
+
+        class Stoppable:
+            def __init__(self):
+                self._stop = threading.Event()
+                self._t = threading.Thread(target=self._loop)
+            def start(self):
+                self._t.start()
+            def _loop(self):
+                while not self._stop.is_set():
+                    time.sleep(0.01)
+            def close(self):
+                self._stop.set()
+                self._t.join()
+        """)
+    assert _rules(r) == []
+
+
+def test_c306_manual_acquire_without_finally(tmp_path):
+    r = _lint(tmp_path, """
+        import threading
+
+        class Manual:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+            def bad(self):
+                self._lock.acquire()
+                self._lock.release()
+            def good(self):
+                self._lock.acquire()
+                try:
+                    pass
+                finally:
+                    self._lock.release()
+        """)
+    assert _rules(r) == ["MXL-C306"]
+
+
+# ===========================================================================
+# suppression matrix
+# ===========================================================================
+
+_BLOCKY = """
+    import queue
+    import threading
+
+    class Blocky:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._q = queue.Queue()
+        def bad(self):
+            with self._lock:
+                return self._q.get(){inline}
+"""
+
+
+def test_inline_disable_suppresses_at_the_line(tmp_path):
+    r = _lint(tmp_path, _BLOCKY.format(
+        inline="  # mxlint: disable=MXL-C301"))
+    assert _rules(r) == [] and len(r.suppressed) == 1
+    assert r.suppressed[0].rule_id == "MXL-C301"
+    assert r.ok("warning")
+
+
+def test_run_level_suppress(tmp_path):
+    r = _lint(tmp_path, _BLOCKY.format(inline=""),
+              suppress=("MXL-C301",))
+    assert _rules(r) == [] and len(r.suppressed) == 1
+
+
+def test_unsuppressed_fails_assert_clean(tmp_path):
+    with pytest.raises(AssertionError) as ei:
+        _lint(tmp_path, _BLOCKY.format(inline="")).assert_clean(
+            fail_on="warning")
+    assert "MXL-C301" in str(ei.value)
+
+
+def test_def_level_disable_for_scope_rules(tmp_path):
+    """C306 anchors on the acquire line but honors a disable on the
+    enclosing ``def`` line too (the finding is about the function's
+    shape, not one statement)."""
+    r = _lint(tmp_path, """
+        import threading
+
+        class Manual:
+            def __init__(self):
+                self._lock = threading.Lock()
+            def bad(self):  # mxlint: disable=MXL-C306
+                self._lock.acquire()
+                self._lock.release()
+        """)
+    assert _rules(r) == [] and len(r.suppressed) == 1
+
+
+def test_json_roundtrip_and_rule_registration(tmp_path):
+    r = _lint(tmp_path, _BLOCKY.format(inline=""))
+    data = json.loads(r.to_json())
+    (f,) = data["findings"]
+    assert f["rule"] == "MXL-C301" and f["severity"] == "warning"
+    assert f["hint"]
+    for rid in ("MXL-C300", "MXL-C301", "MXL-C302", "MXL-C303",
+                "MXL-C304", "MXL-C305", "MXL-C306"):
+        assert rid in analysis.RULES
+
+
+# ===========================================================================
+# dogfood gate: the codebase that ships the linter lints clean
+# ===========================================================================
+
+def test_dogfood_whole_package_is_clean():
+    """``mxnet_tpu/`` itself must produce zero unsuppressed findings at
+    the warning bar — the deliberate patterns (device dispatch under the
+    quiesce mutex, per-handle sync reads) carry justified inline
+    disables and show up in ``suppressed``, never in ``findings``."""
+    r = lint_concurrency([os.path.join(ROOT, "mxnet_tpu")])
+    r.assert_clean(fail_on="warning")
+    assert len(r.suppressed) >= 1           # the justified patterns exist
+
+
+# ===========================================================================
+# lockwatch: the runtime sanitizer
+# ===========================================================================
+
+@pytest.fixture
+def lockcheck(monkeypatch):
+    monkeypatch.setenv("MXNET_LOCKCHECK", "1")
+    lockwatch.reset()
+    yield
+    lockwatch.reset()
+
+
+def test_factories_plain_when_disabled(monkeypatch):
+    monkeypatch.delenv("MXNET_LOCKCHECK", raising=False)
+    assert type(lockwatch.make_lock("t.x")) is type(threading.Lock())
+    assert type(lockwatch.make_rlock("t.x")) is type(threading.RLock())
+
+
+def test_factories_watched_when_enabled(lockcheck):
+    l = lockwatch.make_lock("t.plain")
+    r = lockwatch.make_rlock("t.re")
+    assert isinstance(l, WatchedLock) and not l.reentrant
+    assert isinstance(r, WatchedLock) and r.reentrant
+
+
+def test_self_deadlock_detected_and_raised(lockcheck):
+    l = lockwatch.make_lock("t.self")
+    l.acquire()
+    try:
+        with pytest.raises(LockWatchDeadlock):
+            l.acquire()                     # blocking untimed re-acquire
+    finally:
+        l.release()
+    (f,) = lockwatch.findings()
+    assert f["rule"] == "MXL-C303" and f["site"] == "t.self"
+    assert "stack" in f and f["stack"]
+
+
+def test_rlock_reentry_is_legal(lockcheck):
+    r = lockwatch.make_rlock("t.rl")
+    with r:
+        with r:
+            pass
+    assert lockwatch.findings() == []
+
+
+def test_order_inversion_flagged_with_both_stacks(lockcheck):
+    a = lockwatch.make_lock("t.A")
+    b = lockwatch.make_lock("t.B")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:                             # closes the A->B / B->A cycle
+            pass
+    (f,) = lockwatch.findings()
+    assert f["rule"] == "MXL-C300"
+    assert {f["site"], f["other_site"]} == {"t.A", "t.B"}
+    assert f["stack"] and f["other_stack"]  # both acquisition stacks
+    # the same cycle is reported once, not on every re-acquisition
+    with b:
+        with a:
+            pass
+    assert len(lockwatch.findings()) == 1
+
+
+def test_consistent_order_stays_clean(lockcheck):
+    a = lockwatch.make_lock("t.C")
+    b = lockwatch.make_lock("t.D")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert lockwatch.findings() == []
+    assert lockwatch.edges().get("t.C") == ["t.D"]
+
+
+def test_hold_time_published_on_fake_clock(lockcheck, monkeypatch):
+    monkeypatch.setenv("MXNET_TELEMETRY", "1")
+    from mxnet_tpu.observability import catalog
+    ticks = iter([10.0, 10.25])             # acquire at 10s, release +250ms
+    monkeypatch.setattr(lockwatch.time, "perf_counter",
+                        lambda: next(ticks, 11.0))
+    l = lockwatch.make_lock("t.hold_fake")
+    l.acquire()
+    l.release()
+    assert catalog.LOCK_HOLD_MS.count(site="t.hold_fake") == 1
+    (st,) = [s for s in catalog.LOCK_HOLD_MS.series()
+             if s["labels"].get("site") == "t.hold_fake"]
+    assert st["sum"] == pytest.approx(250.0)
+
+
+def test_contention_counter(lockcheck, monkeypatch):
+    monkeypatch.setenv("MXNET_TELEMETRY", "1")
+    from mxnet_tpu.observability import catalog
+    before = catalog.LOCK_CONTENTION.value(site="t.cont")
+    l = lockwatch.make_lock("t.cont")
+    l.acquire()
+    entered = threading.Event()
+
+    def second():
+        entered.set()
+        with l:                             # blocks until main releases
+            pass
+
+    t = threading.Thread(target=second)
+    t.start()
+    entered.wait(2.0)
+    time.sleep(0.05)                        # let it hit the contended path
+    l.release()
+    t.join(2.0)
+    assert catalog.LOCK_CONTENTION.value(site="t.cont") == before + 1
+    assert lockwatch.findings() == []       # contention is not a finding
+
+
+def test_findings_counter_and_report_roundtrip(lockcheck, monkeypatch,
+                                               tmp_path):
+    monkeypatch.setenv("MXNET_TELEMETRY", "1")
+    from mxnet_tpu.observability import catalog
+    before = catalog.LOCKWATCH_FINDINGS.value(rule="MXL-C303")
+    l = lockwatch.make_lock("t.rep")
+    l.acquire()
+    assert not l.acquire(timeout=0.01)      # timed re-acquire: finding, no raise
+    l.release()
+    assert catalog.LOCKWATCH_FINDINGS.value(rule="MXL-C303") == before + 1
+    path = lockwatch.write_report(str(tmp_path / "lw.json"))
+    data = json.loads(open(path).read())
+    assert data["findings"][0]["rule"] == "MXL-C303"
+    text = lockwatch.render_report(data)
+    assert "MXL-C303" in text and "t.rep" in text
+    with pytest.raises(AssertionError):
+        lockwatch.assert_no_findings()
+    lockwatch.reset()
+    lockwatch.assert_no_findings()
+
+
+def test_condition_over_watched_lock(lockcheck):
+    """``threading.Condition(make_lock(...))`` must work: wait() releases
+    the watched lock (held-set popped) and re-acquires on wake."""
+    lk = lockwatch.make_lock("t.cv")
+    cv = threading.Condition(lk)
+    state = {"ready": False, "seen_unowned": False}
+
+    def consumer():
+        with cv:
+            while not state["ready"]:
+                cv.wait(timeout=2.0)
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    deadline = time.monotonic() + 2.0
+    while time.monotonic() < deadline:
+        if lk.acquire(timeout=0.05):        # acquirable while consumer waits
+            state["seen_unowned"] = True
+            state["ready"] = True
+            cv.notify_all()
+            lk.release()
+            break
+    t.join(2.0)
+    assert not t.is_alive()
+    assert state["seen_unowned"]
+    assert lockwatch.findings() == []
+
+
+# ===========================================================================
+# regressions for the real races the dogfood run found
+# ===========================================================================
+
+def test_watchdog_stale_fire_cannot_clobber_rearm():
+    """resilience/watchdog.py MXL-C304 fix: a deadline that fires must
+    carry ITS region's label, and a later arm() always sees a fresh
+    ``fired = False`` — the check-and-fire is atomic with re-arming."""
+    from mxnet_tpu.resilience.watchdog import Watchdog
+    labels = []
+    wd = Watchdog(deadline=0.08, on_timeout=labels.append)
+    try:
+        with wd.arm("slow-step"):
+            time.sleep(0.3)                 # let the deadline fire
+        assert wd.fired and labels == ["slow-step"]
+        with wd.arm("fast-step"):
+            assert wd.fired is False        # arm() reset it atomically
+        assert wd.fired is False            # fast-step never timed out
+        time.sleep(0.2)                     # a stale timer must stay dead
+        assert labels == ["slow-step"]
+    finally:
+        wd.close()
+
+
+def test_executor_ladder_reads_are_torn_free():
+    """serving/executors.py MXL-C304 fix: bucket_for()/max_bucket() must
+    see ONE consistent ladder even while rebind() swaps it concurrently."""
+    from mxnet_tpu.serving.executors import BucketExecutorCache
+    cache = BucketExecutorCache("{}", b"", input_name="data",
+                                feature_shape=(4,), buckets=(1, 2, 4, 8))
+    stop = threading.Event()
+    errors = []
+
+    def churn():
+        flip = True
+        while not stop.is_set():
+            cache.rebind(2 if flip else 1)
+            flip = not flip
+
+    t = threading.Thread(target=churn)
+    t.start()
+    try:
+        for _ in range(500):
+            b = cache.bucket_for(3)         # 4 on either ladder
+            if b != 4:
+                errors.append(b)
+            if cache.max_bucket != 8:
+                errors.append("max")
+    finally:
+        stop.set()
+        t.join(2.0)
+    assert errors == []
+
+
+def test_fleet_admit_excursion_snapshot():
+    """serving/fleet.py MXL-C304 fix: admit() snapshots ``_excursion``
+    under the guard, so a Preempted raised mid-swap always names the
+    guaranteed tenant (never an empty set read between check and use)."""
+    from mxnet_tpu.serving.fleet import FleetController, TenantPolicy
+    from mxnet_tpu.serving.errors import Preempted
+
+    class _Cache:
+        declared_buckets = (1, 2, 4)
+        chips = 1
+
+        def rebind(self, chips):
+            self.chips = chips
+
+    st = types.SimpleNamespace(cfg=types.SimpleNamespace(name="be"),
+                               cache=_Cache())
+    server = types.SimpleNamespace(_models={"be": st})
+    fleet = FleetController(server, 1, [TenantPolicy("be")])
+    stop = threading.Event()
+
+    def swap():
+        while not stop.is_set():
+            with fleet._lock:
+                fleet._excursion = {"gold": time.monotonic()}
+            with fleet._lock:
+                fleet._excursion = {}
+
+    t = threading.Thread(target=swap)
+    t.start()
+    try:
+        for _ in range(300):
+            req = types.SimpleNamespace(priority="best_effort")
+            try:
+                fleet.admit(st, req)
+            except Preempted as e:
+                assert "gold" in str(e)     # never an empty tenant list
+    finally:
+        stop.set()
+        t.join(2.0)
+
+
+# ===========================================================================
+# HLO invariance: the sanitizer never enters the traced program
+# ===========================================================================
+
+def test_step_hlo_identical_with_lockcheck_on_off(monkeypatch):
+    """Acceptance: lockwatch is host-only bookkeeping — the fused step
+    lowered with MXNET_LOCKCHECK=0 and =1 produces identical StableHLO."""
+    import jax
+    from mxnet_tpu import gluon, parallel
+    from mxnet_tpu.gluon import nn
+
+    def lowered_text(prefix):
+        mx.random.seed(11)
+        net = nn.HybridSequential(prefix=prefix)
+        net.add(nn.Dense(8, activation="relu", prefix=prefix + "d0_"),
+                nn.Dense(3, prefix=prefix + "d1_"))
+        net.initialize(mx.init.Xavier())
+        rng = np.random.RandomState(42)
+        x = rng.randn(16, 6).astype("f4")
+        y = rng.randint(0, 3, (16,)).astype("f4")
+        t = parallel.DataParallelTrainer(
+            net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+            {"learning_rate": 0.1}, grad_guard=True)
+        t._capture(2, sample_arrays=[x, y])
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        spec = NamedSharding(t._mesh, P(t._axis))
+        ax = [jax.device_put(a, spec) for a in (x, y)]
+        key = jax.random.PRNGKey(0)
+        return t._step_fn.lower(t._params, t._aux, t._opt_state,
+                                t._guard_state, key, *ax).as_text()
+
+    monkeypatch.setenv("MXNET_LOCKCHECK", "1")
+    lockwatch.reset()
+    on = lowered_text("hlolw_")
+    monkeypatch.setenv("MXNET_LOCKCHECK", "0")
+    off = lowered_text("hlolw_")    # same prefix/seed => same param names
+    assert on == off
+    lockwatch.reset()

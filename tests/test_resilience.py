@@ -1176,12 +1176,16 @@ def test_periodic_save_deferred_while_skips_await_replay(tmp_path, caplog):
 
 
 @pytest.mark.chaos
-def test_preemption_mid_storm_defers_save_and_resumes_to_digest(tmp_path):
+def test_preemption_mid_storm_defers_save_and_resumes_to_digest(
+        tmp_path, monkeypatch):
     """THE crashloop --inject-nan + kill-schedule bar: a SIGTERM landing
     mid-storm (skipped steps not yet replayed by a rollback) must NOT
     commit the usual final checkpoint — the restarted process falls back
     to the last healthy one, replays the poisoned batches clean, and
     reaches the exact uninjected params."""
+    from mxnet_tpu.analysis import lockwatch
+    monkeypatch.setenv("MXNET_LOCKCHECK", "1")   # crashloop under sanitizer
+    lockwatch.reset()
     N = 30
     batches = _batches(6)
     kw = {"compute_dtype": "bfloat16", "loss_scaling": True,
@@ -1232,6 +1236,7 @@ def test_preemption_mid_storm_defers_save_and_resumes_to_digest(tmp_path):
     for name in ref_params:
         assert np.array_equal(ref_params[name], got[name]), name
     rt2.close()
+    lockwatch.assert_no_findings()
 
 
 def test_divergence_detector_ignores_single_good_outlier():

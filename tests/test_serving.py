@@ -524,13 +524,18 @@ def test_loadgen_row_lands_in_ledger_and_perfwatch_reads_it(tiny, tmp_path):
 
 # -------------------------------------------------- THE chaos acceptance test
 @pytest.mark.chaos
-def test_storm_sheds_bounds_p99_and_recovers(tiny, tmp_path):
+def test_storm_sheds_bounds_p99_and_recovers(tiny, tmp_path, monkeypatch):
     """request_storm at 3x sustainable QPS + slow clients + one injected
     executor fault: typed sheds, accepted p99 within the deadline, zero
     expired dispatches, recovery to baseline after the storm, drain on a
-    real SIGTERM — proven from telemetry counters and a CostLedger row."""
+    real SIGTERM — proven from telemetry counters and a CostLedger row.
+    The whole storm runs under the lock-order sanitizer (MXNET_LOCKCHECK)
+    and must produce zero lockwatch findings."""
     from mxnet_tpu.resilience import chaos as rchaos
+    from mxnet_tpu.analysis import lockwatch
 
+    monkeypatch.setenv("MXNET_LOCKCHECK", "1")   # before any lock is made
+    lockwatch.reset()
     sym_json, pbytes, feat, ref = tiny
     deadline_ms = 400.0
     cfg = _cfg(tiny, name="storm", max_queue=32, deadline_ms=deadline_ms,
@@ -634,3 +639,4 @@ def test_storm_sheds_bounds_p99_and_recovers(tiny, tmp_path):
         assert not srv.ready()
     finally:
         srv.close(timeout=10.0)
+    lockwatch.assert_no_findings()

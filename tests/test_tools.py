@@ -1196,3 +1196,111 @@ def test_loadgen_tenants_cli_matrix(tmp_path):
                         "--url", "http://127.0.0.1:9"],
                        capture_output=True, text=True, timeout=60, env=env)
     assert p.returncode == 2, p.stdout + p.stderr
+
+
+# ----------------------------------------------- mxrace CLI (0/1/2 matrix)
+_RACE_BAD_SRC = """\
+import queue
+import threading
+
+
+class Blocky:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = queue.Queue()
+
+    def bad(self):
+        with self._lock:
+            return self._q.get()
+"""
+
+_RACE_CLEAN_SRC = """\
+import threading
+
+
+class Tidy:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+
+    def bump(self):
+        with self._lock:
+            self.n += 1
+"""
+
+
+def test_mxrace_cli_matrix(tmp_path):
+    """tools/mxrace.py static scan: 0 clean, 1 findings at/above --fail-on,
+    2 unusable target — the mxlint exit convention."""
+    import json as _json
+    cli = os.path.join(REPO, "tools", "mxrace.py")
+    clean = tmp_path / "clean.py"
+    clean.write_text(_RACE_CLEAN_SRC)
+    bad = tmp_path / "bad.py"
+    bad.write_text(_RACE_BAD_SRC)
+
+    p = subprocess.run([sys.executable, cli, str(clean)],
+                       capture_output=True, text=True, timeout=120)
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "clean" in p.stdout
+
+    p = subprocess.run([sys.executable, cli, str(bad)],
+                       capture_output=True, text=True, timeout=120)
+    assert p.returncode == 1, p.stdout + p.stderr
+    assert "MXL-C301" in p.stdout
+
+    p = subprocess.run([sys.executable, cli, str(bad), "--format", "json"],
+                       capture_output=True, text=True, timeout=120)
+    assert p.returncode == 1
+    data = _json.loads(p.stdout)
+    assert data["findings"][0]["rule"] == "MXL-C301"
+
+    # C301 is a warning: raising the bar to error passes it
+    p = subprocess.run([sys.executable, cli, str(bad),
+                        "--fail-on", "error"],
+                       capture_output=True, text=True, timeout=120)
+    assert p.returncode == 0, p.stdout + p.stderr
+
+    # run-level suppression from the command line
+    p = subprocess.run([sys.executable, cli, str(bad),
+                        "--suppress", "MXL-C301"],
+                       capture_output=True, text=True, timeout=120)
+    assert p.returncode == 0, p.stdout + p.stderr
+
+    # unusable targets exit 2: missing path, unparsable source
+    p = subprocess.run([sys.executable, cli, str(tmp_path / "nope.py")],
+                       capture_output=True, text=True, timeout=120)
+    assert p.returncode == 2
+    syn = tmp_path / "syn.py"
+    syn.write_text("def broken(:\n")
+    p = subprocess.run([sys.executable, cli, str(syn)],
+                       capture_output=True, text=True, timeout=120)
+    assert p.returncode == 2, p.stdout + p.stderr
+
+
+def test_mxrace_report_subcommand(tmp_path):
+    """`mxrace report <json>` pretty-prints a lockwatch artifact: exit 1
+    when it carries findings, 0 when clean, 2 when unreadable."""
+    import json as _json
+    cli = os.path.join(REPO, "tools", "mxrace.py")
+    rep = tmp_path / "lw.json"
+    rep.write_text(_json.dumps({
+        "findings": [{"rule": "MXL-C300", "site": "t.B", "other_site": "t.A",
+                      "thread": "w0", "message": "lock-order inversion",
+                      "stack": "  at x\n", "other_stack": "  at y\n"}],
+        "order_graph": {"t.A": ["t.B"], "t.B": ["t.A"]}}))
+    p = subprocess.run([sys.executable, cli, "report", str(rep)],
+                       capture_output=True, text=True, timeout=120)
+    assert p.returncode == 1, p.stdout + p.stderr
+    assert "MXL-C300" in p.stdout and "t.A -> t.B" in p.stdout
+
+    rep.write_text(_json.dumps({"findings": [], "order_graph": {}}))
+    p = subprocess.run([sys.executable, cli, "report", str(rep)],
+                       capture_output=True, text=True, timeout=120)
+    assert p.returncode == 0
+    assert "no findings" in p.stdout
+
+    p = subprocess.run([sys.executable, cli, "report",
+                        str(tmp_path / "missing.json")],
+                       capture_output=True, text=True, timeout=120)
+    assert p.returncode == 2

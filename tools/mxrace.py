@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""mxrace — concurrency static analyzer + lockwatch report viewer.
+
+The static half walks Python sources (no imports, no TPU): models
+threading.Lock/RLock/Condition attributes per class, builds the
+inter-method lock-acquisition graph, and reports the MXL-C300 rule family
+(lock-order inversion, blocking call under a lock, Condition.wait outside
+a while loop, re-entrant self-deadlock, guard-inconsistent shared state,
+leaked threads, manual acquire without try/finally). Rule catalog:
+docs/static_analysis.md "Concurrency analysis".
+
+Usage::
+
+    # static scan over files or package directories
+    python tools/mxrace.py mxnet_tpu/
+    python tools/mxrace.py mxnet_tpu/serving/ --format json
+    python tools/mxrace.py myfile.py --suppress MXL-C304 --fail-on error
+
+    # pretty-print a runtime lockwatch report
+    # (produced by mxnet_tpu.analysis.lockwatch.write_report under
+    #  MXNET_LOCKCHECK=1)
+    python tools/mxrace.py report /tmp/lockwatch.json
+
+The dogfood gate in tests/test_mxrace.py pins ``mxnet_tpu/`` clean at
+``--fail-on warning`` (the default): every deliberate pattern in the repo
+carries an inline ``# mxlint: disable=MXL-Cxxx`` with a justification.
+
+Exit codes: 0 clean (below ``--fail-on``), 1 findings at/above it, 2 the
+target could not be loaded/parsed.
+"""
+import argparse
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def _run_report(path: str) -> int:
+    from mxnet_tpu.analysis import lockwatch
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            data = json.load(fh)
+    except Exception as e:
+        print(f"mxrace: cannot read lockwatch report {path!r}: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        return 2
+    print(lockwatch.render_report(data))
+    return 1 if data.get("findings") else 0
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "report":
+        if len(argv) != 2:
+            print("usage: mxrace report <lockwatch.json>", file=sys.stderr)
+            return 2
+        return _run_report(argv[1])
+
+    ap = argparse.ArgumentParser(
+        prog="mxrace", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("paths", nargs="+",
+                    help="Python files or package directories to scan "
+                         "(or: `report <lockwatch.json>`)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--suppress", default="",
+                    help="comma-separated rule ids to silence")
+    ap.add_argument("--fail-on", choices=("error", "warning"),
+                    default="warning",
+                    help="lowest severity that makes the exit code nonzero "
+                         "(default: warning — the dogfood-clean bar)")
+    args = ap.parse_args(argv)
+    suppress = tuple(s for s in args.suppress.split(",") if s.strip())
+
+    for p in args.paths:
+        if not os.path.exists(p):
+            print(f"mxrace: no such file or directory: {p!r}",
+                  file=sys.stderr)
+            return 2
+
+    try:
+        from mxnet_tpu.analysis import lint_concurrency
+        report = lint_concurrency(args.paths, suppress=suppress)
+    except SyntaxError as e:
+        print(f"mxrace: cannot parse {e.filename!r}: {e}", file=sys.stderr)
+        return 2
+    except Exception as e:
+        print(f"mxrace: cannot scan {args.paths!r}: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        return 2
+
+    print(report.to_json() if args.format == "json" else report.to_text())
+    return 0 if report.ok(args.fail_on) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
