@@ -62,6 +62,14 @@ Rules (catalog in docs/static_analysis.md):
                                           tracing disabled (or sample
                                           rate 0) — a breach leaves no
                                           per-request timeline
+* MXL-T218 unbudgeted-hbm-overcommit (warning) the server's summed
+                                          ledger-estimated footprints
+                                          exceed the per-chip HBM budget,
+                                          or a multi-model server runs
+                                          with footprint evidence on file
+                                          but no budget configured — the
+                                          memory-aware refusal paths are
+                                          blind
 """
 from __future__ import annotations
 
@@ -197,6 +205,19 @@ register_rule(
     "a donor). Attach a FleetController with TenantPolicy(quota_qps=/"
     "priority=) per model, and give every autoscaled tenant a "
     "ModelConfig(slo_p99_ms=) objective.")
+register_rule(
+    "MXL-T218", "warning", "unbudgeted-hbm-overcommit",
+    "The serving process overcommits (or cannot account) its HBM: either "
+    "the sum of the models' ledger-estimated per-chip footprints "
+    "(memwatch.model_footprint) exceeds the per-chip HBM budget — the "
+    "next cold bucket bind or traffic spike OOMs the device although the "
+    "overcommit was computable up front — or multiple models serve with "
+    "memory-footprint evidence on file but NO budget configured "
+    "(MXNET_HBM_BYTES unset on an unknown device), leaving every "
+    "memory-aware refusal path (model-load budget check, fleet "
+    "no_memory refusals, tuner predicted-OOM gate) blind. Set "
+    "MXNET_HBM_BYTES (or serve on a device with a known capacity) and "
+    "shed a model/shrink a ladder until the placement fits.")
 register_rule(
     "MXL-T211", "warning", "untuned-hot-loop",
     "The trainer runs with all-default perf levers while the autotuner "
@@ -584,8 +605,9 @@ def lint_data_iter(data_iter, *, suppress: Sequence[str] = (),
 
 def lint_server(server_or_config, *, suppress: Sequence[str] = (),
                 subject: str = "") -> Report:
-    """Lint a serving configuration for overload-safety, observability
-    and tenant isolation (MXL-T214 / MXL-T215 / MXL-T216 / MXL-T217).
+    """Lint a serving configuration for overload-safety, observability,
+    tenant isolation and memory budgeting (MXL-T214 / MXL-T215 /
+    MXL-T216 / MXL-T217 / MXL-T218).
 
     Accepts a :class:`~mxnet_tpu.serving.server.ModelServer` (every model
     is checked), a :class:`~mxnet_tpu.serving.fleet.FleetController`
@@ -765,6 +787,62 @@ def lint_server(server_or_config, *, suppress: Sequence[str] = (),
                      "MXNET_TRACE_SAMPLE — tail/error traces are always "
                      "retained; docs/observability.md, 'Request "
                      "tracing'"))
+    # ---- unbudgeted HBM overcommit (MXL-T218): needs the live server
+    # (footprints come off its executor caches) — a bare ModelConfig has
+    # no cache and stays silent. Fires on evidence only: a budget the
+    # summed per-chip footprints exceed, or footprint rows on file for a
+    # multi-model server with NO budget to check them against. A fitting
+    # placement, a single model without a budget, or a server with no
+    # memory evidence at all stay silent.
+    srv = (server_or_config if hasattr(server_or_config, "_models")
+           else None)
+    if srv is not None:
+        needs: Dict[str, int] = {}
+        any_ledger = False
+        budget = None
+        try:
+            from ..observability import memwatch as _memwatch
+            budget = _memwatch.hbm_budget_bytes()
+            for m, st in srv._models.items():
+                fp = _memwatch.model_footprint(st.cache, model=m)
+                needs[m] = _memwatch.per_chip_bytes(
+                    fp, getattr(st.cache, "chips", 1) or 1)
+                any_ledger = any_ledger or any(
+                    b.get("source") == "ledger"
+                    for b in (fp.get("buckets") or {}).values())
+        except Exception:
+            needs = {}
+        if needs and budget is not None:
+            avail = int(budget) - int(_memwatch.pressure()["ballast_bytes"])
+            total_need = sum(needs.values())
+            if total_need > avail:
+                ranked = sorted(needs.items(), key=lambda kv: -kv[1])
+                report.add(Diagnostic(
+                    "MXL-T218",
+                    "the %d served model(s) need ~%s/chip combined but "
+                    "the per-chip HBM budget is %s — the placement is "
+                    "overcommitted before any traffic arrives (largest: "
+                    "%s)" % (len(needs), _fmt_bytes(total_need),
+                             _fmt_bytes(max(0, avail)),
+                             ", ".join("%s ~%s" % (m, _fmt_bytes(n))
+                                       for m, n in ranked[:3])),
+                    location="server",
+                    hint="shed a model, shrink a bucket ladder, or raise "
+                         "MXNET_HBM_BYTES — docs/observability.md, "
+                         "'Memory observability'"))
+        elif len(needs) >= 2 and budget is None and any_ledger:
+            report.add(Diagnostic(
+                "MXL-T218",
+                "%d models serve with memory-footprint evidence on file "
+                "(label='memory' ledger rows) but no per-chip HBM budget "
+                "is configured: the memory-aware refusal paths (load "
+                "budget check, fleet no_memory refusals) are blind and "
+                "the first overcommit surfaces as a device OOM"
+                % len(needs),
+                location="server",
+                hint="set MXNET_HBM_BYTES to the chip's capacity (or "
+                     "serve on a device kind memwatch knows) — "
+                     "docs/observability.md, 'Memory observability'"))
     return report
 
 

@@ -23,6 +23,12 @@ jit_hooks           jax.monitoring taps: trace/compile counts + compile
                     time (the dynamic retrace truth)
 xcost               XLA cost ledger: per-executable FLOPs/bytes/roofline
                     rows persisted append-only (``MXNET_PERF_LEDGER``)
+memwatch            HBM memory observability: per-executable memory
+                    ledger rows, live ``mxtpu_hbm_*`` accounting with a
+                    CPU-synthetic fallback, OOM postmortems
+                    (``mxtpu_oom.json`` + typed ``HBMExhausted``) and the
+                    per-chip budget math fleet placement consults
+                    (``tools/mxmem.py`` is its CLI)
 attribution         step-time decomposition + live MFU/device-util gauges
 perfwatch           perf-regression watchdog vs bench baselines
                     (library + ``tools/perfwatch.py`` CLI)
@@ -47,6 +53,7 @@ from . import spans
 from . import flight_recorder
 from . import jit_hooks
 from . import xcost
+from . import memwatch
 from . import attribution
 from . import perfwatch
 from . import tracing
@@ -57,19 +64,20 @@ from .metrics import (Counter, Gauge, Histogram, MetricsRegistry, REGISTRY,
 from .spans import span, active_spans
 from .flight_recorder import FlightRecorder, get_recorder, record_step
 from .xcost import CostLedger, analyze_cost
+from .memwatch import HBMExhausted
 from .attribution import StepAttribution
 from .perfwatch import PerfWatch
 from .tracing import TraceContext, Tracer, SLOTracker, get_tracer
 
 __all__ = ["metrics", "catalog", "spans", "flight_recorder", "jit_hooks",
-           "xcost", "attribution", "perfwatch", "tracing",
+           "xcost", "memwatch", "attribution", "perfwatch", "tracing",
            "Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
            "counter", "gauge", "histogram", "enabled", "snapshot",
            "render_json", "render_prometheus", "write_snapshot",
            "start_exporter", "stop_exporter", "span", "active_spans",
            "FlightRecorder", "get_recorder", "record_step",
-           "CostLedger", "analyze_cost", "StepAttribution", "PerfWatch",
-           "TraceContext", "Tracer", "SLOTracker", "get_tracer"]
+           "CostLedger", "analyze_cost", "HBMExhausted", "StepAttribution",
+           "PerfWatch", "TraceContext", "Tracer", "SLOTracker", "get_tracer"]
 
 # jax.monitoring listeners are cheap (no work between compile events) and
 # honor the live MXNET_TELEMETRY switch themselves, so install eagerly —

@@ -311,6 +311,38 @@ QUANT_SERVE_REQUESTS = _m.counter(
     "model= and outcome= (same outcomes as mxtpu_serve_requests_total — "
     "the int8 slice of serving traffic).")
 
+# ----------------------------------------------------------------- memory
+HBM_BYTES_IN_USE = _m.gauge(
+    "mxtpu_hbm_bytes_in_use",
+    "Live HBM bytes in use per device at the last memwatch.poll_hbm "
+    "sample, labeled device=. Real allocator numbers where the backend "
+    "has memory_stats(); the synthetic live-set sum (registered state "
+    "trees + chaos ballast) on backends without (CPU).")
+HBM_PEAK_BYTES = _m.gauge(
+    "mxtpu_hbm_peak_bytes",
+    "High-watermark HBM bytes across devices (allocator peak_bytes_in_use "
+    "where available; the running synthetic peak otherwise). The number "
+    "placement budgets must stay above.")
+HBM_LARGEST_ALLOC_BYTES = _m.gauge(
+    "mxtpu_hbm_largest_alloc_bytes",
+    "Largest single live allocation (allocator largest_alloc_size where "
+    "available; the largest registered live set otherwise) — the "
+    "fragmentation probe: an OOM with in_use well under the limit and "
+    "this number large means fragmentation, not demand.")
+OOM_TOTAL = _m.counter(
+    "mxtpu_oom_total",
+    "Device RESOURCE_EXHAUSTED failures classified at a dispatch "
+    "boundary, labeled context=serving|trainer|restore. Every increment "
+    "has a matching mxtpu_oom.json postmortem artifact.")
+MEM_REFUSALS = _m.counter(
+    "mxtpu_mem_refusals_total",
+    "Memory-aware refusals instead of a device OOM, labeled reason="
+    "no_memory (fleet grow/resize whose post-state would not fit the "
+    "per-chip HBM budget) | load (ModelServer refused to load a model "
+    "whose estimated footprint exceeds the remaining budget) | "
+    "predicted_oom (tuner candidate skipped because its predicted "
+    "footprint exceeds the budget).")
+
 # -------------------------------------------------------------- callbacks
 SPEEDOMETER_SPS = _m.gauge(
     "mxtpu_speedometer_samples_per_sec",

@@ -59,6 +59,10 @@ METRIC_DIRECTIONS: Dict[str, int] = {
     "int8_acc": +1,            # and so is int8 accuracy drifting down
     "slo_burn_rate": -1,       # serving SLO error-budget burn (max over
                                # model/window series of mxtpu_slo_burn_rate)
+    "peak_bytes": -1,          # memory ledger row (label="memory"): a
+                               # fatter executable is a regression
+    "footprint_bytes": -1,     # estimated resident bytes/chip (tuner
+                               # trial / memwatch footprint)
 }
 
 DEFAULT_THRESHOLD_PCT = 10.0
@@ -155,6 +159,17 @@ def normalize(doc: Any, source: str = "") -> Optional[Dict[str, Any]]:
         return {"kind": "quant_row", "source": source, "metrics": vals,
                 "model": doc.get("model"),
                 "provenance": doc.get("provenance")}
+    if doc.get("label") == "memory" and isinstance(doc.get("memory"), dict):
+        # memwatch memory ledger row: per-executable byte accounting —
+        # peak down-is-good, so a step/bucket growing its HBM appetite
+        # guards exactly like a latency regression
+        vals = {}
+        if doc.get("peak_memory_bytes") is not None:
+            vals["peak_bytes"] = float(doc["peak_memory_bytes"])
+        return {"kind": "memory_row", "source": source, "metrics": vals,
+                "model": doc.get("model"), "bucket": doc.get("bucket"),
+                "mem_label": doc.get("mem_label"),
+                "provenance": doc.get("provenance")}
     if "roofline" in doc or "arithmetic_intensity" in doc:
         vals = {}
         if doc.get("flops") is not None:
@@ -171,6 +186,10 @@ def normalize(doc: Any, source: str = "") -> Optional[Dict[str, Any]]:
             vals["throughput"] = float(doc["throughput_img_s_per_chip"])
         if doc.get("mfu") is not None:
             vals["mfu"] = float(doc["mfu"])
+        if doc.get("footprint_bytes") is not None:
+            # tuner trial rows carry the estimated resident bytes/chip:
+            # a config whose memory appetite grew guards like step_ms
+            vals["footprint_bytes"] = float(doc["footprint_bytes"])
         return {"kind": "ledger_row", "source": source, "metrics": vals,
                 "roofline": doc.get("roofline"),
                 "provenance": doc.get("provenance")}

@@ -42,7 +42,7 @@ from . import metrics as _metrics
 
 __all__ = ["DEVICE_PEAKS", "peak_flops", "peak_hbm_bw", "analyze_cost",
            "CostLedger", "ledger_path", "enabled", "get_ledger", "capture",
-           "cost_of", "merge_costs"]
+           "cost_of", "merge_costs", "memory_of"]
 
 register_config("MXNET_PERF_LEDGER", "", str,
                 "Path of the append-only JSON-lines cost ledger. Non-empty "
@@ -258,11 +258,30 @@ def merge_costs(*costs) -> Optional[Dict[str, Any]]:
     return out or None
 
 
+def memory_of(compiled) -> Optional[Dict[str, int]]:
+    """XLA ``memory_analysis()`` of one compiled executable as a plain
+    byte dict, or None when the backend reports nothing. The shared
+    extraction for every memory row (here and in ``memwatch``)."""
+    try:
+        mem = compiled.memory_analysis()
+    except Exception:
+        return None
+    if mem is None:
+        return None
+    return {
+        "temp_bytes": int(getattr(mem, "temp_size_in_bytes", 0)),
+        "argument_bytes": int(getattr(mem, "argument_size_in_bytes", 0)),
+        "output_bytes": int(getattr(mem, "output_size_in_bytes", 0)),
+        "generated_code_bytes": int(
+            getattr(mem, "generated_code_size_in_bytes", 0)),
+    }
+
+
 def capture(lowered=None, *, cost: Optional[Dict[str, Any]] = None,
             key: Optional[Dict[str, Any]] = None,
             fingerprint: Optional[str] = None, label: str = "",
             device_kind: Optional[str] = None, platform: Optional[str] = None,
-            n_devices: int = 1, compiled=None,
+            n_devices: int = 1, compiled=None, compile_for_memory: bool = False,
             extra: Optional[Dict[str, Any]] = None,
             ledger: Optional[CostLedger] = None) -> Optional[Dict[str, Any]]:
     """Analyze one logical step and persist the row.
@@ -271,7 +290,11 @@ def capture(lowered=None, *, cost: Optional[Dict[str, Any]] = None,
     or a precomputed ``cost`` dict (e.g. :func:`merge_costs` over the kv
     path's grad+apply programs) for multi-program steps. ``compiled`` may
     pass the already-compiled executable (the ``aot_save`` path) to enrich
-    the row with XLA's memory analysis. Returns the persisted row, or None
+    the row with XLA's memory analysis; ``compile_for_memory=True`` closes
+    the lazy-path gap instead — an analysis compile of ``lowered`` is
+    performed here solely for ``memory_analysis`` (the program actually
+    dispatched is untouched; callers gate this on
+    ``memwatch.capture_enabled()``). Returns the persisted row, or None
     when telemetry is off or the backend reports no costs. Never raises:
     the perf layer must not be able to kill training.
     """
@@ -295,23 +318,18 @@ def capture(lowered=None, *, cost: Optional[Dict[str, Any]] = None,
             last_ms = _jit.last_compile_ms()
             if last_ms is not None:
                 row["last_compile_ms"] = last_ms
+        elif compile_for_memory and lowered is not None:
             try:
-                mem = compiled.memory_analysis()
-                row["memory"] = {
-                    "temp_bytes": int(getattr(mem, "temp_size_in_bytes", 0)),
-                    "argument_bytes": int(
-                        getattr(mem, "argument_size_in_bytes", 0)),
-                    "output_bytes": int(
-                        getattr(mem, "output_size_in_bytes", 0)),
-                    "generated_code_bytes": int(
-                        getattr(mem, "generated_code_size_in_bytes", 0)),
-                }
-                row["peak_memory_bytes"] = (
-                    row["memory"]["temp_bytes"]
-                    + row["memory"]["argument_bytes"]
-                    + row["memory"]["output_bytes"])
+                compiled = lowered.compile()
             except Exception:
-                pass
+                compiled = None
+        if compiled is not None:
+            mem = memory_of(compiled)
+            if mem:
+                row["memory"] = mem
+                row["peak_memory_bytes"] = (mem["temp_bytes"]
+                                            + mem["argument_bytes"]
+                                            + mem["output_bytes"])
         if extra:
             row.update(extra)
         led = ledger if ledger is not None else get_ledger()

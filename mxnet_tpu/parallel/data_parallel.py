@@ -29,6 +29,7 @@ from ..ndarray.ndarray import _unwrap, _wrap
 from ..observability import attribution as _attribution
 from ..observability import catalog as _telemetry
 from ..observability import flight_recorder as _flight
+from ..observability import memwatch as _memwatch
 from ..observability import metrics as _metrics
 from ..observability import xcost as _xcost
 from ..passes import manager as _passes
@@ -964,21 +965,31 @@ class DataParallelTrainer:
             # metadata only; the compiled program is untouched)
             self._maybe_capture_cost(rng, arrays)
         td0 = time.perf_counter() if perf is not None else 0.0
-        if self._kv is not None:
-            loss = self._kv_step(rng, arrays)
-        else:
-            fn = self._step_fn
-            if (self._compiled is not None
-                    and _shape_key(arrays) == self._compiled_shapes):
-                # the deserialized executable is shape-exact; a batch with
-                # other shapes (e.g. a ragged final batch) takes the jit
-                # path for that call only, keeping the executable for
-                # exact matches
-                fn = self._compiled
-                rng = jax.device_put(rng, NamedSharding(self._mesh, P()))
-            (self._params, self._aux, self._opt_state, self._guard_state,
-             loss) = fn(self._params, self._aux, self._opt_state,
-                        self._guard_state, rng, *arrays)
+        try:
+            if self._kv is not None:
+                loss = self._kv_step(rng, arrays)
+            else:
+                fn = self._step_fn
+                if (self._compiled is not None
+                        and _shape_key(arrays) == self._compiled_shapes):
+                    # the deserialized executable is shape-exact; a batch
+                    # with other shapes (e.g. a ragged final batch) takes
+                    # the jit path for that call only, keeping the
+                    # executable for exact matches
+                    fn = self._compiled
+                    rng = jax.device_put(rng, NamedSharding(self._mesh, P()))
+                (self._params, self._aux, self._opt_state, self._guard_state,
+                 loss) = fn(self._params, self._aux, self._opt_state,
+                            self._guard_state, rng, *arrays)
+        except Exception as e:
+            # the trainer dispatch boundary: a device RESOURCE_EXHAUSTED
+            # leaves forensics (mxtpu_oom.json) and re-raises typed;
+            # every other failure passes through untouched
+            oom = _memwatch.to_hbm_exhausted(e, context="trainer",
+                                             trainer=self)
+            if oom is not None:
+                raise oom from e
+            raise
         if tel:
             t1 = time.perf_counter()
             dt = t1 - t0
@@ -1026,13 +1037,15 @@ class DataParallelTrainer:
             common = dict(key=self._aot_key(arrays),
                           device_kind=dev.device_kind, platform=dev.platform,
                           n_devices=int(self._mesh.devices.size))
+            mem_on = _memwatch.capture_enabled()
             if self._kv is None:
                 lowered = self._step_fn.lower(
                     self._params, self._aux, self._opt_state,
                     self._guard_state, rng, *arrays)
                 row = _xcost.capture(
                     lowered, fingerprint=self._lowered_digest(lowered),
-                    label="DataParallelTrainer.step", **common)
+                    label="DataParallelTrainer.step",
+                    compile_for_memory=mem_on, **common)
             else:
                 gargs = (self._params, self._aux)
                 if self._scaler_cfg is not None:
@@ -1043,13 +1056,30 @@ class DataParallelTrainer:
                     self._params, self._opt_state, self._guard_state,
                     self._params)
                 import hashlib
+                extra = None
+                if mem_on:
+                    # the kv step IS two programs: memory is their sum
+                    # (same contract as merge_costs — all parts or none)
+                    try:
+                        mems = [_xcost.memory_of(p.compile())
+                                for p in (glow, alow)]
+                    except Exception:
+                        mems = [None]
+                    if all(mems):
+                        mem = {k: sum(m[k] for m in mems) for k in mems[0]}
+                        extra = {"memory": mem,
+                                 "peak_memory_bytes": (
+                                     mem["temp_bytes"]
+                                     + mem["argument_bytes"]
+                                     + mem["output_bytes"])}
                 row = _xcost.capture(
                     cost=_xcost.merge_costs(_xcost.cost_of(glow),
                                             _xcost.cost_of(alow)),
                     fingerprint=hashlib.sha256(
                         (self._lowered_digest(glow)
                          + self._lowered_digest(alow)).encode()).hexdigest(),
-                    label="DataParallelTrainer.kv_step", **common)
+                    label="DataParallelTrainer.kv_step", extra=extra,
+                    **common)
         except Exception as e:   # never let the perf layer kill a step
             logger.warning("cost-ledger capture failed: %r", e)
             return
@@ -1252,6 +1282,34 @@ class DataParallelTrainer:
             else:
                 per_chip += nbytes
         return {"total_bytes": total, "per_chip_bytes": per_chip}
+
+    def footprint(self) -> Dict[str, Any]:
+        """Estimated resident HBM of this trainer (host-side tree sums —
+        never syncs the device): params + aux + guard (replicated: each
+        chip holds a full copy), opt-state via :meth:`opt_state_bytes`
+        (ZeRO-aware per-chip share), and ``donated_bytes`` — the params +
+        opt-state buffers the fused step donates, i.e. the transient the
+        step does NOT double-buffer (XLA reuses donated inputs for the
+        matching outputs). ``step_peak_bytes`` rides along when the memory
+        ledger captured this trainer's executable."""
+        params = _memwatch.tree_bytes(self._params)
+        aux = _memwatch.tree_bytes(self._aux)
+        guard = _memwatch.tree_bytes(self._guard_state)
+        opt = self.opt_state_bytes()
+        total = params + aux + guard + int(opt.get("total_bytes", 0))
+        per_chip = params + aux + guard + int(opt.get("per_chip_bytes", 0))
+        fp: Dict[str, Any] = {
+            "params_bytes": params, "aux_bytes": aux, "guard_bytes": guard,
+            "opt_state_bytes": opt,
+            "donated_bytes": params + int(opt.get("total_bytes", 0)),
+            "total_bytes": total, "per_chip_bytes": per_chip,
+        }
+        peaks = [r.get("peak_memory_bytes") for r in
+                 (self._cost_rows or {}).values()
+                 if r and r.get("peak_memory_bytes")]
+        if peaks:
+            fp["step_peak_bytes"] = int(max(peaks))
+        return fp
 
     # ------------------------------------------------- recovery state hooks
     def set_loss_scale(self, scale: float) -> None:

@@ -260,6 +260,23 @@ class ResilientTrainer:
         return None
 
     def _restore(self, step: int, load_ladder: bool = True) -> None:
+        """Restore wrapper: a device RESOURCE_EXHAUSTED while re-landing
+        checkpoint state (the restored tree plus the still-live one can
+        transiently double-occupy HBM) leaves the same forensics as a
+        step OOM — ``mxtpu_oom.json`` with ``context="restore"`` — and
+        propagates typed
+        :class:`~mxnet_tpu.observability.memwatch.HBMExhausted`."""
+        from ..observability import memwatch as _memwatch
+        try:
+            self._restore_inner(step, load_ladder=load_ladder)
+        except Exception as e:
+            oom = _memwatch.to_hbm_exhausted(e, context="restore",
+                                             trainer=self.trainer)
+            if oom is not None:
+                raise oom from e
+            raise
+
+    def _restore_inner(self, step: int, load_ladder: bool = True) -> None:
         t = self.trainer
         user = self.checkpointer.read_manifest(step).get("user", {})
         # topology reconciliation FIRST — a TopologyMismatch must fire

@@ -35,6 +35,15 @@ tenant_storm        one tenant stormed at a multiple of sustainable QPS
                     THE multi-tenant isolation scenario: the fleet must
                     keep the victims inside their SLOs (autoscale +
                     quota + preemption), proven from counter deltas
+hbm_pressure        synthetic HBM scarcity: a shrunken per-chip budget
+                    and/or a ballast reserve (memwatch.set_pressure) —
+                    the lever that makes a fleet grow memory-infeasible
+                    on a dev box, so the ``no_memory`` refusal path is
+                    testable without a real OOM
+oom_executor        the next N dispatches raise a RESOURCE_EXHAUSTED-
+                    shaped allocation failure — drives the OOM forensics
+                    path: typed HBMExhausted + mxtpu_oom.json postmortem
+                    naming the real top holder
 =================  ======================================================
 """
 from __future__ import annotations
@@ -52,7 +61,8 @@ from ..analysis.lockwatch import make_lock
 __all__ = ["slow_client", "request_storm", "paced_run", "trace_evidence",
            "slow_executor", "executor_fault", "poison_request",
            "poison_payload", "POISON_SENTINEL",
-           "chip_scaled_executor", "tenant_storm"]
+           "chip_scaled_executor", "tenant_storm",
+           "hbm_pressure", "oom_executor"]
 
 # a value a legitimate float32 payload never carries (finite, but at the
 # edge of range) — the poison marker the patched executor looks for
@@ -387,6 +397,58 @@ def tenant_storm(server, storm_model: str, *, qps: float, duration_s: float,
         raise errors[0]
     return {"storm": results[storm_model],
             "victims": {m: results[m] for m, _ in jobs[1:]}}
+
+
+@contextlib.contextmanager
+def hbm_pressure(budget_bytes: Optional[int] = None, ballast_bytes: int = 0):
+    """Synthetic HBM scarcity for the whole process: installs a chaos
+    budget override and/or a ballast reserve via
+    :func:`~mxnet_tpu.observability.memwatch.set_pressure`, restoring the
+    unpressured state on exit. ``budget_bytes`` replaces whatever
+    :func:`~mxnet_tpu.observability.memwatch.hbm_budget_bytes` would
+    answer (so CPU dev boxes — normally unbudgeted — get a budget and the
+    refusal paths turn ON); ``ballast_bytes`` is subtracted from every
+    chip's budget like a co-resident allocation. Yields the live pressure
+    dict."""
+    from ..observability import memwatch as _memwatch
+    prev = _memwatch.pressure()
+    _memwatch.set_pressure(budget_bytes=budget_bytes,
+                           ballast_bytes=ballast_bytes)
+    try:
+        yield _memwatch.pressure()
+    finally:
+        _memwatch.set_pressure(budget_bytes=prev.get("budget_bytes"),
+                               ballast_bytes=prev.get("ballast_bytes", 0))
+
+
+@contextlib.contextmanager
+def oom_executor(server, model: str, faults: int = 1):
+    """The next ``faults`` dispatches for ``model`` raise a
+    RESOURCE_EXHAUSTED-shaped allocation failure — what a real XLA HBM
+    OOM looks like to the dispatch boundary. The server must classify it
+    (``memwatch.is_oom``), write the ``mxtpu_oom.json`` postmortem and
+    answer a typed :class:`~mxnet_tpu.observability.memwatch.HBMExhausted`
+    instead of a generic ExecutorFault. Yields the live ``oomed``
+    count."""
+    st = _state(server, model)
+    orig = st.cache.run
+    state = {"left": int(faults), "oomed": 0}
+
+    def run(batch):
+        if state["left"] > 0:
+            state["left"] -= 1
+            state["oomed"] += 1
+            raise RuntimeError(
+                "chaos: RESOURCE_EXHAUSTED: Out of memory while trying to "
+                "allocate %d bytes (synthetic allocation failure)"
+                % (int(np.asarray(batch).nbytes),))
+        return orig(batch)
+
+    st.cache.run = run
+    try:
+        yield state
+    finally:
+        st.cache.run = orig
 
 
 def poison_payload(feature_shape, sentinel: float = POISON_SENTINEL

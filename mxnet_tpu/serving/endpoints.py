@@ -17,13 +17,17 @@ Draining        503    stop routing here (readyz is already red)
 CircuitOpen     503    model broken here; route elsewhere
 Preempted       503    best-effort shed during a guaranteed tenant's
                        SLO excursion; retry after the storm
+HBMExhausted    503    the device ran out of HBM on this dispatch; a
+                       postmortem (mxtpu_oom.json) was written — route
+                       elsewhere while the operator reads it
 ExecutorFault   500    bad request or broken model — don't retry blind
 =============  =====  ==============================================
 
 With a fleet controller attached (``serving/fleet.py``), ``GET /fleetz``
 answers the fleet status document (404 with fleet mode off — the
 single-tenant surface is unchanged), ``POST /fleetz/resize`` is the
-operator resize (409 on a typed ``TopologyMismatch``), ``/predict``
+operator resize (409 on a typed ``TopologyMismatch`` or
+``MemoryBudgetExceeded``), ``/predict``
 accepts an optional ``"priority"`` field and every /predict response
 carries ``X-Fleet-Tenant`` / ``X-Fleet-Priority`` / ``X-Fleet-Chips``
 headers naming the tenant's current placement.
@@ -46,16 +50,20 @@ from typing import Optional
 
 import numpy as np
 
+from ..observability.memwatch import HBMExhausted
 from ..observability.tracing import TraceContext
 from .errors import (CircuitOpen, DeadlineExceeded, Draining, ExecutorFault,
-                     Overloaded, Preempted)
+                     MemoryBudgetExceeded, Overloaded, Preempted)
 
 __all__ = ["ServingEndpoints"]
 
 # order matters only for subclasses: QuotaExceeded is an Overloaded and
-# maps to the same 429 (clients already handling 429 keep working)
+# maps to the same 429 (clients already handling 429 keep working).
+# HBMExhausted is 503: the device OOMed this dispatch and a postmortem
+# was written — route elsewhere while the operator reads mxtpu_oom.json.
 _STATUS = ((Overloaded, 429), (DeadlineExceeded, 504), (Draining, 503),
-           (CircuitOpen, 503), (Preempted, 503), (ExecutorFault, 500))
+           (CircuitOpen, 503), (Preempted, 503), (HBMExhausted, 503),
+           (ExecutorFault, 500))
 
 # Retry-After hints (integer seconds, RFC 9110): 429 = back off briefly
 # and retry HERE once the burst drains; 503 = draining/breaker-open, give
@@ -136,6 +144,11 @@ def _make_handler(server):
                 # the typed refusal surface: impossible split/overcommit
                 self._reply(409, {"error": str(e),
                                   "type": "TopologyMismatch"})
+            except MemoryBudgetExceeded as e:
+                # same refusal surface, memory axis: the post-resize
+                # footprint does not fit the per-chip HBM budget
+                self._reply(409, {"error": str(e),
+                                  "type": "MemoryBudgetExceeded"})
             except MXNetError as e:
                 self._reply(404, {"error": str(e)})
             else:

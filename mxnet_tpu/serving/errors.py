@@ -34,6 +34,12 @@ Preempted               fleet admission: best-effort work shed because a
                         guaranteed tenant is in an SLO excursion. Typed,
                         never silent — retry once the excursion clears
                         (HTTP 503).
+MemoryBudgetExceeded    memory-aware refusal: loading the model (or the
+                        requested fleet resize) would exceed the per-chip
+                        HBM budget — refused up front instead of letting
+                        the device OOM mid-traffic. Shrink the model /
+                        ladder, raise MXNET_HBM_BYTES, or free a tenant
+                        (HTTP 409 on /fleetz/resize).
 =====================  ====================================================
 """
 from __future__ import annotations
@@ -41,7 +47,8 @@ from __future__ import annotations
 from ..base import MXNetError
 
 __all__ = ["ServingError", "Overloaded", "DeadlineExceeded", "Draining",
-           "CircuitOpen", "ExecutorFault", "QuotaExceeded", "Preempted"]
+           "CircuitOpen", "ExecutorFault", "QuotaExceeded", "Preempted",
+           "MemoryBudgetExceeded"]
 
 
 class ServingError(MXNetError):
@@ -83,3 +90,9 @@ class Preempted(ServingError):
     """Best-effort work shed by the fleet controller because a guaranteed
     tenant is in an SLO excursion. Retry after backoff — the excursion
     clears when the guaranteed tenant's burn rate recovers."""
+
+
+class MemoryBudgetExceeded(ServingError):
+    """The estimated HBM footprint does not fit the per-chip budget
+    (``observability.memwatch``): a model load or fleet resize was
+    refused up front instead of OOMing the device mid-traffic."""

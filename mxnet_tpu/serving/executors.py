@@ -24,7 +24,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..analysis.lockwatch import make_lock
-from ..base import MXNetError, get_env, register_config
+from ..base import MXNetError, get_env, logger, register_config
 
 __all__ = ["BucketExecutorCache", "default_buckets"]
 
@@ -101,9 +101,12 @@ class BucketExecutorCache:
                  buckets: Sequence[int],
                  dev_type: int = 1, dev_id: int = 0,
                  output_keys: Optional[List[str]] = None,
-                 chips: int = 1):
+                 chips: int = 1, model: Optional[str] = None):
         if not buckets:
             raise MXNetError("BucketExecutorCache needs at least one bucket")
+        # serving model name, stamped into this cache's memory-ledger rows
+        # (memwatch.model_footprint filters on it); None = anonymous cache
+        self.model = str(model) if model else None
         self.input_name = str(input_name)
         self.feature_shape = tuple(int(x) for x in feature_shape)
         self.declared_buckets = tuple(sorted({int(b) for b in buckets}))
@@ -178,7 +181,9 @@ class BucketExecutorCache:
                          % (n, buckets[-1]))
 
     def get(self, bucket: int):
-        """The bound predictor for one bucket, building it on first use."""
+        """The bound predictor for one bucket, building it on first use.
+        A fresh bind also records this bucket's ``label="memory"`` ledger
+        row (memwatch) when the cost ledger is on."""
         with self._lock:
             p = self._preds.get(bucket)
             if p is not None:
@@ -196,7 +201,41 @@ class BucketExecutorCache:
             else:
                 p = self._base.reshape(shape)
             self._preds[bucket] = p
-            return p
+            chips = self.chips  # snapshot: rebind() swaps it under _lock
+        # outside the cache lock: the memory row needs an analysis
+        # compile, and holding _lock through a compile would stall
+        # bucket_for/rebind on an unrelated bucket's first bind
+        self._record_memory_row(int(bucket), p, chips)
+        return p
+
+    def _record_memory_row(self, bucket: int, pred, chips: int) -> None:
+        """One ``label="memory"`` ledger row for a freshly bound bucket:
+        the per-executable byte accounting model_footprint and the fleet's
+        placement math read back. Gated like every capture (telemetry +
+        ledger + MXNET_MEM_CAPTURE); never raises."""
+        from ..observability import memwatch as _memwatch
+        from ..observability import metrics as _m
+        from ..observability import xcost as _xcost
+        if not (_m.enabled() and _xcost.enabled()
+                and _memwatch.capture_enabled()):
+            return
+        try:
+            ex = pred._exec
+            fn = ex._compiled(False)
+            if not hasattr(fn, "lower"):
+                return                      # eagerly-run executor: no program
+            import jax
+            inputs = {n: a._data for n, a in ex.arg_dict.items()}
+            inputs.update({n: a._data for n, a in ex.aux_dict.items()})
+            lowered = fn.lower(inputs, jax.random.PRNGKey(0))
+            kind, platform = _device_kind()
+            _memwatch.record_executable(
+                lowered, label="serving.bucket",
+                device_kind=kind, platform=platform, n_devices=chips,
+                extra={"model": self.model, "bucket": int(bucket)})
+        except Exception as e:              # accounting must never bind-fail
+            logger.warning("bucket memory row capture failed (model=%r "
+                           "bucket=%d): %r", self.model, bucket, e)
 
     def warm(self, buckets: Optional[Sequence[int]] = None) -> List[int]:
         """Compile (bind + one dummy forward) the given buckets — all of

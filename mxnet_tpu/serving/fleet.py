@@ -14,7 +14,17 @@ leaves open:
   refused with the SAME typed
   :class:`~mxnet_tpu.resilience.errors.TopologyMismatch` the elastic
   trainer raises (:func:`~mxnet_tpu.resilience.elastic.plan_chip_split`),
-  so training and serving share one refusal surface.
+  so training and serving share one refusal surface. Placement is also
+  memory-aware: when a per-chip HBM budget is known
+  (:func:`~mxnet_tpu.observability.memwatch.hbm_budget_bytes`), any
+  resize whose post-state footprint — ledger-estimated via
+  :func:`~mxnet_tpu.observability.memwatch.model_footprint` — does not
+  fit is refused with a typed
+  :class:`~mxnet_tpu.serving.errors.MemoryBudgetExceeded` (manual path)
+  or a ``no_memory`` refusal in the history (autoscaler), instead of
+  letting the device OOM mid-traffic. Note the donor side: shrinking a
+  donor CONCENTRATES its per-chip footprint, so a grow is refused when
+  the donation would OOM the donor, not just the taker.
 - **autoscaling** — a background evaluator polls each tenant's
   :class:`~mxnet_tpu.observability.tracing.SLOTracker` fast-window burn
   rate plus queue depth and breaker state, and moves chips from
@@ -302,6 +312,19 @@ class FleetController:
                                chips, total=self.total_chips)
         if chips == old:
             return plan                     # placement already satisfied
+        memchk = self._memory_check({model: chips})
+        if not memchk["ok"]:
+            from .errors import MemoryBudgetExceeded
+            v = memchk["violations"][0]
+            detail = ("at %d chip(s) the model needs ~%d bytes/chip but "
+                      "the HBM budget is %d — shrink the ladder, raise "
+                      "MXNET_HBM_BYTES, or free a tenant"
+                      % (v["chips"], v["need_bytes"], v["budget_bytes"]))
+            self._refuse(model, "no_memory", detail)
+            self.server._count_mem_refusal("no_memory")
+            raise MemoryBudgetExceeded(
+                "fleet resize of %r to %d chip(s) refused: %s"
+                % (model, chips, detail))
         t0 = time.perf_counter()
         # quiesce: the worker holds dispatch_mutex for the length of one
         # dispatch, so acquiring it here means the in-flight batch has
@@ -509,6 +532,25 @@ class FleetController:
                         "under-burning tenant can give without "
                         "breaching its floor/dwell" % (need, freed)))
                     break
+            proposed = {taker: target}
+            if donor is not None:
+                proposed[donor[0]] = donor[1]
+            memchk = self._memory_check(proposed)
+            if not memchk["ok"]:
+                # the taker's grow SPREADS its footprint, but a donor's
+                # shrink CONCENTRATES the donor's — either side failing
+                # the post-state budget refuses the whole reallocation
+                # before any rebind (no thrash, no device OOM)
+                v = memchk["violations"][0]
+                self.server._count_mem_refusal("no_memory")
+                actions.append(self._refuse(
+                    taker, "no_memory",
+                    "post-resize placement does not fit the per-chip HBM "
+                    "budget: %r would need ~%d bytes/chip at %d chip(s) "
+                    "against a budget of %d — not attempted"
+                    % (v["model"], v["need_bytes"], v["chips"],
+                       v["budget_bytes"])))
+                break
             if donor is not None:
                 self.resize(donor[0], donor[1], reason="autoscale:donate")
                 actions.append({"action": "shrink", "model": donor[0],
@@ -600,6 +642,32 @@ class FleetController:
             return list(self._history)
 
     # -------------------------------------------------------------- helpers
+    def _memory_check(self, proposed: Dict[str, int]) -> Dict[str, Any]:
+        """Post-state HBM verdict for a proposed placement change.
+
+        ``proposed`` maps model -> new chip count; only the models whose
+        assignment changes are checked (tenants never share a chip, so an
+        untouched tenant's per-chip need is unchanged). Unbudgeted
+        devices — no ``MXNET_HBM_BYTES``, unknown device kind, no chaos
+        pressure — always pass: refusals need a configured budget, never
+        a guess. Footprint estimation failures skip that model rather
+        than block the operation (accounting must not take the fleet
+        down)."""
+        from ..observability import memwatch as _memwatch
+        if _memwatch.hbm_budget_bytes() is None:
+            return {"ok": True, "violations": []}
+        assignments: Dict[str, Any] = {}
+        for m, chips in proposed.items():
+            st = self.server._models[m]
+            try:
+                fp = _memwatch.model_footprint(st.cache, model=m)
+            except Exception as e:
+                logger.warning("fleet memory check: footprint of %r "
+                               "unavailable (%r) — skipping it", m, e)
+                continue
+            assignments[m] = (fp, int(chips))
+        return _memwatch.fleet_memory_check(assignments)
+
     def _record(self, action: Dict[str, Any]) -> None:
         action = dict(action)
         action["time"] = time.time()

@@ -47,10 +47,12 @@ import numpy as np
 
 from ..analysis.lockwatch import make_lock
 from ..base import MXNetError, get_env, logger, register_config
+from ..observability import memwatch as _memwatch
 from ..observability import tracing as _tracing
 from .breaker import CircuitBreaker
 from .errors import (CircuitOpen, DeadlineExceeded, Draining, ExecutorFault,
-                     Overloaded, Preempted, QuotaExceeded, ServingError)
+                     MemoryBudgetExceeded, Overloaded, Preempted,
+                     QuotaExceeded, ServingError)
 from .executors import BucketExecutorCache, default_buckets
 from .queueing import BoundedRequestQueue
 
@@ -260,7 +262,7 @@ class _ModelState:
             cfg.symbol_json, cfg.param_bytes, input_name=cfg.input_name,
             feature_shape=cfg.feature_shape, buckets=cfg.buckets,
             dev_type=cfg.dev_type, dev_id=cfg.dev_id,
-            output_keys=cfg.output_keys)
+            output_keys=cfg.output_keys, model=cfg.name)
         self.breaker = CircuitBreaker(cfg.breaker_threshold,
                                       cfg.breaker_cooldown_s)
         # declared SLO -> rolling burn-rate guard (tracing.SLOTracker);
@@ -307,10 +309,32 @@ class ModelServer:
         # exemplar lookups see every server in the process
         self.tracer = tracer if tracer is not None else _tracing.get_tracer()
         self._models: Dict[str, _ModelState] = {}
+        # memory-aware admission at LOAD time: with a per-chip HBM budget
+        # configured (memwatch: MXNET_HBM_BYTES or a known device), a
+        # model whose estimated footprint does not fit what the already-
+        # accepted models leave is refused typed here — never OOMed onto
+        # the chip mid-traffic. No budget (the CPU default) = no check.
+        budget = _memwatch.hbm_budget_bytes()
+        used = 0
         for cfg in models:
             if cfg.name in self._models:
                 raise MXNetError("duplicate model name %r" % cfg.name)
-            self._models[cfg.name] = _ModelState(cfg)
+            st = _ModelState(cfg)
+            if budget is not None:
+                fp = _memwatch.model_footprint(st.cache, model=cfg.name)
+                need = _memwatch.per_chip_bytes(fp, st.cache.chips)
+                avail = (int(budget)
+                         - int(_memwatch.pressure()["ballast_bytes"]) - used)
+                if need > avail:
+                    self._count_mem_refusal("load")
+                    raise MemoryBudgetExceeded(
+                        "model %r needs ~%d bytes/chip but only %d of the "
+                        "%d-byte HBM budget remain (loaded models hold %d); "
+                        "shrink the bucket ladder, raise MXNET_HBM_BYTES, "
+                        "or serve it elsewhere"
+                        % (cfg.name, need, max(0, avail), int(budget), used))
+                used += need
+            self._models[cfg.name] = st
         self._drain_on_preemption = bool(drain_on_preemption)
         # multi-tenant fleet controller (serving/fleet.py), attached via
         # FleetController(server=...); None (the default) = fleet mode
@@ -704,14 +728,27 @@ class ModelServer:
                            "(attempt %d), retrying in %.3fs: %r",
                            st.cfg.name, i + 1, delay, exc)
 
-        return retry_transient(lambda: st.cache.run(arr),
-                               attempts=st.cfg.retries + 1,
-                               base_delay=0.01, max_delay=0.5,
-                               on_retry=on_retry)
+        try:
+            return retry_transient(lambda: st.cache.run(arr),
+                                   attempts=st.cfg.retries + 1,
+                                   base_delay=0.01, max_delay=0.5,
+                                   on_retry=on_retry)
+        except Exception as e:
+            # the serving dispatch boundary: a device RESOURCE_EXHAUSTED
+            # leaves forensics (mxtpu_oom.json, blame table) and becomes
+            # typed HBMExhausted; everything else passes through
+            oom = _memwatch.to_hbm_exhausted(e, context="serving",
+                                             server=self,
+                                             model=st.cfg.name)
+            if oom is not None:
+                raise oom from e
+            raise
 
     @staticmethod
-    def _fault(e: BaseException) -> ServingError:
-        if isinstance(e, ServingError):
+    def _fault(e: BaseException) -> MXNetError:
+        # HBMExhausted stays typed through the future: the client must be
+        # able to tell "the chip is out of memory" from a poison request
+        if isinstance(e, (ServingError, _memwatch.HBMExhausted)):
             return e
         return ExecutorFault("executor failed: %r" % (e,))
 
@@ -815,6 +852,13 @@ class ModelServer:
             from ..observability import catalog as _c
             _c.SERVE_QUEUE_DEPTH.set(st.queue.depth, model=st.cfg.name)
 
+    @staticmethod
+    def _count_mem_refusal(reason: str) -> None:
+        from ..observability import metrics as _m
+        if _m.enabled():
+            from ..observability import catalog as _c
+            _c.MEM_REFUSALS.inc(reason=reason)
+
     # ------------------------------------------------------------- surface
     def models(self) -> List[str]:
         return sorted(self._models)
@@ -843,6 +887,7 @@ class ModelServer:
                             "sample": st.cfg.trace_sample,
                             "ring_depth": self.tracer.depth},
             }
+        out["memory"] = _memwatch.model_footprint(st.cache, model=model)
         if st.slo is not None:
             out["slo"] = st.slo.snapshot()
         if self._fleet is not None:

@@ -34,6 +34,7 @@ import os
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from ..base import MXNetError, get_env, logger, register_config
+from ..observability import memwatch as _memwatch
 from ..observability import metrics as _metrics
 from ..observability import xcost as _xcost
 from . import ladder as _ladder
@@ -429,6 +430,20 @@ def tune(build: Callable[[Candidate], Tuple[Any, Any]],
                         "batch": cand.batch,
                         "layout": cand.layout + ("+s2d" if cand.s2d
                                                  else "")})
+            # memory column: the candidate's resident footprint (params +
+            # opt-state + batch), estimated host-side off the live trainer
+            # lower() just materialized — the predicted-OOM gate below and
+            # mxmem's blame ranking read it back from the ledger row
+            try:
+                fp = trainer.footprint()
+                batch_bytes = sum(int(getattr(a, "nbytes", 0) or 0)
+                                  for a in (x, y))
+                row["footprint"] = fp
+                row["footprint_bytes"] = (int(fp["per_chip_bytes"])
+                                          + batch_bytes // max(1, n_devices))
+            except Exception as e:
+                logger.warning("tuner: candidate %s footprint estimate "
+                               "failed: %r", cand.label, e)
             t.cost_row = row
             led.append(row)
             # the built trainer is NOT kept: a wide space would otherwise
@@ -503,6 +518,29 @@ def tune(build: Callable[[Candidate], Tuple[Any, Any]],
                     led.append(adopted)
                     t.cost_row = adopted
                     _count_trial("cached")
+                    continue
+            # predicted-OOM gate: a candidate whose estimated footprint
+            # exceeds the per-chip HBM budget is skipped LOUDLY before a
+            # single buffer lands — measuring it would OOM the search on
+            # the real device. Unbudgeted (budget None) measures as ever.
+            need = int((t.cost_row or {}).get("footprint_bytes") or 0)
+            budget = _memwatch.hbm_budget_bytes()
+            if budget is not None and need:
+                avail = (int(budget)
+                         - int(_memwatch.pressure()["ballast_bytes"]))
+                if need > avail:
+                    t.error = ("predicted OOM: footprint ~%d bytes/chip "
+                               "over the %d-byte HBM budget — not "
+                               "measured" % (need, avail))
+                    logger.error("tuner: candidate %s SKIPPED (%s)",
+                                 t.candidate.label, t.error)
+                    flagged = dict(t.cost_row)
+                    flagged["predicted_oom"] = True
+                    led.append(flagged)
+                    t.cost_row = flagged
+                    if _metrics.enabled():
+                        from ..observability import catalog as _catalog
+                        _catalog.MEM_REFUSALS.inc(reason="predicted_oom")
                     continue
             trainer = net = m = None
             try:

@@ -1,0 +1,449 @@
+"""HBM memory observability (observability/memwatch.py + the serving
+spine's memory-aware placement): footprint ledger round-trip, live
+accounting with the CPU-synthetic fallback, budget math, typed refusals
+at load/resize/autoscale time, OOM forensics — and THE chaos acceptance
+test: a two-tenant fleet under synthetic HBM pressure refuses to grow
+the burning tenant (typed ``no_memory``, zero device OOMs), and a forced
+RESOURCE_EXHAUSTED produces an ``mxtpu_oom.json`` postmortem naming the
+real top holder."""
+import json
+import os
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, parallel
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.observability import catalog, memwatch, xcost
+from mxnet_tpu.serving import (FleetController, ModelConfig, ModelServer,
+                               ServingEndpoints, TenantPolicy)
+from mxnet_tpu.serving import chaos as schaos
+from mxnet_tpu.serving import load as sload
+from mxnet_tpu.serving.errors import MemoryBudgetExceeded
+
+pytestmark = pytest.mark.mem
+
+GiB = 1024 ** 3
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    return sload.tiny_model()
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_pressure():
+    """Chaos pressure is process-global state; never leak it across tests."""
+    yield
+    memwatch.set_pressure()
+
+
+def _cfg(tiny, name, **kw):
+    sym_json, pbytes, feat, _ = tiny
+    d = dict(feature_shape=feat, buckets=(1, 2, 4, 8), max_queue=16,
+             deadline_ms=2000.0, max_wait_ms=2.0, slo_p99_ms=200.0)
+    d.update(kw)
+    return ModelConfig(name, sym_json, pbytes, **d)
+
+
+def _fleet2(tiny, total=3, *, a=None, b=None, start=False, **fkw):
+    server = ModelServer([_cfg(tiny, "a"), _cfg(tiny, "b")],
+                         drain_on_preemption=False)
+    fleet = FleetController(
+        server, total,
+        [TenantPolicy("a", **(a or {})),
+         TenantPolicy("b", chips=2, **(b or {}))], **fkw)
+    if start:
+        server.start(warm=True)
+    return server, fleet
+
+
+class _FakeCache:
+    """Just enough executor-cache surface for footprint math."""
+
+    def __init__(self, params=370, feat=(6,), buckets=(1, 2, 4), chips=1):
+        self._param_bytes = b"x" * params
+        self.feature_shape = feat
+        self.buckets = tuple(buckets)
+        self.chips = chips
+
+
+# --------------------------------------------------------------- budget math
+def test_capacity_table_and_budget_priority(monkeypatch):
+    assert memwatch.hbm_capacity_bytes("TPU v4") == 32 * GiB
+    assert memwatch.hbm_capacity_bytes("TPU v5 lite") == 16 * GiB
+    assert memwatch.hbm_capacity_bytes("TPU v5p chip") == 95 * GiB
+    assert memwatch.hbm_capacity_bytes("cpu") is None
+    assert memwatch.hbm_capacity_bytes(None) is None
+
+    # env override beats the (unknown-device) table ...
+    monkeypatch.setenv("MXNET_HBM_BYTES", "1000")
+    assert memwatch.hbm_budget_bytes("cpu") == 1000
+    # ... and chaos pressure beats the env
+    memwatch.set_pressure(budget_bytes=77)
+    assert memwatch.hbm_budget_bytes("cpu") == 77
+    memwatch.set_pressure()
+    assert memwatch.hbm_budget_bytes("cpu") == 1000
+    monkeypatch.delenv("MXNET_HBM_BYTES")
+    assert memwatch.hbm_budget_bytes("cpu") is None
+
+
+def test_is_oom_markers_and_chains():
+    assert memwatch.is_oom(RuntimeError(
+        "RESOURCE_EXHAUSTED: Out of memory allocating 123 bytes"))
+    assert memwatch.is_oom(ValueError("allocation failure on device 0"))
+    assert not memwatch.is_oom(ValueError("shape mismatch"))
+    # the marker may live anywhere on the cause chain
+    try:
+        try:
+            raise RuntimeError("RESOURCE_EXHAUSTED: oom")
+        except RuntimeError as inner:
+            raise ValueError("wrapper") from inner
+    except ValueError as outer:
+        assert memwatch.is_oom(outer)
+
+
+def test_to_hbm_exhausted_writes_postmortem_once(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_TELEMETRY", "1")
+    monkeypatch.setenv("MXNET_OOM_DIR", str(tmp_path))
+    raw = RuntimeError("RESOURCE_EXHAUSTED: Out of memory while trying to "
+                       "allocate 9999 bytes")
+    err = memwatch.to_hbm_exhausted(raw, context="unit")
+    assert isinstance(err, memwatch.HBMExhausted)
+    assert err.postmortem and os.path.exists(err.postmortem)
+    doc = json.load(open(err.postmortem))
+    assert doc["kind"] == "mxtpu_oom" and doc["context"] == "unit"
+    assert "RESOURCE_EXHAUSTED" in doc["exception"]
+
+    # not an OOM -> None (caller re-raises the original untouched)
+    assert memwatch.to_hbm_exhausted(ValueError("nope"), context="unit") is None
+    # already classified (anywhere on the chain) -> None: the INNER
+    # boundary wrote the forensics; an outer layer must not overwrite them
+    assert memwatch.to_hbm_exhausted(err, context="outer") is None
+    try:
+        raise RuntimeError("wrapper") from err
+    except RuntimeError as wrapped:
+        assert memwatch.to_hbm_exhausted(wrapped, context="outer") is None
+
+
+# ---------------------------------------------------------- live accounting
+def test_synthetic_live_accounting(monkeypatch):
+    monkeypatch.setenv("MXNET_TELEMETRY", "1")
+    tree = {"w": np.zeros((10, 10), np.float32)}      # 400 bytes
+    memwatch.track("t_unit", tree)
+    try:
+        assert memwatch.live_set_bytes()["t_unit"] == 400
+        memwatch.track("t_unit", lambda: 123)         # re-register replaces
+        assert memwatch.live_set_bytes()["t_unit"] == 123
+
+        memwatch.set_pressure(ballast_bytes=1000)
+        # a device WITHOUT memory_stats() forces the synthetic path
+        snap = memwatch.poll_hbm(devices=[object()])
+        assert snap["synthetic"] is True
+        assert snap["total_bytes_in_use"] >= 1123     # live set + ballast
+        assert snap["live_sets"]["ballast"] == 1000
+        dev = snap["devices"][0]
+        assert dev["peak_bytes"] >= dev["bytes_in_use"]
+        # gauges and the watermark ring moved
+        assert catalog.HBM_PEAK_BYTES.value() >= snap["peak_bytes"]
+        assert memwatch.watermark_history(1)[-1]["bytes_in_use"] \
+            == snap["total_bytes_in_use"]
+    finally:
+        memwatch.untrack("t_unit")
+    assert "t_unit" not in memwatch.live_set_bytes()
+
+
+def test_broken_live_set_reports_zero():
+    def boom():
+        raise RuntimeError("provider died")
+    memwatch.track("t_boom", boom)
+    try:
+        assert memwatch.live_set_bytes()["t_boom"] == 0
+    finally:
+        memwatch.untrack("t_boom")
+
+
+# ------------------------------------------------------------ memory ledger
+def test_ledger_row_roundtrip_and_top(tmp_path):
+    import jax
+    import jax.numpy as jnp
+    led = xcost.CostLedger(str(tmp_path / "ledger.jsonl"))
+    lowered = jax.jit(lambda x: jnp.dot(x, x.T)).lower(
+        jnp.zeros((8, 6), jnp.float32))
+    row = memwatch.record_executable(lowered, label="unit_dot",
+                                     extra={"model": "m", "bucket": 8},
+                                     ledger=led)
+    assert row is not None and row["label"] == "memory"
+    mem = row["memory"]
+    for k in ("argument_bytes", "output_bytes", "temp_bytes"):
+        assert k in mem
+    assert row["peak_memory_bytes"] == (mem["temp_bytes"]
+                                        + mem["argument_bytes"]
+                                        + mem["output_bytes"])
+    # round-trips through the JSONL file, filtered by model
+    assert len(memwatch.memory_rows(ledger=led, model="m")) == 1
+    assert memwatch.memory_rows(ledger=led, model="ghost") == []
+    # same program recorded twice: latest-per-fingerprint dedup
+    memwatch.record_executable(lowered, label="unit_dot", ledger=led)
+    assert len(memwatch.memory_rows(ledger=led)) == 2
+    assert len(memwatch.top_executables(ledger=led)) == 1
+
+
+# ---------------------------------------------------------------- footprints
+def test_footprint_math_and_placement():
+    cache = _FakeCache()            # 370B params, (6,) f32, ladder (1,2,4)
+    led = xcost.CostLedger("/nonexistent/never_written.jsonl")
+    fp = memwatch.model_footprint(cache, model="m", ledger=led)
+    # analytic per-bucket batch bytes: b * 6 * 4
+    assert fp["params_bytes"] == 370 and fp["estimated"] is True
+    assert fp["buckets"]["1"] == {"bytes": 24, "source": "estimate"}
+    assert fp["buckets"]["4"] == {"bytes": 96, "source": "estimate"}
+    assert fp["total_bytes"] == 370 + 24 + 48 + 96
+
+    # params replicate per chip, the rest splits row-wise (ceil)
+    assert memwatch.per_chip_bytes(fp, 1) == 538
+    assert memwatch.per_chip_bytes(fp, 2) == 370 + 84
+    assert memwatch.per_chip_bytes(fp, 4) == 370 + 42
+
+    memwatch.set_pressure(budget_bytes=500)
+    v = memwatch.placement_check(fp, 1)
+    assert v == {"ok": False, "need_bytes": 538, "budget_bytes": 500,
+                 "reason": "no_memory"}
+    assert memwatch.placement_check(fp, 2)["ok"]    # 454 fits under 500
+    # ballast shrinks what is actually available
+    memwatch.set_pressure(budget_bytes=500, ballast_bytes=100)
+    assert not memwatch.placement_check(fp, 2)["ok"]
+    memwatch.set_pressure()
+    # unbudgeted (CPU default): refusals are off, never guessed
+    assert memwatch.placement_check(fp, 1) == {
+        "ok": True, "need_bytes": 538, "budget_bytes": None, "reason": None}
+
+    memwatch.set_pressure(budget_bytes=500)
+    chk = memwatch.fleet_memory_check({"a": (fp, 1), "b": (fp, 2)})
+    assert not chk["ok"]
+    assert [v["model"] for v in chk["violations"]] == ["a"]
+
+
+def test_perfwatch_normalizes_memory_rows():
+    """Satellite: the regression watchdog guards memory like throughput —
+    memory rows normalize to peak_bytes with higher-is-worse direction."""
+    from mxnet_tpu.observability import perfwatch
+    row = {"label": "memory", "mem_label": "serve:m:b4", "model": "m",
+           "bucket": 4, "fingerprint": "f1", "peak_memory_bytes": 4096,
+           "memory": {"argument_bytes": 1024, "output_bytes": 1024,
+                      "temp_bytes": 2048}}
+    norm = perfwatch.normalize(row)
+    assert norm["kind"] == "memory_row"
+    assert norm["metrics"]["peak_bytes"] == 4096.0
+    grown = dict(norm, metrics={"peak_bytes": 8192.0})
+    cmp = perfwatch.compare(grown, norm)      # current vs baseline
+    assert cmp["status"] == "regression"      # 2x peak IS the regression
+    assert perfwatch.compare(norm, grown)["status"] == "ok"
+
+
+# ------------------------------------------------- typed placement refusals
+def test_server_load_refused_over_budget(tiny):
+    with schaos.hbm_pressure(budget_bytes=600):
+        # one tiny model fits ...
+        srv = ModelServer([_cfg(tiny, "a")])
+        # ... but a second one must be refused typed at LOAD time: the
+        # budget is per chip and both tenants' footprints land on it
+        before = catalog.MEM_REFUSALS.value(reason="load")
+        with pytest.raises(MemoryBudgetExceeded) as ei:
+            ModelServer([_cfg(tiny, "a"), _cfg(tiny, "b")])
+        assert "HBM budget" in str(ei.value)
+        assert catalog.MEM_REFUSALS.value(reason="load") == before + 1
+        del srv
+    # unbudgeted: the same construction is not even checked
+    ModelServer([_cfg(tiny, "a"), _cfg(tiny, "b")])
+
+
+def test_fleet_resize_refusal_manual_and_http(tiny):
+    server, fleet = _fleet2(tiny, total=4, start=True)
+    ep = ServingEndpoints(server, port=0).start()
+    base = "http://127.0.0.1:%d" % ep.port
+    try:
+        with schaos.hbm_pressure(budget_bytes=300):
+            # growing "a" to 2 chips needs ~326B/chip (206B params
+            # replicated + half the ladder) -> typed refusal, loud
+            # history entry, counter bump, NO chip moved
+            before = catalog.MEM_REFUSALS.value(reason="no_memory") or 0
+            with pytest.raises(MemoryBudgetExceeded):
+                fleet.resize("a", 2)
+            assert fleet.chips("a") == 1
+            h = fleet.history()[-1]
+            assert h["action"] == "refused" and h["reason"] == "no_memory"
+            assert catalog.MEM_REFUSALS.value(reason="no_memory") \
+                == before + 1
+            # the same refusal over HTTP is a 409 with the typed name
+            req = urllib.request.Request(
+                base + "/fleetz/resize",
+                data=json.dumps({"model": "a", "chips": 2}).encode(),
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=30)
+            assert ei.value.code == 409
+            assert json.loads(ei.value.read())["type"] \
+                == "MemoryBudgetExceeded"
+        # pressure lifted: the identical resize proceeds
+        plan = fleet.resize("a", 2)
+        assert plan["direction"] == "grow" and fleet.chips("a") == 2
+    finally:
+        ep.stop()
+        fleet.detach()
+        server.close(timeout=10.0)
+
+
+def test_autoscaler_refuses_no_memory(tiny):
+    clock = [100.0]
+    server, fleet = _fleet2(tiny, total=4, clock=lambda: clock[0],
+                            dwell_s=0.0, min_events=1)
+    try:
+        # a free chip exists: capacity is provably NOT the problem
+        for _ in range(30):
+            server._models["a"].slo.record(1000.0)
+        with schaos.hbm_pressure(budget_bytes=300):
+            before = catalog.MEM_REFUSALS.value(reason="no_memory") or 0
+            actions = fleet.evaluate()
+            assert [(a["action"], a["reason"]) for a in actions] \
+                == [("refused", "no_memory")]
+            assert catalog.MEM_REFUSALS.value(reason="no_memory") \
+                == before + 1
+        assert fleet.chips("a") == 1 and fleet.chips("b") == 2
+    finally:
+        fleet.detach()
+        server.close(timeout=10.0)
+
+
+# -------------------------------------------------------------- OOM forensics
+def test_predict_oom_writes_postmortem(tiny, tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_TELEMETRY", "1")
+    monkeypatch.setenv("MXNET_OOM_DIR", str(tmp_path))
+    _, _, feat, _ = tiny
+    srv = ModelServer([_cfg(tiny, "m")]).start(warm=True)
+    try:
+        before = catalog.OOM_TOTAL.value(context="serving")
+        with schaos.oom_executor(srv, "m", faults=1) as st:
+            with pytest.raises(memwatch.HBMExhausted) as ei:
+                srv.predict("m", np.zeros(feat, np.float32))
+            assert st["oomed"] == 1
+        assert catalog.OOM_TOTAL.value(context="serving") == before + 1
+        pm = ei.value.postmortem
+        assert pm and os.path.exists(pm)
+        doc = json.load(open(pm))
+        assert doc["kind"] == "mxtpu_oom" and doc["context"] == "serving"
+        assert doc["model"] == "m"
+        assert doc["buckets"]["m"]["ladder"] == [1, 2, 4, 8]
+        assert any(h["holder"] == "model:m" for h in doc["blame"])
+        # the injector restored the executor: traffic flows again
+        srv.predict("m", np.zeros(feat, np.float32))
+    finally:
+        srv.close(timeout=10.0)
+
+
+# ------------------------------------------------------------ HLO invariance
+def test_step_hlo_identical_with_memwatch_on_off(monkeypatch, tmp_path):
+    """Acceptance guard: memory capture must never enter the trace — the
+    fused step lowered with MXNET_MEM_CAPTURE/budget config on and off
+    produces bitwise-identical StableHLO."""
+    import jax
+
+    def _make_net(prefix):
+        mx.random.seed(11)
+        net = nn.HybridSequential(prefix=prefix)
+        net.add(nn.Dense(8, activation="relu", prefix=prefix + "d0_"),
+                nn.Dense(3, prefix=prefix + "d1_"))
+        net.initialize(mx.init.Xavier())
+        return net
+
+    def lowered_text(prefix):
+        rng_np = np.random.RandomState(42)
+        x = rng_np.randn(16, 6).astype("f4")
+        y = rng_np.randint(0, 3, (16,)).astype("f4")
+        t = parallel.DataParallelTrainer(
+            _make_net(prefix), gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+            {"learning_rate": 0.1}, grad_guard=True)
+        t._capture(2, sample_arrays=[x, y])
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        spec = NamedSharding(t._mesh, P(t._axis))
+        ax = [jax.device_put(a, spec) for a in (x, y)]
+        rng = jax.random.PRNGKey(0)
+        return t._step_fn.lower(t._params, t._aux, t._opt_state,
+                                t._guard_state, rng, *ax).as_text()
+
+    monkeypatch.setenv("MXNET_TELEMETRY", "1")
+    monkeypatch.setenv("MXNET_PERF_LEDGER", str(tmp_path / "led.jsonl"))
+    monkeypatch.setenv("MXNET_MEM_CAPTURE", "1")
+    monkeypatch.setenv("MXNET_HBM_BYTES", str(10 * GiB))
+    on = lowered_text("memhlo_")
+    monkeypatch.setenv("MXNET_MEM_CAPTURE", "0")
+    monkeypatch.delenv("MXNET_PERF_LEDGER")
+    monkeypatch.delenv("MXNET_HBM_BYTES")
+    off = lowered_text("memhlo_")   # same prefix/seed => same param names
+    assert on == off
+
+
+# ----------------------------------------------------------- THE acceptance
+@pytest.mark.chaos
+def test_hbm_pressure_acceptance(tiny, tmp_path, monkeypatch):
+    """THE acceptance test: a two-tenant fleet under synthetic HBM
+    pressure (a) refuses to grow the burning tenant with a typed
+    ``no_memory`` instead of thrashing chips or OOMing the device, and
+    (b) when an executor DOES hit RESOURCE_EXHAUSTED, serving answers
+    with a typed HBMExhausted whose postmortem names the real top
+    holder — all proven from counters and the artifact."""
+    monkeypatch.setenv("MXNET_TELEMETRY", "1")
+    monkeypatch.setenv("MXNET_OOM_DIR", str(tmp_path))
+    sym_a, pb_a, feat, _ = sload.tiny_model(0, 6, 8)
+    sym_big, pb_big, _, _ = sload.tiny_model(1, 6, 32)   # the real hog
+    kw = dict(feature_shape=feat, buckets=(1, 2, 4), max_queue=16,
+              deadline_ms=2000.0, max_wait_ms=2.0, slo_p99_ms=200.0)
+    server = ModelServer([ModelConfig("a", sym_a, pb_a, **kw),
+                          ModelConfig("big", sym_big, pb_big, **kw)],
+                         drain_on_preemption=False)
+    clock = [100.0]
+    fleet = FleetController(server, 4,
+                            [TenantPolicy("a"), TenantPolicy("big", chips=2)],
+                            clock=lambda: clock[0], dwell_s=0.0, min_events=1)
+    server.start(warm=True)
+    try:
+        chips0 = {"a": fleet.chips("a"), "big": fleet.chips("big")}
+        oom0 = catalog.OOM_TOTAL.value(context="serving") or 0
+        # growing "a" to 2 chips needs ~454B/chip (370B params replicated
+        # + half its ladder) — a 450B budget makes that the binding limit
+        with schaos.hbm_pressure(budget_bytes=450):
+            for _ in range(30):
+                server._models["a"].slo.record(1000.0)
+            ref0 = catalog.MEM_REFUSALS.value(reason="no_memory") or 0
+            for _ in range(3):                   # sustained pressure: the
+                clock[0] += 30.0                 # evaluator must not thrash
+                for a in fleet.evaluate():
+                    assert (a["action"], a["reason"]) \
+                        == ("refused", "no_memory")
+            assert catalog.MEM_REFUSALS.value(reason="no_memory") > ref0
+            # no chip ever moved, traffic kept flowing, zero device OOMs
+            assert {"a": fleet.chips("a"), "big": fleet.chips("big")} \
+                == chips0
+            server.predict("a", np.zeros(feat, np.float32))
+            assert catalog.OOM_TOTAL.value(context="serving") == oom0
+
+        # forced allocation failure on the hog: typed error + forensics
+        with schaos.oom_executor(server, "big", faults=1):
+            with pytest.raises(memwatch.HBMExhausted) as ei:
+                server.predict("big", np.zeros(feat, np.float32))
+        doc = json.load(open(ei.value.postmortem))
+        served = [h for h in doc["blame"]
+                  if h["holder"].startswith("model:")]
+        assert served[0]["holder"] == "model:big"   # blame ranks the hog
+        assert served[0]["bytes"] > dict(
+            (h["holder"], h["bytes"]) for h in served)["model:a"]
+        assert catalog.OOM_TOTAL.value(context="serving") == oom0 + 1
+        # the fleet survived the whole episode: both tenants still answer
+        server.predict("a", np.zeros(feat, np.float32))
+        server.predict("big", np.zeros(feat, np.float32))
+    finally:
+        fleet.detach()
+        server.close(timeout=10.0)

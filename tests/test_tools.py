@@ -1304,3 +1304,134 @@ def test_mxrace_report_subcommand(tmp_path):
                         str(tmp_path / "missing.json")],
                        capture_output=True, text=True, timeout=120)
     assert p.returncode == 2
+
+
+# ------------------------------------------------------------------ mxmem
+@pytest.mark.mem
+def test_mxmem_report_cli_matrix(tmp_path):
+    """mxmem report: ledger-only render exits 0, a snapshot with OOM/
+    refusal counters above zero flags trouble (exit 1), --format json
+    round-trips, and unreadable inputs exit 2."""
+    import json as _json
+    cli = os.path.join(REPO, "tools", "mxmem.py")
+    env = {**os.environ, "PYTHONPATH": "",
+           "MXTPU_TUNNEL_REG_DIR": str(tmp_path / "reg")}
+    ledger = tmp_path / "ledger.jsonl"
+    with open(ledger, "w") as f:
+        f.write(_json.dumps({
+            "label": "memory", "mem_label": "serve:m:b4", "model": "m",
+            "bucket": 4, "fingerprint": "f1", "peak_memory_bytes": 4096,
+            "memory": {"argument_bytes": 1024, "output_bytes": 1024,
+                       "temp_bytes": 2048}}) + "\n")
+        f.write("{torn line\n")                       # corrupt: skipped
+        f.write(_json.dumps({"label": "step", "fingerprint": "f2"}) + "\n")
+        f.write(_json.dumps({                          # latest f1 wins
+            "label": "memory", "mem_label": "serve:m:b4", "model": "m",
+            "bucket": 4, "fingerprint": "f1", "peak_memory_bytes": 8192,
+            "memory": {"argument_bytes": 2048, "output_bytes": 2048,
+                       "temp_bytes": 4096}}) + "\n")
+
+    p = subprocess.run([sys.executable, cli, "report", "--ledger",
+                        str(ledger)], capture_output=True, text=True,
+                       timeout=120, env=env)
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "memory ledger (1 executable(s)" in p.stdout
+    assert "serve:m:b4" in p.stdout and "8.00 KiB" in p.stdout
+
+    # a snapshot whose trouble counters moved makes the report exit 1
+    snap = tmp_path / "snap.json"
+    snap.write_text(_json.dumps({"pid": 1, "metrics": {
+        "mxtpu_hbm_bytes_in_use": {"series": [
+            {"labels": {"device": "0"}, "value": 123456}]},
+        "mxtpu_oom_total": {"series": [
+            {"labels": {"context": "serving"}, "value": 1}]},
+        "mxtpu_mem_refusals_total": {"series": [
+            {"labels": {"reason": "no_memory"}, "value": 2}]}}}))
+    p = subprocess.run([sys.executable, cli, "report", str(snap),
+                        "--ledger", str(ledger)], capture_output=True,
+                       text=True, timeout=120, env=env)
+    assert p.returncode == 1, p.stdout + p.stderr
+    assert "mxtpu_hbm_bytes_in_use" in p.stdout
+    assert "mxtpu_oom_total" in p.stdout
+    assert "2 memory-trouble signal(s)" in p.stdout
+
+    p = subprocess.run([sys.executable, cli, "report", "--format", "json",
+                        "--ledger", str(ledger)], capture_output=True,
+                       text=True, timeout=120, env=env)
+    assert p.returncode == 0, p.stdout + p.stderr
+    doc = _json.loads(p.stdout)
+    assert doc["kind"] == "mem" and len(doc["rows"]) == 1
+    assert doc["rows"][0]["peak_memory_bytes"] == 8192
+
+    # nothing loadable -> 2
+    p = subprocess.run([sys.executable, cli, "report", "--ledger",
+                        str(tmp_path / "missing.jsonl")],
+                       capture_output=True, text=True, timeout=120, env=env)
+    assert p.returncode == 2
+    assert "nothing to show" in p.stderr
+
+
+@pytest.mark.mem
+def test_mxmem_postmortem_cli(tmp_path):
+    """mxmem postmortem renders a real memwatch artifact and ALWAYS exits
+    1 (an OOM artifact is the anomaly); non-postmortem JSON exits 2."""
+    import json as _json
+    from mxnet_tpu.observability import memwatch
+    cli = os.path.join(REPO, "tools", "mxmem.py")
+    env = {**os.environ, "PYTHONPATH": "",
+           "MXTPU_TUNNEL_REG_DIR": str(tmp_path / "reg")}
+    pm = str(tmp_path / "mxtpu_oom.json")
+    memwatch.write_postmortem(
+        "unit", exc=RuntimeError("RESOURCE_EXHAUSTED: oom"), path=pm)
+    p = subprocess.run([sys.executable, cli, "postmortem", pm],
+                       capture_output=True, text=True, timeout=120, env=env)
+    assert p.returncode == 1, p.stdout + p.stderr
+    assert "OOM postmortem (unit)" in p.stdout
+    assert "RESOURCE_EXHAUSTED" in p.stdout
+
+    p = subprocess.run([sys.executable, cli, "postmortem", pm,
+                        "--format", "json"], capture_output=True,
+                       text=True, timeout=120, env=env)
+    assert p.returncode == 1
+    assert _json.loads(p.stdout)["doc"]["kind"] == "mxtpu_oom"
+
+    other = tmp_path / "other.json"
+    other.write_text(_json.dumps({"kind": "flight_recorder"}))
+    p = subprocess.run([sys.executable, cli, "postmortem", str(other)],
+                       capture_output=True, text=True, timeout=120, env=env)
+    assert p.returncode == 2
+    assert "not an mxtpu_oom.json" in p.stderr
+
+
+@pytest.mark.mem
+def test_mxtop_mem_view(tmp_path):
+    """`mxtop mem` is the same report surface, reached from the fleet
+    operator's muscle-memory entry point."""
+    import json as _json
+    cli = os.path.join(REPO, "tools", "mxtop.py")
+    env = {**os.environ, "PYTHONPATH": "",
+           "MXTPU_TUNNEL_REG_DIR": str(tmp_path / "reg")}
+    ledger = tmp_path / "ledger.jsonl"
+    ledger.write_text(_json.dumps({
+        "label": "memory", "mem_label": "train_step", "fingerprint": "f9",
+        "peak_memory_bytes": 1 << 20,
+        "memory": {"argument_bytes": 1 << 18, "output_bytes": 1 << 18,
+                   "temp_bytes": 1 << 19}}) + "\n")
+    p = subprocess.run([sys.executable, cli, "mem", "--ledger",
+                        str(ledger)], capture_output=True, text=True,
+                       timeout=120, env=env)
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "mxmem — HBM memory report" in p.stdout
+    assert "train_step" in p.stdout and "1.00 MiB" in p.stdout
+
+
+@pytest.mark.mem
+def test_mxmem_registered_with_tunnel_session():
+    """mxmem joins the tunnel-client registry on BOTH sides (MARKERS +
+    bench.py's /proc scan) and self-registers in main()."""
+    import tunnel_session
+    bench_src = open(os.path.join(REPO, "bench.py")).read()
+    assert "mxmem.py" in tunnel_session.MARKERS
+    assert "mxmem.py" in bench_src
+    tool_src = open(os.path.join(REPO, "tools", "mxmem.py")).read()
+    assert 'tunnel_session.register("mxmem.py"' in tool_src

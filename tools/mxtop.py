@@ -26,6 +26,7 @@ Usage::
     python tools/mxtop.py --tail 20 flight.json        # more records
     python tools/mxtop.py perf --ledger mxtpu_cost_ledger.jsonl
     python tools/mxtop.py perf /run/metrics.json --watch 2
+    python tools/mxtop.py mem --ledger mxtpu_cost_ledger.jsonl
 
 Exit codes (mxlint convention): 0 = healthy, 1 = the artifact shows
 anomalies (a crash-reason flight dump, grad-skip/verify-failure/watchdog/
@@ -313,10 +314,12 @@ def main(argv=None) -> int:
         return _perf_main(argv[1:])
     if argv and argv[0] == "trace":
         return _trace_main(argv[1:])
+    if argv and argv[0] == "mem":
+        return _mem_main(argv[1:])
     ap = argparse.ArgumentParser(
         description="pretty-print mxnet_tpu telemetry snapshots and "
                     "flight recordings (see also: mxtop.py perf, "
-                    "mxtop.py trace)")
+                    "mxtop.py trace, mxtop.py mem)")
     ap.add_argument("path", help="metrics snapshot JSON or flight-recorder "
                                  "dump JSON")
     ap.add_argument("--format", choices=("text", "json"), default="text")
@@ -378,6 +381,42 @@ def _trace_main(argv) -> int:
     if args.watch > 0:
         return _watch_loop(render, args.watch)
     return render()
+
+
+def _mem_main(argv) -> int:
+    """`mxtop.py mem` — the memory-ledger summary view (label="memory"
+    rows ranked by peak + live mxtpu_hbm_* gauges). The full toolbox
+    (postmortem rendering, watch, blame ranking) is tools/mxmem.py; this
+    is the at-a-glance row next to mxtop's other views."""
+    ap = argparse.ArgumentParser(
+        prog="mxtop.py mem",
+        description="memory-ledger rows + live HBM gauges (see "
+                    "tools/mxmem.py for postmortems and blame)")
+    ap.add_argument("snapshot", nargs="?", default=None,
+                    help="telemetry snapshot JSON (write_snapshot / "
+                         "MXNET_TELEMETRY_EXPORT output)")
+    ap.add_argument("--ledger", default=None,
+                    help="cost-ledger JSONL (MXNET_PERF_LEDGER / "
+                         "mxtpu_cost_ledger.jsonl)")
+    ap.add_argument("--tail", type=int, default=10,
+                    help="executables to show (default 10)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--watch", type=float, metavar="SECONDS", default=0,
+                    help="re-render every N seconds; Ctrl-C to stop")
+    args = ap.parse_args(argv)
+    if not args.snapshot and not args.ledger:
+        ap.error("pass a snapshot and/or --ledger")
+    try:
+        import mxmem
+    except ImportError as e:
+        sys.stderr.write("mxtop mem: cannot import mxmem: %s\n" % e)
+        return 2
+    if args.watch > 0:
+        return _watch_loop(lambda: mxmem.run_report(
+            args.snapshot, args.ledger, args.tail, args.format,
+            sys.stdout), args.watch)
+    return mxmem.run_report(args.snapshot, args.ledger, args.tail,
+                            args.format, sys.stdout)
 
 
 def _perf_main(argv) -> int:
