@@ -70,6 +70,12 @@ Rules (catalog in docs/static_analysis.md):
                                           but no budget configured — the
                                           memory-aware refusal paths are
                                           blind
+* MXL-T219 no-retry-budget      (warning) a serving model enables retries
+                                          and/or hedged requests with no
+                                          retry budget — a correlated
+                                          failure amplifies offered load
+                                          onto the degraded backend
+                                          (retry-storm)
 """
 from __future__ import annotations
 
@@ -218,6 +224,18 @@ register_rule(
     "no_memory refusals, tuner predicted-OOM gate) blind. Set "
     "MXNET_HBM_BYTES (or serve on a device with a known capacity) and "
     "shed a model/shrink a ladder until the placement fits.")
+register_rule(
+    "MXL-T219", "warning", "no-retry-budget",
+    "A serving model enables retries (retries>0) and/or hedged requests "
+    "(hedge=True) but configures no retry budget (retry_budget=0): under "
+    "a correlated failure (a sick chip, a flaky interconnect) every "
+    "request retries and every hedge duplicates, multiplying offered "
+    "load onto the already-degraded backend exactly when it can least "
+    "absorb it — the classic retry-storm amplification. Cap duplicate "
+    "work to a fraction of admitted traffic with "
+    "ModelConfig(retry_budget=) or MXNET_SERVE_RETRY_BUDGET (the "
+    "default 0.1 ≈ 10%; the budget is shared by retries and hedges and "
+    "denials are counted, not silent).")
 register_rule(
     "MXL-T211", "warning", "untuned-hot-loop",
     "The trainer runs with all-default perf levers while the autotuner "
@@ -606,8 +624,8 @@ def lint_data_iter(data_iter, *, suppress: Sequence[str] = (),
 def lint_server(server_or_config, *, suppress: Sequence[str] = (),
                 subject: str = "") -> Report:
     """Lint a serving configuration for overload-safety, observability,
-    tenant isolation and memory budgeting (MXL-T214 / MXL-T215 /
-    MXL-T216 / MXL-T217 / MXL-T218).
+    tenant isolation, memory budgeting and retry hygiene (MXL-T214 /
+    MXL-T215 / MXL-T216 / MXL-T217 / MXL-T218 / MXL-T219).
 
     Accepts a :class:`~mxnet_tpu.serving.server.ModelServer` (every model
     is checked), a :class:`~mxnet_tpu.serving.fleet.FleetController`
@@ -787,6 +805,33 @@ def lint_server(server_or_config, *, suppress: Sequence[str] = (),
                      "MXNET_TRACE_SAMPLE — tail/error traces are always "
                      "retained; docs/observability.md, 'Request "
                      "tracing'"))
+        # ---- no retry budget (MXL-T219): duplicate work (retries and/or
+        # hedges) is enabled but uncapped — a correlated failure turns
+        # every request into several, amplifying offered load onto the
+        # already-degraded backend. Fires/silent discipline: retries=0
+        # and hedge off stays silent, any nonzero retry_budget stays
+        # silent, old-style configs without the attributes stay silent.
+        dup = []
+        if int(getattr(cfg, "retries", 0) or 0) > 0:
+            dup.append("retries=%d" % cfg.retries)
+        if bool(getattr(cfg, "hedge", False)):
+            dup.append("hedge=True")
+        if dup and float(getattr(cfg, "retry_budget", 1.0) or 0.0) <= 0.0:
+            report.add(Diagnostic(
+                "MXL-T219",
+                "model %r duplicates work (%s) with NO retry budget "
+                "(retry_budget=0): under a correlated failure every "
+                "request retries and every hedge duplicates, multiplying "
+                "offered load onto the degraded backend exactly when it "
+                "can least absorb it (retry-storm amplification)"
+                % (cfg.name, ", ".join(dup)),
+                location=loc,
+                hint="cap duplicate work with ModelConfig(retry_budget=) "
+                     "or MXNET_SERVE_RETRY_BUDGET (default 0.1 = 10%% of "
+                     "admitted traffic, shared by retries and hedges; "
+                     "denials are counted in "
+                     "mxtpu_retry_budget_denied_total) — docs/serving.md, "
+                     "'Self-healing & tail tolerance'"))
     # ---- unbudgeted HBM overcommit (MXL-T218): needs the live server
     # (footprints come off its executor caches) — a bare ModelConfig has
     # no cache and stays silent. Fires on evidence only: a budget the

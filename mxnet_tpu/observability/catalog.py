@@ -230,6 +230,38 @@ SERVE_QUEUE_DEPTH = _m.gauge(
     "mxtpu_serve_queue_depth",
     "Requests queued per model at last admission/dispatch, labeled "
     "model=. Pinned at the queue bound = shedding load.")
+SERVE_HEDGES = _m.counter(
+    "mxtpu_serve_hedges_total",
+    "Hedged (duplicate tail-tolerance) dispatches, labeled model= and "
+    "outcome=won|lost|budget_denied (won = the hedge completed the "
+    "request first; lost = the primary beat it or the hedge errored — "
+    "its result dropped; budget_denied = the retry budget refused to "
+    "fund the hedge). won/submitted is the hedge hit rate; a high "
+    "budget_denied rate means hedging wants more budget than the "
+    "configured fraction allows.")
+CHIP_QUARANTINES = _m.counter(
+    "mxtpu_chip_quarantines_total",
+    "Chips quarantined by the device sentinel after a device-fatal "
+    "fault (serving/health.py), labeled reason= (device_lost|enqueue|"
+    "data_loss|probe|other). Each quarantine triggers an automatic "
+    "bucket-ladder re-plan onto the survivors.")
+QUARANTINED_CHIPS = _m.gauge(
+    "mxtpu_quarantined_chips",
+    "Chips currently quarantined by the device sentinel (unlabeled). "
+    "Nonzero = serving on reduced capacity; stuck nonzero past the "
+    "cooldown = the half-open re-admission probe keeps failing.")
+SERVE_DEGRADED_RUNG = _m.gauge(
+    "mxtpu_serve_degraded_rung",
+    "Current rung of the per-model degraded-mode ladder, labeled "
+    "model=: 0 healthy, 1 reduced buckets (biggest dropped), 2 int8 "
+    "tier fallback, 3 guaranteed-traffic-only admission, 4 static shed. "
+    "Edge-triggered: transitions also land in the trace ring.")
+RETRY_BUDGET_DENIED = _m.counter(
+    "mxtpu_retry_budget_denied_total",
+    "Retries or hedges refused because the shared retry budget (default "
+    "~10% of admitted traffic) was exhausted, labeled model= and "
+    "kind=retry|hedge. Denials fail fast and typed — a climbing counter "
+    "under overload is the budget doing its job (no retry storm).")
 
 # ----------------------------------------------------------------- fleet
 FLEET_RESIZES = _m.counter(

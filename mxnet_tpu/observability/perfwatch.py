@@ -59,6 +59,12 @@ METRIC_DIRECTIONS: Dict[str, int] = {
     "int8_acc": +1,            # and so is int8 accuracy drifting down
     "slo_burn_rate": -1,       # serving SLO error-budget burn (max over
                                # model/window series of mxtpu_slo_burn_rate)
+    "degraded_rung": -1,       # self-healing ladder position (max over
+                               # models of mxtpu_serve_degraded_rung):
+                               # any rung above 0 is degraded service
+    "budget_denied": -1,       # retry/hedge duplicates refused by the
+                               # retry budget (sum over model/kind of
+                               # mxtpu_retry_budget_denied_total)
     "peak_bytes": -1,          # memory ledger row (label="memory"): a
                                # fatter executable is a regression
     "footprint_bytes": -1,     # estimated resident bytes/chip (tuner
@@ -112,6 +118,24 @@ def normalize(doc: Any, source: str = "") -> Optional[Dict[str, Any]]:
                 burn = float(v) if burn is None else max(burn, float(v))
         if burn is not None:
             vals["slo_burn_rate"] = burn
+        # degraded rung: worst model wins (labeled model=)
+        rung = None
+        for s in (fams.get("mxtpu_serve_degraded_rung") or {}) \
+                .get("series", []):
+            v = s.get("value")
+            if v is not None:
+                rung = float(v) if rung is None else max(rung, float(v))
+        if rung is not None:
+            vals["degraded_rung"] = rung
+        # budget denials: total duplicate work refused (model=/kind=)
+        denied = None
+        for s in (fams.get("mxtpu_retry_budget_denied_total") or {}) \
+                .get("series", []):
+            v = s.get("value")
+            if v is not None:
+                denied = (denied or 0.0) + float(v)
+        if denied is not None:
+            vals["budget_denied"] = denied
         return {"kind": "snapshot", "source": source, "metrics": vals}
     if "metric" in doc and "value" in doc:
         vals = {"throughput": float(doc["value"])}

@@ -21,7 +21,11 @@ __all__ = ["retry_transient", "is_transient", "backoff_delay",
 # Substrings in an XlaRuntimeError (or generic RuntimeError from the
 # runtime) that mark a transient infrastructure failure rather than a
 # miscompiled/misused program. Mirrors the retryable gRPC status classes.
-_TRANSIENT_MARKERS = ("resource exhausted", "unavailable", "aborted",
+# RESOURCE_EXHAUSTED is deliberately NOT here: an HBM OOM is a capacity
+# fact, not a blip — retrying it re-OOMs the device and masks the typed
+# HBMExhausted classification (memwatch). Same for DEVICE_LOST-class
+# faults: the chip is suspect and must be quarantined, never retried.
+_TRANSIENT_MARKERS = ("unavailable", "aborted",
                       "deadline exceeded", "cancelled", "connection reset",
                       "socket closed", "failed to connect")
 
@@ -29,15 +33,39 @@ _TRANSIENT_MARKERS = ("resource exhausted", "unavailable", "aborted",
 def is_transient(exc: BaseException) -> bool:
     """Heuristic: is this exception worth retrying? TransientKVError /
     TransientIOError always; XLA runtime errors only when they carry a
-    retryable status marker."""
+    retryable status marker — and NEVER when the error is an HBM OOM
+    (``memwatch.is_oom``) or device-fatal (``serving.health
+    .is_device_fatal``): those classes have their own typed fates
+    (refusal / quarantine) and retrying them amplifies the outage."""
     if isinstance(exc, (TransientKVError, TransientIOError)):
         return True
     if isinstance(exc, MXNetError):
         return False            # typed framework errors are deliberate
+    if _is_never_retryable(exc):
+        return False
     name = type(exc).__name__
     if name == "XlaRuntimeError" or isinstance(exc, (OSError, IOError)):
         msg = str(exc).lower()
         return any(m in msg for m in _TRANSIENT_MARKERS)
+    return False
+
+
+def _is_never_retryable(exc: BaseException) -> bool:
+    """OOM / device-fatal screen, imported lazily (observability and
+    serving layer above this one); classifier failures fail open —
+    an unclassifiable error falls through to the marker scan."""
+    try:
+        from ..observability.memwatch import is_oom
+        if is_oom(exc):
+            return True
+    except Exception:
+        pass
+    try:
+        from ..serving.health import is_device_fatal
+        if is_device_fatal(exc):
+            return True
+    except Exception:
+        pass
     return False
 
 
@@ -65,13 +93,17 @@ def retry_transient(fn: Callable, *, attempts: Optional[int] = None,
                     max_delay: Optional[float] = None,
                     retry_on: Optional[Tuple[Type[BaseException], ...]] = None,
                     on_retry: Optional[Callable] = None,
+                    gate: Optional[Callable[[BaseException], bool]] = None,
                     sleep: Callable[[float], None] = time.sleep):
     """Call ``fn()``; on a transient failure, back off and retry.
 
     ``retry_on`` overrides the :func:`is_transient` classifier with an
-    explicit exception allowlist. ``on_retry(attempt_idx, exc, delay)`` is
-    invoked before each sleep (telemetry hook). The final failure is
-    re-raised unchanged.
+    explicit exception allowlist. ``gate(exc)`` — checked after an error
+    classifies as retryable, before sleeping — must return True to spend
+    the retry; False re-raises immediately (the serving retry budget
+    plugs in here so retries can't amplify an overload). ``on_retry
+    (attempt_idx, exc, delay)`` is invoked before each sleep (telemetry
+    hook). The final failure is re-raised unchanged.
     """
     attempts = int(attempts if attempts is not None
                    else get_env("MXNET_RESILIENCE_RETRY_ATTEMPTS", 3))
@@ -89,6 +121,8 @@ def retry_transient(fn: Callable, *, attempts: Optional[int] = None,
                          else is_transient(e))
             if not retryable or i >= attempts - 1:
                 raise
+            if gate is not None and not gate(e):
+                raise           # budget denied: fail now, typed and counted
             delay = delays[i]
             if on_retry is not None:
                 on_retry(i, e, delay)

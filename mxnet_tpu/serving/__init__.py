@@ -35,13 +35,15 @@ import importlib as _importlib
 
 __all__ = ["ModelConfig", "ModelServer", "PendingResult",
            "BucketExecutorCache", "default_buckets", "CircuitBreaker",
-           "BoundedRequestQueue", "TokenBucket", "FairShare",
-           "ServingEndpoints", "FleetController", "TenantPolicy",
+           "BoundedRequestQueue", "TokenBucket", "RetryBudget",
+           "FairShare", "ServingEndpoints", "FleetController",
+           "TenantPolicy", "DeviceSentinel", "DegradedLadder",
            "ServingError", "Overloaded", "DeadlineExceeded", "Draining",
            "CircuitOpen", "ExecutorFault", "QuotaExceeded", "Preempted",
+           "MemoryBudgetExceeded", "ChipQuarantined",
            "run_load", "verdict", "ledger_row", "fleet_row",
            "chaos", "load", "server", "errors", "breaker", "queueing",
-           "executors", "endpoints", "fleet"]
+           "executors", "endpoints", "fleet", "health"]
 
 _lazy_attrs = {
     "ModelConfig": ".server", "ModelServer": ".server",
@@ -49,18 +51,21 @@ _lazy_attrs = {
     "BucketExecutorCache": ".executors", "default_buckets": ".executors",
     "CircuitBreaker": ".breaker",
     "BoundedRequestQueue": ".queueing",
-    "TokenBucket": ".queueing", "FairShare": ".queueing",
+    "TokenBucket": ".queueing", "RetryBudget": ".queueing",
+    "FairShare": ".queueing",
     "ServingEndpoints": ".endpoints",
     "FleetController": ".fleet", "TenantPolicy": ".fleet",
+    "DeviceSentinel": ".health", "DegradedLadder": ".health",
     "ServingError": ".errors", "Overloaded": ".errors",
     "DeadlineExceeded": ".errors", "Draining": ".errors",
     "CircuitOpen": ".errors", "ExecutorFault": ".errors",
     "QuotaExceeded": ".errors", "Preempted": ".errors",
+    "MemoryBudgetExceeded": ".errors", "ChipQuarantined": ".errors",
     "run_load": ".load", "verdict": ".load", "ledger_row": ".load",
     "fleet_row": ".load",
 }
 _lazy_mods = {"chaos", "load", "server", "errors", "breaker", "queueing",
-              "executors", "endpoints", "fleet"}
+              "executors", "endpoints", "fleet", "health"}
 
 
 def __getattr__(name):

@@ -44,6 +44,20 @@ oom_executor        the next N dispatches raise a RESOURCE_EXHAUSTED-
                     shaped allocation failure — drives the OOM forensics
                     path: typed HBMExhausted + mxtpu_oom.json postmortem
                     naming the real top holder
+device_lost         one chip vanishes mid-serve: every dispatch raises a
+                    DEVICE_LOST-shaped error (``.chip_idx`` stamped)
+                    until the sentinel quarantines that chip — then the
+                    executor heals, so the re-planned survivors serve.
+                    THE self-healing scenario (quarantine + rebind +
+                    re-dispatch), self-restoring by construction
+straggler_executor  every K-th dispatch stalls for ``delay_s`` — a tail
+                    straggler the hedged-request path is graded against:
+                    hedges fire off the rolling p99 and the duplicate
+                    wins the race
+quarantine_flap     the sentinel's re-admission probe fails the first N
+                    times — a chip that looks back but isn't: half-open
+                    re-admission must re-arm the cooldown, not flap the
+                    capacity back and forth
 =================  ======================================================
 """
 from __future__ import annotations
@@ -62,7 +76,8 @@ __all__ = ["slow_client", "request_storm", "paced_run", "trace_evidence",
            "slow_executor", "executor_fault", "poison_request",
            "poison_payload", "POISON_SENTINEL",
            "chip_scaled_executor", "tenant_storm",
-           "hbm_pressure", "oom_executor"]
+           "hbm_pressure", "oom_executor",
+           "device_lost", "straggler_executor", "quarantine_flap"]
 
 # a value a legitimate float32 payload never carries (finite, but at the
 # edge of range) — the poison marker the patched executor looks for
@@ -304,6 +319,103 @@ def executor_fault(server, model: str, faults: int = 1,
         yield state
     finally:
         st.cache.run = orig
+
+
+@contextlib.contextmanager
+def device_lost(server, model: str, chip_idx: int = 0):
+    """Chip ``chip_idx`` vanishes: every dispatch for ``model`` raises a
+    DEVICE_LOST-shaped ``RuntimeError`` (with ``.chip_idx`` stamped, the
+    way a sharded runtime names the dead participant) — *until* the
+    device sentinel quarantines that chip. From then on the patched
+    executor passes through, modelling what re-placement actually buys:
+    the survivors work fine, only plans that still include the dead chip
+    fail. Self-restoring by construction — the server heals mid-``with``,
+    no exit required. Yields live ``{"faulted", "passed", "chip"}``."""
+    st = _state(server, model)
+    sentinel = getattr(server, "_sentinel", None)
+    if sentinel is None:
+        raise ChaosError("server has no device sentinel")
+    orig = st.cache.run
+    state = {"faulted": 0, "passed": 0, "chip": int(chip_idx)}
+
+    def run(batch):
+        if not sentinel.is_quarantined(state["chip"]):
+            state["faulted"] += 1
+            err = RuntimeError(
+                "chaos: DEVICE_LOST: chip %d vanished mid-dispatch"
+                % state["chip"])
+            err.chip_idx = state["chip"]
+            raise err
+        state["passed"] += 1
+        return orig(batch)
+
+    st.cache.run = run
+    try:
+        yield state
+    finally:
+        st.cache.run = orig
+
+
+@contextlib.contextmanager
+def straggler_executor(server, model: str, delay_s: float, every: int = 2):
+    """Every ``every``-th dispatch for ``model`` stalls an extra
+    ``delay_s`` seconds — a tail straggler (one contended chip in the
+    mesh, a preempted host): most requests are fast, a deterministic
+    minority is slow. The scenario hedged requests are graded against —
+    the hedge fires off the rolling p99 and the fast duplicate wins.
+    Yields live ``{"calls", "stalled"}``."""
+    if every < 1:
+        raise ChaosError("every must be >= 1, got %r" % (every,))
+    st = _state(server, model)
+    orig = st.cache.run
+    state = {"calls": 0, "stalled": 0}
+    lock = threading.Lock()
+
+    def run(batch):
+        with lock:
+            state["calls"] += 1
+            stall = state["calls"] % every == 0
+            if stall:
+                state["stalled"] += 1
+        if stall:
+            time.sleep(delay_s)
+        return orig(batch)
+
+    st.cache.run = run
+    try:
+        yield state
+    finally:
+        st.cache.run = orig
+
+
+@contextlib.contextmanager
+def quarantine_flap(server, failures: int = 2):
+    """The sentinel's re-admission probe fails the first ``failures``
+    times — a chip that *looks* back but isn't (flaky link, partial
+    reset). Half-open re-admission must re-arm the cooldown on each
+    failed probe instead of flapping capacity back and forth. Yields
+    live ``{"probes", "failed"}``."""
+    sentinel = getattr(server, "_sentinel", None)
+    if sentinel is None:
+        raise ChaosError("server has no device sentinel")
+    state = {"left": int(failures), "probes": 0, "failed": 0}
+
+    def probe(chip):
+        state["probes"] += 1
+        if state["left"] > 0:
+            state["left"] -= 1
+            state["failed"] += 1
+            err = RuntimeError(
+                "chaos: DEVICE_LOST: chip %d still dark (flap)" % chip)
+            err.chip_idx = chip
+            raise err
+        return True
+
+    sentinel.set_probe(probe)
+    try:
+        yield state
+    finally:
+        sentinel.set_probe(None)
 
 
 @contextlib.contextmanager

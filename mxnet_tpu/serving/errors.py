@@ -40,6 +40,11 @@ MemoryBudgetExceeded    memory-aware refusal: loading the model (or the
                         the device OOM mid-traffic. Shrink the model /
                         ladder, raise MXNET_HBM_BYTES, or free a tenant
                         (HTTP 409 on /fleetz/resize).
+ChipQuarantined         a device-fatal fault (DEVICE_LOST / failed-to-
+                        enqueue / data loss) quarantined a chip and the
+                        request could not be re-placed on survivors.
+                        Retry against another replica — the chip is
+                        probed and re-admitted after cooldown (HTTP 503).
 =====================  ====================================================
 """
 from __future__ import annotations
@@ -48,7 +53,7 @@ from ..base import MXNetError
 
 __all__ = ["ServingError", "Overloaded", "DeadlineExceeded", "Draining",
            "CircuitOpen", "ExecutorFault", "QuotaExceeded", "Preempted",
-           "MemoryBudgetExceeded"]
+           "MemoryBudgetExceeded", "ChipQuarantined"]
 
 
 class ServingError(MXNetError):
@@ -96,3 +101,11 @@ class MemoryBudgetExceeded(ServingError):
     """The estimated HBM footprint does not fit the per-chip budget
     (``observability.memwatch``): a model load or fleet resize was
     refused up front instead of OOMing the device mid-traffic."""
+
+
+class ChipQuarantined(ServingError):
+    """A device-fatal fault quarantined a chip mid-dispatch and this
+    request could not be re-placed on the survivors (no feasible ladder,
+    or the re-dispatch itself failed). Device-fatal errors are NEVER
+    retried in place — the chip is suspect; the sentinel re-admits it
+    half-open after cooldown (``serving.health.DeviceSentinel``)."""

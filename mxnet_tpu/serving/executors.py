@@ -121,6 +121,7 @@ class BucketExecutorCache:
         self._preds: Dict[int, object] = {}
         self._base = None           # first-built predictor: owns the params
         self.chips = 1
+        self.bucket_cap: Optional[int] = None
         self.buckets = self.declared_buckets
         if int(chips) != 1:
             self.rebind(int(chips))
@@ -158,11 +159,33 @@ class BucketExecutorCache:
                 % (self.declared_buckets, chips))
         with self._lock:
             self.chips = chips
-            self.buckets = eff
+            self.buckets = self._capped_locked(eff)
             # executables for the old split are stale; params live on in
             # _base and are re-placed exactly once per server lifetime
             self._preds = {}
-        return eff
+            return self.buckets
+
+    def _capped_locked(self, ladder: Tuple[int, ...]) -> Tuple[int, ...]:
+        """Apply the degraded-mode bucket cap to ``ladder``, keeping at
+        least the smallest bucket (a cap below the whole ladder degrades
+        to singles, it never empties the ladder)."""
+        cap = self.bucket_cap
+        if cap is None:
+            return ladder
+        capped = tuple(b for b in ladder if b <= cap)
+        return capped or ladder[:1]
+
+    def set_bucket_cap(self, cap: Optional[int]) -> Tuple[int, ...]:
+        """Cap (or uncap, ``None``) the routable ladder — the degraded
+        ladder's "drop the biggest bucket" rung. Cheap and reversible:
+        already-bound executables above the cap stay cached (no re-bind
+        when the cap lifts), they just stop being routed to. Returns the
+        new effective ladder."""
+        with self._lock:
+            self.bucket_cap = None if cap is None else int(cap)
+            eff = self.effective_buckets(self.declared_buckets, self.chips)
+            self.buckets = self._capped_locked(eff)
+            return self.buckets
 
     @property
     def max_bucket(self) -> int:

@@ -358,6 +358,68 @@ class FleetController:
                       "resize_ms": round(elapsed_ms, 3)})
         return plan
 
+    def note_chip_loss(self, model: str, old_chips: int, new_chips: int,
+                       chip: int) -> None:
+        """Bookkeeping for a chip-loss replan the SERVER already executed
+        inline (serving/health.replan_after_loss — the failed dispatch
+        held ``dispatch_mutex``, so the rebind could not go through
+        :meth:`resize` without self-deadlocking on the quiesce). Updates
+        the placement map and counters; donors whose placement no longer
+        fits the surviving capacity are re-planned on the next
+        :meth:`evaluate` pass, OUTSIDE the victim's dispatch."""
+        with self._lock:
+            self._chips[model] = int(new_chips)
+            self._last_resize[model] = self._clock()
+        self._publish_chips(model, new_chips)
+        from ..observability import metrics as _m
+        if _m.enabled():
+            from ..observability import catalog as _c
+            _c.FLEET_RESIZES.inc(direction="shrink")
+        self.server.tracer.record_event(
+            "chip_loss", model=model, chip=int(chip),
+            old_chips=int(old_chips), new_chips=int(new_chips))
+        self._record({"action": "chip_loss", "model": model,
+                      "chip": int(chip), "old_chips": int(old_chips),
+                      "new_chips": int(new_chips)})
+
+    def _reconcile_chip_loss(self) -> List[Dict[str, Any]]:
+        """Donor re-planning after quarantine shrank the fleet: while the
+        placement overcommits the SURVIVING capacity (total minus
+        quarantined chips), shrink the largest-placed tenant one feasible
+        step. Runs at the top of every evaluate() pass; re-admission
+        restores capacity, and the normal autoscaler grows tenants back."""
+        sentinel = getattr(self.server, "_sentinel", None)
+        lost = sentinel.count() if sentinel is not None else 0
+        if lost <= 0:
+            return []
+        actions: List[Dict[str, Any]] = []
+        effective = max(1, self.total_chips - lost)
+        for _ in range(len(self._policies)):
+            with self._lock:
+                placed = dict(self._chips)
+            if sum(placed.values()) <= effective:
+                break
+            for donor in sorted(placed, key=lambda m: -placed[m]):
+                st = self.server._models[donor]
+                pol = self._policies[donor]
+                down = [c for c in self._feasible_steps(st)
+                        if pol.floor_chips <= c < placed[donor]]
+                if not down:
+                    continue
+                try:
+                    self.resize(donor, down[-1], reason="chip_loss:donor")
+                except Exception as e:
+                    logger.error("chip-loss donor shrink of %r failed: "
+                                 "%r", donor, e)
+                    continue
+                actions.append({"action": "shrink", "model": donor,
+                                "new_chips": down[-1],
+                                "reason": "chip_loss"})
+                break
+            else:
+                break           # nobody can give: placement stays over
+        return actions
+
     # ----------------------------------------------------------- autoscaler
     def _burn(self, st) -> Optional[float]:
         """A tenant's fast-window burn, or None when it has no SLO or too
@@ -418,6 +480,10 @@ class FleetController:
         refuses) at most ONE chip reallocation. Returns the actions
         taken; also what the background evaluator calls each interval."""
         actions: List[Dict[str, Any]] = []
+        # chip-loss reconciliation first: a quarantine shrank the usable
+        # fleet, so donors overcommitting the survivors re-plan before
+        # any growth is considered
+        actions.extend(self._reconcile_chip_loss())
         now = self._clock()
         state: Dict[str, Dict[str, Any]] = {}
         for model, pol in self._policies.items():

@@ -36,7 +36,8 @@ from typing import Callable, Dict, List, Optional, Tuple
 from ..analysis.lockwatch import make_lock
 from .errors import Draining, Overloaded
 
-__all__ = ["BoundedRequestQueue", "TokenBucket", "FairShare"]
+__all__ = ["BoundedRequestQueue", "TokenBucket", "RetryBudget",
+           "FairShare"]
 
 
 class BoundedRequestQueue:
@@ -236,6 +237,54 @@ class TokenBucket:
                 self._tokens -= n
                 return True
             return False
+
+
+class RetryBudget:
+    """Token bucket shared by retries AND hedges: tail-tolerance capped
+    at a fraction of real traffic (the classic "retry budget" from SRE
+    practice — retries must never amplify an overload into a retry
+    storm).
+
+    Every ADMITTED request deposits ``fraction`` of a token
+    (:meth:`deposit`); every retry or hedge spends a whole token
+    (:meth:`try_spend`) — so extra dispatches track ~``fraction`` of
+    offered traffic, with ``burst`` tokens of slack for the quiet-start
+    and small-burst cases. Denials are counted per kind and published to
+    ``mxtpu_retry_budget_denied_total`` by the caller — a denied retry
+    fails fast and TYPED, never silently."""
+
+    def __init__(self, fraction: float = 0.1, burst: float = 5.0):
+        if not 0.0 < fraction <= 1.0:
+            raise ValueError("RetryBudget fraction must be in (0, 1], "
+                             "got %r" % (fraction,))
+        self.fraction = float(fraction)
+        self.burst = float(burst)
+        self._tokens = self.burst
+        self._denied: Dict[str, int] = {}
+        self._spent: Dict[str, int] = {}
+        self._lock = make_lock("serving.queueing.RetryBudget._lock")
+
+    def deposit(self, n: float = 1.0) -> None:
+        """Credit ``fraction`` of a token per admitted request."""
+        with self._lock:
+            self._tokens = min(self.burst, self._tokens + n * self.fraction)
+
+    def try_spend(self, kind: str = "retry") -> bool:
+        """Spend one token for a ``kind`` ∈ {"retry", "hedge"} dispatch;
+        False = budget exhausted (the caller counts + types the denial,
+        never blocks)."""
+        with self._lock:
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                self._spent[kind] = self._spent.get(kind, 0) + 1
+                return True
+            self._denied[kind] = self._denied.get(kind, 0) + 1
+            return False
+
+    def stats(self) -> Dict[str, object]:
+        with self._lock:
+            return {"fraction": self.fraction, "tokens": self._tokens,
+                    "spent": dict(self._spent), "denied": dict(self._denied)}
 
 
 class FairShare:

@@ -49,12 +49,13 @@ from ..analysis.lockwatch import make_lock
 from ..base import MXNetError, get_env, logger, register_config
 from ..observability import memwatch as _memwatch
 from ..observability import tracing as _tracing
+from . import health as _health
 from .breaker import CircuitBreaker
-from .errors import (CircuitOpen, DeadlineExceeded, Draining, ExecutorFault,
-                     MemoryBudgetExceeded, Overloaded, Preempted,
-                     QuotaExceeded, ServingError)
+from .errors import (ChipQuarantined, CircuitOpen, DeadlineExceeded,
+                     Draining, ExecutorFault, MemoryBudgetExceeded,
+                     Overloaded, Preempted, QuotaExceeded, ServingError)
 from .executors import BucketExecutorCache, default_buckets
-from .queueing import BoundedRequestQueue
+from .queueing import BoundedRequestQueue, RetryBudget
 
 __all__ = ["ModelConfig", "ModelServer", "PendingResult"]
 
@@ -89,6 +90,24 @@ register_config("MXNET_SERVE_TRACE", True, bool,
                 "way. 0 disables; mxlint MXL-T216 flags an untraced "
                 "server with declared deadlines/SLOs. Per-model "
                 "override: ModelConfig(trace=).")
+register_config("MXNET_SERVE_HEDGE", False, bool,
+                "Opt-in hedged requests: a request still unanswered after "
+                "a rolling-p99-derived delay is dispatched a second time; "
+                "first result wins, the loser is dropped (counted in "
+                "mxtpu_serve_hedges_total). Per-model override: "
+                "ModelConfig(hedge=).")
+register_config("MXNET_SERVE_HEDGE_DELAY_MS", 20.0, float,
+                "Hedge trigger delay floor: used until the model has "
+                "enough completed requests (32) for the rolling p99 to "
+                "derive the delay. Per-model: ModelConfig(hedge_delay_ms=).")
+register_config("MXNET_SERVE_RETRY_BUDGET", 0.1, float,
+                "Retry-budget fraction: retries + hedges together may "
+                "spend at most ~this fraction of admitted traffic "
+                "(token bucket; denials counted in "
+                "mxtpu_retry_budget_denied_total, never silent). 0 "
+                "disables the budget — mxlint MXL-T219 flags a server "
+                "with retries/hedging but no budget. Per-model: "
+                "ModelConfig(retry_budget=).")
 register_config("MXNET_SERVE_TIER", "f32", str,
                 "Default serving tier for models whose ModelConfig does "
                 "not name one: 'f32' serves the graph as loaded; 'int8' "
@@ -103,12 +122,16 @@ def _now() -> float:
 
 
 class PendingResult:
-    """Client-side future for one submitted request."""
+    """Client-side future for one submitted request. First-wins: with
+    hedging on, the primary dispatch and the hedge race to complete it —
+    the first :meth:`_complete` claims the result, later ones are
+    dropped (return False) so a request is answered exactly once."""
 
-    __slots__ = ("_ev", "_value", "_error", "_outcome", "done_at")
+    __slots__ = ("_ev", "_win", "_value", "_error", "_outcome", "done_at")
 
     def __init__(self):
         self._ev = threading.Event()
+        self._win = threading.Lock()    # leaf lock: claim is atomic
         self._value = None
         self._error: Optional[BaseException] = None
         self._outcome: Optional[str] = None
@@ -132,10 +155,22 @@ class PendingResult:
             raise self._error
         return self._value
 
-    def _complete(self, value=None, error=None, outcome="ok") -> None:
-        self._value, self._error, self._outcome = value, error, outcome
-        self.done_at = time.monotonic()
+    def _claim(self, value=None, error=None, outcome="ok") -> bool:
+        """Atomically claim the result WITHOUT waking waiters — the
+        winning completer finishes its accounting first, so counters are
+        already consistent when ``result()`` returns."""
+        with self._win:
+            if self._outcome is not None:
+                return False            # a racing completer already won
+            self._value, self._error, self._outcome = value, error, outcome
+            self.done_at = time.monotonic()
+        return True
+
+    def _complete(self, value=None, error=None, outcome="ok") -> bool:
+        if not self._claim(value=value, error=error, outcome=outcome):
+            return False
         self._ev.set()
+        return True
 
 
 class _Request:
@@ -190,7 +225,10 @@ class ModelConfig:
                  trace: Optional[bool] = None,
                  trace_sample: Optional[float] = None,
                  slo_p99_ms: Optional[float] = None,
-                 slo_availability: Optional[float] = None):
+                 slo_availability: Optional[float] = None,
+                 hedge: Optional[bool] = None,
+                 hedge_delay_ms: Optional[float] = None,
+                 retry_budget: Optional[float] = None):
         if not name:
             raise MXNetError("ModelConfig needs a model name")
         self.name = str(name)
@@ -240,6 +278,19 @@ class ModelConfig:
         self.slo_availability = float(
             get_env("MXNET_SERVE_SLO_AVAILABILITY", 0.999)
             if slo_availability is None else slo_availability)
+        self.hedge = bool(get_env("MXNET_SERVE_HEDGE", False)
+                          if hedge is None else hedge)
+        self.hedge_delay_ms = float(
+            get_env("MXNET_SERVE_HEDGE_DELAY_MS", 20.0)
+            if hedge_delay_ms is None else hedge_delay_ms)
+        if self.hedge_delay_ms < 0:
+            raise MXNetError("hedge_delay_ms must be >= 0")
+        self.retry_budget = float(get_env("MXNET_SERVE_RETRY_BUDGET", 0.1)
+                                  if retry_budget is None else retry_budget)
+        if not (0.0 <= self.retry_budget <= 1.0):
+            raise MXNetError("retry_budget must be in [0, 1] (0 = no "
+                             "budget; MXL-T219 flags it), got %r"
+                             % (self.retry_budget,))
         self.dev_type, self.dev_id = int(dev_type), int(dev_id)
         self.output_keys = output_keys
 
@@ -283,6 +334,14 @@ class _ModelState:
         self.retries = 0
         self.deadline_violations = 0
         self.latencies: List[float] = []   # ok-request ms, bounded ring
+        # tail-tolerance state: the retries+hedges token budget (None =
+        # unbounded, flagged by MXL-T219), hedge outcome counts, and the
+        # degraded-mode ladder (attached by ModelServer — it needs the
+        # server's tracer for edge-triggered transition events)
+        self.budget = (RetryBudget(cfg.retry_budget)
+                       if cfg.retry_budget > 0 else None)
+        self.hedges = {"fired": 0, "won": 0, "lost": 0, "budget_denied": 0}
+        self.ladder = None
 
 
 _LAT_RING = 8192
@@ -335,6 +394,13 @@ class ModelServer:
                         % (cfg.name, need, max(0, avail), int(budget), used))
                 used += need
             self._models[cfg.name] = st
+        # chip-loss self-healing: the sentinel owns the quarantine set;
+        # each model gets a degraded-mode ladder (host-side only — the
+        # served StableHLO is bitwise identical, pinned by test_health)
+        self._sentinel = _health.DeviceSentinel(self)
+        for st in self._models.values():
+            st.ladder = _health.DegradedLadder(self, st)
+        self._hedger: Optional[_health.HedgeMonitor] = None
         self._drain_on_preemption = bool(drain_on_preemption)
         # multi-tenant fleet controller (serving/fleet.py), attached via
         # FleetController(server=...); None (the default) = fleet mode
@@ -363,6 +429,9 @@ class ModelServer:
                                  daemon=True, name="mxserve-%s" % name)
             st.worker = t
             t.start()
+        if any(st.cfg.hedge for st in self._models.values()):
+            self._hedger = _health.HedgeMonitor(self).start()
+        self._sentinel.start()      # canary thread only if PROBE_S is set
         self._started = True
         return self
 
@@ -400,6 +469,9 @@ class ModelServer:
         if self._stopped:
             return True
         ok = self.drain(timeout=timeout)
+        if self._hedger is not None:
+            self._hedger.stop()
+        self._sentinel.stop()
         for st in self._models.values():
             for req in st.queue.drain_remaining():
                 self._complete(st, req, error=Draining(
@@ -480,6 +552,10 @@ class ModelServer:
             # path is otherwise untouched
             if self._fleet is not None:
                 self._fleet.admit(st, req)
+            # degraded-mode gate AFTER the fleet stamped the priority
+            # class: rung 3 admits guaranteed traffic only, rung 4 sheds
+            # statically — typed Overloaded, counted reason="degraded"
+            st.ladder.admit_check(req)
             shed = st.queue.put(req)
         except (Overloaded, Draining, Preempted) as e:
             if req.trace is not None:
@@ -489,6 +565,8 @@ class ModelServer:
                 req.trace.span("admission", now, _now())
                 if isinstance(e, QuotaExceeded):
                     reason = "quota"
+                elif getattr(e, "degraded", False):
+                    reason = "degraded"
                 elif isinstance(e, Overloaded):
                     reason = "overloaded"
                 elif isinstance(e, Preempted):
@@ -503,6 +581,12 @@ class ModelServer:
         req.enqueued_at = _now()
         if req.trace is not None:
             req.trace.span("admission", now, req.enqueued_at)
+        # every admitted request funds the shared retry budget (~10% of
+        # traffic by default) that retries AND hedges spend from
+        if st.budget is not None:
+            st.budget.deposit()
+        if self._hedger is not None and st.cfg.hedge:
+            self._hedger.register(st, req)
         for dead in shed:
             self._complete(st, dead, error=DeadlineExceeded(
                 "deadline passed while queued (shed at admission)"),
@@ -540,6 +624,11 @@ class ModelServer:
                 # submit that raced the close still gets served (drain
                 # semantics: accepted work finishes).
                 self.begin_drain()
+            # sentinel tick: apply pending degraded-ladder effects (the
+            # worker owns its model's executable swaps), then — rate-
+            # limited — half-open re-admission and de-escalation checks.
+            # Runs OUTSIDE dispatch_mutex: effects take it themselves.
+            self._sentinel.tick(st)
             wait_s = st.queue.effective_wait(cfg.max_wait_ms / 1e3)
             batch, expired = st.queue.take_batch(
                 st.cache.max_bucket, wait_s, stop_requested)
@@ -590,6 +679,8 @@ class ModelServer:
         dispatch_at = _now()
         ready: List[_Request] = []
         for req in batch:
+            if req.pending.done():
+                continue    # a hedge already answered it while it queued
             # the last line of the no-expired-work-on-the-chip invariant:
             # anything past deadline at dispatch time is answered, not run
             if req.deadline is not None and req.deadline <= dispatch_at:
@@ -622,7 +713,13 @@ class ModelServer:
         try:
             rows = self._run_with_retry(st, arr)
         except Exception as e:
-            if len(ready) > 1:
+            if _health.is_device_fatal(e):
+                # the chip, not the request, is suspect: quarantine it,
+                # re-plan the ladder on the survivors and re-dispatch the
+                # live batchmates there — never isolate, never retry
+                self._on_device_fatal(st, ready, e, t_f0, batch_span,
+                                      retries_before)
+            elif len(ready) > 1:
                 # isolation: one poison request must not fail its
                 # batchmates — re-dispatch one by one
                 self._dispatch_singly(st, ready, cause=e)
@@ -643,6 +740,74 @@ class ModelServer:
             self._trace_forward(st, req, t_f0, t_f1, batch_span,
                                 len(ready), retries_before)
         for i, req in enumerate(ready):
+            self._complete(st, req, value=rows[i], outcome="ok")
+
+    def _on_device_fatal(self, st: _ModelState, ready: List[_Request],
+                         exc: BaseException, t_f0: float,
+                         batch_span: Optional[str],
+                         retries_before: int) -> None:
+        """Chip-loss recovery for one failed dispatch. Runs under
+        ``dispatch_mutex`` (held by the worker), which doubles as the
+        quiesce for the inline rebind: (1) quarantine the blamed chip,
+        (2) re-plan the bucket ladder over the survivors
+        (``plan_chip_split`` + memory check + ``rebind``), (3) re-
+        dispatch the batch's live batchmates on the new binding — in-
+        flight work is never silently lost. Budget-exempt: the re-
+        dispatch is recovery of ADMITTED work, not extra traffic. Only
+        when no feasible re-placement exists (or the re-dispatch fails
+        again) do the batchmates fail with typed ``ChipQuarantined`` and
+        the degraded ladder escalates."""
+        chip = _health.chip_of(exc)
+        if chip is None:
+            chip = st.cfg.dev_id
+        reason = _health.device_fatal_reason(exc)
+        self._sentinel.quarantine(chip, reason=reason, model=st.cfg.name)
+        plan = _health.replan_after_loss(self, st, chip, exc)
+        now = _now()
+        still: List[_Request] = []
+        for req in ready:
+            if req.pending.done():
+                continue                        # a hedge answered it
+            if req.deadline is not None and req.deadline <= now:
+                self._complete(st, req, error=DeadlineExceeded(
+                    "deadline passed during chip-loss recovery"),
+                    outcome="expired", reason="chip_loss")
+            else:
+                still.append(req)
+        if not still:
+            st.breaker.record_failure()
+            return
+        try:
+            arr = np.stack([r.data for r in still])
+            rows = self._run_with_retry(st, arr)
+        except Exception as e2:
+            st.breaker.record_failure()
+            st.ladder.escalate("chip_loss:redispatch_failed")
+            err = ChipQuarantined(
+                "chip %d quarantined (%s) and the re-dispatch on the "
+                "survivors failed: retry against another replica"
+                % (chip, reason))
+            err.__cause__ = e2
+            for req in still:
+                self._trace_forward(st, req, t_f0, _now(), batch_span,
+                                    len(still), retries_before,
+                                    outcome_tag="error")
+                self._complete(st, req, error=err, outcome="error",
+                               reason="chip_loss")
+            return
+        t_f1 = _now()
+        st.breaker.record_success()
+        if plan is None and st.cache.chips <= 1:
+            # the fault self-cleared but there were no survivors to re-
+            # place onto: serve cautiously until probes stay healthy
+            st.ladder.escalate("chip_loss:no_survivors")
+        with st.lock:
+            st.batches += 1
+        self._observe_batch(st, len(still))
+        for req in still:
+            self._trace_forward(st, req, t_f0, t_f1, batch_span,
+                                len(still), retries_before)
+        for i, req in enumerate(still):
             self._complete(st, req, value=rows[i], outcome="ok")
 
     def _trace_forward(self, st: _ModelState, req: _Request, t0: float,
@@ -728,11 +893,22 @@ class ModelServer:
                            "(attempt %d), retrying in %.3fs: %r",
                            st.cfg.name, i + 1, delay, exc)
 
+        def gate(exc):
+            # the shared retry budget: a transient retry spends a token
+            # funded by admitted traffic; an empty bucket fails the
+            # request NOW (typed, counted) instead of amplifying overload
+            if st.budget is None:
+                return True
+            if st.budget.try_spend("retry"):
+                return True
+            self._count_budget_denied(st, "retry")
+            return False
+
         try:
             return retry_transient(lambda: st.cache.run(arr),
                                    attempts=st.cfg.retries + 1,
                                    base_delay=0.01, max_delay=0.5,
-                                   on_retry=on_retry)
+                                   on_retry=on_retry, gate=gate)
         except Exception as e:
             # the serving dispatch boundary: a device RESOURCE_EXHAUSTED
             # leaves forensics (mxtpu_oom.json, blame table) and becomes
@@ -754,7 +930,23 @@ class ModelServer:
 
     # ---------------------------------------------------------- accounting
     def _complete(self, st: _ModelState, req: _Request, value=None,
-                  error=None, outcome="ok", reason=None) -> None:
+                  error=None, outcome="ok", reason=None) -> bool:
+        # claim FIRST (PendingResult is first-wins): when a hedge and the
+        # primary race, exactly one completer does the accounting below —
+        # the loser's result is dropped whole (no double count, no
+        # double-finished trace). The event is set only AFTER accounting,
+        # so a client that saw result() can trust the counters. Returns
+        # whether THIS call won.
+        if not req.pending._claim(value=value, error=error,
+                                  outcome=outcome):
+            return False
+        try:
+            return self._account(st, req, outcome, reason)
+        finally:
+            req.pending._ev.set()
+
+    def _account(self, st: _ModelState, req: _Request, outcome,
+                 reason) -> bool:
         done_at = _now()
         violated = (outcome == "ok" and req.deadline is not None
                     and req.dispatch_at is not None
@@ -778,7 +970,7 @@ class ModelServer:
                                             else None))
         self._count(st, outcome,
                     latency_ms if outcome == "ok" else None)
-        req.pending._complete(value=value, error=error, outcome=outcome)
+        return True
 
     def _finish_trace(self, st: _ModelState, req: _Request, done_at: float,
                       outcome: str, violated: bool, reason) -> bool:
@@ -859,6 +1051,13 @@ class ModelServer:
             from ..observability import catalog as _c
             _c.MEM_REFUSALS.inc(reason=reason)
 
+    @staticmethod
+    def _count_budget_denied(st: _ModelState, kind: str) -> None:
+        from ..observability import metrics as _m
+        if _m.enabled():
+            from ..observability import catalog as _c
+            _c.RETRY_BUDGET_DENIED.inc(model=st.cfg.name, kind=kind)
+
     # ------------------------------------------------------------- surface
     def models(self) -> List[str]:
         return sorted(self._models)
@@ -886,7 +1085,14 @@ class ModelServer:
                 "tracing": {"enabled": st.cfg.trace,
                             "sample": st.cfg.trace_sample,
                             "ring_depth": self.tracer.depth},
+                "chips": st.cache.chips,
+                "hedges": dict(st.hedges),
             }
+        out["degraded_rung"] = st.ladder.rung if st.ladder is not None \
+            else 0
+        if st.budget is not None:
+            out["retry_budget"] = st.budget.stats()
+        out["sentinel"] = self._sentinel.snapshot()
         out["memory"] = _memwatch.model_footprint(st.cache, model=model)
         if st.slo is not None:
             out["slo"] = st.slo.snapshot()

@@ -1,0 +1,496 @@
+"""Chip-loss self-healing and tail tolerance (mxnet_tpu/serving/health.py):
+device-fatal classification, the retry contract (OOM and DEVICE_LOST are
+NEVER retried), the retry budget, quarantine + half-open re-admission,
+the degraded-mode ladder — and THE chip-loss acceptance test: a two-
+tenant serve loses 1 of 2 chips mid-traffic under the lock-order
+sanitizer; the sentinel quarantines it, the ladder re-plans onto the
+survivor, the failed batch's live batchmates are re-dispatched (nothing
+silently lost), and after the cooldown the chip re-admits and capacity
+restores — all proven from telemetry counters and trace-ring events."""
+import threading
+import time
+import types
+
+import numpy as np
+import pytest
+
+from mxnet_tpu.observability import catalog
+from mxnet_tpu.resilience.retry import is_transient, retry_transient
+from mxnet_tpu.serving import ModelConfig, ModelServer, Overloaded
+from mxnet_tpu.serving import chaos as schaos
+from mxnet_tpu.serving import health
+from mxnet_tpu.serving import load as sload
+from mxnet_tpu.serving.queueing import RetryBudget
+
+pytestmark = pytest.mark.serve
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    return sload.tiny_model()
+
+
+def _cfg(tiny, name="m", **kw):
+    sym_json, pbytes, feat, _ = tiny
+    d = dict(feature_shape=feat, buckets=(1, 2, 4, 8), max_queue=16,
+             deadline_ms=2000.0, max_wait_ms=3.0, breaker_cooldown_s=0.25)
+    d.update(kw)
+    return ModelConfig(name, sym_json, pbytes, **d)
+
+
+class _StubTracer:
+    def __init__(self):
+        self.events = []
+
+    def record_event(self, name, **tags):
+        self.events.append((name, tags))
+
+
+class _StubServer:
+    def __init__(self):
+        self.tracer = _StubTracer()
+        self._models = {}
+
+
+class _Clock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+# ----------------------------------------------------------- classification
+def test_is_device_fatal_markers_and_chip_attribution():
+    e = RuntimeError("DEVICE_LOST: chip 3 went away")
+    assert health.is_device_fatal(e)
+    assert health.device_fatal_reason(e) == "device_lost"
+    assert health.chip_of(e) == 3
+
+    assert health.device_fatal_reason(
+        RuntimeError("transfer failed to enqueue on stream")) == "enqueue"
+    assert health.device_fatal_reason(
+        RuntimeError("DATA_LOSS: corrupt result buffer")) == "data_loss"
+
+    # an explicit chip_idx attribute beats the message mention
+    e2 = RuntimeError("DEVICE_LOST: chip 7 suspect")
+    e2.chip_idx = 1
+    assert health.chip_of(e2) == 1
+    # no attribution at all -> None (caller falls back to the bound device)
+    assert health.chip_of(RuntimeError("device lost")) is None
+
+    # ordinary errors are not device-fatal
+    assert not health.is_device_fatal(ValueError("bad input"))
+    assert not health.is_device_fatal(RuntimeError("INVALID_ARGUMENT"))
+
+    # classification survives exception wrapping (cause chain)
+    try:
+        try:
+            raise RuntimeError("device lost: chip 2")
+        except RuntimeError as inner:
+            raise ValueError("dispatch failed") from inner
+    except ValueError as outer:
+        assert health.is_device_fatal(outer)
+        assert health.chip_of(outer) == 2
+
+
+def test_oom_wins_over_device_fatal():
+    # RESOURCE_EXHAUSTED is a capacity fact with its own typed fate
+    # (HBMExhausted) — never a quarantine trigger, even when the message
+    # also mentions the device
+    e = RuntimeError("RESOURCE_EXHAUSTED: out of memory allocating "
+                     "17179869184 bytes on device_lost chip 0")
+    assert not health.is_device_fatal(e)
+    assert not health.is_device_fatal(
+        RuntimeError("RESOURCE_EXHAUSTED: out of memory"))
+
+
+# ------------------------------------------------------------ retry contract
+class XlaRuntimeError(RuntimeError):
+    """Named like the real jaxlib error so is_transient's name check
+    engages — the regression shape for the classifier tests."""
+
+
+def _always(exc):
+    calls = {"n": 0}
+
+    def fn():
+        calls["n"] += 1
+        raise exc
+
+    return fn, calls
+
+
+def test_retry_never_retries_resource_exhausted():
+    # THE regression: "resource exhausted" used to sit in the transient
+    # markers, so a raw RESOURCE_EXHAUSTED was retried — re-OOMing the
+    # device and masking the typed HBMExhausted classification
+    for msg in ("RESOURCE_EXHAUSTED: out of memory allocating 123 bytes",
+                "Resource exhausted: failed to allocate buffer"):
+        exc = XlaRuntimeError(msg)
+        assert not is_transient(exc)
+        fn, calls = _always(exc)
+        with pytest.raises(XlaRuntimeError):
+            retry_transient(fn, attempts=3, base_delay=0.0,
+                            sleep=lambda s: None)
+        assert calls["n"] == 1      # failed ONCE, no retry
+
+
+def test_retry_never_retries_device_fatal():
+    exc = XlaRuntimeError("DEVICE_LOST: chip 0 unavailable, aborted")
+    assert not is_transient(exc)    # device-fatal wins over the markers
+    fn, calls = _always(exc)
+    with pytest.raises(XlaRuntimeError):
+        retry_transient(fn, attempts=4, base_delay=0.0,
+                        sleep=lambda s: None)
+    assert calls["n"] == 1
+
+    # plain transient infra errors still retry
+    ok = XlaRuntimeError("UNAVAILABLE: connection reset by peer")
+    assert is_transient(ok)
+    state = {"n": 0}
+
+    def flaky():
+        state["n"] += 1
+        if state["n"] == 1:
+            raise ok
+        return "served"
+
+    assert retry_transient(flaky, attempts=3, base_delay=0.0,
+                           sleep=lambda s: None) == "served"
+    assert state["n"] == 2
+
+
+def test_retry_gate_denial_fails_fast():
+    exc = XlaRuntimeError("UNAVAILABLE: connection reset")
+    fn, calls = _always(exc)
+    with pytest.raises(XlaRuntimeError):
+        retry_transient(fn, attempts=5, base_delay=0.0, gate=lambda e: False,
+                        sleep=lambda s: None)
+    assert calls["n"] == 1          # denied budget: no second attempt
+
+
+# -------------------------------------------------------------- retry budget
+def test_retry_budget_math():
+    b = RetryBudget(fraction=0.5, burst=2.0)
+    assert b.try_spend("retry") and b.try_spend("hedge")
+    assert not b.try_spend("retry")             # burst drained
+    for _ in range(2):                           # 2 admits * 0.5 = 1 token
+        b.deposit()
+    assert b.try_spend("hedge")
+    assert not b.try_spend("hedge")
+    s = b.stats()
+    assert s["spent"] == {"retry": 1, "hedge": 2}
+    assert s["denied"] == {"retry": 1, "hedge": 1}
+    assert s["fraction"] == 0.5
+    for bad in (0.0, -0.1, 1.5):
+        with pytest.raises(ValueError):
+            RetryBudget(fraction=bad)
+
+
+# ------------------------------------------------- sentinel (fake clock)
+def test_sentinel_quarantine_and_optimistic_readmit():
+    clk = _Clock()
+    stub = _StubServer()
+    s = health.DeviceSentinel(stub, cooldown_s=10.0, clock=clk)
+    q0 = catalog.CHIP_QUARANTINES.value(reason="device_lost")
+
+    s.quarantine(3, reason="device_lost", model="m")
+    assert s.is_quarantined(3) and s.count() == 1
+    assert catalog.CHIP_QUARANTINES.value(reason="device_lost") - q0 == 1
+    assert catalog.QUARANTINED_CHIPS.value() == 1
+    snap = s.snapshot()
+    assert snap["quarantined"][3]["reason"] == "device_lost"
+
+    # a repeat extends the cooldown but keeps the original `since`
+    since = snap["quarantined"][3]["since"]
+    clk.t += 4.0
+    s.quarantine(3, reason="device_lost")
+    snap = s.snapshot()
+    assert snap["quarantined"][3]["since"] == since
+    assert snap["quarantined"][3]["until"] == clk.t + 10.0
+
+    clk.t += 9.0                                 # not due yet
+    assert s.maybe_readmit() == []
+    clk.t += 1.5                                 # past the cooldown
+    assert s.maybe_readmit() == [3]
+    assert s.count() == 0
+    assert catalog.QUARANTINED_CHIPS.value() == 0
+    assert [n for n, _ in stub.tracer.events] \
+        == ["quarantine", "quarantine", "readmit"]
+
+
+def test_sentinel_probe_failure_rearms_cooldown():
+    clk = _Clock()
+    stub = _StubServer()
+    s = health.DeviceSentinel(stub, cooldown_s=5.0, clock=clk)
+    stub._sentinel = s
+    p0 = catalog.CHIP_QUARANTINES.value(reason="probe")
+    s.quarantine(0, reason="enqueue")
+    with schaos.quarantine_flap(stub, failures=2) as flap:
+        clk.t += 6.0
+        assert s.maybe_readmit() == []           # probe 1 fails: re-armed
+        assert s.is_quarantined(0)
+        clk.t += 2.0
+        assert s.maybe_readmit() == []           # not due (cooldown re-armed)
+        clk.t += 4.0
+        assert s.maybe_readmit() == []           # probe 2 fails
+        clk.t += 6.0
+        assert s.maybe_readmit() == [0]          # probe 3 passes
+    assert flap["probes"] == 3 and flap["failed"] == 2
+    assert catalog.CHIP_QUARANTINES.value(reason="probe") - p0 == 2
+
+
+# ------------------------------------------------------------ degraded ladder
+def test_ladder_transitions_and_admission_gates():
+    stub = _StubServer()
+    st = types.SimpleNamespace(cfg=types.SimpleNamespace(name="lad",
+                                                         tier="f32"))
+    lad = health.DegradedLadder(stub, st)
+    assert lad.rung == 0 and lad.name() == "healthy"
+
+    req_be = types.SimpleNamespace(priority=None)
+    req_g = types.SimpleNamespace(priority="guaranteed")
+    lad.admit_check(req_be)                      # healthy: everyone in
+
+    assert lad.escalate("test") == 1
+    assert lad.escalate("test") == 2
+    lad.admit_check(req_be)                      # rungs 1-2: still admitting
+    assert lad.escalate("test") == 3
+    with pytest.raises(Overloaded) as ei:
+        lad.admit_check(req_be)                  # rung 3 sheds best-effort
+    assert getattr(ei.value, "degraded", False)
+    lad.admit_check(req_g)                       # ... but not guaranteed
+    assert lad.escalate("test") == 4
+    assert lad.escalate("test") == 4             # capped at static shed
+    with pytest.raises(Overloaded):
+        lad.admit_check(req_g)                   # rung 4 sheds everyone
+    assert catalog.SERVE_DEGRADED_RUNG.value(model="lad") == 4
+
+    for want in (3, 2, 1, 0):
+        assert lad.de_escalate("healthy") == want
+    assert lad.de_escalate("healthy") == 0       # capped at healthy
+    assert catalog.SERVE_DEGRADED_RUNG.value(model="lad") == 0
+    # EDGE-triggered: one trace event per actual change, none for the
+    # capped no-op calls
+    degraded = [t for n, t in stub.tracer.events if n == "degraded"]
+    assert len(degraded) == 8
+    assert [t["rung"] for t in degraded] == [1, 2, 3, 4, 3, 2, 1, 0]
+
+
+def test_ladder_effect_reduces_buckets_live(tiny):
+    srv = ModelServer([_cfg(tiny, name="cap")]).start(warm=True)
+    _, _, feat, ref = tiny
+    d = np.random.RandomState(5).randn(*feat).astype("float32")
+    try:
+        st = srv._models["cap"]
+        assert st.cache.buckets == (1, 2, 4, 8)
+        st.ladder.escalate("test:reduced")
+        # the model's own worker applies the effect on its next tick
+        deadline = time.monotonic() + 5.0
+        while st.cache.buckets != (1, 2, 4):
+            assert time.monotonic() < deadline, st.cache.buckets
+            srv.predict("cap", d, timeout=30.0)
+        np.testing.assert_allclose(srv.predict("cap", d, timeout=30.0),
+                                   ref(d), rtol=1e-4, atol=1e-5)
+        assert catalog.SERVE_DEGRADED_RUNG.value(model="cap") == 1
+        # the transition is on the trace ring, not just the gauge
+        events = srv.tracer.traces(model="cap", outcome="event")
+        assert any(s["tags"].get("mode") == "reduced_buckets"
+                   for t in events for s in t.spans
+                   if s["stage"] == "degraded")
+        st.ladder.de_escalate("test:healthy")
+        deadline = time.monotonic() + 5.0
+        while st.cache.buckets != (1, 2, 4, 8):
+            assert time.monotonic() < deadline, st.cache.buckets
+            srv.predict("cap", d, timeout=30.0)
+        assert catalog.SERVE_DEGRADED_RUNG.value(model="cap") == 0
+    finally:
+        srv.close(timeout=10.0)
+
+
+# ---------------------------------------------- THE chip-loss acceptance test
+@pytest.mark.chaos
+def test_chip_loss_quarantines_replans_and_restores(tiny, monkeypatch):
+    """Two tenants serving, tenant `a` spread over 2 chips; chip 1 dies
+    mid-traffic (every dispatch device-fatal until quarantined). The
+    sentinel must quarantine it (counted), re-plan `a` onto the survivor
+    (trace-ring `replan` event), re-dispatch the failed batch's live
+    batchmates (every future answers, correctly), keep tenant `b`
+    untouched — and after the cooldown re-admit the chip and restore the
+    pre-loss placement. Runs under the lock-order sanitizer: zero
+    findings."""
+    from mxnet_tpu.analysis import lockwatch
+
+    monkeypatch.setenv("MXNET_LOCKCHECK", "1")   # before any lock is made
+    lockwatch.reset()
+    _, _, feat, ref = tiny
+    srv = ModelServer([_cfg(tiny, name="a", max_queue=64),
+                       _cfg(tiny, name="b", max_queue=64)]).start(warm=True)
+    payload = np.random.RandomState(9).randn(*feat).astype("float32")
+    q0 = catalog.CHIP_QUARANTINES.value(reason="device_lost")
+    ok0 = {m: catalog.SERVE_REQUESTS.value(model=m, outcome="ok")
+           for m in ("a", "b")}
+    try:
+        st_a = srv._models["a"]
+        with st_a.dispatch_mutex:
+            assert st_a.cache.rebind(2) == (2, 4, 8)
+        srv._sentinel.cooldown_s = 0.5
+
+        with schaos.device_lost(srv, "a", chip_idx=1) as dl:
+            futs = [srv.submit("a", payload) for _ in range(24)]
+            futs += [srv.submit("b", payload) for _ in range(12)]
+            for f in futs:
+                np.testing.assert_allclose(f.result(30.0), ref(payload),
+                                           rtol=1e-4, atol=1e-5)
+            # the chip actually died, was quarantined, and the survivors
+            # then served real traffic through the same executor
+            assert dl["faulted"] >= 1 and dl["passed"] >= 1
+            assert srv._sentinel.is_quarantined(1)
+            assert st_a.cache.chips == 1         # re-planned onto survivor
+            snap = srv._sentinel.snapshot()
+            assert snap["restore"] == {"a": 2}   # pre-loss placement noted
+
+        # counter proof: one device_lost quarantine, zero lost requests
+        assert catalog.CHIP_QUARANTINES.value(reason="device_lost") \
+            - q0 == 1
+        d_ok = {m: catalog.SERVE_REQUESTS.value(model=m, outcome="ok")
+                - ok0[m] for m in ("a", "b")}
+        assert d_ok["a"] >= 24 and d_ok["b"] >= 12
+        assert srv.stats("a")["deadline_violations"] == 0
+        assert srv.stats("b")["deadline_violations"] == 0
+        assert srv.stats("a")["counts"]["error"] == 0
+
+        # trace-ring proof: quarantine and replan landed as events
+        events = srv.tracer.traces(model="a", outcome="event")
+        spans = [s for t in events for s in t.spans]
+        assert any(s["stage"] == "replan"
+                   and s["tags"].get("reason") == "chip_loss"
+                   for s in spans)
+
+        # half-open re-admission after the cooldown: the chip re-admits
+        # and capacity restores to the pre-loss 2 chips (the worker tick
+        # drives it; idle traffic keeps the worker looping)
+        deadline = time.monotonic() + 10.0
+        while st_a.cache.chips != 2:
+            assert time.monotonic() < deadline, srv._sentinel.snapshot()
+            srv.predict("a", payload, timeout=30.0)
+            time.sleep(0.05)
+        assert not srv._sentinel.is_quarantined(1)
+        assert srv._sentinel.snapshot()["restore"] == {}
+        events = srv.tracer.traces(outcome="event")
+        assert any(s["stage"] == "readmit"
+                   for t in events for s in t.spans)
+        # still correct after restore
+        np.testing.assert_allclose(srv.predict("a", payload, timeout=30.0),
+                                   ref(payload), rtol=1e-4, atol=1e-5)
+    finally:
+        srv.close(timeout=10.0)
+    lockwatch.assert_no_findings()
+
+
+# --------------------------------------------------- hedging + retry budget
+@pytest.mark.chaos
+def test_hedging_rescues_stragglers(tiny):
+    """Every 3rd dispatch stalls 0.5s; hedging (80ms trigger) must answer
+    every request well before the stall — while the same straggler
+    WITHOUT hedging shows the full 0.5s tail. (80ms, not lower: a hedge
+    dispatch can itself land on the straggler's every-3rd slot, and the
+    rescue then comes from the primary once the worker frees — the
+    bigger trigger keeps that worst chain comfortably under the bar.)"""
+    _, _, feat, ref = tiny
+    srv = ModelServer([
+        _cfg(tiny, name="hm", hedge=True, hedge_delay_ms=80.0,
+             retry_budget=0.5),
+        _cfg(tiny, name="nm", hedge=False),
+    ]).start(warm=True)
+    d = np.random.RandomState(11).randn(*feat).astype("float32")
+    try:
+        st = srv._models["hm"]
+        with schaos.straggler_executor(srv, "hm", 0.5, every=3) as s1:
+            lat_hedged = []
+            for _ in range(12):
+                t0 = time.monotonic()
+                np.testing.assert_allclose(
+                    srv.predict("hm", d, timeout=30.0), ref(d),
+                    rtol=1e-4, atol=1e-5)
+                lat_hedged.append(time.monotonic() - t0)
+        assert s1["stalled"] >= 3
+        assert st.hedges["fired"] >= s1["stalled"]
+        assert st.hedges["won"] >= 1
+        assert catalog.SERVE_HEDGES.value(model="hm", outcome="won") >= 1
+        # every straggle was rescued: nothing waited out the full stall
+        assert max(lat_hedged) < 0.45, lat_hedged
+
+        with schaos.straggler_executor(srv, "nm", 0.5, every=3) as s2:
+            lat_plain = []
+            for _ in range(6):
+                t0 = time.monotonic()
+                srv.predict("nm", d, timeout=30.0)
+                lat_plain.append(time.monotonic() - t0)
+        assert s2["stalled"] >= 2
+        assert max(lat_plain) >= 0.45, lat_plain  # the tail hedging cut
+        assert srv.stats("hm")["deadline_violations"] == 0
+    finally:
+        srv.close(timeout=10.0)
+
+
+@pytest.mark.chaos
+def test_retry_budget_caps_hedge_traffic(tiny):
+    """With EVERY dispatch slow, every request wants a hedge — the
+    budget (10% + burst) must cap how many actually fire, and count the
+    denials (typed, never silent)."""
+    _, _, feat, _ = tiny
+    srv = ModelServer([_cfg(tiny, name="bm", hedge=True, hedge_delay_ms=5.0,
+                            retry_budget=0.1)]).start(warm=True)
+    d = np.zeros(feat, "float32")
+    den0 = catalog.RETRY_BUDGET_DENIED.value(model="bm", kind="hedge")
+    try:
+        st = srv._models["bm"]
+        with schaos.straggler_executor(srv, "bm", 0.05, every=1):
+            for _ in range(30):
+                srv.predict("bm", d, timeout=30.0)
+        h = dict(st.hedges)
+        # the cap: burst (5) + 10% of 30 admits, with a little slack for
+        # hedges of hedged dispatches
+        assert h["fired"] <= 10, h
+        assert h["budget_denied"] >= 5, h
+        assert catalog.RETRY_BUDGET_DENIED.value(model="bm", kind="hedge") \
+            - den0 == h["budget_denied"]
+        assert srv.stats("bm")["retry_budget"]["denied"]["hedge"] \
+            == h["budget_denied"]
+    finally:
+        srv.close(timeout=10.0)
+
+
+# --------------------------------------------------------- invariance guard
+def test_self_healing_is_hlo_invariant(tiny):
+    """The whole subsystem is host-side: with the sentinel idle and
+    hedging off (the defaults) the served StableHLO is BITWISE unchanged
+    by health.py existing, importing, or a server running with it."""
+    import jax
+
+    from mxnet_tpu import symbol as sym_mod
+    from mxnet_tpu.executor import _GraphLowering
+
+    sym_json, pbytes, feat, ref = tiny
+
+    def lowered_text():
+        sym = sym_mod.load_json(sym_json)
+        fn = _GraphLowering(sym).lower(is_train=False)
+        inputs = {"data": np.zeros((2,) + feat, np.float32),
+                  "fc1_weight": np.zeros((3, feat[0]), np.float32),
+                  "fc1_bias": np.zeros((3,), np.float32)}
+        return jax.jit(fn).lower(inputs, jax.random.PRNGKey(0)).as_text()
+
+    before = lowered_text()
+    srv = ModelServer([_cfg(tiny, name="inv")]).start(warm=True)
+    try:
+        assert srv._hedger is None               # nobody opted in
+        assert srv._sentinel.count() == 0
+        d = np.random.RandomState(2).randn(*feat).astype("float32")
+        np.testing.assert_allclose(srv.predict("inv", d, timeout=30.0),
+                                   ref(d), rtol=1e-4, atol=1e-5)
+    finally:
+        srv.close(timeout=10.0)
+    assert lowered_text() == before

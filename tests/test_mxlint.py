@@ -948,6 +948,49 @@ def test_lint_server_t214_silent_and_suppressed():
 
 
 # ---------------------------------------------------------------------------
+# MXL-T219: no-retry-budget — retries and/or hedged requests enabled with
+# no retry budget: a correlated failure amplifies offered load onto the
+# degraded backend (retry-storm). Pure config check via analysis.lint_server.
+# ---------------------------------------------------------------------------
+def test_lint_server_t219_flags_unbudgeted_duplicate_work():
+    # retries with no budget fires, naming the duplicate-work source
+    cfg = _serve_cfg(retries=2, retry_budget=0.0)
+    diags = analysis.lint_server(cfg).by_rule("MXL-T219")
+    assert len(diags) == 1
+    assert "retries=2" in diags[0].message
+    assert "retry-storm" in diags[0].message
+    assert diags[0].severity == "warning"
+    # hedging with no budget fires too, and both sources are named
+    diags = analysis.lint_server(
+        _serve_cfg(retries=0, hedge=True, retry_budget=0.0)
+    ).by_rule("MXL-T219")
+    assert len(diags) == 1 and "hedge=True" in diags[0].message
+    diags = analysis.lint_server(
+        _serve_cfg(retries=3, hedge=True, retry_budget=0.0)
+    ).by_rule("MXL-T219")
+    assert "retries=3" in diags[0].message
+    assert "hedge=True" in diags[0].message
+
+
+def test_lint_server_t219_silent_and_suppressed():
+    # the default config carries a budget (MXNET_SERVE_RETRY_BUDGET=0.1)
+    assert not analysis.lint_server(_serve_cfg()).by_rule("MXL-T219")
+    # any nonzero budget is silent
+    assert not analysis.lint_server(
+        _serve_cfg(retries=2, hedge=True, retry_budget=0.05)
+    ).by_rule("MXL-T219")
+    # no duplicate work at all: nothing to budget, silent
+    assert not analysis.lint_server(
+        _serve_cfg(retries=0, hedge=False, retry_budget=0.0)
+    ).by_rule("MXL-T219")
+    # suppression moves the finding to the suppressed list
+    report = analysis.lint_server(_serve_cfg(retries=2, retry_budget=0.0),
+                                  suppress=("MXL-T219",))
+    assert not report.by_rule("MXL-T219")
+    assert any(d.rule_id == "MXL-T219" for d in report.suppressed)
+
+
+# ---------------------------------------------------------------------------
 # MXL-G108: uncalibrated-quantized-graph — quantize nodes running with
 # runtime (defaulted) ranges instead of baked-in calibrated constants.
 # ---------------------------------------------------------------------------

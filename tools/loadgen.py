@@ -90,6 +90,19 @@ def main(argv=None) -> int:
                     help="with --tenants: attach a FleetController over "
                          "this chip budget (autoscaler + fair queueing "
                          "live during the run)")
+    ap.add_argument("--hedge", action="store_true",
+                    help="selfhost: enable hedged requests — a duplicate "
+                         "dispatch fires after the rolling-p99-derived "
+                         "delay and the first result wins (tail "
+                         "tolerance; spend capped by the retry budget)")
+    ap.add_argument("--hedge-delay-ms", type=float, default=None,
+                    help="hedge fire delay floor before enough latency "
+                         "samples exist (default MXNET_SERVE_HEDGE_"
+                         "DELAY_MS)")
+    ap.add_argument("--retry-budget", type=float, default=None,
+                    help="fraction of admitted requests that may be "
+                         "duplicated as retries+hedges (0 disables the "
+                         "cap; default MXNET_SERVE_RETRY_BUDGET)")
     ap.add_argument("--trace-dump", default=None, metavar="PATH",
                     help="selfhost: write the trace ring to PATH after "
                          "the run (pretty-print with tools/mxtrace.py) — "
@@ -156,11 +169,19 @@ def _run_selfhost(args, qps) -> int:
     except Exception as e:
         sys.stderr.write("loadgen: cannot import the backend: %r\n" % e)
         return 2
+    hedge_kwargs = {}
+    if args.hedge:
+        hedge_kwargs["hedge"] = True
+    if args.hedge_delay_ms is not None:
+        hedge_kwargs["hedge_delay_ms"] = args.hedge_delay_ms
+    if args.retry_budget is not None:
+        hedge_kwargs["retry_budget"] = args.retry_budget
     try:
         cfg = sload.model_config_from_files(
             args.model, params=args.params,
             feature_shape=args.feature_shape, buckets=args.buckets,
-            max_queue=args.max_queue, deadline_ms=args.deadline_ms)
+            max_queue=args.max_queue, deadline_ms=args.deadline_ms,
+            **hedge_kwargs)
         server = ModelServer([cfg]).start(warm=True)
     except Exception as e:
         sys.stderr.write("loadgen: cannot build the selfhost server: "
@@ -171,8 +192,18 @@ def _run_selfhost(args, qps) -> int:
                                duration_s=args.duration,
                                threads=args.threads,
                                deadline_ms=args.deadline_ms)
+        srv_stats = server.stats(cfg.name)
     finally:
         server.close(timeout=15.0)
+    if args.hedge:
+        hedges = srv_stats.get("hedges") or {}
+        budget = srv_stats.get("retry_budget") or {}
+        print("loadgen: hedges fired=%d won=%d lost=%d budget_denied=%d  "
+              "budget spent=%s denied=%s"
+              % (hedges.get("fired", 0), hedges.get("won", 0),
+                 hedges.get("lost", 0), hedges.get("budget_denied", 0),
+                 budget.get("spent") or {}, budget.get("denied") or {}),
+              flush=True)
     if args.trace_dump:
         try:
             server.dump_traces(args.trace_dump)

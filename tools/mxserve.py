@@ -66,9 +66,12 @@ def main(argv=None) -> int:
                     metavar="N",
                     help="serve N smoke requests through the full batching "
                          "path in-process, drain, and exit (no HTTP)")
-    ap.add_argument("--chaos", choices=("executor_fault",), default=None,
+    ap.add_argument("--chaos", choices=("executor_fault", "device_lost"),
+                    default=None,
                     help="selfcheck only: inject a deterministic executor "
-                         "fault so the degraded exit path is exercised")
+                         "fault (degraded exit path) or a DEVICE_LOST "
+                         "chip failure (quarantine + re-placement + "
+                         "re-dispatch self-healing path)")
     args = ap.parse_args(argv)
 
     try:
@@ -144,11 +147,15 @@ def _selfcheck(server, cfg, n, chaos_mode) -> int:
     from mxnet_tpu.serving import chaos as schaos
 
     rng = np.random.RandomState(7)
-    inject = (schaos.executor_fault(server, cfg.name, faults=1 << 30,
-                                    transient=False)
-              if chaos_mode == "executor_fault" else contextlib.nullcontext())
+    if chaos_mode == "executor_fault":
+        inject = schaos.executor_fault(server, cfg.name, faults=1 << 30,
+                                       transient=False)
+    elif chaos_mode == "device_lost":
+        inject = schaos.device_lost(server, cfg.name, chip_idx=0)
+    else:
+        inject = contextlib.nullcontext()
     futures = []
-    with inject:
+    with inject as chaos_stats:
         for _ in range(max(1, int(n))):
             futures.append(server.submit(
                 cfg.name, rng.randn(*cfg.feature_shape).astype("float32")))
@@ -163,6 +170,18 @@ def _selfcheck(server, cfg, n, chaos_mode) -> int:
     stats = server.stats(cfg.name)
     print("mxserve selfcheck: ok=%d failed=%d batches=%d counts=%s"
           % (ok, bad, stats["batches"], stats["counts"]), flush=True)
+    if chaos_mode == "device_lost":
+        sent = stats.get("sentinel") or {}
+        print("mxserve selfcheck: device_lost chip=%d faulted=%d "
+              "passed=%d quarantined=%s degraded_rung=%d"
+              % (chaos_stats["chip"], chaos_stats["faulted"],
+                 chaos_stats["passed"],
+                 sorted((sent.get("quarantined") or {}).keys()),
+                 stats.get("degraded_rung", 0)), flush=True)
+        # the self-healing bar: the chip was actually lost, the sentinel
+        # quarantined it, and the re-dispatched requests still answered
+        if not chaos_stats["faulted"] or not sent.get("quarantined"):
+            return 1
     return 0 if bad == 0 else 1
 
 
