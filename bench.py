@@ -585,7 +585,7 @@ def _foreign_tunnel_clients():
     markers = (_tunnel.MARKERS if _tunnel is not None else
                ("aot_warm.py", "perf_lab.py", "mxtune.py", "collbench.py",
                 "mxserve.py", "loadgen.py", "mxquant.py", "mxtrace.py",
-                "mxfleet.py", "mxmem.py", "tpu_session"))
+                "mxfleet.py", "mxmem.py", "mxrollout.py", "tpu_session"))
     found = []
     try:
         for pid in os.listdir("/proc"):

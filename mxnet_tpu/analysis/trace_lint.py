@@ -76,6 +76,13 @@ Rules (catalog in docs/static_analysis.md):
                                           failure amplifies offered load
                                           onto the degraded backend
                                           (retry-storm)
+* MXL-T220 ungated-rollout      (warning) a live model rollout ramps with
+                                          its safety gates off: automatic
+                                          rollback disabled, shadow
+                                          agreement sampling off, or a
+                                          canary with no SLO — a bad
+                                          version reaches 100% of traffic
+                                          with nothing to stop it
 """
 from __future__ import annotations
 
@@ -236,6 +243,20 @@ register_rule(
     "ModelConfig(retry_budget=) or MXNET_SERVE_RETRY_BUDGET (the "
     "default 0.1 ≈ 10%; the budget is shared by retries and hedges and "
     "denials are counted, not silent).")
+register_rule(
+    "MXL-T220", "warning", "ungated-rollout",
+    "A live model rollout ramps toward 100% of traffic with one or more "
+    "of its safety gates off: automatic rollback disabled "
+    "(rollback=False — the gate evaluates but only logs; a failing "
+    "canary keeps its traffic share), shadow agreement sampling off "
+    "(shadow_sample=0 — a silently-wrong canary that meets its latency "
+    "SLO ramps to 100% unchallenged), or a canary version that declares "
+    "no SLO (slo_p99_ms=0 — the burn-rate gate is blind; only the "
+    "coarser p99-vs-incumbent delta remains). The whole point of a "
+    "staged rollout is that evidence can stop it; every disabled gate "
+    "is a class of regression that ships. Keep MXNET_ROLLOUT_ROLLBACK "
+    "and MXNET_ROLLOUT_SHADOW_SAMPLE on, and give the candidate config "
+    "a slo_p99_ms objective.")
 register_rule(
     "MXL-T211", "warning", "untuned-hot-loop",
     "The trainer runs with all-default perf levers while the autotuner "
@@ -624,8 +645,9 @@ def lint_data_iter(data_iter, *, suppress: Sequence[str] = (),
 def lint_server(server_or_config, *, suppress: Sequence[str] = (),
                 subject: str = "") -> Report:
     """Lint a serving configuration for overload-safety, observability,
-    tenant isolation, memory budgeting and retry hygiene (MXL-T214 /
-    MXL-T215 / MXL-T216 / MXL-T217 / MXL-T218 / MXL-T219).
+    tenant isolation, memory budgeting, retry hygiene and rollout
+    gating (MXL-T214 / MXL-T215 / MXL-T216 / MXL-T217 / MXL-T218 /
+    MXL-T219 / MXL-T220).
 
     Accepts a :class:`~mxnet_tpu.serving.server.ModelServer` (every model
     is checked), a :class:`~mxnet_tpu.serving.fleet.FleetController`
@@ -888,6 +910,51 @@ def lint_server(server_or_config, *, suppress: Sequence[str] = (),
                 hint="set MXNET_HBM_BYTES to the chip's capacity (or "
                      "serve on a device kind memwatch knows) — "
                      "docs/observability.md, 'Memory observability'"))
+    # ---- ungated rollout (MXL-T220): needs the live server (rollouts
+    # hang off server._rollout) — a bare ModelConfig, a server with no
+    # rollout manager, or a manager with only terminal rollouts stays
+    # silent. Fires once per disabled gate per in-flight rollout.
+    mgr = getattr(srv, "_rollout", None) if srv is not None else None
+    if mgr is not None:
+        for ro in list(getattr(mgr, "_rollouts", {}).values()):
+            if ro.state not in ("loading", "serving"):
+                continue
+            loc = "rollout %s@%s" % (ro.model, ro.version)
+            if not ro.knobs.get("rollback", True):
+                report.add(Diagnostic(
+                    "MXL-T220",
+                    "rollout of %r version %r ramps with automatic "
+                    "rollback DISABLED (rollback=False): the gate "
+                    "evaluates but only records gate_failed events — a "
+                    "failing canary keeps its traffic share until an "
+                    "operator notices" % (ro.model, ro.version),
+                    location=loc,
+                    hint="leave MXNET_ROLLOUT_ROLLBACK=1 (or drop the "
+                         "rollback=False override) — docs/serving.md, "
+                         "'Safe rollout'"))
+            if float(ro.knobs.get("shadow_sample", 0.0) or 0.0) <= 0.0:
+                report.add(Diagnostic(
+                    "MXL-T220",
+                    "rollout of %r version %r ramps with shadow "
+                    "agreement sampling OFF (shadow_sample=0): a canary "
+                    "that answers quickly but wrongly sails through the "
+                    "latency/error gates and ships an accuracy "
+                    "regression" % (ro.model, ro.version),
+                    location=loc,
+                    hint="set MXNET_ROLLOUT_SHADOW_SAMPLE (default "
+                         "0.25) or the shadow_sample= start knob — "
+                         "docs/serving.md, 'Safe rollout'"))
+            if not float(getattr(ro.cfg, "slo_p99_ms", 0.0) or 0.0):
+                report.add(Diagnostic(
+                    "MXL-T220",
+                    "the canary version %r of model %r declares no SLO "
+                    "(slo_p99_ms=0): the burn-rate gate is blind to it; "
+                    "only the coarser p99-vs-incumbent delta can catch "
+                    "a latency regression" % (ro.version, ro.model),
+                    location=loc,
+                    hint="give the candidate ModelConfig(slo_p99_ms=) "
+                         "an objective — docs/serving.md, 'Safe "
+                         "rollout'"))
     return report
 
 

@@ -263,6 +263,36 @@ RETRY_BUDGET_DENIED = _m.counter(
     "kind=retry|hedge. Denials fail fast and typed — a climbing counter "
     "under overload is the budget doing its job (no retry storm).")
 
+# --------------------------------------------------------------- rollout
+ROLLOUT_STAGE = _m.gauge(
+    "mxtpu_rollout_stage",
+    "Current ramp stage of the model's live rollout, labeled model=: "
+    "0 shadow, 1/2/3 the 1%/10%/50% canary stages, 4 the 100% stage "
+    "(left there once promoted), -1 rolled back/aborted. Transitions "
+    "are edge-triggered and also land in the trace ring as 'rollout' "
+    "events.")
+ROLLOUT_ROLLBACKS = _m.counter(
+    "mxtpu_rollout_rollbacks_total",
+    "Automatic or operator rollbacks of a canary version, labeled "
+    "reason= (breaker|error_rate|slo_burn|p99_delta|agreement|operator|"
+    "abort). One bump per rollback transition, never per request — "
+    "perfwatch treats a climbing count as a regression signal "
+    "(down-is-good).")
+ROLLOUT_SHADOW_AGREEMENT = _m.gauge(
+    "mxtpu_rollout_shadow_agreement",
+    "Rolling top-1 agreement between the canary's shadow answers and "
+    "the incumbent's served answers, labeled model= (1.0 = identical "
+    "argmax on every sampled request; the gate rolls back below "
+    "MXNET_ROLLOUT_MIN_AGREEMENT). Same statistic the quant "
+    "evaluate_agreement harness reports for int8 tiers.")
+ROLLOUT_VERSION_REQUESTS = _m.counter(
+    "mxtpu_rollout_version_requests_total",
+    "Model-server requests attributed to a rollout version, labeled "
+    "model=, version= and outcome= (same outcomes as "
+    "mxtpu_serve_requests_total). The zero-downtime proof: a retired "
+    "version's counters stop moving after the swap, and the per-version "
+    "sum equals the model's total while a rollout is configured.")
+
 # ----------------------------------------------------------------- fleet
 FLEET_RESIZES = _m.counter(
     "mxtpu_fleet_resizes_total",
@@ -372,6 +402,8 @@ MEM_REFUSALS = _m.counter(
     "no_memory (fleet grow/resize whose post-state would not fit the "
     "per-chip HBM budget) | load (ModelServer refused to load a model "
     "whose estimated footprint exceeds the remaining budget) | "
+    "rollout (a canary version refused at load because it would not fit "
+    "next to the resident versions — the incumbent keeps serving) | "
     "predicted_oom (tuner candidate skipped because its predicted "
     "footprint exceeds the budget).")
 

@@ -69,6 +69,14 @@ METRIC_DIRECTIONS: Dict[str, int] = {
                                # fatter executable is a regression
     "footprint_bytes": -1,     # estimated resident bytes/chip (tuner
                                # trial / memwatch footprint)
+    "rollout_agreement": +1,   # shadow top-1 agreement (worst model of
+                               # mxtpu_rollout_shadow_agreement, or a
+                               # loadgen --during-rollout ledger row):
+                               # canary answers drifting from the
+                               # incumbent is a regression
+    "rollout_rollbacks": -1,   # sum over reasons of
+                               # mxtpu_rollout_rollbacks_total: gate
+                               # rollbacks trending up is a regression
 }
 
 DEFAULT_THRESHOLD_PCT = 10.0
@@ -136,6 +144,24 @@ def normalize(doc: Any, source: str = "") -> Optional[Dict[str, Any]]:
                 denied = (denied or 0.0) + float(v)
         if denied is not None:
             vals["budget_denied"] = denied
+        # rollout gate health: worst model's shadow agreement (labeled
+        # model=, up-is-good so the MIN is the worst), total rollbacks
+        agree = None
+        for s in (fams.get("mxtpu_rollout_shadow_agreement") or {}) \
+                .get("series", []):
+            v = s.get("value")
+            if v is not None:
+                agree = float(v) if agree is None else min(agree, float(v))
+        if agree is not None:
+            vals["rollout_agreement"] = agree
+        rb = None
+        for s in (fams.get("mxtpu_rollout_rollbacks_total") or {}) \
+                .get("series", []):
+            v = s.get("value")
+            if v is not None:
+                rb = (rb or 0.0) + float(v)
+        if rb is not None:
+            vals["rollout_rollbacks"] = rb
         return {"kind": "snapshot", "source": source, "metrics": vals}
     if "metric" in doc and "value" in doc:
         vals = {"throughput": float(doc["value"])}
@@ -154,6 +180,10 @@ def normalize(doc: Any, source: str = "") -> Optional[Dict[str, Any]]:
         for k in ("qps", "p50_ms", "p99_ms"):
             if doc.get(k) is not None:
                 vals[k] = float(doc[k])
+        ro = doc.get("rollout")
+        if isinstance(ro, dict) and ro.get("agreement") is not None:
+            # loadgen --during-rollout evidence riding the serving row
+            vals["rollout_agreement"] = float(ro["agreement"])
         return {"kind": "serving_row", "source": source, "metrics": vals,
                 "model": doc.get("model"),
                 "provenance": doc.get("provenance")}

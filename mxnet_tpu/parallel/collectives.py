@@ -46,8 +46,8 @@ def _count_dispatch(op: str, arrays) -> None:
 __all__ = ["allreduce", "allgather", "reduce_scatter", "broadcast", "ppermute",
            "all_to_all", "psum_arrays", "cross_process_allreduce",
            "cross_process_allreduce_many", "cross_process_alltoall",
-           "cross_process_allgather_tiled", "bucket_assignment",
-           "bucketed_allreduce"]
+           "cross_process_allgather_tiled", "cross_process_broadcast0",
+           "bucket_assignment", "bucketed_allreduce"]
 
 
 # ---- inside-shard_map primitives (thin, named-axis) -----------------------
@@ -80,6 +80,98 @@ def all_to_all(x, axis_name: str, split_axis: int, concat_axis: int):
                           concat_axis=concat_axis, tiled=True)
 
 
+# ---- coordination-service fallback ----------------------------------------
+# XLA cross-process collectives need backend support (TPU ICI/DCN, or a
+# CPU/GPU build with cross-host collectives). jax 0.4.x's CPU backend has
+# none — every multiprocess computation raises "Multiprocess computations
+# aren't implemented on the CPU backend" — yet the dist kvstore must still
+# work there (tests/test_dist.py runs real multi-process clusters on CPU).
+# The coordination service (already joined for barriers/heartbeats) is a
+# correct, slow wire: each rank publishes its host array under a
+# round-numbered key and reads every peer's. Used only when the XLA path
+# is impossible; TPU traffic never touches it.
+
+_coord_rounds: dict = {}
+
+
+@functools.lru_cache(maxsize=1)
+def _xla_cross_process_ok() -> bool:
+    """Probe (once, collectively — every rank calls this before its first
+    host-level collective, in the same program order) whether the backend
+    can run a real multiprocess computation."""
+    if jax.process_count() == 1:
+        return True
+    try:
+        from jax.experimental import multihost_utils
+        multihost_utils.process_allgather(jnp.zeros((1,), jnp.float32)[None],
+                                          tiled=True)
+        return True
+    except Exception:
+        return False
+
+
+def _coord_timeout_ms() -> int:
+    from ..base import get_env
+    return int(float(get_env("MXNET_KVSTORE_BARRIER_TIMEOUT", 300.0)) * 1000)
+
+
+def _coord_gather(x, tag: str):
+    """Rank-ordered list of every process's copy of host array ``x``,
+    exchanged over the coordination KV. Per-tag round numbers keep
+    successive calls collision-free (ranks call collectives in identical
+    program order — the same invariant barrier ids rely on)."""
+    import numpy as np
+
+    from .. import kvstore as _kv
+    client = _kv._dist_client()
+    if client is None:
+        raise RuntimeError("coordination-service collective fallback "
+                           "requires a joined jax.distributed cluster")
+    nprocs, rank = jax.process_count(), jax.process_index()
+    rnd = _coord_rounds.get(tag, 0)
+    _coord_rounds[tag] = rnd + 1
+    key = lambda rr, p: "mxcoll/%s/%d/%d" % (tag, rr, p)
+    client.key_value_set_bytes(key(rnd, rank),
+                               _kv._encode_array(np.asarray(x)))
+    timeout_ms = _coord_timeout_ms()
+    out = [np.asarray(_kv._decode_array(
+        client.blocking_key_value_get_bytes(key(rnd, p), timeout_ms)))
+        for p in range(nprocs)]
+    # reclaim this rank's round-(n-2) key: every peer observed in round
+    # rnd-1 had fully finished its rnd-2 reads (calls are sequential per
+    # rank), so nobody can still need it
+    if rnd >= 2:
+        try:
+            client.key_value_delete(key(rnd - 2, rank))
+        except Exception:
+            pass
+    return out
+
+
+def cross_process_broadcast0(x):
+    """Every process gets process 0's host-local array (the kvstore init
+    weight broadcast). XLA collective when the backend supports it, the
+    coordination KV otherwise (one write by rank 0, one read per peer;
+    keys are kept — init runs a bounded number of times and a reader may
+    lag arbitrarily, so reclaiming here could strand it)."""
+    if jax.process_count() == 1:
+        return jnp.asarray(x)
+    _count_dispatch("cp_broadcast", (x,))
+    if _xla_cross_process_ok():
+        from jax.experimental import multihost_utils
+        return jnp.asarray(multihost_utils.broadcast_one_to_all(x))
+    from .. import kvstore as _kv
+    client = _kv._dist_client()
+    rnd = _coord_rounds.get("bcast0", 0)
+    _coord_rounds["bcast0"] = rnd + 1
+    key = "mxcoll/bcast0/%d" % rnd
+    if jax.process_index() == 0:
+        import numpy as np
+        client.key_value_set_bytes(key, _kv._encode_array(np.asarray(x)))
+    blob = client.blocking_key_value_get_bytes(key, _coord_timeout_ms())
+    return jnp.asarray(_kv._decode_array(blob))
+
+
 # ---- host-level helpers ----------------------------------------------------
 @functools.lru_cache(maxsize=64)
 def _psum_fn(mesh: Mesh, axis: str, n: int):
@@ -106,6 +198,10 @@ def cross_process_allreduce(x):
     if jax.process_count() == 1:
         return x
     _count_dispatch("cp_allreduce", (x,))
+    if not _xla_cross_process_ok():
+        import numpy as np
+        parts = _coord_gather(x, "allreduce")
+        return jnp.asarray(np.sum(np.stack(parts, axis=0), axis=0))
     from jax.experimental import multihost_utils
     gathered = multihost_utils.process_allgather(x[None], tiled=True)
     return jnp.asarray(gathered).sum(axis=0)
@@ -155,6 +251,13 @@ def cross_process_alltoall(x):
     if nprocs == 1:
         return x
     _count_dispatch("cp_alltoall", (x,))
+    if not _xla_cross_process_ok():
+        import numpy as np
+        # row p of MY result is row my_rank of rank p's matrix
+        parts = _coord_gather(x, "alltoall")
+        mine = jax.process_index()
+        return jnp.asarray(np.stack([parts[p][mine]
+                                     for p in range(nprocs)], axis=0))
     from jax.experimental import multihost_utils
     mesh, fn = _alltoall_fn(nprocs)
     g = multihost_utils.host_local_array_to_global_array(
@@ -193,6 +296,10 @@ def cross_process_allgather_tiled(x):
     if jax.process_count() == 1:
         return jnp.asarray(x)
     _count_dispatch("cp_allgather", (x,))
+    if not _xla_cross_process_ok():
+        import numpy as np
+        parts = _coord_gather(np.asarray(x), "allgather")
+        return jnp.asarray(np.concatenate(parts, axis=0).reshape(-1))
     from jax.experimental import multihost_utils
     return jnp.asarray(
         multihost_utils.process_allgather(jnp.asarray(x)[None], tiled=True)

@@ -161,8 +161,11 @@ def evaluate_agreement(sym, arg_params, aux_params, qsym, qarg_params,
     int8 = _bind_forward(qsym, qarg_params, qaux_params)
     f32_top, int8_top = [], []
     for batch in eval_data:
-        x = np.asarray(batch.data[0].asnumpy()
-                       if hasattr(batch, "data") else batch)
+        # DataBatch duck-check must exclude ndarray: np.ndarray.data is
+        # a memoryview, not an iterator payload
+        is_databatch = (hasattr(batch, "data")
+                        and not isinstance(batch, np.ndarray))
+        x = np.asarray(batch.data[0].asnumpy() if is_databatch else batch)
         f32_top.append(np.argmax(f32(x), axis=-1))
         int8_top.append(np.argmax(int8(x), axis=-1))
     f32_top = np.concatenate(f32_top) if f32_top else np.zeros(0, np.int64)

@@ -41,9 +41,10 @@ __all__ = ["ModelConfig", "ModelServer", "PendingResult",
            "ServingError", "Overloaded", "DeadlineExceeded", "Draining",
            "CircuitOpen", "ExecutorFault", "QuotaExceeded", "Preempted",
            "MemoryBudgetExceeded", "ChipQuarantined",
+           "RolloutManager", "Rollout",
            "run_load", "verdict", "ledger_row", "fleet_row",
            "chaos", "load", "server", "errors", "breaker", "queueing",
-           "executors", "endpoints", "fleet", "health"]
+           "executors", "endpoints", "fleet", "health", "rollout"]
 
 _lazy_attrs = {
     "ModelConfig": ".server", "ModelServer": ".server",
@@ -56,6 +57,7 @@ _lazy_attrs = {
     "ServingEndpoints": ".endpoints",
     "FleetController": ".fleet", "TenantPolicy": ".fleet",
     "DeviceSentinel": ".health", "DegradedLadder": ".health",
+    "RolloutManager": ".rollout", "Rollout": ".rollout",
     "ServingError": ".errors", "Overloaded": ".errors",
     "DeadlineExceeded": ".errors", "Draining": ".errors",
     "CircuitOpen": ".errors", "ExecutorFault": ".errors",
@@ -65,7 +67,7 @@ _lazy_attrs = {
     "fleet_row": ".load",
 }
 _lazy_mods = {"chaos", "load", "server", "errors", "breaker", "queueing",
-              "executors", "endpoints", "fleet", "health"}
+              "executors", "endpoints", "fleet", "health", "rollout"}
 
 
 def __getattr__(name):

@@ -77,7 +77,8 @@ __all__ = ["slow_client", "request_storm", "paced_run", "trace_evidence",
            "poison_payload", "POISON_SENTINEL",
            "chip_scaled_executor", "tenant_storm",
            "hbm_pressure", "oom_executor",
-           "device_lost", "straggler_executor", "quarantine_flap"]
+           "device_lost", "straggler_executor", "quarantine_flap",
+           "bad_canary"]
 
 # a value a legitimate float32 payload never carries (finite, but at the
 # edge of range) — the poison marker the patched executor looks for
@@ -561,6 +562,62 @@ def oom_executor(server, model: str, faults: int = 1):
         yield state
     finally:
         st.cache.run = orig
+
+
+@contextlib.contextmanager
+def bad_canary(server, model: str, mode: str = "skew",
+               delay: float = 0.2, shift: int = 1):
+    """Break ONLY the canary version of an in-flight rollout — the
+    incumbent keeps serving untouched. The failure the rollout gate must
+    catch before the bad version reaches 100% of traffic:
+
+    =========  ==========================================================
+    mode        what the canary does
+    =========  ==========================================================
+    ``skew``    silently wrong answers: output rows are rolled along the
+                class axis so the argmax moves — shadow agreement
+                collapses (the accuracy regression an SLO alone misses)
+    ``latency`` every canary dispatch takes ``delay`` extra seconds —
+                canary p99 blows past the incumbent-relative slack and
+                the canary SLO fast-burns
+    ``fault``   every canary dispatch raises a deterministic
+                ``ChaosError`` — error-rate gate / breaker territory
+    =========  ==========================================================
+
+    Yields a dict with the live ``calls`` count. Restore tolerates the
+    canary having been retired mid-injection (``cache`` dropped)."""
+    if mode not in ("skew", "latency", "fault"):
+        raise ChaosError("bad_canary: unknown mode %r" % (mode,))
+    mgr = getattr(server, "_rollout", None)
+    ro = mgr.get(model) if mgr is not None else None
+    if ro is None or ro.canary is None:
+        raise ChaosError("bad_canary: model %r has no canary in flight"
+                         % (model,))
+    can = ro.canary
+    cache = can.cache
+    if cache is None:
+        raise ChaosError("bad_canary: canary for %r already retired"
+                         % (model,))
+    orig = cache.run
+    state = {"calls": 0, "mode": mode}
+
+    def run(batch):
+        state["calls"] += 1
+        if mode == "fault":
+            raise ChaosError("chaos: bad canary deterministic fault")
+        if mode == "latency":
+            time.sleep(delay)
+            return orig(batch)
+        out = np.asarray(orig(batch))
+        return np.roll(out, shift, axis=-1)
+
+    cache.run = run
+    try:
+        yield state
+    finally:
+        live = can.cache
+        if live is not None and getattr(live, "run", None) is run:
+            live.run = orig
 
 
 def poison_payload(feature_shape, sentinel: float = POISON_SENTINEL

@@ -32,6 +32,13 @@ accepts an optional ``"priority"`` field and every /predict response
 carries ``X-Fleet-Tenant`` / ``X-Fleet-Priority`` / ``X-Fleet-Chips``
 headers naming the tenant's current placement.
 
+With a rollout manager attached (``serving/rollout.py``), ``GET
+/rolloutz`` answers the rollout status document (404 with rollout mode
+off) and ``POST /rolloutz`` carries the operator actions
+(``start``/``promote``/``rollback``/``abort`` — ``tools/mxrollout.py``
+is the CLI over both): a canary that doesn't fit the HBM budget is
+refused 409 typed, never loaded onto the incumbent's chips.
+
 /predict is also the trace edge: an inbound W3C ``traceparent`` header
 is parsed into a :class:`~mxnet_tpu.observability.tracing.TraceContext`
 (a fresh one is minted when absent/malformed) and propagated through the
@@ -119,8 +126,72 @@ def _make_handler(server):
                                       "attached (fleet mode off)"})
                 else:
                     self._reply(200, fleet.status())
+            elif self.path == "/rolloutz":
+                rollout = getattr(server, "_rollout", None)
+                if rollout is None:
+                    self._reply(404, {"error": "no rollout manager "
+                                      "attached (rollout mode off)"})
+                else:
+                    self._reply(200, rollout.status())
             else:
                 self._reply(404, {"error": "unknown path %r" % self.path})
+
+        def _post_rollout(self):
+            """POST /rolloutz: {"action": start|promote|rollback|abort,
+            "model": ..., start extras: "version", "tier", "param_b64",
+            "symbol_json", "stage", knob overrides in "knobs"}. Typed
+            refusals (a canary that doesn't fit HBM, a duplicate
+            rollout) answer 409; unknown models 404."""
+            import base64
+
+            from ..base import MXNetError
+            from .rollout import RolloutManager
+            try:
+                n = int(self.headers.get("Content-Length", "0"))
+                doc = json.loads(self.rfile.read(n) or b"{}")
+                action = doc["action"]
+                model = doc["model"]
+            except (KeyError, ValueError, TypeError) as e:
+                self._reply(400, {"error": "bad request: %r" % (e,)})
+                return
+            try:
+                if action == "start":
+                    mgr = RolloutManager.attach(server)
+                    param_bytes = None
+                    if doc.get("param_b64") is not None:
+                        param_bytes = base64.b64decode(doc["param_b64"])
+                    ro = mgr.start(
+                        model, doc.get("version", "candidate"),
+                        symbol_json=doc.get("symbol_json"),
+                        param_bytes=param_bytes, tier=doc.get("tier"),
+                        stage=doc.get("stage"),
+                        **(doc.get("knobs") or {}))
+                    self._reply(200, ro.status())
+                    return
+                rollout = getattr(server, "_rollout", None)
+                if rollout is None:
+                    self._reply(404, {"error": "no rollout manager "
+                                      "attached (rollout mode off)"})
+                    return
+                if action == "promote":
+                    self._reply(200, rollout.promote(model))
+                elif action == "rollback":
+                    self._reply(200, rollout.rollback(
+                        model, reason=str(doc.get("reason", "operator"))))
+                elif action == "abort":
+                    self._reply(200, rollout.abort(model))
+                else:
+                    self._reply(400, {"error": "unknown rollout action "
+                                      "%r" % (action,)})
+            except MemoryBudgetExceeded as e:
+                # typed refusal surface: the canary does not fit next to
+                # the resident versions — the incumbent keeps serving
+                self._reply(409, {"error": str(e),
+                                  "type": "MemoryBudgetExceeded"})
+            except MXNetError as e:
+                code = 409 if "already has rollout" in str(e) else 404
+                self._reply(code, {"error": str(e),
+                                   "type": type(e).__name__})
 
         def _post_fleet_resize(self):
             fleet = getattr(server, "_fleet", None)
@@ -160,6 +231,9 @@ def _make_handler(server):
         def do_POST(self):
             if self.path == "/fleetz/resize":
                 self._post_fleet_resize()
+                return
+            if self.path == "/rolloutz":
+                self._post_rollout()
                 return
             if self.path != "/predict":
                 self._reply(404, {"error": "unknown path %r" % self.path})

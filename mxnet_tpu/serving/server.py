@@ -407,6 +407,11 @@ class ModelServer:
         # off — admission, dispatch and the served HLO are bitwise
         # identical to a pre-fleet server (pinned by test_fleet.py)
         self._fleet = None
+        # versioned-rollout manager (serving/rollout.py), attached via
+        # RolloutManager.attach(server); None (the default) = rollout
+        # mode off — submit, stats() and the served HLO are byte-
+        # identical to a rollout-less server (pinned by test_rollout.py)
+        self._rollout = None
         self._guard = None
         self._started = False
         self._stopped = False
@@ -448,13 +453,20 @@ class ModelServer:
             # the worker decided it may exit (it would hang forever)
             for st in self._models.values():
                 st.queue.close()
+            if self._rollout is not None:
+                self._rollout.begin_drain()
 
     def drain(self, timeout: Optional[float] = None) -> bool:
         """begin_drain + wait for every queue to empty and every worker to
         exit. Returns True when fully drained within ``timeout``."""
         self.begin_drain()
         deadline = None if timeout is None else _now() + timeout
-        for st in self._models.values():
+        states = list(self._models.values())
+        if self._rollout is not None:
+            # live canary versions drain exactly like primary models:
+            # accepted work finishes, their workers exit on empty+closed
+            states += self._rollout.worker_states()
+        for st in states:
             if st.worker is not None:
                 left = None if deadline is None else max(0.0, deadline - _now())
                 st.worker.join(timeout=left)
@@ -472,7 +484,10 @@ class ModelServer:
         if self._hedger is not None:
             self._hedger.stop()
         self._sentinel.stop()
-        for st in self._models.values():
+        states = list(self._models.values())
+        if self._rollout is not None:
+            states += self._rollout.worker_states()
+        for st in states:
             for req in st.queue.drain_remaining():
                 self._complete(st, req, error=Draining(
                     "server closed before this request was dispatched"),
@@ -523,6 +538,16 @@ class ModelServer:
                              % (model, ", ".join(sorted(self._models))))
         if not self._started:
             raise MXNetError("server not started")
+        # the rollout traffic splitter: with a live rollout the request
+        # hash may route admission to the canary version's own state
+        # (queue/breaker/SLO) — deterministic on the trace id, so a
+        # client retry never flip-flops versions and the retry/hedge
+        # paths below act on whichever version admitted it. No rollout
+        # attached = one None check, the path is untouched.
+        route = self._rollout.route(model, trace) \
+            if self._rollout is not None else None
+        if route is not None and route.state is not None:
+            st = route.state
         try:
             self._check_draining()
         except Draining:
@@ -587,6 +612,11 @@ class ModelServer:
             st.budget.deposit()
         if self._hedger is not None and st.cfg.hedge:
             self._hedger.register(st, req)
+        if route is not None and route.shadow:
+            # shadow dual-dispatch: the canary sees the same input on
+            # its own executable, the incumbent's answer stays the only
+            # one the client gets (agreement evidence, never traffic)
+            self._rollout.shadow_dispatch(route.rollout, req)
         for dead in shed:
             self._complete(st, dead, error=DeadlineExceeded(
                 "deadline passed while queued (shed at admission)"),
@@ -629,6 +659,11 @@ class ModelServer:
             # limited — half-open re-admission and de-escalation checks.
             # Runs OUTSIDE dispatch_mutex: effects take it themselves.
             self._sentinel.tick(st)
+            # rollout tick (same discipline): gate evaluation, stage
+            # promotion and canary retirement ride the worker loop —
+            # the hot-swap takes dispatch_mutex itself
+            if self._rollout is not None:
+                self._rollout.tick(st)
             wait_s = st.queue.effective_wait(cfg.max_wait_ms / 1e3)
             batch, expired = st.queue.take_batch(
                 st.cache.max_bucket, wait_s, stop_requested)
@@ -1023,6 +1058,13 @@ class ModelServer:
             if st.cfg.tier == "int8":
                 _c.QUANT_SERVE_REQUESTS.inc(model=st.cfg.name,
                                             outcome=outcome)
+            ver = getattr(st, "rollout_version", None)
+            if ver is not None:
+                # per-version outcome attribution while a rollout is
+                # (or was) configured: the zero-downtime proof reads
+                # these deltas — a retired version's counters stop
+                _c.ROLLOUT_VERSION_REQUESTS.inc(
+                    model=st.cfg.name, version=ver, outcome=outcome)
 
     def _observe_latency(self, st: _ModelState, ms: float,
                          trace_id: Optional[str] = None) -> None:
@@ -1039,6 +1081,10 @@ class ModelServer:
             _c.SERVE_BATCH.observe(size, model=st.cfg.name)
 
     def _gauge_depth(self, st: _ModelState) -> None:
+        if getattr(st, "rollout_canary", False):
+            # the model's depth gauge stays the incumbent queue's: two
+            # states flapping one {model} gauge would render as noise
+            return
         from ..observability import metrics as _m
         if _m.enabled():
             from ..observability import catalog as _c
@@ -1100,6 +1146,11 @@ class ModelServer:
             # only when a fleet is attached: stats() output with fleet
             # mode off is byte-identical to pre-fleet servers
             out["fleet"] = self._fleet.model_status(model)
+        if self._rollout is not None:
+            # same discipline for rollouts: no manager, no key
+            ro = self._rollout.model_status(model)
+            if ro is not None:
+                out["rollout"] = ro
         if lat.size:
             out["p50_ms"] = float(np.percentile(lat, 50))
             out["p99_ms"] = float(np.percentile(lat, 99))

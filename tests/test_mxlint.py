@@ -991,6 +991,63 @@ def test_lint_server_t219_silent_and_suppressed():
 
 
 # ---------------------------------------------------------------------------
+# MXL-T220: ungated-rollout — a live rollout ramps with rollback disabled,
+# shadow agreement sampling off, or a canary with no SLO. Needs the live
+# server (rollouts hang off server._rollout) via analysis.lint_server.
+# ---------------------------------------------------------------------------
+def _rollout_server(monkeypatch, slo_p99_ms=50.0, **knobs):
+    """A server with one in-flight rollout, deterministically held in
+    the 'loading' state (the background loader is stubbed out — lint is
+    a pure config check, nothing should compile)."""
+    from mxnet_tpu.serving import ModelServer
+    from mxnet_tpu.serving import rollout as srollout
+    monkeypatch.setattr(srollout.RolloutManager, "_load",
+                        lambda self, ro, st: None)
+    srv = ModelServer([_serve_cfg(name="rm", slo_p99_ms=slo_p99_ms)],
+                      drain_on_preemption=False)
+    mgr = srollout.RolloutManager.attach(srv)
+    ro = mgr.start("rm", "v2", **knobs)
+    return srv, mgr, ro
+
+
+def test_lint_server_t220_flags_every_disabled_gate(monkeypatch):
+    srv, _, _ = _rollout_server(monkeypatch, slo_p99_ms=0.0,
+                                rollback=False, shadow_sample=0.0)
+    diags = analysis.lint_server(srv).by_rule("MXL-T220")
+    assert len(diags) == 3          # one per disabled gate
+    msgs = " | ".join(d.message for d in diags)
+    assert "rollback DISABLED" in msgs
+    assert "shadow" in msgs and "shadow_sample=0" in msgs
+    assert "no SLO" in msgs
+    assert all(d.severity == "warning" for d in diags)
+    assert all("rm@v2" in d.location for d in diags)
+    # one gate off -> exactly that one finding
+    srv, _, _ = _rollout_server(monkeypatch, rollback=False)
+    diags = analysis.lint_server(srv).by_rule("MXL-T220")
+    assert len(diags) == 1 and "rollback DISABLED" in diags[0].message
+
+
+def test_lint_server_t220_silent_and_suppressed(monkeypatch):
+    # no rollout manager at all: silent
+    from mxnet_tpu.serving import ModelServer
+    srv = ModelServer([_serve_cfg(name="rm", slo_p99_ms=50.0)],
+                      drain_on_preemption=False)
+    assert not analysis.lint_server(srv).by_rule("MXL-T220")
+    # fully gated rollout (defaults + an SLO): silent
+    srv, _, ro = _rollout_server(monkeypatch)
+    assert not analysis.lint_server(srv).by_rule("MXL-T220")
+    # terminal rollout: nothing is ramping, silent even when ungated
+    srv, _, ro = _rollout_server(monkeypatch, rollback=False)
+    ro.state = "rolled_back"
+    assert not analysis.lint_server(srv).by_rule("MXL-T220")
+    # suppression moves the finding to the suppressed list
+    srv, _, _ = _rollout_server(monkeypatch, rollback=False)
+    report = analysis.lint_server(srv, suppress=("MXL-T220",))
+    assert not report.by_rule("MXL-T220")
+    assert any(d.rule_id == "MXL-T220" for d in report.suppressed)
+
+
+# ---------------------------------------------------------------------------
 # MXL-G108: uncalibrated-quantized-graph — quantize nodes running with
 # runtime (defaulted) ranges instead of baked-in calibrated constants.
 # ---------------------------------------------------------------------------

@@ -1435,3 +1435,126 @@ def test_mxmem_registered_with_tunnel_session():
     assert "mxmem.py" in bench_src
     tool_src = open(os.path.join(REPO, "tools", "mxmem.py")).read()
     assert 'tunnel_session.register("mxmem.py"' in tool_src
+
+
+@pytest.mark.rollout
+def test_mxrollout_registered_with_tunnel_session():
+    """mxrollout joins the tunnel-client registry on BOTH sides (MARKERS
+    + bench.py's /proc scan) and self-registers in main()."""
+    import tunnel_session
+    bench_src = open(os.path.join(REPO, "bench.py")).read()
+    assert "mxrollout.py" in tunnel_session.MARKERS
+    assert "mxrollout.py" in bench_src
+    tool_src = open(os.path.join(REPO, "tools", "mxrollout.py")).read()
+    assert 'tunnel_session.register("mxrollout.py"' in tool_src
+
+
+@pytest.mark.rollout
+def test_mxrollout_cli_matrix(tmp_path):
+    """mxrollout: selfcheck proves the bad-canary gate loop in one
+    process (exit 0 + PASS); status/start/rollback against a live server
+    speak /rolloutz (0 healthy, 1 on a 409 refusal or a rolled-back
+    rollout); a dead URL or rollout-mode-off server is "cannot run" (2),
+    never a silent 0."""
+    cli = os.path.join(REPO, "tools", "mxrollout.py")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": "",
+           "MXTPU_TUNNEL_REG_DIR": str(tmp_path / "reg")}
+    p = subprocess.run([sys.executable, cli, "selfcheck"],
+                       capture_output=True, text=True, timeout=300,
+                       env=env)
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "PASS" in p.stdout
+
+    # nothing listening: cannot run (2)
+    dead = "http://127.0.0.1:9"
+    p = subprocess.run([sys.executable, cli, "status", "--url", dead],
+                       capture_output=True, text=True, timeout=60, env=env)
+    assert p.returncode == 2, p.stdout + p.stderr
+
+    # against a live server: status is 2 before any rollout manager is
+    # attached (rollout mode off), the CLI start attaches one, a second
+    # start is a typed 409 refusal (1), rollback turns status unhealthy
+    from mxnet_tpu.serving import load as sload
+    from mxnet_tpu.serving.endpoints import ServingEndpoints
+    from mxnet_tpu.serving.server import ModelConfig, ModelServer
+    sym, params, shape, _ = sload.tiny_model()
+    _, params2, _, _ = sload.tiny_model(seed=1)
+    pfile = tmp_path / "v2.params"
+    pfile.write_bytes(params2)
+    cfg = ModelConfig("m", sym, params, feature_shape=shape,
+                      buckets=(1, 2), max_queue=16, deadline_ms=1000.0,
+                      slo_p99_ms=200.0)
+    server = ModelServer([cfg], drain_on_preemption=False)
+    server.start(warm=False)
+    ep = ServingEndpoints(server, port=0).start()
+    base = "http://127.0.0.1:%d" % ep.port
+    run = lambda *a: subprocess.run([sys.executable, cli, *a, "--url",
+                                     base], capture_output=True,
+                                    text=True, timeout=120, env=env)
+    try:
+        p = run("status")
+        assert p.returncode == 2, p.stdout + p.stderr
+        assert "rollout mode off" in p.stderr
+        p = run("start", "--model", "m", "--version", "v2",
+                "--params", str(pfile), "--knob", "dwell_s=600",
+                "--knob", "shadow_sample=0")
+        assert p.returncode == 0, p.stdout + p.stderr
+        assert "start 'm'" in p.stdout and "version=v2" in p.stdout
+        p = run("status")
+        assert p.returncode == 0, p.stdout + p.stderr
+        assert "v2" in p.stdout and "shadow" in p.stdout
+        p = run("start", "--model", "m", "--version", "v3")
+        assert p.returncode == 1, p.stdout + p.stderr
+        assert "REFUSED" in p.stderr
+        p = run("rollback", "--model", "m", "--reason", "drill")
+        assert p.returncode == 0, p.stdout + p.stderr
+        p = run("status")
+        assert p.returncode == 1, p.stdout + p.stderr
+        assert "ROLLED_BACK" in p.stdout
+        p = run("promote", "--model", "nope")
+        assert p.returncode == 2, p.stdout + p.stderr
+    finally:
+        ep.stop()
+        server.close(timeout=10.0)
+
+
+@pytest.mark.rollout
+def test_loadgen_during_rollout_evidence(tmp_path):
+    """loadgen --during-rollout: the selfhost run carries a live rollout
+    of the same model, prints per-version latency/outcome evidence plus
+    the ramp timeline, and the ledger row embeds the whole readout. The
+    flag is selfhost-only: with --url it is rejected before any backend
+    init (exit 2)."""
+    import json as _json
+    cli = os.path.join(REPO, "tools", "loadgen.py")
+    ledger = str(tmp_path / "ledger.jsonl")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": "",
+           "MXTPU_TUNNEL_REG_DIR": str(tmp_path / "reg")}
+    p = subprocess.run([sys.executable, cli, "--url", "http://x:1",
+                        "--during-rollout"], capture_output=True,
+                       text=True, timeout=60, env=env)
+    assert p.returncode == 2, p.stdout + p.stderr
+    assert "selfhost-only" in p.stderr
+
+    p = subprocess.run([sys.executable, cli, "--selfhost",
+                        "--during-rollout", "--qps", "120",
+                        "--duration", "2.5", "--ledger", ledger],
+                       capture_output=True, text=True, timeout=300,
+                       env=env)
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "loadgen: rollout version" in p.stdout
+    assert "timeline: start -> serving" in p.stdout
+    rows = [_json.loads(l) for l in open(ledger)]
+    ro = rows[-1].get("rollout")
+    assert ro and ro["version"] == "candidate" and ro["incumbent"]
+    assert ro["state"] in ("serving", "promoted")
+    assert [h["action"] for h in ro["timeline"]][:2] == ["start",
+                                                         "serving"]
+    vs = ro["versions"]
+    assert set(vs) == {ro["incumbent"], "candidate"}
+    for row in vs.values():
+        assert abs(sum(row["fractions"].values()) - 1.0) < 1e-6 \
+            or sum(row["counts"].values()) == 0
+    # the candidate actually served sampled traffic during the run
+    assert sum(vs["candidate"]["counts"].values()) > 0
+    assert "p50_ms" in vs[ro["incumbent"]]

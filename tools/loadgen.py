@@ -108,6 +108,14 @@ def main(argv=None) -> int:
                          "the run (pretty-print with tools/mxtrace.py) — "
                          "the retained tail/error timelines behind the "
                          "reported trace_ids")
+    ap.add_argument("--during-rollout", action="store_true",
+                    help="selfhost: start a staged rollout of a same-"
+                         "weights candidate version mid-run and ramp it "
+                         "on fast dwell — the run then reports per-"
+                         "version p50/p99 + outcome fractions and the "
+                         "rollout timeline (the zero-downtime-swap "
+                         "evidence), and the ledger row carries the "
+                         "timeline")
     ap.add_argument("--format", choices=("text", "json"), default="text")
     args = ap.parse_args(argv)
 
@@ -127,6 +135,11 @@ def main(argv=None) -> int:
     except Exception:
         pass
 
+    if args.during_rollout and not args.selfhost:
+        sys.stderr.write("loadgen: --during-rollout is selfhost-only "
+                         "(the rollout manager lives in the serving "
+                         "process)\n")
+        return 2
     if args.tenants:
         if args.url:
             sys.stderr.write("loadgen: --tenants is selfhost-only (the "
@@ -187,12 +200,31 @@ def _run_selfhost(args, qps) -> int:
         sys.stderr.write("loadgen: cannot build the selfhost server: "
                          "%r\n" % e)
         return 2
+    ro = rollout_evidence = None
+    if args.during_rollout:
+        # same-weights candidate: the ramp exercises the whole splitter/
+        # gate/hot-swap machinery while answers stay byte-comparable —
+        # the run itself is the zero-downtime proof
+        try:
+            from mxnet_tpu.serving.rollout import RolloutManager
+            mgr = RolloutManager.attach(server)
+            ro = mgr.start(cfg.name, "candidate",
+                           dwell_s=max(0.05, args.duration / 12.0),
+                           min_shadow=3, min_requests=3,
+                           shadow_sample=0.5)
+        except Exception as e:
+            server.close(timeout=15.0)
+            sys.stderr.write("loadgen: cannot start the rollout: %r\n"
+                             % e)
+            return 2
     try:
         stats = sload.run_load(server, cfg.name, qps=qps,
                                duration_s=args.duration,
                                threads=args.threads,
                                deadline_ms=args.deadline_ms)
         srv_stats = server.stats(cfg.name)
+        if ro is not None:
+            rollout_evidence = _rollout_evidence(server, cfg.name, ro)
     finally:
         server.close(timeout=15.0)
     if args.hedge:
@@ -211,14 +243,89 @@ def _run_selfhost(args, qps) -> int:
             sys.stderr.write("loadgen: trace dump failed: %r\n" % e)
     ledger = (xcost.CostLedger(args.ledger) if args.ledger
               else xcost.get_ledger())
-    row = sload.ledger_row(stats, ledger=ledger,
-                           extra={"target": "selfhost",
-                                  "slow_traces": stats.get("slow_traces"),
-                                  "failed_traces":
-                                      stats.get("failed_traces")})
+    extra = {"target": "selfhost",
+             "slow_traces": stats.get("slow_traces"),
+             "failed_traces": stats.get("failed_traces")}
+    if rollout_evidence is not None:
+        extra["rollout"] = rollout_evidence
+    row = sload.ledger_row(stats, ledger=ledger, extra=extra)
     v = sload.verdict(stats, max_degraded_frac=args.max_degraded_frac)
+    if (rollout_evidence is not None
+            and rollout_evidence["state"] not in ("promoted", "serving")):
+        v = "degraded"
     _emit(args, stats, row, v)
+    if rollout_evidence is not None:
+        _emit_rollout(rollout_evidence)
     return 0 if v == "ok" else 1
+
+
+def _rollout_evidence(server, model, ro):
+    """Per-version latency/outcome readout + the rollout timeline —
+    collected while the server (and the canary state) is still alive."""
+    import numpy as np
+
+    from mxnet_tpu.observability import catalog as _c
+
+    versions = {}
+    outcomes = ("ok", "shed", "expired", "error")
+
+    def _version_row(version, latencies):
+        counts = {oc: int(_c.ROLLOUT_VERSION_REQUESTS.value(
+            model=model, version=version, outcome=oc) or 0)
+            for oc in outcomes}
+        total = sum(counts.values())
+        row = {"counts": counts,
+               "fractions": {oc: (counts[oc] / total if total else 0.0)
+                             for oc in outcomes}}
+        lat = np.asarray(latencies or [], np.float64)
+        if lat.size:
+            row["p50_ms"] = float(np.percentile(lat, 50))
+            row["p99_ms"] = float(np.percentile(lat, 99))
+        return row
+
+    st = server._models.get(model)
+    with st.lock:
+        inc_lat = list(st.latencies)
+    versions[ro.incumbent] = _version_row(ro.incumbent, inc_lat)
+    can = ro.canary
+    can_lat = []
+    if can is not None:
+        with can.lock:
+            can_lat = list(can.latencies)
+    versions[ro.version] = _version_row(ro.version, can_lat)
+    return {"version": ro.version, "incumbent": ro.incumbent,
+            "state": ro.state, "stage": ro.stage,
+            "agreement": ro.agreement(),
+            "timeline": [{k: h[k] for k in ("action", "stage", "reason")
+                          if k in h} for h in ro.history],
+            "versions": versions}
+
+
+def _emit_rollout(ev) -> None:
+    for version in sorted(ev["versions"]):
+        row = ev["versions"][version]
+        c, fr = row["counts"], row["fractions"]
+        tag = " (candidate)" if version == ev["version"] else ""
+        print("loadgen: rollout version %-10s ok=%d shed=%d expired=%d "
+              "error=%d  ok_frac=%.3f  p50=%s p99=%s%s"
+              % (version, c["ok"], c["shed"], c["expired"], c["error"],
+                 fr["ok"],
+                 ("%.2fms" % row["p50_ms"]) if "p50_ms" in row else "n/a",
+                 ("%.2fms" % row["p99_ms"]) if "p99_ms" in row else "n/a",
+                 tag), flush=True)
+    steps = []
+    for h in ev["timeline"]:
+        step = h["action"]
+        if h.get("stage") and h["action"] == "stage":
+            step = "stage:%s" % h["stage"]
+        if h.get("reason"):
+            step += "(%s)" % h["reason"]
+        steps.append(step)
+    print("loadgen: rollout %s -> %s  state=%s agreement=%s  timeline: %s"
+          % (ev["incumbent"], ev["version"], ev["state"],
+             ("%.3f" % ev["agreement"]) if ev["agreement"] is not None
+             else "n/a",
+             " -> ".join(steps)), flush=True)
 
 
 def _parse_tenants(spec: str):

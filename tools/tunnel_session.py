@@ -32,7 +32,7 @@ __all__ = ["MARKERS", "reg_dir", "register", "owned_pids", "kill"]
 # same marker list bench.py scans /proc for
 MARKERS = ("aot_warm.py", "perf_lab.py", "mxtune.py", "collbench.py",
            "mxserve.py", "loadgen.py", "mxquant.py", "mxtrace.py",
-           "mxfleet.py", "mxmem.py", "tpu_session")
+           "mxfleet.py", "mxmem.py", "mxrollout.py", "tpu_session")
 
 
 def reg_dir() -> str:
